@@ -1,0 +1,22 @@
+"""koordinator_tpu — a TPU-native colocation scheduling framework.
+
+A from-scratch rebuild of the capabilities of Koordinator (QoS-based colocation
+scheduling for Kubernetes) designed TPU-first:
+
+- Cluster state (nodes, pods, NUMA topology, quota trees, gangs, reservations,
+  devices) lives in columnar, device-resident tensors (`snapshot/`).
+- The scheduler's per-pod Filter/Score hot loop becomes batched JAX kernels
+  emitting a pods x nodes score matrix reduced with top-k (`scheduler/`, `ops/`).
+- Scale-out is sharding the node axis of the snapshot over a `jax.sharding.Mesh`
+  (ICI collectives for the global top-k reduce), see `parallel/`.
+- The node agent (koordlet), SLO controller, descheduler, webhook, and runtime
+  hook components exist as capability-equivalent host-side subsystems feeding
+  the device snapshot (`koordlet/`, `slo_controller/`, `descheduler/`,
+  `webhook/`, `runtimeproxy/`).
+
+Reference: hhyasdf/koordinator (see SURVEY.md at the repo root). Reference
+file:line citations appear in docstrings throughout so behavior parity can be
+checked; the implementation is original and TPU-native.
+"""
+
+__version__ = "0.1.0"

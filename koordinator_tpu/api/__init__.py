@@ -1,0 +1,15 @@
+"""API layer: the protocol (QoS classes, priority bands, resource kinds,
+label/annotation keys) and CRD-equivalent typed objects.
+
+Mirrors the capability surface of the reference's `apis/` tree
+(apis/extension, apis/slo, apis/scheduling, apis/quota, apis/configuration).
+"""
+
+from koordinator_tpu.api.extension import (  # noqa: F401
+    QoSClass,
+    PriorityClass,
+    ResourceKind,
+    PRIORITY_BANDS,
+    priority_class_of,
+    translate_resource_by_priority,
+)

@@ -1,0 +1,182 @@
+"""The annotation/label protocol: QoS classes, priority bands, extended
+resources, and the fixed resource-dimension enum used by the device tensors.
+
+Capability parity with the reference's `apis/extension/` package:
+- QoS classes LSE/LSR/LS/BE/SYSTEM (apis/extension/qos.go:23-28)
+- Priority bands koord-prod 9000-9999 / mid 7000-7999 / batch 5000-5999 /
+  free 3000-3999 (apis/extension/priority.go:38-48)
+- Batch/Mid extended resources kubernetes.io/batch-cpu|batch-memory|
+  mid-cpu|mid-memory (apis/extension/resource.go:26-29)
+- Device resources gpu-core/gpu-memory/gpu-memory-ratio/rdma/fpga
+  (apis/extension/device_share.go:38-55)
+
+TPU-native addition: `ResourceKind` is the *fixed, static* resource axis of
+every device tensor. XLA requires static shapes, so instead of the reference's
+open-ended `map[ResourceName]Quantity`, cluster state is columnar over this
+enum. Canonical device units: CPU-like dims in millicores, memory-like dims in
+MiB (float32-safe up to ~16 PiB), device dims in device-specific units.
+"""
+
+from __future__ import annotations
+
+import enum
+from typing import Mapping, Optional
+
+
+class QoSClass(enum.IntEnum):
+    """Koordinator QoS classes (apis/extension/qos.go:23-28).
+
+    Integer-valued so pod QoS can live in an int8 device column.
+    """
+
+    NONE = 0
+    SYSTEM = 1
+    LSE = 2  # latency-sensitive exclusive: pinned cpus, no sharing
+    LSR = 3  # latency-sensitive reserved: pinned cpus, sharable with BE
+    LS = 4   # latency-sensitive (shared pool)
+    BE = 5   # best effort (reclaimed/batch resources)
+
+    @classmethod
+    def parse(cls, s: str) -> "QoSClass":
+        try:
+            return cls[s.upper()] if s else cls.NONE
+        except KeyError:
+            return cls.NONE
+
+
+class PriorityClass(enum.IntEnum):
+    """Koordinator priority classes (apis/extension/priority.go:29-35)."""
+
+    NONE = 0
+    FREE = 1
+    BATCH = 2
+    MID = 3
+    PROD = 4
+
+    @classmethod
+    def parse(cls, s: str) -> "PriorityClass":
+        key = s.replace("koord-", "").upper() if s else ""
+        try:
+            return cls[key] if key else cls.NONE
+        except KeyError:
+            return cls.NONE
+
+    @property
+    def text(self) -> str:
+        return "" if self is PriorityClass.NONE else f"koord-{self.name.lower()}"
+
+
+# Priority value bands (apis/extension/priority.go:38-48): class -> (min, max).
+PRIORITY_BANDS: Mapping[PriorityClass, tuple] = {
+    PriorityClass.PROD: (9000, 9999),
+    PriorityClass.MID: (7000, 7999),
+    PriorityClass.BATCH: (5000, 5999),
+    PriorityClass.FREE: (3000, 3999),
+}
+
+DEFAULT_PRIORITY_CLASS = PriorityClass.NONE
+
+
+def priority_class_of(priority: Optional[int],
+                      label: str = "") -> PriorityClass:
+    """Resolve a pod's PriorityClass from its priority value or override label.
+
+    Mirrors GetPodPriorityClassRaw/getPriorityClassByPriority
+    (apis/extension/priority.go:73-103): the `koordinator.sh/priority-class`
+    label wins; otherwise the numeric priority is matched against the bands.
+    """
+    if label:
+        parsed = PriorityClass.parse(label)
+        if parsed is not PriorityClass.NONE:
+            return parsed
+    if priority is None:
+        return PriorityClass.NONE
+    for cls, (lo, hi) in PRIORITY_BANDS.items():
+        if lo <= priority <= hi:
+            return cls
+    return DEFAULT_PRIORITY_CLASS
+
+
+class ResourceKind(enum.IntEnum):
+    """The static resource axis R of all device tensors.
+
+    Covers the reference's standard + extended resources:
+    cpu/memory (k8s core), batch-* / mid-* overcommit resources
+    (apis/extension/resource.go:26-29), and device resources
+    (apis/extension/device_share.go).
+    """
+
+    CPU = 0            # millicores
+    MEMORY = 1         # MiB
+    BATCH_CPU = 2      # millicores (BE-tier overcommit)
+    BATCH_MEMORY = 3   # MiB
+    MID_CPU = 4        # millicores (Mid-tier overcommit)
+    MID_MEMORY = 5     # MiB
+    GPU_CORE = 6       # percent-of-one-GPU units (100 == one full GPU)
+    GPU_MEMORY = 7     # MiB
+    EPHEMERAL_STORAGE = 8  # MiB
+    RDMA = 9           # percent units
+    FPGA = 10          # percent units
+
+    @classmethod
+    def dim(cls) -> int:
+        return len(cls)
+
+
+NUM_RESOURCES = ResourceKind.dim()
+
+# k8s-style resource-name strings <-> ResourceKind.
+RESOURCE_NAMES: Mapping[str, ResourceKind] = {
+    "cpu": ResourceKind.CPU,
+    "memory": ResourceKind.MEMORY,
+    "kubernetes.io/batch-cpu": ResourceKind.BATCH_CPU,
+    "kubernetes.io/batch-memory": ResourceKind.BATCH_MEMORY,
+    "kubernetes.io/mid-cpu": ResourceKind.MID_CPU,
+    "kubernetes.io/mid-memory": ResourceKind.MID_MEMORY,
+    "koordinator.sh/gpu-core": ResourceKind.GPU_CORE,
+    "koordinator.sh/gpu-memory": ResourceKind.GPU_MEMORY,
+    "ephemeral-storage": ResourceKind.EPHEMERAL_STORAGE,
+    "koordinator.sh/rdma": ResourceKind.RDMA,
+    "koordinator.sh/fpga": ResourceKind.FPGA,
+}
+
+# Label / annotation keys (apis/extension/constants.go).
+DOMAIN_PREFIX = "koordinator.sh/"
+SCHEDULING_DOMAIN_PREFIX = "scheduling.koordinator.sh"
+NODE_DOMAIN_PREFIX = "node.koordinator.sh"
+POD_DOMAIN_PREFIX = "pod.koordinator.sh"
+
+LABEL_POD_QOS = DOMAIN_PREFIX + "qosClass"
+LABEL_POD_PRIORITY = DOMAIN_PREFIX + "priority"
+LABEL_POD_PRIORITY_CLASS = DOMAIN_PREFIX + "priority-class"
+LABEL_PODGROUP = "pod-group.scheduling.sigs.k8s.io"  # gang membership
+LABEL_QUOTA_NAME = "quota.scheduling.koordinator.sh/name"
+ANNOTATION_RESOURCE_SPEC = SCHEDULING_DOMAIN_PREFIX + "/resource-spec"
+ANNOTATION_RESOURCE_STATUS = SCHEDULING_DOMAIN_PREFIX + "/resource-status"
+ANNOTATION_RESERVATION_ALLOCATED = SCHEDULING_DOMAIN_PREFIX + "/reservation-allocated"
+ANNOTATION_EXTENDED_RESOURCE_SPEC = NODE_DOMAIN_PREFIX + "/extended-resource-spec"
+ANNOTATION_NODE_CPU_NORMALIZATION_RATIO = NODE_DOMAIN_PREFIX + "/cpu-normalization-ratio"
+ANNOTATION_NODE_RAW_ALLOCATABLE = NODE_DOMAIN_PREFIX + "/raw-allocatable"
+ANNOTATION_NODE_RESERVATION = NODE_DOMAIN_PREFIX + "/reservation"
+
+
+def translate_resource_by_priority(kind: ResourceKind,
+                                   priority_class: PriorityClass) -> ResourceKind:
+    """Map cpu/memory to the priority tier's extended resource.
+
+    Mirrors TranslateResourceNameByPriorityClass
+    (apis/extension/resource.go:52-57): Batch pods request batch-cpu/
+    batch-memory; Mid pods request mid-cpu/mid-memory; Prod/None keep the
+    native resource.
+    """
+    if priority_class is PriorityClass.BATCH:
+        if kind is ResourceKind.CPU:
+            return ResourceKind.BATCH_CPU
+        if kind is ResourceKind.MEMORY:
+            return ResourceKind.BATCH_MEMORY
+    elif priority_class is PriorityClass.MID:
+        if kind is ResourceKind.CPU:
+            return ResourceKind.MID_CPU
+        if kind is ResourceKind.MEMORY:
+            return ResourceKind.MID_MEMORY
+    return kind

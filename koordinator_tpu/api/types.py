@@ -1,0 +1,339 @@
+"""CRD-equivalent typed objects.
+
+These are the host-side typed objects that the snapshot builder columnarizes
+into device tensors, and that the control-plane components (slo_controller,
+descheduler, webhook) produce/consume. They mirror the reference CRDs:
+
+- NodeMetric / NodeSLO            (apis/slo/v1alpha1, SURVEY.md 2.6)
+- Reservation / Device / PodMigrationJob (apis/scheduling/v1alpha1)
+- PodGroup / ElasticQuota         (vendored scheduling.sigs.k8s.io types)
+- ClusterColocationProfile        (apis/config/v1alpha1)
+- NodeResourceTopology            (topology.node.k8s.io)
+
+ResourceList is a plain dict keyed by ResourceKind in canonical device units
+(cpu-like: millicores, memory-like: MiB) — see api/extension.py.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Dict, List, Mapping, Optional, Tuple
+
+from koordinator_tpu.api.extension import (
+    PriorityClass,
+    QoSClass,
+    ResourceKind,
+    priority_class_of,
+)
+
+ResourceList = Dict[ResourceKind, float]
+
+
+def add_resources(a: ResourceList, b: ResourceList) -> ResourceList:
+    out = dict(a)
+    for k, v in b.items():
+        out[k] = out.get(k, 0.0) + v
+    return out
+
+
+@dataclasses.dataclass
+class ObjectMeta:
+    name: str = ""
+    namespace: str = "default"
+    uid: str = ""
+    labels: Dict[str, str] = dataclasses.field(default_factory=dict)
+    annotations: Dict[str, str] = dataclasses.field(default_factory=dict)
+
+    @property
+    def namespaced_name(self) -> str:
+        return f"{self.namespace}/{self.name}"
+
+
+@dataclasses.dataclass
+class Pod:
+    """A pending or running pod, pre-resolved to the koordinator protocol.
+
+    `requests`/`limits` aggregate the pod's containers (the reference uses
+    PodRequestsAndLimits, estimator/default_estimator.go:62).
+    """
+
+    meta: ObjectMeta = dataclasses.field(default_factory=ObjectMeta)
+    requests: ResourceList = dataclasses.field(default_factory=dict)
+    limits: ResourceList = dataclasses.field(default_factory=dict)
+    priority: Optional[int] = None
+    node_name: str = ""          # "" == pending
+    scheduler_name: str = "koord-scheduler"
+    priority_class_label: str = ""
+    qos_label: str = ""
+    gang_name: str = ""          # pod-group label (coscheduling)
+    quota_name: str = ""         # elastic quota label
+    is_daemonset: bool = False
+    # NUMA / fine-grained CPU request (annotation resource-spec)
+    cpu_bind_policy: str = ""    # "", FullPCPUs, SpreadByPCPUs
+    required_cpu_bind: bool = False
+    # node selection
+    node_selector: Dict[str, str] = dataclasses.field(default_factory=dict)
+    # device request (gpu-core percent, gpu-memory MiB) folded into requests
+    phase: str = "Pending"
+
+    @property
+    def qos(self) -> QoSClass:
+        return QoSClass.parse(self.qos_label)
+
+    @property
+    def priority_class(self) -> PriorityClass:
+        return priority_class_of(self.priority, self.priority_class_label)
+
+
+@dataclasses.dataclass
+class NUMAZone:
+    """One NUMA node's capacity on a machine (NodeResourceTopology zone)."""
+
+    cpus_milli: float = 0.0
+    memory_mib: float = 0.0
+    # bitmask of logical CPU ids in this zone (python int bitmask, host-side)
+    cpuset: int = 0
+
+
+@dataclasses.dataclass
+class NodeResourceTopology:
+    """Per-node CPU/NUMA topology (topology.node.k8s.io NodeResourceTopology;
+    reported by koordlet statesinformer, SURVEY.md 2.2)."""
+
+    node_name: str = ""
+    zones: List[NUMAZone] = dataclasses.field(default_factory=list)
+    cpus_per_core: int = 2  # SMT siblings per physical core
+    kubelet_reserved_cpuset: int = 0
+    policy: str = "None"    # kubelet topology manager policy
+
+
+@dataclasses.dataclass
+class Node:
+    meta: ObjectMeta = dataclasses.field(default_factory=ObjectMeta)
+    allocatable: ResourceList = dataclasses.field(default_factory=dict)
+    unschedulable: bool = False
+    topology: Optional[NodeResourceTopology] = None
+
+
+@dataclasses.dataclass
+class ResourceMap:
+    """Point-in-time resource usage (slo/v1alpha1 ResourceMap)."""
+
+    resources: ResourceList = dataclasses.field(default_factory=dict)
+
+
+@dataclasses.dataclass
+class AggregatedUsage:
+    """Percentile usage over a duration window
+    (nodemetric_types.go aggregated metrics: p50/p90/p95/p99)."""
+
+    # aggregation type ("avg"/"p50"/"p90"/"p95"/"p99") -> usage
+    usages: Dict[str, ResourceList] = dataclasses.field(default_factory=dict)
+    duration_seconds: float = 0.0
+
+
+@dataclasses.dataclass
+class PodMetricInfo:
+    namespace: str = ""
+    name: str = ""
+    priority_class: PriorityClass = PriorityClass.NONE
+    usage: ResourceList = dataclasses.field(default_factory=dict)
+
+    @property
+    def namespaced_name(self) -> str:
+        return f"{self.namespace}/{self.name}"
+
+
+@dataclasses.dataclass
+class NodeMetric:
+    """Per-node usage report written by the node agent
+    (slo/v1alpha1 NodeMetric, nodemetric_types.go:39-123)."""
+
+    node_name: str = ""
+    update_time: float = 0.0           # unix seconds
+    report_interval_seconds: float = 60.0
+    node_usage: ResourceList = dataclasses.field(default_factory=dict)
+    system_usage: ResourceList = dataclasses.field(default_factory=dict)
+    aggregated: List[AggregatedUsage] = dataclasses.field(default_factory=list)
+    pods_metric: List[PodMetricInfo] = dataclasses.field(default_factory=list)
+    prod_reclaimable: ResourceList = dataclasses.field(default_factory=dict)
+
+    def is_expired(self, expiration_seconds: float,
+                   now: Optional[float] = None) -> bool:
+        """isNodeMetricExpired (plugins/loadaware/helper.go)."""
+        now = time.time() if now is None else now
+        return (self.update_time <= 0
+                or now - self.update_time >= expiration_seconds)
+
+    def aggregated_usage(self, agg_type: str,
+                         duration_seconds: float = 0.0) -> Optional[ResourceList]:
+        """getTargetAggregatedUsage (plugins/loadaware/helper.go): pick the
+        window with the largest duration <= requested (or the max window when
+        duration==0), then the requested percentile."""
+        if not self.aggregated:
+            return None
+        best = None
+        for agg in self.aggregated:
+            if duration_seconds <= 0 or agg.duration_seconds <= duration_seconds:
+                if best is None or agg.duration_seconds > best.duration_seconds:
+                    best = agg
+        if best is None:
+            best = min(self.aggregated, key=lambda a: a.duration_seconds)
+        return best.usages.get(agg_type)
+
+
+# --- NodeSLO ----------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class ResourceThresholdStrategy:
+    """resourceUsedThresholdWithBE (slo/v1alpha1 nodeslo_types.go): drives
+    koordlet cpusuppress."""
+
+    enable: bool = False
+    cpu_suppress_threshold_percent: float = 65.0
+    cpu_suppress_policy: str = "cpuset"  # cpuset | cfsQuota
+    memory_evict_threshold_percent: float = 70.0
+    cpu_evict_be_usage_threshold_percent: float = 90.0
+    cpu_evict_time_window_seconds: float = 60.0
+
+
+@dataclasses.dataclass
+class CPUBurstStrategy:
+    policy: str = "none"  # none | cpuBurstOnly | cfsQuotaBurstOnly | auto
+    cpu_burst_percent: float = 1000.0
+    cfs_quota_burst_percent: float = 300.0
+    cfs_quota_burst_period_seconds: float = -1.0
+    share_pool_threshold_percent: float = 50.0
+
+
+@dataclasses.dataclass
+class ResourceQOSStrategy:
+    """Per-QoS-tier cgroup knobs (resourceQOS in nodeslo_types.go), flattened
+    to the fields the TPU build's qosmanager enforces."""
+
+    # qos tier -> {knob: value}; knobs e.g. groupIdentity, memoryQOS priority,
+    # resctrl llc/mba percent
+    tiers: Dict[str, Dict[str, float]] = dataclasses.field(default_factory=dict)
+
+
+@dataclasses.dataclass
+class SystemStrategy:
+    min_free_kbytes_factor: float = 100.0
+    watermark_scale_factor: float = 150.0
+    memcg_reap_enabled: bool = False
+
+
+@dataclasses.dataclass
+class NodeSLO:
+    node_name: str = ""
+    threshold: ResourceThresholdStrategy = dataclasses.field(
+        default_factory=ResourceThresholdStrategy)
+    cpu_burst: CPUBurstStrategy = dataclasses.field(
+        default_factory=CPUBurstStrategy)
+    resource_qos: ResourceQOSStrategy = dataclasses.field(
+        default_factory=ResourceQOSStrategy)
+    system: SystemStrategy = dataclasses.field(default_factory=SystemStrategy)
+
+
+# --- Scheduling CRDs --------------------------------------------------------
+
+
+@dataclasses.dataclass
+class Reservation:
+    """Reserved capacity scheduled like a pod, later consumed by matching
+    owners (scheduling/v1alpha1 reservation_types.go:27-64)."""
+
+    meta: ObjectMeta = dataclasses.field(default_factory=ObjectMeta)
+    requests: ResourceList = dataclasses.field(default_factory=dict)
+    owner_label_selector: Dict[str, str] = dataclasses.field(default_factory=dict)
+    ttl_seconds: float = 86400.0
+    allocate_once: bool = True
+    node_name: str = ""         # set when the reservation is scheduled
+    phase: str = "Pending"      # Pending|Available|Succeeded|Failed|Expired
+    allocated: ResourceList = dataclasses.field(default_factory=dict)
+    create_time: float = 0.0
+
+    def matches(self, pod: Pod) -> bool:
+        sel = self.owner_label_selector
+        return bool(sel) and all(
+            pod.meta.labels.get(k) == v for k, v in sel.items())
+
+
+@dataclasses.dataclass
+class PodGroup:
+    """Gang definition (scheduling.sigs.k8s.io PodGroup)."""
+
+    meta: ObjectMeta = dataclasses.field(default_factory=ObjectMeta)
+    min_member: int = 1
+    total_member: int = 0
+    mode: str = "Strict"           # Strict | NonStrict
+    wait_time_seconds: float = 600.0
+    phase: str = "Pending"
+
+
+@dataclasses.dataclass
+class ElasticQuota:
+    """Hierarchical quota node (scheduling.sigs.k8s.io ElasticQuota with
+    koordinator's hierarchy annotations; SURVEY.md 2.1 ElasticQuota plugin)."""
+
+    meta: ObjectMeta = dataclasses.field(default_factory=ObjectMeta)
+    parent: str = ""               # parent quota name ("" == root child)
+    min: ResourceList = dataclasses.field(default_factory=dict)
+    max: ResourceList = dataclasses.field(default_factory=dict)
+    shared_weight: ResourceList = dataclasses.field(default_factory=dict)
+    is_parent: bool = False
+    allow_lent_resource: bool = True
+    tree_id: str = ""              # multi-quota-tree support
+
+
+@dataclasses.dataclass
+class DeviceInfo:
+    """One device on a node (scheduling/v1alpha1 device_types.go)."""
+
+    minor: int = 0
+    type: str = "gpu"              # gpu | rdma | fpga
+    health: bool = True
+    resources: ResourceList = dataclasses.field(default_factory=dict)
+    numa_node: int = 0
+    pcie_id: str = ""
+
+
+@dataclasses.dataclass
+class Device:
+    node_name: str = ""
+    devices: List[DeviceInfo] = dataclasses.field(default_factory=list)
+
+
+@dataclasses.dataclass
+class PodMigrationJob:
+    """Descheduler-driven migration (scheduling/v1alpha1
+    pod_migration_job_types.go)."""
+
+    meta: ObjectMeta = dataclasses.field(default_factory=ObjectMeta)
+    pod_namespace: str = ""
+    pod_name: str = ""
+    mode: str = "ReservationFirst"  # ReservationFirst | EvictDirectly
+    ttl_seconds: float = 300.0
+    phase: str = "Pending"  # Pending|Running|Succeeded|Failed
+    reservation_name: str = ""
+    reason: str = ""
+
+
+@dataclasses.dataclass
+class ClusterColocationProfile:
+    """Webhook mutation profile (config/v1alpha1
+    cluster_colocation_profile_types.go; webhook mutator
+    pod/mutating/cluster_colocation_profile.go:53-157)."""
+
+    meta: ObjectMeta = dataclasses.field(default_factory=ObjectMeta)
+    namespace_selector: Dict[str, str] = dataclasses.field(default_factory=dict)
+    selector: Dict[str, str] = dataclasses.field(default_factory=dict)
+    labels: Dict[str, str] = dataclasses.field(default_factory=dict)
+    annotations: Dict[str, str] = dataclasses.field(default_factory=dict)
+    qos_class: str = ""
+    priority_class_name: str = ""
+    koordinator_priority: Optional[int] = None
+    scheduler_name: str = ""
+    probability: float = 1.0       # random-percent gating (reference supports %)

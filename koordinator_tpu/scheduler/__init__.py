@@ -1,0 +1,12 @@
+"""The TPU-native scheduler: batched Filter/Score/Commit over the snapshot.
+
+The reference's per-pod scheduling cycle (SURVEY.md 3.1) — PreFilter →
+Filter (parallel over nodes) → Score → selectHost → Reserve → Permit →
+PreBind → Bind — becomes one jitted program over a pods x nodes matrix:
+
+- plugins (`plugins/`) are pure functions (snapshot, pod_batch) -> masks /
+  score matrices, replacing the per-node Go loops (HOT LOOP #1/#2,
+  framework_extender.go:204-259);
+- `core.schedule_batch` fuses feasibility + scoring + a conflict-resolving
+  batched commit (the assume/bind dance) in fixed rounds of lax.scan.
+"""

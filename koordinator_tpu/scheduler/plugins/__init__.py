@@ -1,0 +1,5 @@
+"""Scheduler plugins as pure batched kernels.
+
+Each module mirrors one reference plugin (SURVEY.md 2.1) as functions over
+(NodeState/ClusterSnapshot, PodBatch) returning [P, N] masks or scores.
+"""

@@ -1,0 +1,177 @@
+"""LoadAwareScheduling as batched JAX kernels.
+
+Behavior parity with plugins/loadaware/load_aware.go:
+- Filter (load_aware.go:123-254): reject a node when its (aggregated)
+  utilization percentage meets a per-resource threshold; prod pods are gated
+  on prod-tier usage when ProdUsageThresholds is set; nodes without a fresh
+  NodeMetric pass (missing koordlet is tolerated); DaemonSet pods pass.
+- Score (load_aware.go:269-335): estimatedUsed = estimator(pod) + Σ
+  estimates of recently-assigned pods + node usage (instant or percentile),
+  scored with weighted least-requested (load_aware.go:378-397).
+
+The whole plugin is two dense [P, N] kernels; the reference's per-node map
+lookups become gathers on NodeState columns. Integer-division semantics of
+the Go scorer (floor) are reproduced in float32.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Mapping, Optional
+
+import flax.struct
+import jax.numpy as jnp
+import numpy as np
+
+from koordinator_tpu.api.extension import NUM_RESOURCES, PriorityClass, ResourceKind
+from koordinator_tpu.snapshot.schema import AGG_TYPES, NodeState, PodBatch
+
+MAX_NODE_SCORE = 100.0  # framework.MaxNodeScore
+
+
+@flax.struct.dataclass
+class LoadAwareConfig:
+    """Device-side LoadAwareSchedulingArgs (scheduler config types.go:30-58).
+
+    Threshold/weight vectors are indexed by ResourceKind; 0 disables a
+    resource (matching the reference's "threshold == 0 -> skip").
+    `filter_agg_idx` / `score_agg_idx` select a percentile row in
+    NodeState.agg_usage; -1 means instant usage.
+    """
+
+    resource_weights: jnp.ndarray      # f32[R]
+    usage_thresholds: jnp.ndarray      # f32[R] percent
+    prod_usage_thresholds: jnp.ndarray # f32[R] percent (all-zero = disabled)
+    agg_usage_thresholds: jnp.ndarray  # f32[R] percent (aggregated profile)
+    filter_agg_idx: jnp.ndarray        # i32[] row into AGG_TYPES, -1 = instant
+    score_agg_idx: jnp.ndarray         # i32[] row into AGG_TYPES, -1 = instant
+    score_according_prod_usage: jnp.ndarray  # bool[]
+
+    @staticmethod
+    def make(resource_weights: Optional[Mapping[ResourceKind, float]] = None,
+             usage_thresholds: Optional[Mapping[ResourceKind, float]] = None,
+             prod_usage_thresholds: Optional[Mapping[ResourceKind, float]] = None,
+             agg_usage_thresholds: Optional[Mapping[ResourceKind, float]] = None,
+             filter_agg_type: str = "",
+             score_agg_type: str = "",
+             score_according_prod_usage: bool = False) -> "LoadAwareConfig":
+        def vec(m, default):
+            out = np.zeros((NUM_RESOURCES,), np.float32)
+            for k, v in (default if m is None else m).items():
+                out[int(k)] = v
+            return out
+
+        default_weights = {ResourceKind.CPU: 1.0, ResourceKind.MEMORY: 1.0}
+        default_thresholds = {ResourceKind.CPU: 65.0, ResourceKind.MEMORY: 95.0}
+        return LoadAwareConfig(
+            resource_weights=jnp.asarray(vec(resource_weights, default_weights)),
+            usage_thresholds=jnp.asarray(vec(usage_thresholds, default_thresholds)),
+            prod_usage_thresholds=jnp.asarray(vec(prod_usage_thresholds, {})),
+            agg_usage_thresholds=jnp.asarray(vec(agg_usage_thresholds, {})),
+            filter_agg_idx=jnp.int32(AGG_TYPES.index(filter_agg_type)
+                                     if filter_agg_type else -1),
+            score_agg_idx=jnp.int32(AGG_TYPES.index(score_agg_type)
+                                    if score_agg_type else -1),
+            score_according_prod_usage=jnp.asarray(score_according_prod_usage),
+        )
+
+
+def _usage_percent(used: jnp.ndarray, total: jnp.ndarray) -> jnp.ndarray:
+    """math.Round(used/total*100), 0 where total == 0 (filterNodeUsage math).
+
+    Go math.Round is half-away-from-zero; jnp.round would be half-to-even
+    and flip decisions at exact .5 boundaries. Values are >= 0 here.
+    """
+    pct = jnp.where(total > 0,
+                    jnp.floor(used / jnp.maximum(total, 1e-9) * 100.0 + 0.5),
+                    0.0)
+    return pct
+
+
+def filter_mask(nodes: NodeState, pods: PodBatch,
+                cfg: LoadAwareConfig) -> jnp.ndarray:
+    """bool[P, N]: True = node passes the LoadAware filter for the pod.
+
+    Mirrors Plugin.Filter (load_aware.go:123-254). Per-node custom
+    usage-threshold annotations are folded into the snapshot upstream.
+    """
+    alloc = nodes.allocatable                     # [N, R]
+    # Instant- or percentile-usage source for the standard gate. When the
+    # aggregated profile is configured but a node has no percentile data,
+    # getTargetAggregatedUsage returns nil and the node passes -> usage 0.
+    agg_row = jnp.take(nodes.agg_usage, jnp.maximum(cfg.filter_agg_idx, 0),
+                       axis=1)                    # [N, R]
+    used = jnp.where(
+        cfg.filter_agg_idx >= 0,
+        jnp.where(nodes.has_agg[:, None], agg_row, 0.0),
+        nodes.usage)
+    thresholds = jnp.where(cfg.filter_agg_idx >= 0, cfg.agg_usage_thresholds,
+                           cfg.usage_thresholds)  # [R]
+
+    pct = _usage_percent(used, alloc)             # [N, R]
+    over = (thresholds[None, :] > 0) & (alloc > 0) & (pct >= thresholds[None, :])
+    node_ok = ~jnp.any(over, axis=-1)             # [N]
+
+    # prod gate (filterProdUsage, load_aware.go:228-254)
+    prod_pct = _usage_percent(nodes.prod_usage, alloc)
+    prod_over = ((cfg.prod_usage_thresholds[None, :] > 0) & (alloc > 0)
+                 & (prod_pct >= cfg.prod_usage_thresholds[None, :]))
+    prod_node_ok = ~jnp.any(prod_over, axis=-1)   # [N]
+
+    has_prod_gate = jnp.any(cfg.prod_usage_thresholds > 0)
+    is_prod = pods.priority_class == int(PriorityClass.PROD)  # [P]
+    use_prod_gate = has_prod_gate & is_prod        # [P]
+
+    ok = jnp.where(use_prod_gate[:, None], prod_node_ok[None, :],
+                   node_ok[None, :])               # [P, N]
+
+    # nodes without fresh metrics pass; DaemonSet pods pass
+    ok = ok | ~nodes.metric_fresh[None, :] | pods.daemonset[:, None]
+    return ok
+
+
+def _guarded_sub(source: jnp.ndarray, correction: jnp.ndarray) -> jnp.ndarray:
+    """quantity.Sub(q) guarded by quantity.Cmp(q) >= 0 (load_aware.go:303-309)."""
+    return source - jnp.where(source >= correction, correction, 0.0)
+
+
+def score_matrix(nodes: NodeState, pods: PodBatch,
+                 cfg: LoadAwareConfig) -> jnp.ndarray:
+    """f32[P, N] in [0, 100]: weighted least-requested on estimated usage.
+
+    Mirrors Plugin.Score (load_aware.go:269-335) + loadAwareSchedulingScorer
+    (:378-397). Nodes without a fresh NodeMetric score 0.
+    """
+    alloc = nodes.allocatable                                    # [N, R]
+
+    # --- non-prod path: node usage source (instant or percentile)
+    agg_row = jnp.take(nodes.agg_usage, jnp.maximum(cfg.score_agg_idx, 0),
+                       axis=1)                                   # [N, R]
+    # scoreWithAggregation: missing percentile data contributes zero usage
+    usage_src = jnp.where(
+        cfg.score_agg_idx >= 0,
+        jnp.where(nodes.has_agg[:, None], agg_row, 0.0),
+        nodes.usage)                                             # [N, R]
+    node_term = (nodes.assigned_estimated
+                 + _guarded_sub(usage_src, nodes.assigned_correction))  # [N, R]
+
+    # --- prod path: Σ prod pod usages excluding estimated ones
+    prod_term = (nodes.prod_assigned_estimated
+                 + jnp.maximum(nodes.prod_usage - nodes.prod_assigned_correction,
+                               0.0))                             # [N, R]
+
+    is_prod_scored = (cfg.score_according_prod_usage
+                      & (pods.priority_class == int(PriorityClass.PROD)))  # [P]
+    base = jnp.where(is_prod_scored[:, None, None], prod_term[None, :, :],
+                     node_term[None, :, :])                      # [P, N, R]
+    estimated_used = pods.estimated[:, None, :] + base           # [P, N, R]
+
+    # leastRequestedScore with Go integer-division flooring (:389-397)
+    cap = alloc[None, :, :]
+    least = jnp.floor((cap - estimated_used) * MAX_NODE_SCORE
+                      / jnp.maximum(cap, 1e-9))
+    least = jnp.where((cap > 0) & (estimated_used <= cap), least, 0.0)
+    weights = cfg.resource_weights
+    weight_sum = jnp.maximum(jnp.sum(weights), 1e-9)
+    score = jnp.floor(jnp.einsum("pnr,r->pn", least, weights) / weight_sum)
+
+    return jnp.where(nodes.metric_fresh[None, :], score, 0.0)

@@ -1,0 +1,18 @@
+"""Core data plane: columnar cluster state mirrored into device tensors.
+
+The reference's scheduler works off informer caches + a NodeInfo snapshot
+(k8s framework); here the equivalent is a versioned, double-buffered
+`ClusterSnapshot` pytree of fixed-shape arrays (SURVEY.md 2.9, 7.1).
+"""
+
+from koordinator_tpu.snapshot.schema import (  # noqa: F401
+    AGG_TYPES,
+    ClusterSnapshot,
+    GangState,
+    NodeState,
+    PodBatch,
+    QuotaState,
+    ReservationState,
+)
+from koordinator_tpu.snapshot.builder import SnapshotBuilder  # noqa: F401
+from koordinator_tpu.snapshot.store import SnapshotStore  # noqa: F401

@@ -1,0 +1,532 @@
+"""Host-side ingest: typed API objects -> columnar numpy -> ClusterSnapshot.
+
+This is the equivalent of the reference's informer caches + NodeInfo snapshot
+construction, plus the host half of the LoadAware plugin's per-cycle state:
+
+- the estimator (estimator/default_estimator.go:62-110) is vectorized here so
+  PodBatch.estimated is precomputed once per batch;
+- the podAssignCache adjustment (load_aware.go:260-267, 340-378:
+  estimatedAssignedPodUsed) is folded into NodeState.assigned_estimated and a
+  usage correction, so the device score kernel is pure arithmetic.
+
+Everything is plain numpy; `SnapshotStore` (store.py) owns device upload.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Dict, Iterable, List, Mapping, Optional, Sequence, Tuple
+
+import numpy as np
+
+from koordinator_tpu.api.extension import (
+    NUM_RESOURCES,
+    PriorityClass,
+    QoSClass,
+    ResourceKind,
+    translate_resource_by_priority,
+)
+from koordinator_tpu.api.types import (
+    Device,
+    ElasticQuota,
+    Node,
+    NodeMetric,
+    Pod,
+    PodGroup,
+    Reservation,
+    ResourceList,
+)
+from koordinator_tpu.snapshot.schema import (
+    AGG_TYPES,
+    ClusterSnapshot,
+    GangState,
+    MAX_QUOTA_DEPTH,
+    NodeState,
+    NUM_AGG,
+    PodBatch,
+    QuotaState,
+    ReservationState,
+)
+
+# Defaults mirroring LoadAwareSchedulingArgs defaulting
+# (scheduler/apis/config/v1beta2/defaults.go semantics per types.go:30-58).
+DEFAULT_RESOURCE_WEIGHTS: Dict[ResourceKind, float] = {
+    ResourceKind.CPU: 1.0,
+    ResourceKind.MEMORY: 1.0,
+}
+DEFAULT_USAGE_THRESHOLDS: Dict[ResourceKind, float] = {
+    ResourceKind.CPU: 65.0,
+    ResourceKind.MEMORY: 95.0,
+}
+DEFAULT_SCALING_FACTORS: Dict[ResourceKind, float] = {
+    ResourceKind.CPU: 85.0,
+    ResourceKind.MEMORY: 70.0,
+}
+DEFAULT_MILLI_CPU_REQUEST = 250.0          # load_aware.go:52
+DEFAULT_MEMORY_REQUEST_MIB = 200.0         # load_aware.go:54 (200*1024*1024 B)
+DEFAULT_NODE_METRIC_EXPIRATION_S = 180.0   # types.go:38
+DEFAULT_REPORT_INTERVAL_S = 60.0           # load_aware.go:56
+
+
+def resource_vec(rl: ResourceList) -> np.ndarray:
+    v = np.zeros((NUM_RESOURCES,), np.float32)
+    for k, val in rl.items():
+        v[int(k)] = val
+    return v
+
+
+def round_half_away(x):
+    """Go math.Round: half away from zero (values here are >= 0).
+    np.round's banker's rounding flips filter decisions at exact .5
+    boundaries, so it must not be used for reference-parity math."""
+    return np.floor(np.asarray(x, np.float64) + 0.5)
+
+
+def estimate_pod(pod: Pod,
+                 scaling_factors: Mapping[ResourceKind, float] = None,
+                 weights: Mapping[ResourceKind, float] = None) -> np.ndarray:
+    """DefaultEstimator.EstimatePod (estimator/default_estimator.go:57-110).
+
+    For each weighted resource (cpu/memory), read the request of the pod's
+    priority tier's translated resource; if limit > request use the limit at
+    100%; else scale the request by the factor; zero requests fall back to
+    250m / 200MiB; result is capped at the limit. Output is keyed by the
+    *native* resource dim (scores compare against native allocatable).
+    """
+    scaling_factors = scaling_factors or DEFAULT_SCALING_FACTORS
+    weights = weights or DEFAULT_RESOURCE_WEIGHTS
+    pc = pod.priority_class
+    out = np.zeros((NUM_RESOURCES,), np.float32)
+    for kind in weights:
+        real = translate_resource_by_priority(kind, pc)
+        req = float(pod.requests.get(real, 0.0))
+        lim = float(pod.limits.get(real, 0.0))
+        factor = float(scaling_factors.get(kind, 100.0))
+        if lim > req:
+            qty, factor = lim, 100.0
+        else:
+            qty = req
+        if qty == 0.0:
+            if real in (ResourceKind.CPU, ResourceKind.BATCH_CPU,
+                        ResourceKind.MID_CPU):
+                out[int(kind)] = DEFAULT_MILLI_CPU_REQUEST
+            elif real in (ResourceKind.MEMORY, ResourceKind.BATCH_MEMORY,
+                          ResourceKind.MID_MEMORY):
+                out[int(kind)] = DEFAULT_MEMORY_REQUEST_MIB
+            continue
+        est = round_half_away(qty * factor / 100.0)
+        if lim > 0:
+            est = min(est, lim)
+        out[int(kind)] = est
+    return out
+
+
+@dataclasses.dataclass
+class AssignedPod:
+    """A pod recently assumed on a node (podAssignCache entry,
+    load_aware.go:260-267)."""
+
+    pod: Pod
+    node_name: str
+    timestamp: float
+
+
+class SnapshotBuilder:
+    """Accumulates typed objects and emits a ClusterSnapshot (numpy pytree).
+
+    Static capacities (max nodes/quotas/gangs/reservations/zones) are fixed at
+    construction; rebuilding with the same capacities yields identically-shaped
+    pytrees so jitted programs never recompile across versions.
+    """
+
+    def __init__(self, max_nodes: int, max_quotas: int = 8, max_gangs: int = 8,
+                 max_reservations: int = 8, max_zones: int = 4,
+                 max_selectors: int = 8, max_label_groups: int = 64,
+                 metric_expiration_s: float = DEFAULT_NODE_METRIC_EXPIRATION_S,
+                 estimator_weights: Optional[Mapping[ResourceKind, float]] = None,
+                 estimator_scaling: Optional[Mapping[ResourceKind, float]] = None,
+                 score_with_aggregation: bool = False):
+        self.max_nodes = max_nodes
+        self.max_quotas = max_quotas
+        self.max_gangs = max_gangs
+        self.max_reservations = max_reservations
+        self.max_zones = max_zones
+        self.max_selectors = max_selectors
+        self.max_label_groups = max_label_groups
+        self.metric_expiration_s = metric_expiration_s
+        # estimator config must match the LoadAware plugin args so that
+        # PodBatch.estimated and the assign-cache columns agree with the
+        # score kernel's expectations (types.go:44-58)
+        self.estimator_weights = dict(estimator_weights or DEFAULT_RESOURCE_WEIGHTS)
+        self.estimator_scaling = dict(estimator_scaling or DEFAULT_SCALING_FACTORS)
+        # scoreWithAggregation(args.Aggregated) — affects which assigned
+        # pods are estimated (load_aware.go:355-360 fourth clause)
+        self.score_with_aggregation = score_with_aggregation
+
+        self.nodes: List[Node] = []
+        self.node_index: Dict[str, int] = {}
+        self.metrics: Dict[str, NodeMetric] = {}
+        self.running_pods: List[Pod] = []
+        self.assigned: List[AssignedPod] = []
+        self.quotas: List[ElasticQuota] = []
+        self.quota_index: Dict[str, int] = {}
+        self.gangs: List[PodGroup] = []
+        self.gang_index: Dict[str, int] = {}
+        self.gang_assumed: Dict[str, int] = {}
+        self.reservations: List[Reservation] = []
+
+    # --- ingest -------------------------------------------------------------
+
+    def add_node(self, node: Node) -> int:
+        if len(self.nodes) >= self.max_nodes:
+            raise ValueError("node capacity exceeded")
+        idx = len(self.nodes)
+        self.nodes.append(node)
+        self.node_index[node.meta.name] = idx
+        return idx
+
+    def set_node_metric(self, metric: NodeMetric) -> None:
+        self.metrics[metric.node_name] = metric
+
+    def add_running_pod(self, pod: Pod) -> None:
+        """A pod already bound to a node (counts into `requested`)."""
+        self.running_pods.append(pod)
+
+    def add_assigned(self, pod: Pod, node_name: str,
+                     timestamp: Optional[float] = None) -> None:
+        """Record a recent assume (podAssignCache.assign)."""
+        self.assigned.append(
+            AssignedPod(pod, node_name, time.time() if timestamp is None
+                        else timestamp))
+
+    def add_quota(self, quota: ElasticQuota) -> int:
+        if len(self.quotas) >= self.max_quotas:
+            raise ValueError("quota capacity exceeded")
+        idx = len(self.quotas)
+        self.quotas.append(quota)
+        self.quota_index[quota.meta.name] = idx
+        return idx
+
+    def add_gang(self, pg: PodGroup, assumed: int = 0) -> int:
+        if len(self.gangs) >= self.max_gangs:
+            raise ValueError("gang capacity exceeded")
+        idx = len(self.gangs)
+        self.gangs.append(pg)
+        self.gang_index[pg.meta.name] = idx
+        self.gang_assumed[pg.meta.name] = assumed
+        return idx
+
+    def add_reservation(self, res: Reservation) -> None:
+        if len(self.reservations) >= self.max_reservations:
+            raise ValueError("reservation capacity exceeded")
+        self.reservations.append(res)
+
+    # --- build: nodes -------------------------------------------------------
+
+    def _node_label_groups(self) -> Tuple[np.ndarray, Dict[frozenset, int]]:
+        lab_ids = np.zeros((self.max_nodes,), np.int32)
+        groups: Dict[frozenset, int] = {}
+        for i, node in enumerate(self.nodes):
+            key = frozenset(node.meta.labels.items())
+            if key not in groups:
+                if len(groups) >= self.max_label_groups:
+                    raise ValueError(
+                        f"distinct node label sets exceed max_label_groups="
+                        f"{self.max_label_groups}")
+                groups[key] = len(groups)
+            lab_ids[i] = groups[key]
+        return lab_ids, groups
+
+    def build_nodes(self, now: Optional[float] = None) -> Tuple[NodeState, Dict[frozenset, int]]:
+        now = time.time() if now is None else now
+        n, r, z = self.max_nodes, NUM_RESOURCES, self.max_zones
+        alloc = np.zeros((n, r), np.float32)
+        requested = np.zeros((n, r), np.float32)
+        usage = np.zeros((n, r), np.float32)
+        prod_usage = np.zeros((n, r), np.float32)
+        agg = np.zeros((n, NUM_AGG, r), np.float32)
+        assigned_est = np.zeros((n, r), np.float32)
+        assigned_corr = np.zeros((n, r), np.float32)
+        prod_assigned_est = np.zeros((n, r), np.float32)
+        prod_assigned_corr = np.zeros((n, r), np.float32)
+        fresh = np.zeros((n,), bool)
+        has_agg = np.zeros((n,), bool)
+        schedulable = np.zeros((n,), bool)
+        numa_cap = np.zeros((n, z, 2), np.float32)
+        numa_valid = np.zeros((n, z), bool)
+
+        for i, node in enumerate(self.nodes):
+            alloc[i] = resource_vec(node.allocatable)
+            schedulable[i] = not node.unschedulable
+            if node.topology is not None:
+                for j, zone in enumerate(node.topology.zones[:z]):
+                    numa_cap[i, j, 0] = zone.cpus_milli
+                    numa_cap[i, j, 1] = zone.memory_mib
+                    numa_valid[i, j] = True
+
+        for pod in self.running_pods:
+            idx = self.node_index.get(pod.node_name)
+            if idx is not None:
+                requested[idx] += resource_vec(pod.requests)
+
+        # NodeMetric columns + the assign-cache adjustment.
+        pods_per_node: Dict[str, List[AssignedPod]] = {}
+        for ap in self.assigned:
+            pods_per_node.setdefault(ap.node_name, []).append(ap)
+
+        for name, metric in self.metrics.items():
+            i = self.node_index.get(name)
+            if i is None:
+                continue
+            if metric.is_expired(self.metric_expiration_s, now):
+                continue
+            fresh[i] = True
+            usage[i] = resource_vec(metric.node_usage)
+            pod_usages = {pm.namespaced_name: resource_vec(pm.usage)
+                          for pm in metric.pods_metric}
+            for pm in metric.pods_metric:
+                if pm.priority_class is PriorityClass.PROD:
+                    prod_usage[i] += resource_vec(pm.usage)
+            for a, agg_type in enumerate(AGG_TYPES):
+                au = metric.aggregated_usage(agg_type)
+                if au is not None:
+                    agg[i, a] = resource_vec(au)
+                    has_agg[i] = True
+
+            # estimatedAssignedPodUsed (load_aware.go:340-378): recently
+            # assumed pods not yet visible in the NodeMetric are estimated;
+            # those visible-but-recent use max(estimate, usage). Their
+            # reported usage is recorded as a correction the score kernel
+            # subtracts from the node usage source (load_aware.go:300-315).
+            interval = metric.report_interval_seconds or DEFAULT_REPORT_INTERVAL_S
+            for ap in pods_per_node.get(name, []):
+                key = ap.pod.meta.namespaced_name
+                pod_usage = pod_usages.get(key)
+                recent = (ap.timestamp > metric.update_time
+                          or metric.update_time - ap.timestamp < interval)
+                # fourth clause (load_aware.go:355-360): score aggregation
+                # configured but this node has no percentile data -> the
+                # usage source contributes nothing, so estimate everything
+                agg_missing = self.score_with_aggregation and not metric.aggregated
+                is_prod = ap.pod.priority_class is PriorityClass.PROD
+                if pod_usage is None or recent or agg_missing:
+                    est = estimate_pod(ap.pod, self.estimator_scaling,
+                                       self.estimator_weights)
+                    if pod_usage is not None:
+                        est = np.maximum(est, pod_usage)
+                        assigned_corr[i] += pod_usage
+                        if is_prod:
+                            prod_assigned_corr[i] += pod_usage
+                    assigned_est[i] += est
+                    if is_prod:
+                        prod_assigned_est[i] += est
+
+        lab_ids, groups = self._node_label_groups()
+        state = NodeState(
+            allocatable=alloc, requested=requested, usage=usage,
+            prod_usage=prod_usage, agg_usage=agg,
+            assigned_estimated=assigned_est,
+            assigned_correction=assigned_corr,
+            prod_assigned_estimated=prod_assigned_est,
+            prod_assigned_correction=prod_assigned_corr,
+            metric_fresh=fresh,
+            has_agg=has_agg, schedulable=schedulable, label_group=lab_ids,
+            numa_cap=numa_cap, numa_free=numa_cap.copy(), numa_valid=numa_valid,
+        )
+        return state, groups
+
+    # --- build: quotas / gangs / reservations -------------------------------
+
+    def build_quotas(self) -> QuotaState:
+        q, r = self.max_quotas, NUM_RESOURCES
+        qmin = np.zeros((q, r), np.float32)
+        qmax = np.full((q, r), np.inf, np.float32)
+        weight = np.zeros((q, r), np.float32)
+        parent = np.full((q,), -1, np.int32)
+        ancestors = np.zeros((q, q), bool)
+        used = np.zeros((q, r), np.float32)
+        valid = np.zeros((q,), bool)
+        for i, quota in enumerate(self.quotas):
+            qmin[i] = resource_vec(quota.min)
+            mv = resource_vec(quota.max)
+            qmax[i] = np.where(mv > 0, mv, np.inf)
+            wv = resource_vec(quota.shared_weight)
+            # sharedWeight defaults to max (quota_info semantics)
+            weight[i] = np.where(wv > 0, wv, np.where(np.isinf(qmax[i]), 1.0,
+                                                      qmax[i]))
+            parent[i] = self.quota_index.get(quota.parent, -1)
+            valid[i] = True
+        depth_anc = np.full((q, MAX_QUOTA_DEPTH), -1, np.int32)
+        for i in range(len(self.quotas)):
+            chain = []
+            j = i
+            while j >= 0:
+                if j in chain:
+                    raise ValueError(
+                        f"quota parent cycle involving "
+                        f"{self.quotas[i].meta.name!r}")
+                ancestors[i, j] = True
+                chain.append(j)
+                j = int(parent[j])
+            if len(chain) > MAX_QUOTA_DEPTH:
+                # static device shapes cap the tree depth; reject loudly
+                # rather than silently skipping a level's enforcement
+                raise ValueError(
+                    f"quota tree depth {len(chain)} exceeds MAX_QUOTA_DEPTH="
+                    f"{MAX_QUOTA_DEPTH} at {self.quotas[i].meta.name!r}")
+            # chain is leaf->root; depth_anc[d] = ancestor at depth d from root
+            for d, a in enumerate(reversed(chain)):
+                depth_anc[i, d] = a
+        direct_used = np.zeros((q, r), np.float32)
+        for pod in self.running_pods:
+            qi = self.quota_index.get(pod.quota_name, -1)
+            if qi >= 0:
+                direct_used[qi] += resource_vec(pod.requests)
+        # propagate used up the tree: used[a] = Σ direct_used[q] over quotas q
+        # with a ∈ ancestors(q) (GroupQuotaManager updateGroupDeltaUsed walk)
+        used = ancestors.astype(np.float32).T @ direct_used
+        return QuotaState(min=qmin, max=qmax, shared_weight=weight,
+                          parent=parent, ancestors=ancestors,
+                          depth_ancestor=depth_anc, used=used,
+                          runtime=np.full((q, r), np.inf, np.float32),
+                          valid=valid)
+
+    def build_gangs(self) -> GangState:
+        g = self.max_gangs
+        min_member = np.ones((g,), np.int32)
+        member_count = np.zeros((g,), np.int32)
+        assumed = np.zeros((g,), np.int32)
+        strict = np.ones((g,), bool)
+        valid = np.zeros((g,), bool)
+        for i, pg in enumerate(self.gangs):
+            min_member[i] = pg.min_member
+            member_count[i] = pg.total_member
+            assumed[i] = self.gang_assumed.get(pg.meta.name, 0)
+            strict[i] = pg.mode != "NonStrict"
+            valid[i] = True
+        return GangState(min_member=min_member, member_count=member_count,
+                         assumed=assumed, strict=strict, valid=valid)
+
+    def build_reservations(self, owner_groups: Dict[str, int]) -> ReservationState:
+        v, r = self.max_reservations, NUM_RESOURCES
+        node = np.full((v,), -1, np.int32)
+        free = np.zeros((v, r), np.float32)
+        owner = np.full((v,), -1, np.int32)
+        once = np.ones((v,), bool)
+        valid = np.zeros((v,), bool)
+        for i, res in enumerate(self.reservations):
+            if res.phase != "Available" or not res.node_name:
+                continue
+            ni = self.node_index.get(res.node_name)
+            if ni is None:
+                continue
+            node[i] = ni
+            free[i] = resource_vec(res.requests) - resource_vec(res.allocated)
+            key = _selector_key(res.owner_label_selector)
+            owner[i] = owner_groups.setdefault(key, len(owner_groups))
+            once[i] = res.allocate_once
+            valid[i] = True
+        return ReservationState(node=node, free=free, owner_group=owner,
+                                allocate_once=once, valid=valid)
+
+    def build(self, now: Optional[float] = None,
+              version: int = 0) -> Tuple[ClusterSnapshot, "BuildContext"]:
+        nodes, label_groups = self.build_nodes(now)
+        owner_groups: Dict[str, int] = {}
+        snap = ClusterSnapshot(
+            nodes=nodes,
+            quotas=self.build_quotas(),
+            gangs=self.build_gangs(),
+            reservations=self.build_reservations(owner_groups),
+            version=np.int32(version),
+        )
+        ctx = BuildContext(self, label_groups, owner_groups)
+        return snap, ctx
+
+    # --- build: pod batch ---------------------------------------------------
+
+    def build_pod_batch(self, pods: Sequence[Pod], ctx: "BuildContext",
+                        max_pods: Optional[int] = None) -> PodBatch:
+        p = max_pods or len(pods)
+        if len(pods) > p:
+            raise ValueError("pod batch exceeds capacity")
+        r = NUM_RESOURCES
+        requests = np.zeros((p, r), np.float32)
+        estimated = np.zeros((p, r), np.float32)
+        qos = np.zeros((p,), np.int8)
+        prio_class = np.zeros((p,), np.int8)
+        prio = np.zeros((p,), np.int32)
+        gang_id = np.full((p,), -1, np.int32)
+        quota_id = np.full((p,), -1, np.int32)
+        sel_id = np.full((p,), -1, np.int32)
+        res_owner = np.full((p,), -1, np.int32)
+        numa_single = np.zeros((p,), bool)
+        daemonset = np.zeros((p,), bool)
+        valid = np.zeros((p,), bool)
+
+        selectors: Dict[frozenset, int] = {}
+        for i, pod in enumerate(pods):
+            requests[i] = resource_vec(pod.requests)
+            estimated[i] = estimate_pod(pod, self.estimator_scaling,
+                                        self.estimator_weights)
+            qos[i] = int(pod.qos)
+            prio_class[i] = int(pod.priority_class)
+            prio[i] = pod.priority if pod.priority is not None else 0
+            gang_id[i] = self.gang_index.get(pod.gang_name, -1)
+            quota_id[i] = self.quota_index.get(pod.quota_name, -1)
+            if pod.node_selector:
+                key = frozenset(pod.node_selector.items())
+                if key not in selectors and len(selectors) >= self.max_selectors:
+                    raise ValueError(
+                        f"distinct pod nodeSelectors exceed max_selectors="
+                        f"{self.max_selectors}")
+                sel_id[i] = selectors.setdefault(key, len(selectors))
+            for sel_key, group in ctx.reservation_owner_groups.items():
+                if sel_key and _labels_match_key(pod.meta.labels, sel_key):
+                    res_owner[i] = group
+                    break
+            numa_single[i] = pod.required_cpu_bind
+            daemonset[i] = pod.is_daemonset
+            valid[i] = True
+
+        # selector x node-label-group match matrix, padded to static
+        # capacities so jitted programs never retrace across batches
+        s = self.max_selectors
+        l = self.max_label_groups
+        sel_match = np.zeros((s, l), bool)
+        for sel_key, si in selectors.items():
+            sel = dict(sel_key)
+            for lab_key, li in ctx.node_label_groups.items():
+                labels = dict(lab_key)
+                sel_match[si, li] = all(labels.get(k) == v
+                                        for k, v in sel.items())
+        return PodBatch(
+            requests=requests, estimated=estimated, qos=qos,
+            priority_class=prio_class, priority=prio, gang_id=gang_id,
+            quota_id=quota_id, selector_id=sel_id, selector_match=sel_match,
+            reservation_owner=res_owner, numa_single=numa_single,
+            daemonset=daemonset, valid=valid)
+
+
+def _selector_key(selector: Dict[str, str]) -> str:
+    return ",".join(f"{k}={v}" for k, v in sorted(selector.items()))
+
+
+def _labels_match_key(labels: Dict[str, str], key: str) -> bool:
+    if not key:
+        return False
+    for kv in key.split(","):
+        k, _, v = kv.partition("=")
+        if labels.get(k) != v:
+            return False
+    return True
+
+
+@dataclasses.dataclass
+class BuildContext:
+    """Host-side lookup state shared between snapshot and pod-batch builds."""
+
+    builder: SnapshotBuilder
+    node_label_groups: Dict[frozenset, int]
+    reservation_owner_groups: Dict[str, int]

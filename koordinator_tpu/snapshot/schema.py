@@ -1,0 +1,232 @@
+"""ClusterSnapshot: the device-resident columnar cluster state.
+
+Design (TPU-first, SURVEY.md 2.9):
+- Every per-node / per-pod / per-quota map in the reference becomes a fixed-
+  shape array column; XLA needs static shapes, so capacities (N nodes, P pods,
+  Q quotas, G gangs, Z NUMA zones, V reservations) are padded to the next
+  bucket and masked with validity columns.
+- All "informer caches" the scheduler hot loop reads (NodeInfo requested/
+  allocatable, NodeMetric usage + percentiles, quota tree, gang state,
+  reservation state, NUMA free) are materialized here, so one jitted program
+  can filter+score+commit a pod batch with zero host round-trips.
+- float32 everywhere on the resource axis (canonical units: millicores / MiB)
+  — exact-equality semantics of the Go int64 math are preserved by comparing
+  with a tolerance chosen so the golden tests match bit-for-bit at realistic
+  magnitudes.
+
+Reference parity: NodeInfo snapshot + SLO/NodeMetric/NodeResourceTopology /
+quota/gang/reservation caches (SURVEY.md 1, 2.1).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.struct
+import jax.numpy as jnp
+
+from koordinator_tpu.api.extension import NUM_RESOURCES
+
+# Aggregation rows in NodeState.agg_usage, in order.
+AGG_TYPES = ("avg", "p50", "p90", "p95", "p99")
+NUM_AGG = len(AGG_TYPES)
+
+# Static max depth of the elastic-quota tree (root at depth 0).
+MAX_QUOTA_DEPTH = 6
+
+Array = Any  # jnp.ndarray (host numpy allowed pre-upload)
+
+
+@flax.struct.dataclass
+class NodeState:
+    """Per-node columns. Shapes: [N, ...] with R = NUM_RESOURCES.
+
+    Mirrors: k8s NodeInfo (allocatable/requested), slo NodeMetric status
+    (node_usage, prod_usage, aggregated percentiles, freshness), NUMA zones
+    from NodeResourceTopology.
+    """
+
+    allocatable: Array      # f32[N, R] node allocatable (estimator-adjusted)
+    requested: Array        # f32[N, R] sum of requests of assigned pods
+    usage: Array            # f32[N, R] NodeMetric nodeUsage
+    prod_usage: Array       # f32[N, R] sum of prod-tier pod usages
+    agg_usage: Array        # f32[N, NUM_AGG, R] percentile node usage
+    assigned_estimated: Array  # f32[N, R] Σ max(estimator(pod), reported
+                               # usage) for recently-assigned pods
+                               # (podAssignCache / estimatedAssignedPodUsed,
+                               # load_aware.go:260-267, 340-378)
+    assigned_correction: Array  # f32[N, R] Σ reported usage of those
+                                # estimated pods — subtracted from the node
+                                # usage source at score time with the >=
+                                # guard (load_aware.go:300-315)
+    prod_assigned_estimated: Array   # f32[N, R] prod-only variant
+    prod_assigned_correction: Array  # f32[N, R] prod-only variant
+    metric_fresh: Array     # bool[N] NodeMetric exists and is not expired
+    has_agg: Array          # bool[N] aggregated percentiles available
+    schedulable: Array      # bool[N] node exists, not cordoned
+    label_group: Array      # i32[N] node-label equivalence class (selector gate)
+    # NUMA (Z zones): cpu/mem capacity and free per zone
+    numa_cap: Array         # f32[N, Z, 2] (cpu milli, mem MiB)
+    numa_free: Array        # f32[N, Z, 2]
+    numa_valid: Array       # bool[N, Z]
+
+    @property
+    def num_nodes(self) -> int:
+        return self.allocatable.shape[0]
+
+
+@flax.struct.dataclass
+class PodBatch:
+    """The pending-pod batch being scheduled. Shapes: [P, ...].
+
+    `requests` are already translated to the priority tier's extended
+    resources (api.extension.translate_resource_by_priority);
+    `estimated` is the LoadAware estimator output
+    (estimator/default_estimator.go:62-110).
+    """
+
+    requests: Array         # f32[P, R]
+    estimated: Array        # f32[P, R]
+    qos: Array              # i8[P] QoSClass
+    priority_class: Array   # i8[P] PriorityClass
+    priority: Array         # i32[P] numeric priority (bands, tie-break)
+    gang_id: Array          # i32[P] index into GangState, -1 = none
+    quota_id: Array         # i32[P] index into QuotaState, -1 = none
+    selector_id: Array      # i32[P] row into selector_match, -1 = match all
+    selector_match: Array   # bool[S, L] selector s matches node-label-group l
+                            # (distinct pod selectors x distinct node label
+                            # sets — the nodeSelector gate without a P x N
+                            # host-side matrix)
+    reservation_owner: Array  # i32[P] owner-match group for reservations, -1
+    numa_single: Array      # bool[P] requires single-NUMA-node placement
+    daemonset: Array        # bool[P] DaemonSet pods bypass LoadAware filter
+                            # (load_aware.go isDaemonSetPod)
+    valid: Array            # bool[P]
+
+    @property
+    def num_pods(self) -> int:
+        return self.requests.shape[0]
+
+
+@flax.struct.dataclass
+class QuotaState:
+    """Hierarchical elastic-quota tree, flattened. Shapes: [Q, ...].
+
+    `ancestors[q, a]` is True when quota `a` is `q` or an ancestor of `q` —
+    the device-side equivalent of walking parentInfos
+    (elasticquota/plugin.go:211-257). Runtime is recomputed by the
+    water-filling kernel (ops/waterfill.py).
+    """
+
+    min: Array              # f32[Q, R] guaranteed
+    max: Array              # f32[Q, R] cap (inf if unlimited)
+    shared_weight: Array    # f32[Q, R] fair-share weight (default = max)
+    parent: Array           # i32[Q] parent index, -1 = root's parent
+    ancestors: Array        # bool[Q, Q]
+    depth_ancestor: Array   # i32[Q, D] ancestor at depth d (self included),
+                            # -1 past the leaf — lets the commit kernel do an
+                            # exact per-level prefix gate without a Q x Q
+                            # matmul per pod (D = MAX_QUOTA_DEPTH)
+    used: Array             # f32[Q, R] admitted usage
+    runtime: Array          # f32[Q, R] water-filled entitlement
+    valid: Array            # bool[Q]
+
+
+@flax.struct.dataclass
+class GangState:
+    """Coscheduling gang/PodGroup state. Shapes: [G, ...].
+
+    Mirrors core/gang.go:43-83 state machine inputs: minMember quorum and
+    the count already assumed/bound.
+    """
+
+    min_member: Array       # i32[G]
+    member_count: Array     # i32[G] total members seen (quorum check)
+    assumed: Array          # i32[G] members already assumed/bound
+    strict: Array           # bool[G] strict mode
+    valid: Array            # bool[G]
+
+
+@flax.struct.dataclass
+class ReservationState:
+    """Available reservations as device columns. Shapes: [V, ...].
+
+    A reservation is reserved capacity *already counted* in node `requested`;
+    a matching pod first consumes reservation free capacity (restore
+    semantics, reservation/transformer.go:240-291).
+    """
+
+    node: Array             # i32[V] node index the reservation landed on
+    free: Array             # f32[V, R] remaining reserved capacity
+    owner_group: Array      # i32[V] owner-match group id
+    allocate_once: Array    # bool[V]
+    valid: Array            # bool[V]
+
+
+@flax.struct.dataclass
+class ClusterSnapshot:
+    """The complete device-resident cluster state (one version)."""
+
+    nodes: NodeState
+    quotas: QuotaState
+    gangs: GangState
+    reservations: ReservationState
+    version: Array          # i32[] monotonically increasing
+
+    @property
+    def num_nodes(self) -> int:
+        return self.nodes.num_nodes
+
+
+def zeros_snapshot(num_nodes: int, num_quotas: int = 1, num_gangs: int = 1,
+                   num_reservations: int = 1, num_zones: int = 4) -> ClusterSnapshot:
+    """An all-empty snapshot with the given static capacities."""
+    n, q, g, v, z, r = (num_nodes, num_quotas, num_gangs, num_reservations,
+                        num_zones, NUM_RESOURCES)
+    f32 = jnp.float32
+    nodes = NodeState(
+        allocatable=jnp.zeros((n, r), f32),
+        requested=jnp.zeros((n, r), f32),
+        usage=jnp.zeros((n, r), f32),
+        prod_usage=jnp.zeros((n, r), f32),
+        agg_usage=jnp.zeros((n, NUM_AGG, r), f32),
+        assigned_estimated=jnp.zeros((n, r), f32),
+        assigned_correction=jnp.zeros((n, r), f32),
+        prod_assigned_estimated=jnp.zeros((n, r), f32),
+        prod_assigned_correction=jnp.zeros((n, r), f32),
+        metric_fresh=jnp.zeros((n,), bool),
+        has_agg=jnp.zeros((n,), bool),
+        schedulable=jnp.zeros((n,), bool),
+        label_group=jnp.zeros((n,), jnp.int32),
+        numa_cap=jnp.zeros((n, z, 2), f32),
+        numa_free=jnp.zeros((n, z, 2), f32),
+        numa_valid=jnp.zeros((n, z), bool),
+    )
+    quotas = QuotaState(
+        min=jnp.zeros((q, r), f32),
+        max=jnp.full((q, r), jnp.inf, f32),
+        shared_weight=jnp.zeros((q, r), f32),
+        parent=jnp.full((q,), -1, jnp.int32),
+        ancestors=jnp.zeros((q, q), bool),
+        depth_ancestor=jnp.full((q, MAX_QUOTA_DEPTH), -1, jnp.int32),
+        used=jnp.zeros((q, r), f32),
+        runtime=jnp.full((q, r), jnp.inf, f32),
+        valid=jnp.zeros((q,), bool),
+    )
+    gangs = GangState(
+        min_member=jnp.ones((g,), jnp.int32),
+        member_count=jnp.zeros((g,), jnp.int32),
+        assumed=jnp.zeros((g,), jnp.int32),
+        strict=jnp.ones((g,), bool),
+        valid=jnp.zeros((g,), bool),
+    )
+    reservations = ReservationState(
+        node=jnp.full((v,), -1, jnp.int32),
+        free=jnp.zeros((v, r), f32),
+        owner_group=jnp.full((v,), -1, jnp.int32),
+        allocate_once=jnp.ones((v,), bool),
+        valid=jnp.zeros((v,), bool),
+    )
+    return ClusterSnapshot(nodes=nodes, quotas=quotas, gangs=gangs,
+                           reservations=reservations,
+                           version=jnp.zeros((), jnp.int32))

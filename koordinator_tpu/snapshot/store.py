@@ -1,0 +1,66 @@
+"""Versioned snapshot store with double-buffered device upload.
+
+The reference keeps informer caches fresh via watch streams and takes an
+immutable NodeInfo snapshot per scheduling cycle. Here the host builds a new
+columnar snapshot (or applies deltas) and uploads it to device asynchronously
+while the previous version is still being consumed by in-flight kernels —
+classic double buffering to hide HBM transfer latency behind compute
+(SURVEY.md 2.9 "double-buffered device upload").
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Optional
+
+import jax
+import numpy as np
+
+from koordinator_tpu.snapshot.schema import ClusterSnapshot
+
+
+class SnapshotStore:
+    """Holds the current device-resident ClusterSnapshot.
+
+    - `publish(snapshot)` uploads a new version (host numpy pytree) without
+      blocking readers; upload overlaps the previous version's compute because
+      `jax.device_put` is async.
+    - `current()` returns the freshest fully-uploaded version.
+    - Optional `sharding` places the node axis across a mesh (parallel/mesh.py).
+    """
+
+    def __init__(self, sharding: Optional[Any] = None):
+        self._sharding = sharding
+        self._lock = threading.Lock()
+        self._current: Optional[ClusterSnapshot] = None
+        self._version = 0
+
+    @property
+    def version(self) -> int:
+        return self._version
+
+    def publish(self, snapshot: ClusterSnapshot) -> ClusterSnapshot:
+        """Upload a host-built snapshot; returns the device-resident pytree."""
+        put = (lambda x: jax.device_put(x, self._sharding)
+               if self._sharding is not None else jax.device_put(x))
+        on_device = jax.tree_util.tree_map(put, snapshot)
+        with self._lock:
+            self._version += 1
+            self._current = on_device
+        return on_device
+
+    def current(self) -> ClusterSnapshot:
+        with self._lock:
+            if self._current is None:
+                raise RuntimeError("no snapshot published yet")
+            return self._current
+
+    def update(self, fn: Callable[[ClusterSnapshot], ClusterSnapshot]) -> ClusterSnapshot:
+        """Apply a device-side functional update (e.g. post-commit usage
+        scatter) and publish the result as the next version."""
+        with self._lock:
+            if self._current is None:
+                raise RuntimeError("no snapshot published yet")
+            self._current = fn(self._current)
+            self._version += 1
+            return self._current

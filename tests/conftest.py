@@ -1,0 +1,22 @@
+"""Test harness: force an 8-device virtual CPU platform *before* jax imports.
+
+Multi-chip hardware is not available in CI; sharding tests run on a virtual
+8-device CPU mesh (the driver separately dry-runs `__graft_entry__.
+dryrun_multichip`). Mirrors the reference's hermetic strategy (SURVEY.md 4):
+no cluster needed — fake state layers stand in for kernel/apiserver.
+"""
+
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_enable_x64", False)

@@ -1,0 +1,259 @@
+"""Sequential NumPy oracle re-implementing the reference scheduler semantics.
+
+This is an independent, readable re-statement of the Go behavior
+(load_aware.go:123-397, elasticquota plugin.go:211-257, coscheduling
+core.go:220-341) used as the golden model for the batched JAX kernels:
+pods are scheduled ONE AT A TIME in priority order, exactly like the
+reference's scheduleOne loop, with plain dict/float math.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from koordinator_tpu.api.extension import NUM_RESOURCES, PriorityClass, ResourceKind
+from koordinator_tpu.snapshot.builder import estimate_pod, round_half_away
+from koordinator_tpu.api.types import Node, NodeMetric, Pod
+
+MAX_NODE_SCORE = 100
+
+
+@dataclasses.dataclass
+class OracleArgs:
+    resource_weights: Dict[ResourceKind, float]
+    usage_thresholds: Dict[ResourceKind, float]
+    prod_usage_thresholds: Dict[ResourceKind, float]
+    agg_usage_thresholds: Dict[ResourceKind, float]
+    filter_agg_type: str = ""
+    score_agg_type: str = ""
+    score_according_prod_usage: bool = False
+
+    @staticmethod
+    def default() -> "OracleArgs":
+        return OracleArgs(
+            resource_weights={ResourceKind.CPU: 1, ResourceKind.MEMORY: 1},
+            usage_thresholds={ResourceKind.CPU: 65, ResourceKind.MEMORY: 95},
+            prod_usage_thresholds={},
+            agg_usage_thresholds={},
+        )
+
+
+@dataclasses.dataclass
+class OracleNode:
+    """Host-side per-node scheduler state."""
+
+    node: Node
+    metric: Optional[NodeMetric]
+    metric_fresh: bool
+    requested: np.ndarray                    # [R]
+    assigned_estimated: np.ndarray           # [R]
+    assigned_correction: np.ndarray          # [R]
+    prod_assigned_estimated: np.ndarray      # [R]
+    prod_assigned_correction: np.ndarray     # [R]
+    prod_usage: np.ndarray                   # [R]
+
+    def alloc_vec(self) -> np.ndarray:
+        from koordinator_tpu.snapshot.builder import resource_vec
+        return resource_vec(self.node.allocatable)
+
+
+def usage_vec(metric: Optional[NodeMetric], agg_type: str) -> Optional[np.ndarray]:
+    from koordinator_tpu.snapshot.builder import resource_vec
+    if metric is None:
+        return None
+    if agg_type:
+        rl = metric.aggregated_usage(agg_type)
+        return None if rl is None else resource_vec(rl)
+    return resource_vec(metric.node_usage)
+
+
+def oracle_filter(on: OracleNode, pod: Pod, args: OracleArgs) -> bool:
+    """Plugin.Filter (load_aware.go:123-254)."""
+    if pod.is_daemonset:
+        return True
+    if on.metric is None or not on.metric_fresh:
+        return True
+    alloc = on.alloc_vec()
+    is_prod = pod.priority_class is PriorityClass.PROD
+    if args.prod_usage_thresholds and is_prod:
+        for kind, thr in args.prod_usage_thresholds.items():
+            if thr == 0 or alloc[int(kind)] == 0:
+                continue
+            pct = round_half_away(on.prod_usage[int(kind)] / alloc[int(kind)] * 100)
+            if pct >= thr:
+                return False
+        return True
+    if args.filter_agg_type:
+        thresholds = args.agg_usage_thresholds
+        used = usage_vec(on.metric, args.filter_agg_type)
+        if used is None:
+            return True
+    else:
+        thresholds = args.usage_thresholds
+        used = usage_vec(on.metric, "")
+    for kind, thr in thresholds.items():
+        if thr == 0 or alloc[int(kind)] == 0:
+            continue
+        pct = round_half_away(used[int(kind)] / alloc[int(kind)] * 100)
+        if pct >= thr:
+            return False
+    return True
+
+
+def oracle_score(on: OracleNode, pod: Pod, args: OracleArgs) -> float:
+    """Plugin.Score (load_aware.go:269-335) + scorer (:378-397)."""
+    if on.metric is None or not on.metric_fresh:
+        return 0.0
+    alloc = on.alloc_vec()
+    est = estimate_pod(pod, weights=args.resource_weights)
+    prod_scored = (args.score_according_prod_usage
+                   and pod.priority_class is PriorityClass.PROD)
+    if prod_scored:
+        estimated = (est + on.prod_assigned_estimated
+                     + np.maximum(on.prod_usage - on.prod_assigned_correction, 0))
+    else:
+        src = usage_vec(on.metric, args.score_agg_type)
+        src = np.zeros(NUM_RESOURCES, np.float64) if src is None else src.astype(np.float64)
+        corrected = src - np.where(src >= on.assigned_correction,
+                                   on.assigned_correction, 0)
+        estimated = est + on.assigned_estimated + corrected
+
+    score_sum, weight_sum = 0.0, 0.0
+    for kind, w in args.resource_weights.items():
+        cap, used = alloc[int(kind)], estimated[int(kind)]
+        if cap == 0 or used > cap:
+            s = 0
+        else:
+            s = math.floor((cap - used) * MAX_NODE_SCORE / cap)
+        score_sum += s * w
+        weight_sum += w
+    return math.floor(score_sum / weight_sum)
+
+
+@dataclasses.dataclass
+class OracleQuota:
+    name: str
+    parent: Optional[str]
+    runtime: np.ndarray   # [R] entitlement
+    used: np.ndarray      # [R]
+
+
+class OracleScheduler:
+    """Sequential scheduler: fit + LoadAware + quota gate + gang rollback."""
+
+    def __init__(self, nodes: List[OracleNode], args: OracleArgs,
+                 quotas: Optional[Dict[str, OracleQuota]] = None,
+                 gang_min: Optional[Dict[str, int]] = None,
+                 gang_members: Optional[Dict[str, int]] = None):
+        self.nodes = nodes
+        self.args = args
+        self.quotas = quotas or {}
+        self.gang_min = gang_min or {}
+        self.gang_members = gang_members or {}
+        self.gang_placed: Dict[str, List[Tuple[int, int]]] = {}
+
+    def _quota_chain(self, name: str) -> List[OracleQuota]:
+        chain = []
+        while name:
+            q = self.quotas.get(name)
+            if q is None:
+                break
+            chain.append(q)
+            name = q.parent or ""
+        return chain
+
+    def schedule_one(self, pod: Pod, pod_idx: int) -> int:
+        from koordinator_tpu.snapshot.builder import resource_vec
+        req = resource_vec(pod.requests)
+        # gang quorum prefilter
+        if pod.gang_name:
+            if self.gang_members.get(pod.gang_name, 0) < \
+                    self.gang_min.get(pod.gang_name, 1):
+                return -1
+        # quota admission
+        for q in self._quota_chain(pod.quota_name):
+            if np.any(q.used + req > q.runtime + 0.5):
+                return -1
+        best_node, best_score = -1, -1.0
+        for i, on in enumerate(self.nodes):
+            if on.node.unschedulable:
+                continue
+            if pod.node_selector and any(
+                    on.node.meta.labels.get(k) != v
+                    for k, v in pod.node_selector.items()):
+                continue
+            if np.any(on.requested + req > on.alloc_vec() + 0.5):
+                continue
+            if not oracle_filter(on, pod, self.args):
+                continue
+            s = oracle_score(on, pod, self.args)
+            if s > best_score:
+                best_node, best_score = i, s
+        if best_node < 0:
+            return -1
+        # assume (Reserve): requested + podAssignCache estimate
+        on = self.nodes[best_node]
+        on.requested = on.requested + req
+        est = estimate_pod(pod, weights=self.args.resource_weights)
+        on.assigned_estimated = on.assigned_estimated + est
+        if pod.priority_class is PriorityClass.PROD:
+            on.prod_assigned_estimated = on.prod_assigned_estimated + est
+        for q in self._quota_chain(pod.quota_name):
+            q.used = q.used + req
+        if pod.gang_name:
+            self.gang_placed.setdefault(pod.gang_name, []).append(
+                (pod_idx, best_node))
+        return best_node
+
+    def schedule(self, pods: List[Pod]) -> np.ndarray:
+        """Priority-desc, index-asc order; strict-gang rollback at the end."""
+        from koordinator_tpu.snapshot.builder import resource_vec
+        order = sorted(range(len(pods)),
+                       key=lambda i: (-(pods[i].priority or 0), i))
+        out = np.full((len(pods),), -1, np.int64)
+        for i in order:
+            out[i] = self.schedule_one(pods[i], i)
+        # strict gang all-or-nothing rollback
+        for gang, placed in self.gang_placed.items():
+            prior = 0
+            if len(placed) + prior < self.gang_min.get(gang, 1):
+                for pod_idx, node_idx in placed:
+                    on = self.nodes[node_idx]
+                    pod = pods[pod_idx]
+                    req = resource_vec(pod.requests)
+                    est = estimate_pod(pod, weights=self.args.resource_weights)
+                    on.requested = on.requested - req
+                    on.assigned_estimated = on.assigned_estimated - est
+                    if pod.priority_class is PriorityClass.PROD:
+                        on.prod_assigned_estimated = \
+                            on.prod_assigned_estimated - est
+                    for q in self._quota_chain(pod.quota_name):
+                        q.used = q.used - req
+                    out[pod_idx] = -1
+        return out
+
+
+def make_oracle_nodes(builder, now: Optional[float] = None) -> List[OracleNode]:
+    """Construct oracle state from the same SnapshotBuilder inputs, reusing
+    the builder's columnar output so both sides see identical preprocessing
+    of metrics/assign-cache (that part is itself unit-tested separately)."""
+    state, _ = builder.build_nodes(now)
+    out = []
+    for i, node in enumerate(builder.nodes):
+        metric = builder.metrics.get(node.meta.name)
+        out.append(OracleNode(
+            node=node,
+            metric=metric,
+            metric_fresh=bool(state.metric_fresh[i]),
+            requested=np.array(state.requested[i], np.float64),
+            assigned_estimated=np.array(state.assigned_estimated[i], np.float64),
+            assigned_correction=np.array(state.assigned_correction[i], np.float64),
+            prod_assigned_estimated=np.array(state.prod_assigned_estimated[i], np.float64),
+            prod_assigned_correction=np.array(state.prod_assigned_correction[i], np.float64),
+            prod_usage=np.array(state.prod_usage[i], np.float64),
+        ))
+    return out

@@ -1,0 +1,137 @@
+"""Golden parity tests: LoadAware filter/score kernels vs sequential oracle.
+
+Mirrors the reference's load_aware_test.go strategy (fake NodeMetrics, exact
+filter statuses and scores) with a randomized cluster.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from koordinator_tpu.api.extension import PriorityClass, ResourceKind as RK
+from koordinator_tpu.api.types import (
+    AggregatedUsage, Node, NodeMetric, ObjectMeta, Pod,
+)
+from koordinator_tpu.scheduler.plugins import loadaware
+from koordinator_tpu.snapshot.builder import SnapshotBuilder
+
+from oracle import OracleArgs, make_oracle_nodes, oracle_filter, oracle_score
+
+NOW = 1_700_000_000.0
+
+
+def make_cluster(rng, num_nodes=24, stale_every=5, agg_every=3):
+    b = SnapshotBuilder(max_nodes=num_nodes)
+    for i in range(num_nodes):
+        cpu = float(rng.choice([16000, 32000, 64000]))
+        mem = float(rng.choice([32, 64, 128])) * 1024
+        b.add_node(Node(meta=ObjectMeta(name=f"n{i}", labels={"zone": f"z{i % 2}"}),
+                        allocatable={RK.CPU: cpu, RK.MEMORY: mem}))
+        if i % 7 == 6:
+            continue  # no koordlet on this node (no NodeMetric at all)
+        update = NOW - 1000.0 if i % stale_every == stale_every - 1 else NOW - 5.0
+        usage = {RK.CPU: float(rng.integers(0, cpu // 100) * 100),
+                 RK.MEMORY: float(rng.integers(0, mem // 256) * 256)}
+        metric = NodeMetric(node_name=f"n{i}", update_time=update,
+                            node_usage=usage)
+        if i % agg_every == 0:
+            metric.aggregated = [AggregatedUsage(
+                usages={"p95": {RK.CPU: usage[RK.CPU] * 1.2,
+                                RK.MEMORY: usage[RK.MEMORY] * 1.1},
+                        "p50": usage},
+                duration_seconds=300.0)]
+        b.set_node_metric(metric)
+    return b
+
+
+def make_pods(rng, count=40):
+    pods = []
+    for j in range(count):
+        prio = int(rng.choice([9100, 7100, 5100, 3100]))
+        pods.append(Pod(
+            meta=ObjectMeta(name=f"p{j}"),
+            requests={RK.CPU: float(rng.integers(1, 16) * 500),
+                      RK.MEMORY: float(rng.integers(1, 32) * 512)},
+            limits={},
+            priority=prio,
+            is_daemonset=bool(j % 11 == 10),
+        ))
+    return pods
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+@pytest.mark.parametrize("agg_filter,score_prod", [(False, False), (True, False),
+                                                   (False, True)])
+def test_filter_score_parity(seed, agg_filter, score_prod):
+    rng = np.random.default_rng(seed)
+    b = make_cluster(rng)
+    pods = make_pods(rng)
+    snap, ctx = b.build(now=NOW)
+    batch = b.build_pod_batch(pods, ctx)
+
+    kwargs = dict(score_according_prod_usage=score_prod)
+    oargs = OracleArgs.default()
+    oargs.score_according_prod_usage = score_prod
+    if agg_filter:
+        kwargs.update(filter_agg_type="p95",
+                      agg_usage_thresholds={RK.CPU: 70.0, RK.MEMORY: 95.0})
+        oargs.filter_agg_type = "p95"
+        oargs.agg_usage_thresholds = {RK.CPU: 70, RK.MEMORY: 95}
+    cfg = loadaware.LoadAwareConfig.make(**kwargs)
+
+    mask = np.asarray(loadaware.filter_mask(snap.nodes, batch, cfg))
+    scores = np.asarray(loadaware.score_matrix(snap.nodes, batch, cfg))
+
+    onodes = make_oracle_nodes(b, NOW)
+    for p, pod in enumerate(pods):
+        for n, on in enumerate(onodes):
+            want = oracle_filter(on, pod, oargs)
+            assert mask[p, n] == want, (p, n, pod.meta.name, on.node.meta.name)
+            got, want_s = scores[p, n], oracle_score(on, pod, oargs)
+            assert abs(got - want_s) <= 1.0, (p, n, got, want_s)
+
+
+def test_prod_threshold_gate():
+    """Prod pods are gated on prod-tier usage when ProdUsageThresholds set
+    (load_aware.go:151-160)."""
+    b = SnapshotBuilder(max_nodes=2)
+    b.add_node(Node(meta=ObjectMeta(name="hot"),
+                    allocatable={RK.CPU: 10000, RK.MEMORY: 32768}))
+    b.add_node(Node(meta=ObjectMeta(name="cool"),
+                    allocatable={RK.CPU: 10000, RK.MEMORY: 32768}))
+    from koordinator_tpu.api.types import PodMetricInfo
+    b.set_node_metric(NodeMetric(
+        node_name="hot", update_time=NOW,
+        node_usage={RK.CPU: 1000.0},
+        pods_metric=[PodMetricInfo(namespace="d", name="x",
+                                   priority_class=PriorityClass.PROD,
+                                   usage={RK.CPU: 8000.0})]))
+    b.set_node_metric(NodeMetric(node_name="cool", update_time=NOW,
+                                 node_usage={RK.CPU: 1000.0}))
+    snap, ctx = b.build(now=NOW)
+
+    prod_pod = Pod(meta=ObjectMeta(name="prod"), priority=9500,
+                   requests={RK.CPU: 100.0})
+    batch_pod = Pod(meta=ObjectMeta(name="batch"), priority=5500,
+                    requests={RK.CPU: 100.0})
+    batch = b.build_pod_batch([prod_pod, batch_pod], ctx)
+    cfg = loadaware.LoadAwareConfig.make(
+        prod_usage_thresholds={RK.CPU: 60.0})
+    mask = np.asarray(loadaware.filter_mask(snap.nodes, batch, cfg))
+    assert not mask[0, 0]   # prod pod rejected: prod usage 80% >= 60%
+    assert mask[0, 1]       # cool node fine
+    assert mask[1, 0]       # batch pod not subject to prod gate
+    assert mask[1, 1]
+
+
+def test_missing_metric_passes_filter_scores_zero():
+    b = SnapshotBuilder(max_nodes=1)
+    b.add_node(Node(meta=ObjectMeta(name="bare"),
+                    allocatable={RK.CPU: 1000, RK.MEMORY: 1024}))
+    snap, ctx = b.build(now=NOW)
+    batch = b.build_pod_batch([Pod(meta=ObjectMeta(name="p"),
+                                   requests={RK.CPU: 100.0})], ctx)
+    cfg = loadaware.LoadAwareConfig.make()
+    assert np.asarray(loadaware.filter_mask(snap.nodes, batch, cfg))[0, 0]
+    assert np.asarray(loadaware.score_matrix(snap.nodes, batch, cfg))[0, 0] == 0

@@ -1,0 +1,217 @@
+"""schedule_batch golden + invariant tests.
+
+- chunk-size-1 equivalence: feeding pods one at a time through the batched
+  kernel (carrying the snapshot between calls) must reproduce the sequential
+  oracle exactly — the batched commit degenerates to scheduleOne.
+- full-batch invariants: no node/quota overcommit, priority wins contention,
+  strict gangs are all-or-nothing.
+"""
+
+import numpy as np
+import pytest
+
+from koordinator_tpu.api.extension import ResourceKind as RK
+from koordinator_tpu.api.types import (
+    ElasticQuota, Node, NodeMetric, ObjectMeta, Pod, PodGroup,
+)
+from koordinator_tpu.scheduler import core
+from koordinator_tpu.scheduler.plugins import loadaware
+from koordinator_tpu.snapshot.builder import SnapshotBuilder
+
+from oracle import OracleArgs, OracleQuota, OracleScheduler, make_oracle_nodes
+
+NOW = 1_700_000_000.0
+
+
+def small_cluster(rng, num_nodes=12):
+    b = SnapshotBuilder(max_nodes=num_nodes)
+    for i in range(num_nodes):
+        cpu = float(rng.choice([8000, 16000]))
+        mem = float(rng.choice([16, 32])) * 1024
+        b.add_node(Node(meta=ObjectMeta(name=f"n{i}"),
+                        allocatable={RK.CPU: cpu, RK.MEMORY: mem}))
+        b.set_node_metric(NodeMetric(
+            node_name=f"n{i}", update_time=NOW - 2,
+            node_usage={RK.CPU: float(rng.integers(0, cpu // 200) * 100),
+                        RK.MEMORY: float(rng.integers(0, mem // 512) * 256)}))
+    return b
+
+
+def rand_pods(rng, count):
+    return [Pod(meta=ObjectMeta(name=f"p{j}"),
+                requests={RK.CPU: float(rng.integers(2, 12) * 500),
+                          RK.MEMORY: float(rng.integers(2, 16) * 512)},
+                priority=int(rng.choice([9100, 7100, 5100])))
+            for j in range(count)]
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2, 3])
+def test_chunk1_sequential_equivalence(seed):
+    rng = np.random.default_rng(seed)
+    b = small_cluster(rng)
+    pods = rand_pods(rng, 30)
+    snap, ctx = b.build(now=NOW)
+    cfg = loadaware.LoadAwareConfig.make()
+
+    # oracle runs in priority order; feed chunks of 1 in the same order
+    order = sorted(range(len(pods)), key=lambda i: (-(pods[i].priority or 0), i))
+    got = np.full((len(pods),), -1, np.int64)
+    cur = snap
+    for i in order:
+        batch = b.build_pod_batch([pods[i]], ctx)
+        res = core.schedule_batch(cur, batch, cfg, num_rounds=1)
+        got[i] = int(res.assignment[0])
+        cur = res.snapshot
+
+    oracle = OracleScheduler(make_oracle_nodes(b, NOW), OracleArgs.default())
+    want = oracle.schedule(pods)
+    np.testing.assert_array_equal(got, want)
+
+
+@pytest.mark.parametrize("seed", [0, 7])
+def test_full_batch_invariants(seed):
+    rng = np.random.default_rng(seed)
+    b = small_cluster(rng)
+    pods = rand_pods(rng, 80)  # oversubscribed on purpose
+    snap, ctx = b.build(now=NOW)
+    batch = b.build_pod_batch(pods, ctx)
+    cfg = loadaware.LoadAwareConfig.make()
+    res = core.schedule_batch(snap, batch, cfg, num_rounds=6)
+    a = np.asarray(res.assignment)
+    req = np.asarray(res.snapshot.nodes.requested)
+    alloc = np.asarray(snap.nodes.allocatable)
+
+    # 1. committed `requested` equals initial + sum of placed pod requests
+    expect = np.asarray(snap.nodes.requested).copy()
+    for j, pod in enumerate(pods):
+        if a[j] >= 0:
+            expect[a[j], int(RK.CPU)] += pod.requests[RK.CPU]
+            expect[a[j], int(RK.MEMORY)] += pod.requests[RK.MEMORY]
+    np.testing.assert_allclose(req, expect, atol=1.0)
+
+    # 2. no overcommit anywhere
+    assert np.all(req <= alloc + 1.0)
+
+    # 3. every unplaced pod truly has no allowed node left in the final
+    #    state: it must fail fit or the LoadAware gate everywhere
+    from koordinator_tpu.scheduler.plugins import loadaware as la
+    final_mask = np.asarray(la.filter_mask(res.snapshot.nodes, batch, cfg))
+    reqs = np.asarray(batch.requests)
+    for j in np.where(a < 0)[0]:
+        fits = np.all(req + reqs[j][None, :] <= alloc + 0.5, axis=1)
+        allowed = fits & final_mask[j]
+        assert not allowed.any(), (
+            f"pod {j} unplaced but node(s) {np.where(allowed)[0]} would "
+            f"still admit it")
+
+    # 4. priority respected under contention: count scheduled per class
+    prio = np.array([p.priority for p in pods])
+    if (a < 0).any() and (a >= 0).any():
+        # the lowest scheduled priority must not beat an unscheduled
+        # higher-priority pod that requested strictly less of everything
+        for j in np.where(a < 0)[0]:
+            for k in np.where(a >= 0)[0]:
+                if prio[j] > prio[k]:
+                    dominated = (pods[j].requests[RK.CPU] <= pods[k].requests[RK.CPU]
+                                 and pods[j].requests[RK.MEMORY] <= pods[k].requests[RK.MEMORY])
+                    assert not dominated, (
+                        f"pod {j} (prio {prio[j]}) unscheduled but dominated "
+                        f"pod {k} (prio {prio[k]}) was scheduled")
+
+
+def test_quota_gate_and_accounting():
+    b = SnapshotBuilder(max_nodes=4, max_quotas=4)
+    for i in range(4):
+        b.add_node(Node(meta=ObjectMeta(name=f"n{i}"),
+                        allocatable={RK.CPU: 64000, RK.MEMORY: 65536}))
+        b.set_node_metric(NodeMetric(node_name=f"n{i}", update_time=NOW,
+                                     node_usage={RK.CPU: 0.0}))
+    b.add_quota(ElasticQuota(meta=ObjectMeta(name="root"), is_parent=True,
+                             max={RK.CPU: 20000, RK.MEMORY: 1 << 30}))
+    b.add_quota(ElasticQuota(meta=ObjectMeta(name="team-a"), parent="root",
+                             max={RK.CPU: 12000, RK.MEMORY: 1 << 30}))
+    snap, ctx = b.build(now=NOW)
+    # runtime == max for this test (water-filling comes separately)
+    runtime = np.asarray(snap.quotas.runtime).copy()
+    runtime[0] = [20000, 1 << 30] + [np.inf] * 9
+    runtime[1] = [12000, 1 << 30] + [np.inf] * 9
+    snap = snap.replace(quotas=snap.quotas.replace(runtime=runtime))
+
+    pods = [Pod(meta=ObjectMeta(name=f"p{j}"), priority=9000 - j,
+                requests={RK.CPU: 4000.0, RK.MEMORY: 1024.0},
+                quota_name="team-a") for j in range(6)]
+    batch = b.build_pod_batch(pods, ctx)
+    cfg = loadaware.LoadAwareConfig.make()
+    res = core.schedule_batch(snap, batch, cfg, num_rounds=4)
+    a = np.asarray(res.assignment)
+    # team-a runtime 12000 CPU admits exactly 3 pods of 4000
+    assert (a >= 0).sum() == 3
+    # highest-priority pods won
+    assert set(np.where(a >= 0)[0]) == {0, 1, 2}
+    used = np.asarray(res.snapshot.quotas.used)
+    assert used[1, 0] == pytest.approx(12000)
+    assert used[0, 0] == pytest.approx(12000)  # propagated to parent
+
+
+def test_gang_all_or_nothing():
+    b = SnapshotBuilder(max_nodes=2, max_gangs=2)
+    for i in range(2):
+        b.add_node(Node(meta=ObjectMeta(name=f"n{i}"),
+                        allocatable={RK.CPU: 8000, RK.MEMORY: 16384}))
+        b.set_node_metric(NodeMetric(node_name=f"n{i}", update_time=NOW,
+                                     node_usage={}))
+    b.add_gang(PodGroup(meta=ObjectMeta(name="gang-big"), min_member=5,
+                        total_member=5))
+    b.add_gang(PodGroup(meta=ObjectMeta(name="gang-fit"), min_member=2,
+                        total_member=2))
+    snap, ctx = b.build(now=NOW)
+    # 5 members x 6000 CPU cannot all fit on 2 x 8000 nodes -> rollback
+    pods = ([Pod(meta=ObjectMeta(name=f"big{j}"), priority=9000,
+                 requests={RK.CPU: 6000.0, RK.MEMORY: 512.0},
+                 gang_name="gang-big") for j in range(5)]
+            + [Pod(meta=ObjectMeta(name=f"fit{j}"), priority=5000,
+                   requests={RK.CPU: 1000.0, RK.MEMORY: 512.0},
+                   gang_name="gang-fit") for j in range(2)])
+    batch = b.build_pod_batch(pods, ctx)
+    cfg = loadaware.LoadAwareConfig.make()
+    res = core.schedule_batch(snap, batch, cfg, num_rounds=4)
+    a = np.asarray(res.assignment)
+    assert np.all(a[:5] == -1), f"strict gang must roll back, got {a}"
+    assert np.all(a[5:] >= 0), "small gang should be placed"
+    assumed = np.asarray(res.snapshot.gangs.assumed)
+    assert assumed[0] == 0 and assumed[1] == 2
+    # rollback restored node accounting
+    req = np.asarray(res.snapshot.nodes.requested)
+    assert req[:, 0].sum() == pytest.approx(2000.0)
+
+
+def test_gang_quorum_prefilter():
+    """Gangs below quorum (member_count < minMember) are rejected up front
+    (coscheduling PreFilter, core.go:220-274)."""
+    b = SnapshotBuilder(max_nodes=1, max_gangs=1)
+    b.add_node(Node(meta=ObjectMeta(name="n0"),
+                    allocatable={RK.CPU: 64000, RK.MEMORY: 65536}))
+    b.set_node_metric(NodeMetric(node_name="n0", update_time=NOW, node_usage={}))
+    b.add_gang(PodGroup(meta=ObjectMeta(name="g"), min_member=4, total_member=2))
+    snap, ctx = b.build(now=NOW)
+    pods = [Pod(meta=ObjectMeta(name=f"p{j}"), priority=9000,
+                requests={RK.CPU: 100.0}, gang_name="g") for j in range(2)]
+    batch = b.build_pod_batch(pods, ctx)
+    res = core.schedule_batch(snap, batch, loadaware.LoadAwareConfig.make())
+    assert np.all(np.asarray(res.assignment) == -1)
+
+
+def test_node_selector_gate():
+    b = SnapshotBuilder(max_nodes=2)
+    b.add_node(Node(meta=ObjectMeta(name="gpu-node", labels={"pool": "gpu"}),
+                    allocatable={RK.CPU: 8000, RK.MEMORY: 16384}))
+    b.add_node(Node(meta=ObjectMeta(name="cpu-node", labels={"pool": "cpu"}),
+                    allocatable={RK.CPU: 8000, RK.MEMORY: 16384}))
+    for n in ("gpu-node", "cpu-node"):
+        b.set_node_metric(NodeMetric(node_name=n, update_time=NOW, node_usage={}))
+    snap, ctx = b.build(now=NOW)
+    pods = [Pod(meta=ObjectMeta(name="wants-gpu"), priority=9000,
+                requests={RK.CPU: 100.0}, node_selector={"pool": "gpu"})]
+    batch = b.build_pod_batch(pods, ctx)
+    res = core.schedule_batch(snap, batch, loadaware.LoadAwareConfig.make())
+    assert int(res.assignment[0]) == 0
