@@ -1,0 +1,13 @@
+"""Multi-chip scale-out: shard the node axis of the snapshot over a Mesh.
+
+The reference scales Filter/Score with chunked goroutines over nodes
+(k8s Parallelizer, SURVEY.md 2.9); the TPU-native analogue is sharding the
+node dimension of every [N, ...] column across chips so each chip
+filters/scores a node shard and the top-k select rides ICI collectives.
+"""
+
+from koordinator_tpu.parallel.mesh import (  # noqa: F401
+    make_mesh,
+    snapshot_sharding,
+    shard_snapshot,
+)

@@ -1,0 +1,74 @@
+"""Device mesh + sharding specs for the cluster snapshot.
+
+Design (scaling-book recipe): pick a mesh, annotate shardings, let XLA
+insert collectives.
+
+- 1D mesh over axis "nodes": every per-node column ([N, ...]) is sharded on
+  dim 0; pod batches, quota/gang state, and config are replicated. The
+  [P, N] score matrix is then computed shard-locally ([P, N/dev] per chip);
+  jax.lax.top_k over the sharded axis makes XLA emit an all-gather of the
+  per-shard top-k candidates over ICI (the global "selectHost" reduce);
+  scatter-commits to node columns land shard-locally.
+- The equivalent of sequence/context parallelism for this workload is
+  exactly this node-axis sharding (SURVEY.md 5 "long-context"): the scaling
+  axis is cluster size, and the collective pattern (shard-local reduce +
+  cross-chip top-k merge) mirrors ring-attention's shard-local softmax +
+  global combine.
+
+No shard_map is needed: `scheduler.core.schedule_batch` is pure jit, so
+annotating the snapshot's placement is enough (GSPMD propagates).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from koordinator_tpu.snapshot.schema import ClusterSnapshot
+
+NODE_AXIS = "nodes"
+
+
+def make_mesh(devices: Optional[list] = None) -> Mesh:
+    """1D mesh over all (or the given) devices on the node axis."""
+    devices = jax.devices() if devices is None else devices
+    return Mesh(np.asarray(devices), (NODE_AXIS,))
+
+
+def snapshot_sharding(mesh: Mesh) -> ClusterSnapshot:
+    """A ClusterSnapshot-shaped pytree of NamedShardings: node columns
+    sharded on dim 0, everything else replicated."""
+    node_spec = NamedSharding(mesh, P(NODE_AXIS))
+    repl = NamedSharding(mesh, P())
+
+    def node_field(_):
+        return node_spec
+
+    # nodes.* are all [N, ...] -> shard dim 0; other groups replicate
+    from koordinator_tpu.snapshot.schema import (
+        GangState, NodeState, QuotaState, ReservationState,
+    )
+    nodes = jax.tree_util.tree_map(node_field,
+                                   NodeState(*([0] * len(NodeState.__dataclass_fields__))))
+    quotas = jax.tree_util.tree_map(lambda _: repl,
+                                    QuotaState(*([0] * len(QuotaState.__dataclass_fields__))))
+    gangs = jax.tree_util.tree_map(lambda _: repl,
+                                   GangState(*([0] * len(GangState.__dataclass_fields__))))
+    res = jax.tree_util.tree_map(lambda _: repl,
+                                 ReservationState(*([0] * len(ReservationState.__dataclass_fields__))))
+    return ClusterSnapshot(nodes=nodes, quotas=quotas, gangs=gangs,
+                           reservations=res, version=repl)
+
+
+def shard_snapshot(snap: ClusterSnapshot, mesh: Mesh) -> ClusterSnapshot:
+    """Place a host snapshot onto the mesh (node axis sharded over ICI).
+
+    The node count must be divisible by the mesh size (pad capacities
+    accordingly; SnapshotBuilder's max_nodes is the padded size).
+    """
+    shardings = snapshot_sharding(mesh)
+    return jax.tree_util.tree_map(
+        lambda x, s: jax.device_put(x, s), snap, shardings)

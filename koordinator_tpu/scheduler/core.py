@@ -67,28 +67,38 @@ def _rank_by_priority(pods: PodBatch) -> jnp.ndarray:
     return jnp.zeros((p,), jnp.int32).at[order].set(jnp.arange(p, dtype=jnp.int32))
 
 
-def _segment_prefix_ok(sorted_seg: jnp.ndarray, sorted_req: jnp.ndarray,
-                       base_used: jnp.ndarray, limit: jnp.ndarray,
-                       num_segments: int) -> jnp.ndarray:
-    """For pods sorted by (segment, rank): does each pod fit when charged
-    after all earlier-ordered pods of its segment?
+def _segment_prefix_ok(seg: jnp.ndarray, earlier: jnp.ndarray,
+                       req: jnp.ndarray, base_used: jnp.ndarray,
+                       limit: jnp.ndarray, num_segments: int) -> jnp.ndarray:
+    """Does each pod fit its segment's limit when charged after all
+    earlier-ranked pods of the same segment?
 
-    bool[P]: base_used[seg] + inclusive-prefix-sum(req within seg) <= limit[seg].
-    Out-of-range segments (>= num_segments) are vacuously OK.
+    bool[P]: base_used[seg] + Σ req of same-segment earlier pods + own req
+    <= limit[seg]. Computed sort-free as a masked [P,P] x [P,R] matmul —
+    TPU sorts cost ~1.5ms for even tiny arrays while the MXU does this
+    contraction in microseconds. `earlier[p, p'] = rank[p'] < rank[p]` is
+    shared across all segment levels of a commit step. Out-of-range
+    segments (>= num_segments, the "no candidate" encoding) are vacuously
+    OK; their req rows are zeroed by the caller.
     """
-    csum = jnp.cumsum(sorted_req, axis=0)                       # [P, R]
-    start = jnp.searchsorted(sorted_seg, sorted_seg, side="left")
-    excl = csum - sorted_req
-    group_incl = csum - excl[start]                             # [P, R]
-    seg = jnp.clip(sorted_seg, 0, num_segments - 1)
-    ok = jnp.all(base_used[seg] + group_incl <= limit[seg] + EPS, axis=-1)
-    return ok | (sorted_seg >= num_segments)
+    same = seg[:, None] == seg[None, :]                         # [P, P]
+    mask = (same & earlier).astype(req.dtype)
+    cum_excl = mask @ req                                       # [P, R]
+    seg_c = jnp.clip(seg, 0, num_segments - 1)
+    ok = jnp.all(base_used[seg_c] + cum_excl + req <= limit[seg_c] + EPS,
+                 axis=-1)
+    return ok | (seg >= num_segments)
 
 
-@functools.partial(jax.jit, static_argnames=("num_rounds", "k_choices"))
+@functools.partial(jax.jit, static_argnames=("num_rounds", "k_choices",
+                                             "score_dims", "approx_topk",
+                                             "tie_break"))
 def schedule_batch(snap: ClusterSnapshot, pods: PodBatch,
                    cfg: loadaware.LoadAwareConfig,
-                   num_rounds: int = 4, k_choices: int = 8) -> ScheduleResult:
+                   num_rounds: int = 4, k_choices: int = 8,
+                   score_dims: tuple = None,
+                   approx_topk: bool = False,
+                   tie_break: bool = False) -> ScheduleResult:
     """Schedule a pod batch against the snapshot. Pure function; the caller
     publishes `result.snapshot` as the next version (store.update)."""
     nodes0, quotas0, gangs0 = snap.nodes, snap.quotas, snap.gangs
@@ -98,7 +108,8 @@ def schedule_batch(snap: ClusterSnapshot, pods: PodBatch,
     p = pods.num_pods
 
     rank = _rank_by_priority(pods)
-    arange_p = jnp.arange(p, dtype=jnp.int32)
+    # rank[p'] < rank[p], shared by every prefix gate in the commit
+    earlier = rank[None, :] < rank[:, None]                      # [P, P]
 
     # --- static (per-batch) gates -------------------------------------------
     # nodeSelector gate: sel_match[sel_id, label_group[n]]
@@ -115,6 +126,12 @@ def schedule_batch(snap: ClusterSnapshot, pods: PodBatch,
     pod_anc = jnp.where(pods.quota_id[:, None] >= 0,
                         quotas0.depth_ancestor[quota_id], -1)    # [P, D]
 
+    # LoadAware filter is round-invariant: it reads only NodeMetric-derived
+    # columns and thresholds, never assume state (load_aware.go:123-254
+    # touches no NodeInfo.requested), so compute it once for the batch.
+    la_ok = loadaware.filter_mask(nodes0, pods, cfg)
+    static_ok = la_ok & sel_ok & nodes0.schedulable[None, :]     # [P, N]
+
     def round_body(carry, _):
         requested, quota_used, assigned_est, prod_assigned_est, \
             gang_placed, placed, out_score = carry
@@ -128,10 +145,7 @@ def schedule_batch(snap: ClusterSnapshot, pods: PodBatch,
         # --- feasibility [P, N] (HOT LOOP #1) ---
         fit = jnp.all(pods.requests[:, None, :] + requested[None]
                       <= nodes.allocatable[None] + EPS, axis=-1)
-        la_ok = loadaware.filter_mask(nodes, pods, cfg)
-        feasible = (fit & sel_ok & la_ok
-                    & nodes.schedulable[None, :]
-                    & active[:, None])
+        feasible = fit & static_ok & active[:, None]
 
         # quota admission (ElasticQuota PreFilter, plugin.go:211-257):
         # used + request <= runtime at every tree level
@@ -150,10 +164,26 @@ def schedule_batch(snap: ClusterSnapshot, pods: PodBatch,
         # falls through to its next-best node. Within a round the LoadAware
         # inputs are frozen (the reference's NodeMetric does not change on
         # assume either); capacity and quota stay exact via prefix sums.
-        scores = loadaware.score_matrix(nodes, pods, cfg)
+        scores = loadaware.score_matrix(nodes, pods, cfg, score_dims)
+        if tie_break:
+            # k8s selectHost picks uniformly among max-score nodes
+            # (schedule_one.go reservoir sample); a deterministic per-
+            # (pod, node) jitter < 0.5 reproduces that spread without
+            # reordering distinct integer scores, and de-clusters the
+            # batched argmax under contention.
+            pi = jnp.arange(p, dtype=jnp.uint32)[:, None]
+            ni = jnp.arange(n_nodes, dtype=jnp.uint32)[None, :]
+            h = (pi * jnp.uint32(2654435761) + ni * jnp.uint32(40503)) & 1023
+            scores = scores + h.astype(jnp.float32) * (0.49 / 1024.0)
         masked = jnp.where(feasible, scores, -1.0)
         k = min(k_choices, n_nodes)
-        topk_val, topk_idx = jax.lax.top_k(masked, k)
+        if approx_topk:
+            # TPU-optimized partial reduction (approx_max_k) — the choice
+            # list is a heuristic preference order, so bounded recall only
+            # means an occasional pod falls to a later round.
+            topk_val, topk_idx = jax.lax.approx_max_k(masked, k)
+        else:
+            topk_val, topk_idx = jax.lax.top_k(masked, k)
         topk_idx = topk_idx.astype(jnp.int32)
 
         def inner(inner_carry, _):
@@ -165,20 +195,18 @@ def schedule_batch(snap: ClusterSnapshot, pods: PodBatch,
 
             # node capacity prefix in priority order
             eff_req = jnp.where(trying[:, None], pods.requests, 0.0)
-            perm = jnp.lexsort((rank, choice_eff))
-            ok_node = jnp.zeros((p,), bool).at[perm].set(
-                _segment_prefix_ok(choice_eff[perm], eff_req[perm],
-                                   requested, nodes.allocatable, n_nodes))
-            accept = trying & ok_node
+            accept = trying & _segment_prefix_ok(
+                choice_eff, earlier, eff_req, requested,
+                nodes.allocatable, n_nodes)
 
             # quota prefix per tree level, same trick
             for d in range(MAX_QUOTA_DEPTH):
                 anc = jnp.where(accept, pod_anc[:, d], -1)
                 anc_eff = jnp.where(anc >= 0, anc, n_quotas)
-                perm_q = jnp.lexsort((rank, anc_eff))
-                accept &= jnp.zeros((p,), bool).at[perm_q].set(
-                    _segment_prefix_ok(anc_eff[perm_q], eff_req[perm_q],
-                                       quota_used, quotas0.runtime, n_quotas))
+                acc_req = jnp.where(accept[:, None], pods.requests, 0.0)
+                accept &= _segment_prefix_ok(
+                    anc_eff, earlier, acc_req, quota_used,
+                    quotas0.runtime, n_quotas)
 
             # scatter-commit (assume; scheduler_adapter assume/forget)
             acc_req = pods.requests * accept[:, None]

@@ -1,0 +1,1 @@
+"""Shared utilities (reference pkg/util, SURVEY.md 2.7)."""

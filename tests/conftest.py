@@ -19,4 +19,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import jax  # noqa: E402
 
+# The env var alone is not enough on hosts whose site config pins
+# jax_platforms (e.g. to a TPU tunnel platform); force CPU explicitly.
+jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", False)
