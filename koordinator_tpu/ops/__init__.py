@@ -1,0 +1,2 @@
+"""Device kernels shared across components (top-k commit lives in
+scheduler/core; this package holds self-contained numerical ops)."""

@@ -1,0 +1,23 @@
+"""Quota demand accounting: fold a pending pod batch into the quota tree's
+DIRECT demand column before the water-filling solve.
+
+Mirrors GroupQuotaManager.updatePodRequest: a pod's request charges its own
+quota; ancestor propagation happens inside ops.waterfill with the reference's
+per-level min/max clamp (group_quota_manager.go
+recursiveUpdateGroupTreeWithDeltaRequest)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from koordinator_tpu.snapshot.schema import PodBatch, QuotaState
+
+
+@jax.jit
+def add_pending_demand(quotas: QuotaState, pods: PodBatch) -> QuotaState:
+    q = quotas.min.shape[0]
+    req = pods.requests * pods.valid[:, None]
+    tgt = jnp.where(pods.quota_id >= 0, pods.quota_id, q)
+    demand = quotas.demand.at[tgt].add(req, mode="drop")
+    return quotas.replace(demand=demand)

@@ -1,0 +1,162 @@
+"""Hierarchical elastic-quota runtime calculation (fair-share water-filling).
+
+Behavior parity with elasticquota/core/runtime_quota_calculator.go:111-168
+(`quotaTree.redistribution` + `iterationForRedistribution`), applied level by
+level down the tree (each parent redistributes its own runtime to its
+children, GroupQuotaManager semantics):
+
+1. autoScaleMin = max(min, guarantee). A child whose demand (limitedRequest)
+   exceeds autoScaleMin starts at runtime = autoScaleMin and participates in
+   redistribution weighted by sharedWeight; a child under its min keeps
+   runtime = demand (or min when allowLentResource is false).
+2. The parent's remaining resource is handed out in rounds:
+   delta = floor(weight * remaining / totalWeight + 0.5); children clamp at
+   their demand; the next round re-partitions ONLY the excess returned by
+   the children that clamped (iterationForRedistribution recursion —
+   un-handed rounding remainder is dropped, which also guarantees
+   termination: a round either returns excess from a newly-capped child or
+   ends the group).
+
+TPU-native formulation: all sibling groups x all resource dims iterate
+simultaneously — the loop state is [Q, R] tensors with per-parent segment
+sums, so one fixed-point solves the entire forest (the reference allocates
+one recursive solver per parent per dim).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from koordinator_tpu.snapshot.schema import MAX_QUOTA_DEPTH, QuotaState
+
+
+def _seg_sum(values: jnp.ndarray, seg: jnp.ndarray, num: int) -> jnp.ndarray:
+    """Segment-sum rows of [Q, R] by seg id (clip invalid to a dump row)."""
+    out = jnp.zeros((num + 1,) + values.shape[1:], values.dtype)
+    return out.at[jnp.where(seg >= 0, seg, num)].add(values)[:num]
+
+
+def propagate_demand(quotas: QuotaState) -> jnp.ndarray:
+    """f32[Q, R]: limitedRequest per quota, from DIRECT demand.
+
+    Bottom-up walk with the reference's per-level clamp
+    (group_quota_manager.go:184-214 recursiveUpdateGroupTreeWithDeltaRequest
+    + quota_info.go:196-211 getLimitRequestNoLock): each quota's request is
+    its own pods' demand plus Σ children's *limited* requests; a quota that
+    does not lend floors its request at min; the value passed upward is
+    min(request, max). One unrolled level loop (depth is static)."""
+    q = quotas.min.shape[0]
+    depth = jnp.sum(quotas.depth_ancestor >= 0, axis=-1) - 1  # [Q]
+
+    def clamp(subtree):
+        floored = jnp.where(quotas.allow_lent[:, None], subtree,
+                            jnp.maximum(subtree, quotas.min))
+        return jnp.minimum(floored, quotas.max)
+
+    subtree = quotas.demand
+    for d in range(MAX_QUOTA_DEPTH - 1, 0, -1):
+        at_d = (depth == d)[:, None]
+        contrib = _seg_sum(jnp.where(at_d, clamp(subtree), 0.0),
+                           jnp.where(at_d[:, 0], quotas.parent, -1), q)
+        subtree = subtree + contrib
+    return clamp(subtree)
+
+
+def _redistribute_level(level_mask: jnp.ndarray, parent: jnp.ndarray,
+                        parent_total: jnp.ndarray, demand: jnp.ndarray,
+                        min_eff: jnp.ndarray, weight: jnp.ndarray,
+                        allow_lent: jnp.ndarray, num_quotas: int,
+                        max_iters: int) -> jnp.ndarray:
+    """Runtime for all quotas of one level, vectorized over sibling groups
+    and resource dims. Inputs are full [Q, ...] tensors; rows outside
+    `level_mask` contribute nothing and return 0."""
+    m = level_mask[:, None]                       # [Q, 1]
+    adjusting = m & (demand > min_eff)            # [Q, R]
+    runtime0 = jnp.where(
+        adjusting, min_eff,
+        jnp.where(allow_lent[:, None], jnp.minimum(demand, min_eff), min_eff))
+    runtime0 = jnp.where(m, runtime0, 0.0)
+
+    # remaining per parent = parent_total - Σ children initial runtime
+    spent = _seg_sum(runtime0, parent, num_quotas)          # [Q, R]
+    remaining = jnp.maximum(parent_total - spent, 0.0)      # [Q, R] (by parent row)
+
+    def cond(state):
+        it, runtime, adjusting, remaining = state
+        total_w = _seg_sum(jnp.where(adjusting, weight, 0.0),
+                           parent, num_quotas)
+        want = (remaining > 0.5) & (total_w > 0)
+        return (it < max_iters) & jnp.any(want)
+
+    def body(state):
+        it, runtime, adjusting, remaining = state
+        w = jnp.where(adjusting, weight, 0.0)               # [Q, R]
+        total_w = _seg_sum(w, parent, num_quotas)           # [Q, R] per parent
+        group_live = (remaining > 0.5) & (total_w > 0)      # [Q, R] parent rows
+        tw = jnp.take(total_w, jnp.maximum(parent, 0), axis=0)
+        rem = jnp.take(remaining, jnp.maximum(parent, 0), axis=0)
+        live = adjusting & (tw > 0) & (rem > 0.5)
+        delta = jnp.where(live,
+                          jnp.floor(w * rem / jnp.maximum(tw, 1e-9) + 0.5),
+                          0.0)
+        new_runtime = runtime + delta
+        over = live & (new_runtime >= demand)
+        excess = jnp.where(over, new_runtime - demand, 0.0)
+        new_runtime = jnp.where(over, demand, new_runtime)
+        # the next round re-partitions only the excess returned by children
+        # that hit their demand; a live group's un-handed rounding remainder
+        # is dropped (iterationForRedistribution recursion passes
+        # toPartitionResource = Σ(runtime − request)) — this both matches the
+        # reference and guarantees termination when every delta rounds to 0
+        returned = _seg_sum(excess, parent, num_quotas)
+        remaining = jnp.where(group_live, returned, remaining)
+        adjusting = adjusting & ~over
+        return (it + 1, new_runtime, adjusting, remaining)
+
+    state = (jnp.int32(0), runtime0, adjusting, remaining)
+    _, runtime, _, _ = jax.lax.while_loop(cond, body, state)
+    return jnp.where(m, runtime, 0.0)
+
+
+@functools.partial(jax.jit, static_argnames=("max_iters",))
+def compute_runtime(quotas: QuotaState, cluster_total: jnp.ndarray,
+                    max_iters: int = 64) -> jnp.ndarray:
+    """f32[Q, R]: runtime entitlement for every quota in the forest.
+
+    Top-down over tree levels: roots partition `cluster_total` [R], each
+    lower level partitions its parent's freshly computed runtime. Invalid
+    quota rows get +inf (no gating), preserving schedule_batch's "no quota"
+    fast path.
+    """
+    q = quotas.min.shape[0]
+    min_eff = quotas.min                           # guarantee folded upstream
+    demand = propagate_demand(quotas)              # limitedRequest per quota
+    # per-dim sharedWeight, defaulting to max (quota_info.go semantics)
+    weight = jnp.where(quotas.shared_weight > 0, quotas.shared_weight,
+                       quotas.max)
+    weight = jnp.where(jnp.isfinite(weight), weight, 1.0)
+
+    depth = jnp.sum(quotas.depth_ancestor >= 0, axis=-1) - 1  # [Q], -1 invalid
+    runtime = jnp.zeros_like(quotas.min)
+
+    for d in range(MAX_QUOTA_DEPTH):
+        level = quotas.valid & (depth == d)
+        if d == 0:
+            # Each root owns a whole quota tree against the cluster total
+            # (multi-quota-tree: one RuntimeQuotaCalculator per tree);
+            # a root's runtime is the tree capacity, capped by its max.
+            rt = jnp.minimum(quotas.max, cluster_total[None, :])
+            runtime = jnp.where(level[:, None], rt, runtime)
+            continue
+        parent_total = runtime                      # [Q, R] indexed by parent
+        rt = _redistribute_level(level, quotas.parent, parent_total,
+                                 demand, min_eff, weight, quotas.allow_lent,
+                                 q, max_iters)
+        runtime = jnp.where(level[:, None], rt, runtime)
+
+    # clamp by max everywhere; invalid rows never gate
+    runtime = jnp.minimum(runtime, quotas.max)
+    return jnp.where(quotas.valid[:, None], runtime, jnp.inf)

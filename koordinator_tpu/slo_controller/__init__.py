@@ -1,0 +1,8 @@
+"""slo-controller equivalent: the colocation overcommit engine, NodeMetric
+lifecycle policy, and NodeSLO strategy rendering (SURVEY.md 2.3).
+
+TPU-first design: instead of one controller-runtime reconcile per node, the
+whole cluster's node columns go through batched calculators ([N, R] tensors,
+jit-able) — one program updates every node's batch/mid allocatable per
+NodeMetric sync round.
+"""

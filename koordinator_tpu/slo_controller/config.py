@@ -1,0 +1,124 @@
+"""Colocation strategy config: the dynamic `slo-controller-config` ConfigMap
+schema and its per-node-selector merge semantics.
+
+Capability parity with apis/configuration/slo_controller_config.go
+(ColocationCfg / ColocationStrategy) + pkg/util/sloconfig defaults and the
+per-nodeSelector strategy merge in nodeslo/resource_strategy.go: the cluster
+config carries a cluster-wide strategy plus an ordered list of node-selector
+overrides; the first matching override (merged over the cluster strategy)
+wins for a node.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+from typing import Dict, List, Optional
+
+
+class CalculatePolicy(enum.Enum):
+    """Batch allocatable calculation policy (apis/configuration
+    slo_controller_config.go CalculatePolicy)."""
+
+    USAGE = "usage"
+    REQUEST = "request"
+    MAX_USAGE_REQUEST = "maxUsageRequest"
+
+
+@dataclasses.dataclass
+class ColocationStrategy:
+    """Per-(cluster|node-group) overcommit strategy.
+
+    Field parity with configuration.ColocationStrategy; defaults from
+    pkg/util/sloconfig/colocation_config.go (DefaultColocationStrategy).
+    """
+
+    enable: bool = False
+    metric_aggregate_duration_seconds: float = 300.0
+    metric_report_interval_seconds: float = 60.0
+    # percent of node capacity reclaimable for batch tier
+    cpu_reclaim_threshold_percent: float = 60.0
+    memory_reclaim_threshold_percent: float = 65.0
+    # mid-tier caps as percent of node allocatable
+    mid_cpu_threshold_percent: float = 10.0
+    mid_memory_threshold_percent: float = 10.0
+    # skip node update when relative diff below this
+    resource_diff_threshold: float = 0.1
+    # reset batch resources when NodeMetric is stale for this long
+    degrade_time_minutes: float = 15.0
+    update_time_threshold_seconds: float = 300.0
+    cpu_calculate_policy: CalculatePolicy = CalculatePolicy.USAGE
+    memory_calculate_policy: CalculatePolicy = CalculatePolicy.USAGE
+    # node reservation percent applied to capacity before reclaim
+    # (getNodeReservation: reserveRatio = (100-thresholdPercent)/100)
+
+    def merged(self, override: "ColocationStrategyOverride") -> "ColocationStrategy":
+        out = dataclasses.replace(self)
+        for k, v in override.fields.items():
+            if not hasattr(out, k):
+                raise KeyError(f"unknown strategy field {k!r}")
+            setattr(out, k, v)
+        return out
+
+
+@dataclasses.dataclass
+class ColocationStrategyOverride:
+    """NodeColocationCfg: a node-label selector plus partial strategy."""
+
+    node_selector: Dict[str, str] = dataclasses.field(default_factory=dict)
+    fields: Dict[str, object] = dataclasses.field(default_factory=dict)
+
+    def matches(self, node_labels: Dict[str, str]) -> bool:
+        return all(node_labels.get(k) == v for k, v in self.node_selector.items())
+
+
+@dataclasses.dataclass
+class ColocationConfig:
+    """slo-controller-config `colocation-config` entry (ColocationCfg)."""
+
+    cluster_strategy: ColocationStrategy = dataclasses.field(
+        default_factory=ColocationStrategy)
+    node_overrides: List[ColocationStrategyOverride] = dataclasses.field(
+        default_factory=list)
+
+    def strategy_for(self, node_labels: Dict[str, str]) -> ColocationStrategy:
+        """First matching node override merged over the cluster strategy
+        (nodeslo/resource_strategy.go getNodeColocationStrategy)."""
+        for ov in self.node_overrides:
+            if ov.matches(node_labels):
+                return self.cluster_strategy.merged(ov)
+        return self.cluster_strategy
+
+
+def validate_colocation_config(cfg: ColocationConfig) -> List[str]:
+    """ConfigMap-webhook-style validation (pkg/webhook/cm +
+    sloconfig/colocation_validator.go). Returns a list of problems."""
+    problems = []
+
+    def check(s: ColocationStrategy, where: str):
+        if not 0 <= s.cpu_reclaim_threshold_percent <= 100:
+            problems.append(f"{where}: cpuReclaimThresholdPercent out of [0,100]")
+        if not 0 <= s.memory_reclaim_threshold_percent <= 100:
+            problems.append(f"{where}: memoryReclaimThresholdPercent out of [0,100]")
+        if not 0 <= s.mid_cpu_threshold_percent <= 100:
+            problems.append(f"{where}: midCPUThresholdPercent out of [0,100]")
+        if not 0 <= s.mid_memory_threshold_percent <= 100:
+            problems.append(f"{where}: midMemoryThresholdPercent out of [0,100]")
+        if not 0 <= s.resource_diff_threshold <= 1:
+            problems.append(f"{where}: resourceDiffThreshold out of [0,1]")
+        if s.degrade_time_minutes <= 0:
+            problems.append(f"{where}: degradeTimeMinutes must be positive")
+        if s.metric_report_interval_seconds <= 0:
+            problems.append(f"{where}: metricReportIntervalSeconds must be positive")
+
+    check(cfg.cluster_strategy, "cluster")
+    for i, ov in enumerate(cfg.node_overrides):
+        if not ov.node_selector:
+            problems.append(f"nodeOverride[{i}]: empty node selector")
+        try:
+            merged = cfg.cluster_strategy.merged(ov)
+        except KeyError as e:
+            problems.append(f"nodeOverride[{i}]: {e}")
+            continue
+        check(merged, f"nodeOverride[{i}]")
+    return problems

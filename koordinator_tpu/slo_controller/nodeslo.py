@@ -1,0 +1,108 @@
+"""NodeSLO rendering: turn cluster config + per-node overrides into the
+per-node QoS strategy object the node agent enforces.
+
+Capability parity with pkg/slo-controller/nodeslo (SURVEY.md 2.3): the
+reference renders a NodeSLO CR per Node from the `slo-controller-config`
+ConfigMap strategies (resourceThreshold / resourceQOS / cpuBurst / system),
+each with per-nodeSelector overrides merged over the cluster default
+(nodeslo/resource_strategy.go). Here the render is a pure function
+node labels -> NodeSLO; the agent consumes it directly.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional
+
+from koordinator_tpu.api.types import (
+    CPUBurstStrategy,
+    NodeSLO,
+    ResourceQOSStrategy,
+    ResourceThresholdStrategy,
+    SystemStrategy,
+)
+
+
+@dataclasses.dataclass
+class StrategyOverride:
+    """One per-nodeSelector override entry: partial fields replacing the
+    cluster default for matching nodes."""
+
+    node_selector: Dict[str, str] = dataclasses.field(default_factory=dict)
+    fields: Dict[str, object] = dataclasses.field(default_factory=dict)
+
+    def matches(self, labels: Dict[str, str]) -> bool:
+        return all(labels.get(k) == v for k, v in self.node_selector.items())
+
+
+@dataclasses.dataclass
+class SLOControllerConfig:
+    """The full dynamic config: cluster defaults + overrides per strategy
+    family (apis/configuration/slo_controller_config.go)."""
+
+    threshold: ResourceThresholdStrategy = dataclasses.field(
+        default_factory=ResourceThresholdStrategy)
+    threshold_overrides: List[StrategyOverride] = dataclasses.field(
+        default_factory=list)
+    cpu_burst: CPUBurstStrategy = dataclasses.field(
+        default_factory=CPUBurstStrategy)
+    cpu_burst_overrides: List[StrategyOverride] = dataclasses.field(
+        default_factory=list)
+    resource_qos: ResourceQOSStrategy = dataclasses.field(
+        default_factory=ResourceQOSStrategy)
+    resource_qos_overrides: List[StrategyOverride] = dataclasses.field(
+        default_factory=list)
+    system: SystemStrategy = dataclasses.field(default_factory=SystemStrategy)
+    system_overrides: List[StrategyOverride] = dataclasses.field(
+        default_factory=list)
+
+
+def _merge(base, overrides: List[StrategyOverride],
+           labels: Dict[str, str]):
+    out = dataclasses.replace(base)
+    for ov in overrides:
+        if ov.matches(labels):
+            for k, v in ov.fields.items():
+                if not hasattr(out, k):
+                    raise KeyError(f"unknown strategy field {k!r}")
+                setattr(out, k, v)
+            break  # first match wins (resource_strategy.go)
+    return out
+
+
+def render_node_slo(cfg: SLOControllerConfig, node_name: str,
+                    node_labels: Optional[Dict[str, str]] = None) -> NodeSLO:
+    """getNodeSLOSpec equivalent: cluster default + first matching override
+    per strategy family."""
+    labels = node_labels or {}
+    qos = _merge(cfg.resource_qos, cfg.resource_qos_overrides, labels)
+    qos = dataclasses.replace(
+        qos, tiers={k: dict(v) for k, v in qos.tiers.items()})
+    return NodeSLO(
+        node_name=node_name,
+        threshold=_merge(cfg.threshold, cfg.threshold_overrides, labels),
+        cpu_burst=_merge(cfg.cpu_burst, cfg.cpu_burst_overrides, labels),
+        resource_qos=qos,
+        system=_merge(cfg.system, cfg.system_overrides, labels),
+    )
+
+
+@dataclasses.dataclass
+class NodeMetricCollectPolicy:
+    """NodeMetric spec collect policy distributed by the nodemetric
+    controller (pkg/slo-controller/nodemetric/collect_policy.go)."""
+
+    aggregate_duration_seconds: float = 300.0
+    report_interval_seconds: float = 60.0
+    node_aggregate_policy_durations: List[float] = dataclasses.field(
+        default_factory=lambda: [300.0, 600.0, 1800.0])
+
+
+def collect_policy_from_colocation(metric_aggregate_duration_seconds: float,
+                                   metric_report_interval_seconds: float,
+                                   ) -> NodeMetricCollectPolicy:
+    """nodemetric controller: derive the collect policy from the colocation
+    strategy fields (collect_policy.go getNodeMetricCollectPolicy)."""
+    return NodeMetricCollectPolicy(
+        aggregate_duration_seconds=metric_aggregate_duration_seconds,
+        report_interval_seconds=metric_report_interval_seconds)

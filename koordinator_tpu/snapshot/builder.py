@@ -343,6 +343,7 @@ class SnapshotBuilder:
         qmin = np.zeros((q, r), np.float32)
         qmax = np.full((q, r), np.inf, np.float32)
         weight = np.zeros((q, r), np.float32)
+        allow_lent = np.ones((q,), bool)
         parent = np.full((q,), -1, np.int32)
         ancestors = np.zeros((q, q), bool)
         used = np.zeros((q, r), np.float32)
@@ -356,6 +357,7 @@ class SnapshotBuilder:
             weight[i] = np.where(wv > 0, wv, np.where(np.isinf(qmax[i]), 1.0,
                                                       qmax[i]))
             parent[i] = self.quota_index.get(quota.parent, -1)
+            allow_lent[i] = quota.allow_lent_resource
             valid[i] = True
         depth_anc = np.full((q, MAX_QUOTA_DEPTH), -1, np.int32)
         for i in range(len(self.quotas)):
@@ -386,9 +388,13 @@ class SnapshotBuilder:
         # propagate used up the tree: used[a] = Σ direct_used[q] over quotas q
         # with a ∈ ancestors(q) (GroupQuotaManager updateGroupDeltaUsed walk)
         used = ancestors.astype(np.float32).T @ direct_used
+        # demand is DIRECT per-quota pod demand; ops.waterfill propagates it
+        # bottom-up with the per-level min/max clamp (limitedRequest). The
+        # scheduler adds pending-batch demand (ops.quota_demand) first.
         return QuotaState(min=qmin, max=qmax, shared_weight=weight,
                           parent=parent, ancestors=ancestors,
                           depth_ancestor=depth_anc, used=used,
+                          demand=direct_used.copy(), allow_lent=allow_lent,
                           runtime=np.full((q, r), np.inf, np.float32),
                           valid=valid)
 

@@ -128,6 +128,13 @@ class QuotaState:
                             # exact per-level prefix gate without a Q x Q
                             # matmul per pod (D = MAX_QUOTA_DEPTH)
     used: Array             # f32[Q, R] admitted usage
+    demand: Array           # f32[Q, R] DIRECT pod demand charged to the
+                            # pod's own quota only; ops.waterfill propagates
+                            # it bottom-up with the per-level min/max clamp
+                            # into limitedRequest (quota_info.go
+                            # getLimitRequestNoLock + group_quota_manager.go
+                            # recursiveUpdateGroupTreeWithDeltaRequest)
+    allow_lent: Array       # bool[Q] allowLentResource: lend unused min
     runtime: Array          # f32[Q, R] water-filled entitlement
     valid: Array            # bool[Q]
 
@@ -210,6 +217,8 @@ def zeros_snapshot(num_nodes: int, num_quotas: int = 1, num_gangs: int = 1,
         ancestors=jnp.zeros((q, q), bool),
         depth_ancestor=jnp.full((q, MAX_QUOTA_DEPTH), -1, jnp.int32),
         used=jnp.zeros((q, r), f32),
+        demand=jnp.zeros((q, r), f32),
+        allow_lent=jnp.ones((q,), bool),
         runtime=jnp.full((q, r), jnp.inf, f32),
         valid=jnp.zeros((q,), bool),
     )

@@ -138,7 +138,9 @@ def synthetic_cluster(num_nodes: int, seed: int = 0,
     quotas = QuotaState(
         min=quota_min, max=quota_max, shared_weight=weight, parent=parent,
         ancestors=ancestors, depth_ancestor=depth_anc,
-        used=np.zeros((q, R), f32), runtime=quota_max.copy(), valid=qvalid)
+        used=np.zeros((q, R), f32), demand=np.zeros((q, R), f32),
+        allow_lent=np.ones((q,), bool),
+        runtime=quota_max.copy(), valid=qvalid)
 
     g = max_gangs
     gangs = GangState(

@@ -23,3 +23,8 @@ import jax  # noqa: E402
 # jax_platforms (e.g. to a TPU tunnel platform); force CPU explicitly.
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", False)
+
+# Persistent compilation cache: the schedule/waterfill programs are large and
+# CPU XLA compiles are minutes-slow; cache them across pytest runs.
+jax.config.update("jax_compilation_cache_dir", "/tmp/koord_tpu_jax_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)

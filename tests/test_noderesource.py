@@ -1,0 +1,235 @@
+"""slo-controller overcommit engine tests: batch/mid formulas, degrade,
+diff-gate (reference semantics: batchresource/util.go:38-90, midresource
+plugin.go:130-160, plugin.go:467-484)."""
+
+import numpy as np
+
+from koordinator_tpu.api.extension import ResourceKind as RK
+from koordinator_tpu.api.types import (
+    Node,
+    NodeMetric,
+    ObjectMeta,
+    Pod,
+    PodMetricInfo,
+)
+from koordinator_tpu.api.extension import PriorityClass
+from koordinator_tpu.slo_controller.config import (
+    CalculatePolicy,
+    ColocationConfig,
+    ColocationStrategy,
+    ColocationStrategyOverride,
+    validate_colocation_config,
+)
+from koordinator_tpu.slo_controller.noderesource import (
+    CPU,
+    MEM,
+    NodeResourceController,
+    build_inputs,
+    compute_node_resources,
+    need_sync,
+)
+
+
+def mk_node(name="n0", cpu=100000.0, mem=400000.0):
+    return Node(meta=ObjectMeta(name=name),
+                allocatable={RK.CPU: cpu, RK.MEMORY: mem})
+
+
+def mk_prod_pod(name, cpu, mem, node="n0"):
+    return Pod(meta=ObjectMeta(name=name), priority=9500,
+               requests={RK.CPU: cpu, RK.MEMORY: mem},
+               node_name=node, phase="Running")
+
+
+def test_batch_by_usage_formula():
+    """Batch = Capacity − NodeReserved − max(SysUsed, SysReserved) − HPUsed."""
+    node = mk_node(cpu=100000.0, mem=100000.0)
+    metric = NodeMetric(
+        node_name="n0", update_time=1000.0,
+        system_usage={RK.CPU: 7000.0, RK.MEMORY: 5000.0},
+        pods_metric=[PodMetricInfo(
+            namespace="default", name="p0",
+            priority_class=PriorityClass.PROD,
+            usage={RK.CPU: 20000.0, RK.MEMORY: 30000.0})])
+    pods = [mk_prod_pod("p0", 30000.0, 40000.0)]
+    strategy = ColocationStrategy(
+        enable=True, cpu_reclaim_threshold_percent=60.0,
+        memory_reclaim_threshold_percent=65.0)
+    inputs = build_inputs([node], {"n0": metric}, {"n0": pods}, now=1030.0)
+    out = compute_node_resources(inputs, strategy)
+    # cpu: 100000 − 40000(reserve 40%) − 7000 − 20000 = 33000
+    assert out["batch"][0, CPU] == 33000.0
+    # mem: 100000 − 35000(reserve 35%) − 5000 − 30000 = 30000
+    assert out["batch"][0, MEM] == 30000.0
+    assert not out["degraded"][0]
+
+
+def test_pod_without_metric_counts_at_request():
+    node = mk_node(cpu=100000.0, mem=100000.0)
+    metric = NodeMetric(node_name="n0", update_time=1000.0,
+                        system_usage={RK.CPU: 0.0, RK.MEMORY: 0.0})
+    pods = [mk_prod_pod("p0", 30000.0, 40000.0)]  # no metric entry
+    strategy = ColocationStrategy(cpu_reclaim_threshold_percent=100.0,
+                                  memory_reclaim_threshold_percent=100.0)
+    inputs = build_inputs([node], {"n0": metric}, {"n0": pods}, now=1000.0)
+    out = compute_node_resources(inputs, strategy)
+    assert out["batch"][0, CPU] == 70000.0   # charged at request
+    assert out["batch"][0, MEM] == 60000.0
+
+
+def test_dangling_metric_counts_at_usage():
+    """A pod metric with no matching pod in the list still subtracts."""
+    node = mk_node(cpu=100000.0, mem=100000.0)
+    metric = NodeMetric(
+        node_name="n0", update_time=0.0,
+        pods_metric=[PodMetricInfo(
+            namespace="default", name="ghost",
+            priority_class=PriorityClass.PROD,
+            usage={RK.CPU: 10000.0, RK.MEMORY: 15000.0})])
+    strategy = ColocationStrategy(cpu_reclaim_threshold_percent=100.0,
+                                  memory_reclaim_threshold_percent=100.0,
+                                  degrade_time_minutes=1e9)
+    inputs = build_inputs([node], {"n0": metric}, {"n0": []}, now=0.0)
+    out = compute_node_resources(inputs, strategy)
+    assert out["batch"][0, CPU] == 90000.0
+    assert out["batch"][0, MEM] == 85000.0
+
+
+def test_memory_by_request_policy():
+    node = mk_node(cpu=100000.0, mem=100000.0)
+    metric = NodeMetric(
+        node_name="n0", update_time=1000.0,
+        system_usage={RK.CPU: 0.0, RK.MEMORY: 9000.0},
+        pods_metric=[PodMetricInfo(
+            namespace="default", name="p0",
+            priority_class=PriorityClass.PROD,
+            usage={RK.CPU: 1000.0, RK.MEMORY: 20000.0})])
+    pods = [mk_prod_pod("p0", 30000.0, 50000.0)]
+    strategy = ColocationStrategy(
+        cpu_reclaim_threshold_percent=100.0,
+        memory_reclaim_threshold_percent=100.0,
+        memory_calculate_policy=CalculatePolicy.REQUEST)
+    inputs = build_inputs([node], {"n0": metric}, {"n0": pods}, now=1000.0)
+    out = compute_node_resources(inputs, strategy)
+    # request policy ignores system usage, uses system reserved (0 here)
+    assert out["batch"][0, MEM] == 50000.0
+    # cpu stays usage policy
+    assert out["batch"][0, CPU] == 99000.0
+
+
+def test_degrade_resets_batch():
+    node = mk_node()
+    metric = NodeMetric(node_name="n0", update_time=0.0)
+    strategy = ColocationStrategy(degrade_time_minutes=15.0)
+    inputs = build_inputs([node], {"n0": metric}, {"n0": []},
+                          now=16.0 * 60.0)
+    out = compute_node_resources(inputs, strategy)
+    assert out["degraded"][0]
+    assert (out["batch"][0] == -1.0).all()
+    assert (out["mid"][0] == -1.0).all()
+
+
+def test_mid_capped_by_threshold():
+    node = mk_node(cpu=100000.0, mem=100000.0)
+    metric = NodeMetric(node_name="n0", update_time=1000.0,
+                        prod_reclaimable={RK.CPU: 50000.0,
+                                          RK.MEMORY: 2000.0})
+    strategy = ColocationStrategy(mid_cpu_threshold_percent=10.0,
+                                  mid_memory_threshold_percent=10.0)
+    inputs = build_inputs([node], {"n0": metric}, {"n0": []}, now=1000.0)
+    out = compute_node_resources(inputs, strategy)
+    assert out["mid"][0, CPU] == 10000.0   # capped at 10% of allocatable
+    assert out["mid"][0, MEM] == 2000.0    # reclaimable below cap
+
+
+def test_need_sync_diff_gate():
+    old = np.array([[10000.0, 10000.0], [10000.0, 10000.0]], np.float32)
+    new = np.array([[10500.0, 10000.0],    # 5% diff < 10% => no sync
+                    [12000.0, 10000.0]], np.float32)  # 20% => sync
+    mask = need_sync(old, new, 0.1)
+    assert not mask[0] and mask[1]
+
+
+def test_controller_sync_mask_and_state():
+    nodes = [mk_node(f"n{i}") for i in range(3)]
+    metrics = {f"n{i}": NodeMetric(node_name=f"n{i}", update_time=100.0)
+               for i in range(3)}
+    ctl = NodeResourceController()
+    inputs = build_inputs(nodes, metrics, {}, now=100.0)
+    out1 = ctl.reconcile(inputs)
+    assert out1["sync_mask"].all()  # first round always syncs
+    out2 = ctl.reconcile(inputs)
+    assert not out2["sync_mask"].any()  # no change => no sync
+
+
+def test_sync_gate_latches_applied_value():
+    """Sub-threshold drift accumulates against the last APPLIED value and
+    eventually syncs (reference diffs vs node status, plugin.go:101-112)."""
+    node = mk_node(cpu=100000.0, mem=100000.0)
+    ctl = NodeResourceController(strategy=ColocationStrategy(
+        cpu_reclaim_threshold_percent=100.0,
+        memory_reclaim_threshold_percent=100.0,
+        resource_diff_threshold=0.1))
+
+    def usage(v):
+        m = NodeMetric(node_name="n0", update_time=0.0,
+                       system_usage={RK.CPU: v, RK.MEMORY: 0.0})
+        return build_inputs([node], {"n0": m}, {"n0": []}, now=0.0)
+
+    ctl.reconcile(usage(0.0))                      # applied batch cpu 100000
+    out = ctl.reconcile(usage(5000.0))             # 5% drift: below gate
+    assert not out["sync_mask"][0]
+    out = ctl.reconcile(usage(9000.0))             # 9% cumulative: still below
+    assert not out["sync_mask"][0]
+    out = ctl.reconcile(usage(12000.0))            # 12% vs applied: syncs
+    assert out["sync_mask"][0]
+
+
+def test_per_node_strategies():
+    nodes = [mk_node("n0"), mk_node("n1")]
+    mets = {n.meta.name: NodeMetric(node_name=n.meta.name, update_time=0.0)
+            for n in nodes}
+    inputs = build_inputs(nodes, mets, {}, now=0.0)
+    base = ColocationStrategy(cpu_reclaim_threshold_percent=60.0,
+                              memory_reclaim_threshold_percent=100.0)
+    hot = ColocationStrategy(cpu_reclaim_threshold_percent=80.0,
+                             memory_reclaim_threshold_percent=100.0)
+    out = compute_node_resources(inputs, base, strategies=[base, hot])
+    assert out["batch"][0, CPU] == 60000.0
+    assert out["batch"][1, CPU] == 80000.0
+
+
+def test_colocation_config_merge_and_validation():
+    cfg = ColocationConfig(
+        cluster_strategy=ColocationStrategy(cpu_reclaim_threshold_percent=60.0),
+        node_overrides=[ColocationStrategyOverride(
+            node_selector={"pool": "batch"},
+            fields={"cpu_reclaim_threshold_percent": 80.0})])
+    assert cfg.strategy_for({"pool": "batch"}).cpu_reclaim_threshold_percent == 80.0
+    assert cfg.strategy_for({"pool": "other"}).cpu_reclaim_threshold_percent == 60.0
+    assert validate_colocation_config(cfg) == []
+
+    bad = ColocationConfig(
+        cluster_strategy=ColocationStrategy(cpu_reclaim_threshold_percent=150.0))
+    assert validate_colocation_config(bad)
+
+
+def test_nodeslo_render():
+    from koordinator_tpu.slo_controller.nodeslo import (
+        SLOControllerConfig,
+        StrategyOverride,
+        render_node_slo,
+    )
+    from koordinator_tpu.api.types import ResourceThresholdStrategy
+
+    cfg = SLOControllerConfig(
+        threshold=ResourceThresholdStrategy(
+            enable=True, cpu_suppress_threshold_percent=65.0),
+        threshold_overrides=[StrategyOverride(
+            node_selector={"tier": "gold"},
+            fields={"cpu_suppress_threshold_percent": 50.0})])
+    slo = render_node_slo(cfg, "n0", {"tier": "gold"})
+    assert slo.threshold.cpu_suppress_threshold_percent == 50.0
+    assert slo.threshold.enable
+    slo2 = render_node_slo(cfg, "n1", {})
+    assert slo2.threshold.cpu_suppress_threshold_percent == 65.0

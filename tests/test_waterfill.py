@@ -1,0 +1,239 @@
+"""Golden tests: water-filling runtime kernel vs a sequential oracle
+re-implementing runtime_quota_calculator.go redistribution semantics."""
+
+import numpy as np
+import pytest
+
+from koordinator_tpu.api.extension import NUM_RESOURCES, ResourceKind as RK
+from koordinator_tpu.api.types import ElasticQuota, Node, ObjectMeta
+from koordinator_tpu.ops import waterfill
+from koordinator_tpu.snapshot.builder import SnapshotBuilder
+
+
+def oracle_redistribute(children, total):
+    """quotaTree.redistribution (runtime_quota_calculator.go:111-141) +
+    iterationForRedistribution (:144-168), one resource dim. Each recursion
+    re-partitions ONLY the excess returned by children that hit their
+    request; the rounding remainder of a round is dropped."""
+    runtimes = {}
+    adjusting, tot_w = [], 0.0
+    to_partition = total
+    for c in children:
+        mn = c["min"]
+        if c["demand"] > mn:
+            adjusting.append(c)
+            tot_w += c["weight"]
+            rt = mn
+        else:
+            rt = c["demand"] if c["allow_lent"] else mn
+        runtimes[c["name"]] = rt
+        to_partition -= rt
+
+    while to_partition > 0 and tot_w > 0 and adjusting:
+        nxt, nxt_w, returned = [], 0.0, 0.0
+        for c in adjusting:
+            delta = np.floor(c["weight"] * to_partition / tot_w + 0.5)
+            rt = runtimes[c["name"]] + delta
+            if rt < c["demand"]:
+                nxt.append(c)
+                nxt_w += c["weight"]
+                runtimes[c["name"]] = rt
+            else:
+                returned += rt - c["demand"]
+                runtimes[c["name"]] = c["demand"]
+        to_partition = returned
+        adjusting, tot_w = nxt, nxt_w
+    return runtimes
+
+
+def build_forest(rng, num_children=6, two_level=True):
+    b = SnapshotBuilder(max_nodes=1, max_quotas=32)
+    b.add_node(Node(meta=ObjectMeta(name="n0"), allocatable={}))
+    total = 100000.0
+    b.add_quota(ElasticQuota(meta=ObjectMeta(name="root"),
+                             max={RK.CPU: total}, is_parent=True))
+    spec = {"root": {"max": total, "parent": None}}
+    for i in range(num_children):
+        mx = float(rng.integers(10, 60) * 1000)
+        mn = float(rng.integers(0, 10) * 1000)
+        w = float(rng.integers(1, 10) * 1000)
+        allow = bool(rng.uniform() < 0.8)
+        b.add_quota(ElasticQuota(
+            meta=ObjectMeta(name=f"c{i}"), parent="root",
+            min={RK.CPU: mn}, max={RK.CPU: mx},
+            shared_weight={RK.CPU: w},
+            allow_lent_resource=allow))
+        spec[f"c{i}"] = {"min": mn, "max": mx, "weight": w,
+                         "allow_lent": allow, "parent": "root"}
+    return b, spec, total
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_waterfill_matches_oracle(seed):
+    rng = np.random.default_rng(seed)
+    b, spec, total = build_forest(rng)
+    snap, _ = b.build(now=0.0)
+
+    # random demand per child
+    demand = np.array(snap.quotas.demand).copy()
+    names = [q.meta.name for q in b.quotas]
+    child_specs = []
+    for i, name in enumerate(names):
+        if name == "root":
+            demand[i, int(RK.CPU)] = 0.0
+            continue
+        d = float(rng.integers(0, 80) * 1000)
+        demand[i, int(RK.CPU)] = d
+        s = spec[name]
+        child_specs.append({
+            "name": name, "min": s["min"],
+            "demand": min(d, s["max"]),
+            "weight": s["weight"], "allow_lent": s["allow_lent"]})
+    quotas = snap.quotas.replace(demand=demand)
+
+    cluster_total = np.zeros((NUM_RESOURCES,), np.float32)
+    cluster_total[int(RK.CPU)] = total
+    runtime = np.asarray(waterfill.compute_runtime(quotas, cluster_total))
+
+    want = oracle_redistribute(child_specs, total)
+    for i, name in enumerate(names):
+        if name == "root":
+            assert runtime[i, int(RK.CPU)] == pytest.approx(total)
+            continue
+        got = runtime[i, int(RK.CPU)]
+        assert got == pytest.approx(want[name], abs=1.5), (
+            name, got, want[name])
+
+
+def test_waterfill_respects_min_when_not_lending():
+    """allowLentResource=false keeps runtime at min even with zero demand
+    (redistribution else-branch, runtime_quota_calculator.go:131-137)."""
+    b = SnapshotBuilder(max_nodes=1, max_quotas=8)
+    b.add_node(Node(meta=ObjectMeta(name="n0"), allocatable={}))
+    b.add_quota(ElasticQuota(meta=ObjectMeta(name="root"),
+                             max={RK.CPU: 10000.0}))
+    b.add_quota(ElasticQuota(meta=ObjectMeta(name="hoarder"), parent="root",
+                             min={RK.CPU: 4000.0}, max={RK.CPU: 8000.0},
+                             allow_lent_resource=False))
+    b.add_quota(ElasticQuota(meta=ObjectMeta(name="hungry"), parent="root",
+                             min={RK.CPU: 0.0}, max={RK.CPU: 10000.0},
+                             shared_weight={RK.CPU: 1.0}))
+    snap, _ = b.build(now=0.0)
+    demand = np.array(snap.quotas.demand)
+    demand[2, int(RK.CPU)] = 10000.0  # hungry wants everything
+    quotas = snap.quotas.replace(demand=demand)
+    total = np.zeros((NUM_RESOURCES,), np.float32)
+    total[int(RK.CPU)] = 10000.0
+    runtime = np.asarray(waterfill.compute_runtime(quotas, total))
+    assert runtime[1, int(RK.CPU)] == pytest.approx(4000.0)  # kept min
+    assert runtime[2, int(RK.CPU)] == pytest.approx(6000.0)  # the rest
+
+
+def test_demand_clamped_by_child_max_before_parent():
+    """A child's runaway demand is capped at its max before it reaches the
+    parent (limitedRequest propagation, group_quota_manager.go:184-214), so
+    it cannot starve its parent's siblings."""
+    b = SnapshotBuilder(max_nodes=1, max_quotas=8)
+    b.add_node(Node(meta=ObjectMeta(name="n0"), allocatable={}))
+    b.add_quota(ElasticQuota(meta=ObjectMeta(name="root"),
+                             max={RK.CPU: 100000.0}))
+    # mid is a parent whose only child has max 10k
+    b.add_quota(ElasticQuota(meta=ObjectMeta(name="mid"), parent="root",
+                             max={RK.CPU: 100000.0},
+                             shared_weight={RK.CPU: 1.0}, is_parent=True))
+    b.add_quota(ElasticQuota(meta=ObjectMeta(name="capped"), parent="mid",
+                             max={RK.CPU: 10000.0},
+                             shared_weight={RK.CPU: 1.0}))
+    b.add_quota(ElasticQuota(meta=ObjectMeta(name="sib"), parent="root",
+                             max={RK.CPU: 100000.0},
+                             shared_weight={RK.CPU: 1.0}))
+    snap, _ = b.build(now=0.0)
+    demand = np.array(snap.quotas.demand)
+    names = [q.meta.name for q in b.quotas]
+    demand[names.index("capped"), int(RK.CPU)] = 100000.0  # wants 10x its max
+    demand[names.index("sib"), int(RK.CPU)] = 100000.0
+    quotas = snap.quotas.replace(demand=demand)
+    total = np.zeros((NUM_RESOURCES,), np.float32)
+    total[int(RK.CPU)] = 100000.0
+    runtime = np.asarray(waterfill.compute_runtime(quotas, total))
+    # mid's limitedRequest is 10k (child clamp), so sib gets the other 90k
+    assert runtime[names.index("mid"), int(RK.CPU)] == pytest.approx(10000.0)
+    assert runtime[names.index("sib"), int(RK.CPU)] == pytest.approx(90000.0)
+    assert runtime[names.index("capped"), int(RK.CPU)] == pytest.approx(10000.0)
+
+
+def test_non_lending_child_floors_parent_demand():
+    """allowLentResource=false floors the subtree request at min during
+    propagation (recursiveUpdateGroupTreeWithDeltaRequest min floor)."""
+    b = SnapshotBuilder(max_nodes=1, max_quotas=8)
+    b.add_node(Node(meta=ObjectMeta(name="n0"), allocatable={}))
+    b.add_quota(ElasticQuota(meta=ObjectMeta(name="root"),
+                             max={RK.CPU: 100000.0}))
+    b.add_quota(ElasticQuota(meta=ObjectMeta(name="mid"), parent="root",
+                             max={RK.CPU: 100000.0},
+                             shared_weight={RK.CPU: 1.0}, is_parent=True))
+    b.add_quota(ElasticQuota(meta=ObjectMeta(name="hoard"), parent="mid",
+                             min={RK.CPU: 30000.0}, max={RK.CPU: 50000.0},
+                             allow_lent_resource=False,
+                             shared_weight={RK.CPU: 1.0}))
+    b.add_quota(ElasticQuota(meta=ObjectMeta(name="sib"), parent="root",
+                             max={RK.CPU: 100000.0},
+                             shared_weight={RK.CPU: 1.0}))
+    snap, _ = b.build(now=0.0)
+    demand = np.array(snap.quotas.demand)
+    names = [q.meta.name for q in b.quotas]
+    demand[names.index("sib"), int(RK.CPU)] = 100000.0  # hoard demands nothing
+    quotas = snap.quotas.replace(demand=demand)
+    total = np.zeros((NUM_RESOURCES,), np.float32)
+    total[int(RK.CPU)] = 100000.0
+    runtime = np.asarray(waterfill.compute_runtime(quotas, total))
+    # hoard's 30k min is kept inside mid's subtree request even at 0 demand
+    assert runtime[names.index("mid"), int(RK.CPU)] == pytest.approx(30000.0)
+    assert runtime[names.index("sib"), int(RK.CPU)] == pytest.approx(70000.0)
+
+
+def test_demand_fold_and_runtime_gate_end_to_end():
+    """add_pending_demand -> compute_runtime -> schedule_batch admission."""
+    import jax.numpy as jnp
+
+    from koordinator_tpu.api.extension import ResourceKind
+    from koordinator_tpu.api.types import NodeMetric, Pod
+    from koordinator_tpu.ops.quota_demand import add_pending_demand
+    from koordinator_tpu.scheduler import core
+    from koordinator_tpu.scheduler.plugins import loadaware
+
+    b = SnapshotBuilder(max_nodes=2, max_quotas=8)
+    for i in range(2):
+        b.add_node(Node(meta=ObjectMeta(name=f"n{i}"),
+                        allocatable={RK.CPU: 100000, RK.MEMORY: 1 << 20}))
+        b.set_node_metric(NodeMetric(node_name=f"n{i}", update_time=1.0,
+                                     node_usage={}))
+    b.add_quota(ElasticQuota(meta=ObjectMeta(name="root"),
+                             max={RK.CPU: 30000.0, RK.MEMORY: 1 << 30}))
+    # two siblings with equal weight, no min: fair share = half each
+    for name in ("a", "b"):
+        b.add_quota(ElasticQuota(meta=ObjectMeta(name=name), parent="root",
+                                 max={RK.CPU: 30000.0, RK.MEMORY: 1 << 30},
+                                 shared_weight={RK.CPU: 1.0, RK.MEMORY: 1.0}))
+    snap, ctx = b.build(now=1.0)
+    pods = [Pod(meta=ObjectMeta(name=f"pa{j}"), priority=9000,
+                requests={RK.CPU: 5000.0, RK.MEMORY: 64.0}, quota_name="a")
+            for j in range(4)]
+    pods += [Pod(meta=ObjectMeta(name=f"pb{j}"), priority=8000,
+                 requests={RK.CPU: 5000.0, RK.MEMORY: 64.0}, quota_name="b")
+             for j in range(4)]
+    batch = b.build_pod_batch(pods, ctx)
+
+    quotas = add_pending_demand(snap.quotas, batch)
+    total = np.zeros((NUM_RESOURCES,), np.float32)
+    total[int(RK.CPU)] = 30000.0
+    total[int(RK.MEMORY)] = float(1 << 30)
+    runtime = waterfill.compute_runtime(quotas, total)
+    snap = snap.replace(quotas=quotas.replace(runtime=runtime))
+
+    res = core.schedule_batch(snap, batch, loadaware.LoadAwareConfig.make(),
+                              num_rounds=2)
+    a = np.asarray(res.assignment)
+    # fair share 15000 CPU each -> 3 pods per quota (demand 20000 each)
+    assert (a[:4] >= 0).sum() == 3
+    assert (a[4:] >= 0).sum() == 3
