@@ -1,0 +1,50 @@
+"""Shared batched-commit primitives: priority ranking and the sort-free
+segment prefix gate used by every sequential-equivalent commit kernel
+(node capacity, quota levels, reservations).
+
+Split out of core.py so plugin kernels (reservation pre-pass, device
+allocator) can reuse them without a circular import.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from koordinator_tpu.snapshot.schema import PodBatch
+
+EPS = 0.5  # comparison tolerance in canonical units (millicores / MiB)
+
+
+def rank_by_priority(pods: PodBatch) -> jnp.ndarray:
+    """i32[P]: position in scheduling order — priority desc, index asc.
+
+    The batched analogue of the scheduler queue order (Coscheduling Less +
+    default PrioritySort); gang-group batching is handled by the caller.
+    """
+    p = pods.priority.shape[0]
+    order = jnp.lexsort((jnp.arange(p), -pods.priority))
+    return jnp.zeros((p,), jnp.int32).at[order].set(
+        jnp.arange(p, dtype=jnp.int32))
+
+
+def segment_prefix_ok(seg: jnp.ndarray, earlier: jnp.ndarray,
+                      req: jnp.ndarray, base_used: jnp.ndarray,
+                      limit: jnp.ndarray, num_segments: int) -> jnp.ndarray:
+    """Does each pod fit its segment's limit when charged after all
+    earlier-ranked pods of the same segment?
+
+    bool[P]: base_used[seg] + Σ req of same-segment earlier pods + own req
+    <= limit[seg]. Computed sort-free as a masked [P,P] x [P,R] matmul —
+    TPU sorts cost ~1.5ms for even tiny arrays while the MXU does this
+    contraction in microseconds. `earlier[p, p'] = rank[p'] < rank[p]` is
+    shared across all segment levels of a commit step. Out-of-range
+    segments (>= num_segments, the "no candidate" encoding) are vacuously
+    OK; their req rows are zeroed by the caller.
+    """
+    same = seg[:, None] == seg[None, :]                         # [P, P]
+    mask = (same & earlier).astype(req.dtype)
+    cum_excl = mask @ req                                       # [P, R]
+    seg_c = jnp.clip(seg, 0, num_segments - 1)
+    ok = jnp.all(base_used[seg_c] + cum_excl + req <= limit[seg_c] + EPS,
+                 axis=-1)
+    return ok | (seg >= num_segments)

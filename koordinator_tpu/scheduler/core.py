@@ -39,14 +39,22 @@ import flax.struct
 import jax
 import jax.numpy as jnp
 
+from koordinator_tpu.scheduler.batching import (
+    EPS,
+    rank_by_priority,
+    segment_prefix_ok,
+)
 from koordinator_tpu.scheduler.plugins import loadaware
+from koordinator_tpu.scheduler.plugins.reservation import (
+    MAX_NODE_SCORE,
+    rebuild_reservations,
+    reservation_prepass,
+)
 from koordinator_tpu.snapshot.schema import (
     ClusterSnapshot,
     MAX_QUOTA_DEPTH,
     PodBatch,
 )
-
-EPS = 0.5  # comparison tolerance in canonical units (millicores / MiB)
 
 
 @flax.struct.dataclass
@@ -54,40 +62,6 @@ class ScheduleResult:
     assignment: jnp.ndarray      # i32[P] node index, -1 = unschedulable
     chosen_score: jnp.ndarray    # f32[P] score of the chosen node (debug)
     snapshot: ClusterSnapshot    # post-commit snapshot (requested/used updated)
-
-
-def _rank_by_priority(pods: PodBatch) -> jnp.ndarray:
-    """i32[P]: position in scheduling order — priority desc, index asc.
-
-    The batched analogue of the scheduler queue order (Coscheduling Less +
-    default PrioritySort); gang-group batching is handled by the caller.
-    """
-    p = pods.priority.shape[0]
-    order = jnp.lexsort((jnp.arange(p), -pods.priority))
-    return jnp.zeros((p,), jnp.int32).at[order].set(jnp.arange(p, dtype=jnp.int32))
-
-
-def _segment_prefix_ok(seg: jnp.ndarray, earlier: jnp.ndarray,
-                       req: jnp.ndarray, base_used: jnp.ndarray,
-                       limit: jnp.ndarray, num_segments: int) -> jnp.ndarray:
-    """Does each pod fit its segment's limit when charged after all
-    earlier-ranked pods of the same segment?
-
-    bool[P]: base_used[seg] + Σ req of same-segment earlier pods + own req
-    <= limit[seg]. Computed sort-free as a masked [P,P] x [P,R] matmul —
-    TPU sorts cost ~1.5ms for even tiny arrays while the MXU does this
-    contraction in microseconds. `earlier[p, p'] = rank[p'] < rank[p]` is
-    shared across all segment levels of a commit step. Out-of-range
-    segments (>= num_segments, the "no candidate" encoding) are vacuously
-    OK; their req rows are zeroed by the caller.
-    """
-    same = seg[:, None] == seg[None, :]                         # [P, P]
-    mask = (same & earlier).astype(req.dtype)
-    cum_excl = mask @ req                                       # [P, R]
-    seg_c = jnp.clip(seg, 0, num_segments - 1)
-    ok = jnp.all(base_used[seg_c] + cum_excl + req <= limit[seg_c] + EPS,
-                 axis=-1)
-    return ok | (seg >= num_segments)
 
 
 @functools.partial(jax.jit, static_argnames=("num_rounds", "k_choices",
@@ -107,7 +81,7 @@ def schedule_batch(snap: ClusterSnapshot, pods: PodBatch,
     n_gangs = gangs0.min_member.shape[0]
     p = pods.num_pods
 
-    rank = _rank_by_priority(pods)
+    rank = rank_by_priority(pods)
     # rank[p'] < rank[p], shared by every prefix gate in the commit
     earlier = rank[None, :] < rank[:, None]                      # [P, P]
 
@@ -131,6 +105,12 @@ def schedule_batch(snap: ClusterSnapshot, pods: PodBatch,
     # touches no NodeInfo.requested), so compute it once for the batch.
     la_ok = loadaware.filter_mask(nodes0, pods, cfg)
     static_ok = la_ok & sel_ok & nodes0.schedulable[None, :]     # [P, N]
+
+    # --- reservation restore/consume pre-pass (transformer.go:240-291) ------
+    # Matching pods consume reserved capacity (already counted in node
+    # `requested`) in exact priority order; they skip the normal rounds.
+    res_placed, res_slot, quota_used0 = reservation_prepass(
+        snap, pods, static_ok, earlier, pod_anc, gang_ok)
 
     def round_body(carry, _):
         requested, quota_used, assigned_est, prod_assigned_est, \
@@ -195,7 +175,7 @@ def schedule_batch(snap: ClusterSnapshot, pods: PodBatch,
 
             # node capacity prefix in priority order
             eff_req = jnp.where(trying[:, None], pods.requests, 0.0)
-            accept = trying & _segment_prefix_ok(
+            accept = trying & segment_prefix_ok(
                 choice_eff, earlier, eff_req, requested,
                 nodes.allocatable, n_nodes)
 
@@ -204,7 +184,7 @@ def schedule_batch(snap: ClusterSnapshot, pods: PodBatch,
                 anc = jnp.where(accept, pod_anc[:, d], -1)
                 anc_eff = jnp.where(anc >= 0, anc, n_quotas)
                 acc_req = jnp.where(accept[:, None], pods.requests, 0.0)
-                accept &= _segment_prefix_ok(
+                accept &= segment_prefix_ok(
                     anc_eff, earlier, acc_req, quota_used,
                     quotas0.runtime, n_quotas)
 
@@ -242,11 +222,25 @@ def schedule_batch(snap: ClusterSnapshot, pods: PodBatch,
         return (requested, quota_used, assigned_est, prod_assigned_est,
                 gang_placed, placed, out_score), None
 
-    init = (nodes0.requested, quotas0.used, nodes0.assigned_estimated,
-            nodes0.prod_assigned_estimated,
-            jnp.zeros((n_gangs,), jnp.int32),
-            jnp.full((p,), -1, jnp.int32),
-            jnp.full((p,), -1.0, jnp.float32))
+    # Seed the round carry with the reservation pre-pass result: consuming
+    # pods are already placed (node requested unchanged — covered capacity
+    # was pre-charged), their estimates feed the next scores (podAssignCache
+    # tracks reservation consumers too), and they count toward gang quorum.
+    res_ok = res_placed >= 0
+    res_tgt = jnp.where(res_ok, res_placed, n_nodes)
+    res_est = pods.estimated * res_ok[:, None]
+    is_prod0 = pods.priority_class == 4  # PriorityClass.PROD
+    init = (
+        nodes0.requested,
+        quota_used0,
+        nodes0.assigned_estimated.at[res_tgt].add(res_est, mode="drop"),
+        nodes0.prod_assigned_estimated.at[res_tgt].add(
+            res_est * is_prod0[:, None], mode="drop"),
+        jnp.zeros((n_gangs,), jnp.int32).at[
+            jnp.where(res_ok & (pods.gang_id >= 0), pods.gang_id,
+                      n_gangs)].add(1, mode="drop"),
+        res_placed,
+        jnp.where(res_ok, MAX_NODE_SCORE, -1.0).astype(jnp.float32))
     (_, _, _, _, gang_placed, placed, out_score), _ = jax.lax.scan(
         round_body, init, None, length=num_rounds)
 
@@ -264,7 +258,10 @@ def schedule_batch(snap: ClusterSnapshot, pods: PodBatch,
     fin_req = pods.requests * ok[:, None]
     fin_est = pods.estimated * ok[:, None]
     is_prod = pods.priority_class == 4
-    requested = nodes0.requested.at[tgt].add(fin_req, mode="drop")
+    # reservation consumers don't grow node requested (covered capacity was
+    # already charged by the reserve pod, plugin.go:521-613)
+    node_req = fin_req * (res_slot < 0)[:, None]
+    requested = nodes0.requested.at[tgt].add(node_req, mode="drop")
     assigned_est = nodes0.assigned_estimated.at[tgt].add(fin_est, mode="drop")
     prod_assigned_est = nodes0.prod_assigned_estimated.at[tgt].add(
         fin_est * is_prod[:, None], mode="drop")
@@ -284,6 +281,8 @@ def schedule_batch(snap: ClusterSnapshot, pods: PodBatch,
                              prod_assigned_estimated=prod_assigned_est),
         quotas=quotas0.replace(used=quota_used),
         gangs=gangs0.replace(assumed=gang_assumed),
+        reservations=rebuild_reservations(snap.reservations, pods,
+                                          res_slot, ok),
         version=snap.version + 1,
     )
     return ScheduleResult(assignment=placed, chosen_score=chosen_score,

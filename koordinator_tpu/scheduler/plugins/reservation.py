@@ -1,0 +1,164 @@
+"""Reservation plugin: batched restore/consume semantics.
+
+Behavior parity with plugins/reservation/ (SURVEY.md 2.1):
+- A Reservation is scheduled ahead of time as a "reserve pod", so its full
+  allocatable is already counted in node `requested`
+  (transformer.go restoreUnmatchedReservations comment: reservations and
+  consuming pods would otherwise be cumulative; the net accounting keeps
+  exactly the reservation's allocatable charged).
+- When a pending pod matches a reservation's owners, the reserved capacity
+  is effectively returned to the pod's view of the node
+  (transformer.go:240 restoreMatchedReservation), the nominator picks the
+  reservation to consume, and Reserve allocates from it — so a consuming
+  pod does NOT increase node `requested` for the covered portion
+  (plugin.go:521-613).
+- AllocateOnce reservations admit a single consumer and are then exhausted
+  (plugin.go:509-510).
+
+Batched TPU design: reservations are rare (V small), so instead of carrying
+a [P, N, R] restore tensor through the hot feasibility kernel, a pre-pass
+scans the V reservation slots: for each slot, all matching pods are admitted
+in priority order against the slot's free capacity with an exact prefix-sum
+gate (the sequential-assume equivalent), quota levels included. Pods the
+pre-pass places skip the normal rounds; pods whose requests exceed the
+remaining reserved capacity fall through and schedule as normal pods
+(documented deviation: the reference lets a pod straddle reservation +
+node free capacity; the pre-pass is all-or-nothing per pod, conservative
+because reserved capacity stays charged to the node either way).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from koordinator_tpu.scheduler.batching import EPS, segment_prefix_ok
+from koordinator_tpu.snapshot.schema import (
+    ClusterSnapshot,
+    MAX_QUOTA_DEPTH,
+    PodBatch,
+    ReservationState,
+)
+
+MAX_NODE_SCORE = 100.0
+
+
+def reservation_prepass(
+    snap: ClusterSnapshot, pods: PodBatch,
+    static_ok: jnp.ndarray, earlier: jnp.ndarray, pod_anc: jnp.ndarray,
+    gang_ok: jnp.ndarray,
+) -> Tuple[jnp.ndarray, ReservationState, jnp.ndarray]:
+    """Consume matching reservations in priority order.
+
+    Args:
+      static_ok: bool[P, N] round-invariant node gates (selector, LoadAware,
+        schedulable) — reservation consumers still pass Filter on the
+        reservation's node (plugin.go Filter path).
+      earlier: bool[P, P] rank[p'] < rank[p].
+      pod_anc: i32[P, D] quota ancestor chain per pod (-1 = none).
+      gang_ok: bool[P] gang quorum gate.
+
+    Returns (placed, res_slot, quota_used'): placed is i32[P] with the
+    reservation's node for admitted pods and -1 otherwise; res_slot is
+    i32[P] with the consumed reservation slot (-1 = none) so the caller can
+    rebuild reservation free after gang rollback; node `requested` is
+    intentionally NOT modified (covered capacity was already charged).
+    """
+    resv = snap.reservations
+    quotas = snap.quotas
+    n_quotas = quotas.min.shape[0]
+    p = pods.num_pods
+
+    def body(carry, v):
+        free_all, quota_used, placed, res_slot = carry
+        node_v = resv.node[v]
+        free_v = free_all[v]                                   # [R]
+
+        eligible = (
+            resv.valid[v] & (node_v >= 0)
+            & (pods.reservation_owner >= 0)
+            & (pods.reservation_owner == resv.owner_group[v])
+            & pods.valid & gang_ok & (placed < 0))
+        # Filter still applies on the reservation's node.
+        node_c = jnp.maximum(node_v, 0)
+        eligible &= static_ok[:, node_c]
+
+        # --- AllocateOnce path: the winner is the first pod in priority
+        # order that passes BOTH fit and quota (sequentially each pod tries
+        # in turn; a quota-rejected candidate does not block later owners).
+        # Only one pod consumes, so fit and quota are individual checks.
+        quota_alone = jnp.ones((p,), bool)
+        for d in range(MAX_QUOTA_DEPTH):
+            anc = pod_anc[:, d]
+            a = jnp.maximum(anc, 0)
+            level_ok = jnp.all(quota_used[a] + pods.requests
+                               <= quotas.runtime[a] + EPS, axis=-1)
+            quota_alone &= (anc < 0) | level_ok
+        once_cand = (eligible & quota_alone
+                     & jnp.all(pods.requests <= free_v[None, :] + EPS,
+                               axis=-1))
+        once_accept = once_cand & ~jnp.any(earlier & once_cand[None, :],
+                                           axis=-1)
+
+        # --- Shared path: all-or-nothing fit within remaining reserved
+        # capacity, exact in priority order: own request + Σ earlier
+        # eligible same-slot pods, then quota prefix per tree level
+        # (consuming a reservation still charges the pod's quota,
+        # elasticquota plugin.go AddPod).
+        eff_req = jnp.where(eligible[:, None], pods.requests, 0.0)
+        cum_excl = (earlier & eligible[None, :]).astype(
+            eff_req.dtype) @ eff_req                            # [P, R]
+        shared_accept = eligible & jnp.all(
+            cum_excl + pods.requests <= free_v[None, :] + EPS, axis=-1)
+        for d in range(MAX_QUOTA_DEPTH):
+            anc = jnp.where(shared_accept, pod_anc[:, d], -1)
+            anc_eff = jnp.where(anc >= 0, anc, n_quotas)
+            acc_req = jnp.where(shared_accept[:, None], pods.requests, 0.0)
+            shared_accept &= segment_prefix_ok(
+                anc_eff, earlier, acc_req, quota_used, quotas.runtime,
+                n_quotas)
+
+        accept = jnp.where(resv.allocate_once[v], once_accept, shared_accept)
+
+        acc_req = pods.requests * accept[:, None]
+        consumed = jnp.sum(acc_req, axis=0)                     # [R]
+        any_acc = jnp.any(accept)
+        new_free = jnp.where(
+            resv.allocate_once[v] & any_acc,
+            jnp.zeros_like(free_v),
+            jnp.maximum(free_v - consumed, 0.0))
+        free_all = free_all.at[v].set(new_free)
+        for d in range(MAX_QUOTA_DEPTH):
+            anc = jnp.where(accept, pod_anc[:, d], -1)
+            quota_used = quota_used.at[
+                jnp.where(anc >= 0, anc, n_quotas)].add(acc_req, mode="drop")
+        placed = jnp.where(accept, node_v, placed)
+        res_slot = jnp.where(accept, v, res_slot)
+        return (free_all, quota_used, placed, res_slot), None
+
+    n_res = resv.valid.shape[0]
+    init = (resv.free, quotas.used, jnp.full((p,), -1, jnp.int32),
+            jnp.full((p,), -1, jnp.int32))
+    (_, quota_used, placed, res_slot), _ = jax.lax.scan(
+        body, init, jnp.arange(n_res))
+    return placed, res_slot, quota_used
+
+
+def rebuild_reservations(resv: ReservationState, pods: PodBatch,
+                         res_slot: jnp.ndarray,
+                         ok: jnp.ndarray) -> ReservationState:
+    """Final reservation state from the surviving assignment (pods the gang
+    Permit barrier revoked give their reserved capacity back)."""
+    n_res = resv.valid.shape[0]
+    consuming = ok & (res_slot >= 0)
+    tgt = jnp.where(consuming, res_slot, n_res)
+    consumed = jnp.zeros_like(resv.free).at[tgt].add(
+        pods.requests * consuming[:, None], mode="drop")
+    took_once = jnp.zeros((n_res,), bool).at[tgt].max(
+        consuming, mode="drop")
+    new_free = jnp.where((resv.allocate_once & took_once)[:, None],
+                         0.0, jnp.maximum(resv.free - consumed, 0.0))
+    return resv.replace(free=new_free,
+                        valid=resv.valid & ~(resv.allocate_once & took_once))

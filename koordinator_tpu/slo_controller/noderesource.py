@@ -264,10 +264,15 @@ class NodeResourceController:
             self._last_batch = out["batch"].copy()
             self._last_mid = out["mid"].copy()
         else:
-            sync = (need_sync(self._last_batch, out["batch"],
-                              self.strategy.resource_diff_threshold)
-                    | need_sync(self._last_mid, out["mid"],
-                                self.strategy.resource_diff_threshold))
+            # honor per-node strategy overrides for the diff gate too, same
+            # as the calculator does for the batch/mid math
+            if strategies is not None:
+                thr = np.asarray([s.resource_diff_threshold
+                                  for s in strategies], np.float64)[:, None]
+            else:
+                thr = self.strategy.resource_diff_threshold
+            sync = (need_sync(self._last_batch, out["batch"], thr)
+                    | need_sync(self._last_mid, out["mid"], thr))
             # latch only rows that synced: the diff gate compares against the
             # last APPLIED value so sub-threshold drift accumulates until it
             # crosses the threshold (plugin.go NeedSync diffs vs node status)

@@ -270,6 +270,21 @@ class SnapshotBuilder:
             if idx is not None:
                 requested[idx] += resource_vec(pod.requests)
 
+        # An Available reservation is a "reserve pod": its requests are
+        # charged to node requested up front (reservation/transformer.go
+        # restoreUnmatchedReservations keeps net accounting at exactly the
+        # reservation's allocatable). Consumers appear as running pods
+        # charging their own requests, so only the unallocated remainder is
+        # charged here; in-cycle consumers skip the node charge instead
+        # (scheduler core res_slot handling).
+        for res in self.reservations:
+            if res.phase == "Available" and res.node_name:
+                idx = self.node_index.get(res.node_name)
+                if idx is not None:
+                    requested[idx] += np.maximum(
+                        resource_vec(res.requests)
+                        - resource_vec(res.allocated), 0.0)
+
         # NodeMetric columns + the assign-cache adjustment.
         pods_per_node: Dict[str, List[AssignedPod]] = {}
         for ap in self.assigned:
