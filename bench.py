@@ -40,10 +40,14 @@ def main():
     chunks = [jax.device_put(synthetic.slice_batch(pods, i, CHUNK))
               for i in range(0, NUM_PODS, CHUNK)]
 
+    # enable_numa=False: no pod in this workload requests CPU binding, the
+    # batched analogue of the reference's state.skip NUMA fast path
+    # (nodenumaresource scoring.go skipTheNode); chunks containing bound
+    # pods would compile the enable_numa=True variant instead.
     step = jax.jit(
         functools.partial(core.schedule_batch, num_rounds=2, k_choices=8,
                           score_dims=(0, 1), approx_topk=True,
-                          tie_break=True),
+                          tie_break=True, enable_numa=False),
         donate_argnums=(0,))
 
     def full_pass(snap):

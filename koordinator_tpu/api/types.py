@@ -72,6 +72,9 @@ class Pod:
     # NUMA / fine-grained CPU request (annotation resource-spec)
     cpu_bind_policy: str = ""    # "", FullPCPUs, SpreadByPCPUs
     required_cpu_bind: bool = False
+    # zone granted to a RUNNING bound pod (annotation resource-status,
+    # numa_aware.go) — restored into NodeState.numa_free at snapshot build
+    allocated_numa_zone: int = -1
     # node selection
     node_selector: Dict[str, str] = dataclasses.field(default_factory=dict)
     # device request (gpu-core percent, gpu-memory MiB) folded into requests

@@ -13,6 +13,10 @@ import jax.numpy as jnp
 from koordinator_tpu.snapshot.schema import PodBatch
 
 EPS = 0.5  # comparison tolerance in canonical units (millicores / MiB)
+MAX_NODE_SCORE = 100.0  # framework.MaxNodeScore — single source of truth;
+                        # the reservation-slot preference (MAX_NODE_SCORE+1
+                        # in core.py) relies on every plugin score topping
+                        # out at this value
 
 
 def rank_by_priority(pods: PodBatch) -> jnp.ndarray:

@@ -17,6 +17,12 @@ k8s scheduleOne + frameworkext transformers):
   and losers retry next round against updated state. Strict gangs that miss
   minMember by the end of the batch are rolled back (Permit barrier,
   coscheduling core.go:311-341).
+- Reservations ride the same machinery as VIRTUAL NODE columns (owner-
+  restricted, capacity = reserved free, MaxNodeScore preference), so
+  consumer admission interleaves exactly with normal pods across the node/
+  quota/NUMA prefix gates (plugins/reservation.py).
+- NUMA-bound pods additionally pass a zone-level prefix gate and commit
+  into zone usage (plugins/numaaware.py).
 
 Sequential-equivalence note: within a round, an accepted pod's effect on the
 *scores* of later pods lands at the next round boundary (its effect on
@@ -44,11 +50,11 @@ from koordinator_tpu.scheduler.batching import (
     rank_by_priority,
     segment_prefix_ok,
 )
-from koordinator_tpu.scheduler.plugins import loadaware
+from koordinator_tpu.scheduler.plugins import loadaware, numaaware
 from koordinator_tpu.scheduler.plugins.reservation import (
     MAX_NODE_SCORE,
     rebuild_reservations,
-    reservation_prepass,
+    slot_columns,
 )
 from koordinator_tpu.snapshot.schema import (
     ClusterSnapshot,
@@ -61,18 +67,24 @@ from koordinator_tpu.snapshot.schema import (
 class ScheduleResult:
     assignment: jnp.ndarray      # i32[P] node index, -1 = unschedulable
     chosen_score: jnp.ndarray    # f32[P] score of the chosen node (debug)
+    numa_zone: jnp.ndarray       # i32[P] zone taken by NUMA-bound pods, -1
+                                 # (feeds the resource-status annotation /
+                                 # host cpuset accumulator at bind time)
     snapshot: ClusterSnapshot    # post-commit snapshot (requested/used updated)
 
 
 @functools.partial(jax.jit, static_argnames=("num_rounds", "k_choices",
                                              "score_dims", "approx_topk",
-                                             "tie_break"))
+                                             "tie_break", "enable_numa",
+                                             "numa_strategy"))
 def schedule_batch(snap: ClusterSnapshot, pods: PodBatch,
                    cfg: loadaware.LoadAwareConfig,
                    num_rounds: int = 4, k_choices: int = 8,
                    score_dims: tuple = None,
                    approx_topk: bool = False,
-                   tie_break: bool = False) -> ScheduleResult:
+                   tie_break: bool = False,
+                   enable_numa: bool = True,
+                   numa_strategy: str = "most") -> ScheduleResult:
     """Schedule a pod batch against the snapshot. Pure function; the caller
     publishes `result.snapshot` as the next version (store.update)."""
     nodes0, quotas0, gangs0 = snap.nodes, snap.quotas, snap.gangs
@@ -105,27 +117,57 @@ def schedule_batch(snap: ClusterSnapshot, pods: PodBatch,
     # touches no NodeInfo.requested), so compute it once for the batch.
     la_ok = loadaware.filter_mask(nodes0, pods, cfg)
     static_ok = la_ok & sel_ok & nodes0.schedulable[None, :]     # [P, N]
+    numa_used0 = nodes0.numa_cap - nodes0.numa_free              # [N, Z, 2]
+    if enable_numa:
+        # single-NUMA-node prefilter (upper bound; exact gate in the inner
+        # commit) + zone-allocation score preference (nodenumaresource
+        # topology_hint.go + scoring.go)
+        static_ok &= numaaware.zone_prefilter(nodes0, pods)
+        numa_scores = numaaware.numa_score_matrix(nodes0, pods,
+                                                  numa_strategy)
+        req2 = numaaware.pod_zone_requests(pods)                 # [P, 2]
+        n_zones = nodes0.numa_cap.shape[1]
+        numa_cap_flat = nodes0.numa_cap.reshape(-1, 2)           # [N*Z, 2]
 
-    # --- reservation restore/consume pre-pass (transformer.go:240-291) ------
-    # Matching pods consume reserved capacity (already counted in node
-    # `requested`) in exact priority order; they skip the normal rounds.
-    res_placed, res_slot, quota_used0 = reservation_prepass(
-        snap, pods, static_ok, earlier, pod_anc, gang_ok)
+    # --- reservations as virtual nodes (transformer.go restore/nominate) ---
+    # Each reservation slot is an extra owner-restricted column with the
+    # slot's remaining free as capacity and MaxNodeScore preference, so
+    # consumer admission rides the SAME priority-ordered prefix gates as
+    # normal pods (no pre-pass, no priority inversion).
+    slot_ok, slot_alloc0, slot_node = slot_columns(snap, pods, static_ok)
+    n_slots = slot_node.shape[0]
+    n_ext = n_nodes + n_slots
+    ext_alloc = jnp.concatenate([nodes0.allocatable, slot_alloc0], 0)
+    ext_static = jnp.concatenate([static_ok, slot_ok], 1)        # [P, N+V]
+    is_once = snap.reservations.allocate_once                    # bool[V]
+    slot_node_c = jnp.maximum(slot_node, 0)
+
+    def to_real(ext_idx):
+        """Map an extended node id to its real node (slots -> their node)."""
+        if n_slots == 0:
+            return ext_idx
+        s = jnp.clip(ext_idx - n_nodes, 0, n_slots - 1)
+        return jnp.where(ext_idx >= n_nodes, slot_node_c[s], ext_idx)
 
     def round_body(carry, _):
-        requested, quota_used, assigned_est, prod_assigned_est, \
-            gang_placed, placed, out_score = carry
+        requested, quota_used, numa_used, once_taken, assigned_est, \
+            prod_assigned_est, gang_placed, placed, out_score, \
+            out_zone = carry
         active = pods.valid & (placed < 0) & gang_ok
 
         nodes = nodes0.replace(
-            requested=requested,
+            requested=requested[:n_nodes],
             assigned_estimated=assigned_est,
             prod_assigned_estimated=prod_assigned_est)
 
-        # --- feasibility [P, N] (HOT LOOP #1) ---
+        # --- feasibility [P, N+V] (HOT LOOP #1) ---
         fit = jnp.all(pods.requests[:, None, :] + requested[None]
-                      <= nodes.allocatable[None] + EPS, axis=-1)
-        feasible = fit & static_ok & active[:, None]
+                      <= ext_alloc[None] + EPS, axis=-1)
+        feasible = fit & ext_static & active[:, None]
+        if n_slots:
+            # consumed AllocateOnce slots admit nobody (plugin.go:509-510)
+            feasible &= ~jnp.concatenate(
+                [jnp.zeros((n_nodes,), bool), is_once & once_taken])[None, :]
 
         # quota admission (ElasticQuota PreFilter, plugin.go:211-257):
         # used + request <= runtime at every tree level
@@ -145,6 +187,18 @@ def schedule_batch(snap: ClusterSnapshot, pods: PodBatch,
         # inputs are frozen (the reference's NodeMetric does not change on
         # assume either); capacity and quota stay exact via prefix sums.
         scores = loadaware.score_matrix(nodes, pods, cfg, score_dims)
+        if enable_numa:
+            # framework sums plugin scores; NUMA preference only affects
+            # NUMA-bound pods (numa_scores is 0 elsewhere)
+            scores = scores + numa_scores
+        if n_slots:
+            # slot columns score MaxNodeScore + 1: owners strictly prefer
+            # their reservation over any node (nominator preference); safe
+            # because slot-eligible pods are never NUMA-bound, so their
+            # node scores top out at MAX_NODE_SCORE
+            scores = jnp.concatenate(
+                [scores, jnp.full((p, n_slots), MAX_NODE_SCORE + 1.0)],
+                axis=1)
         if tie_break:
             # k8s selectHost picks uniformly among max-score nodes
             # (schedule_one.go reservoir sample); a deterministic per-
@@ -152,11 +206,11 @@ def schedule_batch(snap: ClusterSnapshot, pods: PodBatch,
             # reordering distinct integer scores, and de-clusters the
             # batched argmax under contention.
             pi = jnp.arange(p, dtype=jnp.uint32)[:, None]
-            ni = jnp.arange(n_nodes, dtype=jnp.uint32)[None, :]
+            ni = jnp.arange(n_ext, dtype=jnp.uint32)[None, :]
             h = (pi * jnp.uint32(2654435761) + ni * jnp.uint32(40503)) & 1023
             scores = scores + h.astype(jnp.float32) * (0.49 / 1024.0)
         masked = jnp.where(feasible, scores, -1.0)
-        k = min(k_choices, n_nodes)
+        k = min(k_choices, n_ext)
         if approx_topk:
             # TPU-optimized partial reduction (approx_max_k) — the choice
             # list is a heuristic preference order, so bounded recall only
@@ -167,17 +221,23 @@ def schedule_batch(snap: ClusterSnapshot, pods: PodBatch,
         topk_idx = topk_idx.astype(jnp.int32)
 
         def inner(inner_carry, _):
-            requested, quota_used, placed, kptr, out_score = inner_carry
+            requested, quota_used, numa_used, once_taken, placed, kptr, \
+                out_score, out_zone = inner_carry
             val = jnp.take_along_axis(topk_val, kptr[:, None], 1)[:, 0]
             choice = jnp.take_along_axis(topk_idx, kptr[:, None], 1)[:, 0]
             trying = active & (placed < 0) & (kptr < k) & (val > -0.5)
-            choice_eff = jnp.where(trying, choice, n_nodes)
+            if n_slots:
+                # a once slot consumed by an earlier inner step admits nobody
+                slot_of = jnp.clip(choice - n_nodes, 0, n_slots - 1)
+                on_slot = choice >= n_nodes
+                trying &= ~(on_slot & (is_once & once_taken)[slot_of])
+            choice_eff = jnp.where(trying, choice, n_ext)
 
-            # node capacity prefix in priority order
+            # node/slot capacity prefix in priority order
             eff_req = jnp.where(trying[:, None], pods.requests, 0.0)
             accept = trying & segment_prefix_ok(
                 choice_eff, earlier, eff_req, requested,
-                nodes.allocatable, n_nodes)
+                ext_alloc, n_ext)
 
             # quota prefix per tree level, same trick
             for d in range(MAX_QUOTA_DEPTH):
@@ -187,6 +247,47 @@ def schedule_batch(snap: ClusterSnapshot, pods: PodBatch,
                 accept &= segment_prefix_ok(
                     anc_eff, earlier, acc_req, quota_used,
                     quotas0.runtime, n_quotas)
+
+            if enable_numa:
+                # zone pick on the chosen node from live usage, then the
+                # same prefix gate over flat (node, zone) segments (slot
+                # choices never carry numa_single pods — slot_columns
+                # excludes them)
+                zone, zone_fit_ok = numaaware.choose_zone(
+                    numa_used, nodes0.numa_cap, nodes0.numa_valid,
+                    choice_eff, req2, pods.numa_single, numa_strategy)
+                accept &= zone_fit_ok
+                is_bound = accept & pods.numa_single
+                zone_seg = jnp.where(is_bound,
+                                     choice_eff * n_zones + zone,
+                                     n_nodes * n_zones)
+                zreq = jnp.where(is_bound[:, None], req2, 0.0)
+                accept &= segment_prefix_ok(
+                    zone_seg, earlier, zreq,
+                    numa_used.reshape(-1, 2), numa_cap_flat,
+                    n_nodes * n_zones)
+                is_bound = accept & pods.numa_single
+                zone_seg = jnp.where(is_bound,
+                                     choice_eff * n_zones + zone,
+                                     n_nodes * n_zones)
+                numa_used = numa_used.reshape(-1, 2).at[zone_seg].add(
+                    req2 * is_bound[:, None],
+                    mode="drop").reshape(numa_used.shape)
+                out_zone = jnp.where(is_bound, zone, out_zone)
+
+            if n_slots:
+                # AllocateOnce: single consumer per slot — among this
+                # step's accepted consumers, only the first in priority
+                # order wins (plugin.go:509-510), then the slot closes.
+                once_here = accept & on_slot & is_once[slot_of]
+                same_slot = choice_eff[:, None] == choice_eff[None, :]
+                first = ~jnp.any(earlier & same_slot & once_here[None, :],
+                                 axis=-1)
+                accept = jnp.where(once_here, accept & first, accept)
+                once_win = accept & on_slot & is_once[slot_of]
+                once_taken = once_taken.at[
+                    jnp.where(once_win, slot_of, n_slots)].set(
+                        True, mode="drop")
 
             # scatter-commit (assume; scheduler_adapter assume/forget)
             acc_req = pods.requests * accept[:, None]
@@ -200,17 +301,20 @@ def schedule_batch(snap: ClusterSnapshot, pods: PodBatch,
             out_score = jnp.where(accept, val, out_score)
             # a rejected pod's chosen node just filled up: fall through
             kptr = jnp.where(trying & ~accept, kptr + 1, kptr)
-            return (requested, quota_used, placed, kptr, out_score), None
+            return (requested, quota_used, numa_used, once_taken, placed,
+                    kptr, out_score, out_zone), None
 
-        (requested, quota_used, placed, _, out_score), _ = jax.lax.scan(
+        (requested, quota_used, numa_used, once_taken, placed, _, out_score,
+         out_zone), _ = jax.lax.scan(
             inner,
-            (requested, quota_used, placed, jnp.zeros((p,), jnp.int32),
-             out_score),
+            (requested, quota_used, numa_used, once_taken, placed,
+             jnp.zeros((p,), jnp.int32), out_score, out_zone),
             None, length=k)
 
         # register newly placed pods' estimates for the next round's scores
+        # (podAssignCache tracks reservation consumers on the REAL node too)
         new = (placed >= 0) & active
-        tgt = jnp.where(new, placed, n_nodes)
+        tgt = jnp.where(new, to_real(placed), n_nodes)
         est = pods.estimated * new[:, None]
         assigned_est = assigned_est.at[tgt].add(est, mode="drop")
         is_prod = pods.priority_class == 4  # PriorityClass.PROD
@@ -219,30 +323,24 @@ def schedule_batch(snap: ClusterSnapshot, pods: PodBatch,
         gang_placed = gang_placed.at[jnp.where(new & (pods.gang_id >= 0),
                                                pods.gang_id, n_gangs)].add(
             1, mode="drop")
-        return (requested, quota_used, assigned_est, prod_assigned_est,
-                gang_placed, placed, out_score), None
+        return (requested, quota_used, numa_used, once_taken, assigned_est,
+                prod_assigned_est, gang_placed, placed, out_score,
+                out_zone), None
 
-    # Seed the round carry with the reservation pre-pass result: consuming
-    # pods are already placed (node requested unchanged — covered capacity
-    # was pre-charged), their estimates feed the next scores (podAssignCache
-    # tracks reservation consumers too), and they count toward gang quorum.
-    res_ok = res_placed >= 0
-    res_tgt = jnp.where(res_ok, res_placed, n_nodes)
-    res_est = pods.estimated * res_ok[:, None]
-    is_prod0 = pods.priority_class == 4  # PriorityClass.PROD
     init = (
-        nodes0.requested,
-        quota_used0,
-        nodes0.assigned_estimated.at[res_tgt].add(res_est, mode="drop"),
-        nodes0.prod_assigned_estimated.at[res_tgt].add(
-            res_est * is_prod0[:, None], mode="drop"),
-        jnp.zeros((n_gangs,), jnp.int32).at[
-            jnp.where(res_ok & (pods.gang_id >= 0), pods.gang_id,
-                      n_gangs)].add(1, mode="drop"),
-        res_placed,
-        jnp.where(res_ok, MAX_NODE_SCORE, -1.0).astype(jnp.float32))
-    (_, _, _, _, gang_placed, placed, out_score), _ = jax.lax.scan(
-        round_body, init, None, length=num_rounds)
+        jnp.concatenate([nodes0.requested,
+                         jnp.zeros_like(slot_alloc0)], axis=0),
+        quotas0.used,
+        numa_used0,
+        jnp.zeros((n_slots,), bool),
+        nodes0.assigned_estimated,
+        nodes0.prod_assigned_estimated,
+        jnp.zeros((n_gangs,), jnp.int32),
+        jnp.full((p,), -1, jnp.int32),
+        jnp.full((p,), -1.0, jnp.float32),
+        jnp.full((p,), -1, jnp.int32))
+    (_, _, _, _, _, _, gang_placed, placed, out_score, out_zone), _ = \
+        jax.lax.scan(round_body, init, None, length=num_rounds)
 
     # --- gang all-or-nothing rollback (Permit barrier, core.go:311-341) ---
     gang_total = gangs0.assumed + gang_placed
@@ -254,7 +352,9 @@ def schedule_batch(snap: ClusterSnapshot, pods: PodBatch,
 
     # --- rebuild post-commit state from the final assignment --------------
     ok = placed >= 0
-    tgt = jnp.where(ok, placed, n_nodes)
+    res_slot = jnp.where(placed >= n_nodes, placed - n_nodes, -1)
+    placed_real = jnp.where(ok, to_real(jnp.maximum(placed, 0)), -1)
+    tgt = jnp.where(ok, placed_real, n_nodes)
     fin_req = pods.requests * ok[:, None]
     fin_est = pods.estimated * ok[:, None]
     is_prod = pods.priority_class == 4
@@ -274,16 +374,36 @@ def schedule_batch(snap: ClusterSnapshot, pods: PodBatch,
                                                pods.gang_id, n_gangs)].add(
         1, mode="drop")
 
-    chosen_score = jnp.where(ok, out_score, -1.0)
+    # NUMA zone usage from the surviving assignment (revoked gang members
+    # give their zone back)
+    numa_zone = jnp.where(ok & pods.numa_single, out_zone, -1)
+    numa_free = nodes0.numa_free
+    if enable_numa:
+        bound = numa_zone >= 0
+        flat_seg = jnp.where(
+            bound, tgt * n_zones + jnp.maximum(numa_zone, 0),
+            n_nodes * n_zones)
+        numa_free = (nodes0.numa_free.reshape(-1, 2).at[flat_seg].add(
+            -req2 * bound[:, None], mode="drop")
+            .reshape(nodes0.numa_free.shape))
+
+    # slot rows scored MaxNodeScore+1 for strict preference; report those
+    # capped at MaxNodeScore (node-placed NUMA pods legitimately exceed 100
+    # — plugin scores sum — and keep their real value)
+    chosen_score = jnp.where(
+        ok, jnp.where(res_slot >= 0,
+                      jnp.minimum(out_score, MAX_NODE_SCORE), out_score),
+        -1.0)
     new_snap = snap.replace(
         nodes=nodes0.replace(requested=requested,
                              assigned_estimated=assigned_est,
-                             prod_assigned_estimated=prod_assigned_est),
+                             prod_assigned_estimated=prod_assigned_est,
+                             numa_free=numa_free),
         quotas=quotas0.replace(used=quota_used),
         gangs=gangs0.replace(assumed=gang_assumed),
         reservations=rebuild_reservations(snap.reservations, pods,
                                           res_slot, ok),
         version=snap.version + 1,
     )
-    return ScheduleResult(assignment=placed, chosen_score=chosen_score,
-                          snapshot=new_snap)
+    return ScheduleResult(assignment=placed_real, chosen_score=chosen_score,
+                          numa_zone=numa_zone, snapshot=new_snap)

@@ -1,0 +1,217 @@
+"""Host-side CPU-set accumulator: exact per-core cpuset assignment at bind
+time.
+
+Behavior parity with plugins/nodenumaresource/cpu_accumulator.go:
+- `take_cpus` (takeCPUs, cpu_accumulator.go:87-245): picks `num_needed`
+  logical CPUs from a node's topology honoring
+  - CPUBindPolicy FullPCPUs: prefer fully-free physical cores, whole-core
+    granularity, NUMA node chosen per NUMAAllocateStrategy
+    (cpu_accumulator.go:105-178: free cores in node, then socket, then
+    cross-socket);
+  - CPUBindPolicy SpreadByPCPUs: one CPU per physical core round-robin,
+    cores ordered by ref count then strategy
+    (cpu_accumulator.go:179-244, spreadCPUs :798);
+  - NUMAAllocateStrategy MostAllocated packs the fullest NUMA node,
+    LeastAllocated spreads to the freest (sortCores :345-370);
+  - maxRefCount: a CPU may be shared by up to maxRefCount LSR pods
+    (newCPUAccumulator :247-288);
+  - CPUExclusivePolicy PCPULevel: avoid cores carrying another exclusive
+    pod's CPUs (isCPUExclusivePCPULevel :318-324).
+- `take_preferred_cpus` (takePreferredCPUs :29-85): reservation-reserved
+  CPUs are taken first.
+
+This runs per placed pod on its chosen node only (the reference calls it in
+Reserve, not the Filter/Score hot loop), so it stays host-side Python; the
+device kernels (numaaware.py) already did zone-level admission.
+
+Deviations (documented): socket-level sorting uses the same strategy key as
+node-level rather than the reference's two-level core sort; exclusive
+policy NUMANodeLevel is approximated by PCPULevel semantics at node scope.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Iterable, List, Optional, Sequence, Set
+
+
+@dataclasses.dataclass(frozen=True)
+class CPUInfo:
+    """One logical CPU (CPUTopology cpu detail)."""
+
+    cpu: int
+    core: int
+    node: int      # NUMA node id
+    socket: int
+
+
+@dataclasses.dataclass
+class CPUTopology:
+    """Node CPU topology mirrored from NodeResourceTopology
+    (topology_options.go CPUTopology)."""
+
+    cpus: List[CPUInfo]
+
+    def __post_init__(self):
+        self.by_cpu = {c.cpu: c for c in self.cpus}
+        self.cores: Dict[int, List[CPUInfo]] = {}
+        self.nodes: Dict[int, List[CPUInfo]] = {}
+        for c in self.cpus:
+            self.cores.setdefault(c.core, []).append(c)
+            self.nodes.setdefault(c.node, []).append(c)
+
+    @property
+    def cpus_per_core(self) -> int:
+        return max(len(v) for v in self.cores.values()) if self.cores else 1
+
+    @property
+    def cpus_per_node(self) -> int:
+        return max(len(v) for v in self.nodes.values()) if self.nodes else 0
+
+    @staticmethod
+    def uniform(num_sockets: int, nodes_per_socket: int,
+                cores_per_node: int, threads_per_core: int = 2
+                ) -> "CPUTopology":
+        """Build a regular topology (test fixture / synthetic clusters)."""
+        cpus = []
+        cpu_id = 0
+        core_id = 0
+        for s in range(num_sockets):
+            for n in range(nodes_per_socket):
+                node_id = s * nodes_per_socket + n
+                for _ in range(cores_per_node):
+                    for _ in range(threads_per_core):
+                        cpus.append(CPUInfo(cpu=cpu_id, core=core_id,
+                                            node=node_id, socket=s))
+                        cpu_id += 1
+                    core_id += 1
+        return CPUTopology(cpus)
+
+
+class CPUAllocationError(Exception):
+    """not enough cpus available to satisfy request
+    (cpu_accumulator.go:103)."""
+
+
+def _ref(allocated: Dict[int, int], cpu: int) -> int:
+    return allocated.get(cpu, 0)
+
+
+def take_cpus(topology: CPUTopology,
+              available: Set[int],
+              allocated: Dict[int, int],
+              num_needed: int,
+              bind_policy: str = "FullPCPUs",
+              exclusive_policy: str = "",
+              numa_strategy: str = "most",
+              max_ref_count: int = 1,
+              exclusive_cores: Optional[Set[int]] = None) -> List[int]:
+    """Pick `num_needed` CPUs; raises CPUAllocationError when impossible.
+
+    `allocated` maps cpu -> current ref count; `exclusive_cores` are cores
+    carrying another PCPU-exclusive pod's CPUs.
+    """
+    exclusive_cores = exclusive_cores or set()
+    usable = sorted(c for c in available
+                    if _ref(allocated, c) < max_ref_count)
+    if len(usable) < num_needed:
+        raise CPUAllocationError(
+            f"need {num_needed} cpus, only {len(usable)} usable")
+    if num_needed == 0:
+        return []
+
+    usable_set = set(usable)
+    pcpu_exclusive = exclusive_policy == "PCPULevel"
+
+    def node_key(node_id: int):
+        """NUMA strategy sort key: free CPUs in the node (MostAllocated
+        packs the node with the fewest free; ties by node id)."""
+        free = sum(1 for c in topology.nodes.get(node_id, ())
+                   if c.cpu in usable_set)
+        return (free, node_id) if numa_strategy == "most" else (-free, node_id)
+
+    taken: List[int] = []
+
+    if bind_policy == "FullPCPUs" or topology.cpus_per_core == 1:
+        # fully-free cores grouped by NUMA node, exclusive-filtered first
+        # then not (filterExclusiveArgs, cpu_accumulator.go:109)
+        for filter_exclusive in ((True, False) if pcpu_exclusive
+                                 else (False,)):
+            for node_id in sorted(topology.nodes, key=node_key):
+                if len(taken) >= num_needed:
+                    break
+                for core_id in sorted(
+                        {c.core for c in topology.nodes[node_id]}):
+                    if len(taken) >= num_needed:
+                        break
+                    if filter_exclusive and core_id in exclusive_cores:
+                        continue
+                    members = topology.cores[core_id]
+                    # whole cores only — a partial core here would leave a
+                    # sibling shared with another pod, defeating FullPCPUs;
+                    # a non-multiple remainder goes through the spread
+                    # fallback instead (the reference rejects non-multiples
+                    # at Filter)
+                    if len(members) > num_needed - len(taken):
+                        continue
+                    if all(m.cpu in usable_set and m.cpu not in taken
+                           for m in members):
+                        taken.extend(m.cpu for m in members)
+            if len(taken) >= num_needed:
+                return taken
+        # not enough full cores: fall through to spread for the remainder
+        bind_policy = "SpreadByPCPUs"
+
+    # SpreadByPCPUs: rounds of one CPU per core; cores ordered by
+    # (ref count of least-referenced cpu, NUMA strategy, core id)
+    remaining = [c for c in usable if c not in taken]
+    if pcpu_exclusive:
+        non_excl = [c for c in remaining
+                    if topology.by_cpu[c].core not in exclusive_cores]
+        if len(taken) + len(non_excl) >= num_needed:
+            remaining = non_excl
+    per_core: Dict[int, List[int]] = {}
+    for c in remaining:
+        per_core.setdefault(topology.by_cpu[c].core, []).append(c)
+    for core_cpus in per_core.values():
+        core_cpus.sort(key=lambda c: (_ref(allocated, c), c))
+
+    def core_order(core_id: int):
+        head = per_core[core_id][0]
+        return (_ref(allocated, head),
+                node_key(topology.by_cpu[head].node), core_id)
+
+    while len(taken) < num_needed:
+        progressed = False
+        for core_id in sorted((c for c in per_core if per_core[c]),
+                              key=core_order):
+            if len(taken) >= num_needed:
+                break
+            taken.append(per_core[core_id].pop(0))
+            progressed = True
+        if not progressed:
+            raise CPUAllocationError("exhausted usable cpus")
+    return taken
+
+
+def take_preferred_cpus(topology: CPUTopology,
+                        available: Set[int],
+                        preferred: Set[int],
+                        allocated: Dict[int, int],
+                        num_needed: int,
+                        **kw) -> List[int]:
+    """Reservation-reserved CPUs first, then the rest
+    (takePreferredCPUs, cpu_accumulator.go:29-85)."""
+    result: List[int] = []
+    max_ref = kw.get("max_ref_count", 1)
+    pref = available & preferred
+    usable_pref = {c for c in pref if _ref(allocated, c) < max_ref}
+    if usable_pref:
+        want = min(num_needed, len(usable_pref))
+        result = take_cpus(topology, usable_pref, allocated, want, **kw)
+        num_needed -= len(result)
+        available = available - pref
+    if num_needed > 0:
+        result += take_cpus(topology, available - set(result), allocated,
+                            num_needed, **kw)
+    return result

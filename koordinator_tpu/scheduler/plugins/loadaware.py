@@ -23,9 +23,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from koordinator_tpu.api.extension import NUM_RESOURCES, PriorityClass, ResourceKind
+from koordinator_tpu.scheduler.batching import MAX_NODE_SCORE
 from koordinator_tpu.snapshot.schema import AGG_TYPES, NodeState, PodBatch
-
-MAX_NODE_SCORE = 100.0  # framework.MaxNodeScore
 
 
 @flax.struct.dataclass

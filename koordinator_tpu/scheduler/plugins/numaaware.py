@@ -1,0 +1,117 @@
+"""NodeNUMAResource plugin: batched NUMA-zone fit, scoring, and zone
+selection kernels.
+
+Behavior parity with plugins/nodenumaresource/ (SURVEY.md 2.1):
+- Pods that require CPU binding / single-NUMA-node placement
+  (`PodBatch.numa_single`, the resource-spec annotation + LSR/LSE QoS) must
+  fit entirely within one NUMA zone of the node (topology_hint.go hint
+  generation merged under the SingleNUMANode policy).
+- Zone choice follows the NUMAAllocateStrategy (least_allocated.go /
+  most_allocated.go): MostAllocated packs the fullest fitting zone,
+  LeastAllocated spreads to the freest.
+- Score mirrors scoring.go resourceAllocationScorer (least/most allocated)
+  restricted to the zone the pod would take.
+
+TPU design: zone capacity/usage live as [N, Z, 2] (cpu milli, mem MiB)
+columns; the hint-merge loop becomes an argmax over the zone axis, and
+sequential-exactness of concurrent zone commits reuses the segment prefix
+gate with flattened (node, zone) segment ids. The exact per-core cpuset
+assignment (cpu_accumulator.go takeCPUs) is bind-time per-pod work on the
+chosen node only — that stays on host (numa_cpu_accumulator.py), exactly
+like the reference runs it in Reserve, not in the Filter/Score hot loop.
+
+Known deviation: pods consuming a Reservation skip zone accounting (the
+reference supports reserved cpusets; tracked for a later round).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax.numpy as jnp
+
+from koordinator_tpu.api.extension import ResourceKind
+from koordinator_tpu.scheduler.batching import EPS, MAX_NODE_SCORE
+from koordinator_tpu.snapshot.schema import NodeState, PodBatch
+
+CPU = int(ResourceKind.CPU)
+MEM = int(ResourceKind.MEMORY)
+
+
+def pod_zone_requests(pods: PodBatch) -> jnp.ndarray:
+    """f32[P, 2]: the (cpu milli, mem MiB) a NUMA-bound pod takes from its
+    zone; zero rows for unbound pods so their scatters are no-ops."""
+    req2 = jnp.stack([pods.requests[:, CPU], pods.requests[:, MEM]], axis=-1)
+    return req2 * pods.numa_single[:, None]
+
+
+def zone_prefilter(nodes: NodeState, pods: PodBatch) -> jnp.ndarray:
+    """bool[P, N]: an upper-bound single-NUMA fit against the batch-start
+    zone state (free only shrinks during commit, so this is a sound
+    prefilter; the exact gate runs per inner commit step on the chosen
+    node). Non-NUMA-bound pods pass everywhere."""
+    req2 = pod_zone_requests(pods)                      # [P, 2]
+    free = nodes.numa_free                              # [N, Z, 2]
+    fits = jnp.all(free[None] + EPS >= req2[:, None, None, :], axis=-1)
+    fits &= nodes.numa_valid[None]                      # [P, N, Z]
+    ok = jnp.any(fits, axis=-1)
+    return ok | ~pods.numa_single[:, None]
+
+
+def numa_score_matrix(nodes: NodeState, pods: PodBatch,
+                      strategy: str = "most") -> jnp.ndarray:
+    """f32[P, N] in [0, 100]: allocation score of the zone the pod would
+    take, 0 for unbound pods / nodes without topology.
+
+    Mirrors scoring.go least/mostResourceScorer over the zone's cpu+mem.
+    Computed once per batch from the snapshot state (heuristic preference;
+    capacity exactness is enforced by the commit prefix gates).
+    """
+    req2 = pod_zone_requests(pods)                      # [P, 2]
+    cap = nodes.numa_cap                                # [N, Z, 2]
+    free = nodes.numa_free
+    fits = jnp.all(free[None] + EPS >= req2[:, None, None, :], axis=-1)
+    fits &= nodes.numa_valid[None]                      # [P, N, Z]
+    used_after = cap[None] - free[None] + req2[:, None, None, :]
+    frac = used_after / jnp.maximum(cap[None], 1e-9)    # [P, N, Z, 2]
+    if strategy == "most":
+        zone_score = jnp.mean(frac, axis=-1)
+    else:
+        zone_score = jnp.mean(1.0 - frac, axis=-1)
+    zone_score = jnp.where(fits, zone_score, -1.0)
+    best = jnp.max(zone_score, axis=-1)                 # [P, N]
+    score = jnp.clip(best, 0.0, 1.0) * MAX_NODE_SCORE
+    return jnp.where(pods.numa_single[:, None], score, 0.0)
+
+
+def choose_zone(numa_used: jnp.ndarray, numa_cap: jnp.ndarray,
+                numa_valid: jnp.ndarray, choice: jnp.ndarray,
+                req2: jnp.ndarray, numa_single: jnp.ndarray,
+                strategy: str = "most") -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Pick each pod's zone on its chosen node from live usage state.
+
+    Args: numa_used/cap [N, Z, 2], numa_valid [N, Z], choice i32[P] (may be
+    out of range = "no node"), req2 f32[P, 2].
+    Returns (zone i32[P], zone_ok bool[P]); zone_ok is True for unbound
+    pods. Exactness among contending pods comes from the caller's segment
+    prefix gate over (node, zone) ids.
+
+    Batched-equivalence note: pods committed in the SAME inner step pick
+    zones from the same pre-commit state, so the LeastAllocated spreading
+    preference is batch-granular (capacity stays exact via the prefix
+    gate; chunk size 1 recovers sequential zone choice). MostAllocated
+    packing is unaffected — contending pods converging on one zone IS the
+    packing intent.
+    """
+    n_nodes = numa_used.shape[0]
+    node_c = jnp.clip(choice, 0, n_nodes - 1)
+    free = numa_cap[node_c] - numa_used[node_c]         # [P, Z, 2]
+    fits = jnp.all(free + EPS >= req2[:, None, :], axis=-1)
+    fits &= numa_valid[node_c]                          # [P, Z]
+    # strategy key on cpu-free: MostAllocated packs (least free wins)
+    key = free[..., 0]
+    key = jnp.where(fits, key, jnp.inf if strategy == "most" else -jnp.inf)
+    zone = (jnp.argmin(key, axis=-1) if strategy == "most"
+            else jnp.argmax(key, axis=-1)).astype(jnp.int32)
+    zone_ok = jnp.any(fits, axis=-1) | ~numa_single
+    return zone, zone_ok

@@ -15,135 +15,55 @@ Behavior parity with plugins/reservation/ (SURVEY.md 2.1):
 - AllocateOnce reservations admit a single consumer and are then exhausted
   (plugin.go:509-510).
 
-Batched TPU design: reservations are rare (V small), so instead of carrying
-a [P, N, R] restore tensor through the hot feasibility kernel, a pre-pass
-scans the V reservation slots: for each slot, all matching pods are admitted
-in priority order against the slot's free capacity with an exact prefix-sum
-gate (the sequential-assume equivalent), quota levels included. Pods the
-pre-pass places skip the normal rounds; pods whose requests exceed the
-remaining reserved capacity fall through and schedule as normal pods
-(documented deviation: the reference lets a pod straddle reservation +
-node free capacity; the pre-pass is all-or-nothing per pod, conservative
-because reserved capacity stays charged to the node either way).
+Batched TPU design: reservations are rare (V small), so each reservation
+slot becomes a VIRTUAL NODE column appended to the score/feasibility
+matrices inside the normal commit rounds. The slot column's capacity is the
+reservation's remaining free; only owner-matched pods see it (the restore +
+nominate semantics); its score is MaxNodeScore so owners prefer it (the
+nominator's reservation preference). Because slots ride the same
+priority-ordered prefix gates as real nodes and quota levels, consumer
+admission interleaves EXACTLY with normal pods — no separate pre-pass, no
+priority inversion against non-consumers. AllocateOnce is a per-slot
+single-winner gate inside the inner commit.
+
+Documented deviation: the reference lets one pod straddle reservation +
+node free capacity; here a pod either fits entirely within the reservation
+free or schedules as a normal pod (conservative — the reserved capacity
+stays charged to the node either way).
 """
 
 from __future__ import annotations
 
 from typing import Tuple
 
-import jax
 import jax.numpy as jnp
 
-from koordinator_tpu.scheduler.batching import EPS, segment_prefix_ok
-from koordinator_tpu.snapshot.schema import (
-    ClusterSnapshot,
-    MAX_QUOTA_DEPTH,
-    PodBatch,
-    ReservationState,
-)
-
-MAX_NODE_SCORE = 100.0
+from koordinator_tpu.scheduler.batching import MAX_NODE_SCORE
+from koordinator_tpu.snapshot.schema import ClusterSnapshot, PodBatch, ReservationState
 
 
-def reservation_prepass(
-    snap: ClusterSnapshot, pods: PodBatch,
-    static_ok: jnp.ndarray, earlier: jnp.ndarray, pod_anc: jnp.ndarray,
-    gang_ok: jnp.ndarray,
-) -> Tuple[jnp.ndarray, ReservationState, jnp.ndarray]:
-    """Consume matching reservations in priority order.
+def slot_columns(snap: ClusterSnapshot, pods: PodBatch,
+                 static_ok: jnp.ndarray
+                 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Virtual-node columns for the V reservation slots.
 
-    Args:
-      static_ok: bool[P, N] round-invariant node gates (selector, LoadAware,
-        schedulable) — reservation consumers still pass Filter on the
-        reservation's node (plugin.go Filter path).
-      earlier: bool[P, P] rank[p'] < rank[p].
-      pod_anc: i32[P, D] quota ancestor chain per pod (-1 = none).
-      gang_ok: bool[P] gang quorum gate.
-
-    Returns (placed, res_slot, quota_used'): placed is i32[P] with the
-    reservation's node for admitted pods and -1 otherwise; res_slot is
-    i32[P] with the consumed reservation slot (-1 = none) so the caller can
-    rebuild reservation free after gang rollback; node `requested` is
-    intentionally NOT modified (covered capacity was already charged).
+    Returns (slot_ok [P, V], slot_alloc [V, R], slot_node i32[V]):
+    - slot_ok: pod may consume slot v — owner match (transformer.go
+      matched-owner restore) AND the slot's underlying node passes the
+      pod's round-invariant gates (Filter still applies on that node);
+      NUMA-bound pods are excluded (reserved cpusets not modeled yet).
+    - slot_alloc: the slot's capacity = remaining reserved free.
+    - slot_node: underlying real node per slot (-1 invalid).
     """
     resv = snap.reservations
-    quotas = snap.quotas
-    n_quotas = quotas.min.shape[0]
-    p = pods.num_pods
-
-    def body(carry, v):
-        free_all, quota_used, placed, res_slot = carry
-        node_v = resv.node[v]
-        free_v = free_all[v]                                   # [R]
-
-        eligible = (
-            resv.valid[v] & (node_v >= 0)
-            & (pods.reservation_owner >= 0)
-            & (pods.reservation_owner == resv.owner_group[v])
-            & pods.valid & gang_ok & (placed < 0))
-        # Filter still applies on the reservation's node.
-        node_c = jnp.maximum(node_v, 0)
-        eligible &= static_ok[:, node_c]
-
-        # --- AllocateOnce path: the winner is the first pod in priority
-        # order that passes BOTH fit and quota (sequentially each pod tries
-        # in turn; a quota-rejected candidate does not block later owners).
-        # Only one pod consumes, so fit and quota are individual checks.
-        quota_alone = jnp.ones((p,), bool)
-        for d in range(MAX_QUOTA_DEPTH):
-            anc = pod_anc[:, d]
-            a = jnp.maximum(anc, 0)
-            level_ok = jnp.all(quota_used[a] + pods.requests
-                               <= quotas.runtime[a] + EPS, axis=-1)
-            quota_alone &= (anc < 0) | level_ok
-        once_cand = (eligible & quota_alone
-                     & jnp.all(pods.requests <= free_v[None, :] + EPS,
-                               axis=-1))
-        once_accept = once_cand & ~jnp.any(earlier & once_cand[None, :],
-                                           axis=-1)
-
-        # --- Shared path: all-or-nothing fit within remaining reserved
-        # capacity, exact in priority order: own request + Σ earlier
-        # eligible same-slot pods, then quota prefix per tree level
-        # (consuming a reservation still charges the pod's quota,
-        # elasticquota plugin.go AddPod).
-        eff_req = jnp.where(eligible[:, None], pods.requests, 0.0)
-        cum_excl = (earlier & eligible[None, :]).astype(
-            eff_req.dtype) @ eff_req                            # [P, R]
-        shared_accept = eligible & jnp.all(
-            cum_excl + pods.requests <= free_v[None, :] + EPS, axis=-1)
-        for d in range(MAX_QUOTA_DEPTH):
-            anc = jnp.where(shared_accept, pod_anc[:, d], -1)
-            anc_eff = jnp.where(anc >= 0, anc, n_quotas)
-            acc_req = jnp.where(shared_accept[:, None], pods.requests, 0.0)
-            shared_accept &= segment_prefix_ok(
-                anc_eff, earlier, acc_req, quota_used, quotas.runtime,
-                n_quotas)
-
-        accept = jnp.where(resv.allocate_once[v], once_accept, shared_accept)
-
-        acc_req = pods.requests * accept[:, None]
-        consumed = jnp.sum(acc_req, axis=0)                     # [R]
-        any_acc = jnp.any(accept)
-        new_free = jnp.where(
-            resv.allocate_once[v] & any_acc,
-            jnp.zeros_like(free_v),
-            jnp.maximum(free_v - consumed, 0.0))
-        free_all = free_all.at[v].set(new_free)
-        for d in range(MAX_QUOTA_DEPTH):
-            anc = jnp.where(accept, pod_anc[:, d], -1)
-            quota_used = quota_used.at[
-                jnp.where(anc >= 0, anc, n_quotas)].add(acc_req, mode="drop")
-        placed = jnp.where(accept, node_v, placed)
-        res_slot = jnp.where(accept, v, res_slot)
-        return (free_all, quota_used, placed, res_slot), None
-
-    n_res = resv.valid.shape[0]
-    init = (resv.free, quotas.used, jnp.full((p,), -1, jnp.int32),
-            jnp.full((p,), -1, jnp.int32))
-    (_, quota_used, placed, res_slot), _ = jax.lax.scan(
-        body, init, jnp.arange(n_res))
-    return placed, res_slot, quota_used
+    node_c = jnp.maximum(resv.node, 0)
+    base_ok = (resv.valid & (resv.node >= 0))[None, :]           # [1, V]
+    owner_ok = ((pods.reservation_owner[:, None] >= 0)
+                & (pods.reservation_owner[:, None]
+                   == resv.owner_group[None, :]))                # [P, V]
+    slot_ok = (base_ok & owner_ok & static_ok[:, node_c]
+               & ~pods.numa_single[:, None])
+    return slot_ok, resv.free, resv.node
 
 
 def rebuild_reservations(resv: ReservationState, pods: PodBatch,

@@ -265,10 +265,19 @@ class SnapshotBuilder:
                     numa_cap[i, j, 1] = zone.memory_mib
                     numa_valid[i, j] = True
 
+        numa_used = np.zeros((n, z, 2), np.float32)
         for pod in self.running_pods:
             idx = self.node_index.get(pod.node_name)
             if idx is not None:
-                requested[idx] += resource_vec(pod.requests)
+                rv = resource_vec(pod.requests)
+                requested[idx] += rv
+                # restore zone usage of running NUMA-bound pods from their
+                # resource-status annotation (nodenumaresource
+                # resource_manager.go rebuilds allocations the same way)
+                zi = pod.allocated_numa_zone
+                if pod.required_cpu_bind and 0 <= zi < z:
+                    numa_used[idx, zi, 0] += rv[int(ResourceKind.CPU)]
+                    numa_used[idx, zi, 1] += rv[int(ResourceKind.MEMORY)]
 
         # An Available reservation is a "reserve pod": its requests are
         # charged to node requested up front (reservation/transformer.go
@@ -347,7 +356,9 @@ class SnapshotBuilder:
             prod_assigned_correction=prod_assigned_corr,
             metric_fresh=fresh,
             has_agg=has_agg, schedulable=schedulable, label_group=lab_ids,
-            numa_cap=numa_cap, numa_free=numa_cap.copy(), numa_valid=numa_valid,
+            numa_cap=numa_cap,
+            numa_free=np.maximum(numa_cap - numa_used, 0.0),
+            numa_valid=numa_valid,
         )
         return state, groups
 

@@ -1,0 +1,166 @@
+"""NodeNUMAResource: device zone kernels + host cpuset accumulator.
+
+Mirrors plugins/nodenumaresource/ semantics (topology_hint.go,
+cpu_accumulator.go, scoring.go).
+"""
+
+import numpy as np
+import pytest
+
+from koordinator_tpu.api.extension import QoSClass, ResourceKind as RK
+from koordinator_tpu.api.types import (
+    Node, NodeMetric, NodeResourceTopology, NUMAZone, ObjectMeta, Pod,
+)
+from koordinator_tpu.scheduler import core
+from koordinator_tpu.scheduler.plugins import loadaware
+from koordinator_tpu.scheduler.plugins.cpu_accumulator import (
+    CPUAllocationError, CPUTopology, take_cpus, take_preferred_cpus,
+)
+from koordinator_tpu.snapshot.builder import SnapshotBuilder
+
+NOW = 1_700_000_000.0
+CFG = loadaware.LoadAwareConfig.make()
+
+
+def numa_node(name, zone_cpu=8000.0, zone_mem=16384.0, zones=2):
+    return Node(
+        meta=ObjectMeta(name=name),
+        allocatable={RK.CPU: zone_cpu * zones, RK.MEMORY: zone_mem * zones},
+        topology=NodeResourceTopology(
+            zones=[NUMAZone(cpus_milli=zone_cpu, memory_mib=zone_mem)
+                   for _ in range(zones)]))
+
+
+def bind_pod(name, cpu, mem, priority=9100):
+    return Pod(meta=ObjectMeta(name=name),
+               requests={RK.CPU: cpu, RK.MEMORY: mem},
+               priority=priority, qos_label="LSR", required_cpu_bind=True)
+
+
+def build(nodes, pods, **kw):
+    b = SnapshotBuilder(max_nodes=len(nodes))
+    for n in nodes:
+        b.add_node(n)
+        b.set_node_metric(NodeMetric(node_name=n.meta.name,
+                                     update_time=NOW - 2,
+                                     node_usage={RK.CPU: 0.0}))
+    snap, ctx = b.build(now=NOW)
+    batch = b.build_pod_batch(pods, ctx)
+    return core.schedule_batch(snap, batch, CFG, **{"num_rounds": 3, **kw})
+
+
+def test_single_numa_fit_gate():
+    # pod needs 6000m in ONE zone; node zones are 4000m each though the
+    # node total (8000m) would fit -> unschedulable on that node.
+    small = numa_node("small", zone_cpu=4000.0)
+    big = numa_node("big", zone_cpu=8000.0)
+    res = build([small, big], [bind_pod("p", 6000.0, 1024.0)])
+    assert int(res.assignment[0]) == 1
+    assert int(res.numa_zone[0]) >= 0
+
+
+def test_zone_accounting_and_contention():
+    # zones hold 8000m each; three 5000m bound pods -> only two fit (one
+    # per zone), third is revoked by zone exactness.
+    n = numa_node("n0", zone_cpu=8000.0, zones=2)
+    pods = [bind_pod(f"p{i}", 5000.0, 1024.0, priority=9500 - i)
+            for i in range(3)]
+    res = build([n], pods)
+    a = np.asarray(res.assignment)
+    z = np.asarray(res.numa_zone)
+    assert (a[:2] == 0).all() and a[2] == -1
+    assert z[0] != z[1]  # each took its own zone
+    free = np.asarray(res.snapshot.nodes.numa_free)[0]
+    np.testing.assert_allclose(sorted(free[:2, 0]), [3000.0, 3000.0])
+
+
+def test_most_allocated_packs_zones():
+    # strategy "most": second small pod should pack into the same zone.
+    n = numa_node("n0", zone_cpu=8000.0, zones=2)
+    pods = [bind_pod("a", 2000.0, 1024.0, priority=9500),
+            bind_pod("b", 2000.0, 1024.0, priority=9400)]
+    res = build([n], pods, numa_strategy="most")
+    z = np.asarray(res.numa_zone)
+    assert z[0] == z[1]
+
+
+def test_least_allocated_spreads_zones_sequentially():
+    # LeastAllocated spreading is sequential-exact at chunk size 1
+    # (choose_zone docstring): feed pods one at a time.
+    b = SnapshotBuilder(max_nodes=1)
+    n = numa_node("n0", zone_cpu=8000.0, zones=2)
+    b.add_node(n)
+    b.set_node_metric(NodeMetric(node_name="n0", update_time=NOW - 2,
+                                 node_usage={RK.CPU: 0.0}))
+    snap, ctx = b.build(now=NOW)
+    zones = []
+    for name in ("a", "b"):
+        batch = b.build_pod_batch([bind_pod(name, 2000.0, 1024.0)], ctx)
+        res = core.schedule_batch(snap, batch, CFG, num_rounds=1,
+                                  numa_strategy="least")
+        zones.append(int(res.numa_zone[0]))
+        snap = res.snapshot
+    assert zones[0] != zones[1]
+
+
+def test_unbound_pods_ignore_numa():
+    n = numa_node("n0", zone_cpu=2000.0, zones=2)  # tiny zones
+    p = Pod(meta=ObjectMeta(name="p"), requests={RK.CPU: 3000.0},
+            priority=9000)  # exceeds any zone but fits the node
+    res = build([n], [p])
+    assert int(res.assignment[0]) == 0
+    assert int(res.numa_zone[0]) == -1
+
+
+# --- host cpuset accumulator -------------------------------------------------
+
+TOPO = CPUTopology.uniform(num_sockets=2, nodes_per_socket=1,
+                           cores_per_node=4, threads_per_core=2)
+ALL = {c.cpu for c in TOPO.cpus}
+
+
+def test_full_pcpus_whole_cores():
+    got = take_cpus(TOPO, ALL, {}, 4, bind_policy="FullPCPUs")
+    cores = {TOPO.by_cpu[c].core for c in got}
+    assert len(got) == 4 and len(cores) == 2  # two whole cores
+    # sibling pairs complete
+    for core in cores:
+        assert all(m.cpu in got for m in TOPO.cores[core])
+
+
+def test_spread_by_pcpus_distinct_cores():
+    got = take_cpus(TOPO, ALL, {}, 4, bind_policy="SpreadByPCPUs")
+    cores = [TOPO.by_cpu[c].core for c in got]
+    assert len(set(cores)) == 4  # one per physical core
+
+
+def test_most_allocated_packs_numa_node():
+    # node 0 partially used -> "most" strategy fills node 0 first
+    allocated = {0: 1, 1: 1}
+    avail = ALL - {0, 1}
+    got = take_cpus(TOPO, avail, allocated, 4, bind_policy="FullPCPUs",
+                    numa_strategy="most")
+    assert all(TOPO.by_cpu[c].node == 0 for c in got)
+    got_least = take_cpus(TOPO, avail, allocated, 4,
+                          bind_policy="FullPCPUs", numa_strategy="least")
+    assert all(TOPO.by_cpu[c].node == 1 for c in got_least)
+
+
+def test_max_ref_count_sharing_and_exhaustion():
+    allocated = {c: 1 for c in ALL}
+    with pytest.raises(CPUAllocationError):
+        take_cpus(TOPO, ALL, allocated, 2, max_ref_count=1)
+    got = take_cpus(TOPO, ALL, allocated, 2, max_ref_count=2)
+    assert len(got) == 2
+
+
+def test_pcpu_exclusive_avoids_marked_cores():
+    got = take_cpus(TOPO, ALL, {}, 2, bind_policy="SpreadByPCPUs",
+                    exclusive_policy="PCPULevel", exclusive_cores={0, 1})
+    assert all(TOPO.by_cpu[c].core not in {0, 1} for c in got)
+
+
+def test_preferred_reservation_cpus_first():
+    got = take_preferred_cpus(TOPO, ALL, preferred={4, 5}, allocated={},
+                              num_needed=4, bind_policy="FullPCPUs")
+    assert {4, 5}.issubset(got) and len(got) == 4

@@ -167,3 +167,63 @@ def test_allocate_once_quota_rejected_winner_does_not_block():
     assert a[0] == -1          # hi blocked by quota everywhere
     assert a[1] == 0           # lo consumed the reservation on n0
     assert not bool(np.asarray(res.snapshot.reservations.valid)[0])
+
+
+def test_shared_reservation_oversize_owner_does_not_block_smaller():
+    # hi-priority owner requests more than the reservation's free capacity
+    # (falls through to normal scheduling); the smaller lo-priority owner
+    # must still consume — an eligible-but-unfitting pod is not charged
+    # against the reservation.
+    b = two_node_builder(cpu=20_000.0, mem=40_960.0)
+    b.add_reservation(reserve("r0", 5_000, 20_480, once=False))
+    hi = owned_pod("hi", 6_000, 2_048, priority=9500)
+    lo = owned_pod("lo", 2_000, 2_048, priority=9001)
+    snap, res = run(b, [hi, lo])
+    a = np.asarray(res.assignment)
+    assert a[0] >= 0 and a[1] == 0
+    free = np.asarray(res.snapshot.reservations.free)[0]
+    np.testing.assert_allclose(free[int(RK.CPU)], 3_000.0, atol=0.5)
+    # hi was charged to the node, lo was not
+    added = (np.asarray(res.snapshot.nodes.requested).sum(0)
+             - np.asarray(snap.nodes.requested).sum(0))
+    np.testing.assert_allclose(added[int(RK.CPU)], 6_000.0, atol=0.5)
+
+
+def test_no_quota_priority_inversion_with_reservation():
+    # quota has room for ONE pod; the hi-priority NON-owner pod must win the
+    # quota over the lo-priority reservation consumer (sequential priority
+    # order interleaves consumers with normal pods).
+    from koordinator_tpu.api.types import ElasticQuota
+    b = two_node_builder()
+    b.add_quota(ElasticQuota(meta=ObjectMeta(name="q"),
+                             max={RK.CPU: 2_500, RK.MEMORY: 40_960}))
+    b.add_reservation(reserve("r0", 6_000, 8_192))
+    hi = owned_pod("hi", 2_000, 2_048, priority=9500, labels={"team": "b"})
+    hi.quota_name = "q"
+    lo = owned_pod("lo", 2_000, 2_048, priority=9001)
+    lo.quota_name = "q"
+    snap, ctx = b.build(now=NOW)
+    snap = snap.replace(quotas=snap.quotas.replace(
+        runtime=np.asarray(snap.quotas.max).copy()))
+    batch = b.build_pod_batch([hi, lo], ctx)
+    res = core.schedule_batch(snap, batch, CFG, num_rounds=3)
+    a = np.asarray(res.assignment)
+    assert a[0] >= 0   # hi got the quota
+    assert a[1] == -1  # lo (consumer) lost: quota exhausted by hi
+    # reservation untouched
+    free = np.asarray(res.snapshot.reservations.free)[0]
+    np.testing.assert_allclose(free[int(RK.CPU)], 6_000.0)
+
+
+def test_zero_reservation_capacity_schedules():
+    # V=0 snapshots (max_reservations=0) must still schedule.
+    b = SnapshotBuilder(max_nodes=2, max_reservations=0)
+    for i in range(2):
+        b.add_node(Node(meta=ObjectMeta(name=f"n{i}"),
+                        allocatable={RK.CPU: 8_000, RK.MEMORY: 16_384}))
+        b.set_node_metric(NodeMetric(node_name=f"n{i}", update_time=NOW - 2,
+                                     node_usage={RK.CPU: 0.0}))
+    snap, ctx = b.build(now=NOW)
+    batch = b.build_pod_batch([owned_pod("p", 2_000, 2_048)], ctx)
+    res = core.schedule_batch(snap, batch, CFG, num_rounds=2)
+    assert int(res.assignment[0]) >= 0
