@@ -1,0 +1,15 @@
+"""koordlet: the node agent.
+
+Capability parity with the reference `pkg/koordlet/` (SURVEY.md 2.2): meters
+real node/pod usage from kernel interfaces, aggregates it into NodeMetric
+reports for the TPU scheduler's snapshot ingest, and enforces QoS by writing
+cgroup / resctrl files.
+
+Start order mirrors koordlet.go:127-188:
+executor -> metriccache -> statesinformer -> metricsadvisor -> prediction ->
+qosmanager -> runtimehooks.
+
+Everything reads/writes the kernel through `system.Host`, whose filesystem
+root is redirectable — the hermetic fake-host fixture the whole test suite
+uses (reference: koordlet/util/system/util_test_tool.go NewFileTestUtil).
+"""

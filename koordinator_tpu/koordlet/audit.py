@@ -1,0 +1,113 @@
+"""Audit: append-only record of every agent action on the kernel.
+
+Capability parity with `pkg/koordlet/audit/` (auditor.go): an in-memory ring
+buffer plus size-rotated on-disk log files, with a query API (the reference
+serves it over HTTP gated by AuditEventsHTTPHandler; here `query()` is the
+handler body and edge/service.py exposes it).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import threading
+import time
+from typing import Iterator, List, Optional
+
+
+@dataclasses.dataclass
+class Event:
+    ts: float
+    level: str        # "info" | "warn" | "error"
+    component: str    # e.g. "resourceexecutor", "cpusuppress"
+    operation: str    # e.g. "write", "evict"
+    target: str       # e.g. cgroup file path, pod uid
+    detail: str = ""
+
+    def to_json(self) -> str:
+        return json.dumps(dataclasses.asdict(self), separators=(",", ":"))
+
+    @classmethod
+    def from_json(cls, line: str) -> "Event":
+        return cls(**json.loads(line))
+
+
+class Auditor:
+    """Ring buffer + rotating files. Thread-safe."""
+
+    def __init__(self, log_dir: Optional[str] = None,
+                 ring_size: int = 4096,
+                 max_file_bytes: int = 4 * 1024 * 1024,
+                 max_files: int = 8):
+        self._ring: List[Event] = []
+        self._ring_size = ring_size
+        self._log_dir = log_dir
+        self._max_file_bytes = max_file_bytes
+        self._max_files = max_files
+        self._lock = threading.Lock()
+        self._fh = None
+        self._fh_bytes = 0
+        if log_dir:
+            os.makedirs(log_dir, exist_ok=True)
+            self._open_file()
+
+    def _open_file(self) -> None:
+        path = os.path.join(self._log_dir, "audit.log")
+        self._fh = open(path, "a", encoding="utf-8")
+        self._fh_bytes = self._fh.tell()
+
+    def _rotate(self) -> None:
+        self._fh.close()
+        base = os.path.join(self._log_dir, "audit.log")
+        for i in range(self._max_files - 1, 0, -1):
+            src = base if i == 1 else f"{base}.{i - 1}"
+            dst = f"{base}.{i}"
+            if os.path.exists(src):
+                os.replace(src, dst)
+        self._open_file()
+
+    def record(self, level: str, component: str, operation: str,
+               target: str, detail: str = "") -> None:
+        ev = Event(time.time(), level, component, operation, target, detail)
+        with self._lock:
+            self._ring.append(ev)
+            if len(self._ring) > self._ring_size:
+                del self._ring[:len(self._ring) - self._ring_size]
+            if self._fh is not None:
+                line = ev.to_json() + "\n"
+                self._fh.write(line)
+                self._fh.flush()
+                self._fh_bytes += len(line)
+                if self._fh_bytes >= self._max_file_bytes:
+                    self._rotate()
+
+    def info(self, component: str, operation: str, target: str,
+             detail: str = "") -> None:
+        self.record("info", component, operation, target, detail)
+
+    def query(self, component: Optional[str] = None,
+              since: Optional[float] = None,
+              limit: int = 256) -> List[Event]:
+        """Newest-first query over the ring (auditor.go:130 HTTP handler)."""
+        with self._lock:
+            events: Iterator[Event] = reversed(self._ring)
+            out: List[Event] = []
+            for ev in events:
+                if component is not None and ev.component != component:
+                    continue
+                if since is not None and ev.ts < since:
+                    break
+                out.append(ev)
+                if len(out) >= limit:
+                    break
+        return out
+
+    def close(self) -> None:
+        with self._lock:
+            if self._fh is not None:
+                self._fh.close()
+                self._fh = None
+
+
+NULL_AUDITOR = Auditor(log_dir=None, ring_size=1)

@@ -1,0 +1,163 @@
+"""metriccache: the node-local TSDB the agent aggregates from.
+
+Capability parity with `pkg/koordlet/metriccache/` (SURVEY.md 2.2): the
+reference embeds a Prometheus TSDB + an in-memory KV; here each series is a
+fixed-capacity numpy ring buffer (the agent only ever queries bounded
+trailing windows — 5 min aggregate / 24h percentiles — so a ring sized by
+retention/period is the idiomatic columnar equivalent, and percentile
+queries become vectorized numpy reductions instead of TSDB iterators).
+
+API parity: typed metric kinds + label sets (metric_resources.go), an
+appender, range queries with the aggregation types the NodeMetric report
+uses (avg/p50/p90/p95/p99/latest/count), and a KV store for point-in-time
+objects (kv_storage.go).
+"""
+
+from __future__ import annotations
+
+import bisect
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+# --- metric kinds (metric_resources.go) ---------------------------------
+NODE_CPU_USAGE = "node_cpu_usage"            # cores
+NODE_MEMORY_USAGE = "node_memory_usage"      # bytes
+POD_CPU_USAGE = "pod_cpu_usage"              # labels: pod_uid
+POD_MEMORY_USAGE = "pod_memory_usage"
+CONTAINER_CPU_USAGE = "container_cpu_usage"  # labels: pod_uid, container
+CONTAINER_MEMORY_USAGE = "container_memory_usage"
+BE_CPU_USAGE = "be_cpu_usage"                # BE tier total, cores
+SYS_CPU_USAGE = "sys_cpu_usage"              # host system procs, cores
+PSI_CPU_SOME_AVG10 = "psi_cpu_some_avg10"    # labels: cgroup
+PSI_MEM_FULL_AVG10 = "psi_mem_full_avg10"
+PSI_IO_FULL_AVG10 = "psi_io_full_avg10"
+CONTAINER_CPI_CYCLES = "container_cpi_cycles"        # labels: pod_uid, container
+CONTAINER_CPI_INSTRUCTIONS = "container_cpi_instructions"
+HOST_APP_CPU_USAGE = "host_app_cpu_usage"    # labels: app
+COLD_PAGE_BYTES = "cold_page_bytes"          # kidled cold memory
+
+AGGREGATIONS = ("avg", "p50", "p90", "p95", "p99", "latest", "count", "max")
+
+_SeriesKey = Tuple[str, Tuple[Tuple[str, str], ...]]
+
+
+def _key(metric: str, labels: Optional[Dict[str, str]]) -> _SeriesKey:
+    return metric, tuple(sorted((labels or {}).items()))
+
+
+class _Ring:
+    """Fixed-capacity (ts, value) ring with monotonically increasing ts."""
+
+    __slots__ = ("ts", "val", "cap", "n", "head")
+
+    def __init__(self, cap: int):
+        self.cap = cap
+        self.ts = np.zeros(cap, np.float64)
+        self.val = np.zeros(cap, np.float64)
+        self.n = 0
+        self.head = 0  # next write slot
+
+    def append(self, ts: float, value: float) -> None:
+        self.ts[self.head] = ts
+        self.val[self.head] = value
+        self.head = (self.head + 1) % self.cap
+        self.n = min(self.n + 1, self.cap)
+
+    def window(self, start: float, end: float) -> np.ndarray:
+        """Values with start <= ts <= end, oldest-first."""
+        if self.n < self.cap:
+            ts, val = self.ts[:self.n], self.val[:self.n]
+        else:
+            idx = np.r_[self.head:self.cap, 0:self.head]
+            ts, val = self.ts[idx], self.val[idx]
+        lo = bisect.bisect_left(ts, start)
+        hi = bisect.bisect_right(ts, end)
+        return val[lo:hi]
+
+    def latest(self) -> Optional[Tuple[float, float]]:
+        if self.n == 0:
+            return None
+        i = (self.head - 1) % self.cap
+        return float(self.ts[i]), float(self.val[i])
+
+
+class MetricCache:
+    """Thread-safe append/query store (MetricCache interface,
+    metric_cache.go:56-60)."""
+
+    def __init__(self, capacity_per_series: int = 4096):
+        self._cap = capacity_per_series
+        self._series: Dict[_SeriesKey, _Ring] = {}
+        self._kv: Dict[str, object] = {}
+        self._lock = threading.Lock()
+
+    # --- appender -------------------------------------------------------
+    def append(self, metric: str, ts: float, value: float,
+               labels: Optional[Dict[str, str]] = None) -> None:
+        k = _key(metric, labels)
+        with self._lock:
+            ring = self._series.get(k)
+            if ring is None:
+                ring = self._series[k] = _Ring(self._cap)
+            ring.append(ts, value)
+
+    def append_many(self,
+                    samples: Sequence[Tuple[str, float, float,
+                                            Optional[Dict[str, str]]]]) -> None:
+        for metric, ts, value, labels in samples:
+            self.append(metric, ts, value, labels)
+
+    # --- queries --------------------------------------------------------
+    def query(self, metric: str, start: float, end: float,
+              labels: Optional[Dict[str, str]] = None,
+              agg: str = "avg") -> Optional[float]:
+        """Aggregate one series over [start, end]; None when empty."""
+        if agg not in AGGREGATIONS:
+            raise ValueError(f"unknown aggregation {agg!r}")
+        with self._lock:
+            ring = self._series.get(_key(metric, labels))
+            if ring is None:
+                return None
+            if agg == "latest":
+                latest = ring.latest()
+                if latest is None or not start <= latest[0] <= end:
+                    return None
+                return latest[1]
+            vals = ring.window(start, end)
+        if vals.size == 0:
+            return None if agg != "count" else 0.0
+        if agg == "avg":
+            return float(vals.mean())
+        if agg == "count":
+            return float(vals.size)
+        if agg == "max":
+            return float(vals.max())
+        pct = {"p50": 50, "p90": 90, "p95": 95, "p99": 99}[agg]
+        return float(np.percentile(vals, pct))
+
+    def query_all(self, metric: str, start: float, end: float,
+                  agg: str = "avg") -> Dict[Tuple[Tuple[str, str], ...], float]:
+        """Aggregate every label-set of `metric` (e.g. all pods)."""
+        with self._lock:
+            keys = [k for k in self._series if k[0] == metric]
+        out = {}
+        for k in keys:
+            v = self.query(metric, start, end, dict(k[1]), agg)
+            if v is not None:
+                out[k[1]] = v
+        return out
+
+    def series_labels(self, metric: str) -> List[Dict[str, str]]:
+        with self._lock:
+            return [dict(k[1]) for k in self._series if k[0] == metric]
+
+    # --- KV (kv_storage.go) ---------------------------------------------
+    def set_kv(self, key: str, value: object) -> None:
+        with self._lock:
+            self._kv[key] = value
+
+    def get_kv(self, key: str) -> Optional[object]:
+        with self._lock:
+            return self._kv.get(key)

@@ -1,0 +1,240 @@
+"""metricsadvisor: the collector framework that samples kernel state into
+the metric cache.
+
+Capability parity with `pkg/koordlet/metricsadvisor/` (SURVEY.md 2.2):
+a registry of periodic collectors (framework/plugin.go) — noderesource
+(/proc/stat + meminfo), podresource (per-pod cgroup cpuacct/memory),
+beresource (BE-tier cgroup totals), sysresource (node minus pods),
+PSI, and performance/CPI (grouped perf counters via the native shim,
+performance_collector_linux.go:85-120).
+
+Counter-based rates (CPU) are computed from deltas between ticks, so each
+collector is stateful; `Advisor.collect_once(now)` drives them all — the
+run loop calls it on the collect interval, tests call it directly.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Dict, List, Optional, Protocol, Tuple
+
+from koordinator_tpu.koordlet import metriccache as mc
+from koordinator_tpu.koordlet.statesinformer import StatesInformer, be_pods
+from koordinator_tpu.koordlet.system import Host
+
+_NS = 1e9
+
+
+class Collector(Protocol):
+    name: str
+
+    def collect(self, now: float) -> None: ...
+
+
+class NodeResourceCollector:
+    """Node CPU (cores, from /proc/stat tick deltas) + memory used
+    (MemTotal - MemAvailable)."""
+
+    name = "noderesource"
+
+    def __init__(self, host: Host, cache: mc.MetricCache):
+        self.host = host
+        self.cache = cache
+        self._prev: Optional[Tuple[float, int, int]] = None  # (now, total, idle)
+
+    def collect(self, now: float) -> None:
+        try:
+            total, idle = self.host.proc_stat_cpu_ticks()
+            meminfo = self.host.meminfo()
+        except (FileNotFoundError, ValueError):
+            return
+        if self._prev is not None:
+            _, ptotal, pidle = self._prev
+            dt_total, dt_idle = total - ptotal, idle - pidle
+            if dt_total > 0:
+                n_cpus = len(self.host.cpu_topology()) or 1
+                used_cores = n_cpus * (dt_total - dt_idle) / dt_total
+                self.cache.append(mc.NODE_CPU_USAGE, now, used_cores)
+        self._prev = (now, total, idle)
+        if "MemTotal" in meminfo:
+            avail = meminfo.get("MemAvailable",
+                                meminfo.get("MemFree", 0))
+            self.cache.append(mc.NODE_MEMORY_USAGE, now,
+                              float(meminfo["MemTotal"] - avail))
+
+
+class _CgroupCPUTracker:
+    """cpuacct cumulative-ns -> cores, keyed by cgroup dir."""
+
+    def __init__(self, host: Host):
+        self.host = host
+        self._prev: Dict[str, Tuple[float, int]] = {}
+
+    def cores(self, cgroup_dir: str, now: float) -> Optional[float]:
+        try:
+            ns = self.host.cpu_acct_usage_ns(cgroup_dir)
+        except (FileNotFoundError, ValueError):
+            self._prev.pop(cgroup_dir, None)
+            return None
+        prev = self._prev.get(cgroup_dir)
+        self._prev[cgroup_dir] = (now, ns)
+        if prev is None or now <= prev[0]:
+            return None
+        return max(0.0, (ns - prev[1]) / _NS / (now - prev[0]))
+
+
+class PodResourceCollector:
+    """Per-pod cgroup CPU/memory usage (collectors/podresource)."""
+
+    name = "podresource"
+
+    def __init__(self, host: Host, cache: mc.MetricCache,
+                 informer: StatesInformer):
+        self.host = host
+        self.cache = cache
+        self.informer = informer
+        self._cpu = _CgroupCPUTracker(host)
+
+    def collect(self, now: float) -> None:
+        for meta in self.informer.get_all_pods():
+            uid = meta.pod.meta.uid
+            labels = {"pod_uid": uid}
+            cores = self._cpu.cores(meta.cgroup_dir, now)
+            if cores is not None:
+                self.cache.append(mc.POD_CPU_USAGE, now, cores, labels)
+            try:
+                b = self.host.memory_usage_bytes(meta.cgroup_dir)
+            except (FileNotFoundError, ValueError):
+                continue
+            self.cache.append(mc.POD_MEMORY_USAGE, now, float(b), labels)
+
+
+class BEResourceCollector:
+    """BE tier total usage from the besteffort QoS cgroup
+    (collectors/beresource; feeds cpusuppress/cpuevict)."""
+
+    name = "beresource"
+    be_dir = "kubepods/besteffort"
+
+    def __init__(self, host: Host, cache: mc.MetricCache):
+        self.host = host
+        self.cache = cache
+        self._cpu = _CgroupCPUTracker(host)
+
+    def collect(self, now: float) -> None:
+        cores = self._cpu.cores(self.be_dir, now)
+        if cores is not None:
+            self.cache.append(mc.BE_CPU_USAGE, now, cores)
+
+
+class SysResourceCollector:
+    """system.Used = node.Used - Σ pod.Used, floored at 0
+    (collectors/sysresource)."""
+
+    name = "sysresource"
+
+    def __init__(self, cache: mc.MetricCache, window: float = 60.0):
+        self.cache = cache
+        self.window = window
+
+    def collect(self, now: float) -> None:
+        node = self.cache.query(mc.NODE_CPU_USAGE, now - self.window, now,
+                                agg="latest")
+        if node is None:
+            return
+        pods = self.cache.query_all(mc.POD_CPU_USAGE, now - self.window, now,
+                                    agg="latest")
+        self.cache.append(mc.SYS_CPU_USAGE, now,
+                          max(0.0, node - sum(pods.values())))
+
+
+class PSICollector:
+    """Pressure-stall sampling for node + per-pod cgroups
+    (metricsadvisor performance PSI path)."""
+
+    name = "psi"
+
+    def __init__(self, host: Host, cache: mc.MetricCache,
+                 informer: StatesInformer):
+        self.host = host
+        self.cache = cache
+        self.informer = informer
+
+    def _sample(self, cgroup_dir: str, now: float) -> None:
+        for res, metric in (("cpu", mc.PSI_CPU_SOME_AVG10),
+                            ("memory", mc.PSI_MEM_FULL_AVG10),
+                            ("io", mc.PSI_IO_FULL_AVG10)):
+            try:
+                psi = self.host.psi(cgroup_dir, res)
+            except (FileNotFoundError, ValueError):
+                continue
+            value = psi.full_avg10 if res != "cpu" else psi.some_avg10
+            self.cache.append(metric, now, value, {"cgroup": cgroup_dir})
+
+    def collect(self, now: float) -> None:
+        self._sample("kubepods", now)
+        for meta in self.informer.get_all_pods():
+            self._sample(meta.cgroup_dir, now)
+
+
+class PerformanceCollector:
+    """Container CPI via grouped hardware counters (cycles+instructions),
+    read through the native perf shim (performance_collector_linux.go:
+    85-120; native/perf_group.cpp). `perf_reader(cgroup_dir)` returns
+    (cycles, instructions) deltas for the sample window or None."""
+
+    name = "performance"
+
+    def __init__(self, cache: mc.MetricCache, informer: StatesInformer,
+                 perf_reader: Callable[[str], Optional[Tuple[int, int]]]):
+        self.cache = cache
+        self.informer = informer
+        self.perf_reader = perf_reader
+
+    def collect(self, now: float) -> None:
+        for meta in self.informer.get_all_pods():
+            res = self.perf_reader(meta.cgroup_dir)
+            if res is None:
+                continue
+            cycles, instructions = res
+            labels = {"pod_uid": meta.pod.meta.uid, "container": ""}
+            self.cache.append(mc.CONTAINER_CPI_CYCLES, now, float(cycles),
+                              labels)
+            self.cache.append(mc.CONTAINER_CPI_INSTRUCTIONS, now,
+                              float(instructions), labels)
+
+
+class Advisor:
+    """The collector registry + drive loop (framework/plugin.go registry;
+    metrics_advisor.go:72-102 per-collector goroutines collapse into one
+    tick since every collector is cheap and non-blocking here)."""
+
+    def __init__(self, collectors: List[Collector],
+                 collect_interval: float = 1.0):
+        self.collectors = collectors
+        self.collect_interval = collect_interval
+
+    def collect_once(self, now: Optional[float] = None) -> None:
+        now = time.time() if now is None else now
+        for c in self.collectors:
+            c.collect(now)
+
+    def run(self, stop: Callable[[], bool]) -> None:
+        while not stop():
+            self.collect_once()
+            time.sleep(self.collect_interval)
+
+
+def default_advisor(host: Host, cache: mc.MetricCache,
+                    informer: StatesInformer,
+                    perf_reader: Optional[Callable] = None) -> Advisor:
+    cs: List[Collector] = [
+        NodeResourceCollector(host, cache),
+        PodResourceCollector(host, cache, informer),
+        BEResourceCollector(host, cache),
+        SysResourceCollector(cache),
+        PSICollector(host, cache, informer),
+    ]
+    if perf_reader is not None:
+        cs.append(PerformanceCollector(cache, informer, perf_reader))
+    return Advisor(cs)

@@ -1,0 +1,133 @@
+"""resourceexecutor: the single chokepoint for kernel writes.
+
+Capability parity with `pkg/koordlet/resourceexecutor/` (SURVEY.md 2.2):
+- `Executor.update_batch(cacheable, updaters)`: skips writes whose target
+  file already holds the desired value (cache + readback),
+- `Executor.leveled_update_batch(...)`: for hierarchical constraint files
+  (cpuset.cpus, memory.min/low) writes a top-down MERGE pass (parent value
+  becomes union/max of current and target so children never exceed an
+  intermediate parent) followed by a bottom-up SET pass (executor.go:32-42),
+- every write is audit-logged (audit.py).
+
+All kernel IO goes through `system.Host`, so the whole module is hermetic
+under the fake-host fixture.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Dict, List, Optional, Sequence
+
+from koordinator_tpu.koordlet import system
+from koordinator_tpu.koordlet.audit import Auditor, NULL_AUDITOR
+from koordinator_tpu.koordlet.system import Host, format_cpuset, parse_cpuset
+
+
+def merge_cpuset(current: str, target: str) -> str:
+    """Union merge for cpuset.cpus (never shrink in the merge pass)."""
+    return format_cpuset(parse_cpuset(current) + parse_cpuset(target))
+
+
+def merge_max_int(current: str, target: str) -> str:
+    """Max merge for memory.min/low style protections."""
+    try:
+        return str(max(int(current), int(target)))
+    except ValueError:
+        return target
+
+
+# resource name -> merge function for the leveled top-down pass
+MERGE_FUNCS: Dict[str, Callable[[str, str], str]] = {
+    "cpuset.cpus": merge_cpuset,
+    "cpuset.mems": merge_cpuset,
+    "memory.min": merge_max_int,
+    "memory.low": merge_max_int,
+}
+
+
+@dataclasses.dataclass
+class CgroupUpdate:
+    """One desired (cgroup_dir, resource, value) write."""
+
+    cgroup_dir: str
+    resource: str
+    value: str
+
+    @property
+    def key(self) -> str:
+        return f"{self.cgroup_dir}:{self.resource}"
+
+
+class Executor:
+    """ResourceUpdateExecutor: cacheable, audited, leveled cgroup writes."""
+
+    def __init__(self, host: Host, auditor: Auditor = NULL_AUDITOR):
+        self.host = host
+        self.auditor = auditor
+        self._cache: Dict[str, str] = {}
+
+    # --- reads (CgroupReader, reader.go) --------------------------------
+    def read(self, cgroup_dir: str, resource: str) -> str:
+        return self.host.read_cgroup(cgroup_dir, resource)
+
+    def try_read(self, cgroup_dir: str, resource: str) -> Optional[str]:
+        try:
+            return self.read(cgroup_dir, resource)
+        except (FileNotFoundError, ValueError):
+            return None
+
+    # --- writes ---------------------------------------------------------
+    def _write(self, up: CgroupUpdate, value: str) -> bool:
+        try:
+            self.host.write_cgroup(up.cgroup_dir, up.resource, value)
+        except (FileNotFoundError, ValueError, OSError) as e:
+            self.auditor.record("error", "resourceexecutor", "write",
+                                up.key, f"{value!r}: {e}")
+            return False
+        self._cache[up.key] = value
+        self.auditor.info("resourceexecutor", "write", up.key, value)
+        return True
+
+    def update(self, up: CgroupUpdate, cacheable: bool = True) -> bool:
+        """Write one file; with cacheable=True skip when the live value
+        already matches (reference cacheable updaters)."""
+        if cacheable:
+            if self._cache.get(up.key) == up.value:
+                return True
+            live = self.try_read(up.cgroup_dir, up.resource)
+            if live is not None and live == up.value:
+                self._cache[up.key] = up.value
+                return True
+        return self._write(up, up.value)
+
+    def update_batch(self, updates: Sequence[CgroupUpdate],
+                     cacheable: bool = True) -> int:
+        """Returns the number of successful (or cache-skipped) updates."""
+        return sum(1 for up in updates if self.update(up, cacheable))
+
+    def leveled_update_batch(self, updates: Sequence[CgroupUpdate]) -> int:
+        """Top-down merge then bottom-up set (executor.go:32-42).
+
+        Levels = cgroup path depth. The merge pass only touches resources
+        with a registered merge function; others are written in the set
+        pass only.
+        """
+        by_depth = sorted(updates, key=lambda u: u.cgroup_dir.count("/"))
+        # pass 1: top-down, write merged value so a child's target never
+        # exceeds its parent's intermediate value
+        for up in by_depth:
+            merge = MERGE_FUNCS.get(up.resource)
+            if merge is None:
+                continue
+            current = self.try_read(up.cgroup_dir, up.resource)
+            if current is None:
+                continue
+            merged = merge(current, up.value)
+            if merged != current:
+                self._write(up, merged)
+        # pass 2: bottom-up, set final values
+        ok = 0
+        for up in reversed(by_depth):
+            if self.update(up, cacheable=False):
+                ok += 1
+        return ok

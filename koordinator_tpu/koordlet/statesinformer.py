@@ -1,0 +1,234 @@
+"""statesinformer: the agent's view of node/pod/SLO state + the NodeMetric
+report loop.
+
+Capability parity with `pkg/koordlet/statesinformer/impl/` (SURVEY.md 2.2):
+- a registry of typed states (node, pods, NodeSLO, NodeResourceTopology,
+  devices) with callback fan-out to subscribers (callback_runner.go),
+- `NodeMetricReporter`: aggregates metriccache into a NodeMetric status —
+  node avg usage over the aggregate window, p50/p90/p95/p99 percentile
+  usage over longer windows, per-pod usage, prod-reclaimable from the peak
+  predictor — on the report interval (states_nodemetric.go:202-250).
+
+The reference pulls pods from the kubelet /pods endpoint; here pod
+arrival/update is pushed through `set_pods` by the edge layer (or tests),
+the same boundary shape without an HTTP dependency.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+from koordinator_tpu.api import types as api
+from koordinator_tpu.api.extension import (
+    PriorityClass,
+    QoSClass,
+    ResourceKind,
+)
+from koordinator_tpu.koordlet import metriccache as mc
+from koordinator_tpu.koordlet.system import CgroupDriver, pod_cgroup_dir
+
+# state kinds for callback registration (impl/registry.go)
+STATE_NODE = "node"
+STATE_PODS = "pods"
+STATE_NODE_SLO = "node_slo"
+STATE_TOPOLOGY = "node_topology"
+STATE_DEVICE = "device"
+
+_BYTES_PER_MIB = float(1 << 20)
+
+
+def _qos_tier(qos: QoSClass) -> str:
+    """kubelet QoS tier dir for the pod cgroup path."""
+    if qos in (QoSClass.BE,):
+        return "besteffort"
+    if qos in (QoSClass.LSE, QoSClass.LSR):
+        return "guaranteed"
+    return "burstable"
+
+
+@dataclasses.dataclass
+class PodMeta:
+    """A pod plus its node-local cgroup location (statesinformer.PodMeta)."""
+
+    pod: api.Pod
+    cgroup_dir: str = ""
+
+    def __post_init__(self) -> None:
+        if not self.cgroup_dir:
+            self.cgroup_dir = pod_cgroup_dir(
+                _qos_tier(self.pod.qos), self.pod.meta.uid,
+                CgroupDriver.CGROUPFS)
+
+
+class StatesInformer:
+    """Typed state registry with subscriber callbacks."""
+
+    def __init__(self) -> None:
+        self._lock = threading.RLock()
+        self._node: Optional[api.Node] = None
+        self._pods: Dict[str, PodMeta] = {}
+        self._node_slo: Optional[api.NodeSLO] = None
+        self._topology: Optional[api.NodeResourceTopology] = None
+        self._device: Optional[api.Device] = None
+        self._callbacks: Dict[str, List[Callable[[object], None]]] = {}
+
+    def subscribe(self, state: str, cb: Callable[[object], None]) -> None:
+        with self._lock:
+            self._callbacks.setdefault(state, []).append(cb)
+
+    def _notify(self, state: str, value: object) -> None:
+        for cb in self._callbacks.get(state, []):
+            cb(value)
+
+    # --- setters (informer plugin update paths) -------------------------
+    def set_node(self, node: api.Node) -> None:
+        with self._lock:
+            self._node = node
+        self._notify(STATE_NODE, node)
+
+    def set_pods(self, pods: List[PodMeta]) -> None:
+        with self._lock:
+            self._pods = {p.pod.meta.uid: p for p in pods}
+        self._notify(STATE_PODS, pods)
+
+    def set_node_slo(self, slo: api.NodeSLO) -> None:
+        with self._lock:
+            self._node_slo = slo
+        self._notify(STATE_NODE_SLO, slo)
+
+    def set_topology(self, topo: api.NodeResourceTopology) -> None:
+        with self._lock:
+            self._topology = topo
+        self._notify(STATE_TOPOLOGY, topo)
+
+    def set_device(self, device: api.Device) -> None:
+        with self._lock:
+            self._device = device
+        self._notify(STATE_DEVICE, device)
+
+    # --- getters --------------------------------------------------------
+    def get_node(self) -> Optional[api.Node]:
+        with self._lock:
+            return self._node
+
+    def get_all_pods(self) -> List[PodMeta]:
+        with self._lock:
+            return list(self._pods.values())
+
+    def get_pod(self, uid: str) -> Optional[PodMeta]:
+        with self._lock:
+            return self._pods.get(uid)
+
+    def get_node_slo(self) -> Optional[api.NodeSLO]:
+        with self._lock:
+            return self._node_slo
+
+    def get_topology(self) -> Optional[api.NodeResourceTopology]:
+        with self._lock:
+            return self._topology
+
+    def get_device(self) -> Optional[api.Device]:
+        with self._lock:
+            return self._device
+
+
+@dataclasses.dataclass
+class CollectPolicy:
+    """NodeMetric spec collect policy (nodemetric_types.go:79)."""
+
+    report_interval_seconds: float = 60.0
+    aggregate_duration_seconds: float = 300.0
+    # windows for the aggregated percentile usages
+    aggregate_policy_durations: tuple = (300.0, 1800.0, 86400.0)
+
+
+class NodeMetricReporter:
+    """Builds NodeMetric statuses from the metric cache
+    (nodeMetricInformer sync, states_nodemetric.go:202-250).
+
+    `predictor`, when given, supplies prod-reclaimable resources
+    (prediction.PeakPredictServer -> prodReclaimableMetric).
+    """
+
+    def __init__(self, informer: StatesInformer, cache: mc.MetricCache,
+                 policy: CollectPolicy = CollectPolicy(),
+                 predictor: Optional[object] = None):
+        self.informer = informer
+        self.cache = cache
+        self.policy = policy
+        self.predictor = predictor
+
+    def collect(self, now: Optional[float] = None) -> Optional[api.NodeMetric]:
+        now = time.time() if now is None else now
+        node = self.informer.get_node()
+        if node is None:
+            return None
+        win = self.policy.aggregate_duration_seconds
+        cpu = self.cache.query(mc.NODE_CPU_USAGE, now - win, now, agg="avg")
+        memb = self.cache.query(mc.NODE_MEMORY_USAGE, now - win, now, agg="avg")
+        if cpu is None and memb is None:
+            return None  # "node metric is not ready, skip this round"
+
+        def usage_rl(cpu_cores: Optional[float],
+                     mem_bytes: Optional[float]) -> dict:
+            return {
+                ResourceKind.CPU: (cpu_cores or 0.0) * 1000.0,
+                ResourceKind.MEMORY: (mem_bytes or 0.0) / _BYTES_PER_MIB,
+            }
+
+        nm = api.NodeMetric(
+            node_name=node.meta.name,
+            update_time=now,
+            node_usage=usage_rl(cpu, memb),
+        )
+        sys_cpu = self.cache.query(mc.SYS_CPU_USAGE, now - win, now, agg="avg")
+        if sys_cpu is not None:
+            nm.system_usage = {ResourceKind.CPU: sys_cpu * 1000.0,
+                               ResourceKind.MEMORY: 0.0}
+
+        # aggregated percentiles per window (AggregatedUsage, p50/p90/p95/p99)
+        for dur in self.policy.aggregate_policy_durations:
+            usages: Dict[str, dict] = {}
+            for agg in ("p50", "p90", "p95", "p99"):
+                c = self.cache.query(mc.NODE_CPU_USAGE, now - dur, now, agg=agg)
+                m = self.cache.query(mc.NODE_MEMORY_USAGE, now - dur, now,
+                                     agg=agg)
+                if c is not None or m is not None:
+                    usages[agg] = usage_rl(c, m)
+            if usages:
+                nm.aggregated.append(api.AggregatedUsage(
+                    duration_seconds=dur, usages=usages))
+
+        # per-pod usage
+        for meta in self.informer.get_all_pods():
+            uid = meta.pod.meta.uid
+            labels = {"pod_uid": uid}
+            pc = self.cache.query(mc.POD_CPU_USAGE, now - win, now, labels,
+                                  "avg")
+            pm = self.cache.query(mc.POD_MEMORY_USAGE, now - win, now, labels,
+                                  "avg")
+            if pc is None and pm is None:
+                continue
+            nm.pods_metric.append(api.PodMetricInfo(
+                namespace=meta.pod.meta.namespace,
+                name=meta.pod.meta.name,
+                priority_class=meta.pod.priority_class,
+                usage=usage_rl(pc, pm)))
+
+        if self.predictor is not None:
+            reclaimable = self.predictor.prod_reclaimable(now=now)
+            if reclaimable:
+                nm.prod_reclaimable = reclaimable
+        return nm
+
+
+def prod_pods(pods: List[PodMeta]) -> List[PodMeta]:
+    """Pods in the Prod priority band (helpers for suppress/overcommit)."""
+    return [p for p in pods if p.pod.priority_class == PriorityClass.PROD]
+
+
+def be_pods(pods: List[PodMeta]) -> List[PodMeta]:
+    return [p for p in pods if p.pod.qos == QoSClass.BE]

@@ -1,0 +1,383 @@
+"""Kernel interface surface: cgroup v1/v2, procfs, resctrl, PSI.
+
+Capability parity with `pkg/koordlet/util/system/` (SURVEY.md 2.2):
+- cgroup v1+v2 abstraction with a registry of known resource files
+  (cgroup_resource.go, incl. `cpu.bvt_warp_ns`),
+- cgroup driver layout (cgroupfs vs systemd pod dir naming),
+- PSI pressure files (resourceexecutor/psi.go),
+- resctrl schemata read/write (resctrl.go:38-69),
+- CPU topology discovery (used by cpusuppress cpuset policy).
+
+Design: a `Host` object owns the filesystem root. Production uses
+`Host("/")`; tests use `Host(tmpdir)` — the hermetic fake-host fixture
+(reference: util_test_tool.go NewFileTestUtil). No module-level path
+globals, so parallel tests never collide.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import os
+import re
+from typing import Dict, List, Optional, Sequence, Tuple
+
+
+class CgroupVersion(enum.Enum):
+    V1 = 1
+    V2 = 2
+
+
+class CgroupDriver(enum.Enum):
+    CGROUPFS = "cgroupfs"
+    SYSTEMD = "systemd"
+
+
+@dataclasses.dataclass(frozen=True)
+class CgroupResource:
+    """One known cgroup file (cgroup_resource.go registry entry)."""
+
+    name: str            # logical name, e.g. "cpu.cfs_quota_us"
+    v1_subsystem: str    # v1 controller dir ("cpu", "memory", "cpuset", ...)
+    v1_file: str
+    v2_file: str         # "" if absent in v2
+    # inclusive valid int range, None = unchecked / non-numeric
+    valid_range: Optional[Tuple[int, int]] = None
+
+    def filename(self, version: CgroupVersion) -> str:
+        return self.v1_file if version is CgroupVersion.V1 else self.v2_file
+
+    def supported(self, version: CgroupVersion) -> bool:
+        return bool(self.filename(version))
+
+
+_I64 = (-(2**63), 2**63 - 1)
+
+# The known-files registry (subset of cgroup_resource.go that the agent
+# actually reads/writes; extend as strategies land).
+RESOURCES: Dict[str, CgroupResource] = {r.name: r for r in [
+    CgroupResource("cpu.shares", "cpu", "cpu.shares", "cpu.weight", (2, 262144)),
+    CgroupResource("cpu.cfs_quota_us", "cpu", "cpu.cfs_quota_us", "cpu.max", (-1, _I64[1])),
+    CgroupResource("cpu.cfs_period_us", "cpu", "cpu.cfs_period_us", "cpu.max", (1000, 1000000)),
+    CgroupResource("cpu.cfs_burst_us", "cpu", "cpu.cfs_burst_us", "cpu.max.burst", (0, _I64[1])),
+    CgroupResource("cpu.bvt_warp_ns", "cpu", "cpu.bvt_warp_ns", "cpu.bvt_warp_ns", (-1, 2)),
+    CgroupResource("cpu.idle", "cpu", "cpu.idle", "cpu.idle", (0, 1)),
+    CgroupResource("cpuset.cpus", "cpuset", "cpuset.cpus", "cpuset.cpus"),
+    CgroupResource("cpuset.mems", "cpuset", "cpuset.mems", "cpuset.mems"),
+    CgroupResource("cpuacct.usage", "cpuacct", "cpuacct.usage", ""),
+    CgroupResource("cpu.stat", "cpu", "cpu.stat", "cpu.stat"),
+    CgroupResource("memory.limit_in_bytes", "memory", "memory.limit_in_bytes", "memory.max", (-1, _I64[1])),
+    CgroupResource("memory.min", "memory", "memory.min", "memory.min", (0, _I64[1])),
+    CgroupResource("memory.low", "memory", "memory.low", "memory.low", (0, _I64[1])),
+    CgroupResource("memory.high", "memory", "memory.high", "memory.high", (-1, _I64[1])),
+    CgroupResource("memory.wmark_ratio", "memory", "memory.wmark_ratio", "memory.wmark_ratio", (0, 100)),
+    CgroupResource("memory.usage_in_bytes", "memory", "memory.usage_in_bytes", "memory.current"),
+    CgroupResource("memory.stat", "memory", "memory.stat", "memory.stat"),
+    CgroupResource("memory.oom.group", "memory", "memory.oom.group", "memory.oom.group", (0, 1)),
+    CgroupResource("cpu.pressure", "cpu", "cpu.pressure", "cpu.pressure"),
+    CgroupResource("memory.pressure", "memory", "memory.pressure", "memory.pressure"),
+    CgroupResource("io.pressure", "io", "io.pressure", "io.pressure"),
+    CgroupResource("blkio.throttle.read_bps_device", "blkio", "blkio.throttle.read_bps_device", "io.max"),
+    CgroupResource("blkio.throttle.write_bps_device", "blkio", "blkio.throttle.write_bps_device", "io.max"),
+]}
+
+# kubelet cgroup tree roots per QoS class (v1 path under each subsystem;
+# v2 path under the unified mount).
+KUBEPODS_ROOT = "kubepods"
+QOS_DIRS = {"guaranteed": "", "burstable": "burstable", "besteffort": "besteffort"}
+
+
+def pod_cgroup_dir(qos: str, pod_uid: str,
+                   driver: CgroupDriver = CgroupDriver.CGROUPFS) -> str:
+    """Relative cgroup dir of a pod under the kubepods root.
+
+    cgroupfs: kubepods/besteffort/pod<uid>
+    systemd:  kubepods.slice/kubepods-besteffort.slice/
+              kubepods-besteffort-pod<uid_with_underscores>.slice
+    """
+    qos_dir = QOS_DIRS.get(qos.lower())
+    if qos_dir is None:
+        raise ValueError(f"unknown qos tier {qos!r}")
+    if driver is CgroupDriver.CGROUPFS:
+        parts = [KUBEPODS_ROOT] + ([qos_dir] if qos_dir else []) + [f"pod{pod_uid}"]
+        return "/".join(parts)
+    uid = pod_uid.replace("-", "_")
+    if qos_dir:
+        return (f"{KUBEPODS_ROOT}.slice/{KUBEPODS_ROOT}-{qos_dir}.slice/"
+                f"{KUBEPODS_ROOT}-{qos_dir}-pod{uid}.slice")
+    return f"{KUBEPODS_ROOT}.slice/{KUBEPODS_ROOT}-pod{uid}.slice"
+
+
+def parse_cpuset(s: str) -> List[int]:
+    """'0-2,5,7-8' -> [0,1,2,5,7,8] (util/cpuset parse)."""
+    cpus: List[int] = []
+    s = s.strip()
+    if not s:
+        return cpus
+    for part in s.split(","):
+        part = part.strip()
+        if "-" in part:
+            lo, hi = part.split("-", 1)
+            cpus.extend(range(int(lo), int(hi) + 1))
+        else:
+            cpus.append(int(part))
+    return sorted(set(cpus))
+
+
+def format_cpuset(cpus: Sequence[int]) -> str:
+    """[0,1,2,5] -> '0-2,5'."""
+    cpus = sorted(set(int(c) for c in cpus))
+    if not cpus:
+        return ""
+    runs: List[Tuple[int, int]] = []
+    start = prev = cpus[0]
+    for c in cpus[1:]:
+        if c == prev + 1:
+            prev = c
+            continue
+        runs.append((start, prev))
+        start = prev = c
+    runs.append((start, prev))
+    return ",".join(f"{a}-{b}" if b > a else f"{a}" for a, b in runs)
+
+
+@dataclasses.dataclass(frozen=True)
+class ProcessorInfo:
+    """One logical CPU (util.ProcessorInfo): ids used by the cpuset
+    suppress policy to avoid LSE/LSR cores and spread over physical cores."""
+
+    cpu_id: int
+    core_id: int
+    socket_id: int
+    node_id: int  # NUMA node
+
+
+@dataclasses.dataclass
+class PSIStats:
+    """Pressure-stall info of one resource ('some'/'full' avg10/avg60/
+    avg300 in percent, total in microseconds)."""
+
+    some_avg10: float = 0.0
+    some_avg60: float = 0.0
+    some_avg300: float = 0.0
+    some_total: int = 0
+    full_avg10: float = 0.0
+    full_avg60: float = 0.0
+    full_avg300: float = 0.0
+    full_total: int = 0
+
+
+def parse_psi(text: str) -> PSIStats:
+    out = PSIStats()
+    for line in text.splitlines():
+        m = re.match(r"(some|full) avg10=([\d.]+) avg60=([\d.]+) "
+                     r"avg300=([\d.]+) total=(\d+)", line.strip())
+        if not m:
+            continue
+        kind = m.group(1)
+        setattr(out, f"{kind}_avg10", float(m.group(2)))
+        setattr(out, f"{kind}_avg60", float(m.group(3)))
+        setattr(out, f"{kind}_avg300", float(m.group(4)))
+        setattr(out, f"{kind}_total", int(m.group(5)))
+    return out
+
+
+class Host:
+    """A (redirectable-root) view of the kernel interface filesystem.
+
+    Layout under `root`:
+      proc/...                         procfs
+      sys/fs/cgroup/<subsys>/...      cgroup v1 mount
+      sys/fs/cgroup/...                cgroup v2 unified mount
+      sys/fs/resctrl/...               resctrl
+    """
+
+    def __init__(self, root: str = "/",
+                 cgroup_version: Optional[CgroupVersion] = None,
+                 driver: CgroupDriver = CgroupDriver.CGROUPFS):
+        self.root = root
+        self.driver = driver
+        self._version = cgroup_version or self._detect_version()
+
+    # --- path helpers ---------------------------------------------------
+    def path(self, *parts: str) -> str:
+        return os.path.join(self.root, *[p.lstrip("/") for p in parts])
+
+    @property
+    def proc_root(self) -> str:
+        return self.path("proc")
+
+    @property
+    def cgroup_root(self) -> str:
+        return self.path("sys/fs/cgroup")
+
+    @property
+    def resctrl_root(self) -> str:
+        return self.path("sys/fs/resctrl")
+
+    def _detect_version(self) -> CgroupVersion:
+        # unified mount has cgroup.controllers at its root
+        if os.path.exists(os.path.join(self.cgroup_root, "cgroup.controllers")):
+            return CgroupVersion.V2
+        return CgroupVersion.V1
+
+    @property
+    def cgroup_version(self) -> CgroupVersion:
+        return self._version
+
+    def cgroup_file(self, cgroup_dir: str, resource: str) -> str:
+        """Absolute path of `resource` (registry name) for a cgroup dir
+        relative to the kubepods mount (e.g. 'kubepods/besteffort')."""
+        res = RESOURCES[resource]
+        if not res.supported(self._version):
+            raise FileNotFoundError(
+                f"{resource} unsupported on cgroup {self._version.name}")
+        if self._version is CgroupVersion.V1:
+            return os.path.join(self.cgroup_root, res.v1_subsystem,
+                                cgroup_dir, res.v1_file)
+        return os.path.join(self.cgroup_root, cgroup_dir, res.v2_file)
+
+    # --- raw IO ---------------------------------------------------------
+    def read(self, abs_path: str) -> str:
+        with open(abs_path, "r", encoding="utf-8") as f:
+            return f.read()
+
+    def write(self, abs_path: str, value: str) -> None:
+        os.makedirs(os.path.dirname(abs_path), exist_ok=True)
+        with open(abs_path, "w", encoding="utf-8") as f:
+            f.write(value)
+
+    def read_cgroup(self, cgroup_dir: str, resource: str) -> str:
+        return self.read(self.cgroup_file(cgroup_dir, resource)).strip()
+
+    def write_cgroup(self, cgroup_dir: str, resource: str, value: str) -> None:
+        res = RESOURCES[resource]
+        if res.valid_range is not None:
+            try:
+                v = int(value)
+            except ValueError:
+                raise ValueError(f"{resource}: non-numeric {value!r}")
+            lo, hi = res.valid_range
+            if not lo <= v <= hi:
+                raise ValueError(f"{resource}: {v} outside [{lo}, {hi}]")
+        self.write(self.cgroup_file(cgroup_dir, resource), value)
+
+    # --- typed readers --------------------------------------------------
+    def cpu_acct_usage_ns(self, cgroup_dir: str) -> int:
+        """Cumulative cgroup CPU time in nanoseconds (v1 cpuacct.usage;
+        v2 cpu.stat usage_usec*1000)."""
+        if self._version is CgroupVersion.V1:
+            return int(self.read_cgroup(cgroup_dir, "cpuacct.usage"))
+        for line in self.read_cgroup(cgroup_dir, "cpu.stat").splitlines():
+            k, _, v = line.partition(" ")
+            if k == "usage_usec":
+                return int(v) * 1000
+        raise ValueError("cpu.stat missing usage_usec")
+
+    def memory_usage_bytes(self, cgroup_dir: str) -> int:
+        """Working-set-ish usage: usage minus inactive file cache
+        (reference collectors subtract total_inactive_file)."""
+        usage = int(self.read_cgroup(cgroup_dir, "memory.usage_in_bytes"))
+        inactive = 0
+        try:
+            for line in self.read_cgroup(cgroup_dir, "memory.stat").splitlines():
+                k, _, v = line.partition(" ")
+                if k in ("total_inactive_file", "inactive_file"):
+                    inactive = int(v)
+                    break
+        except (FileNotFoundError, ValueError):
+            pass
+        return max(0, usage - inactive)
+
+    def psi(self, cgroup_dir: str, resource: str) -> PSIStats:
+        """resource in {cpu, memory, io}."""
+        return parse_psi(self.read_cgroup(cgroup_dir, f"{resource}.pressure"))
+
+    def proc_stat_cpu_ticks(self) -> Tuple[int, int]:
+        """(total_ticks, idle_ticks incl. iowait) from /proc/stat."""
+        text = self.read(os.path.join(self.proc_root, "stat"))
+        for line in text.splitlines():
+            if line.startswith("cpu "):
+                f = [int(x) for x in line.split()[1:]]
+                total = sum(f)
+                idle = f[3] + (f[4] if len(f) > 4 else 0)
+                return total, idle
+        raise ValueError("/proc/stat missing cpu line")
+
+    def meminfo(self) -> Dict[str, int]:
+        """/proc/meminfo in bytes."""
+        out: Dict[str, int] = {}
+        for line in self.read(os.path.join(self.proc_root, "meminfo")).splitlines():
+            m = re.match(r"(\w+):\s+(\d+)(?:\s+kB)?", line)
+            if m:
+                out[m.group(1)] = int(m.group(2)) * 1024
+        return out
+
+    def cpu_topology(self) -> List[ProcessorInfo]:
+        """Logical CPUs from sys/devices topology files (fallback:
+        /proc/cpuinfo fields physical id / core id)."""
+        cpus: List[ProcessorInfo] = []
+        sys_cpu = self.path("sys/devices/system/cpu")
+        if os.path.isdir(sys_cpu):
+            for name in sorted(os.listdir(sys_cpu)):
+                m = re.fullmatch(r"cpu(\d+)", name)
+                if not m:
+                    continue
+                cpu_id = int(m.group(1))
+                topo = os.path.join(sys_cpu, name, "topology")
+                try:
+                    core = int(self.read(os.path.join(topo, "core_id")))
+                    sock = int(self.read(os.path.join(topo,
+                                                      "physical_package_id")))
+                except (FileNotFoundError, ValueError):
+                    core, sock = cpu_id, 0
+                node = 0
+                for entry in os.listdir(os.path.join(sys_cpu, name)) \
+                        if os.path.isdir(os.path.join(sys_cpu, name)) else []:
+                    nm = re.fullmatch(r"node(\d+)", entry)
+                    if nm:
+                        node = int(nm.group(1))
+                        break
+                cpus.append(ProcessorInfo(cpu_id, core, sock, node))
+        if cpus:
+            return cpus
+        # /proc/cpuinfo fallback
+        cur: Dict[str, int] = {}
+        for line in self.read(os.path.join(self.proc_root, "cpuinfo")).splitlines() + [""]:
+            if not line.strip():
+                if "processor" in cur:
+                    cpus.append(ProcessorInfo(
+                        cur["processor"], cur.get("core id", cur["processor"]),
+                        cur.get("physical id", 0), cur.get("physical id", 0)))
+                cur = {}
+                continue
+            k, _, v = line.partition(":")
+            k, v = k.strip(), v.strip()
+            if k in ("processor", "core id", "physical id") and v.isdigit():
+                cur[k] = int(v)
+        return cpus
+
+    # --- resctrl (resctrl.go:38-69) ------------------------------------
+    def resctrl_schemata(self, group: str = "") -> Dict[str, str]:
+        """Read schemata lines of a resctrl group, keyed by resource
+        ('L3', 'MB')."""
+        p = os.path.join(self.resctrl_root, group, "schemata")
+        out: Dict[str, str] = {}
+        for line in self.read(p).splitlines():
+            k, _, v = line.partition(":")
+            if v:
+                out[k.strip()] = v.strip()
+        return out
+
+    def write_resctrl_schemata(self, group: str, lines: Dict[str, str]) -> None:
+        p = os.path.join(self.resctrl_root, group, "schemata")
+        body = "".join(f"{k}:{v}\n" for k, v in lines.items())
+        self.write(p, body)
+
+    def write_resctrl_tasks(self, group: str, pids: Sequence[int]) -> None:
+        p = os.path.join(self.resctrl_root, group, "tasks")
+        os.makedirs(os.path.dirname(p), exist_ok=True)
+        # kernel protocol: one pid per write; the fake FS accepts a batch
+        with open(p, "a", encoding="utf-8") as f:
+            for pid in pids:
+                f.write(f"{pid}\n")

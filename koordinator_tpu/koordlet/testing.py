@@ -1,0 +1,141 @@
+"""Hermetic fake-host fixture: a writable kernel-interface tree.
+
+Capability parity with the reference's fake kernel FS
+(koordlet/util/system/util_test_tool.go NewFileTestUtil, SURVEY.md 4):
+every koordlet test writes and asserts real file contents under a temp root
+— no kernel, no cluster. Also used by the agent demo runner.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, Iterable, Optional
+
+from koordinator_tpu.koordlet.system import (
+    RESOURCES,
+    CgroupVersion,
+    Host,
+)
+
+
+class FakeHost(Host):
+    """A Host rooted in a temp dir with builder helpers."""
+
+    def __init__(self, root: str,
+                 cgroup_version: CgroupVersion = CgroupVersion.V1,
+                 num_cpus: int = 8, mem_bytes: int = 16 << 30,
+                 numa_nodes: int = 1):
+        os.makedirs(root, exist_ok=True)
+        if cgroup_version is CgroupVersion.V2:
+            # marker file that _detect_version keys on
+            p = os.path.join(root, "sys/fs/cgroup/cgroup.controllers")
+            os.makedirs(os.path.dirname(p), exist_ok=True)
+            with open(p, "w") as f:
+                f.write("cpu cpuset memory io\n")
+        super().__init__(root, cgroup_version)
+        self.num_cpus = num_cpus
+        self.mem_bytes = mem_bytes
+        self._ticks_total = 0
+        self._ticks_idle = 0
+        self.set_proc_stat(0, 0)
+        self.set_meminfo(available=mem_bytes)
+        self.add_cpus(num_cpus, numa_nodes)
+        for d in ("kubepods", "kubepods/burstable", "kubepods/besteffort"):
+            self.make_cgroup(d)
+
+    # --- procfs ---------------------------------------------------------
+    def set_proc_stat(self, total_ticks: int, idle_ticks: int) -> None:
+        self._ticks_total, self._ticks_idle = total_ticks, idle_ticks
+        busy = total_ticks - idle_ticks
+        self.write(os.path.join(self.proc_root, "stat"),
+                   f"cpu {busy} 0 0 {idle_ticks} 0 0 0 0 0 0\n")
+
+    def advance_cpu(self, busy_ticks: int, idle_ticks: int) -> None:
+        """Advance the /proc/stat counters by the given deltas."""
+        self.set_proc_stat(self._ticks_total + busy_ticks + idle_ticks,
+                           self._ticks_idle + idle_ticks)
+
+    def set_meminfo(self, available: int,
+                    total: Optional[int] = None) -> None:
+        total = self.mem_bytes if total is None else total
+        self.write(os.path.join(self.proc_root, "meminfo"),
+                   f"MemTotal: {total // 1024} kB\n"
+                   f"MemFree: {available // 1024} kB\n"
+                   f"MemAvailable: {available // 1024} kB\n")
+
+    def add_cpus(self, n: int, numa_nodes: int = 1) -> None:
+        """Create sys/devices/system/cpu/cpuN/topology; 2 threads per
+        physical core, cores split evenly over `numa_nodes` sockets."""
+        per_node = max(1, n // max(1, numa_nodes))
+        for cpu in range(n):
+            node = min(cpu // per_node, numa_nodes - 1)
+            topo = self.path(f"sys/devices/system/cpu/cpu{cpu}/topology")
+            os.makedirs(topo, exist_ok=True)
+            with open(os.path.join(topo, "core_id"), "w") as f:
+                f.write(str(cpu // 2))
+            with open(os.path.join(topo, "physical_package_id"), "w") as f:
+                f.write(str(node))
+            nd = self.path(f"sys/devices/system/cpu/cpu{cpu}/node{node}")
+            os.makedirs(nd, exist_ok=True)
+
+    # --- cgroupfs -------------------------------------------------------
+    def make_cgroup(self, cgroup_dir: str,
+                    defaults: Optional[Dict[str, str]] = None) -> None:
+        """Create a cgroup dir with default files for all known resources."""
+        base_defaults = {
+            "cpu.shares": "1024", "cpu.cfs_quota_us": "-1",
+            "cpu.cfs_period_us": "100000", "cpu.cfs_burst_us": "0",
+            "cpu.bvt_warp_ns": "0", "cpu.idle": "0",
+            "cpuset.cpus": f"0-{self.num_cpus - 1}" if self.num_cpus > 1 else "0",
+            "cpuset.mems": "0",
+            "cpuacct.usage": "0",
+            "cpu.stat": "usage_usec 0\n",
+            "memory.limit_in_bytes": str(self.mem_bytes),
+            "memory.min": "0", "memory.low": "0", "memory.high": "-1",
+            "memory.usage_in_bytes": "0",
+            "memory.stat": "total_inactive_file 0\n",
+            "cpu.pressure": "some avg10=0.00 avg60=0.00 avg300=0.00 total=0\n",
+            "memory.pressure":
+                "some avg10=0.00 avg60=0.00 avg300=0.00 total=0\n"
+                "full avg10=0.00 avg60=0.00 avg300=0.00 total=0\n",
+            "io.pressure":
+                "some avg10=0.00 avg60=0.00 avg300=0.00 total=0\n"
+                "full avg10=0.00 avg60=0.00 avg300=0.00 total=0\n",
+        }
+        base_defaults.update(defaults or {})
+        for name, value in base_defaults.items():
+            res = RESOURCES.get(name)
+            if res is None or not res.supported(self.cgroup_version):
+                continue
+            self.write(self.cgroup_file(cgroup_dir, name), value)
+
+    def set_cgroup_cpu_ns(self, cgroup_dir: str, total_ns: int) -> None:
+        if self.cgroup_version is CgroupVersion.V1:
+            self.write(self.cgroup_file(cgroup_dir, "cpuacct.usage"),
+                       str(total_ns))
+        else:
+            self.write(self.cgroup_file(cgroup_dir, "cpu.stat"),
+                       f"usage_usec {total_ns // 1000}\n")
+
+    def set_cgroup_memory(self, cgroup_dir: str, usage_bytes: int,
+                          inactive_file: int = 0) -> None:
+        self.write(self.cgroup_file(cgroup_dir, "memory.usage_in_bytes"),
+                   str(usage_bytes))
+        self.write(self.cgroup_file(cgroup_dir, "memory.stat"),
+                   f"total_inactive_file {inactive_file}\n"
+                   f"inactive_file {inactive_file}\n")
+
+    def set_psi(self, cgroup_dir: str, resource: str, some_avg10: float,
+                full_avg10: float = 0.0) -> None:
+        self.write(self.cgroup_file(cgroup_dir, f"{resource}.pressure"),
+                   f"some avg10={some_avg10:.2f} avg60=0.00 avg300=0.00 total=0\n"
+                   f"full avg10={full_avg10:.2f} avg60=0.00 avg300=0.00 total=0\n")
+
+    # --- resctrl --------------------------------------------------------
+    def init_resctrl(self, l3_mask: str = "fff", mb_percent: int = 100,
+                     num_l3: int = 1) -> None:
+        lines = "".join([
+            f"L3:{';'.join(f'{i}={l3_mask}' for i in range(num_l3))}\n",
+            f"MB:{';'.join(f'{i}={mb_percent}' for i in range(num_l3))}\n"])
+        self.write(os.path.join(self.resctrl_root, "schemata"), lines)
+        self.write(os.path.join(self.resctrl_root, "cbm_mask"), l3_mask)
