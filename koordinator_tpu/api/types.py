@@ -198,7 +198,10 @@ class ResourceThresholdStrategy:
     cpu_suppress_threshold_percent: float = 65.0
     cpu_suppress_policy: str = "cpuset"  # cpuset | cfsQuota
     memory_evict_threshold_percent: float = 70.0
+    memory_evict_lower_percent: float = 0.0  # default threshold-2
     cpu_evict_be_usage_threshold_percent: float = 90.0
+    cpu_evict_satisfaction_lower_percent: float = 0.0  # 0 = evict disabled
+    cpu_evict_satisfaction_upper_percent: float = 40.0
     cpu_evict_time_window_seconds: float = 60.0
 
 

@@ -1,0 +1,120 @@
+"""The koordlet daemon: component wiring + tick loop.
+
+Capability parity with `pkg/koordlet/koordlet.go` (construct :70-125, start
+order :127-188): executor → metriccache → statesinformer → metricsadvisor →
+prediction → qosmanager → runtimehooks. One `Daemon.tick(now)` runs a full
+agent cycle — collectors sample, prediction trains, QoS strategies enforce,
+the hook reconciler levels the cgroup tree, and (on the report interval)
+a NodeMetric status is produced for the control plane / snapshot ingest.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from collections import deque
+from typing import Callable, Optional
+
+from koordinator_tpu.api import types as api
+from koordinator_tpu.koordlet import metriccache as mc
+from koordinator_tpu.koordlet.audit import Auditor, NULL_AUDITOR
+from koordinator_tpu.koordlet.metricsadvisor import Advisor, default_advisor
+from koordinator_tpu.koordlet.pleg import Pleg
+from koordinator_tpu.koordlet.prediction import PeakPredictServer, PredictConfig
+from koordinator_tpu.koordlet.qosmanager import (
+    QoSManager,
+    RecordingEvictor,
+    default_qos_manager,
+)
+from koordinator_tpu.koordlet.resourceexecutor import Executor
+from koordinator_tpu.koordlet.runtimehooks import (
+    HookServer,
+    Reconciler,
+    default_hook_server,
+)
+from koordinator_tpu.koordlet.statesinformer import (
+    CollectPolicy,
+    NodeMetricReporter,
+    StatesInformer,
+)
+from koordinator_tpu.koordlet.system import Host
+
+
+@dataclasses.dataclass
+class DaemonConfig:
+    collect_interval_seconds: float = 1.0
+    qos_interval_seconds: float = 10.0
+    report_interval_seconds: float = 60.0
+    predict_train_interval_seconds: float = 60.0
+    checkpoint_path: str = ""
+
+
+class Daemon:
+    """agent.Daemon (koordlet.go:56-58)."""
+
+    def __init__(self, host: Host, cfg: Optional[DaemonConfig] = None,
+                 auditor: Auditor = NULL_AUDITOR,
+                 perf_reader: Optional[Callable] = None):
+        self.host = host
+        self.cfg = cfg or DaemonConfig()
+        cfg = self.cfg
+        self.auditor = auditor
+        self.executor = Executor(host, auditor)
+        self.metric_cache = mc.MetricCache()
+        self.informer = StatesInformer()
+        self.advisor: Advisor = default_advisor(
+            host, self.metric_cache, self.informer, perf_reader)
+        self.predictor = PeakPredictServer(
+            self.informer, self.metric_cache,
+            PredictConfig(checkpoint_path=cfg.checkpoint_path))
+        self.predictor.restore()
+        self.evictor = RecordingEvictor()
+        self.qos: QoSManager = default_qos_manager(
+            self.informer, self.metric_cache, self.executor, self.evictor,
+            auditor)
+        self.hook_server: HookServer = default_hook_server(self.informer)
+        self.reconciler = Reconciler(self.informer, self.hook_server,
+                                     self.executor)
+        self.pleg = Pleg.for_host(host, use_inotify=False)
+        self.pleg.subscribe(lambda ev: self.reconciler.reconcile_all())
+        self.reporter = NodeMetricReporter(
+            self.informer, self.metric_cache,
+            CollectPolicy(report_interval_seconds=cfg.report_interval_seconds),
+            predictor=self.predictor)
+        self._last_qos = 0.0
+        self._last_train = 0.0
+        self._last_report = 0.0
+        # bounded: the edge layer consumes reports; keep a short history
+        # so a slow consumer never leaks memory in the long-running agent
+        self.reports: "deque[api.NodeMetric]" = deque(maxlen=16)
+
+    def tick(self, now: Optional[float] = None) -> Optional[api.NodeMetric]:
+        """One agent cycle; returns a NodeMetric when the report interval
+        elapsed."""
+        now = time.time() if now is None else now
+        self.advisor.collect_once(now)
+        self.pleg.poll_once()
+        report = None
+        if now - self._last_train >= self.cfg.predict_train_interval_seconds:
+            self.predictor.train_once(now)
+            self.predictor.gc(
+                [m.pod.meta.uid for m in self.informer.get_all_pods()])
+            self._last_train = now
+        if now - self._last_qos >= self.cfg.qos_interval_seconds:
+            self.qos.reconcile_all(now)
+            self.reconciler.reconcile_all()
+            self._last_qos = now
+        if now - self._last_report >= self.cfg.report_interval_seconds:
+            report = self.reporter.collect(now)
+            if report is not None:
+                self.reports.append(report)
+            self._last_report = now
+            if self.cfg.checkpoint_path:
+                self.predictor.checkpoint()
+        return report
+
+    def run(self, stop: Callable[[], bool],
+            sleep: Callable[[float], None] = time.sleep) -> None:
+        while not stop():
+            self.tick()
+            sleep(self.cfg.collect_interval_seconds)

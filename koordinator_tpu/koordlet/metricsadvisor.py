@@ -19,7 +19,7 @@ import time
 from typing import Callable, Dict, List, Optional, Protocol, Tuple
 
 from koordinator_tpu.koordlet import metriccache as mc
-from koordinator_tpu.koordlet.statesinformer import StatesInformer, be_pods
+from koordinator_tpu.koordlet.statesinformer import StatesInformer
 from koordinator_tpu.koordlet.system import Host
 
 _NS = 1e9

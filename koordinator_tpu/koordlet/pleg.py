@@ -1,0 +1,214 @@
+"""pleg: pod lifecycle event generator from cgroup directory watches.
+
+Capability parity with `pkg/koordlet/pleg/` (SURVEY.md 2.2): sub-second
+pod/container arrival signal for the runtimehooks reconciler, produced by
+watching the kubepods cgroup tree for directory create/remove
+(pleg.go:81-148, inotify in watcher_linux.go).
+
+Native path: inotify through ctypes against libc (IN_CREATE|IN_DELETE on
+the QoS-tier dirs) — the same kernel facility the reference binds via
+fsnotify. Fallback (non-Linux / fake hosts without inotify coverage of
+test tmpfs): an mtime/dirset polling scanner with identical event output,
+so consumers are agnostic.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import ctypes.util
+import dataclasses
+import enum
+import errno
+import os
+import re
+import select
+import struct
+import threading
+from typing import Callable, Dict, List, Optional, Set
+
+IN_CREATE = 0x00000100
+IN_DELETE = 0x00000200
+IN_ISDIR = 0x40000000
+_EVENT_FMT = "iIII"
+_EVENT_SIZE = struct.calcsize(_EVENT_FMT)
+
+
+class EventType(enum.Enum):
+    POD_ADDED = "pod_added"
+    POD_DELETED = "pod_deleted"
+    CONTAINER_ADDED = "container_added"
+    CONTAINER_DELETED = "container_deleted"
+
+
+@dataclasses.dataclass(frozen=True)
+class Event:
+    type: EventType
+    cgroup_dir: str       # relative dir under the cgroup root
+    pod_uid: str = ""
+
+
+_POD_DIR = re.compile(r"pod([0-9a-f-]+)$")
+
+
+def classify(parent_rel: str, name: str,
+             created: bool) -> Optional[Event]:
+    """Map a directory create/delete under kubepods to a PLEG event."""
+    rel = f"{parent_rel}/{name}" if parent_rel else name
+    m = _POD_DIR.search(name)
+    if m:
+        t = EventType.POD_ADDED if created else EventType.POD_DELETED
+        return Event(t, rel, m.group(1))
+    pm = _POD_DIR.search(parent_rel)
+    if pm:
+        t = (EventType.CONTAINER_ADDED if created
+             else EventType.CONTAINER_DELETED)
+        return Event(t, rel, pm.group(1))
+    return None
+
+
+class PollingWatcher:
+    """Dirset-diff scanner with the same event semantics."""
+
+    def __init__(self, root: str, watch_dirs: List[str]):
+        self.root = root
+        self.watch_dirs = watch_dirs
+        self._seen: Dict[str, Set[str]] = {}
+        self.prime()
+
+    def _list(self, rel: str) -> Set[str]:
+        p = os.path.join(self.root, rel)
+        try:
+            return {d for d in os.listdir(p)
+                    if os.path.isdir(os.path.join(p, d))}
+        except FileNotFoundError:
+            return set()
+
+    def prime(self) -> None:
+        self._seen = {rel: self._list(rel) for rel in self._watched()}
+
+    def _watched(self) -> List[str]:
+        # watch the tier dirs plus every known pod dir (for containers)
+        out = list(self.watch_dirs)
+        for rel in self.watch_dirs:
+            for d in self._list(rel):
+                if _POD_DIR.search(d):
+                    out.append(f"{rel}/{d}")
+        return out
+
+    def poll(self) -> List[Event]:
+        events: List[Event] = []
+        for rel in self._watched():
+            now = self._list(rel)
+            before = self._seen.get(rel, set())
+            for name in sorted(now - before):
+                ev = classify(rel, name, created=True)
+                if ev:
+                    events.append(ev)
+            for name in sorted(before - now):
+                ev = classify(rel, name, created=False)
+                if ev:
+                    events.append(ev)
+            self._seen[rel] = now
+        return events
+
+
+class InotifyWatcher:
+    """ctypes libc inotify watcher (watcher_linux.go equivalent)."""
+
+    def __init__(self, root: str, watch_dirs: List[str]):
+        libc_name = ctypes.util.find_library("c")
+        if not libc_name:
+            raise OSError("libc not found")
+        self._libc = ctypes.CDLL(libc_name, use_errno=True)
+        self._fd = self._libc.inotify_init1(os.O_NONBLOCK)
+        if self._fd < 0:
+            raise OSError(ctypes.get_errno(), "inotify_init1")
+        self.root = root
+        self._wd_to_rel: Dict[int, str] = {}
+        for rel in watch_dirs:
+            self.add_watch(rel)
+
+    def add_watch(self, rel: str) -> None:
+        path = os.path.join(self.root, rel).encode()
+        wd = self._libc.inotify_add_watch(
+            self._fd, path, IN_CREATE | IN_DELETE)
+        if wd >= 0:
+            self._wd_to_rel[wd] = rel
+
+    def poll(self, timeout: float = 0.0) -> List[Event]:
+        r, _, _ = select.select([self._fd], [], [], timeout)
+        if not r:
+            return []
+        try:
+            data = os.read(self._fd, 64 * 1024)
+        except OSError as e:
+            if e.errno == errno.EAGAIN:
+                return []
+            raise
+        events: List[Event] = []
+        off = 0
+        while off + _EVENT_SIZE <= len(data):
+            wd, mask, _cookie, length = struct.unpack_from(_EVENT_FMT, data,
+                                                           off)
+            name = data[off + _EVENT_SIZE: off + _EVENT_SIZE + length]
+            name = name.split(b"\0", 1)[0].decode()
+            off += _EVENT_SIZE + length
+            rel = self._wd_to_rel.get(wd)
+            if rel is None or not (mask & IN_ISDIR):
+                continue
+            created = bool(mask & IN_CREATE)
+            ev = classify(rel, name, created)
+            if ev:
+                events.append(ev)
+                # recursively watch new pod dirs for container arrival
+                if created and ev.type is EventType.POD_ADDED:
+                    self.add_watch(ev.cgroup_dir)
+        return events
+
+    def close(self) -> None:
+        os.close(self._fd)
+
+
+class Pleg:
+    """Drives a watcher and fans events out to handlers (pleg.go)."""
+
+    DEFAULT_WATCH = ["kubepods", "kubepods/burstable", "kubepods/besteffort"]
+
+    @classmethod
+    def for_host(cls, host, use_inotify: bool = True) -> "Pleg":
+        """Watch the kubepods tree of a system.Host: the v1 'cpu' subsystem
+        mount (the reference watches the cpu hierarchy) or the v2 unified
+        mount."""
+        from koordinator_tpu.koordlet.system import CgroupVersion
+        root = host.cgroup_root
+        if host.cgroup_version is CgroupVersion.V1:
+            root = os.path.join(root, "cpu")
+        return cls(root, use_inotify=use_inotify)
+
+    def __init__(self, cgroup_root: str,
+                 use_inotify: bool = True,
+                 watch_dirs: Optional[List[str]] = None):
+        dirs = watch_dirs or self.DEFAULT_WATCH
+        self.watcher = None
+        if use_inotify:
+            try:
+                self.watcher = InotifyWatcher(cgroup_root, dirs)
+            except OSError:
+                self.watcher = None
+        if self.watcher is None:
+            self.watcher = PollingWatcher(cgroup_root, dirs)
+        self._handlers: List[Callable[[Event], None]] = []
+        self._lock = threading.Lock()
+
+    def subscribe(self, handler: Callable[[Event], None]) -> None:
+        with self._lock:
+            self._handlers.append(handler)
+
+    def poll_once(self) -> List[Event]:
+        events = self.watcher.poll()
+        with self._lock:
+            handlers = list(self._handlers)
+        for ev in events:
+            for h in handlers:
+                h(ev)
+        return events

@@ -1,0 +1,247 @@
+"""prediction: decayed-histogram peak estimation of pod/priority usage.
+
+Capability parity with `pkg/koordlet/prediction/` (SURVEY.md 2.2):
+- VPA-style exponential-bucket histograms with half-life time decay
+  (util/histogram; CPU 12h / memory 24h half-lives, config.go:28-42),
+- per-pod and per-priority-class models updated from the metric cache,
+- `PeakPredictServer.prediction(uid)` -> p60/p90/p95/p98/max,
+- `prod_reclaimable()`: Σ over prod pods of
+  max(0, request − peak·(1+safetyMargin)) with cold-start filtering
+  (peak_predictor.go podReclaimablePredictor: CPU peak = p95, memory
+  peak = p98), feeding NodeMetric.prodReclaimable → the Mid tier,
+- disk checkpoint/restore (checkpoint.go).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import math
+import os
+import time
+from typing import Dict, List, Optional
+
+from koordinator_tpu.api.extension import PriorityClass, ResourceKind
+from koordinator_tpu.koordlet import metriccache as mc
+from koordinator_tpu.koordlet.statesinformer import PodMeta, StatesInformer
+
+_BYTES_PER_MIB = float(1 << 20)
+
+
+class DecayedHistogram:
+    """Exponential-bucket histogram with exponential time decay.
+
+    Buckets: value v -> bucket floor(log(v/first)/log(ratio)); weights
+    decay by 0.5 every `half_life_seconds` (decayed weight is applied
+    lazily via a running reference time, the VPA trick: store weights
+    scaled by 2^(t/half_life) and renormalize on overflow).
+    """
+
+    def __init__(self, first_bucket: float, ratio: float = 1.05,
+                 num_buckets: int = 200,
+                 half_life_seconds: float = 12 * 3600.0):
+        self.first = first_bucket
+        self.ratio = ratio
+        self.n = num_buckets
+        self.half_life = half_life_seconds
+        self.weights = [0.0] * num_buckets
+        self.total = 0.0
+        # reference time for lazy decay; anchored to the FIRST sample's
+        # timestamp (a fixed epoch would overflow 2**(t/half_life) for
+        # wall-clock ts)
+        self._ref_ts: Optional[float] = None
+
+    def _bucket(self, value: float) -> int:
+        if value <= self.first:
+            return 0
+        b = int(math.log(value / self.first) / math.log(self.ratio)) + 1
+        return min(b, self.n - 1)
+
+    def _bucket_value(self, b: int) -> float:
+        # upper bound of the bucket (conservative for peaks)
+        return self.first * (self.ratio ** b)
+
+    def _scale(self, ts: float) -> float:
+        # clamp the exponent: past ~40 half-lives old weights are zero
+        # anyway, and an unbounded exponent overflows float64
+        exp = min((ts - self._ref_ts) / self.half_life, 40.0)
+        return 2.0 ** exp
+
+    def add(self, value: float, ts: float, weight: float = 1.0) -> None:
+        if self._ref_ts is None:
+            self._ref_ts = ts
+        w = weight * self._scale(ts)
+        if w > 1e12:  # renormalize to keep floats sane
+            inv = 1.0 / self._scale(ts)
+            self.weights = [x * inv for x in self.weights]
+            self.total *= inv
+            self._ref_ts = ts
+            w = weight
+        b = self._bucket(value)
+        self.weights[b] += w
+        self.total += w
+
+    def percentile(self, q: float) -> float:
+        """q in [0,1]; 0 when empty."""
+        if self.total <= 0:
+            return 0.0
+        target = q * self.total
+        acc = 0.0
+        for b, w in enumerate(self.weights):
+            acc += w
+            if acc >= target - 1e-12:
+                return self._bucket_value(b)
+        return self._bucket_value(self.n - 1)
+
+    def to_dict(self) -> dict:
+        return {"first": self.first, "ratio": self.ratio, "n": self.n,
+                "half_life": self.half_life, "weights": self.weights,
+                "total": self.total, "ref_ts": self._ref_ts}
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "DecayedHistogram":
+        h = cls(d["first"], d["ratio"], d["n"], d["half_life"])
+        h.weights = list(d["weights"])
+        h.total = d["total"]
+        h._ref_ts = d["ref_ts"]
+        return h
+
+
+@dataclasses.dataclass
+class PredictConfig:
+    safety_margin_percent: float = 10.0
+    cold_start_seconds: float = 3600.0
+    cpu_half_life_seconds: float = 12 * 3600.0
+    memory_half_life_seconds: float = 24 * 3600.0
+    checkpoint_path: str = ""
+
+
+class _Model:
+    def __init__(self, cfg: PredictConfig):
+        # first buckets: 10 millicores / 10 MiB
+        self.cpu = DecayedHistogram(0.01, half_life_seconds=cfg.cpu_half_life_seconds)
+        self.memory = DecayedHistogram(10 * _BYTES_PER_MIB,
+                                       half_life_seconds=cfg.memory_half_life_seconds)
+
+
+class PeakPredictServer:
+    """Per-UID decayed histograms trained from the metric cache
+    (predict_server.go:45-61)."""
+
+    def __init__(self, informer: StatesInformer, cache: mc.MetricCache,
+                 cfg: Optional[PredictConfig] = None):
+        self.informer = informer
+        self.cache = cache
+        self.cfg = cfg or PredictConfig()
+        self.models: Dict[str, _Model] = {}
+        self.pod_start: Dict[str, float] = {}
+
+    def _model(self, uid: str) -> _Model:
+        m = self.models.get(uid)
+        if m is None:
+            m = self.models[uid] = _Model(self.cfg)
+        return m
+
+    def train_once(self, now: Optional[float] = None) -> None:
+        """Sample current pod usages into per-pod AND per-priority models
+        (the reference trains on the update interval)."""
+        now = time.time() if now is None else now
+        for meta in self.informer.get_all_pods():
+            uid = meta.pod.meta.uid
+            self.pod_start.setdefault(uid, now)
+            labels = {"pod_uid": uid}
+            cpu = self.cache.query(mc.POD_CPU_USAGE, now - 60, now, labels,
+                                   "latest")
+            mem = self.cache.query(mc.POD_MEMORY_USAGE, now - 60, now,
+                                   labels, "latest")
+            prio = f"priority/{meta.pod.priority_class.name}"
+            if cpu is not None:
+                self._model(uid).cpu.add(cpu, now)
+                self._model(prio).cpu.add(cpu, now)
+            if mem is not None:
+                self._model(uid).memory.add(mem, now)
+                self._model(prio).memory.add(mem, now)
+
+    def prediction(self, uid: str) -> Optional[Dict[str, Dict[str, float]]]:
+        """p60/p90/p95/p98/max -> {cpu: cores, memory: bytes}
+        (GetPrediction, predict_server.go)."""
+        m = self.models.get(uid)
+        if m is None:
+            return None
+        out = {}
+        for name, q in (("p60", 0.6), ("p90", 0.9), ("p95", 0.95),
+                        ("p98", 0.98), ("max", 1.0)):
+            out[name] = {"cpu": m.cpu.percentile(q),
+                         "memory": m.memory.percentile(q)}
+        return out
+
+    def prod_reclaimable(self, now: Optional[float] = None) -> dict:
+        """Σ max(0, request − peak·(1+margin)) over prod pods past cold
+        start (peak_predictor.go AddPod/GetResult). Returns a ResourceList
+        in canonical units (millicores / MiB)."""
+        now = time.time() if now is None else now
+        margin = (100.0 + self.cfg.safety_margin_percent) / 100.0
+        cpu_milli = 0.0
+        mem_mib = 0.0
+        for meta in self.informer.get_all_pods():
+            pod = meta.pod
+            if pod.priority_class != PriorityClass.PROD:
+                continue
+            uid = pod.meta.uid
+            start = self.pod_start.get(uid)
+            if start is None or now - start <= self.cfg.cold_start_seconds:
+                continue
+            pred = self.prediction(uid)
+            if pred is None:
+                continue
+            peak_cpu_milli = pred["p95"]["cpu"] * 1000.0 * margin
+            peak_mem_mib = pred["p98"]["memory"] / _BYTES_PER_MIB * margin
+            cpu_milli += max(0.0, pod.requests.get(ResourceKind.CPU, 0.0)
+                             - peak_cpu_milli)
+            mem_mib += max(0.0, pod.requests.get(ResourceKind.MEMORY, 0.0)
+                           - peak_mem_mib)
+        if cpu_milli <= 0 and mem_mib <= 0:
+            return {}
+        return {ResourceKind.CPU: cpu_milli, ResourceKind.MEMORY: mem_mib}
+
+    # --- checkpoint (checkpoint.go) ------------------------------------
+    def checkpoint(self, path: Optional[str] = None) -> None:
+        path = path or self.cfg.checkpoint_path
+        if not path:
+            return
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        data = {
+            "pod_start": self.pod_start,
+            "models": {uid: {"cpu": m.cpu.to_dict(),
+                             "memory": m.memory.to_dict()}
+                       for uid, m in self.models.items()},
+        }
+        tmp = path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(data, f)
+        os.replace(tmp, path)
+
+    def restore(self, path: Optional[str] = None) -> bool:
+        path = path or self.cfg.checkpoint_path
+        if not path or not os.path.exists(path):
+            return False
+        with open(path, "r", encoding="utf-8") as f:
+            data = json.load(f)
+        self.pod_start = dict(data.get("pod_start", {}))
+        self.models = {}
+        for uid, d in data.get("models", {}).items():
+            m = _Model(self.cfg)
+            m.cpu = DecayedHistogram.from_dict(d["cpu"])
+            m.memory = DecayedHistogram.from_dict(d["memory"])
+            self.models[uid] = m
+        return True
+
+    def gc(self, live_uids: List[str]) -> None:
+        """Drop models of departed pods (predict_server GC loop)."""
+        live = set(live_uids)
+        for uid in list(self.models):
+            if uid.startswith("priority/"):
+                continue
+            if uid not in live:
+                del self.models[uid]
+                self.pod_start.pop(uid, None)

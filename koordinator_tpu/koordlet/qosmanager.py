@@ -1,0 +1,527 @@
+"""qosmanager: the node-side QoS enforcement strategies.
+
+Capability parity with `pkg/koordlet/qosmanager/` (SURVEY.md 2.2, 3.3):
+- **CPUSuppress** — shrink the BE tier so
+  `BE <= node.Capacity * SLOPercent - (nonBE pod used) - system used`
+  (cpu_suppress.go:137-160), applied either as a cpuset (cores picked
+  NUMA-packed, avoiding LSE/LSR cores — calculateBESuppressCPUSetPolicy
+  cpu_suppress.go:653) or as a cfs quota on the BE root cgroup.
+- **CPUBurst** — grant cfs burst to LS pods and scale throttled containers'
+  cfs quota by node share-pool state (cpu_burst.go: idle/cooling/overload,
+  1.2x increase steps).
+- **CPUEvict** — evict BE pods when BE cpu satisfaction
+  (realLimit/request) stays under threshold (be satisfaction eviction).
+- **MemoryEvict** — evict BE pods when node memory utilization exceeds
+  threshold, until the release target is met.
+- **ResctrlReconcile** — LLC/MBA schemata per QoS tier (resctrl groups).
+- **CgroupReconcile** — memory protections (min/low/high) per QoS tier.
+
+Every strategy is a pure-ish `reconcile(now)` over (statesinformer,
+metriccache) that emits writes through the resourceexecutor — the test
+fixture asserts resulting fake-FS file contents, reference-style.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from koordinator_tpu.api import types as api
+from koordinator_tpu.api.extension import QoSClass, ResourceKind
+from koordinator_tpu.koordlet import metriccache as mc
+from koordinator_tpu.koordlet.audit import Auditor, NULL_AUDITOR
+from koordinator_tpu.koordlet.resourceexecutor import CgroupUpdate, Executor
+from koordinator_tpu.koordlet.statesinformer import (
+    PodMeta,
+    StatesInformer,
+    be_pods,
+)
+from koordinator_tpu.koordlet.system import (
+    ProcessorInfo,
+    format_cpuset,
+    parse_cpuset,
+)
+
+BE_ROOT = "kubepods/besteffort"
+CFS_PERIOD_US = 100000
+MIN_SUPPRESS_CORES = 1  # beMinCPU floor: never suppress BE below one core
+
+
+# --- eviction boundary ------------------------------------------------------
+
+Evictor = Callable[[PodMeta, str], None]  # (pod, reason)
+
+
+class RecordingEvictor:
+    """Default evictor: records requests; the edge layer drains them to
+    the control plane (helpers/evictor in the reference calls the API
+    server eviction subresource). Deduped by pod uid so a persisting
+    condition doesn't grow the queue every reconcile."""
+
+    def __init__(self) -> None:
+        self.evicted: List[Tuple[PodMeta, str]] = []
+        self._pending: set = set()
+
+    def __call__(self, pod: PodMeta, reason: str) -> None:
+        uid = pod.pod.meta.uid
+        if uid in self._pending:
+            return
+        self._pending.add(uid)
+        self.evicted.append((pod, reason))
+
+    def drain(self) -> List[Tuple[PodMeta, str]]:
+        out, self.evicted = self.evicted, []
+        self._pending.clear()
+        return out
+
+
+def sort_be_pods_for_eviction(pods: Sequence[PodMeta],
+                              usage: Dict[str, float]) -> List[PodMeta]:
+    """Eviction order: lower priority first, then higher usage first
+    (helpers/common evictor sort)."""
+    return sorted(pods, key=lambda p: (
+        p.pod.priority if p.pod.priority is not None else 0,
+        -usage.get(p.pod.meta.uid, 0.0)))
+
+
+# --- CPUSuppress ------------------------------------------------------------
+
+def suppress_cpuset_policy(need_cpus: int,
+                           processors: Sequence[ProcessorInfo],
+                           exclude: Sequence[int] = ()) -> List[int]:
+    """Pick `need_cpus` logical cpus for the BE cpuset: prefer filling
+    whole physical cores, packed within (numa node, socket) buckets, and
+    never the `exclude` (LSE/LSR-pinned) cpus
+    (calculateBESuppressCPUSetPolicy, cpu_suppress.go:653)."""
+    avail = [p for p in processors if p.cpu_id not in set(exclude)]
+    if need_cpus <= 0 or not avail:
+        return []
+    # cap at what is grantable: when LSE/LSR pins leave fewer cpus than
+    # requested, suppress BE onto ALL remaining cpus rather than skipping
+    # the update (skipping would leave BE on the pinned cores)
+    need_cpus = min(need_cpus, len(avail))
+    buckets: Dict[Tuple[int, int], List[ProcessorInfo]] = {}
+    for p in avail:
+        buckets.setdefault((p.node_id, p.socket_id), []).append(p)
+    ordered = sorted(buckets.values(),
+                     key=lambda b: (-len(b), min(x.cpu_id for x in b)))
+    for b in ordered:
+        b.sort(key=lambda x: (x.core_id, x.cpu_id))
+    out: List[int] = []
+    for b in ordered:
+        for p in b:
+            out.append(p.cpu_id)
+            if len(out) >= need_cpus:
+                return sorted(out)
+    return sorted(out)
+
+
+@dataclasses.dataclass
+class CPUSuppressConfig:
+    policy: str = "cpuset"          # "cpuset" | "cfsQuota"
+    window_seconds: float = 60.0
+
+
+class CPUSuppress:
+    """suppressBECPU (cpu_suppress.go:240-298)."""
+
+    name = "cpusuppress"
+
+    def __init__(self, informer: StatesInformer, cache: mc.MetricCache,
+                 executor: Executor,
+                 cfg: Optional[CPUSuppressConfig] = None,
+                 auditor: Auditor = NULL_AUDITOR):
+        self.informer = informer
+        self.cache = cache
+        self.executor = executor
+        self.cfg = cfg or CPUSuppressConfig()
+        self.auditor = auditor
+
+    def _suppress_cores(self, now: float) -> Optional[float]:
+        node = self.informer.get_node()
+        slo = self.informer.get_node_slo()
+        if node is None or slo is None or not slo.threshold.enable:
+            return None
+        threshold = slo.threshold.cpu_suppress_threshold_percent
+        win = self.cfg.window_seconds
+        node_used = self.cache.query(mc.NODE_CPU_USAGE, now - win, now)
+        if node_used is None:
+            return None
+        be_used = self.cache.query(mc.BE_CPU_USAGE, now - win, now) or 0.0
+        sys_used = self.cache.query(mc.SYS_CPU_USAGE, now - win, now) or 0.0
+        capacity = node.allocatable.get(ResourceKind.CPU, 0.0) / 1000.0
+        # suppress(BE) := capacity*SLO% - pod(nonBE).Used - system.Used
+        non_be_pod_used = max(0.0, node_used - be_used - sys_used)
+        suppress = capacity * threshold / 100.0 - non_be_pod_used - sys_used
+        return max(float(MIN_SUPPRESS_CORES), suppress)
+
+    def _lse_lsr_cpus(self) -> List[int]:
+        """CPUs pinned by LSE/LSR pods (read from their cpuset files)."""
+        out: List[int] = []
+        for meta in self.informer.get_all_pods():
+            if meta.pod.qos in (QoSClass.LSE, QoSClass.LSR):
+                cpus = self.executor.try_read(meta.cgroup_dir, "cpuset.cpus")
+                if cpus:
+                    out.extend(parse_cpuset(cpus))
+        return sorted(set(out))
+
+    def reconcile(self, now: float) -> None:
+        suppress = self._suppress_cores(now)
+        if suppress is None:
+            return
+        host = self.executor.host
+        if self.cfg.policy == "cfsQuota":
+            quota = int(suppress * CFS_PERIOD_US)
+            self.executor.update_batch([
+                CgroupUpdate(BE_ROOT, "cpu.cfs_period_us", str(CFS_PERIOD_US)),
+                CgroupUpdate(BE_ROOT, "cpu.cfs_quota_us", str(quota)),
+            ])
+        else:
+            n = max(MIN_SUPPRESS_CORES, int(math.floor(suppress)))
+            cpus = suppress_cpuset_policy(n, host.cpu_topology(),
+                                          exclude=self._lse_lsr_cpus())
+            if not cpus:
+                return
+            # leveled: BE root first (merge pass keeps parents superset),
+            # then every BE pod cgroup
+            ups = [CgroupUpdate(BE_ROOT, "cpuset.cpus", format_cpuset(cpus))]
+            for meta in be_pods(self.informer.get_all_pods()):
+                ups.append(CgroupUpdate(meta.cgroup_dir, "cpuset.cpus",
+                                        format_cpuset(cpus)))
+            self.executor.leveled_update_batch(ups)
+        self.auditor.info(self.name, "suppress", BE_ROOT,
+                          f"cores={suppress:.2f} policy={self.cfg.policy}")
+
+
+# --- CPUBurst ---------------------------------------------------------------
+
+CFS_INCREASE_STEP = 1.2  # cpu_burst.go:49
+SHARE_POOL_COOLING_RATIO = 0.9
+
+NODE_IDLE, NODE_COOLING, NODE_OVERLOAD = "idle", "cooling", "overload"
+
+
+class CPUBurst:
+    """cfs burst + throttled-quota scaling (cpu_burst.go)."""
+
+    name = "cpuburst"
+
+    def __init__(self, informer: StatesInformer, cache: mc.MetricCache,
+                 executor: Executor, auditor: Auditor = NULL_AUDITOR):
+        self.informer = informer
+        self.cache = cache
+        self.executor = executor
+        self.auditor = auditor
+
+    def node_state(self, now: float, threshold_percent: float) -> str:
+        """Share-pool usage vs threshold (getNodeStateForBurst)."""
+        node = self.informer.get_node()
+        if node is None:
+            return NODE_OVERLOAD
+        total = node.allocatable.get(ResourceKind.CPU, 0.0) / 1000.0
+        used = self.cache.query(mc.NODE_CPU_USAGE, now - 60, now)
+        if used is None or total <= 0:
+            return NODE_OVERLOAD
+        ratio = used / total
+        thresh = threshold_percent / 100.0
+        if ratio >= thresh:
+            return NODE_OVERLOAD
+        if ratio >= thresh * SHARE_POOL_COOLING_RATIO:
+            return NODE_COOLING
+        return NODE_IDLE
+
+    def reconcile(self, now: float) -> None:
+        slo = self.informer.get_node_slo()
+        if slo is None or slo.cpu_burst.policy == "none":
+            return
+        policy = slo.cpu_burst.policy
+        burst_pct = slo.cpu_burst.cpu_burst_percent
+        state = self.node_state(now, slo.cpu_burst.share_pool_threshold_percent)
+        for meta in self.informer.get_all_pods():
+            pod = meta.pod
+            if pod.qos not in (QoSClass.LS, QoSClass.NONE):
+                continue
+            limit_milli = pod.limits.get(ResourceKind.CPU, 0.0)
+            if limit_milli <= 0:
+                continue
+            # cfs burst: limit * burstPercent (cpuBurstOnly | auto)
+            if policy in ("cpuBurstOnly", "auto"):
+                burst_us = int(limit_milli / 1000.0 * burst_pct / 100.0
+                               * CFS_PERIOD_US)
+                self.executor.update(
+                    CgroupUpdate(meta.cgroup_dir, "cpu.cfs_burst_us",
+                                 str(burst_us)))
+            if policy not in ("cfsQuotaBurstOnly", "auto"):
+                continue
+            # throttled-quota scaling, bounded by cfsQuotaBurstPercent
+            cur = self.executor.try_read(meta.cgroup_dir, "cpu.cfs_quota_us")
+            if cur is None:
+                continue
+            base_quota = int(limit_milli / 1000.0 * CFS_PERIOD_US)
+            max_quota = int(base_quota
+                            * slo.cpu_burst.cfs_quota_burst_percent / 100.0)
+            quota = int(cur)
+            throttled = self._throttled(meta, now)
+            new_quota = quota
+            if state == NODE_IDLE and throttled:
+                new_quota = min(max_quota,
+                                int(max(quota, base_quota) * CFS_INCREASE_STEP))
+            elif state == NODE_OVERLOAD and quota > base_quota:
+                new_quota = base_quota
+            if new_quota != quota:
+                self.executor.update(
+                    CgroupUpdate(meta.cgroup_dir, "cpu.cfs_quota_us",
+                                 str(new_quota)), cacheable=False)
+                self.auditor.info(self.name, "scale_quota", meta.cgroup_dir,
+                                  f"{quota}->{new_quota} state={state}")
+
+    def _throttled(self, meta: PodMeta, now: float) -> bool:
+        v = self.cache.query(mc.PSI_CPU_SOME_AVG10, now - 60, now,
+                             {"cgroup": meta.cgroup_dir}, "latest")
+        return bool(v and v > 0.0)
+
+
+# --- CPUEvict ---------------------------------------------------------------
+
+@dataclasses.dataclass
+class CPUEvictConfig:
+    window_seconds: float = 300.0
+    # evict when beUsage/beLimit over this AND satisfaction under threshold
+    be_usage_threshold_percent: float = 90.0
+
+
+class CPUEvict:
+    """BE satisfaction eviction (cpuevict plugin): when the suppressed BE
+    limit starves BE pods (satisfaction = limit/request < threshold) while
+    BE usage presses the limit, evict lowest-priority BE pods until the
+    release target (request*(threshold-satisfaction)) is met."""
+
+    name = "cpuevict"
+
+    def __init__(self, informer: StatesInformer, cache: mc.MetricCache,
+                 executor: Executor, evictor: Evictor,
+                 cfg: Optional[CPUEvictConfig] = None,
+                 auditor: Auditor = NULL_AUDITOR):
+        self.informer = informer
+        self.cache = cache
+        self.executor = executor
+        self.evictor = evictor
+        self.cfg = cfg or CPUEvictConfig()
+        self.auditor = auditor
+
+    def reconcile(self, now: float) -> None:
+        slo = self.informer.get_node_slo()
+        if slo is None or not slo.threshold.enable:
+            return
+        thresh = slo.threshold.cpu_evict_satisfaction_lower_percent
+        if thresh <= 0:
+            return
+        pods = be_pods(self.informer.get_all_pods())
+        be_request_milli = sum(
+            p.pod.requests.get(ResourceKind.BATCH_CPU,
+                               p.pod.requests.get(ResourceKind.CPU, 0.0))
+            for p in pods)
+        if be_request_milli <= 0:
+            return
+        # real BE limit from the suppressed cgroup
+        quota = self.executor.try_read(BE_ROOT, "cpu.cfs_quota_us")
+        cpus = self.executor.try_read(BE_ROOT, "cpuset.cpus")
+        if quota and int(quota) > 0:
+            limit_milli = int(quota) / CFS_PERIOD_US * 1000.0
+        elif cpus:
+            limit_milli = len(parse_cpuset(cpus)) * 1000.0
+        else:
+            return
+        win = self.cfg.window_seconds
+        be_used = self.cache.query(mc.BE_CPU_USAGE, now - win, now)
+        if be_used is None:
+            return
+        satisfaction = limit_milli / be_request_milli
+        usage_ratio = be_used * 1000.0 / max(limit_milli, 1e-9)
+        usage_thresh = slo.threshold.cpu_evict_be_usage_threshold_percent \
+            or self.cfg.be_usage_threshold_percent
+        if satisfaction >= thresh / 100.0 or \
+                usage_ratio * 100.0 < usage_thresh:
+            return
+        release_target = be_request_milli * (thresh / 100.0 - satisfaction)
+        usage = {k[0][1]: v * 1000.0 for k, v in
+                 ((tuple(lbl), u) for lbl, u in self.cache.query_all(
+                     mc.POD_CPU_USAGE, now - win, now).items())}
+        released = 0.0
+        for meta in sort_be_pods_for_eviction(pods, usage):
+            if released >= release_target:
+                break
+            self.evictor(meta, "cpu satisfaction below threshold")
+            released += meta.pod.requests.get(
+                ResourceKind.BATCH_CPU,
+                meta.pod.requests.get(ResourceKind.CPU, 0.0))
+            self.auditor.info(self.name, "evict", meta.pod.meta.uid,
+                              f"satisfaction={satisfaction:.2f}")
+
+
+# --- MemoryEvict ------------------------------------------------------------
+
+class MemoryEvict:
+    """memoryevict plugin: node memory util over evictThresholdPercent →
+    evict BE pods (priority asc, usage desc) until util falls to
+    evictLowerPercent."""
+
+    name = "memoryevict"
+
+    def __init__(self, informer: StatesInformer, cache: mc.MetricCache,
+                 evictor: Evictor, auditor: Auditor = NULL_AUDITOR):
+        self.informer = informer
+        self.cache = cache
+        self.evictor = evictor
+        self.auditor = auditor
+
+    def reconcile(self, now: float) -> None:
+        slo = self.informer.get_node_slo()
+        node = self.informer.get_node()
+        if slo is None or node is None or not slo.threshold.enable:
+            return
+        thresh = slo.threshold.memory_evict_threshold_percent
+        if thresh <= 0:
+            return
+        lower = slo.threshold.memory_evict_lower_percent or (thresh - 2.0)
+        total_mib = node.allocatable.get(ResourceKind.MEMORY, 0.0)
+        used_bytes = self.cache.query(mc.NODE_MEMORY_USAGE, now - 60, now,
+                                      agg="latest")
+        if used_bytes is None or total_mib <= 0:
+            return
+        used_mib = used_bytes / (1 << 20)
+        if used_mib / total_mib * 100.0 < thresh:
+            return
+        target_release_mib = used_mib - total_mib * lower / 100.0
+        usage = {dict(lbl)["pod_uid"]: u / (1 << 20) for lbl, u in
+                 self.cache.query_all(mc.POD_MEMORY_USAGE, now - 60, now,
+                                      agg="latest").items()}
+        released = 0.0
+        for meta in sort_be_pods_for_eviction(
+                be_pods(self.informer.get_all_pods()), usage):
+            if released >= target_release_mib:
+                break
+            self.evictor(meta, "node memory usage over threshold")
+            released += usage.get(
+                meta.pod.meta.uid,
+                meta.pod.requests.get(ResourceKind.BATCH_MEMORY,
+                                      meta.pod.requests.get(
+                                          ResourceKind.MEMORY, 0.0)))
+            self.auditor.info(self.name, "evict", meta.pod.meta.uid,
+                              f"memory used={used_mib:.0f}MiB")
+
+
+# --- ResctrlReconcile -------------------------------------------------------
+
+QOS_RESCTRL_GROUPS = {"LSR": QoSClass.LSR, "LS": QoSClass.LS,
+                      "BE": QoSClass.BE}
+
+
+def cat_mask(percent: float, full_mask: str) -> str:
+    """Rightmost ceil(bits*percent/100) contiguous ways of the L3 mask
+    (resctrl "cache ways" semantics; percent-range from NodeSLO)."""
+    bits = bin(int(full_mask, 16)).count("1")
+    take = max(1, math.ceil(bits * percent / 100.0))
+    return format((1 << take) - 1, "x")
+
+
+class ResctrlReconcile:
+    """LLC/MBA schemata per QoS tier (qosmanager resctrl plugin +
+    util/system resctrl.go:38-69)."""
+
+    name = "resctrl"
+
+    def __init__(self, informer: StatesInformer, executor: Executor,
+                 auditor: Auditor = NULL_AUDITOR):
+        self.informer = informer
+        self.executor = executor
+        self.auditor = auditor
+
+    def reconcile(self, now: float) -> None:
+        slo = self.informer.get_node_slo()
+        if slo is None:
+            return
+        tiers = slo.resource_qos.tiers
+        host = self.executor.host
+        try:
+            full_mask = host.read(
+                f"{host.resctrl_root}/cbm_mask").strip()
+        except FileNotFoundError:
+            return
+        for group in QOS_RESCTRL_GROUPS:
+            cfg = tiers.get(group)
+            if not cfg:
+                continue
+            lines: Dict[str, str] = {}
+            if "catRangeEndPercent" in cfg:
+                lines["L3"] = "0=" + cat_mask(cfg["catRangeEndPercent"],
+                                              full_mask)
+            if "mbaPercent" in cfg:
+                lines["MB"] = f"0={int(cfg['mbaPercent'])}"
+            if lines:
+                host.write_resctrl_schemata(group, lines)
+                self.auditor.info(self.name, "schemata", group, str(lines))
+
+
+# --- CgroupReconcile (memory QoS) -------------------------------------------
+
+class CgroupReconcile:
+    """Per-tier memory protections: LS pods get memory.min/low from their
+    requests scaled by the tier config (qosmanager cgreconcile)."""
+
+    name = "cgreconcile"
+
+    def __init__(self, informer: StatesInformer, executor: Executor):
+        self.informer = informer
+        self.executor = executor
+
+    def reconcile(self, now: float) -> None:
+        slo = self.informer.get_node_slo()
+        if slo is None:
+            return
+        tiers = slo.resource_qos.tiers
+        ups: List[CgroupUpdate] = []
+        for meta in self.informer.get_all_pods():
+            cfg = tiers.get(meta.pod.qos.name)
+            if not cfg:
+                continue
+            req_bytes = int(meta.pod.requests.get(ResourceKind.MEMORY, 0.0)
+                            * (1 << 20))
+            if "memoryMinPercent" in cfg:
+                ups.append(CgroupUpdate(
+                    meta.cgroup_dir, "memory.min",
+                    str(int(req_bytes * cfg["memoryMinPercent"] / 100.0))))
+            if "memoryLowPercent" in cfg:
+                ups.append(CgroupUpdate(
+                    meta.cgroup_dir, "memory.low",
+                    str(int(req_bytes * cfg["memoryLowPercent"] / 100.0))))
+        if ups:
+            self.executor.leveled_update_batch(ups)
+
+
+# --- manager ----------------------------------------------------------------
+
+class QoSManager:
+    """Strategy registry + tick driver (qosmanager.go:72,
+    plugins/register.go:32-41)."""
+
+    def __init__(self, strategies: Sequence[object]):
+        self.strategies = list(strategies)
+
+    def reconcile_all(self, now: float) -> None:
+        for s in self.strategies:
+            s.reconcile(now)
+
+
+def default_qos_manager(informer: StatesInformer, cache: mc.MetricCache,
+                        executor: Executor, evictor: Evictor,
+                        auditor: Auditor = NULL_AUDITOR) -> QoSManager:
+    return QoSManager([
+        CPUSuppress(informer, cache, executor, auditor=auditor),
+        CPUBurst(informer, cache, executor, auditor=auditor),
+        CPUEvict(informer, cache, executor, evictor, auditor=auditor),
+        MemoryEvict(informer, cache, evictor, auditor=auditor),
+        ResctrlReconcile(informer, executor, auditor=auditor),
+        CgroupReconcile(informer, executor),
+    ])

@@ -18,7 +18,6 @@ from __future__ import annotations
 import dataclasses
 from typing import Callable, Dict, List, Optional, Sequence
 
-from koordinator_tpu.koordlet import system
 from koordinator_tpu.koordlet.audit import Auditor, NULL_AUDITOR
 from koordinator_tpu.koordlet.system import Host, format_cpuset, parse_cpuset
 

@@ -1,0 +1,245 @@
+"""runtimehooks: QoS injection at container lifecycle.
+
+Capability parity with `pkg/koordlet/runtimehooks/` (SURVEY.md 2.2, 3.4):
+hook plugins mutate a protocol context (cgroup writes + env/device
+injection) at sandbox/container lifecycle stages; delivery is either
+event-driven — the edge layer (NRI/proxy equivalent, edge/service.py)
+calls `run_hooks(stage, ctx)` and applies the returned adjustments — or
+the **reconciler fallback** that level-walks every known pod cgroup and
+re-applies the same rules directly (reconciler/reconciler.go:34-54).
+
+Plugins (hooks/):
+- **groupidentity**: per-QoS `cpu.bvt_warp_ns` (bvt.go),
+- **cpuset**: the scheduler's fine-grained CPU assignment (pod annotation
+  `scheduling.koordinator.sh/resource-status`) -> `cpuset.cpus`,
+- **batchresource**: BE batch-cpu/batch-memory -> cpu.shares/cfs quota/
+  memory limits (batchresource hook),
+- **coresched**: core-scheduling cookies per QoS group through a
+  `CoreSchedIface` (prctl PR_SCHED_CORE in production via the native
+  shim; a fake in tests — core_sched_linux.go:44-78),
+- **gpu**: device env injection (NVIDIA_VISIBLE_DEVICES) from the device
+  allocation annotation.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import json
+from typing import Dict, List, Optional, Protocol
+
+from koordinator_tpu.api.extension import QoSClass, ResourceKind
+from koordinator_tpu.koordlet.resourceexecutor import CgroupUpdate, Executor
+from koordinator_tpu.koordlet.statesinformer import PodMeta, StatesInformer
+
+CFS_PERIOD_US = 100000
+
+ANNOTATION_RESOURCE_STATUS = "scheduling.koordinator.sh/resource-status"
+ANNOTATION_DEVICE_ALLOCATED = "scheduling.koordinator.sh/device-allocated"
+
+
+class Stage(enum.Enum):
+    """Hook stages (runtimehooks/protocol; api.proto:148-171)."""
+
+    PRE_RUN_POD_SANDBOX = "PreRunPodSandbox"
+    PRE_CREATE_CONTAINER = "PreCreateContainer"
+    PRE_UPDATE_CONTAINER = "PreUpdateContainerResources"
+    POST_START_CONTAINER = "PostStartContainer"
+    POST_STOP_POD_SANDBOX = "PostStopPodSandbox"
+
+
+@dataclasses.dataclass
+class HookContext:
+    """Mutable protocol object passed through hooks (protocol structs →
+    OCI adjustments). Hooks append cgroup writes and env vars."""
+
+    pod: PodMeta
+    stage: Stage
+    container_name: str = ""
+    cgroup_updates: List[CgroupUpdate] = dataclasses.field(default_factory=list)
+    env: Dict[str, str] = dataclasses.field(default_factory=dict)
+
+    def add_update(self, resource: str, value: str,
+                   cgroup_dir: Optional[str] = None) -> None:
+        self.cgroup_updates.append(CgroupUpdate(
+            cgroup_dir or self.pod.cgroup_dir, resource, value))
+
+
+class CoreSchedIface(Protocol):
+    def assign_cookie(self, cgroup_dir: str, group_id: str) -> None: ...
+
+
+class FakeCoreSched:
+    """Records cookie assignments (prctl is kernel-only)."""
+
+    def __init__(self) -> None:
+        self.assignments: Dict[str, str] = {}
+
+    def assign_cookie(self, cgroup_dir: str, group_id: str) -> None:
+        self.assignments[cgroup_dir] = group_id
+
+
+# --- hook plugins -----------------------------------------------------------
+
+# default group identities per QoS (bvt.go defaults; overridable via
+# NodeSLO resourceQOS tiers `groupIdentity`)
+DEFAULT_BVT = {QoSClass.LSE: 2, QoSClass.LSR: 2, QoSClass.LS: 2,
+               QoSClass.NONE: 0, QoSClass.SYSTEM: 0, QoSClass.BE: -1}
+
+
+class GroupIdentityHook:
+    name = "groupidentity"
+    stages = (Stage.PRE_RUN_POD_SANDBOX, Stage.PRE_UPDATE_CONTAINER)
+
+    def __init__(self, informer: StatesInformer):
+        self.informer = informer
+
+    def _bvt(self, pod: PodMeta) -> int:
+        slo = self.informer.get_node_slo()
+        if slo is not None:
+            tier = slo.resource_qos.tiers.get(pod.pod.qos.name, {})
+            if "groupIdentity" in tier:
+                return int(tier["groupIdentity"])
+        return DEFAULT_BVT.get(pod.pod.qos, 0)
+
+    def apply(self, ctx: HookContext) -> None:
+        ctx.add_update("cpu.bvt_warp_ns", str(self._bvt(ctx.pod)))
+
+
+class CPUSetHook:
+    """Scheduler's NUMA/cpuset decision -> cgroup (cpuset/rule.go). The
+    annotation value is the JSON the NodeNUMAResource PreBind writes:
+    {"cpuset": "0-3", "numaNodes": [0]}."""
+
+    name = "cpuset"
+    stages = (Stage.PRE_CREATE_CONTAINER, Stage.PRE_UPDATE_CONTAINER)
+
+    def apply(self, ctx: HookContext) -> None:
+        raw = ctx.pod.pod.meta.annotations.get(ANNOTATION_RESOURCE_STATUS)
+        if not raw:
+            return
+        try:
+            status = json.loads(raw)
+        except ValueError:
+            return
+        cpuset = status.get("cpuset", "")
+        if cpuset:
+            ctx.add_update("cpuset.cpus", cpuset)
+        numa = status.get("numaNodes")
+        if numa:
+            ctx.add_update("cpuset.mems",
+                           ",".join(str(int(z)) for z in numa))
+
+
+class BatchResourceHook:
+    """batch-cpu/batch-memory -> cgroup limits for BE pods
+    (batchresource hook: shares = milli*1024/1000, quota = milli/1000 *
+    period, memory.limit = batch-memory)."""
+
+    name = "batchresource"
+    stages = (Stage.PRE_RUN_POD_SANDBOX, Stage.PRE_UPDATE_CONTAINER)
+
+    def apply(self, ctx: HookContext) -> None:
+        pod = ctx.pod.pod
+        if pod.qos != QoSClass.BE:
+            return
+        cpu_milli = pod.requests.get(ResourceKind.BATCH_CPU, 0.0)
+        cpu_limit_milli = pod.limits.get(ResourceKind.BATCH_CPU, cpu_milli)
+        mem_mib = pod.limits.get(
+            ResourceKind.BATCH_MEMORY,
+            pod.requests.get(ResourceKind.BATCH_MEMORY, 0.0))
+        if cpu_milli > 0:
+            ctx.add_update("cpu.shares",
+                           str(max(2, int(cpu_milli * 1024 / 1000))))
+        if cpu_limit_milli > 0:
+            ctx.add_update("cpu.cfs_quota_us",
+                           str(int(cpu_limit_milli / 1000.0 * CFS_PERIOD_US)))
+        if mem_mib > 0:
+            ctx.add_update("memory.limit_in_bytes",
+                           str(int(mem_mib * (1 << 20))))
+
+
+class CoreSchedHook:
+    """Core-scheduling cookie per QoS group (coresched hook)."""
+
+    name = "coresched"
+    stages = (Stage.PRE_RUN_POD_SANDBOX, Stage.PRE_UPDATE_CONTAINER)
+
+    def __init__(self, core_sched: CoreSchedIface):
+        self.core_sched = core_sched
+
+    def apply(self, ctx: HookContext) -> None:
+        qos = ctx.pod.pod.qos
+        if qos in (QoSClass.BE, QoSClass.LS, QoSClass.LSR):
+            self.core_sched.assign_cookie(ctx.pod.cgroup_dir,
+                                          f"qos/{qos.name}")
+
+
+class GPUEnvHook:
+    """Device allocation annotation -> container env (gpu hook)."""
+
+    name = "gpu"
+    stages = (Stage.PRE_CREATE_CONTAINER,)
+
+    def apply(self, ctx: HookContext) -> None:
+        raw = ctx.pod.pod.meta.annotations.get(ANNOTATION_DEVICE_ALLOCATED)
+        if not raw:
+            return
+        try:
+            alloc = json.loads(raw)
+        except ValueError:
+            return
+        minors = [str(d.get("minor", 0)) for d in alloc.get("gpu", [])]
+        if minors:
+            ctx.env["NVIDIA_VISIBLE_DEVICES"] = ",".join(minors)
+
+
+# --- dispatch + reconciler --------------------------------------------------
+
+class HookServer:
+    """Dispatch table stage -> plugins (hooks/hooks.go:97-99)."""
+
+    def __init__(self, plugins: List[object]):
+        self.plugins = plugins
+
+    def run_hooks(self, stage: Stage, ctx: HookContext) -> HookContext:
+        for p in self.plugins:
+            if stage in p.stages:
+                p.apply(ctx)
+        return ctx
+
+
+class Reconciler:
+    """Fallback level-walk: re-derive and write every pod's hook output
+    directly through the executor (reconciler/reconciler.go:34-54). In
+    production this runs on PLEG events + a period; tests call
+    `reconcile_all` directly."""
+
+    def __init__(self, informer: StatesInformer, server: HookServer,
+                 executor: Executor):
+        self.informer = informer
+        self.server = server
+        self.executor = executor
+
+    def reconcile_pod(self, meta: PodMeta) -> HookContext:
+        ctx = HookContext(pod=meta, stage=Stage.PRE_UPDATE_CONTAINER)
+        self.server.run_hooks(Stage.PRE_UPDATE_CONTAINER, ctx)
+        if ctx.cgroup_updates:
+            self.executor.leveled_update_batch(ctx.cgroup_updates)
+        return ctx
+
+    def reconcile_all(self) -> None:
+        for meta in self.informer.get_all_pods():
+            self.reconcile_pod(meta)
+
+
+def default_hook_server(informer: StatesInformer,
+                        core_sched: Optional[CoreSchedIface] = None
+                        ) -> HookServer:
+    return HookServer([
+        GroupIdentityHook(informer),
+        CPUSetHook(),
+        BatchResourceHook(),
+        CoreSchedHook(core_sched or FakeCoreSched()),
+        GPUEnvHook(),
+    ])

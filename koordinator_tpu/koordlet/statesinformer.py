@@ -154,11 +154,11 @@ class NodeMetricReporter:
     """
 
     def __init__(self, informer: StatesInformer, cache: mc.MetricCache,
-                 policy: CollectPolicy = CollectPolicy(),
+                 policy: Optional[CollectPolicy] = None,
                  predictor: Optional[object] = None):
         self.informer = informer
         self.cache = cache
-        self.policy = policy
+        self.policy = policy or CollectPolicy()
         self.predictor = predictor
 
     def collect(self, now: Optional[float] = None) -> Optional[api.NodeMetric]:

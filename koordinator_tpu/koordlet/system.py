@@ -243,12 +243,62 @@ class Host:
             return f.read()
 
     def write(self, abs_path: str, value: str) -> None:
-        os.makedirs(os.path.dirname(abs_path), exist_ok=True)
+        # No makedirs: in a real cgroupfs, mkdir CREATES a cgroup — writes
+        # to vanished dirs must fail loudly (the executor catches and
+        # audits), not resurrect them as ghosts. The FakeHost builder
+        # helpers create dirs explicitly.
         with open(abs_path, "w", encoding="utf-8") as f:
             f.write(value)
 
+    # --- v1<->v2 value translation -------------------------------------
+    # Logical values are always the v1 convention; v2 files with different
+    # value syntax are translated on the way in/out.
+
+    def _read_v2_cpu_max(self, cgroup_dir: str) -> Tuple[str, str]:
+        raw = self.read(os.path.join(self.cgroup_root, cgroup_dir,
+                                     "cpu.max")).split()
+        quota = raw[0] if raw else "max"
+        period = raw[1] if len(raw) > 1 else "100000"
+        return quota, period
+
+    def _translate_v2_read(self, cgroup_dir: str, resource: str,
+                           raw: str) -> str:
+        if resource == "cpu.cfs_quota_us":
+            quota, _ = self._read_v2_cpu_max(cgroup_dir)
+            return "-1" if quota == "max" else quota
+        if resource == "cpu.cfs_period_us":
+            _, period = self._read_v2_cpu_max(cgroup_dir)
+            return period
+        if resource == "cpu.shares":
+            # kernel mapping: weight = 1 + ((shares-2)*9999)/262142
+            weight = int(raw)
+            return str(2 + (weight - 1) * 262142 // 9999)
+        if resource in ("memory.limit_in_bytes", "memory.high") \
+                and raw == "max":
+            return "-1"
+        return raw
+
+    def _translate_v2_write(self, cgroup_dir: str, resource: str,
+                            value: str) -> str:
+        if resource == "cpu.cfs_quota_us":
+            _, period = self._read_v2_cpu_max(cgroup_dir)
+            return f"max {period}" if int(value) < 0 else f"{value} {period}"
+        if resource == "cpu.cfs_period_us":
+            quota, _ = self._read_v2_cpu_max(cgroup_dir)
+            return f"{quota} {value}"
+        if resource == "cpu.shares":
+            shares = int(value)
+            return str(1 + (shares - 2) * 9999 // 262142)
+        if resource in ("memory.limit_in_bytes", "memory.high") \
+                and int(value) < 0:
+            return "max"
+        return value
+
     def read_cgroup(self, cgroup_dir: str, resource: str) -> str:
-        return self.read(self.cgroup_file(cgroup_dir, resource)).strip()
+        raw = self.read(self.cgroup_file(cgroup_dir, resource)).strip()
+        if self._version is CgroupVersion.V2:
+            return self._translate_v2_read(cgroup_dir, resource, raw)
+        return raw
 
     def write_cgroup(self, cgroup_dir: str, resource: str, value: str) -> None:
         res = RESOURCES[resource]
@@ -260,6 +310,8 @@ class Host:
             lo, hi = res.valid_range
             if not lo <= v <= hi:
                 raise ValueError(f"{resource}: {v} outside [{lo}, {hi}]")
+        if self._version is CgroupVersion.V2:
+            value = self._translate_v2_write(cgroup_dir, resource, value)
         self.write(self.cgroup_file(cgroup_dir, resource), value)
 
     # --- typed readers --------------------------------------------------
@@ -315,7 +367,19 @@ class Host:
 
     def cpu_topology(self) -> List[ProcessorInfo]:
         """Logical CPUs from sys/devices topology files (fallback:
-        /proc/cpuinfo fields physical id / core id)."""
+        /proc/cpuinfo fields physical id / core id). Topology is static —
+        cached after the first scan (collectors call this every tick)."""
+        cached = getattr(self, "_topology_cache", None)
+        if cached is not None:
+            return cached
+        cpus = self._scan_cpu_topology()
+        self._topology_cache = cpus
+        return cpus
+
+    def invalidate_topology_cache(self) -> None:
+        self._topology_cache = None
+
+    def _scan_cpu_topology(self) -> List[ProcessorInfo]:
         cpus: List[ProcessorInfo] = []
         sys_cpu = self.path("sys/devices/system/cpu")
         if os.path.isdir(sys_cpu):
@@ -370,7 +434,10 @@ class Host:
         return out
 
     def write_resctrl_schemata(self, group: str, lines: Dict[str, str]) -> None:
+        # unlike cgroupfs, mkdir in resctrl legitimately CREATES the group
+        # (resctrl.go creates LS/BE groups this way)
         p = os.path.join(self.resctrl_root, group, "schemata")
+        os.makedirs(os.path.dirname(p), exist_ok=True)
         body = "".join(f"{k}:{v}\n" for k, v in lines.items())
         self.write(p, body)
 

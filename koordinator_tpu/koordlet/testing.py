@@ -43,11 +43,18 @@ class FakeHost(Host):
         for d in ("kubepods", "kubepods/burstable", "kubepods/besteffort"):
             self.make_cgroup(d)
 
+    def _seed(self, abs_path: str, value: str) -> None:
+        """Builder write: creates parent dirs (unlike Host.write, which
+        must fail on vanished cgroup dirs in production)."""
+        os.makedirs(os.path.dirname(abs_path), exist_ok=True)
+        with open(abs_path, "w", encoding="utf-8") as f:
+            f.write(value)
+
     # --- procfs ---------------------------------------------------------
     def set_proc_stat(self, total_ticks: int, idle_ticks: int) -> None:
         self._ticks_total, self._ticks_idle = total_ticks, idle_ticks
         busy = total_ticks - idle_ticks
-        self.write(os.path.join(self.proc_root, "stat"),
+        self._seed(os.path.join(self.proc_root, "stat"),
                    f"cpu {busy} 0 0 {idle_ticks} 0 0 0 0 0 0\n")
 
     def advance_cpu(self, busy_ticks: int, idle_ticks: int) -> None:
@@ -58,7 +65,7 @@ class FakeHost(Host):
     def set_meminfo(self, available: int,
                     total: Optional[int] = None) -> None:
         total = self.mem_bytes if total is None else total
-        self.write(os.path.join(self.proc_root, "meminfo"),
+        self._seed(os.path.join(self.proc_root, "meminfo"),
                    f"MemTotal: {total // 1024} kB\n"
                    f"MemFree: {available // 1024} kB\n"
                    f"MemAvailable: {available // 1024} kB\n")
@@ -77,37 +84,58 @@ class FakeHost(Host):
                 f.write(str(node))
             nd = self.path(f"sys/devices/system/cpu/cpu{cpu}/node{node}")
             os.makedirs(nd, exist_ok=True)
+        self.invalidate_topology_cache()
 
     # --- cgroupfs -------------------------------------------------------
     def make_cgroup(self, cgroup_dir: str,
                     defaults: Optional[Dict[str, str]] = None) -> None:
-        """Create a cgroup dir with default files for all known resources."""
-        base_defaults = {
-            "cpu.shares": "1024", "cpu.cfs_quota_us": "-1",
-            "cpu.cfs_period_us": "100000", "cpu.cfs_burst_us": "0",
-            "cpu.bvt_warp_ns": "0", "cpu.idle": "0",
-            "cpuset.cpus": f"0-{self.num_cpus - 1}" if self.num_cpus > 1 else "0",
-            "cpuset.mems": "0",
-            "cpuacct.usage": "0",
-            "cpu.stat": "usage_usec 0\n",
-            "memory.limit_in_bytes": str(self.mem_bytes),
-            "memory.min": "0", "memory.low": "0", "memory.high": "-1",
-            "memory.usage_in_bytes": "0",
-            "memory.stat": "total_inactive_file 0\n",
-            "cpu.pressure": "some avg10=0.00 avg60=0.00 avg300=0.00 total=0\n",
-            "memory.pressure":
-                "some avg10=0.00 avg60=0.00 avg300=0.00 total=0\n"
-                "full avg10=0.00 avg60=0.00 avg300=0.00 total=0\n",
-            "io.pressure":
-                "some avg10=0.00 avg60=0.00 avg300=0.00 total=0\n"
-                "full avg10=0.00 avg60=0.00 avg300=0.00 total=0\n",
-        }
-        base_defaults.update(defaults or {})
-        for name, value in base_defaults.items():
+        """Create a cgroup dir with kernel-default file contents.
+
+        `defaults` overrides use LOGICAL (v1-convention) values; on a v2
+        host they are seeded raw first (correct v2 syntax) then overridden
+        through `write_cgroup`, which translates.
+        """
+        psi_line = ("some avg10=0.00 avg60=0.00 avg300=0.00 total=0\n"
+                    "full avg10=0.00 avg60=0.00 avg300=0.00 total=0\n")
+        cpus = f"0-{self.num_cpus - 1}" if self.num_cpus > 1 else "0"
+        if self.cgroup_version is CgroupVersion.V1:
+            raw = {
+                "cpu.shares": "1024", "cpu.cfs_quota_us": "-1",
+                "cpu.cfs_period_us": "100000", "cpu.cfs_burst_us": "0",
+                "cpu.bvt_warp_ns": "0", "cpu.idle": "0",
+                "cpuset.cpus": cpus, "cpuset.mems": "0",
+                "cpuacct.usage": "0", "cpu.stat": "usage_usec 0\n",
+                "memory.limit_in_bytes": str(self.mem_bytes),
+                "memory.min": "0", "memory.low": "0", "memory.high": "-1",
+                "memory.usage_in_bytes": "0",
+                "memory.stat": "total_inactive_file 0\n",
+                "cpu.pressure": psi_line, "memory.pressure": psi_line,
+                "io.pressure": psi_line,
+            }
+        else:
+            # raw v2 file contents, kernel syntax
+            raw = {
+                "cpu.shares": "100",          # cpu.weight default
+                "cpu.cfs_quota_us": "max 100000",  # cpu.max
+                "cpu.cfs_burst_us": "0",
+                "cpu.bvt_warp_ns": "0", "cpu.idle": "0",
+                "cpuset.cpus": cpus, "cpuset.mems": "0",
+                "cpu.stat": "usage_usec 0\n",
+                "memory.limit_in_bytes": "max",    # memory.max
+                "memory.min": "0", "memory.low": "0",
+                "memory.high": "max",
+                "memory.usage_in_bytes": "0",      # memory.current
+                "memory.stat": "inactive_file 0\n",
+                "cpu.pressure": psi_line, "memory.pressure": psi_line,
+                "io.pressure": psi_line,
+            }
+        for name, value in raw.items():
             res = RESOURCES.get(name)
             if res is None or not res.supported(self.cgroup_version):
                 continue
-            self.write(self.cgroup_file(cgroup_dir, name), value)
+            self._seed(self.cgroup_file(cgroup_dir, name), value)
+        for name, value in (defaults or {}).items():
+            self.write_cgroup(cgroup_dir, name, value)
 
     def set_cgroup_cpu_ns(self, cgroup_dir: str, total_ns: int) -> None:
         if self.cgroup_version is CgroupVersion.V1:
@@ -137,5 +165,5 @@ class FakeHost(Host):
         lines = "".join([
             f"L3:{';'.join(f'{i}={l3_mask}' for i in range(num_l3))}\n",
             f"MB:{';'.join(f'{i}={mb_percent}' for i in range(num_l3))}\n"])
-        self.write(os.path.join(self.resctrl_root, "schemata"), lines)
-        self.write(os.path.join(self.resctrl_root, "cbm_mask"), l3_mask)
+        self._seed(os.path.join(self.resctrl_root, "schemata"), lines)
+        self._seed(os.path.join(self.resctrl_root, "cbm_mask"), l3_mask)

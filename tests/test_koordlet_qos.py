@@ -1,0 +1,469 @@
+"""qosmanager strategies + runtimehooks + prediction + pleg + audit, all
+against the fake host tree (SURVEY.md 3.3, 3.4)."""
+
+import json
+import os
+
+import pytest
+
+from koordinator_tpu.api import types as api
+from koordinator_tpu.api.extension import QoSClass, ResourceKind
+from koordinator_tpu.koordlet import metriccache as mc
+from koordinator_tpu.koordlet import pleg as plegmod
+from koordinator_tpu.koordlet.audit import Auditor
+from koordinator_tpu.koordlet.prediction import (
+    DecayedHistogram,
+    PeakPredictServer,
+    PredictConfig,
+)
+from koordinator_tpu.koordlet.qosmanager import (
+    BE_ROOT,
+    CPUBurst,
+    CPUEvict,
+    CPUSuppress,
+    CPUSuppressConfig,
+    CgroupReconcile,
+    MemoryEvict,
+    RecordingEvictor,
+    ResctrlReconcile,
+    suppress_cpuset_policy,
+)
+from koordinator_tpu.koordlet.resourceexecutor import Executor
+from koordinator_tpu.koordlet.runtimehooks import (
+    ANNOTATION_RESOURCE_STATUS,
+    FakeCoreSched,
+    HookContext,
+    Reconciler,
+    Stage,
+    default_hook_server,
+)
+from koordinator_tpu.koordlet.statesinformer import PodMeta, StatesInformer
+from koordinator_tpu.koordlet.system import ProcessorInfo, parse_cpuset
+from koordinator_tpu.koordlet.testing import FakeHost
+
+
+def make_pod(uid, qos="LS", priority=9500, cpu_milli=1000.0, mem_mib=1024.0,
+             limits=None, annotations=None):
+    return PodMeta(pod=api.Pod(
+        meta=api.ObjectMeta(uid=uid, name=uid, namespace="default",
+                            annotations=annotations or {}),
+        requests={ResourceKind.CPU: cpu_milli, ResourceKind.MEMORY: mem_mib},
+        limits=limits or {},
+        qos_label=qos, priority=priority))
+
+
+def make_be_pod(uid, batch_cpu=1000.0, batch_mem=1024.0, priority=5500):
+    return PodMeta(pod=api.Pod(
+        meta=api.ObjectMeta(uid=uid, name=uid),
+        requests={ResourceKind.BATCH_CPU: batch_cpu,
+                  ResourceKind.BATCH_MEMORY: batch_mem},
+        limits={ResourceKind.BATCH_CPU: batch_cpu,
+                ResourceKind.BATCH_MEMORY: batch_mem},
+        qos_label="BE", priority=priority))
+
+
+@pytest.fixture
+def env(tmp_path):
+    host = FakeHost(str(tmp_path), num_cpus=8, mem_bytes=16 << 30)
+    informer = StatesInformer()
+    cache = mc.MetricCache()
+    executor = Executor(host)
+    informer.set_node(api.Node(
+        meta=api.ObjectMeta(name="node-1"),
+        allocatable={ResourceKind.CPU: 8000.0,
+                     ResourceKind.MEMORY: 16384.0}))
+    slo = api.NodeSLO(node_name="node-1")
+    slo.threshold.enable = True
+    informer.set_node_slo(slo)
+    return host, informer, cache, executor
+
+
+# --- suppress ---------------------------------------------------------------
+
+def test_suppress_cpuset_policy_packs_cores():
+    procs = [ProcessorInfo(cpu_id=i, core_id=i // 2, socket_id=0, node_id=0)
+             for i in range(8)]
+    # full physical cores first, in core order
+    assert suppress_cpuset_policy(4, procs) == [0, 1, 2, 3]
+    # excluded (LSR-pinned) cpus are avoided
+    assert suppress_cpuset_policy(2, procs, exclude=[0, 1, 2, 3]) == [4, 5]
+    # not enough cpus -> capped to the full available set
+    assert suppress_cpuset_policy(9, procs) == list(range(8))
+
+
+def test_suppress_cpuset_policy_prefers_bigger_numa_bucket():
+    procs = ([ProcessorInfo(i, i // 2, 0, 0) for i in range(4)]
+             + [ProcessorInfo(4 + i, 2 + i // 2, 1, 1) for i in range(8)])
+    got = suppress_cpuset_policy(4, procs)
+    assert got == [4, 5, 6, 7]  # larger node-1 bucket wins
+
+
+def test_cpusuppress_cpuset(env):
+    host, informer, cache, executor = env
+    be = make_be_pod("be-1")
+    host.make_cgroup(be.cgroup_dir)
+    informer.set_pods([be])
+    # node used 6 of 8 cores, BE itself 1, system 1 => nonBE pods = 4
+    for t in (0.0, 30.0):
+        cache.append(mc.NODE_CPU_USAGE, t, 6.0)
+        cache.append(mc.BE_CPU_USAGE, t, 1.0)
+        cache.append(mc.SYS_CPU_USAGE, t, 1.0)
+    CPUSuppress(informer, cache, executor).reconcile(now=30.0)
+    # suppress = 8*0.65 - 4 - 1 = 0.2 -> floored to MIN 1 core
+    got = parse_cpuset(host.read_cgroup(BE_ROOT, "cpuset.cpus"))
+    assert len(got) == 1
+    assert parse_cpuset(host.read_cgroup(be.cgroup_dir, "cpuset.cpus")) == got
+
+
+def test_cpusuppress_cfs_quota(env):
+    host, informer, cache, executor = env
+    informer.set_pods([])
+    for t in (0.0, 30.0):
+        cache.append(mc.NODE_CPU_USAGE, t, 2.0)   # mostly idle
+        cache.append(mc.BE_CPU_USAGE, t, 0.5)
+        cache.append(mc.SYS_CPU_USAGE, t, 0.5)
+    CPUSuppress(informer, cache, executor,
+                CPUSuppressConfig(policy="cfsQuota")).reconcile(now=30.0)
+    # suppress = 8*0.65 - 1.0 - 0.5 = 3.7 cores -> quota 370000
+    assert host.read_cgroup(BE_ROOT, "cpu.cfs_quota_us") == "370000"
+
+
+def test_cpusuppress_avoids_lsr_cpus(env):
+    host, informer, cache, executor = env
+    lsr = make_pod("lsr-1", qos="LSR")
+    host.make_cgroup(lsr.cgroup_dir, {"cpuset.cpus": "0-3"})
+    informer.set_pods([lsr])
+    for t in (0.0, 30.0):
+        cache.append(mc.NODE_CPU_USAGE, t, 1.0)
+        cache.append(mc.BE_CPU_USAGE, t, 0.5)
+        cache.append(mc.SYS_CPU_USAGE, t, 0.5)
+    CPUSuppress(informer, cache, executor).reconcile(now=30.0)
+    got = parse_cpuset(host.read_cgroup(BE_ROOT, "cpuset.cpus"))
+    assert got and not set(got) & {0, 1, 2, 3}
+
+
+def test_cpusuppress_disabled_no_write(env):
+    host, informer, cache, executor = env
+    informer.get_node_slo().threshold.enable = False
+    before = host.read_cgroup(BE_ROOT, "cpuset.cpus")
+    cache.append(mc.NODE_CPU_USAGE, 0.0, 6.0)
+    CPUSuppress(informer, cache, executor).reconcile(now=1.0)
+    assert host.read_cgroup(BE_ROOT, "cpuset.cpus") == before
+
+
+# --- burst ------------------------------------------------------------------
+
+def test_cpuburst_grants_and_scales(env):
+    host, informer, cache, executor = env
+    slo = informer.get_node_slo()
+    slo.cpu_burst.policy = "auto"
+    pod = make_pod("ls-1", limits={ResourceKind.CPU: 2000.0})
+    host.make_cgroup(pod.cgroup_dir, {"cpu.cfs_quota_us": "200000"})
+    informer.set_pods([pod])
+    cache.append(mc.NODE_CPU_USAGE, 0.0, 1.0)  # idle node
+    cache.append(mc.PSI_CPU_SOME_AVG10, 0.0, 25.0,
+                 {"cgroup": pod.cgroup_dir})   # throttled
+    CPUBurst(informer, cache, executor).reconcile(now=1.0)
+    # burst = 2 cores * 1000% = 20 cores * period
+    assert host.read_cgroup(pod.cgroup_dir, "cpu.cfs_burst_us") == "2000000"
+    # quota scaled up 1.2x
+    assert host.read_cgroup(pod.cgroup_dir, "cpu.cfs_quota_us") == "240000"
+
+    # overloaded node resets quota to base
+    cache.append(mc.NODE_CPU_USAGE, 2.0, 7.9)
+    CPUBurst(informer, cache, executor).reconcile(now=2.0)
+    assert host.read_cgroup(pod.cgroup_dir, "cpu.cfs_quota_us") == "200000"
+
+
+def test_cpuburst_cap(env):
+    host, informer, cache, executor = env
+    slo = informer.get_node_slo()
+    slo.cpu_burst.policy = "cfsQuotaBurstOnly"
+    slo.cpu_burst.cfs_quota_burst_percent = 110.0
+    pod = make_pod("ls-1", limits={ResourceKind.CPU: 1000.0})
+    host.make_cgroup(pod.cgroup_dir, {"cpu.cfs_quota_us": "100000"})
+    informer.set_pods([pod])
+    cache.append(mc.NODE_CPU_USAGE, 0.0, 0.5)
+    cache.append(mc.PSI_CPU_SOME_AVG10, 0.0, 25.0,
+                 {"cgroup": pod.cgroup_dir})
+    CPUBurst(informer, cache, executor).reconcile(now=1.0)
+    assert host.read_cgroup(pod.cgroup_dir, "cpu.cfs_quota_us") == "110000"
+    # cpuBurstOnly knob not applied in cfsQuotaBurstOnly mode
+    assert host.read_cgroup(pod.cgroup_dir, "cpu.cfs_burst_us") == "0"
+
+
+# --- evict ------------------------------------------------------------------
+
+def test_cpuevict_releases_lowest_priority_first(env):
+    host, informer, cache, executor = env
+    slo = informer.get_node_slo()
+    slo.threshold.cpu_evict_satisfaction_lower_percent = 30.0
+    b1 = make_be_pod("be-1", batch_cpu=4000.0, priority=5100)
+    b2 = make_be_pod("be-2", batch_cpu=4000.0, priority=5900)
+    for m in (b1, b2):
+        host.make_cgroup(m.cgroup_dir)
+    informer.set_pods([b1, b2])
+    # suppressed BE limit: 1 core over 8000 milli requested => satisfaction
+    # 12.5% < 30%
+    host.write_cgroup(BE_ROOT, "cpu.cfs_quota_us", "100000")
+    for t in (0.0, 100.0):
+        cache.append(mc.BE_CPU_USAGE, t, 0.95)  # pressing the 1-core limit
+    ev = RecordingEvictor()
+    CPUEvict(informer, cache, executor, ev).reconcile(now=100.0)
+    assert [p.pod.meta.uid for p, _ in ev.evicted] == ["be-1"]
+
+
+def test_memoryevict_until_lower_percent(env):
+    host, informer, cache, executor = env
+    slo = informer.get_node_slo()
+    slo.threshold.memory_evict_threshold_percent = 70.0
+    slo.threshold.memory_evict_lower_percent = 65.0
+    b1 = make_be_pod("be-1", batch_mem=2048.0, priority=5100)
+    b2 = make_be_pod("be-2", batch_mem=2048.0, priority=5900)
+    informer.set_pods([b1, b2])
+    # 12 GiB used of 16 GiB = 75% > 70%; target release to 65% => 1.6 GiB
+    cache.append(mc.NODE_MEMORY_USAGE, 0.0, float(12 << 30))
+    cache.append(mc.POD_MEMORY_USAGE, 0.0, float(2 << 30), {"pod_uid": "be-1"})
+    cache.append(mc.POD_MEMORY_USAGE, 0.0, float(2 << 30), {"pod_uid": "be-2"})
+    ev = RecordingEvictor()
+    MemoryEvict(informer, cache, ev).reconcile(now=1.0)
+    assert [p.pod.meta.uid for p, _ in ev.evicted] == ["be-1"]
+
+
+def test_memoryevict_below_threshold_noop(env):
+    host, informer, cache, executor = env
+    informer.get_node_slo().threshold.memory_evict_threshold_percent = 70.0
+    informer.set_pods([make_be_pod("be-1")])
+    cache.append(mc.NODE_MEMORY_USAGE, 0.0, float(4 << 30))
+    ev = RecordingEvictor()
+    MemoryEvict(informer, cache, ev).reconcile(now=1.0)
+    assert ev.evicted == []
+
+
+# --- resctrl + cgroup reconcile --------------------------------------------
+
+def test_resctrl_schemata_per_tier(env):
+    host, informer, cache, executor = env
+    host.init_resctrl(l3_mask="fff")
+    slo = informer.get_node_slo()
+    slo.resource_qos.tiers = {
+        "LS": {"catRangeEndPercent": 100.0, "mbaPercent": 100.0},
+        "BE": {"catRangeEndPercent": 30.0, "mbaPercent": 40.0},
+    }
+    ResctrlReconcile(informer, executor).reconcile(now=1.0)
+    assert host.resctrl_schemata("BE") == {"L3": "0=f", "MB": "0=40"}
+    assert host.resctrl_schemata("LS") == {"L3": "0=fff", "MB": "0=100"}
+
+
+def test_cgroup_reconcile_memory_protection(env):
+    host, informer, cache, executor = env
+    slo = informer.get_node_slo()
+    slo.resource_qos.tiers = {"LS": {"memoryMinPercent": 50.0,
+                                     "memoryLowPercent": 75.0}}
+    pod = make_pod("ls-1", mem_mib=1024.0)
+    host.make_cgroup(pod.cgroup_dir)
+    informer.set_pods([pod])
+    CgroupReconcile(informer, executor).reconcile(now=1.0)
+    assert host.read_cgroup(pod.cgroup_dir, "memory.min") == str(512 << 20)
+    assert host.read_cgroup(pod.cgroup_dir, "memory.low") == str(768 << 20)
+
+
+# --- runtimehooks -----------------------------------------------------------
+
+def test_hooks_group_identity_and_batch(env):
+    host, informer, cache, executor = env
+    server = default_hook_server(informer)
+    be = make_be_pod("be-1", batch_cpu=2000.0, batch_mem=2048.0)
+    ctx = HookContext(pod=be, stage=Stage.PRE_RUN_POD_SANDBOX)
+    server.run_hooks(Stage.PRE_RUN_POD_SANDBOX, ctx)
+    writes = {(u.resource): u.value for u in ctx.cgroup_updates}
+    assert writes["cpu.bvt_warp_ns"] == "-1"
+    assert writes["cpu.shares"] == str(int(2000 * 1024 / 1000))
+    assert writes["cpu.cfs_quota_us"] == "200000"
+    assert writes["memory.limit_in_bytes"] == str(2048 << 20)
+
+
+def test_hooks_cpuset_annotation_and_reconciler(env):
+    host, informer, cache, executor = env
+    status = json.dumps({"cpuset": "2-3", "numaNodes": [0]})
+    pod = make_pod("lsr-1", qos="LSR",
+                   annotations={ANNOTATION_RESOURCE_STATUS: status})
+    host.make_cgroup(pod.cgroup_dir)
+    informer.set_pods([pod])
+    core = FakeCoreSched()
+    server = default_hook_server(informer, core)
+    Reconciler(informer, server, executor).reconcile_all()
+    assert host.read_cgroup(pod.cgroup_dir, "cpuset.cpus") == "2-3"
+    assert host.read_cgroup(pod.cgroup_dir, "cpuset.mems") == "0"
+    assert host.read_cgroup(pod.cgroup_dir, "cpu.bvt_warp_ns") == "2"
+    assert core.assignments[pod.cgroup_dir] == "qos/LSR"
+
+
+def test_hooks_gpu_env():
+    from koordinator_tpu.koordlet.runtimehooks import (
+        ANNOTATION_DEVICE_ALLOCATED,
+        GPUEnvHook,
+    )
+    pod = make_pod("g-1", annotations={
+        ANNOTATION_DEVICE_ALLOCATED: json.dumps(
+            {"gpu": [{"minor": 0}, {"minor": 3}]})})
+    ctx = HookContext(pod=pod, stage=Stage.PRE_CREATE_CONTAINER)
+    GPUEnvHook().apply(ctx)
+    assert ctx.env["NVIDIA_VISIBLE_DEVICES"] == "0,3"
+
+
+# --- prediction -------------------------------------------------------------
+
+def test_histogram_percentile_and_decay():
+    h = DecayedHistogram(0.01, half_life_seconds=3600.0)
+    for _ in range(100):
+        h.add(1.0, ts=0.0)
+    assert h.percentile(0.5) == pytest.approx(1.0, rel=0.06)
+    # a much-later single sample at 4.0 dominates decayed history
+    for _ in range(2):
+        h.add(4.0, ts=20 * 3600.0)
+    assert h.percentile(0.5) == pytest.approx(4.0, rel=0.06)
+
+
+def test_prediction_prod_reclaimable_and_checkpoint(env, tmp_path):
+    host, informer, cache, executor = env
+    pod = make_pod("prod-1", cpu_milli=4000.0, mem_mib=4096.0, priority=9500)
+    informer.set_pods([pod])
+    cfg = PredictConfig(cold_start_seconds=0.0,
+                        checkpoint_path=str(tmp_path / "ckpt.json"))
+    srv = PeakPredictServer(informer, cache, cfg)
+    for t in range(10):
+        cache.append(mc.POD_CPU_USAGE, float(t), 1.0, {"pod_uid": "prod-1"})
+        cache.append(mc.POD_MEMORY_USAGE, float(t), float(1 << 30),
+                     {"pod_uid": "prod-1"})
+        srv.train_once(now=float(t))
+    srv.pod_start["prod-1"] = -10.0
+    rec = srv.prod_reclaimable(now=10.0)
+    # request 4 cores, peak ~1 core * 1.1 margin -> ~2.9 reclaimable
+    assert rec[ResourceKind.CPU] == pytest.approx(2900.0, rel=0.1)
+    assert rec[ResourceKind.MEMORY] == pytest.approx(4096 - 1024 * 1.1,
+                                                     rel=0.1)
+    # checkpoint roundtrip preserves prediction
+    srv.checkpoint()
+    srv2 = PeakPredictServer(informer, cache, cfg)
+    assert srv2.restore()
+    assert srv2.prediction("prod-1")["p95"]["cpu"] == pytest.approx(
+        srv.prediction("prod-1")["p95"]["cpu"])
+
+
+def test_prediction_gc():
+    informer = StatesInformer()
+    cache = mc.MetricCache()
+    srv = PeakPredictServer(informer, cache)
+    srv._model("pod-a")
+    srv._model("priority/PROD")
+    srv.gc(live_uids=[])
+    assert "pod-a" not in srv.models
+    assert "priority/PROD" in srv.models  # aggregates survive
+
+
+# --- pleg -------------------------------------------------------------------
+
+def test_pleg_polling_events(tmp_path):
+    host = FakeHost(str(tmp_path))
+    p = plegmod.Pleg.for_host(host, use_inotify=False)
+    got = []
+    p.subscribe(got.append)
+    host.make_cgroup("kubepods/besteffort/pod12ab-34")
+    events = p.poll_once()
+    assert any(e.type is plegmod.EventType.POD_ADDED
+               and e.pod_uid == "12ab-34" for e in events)
+    # container arrival inside the pod dir
+    host.make_cgroup("kubepods/besteffort/pod12ab-34/ctr1")
+    events = p.poll_once()
+    assert any(e.type is plegmod.EventType.CONTAINER_ADDED for e in events)
+    assert got, "subscriber received events"
+
+
+def test_pleg_inotify_if_available(tmp_path):
+    host = FakeHost(str(tmp_path))
+    p = plegmod.Pleg.for_host(host, use_inotify=True)
+    if not isinstance(p.watcher, plegmod.InotifyWatcher):
+        pytest.skip("inotify unavailable")
+    os.makedirs(os.path.join(host.cgroup_root, "cpu/kubepods/podcc-dd"),
+                exist_ok=True)
+    events = p.watcher.poll(timeout=1.0)
+    assert any(e.pod_uid == "cc-dd" for e in events)
+
+
+# --- audit ------------------------------------------------------------------
+
+def test_audit_ring_and_rotation(tmp_path):
+    a = Auditor(log_dir=str(tmp_path), ring_size=5, max_file_bytes=200,
+                max_files=3)
+    for i in range(20):
+        a.record("info", "test", "write", f"target-{i}")
+    got = a.query(component="test", limit=3)
+    assert [e.target for e in got] == ["target-19", "target-18", "target-17"]
+    assert len(a.query()) == 5  # ring bound
+    a.close()
+    files = sorted(os.listdir(tmp_path))
+    assert "audit.log" in files and any(f.startswith("audit.log.")
+                                        for f in files)
+
+
+# --- daemon wiring ----------------------------------------------------------
+
+def test_daemon_full_cycle(tmp_path):
+    from koordinator_tpu.koordlet.agent import Daemon, DaemonConfig
+    host = FakeHost(str(tmp_path), num_cpus=8, mem_bytes=16 << 30)
+    d = Daemon(host, DaemonConfig(qos_interval_seconds=5.0,
+                                  report_interval_seconds=10.0))
+    d.informer.set_node(api.Node(
+        meta=api.ObjectMeta(name="node-1"),
+        allocatable={ResourceKind.CPU: 8000.0,
+                     ResourceKind.MEMORY: 16384.0}))
+    slo = api.NodeSLO(node_name="node-1")
+    slo.threshold.enable = True
+    d.informer.set_node_slo(slo)
+    be = make_be_pod("be-1")
+    host.make_cgroup(be.cgroup_dir)
+    d.informer.set_pods([be])
+
+    d.tick(now=0.0)
+    host.advance_cpu(busy_ticks=6000, idle_ticks=2000)  # 6 of 8 cores busy
+    host.set_cgroup_cpu_ns(be.cgroup_dir, 10_000_000_000)
+    report = d.tick(now=10.0)
+    # report produced on the interval, BE cpuset suppressed, hooks applied
+    assert report is not None and report.node_name == "node-1"
+    assert report.node_usage[ResourceKind.CPU] > 0
+    assert host.read_cgroup(be.cgroup_dir, "cpu.bvt_warp_ns") == "-1"
+    suppressed = parse_cpuset(host.read_cgroup(BE_ROOT, "cpuset.cpus"))
+    assert len(suppressed) < 8
+
+
+def test_histogram_wallclock_timestamps():
+    """Real epoch timestamps must not overflow the decay scale."""
+    import time as _time
+    h = DecayedHistogram(0.01, half_life_seconds=12 * 3600.0)
+    now = _time.time()
+    for i in range(100):
+        h.add(2.0, ts=now + i)
+    assert h.percentile(0.9) == pytest.approx(2.0, rel=0.06)
+    # and a huge forward jump still renormalizes instead of overflowing
+    h.add(2.0, ts=now + 365 * 86400.0)
+    assert h.percentile(0.9) == pytest.approx(2.0, rel=0.06)
+
+
+def test_suppress_policy_caps_to_available():
+    procs = [ProcessorInfo(cpu_id=i, core_id=i // 2, socket_id=0, node_id=0)
+             for i in range(8)]
+    # want 5 but only 2 grantable after exclusion -> grant the 2
+    got = suppress_cpuset_policy(5, procs, exclude=[0, 1, 2, 3, 4, 5])
+    assert got == [6, 7]
+
+
+def test_evictor_dedup_and_drain():
+    ev = RecordingEvictor()
+    pod = make_be_pod("be-1")
+    ev(pod, "r1")
+    ev(pod, "r1 again")
+    assert len(ev.evicted) == 1
+    assert len(ev.drain()) == 1
+    ev(pod, "after drain")
+    assert len(ev.evicted) == 1

@@ -248,3 +248,46 @@ def test_reporter_requires_metrics(host):
     informer.set_node(api.Node(meta=api.ObjectMeta(name="n")))
     reporter = NodeMetricReporter(informer, mc.MetricCache())
     assert reporter.collect(now=1.0) is None
+
+
+def test_cgroup_v2_value_translation(tmp_path):
+    """v2 files use different value syntax: cpu.max pairs, cpu.weight
+    scale, memory 'max' sentinel — logical values stay v1-convention."""
+    host = FakeHost(str(tmp_path), cgroup_version=system.CgroupVersion.V2)
+    host.make_cgroup("kubepods/podx")
+    # quota: unlimited reads back as -1
+    assert host.read_cgroup("kubepods/podx", "cpu.cfs_quota_us") == "-1"
+    host.write_cgroup("kubepods/podx", "cpu.cfs_quota_us", "250000")
+    raw = host.read(host.cgroup_file("kubepods/podx", "cpu.cfs_quota_us"))
+    assert raw.strip() == "250000 100000"
+    assert host.read_cgroup("kubepods/podx", "cpu.cfs_quota_us") == "250000"
+    # period write preserves quota
+    host.write_cgroup("kubepods/podx", "cpu.cfs_period_us", "50000")
+    assert host.read(host.cgroup_file(
+        "kubepods/podx", "cpu.cfs_period_us")).strip() == "250000 50000"
+    # back to unlimited
+    host.write_cgroup("kubepods/podx", "cpu.cfs_quota_us", "-1")
+    assert host.read_cgroup("kubepods/podx", "cpu.cfs_quota_us") == "-1"
+    # shares <-> weight (kernel formula); 1024 shares ~ weight 39
+    host.write_cgroup("kubepods/podx", "cpu.shares", "1024")
+    assert host.read(host.cgroup_file(
+        "kubepods/podx", "cpu.shares")).strip() == "39"
+    back = int(host.read_cgroup("kubepods/podx", "cpu.shares"))
+    assert abs(back - 1024) < 30  # integer rounding on the round trip
+    # memory unlimited sentinel
+    host.write_cgroup("kubepods/podx", "memory.limit_in_bytes", "-1")
+    assert host.read(host.cgroup_file(
+        "kubepods/podx", "memory.limit_in_bytes")).strip() == "max"
+    assert host.read_cgroup("kubepods/podx", "memory.limit_in_bytes") == "-1"
+
+
+def test_write_does_not_create_ghost_cgroups(host):
+    """A write to a vanished pod cgroup fails (and is audited) instead of
+    mkdir-ing a ghost cgroup."""
+    from koordinator_tpu.koordlet.resourceexecutor import CgroupUpdate, Executor
+    ex = Executor(host)
+    up = CgroupUpdate("kubepods/podgone", "cpu.shares", "512")
+    assert not ex.update(up, cacheable=False)
+    import os
+    assert not os.path.exists(
+        os.path.dirname(host.cgroup_file("kubepods/podgone", "cpu.shares")))
