@@ -75,6 +75,16 @@ class Pod:
     # zone granted to a RUNNING bound pod (annotation resource-status,
     # numa_aware.go) — restored into NodeState.numa_free at snapshot build
     allocated_numa_zone: int = -1
+    # device requests/allocations (apis/extension/device_share.go):
+    # gpu-core/gpu-memory/rdma/fpga ride in `requests`; an explicit
+    # gpu-memory-ratio request is carried separately (it is converted
+    # against the node's per-GPU memory at filter time)
+    gpu_memory_ratio: float = 0.0
+    # instance indices granted to a RUNNING pod (the device-allocation
+    # annotation) — restored into DeviceState free at snapshot build
+    allocated_gpu_minors: Tuple[int, ...] = ()
+    allocated_rdma_inst: int = -1
+    allocated_fpga_inst: int = -1
     # node selection
     node_selector: Dict[str, str] = dataclasses.field(default_factory=dict)
     # device request (gpu-core percent, gpu-memory MiB) folded into requests

@@ -47,9 +47,10 @@ def snapshot_sharding(mesh: Mesh) -> ClusterSnapshot:
     def node_field(_):
         return node_spec
 
-    # nodes.* are all [N, ...] -> shard dim 0; other groups replicate
+    # nodes.* / devices.* are all [N, ...] -> shard dim 0; other groups
+    # replicate
     from koordinator_tpu.snapshot.schema import (
-        GangState, NodeState, QuotaState, ReservationState,
+        DeviceState, GangState, NodeState, QuotaState, ReservationState,
     )
     nodes = jax.tree_util.tree_map(node_field,
                                    NodeState(*([0] * len(NodeState.__dataclass_fields__))))
@@ -59,8 +60,10 @@ def snapshot_sharding(mesh: Mesh) -> ClusterSnapshot:
                                    GangState(*([0] * len(GangState.__dataclass_fields__))))
     res = jax.tree_util.tree_map(lambda _: repl,
                                  ReservationState(*([0] * len(ReservationState.__dataclass_fields__))))
+    devs = jax.tree_util.tree_map(node_field,
+                                  DeviceState(*([0] * len(DeviceState.__dataclass_fields__))))
     return ClusterSnapshot(nodes=nodes, quotas=quotas, gangs=gangs,
-                           reservations=res, version=repl)
+                           reservations=res, devices=devs, version=repl)
 
 
 def shard_snapshot(snap: ClusterSnapshot, mesh: Mesh) -> ClusterSnapshot:

@@ -14,9 +14,11 @@ from koordinator_tpu.snapshot.schema import PodBatch
 
 EPS = 0.5  # comparison tolerance in canonical units (millicores / MiB)
 MAX_NODE_SCORE = 100.0  # framework.MaxNodeScore — single source of truth;
-                        # the reservation-slot preference (MAX_NODE_SCORE+1
+                        # the reservation-slot preference (3*MAX_NODE_SCORE+1
                         # in core.py) relies on every plugin score topping
-                        # out at this value
+                        # out at this value and at most THREE plugin scores
+                        # (loadaware + numa + device) summing per node —
+                        # raise the slot multiplier when adding a fourth
 
 
 def rank_by_priority(pods: PodBatch) -> jnp.ndarray:

@@ -50,7 +50,7 @@ from koordinator_tpu.scheduler.batching import (
     rank_by_priority,
     segment_prefix_ok,
 )
-from koordinator_tpu.scheduler.plugins import loadaware, numaaware
+from koordinator_tpu.scheduler.plugins import deviceshare, loadaware, numaaware
 from koordinator_tpu.scheduler.plugins.reservation import (
     MAX_NODE_SCORE,
     rebuild_reservations,
@@ -59,6 +59,8 @@ from koordinator_tpu.scheduler.plugins.reservation import (
 from koordinator_tpu.snapshot.schema import (
     ClusterSnapshot,
     MAX_QUOTA_DEPTH,
+    NUM_AUX_TYPES,
+    NUM_DEV_DIMS,
     PodBatch,
 )
 
@@ -70,13 +72,19 @@ class ScheduleResult:
     numa_zone: jnp.ndarray       # i32[P] zone taken by NUMA-bound pods, -1
                                  # (feeds the resource-status annotation /
                                  # host cpuset accumulator at bind time)
+    gpu_take: jnp.ndarray        # bool[P, I] GPU instances taken on the
+                                 # assigned node (feeds the device-allocation
+                                 # annotation at bind, plugin.go PreBind)
+    aux_inst: jnp.ndarray        # i32[P, A] aux (rdma/fpga) instance, -1
     snapshot: ClusterSnapshot    # post-commit snapshot (requested/used updated)
 
 
 @functools.partial(jax.jit, static_argnames=("num_rounds", "k_choices",
                                              "score_dims", "approx_topk",
                                              "tie_break", "enable_numa",
-                                             "numa_strategy"))
+                                             "numa_strategy",
+                                             "enable_devices",
+                                             "device_strategy"))
 def schedule_batch(snap: ClusterSnapshot, pods: PodBatch,
                    cfg: loadaware.LoadAwareConfig,
                    num_rounds: int = 4, k_choices: int = 8,
@@ -84,14 +92,23 @@ def schedule_batch(snap: ClusterSnapshot, pods: PodBatch,
                    approx_topk: bool = False,
                    tie_break: bool = False,
                    enable_numa: bool = True,
-                   numa_strategy: str = "most") -> ScheduleResult:
+                   numa_strategy: str = "most",
+                   enable_devices: bool = True,
+                   device_strategy: str = "least") -> ScheduleResult:
     """Schedule a pod batch against the snapshot. Pure function; the caller
     publishes `result.snapshot` as the next version (store.update)."""
     nodes0, quotas0, gangs0 = snap.nodes, snap.quotas, snap.gangs
+    devices0 = snap.devices
     n_nodes = nodes0.num_nodes
     n_quotas = quotas0.min.shape[0]
     n_gangs = gangs0.min_member.shape[0]
     p = pods.num_pods
+    # device pools are skipped entirely when the snapshot has no instance
+    # capacity (static shapes, so this specializes the compiled program)
+    n_inst = devices0.gpu_free.shape[1]
+    n_aux = devices0.aux_free.shape[2]
+    use_gpu = enable_devices and n_inst > 0
+    use_aux = enable_devices and n_aux > 0
 
     rank = rank_by_priority(pods)
     # rank[p'] < rank[p], shared by every prefix gate in the commit
@@ -117,6 +134,16 @@ def schedule_batch(snap: ClusterSnapshot, pods: PodBatch,
     # touches no NodeInfo.requested), so compute it once for the batch.
     la_ok = loadaware.filter_mask(nodes0, pods, cfg)
     static_ok = la_ok & sel_ok & nodes0.schedulable[None, :]     # [P, N]
+    if enable_devices:
+        # batch-start device upper bound (exact instance gates run in the
+        # inner commit); also rejects device pods on device-less nodes —
+        # including ratio-only GPU requests, which don't appear in the
+        # node-allocatable columns (deviceshare
+        # UnschedulableAndUnresolvable). Runs even with zero instance
+        # capacity so such pods never silently place without a GPU.
+        static_ok &= deviceshare.prefilter(devices0, pods)
+    if use_gpu:
+        dev_scores = deviceshare.score_matrix(devices0, pods, device_strategy)
     numa_used0 = nodes0.numa_cap - nodes0.numa_free              # [N, Z, 2]
     if enable_numa:
         # single-NUMA-node prefilter (upper bound; exact gate in the inner
@@ -150,9 +177,9 @@ def schedule_batch(snap: ClusterSnapshot, pods: PodBatch,
         return jnp.where(ext_idx >= n_nodes, slot_node_c[s], ext_idx)
 
     def round_body(carry, _):
-        requested, quota_used, numa_used, once_taken, assigned_est, \
-            prod_assigned_est, gang_placed, placed, out_score, \
-            out_zone = carry
+        requested, quota_used, numa_used, gpu_free, aux_free, once_taken, \
+            assigned_est, prod_assigned_est, gang_placed, placed, out_score, \
+            out_zone, out_gpu_take, out_aux = carry
         active = pods.valid & (placed < 0) & gang_ok
 
         nodes = nodes0.replace(
@@ -191,13 +218,16 @@ def schedule_batch(snap: ClusterSnapshot, pods: PodBatch,
             # framework sums plugin scores; NUMA preference only affects
             # NUMA-bound pods (numa_scores is 0 elsewhere)
             scores = scores + numa_scores
+        if use_gpu:
+            # device preference likewise only affects GPU-requesting pods
+            scores = scores + dev_scores
         if n_slots:
-            # slot columns score MaxNodeScore + 1: owners strictly prefer
-            # their reservation over any node (nominator preference); safe
-            # because slot-eligible pods are never NUMA-bound, so their
-            # node scores top out at MAX_NODE_SCORE
+            # slot columns outscore any node sum: owners strictly prefer
+            # their reservation (nominator preference); safe because slot-
+            # eligible pods are never NUMA-bound nor device-requesting, so
+            # their node scores top out at MAX_NODE_SCORE
             scores = jnp.concatenate(
-                [scores, jnp.full((p, n_slots), MAX_NODE_SCORE + 1.0)],
+                [scores, jnp.full((p, n_slots), 3.0 * MAX_NODE_SCORE + 1.0)],
                 axis=1)
         if tie_break:
             # k8s selectHost picks uniformly among max-score nodes
@@ -221,8 +251,9 @@ def schedule_batch(snap: ClusterSnapshot, pods: PodBatch,
         topk_idx = topk_idx.astype(jnp.int32)
 
         def inner(inner_carry, _):
-            requested, quota_used, numa_used, once_taken, placed, kptr, \
-                out_score, out_zone = inner_carry
+            requested, quota_used, numa_used, gpu_free, aux_free, \
+                once_taken, placed, kptr, out_score, out_zone, \
+                out_gpu_take, out_aux = inner_carry
             val = jnp.take_along_axis(topk_val, kptr[:, None], 1)[:, 0]
             choice = jnp.take_along_axis(topk_idx, kptr[:, None], 1)[:, 0]
             trying = active & (placed < 0) & (kptr < k) & (val > -0.5)
@@ -248,14 +279,28 @@ def schedule_batch(snap: ClusterSnapshot, pods: PodBatch,
                     anc_eff, earlier, acc_req, quota_used,
                     quotas0.runtime, n_quotas)
 
+            # All remaining gates only SHRINK accept; every scatter-commit
+            # is deferred until accept is final, so a pod rejected by a
+            # later gate (device, AllocateOnce) never leaves a stale zone/
+            # instance charge behind.
+            if use_gpu:
+                g_count, g_per = deviceshare.per_instance_at(
+                    devices0, pods, choice_eff)
             if enable_numa:
-                # zone pick on the chosen node from live usage, then the
-                # same prefix gate over flat (node, zone) segments (slot
-                # choices never carry numa_single pods — slot_columns
-                # excludes them)
+                # zone pick on the chosen node from live usage — the hint
+                # intersection of the CPU/mem provider and (when GPUs are
+                # present) the deviceshare provider, so a NUMA-bound GPU
+                # pod lands on a zone that can hold BOTH its cpuset and its
+                # instances — then the same prefix gate over flat (node,
+                # zone) segments (slot choices never carry numa_single
+                # pods — slot_columns excludes them)
+                gpu_hint = (deviceshare.gpu_zone_hint(
+                    gpu_free, devices0, choice_eff, g_per, g_count,
+                    n_zones) if use_gpu else None)
                 zone, zone_fit_ok = numaaware.choose_zone(
                     numa_used, nodes0.numa_cap, nodes0.numa_valid,
-                    choice_eff, req2, pods.numa_single, numa_strategy)
+                    choice_eff, req2, pods.numa_single, numa_strategy,
+                    extra_zone_ok=gpu_hint)
                 accept &= zone_fit_ok
                 is_bound = accept & pods.numa_single
                 zone_seg = jnp.where(is_bound,
@@ -266,14 +311,74 @@ def schedule_batch(snap: ClusterSnapshot, pods: PodBatch,
                     zone_seg, earlier, zreq,
                     numa_used.reshape(-1, 2), numa_cap_flat,
                     n_nodes * n_zones)
-                is_bound = accept & pods.numa_single
-                zone_seg = jnp.where(is_bound,
-                                     choice_eff * n_zones + zone,
-                                     n_nodes * n_zones)
-                numa_used = numa_used.reshape(-1, 2).at[zone_seg].add(
-                    req2 * is_bound[:, None],
-                    mode="drop").reshape(numa_used.shape)
-                out_zone = jnp.where(is_bound, zone, out_zone)
+
+            if use_gpu:
+                # --- GPU instance gates (deviceshare allocateDevices) ---
+                # device pods are never slot candidates, so choice_eff is a
+                # real node index whenever these gates bind
+                shared = g_count == 1
+                multi = g_count > 1
+                # with NUMA modeling off, the zone constraint is dropped
+                # (not tightened against a sentinel zone)
+                if enable_numa:
+                    zone_for_dev, numa_bound_dev = zone, pods.numa_single
+                else:
+                    zone_for_dev = jnp.full((p,), -1, jnp.int32)
+                    numa_bound_dev = jnp.zeros((p,), bool)
+                inst, inst_ok = deviceshare.choose_gpu_instance(
+                    gpu_free, devices0, choice_eff, g_per, shared,
+                    numa_bound_dev, zone_for_dev, device_strategy)
+                accept &= ~shared | inst_ok
+                gseg = jnp.where(accept & shared,
+                                 choice_eff * n_inst + inst,
+                                 n_nodes * n_inst)
+                greq = g_per * (accept & shared)[:, None]
+                gpu_free_flat = gpu_free.reshape(-1, NUM_DEV_DIMS)
+                accept &= segment_prefix_ok(
+                    gseg, earlier, greq, jnp.zeros_like(gpu_free_flat),
+                    gpu_free_flat, n_nodes * n_inst)
+                took_shared = accept & shared
+                # multi-GPU (whole instances): one winner per node per inner
+                # step keeps lowest-index instance identity unambiguous;
+                # contenders fall through to the next step/round. Instances
+                # tentatively taken by this step's shared pods are excluded
+                # (shared-before-multi intra-step order; exact order is
+                # recovered at chunk size 1).
+                shared_taken_now = jnp.zeros(
+                    (n_nodes * n_inst + 1,), bool).at[
+                        jnp.where(took_shared, choice_eff * n_inst + inst,
+                                  n_nodes * n_inst)].set(True)[:-1]
+                nc = jnp.clip(choice_eff, 0, n_nodes - 1)
+                take, enough = deviceshare.full_fit_instances(
+                    gpu_free, devices0, choice_eff, g_per, g_count,
+                    numa_bound_dev, zone_for_dev,
+                    exclude=shared_taken_now.reshape(n_nodes, n_inst)[nc])
+                same_node = choice_eff[:, None] == choice_eff[None, :]
+                multi_cand = multi & accept
+                first_multi = ~jnp.any(earlier & same_node
+                                       & multi_cand[None, :], axis=-1)
+                accept = jnp.where(multi, accept & first_multi & enough,
+                                   accept)
+
+            if use_aux:
+                # --- aux (rdma/fpga) VF gates (default device handler) ---
+                aux_free_flat = aux_free.reshape(-1, 1)
+                aux_insts = []
+                for t in range(NUM_AUX_TYPES):
+                    a_req = pods.requests[:, deviceshare.AUX_KINDS[t]]
+                    has = a_req > 0
+                    a_inst, a_ok = deviceshare.choose_aux_instance(
+                        aux_free, devices0, choice_eff, t, a_req,
+                        device_strategy)
+                    accept &= ~has | a_ok
+                    base = (choice_eff * NUM_AUX_TYPES + t) * n_aux
+                    aseg = jnp.where(accept & has, base + a_inst,
+                                     n_nodes * NUM_AUX_TYPES * n_aux)
+                    areq = (a_req * (accept & has))[:, None]
+                    accept &= segment_prefix_ok(
+                        aseg, earlier, areq, jnp.zeros_like(aux_free_flat),
+                        aux_free_flat, n_nodes * NUM_AUX_TYPES * n_aux)
+                    aux_insts.append(a_inst)
 
             if n_slots:
                 # AllocateOnce: single consumer per slot — among this
@@ -289,7 +394,46 @@ def schedule_batch(snap: ClusterSnapshot, pods: PodBatch,
                     jnp.where(once_win, slot_of, n_slots)].set(
                         True, mode="drop")
 
-            # scatter-commit (assume; scheduler_adapter assume/forget)
+            # scatter-commit (assume; scheduler_adapter assume/forget) —
+            # accept is final from here on
+            if enable_numa:
+                is_bound = accept & pods.numa_single
+                zone_seg = jnp.where(is_bound,
+                                     choice_eff * n_zones + zone,
+                                     n_nodes * n_zones)
+                numa_used = numa_used.reshape(-1, 2).at[zone_seg].add(
+                    req2 * is_bound[:, None],
+                    mode="drop").reshape(numa_used.shape)
+                out_zone = jnp.where(is_bound, zone, out_zone)
+            if use_gpu:
+                took_shared = accept & shared
+                gseg = jnp.where(took_shared, choice_eff * n_inst + inst,
+                                 n_nodes * n_inst)
+                gpu_free = gpu_free.reshape(-1, NUM_DEV_DIMS).at[gseg].add(
+                    -g_per * took_shared[:, None],
+                    mode="drop").reshape(gpu_free.shape)
+                took_multi = accept & multi
+                g_upd = (take[:, :, None] * g_per[:, None, :]
+                         * took_multi[:, None, None])
+                g_tgt = jnp.where(took_multi, choice_eff, n_nodes)
+                gpu_free = gpu_free.at[g_tgt].add(-g_upd, mode="drop")
+                inst_onehot = (jnp.arange(n_inst, dtype=jnp.int32)[None, :]
+                               == inst[:, None])
+                out_gpu_take |= (inst_onehot & took_shared[:, None]) | \
+                    (take & took_multi[:, None])
+            if use_aux:
+                aux_free_flat = aux_free.reshape(-1, 1)
+                for t in range(NUM_AUX_TYPES):
+                    a_req = pods.requests[:, deviceshare.AUX_KINDS[t]]
+                    took_a = accept & (a_req > 0)
+                    base = (choice_eff * NUM_AUX_TYPES + t) * n_aux
+                    aseg = jnp.where(took_a, base + aux_insts[t],
+                                     n_nodes * NUM_AUX_TYPES * n_aux)
+                    aux_free_flat = aux_free_flat.at[aseg].add(
+                        -(a_req * took_a)[:, None], mode="drop")
+                    out_aux = out_aux.at[:, t].set(
+                        jnp.where(took_a, aux_insts[t], out_aux[:, t]))
+                aux_free = aux_free_flat.reshape(aux_free.shape)
             acc_req = pods.requests * accept[:, None]
             requested = requested.at[choice_eff].add(acc_req, mode="drop")
             for d in range(MAX_QUOTA_DEPTH):
@@ -301,15 +445,18 @@ def schedule_batch(snap: ClusterSnapshot, pods: PodBatch,
             out_score = jnp.where(accept, val, out_score)
             # a rejected pod's chosen node just filled up: fall through
             kptr = jnp.where(trying & ~accept, kptr + 1, kptr)
-            return (requested, quota_used, numa_used, once_taken, placed,
-                    kptr, out_score, out_zone), None
+            return (requested, quota_used, numa_used, gpu_free, aux_free,
+                    once_taken, placed, kptr, out_score, out_zone,
+                    out_gpu_take, out_aux), None
 
-        (requested, quota_used, numa_used, once_taken, placed, _, out_score,
-         out_zone), _ = jax.lax.scan(
-            inner,
-            (requested, quota_used, numa_used, once_taken, placed,
-             jnp.zeros((p,), jnp.int32), out_score, out_zone),
-            None, length=k)
+        (requested, quota_used, numa_used, gpu_free, aux_free, once_taken,
+         placed, _, out_score, out_zone, out_gpu_take, out_aux), _ = \
+            jax.lax.scan(
+                inner,
+                (requested, quota_used, numa_used, gpu_free, aux_free,
+                 once_taken, placed, jnp.zeros((p,), jnp.int32), out_score,
+                 out_zone, out_gpu_take, out_aux),
+                None, length=k)
 
         # register newly placed pods' estimates for the next round's scores
         # (podAssignCache tracks reservation consumers on the REAL node too)
@@ -323,23 +470,28 @@ def schedule_batch(snap: ClusterSnapshot, pods: PodBatch,
         gang_placed = gang_placed.at[jnp.where(new & (pods.gang_id >= 0),
                                                pods.gang_id, n_gangs)].add(
             1, mode="drop")
-        return (requested, quota_used, numa_used, once_taken, assigned_est,
-                prod_assigned_est, gang_placed, placed, out_score,
-                out_zone), None
+        return (requested, quota_used, numa_used, gpu_free, aux_free,
+                once_taken, assigned_est, prod_assigned_est, gang_placed,
+                placed, out_score, out_zone, out_gpu_take, out_aux), None
 
     init = (
         jnp.concatenate([nodes0.requested,
                          jnp.zeros_like(slot_alloc0)], axis=0),
         quotas0.used,
         numa_used0,
+        devices0.gpu_free,
+        devices0.aux_free,
         jnp.zeros((n_slots,), bool),
         nodes0.assigned_estimated,
         nodes0.prod_assigned_estimated,
         jnp.zeros((n_gangs,), jnp.int32),
         jnp.full((p,), -1, jnp.int32),
         jnp.full((p,), -1.0, jnp.float32),
-        jnp.full((p,), -1, jnp.int32))
-    (_, _, _, _, _, _, gang_placed, placed, out_score, out_zone), _ = \
+        jnp.full((p,), -1, jnp.int32),
+        jnp.zeros((p, n_inst), bool),
+        jnp.full((p, NUM_AUX_TYPES), -1, jnp.int32))
+    (_, _, _, _, _, _, _, _, gang_placed, placed, out_score, out_zone,
+     out_gpu_take, out_aux), _ = \
         jax.lax.scan(round_body, init, None, length=num_rounds)
 
     # --- gang all-or-nothing rollback (Permit barrier, core.go:311-341) ---
@@ -387,9 +539,36 @@ def schedule_batch(snap: ClusterSnapshot, pods: PodBatch,
             -req2 * bound[:, None], mode="drop")
             .reshape(nodes0.numa_free.shape))
 
-    # slot rows scored MaxNodeScore+1 for strict preference; report those
-    # capped at MaxNodeScore (node-placed NUMA pods legitimately exceed 100
-    # — plugin scores sum — and keep their real value)
+    # device pools from the surviving assignment (revoked gang members give
+    # their instances back); per-instance requests are a pure function of
+    # (pod, assigned node), so only the take masks carry through the scan
+    new_devices = devices0
+    gpu_take = out_gpu_take & ok[:, None]
+    aux_inst = jnp.where(ok[:, None], out_aux, -1)
+    if use_gpu:
+        _, per_f = deviceshare.per_instance_at(devices0, pods, placed_real)
+        g_upd = gpu_take[:, :, None] * per_f[:, None, :]
+        g_tgt = jnp.where(ok, placed_real, n_nodes)
+        new_gpu_free = devices0.gpu_free.at[g_tgt].add(-g_upd, mode="drop")
+        new_devices = new_devices.replace(
+            gpu_free=jnp.maximum(new_gpu_free, 0.0))
+    if use_aux:
+        aux_flat = devices0.aux_free.reshape(-1, 1)
+        for t in range(NUM_AUX_TYPES):
+            a_req = pods.requests[:, deviceshare.AUX_KINDS[t]]
+            took = ok & (a_req > 0) & (aux_inst[:, t] >= 0)
+            base = (jnp.maximum(placed_real, 0) * NUM_AUX_TYPES + t) * n_aux
+            aseg = jnp.where(took, base + aux_inst[:, t],
+                             n_nodes * NUM_AUX_TYPES * n_aux)
+            aux_flat = aux_flat.at[aseg].add(-(a_req * took)[:, None],
+                                             mode="drop")
+        new_devices = new_devices.replace(
+            aux_free=jnp.maximum(
+                aux_flat.reshape(devices0.aux_free.shape), 0.0))
+
+    # slot rows outscore any node sum for strict preference; report those
+    # capped at MaxNodeScore (node-placed NUMA/device pods legitimately
+    # exceed 100 — plugin scores sum — and keep their real value)
     chosen_score = jnp.where(
         ok, jnp.where(res_slot >= 0,
                       jnp.minimum(out_score, MAX_NODE_SCORE), out_score),
@@ -403,7 +582,9 @@ def schedule_batch(snap: ClusterSnapshot, pods: PodBatch,
         gangs=gangs0.replace(assumed=gang_assumed),
         reservations=rebuild_reservations(snap.reservations, pods,
                                           res_slot, ok),
+        devices=new_devices,
         version=snap.version + 1,
     )
     return ScheduleResult(assignment=placed_real, chosen_score=chosen_score,
-                          numa_zone=numa_zone, snapshot=new_snap)
+                          numa_zone=numa_zone, gpu_take=gpu_take,
+                          aux_inst=aux_inst, snapshot=new_snap)

@@ -1,0 +1,268 @@
+"""DeviceShare plugin: batched GPU/RDMA/FPGA instance fit, scoring, and
+instance-selection kernels.
+
+Behavior parity with plugins/deviceshare/ (SURVEY.md 2.1):
+- GPU requests arrive as gpu-core / gpu-memory / gpu-memory-ratio
+  (apis/extension/device_share.go:44-46). Per node, an explicit gpu-memory
+  request is converted to a ratio against that node's per-GPU memory and
+  vice versa (devicehandler_gpu.go:68-90 fillGPUTotalMem); a ratio > 100
+  divisible by 100 means `ratio/100` whole GPUs with the request split
+  evenly per instance (devicehandler_gpu.go:54-64).
+- Allocation packs `count` instances each satisfying the per-instance
+  request on all three dims (device_allocator.go allocateDevices); instance
+  preference follows the least/most-allocated scorer (device_resources.go
+  scoreDevices).
+- RDMA/FPGA follow the default device handler: one instance (VF pool)
+  serves the whole request (devicehandler_default.go).
+- Node score is the least/most-allocated fraction over the node's GPU pool
+  (scoring.go resourceAllocationScorer), 0 for pods without device requests.
+
+TPU design: device instances are fixed-capacity columns ([N, I, 3] GPU,
+[N, A, J] aux); the per-node allocator loop becomes an argmax over the
+instance axis, and concurrent instance commits reuse the segment prefix gate
+with flattened (node, instance) segment ids — the same machinery as NUMA
+zones. Multi-GPU pods consume whole instances; identity among interchangeable
+fully-free instances is the lowest-index prefix, with at most one multi-GPU
+pod admitted per node per inner commit step (losers fall through to the next
+step/round), which keeps instance identity unambiguous without a sort.
+
+Documented deviations (tracked for later rounds): PCIe joint-allocate is a
+bind-time minor-ordering preference on host (allocation counts are
+identical); device capacity covered by Reservations is not restored
+(device-requesting pods schedule on real nodes only).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax.numpy as jnp
+
+from koordinator_tpu.api.extension import ResourceKind
+from koordinator_tpu.scheduler.batching import EPS, MAX_NODE_SCORE
+from koordinator_tpu.snapshot.schema import (
+    AUX_FPGA,
+    AUX_RDMA,
+    DEV_CORE,
+    DEV_MEM,
+    DEV_RATIO,
+    DeviceState,
+    PodBatch,
+)
+
+GPU_CORE = int(ResourceKind.GPU_CORE)
+GPU_MEMORY = int(ResourceKind.GPU_MEMORY)
+# aux pool index -> ResourceKind column carrying the request
+AUX_KINDS = (int(ResourceKind.RDMA), int(ResourceKind.FPGA))
+
+
+def has_gpu_request(pods: PodBatch) -> jnp.ndarray:
+    """bool[P]: pod requests any GPU resource (requests may also be a
+    broadcast [P, N, R] view, hence the ellipsis indexing)."""
+    return ((pods.requests[..., GPU_CORE] > 0)
+            | (pods.requests[..., GPU_MEMORY] > 0)
+            | (pods.gpu_ratio > 0))
+
+
+def has_device_request(pods: PodBatch) -> jnp.ndarray:
+    """bool[P]: pod requests any device resource (GPU or aux pools)."""
+    out = has_gpu_request(pods)
+    for kind in AUX_KINDS:
+        out |= pods.requests[..., kind] > 0
+    return out
+
+
+def _per_instance(total_mem, pods: PodBatch):
+    """Per-instance GPU request against nodes whose per-GPU memory is
+    `total_mem` (broadcastable against [P]).
+
+    Returns (count, per_inst[..., 3]) with the reference's integer floor
+    division (devicehandler_gpu.go:54-64, memoryBytesToRatio truncation).
+    Pods without GPU requests get count=0 and a zero per_inst row.
+    """
+    core = pods.requests[..., GPU_CORE]
+    mem = pods.requests[..., GPU_MEMORY]
+    mem_specified = mem > 0
+    safe_total = jnp.maximum(total_mem, 1.0)
+    ratio_eff = jnp.where(mem_specified,
+                          jnp.floor(mem / safe_total * 100.0),
+                          pods.gpu_ratio)
+    mem_eff = jnp.where(mem_specified, mem,
+                        jnp.floor(pods.gpu_ratio * total_mem / 100.0))
+    multi = (ratio_eff > 100.0) & (jnp.mod(ratio_eff, 100.0) == 0.0)
+    count = jnp.where(multi, ratio_eff / 100.0, 1.0)
+    per_inst = jnp.stack([jnp.floor(core / count),
+                          jnp.floor(mem_eff / count),
+                          jnp.floor(ratio_eff / count)], axis=-1)
+    gpu = has_gpu_request(pods)
+    shape = jnp.broadcast_shapes(count.shape, gpu.shape)
+    gpu = jnp.broadcast_to(gpu, shape)
+    count = jnp.where(gpu, count, 0.0).astype(jnp.int32)
+    per_inst = per_inst * gpu[..., None]
+    return count, per_inst
+
+
+def per_instance_at(devices: DeviceState, pods: PodBatch,
+                    node_idx: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """(count i32[P], per_inst f32[P, 3]) at each pod's chosen node
+    (node_idx may be out of range = "no node"; clipped)."""
+    n = devices.gpu_total.shape[0]
+    nc = jnp.clip(node_idx, 0, n - 1)
+    return _per_instance(devices.gpu_total[nc, DEV_MEM], pods)
+
+
+def prefilter(devices: DeviceState, pods: PodBatch) -> jnp.ndarray:
+    """bool[P, N]: batch-start upper bound — the node has >= count instances
+    each fitting the per-instance request, and every requested aux pool has
+    a fitting instance. Free only shrinks during commit, so this is sound
+    (the exact gate runs per inner commit step on the chosen node).
+    Non-device pods pass everywhere."""
+    total_mem = devices.gpu_total[None, :, DEV_MEM]          # [1, N]
+    count, per_inst = _per_instance(
+        total_mem, pods.replace(
+            requests=pods.requests[:, None, :],
+            gpu_ratio=pods.gpu_ratio[:, None]))              # [P, N], [P,N,3]
+    fits = jnp.all(devices.gpu_free[None] + EPS >= per_inst[:, :, None, :],
+                   axis=-1)
+    fits &= devices.gpu_valid[None]                          # [P, N, I]
+    n_fit = jnp.sum(fits, axis=-1)                           # [P, N]
+    ok = ~has_gpu_request(pods)[:, None] | (n_fit >= count)
+    for t, kind in enumerate(AUX_KINDS):
+        req = pods.requests[:, kind]
+        aux_ok = jnp.any(
+            (devices.aux_free[None, :, t, :] + EPS >= req[:, None, None])
+            & devices.aux_valid[None, :, t, :], axis=-1)     # [P, N]
+        ok &= (req <= 0)[:, None] | aux_ok
+    return ok
+
+
+def score_matrix(devices: DeviceState, pods: PodBatch,
+                 strategy: str = "least") -> jnp.ndarray:
+    """f32[P, N] in [0, 100]: least/most-allocated score of the node's GPU
+    pool after the hypothetical allocation, over the dims the pod requests
+    (scoring.go resourceAllocationScorer); 0 for pods without GPU requests.
+
+    Default strategy is LeastAllocated (DeviceShareArgs defaulting,
+    scheduler/apis/config/v1beta2/defaults.go).
+    """
+    total_mem = devices.gpu_total[None, :, DEV_MEM]
+    count, per_inst = _per_instance(
+        total_mem, pods.replace(
+            requests=pods.requests[:, None, :],
+            gpu_ratio=pods.gpu_ratio[:, None]))              # [P, N], [P,N,3]
+    valid_n = jnp.sum(devices.gpu_valid, axis=-1)            # [N]
+    pool_total = devices.gpu_total * valid_n[:, None]        # [N, 3]
+    pool_free = jnp.sum(
+        devices.gpu_free * devices.gpu_valid[..., None], axis=1)  # [N, 3]
+    alloc = per_inst * count[..., None]                      # [P, N, 3]
+    used_after = (pool_total - pool_free)[None] + alloc
+    frac = used_after / jnp.maximum(pool_total[None], 1e-9)
+    requested_dim = per_inst > 0                             # [P, N, 3]
+    w = requested_dim.astype(jnp.float32)
+    wsum = jnp.maximum(jnp.sum(w, axis=-1), 1.0)
+    if strategy == "most":
+        s = jnp.sum(frac * w, axis=-1) / wsum
+    else:
+        s = jnp.sum((1.0 - frac) * w, axis=-1) / wsum
+    score = jnp.clip(s, 0.0, 1.0) * MAX_NODE_SCORE
+    return jnp.where(has_gpu_request(pods)[:, None], score, 0.0)
+
+
+def gpu_zone_hint(gpu_free: jnp.ndarray, devices: DeviceState,
+                  node_idx: jnp.ndarray, per_inst: jnp.ndarray,
+                  count: jnp.ndarray, n_zones: int) -> jnp.ndarray:
+    """bool[P, Z]: zone z of the chosen node has >= count fitting instances
+    — the deviceshare NUMATopologyHintProvider's hint, intersected into the
+    zone merge (topology_hint.go GetPodTopologyHints). All-True for pods
+    without GPU requests so the CPU/mem providers decide alone."""
+    n = gpu_free.shape[0]
+    nc = jnp.clip(node_idx, 0, n - 1)
+    fits = jnp.all(gpu_free[nc] + EPS >= per_inst[:, None, :], axis=-1)
+    fits &= devices.gpu_valid[nc]                            # [P, I]
+    zid = devices.gpu_numa[nc]                               # [P, I]
+    onehot = zid[:, :, None] == jnp.arange(n_zones,
+                                           dtype=zid.dtype)[None, None, :]
+    counts = jnp.sum((fits[:, :, None] & onehot).astype(jnp.int32), axis=1)
+    return (counts >= count[:, None]) | (count == 0)[:, None]
+
+
+def choose_gpu_instance(gpu_free: jnp.ndarray, devices: DeviceState,
+                        node_idx: jnp.ndarray, per_inst: jnp.ndarray,
+                        shared: jnp.ndarray, numa_single: jnp.ndarray,
+                        numa_zone: jnp.ndarray,
+                        strategy: str = "least"
+                        ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Pick each shared-GPU pod's instance on its chosen node from live free
+    state (the scoreDevices instance preference).
+
+    NUMA-bound pods only take instances on their chosen zone (the hint
+    providers' merged affinity, topology_hint.go). Returns (inst i32[P],
+    ok bool[P]); ok is True for pods the shared gate doesn't apply to.
+    Exactness among contending pods comes from the caller's segment prefix
+    gate over (node, instance) ids.
+    """
+    n = gpu_free.shape[0]
+    nc = jnp.clip(node_idx, 0, n - 1)
+    free = gpu_free[nc]                                      # [P, I, 3]
+    fits = jnp.all(free + EPS >= per_inst[:, None, :], axis=-1)
+    fits &= devices.gpu_valid[nc]                            # [P, I]
+    aligned = devices.gpu_numa[nc] == numa_zone[:, None]
+    fits &= ~numa_single[:, None] | aligned
+    # instance preference keyed on free core: least-allocated spreads
+    # (freest instance), most-allocated packs (fullest fitting instance)
+    key = free[..., DEV_CORE]
+    if strategy == "most":
+        key = jnp.where(fits, key, jnp.inf)
+        inst = jnp.argmin(key, axis=-1).astype(jnp.int32)
+    else:
+        key = jnp.where(fits, key, -jnp.inf)
+        inst = jnp.argmax(key, axis=-1).astype(jnp.int32)
+    ok = jnp.any(fits, axis=-1) | ~shared
+    return inst, ok
+
+
+def full_fit_instances(gpu_free: jnp.ndarray, devices: DeviceState,
+                       node_idx: jnp.ndarray, per_inst: jnp.ndarray,
+                       count: jnp.ndarray, numa_single: jnp.ndarray,
+                       numa_zone: jnp.ndarray,
+                       exclude: jnp.ndarray = None
+                       ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """For multi-GPU pods: (take bool[P, I], enough bool[P]) — the lowest-
+    index `count` fitting instances on the chosen node, and whether there
+    are at least `count` of them.
+
+    NUMA-bound pods only take instances on their chosen zone (same
+    alignment rule as choose_gpu_instance); `exclude` bool[P, I] marks
+    instances unavailable to this pod (e.g. tentatively taken by the same
+    commit step's shared pods).
+    """
+    n = gpu_free.shape[0]
+    nc = jnp.clip(node_idx, 0, n - 1)
+    fits = jnp.all(gpu_free[nc] + EPS >= per_inst[:, None, :], axis=-1)
+    fits &= devices.gpu_valid[nc]                            # [P, I]
+    if exclude is not None:
+        fits &= ~exclude
+    aligned = devices.gpu_numa[nc] == numa_zone[:, None]
+    fits &= ~numa_single[:, None] | aligned
+    enough = jnp.sum(fits, axis=-1) >= count
+    cum = jnp.cumsum(fits.astype(jnp.int32), axis=-1)
+    take = fits & (cum <= count[:, None])
+    return take, enough
+
+
+def choose_aux_instance(aux_free: jnp.ndarray, devices: DeviceState,
+                        node_idx: jnp.ndarray, pool: int,
+                        req: jnp.ndarray,
+                        strategy: str = "least"
+                        ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Pick an aux (RDMA/FPGA) instance with free >= req on the chosen
+    node. Returns (inst i32[P], ok bool[P]); ok is True when req == 0."""
+    n = aux_free.shape[0]
+    nc = jnp.clip(node_idx, 0, n - 1)
+    free = aux_free[nc, pool]                                # [P, J]
+    fits = (free + EPS >= req[:, None]) & devices.aux_valid[nc, pool]
+    key = jnp.where(fits, free, jnp.inf if strategy == "most" else -jnp.inf)
+    inst = (jnp.argmin(key, axis=-1) if strategy == "most"
+            else jnp.argmax(key, axis=-1)).astype(jnp.int32)
+    ok = jnp.any(fits, axis=-1) | (req <= 0)
+    return inst, ok

@@ -87,11 +87,17 @@ def numa_score_matrix(nodes: NodeState, pods: PodBatch,
 def choose_zone(numa_used: jnp.ndarray, numa_cap: jnp.ndarray,
                 numa_valid: jnp.ndarray, choice: jnp.ndarray,
                 req2: jnp.ndarray, numa_single: jnp.ndarray,
-                strategy: str = "most") -> Tuple[jnp.ndarray, jnp.ndarray]:
+                strategy: str = "most",
+                extra_zone_ok: jnp.ndarray = None
+                ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Pick each pod's zone on its chosen node from live usage state.
 
     Args: numa_used/cap [N, Z, 2], numa_valid [N, Z], choice i32[P] (may be
     out of range = "no node"), req2 f32[P, 2].
+    `extra_zone_ok` bool[P, Z] ANDs additional per-zone admissibility into
+    the fit — the merged hint of other NUMA providers (deviceshare GPU zone
+    counts, topologymanager policy merge): a zone is only eligible when
+    EVERY provider admits it, mirroring kubelet-style hint intersection.
     Returns (zone i32[P], zone_ok bool[P]); zone_ok is True for unbound
     pods. Exactness among contending pods comes from the caller's segment
     prefix gate over (node, zone) ids.
@@ -108,6 +114,8 @@ def choose_zone(numa_used: jnp.ndarray, numa_cap: jnp.ndarray,
     free = numa_cap[node_c] - numa_used[node_c]         # [P, Z, 2]
     fits = jnp.all(free + EPS >= req2[:, None, :], axis=-1)
     fits &= numa_valid[node_c]                          # [P, Z]
+    if extra_zone_ok is not None:
+        fits &= extra_zone_ok
     # strategy key on cpu-free: MostAllocated packs (least free wins)
     key = free[..., 0]
     key = jnp.where(fits, key, jnp.inf if strategy == "most" else -jnp.inf)

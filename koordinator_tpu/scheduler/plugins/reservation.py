@@ -51,10 +51,14 @@ def slot_columns(snap: ClusterSnapshot, pods: PodBatch,
     - slot_ok: pod may consume slot v — owner match (transformer.go
       matched-owner restore) AND the slot's underlying node passes the
       pod's round-invariant gates (Filter still applies on that node);
-      NUMA-bound pods are excluded (reserved cpusets not modeled yet).
+      NUMA-bound and device-requesting pods are excluded (reserved cpusets
+      / reserved device instances not modeled yet — those pods schedule on
+      real nodes, conservatively leaving reserved capacity charged).
     - slot_alloc: the slot's capacity = remaining reserved free.
     - slot_node: underlying real node per slot (-1 invalid).
     """
+    from koordinator_tpu.scheduler.plugins import deviceshare
+
     resv = snap.reservations
     node_c = jnp.maximum(resv.node, 0)
     base_ok = (resv.valid & (resv.node >= 0))[None, :]           # [1, V]
@@ -62,7 +66,8 @@ def slot_columns(snap: ClusterSnapshot, pods: PodBatch,
                 & (pods.reservation_owner[:, None]
                    == resv.owner_group[None, :]))                # [P, V]
     slot_ok = (base_ok & owner_ok & static_ok[:, node_c]
-               & ~pods.numa_single[:, None])
+               & ~pods.numa_single[:, None]
+               & ~deviceshare.has_device_request(pods)[:, None])
     return slot_ok, resv.free, resv.node
 
 

@@ -39,11 +39,18 @@ from koordinator_tpu.api.types import (
 )
 from koordinator_tpu.snapshot.schema import (
     AGG_TYPES,
+    AUX_FPGA,
+    AUX_RDMA,
     ClusterSnapshot,
+    DEV_CORE,
+    DEV_MEM,
+    DeviceState,
     GangState,
     MAX_QUOTA_DEPTH,
     NodeState,
     NUM_AGG,
+    NUM_AUX_TYPES,
+    NUM_DEV_DIMS,
     PodBatch,
     QuotaState,
     ReservationState,
@@ -122,6 +129,28 @@ def estimate_pod(pod: Pod,
     return out
 
 
+def gpu_per_instance_host(total_mem: float, pod: Pod) -> Tuple[int, np.ndarray]:
+    """Host mirror of the device kernel's per-instance GPU request math
+    (deviceshare devicehandler_gpu.go:40-66; scheduler/plugins/deviceshare
+    _per_instance): returns (count, per_inst f32[3])."""
+    core = float(pod.requests.get(ResourceKind.GPU_CORE, 0.0))
+    mem = float(pod.requests.get(ResourceKind.GPU_MEMORY, 0.0))
+    ratio = float(pod.gpu_memory_ratio)
+    if core <= 0 and mem <= 0 and ratio <= 0:
+        return 0, np.zeros((NUM_DEV_DIMS,), np.float32)
+    if mem > 0:
+        ratio_eff = np.floor(mem / max(total_mem, 1.0) * 100.0)
+        mem_eff = mem
+    else:
+        ratio_eff = ratio
+        mem_eff = np.floor(ratio * total_mem / 100.0)
+    count = int(ratio_eff // 100) if (ratio_eff > 100
+                                      and ratio_eff % 100 == 0) else 1
+    per_inst = np.array([np.floor(core / count), np.floor(mem_eff / count),
+                         np.floor(ratio_eff / count)], np.float32)
+    return count, per_inst
+
+
 @dataclasses.dataclass
 class AssignedPod:
     """A pod recently assumed on a node (podAssignCache entry,
@@ -142,6 +171,7 @@ class SnapshotBuilder:
 
     def __init__(self, max_nodes: int, max_quotas: int = 8, max_gangs: int = 8,
                  max_reservations: int = 8, max_zones: int = 4,
+                 max_gpu_inst: int = 0, max_aux_inst: int = 0,
                  max_selectors: int = 8, max_label_groups: int = 64,
                  metric_expiration_s: float = DEFAULT_NODE_METRIC_EXPIRATION_S,
                  estimator_weights: Optional[Mapping[ResourceKind, float]] = None,
@@ -152,6 +182,8 @@ class SnapshotBuilder:
         self.max_gangs = max_gangs
         self.max_reservations = max_reservations
         self.max_zones = max_zones
+        self.max_gpu_inst = max_gpu_inst
+        self.max_aux_inst = max_aux_inst
         self.max_selectors = max_selectors
         self.max_label_groups = max_label_groups
         self.metric_expiration_s = metric_expiration_s
@@ -175,6 +207,7 @@ class SnapshotBuilder:
         self.gang_index: Dict[str, int] = {}
         self.gang_assumed: Dict[str, int] = {}
         self.reservations: List[Reservation] = []
+        self.devices: Dict[str, Device] = {}
 
     # --- ingest -------------------------------------------------------------
 
@@ -221,6 +254,11 @@ class SnapshotBuilder:
         if len(self.reservations) >= self.max_reservations:
             raise ValueError("reservation capacity exceeded")
         self.reservations.append(res)
+
+    def add_device(self, device: Device) -> None:
+        """Ingest a Device CR (per-node device inventory, deviceshare
+        eventhandler_device.go)."""
+        self.devices[device.node_name] = device
 
     # --- build: nodes -------------------------------------------------------
 
@@ -462,15 +500,111 @@ class SnapshotBuilder:
         return ReservationState(node=node, free=free, owner_group=owner,
                                 allocate_once=once, valid=valid)
 
+    def build_devices(self) -> DeviceState:
+        """Columnarize Device CRs; running pods' granted instances (the
+        device-allocation annotation) are subtracted from free, mirroring
+        how deviceshare eventhandler_pod.go rebuilds nodeDeviceCache."""
+        n, i, j = self.max_nodes, self.max_gpu_inst, self.max_aux_inst
+        f32 = np.float32
+        gpu_total = np.zeros((n, NUM_DEV_DIMS), f32)
+        gpu_free = np.zeros((n, i, NUM_DEV_DIMS), f32)
+        gpu_valid = np.zeros((n, i), bool)
+        gpu_numa = np.full((n, i), -1, np.int32)
+        gpu_pcie = np.full((n, i), -1, np.int32)
+        aux_free = np.zeros((n, NUM_AUX_TYPES, j), f32)
+        aux_valid = np.zeros((n, NUM_AUX_TYPES, j), bool)
+        aux_pool = {"rdma": AUX_RDMA, "fpga": AUX_FPGA}
+        pcie_ids: Dict[str, int] = {}
+        for node_name, device in self.devices.items():
+            ni = self.node_index.get(node_name)
+            if ni is None:
+                continue
+            gpu_slot = 0
+            aux_slot = {AUX_RDMA: 0, AUX_FPGA: 0}
+            for info in device.devices:
+                if info.type == "gpu":
+                    if gpu_slot >= i:
+                        raise ValueError(
+                            f"GPUs on {node_name!r} exceed max_gpu_inst={i}")
+                    mem = float(info.resources.get(ResourceKind.GPU_MEMORY,
+                                                   0.0))
+                    gpu_total[ni] = (100.0, mem, 100.0)
+                    if info.health:
+                        gpu_free[ni, gpu_slot] = (100.0, mem, 100.0)
+                        gpu_valid[ni, gpu_slot] = True
+                    gpu_numa[ni, gpu_slot] = info.numa_node
+                    if info.pcie_id:
+                        gpu_pcie[ni, gpu_slot] = pcie_ids.setdefault(
+                            info.pcie_id, len(pcie_ids))
+                    gpu_slot += 1
+                elif info.type in aux_pool:
+                    t = aux_pool[info.type]
+                    if aux_slot[t] >= j:
+                        raise ValueError(
+                            f"{info.type} instances on {node_name!r} exceed "
+                            f"max_aux_inst={j}")
+                    if info.health:
+                        kind = (ResourceKind.RDMA if t == AUX_RDMA
+                                else ResourceKind.FPGA)
+                        aux_free[ni, t, aux_slot[t]] = float(
+                            info.resources.get(kind, 100.0))
+                        aux_valid[ni, t, aux_slot[t]] = True
+                    aux_slot[t] += 1
+        for pod in self.running_pods:
+            ni = self.node_index.get(pod.node_name)
+            if ni is None:
+                continue
+            if pod.allocated_gpu_minors:
+                _, per_inst = gpu_per_instance_host(
+                    gpu_total[ni, DEV_MEM], pod)
+                for minor in pod.allocated_gpu_minors:
+                    if 0 <= minor < i:
+                        gpu_free[ni, minor] = np.maximum(
+                            gpu_free[ni, minor] - per_inst, 0.0)
+            for t, inst in ((AUX_RDMA, pod.allocated_rdma_inst),
+                            (AUX_FPGA, pod.allocated_fpga_inst)):
+                kind = ResourceKind.RDMA if t == AUX_RDMA else ResourceKind.FPGA
+                req = float(pod.requests.get(kind, 0.0))
+                if req > 0 and 0 <= inst < j:
+                    aux_free[ni, t, inst] = max(aux_free[ni, t, inst] - req,
+                                                0.0)
+        return DeviceState(gpu_total=gpu_total, gpu_free=gpu_free,
+                           gpu_valid=gpu_valid, gpu_numa=gpu_numa,
+                           gpu_pcie=gpu_pcie, aux_free=aux_free,
+                           aux_valid=aux_valid)
+
     def build(self, now: Optional[float] = None,
               version: int = 0) -> Tuple[ClusterSnapshot, "BuildContext"]:
         nodes, label_groups = self.build_nodes(now)
+        devices = self.build_devices()
+        # aggregate device capacity rides node allocatable (the device
+        # plugin reports extended resources) unless the Node already did,
+        # feeding the cheap node-level fit gate before the instance gates
+        gc, gm = int(ResourceKind.GPU_CORE), int(ResourceKind.GPU_MEMORY)
+        valid_count = np.sum(devices.gpu_valid, axis=1, dtype=np.float32)
+        agg_core = devices.gpu_total[:, DEV_CORE] * valid_count
+        agg_mem = devices.gpu_total[:, DEV_MEM] * valid_count
+        alloc = nodes.allocatable
+        alloc[:, gc] = np.where(alloc[:, gc] > 0, alloc[:, gc], agg_core)
+        alloc[:, gm] = np.where(alloc[:, gm] > 0, alloc[:, gm], agg_mem)
+        for kind, typ in ((ResourceKind.RDMA, "rdma"),
+                          (ResourceKind.FPGA, "fpga")):
+            k = int(kind)
+            for node_name, device in self.devices.items():
+                ni = self.node_index.get(node_name)
+                if ni is None or alloc[ni, k] > 0:
+                    continue
+                alloc[ni, k] = sum(
+                    float(info.resources.get(kind, 100.0))
+                    for info in device.devices
+                    if info.type == typ and info.health)
         owner_groups: Dict[str, int] = {}
         snap = ClusterSnapshot(
             nodes=nodes,
             quotas=self.build_quotas(),
             gangs=self.build_gangs(),
             reservations=self.build_reservations(owner_groups),
+            devices=devices,
             version=np.int32(version),
         )
         ctx = BuildContext(self, label_groups, owner_groups)
@@ -493,6 +627,7 @@ class SnapshotBuilder:
         quota_id = np.full((p,), -1, np.int32)
         sel_id = np.full((p,), -1, np.int32)
         res_owner = np.full((p,), -1, np.int32)
+        gpu_ratio = np.zeros((p,), np.float32)
         numa_single = np.zeros((p,), bool)
         daemonset = np.zeros((p,), bool)
         valid = np.zeros((p,), bool)
@@ -518,6 +653,7 @@ class SnapshotBuilder:
                 if sel_key and _labels_match_key(pod.meta.labels, sel_key):
                     res_owner[i] = group
                     break
+            gpu_ratio[i] = pod.gpu_memory_ratio
             numa_single[i] = pod.required_cpu_bind
             daemonset[i] = pod.is_daemonset
             valid[i] = True
@@ -537,8 +673,8 @@ class SnapshotBuilder:
             requests=requests, estimated=estimated, qos=qos,
             priority_class=prio_class, priority=prio, gang_id=gang_id,
             quota_id=quota_id, selector_id=sel_id, selector_match=sel_match,
-            reservation_owner=res_owner, numa_single=numa_single,
-            daemonset=daemonset, valid=valid)
+            reservation_owner=res_owner, gpu_ratio=gpu_ratio,
+            numa_single=numa_single, daemonset=daemonset, valid=valid)
 
 
 def _selector_key(selector: Dict[str, str]) -> str:

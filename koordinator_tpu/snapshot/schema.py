@@ -34,6 +34,19 @@ NUM_AGG = len(AGG_TYPES)
 # Static max depth of the elastic-quota tree (root at depth 0).
 MAX_QUOTA_DEPTH = 6
 
+# Device-instance resource dims (DeviceState.gpu_free last axis), mirroring
+# the GPU resources of apis/extension/device_share.go:44-46.
+DEV_CORE = 0    # gpu-core percent (100 == one full GPU)
+DEV_MEM = 1     # gpu-memory MiB
+DEV_RATIO = 2   # gpu-memory-ratio percent
+NUM_DEV_DIMS = 3
+
+# Aux device pools (DeviceState.aux_free axis 1): percent units per instance
+# (an RDMA/FPGA virtual function is allocated from one instance).
+AUX_RDMA = 0
+AUX_FPGA = 1
+NUM_AUX_TYPES = 2
+
 Array = Any  # jnp.ndarray (host numpy allowed pre-upload)
 
 
@@ -98,6 +111,10 @@ class PodBatch:
                             # sets — the nodeSelector gate without a P x N
                             # host-side matrix)
     reservation_owner: Array  # i32[P] owner-match group for reservations, -1
+    gpu_ratio: Array        # f32[P] explicit gpu-memory-ratio request (0 =
+                            # unspecified; requests[GPU_MEMORY] > 0 wins and
+                            # the ratio is derived per node from the node's
+                            # GPU memory, devicehandler_gpu.go:68-90)
     numa_single: Array      # bool[P] requires single-NUMA-node placement
     daemonset: Array        # bool[P] DaemonSet pods bypass LoadAware filter
                             # (load_aware.go isDaemonSetPod)
@@ -155,6 +172,34 @@ class GangState:
 
 
 @flax.struct.dataclass
+class DeviceState:
+    """Per-node device instances (DeviceShare nodeDeviceCache, SURVEY.md 2.1
+    plugins/deviceshare: Device CRs mirrored as device columns).
+
+    GPU pool: I instances per node, each with (core %, memory MiB, memory-
+    ratio %) free. A node carries one GPU model, so per-instance totals are a
+    single [N, 3] row (devicehandler_gpu.go:82 "a node can only contain one
+    type of GPU"). Aux pools (RDMA/FPGA) are percent-unit instances; a
+    request is served from a single instance (default device handler
+    semantics: desiredCount 1).
+    """
+
+    gpu_total: Array        # f32[N, 3] per-INSTANCE totals (core=100 when
+                            # present, memory MiB, ratio=100)
+    gpu_free: Array         # f32[N, I, 3]
+    gpu_valid: Array        # bool[N, I] instance exists and is healthy
+    gpu_numa: Array         # i32[N, I] NUMA node of the instance, -1 unknown
+    gpu_pcie: Array         # i32[N, I] PCIe root id, -1 unknown (host bind
+                            # uses it for joint-allocate minor preference)
+    aux_free: Array         # f32[N, A, J] percent free per aux instance
+    aux_valid: Array        # bool[N, A, J]
+
+    @property
+    def num_instances(self) -> int:
+        return self.gpu_free.shape[1]
+
+
+@flax.struct.dataclass
 class ReservationState:
     """Available reservations as device columns. Shapes: [V, ...].
 
@@ -178,6 +223,7 @@ class ClusterSnapshot:
     quotas: QuotaState
     gangs: GangState
     reservations: ReservationState
+    devices: DeviceState
     version: Array          # i32[] monotonically increasing
 
     @property
@@ -185,8 +231,26 @@ class ClusterSnapshot:
         return self.nodes.num_nodes
 
 
+def zeros_devices(num_nodes: int, num_gpu_inst: int = 0,
+                  num_aux_inst: int = 0) -> DeviceState:
+    """An all-empty device pool with the given static instance capacities."""
+    n, i, j = num_nodes, num_gpu_inst, num_aux_inst
+    f32 = jnp.float32
+    return DeviceState(
+        gpu_total=jnp.zeros((n, NUM_DEV_DIMS), f32),
+        gpu_free=jnp.zeros((n, i, NUM_DEV_DIMS), f32),
+        gpu_valid=jnp.zeros((n, i), bool),
+        gpu_numa=jnp.full((n, i), -1, jnp.int32),
+        gpu_pcie=jnp.full((n, i), -1, jnp.int32),
+        aux_free=jnp.zeros((n, NUM_AUX_TYPES, j), f32),
+        aux_valid=jnp.zeros((n, NUM_AUX_TYPES, j), bool),
+    )
+
+
 def zeros_snapshot(num_nodes: int, num_quotas: int = 1, num_gangs: int = 1,
-                   num_reservations: int = 1, num_zones: int = 4) -> ClusterSnapshot:
+                   num_reservations: int = 1, num_zones: int = 4,
+                   num_gpu_inst: int = 0,
+                   num_aux_inst: int = 0) -> ClusterSnapshot:
     """An all-empty snapshot with the given static capacities."""
     n, q, g, v, z, r = (num_nodes, num_quotas, num_gangs, num_reservations,
                         num_zones, NUM_RESOURCES)
@@ -238,4 +302,6 @@ def zeros_snapshot(num_nodes: int, num_quotas: int = 1, num_gangs: int = 1,
     )
     return ClusterSnapshot(nodes=nodes, quotas=quotas, gangs=gangs,
                            reservations=reservations,
+                           devices=zeros_devices(n, num_gpu_inst,
+                                                 num_aux_inst),
                            version=jnp.zeros((), jnp.int32))

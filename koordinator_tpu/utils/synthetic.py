@@ -16,13 +16,17 @@ import numpy as np
 from koordinator_tpu.api.extension import NUM_RESOURCES, PriorityClass, QoSClass, ResourceKind
 from koordinator_tpu.snapshot.schema import (
     ClusterSnapshot,
+    DeviceState,
     GangState,
     MAX_QUOTA_DEPTH,
     NodeState,
     NUM_AGG,
+    NUM_AUX_TYPES,
+    NUM_DEV_DIMS,
     PodBatch,
     QuotaState,
     ReservationState,
+    zeros_devices,
 )
 
 R = NUM_RESOURCES
@@ -62,6 +66,9 @@ def synthetic_cluster(num_nodes: int, seed: int = 0,
                       gang_min_member: int = 8,
                       batch_overcommit_ratio: float = 0.5,
                       usage_cpu_frac: Tuple[float, float] = (0.0, 0.6),
+                      gpu_node_frac: float = 0.0,
+                      gpus_per_node: int = 8,
+                      gpu_memory_mib: float = 81920.0,
                       now_version: int = 0) -> ClusterSnapshot:
     """A realistic colocation cluster: heterogeneous nodes, fresh
     NodeMetrics, batch-tier overcommit resources, a two-level quota tree,
@@ -157,15 +164,47 @@ def synthetic_cluster(num_nodes: int, seed: int = 0,
         allocate_once=np.ones((8,), bool),
         valid=np.zeros((8,), bool),
     )
+    if gpu_node_frac > 0:
+        i = gpus_per_node
+        is_gpu_node = rng.uniform(size=n) < gpu_node_frac
+        gpu_total = np.zeros((n, NUM_DEV_DIMS), f32)
+        gpu_total[is_gpu_node] = (100.0, gpu_memory_mib, 100.0)
+        # aggregate device capacity rides node allocatable too (the device
+        # plugin reports extended resources), feeding the cheap node-level
+        # fit gate before the exact per-instance gates
+        alloc = nodes.allocatable
+        alloc[is_gpu_node, int(ResourceKind.GPU_CORE)] = i * 100.0
+        alloc[is_gpu_node, int(ResourceKind.GPU_MEMORY)] = i * gpu_memory_mib
+        nodes = nodes.replace(allocatable=alloc)
+        gpu_free = np.broadcast_to(gpu_total[:, None, :],
+                                   (n, i, NUM_DEV_DIMS)).copy()
+        gpu_valid = np.broadcast_to(is_gpu_node[:, None], (n, i)).copy()
+        # GPUs split across 2 NUMA nodes, 2 per PCIe root (A100-like)
+        inst = np.arange(i)
+        gpu_numa = np.broadcast_to((inst * 2 // max(i, 1))[None, :],
+                                   (n, i)).astype(np.int32).copy()
+        gpu_pcie = np.broadcast_to((inst // 2)[None, :],
+                                   (n, i)).astype(np.int32).copy()
+        gpu_numa[~is_gpu_node] = -1
+        gpu_pcie[~is_gpu_node] = -1
+        devices = DeviceState(
+            gpu_total=gpu_total, gpu_free=gpu_free, gpu_valid=gpu_valid,
+            gpu_numa=gpu_numa, gpu_pcie=gpu_pcie,
+            aux_free=np.zeros((n, NUM_AUX_TYPES, 0), f32),
+            aux_valid=np.zeros((n, NUM_AUX_TYPES, 0), bool),
+        )
+    else:
+        devices = zeros_devices(n)
     return ClusterSnapshot(nodes=nodes, quotas=quotas, gangs=gangs,
-                           reservations=reservations,
+                           reservations=reservations, devices=devices,
                            version=np.int32(now_version))
 
 
 def synthetic_pods(num_pods: int, seed: int = 1,
                    prod_frac: float = 0.6,
                    num_quotas: int = 0, num_gangs: int = 0,
-                   gang_min_member: int = 8) -> PodBatch:
+                   gang_min_member: int = 8,
+                   gpu_pod_frac: float = 0.0) -> PodBatch:
     """A pending-pod batch: prod pods request native cpu/mem, batch pods
     request batch-tier resources (webhook translation, SURVEY.md 2.3)."""
     rng = np.random.default_rng(seed)
@@ -186,6 +225,16 @@ def synthetic_pods(num_pods: int, seed: int = 1,
     requests[~is_prod, BMEM] = mem_req[~is_prod]
     limits = np.zeros((p, R), f32)
 
+    gpu_ratio = np.zeros((p,), f32)
+    if gpu_pod_frac > 0:
+        # mix of shared (half-GPU), full single, and multi-GPU trainers
+        is_gpu = rng.uniform(size=p) < gpu_pod_frac
+        shape = rng.choice([50, 100, 200, 400], p,
+                           p=[0.4, 0.3, 0.2, 0.1]).astype(f32)
+        gpu_ratio = np.where(is_gpu, shape, 0.0).astype(f32)
+        requests[:, int(ResourceKind.GPU_CORE)] = np.where(
+            is_gpu, shape, 0.0)
+
     estimated = estimate_vectorized(requests, limits, prio_class)
 
     gang_id = np.full((p,), -1, np.int32)
@@ -205,6 +254,7 @@ def synthetic_pods(num_pods: int, seed: int = 1,
         selector_id=np.full((p,), -1, np.int32),
         selector_match=np.zeros((8, 64), bool),
         reservation_owner=np.full((p,), -1, np.int32),
+        gpu_ratio=gpu_ratio,
         numa_single=np.zeros((p,), bool),
         daemonset=np.zeros((p,), bool),
         valid=np.ones((p,), bool),
@@ -213,7 +263,8 @@ def synthetic_pods(num_pods: int, seed: int = 1,
 
 PER_POD_FIELDS = ("requests", "estimated", "qos", "priority_class",
                   "priority", "gang_id", "quota_id", "selector_id",
-                  "reservation_owner", "numa_single", "daemonset", "valid")
+                  "reservation_owner", "gpu_ratio", "numa_single",
+                  "daemonset", "valid")
 
 
 def slice_batch(batch: PodBatch, start: int, size: int) -> PodBatch:
