@@ -47,6 +47,11 @@ class DaemonConfig:
     report_interval_seconds: float = 60.0
     predict_train_interval_seconds: float = 60.0
     checkpoint_path: str = ""
+    # CPI collection via the native perf-group shim (the Libpfm4 feature
+    # gate, koordlet_features.go:117); when enabled and no explicit
+    # perf_reader is given, the Daemon probes the native shim and degrades
+    # to no CPI if the host refuses perf access
+    enable_perf_group: bool = False
 
 
 class Daemon:
@@ -62,6 +67,9 @@ class Daemon:
         self.executor = Executor(host, auditor)
         self.metric_cache = mc.MetricCache()
         self.informer = StatesInformer()
+        if perf_reader is None and cfg.enable_perf_group:
+            from koordinator_tpu.native import cycles_instructions_reader
+            perf_reader = cycles_instructions_reader()
         self.advisor: Advisor = default_advisor(
             host, self.metric_cache, self.informer, perf_reader)
         self.predictor = PeakPredictServer(

@@ -1,0 +1,205 @@
+"""ctypes bindings for the native C++ shims.
+
+The reference builds its perf-group reader as cgo against libpfm4
+(perf_group_linux.go:38-41, hack/libpfm.sh); here the equivalent is a small
+C++ library (perf_group.cpp) built with `make -C koordinator_tpu/native` and
+loaded via ctypes (no pybind11 in the image). Everything degrades
+gracefully: if the .so is missing and cannot be built, or perf_event_open
+is denied (container without CAP_PERFMON), callers get None — mirroring the
+reference's Libpfm4 feature gate defaulting off (koordlet_features.go:117).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from typing import Dict, Optional, Sequence, Tuple
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SO = os.path.join(_DIR, "libperf_group.so")
+
+# perf_event_open(2) portable event encodings (the subset libpfm4 resolves
+# these names to): name -> (perf type, config). Hardware events need a PMU
+# (absent in many VMs -> ENOENT); software events always work and exercise
+# the same grouped-read machinery.
+PERF_TYPE_HARDWARE = 0
+PERF_TYPE_SOFTWARE = 1
+EVENTS = {
+    "cycles": (PERF_TYPE_HARDWARE, 0),        # PERF_COUNT_HW_CPU_CYCLES
+    "instructions": (PERF_TYPE_HARDWARE, 1),  # PERF_COUNT_HW_INSTRUCTIONS
+    "cache-references": (PERF_TYPE_HARDWARE, 2),
+    "cache-misses": (PERF_TYPE_HARDWARE, 3),
+    "branches": (PERF_TYPE_HARDWARE, 4),
+    "branch-misses": (PERF_TYPE_HARDWARE, 5),
+    "sw-cpu-clock": (PERF_TYPE_SOFTWARE, 0),
+    "sw-task-clock": (PERF_TYPE_SOFTWARE, 1),
+    "sw-page-faults": (PERF_TYPE_SOFTWARE, 2),
+    "sw-context-switches": (PERF_TYPE_SOFTWARE, 3),
+}
+
+_lib = None
+_lib_error: Optional[str] = None
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _lib_error
+    if _lib is not None or _lib_error is not None:
+        return _lib
+    if not os.path.exists(_SO):
+        try:
+            subprocess.run(["make", "-C", _DIR, "-s"], check=True,
+                           capture_output=True, timeout=120)
+        except (OSError, subprocess.SubprocessError) as e:
+            _lib_error = f"native build failed: {e}"
+            return None
+    try:
+        lib = ctypes.CDLL(_SO)
+    except OSError as e:
+        _lib_error = f"load failed: {e}"
+        return None
+    lib.pg_open.restype = ctypes.c_void_p
+    lib.pg_open.argtypes = [
+        ctypes.c_char_p, ctypes.c_int,
+        ctypes.POINTER(ctypes.c_int), ctypes.c_int,
+        ctypes.POINTER(ctypes.c_uint), ctypes.POINTER(ctypes.c_ulonglong),
+        ctypes.c_int]
+    lib.pg_read.restype = ctypes.c_int
+    lib.pg_read.argtypes = [ctypes.c_void_p,
+                            ctypes.POINTER(ctypes.c_double)]
+    lib.pg_close.restype = None
+    lib.pg_close.argtypes = [ctypes.c_void_p]
+    lib.pg_last_error.restype = ctypes.c_char_p
+    lib.pg_last_error.argtypes = []
+    _lib = lib
+    return _lib
+
+
+def native_available() -> bool:
+    return _load() is not None
+
+
+def last_error() -> str:
+    lib = _load()
+    if lib is None:
+        return _lib_error or ""
+    return lib.pg_last_error().decode(errors="replace")
+
+
+class PerfGroupCollector:
+    """Grouped hardware counters for one cgroup (or one pid).
+
+    Mirrors PerfGroupCollector (perf_group_linux.go:104-262): one event
+    group per CPU, counts summed across CPUs with multiplexing correction.
+    Raises OSError when the kernel refuses (no perf permission, bad
+    cgroup) — callers treat that as "CPI collection unavailable".
+    """
+
+    def __init__(self, cgroup_dir: Optional[str] = None, pid: int = 0,
+                 events: Sequence[str] = ("cycles", "instructions"),
+                 cpus: Optional[Sequence[int]] = None):
+        lib = _load()
+        if lib is None:
+            raise OSError(_lib_error or "native shim unavailable")
+        self._lib = lib
+        self.events = list(events)
+        n = len(self.events)
+        try:
+            enc = [EVENTS[e] for e in self.events]
+        except KeyError as e:
+            raise ValueError(f"unknown perf event {e}") from None
+        types = (ctypes.c_uint * n)(*(t for t, _ in enc))
+        configs = (ctypes.c_ulonglong * n)(*(c for _, c in enc))
+        if cpus is None:
+            cpu_arr, n_cpus = None, 0
+        else:
+            cpu_arr = (ctypes.c_int * len(cpus))(*cpus)
+            n_cpus = len(cpus)
+        self._h = lib.pg_open(
+            cgroup_dir.encode() if cgroup_dir is not None else None,
+            pid, cpu_arr, n_cpus, types, configs, n)
+        if not self._h:
+            raise OSError(lib.pg_last_error().decode(errors="replace"))
+
+    def read(self) -> Dict[str, float]:
+        out = (ctypes.c_double * len(self.events))()
+        if self._lib.pg_read(self._h, out) != 0:
+            raise OSError(self._lib.pg_last_error().decode(errors="replace"))
+        return dict(zip(self.events, out))
+
+    def close(self) -> None:
+        if getattr(self, "_h", None):
+            self._lib.pg_close(self._h)
+            self._h = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+def cycles_instructions_reader() -> Optional[callable]:
+    """Factory for the metricsadvisor PerformanceCollector's perf_reader
+    (performance_collector_linux.go:85-120): returns
+    `reader(cgroup_dir) -> (cycles, instructions) | None`, or None when
+    perf is unavailable on this host (shim missing, or a probe open of the
+    calling process's events is denied).
+
+    A collector stays open per cgroup between ticks (each group is a pair
+    of fds per CPU, so leaking them across pod churn would exhaust fd
+    limits); the first call per cgroup primes the baseline and returns
+    None, each later call returns the DELTA over the elapsed window. A
+    collector is evicted as soon as its cgroup directory disappears
+    (reading a removed cgroup's perf fds never errors — counters just
+    freeze — so liveness must be checked on the filesystem)."""
+    try:
+        with PerfGroupCollector(pid=0, cpus=[0]) as probe:
+            probe.read()
+    except (OSError, ValueError):
+        return None
+
+    collectors: Dict[str, PerfGroupCollector] = {}
+    last: Dict[str, Dict[str, float]] = {}
+
+    def evict(cgroup_dir: str) -> None:
+        c = collectors.pop(cgroup_dir, None)
+        if c is not None:
+            c.close()
+        last.pop(cgroup_dir, None)
+
+    def reader(cgroup_dir: str) -> Optional[Tuple[float, float]]:
+        # drop collectors of vanished cgroups (exited pods) every call so
+        # fds never accumulate past the live pod set
+        for known in list(collectors):
+            if not os.path.isdir(known):
+                evict(known)
+        if not os.path.isdir(cgroup_dir):
+            return None
+        c = collectors.get(cgroup_dir)
+        first = c is None
+        if first:
+            try:
+                c = PerfGroupCollector(cgroup_dir=cgroup_dir)
+            except OSError:
+                return None
+            collectors[cgroup_dir] = c
+        try:
+            v = c.read()
+        except OSError:
+            evict(cgroup_dir)
+            return None
+        prev = last.get(cgroup_dir)
+        last[cgroup_dir] = v
+        if first or prev is None:
+            return None  # baseline primed; first delta next tick
+        return (v["cycles"] - prev["cycles"],
+                v["instructions"] - prev["instructions"])
+
+    return reader

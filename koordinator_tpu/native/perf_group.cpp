@@ -1,0 +1,182 @@
+// Grouped perf-event reader for per-cgroup hardware counters (CPI).
+//
+// C++ equivalent of the reference's cgo/libpfm4 component
+// (pkg/koordlet/util/perf_group/perf_group_linux.go:140-262): one event
+// GROUP per CPU opened against a cgroup fd with PERF_FLAG_PID_CGROUP,
+// leader + members sharing a group so the counters are scheduled
+// atomically; read returns PERF_FORMAT_GROUP records with
+// time_enabled/time_running multiplexing correction. Event encoding uses
+// perf's portable PERF_TYPE_HARDWARE ids directly (the subset libpfm4
+// resolves "cycles"/"instructions" to), so no external library is needed.
+//
+// Exposed as a plain C ABI for ctypes (no pybind11 in the image).
+
+#include <cerrno>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <vector>
+
+#include <fcntl.h>
+#include <linux/perf_event.h>
+#include <sys/ioctl.h>
+#include <sys/syscall.h>
+#include <unistd.h>
+
+namespace {
+
+thread_local std::string g_last_error;
+
+void set_error(const char* what) {
+  g_last_error = std::string(what) + ": " + std::strerror(errno);
+}
+
+long perf_event_open(perf_event_attr* attr, pid_t pid, int cpu, int group_fd,
+                     unsigned long flags) {
+  return syscall(SYS_perf_event_open, attr, pid, cpu, group_fd, flags);
+}
+
+// PERF_FORMAT_GROUP read layout (perf_event_open(2) "Reading results").
+struct ReadValue {
+  uint64_t value;
+  uint64_t id;
+};
+struct ReadFormat {
+  uint64_t nr;
+  uint64_t time_enabled;
+  uint64_t time_running;
+  ReadValue values[];  // nr entries
+};
+
+struct CpuGroup {
+  int leader = -1;
+  std::vector<int> fds;  // leader first, then members (open order = event order)
+};
+
+}  // namespace
+
+struct pg_collector {
+  std::vector<CpuGroup> groups;
+  int n_events = 0;
+  int cgroup_fd = -1;
+};
+
+extern "C" {
+
+void pg_close(pg_collector* col);
+
+const char* pg_last_error() { return g_last_error.c_str(); }
+
+// Open one perf group per cpu for `n_events` events given by
+// (types[i], configs[i]); target is a cgroup directory fd when
+// cgroup_dir != NULL (PERF_FLAG_PID_CGROUP) or a pid otherwise
+// (pid 0 = self — used by the self-test path where cgroup perms are
+// unavailable). cpus == NULL means all online CPUs. Returns NULL on error.
+pg_collector* pg_open(const char* cgroup_dir, int pid, const int* cpus,
+                      int n_cpus, const unsigned* types,
+                      const unsigned long long* configs, int n_events) {
+  if (n_events <= 0) {
+    g_last_error = "no events";
+    return nullptr;
+  }
+  std::vector<int> cpu_list;
+  if (cpus == nullptr || n_cpus <= 0) {
+    int n = static_cast<int>(sysconf(_SC_NPROCESSORS_ONLN));
+    for (int c = 0; c < n; c++) cpu_list.push_back(c);
+  } else {
+    cpu_list.assign(cpus, cpus + n_cpus);
+  }
+
+  pg_collector* col = new pg_collector();
+  col->n_events = n_events;
+  pid_t target = pid;
+  unsigned long flags = PERF_FLAG_FD_CLOEXEC;
+  if (cgroup_dir != nullptr) {
+    col->cgroup_fd = open(cgroup_dir, O_RDONLY | O_DIRECTORY | O_CLOEXEC);
+    if (col->cgroup_fd < 0) {
+      set_error("open cgroup");
+      delete col;
+      return nullptr;
+    }
+    target = col->cgroup_fd;
+    flags |= PERF_FLAG_PID_CGROUP;
+  }
+
+  for (int cpu : cpu_list) {
+    CpuGroup group;
+    for (int e = 0; e < n_events; e++) {
+      perf_event_attr attr;
+      std::memset(&attr, 0, sizeof(attr));
+      attr.size = sizeof(attr);
+      attr.type = types[e];
+      attr.config = configs[e];
+      attr.read_format = PERF_FORMAT_GROUP | PERF_FORMAT_TOTAL_TIME_ENABLED |
+                         PERF_FORMAT_TOTAL_TIME_RUNNING | PERF_FORMAT_ID;
+      attr.sample_type = PERF_SAMPLE_IDENTIFIER;
+      attr.disabled = (e == 0) ? 1 : 0;  // enable whole group via leader
+      attr.inherit = 1;
+      attr.exclude_hv = 1;
+      long fd = perf_event_open(&attr, target, cpu, group.leader, flags);
+      if (fd < 0) {
+        set_error("perf_event_open");
+        for (int f : group.fds) close(f);
+        pg_close(col);
+        return nullptr;
+      }
+      if (e == 0) group.leader = static_cast<int>(fd);
+      group.fds.push_back(static_cast<int>(fd));
+    }
+    if (ioctl(group.leader, PERF_EVENT_IOC_RESET, PERF_IOC_FLAG_GROUP) < 0 ||
+        ioctl(group.leader, PERF_EVENT_IOC_ENABLE, PERF_IOC_FLAG_GROUP) < 0) {
+      set_error("ioctl enable");
+      for (int f : group.fds) close(f);
+      pg_close(col);
+      return nullptr;
+    }
+    col->groups.push_back(std::move(group));
+  }
+  return col;
+}
+
+// Sum each event's counts across all CPU groups into out_values[n_events],
+// applying the time_enabled/time_running multiplexing correction per group
+// (GetContainerPerfResult semantics). Returns 0 on success.
+int pg_read(pg_collector* col, double* out_values) {
+  if (col == nullptr) return -1;
+  for (int e = 0; e < col->n_events; e++) out_values[e] = 0.0;
+  std::vector<char> buf(sizeof(ReadFormat) +
+                        sizeof(ReadValue) * col->n_events);
+  for (const CpuGroup& group : col->groups) {
+    ssize_t n = read(group.leader, buf.data(), buf.size());
+    if (n < 0) {
+      set_error("read");
+      return -1;
+    }
+    const ReadFormat* rf = reinterpret_cast<const ReadFormat*>(buf.data());
+    double scale = 1.0;
+    if (rf->time_running > 0 && rf->time_running < rf->time_enabled) {
+      scale = static_cast<double>(rf->time_enabled) /
+              static_cast<double>(rf->time_running);
+    }
+    uint64_t nr = rf->nr;
+    if (nr > static_cast<uint64_t>(col->n_events)) nr = col->n_events;
+    for (uint64_t i = 0; i < nr; i++) {
+      out_values[i] += static_cast<double>(rf->values[i].value) * scale;
+    }
+  }
+  return 0;
+}
+
+void pg_close(pg_collector* col) {
+  if (col == nullptr) return;
+  for (const CpuGroup& group : col->groups) {
+    if (group.leader >= 0)
+      ioctl(group.leader, PERF_EVENT_IOC_DISABLE, PERF_IOC_FLAG_GROUP);
+    for (int fd : group.fds) close(fd);
+  }
+  if (col->cgroup_fd >= 0) close(col->cgroup_fd);
+  delete col;
+}
+
+}  // extern "C"

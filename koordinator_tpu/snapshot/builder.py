@@ -519,37 +519,51 @@ class SnapshotBuilder:
             ni = self.node_index.get(node_name)
             if ni is None:
                 continue
-            gpu_slot = 0
-            aux_slot = {AUX_RDMA: 0, AUX_FPGA: 0}
+            # columns are indexed by DeviceInfo.minor — running-pod restore
+            # and the scheduler's gpu_take/aux_inst outputs (the device-
+            # allocation annotation) address instances by minor, so list
+            # position must not matter
+            seen_gpu = set()
+            seen_aux = {AUX_RDMA: set(), AUX_FPGA: set()}
             for info in device.devices:
                 if info.type == "gpu":
-                    if gpu_slot >= i:
+                    m = info.minor
+                    if not 0 <= m < i:
                         raise ValueError(
-                            f"GPUs on {node_name!r} exceed max_gpu_inst={i}")
+                            f"GPU minor {m} on {node_name!r} outside "
+                            f"max_gpu_inst={i}")
+                    if m in seen_gpu:
+                        raise ValueError(
+                            f"duplicate GPU minor {m} on {node_name!r}")
+                    seen_gpu.add(m)
                     mem = float(info.resources.get(ResourceKind.GPU_MEMORY,
                                                    0.0))
                     gpu_total[ni] = (100.0, mem, 100.0)
                     if info.health:
-                        gpu_free[ni, gpu_slot] = (100.0, mem, 100.0)
-                        gpu_valid[ni, gpu_slot] = True
-                    gpu_numa[ni, gpu_slot] = info.numa_node
+                        gpu_free[ni, m] = (100.0, mem, 100.0)
+                        gpu_valid[ni, m] = True
+                    gpu_numa[ni, m] = info.numa_node
                     if info.pcie_id:
-                        gpu_pcie[ni, gpu_slot] = pcie_ids.setdefault(
+                        gpu_pcie[ni, m] = pcie_ids.setdefault(
                             info.pcie_id, len(pcie_ids))
-                    gpu_slot += 1
                 elif info.type in aux_pool:
                     t = aux_pool[info.type]
-                    if aux_slot[t] >= j:
+                    m = info.minor
+                    if not 0 <= m < j:
                         raise ValueError(
-                            f"{info.type} instances on {node_name!r} exceed "
-                            f"max_aux_inst={j}")
+                            f"{info.type} minor {m} on {node_name!r} "
+                            f"outside max_aux_inst={j}")
+                    if m in seen_aux[t]:
+                        raise ValueError(
+                            f"duplicate {info.type} minor {m} on "
+                            f"{node_name!r}")
+                    seen_aux[t].add(m)
                     if info.health:
                         kind = (ResourceKind.RDMA if t == AUX_RDMA
                                 else ResourceKind.FPGA)
-                        aux_free[ni, t, aux_slot[t]] = float(
+                        aux_free[ni, t, m] = float(
                             info.resources.get(kind, 100.0))
-                        aux_valid[ni, t, aux_slot[t]] = True
-                    aux_slot[t] += 1
+                        aux_valid[ni, t, m] = True
         for pod in self.running_pods:
             ni = self.node_index.get(pod.node_name)
             if ni is None:

@@ -274,6 +274,38 @@ def test_rdma_vf_fragmentation():
 # --- builder restore --------------------------------------------------------
 
 
+def test_builder_indexes_columns_by_minor():
+    # Device CR listed out of minor order: columns must follow minors so
+    # running-pod restore by minor hits the right physical GPU
+    b = SnapshotBuilder(max_nodes=1, max_gpu_inst=2)
+    b.add_node(Node(meta=ObjectMeta(name="n0"),
+                    allocatable={CPU: 32000.0, MEM: 64000.0}))
+    b.set_node_metric(NodeMetric(node_name="n0", update_time=1e9,
+                                 node_usage={CPU: 100.0, MEM: 100.0}))
+    b.add_device(Device(node_name="n0", devices=[
+        DeviceInfo(minor=1, type="gpu", resources={GC: 100.0, GM: 1000.0},
+                   numa_node=1),
+        DeviceInfo(minor=0, type="gpu", resources={GC: 100.0, GM: 1000.0},
+                   numa_node=0)]))
+    running = gpu_pod("r", core=100, ratio=100)
+    running.node_name = "n0"
+    running.allocated_gpu_minors = (1,)
+    b.add_running_pod(running)
+    snap, _ = b.build(now=1e9)
+    free = np.asarray(snap.devices.gpu_free)
+    numa = np.asarray(snap.devices.gpu_numa)
+    assert free[0, 0, 0] == 100.0 and free[0, 1, 0] == 0.0
+    assert numa[0].tolist() == [0, 1]
+    # duplicate / out-of-range minors are rejected loudly
+    b2 = SnapshotBuilder(max_nodes=1, max_gpu_inst=1)
+    b2.add_node(Node(meta=ObjectMeta(name="n0"),
+                     allocatable={CPU: 1000.0, MEM: 1000.0}))
+    b2.add_device(Device(node_name="n0", devices=[
+        DeviceInfo(minor=3, type="gpu", resources={GC: 100.0, GM: 10.0})]))
+    with pytest.raises(ValueError):
+        b2.build(now=1e9)
+
+
 def test_builder_restores_running_allocations():
     b = make_builder(num_nodes=1, gpus=2)
     running = gpu_pod("r", core=200, ratio=200)
