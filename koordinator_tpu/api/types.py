@@ -87,6 +87,10 @@ class Pod:
     allocated_fpga_inst: int = -1
     # node selection
     node_selector: Dict[str, str] = dataclasses.field(default_factory=dict)
+    # controller owner (ReplicaSet/StatefulSet...) — the migration
+    # arbitrator bounds blast radius per workload (arbitrator/filter.go)
+    owner_workload: str = ""     # "namespace/name" of the controller
+    workload_replicas: int = 0
     # device request (gpu-core percent, gpu-memory MiB) folded into requests
     phase: str = "Pending"
 

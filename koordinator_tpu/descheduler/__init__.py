@@ -1,0 +1,21 @@
+"""koord-descheduler equivalent: descheduling framework, LowNodeLoad
+balance plugin, and the PodMigrationJob controller with arbitration
+(SURVEY.md 2.4)."""
+
+from koordinator_tpu.descheduler.framework import (  # noqa: F401
+    BalancePlugin,
+    CycleRunner,
+    DeschedulePlugin,
+    EvictionLimiter,
+    Evictor,
+    RecordingEvictor,
+)
+from koordinator_tpu.descheduler.lownodeload import (  # noqa: F401
+    LowNodeLoadArgs,
+    LowNodeLoad,
+)
+from koordinator_tpu.descheduler.migration import (  # noqa: F401
+    Arbitrator,
+    MigrationController,
+    MigrationControllerArgs,
+)

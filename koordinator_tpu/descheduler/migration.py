@@ -1,0 +1,230 @@
+"""PodMigrationJob controller + arbitration.
+
+Capability parity with pkg/descheduler/controllers/migration (SURVEY.md
+2.4, 3.5):
+- The controller reconciles PodMigrationJob CRs: Pending jobs pass through
+  the ARBITRATOR (group/sort/filter bounding blast radius per node /
+  namespace / workload, arbitrator/{arbitrator,filter,sort}.go), then run:
+  optionally reserve replacement capacity via a Reservation and wait for it
+  to schedule (ReservationFirst, controller.go:241 doMigrate), then evict
+  the pod; TTL-expired jobs fail.
+- Filters (filter.go:133-360): one active job per pod; maxMigratingPerNode;
+  maxMigratingPerNamespace; per-workload maxMigrating AND maxUnavailable
+  (unavailable replicas + migrating replicas must stay under the limits).
+- Sort (sort.go): stable order by creation time, then jobs whose workload
+  already has migrations run LATER (SortJobsByMigratingNum), spreading
+  disruption across workloads.
+
+The reservation step is pluggable: the production edge hands the
+Reservation to the TPU scheduler (reservations are virtual node columns,
+scheduler/plugins/reservation.py) and reports back when it is Available.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import math
+from typing import Callable, Dict, List, Mapping, Optional, Sequence
+
+from koordinator_tpu.api import types as api
+from koordinator_tpu.descheduler.framework import Evictor
+
+
+def _limit(value, replicas: int) -> Optional[int]:
+    """GetMaxMigrating/GetMaxUnavailable (pkg/util): int = absolute,
+    float in (0,1] = fraction of replicas rounded up, None = unlimited."""
+    if value is None:
+        return None
+    if isinstance(value, float) and 0.0 < value <= 1.0:
+        return max(1, math.ceil(value * replicas))
+    return int(value)
+
+
+@dataclasses.dataclass
+class MigrationControllerArgs:
+    """MigrationControllerArgs (descheduler/apis/config/types.go) subset
+    with reference defaults."""
+
+    max_migrating_per_node: Optional[int] = 2
+    max_migrating_per_namespace: Optional[int] = None
+    max_migrating_per_workload: Optional[object] = 0.1   # 10% of replicas
+    max_unavailable_per_workload: Optional[object] = 0.1
+    ttl_seconds: float = 300.0
+    default_mode: str = "ReservationFirst"  # | "EvictDirectly"
+
+
+class Arbitrator:
+    """Sort + filter over the pending job queue (arbitrator.go
+    doOnceArbitrate)."""
+
+    def __init__(self, args: MigrationControllerArgs):
+        self.args = args
+
+    def sort(self, jobs: Sequence[api.PodMigrationJob],
+             pod_of_job: Mapping[str, api.Pod],
+             migrating_per_workload: Mapping[str, int]
+             ) -> List[api.PodMigrationJob]:
+        def key(idx_job):
+            idx, job = idx_job
+            pod = pod_of_job.get(job.meta.name)
+            wl = pod.owner_workload if pod is not None else ""
+            return (migrating_per_workload.get(wl, 0), idx)
+        return [j for _, j in sorted(enumerate(jobs), key=key)]
+
+    def filter(self, pod: api.Pod,
+               migrating_pods: Sequence[api.Pod],
+               unavailable_per_workload: Mapping[str, int]) -> bool:
+        """May this pod start migrating given the currently-migrating set?"""
+        args = self.args
+        if any(p.meta.namespaced_name == pod.meta.namespaced_name
+               for p in migrating_pods):
+            return False  # one active job per pod (filterExistingPodMigrationJob)
+        if args.max_migrating_per_node is not None and pod.node_name:
+            on_node = sum(1 for p in migrating_pods
+                          if p.node_name == pod.node_name)
+            if on_node >= args.max_migrating_per_node:
+                return False
+        if args.max_migrating_per_namespace is not None:
+            in_ns = sum(1 for p in migrating_pods
+                        if p.meta.namespace == pod.meta.namespace)
+            if in_ns >= args.max_migrating_per_namespace:
+                return False
+        wl = pod.owner_workload
+        if wl:
+            replicas = pod.workload_replicas or 1
+            migrating = sum(1 for p in migrating_pods
+                            if p.owner_workload == wl)
+            max_migrating = _limit(args.max_migrating_per_workload, replicas)
+            if max_migrating is not None and migrating >= max_migrating:
+                return False
+            max_unavail = _limit(args.max_unavailable_per_workload, replicas)
+            if max_unavail is not None:
+                unavailable = unavailable_per_workload.get(wl, 0)
+                if unavailable + migrating >= max_unavail:
+                    return False
+        return True
+
+
+class MigrationController:
+    """The PodMigrationJob reconciler (controllers/migration/controller.go).
+
+    Callbacks:
+    - reserve(pod) -> reservation name: create replacement capacity
+      (ReservationFirst); return "" to proceed without one.
+    - reservation_available(name) -> bool: has the reservation scheduled?
+    - release_reservation(name): cancel reserved capacity when a job fails
+      (controller.go abort path deletes the Reservation — without this the
+      reserved virtual-node capacity would leak on every timeout).
+    - get_pod(namespace/name) -> Pod | None
+    - unavailable_per_workload() -> workload -> count of not-Running
+      replicas (beyond those being migrated)
+    """
+
+    def __init__(self, evictor: Evictor,
+                 args: Optional[MigrationControllerArgs] = None,
+                 reserve: Optional[Callable[[api.Pod], str]] = None,
+                 reservation_available: Optional[Callable[[str], bool]] = None,
+                 release_reservation: Optional[Callable[[str], None]] = None,
+                 get_pod: Optional[Callable[[str], Optional[api.Pod]]] = None,
+                 unavailable_per_workload: Optional[
+                     Callable[[], Mapping[str, int]]] = None):
+        self.evictor = evictor
+        self.args = args or MigrationControllerArgs()
+        self.arbitrator = Arbitrator(self.args)
+        self.reserve = reserve
+        self.reservation_available = reservation_available
+        self.release_reservation = release_reservation
+        self.get_pod = get_pod or (lambda _key: None)
+        self.unavailable_per_workload = unavailable_per_workload or dict
+        self.jobs: Dict[str, api.PodMigrationJob] = {}
+        self._created: Dict[str, float] = {}
+        self._seq = itertools.count()
+
+    # -- job intake ----------------------------------------------------------
+
+    def submit_for_pod(self, pod: api.Pod, reason: str = "",
+                       now: float = 0.0) -> api.PodMigrationJob:
+        """What the descheduler's evictor edge does: an eviction request
+        becomes a PodMigrationJob (evictor/evictor.go)."""
+        name = f"pmj-{next(self._seq)}"
+        job = api.PodMigrationJob(
+            meta=api.ObjectMeta(name=name),
+            pod_namespace=pod.meta.namespace, pod_name=pod.meta.name,
+            mode=self.args.default_mode, ttl_seconds=self.args.ttl_seconds,
+            phase="Pending", reason=reason)
+        self.submit(job, now)
+        return job
+
+    def submit(self, job: api.PodMigrationJob, now: float = 0.0) -> None:
+        self.jobs[job.meta.name] = job
+        self._created[job.meta.name] = now
+
+    # -- reconcile -----------------------------------------------------------
+
+    def _migrating_pods(self) -> List[api.Pod]:
+        out = []
+        for job in self.jobs.values():
+            if job.phase == "Running":
+                pod = self.get_pod(f"{job.pod_namespace}/{job.pod_name}")
+                if pod is not None:
+                    out.append(pod)
+        return out
+
+    def reconcile_once(self, now: float) -> None:
+        # TTL expiry applies to any non-terminal job (controller.go
+        # abortJobIfTimeout)
+        for job in self.jobs.values():
+            if job.phase in ("Pending", "Running") and \
+                    now - self._created[job.meta.name] > job.ttl_seconds:
+                job.phase = "Failed"
+                job.reason = "timeout"
+                if job.reservation_name and self.release_reservation:
+                    self.release_reservation(job.reservation_name)
+                    job.reservation_name = ""
+
+        pending = [j for j in self.jobs.values() if j.phase == "Pending"]
+        pod_of_job = {
+            j.meta.name: self.get_pod(f"{j.pod_namespace}/{j.pod_name}")
+            for j in pending}
+        migrating = self._migrating_pods()
+        per_wl: Dict[str, int] = {}
+        for p in migrating:
+            if p.owner_workload:
+                per_wl[p.owner_workload] = per_wl.get(p.owner_workload, 0) + 1
+        unavailable = dict(self.unavailable_per_workload())
+
+        for job in self.arbitrator.sort(pending, pod_of_job, per_wl):
+            pod = pod_of_job.get(job.meta.name)
+            if pod is None:
+                job.phase = "Failed"
+                job.reason = "pod not found"
+                continue
+            if not self.arbitrator.filter(pod, migrating, unavailable):
+                continue  # stays Pending, retried next reconcile
+            job.phase = "Running"
+            migrating.append(pod)
+            if pod.owner_workload:
+                per_wl[pod.owner_workload] = \
+                    per_wl.get(pod.owner_workload, 0) + 1
+            if job.mode == "ReservationFirst" and self.reserve is not None:
+                job.reservation_name = self.reserve(pod)
+
+        for job in [j for j in self.jobs.values() if j.phase == "Running"]:
+            pod = self.get_pod(f"{job.pod_namespace}/{job.pod_name}")
+            if pod is None:
+                job.phase = "Succeeded"  # already gone
+                continue
+            if job.reservation_name and self.reservation_available is not None:
+                if not self.reservation_available(job.reservation_name):
+                    continue  # wait for replacement capacity
+            if self.evictor.evict(pod, job.reason or "migration"):
+                job.phase = "Succeeded"
+            # else: stays Running, retried (eviction limiter may admit later)
+
+    def gc(self) -> None:
+        """Drop terminal jobs (controller job GC)."""
+        for name in [n for n, j in self.jobs.items()
+                     if j.phase in ("Succeeded", "Failed")]:
+            del self.jobs[name]
+            self._created.pop(name, None)

@@ -81,9 +81,15 @@ pg_collector* pg_open(const char* cgroup_dir, int pid, const int* cpus,
     return nullptr;
   }
   std::vector<int> cpu_list;
+  bool tolerate_offline = false;
   if (cpus == nullptr || n_cpus <= 0) {
-    int n = static_cast<int>(sysconf(_SC_NPROCESSORS_ONLN));
+    // enumerate CONFIGURED cpu ids (online ids may be non-contiguous with
+    // hotplug) and tolerate per-CPU open failures on the offline ones —
+    // failing the whole collector because cpu 2 is offline would disable
+    // CPI collection node-wide
+    int n = static_cast<int>(sysconf(_SC_NPROCESSORS_CONF));
     for (int c = 0; c < n; c++) cpu_list.push_back(c);
+    tolerate_offline = true;
   } else {
     cpu_list.assign(cpus, cpus + n_cpus);
   }
@@ -105,6 +111,7 @@ pg_collector* pg_open(const char* cgroup_dir, int pid, const int* cpus,
 
   for (int cpu : cpu_list) {
     CpuGroup group;
+    bool skip_cpu = false;
     for (int e = 0; e < n_events; e++) {
       perf_event_attr attr;
       std::memset(&attr, 0, sizeof(attr));
@@ -119,6 +126,11 @@ pg_collector* pg_open(const char* cgroup_dir, int pid, const int* cpus,
       attr.exclude_hv = 1;
       long fd = perf_event_open(&attr, target, cpu, group.leader, flags);
       if (fd < 0) {
+        if (tolerate_offline && e == 0 &&
+            (errno == ENODEV || errno == ENXIO || errno == EINVAL)) {
+          skip_cpu = true;  // offline/nonexistent cpu in the CONF range
+          break;
+        }
         set_error("perf_event_open");
         for (int f : group.fds) close(f);
         pg_close(col);
@@ -127,6 +139,7 @@ pg_collector* pg_open(const char* cgroup_dir, int pid, const int* cpus,
       if (e == 0) group.leader = static_cast<int>(fd);
       group.fds.push_back(static_cast<int>(fd));
     }
+    if (skip_cpu) continue;
     if (ioctl(group.leader, PERF_EVENT_IOC_RESET, PERF_IOC_FLAG_GROUP) < 0 ||
         ioctl(group.leader, PERF_EVENT_IOC_ENABLE, PERF_IOC_FLAG_GROUP) < 0) {
       set_error("ioctl enable");
@@ -135,6 +148,11 @@ pg_collector* pg_open(const char* cgroup_dir, int pid, const int* cpus,
       return nullptr;
     }
     col->groups.push_back(std::move(group));
+  }
+  if (col->groups.empty()) {
+    g_last_error = "no usable CPUs for perf group";
+    pg_close(col);
+    return nullptr;
   }
   return col;
 }
