@@ -77,24 +77,37 @@ PRIORITY_BANDS: Mapping[PriorityClass, tuple] = {
 DEFAULT_PRIORITY_CLASS = PriorityClass.NONE
 
 
-def priority_class_of(priority: Optional[int],
-                      label: str = "") -> PriorityClass:
-    """Resolve a pod's PriorityClass from its priority value or override label.
+def priority_class_of(priority: Optional[int], label: str = "",
+                      priority_class_name: str = "") -> PriorityClass:
+    """Resolve a pod's PriorityClass from its priority value, override
+    label, or k8s PriorityClassName.
 
     Mirrors GetPodPriorityClassRaw/getPriorityClassByPriority
     (apis/extension/priority.go:73-103): the `koordinator.sh/priority-class`
-    label wins; otherwise the numeric priority is matched against the bands.
+    label wins; a koord-* PriorityClassName is next (it covers priority
+    values outside the koordinator bands); otherwise the numeric priority is
+    matched against the bands.
     """
-    if label:
-        parsed = PriorityClass.parse(label)
-        if parsed is not PriorityClass.NONE:
-            return parsed
+    for override in (label, priority_class_name):
+        if override:
+            parsed = PriorityClass.parse(override)
+            if parsed is not PriorityClass.NONE:
+                return parsed
     if priority is None:
         return PriorityClass.NONE
     for cls, (lo, hi) in PRIORITY_BANDS.items():
         if lo <= priority <= hi:
             return cls
     return DEFAULT_PRIORITY_CLASS
+
+
+def selector_matches(selector: Mapping[str, str],
+                     labels: Mapping[str, str]) -> bool:
+    """Exact-match label selector; empty selector matches everything
+    (util.GetFastLabelSelector semantics for matchLabels-only selectors).
+    Single shared implementation — webhook matching, quota profiles, and
+    slo-config node strategies all use this."""
+    return all(labels.get(k) == v for k, v in selector.items())
 
 
 class ResourceKind(enum.IntEnum):

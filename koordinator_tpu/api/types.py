@@ -64,6 +64,7 @@ class Pod:
     priority: Optional[int] = None
     node_name: str = ""          # "" == pending
     scheduler_name: str = "koord-scheduler"
+    priority_class_name: str = ""  # k8s PriorityClass reference
     priority_class_label: str = ""
     qos_label: str = ""
     gang_name: str = ""          # pod-group label (coscheduling)
@@ -100,7 +101,8 @@ class Pod:
 
     @property
     def priority_class(self) -> PriorityClass:
-        return priority_class_of(self.priority, self.priority_class_label)
+        return priority_class_of(self.priority, self.priority_class_label,
+                                 self.priority_class_name)
 
 
 @dataclasses.dataclass
@@ -306,6 +308,23 @@ class ElasticQuota:
     is_parent: bool = False
     allow_lent_resource: bool = True
     tree_id: str = ""              # multi-quota-tree support
+    namespaces: List[str] = dataclasses.field(default_factory=list)
+    allow_force_update: bool = False
+
+
+@dataclasses.dataclass
+class ElasticQuotaProfile:
+    """Quota-tree provisioning profile (quota.koordinator.sh/v1alpha1;
+    pkg/quota-controller/profile): generates a root ElasticQuota whose min
+    tracks the total allocatable of the selected nodes."""
+
+    meta: ObjectMeta = dataclasses.field(default_factory=ObjectMeta)
+    quota_name: str = ""
+    node_selector: Dict[str, str] = dataclasses.field(default_factory=dict)
+    resource_ratio: float = 1.0
+    resource_keys: Tuple[ResourceKind, ...] = (ResourceKind.CPU,
+                                               ResourceKind.MEMORY)
+    tree_id: str = ""
 
 
 @dataclasses.dataclass
@@ -352,8 +371,11 @@ class ClusterColocationProfile:
     selector: Dict[str, str] = dataclasses.field(default_factory=dict)
     labels: Dict[str, str] = dataclasses.field(default_factory=dict)
     annotations: Dict[str, str] = dataclasses.field(default_factory=dict)
+    label_keys_mapping: Dict[str, str] = dataclasses.field(default_factory=dict)
+    annotation_keys_mapping: Dict[str, str] = dataclasses.field(default_factory=dict)
     qos_class: str = ""
     priority_class_name: str = ""
     koordinator_priority: Optional[int] = None
     scheduler_name: str = ""
     probability: float = 1.0       # random-percent gating (reference supports %)
+    skip_update_resources: bool = False

@@ -1,0 +1,61 @@
+"""ElasticQuotaProfile controller: quota-tree provisioning.
+
+Behavior parity with pkg/quota-controller/profile/profile_controller.go
+(SURVEY.md 2.3): each profile owns one ROOT ElasticQuota; on reconcile the
+quota's min is set to the total allocatable of the nodes matching the
+profile's nodeSelector (scaled by the resource ratio,
+DecorateResourceByResourceRatio :259-272), max is unbounded, the tree id is
+derived deterministically from the profile name (:96-100 hash), and the
+quota is labeled a tree root / parent.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import Dict, Sequence
+
+from koordinator_tpu.api import types as api
+from koordinator_tpu.api.extension import selector_matches
+from koordinator_tpu.webhook.elasticquota import QuotaTopology
+
+
+def _tree_id(profile: api.ElasticQuotaProfile) -> str:
+    key = f"{profile.meta.namespace}/{profile.meta.name}"
+    return hashlib.sha256(key.encode()).hexdigest()[:16]
+
+
+class QuotaProfileReconciler:
+    """Reconciles profiles into root quotas; hand the result to the quota
+    topology/webhook and the scheduler's quota snapshot build."""
+
+    UNBOUNDED = float(2**62)
+
+    def __init__(self, topology: QuotaTopology = None):
+        self.topology = topology
+        self.quotas: Dict[str, api.ElasticQuota] = {}
+
+    def reconcile(self, profile: api.ElasticQuotaProfile,
+                  nodes: Sequence[api.Node]) -> api.ElasticQuota:
+        if not profile.tree_id:
+            profile.tree_id = _tree_id(profile)
+        total: Dict = {}
+        for node in nodes:
+            if selector_matches(profile.node_selector, node.meta.labels):
+                for kind, v in node.allocatable.items():
+                    total[kind] = total.get(kind, 0.0) + v
+        quota = self.quotas.get(profile.quota_name) or api.ElasticQuota(
+            meta=api.ObjectMeta(name=profile.quota_name,
+                                namespace=profile.meta.namespace))
+        quota.min = {k: total.get(k, 0.0) * profile.resource_ratio
+                     for k in profile.resource_keys}
+        quota.max = {k: self.UNBOUNDED for k in profile.resource_keys}
+        quota.tree_id = profile.tree_id
+        quota.is_parent = True
+        exists = profile.quota_name in self.quotas
+        self.quotas[profile.quota_name] = quota
+        if self.topology is not None:
+            if exists:
+                self.topology.valid_update(quota)
+            else:
+                self.topology.valid_add(quota)
+        return quota

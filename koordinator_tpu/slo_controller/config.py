@@ -12,6 +12,8 @@ wins for a node.
 from __future__ import annotations
 
 import dataclasses
+
+from koordinator_tpu.api.extension import selector_matches
 import enum
 from typing import Dict, List, Optional
 
@@ -69,7 +71,7 @@ class ColocationStrategyOverride:
     fields: Dict[str, object] = dataclasses.field(default_factory=dict)
 
     def matches(self, node_labels: Dict[str, str]) -> bool:
-        return all(node_labels.get(k) == v for k, v in self.node_selector.items())
+        return selector_matches(self.node_selector, node_labels)
 
 
 @dataclasses.dataclass

@@ -14,6 +14,7 @@ from __future__ import annotations
 import dataclasses
 from typing import Dict, List, Optional
 
+from koordinator_tpu.api.extension import selector_matches
 from koordinator_tpu.api.types import (
     CPUBurstStrategy,
     NodeSLO,
@@ -32,7 +33,7 @@ class StrategyOverride:
     fields: Dict[str, object] = dataclasses.field(default_factory=dict)
 
     def matches(self, labels: Dict[str, str]) -> bool:
-        return all(labels.get(k) == v for k, v in self.node_selector.items())
+        return selector_matches(self.node_selector, labels)
 
 
 @dataclasses.dataclass

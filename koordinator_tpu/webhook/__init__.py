@@ -1,0 +1,12 @@
+"""koord-manager webhook equivalents: pod mutation/validation by
+ClusterColocationProfile and the ElasticQuota topology guard
+(SURVEY.md 2.3, pkg/webhook)."""
+
+from koordinator_tpu.webhook.pod_mutating import PodMutator  # noqa: F401
+from koordinator_tpu.webhook.pod_validating import validate_pod  # noqa: F401
+from koordinator_tpu.webhook.elasticquota import (  # noqa: F401
+    DEFAULT_QUOTA_NAME,
+    ROOT_QUOTA_NAME,
+    SYSTEM_QUOTA_NAME,
+    QuotaTopology,
+)

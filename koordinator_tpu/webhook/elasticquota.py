@@ -1,0 +1,194 @@
+"""ElasticQuota topology guard: admission validation of the quota tree.
+
+Behavior parity with pkg/webhook/elasticquota/{quota_topology.go,
+quota_topology_check.go} (SURVEY.md 2.3):
+- self checks (validateQuotaSelfItem): min/max/sharedWeight nonnegative per
+  dimension, min <= max on every declared dimension
+- defaults (fillQuotaDefaultInformation :198-239): parent defaults to the
+  root quota; tree id inherits from the parent; sharedWeight defaults to max
+- topology (validateQuotaTopology + checks): parent must exist and have
+  isParent=true; the tree id must match the parent's (and, on update, the
+  children's); a child's max keys must equal its parent's max keys; the sum
+  of sibling mins (including the candidate) must not exceed the parent min
+  (checkMinQuotaValidate :212-245, skipped for direct root children and
+  allowForceUpdate); parent changes with attached pods are forbidden
+- namespace bindings are exclusive: one namespace annotates at most one
+  quota (:71-76)
+- delete guards (ValidDeleteQuota :153-196): system/root/default quotas are
+  protected; quotas with children or bound pods cannot be deleted
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Set
+
+from koordinator_tpu.api import types as api
+from koordinator_tpu.api.extension import ResourceKind
+
+ROOT_QUOTA_NAME = "koordinator-root-quota"
+SYSTEM_QUOTA_NAME = "koordinator-system-quota"
+DEFAULT_QUOTA_NAME = "koordinator-default-quota"
+_PROTECTED = (ROOT_QUOTA_NAME, SYSTEM_QUOTA_NAME, DEFAULT_QUOTA_NAME)
+
+
+class QuotaTopologyError(ValueError):
+    pass
+
+
+class QuotaTopology:
+    """In-memory mirror of the quota tree driving admission decisions.
+
+    `pod_counter(quota_name) -> int` stands in for the pod list the
+    reference queries on delete/parent-change (quota_topology.go:153-196).
+    """
+
+    def __init__(self,
+                 pod_counter: Optional[Callable[[str], int]] = None):
+        self.quotas: Dict[str, api.ElasticQuota] = {}
+        self.children: Dict[str, Set[str]] = {ROOT_QUOTA_NAME: set()}
+        self.namespace_to_quota: Dict[str, str] = {}
+        self.pod_counter = pod_counter or (lambda _name: 0)
+
+    # -- admission entry points ----------------------------------------------
+
+    def valid_add(self, quota: api.ElasticQuota) -> None:
+        name = quota.meta.name
+        if name in self.quotas:
+            raise QuotaTopologyError(f"quota already exists: {name}")
+        for ns in quota.namespaces:
+            bound = self.namespace_to_quota.get(ns)
+            if bound is not None:
+                raise QuotaTopologyError(
+                    f"namespace {ns} is already bound to quota {bound}")
+        self.fill_defaults(quota)
+        self._validate_self(quota)
+        self._validate_topology(quota, old=None)
+        self.quotas[name] = quota
+        self.children.setdefault(name, set())
+        self.children.setdefault(quota.parent, set()).add(name)
+        for ns in quota.namespaces:
+            self.namespace_to_quota[ns] = name
+
+    def valid_update(self, quota: api.ElasticQuota) -> None:
+        name = quota.meta.name
+        old = self.quotas.get(name)
+        if old is None:
+            raise QuotaTopologyError(f"quota does not exist: {name}")
+        for ns in quota.namespaces:
+            bound = self.namespace_to_quota.get(ns)
+            if bound is not None and bound != name:
+                raise QuotaTopologyError(
+                    f"namespace {ns} is already bound to quota {bound}")
+        self.fill_defaults(quota)
+        self._validate_self(quota)
+        self._validate_topology(quota, old=old)
+        self.quotas[name] = quota
+        if old.parent != quota.parent:
+            self.children[old.parent].discard(name)
+            self.children.setdefault(quota.parent, set()).add(name)
+        for ns in old.namespaces:
+            self.namespace_to_quota.pop(ns, None)
+        for ns in quota.namespaces:
+            self.namespace_to_quota[ns] = name
+
+    def valid_delete(self, name: str) -> None:
+        if name in _PROTECTED:
+            raise QuotaTopologyError(f"can not delete quota {name}")
+        quota = self.quotas.get(name)
+        if quota is None:
+            raise QuotaTopologyError(f"quota not found: {name}")
+        if self.children.get(name):
+            raise QuotaTopologyError(f"quota {name} has child quotas")
+        if self.pod_counter(name) > 0:
+            raise QuotaTopologyError(f"quota {name} has bound pods")
+        self.children[quota.parent].discard(name)
+        self.children.pop(name, None)
+        del self.quotas[name]
+        for ns in quota.namespaces:
+            self.namespace_to_quota.pop(ns, None)
+
+    # -- defaults ------------------------------------------------------------
+
+    def fill_defaults(self, quota: api.ElasticQuota) -> None:
+        if not quota.parent and quota.meta.name != ROOT_QUOTA_NAME:
+            quota.parent = ROOT_QUOTA_NAME
+        if not quota.tree_id and quota.parent != ROOT_QUOTA_NAME:
+            parent = self.quotas.get(quota.parent)
+            if parent is None:
+                raise QuotaTopologyError(
+                    f"fill quota {quota.meta.name} failed, parent not exist")
+            quota.tree_id = parent.tree_id
+        if not quota.shared_weight:
+            quota.shared_weight = dict(quota.max)
+
+    # -- checks --------------------------------------------------------------
+
+    def _validate_self(self, quota: api.ElasticQuota) -> None:
+        name = quota.meta.name
+        for label, rl in (("max", quota.max), ("min", quota.min),
+                          ("sharedWeight", quota.shared_weight)):
+            bad = [k.name for k, v in rl.items() if v < 0]
+            if bad:
+                raise QuotaTopologyError(
+                    f"{name} quota {label} < 0 in dimensions: {bad}")
+        for kind, lo in quota.min.items():
+            if lo > quota.max.get(kind, float("inf")):
+                raise QuotaTopologyError(f"{name} min > max for {kind.name}")
+
+    def _validate_topology(self, quota: api.ElasticQuota,
+                           old: Optional[api.ElasticQuota]) -> None:
+        name = quota.meta.name
+        parent_name = quota.parent
+        if parent_name != ROOT_QUOTA_NAME:
+            parent = self.quotas.get(parent_name)
+            if parent is None:
+                raise QuotaTopologyError(
+                    f"{name} has parent {parent_name} but it does not exist")
+            if not parent.is_parent:
+                raise QuotaTopologyError(
+                    f"{name} has parent {parent_name} whose isParent is "
+                    f"false")
+            if quota.tree_id != parent.tree_id:
+                raise QuotaTopologyError(
+                    f"{name} tree id differs from parent {parent_name}: "
+                    f"[{quota.tree_id}] vs [{parent.tree_id}]")
+            # max dimensions must agree with the parent's
+            if set(quota.max) != set(parent.max):
+                raise QuotaTopologyError(
+                    f"{name} max keys differ from parent {parent_name}")
+            self._check_min_sum(quota, parent)
+        if old is not None:
+            for child_name in self.children.get(name, ()):
+                child = self.quotas[child_name]
+                if child.tree_id != quota.tree_id:
+                    raise QuotaTopologyError(
+                        f"{name} tree id differs from child {child_name}")
+            if old.is_parent and not quota.is_parent \
+                    and self.children.get(name):
+                raise QuotaTopologyError(
+                    f"{name} has children; isParent cannot become false")
+            if not old.is_parent and quota.is_parent \
+                    and self.pod_counter(name) > 0:
+                raise QuotaTopologyError(
+                    f"{name} has bound pods; isParent cannot become true")
+            if old.parent != quota.parent and self.pod_counter(name) > 0:
+                raise QuotaTopologyError(
+                    f"{name} has bound pods; parent cannot change")
+
+    def _check_min_sum(self, quota: api.ElasticQuota,
+                       parent: api.ElasticQuota) -> None:
+        """Σ sibling min (incl. candidate) <= parent min per dimension
+        (checkMinQuotaValidate; skipped under allowForceUpdate)."""
+        if quota.allow_force_update:
+            return
+        total: Dict[ResourceKind, float] = dict(quota.min)
+        for sibling_name in self.children.get(parent.meta.name, ()):
+            if sibling_name == quota.meta.name:
+                continue
+            for kind, v in self.quotas[sibling_name].min.items():
+                total[kind] = total.get(kind, 0.0) + v
+        for kind, v in total.items():
+            if v > parent.min.get(kind, 0.0) + 1e-9:
+                raise QuotaTopologyError(
+                    f"all siblings' min > parent {parent.meta.name} min "
+                    f"for {kind.name}")

@@ -1,0 +1,165 @@
+"""ClusterColocationProfile pod mutation.
+
+Behavior parity with pkg/webhook/pod/mutating/cluster_colocation_profile.go
+(SURVEY.md 2.3):
+- On CREATE, every profile whose namespaceSelector matches the pod's
+  namespace labels AND whose selector matches the pod's labels applies, in
+  list order (:53-110); a probability percent gates each profile (:147-157
+  shouldSkipProfile).
+- A matching profile stamps labels/annotations (incl. key remappings),
+  schedulerName, the QoS label, the k8s priorityClassName + resolved
+  priority value, and the koordinator priority label (:159-236).
+- Afterwards (unless skipped), non-Prod pods get their cpu/memory
+  requests/limits TRANSLATED to the priority tier's extended resources —
+  batch-cpu/batch-memory for Batch, mid-* for Mid — erasing the native
+  entries (mutatePodResourceSpec :239-294, replaceAndEraseResource); a
+  translated limit without a request gets request=limit
+  (restrictResourceRequestAndLimit :281-294).
+
+Requests/limits here are pod-level aggregates (ResourceKind-keyed), the
+granularity the rest of this framework schedules at.
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Callable, Dict, Mapping, Optional, Sequence
+
+from koordinator_tpu.api import types as api
+from koordinator_tpu.api.extension import (
+    PriorityClass,
+    ResourceKind,
+    priority_class_of,
+    selector_matches,
+    translate_resource_by_priority,
+)
+
+
+class PodMutator:
+    """The mutating admission path for pods.
+
+    - `namespaces`: namespace name -> labels (the Namespace objects the
+      reference fetches per request).
+    - `priority_classes`: k8s PriorityClass name -> value.
+    - `rng`: percent roll for probability gating (inject for tests).
+    """
+
+    def __init__(self, profiles: Sequence[api.ClusterColocationProfile] = (),
+                 namespaces: Optional[Mapping[str, Dict[str, str]]] = None,
+                 priority_classes: Optional[Mapping[str, int]] = None,
+                 rng: Callable[[], float] = random.random,
+                 skip_mutating_resources: bool = False):
+        self.profiles = list(profiles)
+        self.namespaces = dict(namespaces or {})
+        self.priority_classes = dict(priority_classes or {})
+        self.rng = rng
+        self.skip_mutating_resources = skip_mutating_resources
+
+    def mutate(self, pod: api.Pod, operation: str = "Create") -> bool:
+        """Apply matching profiles in place; returns whether anything
+        changed. Only CREATE is mutated (:54-56)."""
+        if operation != "Create":
+            return False
+        matched = [p for p in self.profiles if self._matches(p, pod)]
+        if not matched:
+            return False
+        changed = False
+        skip_resources = self.skip_mutating_resources
+        for profile in matched:
+            # the skip flag latches BEFORE the probability roll, exactly as
+            # the reference does (cluster_colocation_profile.go:88-99) — a
+            # skip-resources profile suppresses translation even for the
+            # fraction of pods its probability gate passes over
+            if profile.skip_update_resources:
+                skip_resources = True
+            if self._skip_by_probability(profile):
+                continue
+            changed |= self._apply(profile, pod)
+        if not skip_resources:
+            changed |= self._mutate_resource_spec(pod)
+        return changed
+
+    # -- matching ------------------------------------------------------------
+
+    def _matches(self, profile: api.ClusterColocationProfile,
+                 pod: api.Pod) -> bool:
+        ns_labels = self.namespaces.get(pod.meta.namespace, {})
+        if not selector_matches(profile.namespace_selector, ns_labels):
+            return False
+        return selector_matches(profile.selector, pod.meta.labels)
+
+    def _skip_by_probability(self,
+                             profile: api.ClusterColocationProfile) -> bool:
+        percent = profile.probability * 100.0
+        return percent == 0 or (percent != 100.0
+                                and self.rng() * 100.0 > percent)
+
+    # -- application ---------------------------------------------------------
+
+    def _apply(self, profile: api.ClusterColocationProfile,
+               pod: api.Pod) -> bool:
+        changed = False
+        for k, v in profile.labels.items():
+            if pod.meta.labels.get(k) != v:
+                pod.meta.labels[k] = v
+                changed = True
+        for k, v in profile.annotations.items():
+            if pod.meta.annotations.get(k) != v:
+                pod.meta.annotations[k] = v
+                changed = True
+        for old, new in profile.label_keys_mapping.items():
+            if old in pod.meta.labels and \
+                    pod.meta.labels.get(new) != pod.meta.labels[old]:
+                pod.meta.labels[new] = pod.meta.labels[old]
+                changed = True
+        for old, new in profile.annotation_keys_mapping.items():
+            if old in pod.meta.annotations and \
+                    pod.meta.annotations.get(new) != pod.meta.annotations[old]:
+                pod.meta.annotations[new] = pod.meta.annotations[old]
+                changed = True
+        if profile.scheduler_name:
+            pod.scheduler_name = profile.scheduler_name
+            changed = True
+        if profile.qos_class:
+            pod.qos_label = profile.qos_class
+            changed = True
+        if profile.priority_class_name:
+            value = self.priority_classes.get(profile.priority_class_name)
+            if value is None:
+                raise KeyError(
+                    f"PriorityClass {profile.priority_class_name!r} not found")
+            pod.priority_class_name = profile.priority_class_name
+            pod.priority = value
+            changed = True
+        if profile.koordinator_priority is not None:
+            from koordinator_tpu.api.extension import LABEL_POD_PRIORITY
+            pod.meta.labels[LABEL_POD_PRIORITY] = str(
+                profile.koordinator_priority)
+            changed = True
+        return changed
+
+    # -- resource translation ------------------------------------------------
+
+    def _mutate_resource_spec(self, pod: api.Pod) -> bool:
+        pc = priority_class_of(pod.priority, pod.priority_class_label,
+                               pod.priority_class_name)
+        if pc in (PriorityClass.NONE, PriorityClass.PROD):
+            return False
+        changed = False
+        for rl in (pod.requests, pod.limits):
+            for kind in (ResourceKind.CPU, ResourceKind.MEMORY):
+                target = translate_resource_by_priority(kind, pc)
+                if target is kind:
+                    continue
+                if kind in rl:
+                    rl[target] = rl.pop(kind)
+                    changed = True
+        # a translated limit without a request gets request=limit
+        for kind in (ResourceKind.CPU, ResourceKind.MEMORY):
+            target = translate_resource_by_priority(kind, pc)
+            if target is kind:
+                continue
+            if target in pod.limits and target not in pod.requests:
+                pod.requests[target] = pod.limits[target]
+                changed = True
+        return changed

@@ -1,0 +1,75 @@
+"""Pod validating admission.
+
+Behavior parity with pkg/webhook/pod/validating/
+cluster_colocation_profile.go (SURVEY.md 2.3):
+- batch-tier resources require the BE QoS label (validateRequiredQoSClass
+  :71-85)
+- forbidden combinations (:104-122): QoS BE with priorityClass None/Prod;
+  QoS LSR with None/Mid/Batch/Free
+- LSR/LSE pods must request a nonzero, INTEGER number of CPUs
+  (validateResources :123-140)
+- on UPDATE, the QoS label, priority class, and koordinator priority label
+  are immutable (:86-103)
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+from koordinator_tpu.api import types as api
+from koordinator_tpu.api.extension import (
+    LABEL_POD_PRIORITY,
+    LABEL_POD_QOS,
+    PriorityClass,
+    QoSClass,
+    ResourceKind,
+    priority_class_of,
+)
+
+_FORBIDDEN = {
+    QoSClass.BE: (PriorityClass.NONE, PriorityClass.PROD),
+    QoSClass.LSR: (PriorityClass.NONE, PriorityClass.MID,
+                   PriorityClass.BATCH, PriorityClass.FREE),
+}
+
+
+def validate_pod(new_pod: api.Pod,
+                 old_pod: Optional[api.Pod] = None) -> Tuple[bool, List[str]]:
+    """Returns (allowed, reasons)."""
+    errs: List[str] = []
+    if old_pod is not None:
+        if old_pod.qos is not new_pod.qos:
+            errs.append(f"labels.{LABEL_POD_QOS}: field is immutable")
+        if (priority_class_of(old_pod.priority, old_pod.priority_class_label,
+                              old_pod.priority_class_name)
+                is not priority_class_of(new_pod.priority,
+                                         new_pod.priority_class_label,
+                                         new_pod.priority_class_name)):
+            errs.append("spec.priority: field is immutable")
+        if (old_pod.meta.labels.get(LABEL_POD_PRIORITY)
+                != new_pod.meta.labels.get(LABEL_POD_PRIORITY)):
+            errs.append(f"labels.{LABEL_POD_PRIORITY}: field is immutable")
+
+    batch_cpu = new_pod.requests.get(ResourceKind.BATCH_CPU, 0.0)
+    batch_mem = new_pod.requests.get(ResourceKind.BATCH_MEMORY, 0.0)
+    if (batch_cpu or batch_mem) and new_pod.qos is not QoSClass.BE:
+        errs.append(
+            f"labels.{LABEL_POD_QOS}: must specify koordinator QoS BE with "
+            f"koordinator colocation resources")
+
+    pc = priority_class_of(new_pod.priority, new_pod.priority_class_label,
+                           new_pod.priority_class_name)
+    forbidden = _FORBIDDEN.get(new_pod.qos, ())
+    if pc in forbidden:
+        errs.append(
+            f"{LABEL_POD_QOS}={new_pod.qos.name} and priorityClass="
+            f"{pc.name.lower()} cannot be used in combination")
+
+    if new_pod.qos in (QoSClass.LSR, QoSClass.LSE):
+        cpu = new_pod.requests.get(ResourceKind.CPU, 0.0)
+        if cpu == 0:
+            errs.append("LSR Pod must declare the requested CPUs")
+        elif cpu % 1000 != 0:
+            errs.append("the requested CPUs of LSR Pod must be integer")
+
+    return not errs, errs

@@ -1,0 +1,162 @@
+"""Quota-profile provisioning, overuse revocation, and quota-constrained
+preemption (SURVEY.md 2.1/2.3; reference profile_controller_test.go /
+quota_overuse_revoke_test.go / preempt_test.go scenarios)."""
+
+import numpy as np
+import pytest
+
+from koordinator_tpu.api import types as api
+from koordinator_tpu.api.extension import NUM_RESOURCES, ResourceKind as RK
+from koordinator_tpu.quota_controller import QuotaProfileReconciler
+from koordinator_tpu.scheduler.plugins.quota_revoke import (
+    QuotaOverUsedRevokeController,
+    select_revoke_victims,
+    select_victims_on_node,
+)
+from koordinator_tpu.snapshot.builder import resource_vec
+from koordinator_tpu.webhook import QuotaTopology
+
+
+def mk_node(name, labels=None, cpu=32000.0, mem=65536.0):
+    return api.Node(meta=api.ObjectMeta(name=name, labels=labels or {}),
+                    allocatable={RK.CPU: cpu, RK.MEMORY: mem})
+
+
+def quota_pod(name, cpu, prio, quota="q", **kw):
+    return api.Pod(meta=api.ObjectMeta(name=name),
+                   requests={RK.CPU: cpu}, priority=prio,
+                   quota_name=quota, **kw)
+
+
+# --- profile controller -----------------------------------------------------
+
+
+def test_profile_generates_root_quota_from_selected_nodes():
+    rec = QuotaProfileReconciler(QuotaTopology())
+    profile = api.ElasticQuotaProfile(
+        meta=api.ObjectMeta(name="ml-pool"), quota_name="ml-root",
+        node_selector={"pool": "ml"})
+    nodes = [mk_node("n0", {"pool": "ml"}), mk_node("n1", {"pool": "ml"}),
+             mk_node("n2", {"pool": "web"})]
+    quota = rec.reconcile(profile, nodes)
+    assert quota.min[RK.CPU] == 64000.0
+    assert quota.min[RK.MEMORY] == 2 * 65536.0
+    assert quota.is_parent and quota.tree_id
+    # re-reconcile after node set change updates min in place
+    quota2 = rec.reconcile(profile, nodes[:1])
+    assert quota2.min[RK.CPU] == 32000.0
+
+
+def test_profile_resource_ratio():
+    rec = QuotaProfileReconciler()
+    profile = api.ElasticQuotaProfile(
+        meta=api.ObjectMeta(name="p"), quota_name="q",
+        node_selector={}, resource_ratio=0.5)
+    quota = rec.reconcile(profile, [mk_node("n0")])
+    assert quota.min[RK.CPU] == 16000.0
+
+
+# --- overuse revoke ---------------------------------------------------------
+
+
+def _vec(cpu):
+    v = np.zeros(NUM_RESOURCES)
+    v[int(RK.CPU)] = cpu
+    return v
+
+
+def test_revoke_victims_minimal_set():
+    # used 100, runtime 60: revoke walks p1(10),p2(30),p3(50) low->high
+    # until under, then assigns back what still fits
+    pods = [quota_pod("p3", 50.0, 9000), quota_pod("p2", 30.0, 7000),
+            quota_pod("p1", 10.0, 5000)]
+    victims = select_revoke_victims(pods, _vec(100.0), _vec(60.0))
+    # tried: p1 (90), p2 (60) -> fits; assign back: p2 (90 > 60, keep
+    # revoked), p1 (70 > 60, keep revoked)
+    assert {p.meta.name for p in victims} == {"p1", "p2"}
+
+
+def test_revoke_assign_back_reprieves_covered_pod():
+    # used 100, runtime 55: tried p1(90), p2(60), p3(10)->fits.
+    # back: p3? 10+50=60>55 keep; p2 10+30=40<=55 reprieve; p1 40+10=50 ok
+    pods = [quota_pod("p3", 50.0, 9000), quota_pod("p2", 30.0, 7000),
+            quota_pod("p1", 10.0, 5000)]
+    victims = select_revoke_victims(pods, _vec(100.0), _vec(55.0))
+    assert {p.meta.name for p in victims} == {"p3"}
+
+
+def test_revoke_skips_non_preemptible():
+    shielded = quota_pod("s", 80.0, 5000)
+    shielded.meta.annotations["scheduling.koordinator.sh/preemptible"] = "false"
+    pods = [shielded, quota_pod("p", 20.0, 7000)]
+    victims = select_revoke_victims(pods, _vec(100.0), _vec(10.0))
+    assert {p.meta.name for p in victims} == {"p"}
+
+
+def test_overuse_controller_requires_sustained_overuse():
+    ctl = QuotaOverUsedRevokeController(trigger_evict_duration_seconds=100.0)
+    used = np.stack([_vec(100.0)])
+    runtime = np.stack([_vec(60.0)])
+    pods = {"q": [quota_pod("p", 50.0, 5000)]}
+    assert ctl.revoke_pods(["q"], used, runtime, pods, now=0.0) == []
+    assert ctl.revoke_pods(["q"], used, runtime, pods, now=50.0) == []
+    out = ctl.revoke_pods(["q"], used, runtime, pods, now=150.0)
+    assert [p.meta.name for p in out] == ["p"]
+    # under-use resets the streak
+    ctl2 = QuotaOverUsedRevokeController(trigger_evict_duration_seconds=100.0)
+    ctl2.revoke_pods(["q"], used, runtime, pods, now=0.0)
+    ctl2.revoke_pods(["q"], np.stack([_vec(10.0)]), runtime, pods, now=90.0)
+    assert ctl2.revoke_pods(["q"], used, runtime, pods, now=150.0) == []
+
+
+# --- preemption -------------------------------------------------------------
+
+
+def test_preemption_same_quota_lower_priority_only():
+    # non-candidates (high-same + low-other) use 80; preemptor needs 50:
+    # fits the 150 node only when low-same's 40 stays gone
+    alloc = _vec(150.0)
+    alloc[int(RK.MEMORY)] = 1e9
+    on_node = [quota_pod("low-same", 40.0, 5000),
+               quota_pod("high-same", 40.0, 9500),
+               quota_pod("low-other", 40.0, 5000, quota="other")]
+    preemptor = quota_pod("p", 50.0, 9000)
+    res = select_victims_on_node(
+        preemptor, alloc, on_node,
+        quota_used=_vec(80.0), quota_runtime=_vec(100.0))
+    assert res is not None
+    assert [v.meta.name for v in res.victims] == ["low-same"]
+
+
+def test_preemption_respects_quota_runtime():
+    # node has room but the quota doesn't: victims must free QUOTA too
+    alloc = _vec(1000.0)
+    alloc[int(RK.MEMORY)] = 1e9
+    on_node = [quota_pod("v", 50.0, 5000)]
+    preemptor = quota_pod("p", 50.0, 9000)
+    # quota used 100 == runtime 100: preemptor's 50 fits only if v goes
+    res = select_victims_on_node(preemptor, alloc, on_node,
+                                 quota_used=_vec(100.0),
+                                 quota_runtime=_vec(100.0))
+    assert res is not None and [v.meta.name for v in res.victims] == ["v"]
+    # runtime is too small even with all victims gone -> None
+    res = select_victims_on_node(preemptor, alloc, on_node,
+                                 quota_used=_vec(100.0),
+                                 quota_runtime=_vec(40.0))
+    assert res is None
+
+
+def test_preemption_reprieves_unneeded_candidates():
+    alloc = _vec(100.0)
+    alloc[int(RK.MEMORY)] = 1e9
+    on_node = [quota_pod("a", 30.0, 5000), quota_pod("b", 30.0, 6000),
+               quota_pod("c", 30.0, 7000)]
+    preemptor = quota_pod("p", 35.0, 9000)
+    res = select_victims_on_node(preemptor, alloc, on_node,
+                                 quota_used=_vec(90.0),
+                                 quota_runtime=_vec(1000.0))
+    # node 90/100 used; need 35 -> free >= 25: reprieve c (25+30 over?
+    # base=0 after removing all; back c: 30+35=65<=100 ok; back b:
+    # 60+35=95<=100 ok; back a: 90+35=125>100 -> a is the single victim
+    assert res is not None
+    assert [v.meta.name for v in res.victims] == ["a"]

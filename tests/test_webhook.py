@@ -1,0 +1,270 @@
+"""Webhook tests: ClusterColocationProfile pod mutation/validation and the
+ElasticQuota topology guard (SURVEY.md 2.3; reference
+cluster_colocation_profile_test.go / quota_topology_test.go scenarios)."""
+
+import pytest
+
+from koordinator_tpu.api import types as api
+from koordinator_tpu.api.extension import QoSClass, ResourceKind as RK
+from koordinator_tpu.webhook import (
+    PodMutator,
+    QuotaTopology,
+    ROOT_QUOTA_NAME,
+    validate_pod,
+)
+from koordinator_tpu.webhook.elasticquota import QuotaTopologyError
+
+
+def be_profile(**kw):
+    return api.ClusterColocationProfile(
+        meta=api.ObjectMeta(name="colocation"),
+        selector={"app": "batch-job"},
+        labels={"koordinator.sh/mutated": "true"},
+        qos_class="BE",
+        priority_class_name="koord-batch",
+        koordinator_priority=1111,
+        scheduler_name="koord-scheduler",
+        **kw)
+
+
+def batch_pod(**kw):
+    return api.Pod(meta=api.ObjectMeta(name="p", labels={"app": "batch-job"}),
+                   requests={RK.CPU: 4000.0, RK.MEMORY: 4096.0},
+                   limits={RK.CPU: 8000.0, RK.MEMORY: 8192.0}, **kw)
+
+
+def mk_mutator(profile=None, **kw):
+    return PodMutator([profile or be_profile()],
+                      priority_classes={"koord-batch": 5500},
+                      **kw)
+
+
+# --- mutation ---------------------------------------------------------------
+
+
+def test_profile_mutation_full_stack():
+    pod = batch_pod()
+    assert mk_mutator().mutate(pod)
+    assert pod.meta.labels["koordinator.sh/mutated"] == "true"
+    assert pod.qos_label == "BE" and pod.qos is QoSClass.BE
+    assert pod.priority == 5500
+    assert pod.meta.labels["koordinator.sh/priority"] == "1111"
+    assert pod.scheduler_name == "koord-scheduler"
+    # batch priority translates cpu/memory to batch resources, erasing
+    # the native entries
+    assert RK.CPU not in pod.requests and RK.MEMORY not in pod.requests
+    assert pod.requests[RK.BATCH_CPU] == 4000.0
+    assert pod.requests[RK.BATCH_MEMORY] == 4096.0
+    assert pod.limits[RK.BATCH_CPU] == 8000.0
+
+
+def test_profile_selector_and_operation_gate():
+    pod = api.Pod(meta=api.ObjectMeta(name="p", labels={"app": "web"}),
+                  requests={RK.CPU: 1000.0})
+    assert not mk_mutator().mutate(pod)
+    assert pod.qos_label == ""
+    assert not mk_mutator().mutate(batch_pod(), operation="Update")
+
+
+def test_profile_namespace_selector():
+    prof = be_profile(namespace_selector={"team": "ml"})
+    m = PodMutator([prof], namespaces={"mlns": {"team": "ml"},
+                                       "other": {"team": "web"}},
+                   priority_classes={"koord-batch": 5500})
+    pod_in = batch_pod()
+    pod_in.meta.namespace = "mlns"
+    pod_out = batch_pod()
+    pod_out.meta.namespace = "other"
+    assert m.mutate(pod_in)
+    assert not m.mutate(pod_out)
+
+
+def test_priority_class_name_resolves_out_of_band_values():
+    # a koord-batch PriorityClass whose k8s value sits OUTSIDE the
+    # koordinator batch band still resolves to BATCH via the name, so
+    # resource translation and validation agree
+    pod = batch_pod()
+    PodMutator([be_profile()],
+               priority_classes={"koord-batch": 2000}).mutate(pod)
+    assert pod.priority == 2000
+    assert RK.BATCH_CPU in pod.requests
+    ok, errs = validate_pod(pod)
+    assert ok, errs
+
+
+def test_key_mapping_skips_missing_sources():
+    prof = be_profile(label_keys_mapping={"absent": "copied"})
+    pod = batch_pod()
+    mk_mutator(prof).mutate(pod)
+    assert "copied" not in pod.meta.labels
+
+
+def test_probability_gating():
+    # percent 50 with rng always above -> profile skipped, but the
+    # resource translation still runs for already-batch pods
+    prof = be_profile(probability=0.5)
+    m = mk_mutator(prof, rng=lambda: 0.99)
+    pod = batch_pod()
+    m.mutate(pod)
+    assert pod.qos_label == ""
+    m2 = mk_mutator(be_profile(probability=0.5), rng=lambda: 0.01)
+    pod2 = batch_pod()
+    m2.mutate(pod2)
+    assert pod2.qos_label == "BE"
+
+
+def test_limit_only_gets_request():
+    prof = be_profile()
+    m = mk_mutator(prof)
+    pod = api.Pod(meta=api.ObjectMeta(name="p", labels={"app": "batch-job"}),
+                  limits={RK.CPU: 2000.0})
+    m.mutate(pod)
+    assert pod.requests[RK.BATCH_CPU] == 2000.0
+
+
+def test_skip_update_resources():
+    prof = be_profile(skip_update_resources=True)
+    pod = batch_pod()
+    mk_mutator(prof).mutate(pod)
+    assert pod.qos_label == "BE"
+    assert RK.CPU in pod.requests  # translation skipped
+
+
+# --- validation -------------------------------------------------------------
+
+
+def test_validate_forbidden_combinations():
+    ok, errs = validate_pod(api.Pod(qos_label="BE", priority=9100))
+    assert not ok and "cannot be used in combination" in errs[0]
+    ok, _ = validate_pod(api.Pod(qos_label="BE", priority=5100,
+                                 requests={RK.BATCH_CPU: 100.0}))
+    assert ok
+    ok, _ = validate_pod(api.Pod(qos_label="LSR", priority=5100,
+                                 requests={RK.CPU: 1000.0}))
+    assert not ok
+
+
+def test_validate_batch_resources_require_be():
+    ok, errs = validate_pod(api.Pod(qos_label="LS", priority=5100,
+                                    requests={RK.BATCH_CPU: 100.0}))
+    assert not ok and "QoS BE" in errs[0]
+
+
+def test_validate_lsr_integer_cpu():
+    base = dict(qos_label="LSR", priority=9100)
+    ok, _ = validate_pod(api.Pod(requests={RK.CPU: 2000.0}, **base))
+    assert ok
+    ok, errs = validate_pod(api.Pod(requests={RK.CPU: 2500.0}, **base))
+    assert not ok and "integer" in errs[0]
+    ok, errs = validate_pod(api.Pod(requests={}, **base))
+    assert not ok and "must declare" in errs[0]
+
+
+def test_validate_immutable_on_update():
+    old = api.Pod(qos_label="LS", priority=9100)
+    new = api.Pod(qos_label="BE", priority=5100,
+                  requests={RK.BATCH_CPU: 10.0})
+    ok, errs = validate_pod(new, old)
+    assert not ok
+    assert any("immutable" in e for e in errs)
+
+
+# --- quota topology ---------------------------------------------------------
+
+
+def quota(name, parent="", minq=None, maxq=None, **kw):
+    return api.ElasticQuota(meta=api.ObjectMeta(name=name), parent=parent,
+                            min=minq or {}, max=maxq or {}, **kw)
+
+
+def test_quota_defaults_and_add():
+    qt = QuotaTopology()
+    q = quota("a", maxq={RK.CPU: 100.0}, minq={RK.CPU: 10.0})
+    qt.valid_add(q)
+    assert q.parent == ROOT_QUOTA_NAME
+    assert q.shared_weight == {RK.CPU: 100.0}
+
+
+def test_quota_min_greater_than_max_rejected():
+    qt = QuotaTopology()
+    with pytest.raises(QuotaTopologyError):
+        qt.valid_add(quota("bad", minq={RK.CPU: 200.0},
+                           maxq={RK.CPU: 100.0}))
+
+
+def test_quota_parent_must_be_parent_and_tree_inherits():
+    qt = QuotaTopology()
+    parent = quota("parent", minq={RK.CPU: 100.0}, maxq={RK.CPU: 200.0},
+                   is_parent=True, tree_id="t1")
+    qt.valid_add(parent)
+    child = quota("child", parent="parent", minq={RK.CPU: 50.0},
+                  maxq={RK.CPU: 200.0})
+    qt.valid_add(child)
+    assert child.tree_id == "t1"
+    leaf = quota("leaf", parent="child", maxq={RK.CPU: 10.0})
+    with pytest.raises(QuotaTopologyError):  # child.is_parent is False
+        qt.valid_add(leaf)
+
+
+def test_quota_max_keys_must_match_parent():
+    qt = QuotaTopology()
+    qt.valid_add(quota("parent", minq={RK.CPU: 100.0},
+                       maxq={RK.CPU: 200.0}, is_parent=True))
+    with pytest.raises(QuotaTopologyError):
+        qt.valid_add(quota("child", parent="parent",
+                           maxq={RK.CPU: 50.0, RK.MEMORY: 10.0}))
+
+
+def test_quota_sibling_min_sum_capped_by_parent():
+    qt = QuotaTopology()
+    qt.valid_add(quota("parent", minq={RK.CPU: 100.0},
+                       maxq={RK.CPU: 200.0}, is_parent=True))
+    qt.valid_add(quota("a", parent="parent", minq={RK.CPU: 70.0},
+                       maxq={RK.CPU: 200.0}))
+    with pytest.raises(QuotaTopologyError):
+        qt.valid_add(quota("b", parent="parent", minq={RK.CPU: 40.0},
+                           maxq={RK.CPU: 200.0}))
+    # allowForceUpdate bypasses the min-sum check
+    qt.valid_add(quota("b", parent="parent", minq={RK.CPU: 40.0},
+                       maxq={RK.CPU: 200.0}, allow_force_update=True))
+
+
+def test_quota_namespace_binding_exclusive():
+    qt = QuotaTopology()
+    qt.valid_add(quota("a", maxq={RK.CPU: 10.0}, namespaces=["ns1"]))
+    with pytest.raises(QuotaTopologyError):
+        qt.valid_add(quota("b", maxq={RK.CPU: 10.0}, namespaces=["ns1"]))
+
+
+def test_quota_delete_guards():
+    pods = {"a": 0, "parent": 0}
+    qt = QuotaTopology(pod_counter=lambda n: pods.get(n, 0))
+    qt.valid_add(quota("parent", minq={RK.CPU: 100.0},
+                       maxq={RK.CPU: 200.0}, is_parent=True))
+    qt.valid_add(quota("a", parent="parent", minq={RK.CPU: 10.0},
+                       maxq={RK.CPU: 200.0}))
+    with pytest.raises(QuotaTopologyError):  # has children
+        qt.valid_delete("parent")
+    pods["a"] = 3
+    with pytest.raises(QuotaTopologyError):  # has pods
+        qt.valid_delete("a")
+    pods["a"] = 0
+    qt.valid_delete("a")
+    qt.valid_delete("parent")
+    with pytest.raises(QuotaTopologyError):  # protected names
+        qt.valid_delete(ROOT_QUOTA_NAME)
+
+
+def test_quota_update_parent_with_pods_forbidden():
+    pods = {"c": 2}
+    qt = QuotaTopology(pod_counter=lambda n: pods.get(n, 0))
+    qt.valid_add(quota("p1", minq={RK.CPU: 100.0}, maxq={RK.CPU: 200.0},
+                       is_parent=True))
+    qt.valid_add(quota("p2", minq={RK.CPU: 100.0}, maxq={RK.CPU: 200.0},
+                       is_parent=True))
+    qt.valid_add(quota("c", parent="p1", minq={RK.CPU: 10.0},
+                       maxq={RK.CPU: 200.0}))
+    moved = quota("c", parent="p2", minq={RK.CPU: 10.0},
+                  maxq={RK.CPU: 200.0})
+    with pytest.raises(QuotaTopologyError):
+        qt.valid_update(moved)
