@@ -85,14 +85,20 @@ def priority_class_of(priority: Optional[int], label: str = "",
     Mirrors GetPodPriorityClassRaw/getPriorityClassByPriority
     (apis/extension/priority.go:73-103): the `koordinator.sh/priority-class`
     label wins; a koord-* PriorityClassName is next (it covers priority
-    values outside the koordinator bands); otherwise the numeric priority is
-    matched against the bands.
+    values outside the koordinator bands — a cluster's unrelated
+    PriorityClass that merely happens to be named "batch" must NOT be
+    treated as koordinator Batch, so only the koord- prefixed names
+    resolve here); otherwise the numeric priority is matched against the
+    bands.
     """
-    for override in (label, priority_class_name):
-        if override:
-            parsed = PriorityClass.parse(override)
-            if parsed is not PriorityClass.NONE:
-                return parsed
+    if label:
+        parsed = PriorityClass.parse(label)
+        if parsed is not PriorityClass.NONE:
+            return parsed
+    if priority_class_name and priority_class_name.startswith("koord-"):
+        parsed = PriorityClass.parse(priority_class_name)
+        if parsed is not PriorityClass.NONE:
+            return parsed
     if priority is None:
         return PriorityClass.NONE
     for cls, (lo, hi) in PRIORITY_BANDS.items():

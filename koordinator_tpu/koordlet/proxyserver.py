@@ -1,0 +1,126 @@
+"""koordlet proxy-mode hook server: serves RuntimeHookService over the
+framed unix-socket RPC, translating wire requests into HookContext runs.
+
+Capability parity with koordlet runtimehooks/proxyserver/server.go:101-112
+(SURVEY.md 2.2 delivery mode 2): the runtime proxy calls these endpoints
+around CRI operations; each maps to a hook Stage, the registered hook
+plugins (groupidentity/cpuset/batchresource/gpu...) produce cgroup updates
+and env injections, and those are folded into the protobuf response the
+proxy merges into the forwarded CRI request. Known cgroup files map onto
+the typed LinuxContainerResources fields; everything else rides the
+cgroup-v2-style `unified` map.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+from koordinator_tpu.api import types as api
+from koordinator_tpu.api.extension import LABEL_POD_QOS
+from koordinator_tpu.koordlet.runtimehooks import HookContext, HookServer, Stage
+from koordinator_tpu.koordlet.statesinformer import PodMeta
+from koordinator_tpu.runtimeproxy import api_pb2 as pb
+from koordinator_tpu.runtimeproxy.rpc import RpcServer
+
+# cgroup file -> typed LinuxContainerResources field
+_TYPED_FIELDS = {
+    "cpu.shares": "cpu_shares",
+    "cpu.cfs_quota_us": "cpu_quota",
+    "cpu.cfs_period_us": "cpu_period",
+    "memory.limit_in_bytes": "memory_limit_in_bytes",
+}
+
+_POD_STAGES = {
+    "PreRunPodSandboxHook": Stage.PRE_RUN_POD_SANDBOX,
+    "PostStopPodSandboxHook": Stage.POST_STOP_POD_SANDBOX,
+}
+_CONTAINER_STAGES = {
+    "PreCreateContainerHook": Stage.PRE_CREATE_CONTAINER,
+    "PreStartContainerHook": Stage.PRE_CREATE_CONTAINER,
+    "PostStartContainerHook": Stage.POST_START_CONTAINER,
+    "PostStopContainerHook": Stage.POST_STOP_POD_SANDBOX,
+    "PreUpdateContainerResourcesHook": Stage.PRE_UPDATE_CONTAINER,
+}
+
+
+def _pod_meta(name: str, namespace: str, uid: str,
+              labels: Dict[str, str], annotations: Dict[str, str],
+              cgroup_parent: str) -> PodMeta:
+    pod = api.Pod(meta=api.ObjectMeta(name=name, namespace=namespace,
+                                      uid=uid, labels=dict(labels),
+                                      annotations=dict(annotations)),
+                  qos_label=labels.get(LABEL_POD_QOS, ""))
+    return PodMeta(pod=pod, cgroup_dir=cgroup_parent or "")
+
+
+def _fold_updates(ctx: HookContext,
+                  resources: pb.LinuxContainerResources) -> None:
+    for upd in ctx.cgroup_updates:
+        field = _TYPED_FIELDS.get(upd.resource)
+        if field is not None:
+            try:
+                setattr(resources, field, int(float(upd.value)))
+                continue
+            except ValueError:
+                pass
+        if upd.resource == "cpuset.cpus":
+            resources.cpuset_cpus = upd.value
+        else:
+            resources.unified[upd.resource] = upd.value
+
+
+class ProxyHookService:
+    """The RuntimeHookService implementation backed by a HookServer."""
+
+    def __init__(self, hook_server: HookServer):
+        self.hook_server = hook_server
+
+    # -- pod sandbox ---------------------------------------------------------
+
+    def _pod_hook(self, method: str, req: pb.PodSandboxHookRequest
+                  ) -> pb.PodSandboxHookResponse:
+        meta = _pod_meta(req.pod_meta.name, req.pod_meta.namespace,
+                         req.pod_meta.uid, req.labels, req.annotations,
+                         req.cgroup_parent)
+        ctx = HookContext(pod=meta, stage=_POD_STAGES[method])
+        self.hook_server.run_hooks(ctx.stage, ctx)
+        resp = pb.PodSandboxHookResponse(cgroup_parent=req.cgroup_parent)
+        resources = pb.LinuxContainerResources()
+        _fold_updates(ctx, resources)
+        resp.resources.CopyFrom(resources)
+        return resp
+
+    # -- containers ----------------------------------------------------------
+
+    def _container_hook(self, method: str,
+                        req: pb.ContainerResourceHookRequest
+                        ) -> pb.ContainerResourceHookResponse:
+        meta = _pod_meta(req.pod_meta.name, req.pod_meta.namespace,
+                         req.pod_meta.uid, req.pod_labels,
+                         req.pod_annotations, req.pod_cgroup_parent)
+        ctx = HookContext(pod=meta, stage=_CONTAINER_STAGES[method],
+                          container_name=req.container_meta.name)
+        self.hook_server.run_hooks(ctx.stage, ctx)
+        resp = pb.ContainerResourceHookResponse(
+            pod_cgroup_parent=req.pod_cgroup_parent)
+        resources = pb.LinuxContainerResources()
+        resources.CopyFrom(req.container_resources)
+        _fold_updates(ctx, resources)
+        resp.container_resources.CopyFrom(resources)
+        for k, v in ctx.env.items():
+            resp.container_envs[k] = v
+        return resp
+
+    # -- serving -------------------------------------------------------------
+
+    def serve(self, sock_path: str) -> RpcServer:
+        handlers = {}
+        for method in _POD_STAGES:
+            handlers[method] = (
+                pb.PodSandboxHookRequest,
+                lambda req, m=method: self._pod_hook(m, req))
+        for method in _CONTAINER_STAGES:
+            handlers[method] = (
+                pb.ContainerResourceHookRequest,
+                lambda req, m=method: self._container_hook(m, req))
+        return RpcServer(sock_path, handlers)

@@ -1,0 +1,9 @@
+"""koord-runtime-proxy equivalent: CRI-interposing proxy + RuntimeHookService
+wire protocol (SURVEY.md 2.5, pkg/runtimeproxy + apis/runtime/v1alpha1)."""
+
+from koordinator_tpu.runtimeproxy.rpc import RpcClient, RpcError, RpcServer  # noqa: F401
+from koordinator_tpu.runtimeproxy.server import (  # noqa: F401
+    FailurePolicy,
+    RuntimeProxy,
+)
+from koordinator_tpu.runtimeproxy.store import MetaStore  # noqa: F401

@@ -1,0 +1,127 @@
+"""Length-prefixed protobuf RPC over unix sockets.
+
+The reference speaks gRPC over a unix socket between the proxy and the
+hook server (runtimeproxy/server, koordlet proxyserver/server.go:101-112).
+grpcio is not in this image, so the same service contract rides a minimal
+framed protocol instead — protoc-generated messages on the wire, one
+request/response per connection round:
+
+    frame     := u32_be length ++ payload
+    request   := u8 method_len ++ method_name ++ message_bytes
+    response  := u8 status (0 ok / 1 error) ++ payload
+                 (message_bytes on ok, utf-8 error text on error)
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import socketserver
+import struct
+import threading
+from typing import Callable, Dict, Optional, Tuple, Type
+
+
+class RpcError(RuntimeError):
+    pass
+
+
+def _read_exact(sock: socket.socket, n: int) -> bytes:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise RpcError("connection closed mid-frame")
+        buf += chunk
+    return buf
+
+
+def _read_frame(sock: socket.socket) -> bytes:
+    (length,) = struct.unpack(">I", _read_exact(sock, 4))
+    if length > 64 * 1024 * 1024:
+        raise RpcError(f"frame too large: {length}")
+    return _read_exact(sock, length)
+
+
+def _write_frame(sock: socket.socket, payload: bytes) -> None:
+    sock.sendall(struct.pack(">I", len(payload)) + payload)
+
+
+class RpcServer:
+    """Serves `handlers`: method name -> (request class, fn(req) -> resp).
+
+    Runs on a background thread; `close()` stops it. One RPC per
+    connection keeps the framing trivial (hook calls are rare: container
+    lifecycle events)."""
+
+    def __init__(self, sock_path: str,
+                 handlers: Dict[str, Tuple[Type, Callable]]):
+        self.sock_path = sock_path
+        self.handlers = dict(handlers)
+        outer = self
+
+        class Handler(socketserver.BaseRequestHandler):
+            def handle(self) -> None:
+                try:
+                    payload = _read_frame(self.request)
+                except RpcError:
+                    return
+                try:
+                    mlen = payload[0]
+                    method = payload[1:1 + mlen].decode()
+                    body = payload[1 + mlen:]
+                    entry = outer.handlers.get(method)
+                    if entry is None:
+                        raise RpcError(f"unknown method {method!r}")
+                    req_cls, fn = entry
+                    resp = fn(req_cls.FromString(body))
+                    out = b"\x00" + resp.SerializeToString()
+                except Exception as e:  # surfaced to the caller as status 1
+                    out = b"\x01" + str(e).encode()
+                _write_frame(self.request, out)
+
+        class Server(socketserver.ThreadingUnixStreamServer):
+            daemon_threads = True
+
+        # a crashed/restarted server leaves the socket file behind and
+        # AF_UNIX bind() fails on it (allow_reuse_address is a no-op for
+        # unix sockets) — unlink the stale path so restart always works
+        try:
+            os.unlink(sock_path)
+        except FileNotFoundError:
+            pass
+        self._server = Server(sock_path, Handler)
+        self._thread = threading.Thread(target=self._server.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+
+    def close(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+        try:
+            os.unlink(self.sock_path)
+        except FileNotFoundError:
+            pass
+
+
+class RpcClient:
+    def __init__(self, sock_path: str, timeout: float = 5.0):
+        self.sock_path = sock_path
+        self.timeout = timeout
+
+    def call(self, method: str, request, response_cls: Type):
+        sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        sock.settimeout(self.timeout)
+        try:
+            sock.connect(self.sock_path)
+            name = method.encode()
+            _write_frame(sock, bytes([len(name)]) + name
+                         + request.SerializeToString())
+            resp = _read_frame(sock)
+        finally:
+            sock.close()
+        if not resp:
+            raise RpcError("empty response")
+        if resp[0] != 0:
+            raise RpcError(resp[1:].decode(errors="replace"))
+        return response_cls.FromString(resp[1:])

@@ -1,0 +1,243 @@
+"""The CRI-interposing runtime proxy (RuntimeManager).
+
+Capability parity with pkg/runtimeproxy/server (SURVEY.md 2.5): the proxy
+sits between the kubelet-facing CRI socket and the real runtime; before and
+after forwarding each lifecycle operation it calls the registered hook
+server (the node agent) over the RuntimeHookService protocol, merging the
+hook response into the forwarded request so QoS adjustments (cgroup
+parent, cpu shares/quota/cpuset, memory limits, env injection) reach the
+runtime atomically with the operation. Hook failures follow the configured
+failure policy: Fail rejects the CRI op, Ignore forwards unmodified
+(runtimeproxy/config failure policies).
+
+The CRI surface is a typed subset (this framework's kubelet edge is
+internal); the hook wire protocol is the protoc-generated api_pb2.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import logging
+from typing import Dict, Optional, Protocol
+
+from koordinator_tpu.runtimeproxy import api_pb2 as pb
+from koordinator_tpu.runtimeproxy.rpc import RpcClient, RpcError
+from koordinator_tpu.runtimeproxy.store import (
+    ContainerInfo,
+    MetaStore,
+    PodSandboxInfo,
+)
+
+log = logging.getLogger(__name__)
+
+
+class FailurePolicy(enum.Enum):
+    FAIL = "Fail"
+    IGNORE = "Ignore"
+
+
+@dataclasses.dataclass
+class PodSandboxRequest:
+    """CRI RunPodSandbox/StopPodSandbox subset (incl. the sandbox-level
+    cgroup resources the hook response can adjust)."""
+
+    sandbox_id: str = ""
+    name: str = ""
+    namespace: str = ""
+    uid: str = ""
+    labels: Dict[str, str] = dataclasses.field(default_factory=dict)
+    annotations: Dict[str, str] = dataclasses.field(default_factory=dict)
+    cgroup_parent: str = ""
+    runtime_handler: str = ""
+    cpu_shares: int = 0
+    cpu_quota: int = 0
+    memory_limit_bytes: int = 0
+    cpuset_cpus: str = ""
+    unified: Dict[str, str] = dataclasses.field(default_factory=dict)
+
+
+@dataclasses.dataclass
+class ContainerRequest:
+    """CRI Create/Start/Update/StopContainer subset."""
+
+    container_id: str = ""
+    sandbox_id: str = ""
+    name: str = ""
+    annotations: Dict[str, str] = dataclasses.field(default_factory=dict)
+    envs: Dict[str, str] = dataclasses.field(default_factory=dict)
+    cpu_shares: int = 0
+    cpu_quota: int = 0
+    memory_limit_bytes: int = 0
+    cpuset_cpus: str = ""
+    unified: Dict[str, str] = dataclasses.field(default_factory=dict)
+
+
+class RuntimeBackend(Protocol):
+    """The real runtime (containerd/dockerd stand-in)."""
+
+    def run_pod_sandbox(self, req: PodSandboxRequest) -> None: ...
+    def stop_pod_sandbox(self, req: PodSandboxRequest) -> None: ...
+    def create_container(self, req: ContainerRequest) -> None: ...
+    def start_container(self, req: ContainerRequest) -> None: ...
+    def update_container_resources(self, req: ContainerRequest) -> None: ...
+    def stop_container(self, req: ContainerRequest) -> None: ...
+
+
+def _resources_to_pb(req) -> pb.LinuxContainerResources:
+    res = pb.LinuxContainerResources(
+        cpu_shares=req.cpu_shares, cpu_quota=req.cpu_quota,
+        memory_limit_in_bytes=req.memory_limit_bytes,
+        cpuset_cpus=req.cpuset_cpus)
+    for k, v in req.unified.items():
+        res.unified[k] = v
+    return res
+
+
+def _merge_resources(req, res: pb.LinuxContainerResources) -> None:
+    """Hook response fields override the forwarded request where set
+    (works on both sandbox and container requests)."""
+    if res.cpu_shares:
+        req.cpu_shares = res.cpu_shares
+    if res.cpu_quota:
+        req.cpu_quota = res.cpu_quota
+    if res.memory_limit_in_bytes:
+        req.memory_limit_bytes = res.memory_limit_in_bytes
+    if res.cpuset_cpus:
+        req.cpuset_cpus = res.cpuset_cpus
+    for k, v in res.unified.items():
+        req.unified[k] = v
+
+
+class RuntimeProxy:
+    def __init__(self, backend: RuntimeBackend,
+                 hook_client: Optional[RpcClient] = None,
+                 failure_policy: FailurePolicy = FailurePolicy.IGNORE,
+                 store: Optional[MetaStore] = None):
+        self.backend = backend
+        self.hooks = hook_client
+        self.failure_policy = failure_policy
+        self.store = store or MetaStore()
+
+    # -- hook plumbing -------------------------------------------------------
+
+    def _call_hook(self, method: str, request, response_cls):
+        if self.hooks is None:
+            return None
+        try:
+            return self.hooks.call(method, request, response_cls)
+        except (RpcError, OSError) as e:
+            if self.failure_policy is FailurePolicy.FAIL:
+                raise
+            log.warning("hook %s failed (policy Ignore): %s", method, e)
+            return None
+
+    def _pod_hook_request(self, req: PodSandboxRequest
+                          ) -> pb.PodSandboxHookRequest:
+        out = pb.PodSandboxHookRequest(
+            pod_meta=pb.PodSandboxMetadata(name=req.name,
+                                           namespace=req.namespace,
+                                           uid=req.uid),
+            cgroup_parent=req.cgroup_parent,
+            runtime_handler=req.runtime_handler,
+            resources=_resources_to_pb(req))
+        for k, v in req.labels.items():
+            out.labels[k] = v
+        for k, v in req.annotations.items():
+            out.annotations[k] = v
+        return out
+
+    def _container_hook_request(self, req: ContainerRequest
+                                ) -> pb.ContainerResourceHookRequest:
+        pod = (self.store.pods.get(req.sandbox_id)
+               or self.store.pod_of_container(req.container_id)
+               or PodSandboxInfo())
+        out = pb.ContainerResourceHookRequest(
+            pod_meta=pb.PodSandboxMetadata(name=pod.name,
+                                           namespace=pod.namespace,
+                                           uid=pod.uid),
+            container_meta=pb.ContainerMetadata(name=req.name,
+                                                id=req.container_id),
+            container_resources=_resources_to_pb(req),
+            pod_cgroup_parent=pod.cgroup_parent)
+        for k, v in req.annotations.items():
+            out.container_annotations[k] = v
+        for k, v in pod.labels.items():
+            out.pod_labels[k] = v
+        for k, v in pod.annotations.items():
+            out.pod_annotations[k] = v
+        for k, v in req.envs.items():
+            out.container_envs[k] = v
+        return out
+
+    # -- CRI surface ---------------------------------------------------------
+
+    def run_pod_sandbox(self, req: PodSandboxRequest) -> None:
+        resp = self._call_hook("PreRunPodSandboxHook",
+                               self._pod_hook_request(req),
+                               pb.PodSandboxHookResponse)
+        if resp is not None:
+            if resp.cgroup_parent:
+                req.cgroup_parent = resp.cgroup_parent
+            for k, v in resp.labels.items():
+                req.labels[k] = v
+            for k, v in resp.annotations.items():
+                req.annotations[k] = v
+            # sandbox-level cgroup adjustments (e.g. BE group identity)
+            # ride the created sandbox, not a later update
+            _merge_resources(req, resp.resources)
+        self.store.put_pod(req.sandbox_id, PodSandboxInfo(
+            name=req.name, namespace=req.namespace, uid=req.uid,
+            labels=dict(req.labels), annotations=dict(req.annotations),
+            cgroup_parent=req.cgroup_parent))
+        self.backend.run_pod_sandbox(req)
+
+    def stop_pod_sandbox(self, req: PodSandboxRequest) -> None:
+        self.backend.stop_pod_sandbox(req)
+        self._call_hook("PostStopPodSandboxHook",
+                        self._pod_hook_request(req),
+                        pb.PodSandboxHookResponse)
+        self.store.delete_pod(req.sandbox_id)
+
+    def create_container(self, req: ContainerRequest) -> None:
+        resp = self._call_hook("PreCreateContainerHook",
+                               self._container_hook_request(req),
+                               pb.ContainerResourceHookResponse)
+        if resp is not None:
+            _merge_resources(req, resp.container_resources)
+            for k, v in resp.container_envs.items():
+                req.envs[k] = v
+            for k, v in resp.container_annotations.items():
+                req.annotations[k] = v
+        self.backend.create_container(req)
+        # register only once the container truly exists: a FAIL-policy
+        # rejection or backend error must not leave a phantom entry in
+        # the (checkpointed) store
+        self.store.put_container(req.container_id, ContainerInfo(
+            name=req.name, pod_sandbox_id=req.sandbox_id))
+
+    def start_container(self, req: ContainerRequest) -> None:
+        resp = self._call_hook("PreStartContainerHook",
+                               self._container_hook_request(req),
+                               pb.ContainerResourceHookResponse)
+        if resp is not None:
+            _merge_resources(req, resp.container_resources)
+        self.backend.start_container(req)
+        self._call_hook("PostStartContainerHook",
+                        self._container_hook_request(req),
+                        pb.ContainerResourceHookResponse)
+
+    def update_container_resources(self, req: ContainerRequest) -> None:
+        resp = self._call_hook("PreUpdateContainerResourcesHook",
+                               self._container_hook_request(req),
+                               pb.ContainerResourceHookResponse)
+        if resp is not None:
+            _merge_resources(req, resp.container_resources)
+        self.backend.update_container_resources(req)
+
+    def stop_container(self, req: ContainerRequest) -> None:
+        self.backend.stop_container(req)
+        self._call_hook("PostStopContainerHook",
+                        self._container_hook_request(req),
+                        pb.ContainerResourceHookResponse)
+        self.store.delete_container(req.container_id)

@@ -1,0 +1,87 @@
+"""Pod/container metadata checkpoint for the proxy.
+
+The proxy must attach pod context (labels/annotations/cgroup parent) to
+container-level hook calls whose CRI requests only carry a sandbox id —
+the reference checkpoints this in runtimeproxy/store (SURVEY.md 2.5).
+Persistence is optional: `save`/`load` round-trip through a JSON file so a
+restarted proxy keeps serving in-flight pods (store checkpoint dir).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Dict, Optional
+
+
+@dataclasses.dataclass
+class PodSandboxInfo:
+    name: str = ""
+    namespace: str = ""
+    uid: str = ""
+    labels: Dict[str, str] = dataclasses.field(default_factory=dict)
+    annotations: Dict[str, str] = dataclasses.field(default_factory=dict)
+    cgroup_parent: str = ""
+
+
+@dataclasses.dataclass
+class ContainerInfo:
+    name: str = ""
+    pod_sandbox_id: str = ""
+
+
+class MetaStore:
+    def __init__(self, checkpoint_path: str = ""):
+        self.pods: Dict[str, PodSandboxInfo] = {}
+        self.containers: Dict[str, ContainerInfo] = {}
+        self.checkpoint_path = checkpoint_path
+
+    def put_pod(self, sandbox_id: str, info: PodSandboxInfo) -> None:
+        self.pods[sandbox_id] = info
+        self._save()
+
+    def put_container(self, container_id: str, info: ContainerInfo) -> None:
+        self.containers[container_id] = info
+        self._save()
+
+    def pod_of_container(self, container_id: str) -> Optional[PodSandboxInfo]:
+        c = self.containers.get(container_id)
+        return self.pods.get(c.pod_sandbox_id) if c else None
+
+    def delete_pod(self, sandbox_id: str) -> None:
+        self.pods.pop(sandbox_id, None)
+        for cid in [cid for cid, c in self.containers.items()
+                    if c.pod_sandbox_id == sandbox_id]:
+            del self.containers[cid]
+        self._save()
+
+    def delete_container(self, container_id: str) -> None:
+        self.containers.pop(container_id, None)
+        self._save()
+
+    # -- checkpoint ----------------------------------------------------------
+
+    def _save(self) -> None:
+        if not self.checkpoint_path:
+            return
+        data = {
+            "pods": {k: dataclasses.asdict(v) for k, v in self.pods.items()},
+            "containers": {k: dataclasses.asdict(v)
+                           for k, v in self.containers.items()},
+        }
+        tmp = self.checkpoint_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(data, f)
+        os.replace(tmp, self.checkpoint_path)
+
+    def load(self) -> None:
+        if not self.checkpoint_path or \
+                not os.path.exists(self.checkpoint_path):
+            return
+        with open(self.checkpoint_path) as f:
+            data = json.load(f)
+        self.pods = {k: PodSandboxInfo(**v)
+                     for k, v in data.get("pods", {}).items()}
+        self.containers = {k: ContainerInfo(**v)
+                           for k, v in data.get("containers", {}).items()}

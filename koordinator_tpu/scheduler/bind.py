@@ -1,0 +1,69 @@
+"""Bind-time materialization: turn a ScheduleResult row into the pod
+annotations the node agent's runtime hooks consume.
+
+Mirrors the PreBind writes of the reference plugins (SURVEY.md 3.1):
+- NodeNUMAResource writes `scheduling.koordinator.sh/resource-status`
+  (zone + exact cpuset, plugin.go:427-463) — the cpuset comes from the
+  host-side accumulator (cpu_accumulator.take_cpus) on the chosen node's
+  topology, exactly like the reference runs takeCPUs at Reserve time.
+- DeviceShare writes the device-allocation annotation (minors + per-
+  instance shares); PCIe-grouped minors are ordered so joint-allocate
+  consumers enumerate devices on the same root first.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, Optional
+
+import numpy as np
+
+from koordinator_tpu.api.extension import ANNOTATION_RESOURCE_STATUS
+from koordinator_tpu.koordlet.runtimehooks import ANNOTATION_DEVICE_ALLOCATED
+from koordinator_tpu.scheduler.plugins.cpu_accumulator import (
+    CPUTopology,
+    take_cpus,
+)
+from koordinator_tpu.snapshot.schema import ClusterSnapshot, PodBatch
+
+
+def resource_status_annotation(result, pod_index: int,
+                               topology: Optional[CPUTopology] = None,
+                               cpus_needed: int = 0,
+                               allocated: Optional[Dict[int, int]] = None,
+                               bind_policy: str = "FullPCPUs") -> Dict[str, str]:
+    """The resource-status annotation for a NUMA-bound pod; {} when the pod
+    took no zone. With a topology, the exact cpuset is accumulated on the
+    chosen zone (otherwise only the zone is reported)."""
+    zone = int(np.asarray(result.numa_zone)[pod_index])
+    if zone < 0:
+        return {}
+    status: Dict[str, object] = {"numaNodes": [zone]}
+    if topology is not None and cpus_needed > 0:
+        available = {c.cpu for c in topology.nodes.get(zone, ())}
+        cpus = take_cpus(topology, available, allocated or {}, cpus_needed,
+                         bind_policy=bind_policy)
+        status["cpuset"] = ",".join(str(c) for c in sorted(cpus))
+    return {ANNOTATION_RESOURCE_STATUS: json.dumps(status)}
+
+
+def device_allocation_annotation(snap: ClusterSnapshot, pods: PodBatch,
+                                 result, pod_index: int) -> Dict[str, str]:
+    """The device-allocation annotation from the result's instance masks;
+    {} when the pod took no devices. GPU minors are sorted PCIe-group-
+    first so same-root pairs stay adjacent (topology guide preference)."""
+    take = np.asarray(result.gpu_take)[pod_index]
+    aux = np.asarray(result.aux_inst)[pod_index]
+    node = int(np.asarray(result.assignment)[pod_index])
+    alloc: Dict[str, list] = {}
+    if node >= 0 and take.any():
+        pcie = np.asarray(snap.devices.gpu_pcie)[node]
+        minors = sorted(int(m) for m in np.nonzero(take)[0])
+        minors.sort(key=lambda m: (int(pcie[m]), m))
+        alloc["gpu"] = [{"minor": m} for m in minors]
+    for t, key in enumerate(("rdma", "fpga")):
+        if node >= 0 and aux[t] >= 0:
+            alloc[key] = [{"minor": int(aux[t])}]
+    if not alloc:
+        return {}
+    return {ANNOTATION_DEVICE_ALLOCATED: json.dumps(alloc)}

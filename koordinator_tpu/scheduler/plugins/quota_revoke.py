@@ -141,13 +141,16 @@ def select_victims_on_node(preemptor: api.Pod,
     runtime after the removals. Returns None when preemption on this node
     cannot help."""
     prio = preemptor.priority or 0
-    candidates = [p for p in pods_on_node
-                  if (p.priority or 0) < prio
-                  and p.quota_name == preemptor.quota_name]
+
+    def is_candidate(p: api.Pod) -> bool:
+        return ((p.priority or 0) < prio
+                and p.quota_name == preemptor.quota_name)
+
+    candidates = [p for p in pods_on_node if is_candidate(p)]
     if not candidates:
         return None
 
-    others = [p for p in pods_on_node if p not in candidates]
+    others = [p for p in pods_on_node if not is_candidate(p)]
     req = resource_vec(preemptor.requests).astype(np.float64)
     base_used = sum((resource_vec(p.requests).astype(np.float64)
                      for p in others),

@@ -1,0 +1,296 @@
+"""Runtime-proxy tests: framed RPC wire protocol, CRI interposition with
+hook merging over a REAL unix socket, failure policies, and the metadata
+checkpoint (SURVEY.md 2.5; reference runtimeproxy/server + proxyserver)."""
+
+import json
+import os
+
+import pytest
+
+from koordinator_tpu.api import types as api
+from koordinator_tpu.api.extension import (
+    ANNOTATION_RESOURCE_STATUS,
+    LABEL_POD_QOS,
+    ResourceKind as RK,
+)
+from koordinator_tpu.koordlet.proxyserver import ProxyHookService
+from koordinator_tpu.koordlet.runtimehooks import default_hook_server
+from koordinator_tpu.koordlet.statesinformer import StatesInformer
+from koordinator_tpu.runtimeproxy import (
+    FailurePolicy,
+    MetaStore,
+    RpcClient,
+    RpcError,
+    RuntimeProxy,
+)
+from koordinator_tpu.runtimeproxy import api_pb2 as pb
+from koordinator_tpu.runtimeproxy.rpc import RpcServer
+from koordinator_tpu.runtimeproxy.server import (
+    ContainerRequest,
+    PodSandboxRequest,
+)
+
+
+class FakeRuntime:
+    """Records forwarded CRI calls (containerd stand-in)."""
+
+    def __init__(self):
+        self.calls = []
+
+    def __getattr__(self, name):
+        def record(req):
+            self.calls.append((name, req))
+        return record
+
+
+@pytest.fixture
+def hook_endpoint(tmp_path):
+    informer = StatesInformer()
+    service = ProxyHookService(default_hook_server(informer))
+    sock = str(tmp_path / "hooks.sock")
+    server = service.serve(sock)
+    yield sock
+    server.close()
+
+
+def be_sandbox():
+    return PodSandboxRequest(
+        sandbox_id="sb1", name="spark-1", namespace="default", uid="u1",
+        labels={LABEL_POD_QOS: "BE"},
+        cgroup_parent="kubepods/besteffort/podu1")
+
+
+def test_rpc_roundtrip_and_errors(tmp_path):
+    sock = str(tmp_path / "t.sock")
+
+    def echo(req):
+        resp = pb.PodSandboxHookResponse()
+        resp.labels.update(req.labels)
+        return resp
+
+    def boom(req):
+        raise RuntimeError("hook exploded")
+
+    server = RpcServer(sock, {
+        "Echo": (pb.PodSandboxHookRequest, echo),
+        "Boom": (pb.PodSandboxHookRequest, boom)})
+    try:
+        client = RpcClient(sock)
+        req = pb.PodSandboxHookRequest()
+        req.labels["k"] = "v"
+        resp = client.call("Echo", req, pb.PodSandboxHookResponse)
+        assert dict(resp.labels) == {"k": "v"}
+        with pytest.raises(RpcError, match="hook exploded"):
+            client.call("Boom", req, pb.PodSandboxHookResponse)
+        with pytest.raises(RpcError, match="unknown method"):
+            client.call("Nope", req, pb.PodSandboxHookResponse)
+    finally:
+        server.close()
+
+
+def test_proxy_interposes_be_pod_lifecycle(hook_endpoint):
+    runtime = FakeRuntime()
+    proxy = RuntimeProxy(runtime, RpcClient(hook_endpoint),
+                         FailurePolicy.FAIL)
+    proxy.run_pod_sandbox(be_sandbox())
+    # container of a BE pod with batch resources + cpuset + gpu allocation
+    pod_annotations = {
+        ANNOTATION_RESOURCE_STATUS: json.dumps(
+            {"cpuset": "4-7", "numaNodes": [1]}),
+        "scheduling.koordinator.sh/device-allocated": json.dumps(
+            {"gpu": [{"minor": 2}, {"minor": 3}]}),
+    }
+    proxy.store.pods["sb1"].annotations.update(pod_annotations)
+    creq = ContainerRequest(container_id="c1", sandbox_id="sb1",
+                            name="main", cpu_shares=1024)
+    proxy.create_container(creq)
+    assert [name for name, _ in runtime.calls] == ["run_pod_sandbox",
+                                                   "create_container"]
+    fwd = runtime.calls[1][1]
+    # cpuset hook output merged into the forwarded CRI request
+    assert fwd.cpuset_cpus == "4-7"
+    assert fwd.unified["cpuset.mems"] == "1"
+    # gpu hook env injection
+    assert fwd.envs["NVIDIA_VISIBLE_DEVICES"] == "2,3"
+
+
+def test_sandbox_creation_carries_pod_stage_cgroup_updates(hook_endpoint):
+    # BE group identity computed at PreRunPodSandbox must ride the CREATED
+    # sandbox, not wait for a later update call
+    runtime = FakeRuntime()
+    proxy = RuntimeProxy(runtime, RpcClient(hook_endpoint),
+                         FailurePolicy.FAIL)
+    req = be_sandbox()
+    proxy.run_pod_sandbox(req)
+    fwd = runtime.calls[0][1]
+    assert fwd.unified["cpu.bvt_warp_ns"] == "-1"
+
+
+def test_rpc_server_restart_on_stale_socket(tmp_path):
+    sock = str(tmp_path / "s.sock")
+    handlers = {"Echo": (pb.PodSandboxHookRequest,
+                         lambda req: pb.PodSandboxHookResponse())}
+    first = RpcServer(sock, handlers)
+    # simulate a crash: the socket file stays behind
+    first._server.shutdown()
+    first._server.server_close()
+    assert os.path.exists(sock)
+    second = RpcServer(sock, handlers)
+    RpcClient(sock).call("Echo", pb.PodSandboxHookRequest(),
+                         pb.PodSandboxHookResponse)
+    second.close()
+    assert not os.path.exists(sock)
+
+
+def test_failed_create_leaves_no_phantom_container(tmp_path):
+    runtime = FakeRuntime()
+    dead = str(tmp_path / "dead.sock")
+    proxy = RuntimeProxy(runtime, RpcClient(dead), FailurePolicy.FAIL)
+    with pytest.raises(OSError):
+        proxy.create_container(ContainerRequest(container_id="c1",
+                                                sandbox_id="sb1",
+                                                name="main"))
+    assert "c1" not in proxy.store.containers
+
+
+def test_proxy_update_applies_batch_resources(hook_endpoint):
+    runtime = FakeRuntime()
+    proxy = RuntimeProxy(runtime, RpcClient(hook_endpoint),
+                         FailurePolicy.FAIL)
+    sb = be_sandbox()
+    proxy.run_pod_sandbox(sb)
+    # hooks derive batch limits from the pod labels only in proxy mode;
+    # the batchresource hook needs requests — carried via annotations is
+    # not modeled, so drive the typed path: BE pod label -> bvt in unified
+    ureq = ContainerRequest(container_id="c1", sandbox_id="sb1", name="main")
+    proxy.update_container_resources(ureq)
+    fwd = runtime.calls[-1][1]
+    assert fwd.unified["cpu.bvt_warp_ns"] == "-1"  # BE group identity
+
+
+def test_failure_policy_fail_rejects_and_ignore_forwards(tmp_path):
+    runtime = FakeRuntime()
+    dead_sock = str(tmp_path / "nobody.sock")
+    strict = RuntimeProxy(runtime, RpcClient(dead_sock), FailurePolicy.FAIL)
+    with pytest.raises(OSError):
+        strict.run_pod_sandbox(be_sandbox())
+    assert runtime.calls == []
+    lenient = RuntimeProxy(runtime, RpcClient(dead_sock),
+                           FailurePolicy.IGNORE)
+    lenient.run_pod_sandbox(be_sandbox())
+    assert [name for name, _ in runtime.calls] == ["run_pod_sandbox"]
+
+
+def test_proxy_without_hook_client_passthrough():
+    runtime = FakeRuntime()
+    proxy = RuntimeProxy(runtime)
+    proxy.run_pod_sandbox(be_sandbox())
+    proxy.stop_pod_sandbox(be_sandbox())
+    assert len(runtime.calls) == 2
+    assert "sb1" not in proxy.store.pods
+
+
+def test_store_checkpoint_roundtrip(tmp_path):
+    path = str(tmp_path / "meta.json")
+    store = MetaStore(path)
+    from koordinator_tpu.runtimeproxy.store import ContainerInfo, PodSandboxInfo
+    store.put_pod("sb1", PodSandboxInfo(name="p", uid="u",
+                                        labels={"a": "b"}))
+    store.put_container("c1", ContainerInfo(name="main",
+                                            pod_sandbox_id="sb1"))
+    restored = MetaStore(path)
+    restored.load()
+    assert restored.pods["sb1"].labels == {"a": "b"}
+    assert restored.pod_of_container("c1").name == "p"
+    restored.delete_pod("sb1")
+    assert restored.pod_of_container("c1") is None
+
+
+def test_schedule_to_runtime_annotation_loop(hook_endpoint):
+    """Scheduler result -> bind annotations -> proxy hook -> forwarded CRI
+    request: the full loop from the TPU kernel's instance masks to the
+    cgroup/env adjustments containerd would receive."""
+    import numpy as np
+
+    from koordinator_tpu.api.types import (
+        Device, DeviceInfo, Node, NodeMetric, NodeResourceTopology,
+        NUMAZone, ObjectMeta, Pod,
+    )
+    from koordinator_tpu.scheduler import core
+    from koordinator_tpu.scheduler.bind import (
+        device_allocation_annotation,
+        resource_status_annotation,
+    )
+    from koordinator_tpu.scheduler.plugins.cpu_accumulator import CPUTopology
+    from koordinator_tpu.scheduler.plugins.loadaware import LoadAwareConfig
+    from koordinator_tpu.snapshot import SnapshotBuilder
+
+    b = SnapshotBuilder(max_nodes=1, max_gpu_inst=4)
+    b.add_node(Node(
+        meta=ObjectMeta(name="n0"),
+        allocatable={RK.CPU: 16000.0, RK.MEMORY: 65536.0},
+        topology=NodeResourceTopology(node_name="n0", zones=[
+            NUMAZone(cpus_milli=8000.0, memory_mib=32768.0),
+            NUMAZone(cpus_milli=8000.0, memory_mib=32768.0)])))
+    b.set_node_metric(NodeMetric(node_name="n0", update_time=1e9,
+                                 node_usage={RK.CPU: 500.0,
+                                             RK.MEMORY: 1000.0}))
+    b.add_device(Device(node_name="n0", devices=[
+        DeviceInfo(minor=m, type="gpu",
+                   resources={RK.GPU_CORE: 100.0, RK.GPU_MEMORY: 1000.0},
+                   numa_node=m // 2, pcie_id=f"p{m//2}")
+        for m in range(4)]))
+    snap, ctx = b.build(now=1e9)
+    pod = Pod(meta=ObjectMeta(name="train", labels={LABEL_POD_QOS: "LSR"}),
+              requests={RK.CPU: 2000.0, RK.MEMORY: 4096.0,
+                        RK.GPU_CORE: 200.0},
+              priority=9100, gpu_memory_ratio=200.0, qos_label="LSR",
+              required_cpu_bind=True)
+    res = core.schedule_batch(snap, b.build_pod_batch([pod], ctx),
+                              LoadAwareConfig.make())
+    assert int(np.asarray(res.assignment)[0]) == 0
+
+    topo = CPUTopology.uniform(num_sockets=1, nodes_per_socket=2,
+                               cores_per_node=4, threads_per_core=2)
+    annotations = {}
+    annotations.update(resource_status_annotation(res, 0, topo,
+                                                  cpus_needed=2))
+    annotations.update(device_allocation_annotation(snap,
+                                                    b.build_pod_batch(
+                                                        [pod], ctx),
+                                                    res, 0))
+    assert ANNOTATION_RESOURCE_STATUS in annotations
+    status = json.loads(annotations[ANNOTATION_RESOURCE_STATUS])
+    zone = status["numaNodes"][0]
+    minors = [d["minor"] for d in json.loads(
+        annotations["scheduling.koordinator.sh/device-allocated"])["gpu"]]
+    assert all(m // 2 == zone for m in minors)  # GPUs on the cpuset zone
+
+    # the annotations drive the runtime hooks through the proxy
+    runtime = FakeRuntime()
+    proxy = RuntimeProxy(runtime, RpcClient(hook_endpoint),
+                         FailurePolicy.FAIL)
+    sreq = PodSandboxRequest(sandbox_id="sb", name="train", uid="u",
+                             labels={LABEL_POD_QOS: "LSR"},
+                             annotations=annotations,
+                             cgroup_parent="kubepods/podu")
+    proxy.run_pod_sandbox(sreq)
+    proxy.create_container(ContainerRequest(container_id="c",
+                                            sandbox_id="sb", name="main"))
+    fwd = runtime.calls[-1][1]
+    assert fwd.cpuset_cpus == status["cpuset"]
+    assert fwd.envs["NVIDIA_VISIBLE_DEVICES"] == ",".join(
+        str(m) for m in minors)
+
+
+def test_stop_container_cleans_store(hook_endpoint):
+    runtime = FakeRuntime()
+    proxy = RuntimeProxy(runtime, RpcClient(hook_endpoint),
+                         FailurePolicy.FAIL)
+    proxy.run_pod_sandbox(be_sandbox())
+    proxy.create_container(ContainerRequest(container_id="c1",
+                                            sandbox_id="sb1", name="main"))
+    assert "c1" in proxy.store.containers
+    proxy.stop_container(ContainerRequest(container_id="c1",
+                                          sandbox_id="sb1", name="main"))
+    assert "c1" not in proxy.store.containers

@@ -92,6 +92,14 @@ def test_priority_class_name_resolves_out_of_band_values():
     assert ok, errs
 
 
+def test_unrelated_priority_class_name_not_koordinator():
+    # a cluster PriorityClass merely NAMED "batch" must not resolve to the
+    # koordinator Batch class (only koord-* names do)
+    from koordinator_tpu.api.extension import PriorityClass, priority_class_of
+    assert priority_class_of(800000, "", "batch") is PriorityClass.NONE
+    assert priority_class_of(800000, "", "koord-batch") is PriorityClass.BATCH
+
+
 def test_key_mapping_skips_missing_sources():
     prof = be_profile(label_keys_mapping={"absent": "copied"})
     pod = batch_pod()
