@@ -1,0 +1,133 @@
+"""Feature gates.
+
+Capability parity with pkg/features (SURVEY.md 2.7): k8s-featuregate-style
+machinery — a registry of named gates with defaults and maturity stages,
+`--feature-gates=A=true,B=false` string parsing, and per-component gate
+catalogs (webhook gates features.go:28-52, koordlet QoS gates
+koordlet_features.go:33-143, scheduler gates scheduler_features.go).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Dict, Iterable, Mapping
+
+
+@dataclasses.dataclass(frozen=True)
+class FeatureSpec:
+    default: bool
+    stage: str = "ALPHA"          # ALPHA | BETA | GA
+    lock_to_default: bool = False
+
+
+class FeatureGate:
+    """Mutable view over a spec registry (featuregate.MutableFeatureGate)."""
+
+    def __init__(self, specs: Mapping[str, FeatureSpec]):
+        self._specs = dict(specs)
+        self._overrides: Dict[str, bool] = {}
+        self._lock = threading.Lock()
+
+    def add(self, specs: Mapping[str, FeatureSpec]) -> None:
+        with self._lock:
+            for name, spec in specs.items():
+                existing = self._specs.get(name)
+                if existing is not None and existing != spec:
+                    raise ValueError(f"feature gate {name} redefined")
+                self._specs[name] = spec
+
+    def known(self) -> Iterable[str]:
+        return sorted(self._specs)
+
+    def enabled(self, name: str) -> bool:
+        spec = self._specs.get(name)
+        if spec is None:
+            raise KeyError(f"unknown feature gate {name!r}")
+        with self._lock:
+            return self._overrides.get(name, spec.default)
+
+    def set(self, name: str, value: bool) -> None:
+        spec = self._specs.get(name)
+        if spec is None:
+            raise KeyError(f"unknown feature gate {name!r}")
+        if spec.lock_to_default and value != spec.default:
+            raise ValueError(f"feature gate {name} is locked to "
+                             f"{spec.default}")
+        with self._lock:
+            self._overrides[name] = value
+
+    def set_from_map(self, values: Mapping[str, bool]) -> None:
+        for name, value in values.items():
+            self.set(name, value)
+
+    def parse(self, flag: str) -> None:
+        """--feature-gates=A=true,B=false"""
+        for part in filter(None, (p.strip() for p in flag.split(","))):
+            name, _, raw = part.partition("=")
+            lowered = raw.strip().lower()
+            if lowered not in ("true", "false"):
+                raise ValueError(
+                    f"invalid feature gate value {part!r} (want "
+                    f"name=true|false)")
+            self.set(name.strip(), lowered == "true")
+
+
+def _specs(**kw: FeatureSpec) -> Dict[str, FeatureSpec]:
+    return kw
+
+
+_on = lambda stage="BETA": FeatureSpec(default=True, stage=stage)   # noqa: E731
+_off = lambda stage="ALPHA": FeatureSpec(default=False, stage=stage)  # noqa: E731
+
+# Webhook / manager gates (pkg/features/features.go:28-52).
+MANAGER_GATES = _specs(
+    PodMutatingWebhook=_on(),
+    PodValidatingWebhook=_on(),
+    ElasticQuotaIgnorePodOverhead=_off(),
+    ElasticQuotaGuaranteePercent=_off(),
+    DisableDefaultQuota=_off(),
+    SupportParentQuotaSubmitPod=_off(),
+    WebhookFramework=_on("BETA"),
+    ColocationProfileSkipMutatingResources=_off(),
+    MultiQuotaTree=_off(),
+    ElasticQuotaProfile=_off(),
+)
+
+# koordlet QoS gates (pkg/features/koordlet_features.go:33-143).
+KOORDLET_GATES = _specs(
+    AuditEvents=_off(),
+    AuditEventsHTTPHandler=_off(),
+    BECFSQuotaBurst=_off(),
+    BECPUEvict=_off(),
+    BEMemoryEvict=_off(),
+    BECPUSuppress=_on(),
+    BECPUManager=_off(),
+    CPUBurst=_on(),
+    SystemConfig=_off(),
+    RdtResctrl=_on(),
+    CgroupReconcile=_off(),
+    NodeTopologyReport=_on(),
+    Libpfm4=_off(),
+    CPICollector=_off(),
+    PSICollector=_on(),
+    CPUSuppress=_on(),
+    CgroupV2=_on("BETA"),
+    ColdPageCollector=_off(),
+    Accelerators=_off(),
+    CoreSched=_off(),
+    BlkIOReconcile=_off(),
+)
+
+# Scheduler gates (pkg/features/scheduler_features.go).
+SCHEDULER_GATES = _specs(
+    CompatibleCSIStorageCapacity=_off(),
+    DisableCSIStorageCapacityInformer=_off(),
+    CompatiblePodDisruptionBudget=_off(),
+    DisablePodDisruptionBudgetInformer=_off(),
+    ResizePod=_off(),
+    EnableACKGPUShareScheduling=_off(),
+)
+
+DEFAULT_FEATURE_GATE = FeatureGate({**MANAGER_GATES, **KOORDLET_GATES,
+                                    **SCHEDULER_GATES})

@@ -38,7 +38,9 @@ _CONTAINER_STAGES = {
     "PreCreateContainerHook": Stage.PRE_CREATE_CONTAINER,
     "PreStartContainerHook": Stage.PRE_CREATE_CONTAINER,
     "PostStartContainerHook": Stage.POST_START_CONTAINER,
-    "PostStopContainerHook": Stage.POST_STOP_POD_SANDBOX,
+    # container teardown is its own stage: pod-level cleanup plugins must
+    # NOT fire when one container of a live pod stops
+    "PostStopContainerHook": Stage.POST_STOP_CONTAINER,
     "PreUpdateContainerResourcesHook": Stage.PRE_UPDATE_CONTAINER,
 }
 

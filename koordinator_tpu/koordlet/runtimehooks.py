@@ -45,6 +45,7 @@ class Stage(enum.Enum):
     PRE_CREATE_CONTAINER = "PreCreateContainer"
     PRE_UPDATE_CONTAINER = "PreUpdateContainerResources"
     POST_START_CONTAINER = "PostStartContainer"
+    POST_STOP_CONTAINER = "PostStopContainer"
     POST_STOP_POD_SANDBOX = "PostStopPodSandbox"
 
 

@@ -172,6 +172,16 @@ class RuntimeProxy:
 
     # -- CRI surface ---------------------------------------------------------
 
+    def _post_stop_hook(self, method: str, request, response_cls) -> None:
+        """Post-stop hooks are cleanup notifications: the backend operation
+        already succeeded and cannot be undone, so a hook failure must
+        neither fail the CRI op nor skip store cleanup — always Ignore."""
+        try:
+            if self.hooks is not None:
+                self.hooks.call(method, request, response_cls)
+        except (RpcError, OSError) as e:
+            log.warning("post-stop hook %s failed (ignored): %s", method, e)
+
     def run_pod_sandbox(self, req: PodSandboxRequest) -> None:
         resp = self._call_hook("PreRunPodSandboxHook",
                                self._pod_hook_request(req),
@@ -186,17 +196,31 @@ class RuntimeProxy:
             # sandbox-level cgroup adjustments (e.g. BE group identity)
             # ride the created sandbox, not a later update
             _merge_resources(req, resp.resources)
+        self.backend.run_pod_sandbox(req)
+        # register only after the sandbox truly exists (no phantom entries
+        # in the checkpointed store on backend failure)
         self.store.put_pod(req.sandbox_id, PodSandboxInfo(
             name=req.name, namespace=req.namespace, uid=req.uid,
             labels=dict(req.labels), annotations=dict(req.annotations),
             cgroup_parent=req.cgroup_parent))
-        self.backend.run_pod_sandbox(req)
 
     def stop_pod_sandbox(self, req: PodSandboxRequest) -> None:
+        # a real CRI StopPodSandbox carries only the sandbox id; restore
+        # the pod metadata from the checkpoint so teardown hooks see the
+        # same labels/annotations the creation hooks did
+        pod = self.store.pods.get(req.sandbox_id)
+        if pod is not None:
+            req = dataclasses.replace(
+                req, name=pod.name or req.name,
+                namespace=pod.namespace or req.namespace,
+                uid=pod.uid or req.uid,
+                labels={**pod.labels, **req.labels},
+                annotations={**pod.annotations, **req.annotations},
+                cgroup_parent=req.cgroup_parent or pod.cgroup_parent)
         self.backend.stop_pod_sandbox(req)
-        self._call_hook("PostStopPodSandboxHook",
-                        self._pod_hook_request(req),
-                        pb.PodSandboxHookResponse)
+        self._post_stop_hook("PostStopPodSandboxHook",
+                             self._pod_hook_request(req),
+                             pb.PodSandboxHookResponse)
         self.store.delete_pod(req.sandbox_id)
 
     def create_container(self, req: ContainerRequest) -> None:
@@ -237,7 +261,7 @@ class RuntimeProxy:
 
     def stop_container(self, req: ContainerRequest) -> None:
         self.backend.stop_container(req)
-        self._call_hook("PostStopContainerHook",
-                        self._container_hook_request(req),
-                        pb.ContainerResourceHookResponse)
+        self._post_stop_hook("PostStopContainerHook",
+                             self._container_hook_request(req),
+                             pb.ContainerResourceHookResponse)
         self.store.delete_container(req.container_id)

@@ -58,8 +58,8 @@ def device_allocation_annotation(snap: ClusterSnapshot, pods: PodBatch,
     alloc: Dict[str, list] = {}
     if node >= 0 and take.any():
         pcie = np.asarray(snap.devices.gpu_pcie)[node]
-        minors = sorted(int(m) for m in np.nonzero(take)[0])
-        minors.sort(key=lambda m: (int(pcie[m]), m))
+        minors = sorted((int(m) for m in np.nonzero(take)[0]),
+                        key=lambda m: (int(pcie[m]), m))
         alloc["gpu"] = [{"minor": m} for m in minors]
     for t, key in enumerate(("rdma", "fpga")):
         if node >= 0 and aux[t] >= 0:

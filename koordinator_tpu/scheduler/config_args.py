@@ -1,0 +1,212 @@
+"""Typed scheduler plugin args with defaulting, validation, and conversion
+to the device-kernel configs.
+
+Capability parity with pkg/scheduler/apis/config (SURVEY.md 2.1
+"scheduler apis/config", types.go:30-214 + v1beta2 defaults + validation):
+each plugin's arguments are a typed object; `validate()` rejects
+out-of-range values; `schedule_options()` lowers the whole profile into
+the static/traced arguments of scheduler.core.schedule_batch plus the
+LoadAwareConfig operand.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional
+
+from koordinator_tpu.api.extension import ResourceKind
+from koordinator_tpu.scheduler.plugins.loadaware import LoadAwareConfig
+from koordinator_tpu.snapshot.schema import AGG_TYPES
+
+MostAllocated = "MostAllocated"
+LeastAllocated = "LeastAllocated"
+_STRATEGIES = (MostAllocated, LeastAllocated)
+
+
+def _validate_percent_map(name: str, m: Dict[ResourceKind, float],
+                          errs: List[str], max_value: float = 100.0) -> None:
+    for kind, v in m.items():
+        if not 0 <= v <= max_value:
+            errs.append(f"{name}[{kind.name}]={v} outside [0, {max_value}]")
+
+
+@dataclasses.dataclass
+class LoadAwareSchedulingArgs:
+    """types.go:30-58 with v1beta2 defaults."""
+
+    node_metric_expiration_seconds: float = 180.0
+    resource_weights: Dict[ResourceKind, float] = dataclasses.field(
+        default_factory=lambda: {ResourceKind.CPU: 1.0,
+                                 ResourceKind.MEMORY: 1.0})
+    usage_thresholds: Dict[ResourceKind, float] = dataclasses.field(
+        default_factory=lambda: {ResourceKind.CPU: 65.0,
+                                 ResourceKind.MEMORY: 95.0})
+    prod_usage_thresholds: Dict[ResourceKind, float] = dataclasses.field(
+        default_factory=dict)
+    score_according_prod_usage: bool = False
+    estimated_scaling_factors: Dict[ResourceKind, float] = dataclasses.field(
+        default_factory=lambda: {ResourceKind.CPU: 85.0,
+                                 ResourceKind.MEMORY: 70.0})
+    # aggregated percentile profile (LoadAwareSchedulingAggregatedArgs)
+    agg_usage_thresholds: Dict[ResourceKind, float] = dataclasses.field(
+        default_factory=dict)
+    filter_agg_type: str = ""
+    score_agg_type: str = ""
+
+    def validate(self) -> List[str]:
+        errs: List[str] = []
+        if self.node_metric_expiration_seconds <= 0:
+            errs.append("nodeMetricExpirationSeconds must be positive")
+        for kind, w in self.resource_weights.items():
+            if w < 0:
+                errs.append(f"resourceWeights[{kind.name}] must be >= 0")
+        _validate_percent_map("usageThresholds", self.usage_thresholds, errs)
+        _validate_percent_map("prodUsageThresholds",
+                              self.prod_usage_thresholds, errs)
+        _validate_percent_map("aggregatedUsageThresholds",
+                              self.agg_usage_thresholds, errs)
+        for kind, f in self.estimated_scaling_factors.items():
+            if not 0 < f <= 100:
+                errs.append(
+                    f"estimatedScalingFactors[{kind.name}] outside (0, 100]")
+        for label, agg in (("usageAggregationType", self.filter_agg_type),
+                           ("scoreAggregationType", self.score_agg_type)):
+            if agg and agg not in AGG_TYPES:
+                errs.append(f"{label}={agg!r} not one of {AGG_TYPES}")
+        return errs
+
+    def to_config(self) -> LoadAwareConfig:
+        return LoadAwareConfig.make(
+            resource_weights=self.resource_weights,
+            usage_thresholds=self.usage_thresholds,
+            prod_usage_thresholds=self.prod_usage_thresholds or None,
+            agg_usage_thresholds=self.agg_usage_thresholds or None,
+            filter_agg_type=self.filter_agg_type,
+            score_agg_type=self.score_agg_type,
+            score_according_prod_usage=self.score_according_prod_usage)
+
+
+@dataclasses.dataclass
+class NodeNUMAResourceArgs:
+    """types.go:103-115."""
+
+    default_cpu_bind_policy: str = ""   # "", FullPCPUs, SpreadByPCPUs
+    numa_scoring_strategy: str = MostAllocated
+    scoring_strategy: str = LeastAllocated
+
+    def validate(self) -> List[str]:
+        errs: List[str] = []
+        if self.default_cpu_bind_policy not in ("", "FullPCPUs",
+                                                "SpreadByPCPUs"):
+            errs.append(f"defaultCPUBindPolicy="
+                        f"{self.default_cpu_bind_policy!r} invalid")
+        for label, s in (("numaScoringStrategy", self.numa_scoring_strategy),
+                         ("scoringStrategy", self.scoring_strategy)):
+            if s not in _STRATEGIES:
+                errs.append(f"{label}={s!r} not one of {_STRATEGIES}")
+        return errs
+
+
+@dataclasses.dataclass
+class ReservationArgs:
+    """types.go:156-162."""
+
+    enable_preemption: bool = False
+
+    def validate(self) -> List[str]:
+        return []
+
+
+@dataclasses.dataclass
+class ElasticQuotaArgs:
+    """types.go:166-195."""
+
+    delay_evict_time_seconds: float = 300.0
+    revoke_pod_interval_seconds: float = 60.0
+    monitor_all_quotas: bool = False
+    enable_check_parent_quota: bool = False
+    enable_runtime_quota: bool = True
+
+    def validate(self) -> List[str]:
+        errs: List[str] = []
+        if self.delay_evict_time_seconds < 0:
+            errs.append("delayEvictTime must be >= 0")
+        if self.revoke_pod_interval_seconds <= 0:
+            errs.append("revokePodInterval must be positive")
+        return errs
+
+
+@dataclasses.dataclass
+class CoschedulingArgs:
+    """types.go:197-210."""
+
+    default_timeout_seconds: float = 600.0
+    controller_workers: int = 1
+    skip_check_schedule_cycle: bool = False
+
+    def validate(self) -> List[str]:
+        errs: List[str] = []
+        if self.default_timeout_seconds <= 0:
+            errs.append("defaultTimeout must be positive")
+        if self.controller_workers < 1:
+            errs.append("controllerWorkers must be >= 1")
+        return errs
+
+
+@dataclasses.dataclass
+class DeviceShareArgs:
+    """types.go:214-222."""
+
+    scoring_strategy: str = LeastAllocated
+
+    def validate(self) -> List[str]:
+        if self.scoring_strategy not in _STRATEGIES:
+            return [f"scoringStrategy={self.scoring_strategy!r} not one of "
+                    f"{_STRATEGIES}"]
+        return []
+
+
+@dataclasses.dataclass
+class SchedulerProfile:
+    """The full plugin-args profile, lowered into schedule_batch inputs."""
+
+    load_aware: LoadAwareSchedulingArgs = dataclasses.field(
+        default_factory=LoadAwareSchedulingArgs)
+    numa: NodeNUMAResourceArgs = dataclasses.field(
+        default_factory=NodeNUMAResourceArgs)
+    reservation: ReservationArgs = dataclasses.field(
+        default_factory=ReservationArgs)
+    elastic_quota: ElasticQuotaArgs = dataclasses.field(
+        default_factory=ElasticQuotaArgs)
+    coscheduling: CoschedulingArgs = dataclasses.field(
+        default_factory=CoschedulingArgs)
+    device_share: DeviceShareArgs = dataclasses.field(
+        default_factory=DeviceShareArgs)
+
+    def validate(self) -> List[str]:
+        errs: List[str] = []
+        for name, args in (("loadAware", self.load_aware),
+                           ("nodeNUMAResource", self.numa),
+                           ("reservation", self.reservation),
+                           ("elasticQuota", self.elastic_quota),
+                           ("coscheduling", self.coscheduling),
+                           ("deviceShare", self.device_share)):
+            errs.extend(f"{name}: {e}" for e in args.validate())
+        return errs
+
+    def schedule_options(self) -> Dict[str, object]:
+        """kwargs for scheduler.core.schedule_batch (static args) — the
+        LoadAwareConfig operand rides separately via `load_aware_config`."""
+        errs = self.validate()
+        if errs:
+            raise ValueError("; ".join(errs))
+        strategy = ("most" if self.numa.numa_scoring_strategy == MostAllocated
+                    else "least")
+        return {
+            "numa_strategy": strategy,
+            "device_strategy": ("most" if self.device_share.scoring_strategy
+                                == MostAllocated else "least"),
+        }
+
+    def load_aware_config(self) -> LoadAwareConfig:
+        return self.load_aware.to_config()

@@ -1,0 +1,242 @@
+"""frameworkext: the extender seam around the batched scheduling core —
+cycle watchdog, live score introspection, plugin service endpoints, and the
+sidecar-facing scheduler service.
+
+Capability parity with pkg/scheduler/frameworkext (SURVEY.md 2.1):
+- SchedulerMonitor (scheduler_monitor.go:40-52): records each batch's
+  start; a completion past the timeout logs a warning and increments a
+  counter; overdue in-flight cycles are queryable (the watchdog thread).
+- Debug score tables (debug.go:42-59): when enabled, every scheduled batch
+  dumps a pretty-printed top-N nodes-by-score table per pod — the direct
+  fixture for eyeballing the TPU score matrix.
+- Services (services/): every registered provider serves its summary at
+  /apis/v1/plugins/<name> on a plain HTTP endpoint; /debug/flags/s toggles
+  the score dump at runtime like the reference's DebugScoresSetter.
+- SchedulerService: the seam the control-plane edge calls (the gRPC
+  sidecar boundary per BASELINE.json): holds the SnapshotStore, schedules
+  pod batches chunk-by-chunk against the current snapshot, publishes the
+  post-commit snapshot, and reports through the monitor/debug hooks.
+"""
+
+from __future__ import annotations
+
+import http.server
+import json
+import logging
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+from koordinator_tpu.scheduler import core
+from koordinator_tpu.scheduler.plugins.loadaware import LoadAwareConfig
+from koordinator_tpu.snapshot.schema import ClusterSnapshot, PodBatch
+from koordinator_tpu.snapshot.store import SnapshotStore
+
+log = logging.getLogger(__name__)
+
+
+class SchedulerMonitor:
+    """Per-batch cycle watchdog."""
+
+    def __init__(self, timeout_seconds: float = 30.0):
+        self.timeout = timeout_seconds
+        self.timeouts = 0
+        self._lock = threading.Lock()
+        self._inflight: Dict[int, float] = {}
+        self._seq = 0
+
+    def start_cycle(self, now: Optional[float] = None) -> int:
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            self._seq += 1
+            self._inflight[self._seq] = now
+            return self._seq
+
+    def complete_cycle(self, token: int,
+                       now: Optional[float] = None) -> float:
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            started = self._inflight.pop(token, now)
+        elapsed = now - started
+        if elapsed > self.timeout:
+            self.timeouts += 1
+            log.warning("scheduling cycle exceeded %.0fs: %.2fs",
+                        self.timeout, elapsed)
+        return elapsed
+
+    def overdue(self, now: Optional[float] = None) -> List[int]:
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            return [t for t, s in self._inflight.items()
+                    if now - s > self.timeout]
+
+
+def debug_score_table(snap: ClusterSnapshot, pods: PodBatch,
+                      cfg: LoadAwareConfig, top_n: int = 5,
+                      pod_names: Optional[List[str]] = None) -> str:
+    """Top-N nodes by summed plugin score per pod (debug.go:61
+    debugScores) recomputed from the snapshot with the same kernels the
+    commit loop uses."""
+    from koordinator_tpu.scheduler.plugins import (
+        deviceshare,
+        loadaware,
+        numaaware,
+    )
+
+    scores = np.asarray(loadaware.score_matrix(snap.nodes, pods, cfg))
+    scores = scores + np.asarray(numaaware.numa_score_matrix(
+        snap.nodes, pods))
+    if snap.devices.gpu_free.shape[1] > 0:
+        scores = scores + np.asarray(
+            deviceshare.score_matrix(snap.devices, pods))
+    feasible = (np.asarray(loadaware.filter_mask(snap.nodes, pods, cfg))
+                & np.asarray(snap.nodes.schedulable)[None, :])
+    scores = np.where(feasible, scores, -1.0)
+    lines = []
+    p = pods.num_pods
+    for i in range(p):
+        name = pod_names[i] if pod_names else f"pod[{i}]"
+        order = np.argsort(-scores[i])[:top_n]
+        cells = " | ".join(f"node{int(n)}:{scores[i, n]:.1f}"
+                           for n in order if scores[i, n] >= 0)
+        lines.append(f"{name:<24} | {cells}")
+    header = f"{'pod':<24} | top-{top_n} nodes by score"
+    return "\n".join([header, "-" * len(header)] + lines)
+
+
+class ServiceRegistry:
+    """APIServiceProvider registry: name -> summary() (services.go:44-51)."""
+
+    def __init__(self):
+        self._providers: Dict[str, Callable[[], dict]] = {}
+
+    def register(self, name: str, summary: Callable[[], dict]) -> None:
+        self._providers[name] = summary
+
+    def names(self) -> List[str]:
+        return sorted(self._providers)
+
+    def summary(self, name: str) -> Optional[dict]:
+        fn = self._providers.get(name)
+        return fn() if fn is not None else None
+
+
+class DebugFlags:
+    """Runtime debug toggles (debug.go DebugScoresSetter /debug/flags/s)."""
+
+    def __init__(self):
+        self.score_top_n = 0  # 0 = disabled
+
+
+class ServicesServer:
+    """HTTP endpoint: /apis/v1/plugins/<name> summaries + /debug/flags/s."""
+
+    def __init__(self, registry: ServiceRegistry, flags: DebugFlags,
+                 host: str = "127.0.0.1", port: int = 0):
+        registry_ref, flags_ref = registry, flags
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def log_message(self, *a):  # quiet
+                pass
+
+            def _reply(self, code: int, payload: dict) -> None:
+                body = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path == "/apis/v1/plugins":
+                    self._reply(200, {"plugins": registry_ref.names()})
+                    return
+                prefix = "/apis/v1/plugins/"
+                if self.path.startswith(prefix):
+                    summary = registry_ref.summary(self.path[len(prefix):])
+                    if summary is None:
+                        self._reply(404, {"error": "unknown plugin"})
+                    else:
+                        self._reply(200, summary)
+                    return
+                self._reply(404, {"error": "not found"})
+
+            def do_PUT(self):
+                if self.path.startswith("/debug/flags/s"):
+                    length = int(self.headers.get("Content-Length", 0))
+                    raw = self.rfile.read(length).decode().strip()
+                    try:
+                        flags_ref.score_top_n = int(raw or "0")
+                    except ValueError:
+                        self._reply(400, {"error": f"bad value {raw!r}"})
+                        return
+                    self._reply(200, {"scoreTopN": flags_ref.score_top_n})
+                    return
+                self._reply(404, {"error": "not found"})
+
+        self._httpd = http.server.ThreadingHTTPServer((host, port), Handler)
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+
+    def close(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+
+class SchedulerService:
+    """The sidecar seam: snapshot in, assignments out.
+
+    The control-plane edge publishes snapshots (or functional deltas) into
+    the store and feeds pending-pod batches; each batch runs the full
+    device program, the post-commit snapshot becomes the next version, and
+    the per-cycle watchdog + optional score dump observe every batch.
+    """
+
+    def __init__(self, store: Optional[SnapshotStore] = None,
+                 cfg: Optional[LoadAwareConfig] = None,
+                 monitor: Optional[SchedulerMonitor] = None,
+                 flags: Optional[DebugFlags] = None,
+                 registry: Optional[ServiceRegistry] = None,
+                 **schedule_kwargs):
+        self.store = store or SnapshotStore()
+        self.cfg = cfg if cfg is not None else LoadAwareConfig.make()
+        self.monitor = monitor or SchedulerMonitor()
+        self.flags = flags or DebugFlags()
+        self.registry = registry or ServiceRegistry()
+        self.schedule_kwargs = schedule_kwargs
+        self.batches = 0
+        self.pods_placed = 0
+        self.last_elapsed = 0.0
+        self.registry.register("scheduler", self.summary)
+
+    def publish(self, snapshot: ClusterSnapshot) -> None:
+        self.store.publish(snapshot)
+
+    def schedule(self, pods: PodBatch,
+                 pod_names: Optional[List[str]] = None) -> core.ScheduleResult:
+        token = self.monitor.start_cycle()
+        snap = self.store.current()
+        result = core.schedule_batch(snap, pods, self.cfg,
+                                     **self.schedule_kwargs)
+        np.asarray(result.assignment)  # D2H completion barrier
+        self.store.update(lambda _old: result.snapshot)
+        self.last_elapsed = self.monitor.complete_cycle(token)
+        self.batches += 1
+        self.pods_placed += int((np.asarray(result.assignment) >= 0).sum())
+        if self.flags.score_top_n > 0:
+            log.info("score table:\n%s", debug_score_table(
+                snap, pods, self.cfg, self.flags.score_top_n, pod_names))
+        return result
+
+    def summary(self) -> dict:
+        return {
+            "batches": self.batches,
+            "podsPlaced": self.pods_placed,
+            "lastCycleSeconds": round(self.last_elapsed, 4),
+            "cycleTimeouts": self.monitor.timeouts,
+            "snapshotVersion": self.store.version,
+        }

@@ -1,0 +1,151 @@
+"""Feature gates, typed config args, and the frameworkext seam (monitor,
+debug tables, service endpoints, scheduler service) — SURVEY.md 2.1/2.7."""
+
+import json
+import urllib.request
+
+import numpy as np
+import pytest
+
+from koordinator_tpu.api.extension import ResourceKind as RK
+from koordinator_tpu.features import (
+    DEFAULT_FEATURE_GATE,
+    FeatureGate,
+    FeatureSpec,
+)
+from koordinator_tpu.scheduler.config_args import (
+    DeviceShareArgs,
+    LoadAwareSchedulingArgs,
+    MostAllocated,
+    NodeNUMAResourceArgs,
+    SchedulerProfile,
+)
+from koordinator_tpu.scheduler.frameworkext import (
+    DebugFlags,
+    SchedulerMonitor,
+    SchedulerService,
+    ServiceRegistry,
+    ServicesServer,
+    debug_score_table,
+)
+from koordinator_tpu.utils import synthetic
+
+
+# --- feature gates ----------------------------------------------------------
+
+
+def test_feature_gate_defaults_and_parse():
+    gate = FeatureGate({"A": FeatureSpec(default=True),
+                        "B": FeatureSpec(default=False),
+                        "L": FeatureSpec(default=True,
+                                         lock_to_default=True)})
+    assert gate.enabled("A") and not gate.enabled("B")
+    gate.parse("A=false, B=true")
+    assert not gate.enabled("A") and gate.enabled("B")
+    with pytest.raises(KeyError):
+        gate.enabled("nope")
+    with pytest.raises(ValueError):
+        gate.parse("A=maybe")
+    with pytest.raises(ValueError):
+        gate.set("L", False)
+
+
+def test_default_gate_catalog():
+    assert DEFAULT_FEATURE_GATE.enabled("BECPUSuppress")
+    assert not DEFAULT_FEATURE_GATE.enabled("Libpfm4")
+    assert not DEFAULT_FEATURE_GATE.enabled("ResizePod")
+    assert len(list(DEFAULT_FEATURE_GATE.known())) >= 35
+
+
+# --- typed args -------------------------------------------------------------
+
+
+def test_args_defaults_validate_clean():
+    assert SchedulerProfile().validate() == []
+
+
+def test_args_validation_rejects_bad_values():
+    bad = SchedulerProfile(
+        load_aware=LoadAwareSchedulingArgs(
+            usage_thresholds={RK.CPU: 150.0},
+            filter_agg_type="p42"),
+        numa=NodeNUMAResourceArgs(default_cpu_bind_policy="Bogus"),
+        device_share=DeviceShareArgs(scoring_strategy="Weird"))
+    errs = bad.validate()
+    assert len(errs) == 4
+    with pytest.raises(ValueError):
+        bad.schedule_options()
+
+
+def test_profile_lowers_to_schedule_options():
+    prof = SchedulerProfile(
+        numa=NodeNUMAResourceArgs(numa_scoring_strategy=MostAllocated),
+        device_share=DeviceShareArgs(scoring_strategy=MostAllocated))
+    opts = prof.schedule_options()
+    assert opts == {"numa_strategy": "most", "device_strategy": "most"}
+    cfg = prof.load_aware_config()
+    assert float(cfg.usage_thresholds[int(RK.CPU)]) == 65.0
+
+
+# --- monitor ----------------------------------------------------------------
+
+
+def test_monitor_flags_slow_cycles():
+    mon = SchedulerMonitor(timeout_seconds=1.0)
+    t = mon.start_cycle(now=0.0)
+    assert mon.overdue(now=2.5) == [t]
+    assert mon.complete_cycle(t, now=3.0) == 3.0
+    assert mon.timeouts == 1
+    t2 = mon.start_cycle(now=10.0)
+    mon.complete_cycle(t2, now=10.2)
+    assert mon.timeouts == 1 and mon.overdue(now=10.5) == []
+
+
+# --- scheduler service + endpoints ------------------------------------------
+
+
+def test_scheduler_service_end_to_end():
+    service = SchedulerService(num_rounds=2, k_choices=4)
+    snap = synthetic.synthetic_cluster(32, num_quotas=4)
+    service.publish(snap)
+    pods = synthetic.synthetic_pods(64, num_quotas=4)
+    res = service.schedule(pods)
+    placed = int((np.asarray(res.assignment) >= 0).sum())
+    assert placed > 0
+    assert service.summary()["podsPlaced"] == placed
+    assert service.store.version == 2  # publish + post-commit update
+    # second batch schedules against the committed state
+    res2 = service.schedule(synthetic.synthetic_pods(64, seed=9,
+                                                     num_quotas=4))
+    assert service.batches == 2
+
+
+def test_debug_score_table_renders():
+    snap = synthetic.synthetic_cluster(8)
+    pods = synthetic.synthetic_pods(3)
+    from koordinator_tpu.scheduler.plugins.loadaware import LoadAwareConfig
+    table = debug_score_table(snap, pods, LoadAwareConfig.make(), top_n=3,
+                              pod_names=["a", "b", "c"])
+    lines = table.splitlines()
+    assert lines[0].startswith("pod")
+    assert len(lines) == 5 and "node" in lines[2]
+
+
+def test_services_http_endpoints():
+    registry = ServiceRegistry()
+    registry.register("gang", lambda: {"gangs": 3})
+    flags = DebugFlags()
+    server = ServicesServer(registry, flags)
+    try:
+        base = f"http://127.0.0.1:{server.port}"
+        with urllib.request.urlopen(f"{base}/apis/v1/plugins") as r:
+            assert json.load(r)["plugins"] == ["gang"]
+        with urllib.request.urlopen(f"{base}/apis/v1/plugins/gang") as r:
+            assert json.load(r) == {"gangs": 3}
+        req = urllib.request.Request(f"{base}/debug/flags/s", data=b"5",
+                                     method="PUT")
+        with urllib.request.urlopen(req) as r:
+            assert json.load(r)["scoreTopN"] == 5
+        assert flags.score_top_n == 5
+    finally:
+        server.close()

@@ -190,6 +190,45 @@ def test_proxy_without_hook_client_passthrough():
     assert "sb1" not in proxy.store.pods
 
 
+def test_post_stop_hooks_never_fail_completed_ops(tmp_path, hook_endpoint):
+    runtime = FakeRuntime()
+    proxy = RuntimeProxy(runtime, RpcClient(hook_endpoint),
+                         FailurePolicy.FAIL)
+    proxy.run_pod_sandbox(be_sandbox())
+    # hook server dies between start and stop: the stop must still
+    # succeed (backend already stopped it) and the store must clean up
+    proxy.hooks = RpcClient(str(tmp_path / "gone.sock"))
+    proxy.stop_pod_sandbox(PodSandboxRequest(sandbox_id="sb1"))
+    assert "sb1" not in proxy.store.pods
+    assert [n for n, _ in runtime.calls] == ["run_pod_sandbox",
+                                             "stop_pod_sandbox"]
+
+
+def test_stop_sandbox_restores_metadata_from_store(hook_endpoint):
+    runtime = FakeRuntime()
+    proxy = RuntimeProxy(runtime, RpcClient(hook_endpoint),
+                         FailurePolicy.FAIL)
+    proxy.run_pod_sandbox(be_sandbox())
+    # CRI StopPodSandbox carries only the id; the forwarded request is
+    # enriched from the checkpoint so teardown hooks see the QoS label
+    proxy.stop_pod_sandbox(PodSandboxRequest(sandbox_id="sb1"))
+    fwd = runtime.calls[-1][1]
+    assert fwd.labels[LABEL_POD_QOS] == "BE"
+    assert fwd.uid == "u1"
+
+
+def test_failed_sandbox_creation_leaves_no_phantom_pod(hook_endpoint):
+    class ExplodingRuntime(FakeRuntime):
+        def run_pod_sandbox(self, req):
+            raise RuntimeError("runtime rejected sandbox")
+
+    proxy = RuntimeProxy(ExplodingRuntime(), RpcClient(hook_endpoint),
+                         FailurePolicy.FAIL)
+    with pytest.raises(RuntimeError):
+        proxy.run_pod_sandbox(be_sandbox())
+    assert "sb1" not in proxy.store.pods
+
+
 def test_store_checkpoint_roundtrip(tmp_path):
     path = str(tmp_path / "meta.json")
     store = MetaStore(path)
