@@ -1,0 +1,92 @@
+"""Upstream-descheduler-compatible plugins.
+
+Capability parity with pkg/descheduler/framework/plugins/kubernetes
+(SURVEY.md 2.4): wrappers of the sigs descheduler behaviors the reference
+re-exports — evict pods violating node selection, plus the default evictor
+filter (daemonsets, system QoS, non-preemptible pods, priority threshold).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Mapping, Optional, Sequence
+
+from koordinator_tpu.api import types as api
+from koordinator_tpu.api.extension import QoSClass, selector_matches
+from koordinator_tpu.descheduler.framework import Evictor
+
+ANNOTATION_PREEMPTIBLE = "scheduling.koordinator.sh/preemptible"
+
+
+def default_evictor_filter(priority_threshold: Optional[int] = None,
+                           evict_system_pods: bool = False
+                           ) -> Callable[[api.Pod], bool]:
+    """defaultevictor.Filter: True = evictable."""
+
+    def allow(pod: api.Pod) -> bool:
+        if pod.is_daemonset:
+            return False
+        if not evict_system_pods and pod.qos is QoSClass.SYSTEM:
+            return False
+        if pod.meta.annotations.get(ANNOTATION_PREEMPTIBLE) == "false":
+            return False
+        if priority_threshold is not None and \
+                (pod.priority or 0) >= priority_threshold:
+            return False
+        return True
+
+    return allow
+
+
+class RemovePodsViolatingNodeSelector:
+    """Deschedule plugin: evict pods whose nodeSelector no longer matches
+    their node's labels (node relabeled after placement)."""
+
+    name = "RemovePodsViolatingNodeSelector"
+
+    def __init__(self, evictor: Evictor,
+                 get_pods_by_node: Callable[[], Mapping[str,
+                                                        Sequence[api.Pod]]],
+                 pod_filter: Optional[Callable[[api.Pod], bool]] = None):
+        self.evictor = evictor
+        self.get_pods_by_node = get_pods_by_node
+        self.pod_filter = pod_filter or default_evictor_filter()
+
+    def deschedule(self, nodes: Sequence[api.Node]) -> None:
+        labels = {n.meta.name: n.meta.labels for n in nodes}
+        for node_name, pods in self.get_pods_by_node().items():
+            node_labels = labels.get(node_name)
+            if node_labels is None:
+                continue
+            for pod in pods:
+                if not pod.node_selector:
+                    continue
+                if selector_matches(pod.node_selector, node_labels):
+                    continue
+                if self.pod_filter(pod):
+                    self.evictor.evict(
+                        pod, f"nodeSelector no longer matches {node_name}")
+
+
+class RemovePodsOnUnschedulableNodes:
+    """Deschedule plugin: drain evictable pods off cordoned nodes (the
+    taint-violation behavior restricted to the unschedulable taint)."""
+
+    name = "RemovePodsOnUnschedulableNodes"
+
+    def __init__(self, evictor: Evictor,
+                 get_pods_by_node: Callable[[], Mapping[str,
+                                                        Sequence[api.Pod]]],
+                 pod_filter: Optional[Callable[[api.Pod], bool]] = None):
+        self.evictor = evictor
+        self.get_pods_by_node = get_pods_by_node
+        self.pod_filter = pod_filter or default_evictor_filter()
+
+    def deschedule(self, nodes: Sequence[api.Node]) -> None:
+        pods_by_node = self.get_pods_by_node()
+        for node in nodes:
+            if not node.unschedulable:
+                continue
+            for pod in pods_by_node.get(node.meta.name, ()):
+                if self.pod_filter(pod):
+                    self.evictor.evict(
+                        pod, f"node {node.meta.name} is unschedulable")

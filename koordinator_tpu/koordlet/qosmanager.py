@@ -25,6 +25,7 @@ from __future__ import annotations
 
 import dataclasses
 import math
+import os
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 from koordinator_tpu.api import types as api
@@ -502,6 +503,81 @@ class CgroupReconcile:
 
 # --- manager ----------------------------------------------------------------
 
+class SystemReconcile:
+    """Host-level sysctl tuning from the NodeSLO system strategy
+    (sysreconcile: min_free_kbytes factor + watermark_scale_factor,
+    system_file.go vm knobs). Factors are permyriad of total memory,
+    matching SystemStrategy defaults."""
+
+    name = "sysreconcile"
+
+    def __init__(self, informer: StatesInformer, executor: Executor,
+                 auditor: Auditor = NULL_AUDITOR):
+        self.informer = informer
+        self.executor = executor
+        self.auditor = auditor
+
+    def _write_sysctl(self, rel: str, value: str) -> None:
+        host = self.executor.host
+        path = os.path.join(host.proc_root, "sys", "vm", rel)
+        try:
+            # cacheable-write discipline: rewriting min_free_kbytes
+            # triggers kernel watermark recalculation even when unchanged
+            try:
+                if host.read(path).strip() == value:
+                    return
+            except OSError:
+                pass
+            host.write(path, value)
+            self.auditor.info("sysreconcile", "write", rel, value)
+        except OSError as e:
+            self.auditor.record("error", "sysreconcile", "write", rel,
+                                f"{value!r}: {e}")
+
+    def reconcile(self, now: float) -> None:
+        slo = self.informer.get_node_slo()
+        if slo is None:
+            return
+        sys_strategy = slo.system
+        mem_total_kb = self.executor.host.meminfo().get("MemTotal", 0) // 1024
+        if mem_total_kb > 0 and sys_strategy.min_free_kbytes_factor > 0:
+            min_free = int(mem_total_kb
+                           * sys_strategy.min_free_kbytes_factor / 10000.0)
+            self._write_sysctl("min_free_kbytes", str(min_free))
+        if sys_strategy.watermark_scale_factor > 0:
+            self._write_sysctl("watermark_scale_factor",
+                               str(int(sys_strategy.watermark_scale_factor)))
+
+
+# per-QoS-tier blkio weight (blkio hook/strategy: BE gets low IO weight so
+# batch IO cannot starve latency-sensitive pods)
+BLKIO_TIER_WEIGHTS = {"kubepods": 1000, "kubepods/burstable": 500,
+                      "kubepods/besteffort": 100}
+
+
+class BlkIOReconcile:
+    """blkio weight per QoS tier cgroup (qosmanager blkio strategy)."""
+
+    name = "blkio"
+
+    def __init__(self, informer: StatesInformer, executor: Executor,
+                 weights: Optional[Dict[str, int]] = None,
+                 auditor: Auditor = NULL_AUDITOR):
+        self.informer = informer
+        self.executor = executor
+        self.weights = dict(weights or BLKIO_TIER_WEIGHTS)
+        self.auditor = auditor
+
+    def reconcile(self, now: float) -> None:
+        # IO weights only apply once the control plane distributed an SLO
+        # (the reference strategy reads the NodeSLO blkio config)
+        if self.informer.get_node_slo() is None:
+            return
+        for tier, weight in self.weights.items():
+            self.executor.update(CgroupUpdate(tier, "blkio.weight",
+                                              str(weight)))
+
+
 class QoSManager:
     """Strategy registry + tick driver (qosmanager.go:72,
     plugins/register.go:32-41)."""
@@ -516,12 +592,24 @@ class QoSManager:
 
 def default_qos_manager(informer: StatesInformer, cache: mc.MetricCache,
                         executor: Executor, evictor: Evictor,
-                        auditor: Auditor = NULL_AUDITOR) -> QoSManager:
-    return QoSManager([
+                        auditor: Auditor = NULL_AUDITOR,
+                        feature_gate=None) -> QoSManager:
+    from koordinator_tpu.features import DEFAULT_FEATURE_GATE
+    gate = feature_gate or DEFAULT_FEATURE_GATE
+    strategies = [
         CPUSuppress(informer, cache, executor, auditor=auditor),
         CPUBurst(informer, cache, executor, auditor=auditor),
         CPUEvict(informer, cache, executor, evictor, auditor=auditor),
         MemoryEvict(informer, cache, evictor, auditor=auditor),
         ResctrlReconcile(informer, executor, auditor=auditor),
         CgroupReconcile(informer, executor),
-    ])
+    ]
+    # host-global sysctl / IO-weight writes stay behind their gates
+    # (default off, koordlet_features.go SystemConfig / BlkIOReconcile)
+    if gate.enabled("SystemConfig"):
+        strategies.append(SystemReconcile(informer, executor,
+                                          auditor=auditor))
+    if gate.enabled("BlkIOReconcile"):
+        strategies.append(BlkIOReconcile(informer, executor,
+                                         auditor=auditor))
+    return QoSManager(strategies)

@@ -232,3 +232,63 @@ def prod_pods(pods: List[PodMeta]) -> List[PodMeta]:
 
 def be_pods(pods: List[PodMeta]) -> List[PodMeta]:
     return [p for p in pods if p.pod.qos == QoSClass.BE]
+
+
+class TopologyReporter:
+    """NodeResourceTopology reporting from the kernel CPU topology
+    (statesinformer/impl noderesourcetopology: zones + per-zone capacity;
+    SURVEY.md 2.2). Memory capacity is split evenly across NUMA zones —
+    per-zone meminfo is a later refinement; cpu capacity is exact."""
+
+    def __init__(self, host, informer: StatesInformer, node_name: str = ""):
+        self.host = host
+        self.informer = informer
+        self.node_name = node_name
+
+    def report(self) -> api.NodeResourceTopology:
+        cpus = self.host.cpu_topology()
+        by_node: Dict[int, List] = {}
+        for c in cpus:
+            by_node.setdefault(c.node_id, []).append(c)
+        mem_total_mib = self.host.meminfo().get("MemTotal", 0) / (1 << 20)
+        n_zones = max(len(by_node), 1)
+        zones = []
+        for node_id in sorted(by_node):
+            members = by_node[node_id]
+            mask = 0
+            for c in members:
+                mask |= 1 << c.cpu_id
+            zones.append(api.NUMAZone(
+                cpus_milli=1000.0 * len(members),
+                memory_mib=mem_total_mib / n_zones,
+                cpuset=mask))
+        # core_id is only unique within a package: group SMT siblings by
+        # (socket, core) or multi-socket hosts double-count thread width
+        by_core: Dict[tuple, int] = {}
+        for c in cpus:
+            key = (c.socket_id, c.core_id)
+            by_core[key] = by_core.get(key, 0) + 1
+        cpus_per_core = max(by_core.values(), default=1)
+        topo = api.NodeResourceTopology(
+            node_name=self.node_name, zones=zones,
+            cpus_per_core=cpus_per_core)
+        self.informer.set_topology(topo)
+        return topo
+
+
+class DeviceReporter:
+    """Device CR reporting from an injected discovery callable (the NVML
+    polling of states_device_linux.go; SURVEY.md 2.2). `discover()` returns
+    the node's DeviceInfo list — hermetic tests inject a fake inventory."""
+
+    def __init__(self, discover: Callable[[], List[api.DeviceInfo]],
+                 informer: StatesInformer, node_name: str = ""):
+        self.discover = discover
+        self.informer = informer
+        self.node_name = node_name
+
+    def report(self) -> api.Device:
+        device = api.Device(node_name=self.node_name,
+                            devices=self.discover())
+        self.informer.set_device(device)
+        return device

@@ -79,6 +79,7 @@ RESOURCES: Dict[str, CgroupResource] = {r.name: r for r in [
     CgroupResource("io.pressure", "io", "io.pressure", "io.pressure"),
     CgroupResource("blkio.throttle.read_bps_device", "blkio", "blkio.throttle.read_bps_device", "io.max"),
     CgroupResource("blkio.throttle.write_bps_device", "blkio", "blkio.throttle.write_bps_device", "io.max"),
+    CgroupResource("blkio.weight", "blkio", "blkio.weight", "io.weight", (1, 1000)),
 ]}
 
 # kubelet cgroup tree roots per QoS class (v1 path under each subsystem;

@@ -1,0 +1,187 @@
+"""Host-side lifecycle controllers owned by scheduler plugins: Reservation
+reconciliation and the gang (PodGroup) state machine.
+
+Capability parity (SURVEY.md 2.1):
+- ReservationController (plugins/reservation/controller/): phase
+  transitions Pending -> Available (scheduled), TTL expiry -> Expired,
+  AllocateOnce fully-consumed -> Succeeded, and terminal-object garbage
+  collection.
+- GangDirectory (plugins/coscheduling/core/{gang,gang_cache}.go): gangs
+  come from PodGroup CRs or lightweight pod annotations; tracks member
+  arrival (quorum), assumed counts, and the Permit WaitTime barrier — a
+  gang whose quorum never assembles within wait_time has its assumed
+  members released (the reference rejects the waiting pods). The
+  reference's per-pod ScheduleCycle bookkeeping (gang.go:71-78, which
+  batches one attempt per member before retrying) maps onto the batched
+  core directly: one schedule_batch invocation IS one gang schedule cycle
+  — every member gets exactly one attempt per device program, so the
+  cycle-validity machinery reduces to the per-batch all-or-nothing
+  rollback already enforced on device.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence
+
+from koordinator_tpu.api import types as api
+
+GC_DURATION_SECONDS = 24 * 3600.0  # terminal reservations kept for a day
+
+
+class ReservationController:
+    """Reconciles Reservation phase/expiry (controller.go:195-230)."""
+
+    def __init__(self, gc_seconds: float = GC_DURATION_SECONDS):
+        self.gc_seconds = gc_seconds
+        self._terminal_at: Dict[str, float] = {}
+
+    def reconcile(self, reservations: List[api.Reservation],
+                  now: float) -> List[api.Reservation]:
+        """Advance phases in place; returns the survivors (GC removes
+        long-terminal objects from the list)."""
+        out: List[api.Reservation] = []
+        for r in reservations:
+            name = r.meta.name
+            if r.phase == "Pending" and r.node_name:
+                r.phase = "Available"
+            # ttl_seconds <= 0 means never expire (TTLSeconds=0 disables
+            # expiration in the reference)
+            if r.phase in ("Pending", "Available") and r.create_time > 0 \
+                    and r.ttl_seconds > 0 \
+                    and now - r.create_time > r.ttl_seconds:
+                r.phase = "Expired"
+            if r.phase == "Available" and r.allocate_once and r.allocated:
+                covered = all(
+                    r.allocated.get(k, 0.0) >= v - 0.5
+                    for k, v in r.requests.items())
+                if covered:
+                    r.phase = "Succeeded"
+            if r.phase in ("Expired", "Succeeded", "Failed"):
+                first = self._terminal_at.setdefault(name, now)
+                if now - first > self.gc_seconds:
+                    self._terminal_at.pop(name, None)
+                    continue  # garbage collected
+            else:
+                self._terminal_at.pop(name, None)
+            out.append(r)
+        return out
+
+
+@dataclasses.dataclass
+class GangRecord:
+    """One gang's host state (core/gang.go:43-99)."""
+
+    name: str
+    min_member: int = 1
+    total_member: int = 0
+    mode: str = "Strict"          # Strict | NonStrict
+    wait_time_seconds: float = 600.0
+    members: set = dataclasses.field(default_factory=set)
+    assumed: set = dataclasses.field(default_factory=set)
+    first_assumed_at: Optional[float] = None
+    timeout_count: int = 0
+
+    @property
+    def quorum(self) -> bool:
+        return len(self.members) >= self.min_member
+
+    @property
+    def satisfied(self) -> bool:
+        return len(self.assumed) >= self.min_member
+
+
+class GangDirectory:
+    """The gangCache equivalent feeding GangState snapshot columns."""
+
+    def __init__(self, default_wait_time_seconds: float = 600.0):
+        self.default_wait_time = default_wait_time_seconds
+        self.gangs: Dict[str, GangRecord] = {}
+
+    # -- ingest (onPodGroupAdd / onPodAdd) -----------------------------------
+
+    def upsert_pod_group(self, pg: api.PodGroup) -> GangRecord:
+        g = self.gangs.get(pg.meta.name)
+        if g is None:
+            g = self.gangs[pg.meta.name] = GangRecord(name=pg.meta.name)
+        g.min_member = pg.min_member
+        g.mode = pg.mode
+        g.wait_time_seconds = pg.wait_time_seconds or self.default_wait_time
+        return g
+
+    def add_pod(self, gang_name: str, pod_uid: str,
+                min_member: Optional[int] = None) -> GangRecord:
+        """Pods may declare gangs by annotation without a PodGroup CR
+        (gang_cache.go onPodAdd creates the gang lazily)."""
+        g = self.gangs.get(gang_name)
+        if g is None:
+            g = self.gangs[gang_name] = GangRecord(
+                name=gang_name, wait_time_seconds=self.default_wait_time)
+        if min_member is not None:
+            g.min_member = min_member
+        g.members.add(pod_uid)
+        g.total_member = len(g.members)
+        return g
+
+    def remove_pod(self, gang_name: str, pod_uid: str) -> None:
+        g = self.gangs.get(gang_name)
+        if g is None:
+            return
+        g.members.discard(pod_uid)
+        g.assumed.discard(pod_uid)
+        g.total_member = len(g.members)
+        if not g.members:
+            del self.gangs[gang_name]
+
+    # -- scheduling feedback -------------------------------------------------
+
+    def mark_assumed(self, gang_name: str, pod_uid: str,
+                     now: float) -> None:
+        g = self.gangs.get(gang_name)
+        if g is None:
+            return
+        g.assumed.add(pod_uid)
+        if g.first_assumed_at is None:
+            g.first_assumed_at = now
+        if g.satisfied:
+            g.first_assumed_at = None  # barrier passed; no timeout pending
+
+    def expire_waits(self, now: float) -> List[str]:
+        """The Permit WaitTime barrier: gangs waiting past wait_time get
+        their assumed members released (core.go:311-341 rejection of
+        waiting pods). Returns the timed-out gang names; the caller
+        unbinds/requeues those pods."""
+        timed_out = []
+        for g in self.gangs.values():
+            if g.first_assumed_at is None or g.satisfied:
+                continue
+            if now - g.first_assumed_at > g.wait_time_seconds:
+                g.assumed.clear()
+                g.first_assumed_at = None
+                g.timeout_count += 1
+                timed_out.append(g.name)
+        return timed_out
+
+    # -- snapshot feed -------------------------------------------------------
+
+    def to_pod_groups(self) -> List[api.PodGroup]:
+        """Typed rows for SnapshotBuilder.add_gang (member counts +
+        assumed ride along)."""
+        return [api.PodGroup(meta=api.ObjectMeta(name=g.name),
+                             min_member=g.min_member,
+                             total_member=g.total_member,
+                             mode=g.mode,
+                             wait_time_seconds=g.wait_time_seconds)
+                for g in self.gangs.values()]
+
+    def assumed_count(self, gang_name: str) -> int:
+        g = self.gangs.get(gang_name)
+        return len(g.assumed) if g else 0
+
+    def summary(self) -> dict:
+        """The gang service endpoint payload (frameworkext services)."""
+        return {g.name: {"minMember": g.min_member,
+                         "members": len(g.members),
+                         "assumed": len(g.assumed),
+                         "timeouts": g.timeout_count}
+                for g in self.gangs.values()}

@@ -211,19 +211,25 @@ class SchedulerService:
         self.batches = 0
         self.pods_placed = 0
         self.last_elapsed = 0.0
+        # snapshot ingest and batch commits are serialized: a publish
+        # landing mid-batch would otherwise be silently replaced by the
+        # post-commit snapshot derived from the PREVIOUS version
+        self._commit_lock = threading.Lock()
         self.registry.register("scheduler", self.summary)
 
     def publish(self, snapshot: ClusterSnapshot) -> None:
-        self.store.publish(snapshot)
+        with self._commit_lock:
+            self.store.publish(snapshot)
 
     def schedule(self, pods: PodBatch,
                  pod_names: Optional[List[str]] = None) -> core.ScheduleResult:
         token = self.monitor.start_cycle()
-        snap = self.store.current()
-        result = core.schedule_batch(snap, pods, self.cfg,
-                                     **self.schedule_kwargs)
-        np.asarray(result.assignment)  # D2H completion barrier
-        self.store.update(lambda _old: result.snapshot)
+        with self._commit_lock:
+            snap = self.store.current()
+            result = core.schedule_batch(snap, pods, self.cfg,
+                                         **self.schedule_kwargs)
+            np.asarray(result.assignment)  # D2H completion barrier
+            self.store.update(lambda _old: result.snapshot)
         self.last_elapsed = self.monitor.complete_cycle(token)
         self.batches += 1
         self.pods_placed += int((np.asarray(result.assignment) >= 0).sum())

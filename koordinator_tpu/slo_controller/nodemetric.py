@@ -1,0 +1,56 @@
+"""NodeMetric controller: ensures one NodeMetric object per Node and keeps
+its collect policy in sync with the dynamic config.
+
+Capability parity with pkg/slo-controller/nodemetric (SURVEY.md 2.3,
+collect_policy.go): the spec side of NodeMetric (report interval,
+aggregation windows) is owned by the control plane; the node agent fills
+status. The policy type is the SAME object the koordlet reporter consumes
+(statesinformer.CollectPolicy) — the controller distributes it, the agent
+obeys it.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+from koordinator_tpu.api import types as api
+from koordinator_tpu.koordlet.statesinformer import CollectPolicy
+
+
+class NodeMetricController:
+    def __init__(self, policy: Optional[CollectPolicy] = None):
+        self.policy = policy or CollectPolicy()
+        self.metrics: Dict[str, api.NodeMetric] = {}
+
+    def collect_policy(self) -> CollectPolicy:
+        """The spec the agents should run with (NodeMetricSpec
+        distribution)."""
+        return self.policy
+
+    def reconcile(self, nodes: Sequence[api.Node]) -> List[api.NodeMetric]:
+        """Create missing NodeMetric shells, sync their report interval,
+        and drop rows for deleted nodes; returns the live set."""
+        names = {n.meta.name for n in nodes}
+        for stale in set(self.metrics) - names:
+            del self.metrics[stale]
+        for node in nodes:
+            m = self.metrics.get(node.meta.name)
+            if m is None:
+                m = self.metrics[node.meta.name] = api.NodeMetric(
+                    node_name=node.meta.name)
+            m.report_interval_seconds = self.policy.report_interval_seconds
+        return [self.metrics[n.meta.name] for n in nodes]
+
+    def observe_status(self, report: api.NodeMetric) -> None:
+        """Fold a koordlet status report into the controller's view (the
+        agent writes status; spec fields stay controller-owned)."""
+        m = self.metrics.get(report.node_name)
+        if m is None:
+            self.metrics[report.node_name] = report
+            return
+        m.update_time = report.update_time
+        m.node_usage = report.node_usage
+        m.system_usage = report.system_usage
+        m.aggregated = report.aggregated
+        m.pods_metric = report.pods_metric
+        m.prod_reclaimable = report.prod_reclaimable

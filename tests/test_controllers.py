@@ -1,0 +1,246 @@
+"""Reservation lifecycle, gang directory, NodeMetric controller, koordlet
+reporters, sysreconcile/blkio strategies, and descheduler compat plugins
+(SURVEY.md 2.1-2.4 remaining inventory)."""
+
+import os
+
+import pytest
+
+from koordinator_tpu.api import types as api
+from koordinator_tpu.api.extension import QoSClass, ResourceKind as RK
+from koordinator_tpu.descheduler import RecordingEvictor
+from koordinator_tpu.descheduler.compat import (
+    RemovePodsOnUnschedulableNodes,
+    RemovePodsViolatingNodeSelector,
+    default_evictor_filter,
+)
+from koordinator_tpu.scheduler.controllers import (
+    GangDirectory,
+    ReservationController,
+)
+from koordinator_tpu.slo_controller.nodemetric import NodeMetricController
+
+
+# --- reservation lifecycle --------------------------------------------------
+
+
+def test_reservation_phase_transitions_and_gc():
+    ctl = ReservationController(gc_seconds=100.0)
+    r = api.Reservation(meta=api.ObjectMeta(name="r"), create_time=1.0,
+                        ttl_seconds=50.0, requests={RK.CPU: 100.0})
+    assert ctl.reconcile([r], now=1.0)[0].phase == "Pending"
+    r.node_name = "n0"
+    assert ctl.reconcile([r], now=2.0)[0].phase == "Available"
+    # TTL expiry
+    assert ctl.reconcile([r], now=60.0)[0].phase == "Expired"
+    # GC after terminal hold period
+    assert ctl.reconcile([r], now=100.0) == [r]
+    assert ctl.reconcile([r], now=200.0) == []
+
+
+def test_reservation_zero_ttl_never_expires():
+    ctl = ReservationController()
+    r = api.Reservation(meta=api.ObjectMeta(name="r"), create_time=1.0,
+                        ttl_seconds=0.0, node_name="n0",
+                        requests={RK.CPU: 1.0})
+    assert ctl.reconcile([r], now=1e12)[0].phase == "Available"
+
+
+def test_reservation_allocate_once_succeeds_when_consumed():
+    ctl = ReservationController()
+    r = api.Reservation(meta=api.ObjectMeta(name="r"), create_time=0.0,
+                        node_name="n0", allocate_once=True,
+                        requests={RK.CPU: 100.0},
+                        allocated={RK.CPU: 100.0})
+    assert ctl.reconcile([r], now=1.0)[0].phase == "Succeeded"
+
+
+# --- gang directory ---------------------------------------------------------
+
+
+def test_gang_quorum_and_wait_timeout():
+    d = GangDirectory(default_wait_time_seconds=60.0)
+    g = d.add_pod("ml/gang", "p0", min_member=3)
+    d.add_pod("ml/gang", "p1")
+    assert not g.quorum
+    d.add_pod("ml/gang", "p2")
+    assert g.quorum and g.total_member == 3
+    d.mark_assumed("ml/gang", "p0", now=0.0)
+    d.mark_assumed("ml/gang", "p1", now=5.0)
+    assert d.expire_waits(now=30.0) == []       # within wait time
+    assert d.expire_waits(now=100.0) == ["ml/gang"]
+    assert d.assumed_count("ml/gang") == 0 and g.timeout_count == 1
+    # satisfied gangs never time out
+    for uid in ("p0", "p1", "p2"):
+        d.mark_assumed("ml/gang", uid, now=200.0)
+    assert d.expire_waits(now=1000.0) == []
+
+
+def test_gang_pod_group_sync_and_removal():
+    d = GangDirectory()
+    d.upsert_pod_group(api.PodGroup(meta=api.ObjectMeta(name="g"),
+                                    min_member=2, mode="NonStrict",
+                                    wait_time_seconds=30.0))
+    d.add_pod("g", "p0")
+    rows = d.to_pod_groups()
+    assert rows[0].min_member == 2 and rows[0].mode == "NonStrict"
+    d.remove_pod("g", "p0")
+    assert d.gangs == {}
+
+
+# --- nodemetric controller --------------------------------------------------
+
+
+def test_nodemetric_controller_lifecycle():
+    ctl = NodeMetricController()
+    nodes = [api.Node(meta=api.ObjectMeta(name=f"n{i}")) for i in range(2)]
+    rows = ctl.reconcile(nodes)
+    assert [m.node_name for m in rows] == ["n0", "n1"]
+    assert rows[0].report_interval_seconds == 60.0
+    ctl.observe_status(api.NodeMetric(node_name="n0", update_time=123.0,
+                                      node_usage={RK.CPU: 10.0}))
+    assert ctl.metrics["n0"].update_time == 123.0
+    rows = ctl.reconcile(nodes[:1])
+    assert len(rows) == 1 and "n1" not in ctl.metrics
+
+
+# --- koordlet reporters + strategies ----------------------------------------
+
+
+def test_topology_and_device_reporters(tmp_path):
+    from koordinator_tpu.koordlet.statesinformer import (
+        DeviceReporter,
+        StatesInformer,
+        TopologyReporter,
+    )
+    from koordinator_tpu.koordlet.testing import FakeHost
+
+    host = FakeHost(str(tmp_path), num_cpus=8, numa_nodes=2)
+    informer = StatesInformer()
+    topo = TopologyReporter(host, informer, "n0").report()
+    assert len(topo.zones) == 2
+    assert sum(z.cpus_milli for z in topo.zones) == 8000.0
+    assert informer.get_topology() is topo
+
+    inventory = [api.DeviceInfo(minor=m, type="gpu",
+                                resources={RK.GPU_CORE: 100.0})
+                 for m in range(4)]
+    device = DeviceReporter(lambda: inventory, informer, "n0").report()
+    assert len(device.devices) == 4
+    assert informer.get_device() is device
+
+
+def test_sysreconcile_and_blkio(tmp_path):
+    from koordinator_tpu.koordlet.qosmanager import (
+        BlkIOReconcile,
+        SystemReconcile,
+    )
+    from koordinator_tpu.koordlet.resourceexecutor import Executor
+    from koordinator_tpu.koordlet.statesinformer import StatesInformer
+    from koordinator_tpu.koordlet.testing import FakeHost
+
+    host = FakeHost(str(tmp_path), mem_bytes=16 << 30)
+    os.makedirs(os.path.join(host.proc_root, "sys", "vm"), exist_ok=True)
+    for tier in ("kubepods", "kubepods/burstable", "kubepods/besteffort"):
+        os.makedirs(os.path.join(host.cgroup_root, "blkio", tier),
+                    exist_ok=True)
+    informer = StatesInformer()
+    informer.set_node_slo(api.NodeSLO(
+        node_name="n0",
+        system=api.SystemStrategy(min_free_kbytes_factor=100.0,
+                                  watermark_scale_factor=150.0)))
+    executor = Executor(host)
+    SystemReconcile(informer, executor).reconcile(now=0.0)
+    vm = os.path.join(host.proc_root, "sys", "vm")
+    # 16GiB = 16777216 KiB; factor 100/10000 -> 167772
+    assert open(os.path.join(vm, "min_free_kbytes")).read() == "167772"
+    assert open(os.path.join(vm, "watermark_scale_factor")).read() == "150"
+
+    BlkIOReconcile(informer, executor).reconcile(now=0.0)
+    assert host.read_cgroup("kubepods/besteffort", "blkio.weight") == "100"
+    assert host.read_cgroup("kubepods/burstable", "blkio.weight") == "500"
+
+
+def test_gated_strategies_off_by_default(tmp_path):
+    from koordinator_tpu.features import FeatureGate, FeatureSpec
+    from koordinator_tpu.koordlet.qosmanager import (
+        BlkIOReconcile,
+        RecordingEvictor,
+        SystemReconcile,
+        default_qos_manager,
+    )
+    from koordinator_tpu.koordlet.metriccache import MetricCache
+    from koordinator_tpu.koordlet.resourceexecutor import Executor
+    from koordinator_tpu.koordlet.statesinformer import StatesInformer
+    from koordinator_tpu.koordlet.testing import FakeHost
+
+    host = FakeHost(str(tmp_path))
+    informer = StatesInformer()
+    mgr = default_qos_manager(informer, MetricCache(), Executor(host),
+                              RecordingEvictor())
+    kinds = {type(s) for s in mgr.strategies}
+    assert SystemReconcile not in kinds and BlkIOReconcile not in kinds
+    gate = FeatureGate({"SystemConfig": FeatureSpec(default=True),
+                        "BlkIOReconcile": FeatureSpec(default=True)})
+    mgr_on = default_qos_manager(informer, MetricCache(), Executor(host),
+                                 RecordingEvictor(), feature_gate=gate)
+    kinds_on = {type(s) for s in mgr_on.strategies}
+    assert SystemReconcile in kinds_on and BlkIOReconcile in kinds_on
+
+
+def test_cpus_per_core_multi_socket(tmp_path):
+    # core_id repeats across sockets: SMT width must not double
+    from koordinator_tpu.koordlet.statesinformer import (
+        StatesInformer,
+        TopologyReporter,
+    )
+    from koordinator_tpu.koordlet.system import ProcessorInfo
+    from koordinator_tpu.koordlet.testing import FakeHost
+
+    host = FakeHost(str(tmp_path), num_cpus=8, numa_nodes=2)
+    cpus = [ProcessorInfo(cpu_id=i, core_id=(i // 2) % 2,
+                          socket_id=i // 4, node_id=i // 4)
+            for i in range(8)]
+    host.cpu_topology = lambda: cpus
+    topo = TopologyReporter(host, StatesInformer(), "n0").report()
+    assert topo.cpus_per_core == 2
+
+
+# --- descheduler compat plugins ---------------------------------------------
+
+
+def mk_pod(name, node, **kw):
+    return api.Pod(meta=api.ObjectMeta(name=name), node_name=node, **kw)
+
+
+def test_default_evictor_filter():
+    f = default_evictor_filter(priority_threshold=9000)
+    assert f(mk_pod("ok", "n", priority=5000))
+    assert not f(mk_pod("ds", "n", is_daemonset=True))
+    assert not f(mk_pod("sys", "n", qos_label="SYSTEM"))
+    assert not f(mk_pod("hi", "n", priority=9500))
+    shielded = mk_pod("s", "n")
+    shielded.meta.annotations[
+        "scheduling.koordinator.sh/preemptible"] = "false"
+    assert not f(shielded)
+
+
+def test_remove_pods_violating_node_selector():
+    ev = RecordingEvictor()
+    moved = mk_pod("moved", "n0", node_selector={"pool": "ml"})
+    fine = mk_pod("fine", "n0", node_selector={"pool": "web"})
+    plugin = RemovePodsViolatingNodeSelector(
+        ev, lambda: {"n0": [moved, fine]})
+    plugin.deschedule([api.Node(meta=api.ObjectMeta(
+        name="n0", labels={"pool": "web"}))])
+    assert [e.pod.meta.name for e in ev.evictions] == ["moved"]
+
+
+def test_remove_pods_on_unschedulable_nodes():
+    ev = RecordingEvictor()
+    plugin = RemovePodsOnUnschedulableNodes(
+        ev, lambda: {"n0": [mk_pod("a", "n0")], "n1": [mk_pod("b", "n1")]})
+    plugin.deschedule([
+        api.Node(meta=api.ObjectMeta(name="n0"), unschedulable=True),
+        api.Node(meta=api.ObjectMeta(name="n1"))])
+    assert [e.pod.meta.name for e in ev.evictions] == ["a"]
