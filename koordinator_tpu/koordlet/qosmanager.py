@@ -550,9 +550,15 @@ class SystemReconcile:
 
 
 # per-QoS-tier blkio weight (blkio hook/strategy: BE gets low IO weight so
-# batch IO cannot starve latency-sensitive pods)
-BLKIO_TIER_WEIGHTS = {"kubepods": 1000, "kubepods/burstable": 500,
-                      "kubepods/besteffort": 100}
+# batch IO cannot starve latency-sensitive pods); paths derive from the
+# single cgroup-tree layout in koordlet/system.py
+from koordinator_tpu.koordlet.system import KUBEPODS_ROOT, QOS_DIRS  # noqa: E402
+
+BLKIO_TIER_WEIGHTS = {
+    KUBEPODS_ROOT: 1000,
+    f"{KUBEPODS_ROOT}/{QOS_DIRS['burstable']}": 500,
+    f"{KUBEPODS_ROOT}/{QOS_DIRS['besteffort']}": 100,
+}
 
 
 class BlkIOReconcile:

@@ -40,6 +40,13 @@ class ReservationController:
                   now: float) -> List[api.Reservation]:
         """Advance phases in place; returns the survivors (GC removes
         long-terminal objects from the list)."""
+        # drop tracking for names no longer in the input: externally
+        # deleted objects must not leave stale terminal timestamps that
+        # would prematurely GC a later same-named reservation (and the
+        # map must not grow unboundedly in a long-running controller)
+        live = {r.meta.name for r in reservations}
+        for stale in set(self._terminal_at) - live:
+            del self._terminal_at[stale]
         out: List[api.Reservation] = []
         for r in reservations:
             name = r.meta.name
@@ -77,6 +84,7 @@ class GangRecord:
     total_member: int = 0
     mode: str = "Strict"          # Strict | NonStrict
     wait_time_seconds: float = 600.0
+    from_cr: bool = False         # PodGroup CR is authoritative for spec
     members: set = dataclasses.field(default_factory=set)
     assumed: set = dataclasses.field(default_factory=set)
     first_assumed_at: Optional[float] = None
@@ -104,6 +112,7 @@ class GangDirectory:
         g = self.gangs.get(pg.meta.name)
         if g is None:
             g = self.gangs[pg.meta.name] = GangRecord(name=pg.meta.name)
+        g.from_cr = True
         g.min_member = pg.min_member
         g.mode = pg.mode
         g.wait_time_seconds = pg.wait_time_seconds or self.default_wait_time
@@ -112,12 +121,13 @@ class GangDirectory:
     def add_pod(self, gang_name: str, pod_uid: str,
                 min_member: Optional[int] = None) -> GangRecord:
         """Pods may declare gangs by annotation without a PodGroup CR
-        (gang_cache.go onPodAdd creates the gang lazily)."""
+        (gang_cache.go onPodAdd creates the gang lazily); a CR-backed
+        gang's spec is authoritative — pod annotations never override it."""
         g = self.gangs.get(gang_name)
         if g is None:
             g = self.gangs[gang_name] = GangRecord(
                 name=gang_name, wait_time_seconds=self.default_wait_time)
-        if min_member is not None:
+        if min_member is not None and not g.from_cr:
             g.min_member = min_member
         g.members.add(pod_uid)
         g.total_member = len(g.members)
@@ -130,8 +140,13 @@ class GangDirectory:
         g.members.discard(pod_uid)
         g.assumed.discard(pod_uid)
         g.total_member = len(g.members)
-        if not g.members:
+        # annotation-created gangs vanish with their last member; a
+        # CR-backed record keeps its spec until the CR is deleted
+        if not g.members and not g.from_cr:
             del self.gangs[gang_name]
+
+    def delete_pod_group(self, name: str) -> None:
+        self.gangs.pop(name, None)
 
     # -- scheduling feedback -------------------------------------------------
 

@@ -228,11 +228,12 @@ class SchedulerService:
             snap = self.store.current()
             result = core.schedule_batch(snap, pods, self.cfg,
                                          **self.schedule_kwargs)
-            np.asarray(result.assignment)  # D2H completion barrier
+            # single D2H transfer doubles as the completion barrier
+            assignment = np.asarray(result.assignment)
             self.store.update(lambda _old: result.snapshot)
         self.last_elapsed = self.monitor.complete_cycle(token)
         self.batches += 1
-        self.pods_placed += int((np.asarray(result.assignment) >= 0).sum())
+        self.pods_placed += int((assignment >= 0).sum())
         if self.flags.score_top_n > 0:
             log.info("score table:\n%s", debug_score_table(
                 snap, pods, self.cfg, self.flags.score_top_n, pod_names))

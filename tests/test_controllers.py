@@ -81,11 +81,32 @@ def test_gang_pod_group_sync_and_removal():
     d.upsert_pod_group(api.PodGroup(meta=api.ObjectMeta(name="g"),
                                     min_member=2, mode="NonStrict",
                                     wait_time_seconds=30.0))
-    d.add_pod("g", "p0")
+    # the CR spec is authoritative: a pod annotation cannot lower quorum
+    d.add_pod("g", "p0", min_member=1)
     rows = d.to_pod_groups()
     assert rows[0].min_member == 2 and rows[0].mode == "NonStrict"
+    # CR-backed record survives member churn; annotation gangs do not
     d.remove_pod("g", "p0")
+    assert d.gangs["g"].min_member == 2
+    d.delete_pod_group("g")
+    d.add_pod("anno", "p0", min_member=3)
+    d.remove_pod("anno", "p0")
     assert d.gangs == {}
+
+
+def test_reservation_external_delete_does_not_poison_gc():
+    ctl = ReservationController(gc_seconds=100.0)
+    r1 = api.Reservation(meta=api.ObjectMeta(name="r"), create_time=1.0,
+                         ttl_seconds=5.0, node_name="n0",
+                         requests={RK.CPU: 1.0})
+    ctl.reconcile([r1], now=10.0)          # expired, tracked
+    ctl.reconcile([], now=20.0)            # externally deleted
+    r2 = api.Reservation(meta=api.ObjectMeta(name="r"), create_time=500.0,
+                         ttl_seconds=5.0, node_name="n0",
+                         requests={RK.CPU: 1.0})
+    # same-named successor must get its own full terminal hold period
+    assert ctl.reconcile([r2], now=510.0) == [r2]
+    assert ctl.reconcile([r2], now=550.0) == [r2]
 
 
 # --- nodemetric controller --------------------------------------------------

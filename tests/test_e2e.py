@@ -1,0 +1,233 @@
+"""End-to-end colocation suite — the hermetic analogue of the reference's
+kind-cluster e2e (test/e2e/scheduling + slocontroller, SURVEY.md 4): every
+component cooperates across one story, with the fake host FS standing in
+for the kernel and the virtual CPU mesh for multi-chip.
+
+Story: raw pods are admitted and mutated into BE batch pods; a quota
+profile provisions the tree; the TPU scheduler places the workload
+(including a NUMA-bound multi-GPU trainer) against overcommitted batch
+capacity computed by the slo-controller from koordlet's NodeMetric; bind
+annotations flow through the runtime proxy into cgroup writes on the fake
+host; a hot node is rebalanced through the descheduler's
+reservation-first migration.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from koordinator_tpu.api import types as api
+from koordinator_tpu.api.extension import (
+    LABEL_POD_QOS,
+    QoSClass,
+    ResourceKind as RK,
+)
+from koordinator_tpu.descheduler import (
+    LowNodeLoad,
+    LowNodeLoadArgs,
+    MigrationController,
+    MigrationControllerArgs,
+    RecordingEvictor,
+)
+from koordinator_tpu.koordlet.agent import Daemon, DaemonConfig
+from koordinator_tpu.koordlet.statesinformer import PodMeta
+from koordinator_tpu.koordlet.testing import FakeHost
+from koordinator_tpu.quota_controller import QuotaProfileReconciler
+from koordinator_tpu.scheduler import core
+from koordinator_tpu.scheduler.bind import (
+    device_allocation_annotation,
+    resource_status_annotation,
+)
+from koordinator_tpu.scheduler.frameworkext import SchedulerService
+from koordinator_tpu.scheduler.plugins.loadaware import LoadAwareConfig
+from koordinator_tpu.slo_controller.noderesource import (
+    NodeResourceController,
+)
+from koordinator_tpu.snapshot import SnapshotBuilder
+from koordinator_tpu.webhook import PodMutator, QuotaTopology, validate_pod
+
+
+def mk_nodes(n=4, cpu=64000.0, mem=256 * 1024.0):
+    return [api.Node(meta=api.ObjectMeta(name=f"n{i}", labels={"pool": "colo"}),
+                     allocatable={RK.CPU: cpu, RK.MEMORY: mem})
+            for i in range(n)]
+
+
+def fresh_metric(name, cpu_used, mem_used, pods=()):
+    return api.NodeMetric(node_name=name, update_time=1e9,
+                          node_usage={RK.CPU: cpu_used, RK.MEMORY: mem_used},
+                          pods_metric=list(pods))
+
+
+def test_colocation_pipeline_admission_to_batch_capacity():
+    """webhook -> quota tree -> slo-controller overcommit -> TPU placement
+    of BE pods on batch resources."""
+    nodes = mk_nodes()
+    # slo-controller: NodeMetric usage -> batch-cpu/batch-memory allocatable
+    from koordinator_tpu.slo_controller.noderesource import build_inputs
+
+    ctl = NodeResourceController()
+    metrics = {n.meta.name: fresh_metric(n.meta.name, 8000.0, 32 * 1024.0)
+               for n in nodes}
+    out = ctl.reconcile(build_inputs(nodes, metrics, {}, now=1e9))
+    assert out["sync_mask"].all()
+    for i, n in enumerate(nodes):
+        assert out["batch"][i, 0] > 0
+        n.allocatable[RK.BATCH_CPU] = float(out["batch"][i, 0])
+        n.allocatable[RK.BATCH_MEMORY] = float(out["batch"][i, 1])
+
+    # quota tree from a profile over the pool
+    topo = QuotaTopology()
+    root = QuotaProfileReconciler(topo).reconcile(
+        api.ElasticQuotaProfile(meta=api.ObjectMeta(name="colo"),
+                                quota_name="colo-root",
+                                node_selector={"pool": "colo"}),
+        nodes)
+    assert root.min[RK.CPU] == sum(n.allocatable[RK.CPU] for n in nodes)
+
+    # admission: mutate raw spark pods into BE batch pods
+    mutator = PodMutator(
+        [api.ClusterColocationProfile(
+            meta=api.ObjectMeta(name="colo"), selector={"app": "spark"},
+            qos_class="BE", priority_class_name="koord-batch")],
+        priority_classes={"koord-batch": 5500})
+    pods = []
+    for j in range(32):
+        p = api.Pod(meta=api.ObjectMeta(name=f"spark-{j}",
+                                        labels={"app": "spark"}),
+                    requests={RK.CPU: 4000.0, RK.MEMORY: 8192.0},
+                    quota_name="colo-root")
+        mutator.mutate(p)
+        ok, errs = validate_pod(p)
+        assert ok, errs
+        assert p.qos is QoSClass.BE and RK.BATCH_CPU in p.requests
+        pods.append(p)
+
+    # schedule through the sidecar service
+    b = SnapshotBuilder(max_nodes=4, max_quotas=4)
+    for n in nodes:
+        b.add_node(n)
+    for m in metrics.values():
+        b.set_node_metric(m)
+    b.add_quota(root)
+    snap, ctx = b.build(now=1e9)
+    service = SchedulerService(num_rounds=3, k_choices=4)
+    service.publish(snap)
+    res = service.schedule(b.build_pod_batch(pods, ctx))
+    a = np.asarray(res.assignment)
+    assert (a >= 0).all(), "all BE pods place on batch capacity"
+    req = np.asarray(res.snapshot.nodes.requested)
+    alloc = np.asarray(res.snapshot.nodes.allocatable)
+    assert (req <= alloc + 1.0).all()
+
+
+def test_numa_gpu_trainer_to_cgroup_writes(tmp_path):
+    """scheduler -> bind annotations -> koordlet reconciler -> cgroup
+    files on the fake host."""
+    b = SnapshotBuilder(max_nodes=2, max_gpu_inst=4)
+    for i in range(2):
+        b.add_node(api.Node(
+            meta=api.ObjectMeta(name=f"n{i}"),
+            allocatable={RK.CPU: 16000.0, RK.MEMORY: 64 * 1024.0},
+            topology=api.NodeResourceTopology(node_name=f"n{i}", zones=[
+                api.NUMAZone(8000.0, 32 * 1024.0),
+                api.NUMAZone(8000.0, 32 * 1024.0)])))
+        b.set_node_metric(fresh_metric(f"n{i}", 1000.0, 4096.0))
+        b.add_device(api.Device(node_name=f"n{i}", devices=[
+            api.DeviceInfo(minor=m, type="gpu",
+                           resources={RK.GPU_CORE: 100.0,
+                                      RK.GPU_MEMORY: 80 * 1024.0},
+                           numa_node=m // 2)
+            for m in range(4)]))
+    trainer = api.Pod(
+        meta=api.ObjectMeta(name="train", uid="u-train",
+                            labels={LABEL_POD_QOS: "LSR"}),
+        requests={RK.CPU: 4000.0, RK.MEMORY: 8192.0, RK.GPU_CORE: 200.0},
+        priority=9100, qos_label="LSR", gpu_memory_ratio=200.0,
+        required_cpu_bind=True)
+    snap, ctx = b.build(now=1e9)
+    res = core.schedule_batch(snap, b.build_pod_batch([trainer], ctx),
+                              LoadAwareConfig.make())
+    node = int(np.asarray(res.assignment)[0])
+    assert node >= 0
+    zone = int(np.asarray(res.numa_zone)[0])
+    assert zone >= 0
+    trainer.meta.annotations.update(
+        resource_status_annotation(res, 0))
+    trainer.meta.annotations.update(device_allocation_annotation(
+        snap, b.build_pod_batch([trainer], ctx), res, 0))
+
+    # the node agent levels the pod's cgroup from the annotations
+    host = FakeHost(str(tmp_path))
+    daemon = Daemon(host, DaemonConfig())
+    meta = PodMeta(pod=trainer)
+    host.make_cgroup(meta.cgroup_dir)
+    daemon.informer.set_pods([meta])
+    daemon.tick(now=10)  # past the QoS interval so the reconciler runs
+    minors = [d["minor"] for d in json.loads(
+        trainer.meta.annotations[
+            "scheduling.koordinator.sh/device-allocated"])["gpu"]]
+    assert all(m // 2 == zone for m in minors)
+    # LSR group identity reached the cgroup
+    assert host.read_cgroup(meta.cgroup_dir, "cpu.bvt_warp_ns") == "2"
+    # zone binding reached cpuset.mems
+    assert host.read_cgroup(meta.cgroup_dir, "cpuset.mems") == str(zone)
+
+
+def test_rebalance_loop_hot_node_to_migration():
+    """NodeMetric hot node -> LowNodeLoad victims -> reservation-first
+    migration with replacement scheduled by the TPU core."""
+    nodes = mk_nodes(4, cpu=32000.0, mem=64 * 1024.0)
+    running = [api.Pod(meta=api.ObjectMeta(name=f"r{i}"),
+                       requests={RK.CPU: 6000.0, RK.MEMORY: 4096.0},
+                       priority=9100, node_name="n0",
+                       owner_workload="default/rs", workload_replicas=10)
+               for i in range(4)]
+    metrics = {"n0": fresh_metric(
+        "n0", 28000.0, 20000.0,
+        pods=[api.PodMetricInfo(namespace="default", name=p.meta.name,
+                                usage={RK.CPU: 6500.0, RK.MEMORY: 4096.0})
+              for p in running])}
+    for i in range(1, 4):
+        metrics[f"n{i}"] = fresh_metric(f"n{i}", 2000.0, 4000.0)
+
+    plugin = LowNodeLoad(LowNodeLoadArgs(consecutive_abnormalities=1,
+                                         dry_run=True))
+    victims = plugin.balance_once(nodes, metrics, {"n0": running}, now=1e9)
+    assert victims
+
+    ev = RecordingEvictor()
+    directory = {p.meta.namespaced_name: p for p in running}
+    ready = {}
+
+    def reserve(pod):
+        b = SnapshotBuilder(max_nodes=4)
+        for nd in nodes:
+            b.add_node(nd)
+        for m in metrics.values():
+            b.set_node_metric(m)
+        for p in running:
+            b.add_running_pod(p)
+        snap, ctx = b.build(now=1e9)
+        rp = api.Pod(meta=api.ObjectMeta(name=f"resv-{pod.meta.name}"),
+                     requests=dict(pod.requests), priority=9100)
+        r = core.schedule_batch(snap, b.build_pod_batch([rp], ctx),
+                                LoadAwareConfig.make())
+        assert int(np.asarray(r.assignment)[0]) >= 1  # off the hot node
+        ready[rp.meta.name] = True
+        return rp.meta.name
+
+    mc = MigrationController(
+        ev, MigrationControllerArgs(max_migrating_per_node=None),
+        reserve=reserve, reservation_available=ready.get,
+        get_pod=directory.get)
+    for v in victims:
+        mc.submit_for_pod(v, "hot node", now=0.0)
+    for r in range(1, 8):
+        mc.reconcile_once(now=float(r))
+        if all(j.phase in ("Succeeded", "Failed")
+               for j in mc.jobs.values()):
+            break
+    assert len(ev.evictions) == len(victims)
+    assert all(j.phase == "Succeeded" for j in mc.jobs.values())
