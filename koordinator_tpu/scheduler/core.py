@@ -84,7 +84,9 @@ class ScheduleResult:
                                              "tie_break", "enable_numa",
                                              "numa_strategy",
                                              "enable_devices",
-                                             "device_strategy"))
+                                             "device_strategy",
+                                             "quota_depth",
+                                             "fit_dims"))
 def schedule_batch(snap: ClusterSnapshot, pods: PodBatch,
                    cfg: loadaware.LoadAwareConfig,
                    num_rounds: int = 4, k_choices: int = 8,
@@ -94,9 +96,17 @@ def schedule_batch(snap: ClusterSnapshot, pods: PodBatch,
                    enable_numa: bool = True,
                    numa_strategy: str = "most",
                    enable_devices: bool = True,
-                   device_strategy: str = "least") -> ScheduleResult:
+                   device_strategy: str = "least",
+                   quota_depth: int = MAX_QUOTA_DEPTH,
+                   fit_dims: tuple = None) -> ScheduleResult:
     """Schedule a pod batch against the snapshot. Pure function; the caller
-    publishes `result.snapshot` as the next version (store.update)."""
+    publishes `result.snapshot` as the next version (store.update).
+
+    `fit_dims`: static tuple of ResourceKind indices the capacity/quota
+    gates check; None = all dims. k8s noderesources.Fit only evaluates the
+    resources a pod requests, so restricting to the union of dims any pod
+    in the workload uses is semantically faithful and skips dead matmul
+    columns (the scatter-commits always update the full R axis)."""
     nodes0, quotas0, gangs0 = snap.nodes, snap.quotas, snap.gangs
     devices0 = snap.devices
     n_nodes = nodes0.num_nodes
@@ -109,6 +119,12 @@ def schedule_batch(snap: ClusterSnapshot, pods: PodBatch,
     n_aux = devices0.aux_free.shape[2]
     use_gpu = enable_devices and n_inst > 0
     use_aux = enable_devices and n_aux > 0
+
+    fd = list(fit_dims) if fit_dims is not None else None
+
+    def dims(x):
+        """Restrict a [..., R] operand to the checked resource dims."""
+        return x if fd is None else x[..., fd]
 
     rank = rank_by_priority(pods)
     # rank[p'] < rank[p], shared by every prefix gate in the commit
@@ -188,8 +204,8 @@ def schedule_batch(snap: ClusterSnapshot, pods: PodBatch,
             prod_assigned_estimated=prod_assigned_est)
 
         # --- feasibility [P, N+V] (HOT LOOP #1) ---
-        fit = jnp.all(pods.requests[:, None, :] + requested[None]
-                      <= ext_alloc[None] + EPS, axis=-1)
+        fit = jnp.all(dims(pods.requests)[:, None, :] + dims(requested)[None]
+                      <= dims(ext_alloc)[None] + EPS, axis=-1)
         feasible = fit & ext_static & active[:, None]
         if n_slots:
             # consumed AllocateOnce slots admit nobody (plugin.go:509-510)
@@ -199,11 +215,11 @@ def schedule_batch(snap: ClusterSnapshot, pods: PodBatch,
         # quota admission (ElasticQuota PreFilter, plugin.go:211-257):
         # used + request <= runtime at every tree level
         quota_admit = jnp.ones((p,), bool)
-        for d in range(MAX_QUOTA_DEPTH):
+        for d in range(quota_depth):
             anc = pod_anc[:, d]
             a = jnp.maximum(anc, 0)
-            level_ok = jnp.all(quota_used[a] + pods.requests
-                               <= quotas0.runtime[a] + EPS, axis=-1)
+            level_ok = jnp.all(dims(quota_used)[a] + dims(pods.requests)
+                               <= dims(quotas0.runtime)[a] + EPS, axis=-1)
             quota_admit &= (anc < 0) | level_ok
         feasible &= quota_admit[:, None]
 
@@ -265,19 +281,19 @@ def schedule_batch(snap: ClusterSnapshot, pods: PodBatch,
             choice_eff = jnp.where(trying, choice, n_ext)
 
             # node/slot capacity prefix in priority order
-            eff_req = jnp.where(trying[:, None], pods.requests, 0.0)
+            eff_req = jnp.where(trying[:, None], dims(pods.requests), 0.0)
             accept = trying & segment_prefix_ok(
-                choice_eff, earlier, eff_req, requested,
-                ext_alloc, n_ext)
+                choice_eff, earlier, eff_req, dims(requested),
+                dims(ext_alloc), n_ext)
 
             # quota prefix per tree level, same trick
-            for d in range(MAX_QUOTA_DEPTH):
+            for d in range(quota_depth):
                 anc = jnp.where(accept, pod_anc[:, d], -1)
                 anc_eff = jnp.where(anc >= 0, anc, n_quotas)
-                acc_req = jnp.where(accept[:, None], pods.requests, 0.0)
+                acc_req = jnp.where(accept[:, None], dims(pods.requests), 0.0)
                 accept &= segment_prefix_ok(
-                    anc_eff, earlier, acc_req, quota_used,
-                    quotas0.runtime, n_quotas)
+                    anc_eff, earlier, acc_req, dims(quota_used),
+                    dims(quotas0.runtime), n_quotas)
 
             # All remaining gates only SHRINK accept; every scatter-commit
             # is deferred until accept is final, so a pod rejected by a
@@ -436,7 +452,7 @@ def schedule_batch(snap: ClusterSnapshot, pods: PodBatch,
                 aux_free = aux_free_flat.reshape(aux_free.shape)
             acc_req = pods.requests * accept[:, None]
             requested = requested.at[choice_eff].add(acc_req, mode="drop")
-            for d in range(MAX_QUOTA_DEPTH):
+            for d in range(quota_depth):
                 anc = jnp.where(accept, pod_anc[:, d], -1)
                 quota_used = quota_used.at[
                     jnp.where(anc >= 0, anc, n_quotas)].add(acc_req,
@@ -518,7 +534,7 @@ def schedule_batch(snap: ClusterSnapshot, pods: PodBatch,
     prod_assigned_est = nodes0.prod_assigned_estimated.at[tgt].add(
         fin_est * is_prod[:, None], mode="drop")
     quota_used = quotas0.used
-    for d in range(MAX_QUOTA_DEPTH):
+    for d in range(quota_depth):
         anc = jnp.where(ok, pod_anc[:, d], -1)
         quota_used = quota_used.at[jnp.where(anc >= 0, anc, n_quotas)].add(
             fin_req, mode="drop")
