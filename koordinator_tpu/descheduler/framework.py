@@ -15,6 +15,7 @@ import time
 from typing import Callable, Dict, List, Optional, Protocol, Sequence
 
 from koordinator_tpu.api import types as api
+from koordinator_tpu.descheduler.metrics_defs import DeschedulerMetrics
 
 
 class Evictor(Protocol):
@@ -69,15 +70,25 @@ class EvictionLimiter:
 class RecordingEvictor:
     """Test/dry-run evictor honoring an EvictionLimiter."""
 
-    def __init__(self, limiter: Optional[EvictionLimiter] = None):
+    def __init__(self, limiter: Optional[EvictionLimiter] = None,
+                 stats: Optional["DeschedulerMetrics"] = None,
+                 strategy: str = ""):
         self.limiter = limiter or EvictionLimiter()
         self.evictions: List[Eviction] = []
+        self.stats = stats
+        self.strategy = strategy
 
     def evict(self, pod: api.Pod, reason: str) -> bool:
         if not self.limiter.allow(pod):
+            if self.stats is not None:
+                self.stats.pods_evicted.labels(
+                    "error", self.strategy, pod.node_name).inc()
             return False
         self.limiter.record(pod)
         self.evictions.append(Eviction(pod, reason, pod.node_name))
+        if self.stats is not None:
+            self.stats.pods_evicted.labels(
+                "success", self.strategy, pod.node_name).inc()
         return True
 
 

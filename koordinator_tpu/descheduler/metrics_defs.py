@@ -1,0 +1,19 @@
+"""descheduler metric series — parity with pkg/descheduler/metrics/
+metrics.go (PodsEvicted and the migration-job counters)."""
+
+from __future__ import annotations
+
+from koordinator_tpu.metrics import Registry, global_registry
+
+
+class DeschedulerMetrics:
+    def __init__(self, registry: Registry = None):
+        r = registry if registry is not None else global_registry()
+        self.pods_evicted = r.counter(
+            "descheduler_pods_evicted",
+            "Evicted pods by result/strategy/node ('error' = eviction "
+            "failed)", labels=("result", "strategy", "node"))
+        self.migration_jobs = r.counter(
+            "descheduler_migration_jobs",
+            "PodMigrationJob transitions by phase",
+            labels=("phase",))

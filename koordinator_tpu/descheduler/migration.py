@@ -29,6 +29,7 @@ from typing import Callable, Dict, List, Mapping, Optional, Sequence
 
 from koordinator_tpu.api import types as api
 from koordinator_tpu.descheduler.framework import Evictor
+from koordinator_tpu.descheduler.metrics_defs import DeschedulerMetrics
 
 
 def _limit(value, replicas: int) -> Optional[int]:
@@ -128,8 +129,10 @@ class MigrationController:
                  release_reservation: Optional[Callable[[str], None]] = None,
                  get_pod: Optional[Callable[[str], Optional[api.Pod]]] = None,
                  unavailable_per_workload: Optional[
-                     Callable[[], Mapping[str, int]]] = None):
+                     Callable[[], Mapping[str, int]]] = None,
+                 stats: Optional["DeschedulerMetrics"] = None):
         self.evictor = evictor
+        self.stats = stats
         self.args = args or MigrationControllerArgs()
         self.arbitrator = Arbitrator(self.args)
         self.reserve = reserve
@@ -140,6 +143,11 @@ class MigrationController:
         self.jobs: Dict[str, api.PodMigrationJob] = {}
         self._created: Dict[str, float] = {}
         self._seq = itertools.count()
+
+    def _phase(self, job: api.PodMigrationJob, phase: str) -> None:
+        job.phase = phase
+        if self.stats is not None:
+            self.stats.migration_jobs.labels(phase).inc()
 
     # -- job intake ----------------------------------------------------------
 
@@ -177,7 +185,7 @@ class MigrationController:
         for job in self.jobs.values():
             if job.phase in ("Pending", "Running") and \
                     now - self._created[job.meta.name] > job.ttl_seconds:
-                job.phase = "Failed"
+                self._phase(job, "Failed")
                 job.reason = "timeout"
                 if job.reservation_name and self.release_reservation:
                     self.release_reservation(job.reservation_name)
@@ -197,12 +205,12 @@ class MigrationController:
         for job in self.arbitrator.sort(pending, pod_of_job, per_wl):
             pod = pod_of_job.get(job.meta.name)
             if pod is None:
-                job.phase = "Failed"
+                self._phase(job, "Failed")
                 job.reason = "pod not found"
                 continue
             if not self.arbitrator.filter(pod, migrating, unavailable):
                 continue  # stays Pending, retried next reconcile
-            job.phase = "Running"
+            self._phase(job, "Running")
             migrating.append(pod)
             if pod.owner_workload:
                 per_wl[pod.owner_workload] = \
@@ -213,13 +221,13 @@ class MigrationController:
         for job in [j for j in self.jobs.values() if j.phase == "Running"]:
             pod = self.get_pod(f"{job.pod_namespace}/{job.pod_name}")
             if pod is None:
-                job.phase = "Succeeded"  # already gone
+                self._phase(job, "Succeeded")  # already gone
                 continue
             if job.reservation_name and self.reservation_available is not None:
                 if not self.reservation_available(job.reservation_name):
                     continue  # wait for replacement capacity
             if self.evictor.evict(pod, job.reason or "migration"):
-                job.phase = "Succeeded"
+                self._phase(job, "Succeeded")
             # else: stays Running, retried (eviction limiter may admit later)
 
     def gc(self) -> None:

@@ -16,8 +16,10 @@ from collections import deque
 from typing import Callable, Optional
 
 from koordinator_tpu.api import types as api
+from koordinator_tpu.api.extension import ResourceKind
 from koordinator_tpu.koordlet import metriccache as mc
 from koordinator_tpu.koordlet.audit import Auditor, NULL_AUDITOR
+from koordinator_tpu.koordlet.metrics_defs import KoordletMetrics
 from koordinator_tpu.koordlet.metricsadvisor import Advisor, default_advisor
 from koordinator_tpu.koordlet.pleg import Pleg
 from koordinator_tpu.koordlet.prediction import PeakPredictServer, PredictConfig
@@ -59,11 +61,13 @@ class Daemon:
 
     def __init__(self, host: Host, cfg: Optional[DaemonConfig] = None,
                  auditor: Auditor = NULL_AUDITOR,
-                 perf_reader: Optional[Callable] = None):
+                 perf_reader: Optional[Callable] = None,
+                 metrics: Optional[KoordletMetrics] = None):
         self.host = host
         self.cfg = cfg or DaemonConfig()
         cfg = self.cfg
         self.auditor = auditor
+        self.metrics = metrics if metrics is not None else KoordletMetrics()
         self.executor = Executor(host, auditor)
         self.metric_cache = mc.MetricCache()
         self.informer = StatesInformer()
@@ -76,10 +80,10 @@ class Daemon:
             self.informer, self.metric_cache,
             PredictConfig(checkpoint_path=cfg.checkpoint_path))
         self.predictor.restore()
-        self.evictor = RecordingEvictor()
+        self.evictor = RecordingEvictor(metrics=self.metrics)
         self.qos: QoSManager = default_qos_manager(
             self.informer, self.metric_cache, self.executor, self.evictor,
-            auditor)
+            auditor, metrics=self.metrics)
         self.hook_server: HookServer = default_hook_server(self.informer)
         self.reconciler = Reconciler(self.informer, self.hook_server,
                                      self.executor)
@@ -92,6 +96,7 @@ class Daemon:
         self._last_qos = 0.0
         self._last_train = 0.0
         self._last_report = 0.0
+        self._started_at: Optional[float] = None
         # bounded: the edge layer consumes reports; keep a short history
         # so a slow consumer never leaks memory in the long-running agent
         self.reports: "deque[api.NodeMetric]" = deque(maxlen=16)
@@ -102,6 +107,7 @@ class Daemon:
         now = time.time() if now is None else now
         self.advisor.collect_once(now)
         self.pleg.poll_once()
+        self._publish_metrics(now)
         report = None
         if now - self._last_train >= self.cfg.predict_train_interval_seconds:
             self.predictor.train_once(now)
@@ -119,7 +125,70 @@ class Daemon:
             self._last_report = now
             if self.cfg.checkpoint_path:
                 self.predictor.checkpoint()
+            if report is not None:
+                node = self.informer.get_node()
+                node_name = node.meta.name if node else ""
+                for kind, v in report.prod_reclaimable.items():
+                    self.metrics.node_predicted_resource_reclaimable.labels(
+                        node_name, "prodPeak", kind.name.lower(),
+                        "").set(float(v))
         return report
+
+    def _publish_metrics(self, now: float) -> None:
+        """Export the latest cache samples as gauge series (the
+        performance/resource-summary collectors' RecordX calls in the
+        reference — here one pass over the TSDB-lite's freshest points,
+        matching the columnar design)."""
+        m = self.metrics
+        node = self.informer.get_node()
+        node_name = node.meta.name if node else ""
+        if self._started_at is None:
+            self._started_at = now
+            m.start_time.labels(node_name).set(now)
+        # the evictor is constructed before the informer knows the node
+        self.evictor.node_name = node_name
+        if node is not None:
+            # canonical units are millicores/MiB; export CPU in cores so
+            # the series divides cleanly by node_used_cpu_cores
+            for kind, unit, scale in ((ResourceKind.CPU, "core", 1e-3),
+                                      (ResourceKind.MEMORY, "MiB", 1.0)):
+                v = node.allocatable.get(kind)
+                if v is not None:
+                    m.node_resource_allocatable.labels(
+                        node_name, kind.name.lower(), unit).set(
+                            float(v) * scale)
+        cpu_cores = self.metric_cache.query(
+            mc.NODE_CPU_USAGE, now - 60, now, agg="latest")
+        if cpu_cores is not None:
+            m.node_used_cpu_cores.labels(node_name).set(float(cpu_cores))
+        # CPI = cycles / instructions per container series
+        cycles = self.metric_cache.query_all(
+            mc.CONTAINER_CPI_CYCLES, now - 60, now, agg="latest")
+        instructions = self.metric_cache.query_all(
+            mc.CONTAINER_CPI_INSTRUCTIONS, now - 60, now, agg="latest")
+        for labels, cyc in cycles.items():
+            ins = instructions.get(labels)
+            lab = dict(labels)
+            if ins:
+                m.container_cpi.labels(
+                    node_name, lab.get("pod_uid", ""),
+                    lab.get("container", ""), "cpi").set(cyc / ins)
+        # PSI per pod (some/avg10 precision, matching psi.go labels);
+        # the cache keys PSI by cgroup dir — resolve to the owning pod's
+        # UID so the series joins against the other pod-labelled series
+        uid_of_cgroup = {meta.cgroup_dir: meta.pod.meta.uid
+                         for meta in self.informer.get_all_pods()}
+        for metric, resource in ((mc.PSI_CPU_SOME_AVG10, "cpu"),
+                                 (mc.PSI_MEM_FULL_AVG10, "mem"),
+                                 (mc.PSI_IO_FULL_AVG10, "io")):
+            for labels, v in self.metric_cache.query_all(
+                    metric, now - 60, now, agg="latest").items():
+                uid = uid_of_cgroup.get(dict(labels).get("cgroup", ""))
+                if uid is None:
+                    continue
+                degree = "full" if "full" in metric else "some"
+                m.pod_psi.labels(node_name, uid, resource,
+                                 "avg10", degree).set(float(v))
 
     def run(self, stop: Callable[[], bool],
             sleep: Callable[[float], None] = time.sleep) -> None:

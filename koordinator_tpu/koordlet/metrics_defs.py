@@ -1,0 +1,78 @@
+"""koordlet metric series — parity with pkg/koordlet/metrics/ (one
+reference file per series group: cpi.go, psi.go, cpu_suppress.go,
+cpu_burst.go, core_sched.go, prediction.go, resource_summary.go,
+common.go).
+
+Label vocabularies follow the reference (NodeKey/PodUID/... in
+common.go); the node label is bound once via `for_node` so call sites
+pass only the varying labels.
+"""
+
+from __future__ import annotations
+
+from koordinator_tpu.metrics import Registry, global_registry
+
+
+class KoordletMetrics:
+    def __init__(self, registry: Registry = None):
+        r = registry if registry is not None else global_registry()
+        self.start_time = r.gauge(
+            "koordlet_start_time",
+            "Unix time the agent started (common.go StartTime)",
+            labels=("node",))
+        # --- performance collector (cpi.go, psi.go) ---
+        self.container_cpi = r.gauge(
+            "koordlet_container_cpi",
+            "Container cycles-per-instruction collected by the perf group "
+            "reader", labels=("node", "pod_uid", "container_id", "field"))
+        self.container_psi = r.gauge(
+            "koordlet_container_psi",
+            "Container pressure-stall information",
+            labels=("node", "pod_uid", "container_id", "resource",
+                    "precision", "degree"))
+        self.pod_psi = r.gauge(
+            "koordlet_pod_psi", "Pod pressure-stall information",
+            labels=("node", "pod_uid", "resource", "precision", "degree"))
+        # --- qos strategies (cpu_suppress.go, cpu_burst.go) ---
+        self.be_suppress_cpu_cores = r.gauge(
+            "koordlet_be_suppress_cpu_cores",
+            "Cores granted to the BE tier by the suppress policy",
+            labels=("node", "type"))  # type: cfsQuota | cpuset
+        self.be_suppress_ls_used_cpu_cores = r.gauge(
+            "koordlet_be_suppress_ls_used_cpu_cores",
+            "Cores the LS tier currently uses as seen by the suppress "
+            "policy", labels=("node",))
+        self.container_scaled_cfs_quota_us = r.gauge(
+            "koordlet_container_scaled_cfs_quota_us",
+            "cfs quota written by the burst strategy",
+            labels=("node", "pod_uid", "container_id"))
+        self.container_scaled_cfs_burst_us = r.gauge(
+            "koordlet_container_scaled_cfs_burst_us",
+            "cfs burst written by the burst strategy",
+            labels=("node", "pod_uid", "container_id"))
+        self.pod_eviction = r.counter(
+            "koordlet_pod_eviction",
+            "Evictions requested by QoS strategies by reason",
+            labels=("node", "reason"))
+        # --- core scheduling (core_sched.go) ---
+        self.container_core_sched_cookie = r.gauge(
+            "koordlet_container_core_sched_cookie",
+            "Core-scheduling cookie assigned to the container",
+            labels=("node", "pod_uid", "container_id", "group"))
+        self.core_sched_cookie_manage_status = r.counter(
+            "koordlet_core_sched_cookie_manage_status",
+            "Cookie assign/clear operations by status",
+            labels=("node", "group", "status"))
+        # --- prediction / node summary (prediction.go, resource_summary.go)
+        self.node_predicted_resource_reclaimable = r.gauge(
+            "koordlet_node_predicted_resource_reclaimable",
+            "Reclaimable resource predicted by the peak predictor",
+            labels=("node", "predictor", "resource", "unit"))
+        self.node_resource_allocatable = r.gauge(
+            "koordlet_node_resource_allocatable",
+            "Node allocatable as reported",
+            labels=("node", "resource", "unit"))
+        self.node_used_cpu_cores = r.gauge(
+            "koordlet_node_used_cpu_cores",
+            "Node CPU usage in cores (resource_summary.go)",
+            labels=("node",))

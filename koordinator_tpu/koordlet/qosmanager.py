@@ -32,6 +32,7 @@ from koordinator_tpu.api import types as api
 from koordinator_tpu.api.extension import QoSClass, ResourceKind
 from koordinator_tpu.koordlet import metriccache as mc
 from koordinator_tpu.koordlet.audit import Auditor, NULL_AUDITOR
+from koordinator_tpu.koordlet.metrics_defs import KoordletMetrics
 from koordinator_tpu.koordlet.resourceexecutor import CgroupUpdate, Executor
 from koordinator_tpu.koordlet.statesinformer import (
     PodMeta,
@@ -60,8 +61,11 @@ class RecordingEvictor:
     server eviction subresource). Deduped by pod uid so a persisting
     condition doesn't grow the queue every reconcile."""
 
-    def __init__(self) -> None:
+    def __init__(self, metrics: Optional[KoordletMetrics] = None,
+                 node_name: str = "") -> None:
         self.evicted: List[Tuple[PodMeta, str]] = []
+        self.metrics = metrics
+        self.node_name = node_name
         self._pending: set = set()
 
     def __call__(self, pod: PodMeta, reason: str) -> None:
@@ -70,6 +74,8 @@ class RecordingEvictor:
             return
         self._pending.add(uid)
         self.evicted.append((pod, reason))
+        if self.metrics is not None:
+            self.metrics.pod_eviction.labels(self.node_name, reason).inc()
 
     def drain(self) -> List[Tuple[PodMeta, str]]:
         out, self.evicted = self.evicted, []
@@ -132,14 +138,18 @@ class CPUSuppress:
     def __init__(self, informer: StatesInformer, cache: mc.MetricCache,
                  executor: Executor,
                  cfg: Optional[CPUSuppressConfig] = None,
-                 auditor: Auditor = NULL_AUDITOR):
+                 auditor: Auditor = NULL_AUDITOR,
+                 metrics: Optional[KoordletMetrics] = None):
         self.informer = informer
         self.cache = cache
         self.executor = executor
         self.cfg = cfg or CPUSuppressConfig()
         self.auditor = auditor
+        self.metrics = metrics
 
-    def _suppress_cores(self, now: float) -> Optional[float]:
+    def _suppress_cores(self, now: float) -> Optional[Tuple[float, float]]:
+        """(suppress cores, LS-tier used cores) or None when disabled/no
+        data."""
         node = self.informer.get_node()
         slo = self.informer.get_node_slo()
         if node is None or slo is None or not slo.threshold.enable:
@@ -155,7 +165,8 @@ class CPUSuppress:
         # suppress(BE) := capacity*SLO% - pod(nonBE).Used - system.Used
         non_be_pod_used = max(0.0, node_used - be_used - sys_used)
         suppress = capacity * threshold / 100.0 - non_be_pod_used - sys_used
-        return max(float(MIN_SUPPRESS_CORES), suppress)
+        return (max(float(MIN_SUPPRESS_CORES), suppress),
+                max(0.0, node_used - be_used))
 
     def _lse_lsr_cpus(self) -> List[int]:
         """CPUs pinned by LSE/LSR pods (read from their cpuset files)."""
@@ -168,9 +179,17 @@ class CPUSuppress:
         return sorted(set(out))
 
     def reconcile(self, now: float) -> None:
-        suppress = self._suppress_cores(now)
-        if suppress is None:
+        computed = self._suppress_cores(now)
+        if computed is None:
             return
+        suppress, ls_used = computed
+        if self.metrics is not None:
+            node = self.informer.get_node()
+            node_name = node.meta.name if node else ""
+            self.metrics.be_suppress_cpu_cores.labels(
+                node_name, self.cfg.policy).set(float(suppress))
+            self.metrics.be_suppress_ls_used_cpu_cores.labels(
+                node_name).set(ls_used)
         host = self.executor.host
         if self.cfg.policy == "cfsQuota":
             quota = int(suppress * CFS_PERIOD_US)
@@ -209,11 +228,24 @@ class CPUBurst:
     name = "cpuburst"
 
     def __init__(self, informer: StatesInformer, cache: mc.MetricCache,
-                 executor: Executor, auditor: Auditor = NULL_AUDITOR):
+                 executor: Executor, auditor: Auditor = NULL_AUDITOR,
+                 metrics: Optional[KoordletMetrics] = None):
         self.informer = informer
         self.cache = cache
         self.executor = executor
         self.auditor = auditor
+        self.metrics = metrics
+
+    def _record(self, meta: PodMeta, file: str, value: float) -> None:
+        if self.metrics is None:
+            return
+        node = self.informer.get_node()
+        node_name = node.meta.name if node else ""
+        gauge = (self.metrics.container_scaled_cfs_burst_us
+                 if file == "cpu.cfs_burst_us"
+                 else self.metrics.container_scaled_cfs_quota_us)
+        gauge.labels(node_name, meta.pod.meta.uid,
+                     os.path.basename(meta.cgroup_dir)).set(value)
 
     def node_state(self, now: float, threshold_percent: float) -> str:
         """Share-pool usage vs threshold (getNodeStateForBurst)."""
@@ -253,6 +285,7 @@ class CPUBurst:
                 self.executor.update(
                     CgroupUpdate(meta.cgroup_dir, "cpu.cfs_burst_us",
                                  str(burst_us)))
+                self._record(meta, "cpu.cfs_burst_us", float(burst_us))
             if policy not in ("cfsQuotaBurstOnly", "auto"):
                 continue
             # throttled-quota scaling, bounded by cfsQuotaBurstPercent
@@ -274,6 +307,7 @@ class CPUBurst:
                 self.executor.update(
                     CgroupUpdate(meta.cgroup_dir, "cpu.cfs_quota_us",
                                  str(new_quota)), cacheable=False)
+                self._record(meta, "cpu.cfs_quota_us", float(new_quota))
                 self.auditor.info(self.name, "scale_quota", meta.cgroup_dir,
                                   f"{quota}->{new_quota} state={state}")
 
@@ -599,12 +633,15 @@ class QoSManager:
 def default_qos_manager(informer: StatesInformer, cache: mc.MetricCache,
                         executor: Executor, evictor: Evictor,
                         auditor: Auditor = NULL_AUDITOR,
-                        feature_gate=None) -> QoSManager:
+                        feature_gate=None,
+                        metrics: Optional[KoordletMetrics] = None) -> QoSManager:
     from koordinator_tpu.features import DEFAULT_FEATURE_GATE
     gate = feature_gate or DEFAULT_FEATURE_GATE
     strategies = [
-        CPUSuppress(informer, cache, executor, auditor=auditor),
-        CPUBurst(informer, cache, executor, auditor=auditor),
+        CPUSuppress(informer, cache, executor, auditor=auditor,
+                    metrics=metrics),
+        CPUBurst(informer, cache, executor, auditor=auditor,
+                 metrics=metrics),
         CPUEvict(informer, cache, executor, evictor, auditor=auditor),
         MemoryEvict(informer, cache, evictor, auditor=auditor),
         ResctrlReconcile(informer, executor, auditor=auditor),

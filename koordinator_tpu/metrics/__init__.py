@@ -1,0 +1,298 @@
+"""Metrics/observability layer: a small Prometheus-style registry shared by
+every component, plus TPU kernel timing helpers.
+
+Capability parity with the reference's per-component registries
+(pkg/koordlet/metrics/ — CPI/PSI/suppress/burst/coresched/prediction
+series; pkg/scheduler/metrics/metrics.go; pkg/slo-controller/metrics/;
+pkg/descheduler/metrics/metrics.go): counters, gauges, histograms with
+labels, and text exposition in the Prometheus scrape format. The reference
+links client_golang; here a ~200-line registry is the idiomatic equivalent
+— the series catalogs live next to each component
+(scheduler/metrics_defs.py, koordlet/metrics_defs.py, ...) exactly like the
+reference's one-file-per-series layout.
+
+TPU addition (SURVEY.md §5 "jax profiler hooks + per-batch kernel
+timing"): `kernel_timer` wraps a jitted call in a
+jax.profiler.TraceAnnotation and records blocked wall time into a
+histogram, so schedule-batch device time shows up as a series alongside
+the control-plane counters.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from contextlib import contextmanager
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+__all__ = [
+    "Counter", "Gauge", "Histogram", "Registry",
+    "global_registry", "kernel_timer",
+]
+
+# classic client_golang default buckets; fine for seconds-scale latencies
+DEFAULT_BUCKETS = (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0,
+                   2.5, 5.0, 10.0)
+
+
+def _validate_labels(names: Sequence[str], values: Sequence[str]) -> Tuple[str, ...]:
+    if len(names) != len(values):
+        raise ValueError(f"expected labels {list(names)}, got {list(values)}")
+    return tuple(str(v) for v in values)
+
+
+class _Metric:
+    """Base: a named family of label-keyed children."""
+
+    kind = "untyped"
+
+    def __init__(self, name: str, help_text: str = "",
+                 labels: Sequence[str] = ()):
+        self.name = name
+        self.help = help_text
+        self.label_names = tuple(labels)
+        self._lock = threading.Lock()
+        self._children: Dict[Tuple[str, ...], float] = {}
+
+    def labels(self, *values: str) -> "_Bound":
+        return _Bound(self, _validate_labels(self.label_names, values))
+
+    def _set(self, key: Tuple[str, ...], value: float) -> None:
+        with self._lock:
+            self._children[key] = value
+
+    def _add(self, key: Tuple[str, ...], delta: float) -> None:
+        with self._lock:
+            self._children[key] = self._children.get(key, 0.0) + delta
+
+    def value(self, *values: str) -> float:
+        key = _validate_labels(self.label_names, values)
+        with self._lock:
+            return self._children.get(key, 0.0)
+
+    def samples(self) -> List[Tuple[str, Tuple[Tuple[str, str], ...], float]]:
+        with self._lock:
+            return [(self.name, tuple(zip(self.label_names, key)), v)
+                    for key, v in sorted(self._children.items())]
+
+    def clear(self) -> None:
+        with self._lock:
+            self._children.clear()
+
+
+class _Bound:
+    """A metric bound to one label vector."""
+
+    def __init__(self, metric: "_Metric", key: Tuple[str, ...]):
+        self._m = metric
+        self._key = key
+
+    def inc(self, delta: float = 1.0) -> None:
+        if delta < 0:
+            raise ValueError("counters only go up")
+        self._m._add(self._key, delta)
+
+    def add(self, delta: float) -> None:
+        self._m._add(self._key, delta)
+
+    def set(self, value: float) -> None:
+        self._m._set(self._key, value)
+
+    def observe(self, value: float) -> None:
+        self._m.observe_key(self._key, value)  # type: ignore[attr-defined]
+
+    def get(self) -> float:
+        with self._m._lock:
+            return self._m._children.get(self._key, 0.0)
+
+
+class Counter(_Metric):
+    kind = "counter"
+
+    def inc(self, delta: float = 1.0) -> None:
+        if self.label_names:
+            raise ValueError(f"{self.name} needs labels()")
+        if delta < 0:
+            raise ValueError("counters only go up")
+        self._add((), delta)
+
+
+class Gauge(_Metric):
+    kind = "gauge"
+
+    def set(self, value: float) -> None:
+        if self.label_names:
+            raise ValueError(f"{self.name} needs labels()")
+        self._set((), value)
+
+    def add(self, delta: float) -> None:
+        if self.label_names:
+            raise ValueError(f"{self.name} needs labels()")
+        self._add((), delta)
+
+
+class Histogram(_Metric):
+    kind = "histogram"
+
+    def __init__(self, name: str, help_text: str = "",
+                 labels: Sequence[str] = (),
+                 buckets: Sequence[float] = DEFAULT_BUCKETS):
+        super().__init__(name, help_text, labels)
+        self.buckets = tuple(sorted(buckets))
+        # per child: [bucket counts..., +Inf count, sum]
+        self._hist: Dict[Tuple[str, ...], List[float]] = {}
+
+    def observe(self, value: float) -> None:
+        if self.label_names:
+            raise ValueError(f"{self.name} needs labels()")
+        self.observe_key((), value)
+
+    def observe_key(self, key: Tuple[str, ...], value: float) -> None:
+        with self._lock:
+            h = self._hist.setdefault(key, [0.0] * (len(self.buckets) + 2))
+            for i, b in enumerate(self.buckets):
+                if value <= b:
+                    h[i] += 1
+            h[len(self.buckets)] += 1       # +Inf / count
+            h[len(self.buckets) + 1] += value  # sum
+
+    def count(self, *values: str) -> float:
+        key = _validate_labels(self.label_names, values)
+        with self._lock:
+            h = self._hist.get(key)
+            return 0.0 if h is None else h[len(self.buckets)]
+
+    def sum(self, *values: str) -> float:
+        key = _validate_labels(self.label_names, values)
+        with self._lock:
+            h = self._hist.get(key)
+            return 0.0 if h is None else h[len(self.buckets) + 1]
+
+    def samples(self) -> List[Tuple[str, Tuple[Tuple[str, str], ...], float]]:
+        out = []
+        with self._lock:
+            for key, h in sorted(self._hist.items()):
+                base = tuple(zip(self.label_names, key))
+                for i, b in enumerate(self.buckets):
+                    out.append((f"{self.name}_bucket",
+                                base + (("le", repr(float(b))),), h[i]))
+                out.append((f"{self.name}_bucket", base + (("le", "+Inf"),),
+                            h[len(self.buckets)]))
+                out.append((f"{self.name}_count", base,
+                            h[len(self.buckets)]))
+                out.append((f"{self.name}_sum", base,
+                            h[len(self.buckets) + 1]))
+        return out
+
+    def clear(self) -> None:
+        with self._lock:
+            self._hist.clear()
+
+
+class Registry:
+    """A named collection of metric families with text exposition."""
+
+    def __init__(self, prefix: str = ""):
+        self.prefix = prefix
+        self._lock = threading.Lock()
+        self._metrics: Dict[str, _Metric] = {}
+
+    def _register(self, metric: _Metric) -> _Metric:
+        with self._lock:
+            existing = self._metrics.get(metric.name)
+            if existing is not None:
+                if type(existing) is not type(metric) or \
+                        existing.label_names != metric.label_names:
+                    raise ValueError(
+                        f"metric {metric.name} re-registered with a "
+                        f"different shape")
+                return existing
+            self._metrics[metric.name] = metric
+            return metric
+
+    def counter(self, name: str, help_text: str = "",
+                labels: Sequence[str] = ()) -> Counter:
+        return self._register(Counter(self._full(name), help_text, labels))  # type: ignore[return-value]
+
+    def gauge(self, name: str, help_text: str = "",
+              labels: Sequence[str] = ()) -> Gauge:
+        return self._register(Gauge(self._full(name), help_text, labels))  # type: ignore[return-value]
+
+    def histogram(self, name: str, help_text: str = "",
+                  labels: Sequence[str] = (),
+                  buckets: Sequence[float] = DEFAULT_BUCKETS) -> Histogram:
+        return self._register(
+            Histogram(self._full(name), help_text, labels, buckets))  # type: ignore[return-value]
+
+    def _full(self, name: str) -> str:
+        return f"{self.prefix}_{name}" if self.prefix else name
+
+    def get(self, name: str) -> Optional[_Metric]:
+        with self._lock:
+            return self._metrics.get(self._full(name))
+
+    def families(self) -> List[_Metric]:
+        with self._lock:
+            return [self._metrics[k] for k in sorted(self._metrics)]
+
+    def expose(self) -> str:
+        """Prometheus text format (the /metrics payload)."""
+        lines: List[str] = []
+        for m in self.families():
+            if m.help:
+                lines.append(f"# HELP {m.name} {m.help}")
+            lines.append(f"# TYPE {m.name} {m.kind}")
+            for name, label_pairs, value in m.samples():
+                if label_pairs:
+                    body = ",".join(f'{k}="{_escape(v)}"'
+                                    for k, v in label_pairs)
+                    lines.append(f"{name}{{{body}}} {_fmt(value)}")
+                else:
+                    lines.append(f"{name} {_fmt(value)}")
+        return "\n".join(lines) + "\n"
+
+    def reset(self) -> None:
+        """Zero every family (test isolation)."""
+        for m in self.families():
+            m.clear()
+
+
+def _escape(v: str) -> str:
+    return str(v).replace("\\", r"\\").replace('"', r'\"').replace("\n", r"\n")
+
+
+def _fmt(v: float) -> str:
+    if v == int(v) and abs(v) < 1e15:
+        return str(int(v))
+    return repr(float(v))
+
+
+_GLOBAL = Registry()
+
+
+def global_registry() -> Registry:
+    """The process-wide registry every component catalog registers into
+    (the reference's prometheus.DefaultRegisterer equivalent); components
+    may still construct private Registries for tests."""
+    return _GLOBAL
+
+
+@contextmanager
+def kernel_timer(histogram: Histogram, annotation: str,
+                 labels: Tuple[str, ...] = ()):
+    """Per-batch kernel timing: annotate the region for the jax profiler
+    (visible in a captured trace) and record blocked wall time.
+
+    The body must block on its device result (e.g. np.asarray of an
+    output) for the recorded time to mean device time; the scheduler's
+    single-readback pattern already does.
+    """
+    import jax.profiler
+
+    key = _validate_labels(histogram.label_names, labels)
+    with jax.profiler.TraceAnnotation(annotation):
+        start = time.perf_counter()
+        try:
+            yield
+        finally:
+            histogram.observe_key(key, time.perf_counter() - start)

@@ -29,7 +29,9 @@ from typing import Callable, Dict, List, Optional
 
 import numpy as np
 
+from koordinator_tpu.metrics import kernel_timer
 from koordinator_tpu.scheduler import core
+from koordinator_tpu.scheduler.metrics_defs import SchedulerMetrics
 from koordinator_tpu.scheduler.plugins.loadaware import LoadAwareConfig
 from koordinator_tpu.snapshot.schema import ClusterSnapshot, PodBatch
 from koordinator_tpu.snapshot.store import SnapshotStore
@@ -40,9 +42,11 @@ log = logging.getLogger(__name__)
 class SchedulerMonitor:
     """Per-batch cycle watchdog."""
 
-    def __init__(self, timeout_seconds: float = 30.0):
+    def __init__(self, timeout_seconds: float = 30.0,
+                 metrics: Optional[SchedulerMetrics] = None):
         self.timeout = timeout_seconds
         self.timeouts = 0
+        self.metrics = metrics
         self._lock = threading.Lock()
         self._inflight: Dict[int, float] = {}
         self._seq = 0
@@ -62,6 +66,8 @@ class SchedulerMonitor:
         elapsed = now - started
         if elapsed > self.timeout:
             self.timeouts += 1
+            if self.metrics is not None:
+                self.metrics.scheduling_timeout.labels("default").inc()
             log.warning("scheduling cycle exceeded %.0fs: %.2fs",
                         self.timeout, elapsed)
         return elapsed
@@ -131,11 +137,17 @@ class DebugFlags:
 
 
 class ServicesServer:
-    """HTTP endpoint: /apis/v1/plugins/<name> summaries + /debug/flags/s."""
+    """HTTP endpoint: /apis/v1/plugins/<name> summaries, /debug/flags/s,
+    and Prometheus-format /metrics exposition."""
 
     def __init__(self, registry: ServiceRegistry, flags: DebugFlags,
-                 host: str = "127.0.0.1", port: int = 0):
+                 host: str = "127.0.0.1", port: int = 0,
+                 metrics_registry=None):
+        if metrics_registry is None:
+            from koordinator_tpu.metrics import global_registry
+            metrics_registry = global_registry()
         registry_ref, flags_ref = registry, flags
+        metrics_ref = metrics_registry
 
         class Handler(http.server.BaseHTTPRequestHandler):
             def log_message(self, *a):  # quiet
@@ -150,6 +162,15 @@ class ServicesServer:
                 self.wfile.write(body)
 
             def do_GET(self):
+                if self.path == "/metrics":
+                    body = metrics_ref.expose().encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type",
+                                     "text/plain; version=0.0.4")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
                 if self.path == "/apis/v1/plugins":
                     self._reply(200, {"plugins": registry_ref.names()})
                     return
@@ -201,10 +222,14 @@ class SchedulerService:
                  monitor: Optional[SchedulerMonitor] = None,
                  flags: Optional[DebugFlags] = None,
                  registry: Optional[ServiceRegistry] = None,
+                 metrics: Optional[SchedulerMetrics] = None,
                  **schedule_kwargs):
         self.store = store or SnapshotStore()
         self.cfg = cfg if cfg is not None else LoadAwareConfig.make()
-        self.monitor = monitor or SchedulerMonitor()
+        self.metrics = metrics if metrics is not None else SchedulerMetrics()
+        self.monitor = monitor or SchedulerMonitor(metrics=self.metrics)
+        if self.monitor.metrics is None:
+            self.monitor.metrics = self.metrics
         self.flags = flags or DebugFlags()
         self.registry = registry or ServiceRegistry()
         self.schedule_kwargs = schedule_kwargs
@@ -226,14 +251,24 @@ class SchedulerService:
         token = self.monitor.start_cycle()
         with self._commit_lock:
             snap = self.store.current()
-            result = core.schedule_batch(snap, pods, self.cfg,
-                                         **self.schedule_kwargs)
-            # single D2H transfer doubles as the completion barrier
-            assignment = np.asarray(result.assignment)
+            with kernel_timer(self.metrics.kernel_seconds,
+                              "koord/schedule_batch"):
+                result = core.schedule_batch(snap, pods, self.cfg,
+                                             **self.schedule_kwargs)
+                # single D2H transfer doubles as the completion barrier
+                # (and makes the kernel timer measure device time)
+                assignment = np.asarray(result.assignment)
             self.store.update(lambda _old: result.snapshot)
         self.last_elapsed = self.monitor.complete_cycle(token)
+        self.metrics.cycle_seconds.observe(self.last_elapsed)
         self.batches += 1
-        self.pods_placed += int((assignment >= 0).sum())
+        valid = np.asarray(pods.valid)
+        placed_n = int(((assignment >= 0) & valid).sum())
+        self.pods_placed += placed_n
+        self.metrics.pods_scheduled.labels("placed").inc(placed_n)
+        self.metrics.pods_scheduled.labels("unschedulable").inc(
+            int(((assignment < 0) & valid).sum()))
+        self.metrics.snapshot_version.set(float(self.store.version))
         if self.flags.score_top_n > 0:
             log.info("score table:\n%s", debug_score_table(
                 snap, pods, self.cfg, self.flags.score_top_n, pod_names))

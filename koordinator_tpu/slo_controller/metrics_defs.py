@@ -1,0 +1,37 @@
+"""slo-controller metric series — parity with pkg/slo-controller/metrics/
+(common.go, metrics.go, node_resource.go)."""
+
+from __future__ import annotations
+
+from koordinator_tpu.metrics import Registry, global_registry
+
+
+class SloControllerMetrics:
+    def __init__(self, registry: Registry = None):
+        r = registry if registry is not None else global_registry()
+        self.nodemetric_reconcile_count = r.counter(
+            "slo_controller_nodemetric_reconcile_count",
+            "NodeMetric reconciliations by status",
+            labels=("status",))
+        self.nodemetric_spec_parse_count = r.counter(
+            "slo_controller_nodemetric_spec_parse_count",
+            "NodeMetric collect-policy config parses by status",
+            labels=("status",))
+        self.nodeslo_reconcile_count = r.counter(
+            "slo_controller_nodeslo_reconcile_count",
+            "NodeSLO reconciliations by status", labels=("status",))
+        self.nodeslo_spec_parse_count = r.counter(
+            "slo_controller_nodeslo_spec_parse_count",
+            "NodeSLO strategy config parses by status", labels=("status",))
+        self.node_resource_reconcile_count = r.counter(
+            "slo_controller_node_resource_reconcile_count",
+            "Node batch/mid resource reconciliations by status",
+            labels=("status",))
+        self.node_resource_run_plugin_status = r.counter(
+            "slo_controller_node_resource_run_plugin_status",
+            "Resource-calculate plugin runs by plugin and status",
+            labels=("plugin", "status"))
+        self.node_extended_resource_allocatable = r.gauge(
+            "slo_controller_node_extended_resource_allocatable_internal",
+            "Extended (batch/mid) allocatable the controller computed",
+            labels=("node", "resource", "unit"))

@@ -15,12 +15,35 @@ from typing import Dict, List, Optional, Sequence
 
 from koordinator_tpu.api import types as api
 from koordinator_tpu.koordlet.statesinformer import CollectPolicy
+from koordinator_tpu.slo_controller.metrics_defs import SloControllerMetrics
 
 
 class NodeMetricController:
-    def __init__(self, policy: Optional[CollectPolicy] = None):
+    def __init__(self, policy: Optional[CollectPolicy] = None,
+                 stats: Optional[SloControllerMetrics] = None):
         self.policy = policy or CollectPolicy()
+        # `metrics` is the NodeMetric CR map; the series catalog is `stats`
+        self.stats = stats if stats is not None else SloControllerMetrics()
         self.metrics: Dict[str, api.NodeMetric] = {}
+
+    def parse_policy(self, metric_aggregate_duration_seconds: float,
+                     metric_report_interval_seconds: float) -> CollectPolicy:
+        """Derive the collect policy from colocation config fields
+        (collect_policy.go getNodeMetricCollectPolicy), counting parse
+        outcomes."""
+        try:
+            if metric_report_interval_seconds <= 0 or \
+                    metric_aggregate_duration_seconds <= 0:
+                raise ValueError("non-positive collect policy interval")
+            policy = CollectPolicy(
+                report_interval_seconds=metric_report_interval_seconds,
+                aggregate_duration_seconds=metric_aggregate_duration_seconds)
+        except Exception:
+            self.stats.nodemetric_spec_parse_count.labels("failed").inc()
+            raise
+        self.stats.nodemetric_spec_parse_count.labels("succeeded").inc()
+        self.policy = policy
+        return policy
 
     def collect_policy(self) -> CollectPolicy:
         """The spec the agents should run with (NodeMetricSpec
@@ -39,6 +62,7 @@ class NodeMetricController:
                 m = self.metrics[node.meta.name] = api.NodeMetric(
                     node_name=node.meta.name)
             m.report_interval_seconds = self.policy.report_interval_seconds
+        self.stats.nodemetric_reconcile_count.labels("succeeded").inc()
         return [self.metrics[n.meta.name] for n in nodes]
 
     def observe_status(self, report: api.NodeMetric) -> None:

@@ -34,6 +34,7 @@ import numpy as np
 from koordinator_tpu.api.extension import PriorityClass, QoSClass, ResourceKind
 from koordinator_tpu.api.types import Node, NodeMetric, Pod
 from koordinator_tpu.slo_controller.config import CalculatePolicy, ColocationStrategy
+from koordinator_tpu.slo_controller.metrics_defs import SloControllerMetrics
 
 # Column order of the 2-dim resource axis used by this module.
 CPU, MEM = 0, 1
@@ -57,6 +58,7 @@ class NodeResourceInputs:
     prod_reclaimable: np.ndarray  # f32[N, 2] prediction (mid tier source)
     metric_age_seconds: np.ndarray  # f32[N] now − NodeMetric.updateTime (inf if none)
     valid: np.ndarray             # bool[N]
+    names: Sequence[str] = ()     # node names (metric labels); "" rows OK
 
 
 def _rl2(rl: Dict[ResourceKind, float]) -> np.ndarray:
@@ -146,7 +148,8 @@ def build_inputs(nodes: Sequence[Node],
         capacity=cap, allocatable=alloc, system_used=sys_used,
         system_reserved=sys_rsvd, hp_request=hp_req, hp_used=hp_used,
         hp_max_used_req=hp_max, prod_reclaimable=reclaim,
-        metric_age_seconds=age, valid=valid)
+        metric_age_seconds=age, valid=valid,
+        names=[n.meta.name for n in nodes])
 
 
 @jax.jit
@@ -248,6 +251,7 @@ class NodeResourceController:
 
     strategy: ColocationStrategy = dataclasses.field(
         default_factory=lambda: ColocationStrategy(enable=True))
+    stats: Optional["SloControllerMetrics"] = None
     _last_batch: Optional[np.ndarray] = None
     _last_mid: Optional[np.ndarray] = None
 
@@ -279,4 +283,16 @@ class NodeResourceController:
             self._last_batch[sync] = out["batch"][sync]
             self._last_mid[sync] = out["mid"][sync]
         out["sync_mask"] = sync & inputs.valid
+        if self.stats is None:
+            self.stats = SloControllerMetrics()
+        self.stats.node_resource_reconcile_count.labels("succeeded").inc()
+        for plugin in ("batchresource", "midresource"):
+            self.stats.node_resource_run_plugin_status.labels(
+                plugin, "succeeded").inc()
+        for i, name in enumerate(inputs.names):
+            if not out["sync_mask"][i]:
+                continue
+            for col, kind in ((CPU, "batch-cpu"), (MEM, "batch-memory")):
+                self.stats.node_extended_resource_allocatable.labels(
+                    name, kind, "").set(float(out["batch"][i, col]))
         return out

@@ -22,6 +22,7 @@ from koordinator_tpu.api.types import (
     ResourceThresholdStrategy,
     SystemStrategy,
 )
+from koordinator_tpu.slo_controller.metrics_defs import SloControllerMetrics
 
 
 @dataclasses.dataclass
@@ -72,20 +73,29 @@ def _merge(base, overrides: List[StrategyOverride],
 
 
 def render_node_slo(cfg: SLOControllerConfig, node_name: str,
-                    node_labels: Optional[Dict[str, str]] = None) -> NodeSLO:
+                    node_labels: Optional[Dict[str, str]] = None,
+                    stats: Optional["SloControllerMetrics"] = None) -> NodeSLO:
     """getNodeSLOSpec equivalent: cluster default + first matching override
     per strategy family."""
     labels = node_labels or {}
-    qos = _merge(cfg.resource_qos, cfg.resource_qos_overrides, labels)
-    qos = dataclasses.replace(
-        qos, tiers={k: dict(v) for k, v in qos.tiers.items()})
-    return NodeSLO(
-        node_name=node_name,
-        threshold=_merge(cfg.threshold, cfg.threshold_overrides, labels),
-        cpu_burst=_merge(cfg.cpu_burst, cfg.cpu_burst_overrides, labels),
-        resource_qos=qos,
-        system=_merge(cfg.system, cfg.system_overrides, labels),
-    )
+    try:
+        qos = _merge(cfg.resource_qos, cfg.resource_qos_overrides, labels)
+        qos = dataclasses.replace(
+            qos, tiers={k: dict(v) for k, v in qos.tiers.items()})
+        slo = NodeSLO(
+            node_name=node_name,
+            threshold=_merge(cfg.threshold, cfg.threshold_overrides, labels),
+            cpu_burst=_merge(cfg.cpu_burst, cfg.cpu_burst_overrides, labels),
+            resource_qos=qos,
+            system=_merge(cfg.system, cfg.system_overrides, labels),
+        )
+    except Exception:
+        if stats is not None:
+            stats.nodeslo_reconcile_count.labels("failed").inc()
+        raise
+    if stats is not None:
+        stats.nodeslo_reconcile_count.labels("succeeded").inc()
+    return slo
 
 
 @dataclasses.dataclass

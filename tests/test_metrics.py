@@ -1,0 +1,334 @@
+"""Metrics layer: registry semantics, text exposition, and the series each
+component emits (reference: pkg/koordlet/metrics/metrics_test.go,
+pkg/slo-controller/metrics/metrics_test.go — assert series values after
+driving the component)."""
+
+import numpy as np
+import pytest
+
+from koordinator_tpu.metrics import (
+    Counter, Gauge, Histogram, Registry, global_registry, kernel_timer,
+)
+
+
+# --- registry core ----------------------------------------------------------
+
+def test_counter_gauge_histogram_basics():
+    r = Registry()
+    c = r.counter("requests", "total requests", labels=("code",))
+    c.labels("200").inc()
+    c.labels("200").inc(2)
+    c.labels("500").inc()
+    assert c.value("200") == 3
+    assert c.value("500") == 1
+    with pytest.raises(ValueError):
+        c.labels("200").inc(-1)
+
+    g = r.gauge("temperature")
+    g.set(42.5)
+    g.add(-2.5)
+    assert g.value() == 40.0
+
+    h = r.histogram("latency", buckets=(0.1, 1.0))
+    h.observe(0.05)
+    h.observe(0.5)
+    h.observe(5.0)
+    assert h.count() == 3
+    assert h.sum() == pytest.approx(5.55)
+
+
+def test_registry_dedupes_and_rejects_shape_change():
+    r = Registry()
+    a = r.counter("x", labels=("l",))
+    b = r.counter("x", labels=("l",))
+    assert a is b
+    with pytest.raises(ValueError):
+        r.counter("x", labels=("other",))
+    with pytest.raises(ValueError):
+        r.gauge("x", labels=("l",))
+
+
+def test_text_exposition_format():
+    r = Registry(prefix="koord")
+    c = r.counter("evictions", "evictions by reason", labels=("reason",))
+    c.labels("memory").inc(3)
+    g = r.gauge("version")
+    g.set(7)
+    h = r.histogram("cycle_seconds", buckets=(1.0,))
+    h.observe(0.5)
+    text = r.expose()
+    assert '# TYPE koord_evictions counter' in text
+    assert 'koord_evictions{reason="memory"} 3' in text
+    assert 'koord_version 7' in text
+    assert 'koord_cycle_seconds_bucket{le="1.0"} 1' in text
+    assert 'koord_cycle_seconds_bucket{le="+Inf"} 1' in text
+    assert 'koord_cycle_seconds_count 1' in text
+    assert 'koord_cycle_seconds_sum 0.5' in text
+
+
+def test_label_escaping():
+    r = Registry()
+    c = r.counter("odd", labels=("v",))
+    c.labels('he said "hi"\n').inc()
+    text = r.expose()
+    assert r'he said \"hi\"\n' in text
+
+
+def test_kernel_timer_records_and_annotates():
+    r = Registry()
+    h = r.histogram("kernel_seconds", labels=("op",))
+    import jax.numpy as jnp
+    with kernel_timer(h, "koord/test_kernel", labels=("matmul",)):
+        x = jnp.ones((128, 128)) @ jnp.ones((128, 128))
+        np.asarray(x)
+    assert h.count("matmul") == 1
+    assert h.sum("matmul") > 0
+
+
+# --- scheduler series -------------------------------------------------------
+
+def test_scheduler_service_emits_series():
+    from koordinator_tpu.scheduler.frameworkext import SchedulerService
+    from koordinator_tpu.scheduler.metrics_defs import SchedulerMetrics
+    from koordinator_tpu.snapshot.store import SnapshotStore
+    from koordinator_tpu.utils import synthetic
+
+    reg = Registry()
+    m = SchedulerMetrics(reg)
+    snap = synthetic.synthetic_cluster(64, num_quotas=0)
+    pods = synthetic.synthetic_pods(32)
+    store = SnapshotStore()
+    store.publish(snap)
+    svc = SchedulerService(store=store, metrics=m, num_rounds=2,
+                           k_choices=4)
+    res = svc.schedule(pods)
+    placed = int((np.asarray(res.assignment) >= 0).sum())
+    assert m.pods_scheduled.value("placed") == placed
+    assert m.pods_scheduled.value("placed") + \
+        m.pods_scheduled.value("unschedulable") == 32
+    assert m.cycle_seconds.count() == 1
+    assert m.kernel_seconds.count() == 1
+    assert m.kernel_seconds.sum() > 0
+    assert m.snapshot_version.value() >= 1
+    # watchdog timeout series exists and is 0 (no slow cycle)
+    assert m.scheduling_timeout.value("default") == 0
+
+
+def test_scheduler_monitor_timeout_series():
+    from koordinator_tpu.scheduler.frameworkext import SchedulerMonitor
+    from koordinator_tpu.scheduler.metrics_defs import SchedulerMetrics
+
+    reg = Registry()
+    m = SchedulerMetrics(reg)
+    mon = SchedulerMonitor(timeout_seconds=1.0, metrics=m)
+    t = mon.start_cycle(now=0.0)
+    mon.complete_cycle(t, now=5.0)
+    assert m.scheduling_timeout.value("default") == 1
+
+
+def test_metrics_http_exposition():
+    import urllib.request
+    from koordinator_tpu.scheduler.frameworkext import (
+        DebugFlags, ServiceRegistry, ServicesServer,
+    )
+
+    reg = Registry()
+    reg.counter("koordlet_pod_eviction", labels=("node", "reason")) \
+        .labels("n0", "memory").inc()
+    srv = ServicesServer(ServiceRegistry(), DebugFlags(),
+                         metrics_registry=reg)
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/metrics") as resp:
+            body = resp.read().decode()
+            assert resp.headers["Content-Type"].startswith("text/plain")
+    finally:
+        srv.close()
+    assert 'koordlet_pod_eviction{node="n0",reason="memory"} 1' in body
+
+
+# --- koordlet series --------------------------------------------------------
+
+@pytest.fixture
+def koordlet_env(tmp_path):
+    from koordinator_tpu.api import types as api
+    from koordinator_tpu.api.extension import ResourceKind
+    from koordinator_tpu.koordlet.agent import Daemon, DaemonConfig
+    from koordinator_tpu.koordlet.metrics_defs import KoordletMetrics
+    from koordinator_tpu.koordlet.statesinformer import PodMeta
+    from koordinator_tpu.koordlet.testing import FakeHost
+
+    host = FakeHost(str(tmp_path), num_cpus=8, mem_bytes=16 << 30)
+    reg = Registry()
+    m = KoordletMetrics(reg)
+    d = Daemon(host, DaemonConfig(qos_interval_seconds=1.0), metrics=m)
+    d.informer.set_node(api.Node(
+        meta=api.ObjectMeta(name="node-a"),
+        allocatable={ResourceKind.CPU: 8000,
+                     ResourceKind.MEMORY: 16 * 1024}))
+    slo = api.NodeSLO(node_name="node-a")
+    slo.threshold.enable = True
+    d.informer.set_node_slo(slo)
+    ls = PodMeta(pod=api.Pod(
+        meta=api.ObjectMeta(name="ls", uid="u1"),
+        requests={ResourceKind.CPU: 2000},
+        limits={ResourceKind.CPU: 2000},
+        qos_label="LS", priority=9500), cgroup_dir="kubepods/podu1")
+    be = PodMeta(pod=api.Pod(
+        meta=api.ObjectMeta(name="be", uid="u2"),
+        requests={ResourceKind.BATCH_CPU: 2000},
+        qos_label="BE", priority=5500),
+        cgroup_dir="kubepods/besteffort/podu2")
+    host.make_cgroup("kubepods/podu1")
+    host.make_cgroup("kubepods/besteffort/podu2")
+    d.informer.set_pods([ls, be])
+    return host, d, m
+
+
+def test_koordlet_node_series(koordlet_env):
+    host, d, m = koordlet_env
+    d.tick(now=0)
+    host.advance_cpu(400, 400)
+    d.tick(now=10)
+    assert m.start_time.value("node-a") == 0
+    assert m.node_resource_allocatable.value("node-a", "cpu", "core") == 8
+    assert m.node_resource_allocatable.value(
+        "node-a", "memory", "MiB") == 16 * 1024
+    assert m.node_used_cpu_cores.value("node-a") > 0
+    # suppress ran (SLO defaults enable threshold) -> BE series present
+    assert m.be_suppress_cpu_cores.value("node-a", "cpuset") >= 1
+
+
+def test_koordlet_eviction_series(koordlet_env):
+    from koordinator_tpu.koordlet.qosmanager import RecordingEvictor
+    _host, d, m = koordlet_env
+    assert isinstance(d.evictor, RecordingEvictor)
+    d.tick(now=0)  # binds the evictor to the node name
+    pods = d.informer.get_all_pods()
+    d.evictor(pods[0], "evictPodsByNodeMemoryUsage")
+    d.evictor(pods[0], "evictPodsByNodeMemoryUsage")  # dedupe
+    assert m.pod_eviction.value(
+        "node-a", "evictPodsByNodeMemoryUsage") == 1
+
+
+def test_koordlet_psi_series(koordlet_env):
+    host, d, m = koordlet_env
+    # through the real collector path: fake kernel PSI -> cache -> series
+    host.set_psi("kubepods/podu1", "cpu", 12.5)
+    d.tick(now=10)
+    # cgroup kubepods/podu1 resolves to the owning pod's UID
+    assert m.pod_psi.value("node-a", "u1", "cpu", "avg10", "some") == 12.5
+
+
+def test_koordlet_cpi_series(koordlet_env):
+    from koordinator_tpu.koordlet import metriccache as mc
+    _host, d, m = koordlet_env
+    labels = {"pod_uid": "u1", "container": "c1"}
+    d.metric_cache.append(mc.CONTAINER_CPI_CYCLES, 9.0, 3000.0, labels)
+    d.metric_cache.append(mc.CONTAINER_CPI_INSTRUCTIONS, 9.0, 1500.0, labels)
+    d.tick(now=10)
+    assert m.container_cpi.value("node-a", "u1", "c1", "cpi") == 2.0
+
+
+# --- slo-controller series --------------------------------------------------
+
+def test_slo_controller_series():
+    from koordinator_tpu.api import types as api
+    from koordinator_tpu.slo_controller.metrics_defs import SloControllerMetrics
+    from koordinator_tpu.slo_controller.nodemetric import NodeMetricController
+    from koordinator_tpu.slo_controller.nodeslo import (
+        SLOControllerConfig, render_node_slo,
+    )
+
+    reg = Registry()
+    stats = SloControllerMetrics(reg)
+    ctrl = NodeMetricController(stats=stats)
+    ctrl.reconcile([api.Node(meta=api.ObjectMeta(name="n0"))])
+    assert stats.nodemetric_reconcile_count.value("succeeded") == 1
+    policy = ctrl.parse_policy(300.0, 30.0)
+    assert policy.report_interval_seconds == 30.0
+    assert stats.nodemetric_spec_parse_count.value("succeeded") == 1
+    with pytest.raises(ValueError):
+        ctrl.parse_policy(300.0, -1.0)
+    assert stats.nodemetric_spec_parse_count.value("failed") == 1
+
+    render_node_slo(SLOControllerConfig(), "n0", stats=stats)
+    assert stats.nodeslo_reconcile_count.value("succeeded") == 1
+
+
+def test_noderesource_series():
+    import numpy as np
+    from koordinator_tpu.slo_controller.metrics_defs import SloControllerMetrics
+    from koordinator_tpu.slo_controller.noderesource import (
+        NodeResourceController, NodeResourceInputs,
+    )
+
+    n = 2
+    z = np.zeros((n, 2), np.float32)
+    inputs = NodeResourceInputs(
+        capacity=np.full((n, 2), 1000.0, np.float32),
+        allocatable=np.full((n, 2), 1000.0, np.float32),
+        system_used=z.copy(), system_reserved=z.copy(),
+        hp_request=np.full((n, 2), 200.0, np.float32),
+        hp_used=np.full((n, 2), 100.0, np.float32),
+        hp_max_used_req=np.full((n, 2), 200.0, np.float32),
+        prod_reclaimable=z.copy(),
+        metric_age_seconds=np.zeros((n,), np.float32),
+        valid=np.ones((n,), bool),
+        names=["n0", "n1"])
+    reg = Registry()
+    stats = SloControllerMetrics(reg)
+    ctrl = NodeResourceController(stats=stats)
+    out = ctrl.reconcile(inputs)
+    assert stats.node_resource_reconcile_count.value("succeeded") == 1
+    assert stats.node_resource_run_plugin_status.value(
+        "batchresource", "succeeded") == 1
+    v = stats.node_extended_resource_allocatable.value("n0", "batch-cpu", "")
+    assert v == float(out["batch"][0, 0])
+
+
+# --- descheduler series -----------------------------------------------------
+
+def test_descheduler_eviction_series():
+    from koordinator_tpu.api import types as api
+    from koordinator_tpu.descheduler.framework import (
+        EvictionLimiter, RecordingEvictor,
+    )
+    from koordinator_tpu.descheduler.metrics_defs import DeschedulerMetrics
+
+    reg = Registry()
+    stats = DeschedulerMetrics(reg)
+    ev = RecordingEvictor(EvictionLimiter(max_per_cycle=1), stats=stats,
+                          strategy="LowNodeLoad")
+    p1 = api.Pod(meta=api.ObjectMeta(name="a", namespace="ns"),
+                 node_name="n0")
+    p2 = api.Pod(meta=api.ObjectMeta(name="b", namespace="ns"),
+                 node_name="n0")
+    assert ev.evict(p1, "hot node")
+    assert not ev.evict(p2, "hot node")  # limiter refuses
+    assert stats.pods_evicted.value("success", "LowNodeLoad", "n0") == 1
+    assert stats.pods_evicted.value("error", "LowNodeLoad", "n0") == 1
+
+
+def test_migration_job_phase_series():
+    from koordinator_tpu.api import types as api
+    from koordinator_tpu.descheduler.framework import RecordingEvictor
+    from koordinator_tpu.descheduler.metrics_defs import DeschedulerMetrics
+    from koordinator_tpu.descheduler.migration import MigrationController
+
+    reg = Registry()
+    stats = DeschedulerMetrics(reg)
+    pod = api.Pod(meta=api.ObjectMeta(name="p", namespace="ns"),
+                  node_name="n0")
+    ctrl = MigrationController(RecordingEvictor(), stats=stats,
+                               get_pod=lambda _k: pod)
+    ctrl.submit_for_pod(pod, reason="rebalance")
+    ctrl.reconcile_once(now=0.0)
+    assert stats.migration_jobs.value("Running") == 1
+    assert stats.migration_jobs.value("Succeeded") == 1
+
+
+def test_global_registry_is_shared():
+    r1 = global_registry()
+    r2 = global_registry()
+    assert r1 is r2
