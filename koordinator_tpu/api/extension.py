@@ -179,6 +179,57 @@ ANNOTATION_NODE_RAW_ALLOCATABLE = NODE_DOMAIN_PREFIX + "/raw-allocatable"
 ANNOTATION_NODE_RESERVATION = NODE_DOMAIN_PREFIX + "/reservation"
 
 
+_KIND_NAMES = {v: k for k, v in RESOURCE_NAMES.items()}
+# tier-translated kinds the webhook erases from the native columns — the
+# ones a runtime (NRI/proxy) can only learn through the annotation
+EXTENDED_KINDS = (ResourceKind.BATCH_CPU, ResourceKind.BATCH_MEMORY,
+                  ResourceKind.MID_CPU, ResourceKind.MID_MEMORY)
+
+
+def encode_extended_resource_spec(requests: Mapping,
+                                  limits: Mapping) -> str:
+    """Pod requests/limits -> the `extended-resource-spec` annotation value
+    (apis/extension ExtendedResourceSpec; written by the webhook's
+    extended-resource mutator, read by the NRI/proxy container contexts —
+    protocol/container_context.go:93-120). Only the extended tiers ride
+    the annotation; empty string when none apply. Container-granular in
+    the reference, pod-granular here like the rest of the agent."""
+    import json as _json
+
+    def pick(rl):
+        return {_KIND_NAMES[k]: float(v) for k, v in rl.items()
+                if k in EXTENDED_KINDS}
+
+    req, lim = pick(requests), pick(limits)
+    if not req and not lim:
+        return ""
+    return _json.dumps({"requests": req, "limits": lim})
+
+
+def parse_extended_resource_spec(annotations: Mapping) -> tuple:
+    """annotation -> (requests, limits) ResourceLists (the NRI/proxy-side
+    GetExtendedResourceSpec); ({}, {}) when absent or malformed."""
+    import json as _json
+
+    raw = annotations.get(ANNOTATION_EXTENDED_RESOURCE_SPEC, "")
+    if not raw:
+        return {}, {}
+    try:
+        spec = _json.loads(raw)
+    except ValueError:
+        return {}, {}
+
+    def pick(d):
+        out = {}
+        for name, v in (d or {}).items():
+            kind = RESOURCE_NAMES.get(name)
+            if kind is not None:
+                out[kind] = float(v)
+        return out
+
+    return pick(spec.get("requests")), pick(spec.get("limits"))
+
+
 def translate_resource_by_priority(kind: ResourceKind,
                                    priority_class: PriorityClass) -> ResourceKind:
     """Map cpu/memory to the priority tier's extended resource.

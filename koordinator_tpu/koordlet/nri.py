@@ -1,0 +1,213 @@
+"""NRI delivery mode: the runtime pushes container-lifecycle events, the
+plugin answers with OCI adjustments computed by the SAME hook plugins the
+proxy server and the reconciler use.
+
+Capability parity with pkg/koordlet/runtimehooks/nri/server.go:26,68-89
+(the containerd ≥1.7 path that supersedes the standalone runtime proxy):
+- Configure: negotiate the event mask (RunPodSandbox, CreateContainer,
+  UpdateContainer — server.go `events`).
+- Synchronize: existing pods/containers at plugin (re)start; answered
+  with updates so drifted containers converge without waiting for the
+  reconciler.
+- RunPodSandbox: pod-level hooks run and their cgroup writes are applied
+  DIRECTLY through the executor (podCtx.NriDone(executor) — the sandbox
+  cgroup exists by the time the event fires, and NRI has no pod-level
+  adjustment payload).
+- CreateContainer: container hooks run; cgroup updates + env fold into a
+  ContainerAdjustment the runtime applies to the OCI spec.
+- UpdateContainer: hooks run; folded into a ContainerUpdate.
+
+The wire is the repo's framed unix-socket RPC (the runtime side is an
+RpcClient; tests drive a FakeNriRuntime) instead of containerd's ttRPC
+stub — same events, same payload semantics, no containerd dependency.
+Like the reference (koordlet.go tolerates NRI start failure), a missing
+socket degrades to the reconciler-only mode.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from koordinator_tpu.api import types as api
+from koordinator_tpu.api.extension import (
+    LABEL_POD_QOS,
+    parse_extended_resource_spec,
+)
+from koordinator_tpu.koordlet import nri_pb2 as pb
+from koordinator_tpu.koordlet.resourceexecutor import Executor
+from koordinator_tpu.koordlet.runtimehooks import (
+    HookContext,
+    HookServer,
+    Stage,
+)
+from koordinator_tpu.koordlet.statesinformer import PodMeta
+from koordinator_tpu.runtimeproxy.rpc import RpcServer
+
+EVENTS = ("RunPodSandbox", "CreateContainer", "UpdateContainer")
+
+# failure policies (runtimeproxy/config; nri server Options)
+POLICY_IGNORE = "Ignore"
+POLICY_FAIL = "Fail"
+
+_TYPED_FIELDS = {
+    "cpu.shares": "cpu_shares",
+    "cpu.cfs_quota_us": "cpu_quota",
+    "cpu.cfs_period_us": "cpu_period",
+}
+
+
+def _pod_meta(pod: pb.NriPodSandbox) -> PodMeta:
+    annotations = dict(pod.annotations)
+    # NRI carries no pod spec; the webhook-written extended-resource-spec
+    # annotation is the only source of batch/mid requests
+    # (container_context.go FromNri -> GetExtendedResourceSpec)
+    requests, limits = parse_extended_resource_spec(annotations)
+    p = api.Pod(meta=api.ObjectMeta(name=pod.name, namespace=pod.namespace,
+                                    uid=pod.uid, labels=dict(pod.labels),
+                                    annotations=annotations),
+                requests=requests, limits=limits,
+                qos_label=dict(pod.labels).get(LABEL_POD_QOS, ""))
+    return PodMeta(pod=p, cgroup_dir=pod.cgroup_parent or "")
+
+
+def _fold_resources(ctx: HookContext, res: pb.NriLinuxResources) -> None:
+    """Hook cgroup updates -> NRI resource fields (ContainerAdjustment
+    semantics: typed knobs where NRI has them, `unified` for the rest)."""
+    for upd in ctx.cgroup_updates:
+        field = _TYPED_FIELDS.get(upd.resource)
+        if field is not None:
+            try:
+                setattr(res, field, int(float(upd.value)))
+                continue
+            except ValueError:
+                pass
+        if upd.resource == "cpuset.cpus":
+            res.cpuset_cpus = upd.value
+        elif upd.resource == "cpuset.mems":
+            res.cpuset_mems = upd.value
+        elif upd.resource == "memory.limit_in_bytes":
+            res.memory_limit = int(float(upd.value))
+        else:
+            res.unified[upd.resource] = upd.value
+
+
+class NriServer:
+    """The plugin-side event handler (NriServer in server.go)."""
+
+    def __init__(self, hook_server: HookServer, executor: Executor,
+                 failure_policy: str = POLICY_IGNORE,
+                 events: tuple = EVENTS):
+        self.hook_server = hook_server
+        self.executor = executor
+        self.failure_policy = failure_policy
+        self.events = list(events)
+
+    # -- events --------------------------------------------------------------
+
+    def configure(self, req: pb.NriConfigureRequest
+                  ) -> pb.NriConfigureResponse:
+        """Negotiate the event mask; an empty runtime config keeps the
+        default subscription (server.go Configure)."""
+        resp = pb.NriConfigureResponse()
+        events = self.events
+        if req.config:
+            import json
+            try:
+                cfg = json.loads(req.config)
+                events = list(cfg.get("events", events)) or events
+            except ValueError:
+                pass  # malformed runtime config keeps defaults
+        resp.events.extend(events)
+        return resp
+
+    def synchronize(self, req: pb.NriSynchronizeRequest
+                    ) -> pb.NriSynchronizeResponse:
+        """Re-derive hook output for every existing container so state
+        converges on plugin restart."""
+        pods = {p.id: p for p in req.pods}
+        resp = pb.NriSynchronizeResponse()
+        for c in req.containers:
+            pod = pods.get(c.pod_sandbox_id)
+            if pod is None:
+                continue
+            ctx = self._run(Stage.PRE_UPDATE_CONTAINER, pod, c.name)
+            if ctx is None or not ctx.cgroup_updates:
+                continue
+            upd = resp.updates.add()
+            upd.container_id = c.id
+            _fold_resources(ctx, upd.resources)
+        return resp
+
+    def run_pod_sandbox(self, req: pb.NriRunPodSandboxRequest) -> pb.NriEmpty:
+        ctx = self._run(Stage.PRE_RUN_POD_SANDBOX, req.pod)
+        if ctx is not None and ctx.cgroup_updates:
+            # NriDone: pod-level writes go straight through the executor
+            self.executor.leveled_update_batch(ctx.cgroup_updates)
+        return pb.NriEmpty()
+
+    def create_container(self, req: pb.NriCreateContainerRequest
+                         ) -> pb.NriCreateContainerResponse:
+        resp = pb.NriCreateContainerResponse()
+        ctx = self._run(Stage.PRE_CREATE_CONTAINER, req.pod,
+                        req.container.name)
+        if ctx is not None:
+            for k, v in ctx.env.items():
+                resp.adjustment.env[k] = v
+            _fold_resources(ctx, resp.adjustment.resources)
+        return resp
+
+    def update_container(self, req: pb.NriUpdateContainerRequest
+                         ) -> pb.NriUpdateContainerResponse:
+        resp = pb.NriUpdateContainerResponse()
+        ctx = self._run(Stage.PRE_UPDATE_CONTAINER, req.pod,
+                        req.container.name)
+        if ctx is not None and ctx.cgroup_updates:
+            upd = resp.updates.add()
+            upd.container_id = req.container.id
+            _fold_resources(ctx, upd.resources)
+        return resp
+
+    def _run(self, stage: Stage, pod: pb.NriPodSandbox,
+             container_name: str = "") -> Optional[HookContext]:
+        ctx = HookContext(pod=_pod_meta(pod), stage=stage,
+                          container_name=container_name)
+        try:
+            self.hook_server.run_hooks(stage, ctx)
+        except Exception:
+            # PluginFailurePolicy (server.go): Fail surfaces the error to
+            # the runtime (aborting the operation), Ignore drops the
+            # adjustment and lets the container start untouched
+            if self.failure_policy == POLICY_FAIL:
+                raise
+            return None
+        return ctx
+
+    # -- serving -------------------------------------------------------------
+
+    def serve(self, sock_path: str) -> RpcServer:
+        return RpcServer(sock_path, {
+            "Configure": (pb.NriConfigureRequest, self.configure),
+            "Synchronize": (pb.NriSynchronizeRequest, self.synchronize),
+            "RunPodSandbox": (pb.NriRunPodSandboxRequest,
+                              self.run_pod_sandbox),
+            "CreateContainer": (pb.NriCreateContainerRequest,
+                                self.create_container),
+            "UpdateContainer": (pb.NriUpdateContainerRequest,
+                                self.update_container),
+        })
+
+
+def pod_to_nri(meta: PodMeta, pod_id: str = "") -> pb.NriPodSandbox:
+    """Typed PodMeta -> wire sandbox (the runtime side's view; used by the
+    fake runtime and any in-process event source)."""
+    pod = pb.NriPodSandbox(
+        id=pod_id or meta.pod.meta.uid, name=meta.pod.meta.name,
+        namespace=meta.pod.meta.namespace, uid=meta.pod.meta.uid,
+        cgroup_parent=meta.cgroup_dir)
+    for k, v in meta.pod.meta.labels.items():
+        pod.labels[k] = v
+    for k, v in meta.pod.meta.annotations.items():
+        pod.annotations[k] = v
+    if meta.pod.qos_label:
+        pod.labels[LABEL_POD_QOS] = meta.pod.qos_label
+    return pod

@@ -16,7 +16,10 @@ from __future__ import annotations
 from typing import Dict, Optional
 
 from koordinator_tpu.api import types as api
-from koordinator_tpu.api.extension import LABEL_POD_QOS
+from koordinator_tpu.api.extension import (
+    LABEL_POD_QOS,
+    parse_extended_resource_spec,
+)
 from koordinator_tpu.koordlet.runtimehooks import HookContext, HookServer, Stage
 from koordinator_tpu.koordlet.statesinformer import PodMeta
 from koordinator_tpu.runtimeproxy import api_pb2 as pb
@@ -48,9 +51,15 @@ _CONTAINER_STAGES = {
 def _pod_meta(name: str, namespace: str, uid: str,
               labels: Dict[str, str], annotations: Dict[str, str],
               cgroup_parent: str) -> PodMeta:
+    annotations = dict(annotations)
+    # wire requests have no pod spec; batch/mid tiers arrive through the
+    # webhook-written extended-resource-spec annotation
+    # (container_context.go FromProxy -> GetExtendedResourceSpec)
+    requests, limits = parse_extended_resource_spec(annotations)
     pod = api.Pod(meta=api.ObjectMeta(name=name, namespace=namespace,
                                       uid=uid, labels=dict(labels),
-                                      annotations=dict(annotations)),
+                                      annotations=annotations),
+                  requests=requests, limits=limits,
                   qos_label=labels.get(LABEL_POD_QOS, ""))
     return PodMeta(pod=pod, cgroup_dir=cgroup_parent or "")
 

@@ -2,11 +2,16 @@
 
 Capability parity with `pkg/koordlet/runtimehooks/` (SURVEY.md 2.2, 3.4):
 hook plugins mutate a protocol context (cgroup writes + env/device
-injection) at sandbox/container lifecycle stages; delivery is either
-event-driven — the edge layer (NRI/proxy equivalent, edge/service.py)
-calls `run_hooks(stage, ctx)` and applies the returned adjustments — or
-the **reconciler fallback** that level-walks every known pod cgroup and
-re-applies the same rules directly (reconciler/reconciler.go:34-54).
+injection) at sandbox/container lifecycle stages. Three delivery modes
+share these plugins, matching the reference:
+1. **NRI events** (koordlet/nri.py — nri/server.go): the runtime pushes
+   RunPodSandbox/CreateContainer/UpdateContainer and applies the
+   returned OCI adjustments,
+2. **proxy mode** (koordlet/proxyserver.py — proxyserver/server.go): the
+   CRI-interposing runtime proxy calls the hook service around CRI ops,
+3. **reconciler fallback** (below) that level-walks every known pod
+   cgroup and re-applies the same rules directly
+   (reconciler/reconciler.go:34-54).
 
 Plugins (hooks/):
 - **groupidentity**: per-QoS `cpu.bvt_warp_ns` (bvt.go),
@@ -138,7 +143,10 @@ class BatchResourceHook:
     period, memory.limit = batch-memory)."""
 
     name = "batchresource"
-    stages = (Stage.PRE_RUN_POD_SANDBOX, Stage.PRE_UPDATE_CONTAINER)
+    # pod level at sandbox start, container level at create/update
+    # (batch_resource.go:62-64 registers all three)
+    stages = (Stage.PRE_RUN_POD_SANDBOX, Stage.PRE_CREATE_CONTAINER,
+              Stage.PRE_UPDATE_CONTAINER)
 
     def apply(self, ctx: HookContext) -> None:
         pod = ctx.pod.pod

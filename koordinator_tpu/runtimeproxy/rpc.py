@@ -85,11 +85,26 @@ class RpcServer:
 
         # a crashed/restarted server leaves the socket file behind and
         # AF_UNIX bind() fails on it (allow_reuse_address is a no-op for
-        # unix sockets) — unlink the stale path so restart always works
-        try:
-            os.unlink(sock_path)
-        except FileNotFoundError:
-            pass
+        # unix sockets) — but only unlink a DEAD socket: if a live server
+        # still answers connect(), stealing its path would leave it
+        # serving an unreachable unlinked inode
+        if os.path.exists(sock_path):
+            probe = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+            probe.settimeout(0.2)
+            try:
+                probe.connect(sock_path)
+                alive = True
+            except OSError:
+                alive = False
+            finally:
+                probe.close()
+            if alive:
+                raise RpcError(
+                    f"socket {sock_path!r} is in use by a live server")
+            try:
+                os.unlink(sock_path)
+            except FileNotFoundError:
+                pass
         self._server = Server(sock_path, Handler)
         self._thread = threading.Thread(target=self._server.serve_forever,
                                         daemon=True)

@@ -27,8 +27,10 @@ from typing import Callable, Dict, Mapping, Optional, Sequence
 
 from koordinator_tpu.api import types as api
 from koordinator_tpu.api.extension import (
+    ANNOTATION_EXTENDED_RESOURCE_SPEC,
     PriorityClass,
     ResourceKind,
+    encode_extended_resource_spec,
     priority_class_of,
     selector_matches,
     translate_resource_by_priority,
@@ -162,4 +164,11 @@ class PodMutator:
             if target in pod.limits and target not in pod.requests:
                 pod.requests[target] = pod.limits[target]
                 changed = True
+        if changed:
+            # the runtime-facing copy of the translated tiers: NRI/proxy
+            # contexts have no pod spec, only annotations
+            # (container_context.go:93-120 reads this back)
+            spec = encode_extended_resource_spec(pod.requests, pod.limits)
+            if spec:
+                pod.meta.annotations[ANNOTATION_EXTENDED_RESOURCE_SPEC] = spec
         return changed

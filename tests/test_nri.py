@@ -1,0 +1,284 @@
+"""NRI delivery mode: event flow over a REAL unix socket, adjustment
+semantics, failure policies, and the three-delivery-modes equivalence —
+the same hook plugins produce the same cgroup state whether delivered via
+NRI events, the runtime proxy, or the reconciler level-walk (reference:
+nri/server.go:26,68-89; runtimehooks has one rule set, three transports).
+"""
+
+import json
+
+import pytest
+
+from koordinator_tpu.api import types as api
+from koordinator_tpu.api.extension import (
+    ANNOTATION_RESOURCE_STATUS,
+    LABEL_POD_QOS,
+    ResourceKind as RK,
+)
+from koordinator_tpu.koordlet import nri_pb2 as pb
+from koordinator_tpu.koordlet.nri import (
+    EVENTS,
+    NriServer,
+    POLICY_FAIL,
+    POLICY_IGNORE,
+    pod_to_nri,
+)
+from koordinator_tpu.koordlet.resourceexecutor import Executor
+from koordinator_tpu.koordlet.runtimehooks import (
+    HookContext,
+    HookServer,
+    Reconciler,
+    Stage,
+    default_hook_server,
+)
+from koordinator_tpu.koordlet.statesinformer import PodMeta, StatesInformer
+from koordinator_tpu.koordlet.testing import FakeHost
+from koordinator_tpu.runtimeproxy.rpc import RpcClient
+
+
+def make_pod(uid, qos="BE", annotations=None, cgroup_dir=None):
+    return PodMeta(pod=api.Pod(
+        meta=api.ObjectMeta(uid=uid, name=uid, namespace="default",
+                            labels={LABEL_POD_QOS: qos},
+                            annotations=annotations or {}),
+        requests={RK.BATCH_CPU: 2000.0, RK.BATCH_MEMORY: 1024.0},
+        limits={RK.BATCH_CPU: 2000.0, RK.BATCH_MEMORY: 1024.0},
+        qos_label=qos, priority=5500),
+        cgroup_dir=cgroup_dir or f"kubepods/besteffort/pod{uid}")
+
+
+@pytest.fixture
+def env(tmp_path):
+    host = FakeHost(str(tmp_path), num_cpus=8)
+    informer = StatesInformer()
+    executor = Executor(host)
+    hooks = default_hook_server(informer)
+    server = NriServer(hooks, executor)
+    return host, informer, executor, hooks, server
+
+
+def test_configure_negotiates_event_mask(env):
+    *_, server = env
+    resp = server.configure(pb.NriConfigureRequest(
+        runtime_name="containerd", runtime_version="1.7"))
+    assert list(resp.events) == list(EVENTS)
+    # runtime narrows the subscription
+    resp = server.configure(pb.NriConfigureRequest(
+        config=json.dumps({"events": ["RunPodSandbox"]})))
+    assert list(resp.events) == ["RunPodSandbox"]
+    # malformed config keeps defaults
+    resp = server.configure(pb.NriConfigureRequest(config="not json"))
+    assert list(resp.events) == list(EVENTS)
+
+
+def test_run_pod_sandbox_applies_pod_cgroup_writes(env):
+    host, _informer, _executor, _hooks, server = env
+    meta = make_pod("u1")
+    host.make_cgroup(meta.cgroup_dir)
+    server.run_pod_sandbox(pb.NriRunPodSandboxRequest(pod=pod_to_nri(meta)))
+    # groupidentity wrote bvt for the BE pod directly (NriDone path)
+    assert host.read_cgroup(meta.cgroup_dir, "cpu.bvt_warp_ns") == "-1"
+
+
+def test_create_container_returns_adjustment(env):
+    host, _informer, _executor, _hooks, server = env
+    meta = make_pod("u2", annotations={
+        ANNOTATION_RESOURCE_STATUS: json.dumps(
+            {"cpuset": "2-3", "numaNodes": [0]})})
+    resp = server.create_container(pb.NriCreateContainerRequest(
+        pod=pod_to_nri(meta),
+        container=pb.NriContainer(id="c1", name="main")))
+    adj = resp.adjustment
+    assert adj.resources.cpuset_cpus == "2-3"
+    assert adj.resources.cpuset_mems == "0"
+    # batchresource: 2000m -> shares 2048, quota 200000, memory 1GiB
+    assert adj.resources.cpu_shares == 2048
+    assert adj.resources.cpu_quota == 200000
+    assert adj.resources.memory_limit == 1024 << 20
+    # nothing written host-side: the runtime owns applying the adjustment
+    assert _try_read(host, meta.cgroup_dir, "cpuset.cpus") is None
+
+
+def test_update_container_returns_update(env):
+    *_, server = env
+    meta = make_pod("u3")
+    resp = server.update_container(pb.NriUpdateContainerRequest(
+        pod=pod_to_nri(meta),
+        container=pb.NriContainer(id="c9", name="main")))
+    assert len(resp.updates) == 1
+    assert resp.updates[0].container_id == "c9"
+    assert resp.updates[0].resources.cpu_shares == 2048
+
+
+def test_synchronize_converges_existing_containers(env):
+    *_, server = env
+    meta = make_pod("u4")
+    req = pb.NriSynchronizeRequest()
+    req.pods.append(pod_to_nri(meta, pod_id="sb4"))
+    req.containers.append(pb.NriContainer(
+        id="c4", name="main", pod_sandbox_id="sb4"))
+    # a container of an unknown sandbox is skipped
+    req.containers.append(pb.NriContainer(
+        id="orphan", name="x", pod_sandbox_id="nope"))
+    resp = server.synchronize(req)
+    assert [u.container_id for u in resp.updates] == ["c4"]
+
+
+def test_failure_policy(env):
+    host, _informer, executor, _hooks, _server = env
+
+    class BoomHook:
+        name = "boom"
+        stages = (Stage.PRE_CREATE_CONTAINER,)
+
+        def apply(self, ctx: HookContext) -> None:
+            raise RuntimeError("boom")
+
+    meta = make_pod("u5")
+    req = pb.NriCreateContainerRequest(pod=pod_to_nri(meta),
+                                       container=pb.NriContainer(id="c"))
+    ignore = NriServer(HookServer([BoomHook()]), executor,
+                       failure_policy=POLICY_IGNORE)
+    resp = ignore.create_container(req)  # swallowed, empty adjustment
+    assert not resp.adjustment.env and not resp.adjustment.resources.unified
+
+    fail = NriServer(HookServer([BoomHook()]), executor,
+                     failure_policy=POLICY_FAIL)
+    with pytest.raises(RuntimeError):
+        fail.create_container(req)
+
+
+def test_nri_over_real_socket(env, tmp_path):
+    host, _informer, _executor, _hooks, server = env
+    sock = str(tmp_path / "nri.sock")
+    rpc = server.serve(sock)
+    try:
+        client = RpcClient(sock)
+        resp = client.call("Configure", pb.NriConfigureRequest(),
+                           pb.NriConfigureResponse)
+        assert "CreateContainer" in list(resp.events)
+        meta = make_pod("u6")
+        host.make_cgroup(meta.cgroup_dir)
+        client.call("RunPodSandbox",
+                    pb.NriRunPodSandboxRequest(pod=pod_to_nri(meta)),
+                    pb.NriEmpty)
+        assert host.read_cgroup(meta.cgroup_dir, "cpu.bvt_warp_ns") == "-1"
+        resp = client.call(
+            "CreateContainer",
+            pb.NriCreateContainerRequest(pod=pod_to_nri(meta),
+                                         container=pb.NriContainer(id="c")),
+            pb.NriCreateContainerResponse)
+        assert resp.adjustment.resources.cpu_shares == 2048
+    finally:
+        rpc.close()
+
+
+# --- the three delivery modes produce identical cgroup state ---------------
+
+def _try_read(host, cgroup_dir, resource):
+    try:
+        return host.read_cgroup(cgroup_dir, resource)
+    except (FileNotFoundError, KeyError):
+        return None
+
+
+def _apply_nri_resources(host, cgroup_dir, res: pb.NriLinuxResources) -> None:
+    """The runtime side of NRI: fold an adjustment into cgroup files (what
+    containerd does with a ContainerAdjustment)."""
+    if res.cpu_shares:
+        host.write_cgroup(cgroup_dir, "cpu.shares", str(res.cpu_shares))
+    if res.cpu_quota:
+        host.write_cgroup(cgroup_dir, "cpu.cfs_quota_us", str(res.cpu_quota))
+    if res.cpuset_cpus:
+        host.write_cgroup(cgroup_dir, "cpuset.cpus", res.cpuset_cpus)
+    if res.cpuset_mems:
+        host.write_cgroup(cgroup_dir, "cpuset.mems", res.cpuset_mems)
+    if res.memory_limit:
+        host.write_cgroup(cgroup_dir, "memory.limit_in_bytes",
+                          str(res.memory_limit))
+    for k, v in res.unified.items():
+        host.write_cgroup(cgroup_dir, k, v)
+
+
+FILES = ("cpu.bvt_warp_ns", "cpu.shares", "cpu.cfs_quota_us",
+         "memory.limit_in_bytes", "cpuset.cpus")
+
+
+def _read_state(host, cgroup_dir):
+    return {f: _try_read(host, cgroup_dir, f) for f in FILES}
+
+
+def test_three_delivery_modes_converge(tmp_path):
+    """One pod, three transports, identical cgroup end state."""
+    pod_annotations = {ANNOTATION_RESOURCE_STATUS: json.dumps(
+        {"cpuset": "4-5", "numaNodes": [1]})}
+    states = {}
+    for mode in ("nri", "proxy", "reconciler"):
+        host = FakeHost(str(tmp_path / mode), num_cpus=8)
+        informer = StatesInformer()
+        executor = Executor(host)
+        hooks = default_hook_server(informer)
+        meta = make_pod("p1", annotations=pod_annotations)
+        host.make_cgroup(meta.cgroup_dir)
+
+        if mode == "nri":
+            server = NriServer(hooks, executor)
+            server.run_pod_sandbox(
+                pb.NriRunPodSandboxRequest(pod=pod_to_nri(meta)))
+            resp = server.create_container(pb.NriCreateContainerRequest(
+                pod=pod_to_nri(meta), container=pb.NriContainer(id="c")))
+            _apply_nri_resources(host, meta.cgroup_dir,
+                                 resp.adjustment.resources)
+        elif mode == "proxy":
+            from koordinator_tpu.koordlet.proxyserver import ProxyHookService
+            from koordinator_tpu.runtimeproxy import api_pb2 as ppb
+            svc = ProxyHookService(hooks)
+            req = ppb.PodSandboxHookRequest(cgroup_parent=meta.cgroup_dir)
+            req.pod_meta.name = meta.pod.meta.name
+            req.pod_meta.uid = meta.pod.meta.uid
+            for k, v in meta.pod.meta.labels.items():
+                req.labels[k] = v
+            for k, v in meta.pod.meta.annotations.items():
+                req.annotations[k] = v
+            sresp = svc._pod_hook("PreRunPodSandboxHook", req)
+            # the proxy merges resources into the CRI request; the runtime
+            # then realizes them as cgroup writes
+            creq = ppb.ContainerResourceHookRequest(
+                pod_cgroup_parent=meta.cgroup_dir)
+            creq.pod_meta.name = meta.pod.meta.name
+            creq.pod_meta.uid = meta.pod.meta.uid
+            for k, v in meta.pod.meta.labels.items():
+                creq.pod_labels[k] = v
+            for k, v in meta.pod.meta.annotations.items():
+                creq.pod_annotations[k] = v
+            cresp = svc._container_hook("PreCreateContainerHook", creq)
+            for r in (sresp.resources, cresp.container_resources):
+                if r.cpu_shares:
+                    host.write_cgroup(meta.cgroup_dir, "cpu.shares",
+                                      str(r.cpu_shares))
+                if r.cpu_quota:
+                    host.write_cgroup(meta.cgroup_dir, "cpu.cfs_quota_us",
+                                      str(r.cpu_quota))
+                if r.cpuset_cpus:
+                    host.write_cgroup(meta.cgroup_dir, "cpuset.cpus",
+                                      r.cpuset_cpus)
+                if r.cpuset_mems:
+                    host.write_cgroup(meta.cgroup_dir, "cpuset.mems",
+                                      r.cpuset_mems)
+                if r.memory_limit_in_bytes:
+                    host.write_cgroup(meta.cgroup_dir,
+                                      "memory.limit_in_bytes",
+                                      str(r.memory_limit_in_bytes))
+                for k, v in r.unified.items():
+                    host.write_cgroup(meta.cgroup_dir, k, v)
+        else:
+            informer.set_pods([meta])
+            Reconciler(informer, hooks, executor).reconcile_all()
+
+        states[mode] = _read_state(host, meta.cgroup_dir)
+
+    assert states["nri"] == states["proxy"] == states["reconciler"]
+    # and the state is the hooks' output, not vacuously all-None
+    assert states["nri"]["cpu.bvt_warp_ns"] == "-1"
+    assert states["nri"]["cpu.shares"] == "2048"
+    assert states["nri"]["cpuset.cpus"] == "4-5"
