@@ -30,7 +30,9 @@ from typing import Dict, List, Optional
 
 from koordinator_tpu.api import types as api
 from koordinator_tpu.api.extension import (
+    ANNOTATION_EXTENDED_RESOURCE_SPEC,
     LABEL_POD_QOS,
+    encode_extended_resource_spec,
     parse_extended_resource_spec,
 )
 from koordinator_tpu.koordlet import nri_pb2 as pb
@@ -208,6 +210,15 @@ def pod_to_nri(meta: PodMeta, pod_id: str = "") -> pb.NriPodSandbox:
         pod.labels[k] = v
     for k, v in meta.pod.meta.annotations.items():
         pod.annotations[k] = v
+    if ANNOTATION_EXTENDED_RESOURCE_SPEC not in pod.annotations:
+        # NRI carries no pod spec: the annotation is the only channel the
+        # plugin-side _pod_meta can recover batch/mid requests from, so the
+        # runtime view must carry the same spec the webhook guarantees
+        # (container_context.go FromNri <- extended_resource_spec.go)
+        spec = encode_extended_resource_spec(meta.pod.requests,
+                                             meta.pod.limits)
+        if spec:
+            pod.annotations[ANNOTATION_EXTENDED_RESOURCE_SPEC] = spec
     if meta.pod.qos_label:
         pod.labels[LABEL_POD_QOS] = meta.pod.qos_label
     return pod

@@ -164,11 +164,16 @@ class PodMutator:
             if target in pod.limits and target not in pod.requests:
                 pod.requests[target] = pod.limits[target]
                 changed = True
-        if changed:
-            # the runtime-facing copy of the translated tiers: NRI/proxy
-            # contexts have no pod spec, only annotations
-            # (container_context.go:93-120 reads this back)
-            spec = encode_extended_resource_spec(pod.requests, pod.limits)
-            if spec:
-                pod.meta.annotations[ANNOTATION_EXTENDED_RESOURCE_SPEC] = spec
+        # the runtime-facing copy of the translated tiers: NRI/proxy
+        # contexts have no pod spec, only annotations
+        # (container_context.go:93-120 reads this back). Written whenever
+        # the spec holds extended kinds — even if the submitter already
+        # translated them (changed=False), matching mutateByExtendedResources
+        # (extended_resource_spec.go) which dumps the annotation
+        # unconditionally from the final spec.
+        spec = encode_extended_resource_spec(pod.requests, pod.limits)
+        if spec and pod.meta.annotations.get(
+                ANNOTATION_EXTENDED_RESOURCE_SPEC) != spec:
+            pod.meta.annotations[ANNOTATION_EXTENDED_RESOURCE_SPEC] = spec
+            changed = True
         return changed

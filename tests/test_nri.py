@@ -11,9 +11,11 @@ import pytest
 
 from koordinator_tpu.api import types as api
 from koordinator_tpu.api.extension import (
+    ANNOTATION_EXTENDED_RESOURCE_SPEC,
     ANNOTATION_RESOURCE_STATUS,
     LABEL_POD_QOS,
     ResourceKind as RK,
+    encode_extended_resource_spec,
 )
 from koordinator_tpu.koordlet import nri_pb2 as pb
 from koordinator_tpu.koordlet.nri import (
@@ -37,12 +39,19 @@ from koordinator_tpu.runtimeproxy.rpc import RpcClient
 
 
 def make_pod(uid, qos="BE", annotations=None, cgroup_dir=None):
+    requests = {RK.BATCH_CPU: 2000.0, RK.BATCH_MEMORY: 1024.0}
+    limits = {RK.BATCH_CPU: 2000.0, RK.BATCH_MEMORY: 1024.0}
+    # every admitted pod with extended tiers carries the webhook-written
+    # spec annotation (extended_resource_spec.go) — the only channel the
+    # NRI/proxy runtime contexts can recover batch requests from
+    annotations = dict(annotations or {})
+    annotations[ANNOTATION_EXTENDED_RESOURCE_SPEC] = \
+        encode_extended_resource_spec(requests, limits)
     return PodMeta(pod=api.Pod(
         meta=api.ObjectMeta(uid=uid, name=uid, namespace="default",
                             labels={LABEL_POD_QOS: qos},
-                            annotations=annotations or {}),
-        requests={RK.BATCH_CPU: 2000.0, RK.BATCH_MEMORY: 1024.0},
-        limits={RK.BATCH_CPU: 2000.0, RK.BATCH_MEMORY: 1024.0},
+                            annotations=annotations),
+        requests=requests, limits=limits,
         qos_label=qos, priority=5500),
         cgroup_dir=cgroup_dir or f"kubepods/besteffort/pod{uid}")
 
@@ -69,6 +78,21 @@ def test_configure_negotiates_event_mask(env):
     # malformed config keeps defaults
     resp = server.configure(pb.NriConfigureRequest(config="not json"))
     assert list(resp.events) == list(EVENTS)
+
+
+def test_pod_to_nri_synthesizes_spec_annotation(env):
+    """A typed pod that never saw the webhook (no spec annotation) still
+    crosses the in-process wire with its batch requests intact: pod_to_nri
+    synthesizes the annotation so _pod_meta can recover them."""
+    *_, server = env
+    meta = make_pod("u0")
+    del meta.pod.meta.annotations[ANNOTATION_EXTENDED_RESOURCE_SPEC]
+    wire = pod_to_nri(meta)
+    assert ANNOTATION_EXTENDED_RESOURCE_SPEC in wire.annotations
+    resp = server.create_container(pb.NriCreateContainerRequest(
+        pod=wire, container=pb.NriContainer(id="c0", name="main")))
+    # batchresource saw the recovered 2000m request
+    assert resp.adjustment.resources.cpu_shares == 2048
 
 
 def test_run_pod_sandbox_applies_pod_cgroup_writes(env):
