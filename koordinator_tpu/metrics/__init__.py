@@ -99,9 +99,17 @@ class _Bound:
         self._m._set(self._key, value)
 
     def observe(self, value: float) -> None:
-        self._m.observe_key(self._key, value)  # type: ignore[attr-defined]
+        if not isinstance(self._m, Histogram):
+            raise TypeError(
+                f"{self._m.name} is a {self._m.kind}; observe() needs a "
+                f"histogram")
+        self._m.observe_key(self._key, value)
 
     def get(self) -> float:
+        if isinstance(self._m, Histogram):
+            raise TypeError(
+                f"{self._m.name} is a histogram; read count()/sum(), "
+                f"not get()")
         with self._m._lock:
             return self._m._children.get(self._key, 0.0)
 
@@ -202,7 +210,9 @@ class Registry:
             existing = self._metrics.get(metric.name)
             if existing is not None:
                 if type(existing) is not type(metric) or \
-                        existing.label_names != metric.label_names:
+                        existing.label_names != metric.label_names or \
+                        getattr(existing, "buckets", None) != \
+                        getattr(metric, "buckets", None):
                     raise ValueError(
                         f"metric {metric.name} re-registered with a "
                         f"different shape")

@@ -43,7 +43,11 @@ class QuotaProfileReconciler:
             if selector_matches(profile.node_selector, node.meta.labels):
                 for kind, v in node.allocatable.items():
                     total[kind] = total.get(kind, 0.0) + v
-        quota = self.quotas.get(profile.quota_name) or api.ElasticQuota(
+        existing = self.quotas.get(profile.quota_name)
+        # a FRESH object every reconcile: the topology holds the previously
+        # admitted one, so valid_update's old-vs-new comparison is against
+        # real prior state, never against an in-place-mutated alias
+        quota = api.ElasticQuota(
             meta=api.ObjectMeta(name=profile.quota_name,
                                 namespace=profile.meta.namespace))
         quota.min = {k: total.get(k, 0.0) * profile.resource_ratio
@@ -51,11 +55,13 @@ class QuotaProfileReconciler:
         quota.max = {k: self.UNBOUNDED for k in profile.resource_keys}
         quota.tree_id = profile.tree_id
         quota.is_parent = True
-        exists = profile.quota_name in self.quotas
-        self.quotas[profile.quota_name] = quota
+        # admission gates BEFORE the cache commit (the reference updates
+        # through the apiserver, where the webhook runs first): a rejected
+        # quota leaves both self.quotas and the topology unchanged
         if self.topology is not None:
-            if exists:
+            if existing is not None:
                 self.topology.valid_update(quota)
             else:
                 self.topology.valid_add(quota)
+        self.quotas[profile.quota_name] = quota
         return quota

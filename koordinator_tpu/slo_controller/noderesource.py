@@ -292,7 +292,11 @@ class NodeResourceController:
         for i, name in enumerate(inputs.names):
             if not out["sync_mask"][i]:
                 continue
-            for col, kind in ((CPU, "batch-cpu"), (MEM, "batch-memory")):
-                self.stats.node_extended_resource_allocatable.labels(
-                    name, kind, "").set(float(out["batch"][i, col]))
+            for tier, cols in (("batch", ((CPU, "batch-cpu"),
+                                          (MEM, "batch-memory"))),
+                               ("mid", ((CPU, "mid-cpu"),
+                                        (MEM, "mid-memory")))):
+                for col, kind in cols:
+                    self.stats.node_extended_resource_allocatable.labels(
+                        name, kind, "").set(float(out[tier][i, col]))
         return out

@@ -538,6 +538,14 @@ class SnapshotBuilder:
                     seen_gpu.add(m)
                     mem = float(info.resources.get(ResourceKind.GPU_MEMORY,
                                                    0.0))
+                    # gpu_total[ni] is the per-node memory↔ratio conversion
+                    # basis (memory per 100% of one instance); mixed GPU
+                    # sizes on one node have no single basis, so reject
+                    # them instead of silently keeping the last value
+                    if seen_gpu != {m} and gpu_total[ni][1] != mem:
+                        raise ValueError(
+                            f"heterogeneous GPU memory on {node_name!r}: "
+                            f"{gpu_total[ni][1]} vs {mem} (minor {m})")
                     gpu_total[ni] = (100.0, mem, 100.0)
                     if info.health:
                         gpu_free[ni, m] = (100.0, mem, 100.0)

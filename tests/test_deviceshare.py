@@ -306,6 +306,20 @@ def test_builder_indexes_columns_by_minor():
         b2.build(now=1e9)
 
 
+def test_builder_rejects_heterogeneous_gpu_memory():
+    # gpu_total is the per-node memory<->ratio conversion basis; two GPU
+    # sizes on one node have no single basis, so the build must fail loudly
+    # instead of silently keeping whichever DeviceInfo came last
+    b = SnapshotBuilder(max_nodes=1, max_gpu_inst=2)
+    b.add_node(Node(meta=ObjectMeta(name="n0"),
+                    allocatable={CPU: 32000.0, MEM: 64000.0}))
+    b.add_device(Device(node_name="n0", devices=[
+        DeviceInfo(minor=0, type="gpu", resources={GC: 100.0, GM: 1000.0}),
+        DeviceInfo(minor=1, type="gpu", resources={GC: 100.0, GM: 2000.0})]))
+    with pytest.raises(ValueError, match="heterogeneous GPU memory"):
+        b.build(now=1e9)
+
+
 def test_builder_restores_running_allocations():
     b = make_builder(num_nodes=1, gpus=2)
     running = gpu_pod("r", core=200, ratio=200)

@@ -46,6 +46,21 @@ def test_registry_dedupes_and_rejects_shape_change():
         r.counter("x", labels=("other",))
     with pytest.raises(ValueError):
         r.gauge("x", labels=("l",))
+    # histogram bucket spec is part of the shape
+    h = r.histogram("h", buckets=(0.1, 1.0))
+    assert r.histogram("h", buckets=(0.1, 1.0)) is h
+    with pytest.raises(ValueError):
+        r.histogram("h", buckets=(0.5, 5.0))
+
+
+def test_bound_kind_mismatch_raises():
+    r = Registry()
+    c = r.counter("c", labels=("l",))
+    with pytest.raises(TypeError):
+        c.labels("a").observe(1.0)
+    h = r.histogram("hh", labels=("l",))
+    with pytest.raises(TypeError):
+        h.labels("a").get()
 
 
 def test_text_exposition_format():

@@ -47,6 +47,31 @@ def test_profile_generates_root_quota_from_selected_nodes():
     assert quota2.min[RK.CPU] == 32000.0
 
 
+def test_profile_reconcile_validates_before_commit():
+    """A rejected reconcile leaves both the reconciler cache and the
+    topology holding the previously admitted quota (admission gates the
+    apiserver write in the reference), and re-reconciles never mutate the
+    previously returned object in place."""
+    from koordinator_tpu.webhook.elasticquota import QuotaTopologyError
+
+    topo = QuotaTopology()
+    rec = QuotaProfileReconciler(topo)
+    profile = api.ElasticQuotaProfile(
+        meta=api.ObjectMeta(name="p"), quota_name="q", node_selector={})
+    q1 = rec.reconcile(profile, [mk_node("n0"), mk_node("n1")])
+    assert q1.min[RK.CPU] == 64000.0
+    # a fresh object per reconcile: the first result must not alias-mutate
+    q2 = rec.reconcile(profile, [mk_node("n0")])
+    assert q2.min[RK.CPU] == 32000.0
+    assert q1.min[RK.CPU] == 64000.0, "in-place mutation of admitted quota"
+    # invalid update (negative min) is rejected and nothing diverges
+    profile.resource_ratio = -1.0
+    with pytest.raises(QuotaTopologyError):
+        rec.reconcile(profile, [mk_node("n0")])
+    assert rec.quotas["q"].min[RK.CPU] == 32000.0
+    assert topo.quotas["q"].min[RK.CPU] == 32000.0
+
+
 def test_profile_resource_ratio():
     rec = QuotaProfileReconciler()
     profile = api.ElasticQuotaProfile(
