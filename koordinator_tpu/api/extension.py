@@ -177,6 +177,31 @@ ANNOTATION_EXTENDED_RESOURCE_SPEC = NODE_DOMAIN_PREFIX + "/extended-resource-spe
 ANNOTATION_NODE_CPU_NORMALIZATION_RATIO = NODE_DOMAIN_PREFIX + "/cpu-normalization-ratio"
 ANNOTATION_NODE_RAW_ALLOCATABLE = NODE_DOMAIN_PREFIX + "/raw-allocatable"
 ANNOTATION_NODE_RESERVATION = NODE_DOMAIN_PREFIX + "/reservation"
+LABEL_NUMA_TOPOLOGY_POLICY = NODE_DOMAIN_PREFIX + "/numa-topology-policy"
+
+# NUMA topology-manager policy codes (apis/extension/numa_aware.go:138-145;
+# merged by scheduler/topologymanager.py)
+NUMA_POLICY_NONE = 0
+NUMA_POLICY_BEST_EFFORT = 1
+NUMA_POLICY_RESTRICTED = 2
+NUMA_POLICY_SINGLE_NUMA_NODE = 3
+
+_NUMA_POLICY_NAMES = {
+    "": NUMA_POLICY_NONE,
+    "none": NUMA_POLICY_NONE,
+    "besteffort": NUMA_POLICY_BEST_EFFORT,
+    "best-effort": NUMA_POLICY_BEST_EFFORT,
+    "restricted": NUMA_POLICY_RESTRICTED,
+    "singlenumanode": NUMA_POLICY_SINGLE_NUMA_NODE,
+    "single-numa-node": NUMA_POLICY_SINGLE_NUMA_NODE,
+}
+
+
+def numa_policy_code(name: str) -> int:
+    """Policy string (numa-topology-policy label / kubelet policy, either
+    casing) -> code; unknown strings mean none
+    (GetNodeNUMATopologyPolicy, numa_aware.go:327)."""
+    return _NUMA_POLICY_NAMES.get(name.strip().lower(), NUMA_POLICY_NONE)
 
 
 _KIND_NAMES = {v: k for k, v in RESOURCE_NAMES.items()}

@@ -50,7 +50,9 @@ from koordinator_tpu.scheduler.batching import (
     rank_by_priority,
     segment_prefix_ok,
 )
+from koordinator_tpu.scheduler import topologymanager
 from koordinator_tpu.scheduler.plugins import deviceshare, loadaware, numaaware
+from koordinator_tpu.scheduler.plugins.numaaware import CPU as CPU_KIND, MEM as MEM_KIND
 from koordinator_tpu.scheduler.plugins.reservation import (
     MAX_NODE_SCORE,
     rebuild_reservations,
@@ -72,6 +74,10 @@ class ScheduleResult:
     numa_zone: jnp.ndarray       # i32[P] zone taken by NUMA-bound pods, -1
                                  # (feeds the resource-status annotation /
                                  # host cpuset accumulator at bind time)
+    numa_take: jnp.ndarray       # f32[P, Z, 2] per-zone (cpu, mem) actually
+                                 # charged by topology-engaged pods — multi-
+                                 # zone under best-effort/restricted policy
+                                 # (resource_manager.go NUMANodeResources)
     gpu_take: jnp.ndarray        # bool[P, I] GPU instances taken on the
                                  # assigned node (feeds the device-allocation
                                  # annotation at bind, plugin.go PreBind)
@@ -168,9 +174,20 @@ def schedule_batch(snap: ClusterSnapshot, pods: PodBatch,
         static_ok &= numaaware.zone_prefilter(nodes0, pods)
         numa_scores = numaaware.numa_score_matrix(nodes0, pods,
                                                   numa_strategy)
-        req2 = numaaware.pod_zone_requests(pods)                 # [P, 2]
         n_zones = nodes0.numa_cap.shape[1]
-        numa_cap_flat = nodes0.numa_cap.reshape(-1, 2)           # [N*Z, 2]
+        # every pod's (cpu, mem) zone demand: on a node whose topology
+        # policy engages the manager, ALL pods charge zone usage
+        # (resource_manager.go allocates NUMANodeResources per pod), not
+        # just the CPU-bind ones
+        req2_all = jnp.stack([pods.requests[:, int(CPU_KIND)],
+                              pods.requests[:, int(MEM_KIND)]], axis=-1)
+        numa_policy0 = nodes0.numa_policy                        # i32[N]
+        # policy-node combined-fit prefilter (upper bound): a policy node
+        # whose total valid-zone free cannot hold the pod is infeasible
+        total_zfree = jnp.sum(
+            nodes0.numa_free * nodes0.numa_valid[:, :, None], axis=1)
+        static_ok &= (numa_policy0 == topologymanager.POLICY_NONE)[None] | \
+            jnp.all(total_zfree[None] + EPS >= req2_all[:, None, :], axis=-1)
 
     # --- reservations as virtual nodes (transformer.go restore/nominate) ---
     # Each reservation slot is an extra owner-restricted column with the
@@ -195,7 +212,7 @@ def schedule_batch(snap: ClusterSnapshot, pods: PodBatch,
     def round_body(carry, _):
         requested, quota_used, numa_used, gpu_free, aux_free, once_taken, \
             assigned_est, prod_assigned_est, gang_placed, placed, out_score, \
-            out_zone, out_gpu_take, out_aux = carry
+            out_zone, out_take, out_gpu_take, out_aux = carry
         active = pods.valid & (placed < 0) & gang_ok
 
         nodes = nodes0.replace(
@@ -268,7 +285,7 @@ def schedule_batch(snap: ClusterSnapshot, pods: PodBatch,
 
         def inner(inner_carry, _):
             requested, quota_used, numa_used, gpu_free, aux_free, \
-                once_taken, placed, kptr, out_score, out_zone, \
+                once_taken, placed, kptr, out_score, out_zone, out_take, \
                 out_gpu_take, out_aux = inner_carry
             val = jnp.take_along_axis(topk_val, kptr[:, None], 1)[:, 0]
             choice = jnp.take_along_axis(topk_idx, kptr[:, None], 1)[:, 0]
@@ -303,30 +320,48 @@ def schedule_batch(snap: ClusterSnapshot, pods: PodBatch,
                 g_count, g_per = deviceshare.per_instance_at(
                     devices0, pods, choice_eff)
             if enable_numa:
-                # zone pick on the chosen node from live usage — the hint
-                # intersection of the CPU/mem provider and (when GPUs are
-                # present) the deviceshare provider, so a NUMA-bound GPU
-                # pod lands on a zone that can hold BOTH its cpuset and its
-                # instances — then the same prefix gate over flat (node,
-                # zone) segments (slot choices never carry numa_single
-                # pods — slot_columns excludes them)
-                gpu_hint = (deviceshare.gpu_zone_hint(
-                    gpu_free, devices0, choice_eff, g_per, g_count,
-                    n_zones) if use_gpu else None)
-                zone, zone_fit_ok = numaaware.choose_zone(
-                    numa_used, nodes0.numa_cap, nodes0.numa_valid,
-                    choice_eff, req2, pods.numa_single, numa_strategy,
-                    extra_zone_ok=gpu_hint)
-                accept &= zone_fit_ok
-                is_bound = accept & pods.numa_single
-                zone_seg = jnp.where(is_bound,
-                                     choice_eff * n_zones + zone,
-                                     n_nodes * n_zones)
-                zreq = jnp.where(is_bound[:, None], req2, 0.0)
-                accept &= segment_prefix_ok(
-                    zone_seg, earlier, zreq,
-                    numa_used.reshape(-1, 2), numa_cap_flat,
-                    n_nodes * n_zones)
+                # --- topology manager (frameworkext/topologymanager) ---
+                # Per-pod effective policy: a CPU-bind pod requires single-
+                # numa-node everywhere; otherwise the chosen node's policy
+                # applies. Reservation-slot placements are not engaged (the
+                # reserve pod's own zone accounting covers them).
+                on_node = choice_eff < n_nodes
+                nc_z = jnp.clip(choice_eff, 0, n_nodes - 1)
+                eff_policy = jnp.where(
+                    pods.numa_single,
+                    topologymanager.POLICY_SINGLE_NUMA_NODE,
+                    numa_policy0[nc_z])
+                eff_policy = jnp.where(trying & on_node, eff_policy, 0)
+                engaged = eff_policy > topologymanager.POLICY_NONE
+                free_z = jnp.maximum(
+                    nodes0.numa_cap[nc_z] - numa_used[nc_z], 0.0)
+                validz = nodes0.numa_valid[nc_z]             # [P, Z]
+                req2_eff = req2_all * engaged[:, None]
+                provider_hints = [topologymanager.capacity_hints(
+                    free_z, req2_eff, validz)]
+                if use_gpu:
+                    zcounts = deviceshare.gpu_zone_counts(
+                        gpu_free, devices0, choice_eff, g_per, n_zones)
+                    provider_hints.append(topologymanager.count_hints(
+                        zcounts, g_count * engaged))
+                fit_m, pref_m = topologymanager.merge_hints(provider_hints)
+                affinity, admit, _ = topologymanager.resolve(
+                    fit_m, pref_m, eff_policy, free_z[..., 0], validz,
+                    numa_strategy)
+                accept &= admit
+                numa_take, filled = topologymanager.greedy_take(
+                    free_z, req2_eff, affinity, numa_strategy)
+                accept &= ~engaged | filled
+                # per-zone capacity prefix gates in priority order (the
+                # same sequential-exactness trick as node capacity, one
+                # [N, 2] segment space per zone)
+                for zz in range(n_zones):
+                    znow = accept & engaged
+                    zseg = jnp.where(znow, choice_eff, n_nodes)
+                    accept &= segment_prefix_ok(
+                        zseg, earlier, numa_take[:, zz, :] * znow[:, None],
+                        numa_used[:, zz, :], nodes0.numa_cap[:, zz, :],
+                        n_nodes)
 
             if use_gpu:
                 # --- GPU instance gates (deviceshare allocateDevices) ---
@@ -335,15 +370,15 @@ def schedule_batch(snap: ClusterSnapshot, pods: PodBatch,
                 shared = g_count == 1
                 multi = g_count > 1
                 # with NUMA modeling off, the zone constraint is dropped
-                # (not tightened against a sentinel zone)
+                # (not tightened against a sentinel mask)
                 if enable_numa:
-                    zone_for_dev, numa_bound_dev = zone, pods.numa_single
+                    zone_mask_dev, dev_engaged = affinity, engaged
                 else:
-                    zone_for_dev = jnp.full((p,), -1, jnp.int32)
-                    numa_bound_dev = jnp.zeros((p,), bool)
+                    zone_mask_dev = jnp.ones((p, 1), bool)
+                    dev_engaged = jnp.zeros((p,), bool)
                 inst, inst_ok = deviceshare.choose_gpu_instance(
                     gpu_free, devices0, choice_eff, g_per, shared,
-                    numa_bound_dev, zone_for_dev, device_strategy)
+                    zone_mask_dev, dev_engaged, device_strategy)
                 accept &= ~shared | inst_ok
                 gseg = jnp.where(accept & shared,
                                  choice_eff * n_inst + inst,
@@ -367,7 +402,7 @@ def schedule_batch(snap: ClusterSnapshot, pods: PodBatch,
                 nc = jnp.clip(choice_eff, 0, n_nodes - 1)
                 take, enough = deviceshare.full_fit_instances(
                     gpu_free, devices0, choice_eff, g_per, g_count,
-                    numa_bound_dev, zone_for_dev,
+                    zone_mask_dev, dev_engaged,
                     exclude=shared_taken_now.reshape(n_nodes, n_inst)[nc])
                 same_node = choice_eff[:, None] == choice_eff[None, :]
                 multi_cand = multi & accept
@@ -413,14 +448,17 @@ def schedule_batch(snap: ClusterSnapshot, pods: PodBatch,
             # scatter-commit (assume; scheduler_adapter assume/forget) —
             # accept is final from here on
             if enable_numa:
-                is_bound = accept & pods.numa_single
-                zone_seg = jnp.where(is_bound,
-                                     choice_eff * n_zones + zone,
-                                     n_nodes * n_zones)
-                numa_used = numa_used.reshape(-1, 2).at[zone_seg].add(
-                    req2 * is_bound[:, None],
-                    mode="drop").reshape(numa_used.shape)
-                out_zone = jnp.where(is_bound, zone, out_zone)
+                took_z = accept & engaged
+                numa_used = numa_used.at[
+                    jnp.where(took_z, choice_eff, n_nodes)].add(
+                        numa_take * took_z[:, None, None], mode="drop")
+                out_take = jnp.where(took_z[:, None, None], numa_take,
+                                     out_take)
+                # reported zone: the single zone for CPU-bind pods (feeds
+                # the resource-status annotation)
+                zone1 = jnp.argmax(affinity, axis=-1).astype(jnp.int32)
+                out_zone = jnp.where(took_z & pods.numa_single, zone1,
+                                     out_zone)
             if use_gpu:
                 took_shared = accept & shared
                 gseg = jnp.where(took_shared, choice_eff * n_inst + inst,
@@ -462,16 +500,17 @@ def schedule_batch(snap: ClusterSnapshot, pods: PodBatch,
             # a rejected pod's chosen node just filled up: fall through
             kptr = jnp.where(trying & ~accept, kptr + 1, kptr)
             return (requested, quota_used, numa_used, gpu_free, aux_free,
-                    once_taken, placed, kptr, out_score, out_zone,
+                    once_taken, placed, kptr, out_score, out_zone, out_take,
                     out_gpu_take, out_aux), None
 
         (requested, quota_used, numa_used, gpu_free, aux_free, once_taken,
-         placed, _, out_score, out_zone, out_gpu_take, out_aux), _ = \
+         placed, _, out_score, out_zone, out_take, out_gpu_take,
+         out_aux), _ = \
             jax.lax.scan(
                 inner,
                 (requested, quota_used, numa_used, gpu_free, aux_free,
                  once_taken, placed, jnp.zeros((p,), jnp.int32), out_score,
-                 out_zone, out_gpu_take, out_aux),
+                 out_zone, out_take, out_gpu_take, out_aux),
                 None, length=k)
 
         # register newly placed pods' estimates for the next round's scores
@@ -488,8 +527,10 @@ def schedule_batch(snap: ClusterSnapshot, pods: PodBatch,
             1, mode="drop")
         return (requested, quota_used, numa_used, gpu_free, aux_free,
                 once_taken, assigned_est, prod_assigned_est, gang_placed,
-                placed, out_score, out_zone, out_gpu_take, out_aux), None
+                placed, out_score, out_zone, out_take, out_gpu_take,
+                out_aux), None
 
+    n_zones0 = nodes0.numa_cap.shape[1]
     init = (
         jnp.concatenate([nodes0.requested,
                          jnp.zeros_like(slot_alloc0)], axis=0),
@@ -504,10 +545,11 @@ def schedule_batch(snap: ClusterSnapshot, pods: PodBatch,
         jnp.full((p,), -1, jnp.int32),
         jnp.full((p,), -1.0, jnp.float32),
         jnp.full((p,), -1, jnp.int32),
+        jnp.zeros((p, n_zones0, 2), jnp.float32),
         jnp.zeros((p, n_inst), bool),
         jnp.full((p, NUM_AUX_TYPES), -1, jnp.int32))
     (_, _, _, _, _, _, _, _, gang_placed, placed, out_score, out_zone,
-     out_gpu_take, out_aux), _ = \
+     out_take, out_gpu_take, out_aux), _ = \
         jax.lax.scan(round_body, init, None, length=num_rounds)
 
     # --- gang all-or-nothing rollback (Permit barrier, core.go:311-341) ---
@@ -543,17 +585,13 @@ def schedule_batch(snap: ClusterSnapshot, pods: PodBatch,
         1, mode="drop")
 
     # NUMA zone usage from the surviving assignment (revoked gang members
-    # give their zone back)
+    # give their takes back)
     numa_zone = jnp.where(ok & pods.numa_single, out_zone, -1)
     numa_free = nodes0.numa_free
     if enable_numa:
-        bound = numa_zone >= 0
-        flat_seg = jnp.where(
-            bound, tgt * n_zones + jnp.maximum(numa_zone, 0),
-            n_nodes * n_zones)
-        numa_free = (nodes0.numa_free.reshape(-1, 2).at[flat_seg].add(
-            -req2 * bound[:, None], mode="drop")
-            .reshape(nodes0.numa_free.shape))
+        numa_free = jnp.maximum(
+            nodes0.numa_free.at[tgt].add(
+                -out_take * ok[:, None, None], mode="drop"), 0.0)
 
     # device pools from the surviving assignment (revoked gang members give
     # their instances back); per-instance requests are a pure function of
@@ -602,5 +640,7 @@ def schedule_batch(snap: ClusterSnapshot, pods: PodBatch,
         version=snap.version + 1,
     )
     return ScheduleResult(assignment=placed_real, chosen_score=chosen_score,
-                          numa_zone=numa_zone, gpu_take=gpu_take,
+                          numa_zone=numa_zone,
+                          numa_take=out_take * ok[:, None, None],
+                          gpu_take=gpu_take,
                           aux_inst=aux_inst, snapshot=new_snap)

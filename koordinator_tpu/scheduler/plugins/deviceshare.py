@@ -168,13 +168,12 @@ def score_matrix(devices: DeviceState, pods: PodBatch,
     return jnp.where(has_gpu_request(pods)[:, None], score, 0.0)
 
 
-def gpu_zone_hint(gpu_free: jnp.ndarray, devices: DeviceState,
-                  node_idx: jnp.ndarray, per_inst: jnp.ndarray,
-                  count: jnp.ndarray, n_zones: int) -> jnp.ndarray:
-    """bool[P, Z]: zone z of the chosen node has >= count fitting instances
-    — the deviceshare NUMATopologyHintProvider's hint, intersected into the
-    zone merge (topology_hint.go GetPodTopologyHints). All-True for pods
-    without GPU requests so the CPU/mem providers decide alone."""
+def gpu_zone_counts(gpu_free: jnp.ndarray, devices: DeviceState,
+                    node_idx: jnp.ndarray, per_inst: jnp.ndarray,
+                    n_zones: int) -> jnp.ndarray:
+    """i32[P, Z]: fitting instances per zone of the chosen node — the raw
+    input of the deviceshare NUMATopologyHintProvider (topology_hint.go
+    GetPodTopologyHints), consumed by topologymanager.count_hints."""
     n = gpu_free.shape[0]
     nc = jnp.clip(node_idx, 0, n - 1)
     fits = jnp.all(gpu_free[nc] + EPS >= per_inst[:, None, :], axis=-1)
@@ -182,32 +181,42 @@ def gpu_zone_hint(gpu_free: jnp.ndarray, devices: DeviceState,
     zid = devices.gpu_numa[nc]                               # [P, I]
     onehot = zid[:, :, None] == jnp.arange(n_zones,
                                            dtype=zid.dtype)[None, None, :]
-    counts = jnp.sum((fits[:, :, None] & onehot).astype(jnp.int32), axis=1)
-    return (counts >= count[:, None]) | (count == 0)[:, None]
+    return jnp.sum((fits[:, :, None] & onehot).astype(jnp.int32), axis=1)
+
+
+def _zone_allowed(devices: DeviceState, nc: jnp.ndarray,
+                  zone_mask: jnp.ndarray,
+                  engaged: jnp.ndarray) -> jnp.ndarray:
+    """bool[P, I]: instance is inside the pod's merged NUMA affinity.
+    Topology-engaged pods may only take instances whose zone bit is set
+    (unknown-zone instances excluded); unengaged pods take anywhere."""
+    zid = devices.gpu_numa[nc]                               # [P, I]
+    in_mask = jnp.take_along_axis(
+        zone_mask, jnp.clip(zid, 0, zone_mask.shape[1] - 1), axis=1)
+    return ~engaged[:, None] | (in_mask & (zid >= 0))
 
 
 def choose_gpu_instance(gpu_free: jnp.ndarray, devices: DeviceState,
                         node_idx: jnp.ndarray, per_inst: jnp.ndarray,
-                        shared: jnp.ndarray, numa_single: jnp.ndarray,
-                        numa_zone: jnp.ndarray,
+                        shared: jnp.ndarray, zone_mask: jnp.ndarray,
+                        engaged: jnp.ndarray,
                         strategy: str = "least"
                         ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Pick each shared-GPU pod's instance on its chosen node from live free
     state (the scoreDevices instance preference).
 
-    NUMA-bound pods only take instances on their chosen zone (the hint
-    providers' merged affinity, topology_hint.go). Returns (inst i32[P],
-    ok bool[P]); ok is True for pods the shared gate doesn't apply to.
-    Exactness among contending pods comes from the caller's segment prefix
-    gate over (node, instance) ids.
+    Topology-engaged pods only take instances inside their merged NUMA
+    affinity `zone_mask` bool[P, Z] (the hint providers' merge,
+    topology_hint.go). Returns (inst i32[P], ok bool[P]); ok is True for
+    pods the shared gate doesn't apply to. Exactness among contending pods
+    comes from the caller's segment prefix gate over (node, instance) ids.
     """
     n = gpu_free.shape[0]
     nc = jnp.clip(node_idx, 0, n - 1)
     free = gpu_free[nc]                                      # [P, I, 3]
     fits = jnp.all(free + EPS >= per_inst[:, None, :], axis=-1)
     fits &= devices.gpu_valid[nc]                            # [P, I]
-    aligned = devices.gpu_numa[nc] == numa_zone[:, None]
-    fits &= ~numa_single[:, None] | aligned
+    fits &= _zone_allowed(devices, nc, zone_mask, engaged)
     # instance preference keyed on free core: least-allocated spreads
     # (freest instance), most-allocated packs (fullest fitting instance)
     key = free[..., DEV_CORE]
@@ -223,18 +232,18 @@ def choose_gpu_instance(gpu_free: jnp.ndarray, devices: DeviceState,
 
 def full_fit_instances(gpu_free: jnp.ndarray, devices: DeviceState,
                        node_idx: jnp.ndarray, per_inst: jnp.ndarray,
-                       count: jnp.ndarray, numa_single: jnp.ndarray,
-                       numa_zone: jnp.ndarray,
+                       count: jnp.ndarray, zone_mask: jnp.ndarray,
+                       engaged: jnp.ndarray,
                        exclude: jnp.ndarray = None
                        ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """For multi-GPU pods: (take bool[P, I], enough bool[P]) — the lowest-
     index `count` fitting instances on the chosen node, and whether there
     are at least `count` of them.
 
-    NUMA-bound pods only take instances on their chosen zone (same
-    alignment rule as choose_gpu_instance); `exclude` bool[P, I] marks
-    instances unavailable to this pod (e.g. tentatively taken by the same
-    commit step's shared pods).
+    Topology-engaged pods only take instances inside their merged NUMA
+    affinity (same alignment rule as choose_gpu_instance); `exclude`
+    bool[P, I] marks instances unavailable to this pod (e.g. tentatively
+    taken by the same commit step's shared pods).
     """
     n = gpu_free.shape[0]
     nc = jnp.clip(node_idx, 0, n - 1)
@@ -242,8 +251,7 @@ def full_fit_instances(gpu_free: jnp.ndarray, devices: DeviceState,
     fits &= devices.gpu_valid[nc]                            # [P, I]
     if exclude is not None:
         fits &= ~exclude
-    aligned = devices.gpu_numa[nc] == numa_zone[:, None]
-    fits &= ~numa_single[:, None] | aligned
+    fits &= _zone_allowed(devices, nc, zone_mask, engaged)
     enough = jnp.sum(fits, axis=-1) >= count
     cum = jnp.cumsum(fits.astype(jnp.int32), axis=-1)
     take = fits & (cum <= count[:, None])

@@ -84,42 +84,7 @@ def numa_score_matrix(nodes: NodeState, pods: PodBatch,
     return jnp.where(pods.numa_single[:, None], score, 0.0)
 
 
-def choose_zone(numa_used: jnp.ndarray, numa_cap: jnp.ndarray,
-                numa_valid: jnp.ndarray, choice: jnp.ndarray,
-                req2: jnp.ndarray, numa_single: jnp.ndarray,
-                strategy: str = "most",
-                extra_zone_ok: jnp.ndarray = None
-                ) -> Tuple[jnp.ndarray, jnp.ndarray]:
-    """Pick each pod's zone on its chosen node from live usage state.
-
-    Args: numa_used/cap [N, Z, 2], numa_valid [N, Z], choice i32[P] (may be
-    out of range = "no node"), req2 f32[P, 2].
-    `extra_zone_ok` bool[P, Z] ANDs additional per-zone admissibility into
-    the fit — the merged hint of other NUMA providers (deviceshare GPU zone
-    counts, topologymanager policy merge): a zone is only eligible when
-    EVERY provider admits it, mirroring kubelet-style hint intersection.
-    Returns (zone i32[P], zone_ok bool[P]); zone_ok is True for unbound
-    pods. Exactness among contending pods comes from the caller's segment
-    prefix gate over (node, zone) ids.
-
-    Batched-equivalence note: pods committed in the SAME inner step pick
-    zones from the same pre-commit state, so the LeastAllocated spreading
-    preference is batch-granular (capacity stays exact via the prefix
-    gate; chunk size 1 recovers sequential zone choice). MostAllocated
-    packing is unaffected — contending pods converging on one zone IS the
-    packing intent.
-    """
-    n_nodes = numa_used.shape[0]
-    node_c = jnp.clip(choice, 0, n_nodes - 1)
-    free = numa_cap[node_c] - numa_used[node_c]         # [P, Z, 2]
-    fits = jnp.all(free + EPS >= req2[:, None, :], axis=-1)
-    fits &= numa_valid[node_c]                          # [P, Z]
-    if extra_zone_ok is not None:
-        fits &= extra_zone_ok
-    # strategy key on cpu-free: MostAllocated packs (least free wins)
-    key = free[..., 0]
-    key = jnp.where(fits, key, jnp.inf if strategy == "most" else -jnp.inf)
-    zone = (jnp.argmin(key, axis=-1) if strategy == "most"
-            else jnp.argmax(key, axis=-1)).astype(jnp.int32)
-    zone_ok = jnp.any(fits, axis=-1) | ~numa_single
-    return zone, zone_ok
+# Zone choice and zone capacity gating moved to the topology-manager merge
+# (scheduler/topologymanager.py resolve + greedy_take): the single-NUMA
+# case is the SingleNUMANode policy with the CPU/mem provider, so there is
+# one hint/affinity path for all four policies.

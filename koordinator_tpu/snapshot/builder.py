@@ -25,6 +25,7 @@ from koordinator_tpu.api.extension import (
     PriorityClass,
     QoSClass,
     ResourceKind,
+    numa_policy_code,
     translate_resource_by_priority,
 )
 from koordinator_tpu.api.types import (
@@ -293,6 +294,7 @@ class SnapshotBuilder:
         schedulable = np.zeros((n,), bool)
         numa_cap = np.zeros((n, z, 2), np.float32)
         numa_valid = np.zeros((n, z), bool)
+        numa_policy = np.zeros((n,), np.int32)
 
         for i, node in enumerate(self.nodes):
             alloc[i] = resource_vec(node.allocatable)
@@ -302,6 +304,9 @@ class SnapshotBuilder:
                     numa_cap[i, j, 0] = zone.cpus_milli
                     numa_cap[i, j, 1] = zone.memory_mib
                     numa_valid[i, j] = True
+                # kubelet/NRT topology policy -> the scheduler-side
+                # topology manager (numa_aware.go GetNodeNUMATopologyPolicy)
+                numa_policy[i] = numa_policy_code(node.topology.policy)
 
         numa_used = np.zeros((n, z, 2), np.float32)
         for pod in self.running_pods:
@@ -397,6 +402,7 @@ class SnapshotBuilder:
             numa_cap=numa_cap,
             numa_free=np.maximum(numa_cap - numa_used, 0.0),
             numa_valid=numa_valid,
+            numa_policy=numa_policy,
         )
         return state, groups
 

@@ -82,6 +82,9 @@ class NodeState:
     numa_cap: Array         # f32[N, Z, 2] (cpu milli, mem MiB)
     numa_free: Array        # f32[N, Z, 2]
     numa_valid: Array       # bool[N, Z]
+    numa_policy: Array      # i32[N] topology-manager policy code
+                            # (scheduler/topologymanager.py POLICY_*;
+                            # apis/extension numa-topology-policy label)
 
     @property
     def num_nodes(self) -> int:
@@ -272,6 +275,7 @@ def zeros_snapshot(num_nodes: int, num_quotas: int = 1, num_gangs: int = 1,
         numa_cap=jnp.zeros((n, z, 2), f32),
         numa_free=jnp.zeros((n, z, 2), f32),
         numa_valid=jnp.zeros((n, z), bool),
+        numa_policy=jnp.zeros((n,), jnp.int32),
     )
     quotas = QuotaState(
         min=jnp.zeros((q, r), f32),

@@ -112,6 +112,108 @@ def test_unbound_pods_ignore_numa():
     assert int(res.numa_zone[0]) == -1
 
 
+# --- topology-manager policies end-to-end -----------------------------------
+
+
+def policy_node(name, policy, zone_cpu=2000.0, zone_mem=4096.0, zones=2):
+    return Node(
+        meta=ObjectMeta(name=name),
+        allocatable={RK.CPU: zone_cpu * zones, RK.MEMORY: zone_mem * zones},
+        topology=NodeResourceTopology(
+            policy=policy,
+            zones=[NUMAZone(cpus_milli=zone_cpu, memory_mib=zone_mem)
+                   for _ in range(zones)]))
+
+
+def plain_pod(name, cpu, mem, priority=9000):
+    return Pod(meta=ObjectMeta(name=name),
+               requests={RK.CPU: cpu, RK.MEMORY: mem}, priority=priority)
+
+
+def test_policy_none_node_does_not_engage_plain_pods():
+    # cross-zone pod on a none-policy node: placed, no zone charge
+    n = policy_node("n0", "None")
+    res = build([n], [plain_pod("p", 3000.0, 1024.0)])
+    assert int(res.assignment[0]) == 0
+    np.testing.assert_allclose(np.asarray(res.numa_take[0]).sum(), 0.0)
+    np.testing.assert_allclose(np.asarray(res.snapshot.nodes.numa_free),
+                               np.asarray(res.snapshot.nodes.numa_cap))
+
+
+def test_best_effort_charges_zones_cross_zone():
+    # 3000m needs both 2000m zones; best-effort admits and splits the take
+    n = policy_node("n0", "BestEffort")
+    res = build([n], [plain_pod("p", 3000.0, 1024.0)])
+    assert int(res.assignment[0]) == 0
+    take = np.asarray(res.numa_take[0])
+    np.testing.assert_allclose(take[:, 0].sum(), 3000.0)
+    assert (take[:, 0] > 0).sum() == 2  # genuinely split across zones
+    free = np.asarray(res.snapshot.nodes.numa_free)[0]
+    np.testing.assert_allclose(free[:, 0].sum(), 1000.0)
+
+
+def test_restricted_rejects_unpreferred_merge():
+    # restricted node whose zones each fit the pod singly -> single-zone
+    # preferred merge -> admitted on one zone
+    ok_node = policy_node("ok", "Restricted", zone_cpu=4000.0)
+    res = build([ok_node], [plain_pod("p", 3000.0, 1024.0)])
+    assert int(res.assignment[0]) == 0
+    take = np.asarray(res.numa_take[0])
+    assert (take[:, 0] > 0).sum() == 1
+
+
+def test_single_numa_node_policy_applies_to_plain_pods():
+    # a plain (non-cpu-bind) pod that only fits across zones is rejected
+    # by a SingleNUMANode-policy node but accepted by a BestEffort one
+    strict = policy_node("strict", "SingleNUMANode")
+    soft = policy_node("soft", "BestEffort")
+    res = build([strict, soft], [plain_pod("p", 3000.0, 1024.0)])
+    assert int(res.assignment[0]) == 1
+    res2 = build([strict], [plain_pod("p", 3000.0, 1024.0)])
+    assert int(res2.assignment[0]) == -1
+
+
+def test_policy_zone_capacity_is_exact_under_contention():
+    # two 1500m pods fit (one per 2000m zone); a third 1500m pod cannot
+    # (500m + 500m left but best-effort still needs the combined free)
+    n = policy_node("n0", "BestEffort")
+    pods = [plain_pod(f"p{i}", 1500.0, 512.0, priority=9500 - i)
+            for i in range(3)]
+    res = build([n], pods)
+    a = np.asarray(res.assignment)
+    assert (a[:2] == 0).all() and a[2] == -1
+    free = np.asarray(res.snapshot.nodes.numa_free)[0]
+    np.testing.assert_allclose(free[:, 0].sum(), 1000.0)
+
+
+def test_gpu_pod_on_restricted_node_aligns_instances():
+    # GPU in zone 1 only; cpu fits either zone; restricted policy must
+    # land the pod's cpu/mem take in zone 1 with the GPU
+    b = SnapshotBuilder(max_nodes=1, max_gpu_inst=2)
+    from koordinator_tpu.api.types import Device, DeviceInfo
+    n = policy_node("n0", "Restricted", zone_cpu=4000.0)
+    b.add_node(n)
+    b.set_node_metric(NodeMetric(node_name="n0", update_time=NOW - 2,
+                                 node_usage={RK.CPU: 0.0}))
+    b.add_device(Device(node_name="n0", devices=[
+        DeviceInfo(minor=0, type="gpu",
+                   resources={RK.GPU_CORE: 100.0, RK.GPU_MEMORY: 1000.0},
+                   numa_node=1),
+        DeviceInfo(minor=1, type="gpu",
+                   resources={RK.GPU_CORE: 100.0, RK.GPU_MEMORY: 1000.0},
+                   numa_node=1)]))
+    snap, ctx = b.build(now=NOW)
+    pod = Pod(meta=ObjectMeta(name="g"), priority=9000,
+              requests={RK.CPU: 1000.0, RK.MEMORY: 512.0,
+                        RK.GPU_CORE: 50.0, RK.GPU_MEMORY: 500.0})
+    batch = b.build_pod_batch([pod], ctx)
+    res = core.schedule_batch(snap, batch, CFG, num_rounds=3)
+    assert int(res.assignment[0]) == 0
+    take = np.asarray(res.numa_take[0])
+    assert take[1, 0] == 1000.0 and take[0, 0] == 0.0
+    assert np.asarray(res.gpu_take[0]).any()
+
+
 # --- host cpuset accumulator -------------------------------------------------
 
 TOPO = CPUTopology.uniform(num_sockets=2, nodes_per_socket=1,

@@ -1,0 +1,213 @@
+"""Topology-manager policy merge tests: the four policies over conflicting
+provider hints, mirroring the reference's policy_{none,best_effort,
+restricted,single_numa_node}_test.go scenarios on the batched mask-
+reduction formulation (scheduler/topologymanager.py)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from koordinator_tpu.scheduler import topologymanager as tm
+
+
+def hints(free_rows, req, valid=None):
+    """Single-pod capacity hints: free_rows [[cpu, mem] per zone]."""
+    free = jnp.asarray([free_rows], jnp.float32)
+    r = jnp.asarray([req], jnp.float32)
+    v = (jnp.ones(free.shape[:2], bool) if valid is None
+         else jnp.asarray([valid]))
+    return tm.capacity_hints(free, r, v)
+
+
+def resolve1(fit, pref, policy, free_cpu, valid=None, strategy="most"):
+    v = (jnp.ones((1, len(free_cpu)), bool) if valid is None
+         else jnp.asarray([valid]))
+    aff, admit, engaged = tm.resolve(
+        fit, pref, jnp.asarray([policy], jnp.int32),
+        jnp.asarray([free_cpu], jnp.float32), v, strategy)
+    return np.asarray(aff[0]), bool(admit[0]), bool(engaged[0])
+
+
+def test_policy_code_parses_both_casings():
+    assert tm.policy_code("BestEffort") == tm.POLICY_BEST_EFFORT
+    assert tm.policy_code("best-effort") == tm.POLICY_BEST_EFFORT
+    assert tm.policy_code("Restricted") == tm.POLICY_RESTRICTED
+    assert tm.policy_code("SingleNUMANode") == tm.POLICY_SINGLE_NUMA_NODE
+    assert tm.policy_code("") == tm.POLICY_NONE
+    assert tm.policy_code("bogus") == tm.POLICY_NONE
+
+
+def test_mask_table_row_id_is_bitmask_value():
+    masks, pop = tm.mask_table(3)
+    assert masks.shape == (8, 3)
+    assert not masks[0].any()
+    assert masks[5].tolist() == [True, False, True]  # 0b101
+    assert pop.tolist() == [0, 1, 1, 2, 1, 2, 2, 3]
+
+
+def test_capacity_hints_minimal_mask_is_preferred():
+    # fits in zone 0 alone -> single-zone masks preferred, wider fit too
+    fit, pref = hints([[4000, 8192], [4000, 8192]], [2000, 4096])
+    fit, pref = np.asarray(fit[0]), np.asarray(pref[0])
+    assert fit[0b01] and fit[0b10] and fit[0b11]
+    assert pref[0b01] and pref[0b10] and not pref[0b11]
+    # needs both zones -> only the pair mask fits, and it is minimal
+    fit2, pref2 = hints([[1500, 8192], [1500, 8192]], [2000, 4096])
+    fit2, pref2 = np.asarray(fit2[0]), np.asarray(pref2[0])
+    assert not fit2[0b01] and not fit2[0b10] and fit2[0b11]
+    assert pref2[0b11]
+
+
+def test_capacity_hints_no_request_is_dont_care():
+    fit, pref = hints([[100, 100], [100, 100]], [0, 0])
+    assert np.asarray(fit).all() and np.asarray(pref).all()
+
+
+def test_merge_requires_all_providers(
+):
+    # CPU fits only zone 0; GPU only zone 1 -> no single-zone merged fit;
+    # the pair mask fits (cpu across both, gpu count in {1}) but is not
+    # preferred for the cpu provider
+    cfit, cpref = hints([[2000, 4096], [0, 0]], [2000, 4096])
+    gfit, gpref = tm.count_hints(jnp.asarray([[0, 1]], jnp.int32),
+                                 jnp.asarray([1], jnp.int32))
+    fit, pref = tm.merge_hints([(cfit, cpref), (gfit, gpref)])
+    fit, pref = np.asarray(fit[0]), np.asarray(pref[0])
+    assert not fit[0b01]      # gpu missing in zone 0
+    assert not fit[0b10]      # cpu missing in zone 1
+    # the pair IS a merged fit (cpu from zone 0, gpu in zone 1) but not
+    # preferred: each provider's minimal mask is a different single zone
+    assert fit[0b11] and not pref[0b11]
+
+
+def test_merge_agreeing_providers_single_zone():
+    cfit, cpref = hints([[4000, 8192], [4000, 8192]], [2000, 4096])
+    gfit, gpref = tm.count_hints(jnp.asarray([[2, 0]], jnp.int32),
+                                 jnp.asarray([1], jnp.int32))
+    fit, pref = tm.merge_hints([(cfit, cpref), (gfit, gpref)])
+    fit, pref = np.asarray(fit[0]), np.asarray(pref[0])
+    assert fit[0b01] and pref[0b01]
+    assert not fit[0b10]      # no gpu in zone 1
+    aff, admit, _ = resolve1(jnp.asarray([fit]), jnp.asarray([pref]),
+                             tm.POLICY_SINGLE_NUMA_NODE, [4000, 4000])
+    assert admit and aff.tolist() == [True, False]
+
+
+# --- per-policy admission (policy_*_test.go semantics) ----------------------
+
+
+def cross_zone_case():
+    """A pod that fits only across BOTH zones (no preferred single zone)."""
+    return hints([[1500, 8192], [1500, 8192]], [2000, 4096])
+
+
+def test_none_policy_admits_and_does_not_engage():
+    fit, pref = cross_zone_case()
+    aff, admit, engaged = resolve1(fit, pref, tm.POLICY_NONE, [1500, 1500])
+    assert admit and not engaged
+    assert aff.tolist() == [True, True]
+
+
+def test_best_effort_admits_cross_zone():
+    fit, pref = cross_zone_case()
+    aff, admit, engaged = resolve1(fit, pref, tm.POLICY_BEST_EFFORT,
+                                   [1500, 1500])
+    assert admit and engaged
+    assert aff.tolist() == [True, True]
+
+
+def test_restricted_admits_only_preferred():
+    # cross-zone IS minimal here -> preferred -> restricted admits
+    fit, pref = cross_zone_case()
+    _, admit, _ = resolve1(fit, pref, tm.POLICY_RESTRICTED, [1500, 1500])
+    assert admit
+    # conflicting providers: fits exist, none preferred -> rejected
+    cfit, cpref = hints([[4000, 8192], [4000, 8192]], [2000, 4096])
+    gfit, gpref = tm.count_hints(jnp.asarray([[0, 0]], jnp.int32),
+                                 jnp.asarray([1], jnp.int32))
+    # gpu fits nowhere: merged has no fit at all -> admit (capacity gates
+    # reject instead, keeping policy/capacity failures distinct)
+    fit2, pref2 = tm.merge_hints([(cfit, cpref), (gfit, gpref)])
+    _, admit2, _ = resolve1(fit2, pref2, tm.POLICY_RESTRICTED, [4000, 4000])
+    assert admit2
+    # cpu prefers single zones, gpu needs both zones (one instance each):
+    # the only merged fits are non-preferred for cpu -> restricted rejects
+    gfit3, gpref3 = tm.count_hints(jnp.asarray([[1, 1]], jnp.int32),
+                                   jnp.asarray([2], jnp.int32))
+    fit3, pref3 = tm.merge_hints([(cfit, cpref), (gfit3, gpref3)])
+    fit3np = np.asarray(fit3[0])
+    assert fit3np[0b11] and not np.asarray(pref3[0])[0b11]
+    _, admit3, _ = resolve1(fit3, pref3, tm.POLICY_RESTRICTED, [4000, 4000])
+    assert not admit3
+
+
+def test_single_numa_node_requires_one_zone():
+    # fits zone 0 alone -> admitted, affinity is exactly that zone
+    fit, pref = hints([[4000, 8192], [1000, 1024]], [2000, 4096])
+    aff, admit, _ = resolve1(fit, pref, tm.POLICY_SINGLE_NUMA_NODE,
+                             [4000, 1000])
+    assert admit and aff.tolist() == [True, False]
+    # cross-zone only -> rejected even though best-effort would admit
+    fit2, pref2 = cross_zone_case()
+    _, admit2, _ = resolve1(fit2, pref2, tm.POLICY_SINGLE_NUMA_NODE,
+                            [1500, 1500])
+    assert not admit2
+
+
+def test_strategy_orders_equal_single_zones():
+    # both zones fit; most-allocated packs the least-free zone
+    fit, pref = hints([[4000, 8192], [3000, 8192]], [1000, 1024])
+    aff_most, _, _ = resolve1(fit, pref, tm.POLICY_SINGLE_NUMA_NODE,
+                              [4000, 3000], strategy="most")
+    assert aff_most.tolist() == [False, True]
+    aff_least, _, _ = resolve1(fit, pref, tm.POLICY_SINGLE_NUMA_NODE,
+                               [4000, 3000], strategy="least")
+    assert aff_least.tolist() == [True, False]
+
+
+# --- greedy take ------------------------------------------------------------
+
+
+def test_greedy_take_single_zone():
+    free = jnp.asarray([[[4000, 8192], [4000, 8192]]], jnp.float32)
+    req = jnp.asarray([[2000, 4096]], jnp.float32)
+    aff = jnp.asarray([[True, False]])
+    take, filled = tm.greedy_take(free, req, aff)
+    assert bool(filled[0])
+    assert np.asarray(take[0]).tolist() == [[2000, 4096], [0, 0]]
+
+
+def test_greedy_take_spills_in_strategy_order():
+    free = jnp.asarray([[[1000, 1024], [3000, 8192]]], jnp.float32)
+    req = jnp.asarray([[3500, 2048]], jnp.float32)
+    aff = jnp.asarray([[True, True]])
+    # most-allocated: fill the least-free zone (0) first, spill to 1
+    take, filled = tm.greedy_take(free, req, aff, strategy="most")
+    assert bool(filled[0])
+    t = np.asarray(take[0])
+    assert t[0].tolist() == [1000, 1024]
+    assert t[1].tolist() == [2500, 1024]
+    # least-allocated: fill the freest zone (1) first
+    take2, _ = tm.greedy_take(free, req, aff, strategy="least")
+    t2 = np.asarray(take2[0])
+    assert t2[1].tolist() == [3000, 2048]
+    assert t2[0].tolist() == [500, 0]
+
+
+def test_greedy_take_unfilled_when_short():
+    free = jnp.asarray([[[1000, 1024], [1000, 1024]]], jnp.float32)
+    req = jnp.asarray([[3000, 1024]], jnp.float32)
+    aff = jnp.asarray([[True, True]])
+    take, filled = tm.greedy_take(free, req, aff)
+    assert not bool(filled[0])
+    # never takes more than free
+    assert np.asarray(take).max() <= 1024 + 1e-6
+
+
+def test_greedy_take_respects_affinity():
+    free = jnp.asarray([[[4000, 8192], [4000, 8192]]], jnp.float32)
+    req = jnp.asarray([[2000, 1024]], jnp.float32)
+    aff = jnp.asarray([[False, True]])
+    take, filled = tm.greedy_take(free, req, aff)
+    assert bool(filled[0])
+    assert np.asarray(take[0, 0]).tolist() == [0, 0]
