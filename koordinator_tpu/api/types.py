@@ -86,6 +86,9 @@ class Pod:
     allocated_gpu_minors: Tuple[int, ...] = ()
     allocated_rdma_inst: int = -1
     allocated_fpga_inst: int = -1
+    # reservation this RUNNING pod consumes (reservation-allocated
+    # annotation) — its zone/instance charges stay inside the hold
+    reservation_name: str = ""
     # node selection
     node_selector: Dict[str, str] = dataclasses.field(default_factory=dict)
     # controller owner (ReplicaSet/StatefulSet...) — the migration
@@ -276,6 +279,13 @@ class Reservation:
     phase: str = "Pending"      # Pending|Available|Succeeded|Failed|Expired
     allocated: ResourceList = dataclasses.field(default_factory=dict)
     create_time: float = 0.0
+    # fine-grained holds granted when the reserve pod was scheduled (the
+    # device-allocation / resource-status annotations on the reservation;
+    # restored to consumers, transformer.go:240-291)
+    allocated_gpu_minors: Tuple[int, ...] = ()
+    allocated_numa_zone: int = -1
+    required_cpu_bind: bool = False
+    gpu_memory_ratio: float = 0.0
 
     def matches(self, pod: Pod) -> bool:
         sel = self.owner_label_selector

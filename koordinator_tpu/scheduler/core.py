@@ -156,6 +156,10 @@ def schedule_batch(snap: ClusterSnapshot, pods: PodBatch,
     # touches no NodeInfo.requested), so compute it once for the batch.
     la_ok = loadaware.filter_mask(nodes0, pods, cfg)
     static_ok = la_ok & sel_ok & nodes0.schedulable[None, :]     # [P, N]
+    # the slot columns see the gates BEFORE the device/NUMA prefilters:
+    # those prefilters reason about the node's open pools, but a consumer
+    # draws from the reservation's own hold (restore semantics)
+    static_base = static_ok
     if enable_devices:
         # batch-start device upper bound (exact instance gates run in the
         # inner commit); also rejects device pods on device-less nodes —
@@ -194,12 +198,13 @@ def schedule_batch(snap: ClusterSnapshot, pods: PodBatch,
     # slot's remaining free as capacity and MaxNodeScore preference, so
     # consumer admission rides the SAME priority-ordered prefix gates as
     # normal pods (no pre-pass, no priority inversion).
-    slot_ok, slot_alloc0, slot_node = slot_columns(snap, pods, static_ok)
+    slot_ok, slot_alloc0, slot_node = slot_columns(snap, pods, static_base)
     n_slots = slot_node.shape[0]
     n_ext = n_nodes + n_slots
     ext_alloc = jnp.concatenate([nodes0.allocatable, slot_alloc0], 0)
     ext_static = jnp.concatenate([static_ok, slot_ok], 1)        # [P, N+V]
-    is_once = snap.reservations.allocate_once                    # bool[V]
+    resv0 = snap.reservations
+    is_once = resv0.allocate_once                                # bool[V]
     slot_node_c = jnp.maximum(slot_node, 0)
 
     def to_real(ext_idx):
@@ -208,6 +213,47 @@ def schedule_batch(snap: ClusterSnapshot, pods: PodBatch,
             return ext_idx
         s = jnp.clip(ext_idx - n_nodes, 0, n_slots - 1)
         return jnp.where(ext_idx >= n_nodes, slot_node_c[s], ext_idx)
+
+    # --- reservation fine-grained holds as EXTENDED pool rows -------------
+    # Slot v's reserved GPU instances / NUMA zone capacity appear as row
+    # N+v of the instance/zone pools: the existing per-instance and
+    # per-zone prefix gates then hand consumers exactly the reserved
+    # minors/zone with zero extra machinery (deviceshare/nodenumaresource
+    # ReservationRestorePlugin; instance ids are the node's minors).
+    if use_gpu and n_slots:
+        devices_x = devices0.replace(
+            gpu_total=jnp.concatenate(
+                [devices0.gpu_total, devices0.gpu_total[slot_node_c]], 0),
+            gpu_free=jnp.concatenate(
+                [devices0.gpu_free, resv0.gpu_free], 0),
+            gpu_valid=jnp.concatenate(
+                [devices0.gpu_valid, resv0.gpu_valid], 0),
+            gpu_numa=jnp.concatenate(
+                [devices0.gpu_numa, devices0.gpu_numa[slot_node_c]], 0),
+            gpu_pcie=jnp.concatenate(
+                [devices0.gpu_pcie, devices0.gpu_pcie[slot_node_c]], 0))
+    else:
+        devices_x = devices0
+    n_gpu_rows = devices_x.gpu_free.shape[0] if use_gpu else n_nodes
+    if enable_numa:
+        if n_slots:
+            numa_cap_x = jnp.concatenate(
+                [nodes0.numa_cap, resv0.numa_free], 0)       # [N+V, Z, 2]
+            numa_valid_x = jnp.concatenate(
+                [nodes0.numa_valid, resv0.numa_valid], 0)
+            # slot rows engage only CPU-bind consumers (the reservation's
+            # R-vector free covers plain consumers)
+            numa_policy_x = jnp.concatenate(
+                [numa_policy0,
+                 jnp.zeros((n_slots,), numa_policy0.dtype)], 0)
+            numa_used0_x = jnp.concatenate(
+                [numa_used0, jnp.zeros_like(resv0.numa_free)], 0)
+        else:
+            numa_cap_x, numa_valid_x = nodes0.numa_cap, nodes0.numa_valid
+            numa_policy_x, numa_used0_x = numa_policy0, numa_used0
+        n_numa_rows = numa_cap_x.shape[0]
+    else:
+        numa_used0_x = numa_used0
 
     def round_body(carry, _):
         requested, quota_used, numa_used, gpu_free, aux_free, once_taken, \
@@ -318,30 +364,29 @@ def schedule_batch(snap: ClusterSnapshot, pods: PodBatch,
             # instance charge behind.
             if use_gpu:
                 g_count, g_per = deviceshare.per_instance_at(
-                    devices0, pods, choice_eff)
+                    devices_x, pods, choice_eff)
             if enable_numa:
                 # --- topology manager (frameworkext/topologymanager) ---
                 # Per-pod effective policy: a CPU-bind pod requires single-
-                # numa-node everywhere; otherwise the chosen node's policy
-                # applies. Reservation-slot placements are not engaged (the
-                # reserve pod's own zone accounting covers them).
-                on_node = choice_eff < n_nodes
-                nc_z = jnp.clip(choice_eff, 0, n_nodes - 1)
+                # numa-node everywhere (incl. on a reservation slot, whose
+                # row holds the reserved zone); otherwise the chosen node's
+                # policy applies (slot rows carry policy none).
+                nc_z = jnp.clip(choice_eff, 0, n_numa_rows - 1)
                 eff_policy = jnp.where(
                     pods.numa_single,
                     topologymanager.POLICY_SINGLE_NUMA_NODE,
-                    numa_policy0[nc_z])
-                eff_policy = jnp.where(trying & on_node, eff_policy, 0)
+                    numa_policy_x[nc_z])
+                eff_policy = jnp.where(trying, eff_policy, 0)
                 engaged = eff_policy > topologymanager.POLICY_NONE
                 free_z = jnp.maximum(
-                    nodes0.numa_cap[nc_z] - numa_used[nc_z], 0.0)
-                validz = nodes0.numa_valid[nc_z]             # [P, Z]
+                    numa_cap_x[nc_z] - numa_used[nc_z], 0.0)
+                validz = numa_valid_x[nc_z]                  # [P, Z]
                 req2_eff = req2_all * engaged[:, None]
                 provider_hints = [topologymanager.capacity_hints(
                     free_z, req2_eff, validz)]
                 if use_gpu:
                     zcounts = deviceshare.gpu_zone_counts(
-                        gpu_free, devices0, choice_eff, g_per, n_zones)
+                        gpu_free, devices_x, choice_eff, g_per, n_zones)
                     provider_hints.append(topologymanager.count_hints(
                         zcounts, g_count * engaged))
                 fit_m, pref_m = topologymanager.merge_hints(provider_hints)
@@ -354,19 +399,20 @@ def schedule_batch(snap: ClusterSnapshot, pods: PodBatch,
                 accept &= ~engaged | filled
                 # per-zone capacity prefix gates in priority order (the
                 # same sequential-exactness trick as node capacity, one
-                # [N, 2] segment space per zone)
+                # [N+V, 2] segment space per zone)
                 for zz in range(n_zones):
                     znow = accept & engaged
-                    zseg = jnp.where(znow, choice_eff, n_nodes)
+                    zseg = jnp.where(znow, choice_eff, n_numa_rows)
                     accept &= segment_prefix_ok(
                         zseg, earlier, numa_take[:, zz, :] * znow[:, None],
-                        numa_used[:, zz, :], nodes0.numa_cap[:, zz, :],
-                        n_nodes)
+                        numa_used[:, zz, :], numa_cap_x[:, zz, :],
+                        n_numa_rows)
 
             if use_gpu:
                 # --- GPU instance gates (deviceshare allocateDevices) ---
-                # device pods are never slot candidates, so choice_eff is a
-                # real node index whenever these gates bind
+                # choice_eff indexes the EXTENDED instance pool: node rows
+                # are the open per-instance free, slot rows the remaining
+                # reserved holds — consumers take reserved minors here
                 shared = g_count == 1
                 multi = g_count > 1
                 # with NUMA modeling off, the zone constraint is dropped
@@ -377,17 +423,17 @@ def schedule_batch(snap: ClusterSnapshot, pods: PodBatch,
                     zone_mask_dev = jnp.ones((p, 1), bool)
                     dev_engaged = jnp.zeros((p,), bool)
                 inst, inst_ok = deviceshare.choose_gpu_instance(
-                    gpu_free, devices0, choice_eff, g_per, shared,
+                    gpu_free, devices_x, choice_eff, g_per, shared,
                     zone_mask_dev, dev_engaged, device_strategy)
                 accept &= ~shared | inst_ok
                 gseg = jnp.where(accept & shared,
                                  choice_eff * n_inst + inst,
-                                 n_nodes * n_inst)
+                                 n_gpu_rows * n_inst)
                 greq = g_per * (accept & shared)[:, None]
                 gpu_free_flat = gpu_free.reshape(-1, NUM_DEV_DIMS)
                 accept &= segment_prefix_ok(
                     gseg, earlier, greq, jnp.zeros_like(gpu_free_flat),
-                    gpu_free_flat, n_nodes * n_inst)
+                    gpu_free_flat, n_gpu_rows * n_inst)
                 took_shared = accept & shared
                 # multi-GPU (whole instances): one winner per node per inner
                 # step keeps lowest-index instance identity unambiguous;
@@ -396,14 +442,15 @@ def schedule_batch(snap: ClusterSnapshot, pods: PodBatch,
                 # (shared-before-multi intra-step order; exact order is
                 # recovered at chunk size 1).
                 shared_taken_now = jnp.zeros(
-                    (n_nodes * n_inst + 1,), bool).at[
+                    (n_gpu_rows * n_inst + 1,), bool).at[
                         jnp.where(took_shared, choice_eff * n_inst + inst,
-                                  n_nodes * n_inst)].set(True)[:-1]
-                nc = jnp.clip(choice_eff, 0, n_nodes - 1)
+                                  n_gpu_rows * n_inst)].set(True)[:-1]
+                nc = jnp.clip(choice_eff, 0, n_gpu_rows - 1)
                 take, enough = deviceshare.full_fit_instances(
-                    gpu_free, devices0, choice_eff, g_per, g_count,
+                    gpu_free, devices_x, choice_eff, g_per, g_count,
                     zone_mask_dev, dev_engaged,
-                    exclude=shared_taken_now.reshape(n_nodes, n_inst)[nc])
+                    exclude=shared_taken_now.reshape(n_gpu_rows,
+                                                     n_inst)[nc])
                 same_node = choice_eff[:, None] == choice_eff[None, :]
                 multi_cand = multi & accept
                 first_multi = ~jnp.any(earlier & same_node
@@ -450,7 +497,7 @@ def schedule_batch(snap: ClusterSnapshot, pods: PodBatch,
             if enable_numa:
                 took_z = accept & engaged
                 numa_used = numa_used.at[
-                    jnp.where(took_z, choice_eff, n_nodes)].add(
+                    jnp.where(took_z, choice_eff, n_numa_rows)].add(
                         numa_take * took_z[:, None, None], mode="drop")
                 out_take = jnp.where(took_z[:, None, None], numa_take,
                                      out_take)
@@ -462,14 +509,14 @@ def schedule_batch(snap: ClusterSnapshot, pods: PodBatch,
             if use_gpu:
                 took_shared = accept & shared
                 gseg = jnp.where(took_shared, choice_eff * n_inst + inst,
-                                 n_nodes * n_inst)
+                                 n_gpu_rows * n_inst)
                 gpu_free = gpu_free.reshape(-1, NUM_DEV_DIMS).at[gseg].add(
                     -g_per * took_shared[:, None],
                     mode="drop").reshape(gpu_free.shape)
                 took_multi = accept & multi
                 g_upd = (take[:, :, None] * g_per[:, None, :]
                          * took_multi[:, None, None])
-                g_tgt = jnp.where(took_multi, choice_eff, n_nodes)
+                g_tgt = jnp.where(took_multi, choice_eff, n_gpu_rows)
                 gpu_free = gpu_free.at[g_tgt].add(-g_upd, mode="drop")
                 inst_onehot = (jnp.arange(n_inst, dtype=jnp.int32)[None, :]
                                == inst[:, None])
@@ -535,8 +582,8 @@ def schedule_batch(snap: ClusterSnapshot, pods: PodBatch,
         jnp.concatenate([nodes0.requested,
                          jnp.zeros_like(slot_alloc0)], axis=0),
         quotas0.used,
-        numa_used0,
-        devices0.gpu_free,
+        numa_used0_x,
+        devices_x.gpu_free,
         devices0.aux_free,
         jnp.zeros((n_slots,), bool),
         nodes0.assigned_estimated,
@@ -588,9 +635,13 @@ def schedule_batch(snap: ClusterSnapshot, pods: PodBatch,
     # give their takes back)
     numa_zone = jnp.where(ok & pods.numa_single, out_zone, -1)
     numa_free = nodes0.numa_free
+    on_slot_fin = res_slot >= 0
     if enable_numa:
+        # slot consumers drew from the reservation's hold, not the node's
+        # open pool (the hold already left the node at snapshot build)
+        node_numa_tgt = jnp.where(ok & ~on_slot_fin, tgt, n_nodes)
         numa_free = jnp.maximum(
-            nodes0.numa_free.at[tgt].add(
+            nodes0.numa_free.at[node_numa_tgt].add(
                 -out_take * ok[:, None, None], mode="drop"), 0.0)
 
     # device pools from the surviving assignment (revoked gang members give
@@ -599,10 +650,11 @@ def schedule_batch(snap: ClusterSnapshot, pods: PodBatch,
     new_devices = devices0
     gpu_take = out_gpu_take & ok[:, None]
     aux_inst = jnp.where(ok[:, None], out_aux, -1)
+    per_f = None
     if use_gpu:
         _, per_f = deviceshare.per_instance_at(devices0, pods, placed_real)
         g_upd = gpu_take[:, :, None] * per_f[:, None, :]
-        g_tgt = jnp.where(ok, placed_real, n_nodes)
+        g_tgt = jnp.where(ok & ~on_slot_fin, placed_real, n_nodes)
         new_gpu_free = devices0.gpu_free.at[g_tgt].add(-g_upd, mode="drop")
         new_devices = new_devices.replace(
             gpu_free=jnp.maximum(new_gpu_free, 0.0))
@@ -634,8 +686,10 @@ def schedule_batch(snap: ClusterSnapshot, pods: PodBatch,
                              numa_free=numa_free),
         quotas=quotas0.replace(used=quota_used),
         gangs=gangs0.replace(assumed=gang_assumed),
-        reservations=rebuild_reservations(snap.reservations, pods,
-                                          res_slot, ok),
+        reservations=rebuild_reservations(
+            snap.reservations, pods, res_slot, ok,
+            numa_take=out_take if enable_numa else None,
+            gpu_take=gpu_take if use_gpu else None, gpu_per_inst=per_f),
         devices=new_devices,
         version=snap.version + 1,
     )

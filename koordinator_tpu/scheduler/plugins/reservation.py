@@ -43,17 +43,19 @@ from koordinator_tpu.snapshot.schema import ClusterSnapshot, PodBatch, Reservati
 
 
 def slot_columns(snap: ClusterSnapshot, pods: PodBatch,
-                 static_ok: jnp.ndarray
+                 static_base: jnp.ndarray
                  ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Virtual-node columns for the V reservation slots.
 
     Returns (slot_ok [P, V], slot_alloc [V, R], slot_node i32[V]):
     - slot_ok: pod may consume slot v — owner match (transformer.go
       matched-owner restore) AND the slot's underlying node passes the
-      pod's round-invariant gates (Filter still applies on that node);
-      NUMA-bound and device-requesting pods are excluded (reserved cpusets
-      / reserved device instances not modeled yet — those pods schedule on
-      real nodes, conservatively leaving reserved capacity charged).
+      pod's round-invariant gates BEFORE the device/NUMA prefilters
+      (those reason about the node's open pools; a consumer draws from
+      the hold). CPU-bind pods need a slot with a reserved zone; GPU pods
+      a slot with reserved instances (their exact fit runs in the
+      extended-row instance/zone gates). Aux (rdma/fpga) reservations are
+      not modeled — a documented deviation.
     - slot_alloc: the slot's capacity = remaining reserved free.
     - slot_node: underlying real node per slot (-1 invalid).
     """
@@ -65,17 +67,29 @@ def slot_columns(snap: ClusterSnapshot, pods: PodBatch,
     owner_ok = ((pods.reservation_owner[:, None] >= 0)
                 & (pods.reservation_owner[:, None]
                    == resv.owner_group[None, :]))                # [P, V]
-    slot_ok = (base_ok & owner_ok & static_ok[:, node_c]
-               & ~pods.numa_single[:, None]
-               & ~deviceshare.has_device_request(pods)[:, None])
+    has_zone = jnp.any(resv.numa_valid, axis=-1)                 # [V]
+    has_gpu = jnp.any(resv.gpu_valid, axis=-1)                   # [V]
+    has_aux = jnp.zeros((pods.num_pods,), bool)
+    for kind in deviceshare.AUX_KINDS:
+        has_aux |= pods.requests[:, kind] > 0
+    slot_ok = (base_ok & owner_ok & static_base[:, node_c]
+               & (~pods.numa_single[:, None] | has_zone[None, :])
+               & (~deviceshare.has_gpu_request(pods)[:, None]
+                  | has_gpu[None, :])
+               & ~has_aux[:, None])
     return slot_ok, resv.free, resv.node
 
 
 def rebuild_reservations(resv: ReservationState, pods: PodBatch,
-                         res_slot: jnp.ndarray,
-                         ok: jnp.ndarray) -> ReservationState:
+                         res_slot: jnp.ndarray, ok: jnp.ndarray,
+                         numa_take: jnp.ndarray = None,
+                         gpu_take: jnp.ndarray = None,
+                         gpu_per_inst: jnp.ndarray = None
+                         ) -> ReservationState:
     """Final reservation state from the surviving assignment (pods the gang
-    Permit barrier revoked give their reserved capacity back)."""
+    Permit barrier revoked give their reserved capacity back). Consumers'
+    zone/instance takes are drawn down from the slot's fine-grained holds
+    so the next cycle sees the remaining reserved minors/zone capacity."""
     n_res = resv.valid.shape[0]
     consuming = ok & (res_slot >= 0)
     tgt = jnp.where(consuming, res_slot, n_res)
@@ -83,7 +97,24 @@ def rebuild_reservations(resv: ReservationState, pods: PodBatch,
         pods.requests * consuming[:, None], mode="drop")
     took_once = jnp.zeros((n_res,), bool).at[tgt].max(
         consuming, mode="drop")
-    new_free = jnp.where((resv.allocate_once & took_once)[:, None],
-                         0.0, jnp.maximum(resv.free - consumed, 0.0))
-    return resv.replace(free=new_free,
-                        valid=resv.valid & ~(resv.allocate_once & took_once))
+    exhausted = resv.allocate_once & took_once
+    new_free = jnp.where(exhausted[:, None], 0.0,
+                         jnp.maximum(resv.free - consumed, 0.0))
+    new_gpu_free, new_numa_free = resv.gpu_free, resv.numa_free
+    if gpu_take is not None and gpu_per_inst is not None:
+        g_upd = (gpu_take[:, :, None] * gpu_per_inst[:, None, :]
+                 * consuming[:, None, None])
+        new_gpu_free = jnp.maximum(
+            resv.gpu_free.at[tgt].add(-g_upd, mode="drop"), 0.0)
+    if numa_take is not None:
+        new_numa_free = jnp.maximum(
+            resv.numa_free.at[tgt].add(
+                -numa_take * consuming[:, None, None], mode="drop"), 0.0)
+    gone = exhausted[:, None]
+    return resv.replace(
+        free=new_free,
+        gpu_free=jnp.where(gone[..., None], 0.0, new_gpu_free),
+        gpu_valid=resv.gpu_valid & ~gone,
+        numa_free=jnp.where(gone[..., None], 0.0, new_numa_free),
+        numa_valid=resv.numa_valid & ~gone,
+        valid=resv.valid & ~exhausted)

@@ -484,13 +484,34 @@ class SnapshotBuilder:
         return GangState(min_member=min_member, member_count=member_count,
                          assumed=assumed, strict=strict, valid=valid)
 
-    def build_reservations(self, owner_groups: Dict[str, int]) -> ReservationState:
+    def build_reservations(self, owner_groups: Dict[str, int],
+                           nodes: "NodeState",
+                           devices: "DeviceState") -> ReservationState:
+        """Columnarize Available reservations, including their fine-grained
+        holds (reserved GPU minors / NUMA cpuset zone). The REMAINING hold
+        (reservation grant minus what consumers already drew) is moved from
+        the node pools into per-slot pools, so non-owners cannot take it
+        and consumers draw exactly the reserved minors/zone
+        (transformer.go:240-291 restoreMatchedReservation; deviceshare /
+        nodenumaresource ReservationRestorePlugin)."""
         v, r = self.max_reservations, NUM_RESOURCES
+        n_inst = devices.gpu_free.shape[1]
+        n_zones = nodes.numa_cap.shape[1]
         node = np.full((v,), -1, np.int32)
         free = np.zeros((v, r), np.float32)
         owner = np.full((v,), -1, np.int32)
         once = np.ones((v,), bool)
         valid = np.zeros((v,), bool)
+        gpu_free_v = np.zeros((v, n_inst, NUM_DEV_DIMS), np.float32)
+        gpu_valid_v = np.zeros((v, n_inst), bool)
+        numa_free_v = np.zeros((v, n_zones, 2), np.float32)
+        numa_valid_v = np.zeros((v, n_zones), bool)
+
+        consumers: Dict[str, List[Pod]] = {}
+        for pod in self.running_pods:
+            if pod.reservation_name:
+                consumers.setdefault(pod.reservation_name, []).append(pod)
+
         for i, res in enumerate(self.reservations):
             if res.phase != "Available" or not res.node_name:
                 continue
@@ -503,8 +524,54 @@ class SnapshotBuilder:
             owner[i] = owner_groups.setdefault(key, len(owner_groups))
             once[i] = res.allocate_once
             valid[i] = True
+            consuming = [c for c in consumers.get(res.meta.name, ())
+                         if c.node_name == res.node_name]
+
+            if res.allocated_gpu_minors:
+                pseudo = Pod(requests=dict(res.requests),
+                             gpu_memory_ratio=res.gpu_memory_ratio)
+                _, per_inst = gpu_per_instance_host(
+                    devices.gpu_total[ni, DEV_MEM], pseudo)
+                for m in res.allocated_gpu_minors:
+                    if 0 <= m < n_inst:
+                        gpu_free_v[i, m] = per_inst
+                        gpu_valid_v[i, m] = True
+                for c in consuming:
+                    _, c_per = gpu_per_instance_host(
+                        devices.gpu_total[ni, DEV_MEM], c)
+                    for m in c.allocated_gpu_minors:
+                        if 0 <= m < n_inst and gpu_valid_v[i, m]:
+                            gpu_free_v[i, m] = np.maximum(
+                                gpu_free_v[i, m] - c_per, 0.0)
+                # the remaining hold leaves the node pool (consumers'
+                # takes were already subtracted by build_devices, so
+                # node free drops by exactly the full reserved amount)
+                for m in res.allocated_gpu_minors:
+                    if 0 <= m < n_inst:
+                        devices.gpu_free[ni, m] = np.maximum(
+                            devices.gpu_free[ni, m] - gpu_free_v[i, m], 0.0)
+
+            zi = res.allocated_numa_zone
+            if res.required_cpu_bind and 0 <= zi < n_zones:
+                rv = resource_vec(res.requests)
+                hold = np.array([rv[int(ResourceKind.CPU)],
+                                 rv[int(ResourceKind.MEMORY)]], np.float32)
+                for c in consuming:
+                    if c.required_cpu_bind and c.allocated_numa_zone == zi:
+                        cv = resource_vec(c.requests)
+                        hold -= (cv[int(ResourceKind.CPU)],
+                                 cv[int(ResourceKind.MEMORY)])
+                hold = np.maximum(hold, 0.0)
+                numa_free_v[i, zi] = hold
+                numa_valid_v[i, zi] = True
+                nodes.numa_free[ni, zi] = np.maximum(
+                    nodes.numa_free[ni, zi] - hold, 0.0)
+
         return ReservationState(node=node, free=free, owner_group=owner,
-                                allocate_once=once, valid=valid)
+                                allocate_once=once, valid=valid,
+                                gpu_free=gpu_free_v, gpu_valid=gpu_valid_v,
+                                numa_free=numa_free_v,
+                                numa_valid=numa_valid_v)
 
     def build_devices(self) -> DeviceState:
         """Columnarize Device CRs; running pods' granted instances (the
@@ -627,11 +694,14 @@ class SnapshotBuilder:
                     for info in device.devices
                     if info.type == typ and info.health)
         owner_groups: Dict[str, int] = {}
+        # reservations may move remaining fine-grained holds out of the
+        # node/device pools, so build them against the materialized arrays
+        reservations = self.build_reservations(owner_groups, nodes, devices)
         snap = ClusterSnapshot(
             nodes=nodes,
             quotas=self.build_quotas(),
             gangs=self.build_gangs(),
-            reservations=self.build_reservations(owner_groups),
+            reservations=reservations,
             devices=devices,
             version=np.int32(version),
         )

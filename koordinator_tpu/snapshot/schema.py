@@ -208,7 +208,12 @@ class ReservationState:
 
     A reservation is reserved capacity *already counted* in node `requested`;
     a matching pod first consumes reservation free capacity (restore
-    semantics, reservation/transformer.go:240-291).
+    semantics, reservation/transformer.go:240-291). Reservations holding
+    GPU instances or a NUMA cpuset carry those as per-slot pools the
+    scheduler hands to consumers (the deviceshare / nodenumaresource
+    ReservationRestorePlugin state): instance columns are indexed by the
+    UNDERLYING NODE's minors/zones, so a consumer's grant is directly a
+    node-level allocation.
     """
 
     node: Array             # i32[V] node index the reservation landed on
@@ -216,6 +221,13 @@ class ReservationState:
     owner_group: Array      # i32[V] owner-match group id
     allocate_once: Array    # bool[V]
     valid: Array            # bool[V]
+    # reserved device instances (remaining per-instance capacity; zero
+    # rows for minors the reservation does not hold)
+    gpu_free: Array         # f32[V, I, NUM_DEV_DIMS]
+    gpu_valid: Array        # bool[V, I] reserved minors
+    # reserved NUMA zone capacity remaining (cpu milli, mem MiB)
+    numa_free: Array        # f32[V, Z, 2]
+    numa_valid: Array       # bool[V, Z] reserved zones
 
 
 @flax.struct.dataclass
@@ -303,6 +315,10 @@ def zeros_snapshot(num_nodes: int, num_quotas: int = 1, num_gangs: int = 1,
         owner_group=jnp.full((v,), -1, jnp.int32),
         allocate_once=jnp.ones((v,), bool),
         valid=jnp.zeros((v,), bool),
+        gpu_free=jnp.zeros((v, num_gpu_inst, NUM_DEV_DIMS), f32),
+        gpu_valid=jnp.zeros((v, num_gpu_inst), bool),
+        numa_free=jnp.zeros((v, z, 2), f32),
+        numa_valid=jnp.zeros((v, z), bool),
     )
     return ClusterSnapshot(nodes=nodes, quotas=quotas, gangs=gangs,
                            reservations=reservations,

@@ -158,12 +158,17 @@ def synthetic_cluster(num_nodes: int, seed: int = 0,
         strict=np.ones((g,), bool),
         valid=np.arange(g) < num_gangs,
     )
+    n_inst = gpus_per_node if gpu_node_frac > 0 else 0
     reservations = ReservationState(
         node=np.full((8,), -1, np.int32),
         free=np.zeros((8, R), f32),
         owner_group=np.full((8,), -1, np.int32),
         allocate_once=np.ones((8,), bool),
         valid=np.zeros((8,), bool),
+        gpu_free=np.zeros((8, n_inst, NUM_DEV_DIMS), f32),
+        gpu_valid=np.zeros((8, n_inst), bool),
+        numa_free=np.zeros((8, 4, 2), f32),
+        numa_valid=np.zeros((8, 4), bool),
     )
     if gpu_node_frac > 0:
         i = gpus_per_node

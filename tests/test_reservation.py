@@ -227,3 +227,124 @@ def test_zero_reservation_capacity_schedules():
     batch = b.build_pod_batch([owned_pod("p", 2_000, 2_048)], ctx)
     res = core.schedule_batch(snap, batch, CFG, num_rounds=2)
     assert int(res.assignment[0]) >= 0
+
+
+# --- fine-grained restore: reserved GPU instances + NUMA cpuset -------------
+# (transformer.go:240-291; deviceshare/nodenumaresource ReservationRestore)
+
+
+def gpu_numa_builder():
+    from koordinator_tpu.api.types import (
+        Device, DeviceInfo, NodeResourceTopology, NUMAZone,
+    )
+    b = SnapshotBuilder(max_nodes=1, max_gpu_inst=4)
+    b.add_node(Node(
+        meta=ObjectMeta(name="n0"),
+        allocatable={RK.CPU: 16_000.0, RK.MEMORY: 32_768.0},
+        topology=NodeResourceTopology(
+            zones=[NUMAZone(cpus_milli=8_000.0, memory_mib=16_384.0)
+                   for _ in range(2)])))
+    b.set_node_metric(NodeMetric(node_name="n0", update_time=NOW - 2,
+                                 node_usage={RK.CPU: 0.0}))
+    b.add_device(Device(node_name="n0", devices=[
+        DeviceInfo(minor=m, type="gpu",
+                   resources={RK.GPU_CORE: 100.0, RK.GPU_MEMORY: 1000.0},
+                   numa_node=m // 2)
+        for m in range(4)]))
+    return b
+
+
+def test_consumer_gets_reserved_gpu_minors():
+    # reservation holds minors 2,3 (zone 1); a non-owner GPU pod cannot
+    # take them, the owner gets exactly those minors
+    b = gpu_numa_builder()
+    r = Reservation(meta=ObjectMeta(name="r0"),
+                    requests={RK.CPU: 2_000.0, RK.MEMORY: 2_048.0,
+                              RK.GPU_CORE: 200.0, RK.GPU_MEMORY: 2000.0},
+                    owner_label_selector={"team": "a"},
+                    allocate_once=True, node_name="n0", phase="Available",
+                    allocated_gpu_minors=(2, 3))
+    b.add_reservation(r)
+    snap, ctx = b.build(now=NOW)
+    # build moved the hold out of the node pool: minors 2,3 have no free
+    gf = np.asarray(snap.devices.gpu_free)
+    np.testing.assert_allclose(gf[0, 2:, 0], 0.0)
+    rgf = np.asarray(snap.reservations.gpu_free)
+    np.testing.assert_allclose(rgf[0, 2:, 0], 100.0)
+
+    stranger = Pod(meta=ObjectMeta(name="x", labels={"team": "b"}),
+                   requests={RK.CPU: 1_000.0, RK.MEMORY: 1_024.0,
+                             RK.GPU_CORE: 300.0, RK.GPU_MEMORY: 3000.0},
+                   priority=9500)
+    owner = Pod(meta=ObjectMeta(name="o", labels={"team": "a"}),
+                requests={RK.CPU: 1_000.0, RK.MEMORY: 1_024.0,
+                          RK.GPU_CORE: 200.0, RK.GPU_MEMORY: 2000.0},
+                priority=9100)
+    batch = b.build_pod_batch([stranger, owner], ctx)
+    res = core.schedule_batch(snap, batch, CFG, num_rounds=3)
+    a = np.asarray(res.assignment)
+    take = np.asarray(res.gpu_take)
+    # stranger needs 3 whole GPUs but only minors 0,1 are open -> rejected
+    assert a[0] == -1
+    # owner consumed the reservation and got exactly the reserved minors
+    assert a[1] == 0
+    assert take[1].tolist() == [False, False, True, True]
+    rv = res.snapshot.reservations
+    assert not bool(np.asarray(rv.valid)[0])  # AllocateOnce exhausted
+
+
+def test_consumer_gets_reserved_zone_cpuset():
+    # reservation holds a cpuset in zone 1; the CPU-bind owner lands on it
+    # and its zone IS the reserved zone; node open zone capacity untouched
+    b = gpu_numa_builder()
+    r = Reservation(meta=ObjectMeta(name="r0"),
+                    requests={RK.CPU: 4_000.0, RK.MEMORY: 4_096.0},
+                    owner_label_selector={"team": "a"},
+                    allocate_once=True, node_name="n0", phase="Available",
+                    required_cpu_bind=True, allocated_numa_zone=1)
+    b.add_reservation(r)
+    snap, ctx = b.build(now=NOW)
+    nf = np.asarray(snap.nodes.numa_free)[0]
+    np.testing.assert_allclose(nf[1, 0], 4_000.0)  # 8000 - 4000 hold
+    rnf = np.asarray(snap.reservations.numa_free)[0]
+    np.testing.assert_allclose(rnf[1], [4_000.0, 4_096.0])
+
+    owner = Pod(meta=ObjectMeta(name="o", labels={"team": "a"}),
+                requests={RK.CPU: 3_000.0, RK.MEMORY: 2_048.0},
+                priority=9100, qos_label="LSR", required_cpu_bind=True)
+    batch = b.build_pod_batch([owner], ctx)
+    res = core.schedule_batch(snap, batch, CFG, num_rounds=3)
+    assert int(res.assignment[0]) == 0
+    assert int(res.numa_zone[0]) == 1          # the RESERVED zone
+    take = np.asarray(res.numa_take[0])
+    np.testing.assert_allclose(take[1], [3_000.0, 2_048.0])
+    # node open pool untouched; the hold shrank instead
+    nf2 = np.asarray(res.snapshot.nodes.numa_free)[0]
+    np.testing.assert_allclose(nf2[1, 0], 4_000.0)
+    rnf2 = np.asarray(res.snapshot.reservations.numa_free)[0]
+    np.testing.assert_allclose(rnf2[1], [0.0, 0.0])  # once -> zeroed
+
+
+def test_shared_reservation_zone_hold_drains_across_consumers():
+    b = gpu_numa_builder()
+    r = Reservation(meta=ObjectMeta(name="r0"),
+                    requests={RK.CPU: 4_000.0, RK.MEMORY: 4_096.0},
+                    owner_label_selector={"team": "a"},
+                    allocate_once=False, node_name="n0", phase="Available",
+                    required_cpu_bind=True, allocated_numa_zone=0)
+    b.add_reservation(r)
+    pods = [Pod(meta=ObjectMeta(name=f"o{i}", labels={"team": "a"}),
+                requests={RK.CPU: 1_500.0, RK.MEMORY: 1_024.0},
+                priority=9500 - i, qos_label="LSR", required_cpu_bind=True)
+            for i in range(3)]
+    snap, ctx = b.build(now=NOW)
+    batch = b.build_pod_batch(pods, ctx)
+    res = core.schedule_batch(snap, batch, CFG, num_rounds=3)
+    a = np.asarray(res.assignment)
+    z = np.asarray(res.numa_zone)
+    assert (a == 0).all()
+    # first two drain the hold (2x1500 <= 4000, third 1500 does not fit
+    # the remaining 1000) -> third falls to the node's open zone pool
+    assert z[0] == 0 and z[1] == 0
+    rnf = np.asarray(res.snapshot.reservations.numa_free)[0]
+    np.testing.assert_allclose(rnf[0, 0], 1_000.0)
