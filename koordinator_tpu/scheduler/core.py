@@ -82,6 +82,9 @@ class ScheduleResult:
                                  # assigned node (feeds the device-allocation
                                  # annotation at bind, plugin.go PreBind)
     aux_inst: jnp.ndarray        # i32[P, A] aux (rdma/fpga) instance, -1
+    res_slot: jnp.ndarray        # i32[P] reservation slot consumed, -1 —
+                                 # feeds the reservation-allocated
+                                 # annotation at bind and the forget path
     snapshot: ClusterSnapshot    # post-commit snapshot (requested/used updated)
 
 
@@ -697,4 +700,5 @@ def schedule_batch(snap: ClusterSnapshot, pods: PodBatch,
                           numa_zone=numa_zone,
                           numa_take=out_take * ok[:, None, None],
                           gpu_take=gpu_take,
-                          aux_inst=aux_inst, snapshot=new_snap)
+                          aux_inst=aux_inst, res_slot=res_slot,
+                          snapshot=new_snap)

@@ -97,9 +97,11 @@ def rebuild_reservations(resv: ReservationState, pods: PodBatch,
         pods.requests * consuming[:, None], mode="drop")
     took_once = jnp.zeros((n_res,), bool).at[tgt].max(
         consuming, mode="drop")
+    # exhausted AllocateOnce slots keep their remainders (valid=False
+    # already gates admission) so a later forget/un-assume can restore the
+    # slot exactly (snapshot/delta.py forget_pods)
     exhausted = resv.allocate_once & took_once
-    new_free = jnp.where(exhausted[:, None], 0.0,
-                         jnp.maximum(resv.free - consumed, 0.0))
+    new_free = jnp.maximum(resv.free - consumed, 0.0)
     new_gpu_free, new_numa_free = resv.gpu_free, resv.numa_free
     if gpu_take is not None and gpu_per_inst is not None:
         g_upd = (gpu_take[:, :, None] * gpu_per_inst[:, None, :]
@@ -110,11 +112,8 @@ def rebuild_reservations(resv: ReservationState, pods: PodBatch,
         new_numa_free = jnp.maximum(
             resv.numa_free.at[tgt].add(
                 -numa_take * consuming[:, None, None], mode="drop"), 0.0)
-    gone = exhausted[:, None]
     return resv.replace(
         free=new_free,
-        gpu_free=jnp.where(gone[..., None], 0.0, new_gpu_free),
-        gpu_valid=resv.gpu_valid & ~gone,
-        numa_free=jnp.where(gone[..., None], 0.0, new_numa_free),
-        numa_valid=resv.numa_valid & ~gone,
+        gpu_free=new_gpu_free,
+        numa_free=new_numa_free,
         valid=resv.valid & ~exhausted)

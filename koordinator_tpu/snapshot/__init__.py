@@ -15,4 +15,9 @@ from koordinator_tpu.snapshot.schema import (  # noqa: F401
     ReservationState,
 )
 from koordinator_tpu.snapshot.builder import SnapshotBuilder  # noqa: F401
+from koordinator_tpu.snapshot.delta import (  # noqa: F401
+    NodeMetricDelta,
+    apply_metric_delta,
+    forget_pods,
+)
 from koordinator_tpu.snapshot.store import SnapshotStore  # noqa: F401

@@ -338,56 +338,17 @@ class SnapshotBuilder:
                         - resource_vec(res.allocated), 0.0)
 
         # NodeMetric columns + the assign-cache adjustment.
-        pods_per_node: Dict[str, List[AssignedPod]] = {}
-        for ap in self.assigned:
-            pods_per_node.setdefault(ap.node_name, []).append(ap)
-
+        pods_per_node = self._pods_per_node()
         for name, metric in self.metrics.items():
             i = self.node_index.get(name)
             if i is None:
                 continue
-            if metric.is_expired(self.metric_expiration_s, now):
+            row = self._metric_row(name, metric, now, pods_per_node)
+            if row is None:
                 continue
-            fresh[i] = True
-            usage[i] = resource_vec(metric.node_usage)
-            pod_usages = {pm.namespaced_name: resource_vec(pm.usage)
-                          for pm in metric.pods_metric}
-            for pm in metric.pods_metric:
-                if pm.priority_class is PriorityClass.PROD:
-                    prod_usage[i] += resource_vec(pm.usage)
-            for a, agg_type in enumerate(AGG_TYPES):
-                au = metric.aggregated_usage(agg_type)
-                if au is not None:
-                    agg[i, a] = resource_vec(au)
-                    has_agg[i] = True
-
-            # estimatedAssignedPodUsed (load_aware.go:340-378): recently
-            # assumed pods not yet visible in the NodeMetric are estimated;
-            # those visible-but-recent use max(estimate, usage). Their
-            # reported usage is recorded as a correction the score kernel
-            # subtracts from the node usage source (load_aware.go:300-315).
-            interval = metric.report_interval_seconds or DEFAULT_REPORT_INTERVAL_S
-            for ap in pods_per_node.get(name, []):
-                key = ap.pod.meta.namespaced_name
-                pod_usage = pod_usages.get(key)
-                recent = (ap.timestamp > metric.update_time
-                          or metric.update_time - ap.timestamp < interval)
-                # fourth clause (load_aware.go:355-360): score aggregation
-                # configured but this node has no percentile data -> the
-                # usage source contributes nothing, so estimate everything
-                agg_missing = self.score_with_aggregation and not metric.aggregated
-                is_prod = ap.pod.priority_class is PriorityClass.PROD
-                if pod_usage is None or recent or agg_missing:
-                    est = estimate_pod(ap.pod, self.estimator_scaling,
-                                       self.estimator_weights)
-                    if pod_usage is not None:
-                        est = np.maximum(est, pod_usage)
-                        assigned_corr[i] += pod_usage
-                        if is_prod:
-                            prod_assigned_corr[i] += pod_usage
-                    assigned_est[i] += est
-                    if is_prod:
-                        prod_assigned_est[i] += est
+            (fresh[i], usage[i], prod_usage[i], agg[i], has_agg[i],
+             assigned_est[i], assigned_corr[i], prod_assigned_est[i],
+             prod_assigned_corr[i]) = row
 
         lab_ids, groups = self._node_label_groups()
         state = NodeState(
@@ -483,6 +444,112 @@ class SnapshotBuilder:
             valid[i] = True
         return GangState(min_member=min_member, member_count=member_count,
                          assumed=assumed, strict=strict, valid=valid)
+
+    def _pods_per_node(self) -> Dict[str, List[AssignedPod]]:
+        out: Dict[str, List[AssignedPod]] = {}
+        for ap in self.assigned:
+            out.setdefault(ap.node_name, []).append(ap)
+        return out
+
+    def _metric_row(self, name: str, metric: NodeMetric, now: float,
+                    pods_per_node: Dict[str, List[AssignedPod]]):
+        """One node's metric-derived columns: (fresh, usage, prod_usage,
+        agg [NUM_AGG, R], has_agg, assigned_est, assigned_corr,
+        prod_assigned_est, prod_assigned_corr), or None when expired.
+        Shared by the full rebuild and the per-node metric delta so the two
+        paths cannot drift."""
+        if metric.is_expired(self.metric_expiration_s, now):
+            return None
+        r = NUM_RESOURCES
+        usage = resource_vec(metric.node_usage)
+        prod_usage = np.zeros((r,), np.float32)
+        agg = np.zeros((NUM_AGG, r), np.float32)
+        has_agg = False
+        pod_usages = {pm.namespaced_name: resource_vec(pm.usage)
+                      for pm in metric.pods_metric}
+        for pm in metric.pods_metric:
+            if pm.priority_class is PriorityClass.PROD:
+                prod_usage += resource_vec(pm.usage)
+        for a, agg_type in enumerate(AGG_TYPES):
+            au = metric.aggregated_usage(agg_type)
+            if au is not None:
+                agg[a] = resource_vec(au)
+                has_agg = True
+
+        # estimatedAssignedPodUsed (load_aware.go:340-378): recently
+        # assumed pods not yet visible in the NodeMetric are estimated;
+        # those visible-but-recent use max(estimate, usage). Their
+        # reported usage is recorded as a correction the score kernel
+        # subtracts from the node usage source (load_aware.go:300-315).
+        assigned_est = np.zeros((r,), np.float32)
+        assigned_corr = np.zeros((r,), np.float32)
+        prod_est = np.zeros((r,), np.float32)
+        prod_corr = np.zeros((r,), np.float32)
+        interval = metric.report_interval_seconds or DEFAULT_REPORT_INTERVAL_S
+        for ap in pods_per_node.get(name, []):
+            key = ap.pod.meta.namespaced_name
+            pod_usage = pod_usages.get(key)
+            recent = (ap.timestamp > metric.update_time
+                      or metric.update_time - ap.timestamp < interval)
+            # fourth clause (load_aware.go:355-360): score aggregation
+            # configured but this node has no percentile data -> the
+            # usage source contributes nothing, so estimate everything
+            agg_missing = self.score_with_aggregation and not metric.aggregated
+            is_prod = ap.pod.priority_class is PriorityClass.PROD
+            if pod_usage is None or recent or agg_missing:
+                est = estimate_pod(ap.pod, self.estimator_scaling,
+                                   self.estimator_weights)
+                if pod_usage is not None:
+                    est = np.maximum(est, pod_usage)
+                    assigned_corr += pod_usage
+                    if is_prod:
+                        prod_corr += pod_usage
+                assigned_est += est
+                if is_prod:
+                    prod_est += est
+        return (True, usage, prod_usage, agg, has_agg,
+                assigned_est, assigned_corr, prod_est, prod_corr)
+
+    def metric_delta(self, names: Sequence[str], now: Optional[float] = None,
+                     pad_to: Optional[int] = None) -> "NodeMetricDelta":
+        """Per-node metric ingest: the changed nodes' metric-derived
+        columns as a fixed-capacity delta the store applies DEVICE-SIDE
+        (snapshot/delta.py) — no full column re-upload. `pad_to` fixes the
+        delta capacity so repeated ingests hit one compiled program."""
+        from koordinator_tpu.snapshot.delta import NodeMetricDelta
+
+        now = time.time() if now is None else now
+        k = pad_to if pad_to is not None else max(len(names), 1)
+        if len(names) > k:
+            raise ValueError(f"{len(names)} metric updates exceed pad_to={k}")
+        r = NUM_RESOURCES
+        idx = np.full((k,), -1, np.int32)
+        fresh = np.zeros((k,), bool)
+        usage = np.zeros((k, r), np.float32)
+        prod_usage = np.zeros((k, r), np.float32)
+        agg = np.zeros((k, NUM_AGG, r), np.float32)
+        has_agg = np.zeros((k,), bool)
+        est = np.zeros((k, r), np.float32)
+        corr = np.zeros((k, r), np.float32)
+        p_est = np.zeros((k, r), np.float32)
+        p_corr = np.zeros((k, r), np.float32)
+        pods_per_node = self._pods_per_node()
+        for j, name in enumerate(names):
+            i = self.node_index.get(name)
+            metric = self.metrics.get(name)
+            if i is None or metric is None:
+                continue
+            idx[j] = i
+            row = self._metric_row(name, metric, now, pods_per_node)
+            if row is None:
+                continue  # expired: row stays zero, fresh False
+            (fresh[j], usage[j], prod_usage[j], agg[j], has_agg[j],
+             est[j], corr[j], p_est[j], p_corr[j]) = row
+        return NodeMetricDelta(
+            idx=idx, metric_fresh=fresh, usage=usage, prod_usage=prod_usage,
+            agg_usage=agg, has_agg=has_agg, assigned_estimated=est,
+            assigned_correction=corr, prod_assigned_estimated=p_est,
+            prod_assigned_correction=p_corr)
 
     def build_reservations(self, owner_groups: Dict[str, int],
                            nodes: "NodeState",

@@ -1,0 +1,177 @@
+"""Device-side snapshot deltas: per-node metric ingest and pod
+forget/un-assume, without re-uploading full columns.
+
+The reference keeps its scheduler caches fresh incrementally: informer
+event handlers patch NodeInfo/nodeMetric entries in place, and
+scheduler_adapter's assume/forget compensates optimistic assumptions when
+a bind fails (pkg/scheduler/frameworkext/scheduler_adapter.go; SURVEY §7
+hard part (e) — snapshot freshness inside the cycle budget).
+
+TPU design: a delta is a small fixed-capacity struct (K rows, padded with
+idx = -1) uploaded per ingest tick; application is ONE jitted scatter
+program over the device-resident snapshot, so a 10k-node cluster's metric
+churn costs an O(K) transfer + O(K) scatter instead of an O(N) rebuild
+and re-upload. Fixed K means repeated ingests reuse one compiled program.
+"""
+
+from __future__ import annotations
+
+import flax.struct
+import jax
+import jax.numpy as jnp
+
+from koordinator_tpu.snapshot.schema import Array, ClusterSnapshot
+
+__all__ = ["NodeMetricDelta", "apply_metric_delta", "forget_pods"]
+
+
+@flax.struct.dataclass
+class NodeMetricDelta:
+    """K node rows of metric-derived columns (builder.metric_delta output);
+    idx = -1 rows are padding and apply nowhere."""
+
+    idx: Array                       # i32[K] node row, -1 = pad
+    metric_fresh: Array              # bool[K]
+    usage: Array                     # f32[K, R]
+    prod_usage: Array                # f32[K, R]
+    agg_usage: Array                 # f32[K, NUM_AGG, R]
+    has_agg: Array                   # bool[K]
+    assigned_estimated: Array        # f32[K, R]
+    assigned_correction: Array       # f32[K, R]
+    prod_assigned_estimated: Array   # f32[K, R]
+    prod_assigned_correction: Array  # f32[K, R]
+
+
+@jax.jit
+def apply_metric_delta(snap: ClusterSnapshot,
+                       delta: NodeMetricDelta) -> ClusterSnapshot:
+    """Scatter the delta rows into the node columns (replace semantics —
+    each row is that node's full recomputed metric view, exactly what the
+    full rebuild would have produced for it)."""
+    nodes = snap.nodes
+    n = nodes.num_nodes
+    tgt = jnp.where(delta.idx >= 0, delta.idx, n)
+
+    def put(col, rows):
+        return col.at[tgt].set(rows, mode="drop")
+
+    nodes = nodes.replace(
+        metric_fresh=put(nodes.metric_fresh, delta.metric_fresh),
+        usage=put(nodes.usage, delta.usage),
+        prod_usage=put(nodes.prod_usage, delta.prod_usage),
+        agg_usage=put(nodes.agg_usage, delta.agg_usage),
+        has_agg=put(nodes.has_agg, delta.has_agg),
+        assigned_estimated=put(nodes.assigned_estimated,
+                               delta.assigned_estimated),
+        assigned_correction=put(nodes.assigned_correction,
+                                delta.assigned_correction),
+        prod_assigned_estimated=put(nodes.prod_assigned_estimated,
+                                    delta.prod_assigned_estimated),
+        prod_assigned_correction=put(nodes.prod_assigned_correction,
+                                     delta.prod_assigned_correction),
+    )
+    return snap.replace(nodes=nodes, version=snap.version + 1)
+
+
+@jax.jit
+def forget_pods(snap: ClusterSnapshot, pods, result,
+                mask: jnp.ndarray) -> ClusterSnapshot:
+    """Un-assume: return the charges of `mask`ed pods from a
+    schedule_batch result whose binds failed (scheduler_adapter.go
+    Forget). The exact inverse of the post-commit rebuild: node requested
+    / quota used / gang assumed / NUMA takes / GPU instances / aux VFs /
+    reservation holds all flow back, so a retry sees the capacity again.
+    """
+    from koordinator_tpu.scheduler.plugins import deviceshare
+
+    nodes, quotas, gangs = snap.nodes, snap.quotas, snap.gangs
+    resv, devices = snap.reservations, snap.devices
+    n = nodes.num_nodes
+    n_res = resv.valid.shape[0]
+    und = mask & (result.assignment >= 0)
+    on_slot = result.res_slot >= 0
+    node_tgt = jnp.where(und, result.assignment, n)
+    req = pods.requests * und[:, None]
+
+    # node requested: only non-consumers charged it (consumers drew from
+    # the reservation)
+    requested = nodes.requested.at[
+        jnp.where(und & ~on_slot, result.assignment, n)].add(
+            -req, mode="drop")
+    est = pods.estimated * und[:, None]
+    assigned_est = nodes.assigned_estimated.at[node_tgt].add(
+        -est, mode="drop")
+    is_prod = pods.priority_class == 4
+    prod_est = nodes.prod_assigned_estimated.at[node_tgt].add(
+        -est * is_prod[:, None], mode="drop")
+
+    n_quotas = quotas.used.shape[0]
+    quota_id = jnp.maximum(pods.quota_id, 0)
+    depth = quotas.depth_ancestor.shape[1]
+    pod_anc = jnp.where(pods.quota_id[:, None] >= 0,
+                        quotas.depth_ancestor[quota_id], -1)
+    used = quotas.used
+    for d in range(depth):
+        anc = jnp.where(und, pod_anc[:, d], -1)
+        used = used.at[jnp.where(anc >= 0, anc, n_quotas)].add(
+            -req, mode="drop")
+
+    n_gangs = gangs.assumed.shape[0]
+    assumed = gangs.assumed.at[
+        jnp.where(und & (pods.gang_id >= 0), jnp.maximum(pods.gang_id, 0),
+                  n_gangs)].add(-1, mode="drop")
+
+    # NUMA takes back to the node's open pool or the reservation hold
+    numa_free = jnp.minimum(
+        nodes.numa_free.at[
+            jnp.where(und & ~on_slot, result.assignment, n)].add(
+                result.numa_take * und[:, None, None], mode="drop"),
+        nodes.numa_cap)
+    slot_tgt = jnp.where(und & on_slot, jnp.maximum(result.res_slot, 0),
+                         n_res)
+    resv_numa = resv.numa_free.at[slot_tgt].add(
+        result.numa_take * und[:, None, None], mode="drop")
+
+    # GPU instances back (per-instance amounts are a pure function of
+    # (pod, node), same as the commit used)
+    n_inst = devices.gpu_free.shape[1]
+    gpu_free, resv_gpu = devices.gpu_free, resv.gpu_free
+    if n_inst:
+        _, per_f = deviceshare.per_instance_at(devices, pods,
+                                               result.assignment)
+        g_upd = (result.gpu_take[:, :, None] * per_f[:, None, :]
+                 * und[:, None, None])
+        gpu_free = devices.gpu_free.at[
+            jnp.where(und & ~on_slot, result.assignment, n)].add(
+                g_upd, mode="drop")
+        resv_gpu = resv.gpu_free.at[slot_tgt].add(g_upd, mode="drop")
+    n_aux = devices.aux_free.shape[2]
+    aux_free = devices.aux_free
+    if n_aux:
+        flat = aux_free.reshape(-1, 1)
+        n_types = aux_free.shape[1]
+        for t in range(n_types):
+            a_req = pods.requests[:, deviceshare.AUX_KINDS[t]]
+            took = und & (a_req > 0) & (result.aux_inst[:, t] >= 0)
+            base = (jnp.maximum(result.assignment, 0) * n_types + t) * n_aux
+            seg = jnp.where(took, base + result.aux_inst[:, t],
+                            n * n_types * n_aux)
+            flat = flat.at[seg].add((a_req * took)[:, None], mode="drop")
+        aux_free = flat.reshape(aux_free.shape)
+
+    resv_free = resv.free.at[slot_tgt].add(req, mode="drop")
+    # a forgotten AllocateOnce winner re-opens its slot
+    reopen = jnp.zeros((n_res,), bool).at[slot_tgt].max(
+        und, mode="drop") & resv.allocate_once
+    return snap.replace(
+        nodes=nodes.replace(requested=jnp.maximum(requested, 0.0),
+                            assigned_estimated=jnp.maximum(assigned_est, 0.0),
+                            prod_assigned_estimated=jnp.maximum(prod_est, 0.0),
+                            numa_free=numa_free),
+        quotas=quotas.replace(used=jnp.maximum(used, 0.0)),
+        gangs=gangs.replace(assumed=jnp.maximum(assumed, 0)),
+        reservations=resv.replace(free=resv_free, numa_free=resv_numa,
+                                  gpu_free=resv_gpu,
+                                  valid=resv.valid | reopen),
+        devices=devices.replace(gpu_free=gpu_free, aux_free=aux_free),
+        version=snap.version + 1)

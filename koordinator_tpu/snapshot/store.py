@@ -2,10 +2,12 @@
 
 The reference keeps informer caches fresh via watch streams and takes an
 immutable NodeInfo snapshot per scheduling cycle. Here the host builds a new
-columnar snapshot (or applies deltas) and uploads it to device asynchronously
-while the previous version is still being consumed by in-flight kernels —
-classic double buffering to hide HBM transfer latency behind compute
-(SURVEY.md 2.9 "double-buffered device upload").
+columnar snapshot and uploads it asynchronously while the previous version
+is still being consumed by in-flight kernels — classic double buffering to
+hide HBM transfer latency behind compute (SURVEY.md 2.9) — and between
+rebuilds the store stays fresh with O(K) device-side deltas: `ingest`
+scatters per-node metric updates, `forget` un-assumes failed binds
+(snapshot/delta.py; scheduler_adapter.go assume/forget).
 """
 
 from __future__ import annotations
@@ -64,3 +66,18 @@ class SnapshotStore:
             self._current = fn(self._current)
             self._version += 1
             return self._current
+
+    def ingest(self, delta) -> ClusterSnapshot:
+        """Apply a NodeMetricDelta device-side (snapshot/delta.py): an
+        O(K) upload + scatter instead of an O(N) rebuild — the informer
+        event-handler path of the reference, on columns."""
+        from koordinator_tpu.snapshot.delta import apply_metric_delta
+
+        return self.update(lambda s: apply_metric_delta(s, delta))
+
+    def forget(self, pods, result, mask) -> ClusterSnapshot:
+        """Un-assume failed binds (scheduler_adapter.go Forget): returns
+        the masked pods' charges to the snapshot device-side."""
+        from koordinator_tpu.snapshot.delta import forget_pods
+
+        return self.update(lambda s: forget_pods(s, pods, result, mask))

@@ -1,0 +1,158 @@
+"""Delta ingest + forget/un-assume (snapshot/delta.py;
+scheduler_adapter.go assume/forget; SURVEY §7 hard part (e) — snapshot
+freshness within the cycle budget).
+
+Invariants:
+- applying a metric delta produces EXACTLY the columns a full rebuild
+  would (the two paths share builder._metric_row);
+- forget is the inverse of the schedule commit: capacity flows back and a
+  retry succeeds where the stale snapshot would have rejected;
+- a 10k-node ingest tick fits far inside the 2 s cycle budget.
+"""
+
+import time
+
+import numpy as np
+
+from koordinator_tpu.api.extension import ResourceKind as RK
+from koordinator_tpu.api.types import Node, NodeMetric, ObjectMeta, Pod, Reservation
+from koordinator_tpu.scheduler import core
+from koordinator_tpu.scheduler.plugins import loadaware
+from koordinator_tpu.snapshot import SnapshotBuilder, SnapshotStore
+from koordinator_tpu.snapshot.delta import apply_metric_delta, forget_pods
+
+NOW = 1_700_000_000.0
+CFG = loadaware.LoadAwareConfig.make()
+
+
+def make_builder(n=4, cpu=10_000.0, mem=20_480.0):
+    b = SnapshotBuilder(max_nodes=n)
+    for i in range(n):
+        b.add_node(Node(meta=ObjectMeta(name=f"n{i}"),
+                        allocatable={RK.CPU: cpu, RK.MEMORY: mem}))
+        b.set_node_metric(NodeMetric(node_name=f"n{i}", update_time=NOW - 5,
+                                     node_usage={RK.CPU: 500.0,
+                                                 RK.MEMORY: 1024.0}))
+    return b
+
+
+def test_metric_delta_matches_full_rebuild():
+    b = make_builder()
+    snap, _ = b.build(now=NOW)
+    # two nodes report new metrics
+    b.set_node_metric(NodeMetric(node_name="n1", update_time=NOW + 5,
+                                 node_usage={RK.CPU: 4_000.0,
+                                             RK.MEMORY: 8_192.0}))
+    b.set_node_metric(NodeMetric(node_name="n3", update_time=NOW + 5,
+                                 node_usage={RK.CPU: 9_999.0}))
+    delta = b.metric_delta(["n1", "n3"], now=NOW + 6, pad_to=4)
+    patched = apply_metric_delta(snap, delta)
+    rebuilt, _ = b.build(now=NOW + 6)
+    for field in ("usage", "prod_usage", "agg_usage", "metric_fresh",
+                  "has_agg", "assigned_estimated", "assigned_correction",
+                  "prod_assigned_estimated", "prod_assigned_correction"):
+        np.testing.assert_allclose(
+            np.asarray(getattr(patched.nodes, field)),
+            np.asarray(getattr(rebuilt.nodes, field)),
+            err_msg=field)
+
+
+def test_metric_delta_expired_marks_stale():
+    b = make_builder()
+    snap, _ = b.build(now=NOW)
+    assert bool(np.asarray(snap.nodes.metric_fresh)[2])
+    # n2's metric ages out -> the delta marks it unfresh
+    delta = b.metric_delta(["n2"], now=NOW + 10_000, pad_to=2)
+    patched = apply_metric_delta(snap, delta)
+    fresh = np.asarray(patched.nodes.metric_fresh)
+    assert not fresh[2] and fresh[0] and fresh[1] and fresh[3]
+
+
+def test_store_ingest_bumps_version_without_rebuild():
+    b = make_builder()
+    snap, _ = b.build(now=NOW)
+    store = SnapshotStore()
+    store.publish(snap)
+    v0 = store.version
+    b.set_node_metric(NodeMetric(node_name="n0", update_time=NOW + 1,
+                                 node_usage={RK.CPU: 7_000.0}))
+    store.ingest(b.metric_delta(["n0"], now=NOW + 2, pad_to=2))
+    assert store.version == v0 + 1
+    got = np.asarray(store.current().nodes.usage)[0, int(RK.CPU)]
+    np.testing.assert_allclose(got, 7_000.0)
+
+
+def test_forget_returns_capacity_and_allows_retry():
+    # fill a node, forget the pod, the same request fits again
+    b = make_builder(n=1, cpu=4_000.0)
+    snap, ctx = b.build(now=NOW)
+    pod = Pod(meta=ObjectMeta(name="p"),
+              requests={RK.CPU: 3_000.0, RK.MEMORY: 2_048.0}, priority=9000)
+    batch = b.build_pod_batch([pod], ctx)
+    res = core.schedule_batch(snap, batch, CFG, num_rounds=2)
+    assert int(res.assignment[0]) == 0
+    # without forget the next identical pod cannot fit
+    res2 = core.schedule_batch(res.snapshot, batch, CFG, num_rounds=2)
+    assert int(res2.assignment[0]) == -1
+    # bind failed -> forget -> retry fits
+    reverted = forget_pods(res.snapshot, batch, res,
+                           np.asarray([True]))
+    np.testing.assert_allclose(np.asarray(reverted.nodes.requested),
+                               np.asarray(snap.nodes.requested))
+    res3 = core.schedule_batch(reverted, batch, CFG, num_rounds=2)
+    assert int(res3.assignment[0]) == 0
+
+
+def test_forget_restores_reservation_consumer():
+    b = make_builder(n=1)
+    b.add_reservation(Reservation(
+        meta=ObjectMeta(name="r0"),
+        requests={RK.CPU: 4_000.0, RK.MEMORY: 4_096.0},
+        owner_label_selector={"team": "a"}, allocate_once=True,
+        node_name="n0", phase="Available"))
+    snap, ctx = b.build(now=NOW)
+    owner = Pod(meta=ObjectMeta(name="o", labels={"team": "a"}),
+                requests={RK.CPU: 2_000.0, RK.MEMORY: 2_048.0},
+                priority=9000)
+    batch = b.build_pod_batch([owner], ctx)
+    res = core.schedule_batch(snap, batch, CFG, num_rounds=2)
+    assert int(res.assignment[0]) == 0
+    assert int(res.res_slot[0]) == 0
+    rv = res.snapshot.reservations
+    assert not bool(np.asarray(rv.valid)[0])  # AllocateOnce consumed
+    reverted = forget_pods(res.snapshot, batch, res, np.asarray([True]))
+    rv2 = reverted.reservations
+    assert bool(np.asarray(rv2.valid)[0])     # slot re-opened
+    np.testing.assert_allclose(np.asarray(rv2.free)[0, int(RK.CPU)],
+                               4_000.0)
+    # node requested unchanged by the consumer round-trip
+    np.testing.assert_allclose(np.asarray(reverted.nodes.requested),
+                               np.asarray(snap.nodes.requested))
+
+
+def test_ingest_10k_nodes_fits_cycle_budget():
+    n = 10_000
+    b = SnapshotBuilder(max_nodes=n)
+    for i in range(n):
+        b.add_node(Node(meta=ObjectMeta(name=f"n{i}"),
+                        allocatable={RK.CPU: 32_000.0, RK.MEMORY: 65_536.0}))
+        b.set_node_metric(NodeMetric(node_name=f"n{i}", update_time=NOW - 5,
+                                     node_usage={RK.CPU: 1_000.0}))
+    snap, _ = b.build(now=NOW)
+    store = SnapshotStore()
+    store.publish(snap)
+    # a realistic tick: 256 nodes report between cycles
+    names = [f"n{i}" for i in range(0, 2560, 10)]
+    for name in names[:16]:
+        b.set_node_metric(NodeMetric(node_name=name, update_time=NOW + 1,
+                                     node_usage={RK.CPU: 5_000.0}))
+    delta = b.metric_delta(names, now=NOW + 2, pad_to=256)
+    store.ingest(delta)  # warm-up compiles the scatter program
+    t0 = time.perf_counter()
+    for _ in range(5):
+        out = store.ingest(delta)
+    np.asarray(out.nodes.usage)  # force materialization
+    per_tick = (time.perf_counter() - t0) / 5
+    # SURVEY §7: the whole scheduling cycle has a 2 s budget; ingest must
+    # be a rounding error within it
+    assert per_tick < 2.0, f"ingest tick took {per_tick:.3f}s"

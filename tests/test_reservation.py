@@ -80,8 +80,9 @@ def test_matching_pod_consumes_without_recharging_node():
     np.testing.assert_allclose(np.asarray(res.snapshot.nodes.requested),
                                np.asarray(snap.nodes.requested), atol=0.5)
     free = np.asarray(res.snapshot.reservations.free)[0]
-    # AllocateOnce: fully exhausted after its single consumer
-    assert free[int(RK.CPU)] == 0.0
+    # AllocateOnce: exhausted after its single consumer (valid=False gates
+    # admission; the remainder is kept so forget can restore it exactly)
+    assert free[int(RK.CPU)] == 2_000.0
     assert not bool(np.asarray(res.snapshot.reservations.valid)[0])
     assert float(res.chosen_score[0]) == core.MAX_NODE_SCORE
 
@@ -321,8 +322,10 @@ def test_consumer_gets_reserved_zone_cpuset():
     # node open pool untouched; the hold shrank instead
     nf2 = np.asarray(res.snapshot.nodes.numa_free)[0]
     np.testing.assert_allclose(nf2[1, 0], 4_000.0)
+    # remainder is kept (valid=False gates admission; forget can restore)
     rnf2 = np.asarray(res.snapshot.reservations.numa_free)[0]
-    np.testing.assert_allclose(rnf2[1], [0.0, 0.0])  # once -> zeroed
+    np.testing.assert_allclose(rnf2[1], [1_000.0, 2_048.0])
+    assert not bool(np.asarray(res.snapshot.reservations.valid)[0])
 
 
 def test_shared_reservation_zone_hold_drains_across_consumers():
