@@ -346,6 +346,72 @@ class Host:
         """resource in {cpu, memory, io}."""
         return parse_psi(self.read_cgroup(cgroup_dir, f"{resource}.pressure"))
 
+    def memory_usage_with_page_cache_bytes(self, cgroup_dir: str) -> int:
+        """Raw cgroup usage INCLUDING page cache (pagecache collector;
+        page_cache_collector.go collectPodPageCache reads usage without
+        the inactive-file subtraction)."""
+        return int(self.read_cgroup(cgroup_dir, "memory.usage_in_bytes"))
+
+    # -- kidled cold memory (util/system/kidled_util.go) ---------------------
+
+    @property
+    def kidled_root(self) -> str:
+        return self.path("sys", "kernel", "mm", "kidled")
+
+    def kidled_supported(self) -> bool:
+        """IsKidledSupport: both kidled sysfs knobs exist."""
+        return (os.path.isfile(os.path.join(self.kidled_root,
+                                            "scan_period_in_seconds"))
+                and os.path.isfile(os.path.join(self.kidled_root,
+                                                "use_hierarchy")))
+
+    def kidled_start(self, scan_period_s: int = 5,
+                     use_hierarchy: int = 1) -> None:
+        """SetKidledScanPeriodInSeconds/SetKidledUseHierarchy — arm the
+        kernel idle-page scanner (NewDefaultKidledConfig)."""
+        self.write(os.path.join(self.kidled_root, "scan_period_in_seconds"),
+                   str(scan_period_s))
+        self.write(os.path.join(self.kidled_root, "use_hierarchy"),
+                   str(use_hierarchy))
+
+    def cold_page_bytes(self, cgroup_dir: str) -> int:
+        """Idle (cold) file-page bytes of a cgroup from kidled's
+        memory.idle_page_stats: Σ cfei+dfei+cfui+dfui over all age
+        buckets (ColdPageInfoByKidled.GetColdPageTotalBytes,
+        kidled_util.go:140-143)."""
+        text = self.read_cgroup(cgroup_dir, "memory.idle_page_stats")
+        total = 0
+        for line in text.splitlines():
+            fields = line.split()
+            if not fields or fields[0].lstrip("#") == "":
+                continue
+            if fields[0] in ("cfei", "dfei", "cfui", "dfui"):
+                total += sum(int(x) for x in fields[1:])
+        return total
+
+    # -- local storage (nodestorageinfo collector) ---------------------------
+
+    def diskstats(self) -> List[Dict[str, int]]:
+        """/proc/diskstats rows as dicts (device, reads, read_sectors,
+        writes, write_sectors, io_in_progress, io_ticks_ms); partition
+        rows included — callers filter."""
+        out: List[Dict[str, int]] = []
+        try:
+            text = self.read(os.path.join(self.proc_root, "diskstats"))
+        except FileNotFoundError:
+            return out
+        for line in text.splitlines():
+            f = line.split()
+            if len(f) < 13:
+                continue
+            out.append({
+                "major": int(f[0]), "minor": int(f[1]), "device": f[2],
+                "reads": int(f[3]), "read_sectors": int(f[5]),
+                "writes": int(f[7]), "write_sectors": int(f[9]),
+                "io_in_progress": int(f[11]), "io_ticks_ms": int(f[12]),
+            })
+        return out
+
     def proc_stat_cpu_ticks(self) -> Tuple[int, int]:
         """(total_ticks, idle_ticks incl. iowait) from /proc/stat."""
         text = self.read(os.path.join(self.proc_root, "stat"))
