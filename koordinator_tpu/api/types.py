@@ -168,6 +168,17 @@ class PodMetricInfo:
 
 
 @dataclasses.dataclass
+class HostApplicationMetricInfo:
+    """Usage of one out-of-band host application
+    (nodemetric_types.go:67-78)."""
+
+    name: str = ""
+    usage: ResourceList = dataclasses.field(default_factory=dict)
+    priority_class: PriorityClass = PriorityClass.NONE
+    qos: QoSClass = QoSClass.NONE
+
+
+@dataclasses.dataclass
 class NodeMetric:
     """Per-node usage report written by the node agent
     (slo/v1alpha1 NodeMetric, nodemetric_types.go:39-123)."""
@@ -179,6 +190,8 @@ class NodeMetric:
     system_usage: ResourceList = dataclasses.field(default_factory=dict)
     aggregated: List[AggregatedUsage] = dataclasses.field(default_factory=list)
     pods_metric: List[PodMetricInfo] = dataclasses.field(default_factory=list)
+    host_app_metric: List[HostApplicationMetricInfo] = dataclasses.field(
+        default_factory=list)
     prod_reclaimable: ResourceList = dataclasses.field(default_factory=dict)
 
     def is_expired(self, expiration_seconds: float,
@@ -251,6 +264,20 @@ class SystemStrategy:
 
 
 @dataclasses.dataclass
+class HostApplication:
+    """Out-of-band application running directly on the host, under agent
+    QoS management (slo/v1alpha1 host_application.go:24-34). When
+    `cgroup_dir` is empty the agent derives it from the QoS class
+    (host-latency-sensitive/<name> or host-best-effort/<name>,
+    util/host_application.go:28-46)."""
+
+    name: str = ""
+    priority_class: PriorityClass = PriorityClass.NONE
+    qos: QoSClass = QoSClass.NONE
+    cgroup_dir: str = ""   # explicit relative cgroup dir override
+
+
+@dataclasses.dataclass
 class NodeSLO:
     node_name: str = ""
     threshold: ResourceThresholdStrategy = dataclasses.field(
@@ -260,6 +287,8 @@ class NodeSLO:
     resource_qos: ResourceQOSStrategy = dataclasses.field(
         default_factory=ResourceQOSStrategy)
     system: SystemStrategy = dataclasses.field(default_factory=SystemStrategy)
+    host_applications: List[HostApplication] = dataclasses.field(
+        default_factory=list)
 
 
 # --- Scheduling CRDs --------------------------------------------------------

@@ -54,6 +54,9 @@ class DaemonConfig:
     # perf_reader is given, the Daemon probes the native shim and degrades
     # to no CPI if the host refuses perf access
     enable_perf_group: bool = False
+    # PageCacheCollector gate (koordlet_features.go PageCacheCollector);
+    # kidled cold memory self-gates on kernel support instead
+    enable_page_cache: bool = False
 
 
 class Daemon:
@@ -62,7 +65,8 @@ class Daemon:
     def __init__(self, host: Host, cfg: Optional[DaemonConfig] = None,
                  auditor: Auditor = NULL_AUDITOR,
                  perf_reader: Optional[Callable] = None,
-                 metrics: Optional[KoordletMetrics] = None):
+                 metrics: Optional[KoordletMetrics] = None,
+                 device_reader: Optional[Callable] = None):
         self.host = host
         self.cfg = cfg or DaemonConfig()
         cfg = self.cfg
@@ -75,7 +79,9 @@ class Daemon:
             from koordinator_tpu.native import cycles_instructions_reader
             perf_reader = cycles_instructions_reader()
         self.advisor: Advisor = default_advisor(
-            host, self.metric_cache, self.informer, perf_reader)
+            host, self.metric_cache, self.informer, perf_reader,
+            device_reader=device_reader,
+            enable_page_cache=cfg.enable_page_cache)
         self.predictor = PeakPredictServer(
             self.informer, self.metric_cache,
             PredictConfig(checkpoint_path=cfg.checkpoint_path))

@@ -36,7 +36,27 @@ PSI_IO_FULL_AVG10 = "psi_io_full_avg10"
 CONTAINER_CPI_CYCLES = "container_cpi_cycles"        # labels: pod_uid, container
 CONTAINER_CPI_INSTRUCTIONS = "container_cpi_instructions"
 HOST_APP_CPU_USAGE = "host_app_cpu_usage"    # labels: app
-COLD_PAGE_BYTES = "cold_page_bytes"          # kidled cold memory
+HOST_APP_MEMORY_USAGE = "host_app_memory_usage"  # labels: app
+# kidled cold memory; labels: {} = node, pod_uid = pod, app = host app
+COLD_PAGE_BYTES = "cold_page_bytes"
+# usage WITHOUT the inactive-file subtraction (pagecache collector)
+NODE_MEMORY_USAGE_WITH_PAGE_CACHE = "node_memory_usage_with_page_cache"
+POD_MEMORY_USAGE_WITH_PAGE_CACHE = "pod_memory_usage_with_page_cache"
+# usage counting only HOT page cache: with_page_cache - cold (kidled)
+NODE_MEMORY_WITH_HOT_PAGE_USAGE = "node_memory_with_hot_page_usage"
+# accelerator devices; labels: minor (+ pod_uid for the pod-level series)
+GPU_CORE_USAGE = "gpu_core_usage"            # percent of device cores
+GPU_MEMORY_USED = "gpu_memory_used"          # bytes
+GPU_MEMORY_TOTAL = "gpu_memory_total"        # bytes (device capacity)
+POD_GPU_CORE_USAGE = "pod_gpu_core_usage"    # labels: pod_uid, minor
+POD_GPU_MEMORY_USED = "pod_gpu_memory_used"
+# local storage; labels: device
+NODE_DISK_IO_UTIL = "node_disk_io_util"      # percent busy
+NODE_DISK_READ_BPS = "node_disk_read_bps"    # bytes/s
+NODE_DISK_WRITE_BPS = "node_disk_write_bps"
+
+# KV keys (kv_storage.go point-in-time objects)
+NODE_LOCAL_STORAGE_KEY = "node_local_storage_info"
 
 AGGREGATIONS = ("avg", "p50", "p90", "p95", "p99", "latest", "count", "max")
 

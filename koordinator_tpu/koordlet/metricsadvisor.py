@@ -15,11 +15,15 @@ run loop calls it on the collect interval, tests call it directly.
 
 from __future__ import annotations
 
+import os
 import time
 from typing import Callable, Dict, List, Optional, Protocol, Tuple
 
 from koordinator_tpu.koordlet import metriccache as mc
-from koordinator_tpu.koordlet.statesinformer import StatesInformer
+from koordinator_tpu.koordlet.statesinformer import (
+    StatesInformer,
+    host_app_cgroup_dir,
+)
 from koordinator_tpu.koordlet.system import Host
 
 _NS = 1e9
@@ -204,6 +208,277 @@ class PerformanceCollector:
                               float(instructions), labels)
 
 
+class PageCacheCollector:
+    """Memory usage INCLUDING page cache (collectors/pagecache/
+    page_cache_collector.go): node = MemTotal - MemFree (no MemAvailable
+    credit, meminfo.go:107-110); pod = raw cgroup usage without the
+    inactive-file subtraction."""
+
+    name = "pagecache"
+
+    def __init__(self, host: Host, cache: mc.MetricCache,
+                 informer: StatesInformer):
+        self.host = host
+        self.cache = cache
+        self.informer = informer
+
+    def collect(self, now: float) -> None:
+        try:
+            meminfo = self.host.meminfo()
+        except (FileNotFoundError, ValueError):
+            return
+        if "MemTotal" in meminfo:
+            used = float(meminfo["MemTotal"] - meminfo.get("MemFree", 0))
+            self.cache.append(mc.NODE_MEMORY_USAGE_WITH_PAGE_CACHE, now, used)
+        for meta in self.informer.get_all_pods():
+            try:
+                b = self.host.memory_usage_with_page_cache_bytes(
+                    meta.cgroup_dir)
+            except (FileNotFoundError, ValueError):
+                continue
+            self.cache.append(mc.POD_MEMORY_USAGE_WITH_PAGE_CACHE, now,
+                              float(b), {"pod_uid": meta.pod.meta.uid})
+
+
+class ColdPageCollector:
+    """kidled cold-page accounting (collectors/coldmemoryresource/
+    cold_page_kidled.go): arms the kernel idle-page scanner once, then
+    samples cold bytes for node / pods / host apps plus the node
+    hot-page usage (= usage-with-page-cache - cold, cold_page.go:23-28).
+    Inert when the kernel lacks kidled (cold_page_collector.go Enabled)."""
+
+    name = "coldmemory"
+
+    def __init__(self, host: Host, cache: mc.MetricCache,
+                 informer: StatesInformer):
+        self.host = host
+        self.cache = cache
+        self.informer = informer
+        self._armed = False
+
+    def collect(self, now: float) -> None:
+        if not self.host.kidled_supported():
+            return
+        if not self._armed:
+            try:
+                self.host.kidled_start()
+            except OSError:
+                return
+            self._armed = True
+        try:
+            node_cold = self.host.cold_page_bytes("")
+        except (FileNotFoundError, ValueError):
+            return
+        self.cache.append(mc.COLD_PAGE_BYTES, now, float(node_cold))
+        # the derived hot-page series alone depends on meminfo — a meminfo
+        # hiccup must not drop the per-pod/per-app samples below
+        try:
+            meminfo = self.host.meminfo()
+        except (FileNotFoundError, ValueError):
+            meminfo = {}
+        if "MemTotal" in meminfo:
+            with_cache = meminfo["MemTotal"] - meminfo.get("MemFree", 0)
+            self.cache.append(mc.NODE_MEMORY_WITH_HOT_PAGE_USAGE, now,
+                              float(max(0, with_cache - node_cold)))
+        for meta in self.informer.get_all_pods():
+            try:
+                cold = self.host.cold_page_bytes(meta.cgroup_dir)
+            except (FileNotFoundError, ValueError):
+                continue
+            self.cache.append(mc.COLD_PAGE_BYTES, now, float(cold),
+                              {"pod_uid": meta.pod.meta.uid})
+        slo = self.informer.get_node_slo()
+        for app in (slo.host_applications if slo else []):
+            try:
+                cold = self.host.cold_page_bytes(host_app_cgroup_dir(app))
+            except (FileNotFoundError, ValueError):
+                continue
+            self.cache.append(mc.COLD_PAGE_BYTES, now, float(cold),
+                              {"app": app.name})
+
+
+class HostAppCollector:
+    """CPU/memory usage of NodeSLO host applications (collectors/
+    hostapplication/host_app_collector.go:87-140): cgroup CPU delta ->
+    cores, working-set memory; first sample per app is skipped (needs a
+    prior cpuacct reading)."""
+
+    name = "hostapplication"
+
+    def __init__(self, host: Host, cache: mc.MetricCache,
+                 informer: StatesInformer):
+        self.host = host
+        self.cache = cache
+        self.informer = informer
+        self._cpu = _CgroupCPUTracker(host)
+
+    def collect(self, now: float) -> None:
+        slo = self.informer.get_node_slo()
+        if slo is None:
+            return
+        for app in slo.host_applications:
+            cgroup_dir = host_app_cgroup_dir(app)
+            labels = {"app": app.name}
+            cores = self._cpu.cores(cgroup_dir, now)
+            if cores is not None:
+                self.cache.append(mc.HOST_APP_CPU_USAGE, now, cores, labels)
+            try:
+                b = self.host.memory_usage_bytes(cgroup_dir)
+            except (FileNotFoundError, ValueError):
+                continue
+            self.cache.append(mc.HOST_APP_MEMORY_USAGE, now, float(b), labels)
+
+
+class NodeStorageInfoCollector:
+    """Local-storage inventory + IO rates (collectors/nodestorageinfo/
+    node_info_collector.go:65-88): the disk/partition maps land in the
+    metric-cache KV as `NODE_LOCAL_STORAGE_KEY` (the reference stores
+    NodeLocalStorageInfo the same way); /proc/diskstats counter deltas
+    additionally feed busy-percent and read/write byte-rate series. Disks are
+    distinguished from partitions by /sys/block/<dev> existence."""
+
+    name = "nodestorageinfo"
+    _SECTOR = 512
+
+    def __init__(self, host: Host, cache: mc.MetricCache):
+        self.host = host
+        self.cache = cache
+        self._prev: Dict[str, Tuple[float, Dict[str, int]]] = {}
+
+    def collect(self, now: float) -> None:
+        rows = self.host.diskstats()
+        if not rows:
+            return
+        sys_block = self.host.path("sys", "block")
+        disks = set()
+        try:
+            disks = set(os.listdir(sys_block))
+        except FileNotFoundError:
+            pass
+        partition_disk: Dict[str, str] = {}
+        for r in rows:
+            if r["device"] in disks:
+                continue
+            # longest disk name that prefixes the partition name
+            owner = max((d for d in disks if r["device"].startswith(d)),
+                        key=len, default="")
+            if owner:
+                partition_disk[r["device"]] = owner
+        self.cache.set_kv(mc.NODE_LOCAL_STORAGE_KEY, {
+            "disks": sorted(disks & {r["device"] for r in rows}),
+            "partition_disk": partition_disk,
+        })
+        seen = set()
+        for r in rows:
+            dev = r["device"]
+            if dev not in disks:
+                continue
+            prev = self._prev.get(dev)
+            self._prev[dev] = (now, r)
+            seen.add(dev)
+            if prev is None or now <= prev[0]:
+                continue
+            dt = now - prev[0]
+            p = prev[1]
+            labels = {"device": dev}
+            # clamp both ends: counter resets (device re-add, 32-bit wrap)
+            # must not record negative utilization
+            self.cache.append(
+                mc.NODE_DISK_IO_UTIL, now,
+                max(0.0, min(100.0, (r["io_ticks_ms"] - p["io_ticks_ms"])
+                             / (10.0 * dt))), labels)
+            self.cache.append(
+                mc.NODE_DISK_READ_BPS, now,
+                max(0.0, (r["read_sectors"] - p["read_sectors"])
+                    * self._SECTOR / dt), labels)
+            self.cache.append(
+                mc.NODE_DISK_WRITE_BPS, now,
+                max(0.0, (r["write_sectors"] - p["write_sectors"])
+                    * self._SECTOR / dt), labels)
+        # prune trackers for removed devices: a later same-named device
+        # (dm-N churn) must start a fresh delta, and retired names must
+        # not accumulate for the daemon's lifetime
+        for dev in list(self._prev):
+            if dev not in seen:
+                del self._prev[dev]
+
+
+class DeviceUsage:
+    """One accelerator's instantaneous usage as returned by the injected
+    device reader (the NVML poll of collector_gpu_linux.go:100-135;
+    TPU builds read the same shape from the runtime's per-chip stats).
+    `procs` maps pid -> (core_usage_percent, memory_bytes) for pod
+    attribution."""
+
+    __slots__ = ("minor", "core_usage", "memory_used", "memory_total",
+                 "procs")
+
+    def __init__(self, minor: int, core_usage: float, memory_used: int,
+                 memory_total: int = 0,
+                 procs: Optional[Dict[int, Tuple[float, int]]] = None):
+        self.minor = minor
+        self.core_usage = core_usage
+        self.memory_used = memory_used
+        self.memory_total = memory_total
+        self.procs = procs or {}
+
+
+class DeviceCollector:
+    """Accelerator usage collector (metricsadvisor/devices/gpu/
+    collector_gpu_linux.go): node series per minor, pod series by joining
+    device process pids against pod cgroup.procs (the reference joins the
+    other way round via /proc/<pid>/cgroup; same equivalence class)."""
+
+    name = "device"
+
+    def __init__(self, host: Host, cache: mc.MetricCache,
+                 informer: StatesInformer,
+                 device_reader: Callable[[], List[DeviceUsage]]):
+        self.host = host
+        self.cache = cache
+        self.informer = informer
+        self.device_reader = device_reader
+
+    def _pid_to_pod(self) -> Dict[int, str]:
+        # recursive: pod cgroups are interior nodes whose processes live in
+        # container leaf cgroups (v2 forbids interior procs outright)
+        out: Dict[int, str] = {}
+        for meta in self.informer.get_all_pods():
+            for pid in self.host.cgroup_procs_recursive(meta.cgroup_dir):
+                out[pid] = meta.pod.meta.uid
+        return out
+
+    def collect(self, now: float) -> None:
+        usages = self.device_reader()
+        if not usages:
+            return
+        # the cgroup-tree walk is only worth it when something needs
+        # attributing (TPU readers usually report device-level only)
+        pid_pod = (self._pid_to_pod()
+                   if any(u.procs for u in usages) else {})
+        per_pod: Dict[Tuple[str, int], Tuple[float, int]] = {}
+        for u in usages:
+            labels = {"minor": str(u.minor)}
+            self.cache.append(mc.GPU_CORE_USAGE, now, float(u.core_usage),
+                              labels)
+            self.cache.append(mc.GPU_MEMORY_USED, now, float(u.memory_used),
+                              labels)
+            if u.memory_total > 0:
+                self.cache.append(mc.GPU_MEMORY_TOTAL, now,
+                                  float(u.memory_total), labels)
+            for pid, (core, membytes) in u.procs.items():
+                uid = pid_pod.get(pid)
+                if uid is None:
+                    continue
+                c, m = per_pod.get((uid, u.minor), (0.0, 0))
+                per_pod[(uid, u.minor)] = (c + core, m + membytes)
+        for (uid, minor), (core, membytes) in per_pod.items():
+            labels = {"pod_uid": uid, "minor": str(minor)}
+            self.cache.append(mc.POD_GPU_CORE_USAGE, now, core, labels)
+            self.cache.append(mc.POD_GPU_MEMORY_USED, now, float(membytes),
+                              labels)
+
+
 class Advisor:
     """The collector registry + drive loop (framework/plugin.go registry;
     metrics_advisor.go:72-102 per-collector goroutines collapse into one
@@ -213,11 +488,21 @@ class Advisor:
                  collect_interval: float = 1.0):
         self.collectors = collectors
         self.collect_interval = collect_interval
+        # collector name -> last raised exception; one failing collector
+        # (e.g. a device reader hitting a driver reset) must not kill the
+        # whole collection loop (the reference isolates collectors in their
+        # own goroutines, metrics_advisor.go:72-102)
+        self.last_errors: Dict[str, BaseException] = {}
 
     def collect_once(self, now: Optional[float] = None) -> None:
         now = time.time() if now is None else now
         for c in self.collectors:
-            c.collect(now)
+            try:
+                c.collect(now)
+            except Exception as e:  # noqa: BLE001 - isolation boundary
+                self.last_errors[c.name] = e
+            else:
+                self.last_errors.pop(c.name, None)
 
     def run(self, stop: Callable[[], bool]) -> None:
         while not stop():
@@ -227,14 +512,25 @@ class Advisor:
 
 def default_advisor(host: Host, cache: mc.MetricCache,
                     informer: StatesInformer,
-                    perf_reader: Optional[Callable] = None) -> Advisor:
+                    perf_reader: Optional[Callable] = None,
+                    device_reader: Optional[
+                        Callable[[], List[DeviceUsage]]] = None,
+                    enable_page_cache: bool = False) -> Advisor:
     cs: List[Collector] = [
         NodeResourceCollector(host, cache),
         PodResourceCollector(host, cache, informer),
         BEResourceCollector(host, cache),
         SysResourceCollector(cache),
         PSICollector(host, cache, informer),
+        HostAppCollector(host, cache, informer),
+        NodeStorageInfoCollector(host, cache),
+        # self-gating: inert unless the kernel has kidled
+        ColdPageCollector(host, cache, informer),
     ]
+    if enable_page_cache:
+        cs.append(PageCacheCollector(host, cache, informer))
     if perf_reader is not None:
         cs.append(PerformanceCollector(cache, informer, perf_reader))
+    if device_reader is not None:
+        cs.append(DeviceCollector(host, cache, informer, device_reader))
     return Advisor(cs)

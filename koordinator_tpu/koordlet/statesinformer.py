@@ -49,6 +49,19 @@ def _qos_tier(qos: QoSClass) -> str:
     return "burstable"
 
 
+def host_app_cgroup_dir(app: api.HostApplication) -> str:
+    """Relative cgroup dir of an out-of-band host application
+    (util/host_application.go:33-46): explicit override wins, else
+    derived from the QoS class."""
+    if app.cgroup_dir:
+        return app.cgroup_dir
+    if app.qos in (QoSClass.LSE, QoSClass.LSR, QoSClass.LS):
+        return f"host-latency-sensitive/{app.name}"
+    if app.qos is QoSClass.BE:
+        return f"host-best-effort/{app.name}"
+    return app.name
+
+
 @dataclasses.dataclass
 class PodMeta:
     """A pod plus its node-local cgroup location (statesinformer.PodMeta)."""
@@ -217,6 +230,21 @@ class NodeMetricReporter:
                 name=meta.pod.meta.name,
                 priority_class=meta.pod.priority_class,
                 usage=usage_rl(pc, pm)))
+
+        # host application usage (states_nodemetric.go:357-389 /
+        # collectHostAppMetric:717-757)
+        slo = self.informer.get_node_slo()
+        for app in (slo.host_applications if slo else []):
+            labels = {"app": app.name}
+            ac = self.cache.query(mc.HOST_APP_CPU_USAGE, now - win, now,
+                                  labels, "avg")
+            am = self.cache.query(mc.HOST_APP_MEMORY_USAGE, now - win, now,
+                                  labels, "avg")
+            if ac is None and am is None:
+                continue
+            nm.host_app_metric.append(api.HostApplicationMetricInfo(
+                name=app.name, usage=usage_rl(ac, am),
+                priority_class=app.priority_class, qos=app.qos))
 
         if self.predictor is not None:
             reclaimable = self.predictor.prod_reclaimable(now=now)

@@ -74,6 +74,8 @@ RESOURCES: Dict[str, CgroupResource] = {r.name: r for r in [
     CgroupResource("memory.usage_in_bytes", "memory", "memory.usage_in_bytes", "memory.current"),
     CgroupResource("memory.stat", "memory", "memory.stat", "memory.stat"),
     CgroupResource("memory.oom.group", "memory", "memory.oom.group", "memory.oom.group", (0, 1)),
+    CgroupResource("memory.idle_page_stats", "memory", "memory.idle_page_stats", "memory.idle_page_stats"),
+    CgroupResource("cgroup.procs", "cpu", "cgroup.procs", "cgroup.procs"),
     CgroupResource("cpu.pressure", "cpu", "cpu.pressure", "cpu.pressure"),
     CgroupResource("memory.pressure", "memory", "memory.pressure", "memory.pressure"),
     CgroupResource("io.pressure", "io", "io.pressure", "io.pressure"),
@@ -411,6 +413,29 @@ class Host:
                 "io_in_progress": int(f[11]), "io_ticks_ms": int(f[12]),
             })
         return out
+
+    def cgroup_procs_recursive(self, cgroup_dir: str) -> List[int]:
+        """PIDs of the cgroup AND all descendants; used to attribute
+        device/process usage to pods (the GPU collector's pid->pod match,
+        collector_gpu_linux.go:200-250, via the inverse /proc/<pid>/cgroup
+        join). A pod cgroup is an interior node — its own cgroup.procs is
+        empty (v2 forbids interior processes; v1 keeps them in the
+        container leaves), so attribution must walk the subtree."""
+        res = RESOURCES["cgroup.procs"]
+        if self._version is CgroupVersion.V1:
+            base = os.path.join(self.cgroup_root, res.v1_subsystem, cgroup_dir)
+        else:
+            base = os.path.join(self.cgroup_root, cgroup_dir)
+        pids: List[int] = []
+        for dirpath, _dirnames, filenames in os.walk(base):
+            if "cgroup.procs" not in filenames:
+                continue
+            try:
+                text = self.read(os.path.join(dirpath, "cgroup.procs"))
+            except OSError:
+                continue
+            pids.extend(int(x) for x in text.split() if x.strip().isdigit())
+        return pids
 
     def proc_stat_cpu_ticks(self) -> Tuple[int, int]:
         """(total_ticks, idle_ticks incl. iowait) from /proc/stat."""

@@ -159,6 +159,44 @@ class FakeHost(Host):
                    f"some avg10={some_avg10:.2f} avg60=0.00 avg300=0.00 total=0\n"
                    f"full avg10={full_avg10:.2f} avg60=0.00 avg300=0.00 total=0\n")
 
+    def set_cgroup_procs(self, cgroup_dir: str, pids: Iterable[int]) -> None:
+        self.write(self.cgroup_file(cgroup_dir, "cgroup.procs"),
+                   "".join(f"{p}\n" for p in pids))
+
+    # --- kidled (idle-page scanner) -------------------------------------
+    def enable_kidled(self) -> None:
+        """Create the kidled sysfs knobs so kidled_supported() is true."""
+        self._seed(os.path.join(self.kidled_root, "scan_period_in_seconds"),
+                   "120")
+        self._seed(os.path.join(self.kidled_root, "use_hierarchy"), "0")
+
+    def set_cold_pages(self, cgroup_dir: str, cold_bytes: int) -> None:
+        """Seed memory.idle_page_stats so cold_page_bytes() returns
+        `cold_bytes` (one cfei bucket carries it all)."""
+        self.write(self.cgroup_file(cgroup_dir, "memory.idle_page_stats"),
+                   "# version: 1.0\n"
+                   f"cfei {cold_bytes} 0 0 0 0 0 0 0\n"
+                   "dfei 0 0 0 0 0 0 0 0\n"
+                   "cfui 0 0 0 0 0 0 0 0\n"
+                   "dfui 0 0 0 0 0 0 0 0\n")
+
+    # --- block devices ---------------------------------------------------
+    def set_diskstats(self, rows: Iterable[Dict[str, int]]) -> None:
+        """Seed /proc/diskstats. Row keys: device (str), reads,
+        read_sectors, writes, write_sectors, io_in_progress, io_ticks_ms;
+        whole disks additionally get a /sys/block entry."""
+        lines = []
+        for i, r in enumerate(rows):
+            lines.append(
+                f"   8 {i * 16} {r['device']} {r.get('reads', 0)} 0 "
+                f"{r.get('read_sectors', 0)} 0 {r.get('writes', 0)} 0 "
+                f"{r.get('write_sectors', 0)} 0 "
+                f"{r.get('io_in_progress', 0)} {r.get('io_ticks_ms', 0)} 0\n")
+        self._seed(os.path.join(self.proc_root, "diskstats"), "".join(lines))
+
+    def add_disk(self, name: str) -> None:
+        os.makedirs(self.path("sys", "block", name), exist_ok=True)
+
     # --- resctrl --------------------------------------------------------
     def init_resctrl(self, l3_mask: str = "fff", mb_percent: int = 100,
                      num_l3: int = 1) -> None:

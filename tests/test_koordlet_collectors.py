@@ -1,0 +1,302 @@
+"""The extended metricsadvisor collector set: pagecache, kidled cold
+memory, host applications, node storage, accelerator devices.
+
+Hermetic over FakeHost (the reference's NewFileTestUtil strategy,
+SURVEY.md 4). Reference behaviors asserted here:
+ - pagecache: MemTotal-MemFree node usage + raw pod cgroup usage
+   (collectors/pagecache/page_cache_collector.go, meminfo.go:107-110)
+ - coldmemory: kidled gating + hot-page usage = with_cache - cold
+   (collectors/coldmemoryresource/cold_page_kidled.go, cold_page.go:23-28)
+ - hostapplication: NodeSLO-driven cgroup sampling with first-sample skip
+   (collectors/hostapplication/host_app_collector.go:87-140)
+ - nodestorageinfo: disk/partition maps in KV + io counter-delta rates
+   (collectors/nodestorageinfo/node_info_collector.go:65-88)
+ - device: per-minor node series + pid->pod attribution
+   (metricsadvisor/devices/gpu/collector_gpu_linux.go)
+"""
+
+import pytest
+
+from koordinator_tpu.api import types as api
+from koordinator_tpu.api.extension import PriorityClass, QoSClass, ResourceKind
+from koordinator_tpu.koordlet import metriccache as mc
+from koordinator_tpu.koordlet.metricsadvisor import (
+    ColdPageCollector,
+    DeviceCollector,
+    DeviceUsage,
+    HostAppCollector,
+    NodeStorageInfoCollector,
+    PageCacheCollector,
+    default_advisor,
+)
+from koordinator_tpu.koordlet.statesinformer import (
+    CollectPolicy,
+    NodeMetricReporter,
+    PodMeta,
+    StatesInformer,
+    host_app_cgroup_dir,
+)
+from koordinator_tpu.koordlet.testing import FakeHost
+
+
+@pytest.fixture
+def host(tmp_path):
+    return FakeHost(str(tmp_path), num_cpus=8, mem_bytes=16 << 30)
+
+
+def _make_pod(uid, qos="LS"):
+    return PodMeta(pod=api.Pod(
+        meta=api.ObjectMeta(uid=uid, name=uid, namespace="default"),
+        requests={ResourceKind.CPU: 1000.0, ResourceKind.MEMORY: 1024.0},
+        qos_label=qos))
+
+
+@pytest.fixture
+def informer():
+    inf = StatesInformer()
+    inf.set_node(api.Node(meta=api.ObjectMeta(name="node-1")))
+    return inf
+
+
+# --- pagecache ---------------------------------------------------------------
+
+def test_pagecache_node_and_pod(host, informer):
+    cache = mc.MetricCache()
+    pod = _make_pod("pod-a")
+    host.make_cgroup(pod.cgroup_dir)
+    # usage 3GiB of which 1GiB inactive file: pagecache series keeps the raw
+    # value, unlike POD_MEMORY_USAGE which subtracts it
+    host.set_cgroup_memory(pod.cgroup_dir, 3 << 30, inactive_file=1 << 30)
+    informer.set_pods([pod])
+    host.set_meminfo(available=12 << 30)
+
+    PageCacheCollector(host, cache, informer).collect(1.0)
+    # node: MemTotal - MemFree (FakeHost seeds MemFree = available)
+    assert cache.query(mc.NODE_MEMORY_USAGE_WITH_PAGE_CACHE, 0, 2,
+                       agg="latest") == float(4 << 30)
+    assert cache.query(mc.POD_MEMORY_USAGE_WITH_PAGE_CACHE, 0, 2,
+                       {"pod_uid": "pod-a"}, "latest") == float(3 << 30)
+
+
+# --- kidled cold memory ------------------------------------------------------
+
+def test_coldpage_inert_without_kidled(host, informer):
+    cache = mc.MetricCache()
+    ColdPageCollector(host, cache, informer).collect(1.0)
+    assert cache.query(mc.COLD_PAGE_BYTES, 0, 2, agg="latest") is None
+
+
+def test_coldpage_node_pod_hostapp(host, informer):
+    cache = mc.MetricCache()
+    host.enable_kidled()
+    host.set_meminfo(available=12 << 30)   # with_page_cache = 4GiB
+    host.set_cold_pages("", 1 << 30)
+    pod = _make_pod("pod-a")
+    host.make_cgroup(pod.cgroup_dir)
+    host.set_cold_pages(pod.cgroup_dir, 256 << 20)
+    informer.set_pods([pod])
+    app = api.HostApplication(name="nginx", qos=QoSClass.LS)
+    host.make_cgroup(host_app_cgroup_dir(app))
+    host.set_cold_pages(host_app_cgroup_dir(app), 64 << 20)
+    informer.set_node_slo(api.NodeSLO(host_applications=[app]))
+
+    c = ColdPageCollector(host, cache, informer)
+    c.collect(1.0)
+    # arming wrote the scan period (kidled_start defaults)
+    assert host.read(host.path("sys", "kernel", "mm", "kidled",
+                               "scan_period_in_seconds")) == "5"
+    assert cache.query(mc.COLD_PAGE_BYTES, 0, 2,
+                       agg="latest") == float(1 << 30)
+    # hot usage = 4GiB with-cache - 1GiB cold = 3GiB
+    assert cache.query(mc.NODE_MEMORY_WITH_HOT_PAGE_USAGE, 0, 2,
+                       agg="latest") == float(3 << 30)
+    assert cache.query(mc.COLD_PAGE_BYTES, 0, 2,
+                       {"pod_uid": "pod-a"}, "latest") == float(256 << 20)
+    assert cache.query(mc.COLD_PAGE_BYTES, 0, 2,
+                       {"app": "nginx"}, "latest") == float(64 << 20)
+
+
+# --- host applications -------------------------------------------------------
+
+def test_host_app_cgroup_dir_derivation():
+    assert host_app_cgroup_dir(
+        api.HostApplication(name="a", qos=QoSClass.LS)) \
+        == "host-latency-sensitive/a"
+    assert host_app_cgroup_dir(
+        api.HostApplication(name="b", qos=QoSClass.BE)) == "host-best-effort/b"
+    assert host_app_cgroup_dir(api.HostApplication(name="c")) == "c"
+    assert host_app_cgroup_dir(
+        api.HostApplication(name="d", cgroup_dir="kubepods/burstable/x")) \
+        == "kubepods/burstable/x"
+
+
+def test_host_app_collector_cpu_delta_and_memory(host, informer):
+    cache = mc.MetricCache()
+    app = api.HostApplication(name="nginx", qos=QoSClass.LS,
+                              priority_class=PriorityClass.PROD)
+    d = host_app_cgroup_dir(app)
+    host.make_cgroup(d)
+    informer.set_node_slo(api.NodeSLO(host_applications=[app]))
+    c = HostAppCollector(host, cache, informer)
+
+    c.collect(0.0)  # first sample: cpu skipped, memory recorded
+    assert cache.query(mc.HOST_APP_CPU_USAGE, 0, 1,
+                       {"app": "nginx"}, "latest") is None
+    # 2 cores for 10s
+    host.set_cgroup_cpu_ns(d, 20_000_000_000)
+    host.set_cgroup_memory(d, 2 << 30, inactive_file=1 << 30)
+    c.collect(10.0)
+    assert cache.query(mc.HOST_APP_CPU_USAGE, 0, 11, {"app": "nginx"},
+                       "latest") == pytest.approx(2.0)
+    # working set subtracts inactive file
+    assert cache.query(mc.HOST_APP_MEMORY_USAGE, 0, 11, {"app": "nginx"},
+                       "latest") == float(1 << 30)
+
+
+def test_host_app_metrics_in_nodemetric_report(host, informer):
+    cache = mc.MetricCache()
+    app = api.HostApplication(name="nginx", qos=QoSClass.LS,
+                              priority_class=PriorityClass.PROD)
+    d = host_app_cgroup_dir(app)
+    host.make_cgroup(d)
+    informer.set_node_slo(api.NodeSLO(host_applications=[app]))
+    adv = default_advisor(host, cache, informer)
+    host.set_cgroup_memory(d, 1 << 30)
+    adv.collect_once(now=0.0)
+    host.advance_cpu(busy_ticks=4000, idle_ticks=4000)
+    host.set_cgroup_cpu_ns(d, 10_000_000_000)
+    adv.collect_once(now=10.0)
+
+    nm = NodeMetricReporter(informer, cache, CollectPolicy()).collect(now=10.0)
+    assert nm is not None
+    assert len(nm.host_app_metric) == 1
+    ham = nm.host_app_metric[0]
+    assert ham.name == "nginx"
+    assert ham.priority_class is PriorityClass.PROD
+    assert ham.qos is QoSClass.LS
+    assert ham.usage[ResourceKind.CPU] == pytest.approx(1000.0)  # milli
+    assert ham.usage[ResourceKind.MEMORY] == pytest.approx(1024.0)  # MiB
+
+
+# --- node storage ------------------------------------------------------------
+
+def test_storage_info_kv_and_io_rates(host):
+    cache = mc.MetricCache()
+    host.add_disk("sda")
+    host.set_diskstats([
+        {"device": "sda", "read_sectors": 0, "write_sectors": 0,
+         "io_ticks_ms": 0},
+        {"device": "sda1", "read_sectors": 0, "write_sectors": 0,
+         "io_ticks_ms": 0},
+    ])
+    c = NodeStorageInfoCollector(host, cache)
+    c.collect(0.0)
+    info = cache.get_kv(mc.NODE_LOCAL_STORAGE_KEY)
+    assert info["disks"] == ["sda"]
+    assert info["partition_disk"] == {"sda1": "sda"}
+
+    # 10s later: 2048 sectors read (1MiB), 4096 written (2MiB), 5000ms busy
+    host.set_diskstats([
+        {"device": "sda", "read_sectors": 2048, "write_sectors": 4096,
+         "io_ticks_ms": 5000},
+        {"device": "sda1", "read_sectors": 2048, "write_sectors": 4096,
+         "io_ticks_ms": 5000},
+    ])
+    c.collect(10.0)
+    labels = {"device": "sda"}
+    assert cache.query(mc.NODE_DISK_IO_UTIL, 0, 11, labels,
+                       "latest") == pytest.approx(50.0)
+    assert cache.query(mc.NODE_DISK_READ_BPS, 0, 11, labels,
+                       "latest") == pytest.approx((1 << 20) / 10.0)
+    assert cache.query(mc.NODE_DISK_WRITE_BPS, 0, 11, labels,
+                       "latest") == pytest.approx((2 << 20) / 10.0)
+    # partitions produce no per-device series
+    assert cache.query(mc.NODE_DISK_IO_UTIL, 0, 11, {"device": "sda1"},
+                       "latest") is None
+
+    # counter reset (device re-added): clamp at 0, never negative
+    host.set_diskstats([
+        {"device": "sda", "read_sectors": 0, "write_sectors": 0,
+         "io_ticks_ms": 0},
+    ])
+    c.collect(20.0)
+    assert cache.query(mc.NODE_DISK_IO_UTIL, 15, 21, labels, "latest") == 0.0
+    assert cache.query(mc.NODE_DISK_READ_BPS, 15, 21, labels, "latest") == 0.0
+
+
+# --- devices -----------------------------------------------------------------
+
+def test_device_collector_node_and_pod_attribution(host, informer):
+    cache = mc.MetricCache()
+    pod_a, pod_b = _make_pod("pod-a"), _make_pod("pod-b")
+    # processes live in container LEAF cgroups under the pod dir — the pod
+    # cgroup itself is an interior node with empty cgroup.procs (v2
+    # no-internal-process rule); attribution must walk the subtree
+    for p, pids in ((pod_a, [100, 101]), (pod_b, [200])):
+        host.make_cgroup(p.cgroup_dir)
+        host.set_cgroup_procs(p.cgroup_dir, [])
+        ctr = p.cgroup_dir + "/ctr0"
+        host.make_cgroup(ctr)
+        host.set_cgroup_procs(ctr, pids)
+    informer.set_pods([pod_a, pod_b])
+
+    def reader():
+        return [
+            DeviceUsage(minor=0, core_usage=80.0, memory_used=8 << 30,
+                        memory_total=16 << 30,
+                        procs={100: (50.0, 4 << 30), 101: (20.0, 2 << 30),
+                               200: (10.0, 2 << 30),
+                               999: (77.0, 1 << 30)}),  # unknown pid dropped
+            DeviceUsage(minor=1, core_usage=5.0, memory_used=1 << 30,
+                        procs={200: (5.0, 1 << 30)}),
+        ]
+
+    DeviceCollector(host, cache, informer, reader).collect(1.0)
+    assert cache.query(mc.GPU_CORE_USAGE, 0, 2, {"minor": "0"},
+                       "latest") == 80.0
+    assert cache.query(mc.GPU_MEMORY_USED, 0, 2, {"minor": "1"},
+                       "latest") == float(1 << 30)
+    assert cache.query(mc.GPU_MEMORY_TOTAL, 0, 2, {"minor": "0"},
+                       "latest") == float(16 << 30)
+    # minor 1 reported no capacity -> no total series
+    assert cache.query(mc.GPU_MEMORY_TOTAL, 0, 2, {"minor": "1"},
+                       "latest") is None
+    # pod-a on minor 0: 50+20 core, 6GiB
+    assert cache.query(mc.POD_GPU_CORE_USAGE, 0, 2,
+                       {"pod_uid": "pod-a", "minor": "0"}, "latest") == 70.0
+    assert cache.query(mc.POD_GPU_MEMORY_USED, 0, 2,
+                       {"pod_uid": "pod-a", "minor": "0"},
+                       "latest") == float(6 << 30)
+    # pod-b appears on both minors
+    assert cache.query(mc.POD_GPU_CORE_USAGE, 0, 2,
+                       {"pod_uid": "pod-b", "minor": "1"}, "latest") == 5.0
+    # unknown pid attributed nowhere
+    assert cache.query_all(mc.POD_GPU_CORE_USAGE, 0, 2, "count") \
+        .keys().__len__() == 3
+
+
+# --- collector isolation -------------------------------------------------
+
+
+def test_raising_collector_does_not_kill_the_loop(host, informer):
+    """One collector throwing (driver reset, vanished file race) must not
+    stop the others — the reference runs collectors in separate goroutines
+    (metrics_advisor.go:72-102)."""
+    cache = mc.MetricCache()
+
+    class Boom:
+        name = "boom"
+
+        def collect(self, now):
+            raise RuntimeError("device fell off the bus")
+
+    from koordinator_tpu.koordlet.metricsadvisor import (
+        Advisor,
+        NodeResourceCollector,
+    )
+    adv = Advisor([Boom(), NodeResourceCollector(host, cache)])
+    adv.collect_once(now=0.0)
+    host.advance_cpu(busy_ticks=4000, idle_ticks=4000)
+    adv.collect_once(now=10.0)
+    assert cache.query(mc.NODE_CPU_USAGE, 0, 11, agg="latest") is not None
+    assert isinstance(adv.last_errors["boom"], RuntimeError)
