@@ -57,6 +57,10 @@ class DaemonConfig:
     # PageCacheCollector gate (koordlet_features.go PageCacheCollector);
     # kidled cold memory self-gates on kernel support instead
     enable_page_cache: bool = False
+    # CoreSched feature gate (koordlet_features.go CoreSched): when on AND
+    # the kernel supports PR_SCHED_CORE, QoS cookie assignment goes through
+    # the native prctl shim instead of the recording fake
+    enable_core_sched: bool = False
 
 
 class Daemon:
@@ -90,7 +94,14 @@ class Daemon:
         self.qos: QoSManager = default_qos_manager(
             self.informer, self.metric_cache, self.executor, self.evictor,
             auditor, metrics=self.metrics)
-        self.hook_server: HookServer = default_hook_server(self.informer)
+        core_sched = None
+        if cfg.enable_core_sched:
+            from koordinator_tpu import native
+            from koordinator_tpu.koordlet.runtimehooks import NativeCoreSched
+            if native.core_sched_supported():
+                core_sched = NativeCoreSched(host)
+        self.hook_server: HookServer = default_hook_server(
+            self.informer, core_sched)
         self.reconciler = Reconciler(self.informer, self.hook_server,
                                      self.executor)
         self.pleg = Pleg.for_host(host, use_inotify=False)

@@ -31,7 +31,7 @@ from __future__ import annotations
 import dataclasses
 import enum
 import json
-from typing import Dict, List, Optional, Protocol
+from typing import Dict, List, Optional, Protocol, Tuple
 
 from koordinator_tpu.api.extension import QoSClass, ResourceKind
 from koordinator_tpu.koordlet.resourceexecutor import CgroupUpdate, Executor
@@ -83,6 +83,57 @@ class FakeCoreSched:
 
     def assign_cookie(self, cgroup_dir: str, group_id: str) -> None:
         self.assignments[cgroup_dir] = group_id
+
+
+class NativeCoreSched:
+    """prctl-backed cookie manager: every pod in a QoS group shares one
+    core-sched cookie, so SMT siblings never co-run threads of different
+    groups (the coresched rule's cookie cache,
+    runtimehooks/hooks/coresched/rule.go; prctl verbs per
+    core_sched_linux.go:40-176 via the native shim).
+
+    Group state is (reference pid, expected cookie) per group id — the
+    live holder the kernel copies the cookie from. The reference is only
+    reused while its CURRENT cookie equals the recorded one: a recycled
+    pid (died + number reused by some other process, possibly in another
+    group) would otherwise leak a foreign cookie into this group and let
+    different QoS tiers co-run on SMT siblings. On any mismatch or death
+    the group is re-keyed from the pod's own pids (cookies are compared
+    by value, not identity, so a re-created cookie keeps isolating the
+    group's remaining members; the reference accepts the same semantics
+    on its cookie-cache eviction)."""
+
+    def __init__(self, host, ops=None):
+        if ops is None:
+            from koordinator_tpu import native
+            ops = native.CoreSched()
+        self.host = host
+        self.ops = ops
+        self._group_ref: Dict[str, Tuple[int, int]] = {}
+
+    def assign_cookie(self, cgroup_dir: str, group_id: str) -> None:
+        pids = self.host.cgroup_procs_recursive(cgroup_dir)
+        if not pids:
+            return
+        ref = self._group_ref.get(group_id)
+        if ref is not None:
+            ref_pid, expect = ref
+            try:
+                if expect != 0 and self.ops.get(ref_pid) == expect:
+                    self.ops.assign(ref_pid,
+                                    [p for p in pids if p != ref_pid])
+                    return
+            except OSError:
+                pass  # reference pid gone — re-key the group below
+        first, rest = pids[0], pids[1:]
+        try:
+            self.ops.create(first)
+            cookie = self.ops.get(first)
+            if rest:
+                self.ops.assign(first, rest)
+        except OSError:
+            return  # pod exited mid-assign; next reconcile retries
+        self._group_ref[group_id] = (first, cookie)
 
 
 # --- hook plugins -----------------------------------------------------------

@@ -42,21 +42,27 @@ _lib = None
 _lib_error: Optional[str] = None
 
 
-def _load() -> Optional[ctypes.CDLL]:
-    global _lib, _lib_error
-    if _lib is not None or _lib_error is not None:
-        return _lib
-    if not os.path.exists(_SO):
+def _load_shim(so_path: str) -> Tuple[Optional[ctypes.CDLL], Optional[str]]:
+    """Build-if-missing (one `make` covers all shims) then dlopen.
+    Returns (lib, None) or (None, error)."""
+    if not os.path.exists(so_path):
         try:
             subprocess.run(["make", "-C", _DIR, "-s"], check=True,
                            capture_output=True, timeout=120)
         except (OSError, subprocess.SubprocessError) as e:
-            _lib_error = f"native build failed: {e}"
-            return None
+            return None, f"native build failed: {e}"
     try:
-        lib = ctypes.CDLL(_SO)
+        return ctypes.CDLL(so_path), None
     except OSError as e:
-        _lib_error = f"load failed: {e}"
+        return None, f"load failed: {e}"
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _lib_error
+    if _lib is not None or _lib_error is not None:
+        return _lib
+    lib, _lib_error = _load_shim(_SO)
+    if lib is None:
         return None
     lib.pg_open.restype = ctypes.c_void_p
     lib.pg_open.argtypes = [
@@ -203,3 +209,114 @@ def cycles_instructions_reader() -> Optional[callable]:
                 v["instructions"] - prev["instructions"])
 
     return reader
+
+
+# --- core scheduling (prctl PR_SCHED_CORE) ----------------------------------
+
+_CS_SO = os.path.join(_DIR, "libcore_sched.so")
+_cs_lib = None
+_cs_error: Optional[str] = None
+
+# prctl arg4 scope values (linux PIDTYPE_*; CoreSchedScopeType,
+# core_sched.go:34-44)
+SCOPE_THREAD = 0
+SCOPE_PROCESS = 1       # thread group
+SCOPE_PROCESS_GROUP = 2
+
+
+def _load_cs() -> Optional[ctypes.CDLL]:
+    global _cs_lib, _cs_error
+    if _cs_lib is not None or _cs_error is not None:
+        return _cs_lib
+    lib, _cs_error = _load_shim(_CS_SO)
+    if lib is None:
+        return None
+    lib.cs_supported.restype = ctypes.c_int
+    lib.cs_get.restype = ctypes.c_int
+    lib.cs_get.argtypes = [ctypes.c_uint, ctypes.c_int,
+                           ctypes.POINTER(ctypes.c_ulonglong)]
+    for fn in (lib.cs_create, lib.cs_share_to, lib.cs_share_from):
+        fn.restype = ctypes.c_int
+        fn.argtypes = [ctypes.c_uint, ctypes.c_int]
+    lib.cs_assign.restype = ctypes.c_int
+    lib.cs_assign.argtypes = [ctypes.c_uint, ctypes.POINTER(ctypes.c_uint),
+                              ctypes.c_int, ctypes.c_int,
+                              ctypes.POINTER(ctypes.c_uint)]
+    lib.cs_clear.restype = ctypes.c_int
+    lib.cs_clear.argtypes = [ctypes.POINTER(ctypes.c_uint), ctypes.c_int,
+                             ctypes.c_int, ctypes.POINTER(ctypes.c_uint)]
+    lib.cs_last_error.restype = ctypes.c_char_p
+    _cs_lib = lib
+    return _cs_lib
+
+
+class CoreSched:
+    """prctl(PR_SCHED_CORE) operations (core_sched_linux.go:40-176).
+
+    get/create/share_to/share_from are the raw prctl verbs; assign and
+    clear are the compound helper-thread ops (the reference's
+    CoreSchedExtendedInterface). All raise OSError on kernel refusal;
+    construct only after `core_sched_supported()` says the kernel has
+    CONFIG_SCHED_CORE."""
+
+    def __init__(self) -> None:
+        lib = _load_cs()
+        if lib is None:
+            raise OSError(_cs_error or "core-sched shim unavailable")
+        self._lib = lib
+
+    def _check(self, ret: int) -> None:
+        if ret < 0:
+            raise OSError(-ret,
+                          self._lib.cs_last_error().decode(errors="replace"))
+
+    def get(self, pid: int) -> int:
+        """Cookie of a thread (0 = none). pid 0 = self."""
+        cookie = ctypes.c_ulonglong(0)
+        self._check(self._lib.cs_get(pid, SCOPE_THREAD,
+                                     ctypes.byref(cookie)))
+        return cookie.value
+
+    def create(self, pid: int, scope: int = SCOPE_PROCESS) -> None:
+        """Give pid (and, with SCOPE_PROCESS, its whole thread group) a
+        fresh unique cookie."""
+        self._check(self._lib.cs_create(pid, scope))
+
+    def share_to(self, pid: int, scope: int = SCOPE_PROCESS) -> None:
+        self._check(self._lib.cs_share_to(pid, scope))
+
+    def share_from(self, pid: int) -> None:
+        self._check(self._lib.cs_share_from(pid, SCOPE_THREAD))
+
+    def assign(self, pid_from: int, pids_to: Sequence[int],
+               scope: int = SCOPE_PROCESS) -> Tuple[int, ...]:
+        """Copy pid_from's cookie onto every pids_to; returns the pids
+        that failed (dead pids etc. — partial failure is normal during
+        pod churn)."""
+        n = len(pids_to)
+        if n == 0:
+            return ()
+        arr = (ctypes.c_uint * n)(*pids_to)
+        failed = (ctypes.c_uint * n)()
+        ret = self._lib.cs_assign(pid_from, arr, n, scope, failed)
+        self._check(ret)
+        return tuple(failed[i] for i in range(ret))
+
+    def clear(self, pids: Sequence[int],
+              scope: int = SCOPE_PROCESS) -> Tuple[int, ...]:
+        """Reset cookies to 0; returns the pids that failed."""
+        n = len(pids)
+        if n == 0:
+            return ()
+        arr = (ctypes.c_uint * n)(*pids)
+        failed = (ctypes.c_uint * n)()
+        ret = self._lib.cs_clear(arr, n, scope, failed)
+        self._check(ret)
+        return tuple(failed[i] for i in range(ret))
+
+
+def core_sched_supported() -> bool:
+    """True when the shim loads AND the kernel accepts PR_SCHED_CORE
+    (EnableCoreSchedIfSupported's probe)."""
+    lib = _load_cs()
+    return bool(lib) and bool(lib.cs_supported())

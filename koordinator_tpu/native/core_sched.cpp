@@ -1,0 +1,140 @@
+// Core-scheduling prctl(PR_SCHED_CORE) shim.
+//
+// Capability parity with the reference's golang.org/x/sys/unix prctl
+// wrapper (pkg/koordlet/util/system/core_sched_linux.go:40-176): get /
+// create / share_to / share_from plus the compound assign and clear ops,
+// which must run from a helper thread holding the right cookie — prctl
+// SHARE_TO pushes the CALLING THREAD's cookie onto the target, so
+//  - assign: helper thread pulls the source pid's cookie (SHARE_FROM),
+//    then pushes it to each target (the reference's GoWithNewThread
+//    at core_sched_linux.go:153-165);
+//  - clear: a fresh thread starts with the spawner's cookie-0, so
+//    pushing ITS cookie resets targets to 0 (":110-131").
+// The helper thread dies afterwards, taking its cookie with it.
+//
+// Errors: ops return 0 on success or -errno; compound ops return the
+// number of failed pids and record them in failed_out.
+
+#include <errno.h>
+#include <string.h>
+#include <sys/prctl.h>
+
+#include <cstdint>
+#include <cstdio>
+#include <thread>
+
+#ifndef PR_SCHED_CORE
+#define PR_SCHED_CORE 62
+#endif
+#ifndef PR_SCHED_CORE_GET
+#define PR_SCHED_CORE_GET 0
+#define PR_SCHED_CORE_CREATE 1
+#define PR_SCHED_CORE_SHARE_TO 2
+#define PR_SCHED_CORE_SHARE_FROM 3
+#endif
+
+// prctl arg4 scope (linux/sched.h PIDTYPE_*): 0=thread, 1=thread group
+// (process), 2=process group — CoreSchedScopeType in core_sched.go:34-44.
+
+// plain static, NOT thread_local: the compound ops' helper threads must
+// leave their error text readable from the caller after join (callers are
+// serialized through the Python binding)
+static char g_err[256];
+
+static void set_err(const char* op, unsigned pid, int err) {
+    snprintf(g_err, sizeof(g_err), "%s pid=%u failed: %s (errno %d)",
+             op, pid, strerror(err), err);
+}
+
+extern "C" {
+
+const char* cs_last_error() { return g_err; }
+
+// 1 when the kernel supports PR_SCHED_CORE (CONFIG_SCHED_CORE and SMT
+// active enough for the prctl to exist); probing GET on self is free.
+int cs_supported() {
+    unsigned long long cookie = 0;
+    int ret = prctl(PR_SCHED_CORE, PR_SCHED_CORE_GET, 0, 0,
+                    (unsigned long)&cookie);
+    return ret == 0 ? 1 : 0;
+}
+
+int cs_get(unsigned pid, int pid_type, unsigned long long* cookie) {
+    // NOTE: GET only supports thread scope (core_sched_linux.go:41)
+    (void)pid_type;
+    int ret = prctl(PR_SCHED_CORE, PR_SCHED_CORE_GET, pid, 0,
+                    (unsigned long)cookie);
+    if (ret != 0) { set_err("get", pid, errno); return -errno; }
+    return 0;
+}
+
+int cs_create(unsigned pid, int pid_type) {
+    int ret = prctl(PR_SCHED_CORE, PR_SCHED_CORE_CREATE, pid, pid_type, 0);
+    if (ret != 0) { set_err("create", pid, errno); return -errno; }
+    return 0;
+}
+
+int cs_share_to(unsigned pid, int pid_type) {
+    int ret = prctl(PR_SCHED_CORE, PR_SCHED_CORE_SHARE_TO, pid, pid_type, 0);
+    if (ret != 0) { set_err("share_to", pid, errno); return -errno; }
+    return 0;
+}
+
+int cs_share_from(unsigned pid, int pid_type) {
+    // NOTE: SHARE_FROM only supports thread scope on the source
+    (void)pid_type;
+    int ret = prctl(PR_SCHED_CORE, PR_SCHED_CORE_SHARE_FROM, pid, 0, 0);
+    if (ret != 0) { set_err("share_from", pid, errno); return -errno; }
+    return 0;
+}
+
+// Pull pid_from's cookie and push it onto every pid in pids_to (scope
+// pid_type_to). Returns the number of failures (their pids in
+// failed_out, sized >= n), or -errno when the initial SHARE_FROM fails.
+int cs_assign(unsigned pid_from, const unsigned* pids_to, int n,
+              int pid_type_to, unsigned* failed_out) {
+    int n_failed = 0;
+    int from_err = 0;
+    std::thread helper([&] {
+        int ret = prctl(PR_SCHED_CORE, PR_SCHED_CORE_SHARE_FROM, pid_from,
+                        0, 0);
+        if (ret != 0) {
+            from_err = errno;
+            set_err("assign/share_from", pid_from, errno);
+            return;
+        }
+        for (int i = 0; i < n; i++) {
+            ret = prctl(PR_SCHED_CORE, PR_SCHED_CORE_SHARE_TO, pids_to[i],
+                        pid_type_to, 0);
+            if (ret != 0) {
+                set_err("assign/share_to", pids_to[i], errno);
+                failed_out[n_failed++] = pids_to[i];
+            }
+        }
+    });
+    helper.join();
+    if (from_err != 0) return -from_err;
+    return n_failed;
+}
+
+// Reset every pid's cookie to 0 by pushing a fresh thread's inherited
+// cookie-0 (only valid when the caller itself holds cookie 0, which the
+// agent main thread always does). Returns the number of failures.
+int cs_clear(const unsigned* pids, int n, int pid_type,
+             unsigned* failed_out) {
+    int n_failed = 0;
+    std::thread helper([&] {
+        for (int i = 0; i < n; i++) {
+            int ret = prctl(PR_SCHED_CORE, PR_SCHED_CORE_SHARE_TO, pids[i],
+                            pid_type, 0);
+            if (ret != 0) {
+                set_err("clear/share_to", pids[i], errno);
+                failed_out[n_failed++] = pids[i];
+            }
+        }
+    });
+    helper.join();
+    return n_failed;
+}
+
+}  // extern "C"
