@@ -286,6 +286,10 @@ class CoreSched:
         self._check(self._lib.cs_share_to(pid, scope))
 
     def share_from(self, pid: int) -> None:
+        """Pull pid's cookie onto the CALLING THREAD. This tags the agent
+        thread itself (it becomes SMT-isolated and clear() will refuse
+        with EBUSY from it) — prefer assign(), which confines the pull to
+        a throwaway helper thread."""
         self._check(self._lib.cs_share_from(pid, SCOPE_THREAD))
 
     def assign(self, pid_from: int, pids_to: Sequence[int],

@@ -21,6 +21,9 @@
 
 #include <cstdint>
 #include <cstdio>
+#include <mutex>
+#include <new>
+#include <system_error>
 #include <thread>
 
 #ifndef PR_SCHED_CORE
@@ -36,19 +39,46 @@
 // prctl arg4 scope (linux/sched.h PIDTYPE_*): 0=thread, 1=thread group
 // (process), 2=process group — CoreSchedScopeType in core_sched.go:34-44.
 
-// plain static, NOT thread_local: the compound ops' helper threads must
-// leave their error text readable from the caller after join (callers are
-// serialized through the Python binding)
+// One shared buffer (the compound ops' helper threads must leave their
+// error text readable from the caller after join) guarded by a mutex —
+// ctypes releases the GIL across foreign calls, so concurrent shim ops
+// from different agent threads (tick loop vs hook server) are possible.
+// Reads snapshot into a thread_local copy so the returned pointer stays
+// stable on the raising thread.
+static std::mutex g_err_mu;
 static char g_err[256];
+static thread_local char g_err_read[256];
 
 static void set_err(const char* op, unsigned pid, int err) {
+    std::lock_guard<std::mutex> lock(g_err_mu);
     snprintf(g_err, sizeof(g_err), "%s pid=%u failed: %s (errno %d)",
              op, pid, strerror(err), err);
 }
 
+// run fn on a fresh joined thread; -EAGAIN instead of std::terminate when
+// thread creation itself fails (pid/pthread exhaustion on a loaded node)
+template <typename Fn>
+static int with_helper_thread(Fn&& fn) {
+    try {
+        std::thread helper(fn);
+        helper.join();
+        return 0;
+    } catch (const std::system_error&) {
+        set_err("helper_thread", 0, EAGAIN);
+        return -EAGAIN;
+    } catch (const std::bad_alloc&) {
+        set_err("helper_thread", 0, ENOMEM);
+        return -ENOMEM;
+    }
+}
+
 extern "C" {
 
-const char* cs_last_error() { return g_err; }
+const char* cs_last_error() {
+    std::lock_guard<std::mutex> lock(g_err_mu);
+    snprintf(g_err_read, sizeof(g_err_read), "%s", g_err);
+    return g_err_read;
+}
 
 // 1 when the kernel supports PR_SCHED_CORE (CONFIG_SCHED_CORE and SMT
 // active enough for the prctl to exist); probing GET on self is free.
@@ -95,7 +125,7 @@ int cs_assign(unsigned pid_from, const unsigned* pids_to, int n,
               int pid_type_to, unsigned* failed_out) {
     int n_failed = 0;
     int from_err = 0;
-    std::thread helper([&] {
+    int spawn = with_helper_thread([&] {
         int ret = prctl(PR_SCHED_CORE, PR_SCHED_CORE_SHARE_FROM, pid_from,
                         0, 0);
         if (ret != 0) {
@@ -112,18 +142,28 @@ int cs_assign(unsigned pid_from, const unsigned* pids_to, int n,
             }
         }
     });
-    helper.join();
+    if (spawn != 0) return spawn;
     if (from_err != 0) return -from_err;
     return n_failed;
 }
 
 // Reset every pid's cookie to 0 by pushing a fresh thread's inherited
-// cookie-0 (only valid when the caller itself holds cookie 0, which the
-// agent main thread always does). Returns the number of failures.
+// cookie-0. Valid only while the SPAWNING thread holds cookie 0 — the
+// helper CHECKS this (its inherited cookie) and refuses with -EBUSY
+// rather than silently stamping a stale cookie onto the targets (e.g.
+// after a caller misused share_from on its own thread).
 int cs_clear(const unsigned* pids, int n, int pid_type,
              unsigned* failed_out) {
     int n_failed = 0;
-    std::thread helper([&] {
+    int guard_err = 0;
+    int spawn = with_helper_thread([&] {
+        unsigned long long own = 0;
+        if (prctl(PR_SCHED_CORE, PR_SCHED_CORE_GET, 0, 0,
+                  (unsigned long)&own) == 0 && own != 0) {
+            guard_err = EBUSY;
+            set_err("clear/guard: calling thread holds a cookie", 0, EBUSY);
+            return;
+        }
         for (int i = 0; i < n; i++) {
             int ret = prctl(PR_SCHED_CORE, PR_SCHED_CORE_SHARE_TO, pids[i],
                             pid_type, 0);
@@ -133,7 +173,8 @@ int cs_clear(const unsigned* pids, int n, int pid_type,
             }
         }
     });
-    helper.join();
+    if (spawn != 0) return spawn;
+    if (guard_err != 0) return -guard_err;
     return n_failed;
 }
 

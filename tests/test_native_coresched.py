@@ -7,6 +7,7 @@ object and the FakeHost cgroup tree.
 """
 
 import subprocess
+import sys
 
 import pytest
 
@@ -37,7 +38,7 @@ def test_real_cookie_roundtrip_in_subprocess():
         "assert cs.get(0) != 0\n"
         "print('COOKIE_OK')\n"
     )
-    out = subprocess.run(["python", "-c", code], capture_output=True,
+    out = subprocess.run([sys.executable, "-c", code], capture_output=True,
                          text=True, timeout=60)
     assert "COOKIE_OK" in out.stdout, out.stderr
 
