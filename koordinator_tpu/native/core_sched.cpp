@@ -21,7 +21,6 @@
 
 #include <cstdint>
 #include <cstdio>
-#include <mutex>
 #include <new>
 #include <system_error>
 #include <thread>
@@ -39,20 +38,20 @@
 // prctl arg4 scope (linux/sched.h PIDTYPE_*): 0=thread, 1=thread group
 // (process), 2=process group — CoreSchedScopeType in core_sched.go:34-44.
 
-// One shared buffer (the compound ops' helper threads must leave their
-// error text readable from the caller after join) guarded by a mutex —
-// ctypes releases the GIL across foreign calls, so concurrent shim ops
-// from different agent threads (tick loop vs hook server) are possible.
-// Reads snapshot into a thread_local copy so the returned pointer stays
-// stable on the raising thread.
-static std::mutex g_err_mu;
-static char g_err[256];
-static thread_local char g_err_read[256];
+// Error text is PER CALLING THREAD (ctypes releases the GIL across
+// foreign calls, so tick-loop and hook-server threads can fail
+// concurrently — a shared buffer would mis-attribute one thread's
+// failure to another). Helper threads write into a stack buffer their
+// spawner copies back after join, so attribution survives the join.
+static thread_local char g_err[256];
+
+static void set_err_buf(char* buf, const char* op, unsigned pid, int err) {
+    snprintf(buf, 256, "%s pid=%u failed: %s (errno %d)",
+             op, pid, strerror(err), err);
+}
 
 static void set_err(const char* op, unsigned pid, int err) {
-    std::lock_guard<std::mutex> lock(g_err_mu);
-    snprintf(g_err, sizeof(g_err), "%s pid=%u failed: %s (errno %d)",
-             op, pid, strerror(err), err);
+    set_err_buf(g_err, op, pid, err);
 }
 
 // run fn on a fresh joined thread; -EAGAIN instead of std::terminate when
@@ -74,11 +73,7 @@ static int with_helper_thread(Fn&& fn) {
 
 extern "C" {
 
-const char* cs_last_error() {
-    std::lock_guard<std::mutex> lock(g_err_mu);
-    snprintf(g_err_read, sizeof(g_err_read), "%s", g_err);
-    return g_err_read;
-}
+const char* cs_last_error() { return g_err; }
 
 // 1 when the kernel supports PR_SCHED_CORE (CONFIG_SCHED_CORE and SMT
 // active enough for the prctl to exist); probing GET on self is free.
@@ -125,23 +120,25 @@ int cs_assign(unsigned pid_from, const unsigned* pids_to, int n,
               int pid_type_to, unsigned* failed_out) {
     int n_failed = 0;
     int from_err = 0;
+    char herr[256] = "";
     int spawn = with_helper_thread([&] {
         int ret = prctl(PR_SCHED_CORE, PR_SCHED_CORE_SHARE_FROM, pid_from,
                         0, 0);
         if (ret != 0) {
             from_err = errno;
-            set_err("assign/share_from", pid_from, errno);
+            set_err_buf(herr, "assign/share_from", pid_from, errno);
             return;
         }
         for (int i = 0; i < n; i++) {
             ret = prctl(PR_SCHED_CORE, PR_SCHED_CORE_SHARE_TO, pids_to[i],
                         pid_type_to, 0);
             if (ret != 0) {
-                set_err("assign/share_to", pids_to[i], errno);
+                set_err_buf(herr, "assign/share_to", pids_to[i], errno);
                 failed_out[n_failed++] = pids_to[i];
             }
         }
     });
+    if (herr[0]) snprintf(g_err, sizeof(g_err), "%s", herr);
     if (spawn != 0) return spawn;
     if (from_err != 0) return -from_err;
     return n_failed;
@@ -156,23 +153,26 @@ int cs_clear(const unsigned* pids, int n, int pid_type,
              unsigned* failed_out) {
     int n_failed = 0;
     int guard_err = 0;
+    char herr[256] = "";
     int spawn = with_helper_thread([&] {
         unsigned long long own = 0;
         if (prctl(PR_SCHED_CORE, PR_SCHED_CORE_GET, 0, 0,
                   (unsigned long)&own) == 0 && own != 0) {
             guard_err = EBUSY;
-            set_err("clear/guard: calling thread holds a cookie", 0, EBUSY);
+            set_err_buf(herr, "clear/guard: calling thread holds a cookie",
+                        0, EBUSY);
             return;
         }
         for (int i = 0; i < n; i++) {
             int ret = prctl(PR_SCHED_CORE, PR_SCHED_CORE_SHARE_TO, pids[i],
                             pid_type, 0);
             if (ret != 0) {
-                set_err("clear/share_to", pids[i], errno);
+                set_err_buf(herr, "clear/share_to", pids[i], errno);
                 failed_out[n_failed++] = pids[i];
             }
         }
     });
+    if (herr[0]) snprintf(g_err, sizeof(g_err), "%s", herr);
     if (spawn != 0) return spawn;
     if (guard_err != 0) return -guard_err;
     return n_failed;
