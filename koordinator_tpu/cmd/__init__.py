@@ -1,0 +1,23 @@
+"""Process entry points — the TPU build's equivalent of the reference's
+five binaries under `cmd/` (koord-scheduler, koord-manager,
+koord-descheduler, koordlet, koord-runtime-proxy; SURVEY.md 2.x process
+shape): argparse flags + `--feature-gates`, lease-file leader election
+for the singleton control-plane processes, SIGTERM/SIGINT graceful
+shutdown, and `build()` seams that let the e2e suite run the trio
+in-process against fakes."""
+
+from koordinator_tpu.cmd.runtime import (
+    FileLeaseLock,
+    LeaderElector,
+    LeaseRecord,
+    StopHandle,
+    default_identity,
+)
+
+__all__ = [
+    "FileLeaseLock",
+    "LeaderElector",
+    "LeaseRecord",
+    "StopHandle",
+    "default_identity",
+]

@@ -1,0 +1,108 @@
+"""koord-descheduler process: leader-elected descheduling cycle.
+
+Capability parity with `cmd/koord-descheduler/main.go` +
+`pkg/descheduler/descheduler.go` Run: flags, leader election, the
+interval-driven profile loop (CycleRunner), graceful shutdown. Plugin
+wiring (LowNodeLoad + migration arbitration) matches the default profile.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import time
+from typing import Callable, Optional, Sequence
+
+from koordinator_tpu.api import types as api
+from koordinator_tpu.cmd.runtime import (
+    FileLeaseLock,
+    LeaderElector,
+    StopHandle,
+    default_identity,
+    parse_feature_gates,
+)
+from koordinator_tpu.descheduler.framework import CycleRunner, EvictionLimiter
+from koordinator_tpu.features import DEFAULT_FEATURE_GATE, FeatureGate
+
+
+@dataclasses.dataclass
+class DeschedulerConfig:
+    descheduling_interval_seconds: float = 120.0
+    lease_file: str = "koord-descheduler.lease"
+    enable_leader_election: bool = True
+    lease_duration_seconds: float = 15.0
+    retry_period_seconds: float = 2.0
+    feature_gates: str = ""
+    identity: str = ""
+
+
+class DeschedulerProcess:
+    """Hosts a CycleRunner under leader election; `get_nodes` is the
+    informer-plane boundary (a fake in tests)."""
+
+    def __init__(self, cfg: DeschedulerConfig,
+                 runner: CycleRunner,
+                 get_nodes: Callable[[], Sequence[api.Node]],
+                 gate: Optional[FeatureGate] = None,
+                 clock: Callable[[], float] = time.time):
+        self.cfg = cfg
+        self.runner = runner
+        self.get_nodes = get_nodes
+        self.gate = gate or DEFAULT_FEATURE_GATE
+        parse_feature_gates(self.gate, cfg.feature_gates)
+        self.cycles = 0
+        identity = cfg.identity or default_identity()
+        self.elector = LeaderElector(
+            FileLeaseLock(cfg.lease_file, cfg.lease_duration_seconds),
+            identity, cfg.retry_period_seconds, clock=clock)
+
+    def _lead(self, should_stop: Callable[[], bool]) -> None:
+        while not should_stop():
+            self.runner.run_once(self.get_nodes())
+            self.cycles += 1
+            deadline = (time.monotonic()
+                        + self.cfg.descheduling_interval_seconds)
+            while not should_stop() and time.monotonic() < deadline:
+                time.sleep(min(0.05, self.cfg.retry_period_seconds))
+
+    def run(self, stop: Callable[[], bool]) -> None:
+        if self.cfg.enable_leader_election:
+            self.elector.run(self._lead, stop)
+        else:
+            self._lead(stop)
+
+
+def build(argv: Optional[Sequence[str]] = None,
+          runner: Optional[CycleRunner] = None,
+          get_nodes: Optional[Callable[[], Sequence[api.Node]]] = None
+          ) -> DeschedulerProcess:
+    p = argparse.ArgumentParser(prog="koord-descheduler")
+    p.add_argument("--feature-gates", default="")
+    p.add_argument("--lease-file", default="koord-descheduler.lease")
+    p.add_argument("--enable-leader-election", dest="leader_election",
+                   action="store_true", default=True)
+    p.add_argument("--disable-leader-election", dest="leader_election",
+                   action="store_false")
+    p.add_argument("--descheduling-interval-seconds", type=float,
+                   default=120.0)
+    p.add_argument("--identity", default="")
+    args = p.parse_args(argv)
+    cfg = DeschedulerConfig(
+        descheduling_interval_seconds=args.descheduling_interval_seconds,
+        lease_file=args.lease_file,
+        enable_leader_election=args.leader_election,
+        feature_gates=args.feature_gates,
+        identity=args.identity)
+    if runner is None or get_nodes is None:
+        raise SystemExit("koord-descheduler needs a CycleRunner and a node "
+                         "source; pass them via build(runner=, get_nodes=)")
+    return DeschedulerProcess(cfg, runner, get_nodes)
+
+
+def main(argv: Optional[Sequence[str]] = None,
+         runner: Optional[CycleRunner] = None,
+         get_nodes: Optional[Callable[[], Sequence[api.Node]]] = None) -> int:
+    proc = build(argv, runner, get_nodes)
+    stop = StopHandle().install_signal_handlers()
+    proc.run(stop.stopped)
+    return 0

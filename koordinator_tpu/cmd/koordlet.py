@@ -1,0 +1,45 @@
+"""koordlet process: the per-node agent daemon.
+
+Capability parity with `cmd/koordlet/main.go`: flags + feature gates
+mapped onto DaemonConfig, graceful shutdown. No leader election — one
+agent per node. The host root flag lets the agent run against any mounted
+kernel tree (the production default "/", a FakeHost dir in demos)."""
+
+from __future__ import annotations
+
+import argparse
+from typing import Optional, Sequence
+
+from koordinator_tpu.cmd.runtime import StopHandle, parse_feature_gates
+from koordinator_tpu.features import DEFAULT_FEATURE_GATE
+from koordinator_tpu.koordlet.agent import Daemon, DaemonConfig
+from koordinator_tpu.koordlet.system import Host
+
+
+def build(argv: Optional[Sequence[str]] = None,
+          host: Optional[Host] = None) -> Daemon:
+    p = argparse.ArgumentParser(prog="koordlet")
+    p.add_argument("--feature-gates", default="")
+    p.add_argument("--host-root", default="/")
+    p.add_argument("--collect-interval-seconds", type=float, default=1.0)
+    p.add_argument("--report-interval-seconds", type=float, default=60.0)
+    p.add_argument("--checkpoint-path", default="")
+    args = p.parse_args(argv)
+    gate = DEFAULT_FEATURE_GATE
+    parse_feature_gates(gate, args.feature_gates)
+    cfg = DaemonConfig(
+        collect_interval_seconds=args.collect_interval_seconds,
+        report_interval_seconds=args.report_interval_seconds,
+        checkpoint_path=args.checkpoint_path,
+        enable_perf_group=gate.enabled("Libpfm4"),
+        enable_page_cache=gate.enabled("ColdPageCollector"),
+        enable_core_sched=gate.enabled("CoreSched"))
+    return Daemon(host or Host(args.host_root), cfg)
+
+
+def main(argv: Optional[Sequence[str]] = None,
+         host: Optional[Host] = None) -> int:
+    daemon = build(argv, host)
+    stop = StopHandle().install_signal_handlers()
+    daemon.run(stop.stopped)
+    return 0

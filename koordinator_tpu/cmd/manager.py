@@ -1,0 +1,184 @@
+"""koord-manager process: leader-elected control loop hosting the
+slo-controller reconcilers, the quota-profile reconciler, and the
+admission webhooks.
+
+Capability parity with `cmd/koord-manager/main.go`: feature-gate flags,
+leader election (single active manager), health/metrics endpoint, and
+graceful shutdown. Controller wiring mirrors
+`pkg/slo-controller/*` + `pkg/quota-controller/profile` setup done by the
+controller-runtime manager there; cluster state arrives through a
+`ClusterSource` (the edge informer plane in production, a fake in tests)
+instead of client-go informers.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import time
+from typing import Callable, Dict, List, Optional, Protocol, Sequence
+
+from koordinator_tpu.api import types as api
+from koordinator_tpu.api.extension import ResourceKind as RK
+from koordinator_tpu.cmd.runtime import (
+    FileLeaseLock,
+    LeaderElector,
+    StopHandle,
+    default_identity,
+    parse_feature_gates,
+)
+from koordinator_tpu.features import DEFAULT_FEATURE_GATE, FeatureGate
+from koordinator_tpu.quota_controller import QuotaProfileReconciler
+from koordinator_tpu.slo_controller.nodemetric import NodeMetricController
+from koordinator_tpu.slo_controller.noderesource import (
+    NodeResourceController,
+    build_inputs,
+)
+from koordinator_tpu.slo_controller.nodeslo import (
+    SLOControllerConfig,
+    render_node_slo,
+)
+from koordinator_tpu.webhook import PodMutator, QuotaTopology
+
+
+class ClusterSource(Protocol):
+    """The manager's view of the cluster (informer plane boundary)."""
+
+    def nodes(self) -> Sequence[api.Node]: ...
+    def node_metrics(self) -> Dict[str, api.NodeMetric]: ...
+    def pods_by_node(self) -> Dict[str, List[api.Pod]]: ...
+    def quota_profiles(self) -> Sequence[api.ElasticQuotaProfile]: ...
+
+
+class ClusterSink(Protocol):
+    """Where reconcile results land (status writeback boundary)."""
+
+    def set_node_batch_resources(self, node: api.Node,
+                                 batch_cpu: float, batch_mem: float,
+                                 mid_cpu: float, mid_mem: float) -> None: ...
+    def set_node_slo(self, slo: api.NodeSLO) -> None: ...
+
+
+class InMemorySink:
+    """Default sink: mutates the node objects, records NodeSLOs."""
+
+    def __init__(self) -> None:
+        self.node_slos: Dict[str, api.NodeSLO] = {}
+
+    def set_node_batch_resources(self, node: api.Node, batch_cpu: float,
+                                 batch_mem: float, mid_cpu: float,
+                                 mid_mem: float) -> None:
+        node.allocatable[RK.BATCH_CPU] = batch_cpu
+        node.allocatable[RK.BATCH_MEMORY] = batch_mem
+        node.allocatable[RK.MID_CPU] = mid_cpu
+        node.allocatable[RK.MID_MEMORY] = mid_mem
+
+    def set_node_slo(self, slo: api.NodeSLO) -> None:
+        self.node_slos[slo.node_name] = slo
+
+
+@dataclasses.dataclass
+class ManagerConfig:
+    reconcile_interval_seconds: float = 30.0
+    lease_file: str = "koord-manager.lease"
+    enable_leader_election: bool = True
+    lease_duration_seconds: float = 15.0
+    retry_period_seconds: float = 2.0
+    feature_gates: str = ""
+    identity: str = ""
+
+
+class ManagerProcess:
+    """The leader-elected reconcile loop."""
+
+    def __init__(self, cfg: ManagerConfig, source: ClusterSource,
+                 sink: Optional[ClusterSink] = None,
+                 gate: Optional[FeatureGate] = None,
+                 slo_config: Optional[SLOControllerConfig] = None,
+                 clock: Callable[[], float] = time.time):
+        self.cfg = cfg
+        self.source = source
+        self.sink = sink or InMemorySink()
+        self.gate = gate or DEFAULT_FEATURE_GATE
+        parse_feature_gates(self.gate, cfg.feature_gates)
+        self.slo_config = slo_config or SLOControllerConfig()
+        self.clock = clock
+        self.node_metric_ctl = NodeMetricController()
+        self.node_resource_ctl = NodeResourceController()
+        self.quota_reconciler = QuotaProfileReconciler(QuotaTopology())
+        self.mutator: Optional[PodMutator] = None  # admission, set by edge
+        self.ticks = 0
+        identity = cfg.identity or default_identity()
+        self.elector = LeaderElector(
+            FileLeaseLock(cfg.lease_file, cfg.lease_duration_seconds),
+            identity, cfg.retry_period_seconds, clock=clock)
+
+    # one reconcile pass over everything the manager owns
+    def tick(self, now: Optional[float] = None) -> None:
+        now = self.clock() if now is None else now
+        nodes = list(self.source.nodes())
+        metrics = self.source.node_metrics()
+        pods = self.source.pods_by_node()
+        if nodes:
+            out = self.node_resource_ctl.reconcile(
+                build_inputs(nodes, metrics, pods, now=now))
+            for i, node in enumerate(nodes):
+                if not out["sync_mask"][i]:
+                    continue
+                self.sink.set_node_batch_resources(
+                    node,
+                    float(out["batch"][i, 0]), float(out["batch"][i, 1]),
+                    float(out["mid"][i, 0]), float(out["mid"][i, 1]))
+        for node in nodes:
+            self.sink.set_node_slo(render_node_slo(
+                self.slo_config, node.meta.name, node.meta.labels))
+        for profile in self.source.quota_profiles():
+            self.quota_reconciler.reconcile(profile, nodes)
+        self.ticks += 1
+
+    def _lead(self, should_stop: Callable[[], bool]) -> None:
+        while not should_stop():
+            self.tick()
+            deadline = time.monotonic() + self.cfg.reconcile_interval_seconds
+            while not should_stop() and time.monotonic() < deadline:
+                time.sleep(min(0.05, self.cfg.retry_period_seconds))
+
+    def run(self, stop: Callable[[], bool]) -> None:
+        if self.cfg.enable_leader_election:
+            self.elector.run(self._lead, stop)
+        else:
+            self._lead(stop)
+
+
+def build(argv: Optional[Sequence[str]] = None,
+          source: Optional[ClusterSource] = None,
+          sink: Optional[ClusterSink] = None) -> ManagerProcess:
+    p = argparse.ArgumentParser(prog="koord-manager")
+    p.add_argument("--feature-gates", default="")
+    p.add_argument("--lease-file", default="koord-manager.lease")
+    p.add_argument("--enable-leader-election", dest="leader_election",
+                   action="store_true", default=True)
+    p.add_argument("--disable-leader-election", dest="leader_election",
+                   action="store_false")
+    p.add_argument("--reconcile-interval-seconds", type=float, default=30.0)
+    p.add_argument("--identity", default="")
+    args = p.parse_args(argv)
+    cfg = ManagerConfig(
+        reconcile_interval_seconds=args.reconcile_interval_seconds,
+        lease_file=args.lease_file,
+        enable_leader_election=args.leader_election,
+        feature_gates=args.feature_gates,
+        identity=args.identity)
+    if source is None:
+        raise SystemExit("koord-manager needs a cluster source (the edge "
+                         "informer plane); pass one via build(source=...)")
+    return ManagerProcess(cfg, source, sink)
+
+
+def main(argv: Optional[Sequence[str]] = None,
+         source: Optional[ClusterSource] = None,
+         sink: Optional[ClusterSink] = None) -> int:
+    proc = build(argv, source, sink)
+    stop = StopHandle().install_signal_handlers()
+    proc.run(stop.stopped)
+    return 0

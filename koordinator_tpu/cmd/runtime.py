@@ -1,0 +1,194 @@
+"""Shared process runtime: stop signals, lease-based leader election,
+feature-gate flags.
+
+Capability parity with the reference's binary entry points
+(`cmd/koord-manager/main.go`, `cmd/koord-descheduler`, `cmd/koord-scheduler`):
+flag parsing with `--feature-gates=A=true,B=false`, graceful shutdown on
+SIGTERM/SIGINT, and single-active leader election. The reference elects
+through an apiserver resource lock (resourcelock leases,
+cmd/koord-manager/main.go "leader-elect-resource-lock"); the TPU build has
+no apiserver, so the lock is a LEASE FILE on the shared state directory —
+fcntl-serialized read-modify-write gives the same single-holder guarantee
+for processes sharing a filesystem, with the same lease/renew/steal
+semantics as client-go's leaderelection package.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import fcntl
+import json
+import os
+import signal
+import threading
+import time
+from typing import Callable, Optional
+
+from koordinator_tpu.features import FeatureGate
+
+
+class StopHandle:
+    """Cooperative shutdown: a predicate components poll, settable from
+    signal handlers (the stop channel of the Go mains)."""
+
+    def __init__(self) -> None:
+        self._event = threading.Event()
+
+    def stop(self, *_signal_args) -> None:
+        self._event.set()
+
+    def stopped(self) -> bool:
+        return self._event.is_set()
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        return self._event.wait(timeout)
+
+    def install_signal_handlers(self) -> "StopHandle":
+        """Main-thread only; tests drive stop() directly."""
+        signal.signal(signal.SIGTERM, self.stop)
+        signal.signal(signal.SIGINT, self.stop)
+        return self
+
+
+@dataclasses.dataclass
+class LeaseRecord:
+    holder: str = ""
+    renew_time: float = 0.0
+    lease_duration: float = 15.0
+
+    def expired(self, now: float) -> bool:
+        return now >= self.renew_time + self.lease_duration
+
+
+class FileLeaseLock:
+    """A lease on a file: acquire when free/expired/already-held-by-self,
+    renew by bumping renew_time, release by clearing the holder. All
+    transitions run under an fcntl lock on a sidecar so two processes
+    never interleave read-modify-write (LeaseLock semantics from
+    client-go resourcelock, as used by cmd/koord-manager/main.go)."""
+
+    def __init__(self, path: str, lease_duration: float = 15.0):
+        self.path = path
+        self.lease_duration = lease_duration
+        os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+
+    def _locked(self, fn: Callable[[LeaseRecord], Optional[LeaseRecord]]
+                ) -> Optional[LeaseRecord]:
+        with open(self.path + ".lock", "w") as guard:
+            fcntl.flock(guard, fcntl.LOCK_EX)
+            try:
+                rec = self._read()
+                out = fn(rec)
+                if out is not None:
+                    tmp = self.path + ".tmp"
+                    with open(tmp, "w") as f:
+                        f.write(json.dumps(dataclasses.asdict(out)))
+                    os.replace(tmp, self.path)  # atomic publish
+                return out
+            finally:
+                fcntl.flock(guard, fcntl.LOCK_UN)
+
+    def _read(self) -> LeaseRecord:
+        try:
+            with open(self.path) as f:
+                return LeaseRecord(**json.loads(f.read()))
+        except (OSError, ValueError, TypeError):
+            return LeaseRecord()
+
+    def holder(self, now: Optional[float] = None) -> str:
+        now = time.time() if now is None else now
+        rec = self._read()
+        return "" if rec.expired(now) else rec.holder
+
+    def try_acquire(self, identity: str, now: Optional[float] = None) -> bool:
+        now = time.time() if now is None else now
+
+        def txn(rec: LeaseRecord) -> Optional[LeaseRecord]:
+            if rec.holder and rec.holder != identity and not rec.expired(now):
+                return None
+            return LeaseRecord(holder=identity, renew_time=now,
+                               lease_duration=self.lease_duration)
+
+        return self._locked(txn) is not None
+
+    def renew(self, identity: str, now: Optional[float] = None) -> bool:
+        """Fails when the lease was stolen (we stopped being the holder)."""
+        now = time.time() if now is None else now
+
+        def txn(rec: LeaseRecord) -> Optional[LeaseRecord]:
+            if rec.holder != identity:
+                return None
+            return LeaseRecord(holder=identity, renew_time=now,
+                               lease_duration=self.lease_duration)
+
+        return self._locked(txn) is not None
+
+    def release(self, identity: str) -> None:
+        def txn(rec: LeaseRecord) -> Optional[LeaseRecord]:
+            if rec.holder != identity:
+                return None
+            return LeaseRecord()
+
+        self._locked(txn)
+
+
+class LeaderElector:
+    """client-go leaderelection loop: acquire -> lead while renewing ->
+    release on stop / step down on lost lease. `on_started_leading`
+    receives a should-stop predicate it must poll; it returning means the
+    leadership session ended."""
+
+    def __init__(self, lock: FileLeaseLock, identity: str,
+                 retry_period: float = 2.0,
+                 clock: Callable[[], float] = time.time,
+                 sleep: Callable[[float], None] = time.sleep):
+        self.lock = lock
+        self.identity = identity
+        self.retry_period = retry_period
+        self.clock = clock
+        self.sleep = sleep
+        self.is_leader = False
+
+    def run(self, on_started_leading: Callable[[Callable[[], bool]], None],
+            stop: Callable[[], bool]) -> None:
+        while not stop():
+            if not self.lock.try_acquire(self.identity, self.clock()):
+                self.sleep(self.retry_period)
+                continue
+            self.is_leader = True
+            lost = threading.Event()
+            done = threading.Event()
+
+            def renew_loop() -> None:
+                # ANY failure to renew — stolen lease or an I/O error on
+                # the lease file — must depose this leader: a silently
+                # dead renewer while the lease expires is split brain
+                try:
+                    while not done.is_set() and not lost.is_set():
+                        if not self.lock.renew(self.identity, self.clock()):
+                            lost.set()  # stolen — step down
+                            break
+                        done.wait(self.retry_period)
+                except Exception:
+                    lost.set()
+
+            renewer = threading.Thread(target=renew_loop, daemon=True)
+            renewer.start()
+            try:
+                on_started_leading(lambda: stop() or lost.is_set())
+            finally:
+                done.set()
+                renewer.join()
+                self.is_leader = False
+                if not lost.is_set():
+                    self.lock.release(self.identity)
+
+
+def parse_feature_gates(gate: FeatureGate, spec: str) -> None:
+    """--feature-gates=A=true,B=false (component-base flag syntax)."""
+    if spec:
+        gate.parse(spec)
+
+
+def default_identity() -> str:
+    return f"{os.uname().nodename}_{os.getpid()}"

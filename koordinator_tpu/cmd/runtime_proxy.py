@@ -1,0 +1,50 @@
+"""koord-runtime-proxy process: CRI interposition between kubelet and the
+container runtime.
+
+Capability parity with `cmd/koord-runtime-proxy/main.go`: builds the
+RuntimeProxy dispatcher over an injected backend (the real CRI client in
+production, a fake in tests) and an RpcClient to the koordlet hook
+socket, then idles until stopped. Flags: --runtime-hooks-endpoint (the
+koordlet hook socket; the reference's RuntimeHookServerKey config) and
+--hook-failure-policy Fail|Ignore."""
+
+from __future__ import annotations
+
+import argparse
+import time
+from typing import Optional, Sequence
+
+from koordinator_tpu.cmd.runtime import StopHandle
+from koordinator_tpu.runtimeproxy.rpc import RpcClient
+from koordinator_tpu.runtimeproxy.server import (
+    FailurePolicy,
+    RuntimeBackend,
+    RuntimeProxy,
+)
+
+
+def build(argv: Optional[Sequence[str]] = None,
+          backend: Optional[RuntimeBackend] = None) -> RuntimeProxy:
+    p = argparse.ArgumentParser(prog="koord-runtime-proxy")
+    p.add_argument("--runtime-hooks-endpoint",
+                   default="/var/run/koordlet/koordlet.sock")
+    p.add_argument("--hook-failure-policy", choices=["Fail", "Ignore"],
+                   default="Ignore")
+    args = p.parse_args(argv)
+    if backend is None:
+        raise SystemExit("koord-runtime-proxy needs a CRI backend; pass one "
+                         "via build(backend=...)")
+    policy = (FailurePolicy.FAIL if args.hook_failure_policy == "Fail"
+              else FailurePolicy.IGNORE)
+    return RuntimeProxy(backend,
+                        hook_client=RpcClient(args.runtime_hooks_endpoint),
+                        failure_policy=policy)
+
+
+def main(argv: Optional[Sequence[str]] = None,
+         backend: Optional[RuntimeBackend] = None) -> int:
+    proxy = build(argv, backend)  # noqa: F841 — held live while serving
+    stop = StopHandle().install_signal_handlers()
+    while not stop.stopped():
+        time.sleep(0.2)
+    return 0

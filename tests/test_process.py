@@ -1,0 +1,270 @@
+"""Process shape: entry points, lease-file leader election, graceful
+shutdown, and the control-plane trio running in-process against fakes
+(the cmd/ layer; reference: cmd/koord-manager/main.go leader election +
+the five binaries' flag surface)."""
+
+import threading
+import time
+
+import pytest
+
+from koordinator_tpu.api import types as api
+from koordinator_tpu.api.extension import ResourceKind as RK
+from koordinator_tpu.cmd import FileLeaseLock, LeaderElector, StopHandle
+from koordinator_tpu.cmd import descheduler as cmd_descheduler
+from koordinator_tpu.cmd import koordlet as cmd_koordlet
+from koordinator_tpu.cmd import manager as cmd_manager
+from koordinator_tpu.cmd import scheduler as cmd_scheduler
+from koordinator_tpu.descheduler import (
+    LowNodeLoad,
+    LowNodeLoadArgs,
+    RecordingEvictor,
+)
+from koordinator_tpu.descheduler.framework import CycleRunner
+from koordinator_tpu.koordlet.testing import FakeHost
+
+
+# --- lease lock -------------------------------------------------------------
+
+def test_lease_acquire_renew_release(tmp_path):
+    lock = FileLeaseLock(str(tmp_path / "a.lease"), lease_duration=10.0)
+    assert lock.try_acquire("p1", now=0.0)
+    assert lock.holder(now=1.0) == "p1"
+    # a contender cannot take a live lease
+    assert not lock.try_acquire("p2", now=5.0)
+    # the holder renews; contender still locked out past the original TTL
+    assert lock.renew("p1", now=9.0)
+    assert not lock.try_acquire("p2", now=12.0)
+    # release frees it immediately
+    lock.release("p1")
+    assert lock.holder(now=12.0) == ""
+    assert lock.try_acquire("p2", now=12.0)
+
+
+def test_lease_steal_after_expiry(tmp_path):
+    lock = FileLeaseLock(str(tmp_path / "a.lease"), lease_duration=10.0)
+    assert lock.try_acquire("p1", now=0.0)
+    # p1 dies silently; p2 must wait out the TTL then steal
+    assert not lock.try_acquire("p2", now=9.9)
+    assert lock.try_acquire("p2", now=10.1)
+    # p1's renew now fails — it knows it lost leadership
+    assert not lock.renew("p1", now=10.2)
+
+
+def test_elector_single_active_and_failover(tmp_path):
+    """Two electors on one lease: exactly one leads; when it stops, the
+    other takes over."""
+    path = str(tmp_path / "el.lease")
+    leads = {"a": 0, "b": 0}
+    active = []
+    stop_a, stop_b = threading.Event(), threading.Event()
+
+    def make(name, stop_ev):
+        lock = FileLeaseLock(path, lease_duration=0.5)
+        el = LeaderElector(lock, name, retry_period=0.02)
+
+        def lead(should_stop):
+            leads[name] += 1
+            active.append(name)
+            while not should_stop():
+                time.sleep(0.01)
+            active.remove(name)
+
+        t = threading.Thread(target=el.run,
+                             args=(lead, stop_ev.is_set), daemon=True)
+        t.start()
+        return t
+
+    ta = make("a", stop_a)
+    time.sleep(0.1)
+    tb = make("b", stop_b)
+    time.sleep(0.2)
+    assert active == ["a"] and leads["a"] == 1 and leads["b"] == 0
+
+    stop_a.set()
+    ta.join(timeout=5.0)
+    # b takes over once a releases
+    deadline = time.monotonic() + 5.0
+    while not active and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert active == ["b"]
+    stop_b.set()
+    tb.join(timeout=5.0)
+    assert not active
+
+
+# --- manager process --------------------------------------------------------
+
+class FakeSource:
+    def __init__(self, nodes, metrics, profiles=()):
+        self._nodes = nodes
+        self._metrics = metrics
+        self._profiles = list(profiles)
+
+    def nodes(self):
+        return self._nodes
+
+    def node_metrics(self):
+        return self._metrics
+
+    def pods_by_node(self):
+        return {}
+
+    def quota_profiles(self):
+        return self._profiles
+
+
+def mk_cluster(n=3, metric_time=1e9):
+    nodes = [api.Node(meta=api.ObjectMeta(name=f"n{i}",
+                                          labels={"pool": "colo"}),
+                      allocatable={RK.CPU: 64000.0, RK.MEMORY: 256 * 1024.0})
+             for i in range(n)]
+    metrics = {n.meta.name: api.NodeMetric(
+        node_name=n.meta.name, update_time=metric_time,
+        node_usage={RK.CPU: 8000.0, RK.MEMORY: 32 * 1024.0})
+        for n in nodes}
+    return nodes, metrics
+
+
+def test_manager_tick_reconciles_everything(tmp_path):
+    nodes, metrics = mk_cluster()
+    profile = api.ElasticQuotaProfile(
+        meta=api.ObjectMeta(name="colo"), quota_name="colo-root",
+        node_selector={"pool": "colo"})
+    src = FakeSource(nodes, metrics, [profile])
+    proc = cmd_manager.ManagerProcess(
+        cmd_manager.ManagerConfig(lease_file=str(tmp_path / "m.lease")),
+        src)
+    proc.tick(now=1e9)
+    # batch overcommit landed on the nodes
+    assert all(n.allocatable.get(RK.BATCH_CPU, 0) > 0 for n in nodes)
+    # NodeSLO rendered per node
+    assert set(proc.sink.node_slos) == {n.meta.name for n in nodes}
+    # quota tree provisioned from the profile
+    root = proc.quota_reconciler.quotas["colo-root"]
+    assert root.min[RK.CPU] == sum(64000.0 for _ in nodes)
+
+
+def test_manager_leader_election_single_active(tmp_path):
+    """Two manager replicas, one lease: only the leader ticks."""
+    nodes, metrics = mk_cluster()
+    src = FakeSource(nodes, metrics)
+    lease = str(tmp_path / "m.lease")
+
+    def mk(ident):
+        # identity must be explicit in-process: both replicas share a pid,
+        # so default_identity() would collide and both would "hold" it
+        return cmd_manager.ManagerProcess(
+            cmd_manager.ManagerConfig(
+                lease_file=lease, reconcile_interval_seconds=0.02,
+                lease_duration_seconds=1.0, retry_period_seconds=0.02,
+                identity=ident),
+            src)
+
+    m1, m2 = mk("m1"), mk("m2")
+    stop = threading.Event()
+    t1 = threading.Thread(target=m1.run, args=(stop.is_set,), daemon=True)
+    t1.start()
+    time.sleep(0.1)
+    t2 = threading.Thread(target=m2.run, args=(stop.is_set,), daemon=True)
+    t2.start()
+    time.sleep(0.3)
+    stop.set()
+    t1.join(timeout=5.0)
+    t2.join(timeout=5.0)
+    assert m1.ticks > 0
+    assert m2.ticks == 0, "standby replica must not reconcile"
+
+
+# --- descheduler process ----------------------------------------------------
+
+def test_descheduler_process_cycles(tmp_path):
+    nodes, metrics = mk_cluster()
+    evictor = RecordingEvictor()
+    runner = CycleRunner(limiters=[evictor.limiter])
+    proc = cmd_descheduler.DeschedulerProcess(
+        cmd_descheduler.DeschedulerConfig(
+            lease_file=str(tmp_path / "d.lease"),
+            descheduling_interval_seconds=0.02,
+            retry_period_seconds=0.02),
+        runner, get_nodes=lambda: nodes)
+    stop = threading.Event()
+    t = threading.Thread(target=proc.run, args=(stop.is_set,), daemon=True)
+    t.start()
+    time.sleep(0.25)
+    stop.set()
+    t.join(timeout=5.0)
+    assert proc.cycles >= 2
+
+
+# --- scheduler + koordlet entry points --------------------------------------
+
+def test_scheduler_process_serves_metrics(tmp_path):
+    import json
+    import urllib.request
+
+    proc = cmd_scheduler.build(
+        ["--metrics-port", "0",
+         "--lease-file", str(tmp_path / "s.lease")])
+    stop = threading.Event()
+    t = threading.Thread(target=proc.run, args=(stop.is_set,), daemon=True)
+    t.start()
+    try:
+        url = f"http://127.0.0.1:{proc.server.port}/apis/v1/plugins"
+        with urllib.request.urlopen(url, timeout=5) as r:
+            assert "scheduler" in json.loads(r.read())["plugins"]
+    finally:
+        stop.set()
+        t.join(timeout=5.0)
+
+
+def test_koordlet_main_builds_from_flags(tmp_path):
+    host = FakeHost(str(tmp_path / "hostroot"))
+    daemon = cmd_koordlet.build(
+        ["--feature-gates", "ColdPageCollector=true",
+         "--report-interval-seconds", "30"], host=host)
+    assert daemon.cfg.report_interval_seconds == 30.0
+    assert daemon.cfg.enable_page_cache is True
+    # one tick against the fake host must work end to end
+    daemon.informer.set_node(api.Node(meta=api.ObjectMeta(name="n1")))
+    daemon.tick(now=0.0)
+
+
+def test_trio_end_to_end_graceful_shutdown(tmp_path):
+    """Launch manager + descheduler + scheduler together against shared
+    fakes; all three come up, do work, and stop cleanly."""
+    # processes run on the REAL clock: NodeMetrics must be fresh or the
+    # noderesource controller degrades instead of computing batch capacity
+    nodes, metrics = mk_cluster(metric_time=time.time())
+    src = FakeSource(nodes, metrics)
+    mgr = cmd_manager.ManagerProcess(
+        cmd_manager.ManagerConfig(
+            lease_file=str(tmp_path / "m.lease"),
+            reconcile_interval_seconds=0.02, retry_period_seconds=0.02),
+        src)
+    evictor = RecordingEvictor()
+    lnl = LowNodeLoad(LowNodeLoadArgs(), evictor,
+                      get_metrics=lambda: metrics,
+                      get_pods_by_node=lambda: {})
+    runner = CycleRunner(balance_plugins=[lnl], limiters=[evictor.limiter])
+    desched = cmd_descheduler.DeschedulerProcess(
+        cmd_descheduler.DeschedulerConfig(
+            lease_file=str(tmp_path / "d.lease"),
+            descheduling_interval_seconds=0.02, retry_period_seconds=0.02),
+        runner, get_nodes=lambda: nodes)
+    sched = cmd_scheduler.build(
+        ["--metrics-port", "-1", "--lease-file", str(tmp_path / "s.lease")])
+
+    stop = StopHandle()
+    threads = [threading.Thread(target=p.run, args=(stop.stopped,),
+                                daemon=True)
+               for p in (mgr, desched, sched)]
+    for t in threads:
+        t.start()
+    time.sleep(0.3)
+    stop.stop()
+    for t in threads:
+        t.join(timeout=5.0)
+        assert not t.is_alive(), "process failed to shut down"
+    assert mgr.ticks > 0 and desched.cycles > 0
+    assert all(n.allocatable.get(RK.BATCH_CPU, 0) > 0 for n in nodes)
