@@ -295,6 +295,24 @@ class NodeSLO:
 
 
 @dataclasses.dataclass
+class ReservationCondition:
+    """Status condition on a Reservation (reservation_types.go
+    ReservationCondition; written by the scheduler's error handler on
+    unschedulable reserve pods)."""
+
+    type: str = "Scheduled"     # Scheduled | Ready
+    status: str = "False"       # True | False
+    reason: str = ""
+    message: str = ""
+    last_probe_time: float = 0.0
+    last_transition_time: float = 0.0
+
+
+REASON_RESERVATION_UNSCHEDULABLE = "Unschedulable"
+REASON_RESERVATION_SCHEDULED = "Scheduled"
+
+
+@dataclasses.dataclass
 class Reservation:
     """Reserved capacity scheduled like a pod, later consumed by matching
     owners (scheduling/v1alpha1 reservation_types.go:27-64)."""
@@ -308,6 +326,8 @@ class Reservation:
     phase: str = "Pending"      # Pending|Available|Succeeded|Failed|Expired
     allocated: ResourceList = dataclasses.field(default_factory=dict)
     create_time: float = 0.0
+    conditions: List[ReservationCondition] = dataclasses.field(
+        default_factory=list)
     # fine-grained holds granted when the reserve pod was scheduled (the
     # device-allocation / resource-status annotations on the reservation;
     # restored to consumers, transformer.go:240-291)

@@ -22,7 +22,7 @@ from koordinator_tpu.cmd.runtime import (
     parse_feature_gates,
 )
 from koordinator_tpu.descheduler.framework import CycleRunner, EvictionLimiter
-from koordinator_tpu.features import DEFAULT_FEATURE_GATE, FeatureGate
+from koordinator_tpu.features import FeatureGate, new_default_gate
 
 
 @dataclasses.dataclass
@@ -48,7 +48,7 @@ class DeschedulerProcess:
         self.cfg = cfg
         self.runner = runner
         self.get_nodes = get_nodes
-        self.gate = gate or DEFAULT_FEATURE_GATE
+        self.gate = gate or new_default_gate()
         parse_feature_gates(self.gate, cfg.feature_gates)
         self.cycles = 0
         identity = cfg.identity or default_identity()

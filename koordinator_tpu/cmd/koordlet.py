@@ -11,7 +11,7 @@ import argparse
 from typing import Optional, Sequence
 
 from koordinator_tpu.cmd.runtime import StopHandle, parse_feature_gates
-from koordinator_tpu.features import DEFAULT_FEATURE_GATE
+from koordinator_tpu.features import new_default_gate
 from koordinator_tpu.koordlet.agent import Daemon, DaemonConfig
 from koordinator_tpu.koordlet.system import Host
 
@@ -25,7 +25,7 @@ def build(argv: Optional[Sequence[str]] = None,
     p.add_argument("--report-interval-seconds", type=float, default=60.0)
     p.add_argument("--checkpoint-path", default="")
     args = p.parse_args(argv)
-    gate = DEFAULT_FEATURE_GATE
+    gate = new_default_gate()
     parse_feature_gates(gate, args.feature_gates)
     cfg = DaemonConfig(
         collect_interval_seconds=args.collect_interval_seconds,

@@ -27,7 +27,7 @@ from koordinator_tpu.cmd.runtime import (
     default_identity,
     parse_feature_gates,
 )
-from koordinator_tpu.features import DEFAULT_FEATURE_GATE, FeatureGate
+from koordinator_tpu.features import FeatureGate, new_default_gate
 from koordinator_tpu.quota_controller import QuotaProfileReconciler
 from koordinator_tpu.slo_controller.nodemetric import NodeMetricController
 from koordinator_tpu.slo_controller.noderesource import (
@@ -99,7 +99,7 @@ class ManagerProcess:
         self.cfg = cfg
         self.source = source
         self.sink = sink or InMemorySink()
-        self.gate = gate or DEFAULT_FEATURE_GATE
+        self.gate = gate or new_default_gate()
         parse_feature_gates(self.gate, cfg.feature_gates)
         self.slo_config = slo_config or SLOControllerConfig()
         self.clock = clock

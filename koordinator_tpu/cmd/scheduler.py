@@ -22,7 +22,7 @@ from koordinator_tpu.cmd.runtime import (
     default_identity,
     parse_feature_gates,
 )
-from koordinator_tpu.features import DEFAULT_FEATURE_GATE, FeatureGate
+from koordinator_tpu.features import FeatureGate, new_default_gate
 from koordinator_tpu.scheduler.frameworkext import (
     SchedulerService,
     ServicesServer,
@@ -47,7 +47,7 @@ class SchedulerProcess:
                  clock: Callable[[], float] = time.time):
         self.cfg = cfg
         self.service = service or SchedulerService()
-        self.gate = gate or DEFAULT_FEATURE_GATE
+        self.gate = gate or new_default_gate()
         parse_feature_gates(self.gate, cfg.feature_gates)
         self.server: Optional[ServicesServer] = None
         if cfg.metrics_port >= 0:

@@ -131,3 +131,13 @@ SCHEDULER_GATES = _specs(
 
 DEFAULT_FEATURE_GATE = FeatureGate({**MANAGER_GATES, **KOORDLET_GATES,
                                     **SCHEDULER_GATES})
+
+
+def new_default_gate() -> FeatureGate:
+    """A FRESH gate with every catalog — one per process/daemon instance.
+    Each binary owns its own mutable gate (cmd/*/options in the
+    reference); sharing the module-global DEFAULT_FEATURE_GATE across
+    in-process components would leak --feature-gates overrides between
+    them."""
+    return FeatureGate({**MANAGER_GATES, **KOORDLET_GATES,
+                        **SCHEDULER_GATES})

@@ -1,0 +1,188 @@
+"""Scheduling error-handler chain + reservation unschedulable writeback.
+
+Capability parity with `pkg/scheduler/frameworkext/errorhandler_dispatcher.go`
+(pre filters -> default handler -> post filters, a filter returning True
+claims the error) and `frameworkext/eventhandlers/reservation_handler.go`
+(reserve-pod failures write a Scheduled=False/Unschedulable condition on
+the Reservation and requeue it unless it already landed on a node).
+
+In the batched TPU scheduler a "scheduling error" is an unplaced row of a
+batch (assignment -1): `dispatch_batch_errors` fans the unplaced pods out
+through the chain, so plugins observe exactly the per-pod error stream
+the reference's queue-centric scheduler produces.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Callable, List, Optional
+
+import numpy as np
+
+from koordinator_tpu.api import types as api
+
+
+@dataclasses.dataclass
+class SchedulingError(Exception):
+    """FitError equivalent: the pod failed this cycle."""
+
+    message: str = "no fit"
+    unschedulable: bool = True  # False = infrastructure error, retry hard
+
+    def __str__(self) -> str:
+        return self.message
+
+
+@dataclasses.dataclass
+class QueuedPodInfo:
+    """What the handlers see per failed pod (framework.QueuedPodInfo's
+    relevant surface): the typed pod, plus attempt bookkeeping."""
+
+    pod: api.Pod
+    attempts: int = 1
+    unschedulable_plugins: List[str] = dataclasses.field(default_factory=list)
+
+
+# a filter returns True to CLAIM the error (stop the chain)
+ErrorFilter = Callable[[QueuedPodInfo, SchedulingError], bool]
+ErrorHandler = Callable[[QueuedPodInfo, SchedulingError], None]
+
+
+class ErrorHandlerDispatcher:
+    """errorhandler_dispatcher.go: pre filters may claim; otherwise the
+    default handler runs; post filters always get a chance afterwards."""
+
+    def __init__(self, default_handler: Optional[ErrorHandler] = None):
+        self._pre: List[ErrorFilter] = []
+        self._post: List[ErrorFilter] = []
+        self._default: Optional[ErrorHandler] = default_handler
+
+    def set_default_handler(self, handler: ErrorHandler) -> None:
+        self._default = handler
+
+    def register(self, pre: Optional[ErrorFilter] = None,
+                 post: Optional[ErrorFilter] = None) -> None:
+        if pre is not None:
+            self._pre.append(pre)
+        if post is not None:
+            self._post.append(post)
+
+    def error(self, pod_info: QueuedPodInfo, err: SchedulingError) -> None:
+        try:
+            for f in self._pre:
+                if f(pod_info, err):
+                    return
+            if self._default is not None:
+                self._default(pod_info, err)
+        finally:
+            for f in self._post:
+                if f(pod_info, err):
+                    break
+
+
+def set_reservation_unschedulable(r: api.Reservation, msg: str,
+                                  now: Optional[float] = None) -> None:
+    """setReservationUnschedulable (reservation_handler.go:155-190):
+    append or refresh the Scheduled condition; an already-scheduled
+    reservation only gets its probe time bumped (the condition records
+    the LAST scheduling attempt, the phase is untouched so the reserve
+    pod retries next cycle)."""
+    now = time.time() if now is None else now
+    for cond in r.conditions:
+        if cond.type == "Scheduled":
+            if cond.status == "True":
+                cond.last_probe_time = now  # scheduled; just probed again
+            else:
+                cond.reason = api.REASON_RESERVATION_UNSCHEDULABLE
+                cond.message = msg
+                cond.last_probe_time = now
+            return
+    r.conditions.append(api.ReservationCondition(
+        type="Scheduled", status="False",
+        reason=api.REASON_RESERVATION_UNSCHEDULABLE, message=msg,
+        last_probe_time=now, last_transition_time=now))
+
+
+def set_reservation_scheduled(r: api.Reservation, node_name: str,
+                              now: Optional[float] = None) -> None:
+    """The success-side writeback the controllers run on assignment."""
+    now = time.time() if now is None else now
+    r.node_name = node_name
+    for cond in r.conditions:
+        if cond.type == "Scheduled":
+            if cond.status != "True":
+                cond.last_transition_time = now
+            cond.status = "True"
+            cond.reason = api.REASON_RESERVATION_SCHEDULED
+            cond.message = ""
+            cond.last_probe_time = now
+            return
+    r.conditions.append(api.ReservationCondition(
+        type="Scheduled", status="True",
+        reason=api.REASON_RESERVATION_SCHEDULED,
+        last_probe_time=now, last_transition_time=now))
+
+
+def make_reservation_error_filter(
+        get_reservation: Callable[[str], Optional[api.Reservation]],
+        requeue: Optional[Callable[[api.Reservation], None]] = None,
+        clock: Callable[[], float] = time.time) -> ErrorFilter:
+    """The reservation pre-filter (reservation_handler.go:60-151): claims
+    reserve-pod errors, writes the unschedulable condition, and requeues
+    the reservation for the next cycle — unless the live object already
+    carries a node (bind raced the error), where it aborts the requeue."""
+
+    def filt(pod_info: QueuedPodInfo, err: SchedulingError) -> bool:
+        name = reservation_name_of(pod_info.pod)
+        if name is None:
+            return False  # not a reserve pod: let the default handler run
+        r = get_reservation(name)
+        if r is None:
+            return True  # reservation deleted; drop silently (":77-80")
+        if r.node_name:
+            return True  # already landed; stale error (":136-141")
+        set_reservation_unschedulable(r, str(err), clock())
+        if requeue is not None:
+            requeue(r)
+        return True
+
+    return filt
+
+
+# reserve pods are synthesized from reservations; the marker label is the
+# TPU build's equivalent of reservationutil.IsReservePod's name scheme
+LABEL_RESERVE_POD = "koordinator.sh/reservation-name"
+
+
+def reservation_name_of(pod: api.Pod) -> Optional[str]:
+    return pod.meta.labels.get(LABEL_RESERVE_POD)
+
+
+def reserve_pod_for(r: api.Reservation) -> api.Pod:
+    """NewReservePod: the pod the scheduler places to site a reservation."""
+    return api.Pod(
+        meta=api.ObjectMeta(
+            name=f"reserve-{r.meta.name}", uid=f"reserve-{r.meta.uid}",
+            labels={LABEL_RESERVE_POD: r.meta.name}),
+        requests=dict(r.requests))
+
+
+def dispatch_batch_errors(dispatcher: ErrorHandlerDispatcher,
+                          assignment: np.ndarray, valid: np.ndarray,
+                          pods: List[api.Pod],
+                          message: str = "no node fits") -> int:
+    """Fan a batch's unplaced rows through the chain; returns the count.
+    `pods` is the typed pod list in batch order (rows past its length are
+    padding and never dispatched)."""
+    n = 0
+    for i, pod in enumerate(pods):
+        if i >= assignment.shape[0] or not bool(valid[i]):
+            continue
+        if int(assignment[i]) >= 0:
+            continue
+        dispatcher.error(QueuedPodInfo(pod=pod),
+                        SchedulingError(f"{message}: pod "
+                                        f"{pod.meta.namespaced_name}"))
+        n += 1
+    return n

@@ -240,6 +240,12 @@ class SchedulerService:
         # landing mid-batch would otherwise be silently replaced by the
         # post-commit snapshot derived from the PREVIOUS version
         self._commit_lock = threading.Lock()
+        # pre->default->post error chain; plugins (reservation writeback)
+        # register filters (errorhandler_dispatcher.go)
+        from koordinator_tpu.scheduler.errorhandler import (
+            ErrorHandlerDispatcher,
+        )
+        self.error_dispatcher = ErrorHandlerDispatcher()
         self.registry.register("scheduler", self.summary)
 
     def publish(self, snapshot: ClusterSnapshot) -> None:
@@ -247,7 +253,11 @@ class SchedulerService:
             self.store.publish(snapshot)
 
     def schedule(self, pods: PodBatch,
-                 pod_names: Optional[List[str]] = None) -> core.ScheduleResult:
+                 pod_names: Optional[List[str]] = None,
+                 typed_pods: Optional[List] = None) -> core.ScheduleResult:
+        """`typed_pods` (batch-ordered api.Pod list) opts unplaced rows
+        into the error-handler chain — the reservation filter needs the
+        typed pod to recognize reserve pods."""
         token = self.monitor.start_cycle()
         with self._commit_lock:
             snap = self.store.current()
@@ -269,6 +279,12 @@ class SchedulerService:
         self.metrics.pods_scheduled.labels("unschedulable").inc(
             int(((assignment < 0) & valid).sum()))
         self.metrics.snapshot_version.set(float(self.store.version))
+        if typed_pods is not None:
+            from koordinator_tpu.scheduler.errorhandler import (
+                dispatch_batch_errors,
+            )
+            dispatch_batch_errors(self.error_dispatcher, assignment, valid,
+                                  typed_pods)
         if self.flags.score_top_n > 0:
             log.info("score table:\n%s", debug_score_table(
                 snap, pods, self.cfg, self.flags.score_top_n, pod_names))

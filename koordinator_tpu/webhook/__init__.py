@@ -4,6 +4,13 @@ ClusterColocationProfile and the ElasticQuota topology guard
 
 from koordinator_tpu.webhook.pod_mutating import PodMutator  # noqa: F401
 from koordinator_tpu.webhook.pod_validating import validate_pod  # noqa: F401
+from koordinator_tpu.webhook.node_webhook import (  # noqa: F401
+    NodeMutator,
+    validate_node,
+)
+from koordinator_tpu.webhook.config_validating import (  # noqa: F401
+    validate_slo_configmap,
+)
 from koordinator_tpu.webhook.elasticquota import (  # noqa: F401
     DEFAULT_QUOTA_NAME,
     ROOT_QUOTA_NAME,

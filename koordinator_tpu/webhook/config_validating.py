@@ -1,0 +1,237 @@
+"""slo-controller-config ConfigMap validation.
+
+Capability parity with `pkg/webhook/cm/` — the validating handler runs a
+checker per config key (plugins/sloconfig/{colocation,resource_threshold,
+cpu_burst,resource_qos,system_config}_checker.go): each key must parse,
+satisfy its field bounds, and keep node-override selectors non-empty.
+The reference encodes bounds as struct validator tags on
+apis/configuration; here they are explicit range checks on the typed
+strategies (same constraints the koordlet enforcement path assumes).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import re
+from typing import Callable, Dict, List, Tuple
+
+from koordinator_tpu.api import types as api
+from koordinator_tpu.slo_controller.config import (
+    CalculatePolicy,
+    ColocationConfig,
+    ColocationStrategy,
+    ColocationStrategyOverride,
+    validate_colocation_config,
+)
+from koordinator_tpu.slo_controller.nodeslo import StrategyOverride
+
+# ConfigMap keys (sloconfig/config.go ConfigNameColocation etc.)
+KEY_COLOCATION = "colocation-config"
+KEY_RESOURCE_THRESHOLD = "resource-threshold-config"
+KEY_CPU_BURST = "cpu-burst-config"
+KEY_RESOURCE_QOS = "resource-qos-config"
+KEY_SYSTEM = "system-config"
+
+KNOWN_KEYS = (KEY_COLOCATION, KEY_RESOURCE_THRESHOLD, KEY_CPU_BURST,
+              KEY_RESOURCE_QOS, KEY_SYSTEM)
+
+_QOS_TIERS = ("LSE", "LSR", "LS", "BE", "SYSTEM", "NONE")
+_QOS_KNOBS = {"groupIdentity": (-1, 2), "memoryPriority": (0, 12),
+              "llcPercent": (0, 100), "mbaPercent": (0, 100),
+              "memoryLow": (0, float("inf")), "memoryHigh": (0, float("inf")),
+              "memoryWmarkRatio": (0, 100), "cpuIdle": (0, 1)}
+
+
+_SNAKE_RE = re.compile(r"(?<=[a-z0-9])(?=[A-Z])|(?<=[A-Z])(?=[A-Z][a-z])")
+
+
+def _snake(key: str) -> str:
+    """cpuEvictBEUsageThresholdPercent -> cpu_evict_be_usage_threshold_
+    percent: acronym runs (BE, CPU) stay one segment — a per-character
+    split would mangle them into b_e."""
+    return _SNAKE_RE.sub("_", key).lower()
+
+
+def _build(cls, data: dict, where: str, errs: List[str]):
+    """Construct a dataclass from camelCase JSON fields; unknown fields
+    are rejected (the reference decodes with DisallowUnknownFields)."""
+    fields = {f.name: f for f in dataclasses.fields(cls)}
+    kwargs = {}
+    for key, value in data.items():
+        snake = _snake(key)
+        if snake not in fields:
+            errs.append(f"{where}: unknown field {key!r}")
+            continue
+        kwargs[snake] = value
+    try:
+        return cls(**kwargs)
+    except (TypeError, ValueError) as e:
+        errs.append(f"{where}: {e}")
+        return cls()
+
+
+def _overrides(data: dict, where: str,
+               errs: List[str]) -> List[StrategyOverride]:
+    out = []
+    for i, entry in enumerate(data.get("nodeStrategies", [])):
+        sel = entry.get("nodeSelector", {})
+        if not sel:
+            errs.append(f"{where}.nodeStrategies[{i}]: empty node selector")
+        snake_fields = {_snake(k): v for k, v in entry.items()
+                        if k != "nodeSelector"}
+        out.append(StrategyOverride(node_selector=sel, fields=snake_fields))
+    return out
+
+
+# --- per-key checkers --------------------------------------------------------
+
+def _check_colocation(raw: str, errs: List[str]) -> None:
+    data = json.loads(raw)
+    cluster = _build(ColocationStrategy,
+                     {k: v for k, v in data.items()
+                      if k not in ("nodeConfigs",)},
+                     KEY_COLOCATION, errs)
+    if isinstance(cluster.cpu_calculate_policy, str):
+        try:
+            cluster.cpu_calculate_policy = CalculatePolicy(
+                cluster.cpu_calculate_policy)
+        except ValueError:
+            errs.append(f"{KEY_COLOCATION}: unknown cpuCalculatePolicy "
+                        f"{cluster.cpu_calculate_policy!r}")
+            cluster.cpu_calculate_policy = CalculatePolicy.USAGE
+    if isinstance(cluster.memory_calculate_policy, str):
+        try:
+            cluster.memory_calculate_policy = CalculatePolicy(
+                cluster.memory_calculate_policy)
+        except ValueError:
+            errs.append(f"{KEY_COLOCATION}: unknown memoryCalculatePolicy "
+                        f"{cluster.memory_calculate_policy!r}")
+            cluster.memory_calculate_policy = CalculatePolicy.USAGE
+    overrides = []
+    for i, entry in enumerate(data.get("nodeConfigs", [])):
+        sel = entry.get("nodeSelector", {})
+        if not sel:
+            errs.append(f"{KEY_COLOCATION}.nodeConfigs[{i}]: empty selector")
+        fields = {_snake(k): v for k, v in entry.items()
+                  if k != "nodeSelector"}
+        overrides.append(ColocationStrategyOverride(node_selector=sel,
+                                                    fields=fields))
+    errs.extend(validate_colocation_config(
+        ColocationConfig(cluster_strategy=cluster,
+                         node_overrides=overrides)))
+
+
+def _check_threshold(raw: str, errs: List[str]) -> None:
+    data = json.loads(raw)
+    s = _build(api.ResourceThresholdStrategy,
+               {k: v for k, v in data.items() if k != "nodeStrategies"},
+               KEY_RESOURCE_THRESHOLD, errs)
+    _overrides(data, KEY_RESOURCE_THRESHOLD, errs)
+    for name, v in (("cpuSuppressThresholdPercent",
+                     s.cpu_suppress_threshold_percent),
+                    ("memoryEvictThresholdPercent",
+                     s.memory_evict_threshold_percent),
+                    ("cpuEvictBEUsageThresholdPercent",
+                     s.cpu_evict_be_usage_threshold_percent)):
+        if not 0 <= v <= 100:
+            errs.append(f"{KEY_RESOURCE_THRESHOLD}: {name} out of [0,100]")
+    if s.cpu_suppress_policy not in ("cpuset", "cfsQuota"):
+        errs.append(f"{KEY_RESOURCE_THRESHOLD}: unknown cpuSuppressPolicy "
+                    f"{s.cpu_suppress_policy!r}")
+    lo = s.cpu_evict_satisfaction_lower_percent
+    hi = s.cpu_evict_satisfaction_upper_percent
+    if lo and not 0 < lo <= hi <= 100:
+        errs.append(f"{KEY_RESOURCE_THRESHOLD}: satisfaction percents must "
+                    f"satisfy 0 < lower <= upper <= 100")
+    if s.memory_evict_lower_percent and \
+            s.memory_evict_lower_percent >= s.memory_evict_threshold_percent:
+        errs.append(f"{KEY_RESOURCE_THRESHOLD}: memoryEvictLowerPercent must "
+                    f"be below memoryEvictThresholdPercent")
+
+
+def _check_cpu_burst(raw: str, errs: List[str]) -> None:
+    data = json.loads(raw)
+    s = _build(api.CPUBurstStrategy,
+               {k: v for k, v in data.items() if k != "nodeStrategies"},
+               KEY_CPU_BURST, errs)
+    _overrides(data, KEY_CPU_BURST, errs)
+    if s.policy not in ("none", "cpuBurstOnly", "cfsQuotaBurstOnly", "auto"):
+        errs.append(f"{KEY_CPU_BURST}: unknown policy {s.policy!r}")
+    if not 0 < s.cpu_burst_percent <= 10000:
+        errs.append(f"{KEY_CPU_BURST}: cpuBurstPercent out of (0,10000]")
+    if s.cfs_quota_burst_percent < 100:
+        errs.append(f"{KEY_CPU_BURST}: cfsQuotaBurstPercent must be >= 100")
+    if not 0 < s.share_pool_threshold_percent <= 100:
+        errs.append(f"{KEY_CPU_BURST}: sharePoolThresholdPercent out of "
+                    f"(0,100]")
+
+
+def _check_resource_qos(raw: str, errs: List[str]) -> None:
+    data = json.loads(raw)
+    _overrides(data, KEY_RESOURCE_QOS, errs)
+    for tier, knobs in data.items():
+        if tier == "nodeStrategies":
+            continue
+        if tier.upper() not in _QOS_TIERS:
+            errs.append(f"{KEY_RESOURCE_QOS}: unknown QoS tier {tier!r}")
+            continue
+        if not isinstance(knobs, dict):
+            errs.append(f"{KEY_RESOURCE_QOS}.{tier}: must be an object")
+            continue
+        for knob, value in knobs.items():
+            bounds = _QOS_KNOBS.get(knob)
+            if bounds is None:
+                errs.append(f"{KEY_RESOURCE_QOS}.{tier}: unknown knob "
+                            f"{knob!r}")
+                continue
+            lo, hi = bounds
+            try:
+                v = float(value)
+            except (TypeError, ValueError):
+                errs.append(f"{KEY_RESOURCE_QOS}.{tier}.{knob}: non-numeric")
+                continue
+            if not lo <= v <= hi:
+                errs.append(f"{KEY_RESOURCE_QOS}.{tier}.{knob}: {v} out of "
+                            f"[{lo},{hi}]")
+
+
+def _check_system(raw: str, errs: List[str]) -> None:
+    data = json.loads(raw)
+    s = _build(api.SystemStrategy,
+               {k: v for k, v in data.items() if k != "nodeStrategies"},
+               KEY_SYSTEM, errs)
+    _overrides(data, KEY_SYSTEM, errs)
+    if s.min_free_kbytes_factor < 0:
+        errs.append(f"{KEY_SYSTEM}: minFreeKbytesFactor must be >= 0")
+    if not 10 <= s.watermark_scale_factor <= 1000:
+        errs.append(f"{KEY_SYSTEM}: watermarkScaleFactor out of [10,1000] "
+                    f"(kernel bounds)")
+
+
+_CHECKERS: Dict[str, Callable[[str, List[str]], None]] = {
+    KEY_COLOCATION: _check_colocation,
+    KEY_RESOURCE_THRESHOLD: _check_threshold,
+    KEY_CPU_BURST: _check_cpu_burst,
+    KEY_RESOURCE_QOS: _check_resource_qos,
+    KEY_SYSTEM: _check_system,
+}
+
+
+def validate_slo_configmap(data: Dict[str, str]
+                           ) -> Tuple[bool, List[str]]:
+    """Validate the whole slo-controller-config ConfigMap (the cm
+    validating handler). Unknown keys are rejected so typos can't
+    silently disable a strategy family."""
+    errs: List[str] = []
+    for key, raw in data.items():
+        checker = _CHECKERS.get(key)
+        if checker is None:
+            errs.append(f"unknown config key {key!r} (known: "
+                        f"{', '.join(KNOWN_KEYS)})")
+            continue
+        try:
+            checker(raw, errs)
+        except (ValueError, TypeError) as e:
+            errs.append(f"{key}: unparseable: {e}")
+    return not errs, errs

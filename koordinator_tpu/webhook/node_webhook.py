@@ -1,0 +1,136 @@
+"""Node mutating/validating webhooks.
+
+Capability parity with `pkg/webhook/node/` — the mutating handler's
+NodeResourceAmplificationPlugin (plugins/resourceamplification/
+resource_amplification.go:60-165) and the validating handler's ratio
+checks. Amplification lets the scheduler overcommit a node by a
+per-resource ratio: the webhook snapshots the kubelet's raw allocatable
+into an annotation and publishes `raw * ratio` as the visible
+allocatable; clearing the ratio annotation restores raw accounting.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, List, Optional, Tuple
+
+from koordinator_tpu.api import types as api
+from koordinator_tpu.api.extension import (
+    ANNOTATION_NODE_AMPLIFICATION_RATIOS,
+    ANNOTATION_NODE_RAW_ALLOCATABLE,
+    ResourceKind,
+)
+
+# only these dimensions amplify (supportedResources in the reference:
+# cpu + memory; extended/batch resources are derived, never amplified)
+SUPPORTED = (ResourceKind.CPU, ResourceKind.MEMORY)
+
+
+class AdmissionError(ValueError):
+    """Raised to REJECT the admission request (the reference's non-nil
+    Admit/Validate error -> admission.Errored response)."""
+
+
+def _parse_ratios(annotations: Dict[str, str]) -> Dict[ResourceKind, float]:
+    raw = annotations.get(ANNOTATION_NODE_AMPLIFICATION_RATIOS, "")
+    if not raw:
+        return {}
+    try:
+        data = json.loads(raw)
+        return {ResourceKind[str(name).upper()]: float(ratio)
+                for name, ratio in data.items()}
+    except (ValueError, KeyError, AttributeError, TypeError) as e:
+        raise AdmissionError(
+            f"bad {ANNOTATION_NODE_AMPLIFICATION_RATIOS} annotation: "
+            f"{e}") from None
+
+
+def _parse_raw_allocatable(annotations: Dict[str, str]
+                           ) -> Dict[ResourceKind, float]:
+    raw = annotations.get(ANNOTATION_NODE_RAW_ALLOCATABLE, "")
+    if not raw:
+        return {}
+    try:
+        return {ResourceKind[str(k).upper()]: float(v)
+                for k, v in json.loads(raw).items()}
+    except (ValueError, KeyError, AttributeError, TypeError) as e:
+        raise AdmissionError(
+            f"bad {ANNOTATION_NODE_RAW_ALLOCATABLE} annotation: {e}") \
+            from None
+
+
+def _store_raw_allocatable(node: api.Node,
+                           values: Dict[ResourceKind, float]) -> None:
+    node.meta.annotations[ANNOTATION_NODE_RAW_ALLOCATABLE] = json.dumps(
+        {k.name.lower(): v for k, v in values.items()})
+
+
+class NodeMutator:
+    """Admit for CREATE/UPDATE (resource_amplification.go handleCreate/
+    handleUpdate): no ratio annotation -> restore raw allocatable and
+    drop the stash; else stash raw (first time, or when the kubelet
+    changed a supported dimension vs old_node) and publish amplified
+    values for every ratio > 1. Raises AdmissionError (= reject) on a
+    malformed annotation — mutating runs BEFORE validating, so parse
+    failures cannot rely on validate_node to shield them."""
+
+    def admit(self, node: api.Node,
+              old_node: Optional[api.Node] = None) -> bool:
+        anns = node.meta.annotations
+        if not anns.get(ANNOTATION_NODE_AMPLIFICATION_RATIOS):
+            # feature turned off: un-amplify back to the stashed raw
+            # values, then drop the stash (the docstring's "clearing the
+            # ratio annotation restores raw accounting")
+            stashed = _parse_raw_allocatable(anns)
+            for kind, value in stashed.items():
+                node.allocatable[kind] = value
+            return anns.pop(ANNOTATION_NODE_RAW_ALLOCATABLE, None) is not None
+        if not node.allocatable:
+            return False
+        ratios = _parse_ratios(anns)
+        raw = _parse_raw_allocatable(anns)
+        changed = False
+        if not raw or self._kubelet_changed(node, old_node):
+            raw = {k: node.allocatable[k] for k in SUPPORTED
+                   if k in node.allocatable}
+            if raw:
+                _store_raw_allocatable(node, raw)
+                changed = True  # the stash itself is part of the patch
+        for kind in SUPPORTED:
+            ratio = ratios.get(kind, 0.0)
+            if ratio <= 1.0 or kind not in raw:
+                continue  # missing dims stay raw (":146-157")
+            node.allocatable[kind] = raw[kind] * ratio
+            changed = True
+        return changed
+
+    @staticmethod
+    def _kubelet_changed(node: api.Node,
+                         old_node: Optional[api.Node]) -> bool:
+        # only the kubelet rewrites native allocatable; a change vs the
+        # old object means the stash is stale (":104-112")
+        if old_node is None:
+            return False
+        return any(node.allocatable.get(k) != old_node.allocatable.get(k)
+                   for k in SUPPORTED)
+
+
+def validate_node(node: api.Node,
+                  old_node: Optional[api.Node] = None
+                  ) -> Tuple[bool, List[str]]:
+    """Validating handler: the amplification/raw annotations must parse
+    and every ratio must be >= 1 (node/validating + plugin Validate)."""
+    errs: List[str] = []
+    try:
+        ratios = _parse_ratios(node.meta.annotations)
+        for kind, ratio in ratios.items():
+            if ratio < 1.0:
+                errs.append(f"amplification ratio for {kind.name.lower()} "
+                            f"is {ratio}, must be >= 1")
+    except AdmissionError as e:
+        errs.append(str(e))
+    try:
+        _parse_raw_allocatable(node.meta.annotations)
+    except AdmissionError as e:
+        errs.append(str(e))
+    return not errs, errs

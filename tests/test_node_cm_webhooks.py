@@ -1,0 +1,278 @@
+"""Node webhooks, ConfigMap validation, and the scheduler error-handler
+chain (reference: pkg/webhook/node, pkg/webhook/cm/plugins/sloconfig,
+frameworkext/errorhandler_dispatcher.go + eventhandlers/
+reservation_handler.go)."""
+
+import json
+
+import numpy as np
+import pytest
+
+from koordinator_tpu.api import types as api
+from koordinator_tpu.api.extension import (
+    ANNOTATION_NODE_AMPLIFICATION_RATIOS,
+    ANNOTATION_NODE_RAW_ALLOCATABLE,
+    ResourceKind as RK,
+)
+from koordinator_tpu.scheduler.errorhandler import (
+    ErrorHandlerDispatcher,
+    QueuedPodInfo,
+    SchedulingError,
+    dispatch_batch_errors,
+    make_reservation_error_filter,
+    reserve_pod_for,
+    set_reservation_scheduled,
+    set_reservation_unschedulable,
+)
+from koordinator_tpu.webhook import (
+    NodeMutator,
+    validate_node,
+    validate_slo_configmap,
+)
+
+
+# --- node mutating (resource amplification) ---------------------------------
+
+def mk_node(cpu=32000.0, mem=65536.0, anns=None):
+    return api.Node(meta=api.ObjectMeta(name="n1", annotations=anns or {}),
+                    allocatable={RK.CPU: cpu, RK.MEMORY: mem})
+
+
+def test_amplification_stashes_raw_and_scales():
+    node = mk_node(anns={
+        ANNOTATION_NODE_AMPLIFICATION_RATIOS: '{"cpu": 2.0}'})
+    assert NodeMutator().admit(node)
+    assert node.allocatable[RK.CPU] == 64000.0
+    assert node.allocatable[RK.MEMORY] == 65536.0  # no ratio -> untouched
+    raw = json.loads(node.meta.annotations[ANNOTATION_NODE_RAW_ALLOCATABLE])
+    assert raw["cpu"] == 32000.0
+
+
+def test_amplification_is_idempotent_via_raw_stash():
+    node = mk_node(anns={
+        ANNOTATION_NODE_AMPLIFICATION_RATIOS: '{"cpu": 2.0}'})
+    m = NodeMutator()
+    m.admit(node)
+    # a second admission (e.g. status update) must NOT compound 2x again
+    old = api.Node(meta=api.ObjectMeta(name="n1"),
+                   allocatable=dict(node.allocatable))
+    m.admit(node, old_node=old)
+    assert node.allocatable[RK.CPU] == 64000.0
+
+
+def test_amplification_restashes_on_kubelet_change():
+    node = mk_node(anns={
+        ANNOTATION_NODE_AMPLIFICATION_RATIOS: '{"cpu": 2.0}'})
+    m = NodeMutator()
+    m.admit(node)
+    # kubelet re-reports allocatable (reserved resources changed)
+    old = api.Node(meta=api.ObjectMeta(name="n1"),
+                   allocatable=dict(node.allocatable))
+    node.allocatable[RK.CPU] = 16000.0
+    m.admit(node, old_node=old)
+    assert node.allocatable[RK.CPU] == 32000.0  # 16000 * 2 from NEW raw
+
+
+def test_clearing_ratio_restores_raw_and_drops_stash():
+    node = mk_node(anns={
+        ANNOTATION_NODE_AMPLIFICATION_RATIOS: '{"cpu": 2.0}'})
+    m = NodeMutator()
+    m.admit(node)
+    assert node.allocatable[RK.CPU] == 64000.0
+    del node.meta.annotations[ANNOTATION_NODE_AMPLIFICATION_RATIOS]
+    m.admit(node)
+    assert ANNOTATION_NODE_RAW_ALLOCATABLE not in node.meta.annotations
+    # un-amplified: the scheduler stops seeing 2x capacity
+    assert node.allocatable[RK.CPU] == 32000.0
+
+
+def test_malformed_annotation_rejects_not_crashes():
+    from koordinator_tpu.webhook.node_webhook import AdmissionError
+    m = NodeMutator()
+    for bad in ('not json', '{"bogus": 2.0}', '{"cpu": "abc"}'):
+        node = mk_node(anns={ANNOTATION_NODE_AMPLIFICATION_RATIOS: bad})
+        with pytest.raises(AdmissionError):
+            m.admit(node)
+
+
+def test_ratio_exactly_one_still_reports_stash_write():
+    node = mk_node(anns={
+        ANNOTATION_NODE_AMPLIFICATION_RATIOS: '{"cpu": 1.0}'})
+    # the stash annotation IS part of the patch even though no value scales
+    assert NodeMutator().admit(node) is True
+    assert ANNOTATION_NODE_RAW_ALLOCATABLE in node.meta.annotations
+
+
+def test_validate_node_rejects_bad_ratios():
+    ok, errs = validate_node(mk_node(anns={
+        ANNOTATION_NODE_AMPLIFICATION_RATIOS: '{"cpu": 0.5}'}))
+    assert not ok and "must be >= 1" in errs[0]
+    ok, _ = validate_node(mk_node(anns={
+        ANNOTATION_NODE_AMPLIFICATION_RATIOS: 'not json'}))
+    assert not ok
+    ok, _ = validate_node(mk_node(anns={
+        ANNOTATION_NODE_AMPLIFICATION_RATIOS: '{"cpu": 1.5}'}))
+    assert ok
+
+
+# --- ConfigMap validation ----------------------------------------------------
+
+def test_valid_configmap_passes():
+    ok, errs = validate_slo_configmap({
+        "colocation-config": json.dumps({
+            "enable": True, "cpuReclaimThresholdPercent": 65,
+            "nodeConfigs": [{"nodeSelector": {"pool": "batch"},
+                             "cpuReclaimThresholdPercent": 70}]}),
+        "resource-threshold-config": json.dumps({
+            "enable": True, "cpuSuppressThresholdPercent": 65,
+            "cpuEvictBEUsageThresholdPercent": 80}),
+        "cpu-burst-config": json.dumps({
+            "policy": "auto", "cpuBurstPercent": 1000}),
+        "resource-qos-config": json.dumps({
+            "LS": {"groupIdentity": 2}, "BE": {"groupIdentity": -1}}),
+        "system-config": json.dumps({"watermarkScaleFactor": 150}),
+    })
+    assert ok, errs
+
+
+def test_configmap_rejects_out_of_range_and_unknown():
+    ok, errs = validate_slo_configmap({
+        "colocation-config": json.dumps({
+            "cpuReclaimThresholdPercent": 150}),
+    })
+    assert not ok and any("out of [0,100]" in e for e in errs)
+
+    ok, errs = validate_slo_configmap({"no-such-config": "{}"})
+    assert not ok and "unknown config key" in errs[0]
+
+    ok, errs = validate_slo_configmap({
+        "cpu-burst-config": json.dumps({"policy": "warp-speed"})})
+    assert not ok and any("unknown policy" in e for e in errs)
+
+    ok, errs = validate_slo_configmap({
+        "resource-qos-config": json.dumps({"LS": {"groupIdentity": 7}})})
+    assert not ok and any("out of [-1,2]" in e for e in errs)
+
+    ok, errs = validate_slo_configmap({
+        "colocation-config": "{{{not json"})
+    assert not ok and any("unparseable" in e for e in errs)
+
+
+def test_configmap_rejects_empty_override_selector():
+    ok, errs = validate_slo_configmap({
+        "resource-threshold-config": json.dumps({
+            "nodeStrategies": [{"cpuSuppressThresholdPercent": 50}]})})
+    assert not ok and any("empty node selector" in e for e in errs)
+
+
+# --- error-handler chain -----------------------------------------------------
+
+def test_dispatcher_pre_claims_default_post_order():
+    calls = []
+    d = ErrorHandlerDispatcher(
+        default_handler=lambda p, e: calls.append("default"))
+    d.register(pre=lambda p, e: (calls.append("pre1"), False)[1])
+    d.register(pre=lambda p, e: (calls.append("pre2-claim"), True)[1])
+    d.register(post=lambda p, e: (calls.append("post"), True)[1])
+    d.error(QueuedPodInfo(pod=api.Pod()), SchedulingError("x"))
+    # pre2 claimed -> default skipped; post still runs (defer semantics)
+    assert calls == ["pre1", "pre2-claim", "post"]
+
+    calls.clear()
+    d2 = ErrorHandlerDispatcher(
+        default_handler=lambda p, e: calls.append("default"))
+    d2.register(pre=lambda p, e: False)
+    d2.register(post=lambda p, e: (calls.append("post"), True)[1])
+    d2.error(QueuedPodInfo(pod=api.Pod()), SchedulingError("x"))
+    assert calls == ["default", "post"]
+
+
+def test_reservation_filter_writes_unschedulable_and_requeues():
+    r = api.Reservation(meta=api.ObjectMeta(name="rsv-a", uid="u1"),
+                        requests={RK.CPU: 4000.0})
+    requeued = []
+    filt = make_reservation_error_filter(
+        get_reservation={"rsv-a": r}.get, requeue=requeued.append,
+        clock=lambda: 100.0)
+    d = ErrorHandlerDispatcher(default_handler=lambda p, e: pytest.fail(
+        "default must not run for a claimed reserve pod"))
+    d.register(pre=filt)
+
+    pod = reserve_pod_for(r)
+    d.error(QueuedPodInfo(pod=pod), SchedulingError("no fit"))
+    assert requeued == [r]
+    cond = r.conditions[0]
+    assert (cond.type, cond.status, cond.reason) == \
+        ("Scheduled", "False", api.REASON_RESERVATION_UNSCHEDULABLE)
+    assert "no fit" in cond.message and cond.last_probe_time == 100.0
+
+    # second failure refreshes probe time, no duplicate condition
+    filt2 = make_reservation_error_filter(
+        get_reservation={"rsv-a": r}.get, clock=lambda: 200.0)
+    filt2(QueuedPodInfo(pod=pod), SchedulingError("still no fit"))
+    assert len(r.conditions) == 1
+    assert r.conditions[0].last_probe_time == 200.0
+    assert r.conditions[0].last_transition_time == 100.0
+
+
+def test_reservation_filter_aborts_when_already_bound():
+    r = api.Reservation(meta=api.ObjectMeta(name="rsv-a"), node_name="n3")
+    requeued = []
+    filt = make_reservation_error_filter(
+        get_reservation={"rsv-a": r}.get, requeue=requeued.append)
+    claimed = filt(QueuedPodInfo(pod=reserve_pod_for(r)),
+                   SchedulingError("stale"))
+    assert claimed and not requeued and not r.conditions
+
+
+def test_reservation_scheduled_transitions_condition():
+    r = api.Reservation(meta=api.ObjectMeta(name="rsv-a"))
+    set_reservation_unschedulable(r, "no fit", now=1.0)
+    set_reservation_scheduled(r, "n2", now=2.0)
+    cond = r.conditions[0]
+    assert cond.status == "True" and cond.last_transition_time == 2.0
+    assert r.node_name == "n2"
+    # repeated success bumps probe only
+    set_reservation_scheduled(r, "n2", now=3.0)
+    assert cond.last_transition_time == 2.0 and cond.last_probe_time == 3.0
+
+
+def test_dispatch_batch_errors_only_unplaced_valid_rows():
+    pods = [api.Pod(meta=api.ObjectMeta(name=f"p{i}")) for i in range(3)]
+    seen = []
+    d = ErrorHandlerDispatcher(
+        default_handler=lambda pi, e: seen.append(pi.pod.meta.name))
+    assignment = np.array([2, -1, -1, -1])   # row 3 is padding
+    valid = np.array([True, True, False, True])
+    n = dispatch_batch_errors(d, assignment, valid, pods)
+    assert n == 1 and seen == ["p1"]
+
+
+def test_service_schedule_feeds_error_chain():
+    """End to end: an unplaceable pod in a real batch reaches a registered
+    error filter through SchedulerService.schedule(typed_pods=...)."""
+    from koordinator_tpu.scheduler.frameworkext import SchedulerService
+    from koordinator_tpu.snapshot import SnapshotBuilder
+
+    b = SnapshotBuilder(max_nodes=2)
+    node = api.Node(meta=api.ObjectMeta(name="n0"),
+                    allocatable={RK.CPU: 1000.0, RK.MEMORY: 1024.0})
+    b.add_node(node)
+    b.set_node_metric(api.NodeMetric(node_name="n0", update_time=1e9,
+                                     node_usage={RK.CPU: 0.0,
+                                                 RK.MEMORY: 0.0}))
+    snap, ctx = b.build(now=1e9)
+    svc = SchedulerService()
+    svc.publish(snap)
+    failed = []
+    svc.error_dispatcher.register(
+        pre=lambda pi, e: (failed.append(pi.pod.meta.name), True)[1])
+    ok_pod = api.Pod(meta=api.ObjectMeta(name="fits"),
+                     requests={RK.CPU: 100.0, RK.MEMORY: 64.0})
+    huge = api.Pod(meta=api.ObjectMeta(name="huge"),
+                   requests={RK.CPU: 10_000_000.0, RK.MEMORY: 1024.0})
+    res = svc.schedule(b.build_pod_batch([ok_pod, huge], ctx),
+                       typed_pods=[ok_pod, huge])
+    a = np.asarray(res.assignment)
+    assert a[0] >= 0 and a[1] < 0
+    assert failed == ["huge"]
