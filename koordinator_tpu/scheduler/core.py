@@ -85,6 +85,12 @@ class ScheduleResult:
     res_slot: jnp.ndarray        # i32[P] reservation slot consumed, -1 —
                                  # feeds the reservation-allocated
                                  # annotation at bind and the forget path
+    gang_failed: jnp.ndarray     # bool[G] strict gangs PROVEN below quorum
+                                 # this batch (no members outstanding) —
+                                 # members assumed in EARLIER batches still
+                                 # hold capacity; the host reclaims them
+                                 # through the forget/un-assume path without
+                                 # waiting for the Permit timeout
     snapshot: ClusterSnapshot    # post-commit snapshot (requested/used updated)
 
 
@@ -603,10 +609,28 @@ def schedule_batch(snap: ClusterSnapshot, pods: PodBatch,
         jax.lax.scan(round_body, init, None, length=num_rounds)
 
     # --- gang all-or-nothing rollback (Permit barrier, core.go:311-341) ---
+    # A strict gang below quorum rolls back ONLY when no members remain
+    # outstanding (still to be attempted in a later chunk of the scan or a
+    # retry pass). With members outstanding, the placed ones stay ASSUMED —
+    # the Permit wait of the reference: pods sit at the barrier until the
+    # gang completes. Without this, a gang spanning bench CHUNK boundaries
+    # could never form: each chunk would see a partial count and revoke
+    # its own members. Reclaim of a waiting gang that never completes is
+    # two-tier, as in the reference: `gang_failed` in the result flags
+    # gangs PROVEN short this batch so the host can forget/un-assume their
+    # earlier members immediately, and gangs whose failed members simply
+    # never reappear (provable by no one device-side) fall to the Permit
+    # timeout — GangDirectory.expire_waits + the store's forget path.
+    gid = jnp.maximum(pods.gang_id, 0)
+    attempted = jnp.zeros((n_gangs,), jnp.int32).at[
+        jnp.where(pods.valid & (pods.gang_id >= 0), gid, n_gangs)].add(
+        1, mode="drop")
+    outstanding = jnp.maximum(
+        gangs0.member_count - gangs0.assumed - attempted, 0)
     gang_total = gangs0.assumed + gang_placed
     gang_fail = (gangs0.valid & gangs0.strict
-                 & (gang_total < gangs0.min_member))
-    gid = jnp.maximum(pods.gang_id, 0)
+                 & (gang_total < gangs0.min_member)
+                 & (outstanding == 0))
     revoke = (placed >= 0) & (pods.gang_id >= 0) & gang_fail[gid]
     placed = jnp.where(revoke, -1, placed)
 
@@ -701,4 +725,5 @@ def schedule_batch(snap: ClusterSnapshot, pods: PodBatch,
                           numa_take=out_take * ok[:, None, None],
                           gpu_take=gpu_take,
                           aux_inst=aux_inst, res_slot=res_slot,
+                          gang_failed=gang_fail,
                           snapshot=new_snap)
