@@ -51,12 +51,9 @@ def main():
     cfg = LoadAwareConfig.make()
     n_chunks = NUM_PODS // CHUNK
 
-    # the queue as [C, CHUNK, ...] per-pod columns (scan operand) — a
-    # zero-copy reshape of the contiguous batch
-    stacked = {
-        f: getattr(pods, f).reshape(n_chunks, CHUNK,
-                                    *getattr(pods, f).shape[1:])
-        for f in synthetic.PER_POD_FIELDS}
+    # the queue as [C, CHUNK, ...] per-pod columns (scan operand)
+    del n_chunks
+    stacked = synthetic.stack_pod_chunks(pods, CHUNK)
 
     devices = jax.devices()
     if len(devices) > 1:

@@ -1,0 +1,199 @@
+"""BASELINE measurement configs 1-5 (BASELINE.md "Measurement configs").
+
+The north-star bench (bench.py) measures config 0 (100k x 10k, LoadAware+
+quota). This file measures the remaining named configs so every path has
+a recorded scale number:
+  1. spark colocation: 32 BE pods x 10 nodes, LoadAware score only
+  2. 10k pods x 1k nodes, LoadAware + NodeNUMAResource (enable_numa)
+  3. coscheduling: 1k strict gangs (8 pods each) x 5k nodes
+  4. ElasticQuota fair-share: 500-quota tree, 50k pending pods
+  5. descheduler LowNodeLoad: 10k-node eviction/migration plan
+
+Prints ONE JSON line PER CONFIG:
+  {"metric": "...", "value": <seconds>, "unit": "s", ...}
+The reference publishes no numbers for these paths (BASELINE.md), so
+there is no vs_baseline; the lines exist to make regressions visible
+round over round.
+"""
+
+import dataclasses
+import functools
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _emit(name, elapsed, **extra):
+    out = {"metric": name, "value": round(elapsed, 4), "unit": "s"}
+    out.update(extra)
+    print(json.dumps(out))
+
+
+def _run_scheduler_config(name, snap, pods, cfg, chunk, **kw):
+    """Warm + time a chunked schedule over the batch (the bench sweep
+    shape: lax.scan over [C, CHUNK, ...] pod columns, one readback)."""
+    from koordinator_tpu.scheduler import core
+    from koordinator_tpu.utils import synthetic
+
+    num_pods = pods.valid.shape[0]
+    stacked = synthetic.stack_pod_chunks(pods, chunk)
+    step = functools.partial(core.schedule_batch, num_rounds=2, k_choices=8,
+                             score_dims=(0, 1), approx_topk=True,
+                             tie_break=True, quota_depth=2,
+                             fit_dims=(0, 1, 2, 3), **kw)
+
+    @jax.jit
+    def sweep(snap, stacked, pods_dev, cfg):
+        def body(s, cols):
+            res = step(s, pods_dev.replace(**cols), cfg)
+            return res.snapshot, res.assignment
+        s, assign = jax.lax.scan(body, snap, stacked)
+        return s, assign.reshape(-1)
+
+    snap_dev = jax.device_put(snap)
+    stacked = jax.device_put(stacked)
+    pods_dev = jax.device_put(pods)
+    cfg = jax.device_put(cfg)
+    _, a = sweep(snap_dev, stacked, pods_dev, cfg)   # warm/compile
+    np.asarray(a)
+    t0 = time.perf_counter()
+    _, a = sweep(snap_dev, stacked, pods_dev, cfg)
+    a = np.asarray(a)
+    elapsed = time.perf_counter() - t0
+    _emit(name, elapsed, pods=num_pods, placed=int((a >= 0).sum()),
+          pods_per_sec=round(num_pods / elapsed))
+    return a
+
+
+def config_1_spark():
+    """32 BE pods x 10 nodes, LoadAware score only (examples/spark-jobs)."""
+    from koordinator_tpu.scheduler.plugins.loadaware import LoadAwareConfig
+    from koordinator_tpu.utils import synthetic
+
+    snap = synthetic.synthetic_cluster(10, num_quotas=2, seed=0)
+    pods = synthetic.synthetic_pods(32, seed=1, prod_frac=0.0, num_quotas=2)
+    _run_scheduler_config("baseline_cfg1_spark_32x10", snap, pods,
+                          LoadAwareConfig.make(), chunk=32,
+                          enable_numa=False)
+
+
+def config_2_numa():
+    """10k pods x 1k nodes with NodeNUMAResource engaged: nodes carry two
+    populated NUMA zones; prod pods are single-NUMA bound (the resource-
+    spec annotation + LSR QoS path)."""
+    from koordinator_tpu.scheduler.plugins.loadaware import LoadAwareConfig
+    from koordinator_tpu.utils import synthetic
+
+    snap = synthetic.synthetic_cluster(1000, num_quotas=32, seed=0)
+    nodes = snap.nodes
+    alloc = np.asarray(nodes.allocatable)
+    n = alloc.shape[0]
+    numa_cap = np.zeros((n, 4, 2), np.float32)
+    numa_cap[:, 0, 0] = alloc[:, 0] / 2
+    numa_cap[:, 1, 0] = alloc[:, 0] / 2
+    numa_cap[:, 0, 1] = alloc[:, 1] / 2
+    numa_cap[:, 1, 1] = alloc[:, 1] / 2
+    numa_valid = np.zeros((n, 4), bool)
+    numa_valid[:, :2] = True
+    snap = snap.replace(nodes=nodes.replace(
+        numa_cap=jnp.asarray(numa_cap), numa_free=jnp.asarray(numa_cap),
+        numa_valid=jnp.asarray(numa_valid)))
+
+    pods = synthetic.synthetic_pods(10_000, seed=1, prod_frac=0.6,
+                                    num_quotas=32)
+    # prod pods are the CPU-bound tier (requests in native cpu/mem dims)
+    numa_single = np.asarray(pods.priority_class) == 4
+    pods = pods.replace(numa_single=jnp.asarray(numa_single))
+    _run_scheduler_config("baseline_cfg2_numa_10kx1k", snap, pods,
+                          LoadAwareConfig.make(), chunk=2000,
+                          enable_numa=True)
+
+
+def config_3_gangs():
+    """1k strict gangs x 8 members against 5k nodes, all-or-nothing."""
+    from koordinator_tpu.scheduler.plugins.loadaware import LoadAwareConfig
+    from koordinator_tpu.utils import synthetic
+
+    snap = synthetic.synthetic_cluster(5000, num_quotas=32, seed=0,
+                                       num_gangs=1000, max_gangs=1024,
+                                       gang_min_member=8)
+    pods = synthetic.synthetic_pods(8000, seed=1, num_quotas=32,
+                                    num_gangs=1000, gang_min_member=8)
+    a = _run_scheduler_config("baseline_cfg3_gangs_1kx8_5k", snap, pods,
+                              LoadAwareConfig.make(), chunk=2000,
+                              enable_numa=False)
+    del a
+
+
+def config_4_quota():
+    """500-quota hierarchical tree, 50k pending pods."""
+    from koordinator_tpu.scheduler.plugins.loadaware import LoadAwareConfig
+    from koordinator_tpu.utils import synthetic
+
+    snap = synthetic.synthetic_cluster(5000, num_quotas=500, max_quotas=512,
+                                       seed=0)
+    pods = synthetic.synthetic_pods(50_000, seed=1, num_quotas=500)
+    _run_scheduler_config("baseline_cfg4_quota_500x50k", snap, pods,
+                          LoadAwareConfig.make(), chunk=2500,
+                          enable_numa=False)
+
+
+def config_5_descheduler():
+    """LowNodeLoad rebalance plan over 10k nodes: classify + plan the
+    eviction set (host/numpy path — the plan is control-plane work)."""
+    from koordinator_tpu.api import types as api
+    from koordinator_tpu.api.extension import ResourceKind as RK
+    from koordinator_tpu.descheduler import (
+        LowNodeLoad,
+        LowNodeLoadArgs,
+        RecordingEvictor,
+    )
+
+    rng = np.random.default_rng(3)
+    now = 1e9
+    n = 10_000
+    nodes, metrics, pods_by_node = [], {}, {}
+    usage_frac = rng.uniform(0.1, 0.95, size=n)
+    for i in range(n):
+        name = f"n{i}"
+        nodes.append(api.Node(meta=api.ObjectMeta(name=name),
+                              allocatable={RK.CPU: 64000.0,
+                                           RK.MEMORY: 262144.0}))
+        metrics[name] = api.NodeMetric(
+            node_name=name, update_time=now,
+            node_usage={RK.CPU: 64000.0 * usage_frac[i],
+                        RK.MEMORY: 262144.0 * usage_frac[i]})
+        if usage_frac[i] > 0.7:  # candidates carry evictable pods
+            pods_by_node[name] = [
+                api.Pod(meta=api.ObjectMeta(name=f"{name}-p{j}",
+                                            uid=f"{name}-p{j}"),
+                        priority=5500, qos_label="BE",
+                        requests={RK.CPU: 4000.0, RK.MEMORY: 8192.0})
+                for j in range(4)]
+
+    evictor = RecordingEvictor()
+    args = LowNodeLoadArgs(consecutive_abnormalities=1)
+    plugin = LowNodeLoad(args, evictor)
+    plugin.balance_once(nodes, metrics, pods_by_node, now)  # warm gates
+    evictor.limiter.reset()
+    evictor.evictions.clear()  # the warm run's plan must not double-count
+    t0 = time.perf_counter()
+    plugin.balance_once(nodes, metrics, pods_by_node, now)
+    elapsed = time.perf_counter() - t0
+    _emit("baseline_cfg5_descheduler_10k", elapsed, nodes=n,
+          evictions_planned=len(evictor.evictions))
+
+
+def main():
+    config_1_spark()
+    config_2_numa()
+    config_3_gangs()
+    config_4_quota()
+    config_5_descheduler()
+
+
+if __name__ == "__main__":
+    main()

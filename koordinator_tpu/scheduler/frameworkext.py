@@ -246,6 +246,14 @@ class SchedulerService:
             ErrorHandlerDispatcher,
         )
         self.error_dispatcher = ErrorHandlerDispatcher()
+        # called with (failed_gang_indices, result) when a batch PROVES
+        # strict gangs short of quorum; the gang controller un-assumes
+        # their held members through store.forget with the batches it
+        # retained (the immediate tier of the Permit rollback — the
+        # wait-expiry timeout stays the backstop for gangs whose members
+        # simply never reappear)
+        self.on_gang_failed: Optional[Callable] = None
+        self.last_gang_failed: Optional[np.ndarray] = None
         self.registry.register("scheduler", self.summary)
 
     def publish(self, snapshot: ClusterSnapshot) -> None:
@@ -279,6 +287,10 @@ class SchedulerService:
         self.metrics.pods_scheduled.labels("unschedulable").inc(
             int(((assignment < 0) & valid).sum()))
         self.metrics.snapshot_version.set(float(self.store.version))
+        gang_failed = np.asarray(result.gang_failed)
+        self.last_gang_failed = gang_failed
+        if gang_failed.any() and self.on_gang_failed is not None:
+            self.on_gang_failed(np.where(gang_failed)[0], result)
         if typed_pods is not None:
             from koordinator_tpu.scheduler.errorhandler import (
                 dispatch_batch_errors,

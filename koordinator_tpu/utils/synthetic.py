@@ -267,6 +267,19 @@ def synthetic_pods(num_pods: int, seed: int = 1,
     )
 
 
+def stack_pod_chunks(pods: PodBatch, chunk: int) -> dict:
+    """[P, ...] per-pod columns -> [C, CHUNK, ...] scan operands (the
+    bench sweep shape; zero-copy reshape of the contiguous batch). Shared
+    by bench.py and bench_configs.py so the two harnesses cannot drift."""
+    num = pods.valid.shape[0]
+    if num % chunk:
+        raise ValueError(f"{num} pods not divisible by chunk {chunk}")
+    n_chunks = num // chunk
+    return {f: getattr(pods, f).reshape(n_chunks, chunk,
+                                        *getattr(pods, f).shape[1:])
+            for f in PER_POD_FIELDS}
+
+
 PER_POD_FIELDS = ("requests", "estimated", "qos", "priority_class",
                   "priority", "gang_id", "quota_id", "selector_id",
                   "reservation_owner", "gpu_ratio", "numa_single",

@@ -120,6 +120,48 @@ def test_strict_gang_hold_reclaimed_by_unassume():
     assert np.asarray(after.gangs.assumed)[0] == 0
 
 
+def test_service_gang_failed_hook_reclaims_held_members():
+    """Production loop: the SchedulerService surfaces gang_failed to its
+    hook, and the hook un-assumes the earlier batch's held members
+    through the store — capacity returns without the Permit timeout."""
+    from koordinator_tpu.scheduler.frameworkext import SchedulerService
+
+    b = SnapshotBuilder(max_nodes=2, max_gangs=1)
+    _cluster(b, cpu=4000)
+    b.add_gang(PodGroup(meta=ObjectMeta(name="g"), min_member=6,
+                        total_member=6))
+    snap, ctx = b.build(now=NOW)
+    svc = SchedulerService(num_rounds=4)
+    svc.publish(snap)
+
+    retained = []  # the gang controller retains (batch, result) per gang
+
+    def on_gang_failed(gids, _result):
+        assert list(gids) == [0]
+        import jax.numpy as jnp
+        for batch, res in retained:
+            mask = jnp.asarray(batch.gang_id == 0) & batch.valid & \
+                (res.assignment >= 0)
+            svc.store.update(lambda s: forget_pods(s, batch, res, mask))
+
+    svc.on_gang_failed = on_gang_failed
+
+    chunk1 = b.build_pod_batch(_members(b, "g", 3, cpu=2000.0), ctx)
+    res1 = svc.schedule(chunk1)
+    retained.append((chunk1, res1))
+    assert np.all(np.asarray(res1.assignment) >= 0)
+    assert svc.last_gang_failed is not None and not svc.last_gang_failed[0]
+
+    chunk2 = b.build_pod_batch(_members(b, "g", 3, start=3, cpu=3000.0), ctx)
+    res2 = svc.schedule(chunk2)
+    assert np.all(np.asarray(res2.assignment) == -1)
+    assert svc.last_gang_failed[0]
+    # the hook ran: held capacity flowed back into the live snapshot
+    cur = svc.store.current()
+    assert np.asarray(cur.nodes.requested)[:, 0].sum() == pytest.approx(0.0)
+    assert np.asarray(cur.gangs.assumed)[0] == 0
+
+
 def test_bench_straggler_overflow_warns():
     """>TAIL_PASSES*CHUNK stragglers: the bench must SAY the retry bound
     was exceeded (stderr warning + JSON fields), not silently report the

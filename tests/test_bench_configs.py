@@ -1,0 +1,24 @@
+"""bench_configs smoke: the BASELINE config measurements stay runnable
+(the full sweep runs on real hardware; here the cheap configs prove the
+harness on the CPU test platform)."""
+
+import json
+
+import bench_configs
+
+
+def test_config_1_emits_json(capsys):
+    bench_configs.config_1_spark()
+    line = capsys.readouterr().out.strip().splitlines()[-1]
+    out = json.loads(line)
+    assert out["metric"] == "baseline_cfg1_spark_32x10"
+    assert out["placed"] == 32
+
+
+def test_config_5_descheduler_emits_json(capsys):
+    bench_configs.config_5_descheduler()
+    line = capsys.readouterr().out.strip().splitlines()[-1]
+    out = json.loads(line)
+    assert out["metric"] == "baseline_cfg5_descheduler_10k"
+    assert out["nodes"] == 10_000
+    assert out["evictions_planned"] > 0
