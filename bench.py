@@ -49,10 +49,8 @@ def main():
                          f"BENCH_CHUNK={CHUNK}")
     pods = synthetic.synthetic_pods(NUM_PODS, seed=1, num_quotas=32)
     cfg = LoadAwareConfig.make()
-    n_chunks = NUM_PODS // CHUNK
 
     # the queue as [C, CHUNK, ...] per-pod columns (scan operand)
-    del n_chunks
     stacked = synthetic.stack_pod_chunks(pods, CHUNK)
 
     devices = jax.devices()

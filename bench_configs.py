@@ -122,10 +122,9 @@ def config_3_gangs():
                                        gang_min_member=8)
     pods = synthetic.synthetic_pods(8000, seed=1, num_quotas=32,
                                     num_gangs=1000, gang_min_member=8)
-    a = _run_scheduler_config("baseline_cfg3_gangs_1kx8_5k", snap, pods,
-                              LoadAwareConfig.make(), chunk=2000,
-                              enable_numa=False)
-    del a
+    _run_scheduler_config("baseline_cfg3_gangs_1kx8_5k", snap, pods,
+                          LoadAwareConfig.make(), chunk=2000,
+                          enable_numa=False)
 
 
 def config_4_quota():

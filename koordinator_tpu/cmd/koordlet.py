@@ -24,6 +24,7 @@ def build(argv: Optional[Sequence[str]] = None,
     p.add_argument("--collect-interval-seconds", type=float, default=1.0)
     p.add_argument("--report-interval-seconds", type=float, default=60.0)
     p.add_argument("--checkpoint-path", default="")
+    p.add_argument("--audit-http-port", type=int, default=0)
     args = p.parse_args(argv)
     gate = new_default_gate()
     parse_feature_gates(gate, args.feature_gates)
@@ -33,7 +34,9 @@ def build(argv: Optional[Sequence[str]] = None,
         checkpoint_path=args.checkpoint_path,
         enable_perf_group=gate.enabled("Libpfm4"),
         enable_page_cache=gate.enabled("ColdPageCollector"),
-        enable_core_sched=gate.enabled("CoreSched"))
+        enable_core_sched=gate.enabled("CoreSched"),
+        audit_http_port=(args.audit_http_port
+                         if gate.enabled("AuditEventsHTTPHandler") else -1))
     return Daemon(host or Host(args.host_root), cfg)
 
 

@@ -61,6 +61,9 @@ class DaemonConfig:
     # the kernel supports PR_SCHED_CORE, QoS cookie assignment goes through
     # the native prctl shim instead of the recording fake
     enable_core_sched: bool = False
+    # AuditEventsHTTPHandler gate: >= 0 serves the paginated audit query
+    # endpoint on this port (0 = ephemeral); -1 disabled
+    audit_http_port: int = -1
 
 
 class Daemon:
@@ -94,6 +97,11 @@ class Daemon:
         self.qos: QoSManager = default_qos_manager(
             self.informer, self.metric_cache, self.executor, self.evictor,
             auditor, metrics=self.metrics)
+        self.audit_server = None
+        if cfg.audit_http_port >= 0:
+            from koordinator_tpu.koordlet.audit import AuditQueryServer
+            self.audit_server = AuditQueryServer(auditor,
+                                                 port=cfg.audit_http_port)
         core_sched = None
         if cfg.enable_core_sched:
             from koordinator_tpu import native

@@ -13,7 +13,13 @@ import json
 import os
 import threading
 import time
-from typing import Iterator, List, Optional
+import uuid
+from typing import Dict, Iterator, List, Optional
+
+from koordinator_tpu.utils.httpserver import (
+    BackgroundHTTPServer,
+    QuietJsonHandler,
+)
 
 
 @dataclasses.dataclass
@@ -111,3 +117,110 @@ class Auditor:
 
 
 NULL_AUDITOR = Auditor(log_dir=None, ring_size=1)
+
+
+class _Reader:
+    """One paginated query cursor (auditor.go readerContext): a reverse
+    snapshot of the ring at first request, a position, and a refresh
+    timestamp for TTL expiry."""
+
+    __slots__ = ("token", "events", "pos", "refresh_at")
+
+    def __init__(self, token: str, events: List[Event], now: float):
+        self.token = token
+        self.events = events
+        self.pos = 0
+        self.refresh_at = now
+
+
+class AuditQueryServer:
+    """HTTP query endpoint for audit events (auditor.go:130 HttpHandler,
+    gated by AuditEventsHTTPHandler): GET /events?size=N&pageToken=T
+    returns {"events": [...], "pageToken": T, "eof": bool}. The first
+    request (no token) opens a cursor over a reverse snapshot of the
+    ring; follow-ups page through it. Cursors expire after `reader_ttl`
+    seconds idle and the oldest are dropped past `max_readers`
+    (popExpiredReaderNoLock); an expired/unknown token answers 409, an
+    oversized request 400 — the reference's status choices."""
+
+    def __init__(self, auditor: Auditor, host: str = "127.0.0.1",
+                 port: int = 0, default_limit: int = 256,
+                 max_limit: int = 1024, reader_ttl: float = 120.0,
+                 max_readers: int = 16):
+        self.auditor = auditor
+        self.default_limit = default_limit
+        self.max_limit = max_limit
+        self.reader_ttl = reader_ttl
+        self.max_readers = max_readers
+        self._readers: Dict[str, _Reader] = {}
+        self._lock = threading.Lock()
+        outer = self
+
+        class Handler(QuietJsonHandler):
+            def do_GET(self):
+                from urllib.parse import parse_qs, urlparse
+                u = urlparse(self.path)
+                if u.path not in ("/events", "/apis/v1/audit"):
+                    self.reply_json(404, {"error": "not found"})
+                    return
+                q = parse_qs(u.query)
+                code, payload = outer.handle(
+                    size=q.get("size", [""])[0],
+                    page_token=q.get("pageToken", [""])[0])
+                self.reply_json(code, payload)
+
+        self._server = BackgroundHTTPServer(Handler, host, port)
+        self.port = self._server.port
+
+    # handler body, separately callable for tests / other transports
+    def handle(self, size: str = "", page_token: str = "",
+               now: Optional[float] = None):
+        now = time.time() if now is None else now
+        limit = self.default_limit
+        if size:
+            try:
+                limit = int(size)
+            except ValueError:
+                return 400, {"error": f"bad size {size!r}"}
+            if limit > self.max_limit:
+                return 400, {"error": f"size({limit}) exceeds the limit"
+                             f"({self.max_limit})"}
+            if limit <= 0:
+                # a negative size would slice past the cap; zero would
+                # page forever without reaching eof
+                return 400, {"error": f"size({limit}) must be positive"}
+        with self._lock:
+            self._gc(now)
+            if page_token:
+                reader = self._readers.get(page_token)
+                if reader is None:
+                    return 409, {"error": f"invalid pageToken {page_token}"}
+            else:
+                reader = _Reader(str(uuid.uuid4()),
+                                 self.auditor.query(limit=self.max_limit
+                                                    * 64), now)
+                self._readers[reader.token] = reader
+            reader.refresh_at = now
+            page = reader.events[reader.pos:reader.pos + limit]
+            reader.pos += len(page)
+            eof = reader.pos >= len(reader.events)
+            if eof:
+                self._readers.pop(reader.token, None)
+        return 200, {"events": [dataclasses.asdict(e) for e in page],
+                     "pageToken": reader.token, "eof": eof}
+
+    def _gc(self, now: float) -> None:
+        # TTL expiry + cap on concurrent cursors, oldest evicted first
+        expired = [t for t, r in self._readers.items()
+                   if now > r.refresh_at + self.reader_ttl]
+        for t in expired:
+            del self._readers[t]
+        overflow = len(self._readers) - self.max_readers
+        if overflow > 0:
+            for t in sorted(self._readers,
+                            key=lambda t: self._readers[t].refresh_at
+                            )[:overflow]:
+                del self._readers[t]
+
+    def close(self) -> None:
+        self._server.close()

@@ -20,7 +20,6 @@ Capability parity with pkg/scheduler/frameworkext (SURVEY.md 2.1):
 
 from __future__ import annotations
 
-import http.server
 import json
 import logging
 import threading
@@ -28,6 +27,11 @@ import time
 from typing import Callable, Dict, List, Optional
 
 import numpy as np
+
+from koordinator_tpu.utils.httpserver import (
+    BackgroundHTTPServer,
+    QuietJsonHandler,
+)
 
 from koordinator_tpu.metrics import kernel_timer
 from koordinator_tpu.scheduler import core
@@ -149,40 +153,24 @@ class ServicesServer:
         registry_ref, flags_ref = registry, flags
         metrics_ref = metrics_registry
 
-        class Handler(http.server.BaseHTTPRequestHandler):
-            def log_message(self, *a):  # quiet
-                pass
-
-            def _reply(self, code: int, payload: dict) -> None:
-                body = json.dumps(payload).encode()
-                self.send_response(code)
-                self.send_header("Content-Type", "application/json")
-                self.send_header("Content-Length", str(len(body)))
-                self.end_headers()
-                self.wfile.write(body)
-
+        class Handler(QuietJsonHandler):
             def do_GET(self):
                 if self.path == "/metrics":
-                    body = metrics_ref.expose().encode()
-                    self.send_response(200)
-                    self.send_header("Content-Type",
-                                     "text/plain; version=0.0.4")
-                    self.send_header("Content-Length", str(len(body)))
-                    self.end_headers()
-                    self.wfile.write(body)
+                    self.reply_raw(200, "text/plain; version=0.0.4",
+                                   metrics_ref.expose().encode())
                     return
                 if self.path == "/apis/v1/plugins":
-                    self._reply(200, {"plugins": registry_ref.names()})
+                    self.reply_json(200, {"plugins": registry_ref.names()})
                     return
                 prefix = "/apis/v1/plugins/"
                 if self.path.startswith(prefix):
                     summary = registry_ref.summary(self.path[len(prefix):])
                     if summary is None:
-                        self._reply(404, {"error": "unknown plugin"})
+                        self.reply_json(404, {"error": "unknown plugin"})
                     else:
-                        self._reply(200, summary)
+                        self.reply_json(200, summary)
                     return
-                self._reply(404, {"error": "not found"})
+                self.reply_json(404, {"error": "not found"})
 
             def do_PUT(self):
                 if self.path.startswith("/debug/flags/s"):
@@ -191,21 +179,18 @@ class ServicesServer:
                     try:
                         flags_ref.score_top_n = int(raw or "0")
                     except ValueError:
-                        self._reply(400, {"error": f"bad value {raw!r}"})
+                        self.reply_json(400, {"error": f"bad value {raw!r}"})
                         return
-                    self._reply(200, {"scoreTopN": flags_ref.score_top_n})
+                    self.reply_json(200,
+                                    {"scoreTopN": flags_ref.score_top_n})
                     return
-                self._reply(404, {"error": "not found"})
+                self.reply_json(404, {"error": "not found"})
 
-        self._httpd = http.server.ThreadingHTTPServer((host, port), Handler)
-        self.port = self._httpd.server_address[1]
-        self._thread = threading.Thread(target=self._httpd.serve_forever,
-                                        daemon=True)
-        self._thread.start()
+        self._server = BackgroundHTTPServer(Handler, host, port)
+        self.port = self._server.port
 
     def close(self) -> None:
-        self._httpd.shutdown()
-        self._httpd.server_close()
+        self._server.close()
 
 
 class SchedulerService:

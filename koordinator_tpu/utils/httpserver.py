@@ -1,0 +1,46 @@
+"""Shared scaffolding for the in-process HTTP endpoints (scheduler
+services/metrics, koordlet audit query): a quiet JSON request handler
+base and a background ThreadingHTTPServer wrapper, so each endpoint only
+writes its routes."""
+
+from __future__ import annotations
+
+import http.server
+import json
+import threading
+
+
+class QuietJsonHandler(http.server.BaseHTTPRequestHandler):
+    """BaseHTTPRequestHandler with stderr logging silenced and JSON/raw
+    reply helpers."""
+
+    def log_message(self, *args) -> None:  # quiet
+        pass
+
+    def reply_json(self, code: int, payload: dict) -> None:
+        self.reply_raw(code, "application/json",
+                       json.dumps(payload).encode())
+
+    def reply_raw(self, code: int, content_type: str, body: bytes) -> None:
+        self.send_response(code)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+
+class BackgroundHTTPServer:
+    """ThreadingHTTPServer on a daemon thread; `port` reflects the bound
+    (possibly ephemeral) port."""
+
+    def __init__(self, handler_cls, host: str = "127.0.0.1", port: int = 0):
+        self._httpd = http.server.ThreadingHTTPServer((host, port),
+                                                      handler_cls)
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+
+    def close(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()

@@ -1,0 +1,116 @@
+"""Audit HTTP query handler (auditor.go:130 HttpHandler, gated by
+AuditEventsHTTPHandler): token-paginated reverse reads, TTL/cap cursor
+GC, and the reference's 400/409 statuses."""
+
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from koordinator_tpu.koordlet.audit import Auditor, AuditQueryServer
+
+
+@pytest.fixture
+def auditor():
+    a = Auditor(log_dir=None, ring_size=64)
+    for i in range(10):
+        a.info("executor", "write", f"cgroup/{i}")
+    return a
+
+
+def test_pagination_reverse_order(auditor):
+    srv = AuditQueryServer(auditor, default_limit=4)
+    try:
+        code, page1 = srv.handle(size="4")
+        assert code == 200 and len(page1["events"]) == 4
+        # newest first
+        assert page1["events"][0]["target"] == "cgroup/9"
+        assert not page1["eof"]
+        token = page1["pageToken"]
+        code, page2 = srv.handle(size="4", page_token=token)
+        assert page2["events"][0]["target"] == "cgroup/5"
+        code, page3 = srv.handle(size="4", page_token=token)
+        assert len(page3["events"]) == 2 and page3["eof"]
+        # a consumed-to-EOF cursor is gone
+        code, _ = srv.handle(size="4", page_token=token)
+        assert code == 409
+    finally:
+        srv.close()
+
+
+def test_size_cap_and_bad_token(auditor):
+    srv = AuditQueryServer(auditor, max_limit=100)
+    try:
+        code, out = srv.handle(size="1000")
+        assert code == 400 and "exceeds" in out["error"]
+        code, out = srv.handle(page_token="nope")
+        assert code == 409
+        code, out = srv.handle(size="abc")
+        assert code == 400
+        # non-positive sizes would bypass the cap / never reach eof
+        code, _ = srv.handle(size="-1")
+        assert code == 400
+        code, _ = srv.handle(size="0")
+        assert code == 400
+    finally:
+        srv.close()
+
+
+def test_cursor_ttl_and_cap(auditor):
+    srv = AuditQueryServer(auditor, default_limit=2, reader_ttl=10.0,
+                           max_readers=2)
+    try:
+        _, p1 = srv.handle(size="2", now=0.0)
+        # TTL expiry
+        code, _ = srv.handle(size="2", page_token=p1["pageToken"], now=20.0)
+        assert code == 409
+        # cap: 3 fresh cursors, oldest evicted
+        _, a = srv.handle(size="2", now=30.0)
+        _, b = srv.handle(size="2", now=31.0)
+        _, c = srv.handle(size="2", now=32.0)
+        code, _ = srv.handle(size="2", page_token=a["pageToken"], now=33.0)
+        assert code == 409, "oldest cursor past max_readers must be evicted"
+        code, _ = srv.handle(size="2", page_token=c["pageToken"], now=33.0)
+        assert code == 200
+    finally:
+        srv.close()
+
+
+def test_over_real_http(auditor):
+    srv = AuditQueryServer(auditor)
+    try:
+        url = f"http://127.0.0.1:{srv.port}/events?size=3"
+        with urllib.request.urlopen(url, timeout=5) as r:
+            out = json.loads(r.read())
+        assert len(out["events"]) == 3
+        assert out["events"][0]["target"] == "cgroup/9"
+        url2 = (f"http://127.0.0.1:{srv.port}/events?size=3"
+                f"&pageToken={out['pageToken']}")
+        with urllib.request.urlopen(url2, timeout=5) as r:
+            out2 = json.loads(r.read())
+        assert out2["events"][0]["target"] == "cgroup/6"
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/events?pageToken=bad",
+                timeout=5)
+        assert ei.value.code == 409
+    finally:
+        srv.close()
+
+
+def test_daemon_wires_audit_server(tmp_path):
+    from koordinator_tpu.api import types as api
+    from koordinator_tpu.koordlet.agent import Daemon, DaemonConfig
+    from koordinator_tpu.koordlet.testing import FakeHost
+
+    a = Auditor(log_dir=None, ring_size=16)
+    a.info("boot", "start", "daemon")
+    d = Daemon(FakeHost(str(tmp_path)), DaemonConfig(audit_http_port=0),
+               auditor=a)
+    assert d.audit_server is not None
+    url = f"http://127.0.0.1:{d.audit_server.port}/apis/v1/audit"
+    with urllib.request.urlopen(url, timeout=5) as r:
+        out = json.loads(r.read())
+    assert out["events"][0]["operation"] == "start"
+    d.audit_server.close()
