@@ -51,6 +51,33 @@ class ObjectMeta:
 
 
 @dataclasses.dataclass
+class Taint:
+    """Node taint (core/v1 Taint; consumed by the descheduler's
+    RemovePodsViolatingNodeTaints compat plugin)."""
+
+    key: str = ""
+    value: str = ""
+    effect: str = "NoSchedule"   # NoSchedule | NoExecute | PreferNoSchedule
+
+
+@dataclasses.dataclass
+class Toleration:
+    """Pod toleration: empty value tolerates any value of the key
+    (operator Exists); empty effect tolerates every effect."""
+
+    key: str = ""
+    value: str = ""
+    effect: str = ""
+
+    def tolerates(self, taint: "Taint") -> bool:
+        if self.key != taint.key:
+            return False
+        if self.value and self.value != taint.value:
+            return False
+        return not self.effect or self.effect == taint.effect
+
+
+@dataclasses.dataclass
 class Pod:
     """A pending or running pod, pre-resolved to the koordinator protocol.
 
@@ -97,6 +124,14 @@ class Pod:
     workload_replicas: int = 0
     # device request (gpu-core percent, gpu-memory MiB) folded into requests
     phase: str = "Pending"
+    # lifecycle/status consumed by the descheduler compat plugins
+    start_time: float = 0.0      # unix seconds; 0 = unknown
+    restart_count: int = 0       # sum over containers
+    tolerations: List[Toleration] = dataclasses.field(default_factory=list)
+    # simplified topologySpreadConstraint (one per pod): spread over the
+    # node-label key with bounded skew; "" = none
+    spread_topology_key: str = ""
+    spread_max_skew: int = 1
 
     @property
     def qos(self) -> QoSClass:
@@ -136,6 +171,7 @@ class Node:
     allocatable: ResourceList = dataclasses.field(default_factory=dict)
     unschedulable: bool = False
     topology: Optional[NodeResourceTopology] = None
+    taints: List[Taint] = dataclasses.field(default_factory=list)
 
 
 @dataclasses.dataclass

@@ -19,3 +19,7 @@ from koordinator_tpu.descheduler.migration import (  # noqa: F401
     MigrationController,
     MigrationControllerArgs,
 )
+from koordinator_tpu.descheduler.compat import (  # noqa: F401
+    COMPAT_PLUGINS,
+    default_evictor_filter,
+)

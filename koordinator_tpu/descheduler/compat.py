@@ -1,18 +1,29 @@
 """Upstream-descheduler-compatible plugins.
 
 Capability parity with pkg/descheduler/framework/plugins/kubernetes
-(SURVEY.md 2.4): wrappers of the sigs descheduler behaviors the reference
-re-exports — evict pods violating node selection, plus the default evictor
-filter (daemonsets, system QoS, non-preemptible pods, priority threshold).
+(SURVEY.md 2.4, plugin.go:62-130 registry): the sigs descheduler
+behaviors the reference re-exports — PodLifeTime, RemoveFailedPods,
+RemoveDuplicates, RemovePodsHavingTooManyRestarts, the node-selection/
+taint/topology-spread violation evictors, the request-based
+Low/HighNodeUtilization pair — plus the default evictor filter
+(daemonsets, system QoS, non-preemptible pods, priority threshold).
 """
 
 from __future__ import annotations
 
-from typing import Callable, Mapping, Optional, Sequence
+import time
+from typing import Callable, Dict, List, Mapping, Optional, Sequence
+
+import numpy as np
 
 from koordinator_tpu.api import types as api
-from koordinator_tpu.api.extension import QoSClass, selector_matches
+from koordinator_tpu.api.extension import (
+    QoSClass,
+    ResourceKind,
+    selector_matches,
+)
 from koordinator_tpu.descheduler.framework import Evictor
+from koordinator_tpu.snapshot.builder import resource_vec
 
 ANNOTATION_PREEMPTIBLE = "scheduling.koordinator.sh/preemptible"
 
@@ -90,3 +101,307 @@ class RemovePodsOnUnschedulableNodes:
                 if self.pod_filter(pod):
                     self.evictor.evict(
                         pod, f"node {node.meta.name} is unschedulable")
+
+
+class _CompatBase:
+    """Shared wiring: evictor + pod source + evictability filter + clock."""
+
+    def __init__(self, evictor: Evictor,
+                 get_pods_by_node: Callable[[], Mapping[str,
+                                                        Sequence[api.Pod]]],
+                 pod_filter: Optional[Callable[[api.Pod], bool]] = None,
+                 now_fn: Callable[[], float] = time.time):
+        self.evictor = evictor
+        self.get_pods_by_node = get_pods_by_node
+        self.pod_filter = pod_filter or default_evictor_filter()
+        self.now_fn = now_fn
+
+
+class PodLifeTime(_CompatBase):
+    """Evict pods older than maxPodLifeTimeSeconds, optionally only in
+    the given phases (podlifetime.PodLifeTimeArgs)."""
+
+    name = "PodLifeTime"
+
+    def __init__(self, *args, max_pod_life_time_seconds: float = 86400.0,
+                 states: Sequence[str] = (), **kw):
+        super().__init__(*args, **kw)
+        self.max_age = max_pod_life_time_seconds
+        self.states = set(states)
+
+    def deschedule(self, nodes: Sequence[api.Node]) -> None:
+        now = self.now_fn()
+        for pods in self.get_pods_by_node().values():
+            for pod in pods:
+                if self.states and pod.phase not in self.states:
+                    continue
+                if pod.start_time <= 0 or \
+                        now - pod.start_time < self.max_age:
+                    continue
+                if self.pod_filter(pod):
+                    self.evictor.evict(
+                        pod, f"pod exceeded max lifetime {self.max_age}s")
+
+
+class RemoveFailedPods(_CompatBase):
+    """Evict Failed pods, optionally only past a minimum age
+    (removefailedpods.RemoveFailedPodsArgs)."""
+
+    name = "RemoveFailedPods"
+
+    def __init__(self, *args, min_pod_lifetime_seconds: float = 0.0, **kw):
+        super().__init__(*args, **kw)
+        self.min_age = min_pod_lifetime_seconds
+
+    def deschedule(self, nodes: Sequence[api.Node]) -> None:
+        now = self.now_fn()
+        for pods in self.get_pods_by_node().values():
+            for pod in pods:
+                if pod.phase != "Failed":
+                    continue
+                if self.min_age and pod.start_time > 0 and \
+                        now - pod.start_time < self.min_age:
+                    continue
+                if self.pod_filter(pod):
+                    self.evictor.evict(pod, "pod is in Failed phase")
+
+
+class RemovePodsHavingTooManyRestarts(_CompatBase):
+    """Evict pods whose container restart total crossed the threshold
+    (removepodshavingtoomanyrestarts args)."""
+
+    name = "RemovePodsHavingTooManyRestarts"
+
+    def __init__(self, *args, pod_restart_threshold: int = 100, **kw):
+        super().__init__(*args, **kw)
+        self.threshold = pod_restart_threshold
+
+    def deschedule(self, nodes: Sequence[api.Node]) -> None:
+        for pods in self.get_pods_by_node().values():
+            for pod in pods:
+                if pod.restart_count < self.threshold:
+                    continue
+                if self.pod_filter(pod):
+                    self.evictor.evict(
+                        pod, f"{pod.restart_count} restarts >= "
+                             f"{self.threshold}")
+
+
+class RemoveDuplicates(_CompatBase):
+    """One replica of a workload per node: evict the extras so the
+    owner's pods spread (removeduplicates semantics — duplicates are
+    same-owner pods colocated on one node)."""
+
+    name = "RemoveDuplicates"
+
+    def deschedule(self, nodes: Sequence[api.Node]) -> None:
+        for node_name, pods in self.get_pods_by_node().items():
+            seen: Dict[str, int] = {}
+            for pod in pods:
+                owner = pod.owner_workload
+                if not owner:
+                    continue
+                seen[owner] = seen.get(owner, 0) + 1
+                if seen[owner] > 1 and self.pod_filter(pod):
+                    self.evictor.evict(
+                        pod, f"duplicate of {owner} on {node_name}")
+
+
+class RemovePodsViolatingNodeAffinity(RemovePodsViolatingNodeSelector):
+    """requiredDuringSchedulingIgnoredDuringExecution re-check: the pod's
+    node selection no longer matches the (relabeled) node. The typed Pod
+    carries affinity pre-resolved into `node_selector`, so the check is
+    the selector re-match."""
+
+    name = "RemovePodsViolatingNodeAffinity"
+
+
+class RemovePodsViolatingNodeTaints(_CompatBase):
+    """Evict pods that do not tolerate their node's NoSchedule/NoExecute
+    taints (taint added after placement)."""
+
+    name = "RemovePodsViolatingNodeTaints"
+
+    def deschedule(self, nodes: Sequence[api.Node]) -> None:
+        pods_by_node = self.get_pods_by_node()
+        for node in nodes:
+            hard = [t for t in node.taints
+                    if t.effect in ("NoSchedule", "NoExecute")]
+            if not hard:
+                continue
+            for pod in pods_by_node.get(node.meta.name, ()):
+                bad = [t for t in hard
+                       if not any(tol.tolerates(t)
+                                  for tol in pod.tolerations)]
+                if bad and self.pod_filter(pod):
+                    self.evictor.evict(
+                        pod, f"untolerated taint {bad[0].key}="
+                             f"{bad[0].value}:{bad[0].effect}")
+
+
+class RemovePodsViolatingTopologySpreadConstraint(_CompatBase):
+    """Rebalance workloads whose per-domain pod counts violate maxSkew.
+    Domains come from the node label named by the pod's
+    spread_topology_key; EMPTY domains count as targets only when some
+    SCHEDULABLE node provides them (a cordoned/tainted-only domain must
+    not drag the floor to zero and trigger churn the scheduler can never
+    repair). Evictions are the MINIMAL move set that repairs the skew,
+    assuming each evicted pod reschedules into the emptiest domain —
+    the upstream plugin's balanceDomains simulation."""
+
+    name = "RemovePodsViolatingTopologySpreadConstraint"
+
+    def deschedule(self, nodes: Sequence[api.Node]) -> None:
+        node_labels = {n.meta.name: n.meta.labels for n in nodes}
+        schedulable = [
+            n for n in nodes
+            if not n.unschedulable and not any(
+                t.effect in ("NoSchedule", "NoExecute") for t in n.taints)]
+        # group pods by (owner, topology key)
+        groups: Dict[tuple, List[tuple]] = {}
+        for node_name, pods in self.get_pods_by_node().items():
+            labels = node_labels.get(node_name, {})
+            for pod in pods:
+                key = pod.spread_topology_key
+                if not key or not pod.owner_workload:
+                    continue
+                domain = labels.get(key)
+                if domain is None:
+                    continue
+                groups.setdefault((pod.owner_workload, key), []).append(
+                    (domain, pod))
+        for (owner, key), members in groups.items():
+            counts: Dict[str, int] = {}
+            for n in schedulable:
+                d = n.meta.labels.get(key)
+                if d is not None:
+                    counts[d] = 0
+            for domain, _pod in members:
+                counts[domain] = counts.get(domain, 0) + 1
+            if len(counts) < 2:
+                continue
+            max_skew = max(p.spread_max_skew for _, p in members)
+            # minimal repair: move one pod at a time from the fullest to
+            # the emptiest domain until the skew constraint holds
+            evict_from: Dict[str, int] = {}
+            sim = dict(counts)
+            while max(sim.values()) - min(sim.values()) > max_skew:
+                hi = max(sim, key=sim.get)  # type: ignore[arg-type]
+                lo = min(sim, key=sim.get)  # type: ignore[arg-type]
+                sim[hi] -= 1
+                sim[lo] += 1
+                evict_from[hi] = evict_from.get(hi, 0) + 1
+            for domain, n_evict in evict_from.items():
+                victims = [p for d, p in members
+                           if d == domain and self.pod_filter(p)]
+                for pod in victims[:n_evict]:
+                    self.evictor.evict(
+                        pod, f"skew of {owner} over {key} exceeds "
+                             f"{max_skew}")
+
+
+class _RequestUtilization(_CompatBase):
+    """Shared classification for the upstream nodeutilization pair: node
+    utilization = Σ pod REQUESTS / allocatable (the upstream plugins are
+    request-based; the koord LowNodeLoad plugin is the usage-based one).
+    The pod listing is fetched ONCE per cycle and shared between
+    classification and draining so both see one consistent snapshot."""
+
+    rdims = (int(ResourceKind.CPU), int(ResourceKind.MEMORY))
+
+    def _utilization(self, nodes: Sequence[api.Node],
+                     pods_by_node: Mapping[str, Sequence[api.Pod]]
+                     ) -> np.ndarray:
+        pct = np.zeros((len(nodes), len(self.rdims)), np.float32)
+        for i, node in enumerate(nodes):
+            cap = resource_vec(node.allocatable)[list(self.rdims)]
+            req = np.zeros_like(cap)
+            for pod in pods_by_node.get(node.meta.name, ()):
+                req += resource_vec(pod.requests)[list(self.rdims)]
+            with np.errstate(divide="ignore", invalid="ignore"):
+                pct[i] = np.where(cap > 0, 100.0 * req / cap, 0.0)
+        return pct
+
+    def _drain(self, node: api.Node,
+               pods_by_node: Mapping[str, Sequence[api.Pod]],
+               max_per_node: int, reason: str) -> None:
+        evicted = 0
+        # lowest-priority first — upstream eviction order
+        for pod in sorted(pods_by_node.get(node.meta.name, ()),
+                          key=lambda p: p.priority or 0):
+            if evicted >= max_per_node:
+                break
+            if self.pod_filter(pod) and self.evictor.evict(pod, reason):
+                evicted += 1
+
+
+class LowNodeUtilization(_RequestUtilization):
+    """Balance plugin: evict from request-overutilized nodes while
+    underutilized targets exist (nodeutilization.LowNodeUtilizationArgs,
+    request-based upstream variant)."""
+
+    name = "LowNodeUtilization"
+
+    def __init__(self, *args, thresholds: float = 20.0,
+                 target_thresholds: float = 70.0,
+                 max_evictions_per_node: int = 5, **kw):
+        super().__init__(*args, **kw)
+        self.low = thresholds
+        self.high = target_thresholds
+        self.max_per_node = max_evictions_per_node
+
+    def balance(self, nodes: Sequence[api.Node]) -> None:
+        pods_by_node = self.get_pods_by_node()
+        pct = self._utilization(nodes, pods_by_node)
+        low_mask = (pct < self.low).all(axis=1)
+        high_mask = (pct > self.high).any(axis=1)
+        if not low_mask.any():
+            return  # nowhere to move pods to
+        for i, node in enumerate(nodes):
+            if high_mask[i]:
+                self._drain(node, pods_by_node, self.max_per_node,
+                            f"node {node.meta.name} request-overutilized")
+
+
+class HighNodeUtilization(_RequestUtilization):
+    """Balance plugin: bin-packing — drain UNDERutilized nodes so their
+    workload compacts onto the rest (nodeutilization.
+    HighNodeUtilizationArgs)."""
+
+    name = "HighNodeUtilization"
+
+    def __init__(self, *args, thresholds: float = 20.0,
+                 max_evictions_per_node: int = 5, **kw):
+        super().__init__(*args, **kw)
+        self.low = thresholds
+        self.max_per_node = max_evictions_per_node
+
+    def balance(self, nodes: Sequence[api.Node]) -> None:
+        pods_by_node = self.get_pods_by_node()
+        pct = self._utilization(nodes, pods_by_node)
+        low_mask = (pct < self.low).all(axis=1)
+        if low_mask.all():
+            return  # nowhere to compact onto
+        for i, node in enumerate(nodes):
+            if low_mask[i]:
+                self._drain(node, pods_by_node, self.max_per_node,
+                            f"draining underutilized {node.meta.name} "
+                            f"for bin-packing")
+
+
+# name -> class, the plugin.go:62-130 registry analogue
+COMPAT_PLUGINS = {
+    p.name: p for p in (
+        RemovePodsViolatingNodeSelector,
+        RemovePodsOnUnschedulableNodes,
+        PodLifeTime,
+        RemoveFailedPods,
+        RemovePodsHavingTooManyRestarts,
+        RemoveDuplicates,
+        RemovePodsViolatingNodeAffinity,
+        RemovePodsViolatingNodeTaints,
+        RemovePodsViolatingTopologySpreadConstraint,
+        LowNodeUtilization,
+        HighNodeUtilization,
+    )
+}

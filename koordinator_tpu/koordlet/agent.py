@@ -11,6 +11,7 @@ a NodeMetric status is produced for the control plane / snapshot ingest.
 from __future__ import annotations
 
 import dataclasses
+import logging
 import time
 from collections import deque
 from typing import Callable, Optional
@@ -102,6 +103,10 @@ class Daemon:
             from koordinator_tpu.koordlet.audit import AuditQueryServer
             self.audit_server = AuditQueryServer(auditor,
                                                  port=cfg.audit_http_port)
+            # an ephemeral port (0) is useless unless announced
+            logging.getLogger("koordlet").info(
+                "audit query endpoint on 127.0.0.1:%d",
+                self.audit_server.port)
         core_sched = None
         if cfg.enable_core_sched:
             from koordinator_tpu import native

@@ -1,0 +1,211 @@
+"""Upstream-compat descheduler plugin set (plugin.go:62-130 registry):
+lifetime/failed/restarts/duplicates evictors, taint + topology-spread
+violation, and the request-based nodeutilization pair."""
+
+import pytest
+
+from koordinator_tpu.api import types as api
+from koordinator_tpu.api.extension import ResourceKind as RK
+from koordinator_tpu.descheduler import COMPAT_PLUGINS, RecordingEvictor
+from koordinator_tpu.descheduler.compat import (
+    HighNodeUtilization,
+    LowNodeUtilization,
+    PodLifeTime,
+    RemoveDuplicates,
+    RemoveFailedPods,
+    RemovePodsHavingTooManyRestarts,
+    RemovePodsViolatingNodeTaints,
+    RemovePodsViolatingTopologySpreadConstraint,
+)
+
+
+def mk_pod(name, node="n0", **kw):
+    kw.setdefault("phase", "Running")
+    return api.Pod(meta=api.ObjectMeta(name=name, uid=name),
+                   node_name=node, **kw)
+
+
+def mk_node(name, labels=None, taints=(), cpu=16000.0):
+    return api.Node(meta=api.ObjectMeta(name=name, labels=labels or {}),
+                    allocatable={RK.CPU: cpu, RK.MEMORY: 32768.0},
+                    taints=list(taints))
+
+
+def evicted_names(ev):
+    return [e.pod.meta.name for e in ev.evictions]
+
+
+def test_registry_has_the_upstream_set():
+    for name in ("PodLifeTime", "RemoveFailedPods", "RemoveDuplicates",
+                 "RemovePodsHavingTooManyRestarts",
+                 "RemovePodsViolatingNodeAffinity",
+                 "RemovePodsViolatingNodeTaints",
+                 "RemovePodsViolatingTopologySpreadConstraint",
+                 "LowNodeUtilization", "HighNodeUtilization"):
+        assert name in COMPAT_PLUGINS
+
+
+def test_pod_lifetime_and_states():
+    ev = RecordingEvictor()
+    pods = {"n0": [mk_pod("old", start_time=100.0),
+                   mk_pod("young", start_time=900.0),
+                   mk_pod("old-pending", start_time=100.0,
+                          phase="Pending"),
+                   mk_pod("unknown-age", start_time=0.0)]}
+    p = PodLifeTime(ev, lambda: pods, now_fn=lambda: 1000.0,
+                    max_pod_life_time_seconds=500.0, states=("Running",))
+    p.deschedule([mk_node("n0")])
+    assert evicted_names(ev) == ["old"]
+
+
+def test_remove_failed_pods_min_age():
+    ev = RecordingEvictor()
+    pods = {"n0": [mk_pod("failed-old", phase="Failed", start_time=100.0),
+                   mk_pod("failed-new", phase="Failed", start_time=990.0),
+                   mk_pod("running", phase="Running")]}
+    p = RemoveFailedPods(ev, lambda: pods, now_fn=lambda: 1000.0,
+                         min_pod_lifetime_seconds=100.0)
+    p.deschedule([mk_node("n0")])
+    assert evicted_names(ev) == ["failed-old"]
+
+
+def test_too_many_restarts():
+    ev = RecordingEvictor()
+    pods = {"n0": [mk_pod("crashy", restart_count=120),
+                   mk_pod("stable", restart_count=3)]}
+    p = RemovePodsHavingTooManyRestarts(ev, lambda: pods,
+                                        pod_restart_threshold=100)
+    p.deschedule([mk_node("n0")])
+    assert evicted_names(ev) == ["crashy"]
+
+
+def test_remove_duplicates_keeps_one_per_owner_per_node():
+    ev = RecordingEvictor()
+    pods = {"n0": [mk_pod(f"web-{i}", owner_workload="default/web")
+                   for i in range(3)] + [mk_pod("db-0",
+                                                owner_workload="default/db")],
+            "n1": [mk_pod("web-3", node="n1",
+                          owner_workload="default/web")]}
+    RemoveDuplicates(ev, lambda: pods).deschedule(
+        [mk_node("n0"), mk_node("n1")])
+    # one web replica survives on n0; the lone n1 replica untouched
+    assert evicted_names(ev) == ["web-1", "web-2"]
+
+
+def test_taint_violation_respects_tolerations():
+    ev = RecordingEvictor()
+    taint = api.Taint(key="dedicated", value="ml", effect="NoSchedule")
+    pods = {"n0": [
+        mk_pod("tolerant",
+               tolerations=[api.Toleration(key="dedicated", value="ml")]),
+        mk_pod("exists-tolerant",
+               tolerations=[api.Toleration(key="dedicated")]),
+        mk_pod("violator"),
+    ]}
+    RemovePodsViolatingNodeTaints(ev, lambda: pods).deschedule(
+        [mk_node("n0", taints=[taint])])
+    assert evicted_names(ev) == ["violator"]
+    # PreferNoSchedule is soft: nobody evicted
+    ev2 = RecordingEvictor()
+    RemovePodsViolatingNodeTaints(ev2, lambda: pods).deschedule(
+        [mk_node("n0", taints=[api.Taint(key="x", effect="PreferNoSchedule")])])
+    assert not ev2.evictions
+
+
+def test_topology_spread_evicts_excess_skew():
+    ev = RecordingEvictor()
+    nodes = [mk_node("a1", {"zone": "a"}), mk_node("b1", {"zone": "b"})]
+    mk = lambda name, node: mk_pod(name, node=node,  # noqa: E731
+                                   owner_workload="default/web",
+                                   spread_topology_key="zone",
+                                   spread_max_skew=1)
+    pods = {"a1": [mk(f"w{i}", "a1") for i in range(4)],
+            "b1": [mk("w9", "b1")]}
+    RemovePodsViolatingTopologySpreadConstraint(
+        ev, lambda: pods).deschedule(nodes)
+    # zone a has 4, zone b has 1: one move repairs the skew to {3, 2}
+    assert len(ev.evictions) == 1
+    assert ev.evictions[0].pod.node_name == "a1"
+
+
+def test_topology_spread_ignores_unschedulable_empty_domains():
+    """A zone provided only by a cordoned node must not drag the floor to
+    zero (it can never receive pods, so evicting toward it is churn)."""
+    ev = RecordingEvictor()
+    cordoned = mk_node("c1", {"zone": "c"})
+    cordoned.unschedulable = True
+    nodes = [mk_node("a1", {"zone": "a"}), mk_node("b1", {"zone": "b"}),
+             cordoned]
+    mk = lambda name, node: mk_pod(name, node=node,  # noqa: E731
+                                   owner_workload="default/web",
+                                   spread_topology_key="zone",
+                                   spread_max_skew=1)
+    pods = {"a1": [mk("w0", "a1"), mk("w1", "a1")],
+            "b1": [mk("w2", "b1")]}
+    RemovePodsViolatingTopologySpreadConstraint(
+        ev, lambda: pods).deschedule(nodes)
+    assert not ev.evictions, "skew {2,1} within maxSkew=1 once the " \
+        "cordoned-only zone is excluded"
+
+
+def test_topology_spread_filters_before_budgeting():
+    """Unevictable pods must not absorb the eviction budget: with the
+    excess at the head of the list protected, the evictable ones behind
+    them are chosen."""
+    ev = RecordingEvictor()
+    nodes = [mk_node("a1", {"zone": "a"}), mk_node("b1", {"zone": "b"})]
+
+    def mk(name, node, protected=False):
+        anns = {"scheduling.koordinator.sh/preemptible": "false"} \
+            if protected else {}
+        return api.Pod(meta=api.ObjectMeta(name=name, uid=name,
+                                           annotations=anns),
+                       node_name=node, phase="Running",
+                       owner_workload="default/web",
+                       spread_topology_key="zone", spread_max_skew=1)
+
+    pods = {"a1": [mk("prot0", "a1", True), mk("prot1", "a1", True),
+                   mk("free0", "a1"), mk("free1", "a1")],
+            "b1": [mk("w", "b1")]}
+    RemovePodsViolatingTopologySpreadConstraint(
+        ev, lambda: pods).deschedule(nodes)
+    # one move repairs {4,1} -> {3,2}; it must hit an evictable pod
+    assert [e.pod.meta.name for e in ev.evictions] == ["free0"]
+
+
+def test_low_node_utilization_request_based():
+    ev = RecordingEvictor()
+    nodes = [mk_node("hot"), mk_node("cold")]
+    pods = {"hot": [mk_pod(f"p{i}", node="hot", priority=1000 + i,
+                           requests={RK.CPU: 4000.0, RK.MEMORY: 1024.0})
+                    for i in range(4)],
+            "cold": []}
+    p = LowNodeUtilization(ev, lambda: pods, thresholds=20.0,
+                           target_thresholds=70.0,
+                           max_evictions_per_node=2)
+    p.balance(nodes)
+    # hot = 100% cpu requested, cold = 0%: evict 2 lowest-priority pods
+    assert evicted_names(ev) == ["p0", "p1"]
+
+    # no underutilized target -> nothing moves
+    ev2 = RecordingEvictor()
+    pods2 = {"hot": pods["hot"],
+             "cold": [mk_pod("filler", node="cold",
+                             requests={RK.CPU: 8000.0,
+                                       RK.MEMORY: 16384.0})]}
+    LowNodeUtilization(ev2, lambda: pods2, thresholds=20.0,
+                       target_thresholds=70.0).balance(nodes)
+    assert not ev2.evictions
+
+
+def test_high_node_utilization_drains_underutilized():
+    ev = RecordingEvictor()
+    nodes = [mk_node("sparse"), mk_node("packed")]
+    pods = {"sparse": [mk_pod("loner", node="sparse",
+                              requests={RK.CPU: 1000.0,
+                                        RK.MEMORY: 512.0})],
+            "packed": [mk_pod("big", node="packed",
+                              requests={RK.CPU: 12000.0,
+                                        RK.MEMORY: 16384.0})]}
+    HighNodeUtilization(ev, lambda: pods, thresholds=20.0).balance(nodes)
+    assert evicted_names(ev) == ["loner"]
