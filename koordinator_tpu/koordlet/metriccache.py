@@ -55,8 +55,12 @@ NODE_DISK_IO_UTIL = "node_disk_io_util"      # percent busy
 NODE_DISK_READ_BPS = "node_disk_read_bps"    # bytes/s
 NODE_DISK_WRITE_BPS = "node_disk_write_bps"
 
+# CFS throttling pressure: delta(nr_throttled)/delta(nr_periods) in [0,1]
+POD_CPU_THROTTLED_RATIO = "pod_cpu_throttled_ratio"  # labels: pod_uid
+
 # KV keys (kv_storage.go point-in-time objects)
 NODE_LOCAL_STORAGE_KEY = "node_local_storage_info"
+NODE_CPU_INFO_KEY = "node_cpu_info"
 
 AGGREGATIONS = ("avg", "p50", "p90", "p95", "p99", "latest", "count", "max")
 

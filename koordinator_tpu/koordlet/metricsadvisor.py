@@ -479,6 +479,73 @@ class DeviceCollector:
                               labels)
 
 
+class PodThrottledCollector:
+    """Per-pod CFS throttling ratio (collectors/podthrottled/
+    pod_throttled_collector.go): delta(nr_throttled)/delta(nr_periods)
+    over the sample window; first sample per pod primes the baseline."""
+
+    name = "podthrottled"
+
+    def __init__(self, host: Host, cache: mc.MetricCache,
+                 informer: StatesInformer):
+        self.host = host
+        self.cache = cache
+        self.informer = informer
+        self._prev: Dict[str, Tuple[int, int]] = {}
+
+    def collect(self, now: float) -> None:
+        live = set()
+        for meta in self.informer.get_all_pods():
+            uid = meta.pod.meta.uid
+            live.add(uid)
+            try:
+                periods, throttled = self.host.cpu_stat_throttled(
+                    meta.cgroup_dir)
+            except (FileNotFoundError, ValueError):
+                continue
+            prev = self._prev.get(uid)
+            self._prev[uid] = (periods, throttled)
+            if prev is None:
+                continue
+            dp, dt = periods - prev[0], throttled - prev[1]
+            if dp <= 0:
+                continue  # no CFS periods elapsed (or counter reset)
+            self.cache.append(mc.POD_CPU_THROTTLED_RATIO, now,
+                              min(1.0, max(0.0, dt / dp)),
+                              {"pod_uid": uid})
+        for uid in list(self._prev):
+            if uid not in live:
+                del self._prev[uid]
+
+
+class NodeInfoCollector:
+    """Point-in-time node CPU inventory into the KV (collectors/nodeinfo/
+    node_info_collector.go NodeCPUInfo): model, logical CPUs, physical
+    cores, sockets, NUMA nodes — the scheduler-facing hardware shape."""
+
+    name = "nodeinfo"
+
+    def __init__(self, host: Host, cache: mc.MetricCache):
+        self.host = host
+        self.cache = cache
+        self._done = False
+
+    def collect(self, now: float) -> None:
+        if self._done:
+            return  # static for the node's lifetime; one read suffices
+        cpus = self.host.cpu_topology()
+        if not cpus:
+            return
+        self._done = True
+        self.cache.set_kv(mc.NODE_CPU_INFO_KEY, {
+            "model": self.host.cpu_model(),
+            "cpus": len(cpus),
+            "cores": len({(c.socket_id, c.core_id) for c in cpus}),
+            "sockets": len({c.socket_id for c in cpus}),
+            "numa_nodes": len({c.node_id for c in cpus}),
+        })
+
+
 class Advisor:
     """The collector registry + drive loop (framework/plugin.go registry;
     metrics_advisor.go:72-102 per-collector goroutines collapse into one
@@ -524,6 +591,8 @@ def default_advisor(host: Host, cache: mc.MetricCache,
         PSICollector(host, cache, informer),
         HostAppCollector(host, cache, informer),
         NodeStorageInfoCollector(host, cache),
+        PodThrottledCollector(host, cache, informer),
+        NodeInfoCollector(host, cache),
         # self-gating: inert unless the kernel has kidled
         ColdPageCollector(host, cache, informer),
     ]

@@ -414,6 +414,32 @@ class Host:
             })
         return out
 
+    def cpu_stat_throttled(self, cgroup_dir: str) -> Tuple[int, int]:
+        """(nr_periods, nr_throttled) from cpu.stat (ParseCPUStatRaw,
+        util/system/cgroup.go:85-100; feeds the podthrottled
+        collector)."""
+        periods = throttled = 0
+        for line in self.read_cgroup(cgroup_dir, "cpu.stat").splitlines():
+            k, _, v = line.partition(" ")
+            if k == "nr_periods":
+                periods = int(v)
+            elif k == "nr_throttled":
+                throttled = int(v)
+        return periods, throttled
+
+    def cpu_model(self) -> str:
+        """CPU model name from /proc/cpuinfo (NodeCPUInfo, the nodeinfo
+        collector's KV payload)."""
+        try:
+            text = self.read(os.path.join(self.proc_root, "cpuinfo"))
+        except FileNotFoundError:
+            return ""
+        for line in text.splitlines():
+            k, _, v = line.partition(":")
+            if k.strip() == "model name":
+                return v.strip()
+        return ""
+
     def cgroup_procs_recursive(self, cgroup_dir: str) -> List[int]:
         """PIDs of the cgroup AND all descendants; used to attribute
         device/process usage to pods (the GPU collector's pid->pod match,

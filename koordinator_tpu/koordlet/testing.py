@@ -159,6 +159,18 @@ class FakeHost(Host):
                    f"some avg10={some_avg10:.2f} avg60=0.00 avg300=0.00 total=0\n"
                    f"full avg10={full_avg10:.2f} avg60=0.00 avg300=0.00 total=0\n")
 
+    def set_cgroup_throttled(self, cgroup_dir: str, nr_periods: int,
+                             nr_throttled: int,
+                             usage_usec: int = 0) -> None:
+        self.write(self.cgroup_file(cgroup_dir, "cpu.stat"),
+                   f"usage_usec {usage_usec}\n"
+                   f"nr_periods {nr_periods}\n"
+                   f"nr_throttled {nr_throttled}\n")
+
+    def set_cpu_model(self, model: str) -> None:
+        self._seed(os.path.join(self.proc_root, "cpuinfo"),
+                   f"processor\t: 0\nmodel name\t: {model}\n")
+
     def set_cgroup_procs(self, cgroup_dir: str, pids: Iterable[int]) -> None:
         self.write(self.cgroup_file(cgroup_dir, "cgroup.procs"),
                    "".join(f"{p}\n" for p in pids))

@@ -275,6 +275,44 @@ def test_device_collector_node_and_pod_attribution(host, informer):
         .keys().__len__() == 3
 
 
+# --- podthrottled + nodeinfo -------------------------------------------
+
+
+def test_pod_throttled_ratio_delta(host, informer):
+    cache = mc.MetricCache()
+    pod = _make_pod("pod-a")
+    host.make_cgroup(pod.cgroup_dir)
+    informer.set_pods([pod])
+    from koordinator_tpu.koordlet.metricsadvisor import PodThrottledCollector
+    c = PodThrottledCollector(host, cache, informer)
+
+    host.set_cgroup_throttled(pod.cgroup_dir, nr_periods=100, nr_throttled=10)
+    c.collect(0.0)  # baseline primed
+    assert cache.query(mc.POD_CPU_THROTTLED_RATIO, 0, 1,
+                       {"pod_uid": "pod-a"}, "latest") is None
+    # 100 more periods, 25 of them throttled
+    host.set_cgroup_throttled(pod.cgroup_dir, nr_periods=200, nr_throttled=35)
+    c.collect(10.0)
+    assert cache.query(mc.POD_CPU_THROTTLED_RATIO, 0, 11,
+                       {"pod_uid": "pod-a"}, "latest") \
+        == pytest.approx(0.25)
+    # pod gone -> tracker pruned
+    informer.set_pods([])
+    c.collect(20.0)
+    assert c._prev == {}
+
+
+def test_node_info_kv(host):
+    cache = mc.MetricCache()
+    host.set_cpu_model("AMD EPYC 7B12")
+    from koordinator_tpu.koordlet.metricsadvisor import NodeInfoCollector
+    NodeInfoCollector(host, cache).collect(0.0)
+    info = cache.get_kv(mc.NODE_CPU_INFO_KEY)
+    assert info["model"] == "AMD EPYC 7B12"
+    assert info["cpus"] == 8 and info["cores"] == 4
+    assert info["numa_nodes"] == 1
+
+
 # --- collector isolation -------------------------------------------------
 
 
