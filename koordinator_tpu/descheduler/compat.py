@@ -280,7 +280,9 @@ class RemovePodsViolatingTopologySpreadConstraint(_CompatBase):
                 counts[domain] = counts.get(domain, 0) + 1
             if len(counts) < 2:
                 continue
-            max_skew = max(p.spread_max_skew for _, p in members)
+            # clamp: skew < 1 is unsatisfiable between unequal domains
+            # and would make the repair loop oscillate forever
+            max_skew = max(1, max(p.spread_max_skew for _, p in members))
             # minimal repair: move one pod at a time from the fullest to
             # the emptiest domain until the skew constraint holds
             evict_from: Dict[str, int] = {}

@@ -1,9 +1,9 @@
 """Audit: append-only record of every agent action on the kernel.
 
 Capability parity with `pkg/koordlet/audit/` (auditor.go): an in-memory ring
-buffer plus size-rotated on-disk log files, with a query API (the reference
-serves it over HTTP gated by AuditEventsHTTPHandler; here `query()` is the
-handler body and edge/service.py exposes it).
+buffer plus size-rotated on-disk log files, with a query API — `query()`
+for in-process callers and `AuditQueryServer` for the paginated HTTP
+endpoint (gated by AuditEventsHTTPHandler).
 """
 
 from __future__ import annotations

@@ -21,3 +21,7 @@ from koordinator_tpu.snapshot.delta import (  # noqa: F401
     forget_pods,
 )
 from koordinator_tpu.snapshot.store import SnapshotStore  # noqa: F401
+from koordinator_tpu.snapshot.informers import (  # noqa: F401
+    ClusterInformerHub,
+    SnapshotSyncer,
+)
