@@ -1,0 +1,152 @@
+"""Host ingest-plane race test (SURVEY §5 'keep race tests on the host
+ingest layer'): concurrent informer writers, a syncing snapshotter, and
+a scheduling reader must never corrupt state — the functional snapshot
+makes device state immune, so the risk surface is the hub caches,
+indexes, and the store's version chain."""
+
+import threading
+
+import numpy as np
+
+from koordinator_tpu.api import types as api
+from koordinator_tpu.api.extension import ResourceKind as RK
+from koordinator_tpu.scheduler import core
+from koordinator_tpu.scheduler.plugins import loadaware
+from koordinator_tpu.snapshot import (
+    ClusterInformerHub,
+    SnapshotStore,
+    SnapshotSyncer,
+)
+
+NOW = 1e9
+N_NODES = 8
+
+
+def test_concurrent_writers_syncer_and_reader():
+    hub = ClusterInformerHub()
+    store = SnapshotStore()
+    syncer = SnapshotSyncer(hub, store, max_nodes=N_NODES, delta_pad=8)
+    for i in range(N_NODES):
+        hub.upsert_node(api.Node(
+            meta=api.ObjectMeta(name=f"n{i}"),
+            allocatable={RK.CPU: 32000.0, RK.MEMORY: 65536.0}))
+        hub.set_node_metric(api.NodeMetric(
+            node_name=f"n{i}", update_time=NOW,
+            node_usage={RK.CPU: 1000.0, RK.MEMORY: 512.0}))
+    syncer.sync(now=NOW)
+    cfg = loadaware.LoadAwareConfig.make()
+    errors = []
+    stop = threading.Event()
+
+    def metric_writer(seed):
+        rng = np.random.default_rng(seed)
+        try:
+            while not stop.is_set():
+                i = int(rng.integers(N_NODES))
+                hub.set_node_metric(api.NodeMetric(
+                    node_name=f"n{i}", update_time=NOW,
+                    node_usage={RK.CPU: float(rng.uniform(0, 16000)),
+                                RK.MEMORY: 512.0}))
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    def pod_writer():
+        try:
+            j = 0
+            while not stop.is_set():
+                uid = f"u{j % 50}"
+                hub.upsert_pod(api.Pod(
+                    meta=api.ObjectMeta(uid=uid, name=uid),
+                    node_name=f"n{j % N_NODES}",
+                    owner_workload="default/w", phase="Running",
+                    requests={RK.CPU: 100.0, RK.MEMORY: 64.0}))
+                if j % 3 == 0:
+                    hub.delete_pod(f"u{(j // 3) % 50}")
+                j += 1
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    def sync_loop():
+        try:
+            while not stop.is_set():
+                syncer.sync(now=NOW)
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    def reader_loop():
+        last_version = -1
+        try:
+            while not stop.is_set():
+                v = store.version
+                snap = store.current()
+                # the version chain only moves forward
+                assert v >= last_version, f"version went back: {v}"
+                last_version = v
+                req = np.asarray(snap.nodes.requested)
+                assert (req >= -1e-3).all()
+                pbn = hub.pods_by_node()
+                for pods in pbn.values():
+                    assert all(p.meta.uid for p in pods)
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    import time
+
+    # phase 1: pod churn + metric churn — every sync is a full rebuild
+    # (shape dirty), racing builders against readers
+    pod_stop = threading.Event()
+
+    def pod_writer_guarded():
+        try:
+            j = 0
+            while not stop.is_set() and not pod_stop.is_set():
+                uid = f"u{j % 50}"
+                hub.upsert_pod(api.Pod(
+                    meta=api.ObjectMeta(uid=uid, name=uid),
+                    node_name=f"n{j % N_NODES}",
+                    owner_workload="default/w", phase="Running",
+                    requests={RK.CPU: 100.0, RK.MEMORY: 64.0}))
+                if j % 3 == 0:
+                    hub.delete_pod(f"u{(j // 3) % 50}")
+                j += 1
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    del pod_writer  # replaced by the guarded variant
+    threads = [threading.Thread(target=metric_writer, args=(s,))
+               for s in (1, 2)]
+    threads += [threading.Thread(target=pod_writer_guarded),
+                threading.Thread(target=sync_loop),
+                threading.Thread(target=reader_loop)]
+    for t in threads:
+        t.start()
+    time.sleep(1.2)
+    # phase 2: quiesce pods, keep metric writers going — syncs now take
+    # the O(K) DELTA path (store.ingest) under concurrent readers, the
+    # actual risk surface of the freshness split
+    pod_stop.set()
+    time.sleep(1.2)
+    stop.set()
+    for t in threads:
+        t.join(timeout=30)
+        assert not t.is_alive()
+    assert not errors, errors
+    assert syncer.delta_ingests > 0, \
+        "the metric-only phase must exercise the delta-ingest path"
+    assert syncer.full_rebuilds > 0
+
+    # quiesce: one final sync must reflect the final hub state exactly
+    syncer.sync(now=NOW)
+    final = store.current()
+    metrics = hub.node_metrics()
+    usage = np.asarray(final.nodes.usage)
+    for i in range(N_NODES):
+        assert usage[i, 0] == np.float32(
+            metrics[f"n{i}"].node_usage[RK.CPU])
+
+    # and the snapshot still schedules
+    pod = api.Pod(meta=api.ObjectMeta(name="probe"),
+                  requests={RK.CPU: 100.0, RK.MEMORY: 64.0}, priority=9000)
+    batch = syncer.builder.build_pod_batch([pod], syncer.ctx)
+    res = core.schedule_batch(final, batch, cfg)
+    assert int(np.asarray(res.assignment)[0]) >= 0
