@@ -175,6 +175,12 @@ ANNOTATION_RESOURCE_STATUS = SCHEDULING_DOMAIN_PREFIX + "/resource-status"
 ANNOTATION_RESERVATION_ALLOCATED = SCHEDULING_DOMAIN_PREFIX + "/reservation-allocated"
 ANNOTATION_EXTENDED_RESOURCE_SPEC = NODE_DOMAIN_PREFIX + "/extended-resource-spec"
 ANNOTATION_NODE_CPU_NORMALIZATION_RATIO = NODE_DOMAIN_PREFIX + "/cpu-normalization-ratio"
+# per-node colocation strategy override (node_colocation.go:23) — a JSON
+# partial ColocationStrategy merged over the cluster/selector strategy
+ANNOTATION_NODE_COLOCATION_STRATEGY = NODE_DOMAIN_PREFIX + "/colocation-strategy"
+# float ratios that take precedence over the strategy's reclaim percents
+LABEL_CPU_RECLAIM_RATIO = NODE_DOMAIN_PREFIX + "/cpu-reclaim-ratio"
+LABEL_MEMORY_RECLAIM_RATIO = NODE_DOMAIN_PREFIX + "/memory-reclaim-ratio"
 ANNOTATION_NODE_RAW_ALLOCATABLE = NODE_DOMAIN_PREFIX + "/raw-allocatable"
 ANNOTATION_NODE_AMPLIFICATION_RATIOS = (
     NODE_DOMAIN_PREFIX + "/resource-amplification-ratio")

@@ -31,6 +31,7 @@ from __future__ import annotations
 import dataclasses
 import enum
 import json
+import math
 from typing import Dict, List, Optional, Protocol, Tuple
 
 from koordinator_tpu.api.extension import QoSClass, ResourceKind
@@ -235,6 +236,36 @@ class CoreSchedHook:
                                           f"qos/{qos.name}")
 
 
+class CPUNormalizationHook:
+    """Scale CFS quota by the node's CPU normalization ratio
+    (runtimehooks/hooks/cpunormalization/cpu_normalization.go:121-146):
+    a node R times faster than the basic model delivers a requested
+    millicore with quota/R. Runs LAST so it post-processes every quota
+    the earlier hooks emitted."""
+
+    name = "cpunormalization"
+    stages = (Stage.PRE_RUN_POD_SANDBOX, Stage.PRE_CREATE_CONTAINER,
+              Stage.PRE_UPDATE_CONTAINER)
+
+    def __init__(self, informer: StatesInformer):
+        self.informer = informer
+
+    def apply(self, ctx: HookContext) -> None:
+        from koordinator_tpu.slo_controller.cpu_normalization import (
+            node_ratio,
+        )
+
+        ratio = node_ratio(self.informer.get_node())
+        if ratio <= 1.0:
+            return
+        for upd in ctx.cgroup_updates:
+            if upd.resource != "cpu.cfs_quota_us":
+                continue
+            quota = int(upd.value)
+            if quota > 0:
+                upd.value = str(math.ceil(quota / ratio))
+
+
 class GPUEnvHook:
     """Device allocation annotation -> container env (gpu hook)."""
 
@@ -302,4 +333,6 @@ def default_hook_server(informer: StatesInformer,
         BatchResourceHook(),
         CoreSchedHook(core_sched or FakeCoreSched()),
         GPUEnvHook(),
+        # LAST: post-processes every cfs-quota update the hooks above emit
+        CPUNormalizationHook(informer),
     ])

@@ -12,10 +12,17 @@ wins for a node.
 from __future__ import annotations
 
 import dataclasses
-
-from koordinator_tpu.api.extension import selector_matches
 import enum
+import json
 from typing import Dict, List, Optional
+
+from koordinator_tpu.api.extension import (
+    ANNOTATION_NODE_COLOCATION_STRATEGY,
+    LABEL_CPU_RECLAIM_RATIO,
+    LABEL_MEMORY_RECLAIM_RATIO,
+    selector_matches,
+)
+from koordinator_tpu.utils.naming import camel_to_snake
 
 
 class CalculatePolicy(enum.Enum):
@@ -83,13 +90,82 @@ class ColocationConfig:
     node_overrides: List[ColocationStrategyOverride] = dataclasses.field(
         default_factory=list)
 
-    def strategy_for(self, node_labels: Dict[str, str]) -> ColocationStrategy:
-        """First matching node override merged over the cluster strategy
-        (nodeslo/resource_strategy.go getNodeColocationStrategy)."""
+    def strategy_for(self, node_labels: Dict[str, str],
+                     node_annotations: Optional[Dict[str, str]] = None
+                     ) -> ColocationStrategy:
+        """Per-node strategy resolution (sloconfig/colocation_config.go
+        GetNodeColocationStrategy:102-155), precedence low to high:
+        cluster strategy -> first matching node-selector override ->
+        node annotation JSON partial -> reclaim-ratio labels. Illegal
+        node metadata is ignored, never fatal (":142-154")."""
+        import json
+
+        from koordinator_tpu.api.extension import (
+            ANNOTATION_NODE_COLOCATION_STRATEGY,
+            LABEL_CPU_RECLAIM_RATIO,
+            LABEL_MEMORY_RECLAIM_RATIO,
+        )
+
+        out = self.cluster_strategy
         for ov in self.node_overrides:
             if ov.matches(node_labels):
-                return self.cluster_strategy.merged(ov)
-        return self.cluster_strategy
+                out = self.cluster_strategy.merged(ov)
+                break
+        anns = node_annotations or {}
+        raw = anns.get(ANNOTATION_NODE_COLOCATION_STRATEGY)
+        if raw:
+            try:
+                data = json.loads(raw)
+            except ValueError:
+                data = None  # illegal annotation ignored, never fatal
+            if isinstance(data, dict):
+                fields = {}
+                for k, v in data.items():
+                    snake = camel_to_snake(k)
+                    coerced = self._coerce(out, snake, v)
+                    if coerced is not None:
+                        fields[snake] = coerced
+                out = out.merged(ColocationStrategyOverride(fields=fields))
+        out = dataclasses.replace(out)
+        for label, attr in ((LABEL_CPU_RECLAIM_RATIO,
+                             "cpu_reclaim_threshold_percent"),
+                            (LABEL_MEMORY_RECLAIM_RATIO,
+                             "memory_reclaim_threshold_percent")):
+            raw = node_labels.get(label)
+            if raw is None:
+                continue
+            try:
+                ratio = float(raw)
+            except ValueError:
+                continue
+            # the same [0,100]-percent invariant the ConfigMap webhook
+            # enforces; an oversized ratio would overcommit the node
+            if 0.0 <= ratio <= 1.0:
+                setattr(out, attr, ratio * 100.0)
+        return out
+
+    @staticmethod
+    def _coerce(strategy: ColocationStrategy, field: str,
+                value: object) -> Optional[object]:
+        """Annotation values must land with the field's own type — the
+        ConfigMap path coerces through the webhook validator; untyped
+        node metadata must not sneak a str into arithmetic or a bogus
+        policy into the kernel lowering. None = drop the field."""
+        current = getattr(strategy, field, None)
+        if current is None:
+            return None  # unknown field
+        if isinstance(current, CalculatePolicy):
+            try:
+                return CalculatePolicy(value)
+            except ValueError:
+                return None
+        if isinstance(current, bool):
+            return value if isinstance(value, bool) else None
+        if isinstance(current, float):
+            return (float(value)
+                    if isinstance(value, (int, float))
+                    and not isinstance(value, bool) else None)
+        return value if type(value) is type(current) else None
 
 
 def validate_colocation_config(cfg: ColocationConfig) -> List[str]:

@@ -1,0 +1,76 @@
+"""CPU normalization: per-node performance ratio from the CPU model.
+
+Capability parity with the noderesource CPUNormalization plugin
+(`pkg/slo-controller/noderesource/plugins/cpunormalization/plugin.go`):
+the cluster config maps CPU models to a performance ratio relative to
+the fleet's basic model; the manager writes the node's ratio into the
+`cpu-normalization-ratio` annotation, and koordlet's cpunormalization
+runtime hook divides CFS quota by it so one requested millicore means
+the same delivered compute on every machine generation. Ratios are
+clamped to [1.0, 5.0] — scaling below the basic model is unsupported
+(plugin.go defaultMinRatio/defaultMaxRatio).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional
+
+from koordinator_tpu.api import types as api
+from koordinator_tpu.api.extension import (
+    ANNOTATION_NODE_CPU_NORMALIZATION_RATIO,
+)
+
+MIN_RATIO = 1.0
+MAX_RATIO = 5.0
+
+
+@dataclasses.dataclass
+class CPUNormalizationStrategy:
+    """cpu-normalization-config ConfigMap entry: model -> ratio."""
+
+    enable: bool = False
+    ratio_model: Dict[str, float] = dataclasses.field(default_factory=dict)
+    default_ratio: float = 1.0
+
+
+def compute_ratio(strategy: CPUNormalizationStrategy,
+                  cpu_model: str) -> float:
+    ratio = strategy.ratio_model.get(cpu_model, strategy.default_ratio)
+    return min(MAX_RATIO, max(MIN_RATIO, float(ratio)))
+
+
+class CPUNormalizationPlugin:
+    """Reconcile the ratio annotation from the node's CPU model (the
+    model arrives through the koordlet nodeinfo collector's NodeCPUInfo;
+    the reference reads it off the NodeResourceTopology annotations)."""
+
+    name = "CPUNormalization"
+
+    def __init__(self, strategy: Optional[CPUNormalizationStrategy] = None):
+        self.strategy = strategy or CPUNormalizationStrategy()
+
+    def reconcile(self, node: api.Node, cpu_model: str) -> bool:
+        """Returns whether the node annotation changed."""
+        anns = node.meta.annotations
+        if not self.strategy.enable:
+            return anns.pop(ANNOTATION_NODE_CPU_NORMALIZATION_RATIO,
+                            None) is not None
+        value = f"{compute_ratio(self.strategy, cpu_model):.2f}"
+        if anns.get(ANNOTATION_NODE_CPU_NORMALIZATION_RATIO) == value:
+            return False
+        anns[ANNOTATION_NODE_CPU_NORMALIZATION_RATIO] = value
+        return True
+
+
+def node_ratio(node: Optional[api.Node]) -> float:
+    """Parse the annotation; 1.0 (no scaling) on absence or bad value."""
+    if node is None:
+        return 1.0
+    raw = node.meta.annotations.get(
+        ANNOTATION_NODE_CPU_NORMALIZATION_RATIO, "")
+    try:
+        ratio = float(raw)
+    except ValueError:
+        return 1.0
+    return ratio if MIN_RATIO <= ratio <= MAX_RATIO else 1.0

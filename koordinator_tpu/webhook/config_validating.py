@@ -13,8 +13,9 @@ from __future__ import annotations
 
 import dataclasses
 import json
-import re
 from typing import Callable, Dict, List, Tuple
+
+from koordinator_tpu.utils.naming import camel_to_snake as _snake
 
 from koordinator_tpu.api import types as api
 from koordinator_tpu.slo_controller.config import (
@@ -43,14 +44,6 @@ _QOS_KNOBS = {"groupIdentity": (-1, 2), "memoryPriority": (0, 12),
               "memoryWmarkRatio": (0, 100), "cpuIdle": (0, 1)}
 
 
-_SNAKE_RE = re.compile(r"(?<=[a-z0-9])(?=[A-Z])|(?<=[A-Z])(?=[A-Z][a-z])")
-
-
-def _snake(key: str) -> str:
-    """cpuEvictBEUsageThresholdPercent -> cpu_evict_be_usage_threshold_
-    percent: acronym runs (BE, CPU) stay one segment — a per-character
-    split would mangle them into b_e."""
-    return _SNAKE_RE.sub("_", key).lower()
 
 
 def _build(cls, data: dict, where: str, errs: List[str]):

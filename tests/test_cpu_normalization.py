@@ -1,0 +1,160 @@
+"""CPU normalization (slo-controller plugin + koordlet hook) and the
+per-node colocation-strategy metadata overrides
+(plugins/cpunormalization/plugin.go, hooks/cpunormalization/,
+sloconfig/colocation_config.go:102-155)."""
+
+import json
+
+import pytest
+
+from koordinator_tpu.api import types as api
+from koordinator_tpu.api.extension import (
+    ANNOTATION_NODE_COLOCATION_STRATEGY,
+    ANNOTATION_NODE_CPU_NORMALIZATION_RATIO,
+    LABEL_CPU_RECLAIM_RATIO,
+    QoSClass,
+    ResourceKind as RK,
+)
+from koordinator_tpu.koordlet.runtimehooks import (
+    CPUNormalizationHook,
+    HookContext,
+    Stage,
+)
+from koordinator_tpu.koordlet.statesinformer import PodMeta, StatesInformer
+from koordinator_tpu.slo_controller.config import (
+    ColocationConfig,
+    ColocationStrategy,
+    ColocationStrategyOverride,
+)
+from koordinator_tpu.slo_controller.cpu_normalization import (
+    CPUNormalizationPlugin,
+    CPUNormalizationStrategy,
+    compute_ratio,
+    node_ratio,
+)
+
+
+# --- plugin ------------------------------------------------------------------
+
+def test_ratio_model_lookup_and_clamp():
+    s = CPUNormalizationStrategy(enable=True,
+                                 ratio_model={"FastChip": 1.5,
+                                              "WarpChip": 9.0,
+                                              "SlowChip": 0.5},
+                                 default_ratio=1.1)
+    assert compute_ratio(s, "FastChip") == 1.5
+    assert compute_ratio(s, "WarpChip") == 5.0   # clamped to max
+    assert compute_ratio(s, "SlowChip") == 1.0   # below basic unsupported
+    assert compute_ratio(s, "Unknown") == pytest.approx(1.1)
+
+
+def test_plugin_annotates_and_clears():
+    node = api.Node(meta=api.ObjectMeta(name="n0"))
+    p = CPUNormalizationPlugin(CPUNormalizationStrategy(
+        enable=True, ratio_model={"FastChip": 1.5}))
+    assert p.reconcile(node, "FastChip")
+    assert node.meta.annotations[
+        ANNOTATION_NODE_CPU_NORMALIZATION_RATIO] == "1.50"
+    assert not p.reconcile(node, "FastChip")  # idempotent
+    # feature off -> annotation cleared
+    p.strategy.enable = False
+    assert p.reconcile(node, "FastChip")
+    assert ANNOTATION_NODE_CPU_NORMALIZATION_RATIO not in \
+        node.meta.annotations
+
+
+def test_node_ratio_parse_guards():
+    n = api.Node(meta=api.ObjectMeta(annotations={
+        ANNOTATION_NODE_CPU_NORMALIZATION_RATIO: "2.00"}))
+    assert node_ratio(n) == 2.0
+    assert node_ratio(None) == 1.0
+    n.meta.annotations[ANNOTATION_NODE_CPU_NORMALIZATION_RATIO] = "bogus"
+    assert node_ratio(n) == 1.0
+    n.meta.annotations[ANNOTATION_NODE_CPU_NORMALIZATION_RATIO] = "99.0"
+    assert node_ratio(n) == 1.0  # outside [1, 5] distrusted
+
+
+# --- hook --------------------------------------------------------------------
+
+def mk_ctx():
+    pod = PodMeta(pod=api.Pod(meta=api.ObjectMeta(uid="p1", name="p1"),
+                              qos_label="BE"))
+    return HookContext(pod=pod, stage=Stage.PRE_CREATE_CONTAINER)
+
+
+def test_hook_scales_quota_down():
+    informer = StatesInformer()
+    informer.set_node(api.Node(meta=api.ObjectMeta(
+        name="n0", annotations={
+            ANNOTATION_NODE_CPU_NORMALIZATION_RATIO: "2.00"})))
+    ctx = mk_ctx()
+    ctx.add_update("cpu.cfs_quota_us", "100001")
+    ctx.add_update("cpu.shares", "1024")       # untouched
+    ctx.add_update("cpu.cfs_quota_us", "-1")   # unlimited untouched
+    CPUNormalizationHook(informer).apply(ctx)
+    values = [(u.resource, u.value) for u in ctx.cgroup_updates]
+    assert values == [("cpu.cfs_quota_us", "50001"),  # ceil(100001/2)
+                      ("cpu.shares", "1024"),
+                      ("cpu.cfs_quota_us", "-1")]
+
+
+def test_hook_noop_without_ratio():
+    informer = StatesInformer()
+    informer.set_node(api.Node(meta=api.ObjectMeta(name="n0")))
+    ctx = mk_ctx()
+    ctx.add_update("cpu.cfs_quota_us", "100000")
+    CPUNormalizationHook(informer).apply(ctx)
+    assert ctx.cgroup_updates[0].value == "100000"
+
+
+# --- node colocation strategy overrides -------------------------------------
+
+def test_strategy_precedence_annotation_and_labels():
+    cfg = ColocationConfig(
+        cluster_strategy=ColocationStrategy(
+            cpu_reclaim_threshold_percent=60.0,
+            memory_reclaim_threshold_percent=65.0),
+        node_overrides=[ColocationStrategyOverride(
+            node_selector={"pool": "batch"},
+            fields={"cpu_reclaim_threshold_percent": 70.0})])
+
+    # selector override only
+    s = cfg.strategy_for({"pool": "batch"})
+    assert s.cpu_reclaim_threshold_percent == 70.0
+
+    # annotation partial wins over the selector override
+    s = cfg.strategy_for(
+        {"pool": "batch"},
+        {ANNOTATION_NODE_COLOCATION_STRATEGY: json.dumps(
+            {"cpuReclaimThresholdPercent": 80.0, "unknownField": 1})})
+    assert s.cpu_reclaim_threshold_percent == 80.0
+
+    # reclaim-ratio label wins over everything
+    s = cfg.strategy_for(
+        {"pool": "batch", LABEL_CPU_RECLAIM_RATIO: "0.9"},
+        {ANNOTATION_NODE_COLOCATION_STRATEGY: json.dumps(
+            {"cpuReclaimThresholdPercent": 80.0})})
+    assert s.cpu_reclaim_threshold_percent == pytest.approx(90.0)
+    assert s.memory_reclaim_threshold_percent == 65.0
+
+    # illegal metadata ignored, never fatal: bad JSON, non-dict JSON,
+    # wrong-typed values, bogus policy strings, out-of-range ratios
+    for labels, anns in (
+            ({LABEL_CPU_RECLAIM_RATIO: "abc"},
+             {ANNOTATION_NODE_COLOCATION_STRATEGY: "{{{"}),
+            ({}, {ANNOTATION_NODE_COLOCATION_STRATEGY: "[1,2]"}),
+            ({}, {ANNOTATION_NODE_COLOCATION_STRATEGY: json.dumps(
+                {"cpuReclaimThresholdPercent": "70"})}),
+            ({}, {ANNOTATION_NODE_COLOCATION_STRATEGY: json.dumps(
+                {"memoryCalculatePolicy": "warp-speed"})}),
+            ({LABEL_CPU_RECLAIM_RATIO: "1.5"}, {})):
+        s = cfg.strategy_for(labels, anns)
+        assert s.cpu_reclaim_threshold_percent == 60.0
+    # a VALID policy string does coerce into the enum
+    from koordinator_tpu.slo_controller.config import CalculatePolicy
+    s = cfg.strategy_for({}, {ANNOTATION_NODE_COLOCATION_STRATEGY:
+                              json.dumps({"memoryCalculatePolicy":
+                                          "request"})})
+    assert s.memory_calculate_policy is CalculatePolicy.REQUEST
+    # the cluster strategy object itself is never mutated
+    assert cfg.cluster_strategy.cpu_reclaim_threshold_percent == 60.0
