@@ -106,12 +106,28 @@ class ManagerProcess:
         self.node_metric_ctl = NodeMetricController()
         self.node_resource_ctl = NodeResourceController()
         self.quota_reconciler = QuotaProfileReconciler(QuotaTopology())
-        self.mutator: Optional[PodMutator] = None  # admission, set by edge
+        # the webhook framework: the edge calls admission.admit(kind, obj)
+        # for every write (pkg/webhook/server.go handler registry); set
+        # `mutator` (below) when colocation profiles arrive
+        from koordinator_tpu.webhook.framework import AdmissionDispatcher
+        self.admission = AdmissionDispatcher(
+            mutator=None, quota_topology=self.quota_reconciler.topology,
+            gate=self.gate)
         self.ticks = 0
         identity = cfg.identity or default_identity()
         self.elector = LeaderElector(
             FileLeaseLock(cfg.lease_file, cfg.lease_duration_seconds),
             identity, cfg.retry_period_seconds, clock=clock)
+
+    @property
+    def mutator(self) -> Optional[PodMutator]:
+        """ONE mutator slot shared with the admission dispatcher —
+        assigning here makes pod admission apply it."""
+        return self.admission.mutator
+
+    @mutator.setter
+    def mutator(self, value: Optional[PodMutator]) -> None:
+        self.admission.mutator = value
 
     # one reconcile pass over everything the manager owns
     def tick(self, now: Optional[float] = None) -> None:

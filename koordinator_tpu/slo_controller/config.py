@@ -98,14 +98,6 @@ class ColocationConfig:
         cluster strategy -> first matching node-selector override ->
         node annotation JSON partial -> reclaim-ratio labels. Illegal
         node metadata is ignored, never fatal (":142-154")."""
-        import json
-
-        from koordinator_tpu.api.extension import (
-            ANNOTATION_NODE_COLOCATION_STRATEGY,
-            LABEL_CPU_RECLAIM_RATIO,
-            LABEL_MEMORY_RECLAIM_RATIO,
-        )
-
         out = self.cluster_strategy
         for ov in self.node_overrides:
             if ov.matches(node_labels):
@@ -147,25 +139,34 @@ class ColocationConfig:
     @staticmethod
     def _coerce(strategy: ColocationStrategy, field: str,
                 value: object) -> Optional[object]:
-        """Annotation values must land with the field's own type — the
-        ConfigMap path coerces through the webhook validator; untyped
+        """Annotation values must land with the field's DECLARED type —
+        the ConfigMap path coerces through the webhook validator; untyped
         node metadata must not sneak a str into arithmetic or a bogus
-        policy into the kernel lowering. None = drop the field."""
-        current = getattr(strategy, field, None)
-        if current is None:
+        policy into the kernel lowering. Dispatching on the declared type
+        (not the current value's runtime type, which a prior int-valued
+        override could have polluted) keeps valid values accepted.
+        None = drop the field."""
+        declared = _STRATEGY_FIELD_TYPES.get(field)
+        if declared is None:
             return None  # unknown field
-        if isinstance(current, CalculatePolicy):
+        if declared == "CalculatePolicy":
             try:
                 return CalculatePolicy(value)
             except ValueError:
                 return None
-        if isinstance(current, bool):
+        if declared == "bool":
             return value if isinstance(value, bool) else None
-        if isinstance(current, float):
+        if declared == "float":
             return (float(value)
                     if isinstance(value, (int, float))
                     and not isinstance(value, bool) else None)
-        return value if type(value) is type(current) else None
+        return value
+
+
+# declared field types (annotation strings under `from __future__ import
+# annotations`) — the authority _coerce dispatches on
+_STRATEGY_FIELD_TYPES: Dict[str, str] = {
+    f.name: str(f.type) for f in dataclasses.fields(ColocationStrategy)}
 
 
 def validate_colocation_config(cfg: ColocationConfig) -> List[str]:

@@ -1,0 +1,182 @@
+"""Admission dispatch framework (pkg/webhook/server.go handler
+registry): kind routing, gate behavior, mutate-then-validate phase
+order, and the quota topology guard."""
+
+import json
+
+import pytest
+
+from koordinator_tpu.api import types as api
+from koordinator_tpu.api.extension import (
+    ANNOTATION_NODE_AMPLIFICATION_RATIOS,
+    ResourceKind as RK,
+)
+from koordinator_tpu.features import new_default_gate
+from koordinator_tpu.webhook import QuotaTopology
+from koordinator_tpu.webhook.framework import AdmissionDispatcher
+from koordinator_tpu.webhook.pod_mutating import PodMutator
+
+
+def mk_dispatcher(**kw):
+    kw.setdefault("quota_topology", QuotaTopology())
+    return AdmissionDispatcher(**kw)
+
+
+def test_framework_gate_disables_everything():
+    gate = new_default_gate()
+    gate.set("WebhookFramework", False)
+    d = mk_dispatcher(gate=gate)
+    # a node with a broken annotation would normally be rejected
+    node = api.Node(meta=api.ObjectMeta(name="n0", annotations={
+        ANNOTATION_NODE_AMPLIFICATION_RATIOS: "not json"}))
+    resp = d.admit("Node", node)
+    assert resp.allowed and not resp.mutated
+
+
+def test_pod_mutate_then_validate():
+    mutator = PodMutator(
+        [api.ClusterColocationProfile(
+            meta=api.ObjectMeta(name="colo"), selector={"app": "spark"},
+            qos_class="BE", priority_class_name="koord-batch")],
+        priority_classes={"koord-batch": 5500})
+    d = mk_dispatcher(mutator=mutator)
+    pod = api.Pod(meta=api.ObjectMeta(name="p", labels={"app": "spark"}),
+                  requests={RK.CPU: 1000.0, RK.MEMORY: 512.0})
+    resp = d.admit("Pod", pod)
+    assert resp.allowed and resp.mutated
+    assert RK.BATCH_CPU in pod.requests  # mutation ran before validation
+
+
+def test_pod_validating_gate_respected():
+    gate = new_default_gate()
+    gate.set("PodValidatingWebhook", False)
+    d = mk_dispatcher(gate=gate)
+    # an invalid pod passes when the validating gate is off
+    bad = api.Pod(meta=api.ObjectMeta(name="p"), qos_label="LSE",
+                  priority=5500)  # LSE + batch priority is invalid
+    assert d.admit("Pod", bad).allowed
+    assert not mk_dispatcher().admit("Pod", bad).allowed
+
+
+def test_node_reject_on_bad_annotation():
+    d = mk_dispatcher()
+    node = api.Node(meta=api.ObjectMeta(name="n0", annotations={
+        ANNOTATION_NODE_AMPLIFICATION_RATIOS: '{"cpu": "abc"}'}),
+        allocatable={RK.CPU: 1000.0})
+    resp = d.admit("Node", node)
+    assert not resp.allowed and resp.errors
+
+
+def test_node_mutates_amplification():
+    d = mk_dispatcher()
+    node = api.Node(meta=api.ObjectMeta(name="n0", annotations={
+        ANNOTATION_NODE_AMPLIFICATION_RATIOS: '{"cpu": 2.0}'}),
+        allocatable={RK.CPU: 1000.0, RK.MEMORY: 1024.0})
+    resp = d.admit("Node", node)
+    assert resp.allowed and resp.mutated
+    assert node.allocatable[RK.CPU] == 2000.0
+
+
+def test_configmap_routing():
+    d = mk_dispatcher()
+    assert d.admit("ConfigMap", {
+        "colocation-config": json.dumps({"enable": True})}).allowed
+    resp = d.admit("ConfigMap", {"no-such-key": "{}"})
+    assert not resp.allowed
+
+
+def test_quota_lifecycle_through_dispatcher():
+    topo = QuotaTopology()
+    d = mk_dispatcher(quota_topology=topo)
+    q = api.ElasticQuota(meta=api.ObjectMeta(name="team-a"),
+                        min={RK.CPU: 1000.0}, max={RK.CPU: 2000.0})
+    assert d.admit("ElasticQuota", q, "Create").allowed
+    assert "team-a" in topo.quotas
+    # duplicate add rejected
+    q2 = api.ElasticQuota(meta=api.ObjectMeta(name="team-a"),
+                         min={RK.CPU: 1.0}, max={RK.CPU: 2.0})
+    assert not d.admit("ElasticQuota", q2, "Create").allowed
+    assert d.admit("ElasticQuota", q, "Delete").allowed
+    assert "team-a" not in topo.quotas
+
+
+def test_unregistered_kind_passes():
+    assert mk_dispatcher().admit("Unknown", object()).allowed
+
+
+def test_annotation_override_after_int_valued_configmap_override():
+    """Declared-type dispatch: a ConfigMap override that left an int in a
+    float field must not make later float annotations get dropped."""
+    from koordinator_tpu.api.extension import (
+        ANNOTATION_NODE_COLOCATION_STRATEGY,
+    )
+    from koordinator_tpu.slo_controller.config import (
+        ColocationConfig,
+        ColocationStrategy,
+        ColocationStrategyOverride,
+    )
+    cfg = ColocationConfig(
+        cluster_strategy=ColocationStrategy(),
+        node_overrides=[ColocationStrategyOverride(
+            node_selector={"pool": "x"},
+            fields={"cpu_reclaim_threshold_percent": 70})])  # int!
+    s = cfg.strategy_for({"pool": "x"}, {
+        ANNOTATION_NODE_COLOCATION_STRATEGY:
+        json.dumps({"cpuReclaimThresholdPercent": 80.0})})
+    assert s.cpu_reclaim_threshold_percent == 80.0
+
+
+def test_quota_mutated_reflects_actual_defaulting():
+    topo = QuotaTopology()
+    d = mk_dispatcher(quota_topology=topo)
+    # a quota needing defaults (parent unset) reports mutated
+    q = api.ElasticQuota(meta=api.ObjectMeta(name="a"),
+                        min={RK.CPU: 1.0}, max={RK.CPU: 2.0})
+    assert d.admit("ElasticQuota", q, "Create").mutated
+    # an update where defaulting changes nothing reports unmutated
+    resp = d.admit("ElasticQuota", q, "Update")
+    assert resp.allowed and not resp.mutated
+
+
+def test_manager_mutator_slot_is_shared(tmp_path):
+    """Assigning proc.mutator must make admission apply it — a second
+    disconnected slot would silently skip profile translation."""
+    from koordinator_tpu.cmd import manager as cmd_manager
+
+    class Src:
+        def nodes(self): return []
+        def node_metrics(self): return {}
+        def pods_by_node(self): return {}
+        def quota_profiles(self): return []
+
+    proc = cmd_manager.ManagerProcess(
+        cmd_manager.ManagerConfig(lease_file=str(tmp_path / "m2.lease")),
+        Src())
+    proc.mutator = PodMutator(
+        [api.ClusterColocationProfile(
+            meta=api.ObjectMeta(name="c"), selector={"app": "spark"},
+            qos_class="BE", priority_class_name="koord-batch")],
+        priority_classes={"koord-batch": 5500})
+    pod = api.Pod(meta=api.ObjectMeta(name="p", labels={"app": "spark"}),
+                  requests={RK.CPU: 1000.0, RK.MEMORY: 512.0})
+    resp = proc.admission.admit("Pod", pod)
+    assert resp.mutated and RK.BATCH_CPU in pod.requests
+
+
+def test_manager_hosts_the_dispatcher(tmp_path):
+    from koordinator_tpu.cmd import manager as cmd_manager
+
+    class Src:
+        def nodes(self): return []
+        def node_metrics(self): return {}
+        def pods_by_node(self): return {}
+        def quota_profiles(self): return []
+
+    proc = cmd_manager.ManagerProcess(
+        cmd_manager.ManagerConfig(lease_file=str(tmp_path / "m.lease")),
+        Src())
+    q = api.ElasticQuota(meta=api.ObjectMeta(name="t"),
+                        min={RK.CPU: 1.0}, max={RK.CPU: 2.0})
+    assert proc.admission.admit("ElasticQuota", q, "Create").allowed
+    # the dispatcher guards the SAME topology the profile reconciler uses
+    assert "t" in proc.quota_reconciler.topology.quotas
