@@ -4,13 +4,17 @@ the metric cache.
 Capability parity with `pkg/koordlet/metricsadvisor/` (SURVEY.md 2.2):
 a registry of periodic collectors (framework/plugin.go) — noderesource
 (/proc/stat + meminfo), podresource (per-pod cgroup cpuacct/memory),
-beresource (BE-tier cgroup totals), sysresource (node minus pods),
-PSI, and performance/CPI (grouped perf counters via the native shim,
-performance_collector_linux.go:85-120).
+beresource (BE-tier cgroup totals), sysresource (node minus pods), PSI,
+performance/CPI (grouped perf counters via the native shim,
+performance_collector_linux.go:85-120), pagecache, kidled cold memory,
+hostapplication, nodestorageinfo (+ disk IO rates), accelerator devices
+(pid->pod attribution), podthrottled, and nodeinfo.
 
 Counter-based rates (CPU) are computed from deltas between ticks, so each
 collector is stateful; `Advisor.collect_once(now)` drives them all — the
-run loop calls it on the collect interval, tests call it directly.
+run loop calls it on the collect interval, tests call it directly — and
+isolates per-collector failures (the reference's per-collector
+goroutines).
 """
 
 from __future__ import annotations

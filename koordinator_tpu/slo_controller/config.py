@@ -114,7 +114,7 @@ class ColocationConfig:
                 fields = {}
                 for k, v in data.items():
                     snake = camel_to_snake(k)
-                    coerced = self._coerce(out, snake, v)
+                    coerced = self._coerce(snake, v)
                     if coerced is not None:
                         fields[snake] = coerced
                 out = out.merged(ColocationStrategyOverride(fields=fields))
@@ -137,8 +137,7 @@ class ColocationConfig:
         return out
 
     @staticmethod
-    def _coerce(strategy: ColocationStrategy, field: str,
-                value: object) -> Optional[object]:
+    def _coerce(field: str, value: object) -> Optional[object]:
         """Annotation values must land with the field's DECLARED type —
         the ConfigMap path coerces through the webhook validator; untyped
         node metadata must not sneak a str into arithmetic or a bogus
@@ -160,7 +159,9 @@ class ColocationConfig:
             return (float(value)
                     if isinstance(value, (int, float))
                     and not isinstance(value, bool) else None)
-        return value
+        # unhandled declared kinds reject rather than admit untyped data —
+        # a future field must get an explicit branch here to be overridable
+        return None
 
 
 # declared field types (annotation strings under `from __future__ import

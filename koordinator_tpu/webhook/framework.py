@@ -63,6 +63,11 @@ class AdmissionDispatcher:
         }.get(kind)
         if handler is None:
             return resp  # unregistered kinds pass through
+        if operation == "Delete" and kind != KIND_ELASTIC_QUOTA:
+            # only the quota guard vets deletion (children/pods checks);
+            # validating a doomed object would let a pre-existing invalid
+            # one become undeletable
+            return resp
         handler(resp, obj, operation, old)
         return resp
 

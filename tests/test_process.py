@@ -230,6 +230,44 @@ def test_koordlet_main_builds_from_flags(tmp_path):
     daemon.tick(now=0.0)
 
 
+def test_runtime_proxy_build_wires_hooks(tmp_path):
+    """cmd/runtime_proxy: flags -> RuntimeProxy over an injected backend
+    and the koordlet hook socket; a sandbox start flows hook adjustments
+    into the backend call."""
+    from koordinator_tpu.cmd import runtime_proxy as cmd_proxy
+    from koordinator_tpu.koordlet.proxyserver import ProxyHookService
+    from koordinator_tpu.koordlet.runtimehooks import default_hook_server
+    from koordinator_tpu.koordlet.statesinformer import StatesInformer
+    from koordinator_tpu.runtimeproxy.server import PodSandboxRequest
+
+    informer = StatesInformer()
+    sock = str(tmp_path / "koordlet.sock")
+    server = ProxyHookService(default_hook_server(informer)).serve(sock)
+    try:
+        calls = []
+
+        class Backend:
+            def run_pod_sandbox(self, req):
+                calls.append(req)
+
+            def __getattr__(self, name):
+                return lambda req: calls.append(req)
+
+        proxy = cmd_proxy.build(
+            ["--runtime-hooks-endpoint", sock,
+             "--hook-failure-policy", "Fail"],
+            backend=Backend())
+        req = PodSandboxRequest(sandbox_id="s1", name="p1",
+                                namespace="default", uid="u1")
+        proxy.run_pod_sandbox(req)
+        assert calls, "backend must receive the forwarded sandbox start"
+    finally:
+        server.close()
+
+    with pytest.raises(SystemExit):
+        cmd_proxy.build([])  # no backend injected
+
+
 def test_trio_end_to_end_graceful_shutdown(tmp_path):
     """Launch manager + descheduler + scheduler together against shared
     fakes; all three come up, do work, and stop cleanly."""

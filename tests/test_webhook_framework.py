@@ -104,6 +104,20 @@ def test_unregistered_kind_passes():
     assert mk_dispatcher().admit("Unknown", object()).allowed
 
 
+def test_delete_skips_validation_for_non_quota_kinds():
+    """A pre-existing invalid object must stay deletable: validation
+    (and mutation) never gate Delete except the quota topology checks."""
+    d = mk_dispatcher()
+    bad_pod = api.Pod(meta=api.ObjectMeta(name="p"), qos_label="LSE",
+                      priority=5500)
+    assert not d.admit("Pod", bad_pod, "Update").allowed
+    resp = d.admit("Pod", bad_pod, "Delete")
+    assert resp.allowed and not resp.mutated and not resp.errors
+    bad_node = api.Node(meta=api.ObjectMeta(name="n", annotations={
+        ANNOTATION_NODE_AMPLIFICATION_RATIOS: "not json"}))
+    assert d.admit("Node", bad_node, "Delete").allowed
+
+
 def test_annotation_override_after_int_valued_configmap_override():
     """Declared-type dispatch: a ConfigMap override that left an int in a
     float field must not make later float annotations get dropped."""
