@@ -231,6 +231,10 @@ class SchedulerService:
             ErrorHandlerDispatcher,
         )
         self.error_dispatcher = ErrorHandlerDispatcher()
+        # version of the last commit THIS service made (read under the
+        # commit lock; `store.version` alone can already reflect another
+        # thread's later commit)
+        self.last_committed_version = 0
         # called with (failed_gang_indices, result) when a batch PROVES
         # strict gangs short of quorum; the gang controller un-assumes
         # their held members through store.forget with the batches it
@@ -241,9 +245,23 @@ class SchedulerService:
         self.last_gang_failed: Optional[np.ndarray] = None
         self.registry.register("scheduler", self.summary)
 
-    def publish(self, snapshot: ClusterSnapshot) -> None:
+    def publish(self, snapshot: ClusterSnapshot) -> int:
+        """Returns the published version, read under the commit lock so a
+        concurrent mutator cannot be misattributed."""
         with self._commit_lock:
             self.store.publish(snapshot)
+            self.last_committed_version = self.store.version
+            return self.last_committed_version
+
+    def ingest(self, delta) -> int:
+        """Apply an O(K) metric delta SERIALIZED with batch commits — a
+        delta landing between a batch's snapshot read and its post-commit
+        publish would be silently overwritten (the same hazard the commit
+        lock exists for; see the lock comment above)."""
+        with self._commit_lock:
+            self.store.ingest(delta)
+            self.last_committed_version = self.store.version
+            return self.last_committed_version
 
     def schedule(self, pods: PodBatch,
                  pod_names: Optional[List[str]] = None,
@@ -262,6 +280,7 @@ class SchedulerService:
                 # (and makes the kernel timer measure device time)
                 assignment = np.asarray(result.assignment)
             self.store.update(lambda _old: result.snapshot)
+            self.last_committed_version = self.store.version
         self.last_elapsed = self.monitor.complete_cycle(token)
         self.metrics.cycle_seconds.observe(self.last_elapsed)
         self.batches += 1

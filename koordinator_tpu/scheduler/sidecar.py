@@ -1,0 +1,147 @@
+"""Scheduler sidecar: the RPC edge in front of SchedulerService.
+
+The BASELINE north-star architecture (SURVEY.md §7 step 10): the
+control-plane process (the reference's Go koord-scheduler) keeps its
+informers/queues and calls this sidecar for the device part — publish
+snapshot / ingest metric delta / schedule batch. Transport is the same
+framed unix-socket RPC the runtime proxy uses (runtimeproxy/rpc.py);
+array payloads are flax msgpack state dicts (language-neutral:
+dtype+shape-tagged, readable from Go with any msgpack library).
+
+Deserialization targets: flax `from_bytes` replaces leaves wholesale,
+so a capacity-1 `zeros_snapshot()` template restores a snapshot of ANY
+static shape — the wire needs no shape negotiation.
+
+Cost model (measured on one v5e chip): a FULL 10k-node snapshot publish
+is ~10 s on the wire — the rare topology-churn path; the steady state is
+O(K) metric deltas (`ingest`) plus ~0.14 s RPC overhead per 2k-pod
+schedule call, against ~0.15 s device time for the batch itself.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Optional, Sequence
+
+import flax.serialization
+import jax.numpy as jnp
+import numpy as np
+
+from koordinator_tpu.runtimeproxy.rpc import RpcClient, RpcServer
+from koordinator_tpu.scheduler import sidecar_pb2 as pb
+from koordinator_tpu.scheduler.frameworkext import SchedulerService
+from koordinator_tpu.snapshot.delta import NodeMetricDelta
+from koordinator_tpu.snapshot.schema import (
+    ClusterSnapshot,
+    PodBatch,
+    zeros_snapshot,
+)
+
+
+def _snapshot_template() -> ClusterSnapshot:
+    # nested structure must match; leaf shapes are irrelevant
+    return zeros_snapshot(num_nodes=1)
+
+
+def _flat_template(cls):
+    """Restore target for a FLAT flax struct (every field an array)."""
+    return cls(**{f.name: jnp.zeros((1,), jnp.float32)
+                  for f in dataclasses.fields(cls)})
+
+
+class SchedulerSidecarServer:
+    """Serves a SchedulerService over the framed-RPC socket."""
+
+    def __init__(self, service: SchedulerService, sock_path: str):
+        self.service = service
+        self._rpc = RpcServer(sock_path, {
+            "PublishSnapshot": (pb.PublishSnapshotRequest, self._publish),
+            "IngestDelta": (pb.IngestDeltaRequest, self._ingest),
+            "Schedule": (pb.ScheduleRequest, self._schedule),
+            "Summary": (pb.SummaryRequest, self._summary),
+        })
+        self.sock_path = sock_path
+
+    def close(self) -> None:
+        self._rpc.close()
+
+    # --- handlers ---------------------------------------------------------
+    def _publish(self, req: pb.PublishSnapshotRequest
+                 ) -> pb.PublishSnapshotResponse:
+        # no explicit device_put: store.publish places the arrays (with
+        # the store's sharding when one is configured)
+        snap = flax.serialization.from_bytes(_snapshot_template(),
+                                             req.snapshot_msgpack)
+        return pb.PublishSnapshotResponse(
+            version=self.service.publish(snap))
+
+    def _ingest(self, req: pb.IngestDeltaRequest) -> pb.IngestDeltaResponse:
+        delta = flax.serialization.from_bytes(_flat_template(NodeMetricDelta),
+                                              req.delta_msgpack)
+        # service.ingest, NOT store.ingest: the RPC server is threaded and
+        # a delta racing a Schedule call must serialize with the commit
+        return pb.IngestDeltaResponse(version=self.service.ingest(delta))
+
+    def _schedule(self, req: pb.ScheduleRequest) -> pb.ScheduleResponse:
+        pods = flax.serialization.from_bytes(_flat_template(PodBatch),
+                                             req.pods_msgpack)
+        result = self.service.schedule(
+            pods, pod_names=list(req.pod_names) or None)
+        return pb.ScheduleResponse(
+            assignment=np.asarray(result.assignment,
+                                  np.int32).tolist(),
+            chosen_score=np.asarray(result.chosen_score,
+                                    np.float32).tolist(),
+            numa_zone=np.asarray(result.numa_zone, np.int32).tolist(),
+            gang_failed=np.asarray(result.gang_failed, bool).tolist(),
+            snapshot_version=self.service.last_committed_version,
+            elapsed_seconds=self.service.last_elapsed)
+
+    def _summary(self, _req: pb.SummaryRequest) -> pb.SummaryResponse:
+        return pb.SummaryResponse(json=json.dumps(self.service.summary()))
+
+
+class SchedulerSidecarClient:
+    """The edge side: typed objects in, numpy out."""
+
+    def __init__(self, sock_path: str, timeout: float = 60.0):
+        self._rpc = RpcClient(sock_path, timeout=timeout)
+
+    def publish(self, snapshot: ClusterSnapshot) -> int:
+        resp = self._rpc.call(
+            "PublishSnapshot",
+            pb.PublishSnapshotRequest(
+                snapshot_msgpack=flax.serialization.to_bytes(snapshot)),
+            pb.PublishSnapshotResponse)
+        return resp.version
+
+    def ingest(self, delta: NodeMetricDelta) -> int:
+        resp = self._rpc.call(
+            "IngestDelta",
+            pb.IngestDeltaRequest(
+                delta_msgpack=flax.serialization.to_bytes(delta)),
+            pb.IngestDeltaResponse)
+        return resp.version
+
+    def schedule(self, pods: PodBatch,
+                 pod_names: Optional[Sequence[str]] = None):
+        resp = self._rpc.call(
+            "Schedule",
+            pb.ScheduleRequest(
+                pods_msgpack=flax.serialization.to_bytes(pods),
+                pod_names=list(pod_names or [])),
+            pb.ScheduleResponse)
+        return {
+            "assignment": np.asarray(resp.assignment, np.int32),
+            "chosen_score": np.asarray(resp.chosen_score, np.float32),
+            "numa_zone": np.asarray(resp.numa_zone, np.int32),
+            "gang_failed": np.asarray(resp.gang_failed, bool),
+            "snapshot_version": resp.snapshot_version,
+            "elapsed_seconds": resp.elapsed_seconds,
+        }
+
+    def summary(self) -> dict:
+        resp = self._rpc.call("Summary", pb.SummaryRequest(),
+                              pb.SummaryResponse)
+        return json.loads(resp.json)

@@ -1,0 +1,112 @@
+"""Scheduler sidecar RPC (SURVEY §7 step 10, the BASELINE north-star
+edge): publish/ingest/schedule over the framed unix socket must match
+in-process scheduling exactly."""
+
+import numpy as np
+import pytest
+
+from koordinator_tpu.api import types as api
+from koordinator_tpu.api.extension import ResourceKind as RK
+from koordinator_tpu.scheduler import core
+from koordinator_tpu.scheduler.frameworkext import SchedulerService
+from koordinator_tpu.scheduler.plugins import loadaware
+from koordinator_tpu.scheduler.sidecar import (
+    SchedulerSidecarClient,
+    SchedulerSidecarServer,
+)
+from koordinator_tpu.snapshot import SnapshotBuilder
+
+NOW = 1e9
+
+
+@pytest.fixture
+def cluster():
+    b = SnapshotBuilder(max_nodes=4)
+    for i in range(4):
+        b.add_node(api.Node(meta=api.ObjectMeta(name=f"n{i}"),
+                            allocatable={RK.CPU: 16000.0,
+                                         RK.MEMORY: 32768.0}))
+        b.set_node_metric(api.NodeMetric(node_name=f"n{i}", update_time=NOW,
+                                         node_usage={RK.CPU: 1000.0}))
+    snap, ctx = b.build(now=NOW)
+    return b, snap, ctx
+
+
+def mk_pods(b, ctx, n=8):
+    pods = [api.Pod(meta=api.ObjectMeta(name=f"p{j}"), priority=9000,
+                    requests={RK.CPU: 1000.0, RK.MEMORY: 512.0})
+            for j in range(n)]
+    return b.build_pod_batch(pods, ctx)
+
+
+def test_schedule_over_socket_matches_local(tmp_path, cluster):
+    b, snap, ctx = cluster
+    batch = mk_pods(b, ctx)
+
+    # local reference run
+    local = core.schedule_batch(snap, batch, loadaware.LoadAwareConfig.make())
+    local_assign = np.asarray(local.assignment)
+
+    service = SchedulerService()
+    server = SchedulerSidecarServer(service, str(tmp_path / "sidecar.sock"))
+    try:
+        client = SchedulerSidecarClient(server.sock_path)
+        v = client.publish(snap)
+        assert v == 1
+        out = client.schedule(batch, pod_names=[f"p{j}" for j in range(8)])
+        np.testing.assert_array_equal(out["assignment"], local_assign)
+        assert out["snapshot_version"] == 2  # post-commit publish
+        assert out["elapsed_seconds"] > 0
+        assert not out["gang_failed"].any()
+
+        # a second batch schedules against the POST-COMMIT snapshot:
+        # capacity consumed by batch 1 is visible
+        out2 = client.schedule(mk_pods(b, ctx))
+        assert (out2["assignment"] >= 0).all()
+        req = np.asarray(service.store.current().nodes.requested)
+        assert req[:, 0].sum() == pytest.approx(16000.0)  # 16 x 1000m
+
+        summary = client.summary()
+        assert summary["batches"] == 2 and summary["podsPlaced"] == 16
+    finally:
+        server.close()
+
+
+def test_delta_ingest_over_socket(tmp_path, cluster):
+    b, snap, ctx = cluster
+    service = SchedulerService()
+    server = SchedulerSidecarServer(service, str(tmp_path / "s.sock"))
+    try:
+        client = SchedulerSidecarClient(server.sock_path)
+        client.publish(snap)
+        # node 0 re-reports heavy usage; ingest the O(K) delta
+        b.set_node_metric(api.NodeMetric(node_name="n0", update_time=NOW,
+                                         node_usage={RK.CPU: 15000.0}))
+        v = client.ingest(b.metric_delta(["n0"], now=NOW, pad_to=4))
+        assert v == 2
+        usage = np.asarray(service.store.current().nodes.usage)
+        assert usage[0, 0] == pytest.approx(15000.0)
+    finally:
+        server.close()
+
+
+def test_wire_preserves_dtypes_and_shapes(tmp_path, cluster):
+    """flax msgpack round-trip: every column of the published snapshot
+    must arrive with identical dtype, shape, and content."""
+    import jax
+
+    b, snap, ctx = cluster
+    service = SchedulerService()
+    server = SchedulerSidecarServer(service, str(tmp_path / "w.sock"))
+    try:
+        SchedulerSidecarClient(server.sock_path).publish(snap)
+        got = service.store.current()
+        sent_leaves = jax.tree_util.tree_leaves(snap)
+        got_leaves = jax.tree_util.tree_leaves(got)
+        assert len(sent_leaves) == len(got_leaves)
+        for s, g in zip(sent_leaves, got_leaves):
+            s, g = np.asarray(s), np.asarray(g)
+            assert s.dtype == g.dtype and s.shape == g.shape
+            np.testing.assert_array_equal(s, g)
+    finally:
+        server.close()
