@@ -32,6 +32,7 @@ from koordinator_tpu.scheduler.frameworkext import (
 @dataclasses.dataclass
 class SchedulerProcessConfig:
     metrics_port: int = 0            # 0 = ephemeral, -1 = disabled
+    sidecar_socket: str = ""         # "" = RPC edge disabled (in-process use)
     lease_file: str = "koord-scheduler.lease"
     enable_leader_election: bool = False
     lease_duration_seconds: float = 15.0
@@ -54,14 +55,39 @@ class SchedulerProcess:
             self.server = ServicesServer(self.service.registry,
                                          self.service.flags,
                                          port=cfg.metrics_port)
-        identity = cfg.identity or default_identity()
-        self.elector = LeaderElector(
-            FileLeaseLock(cfg.lease_file, cfg.lease_duration_seconds),
-            identity, cfg.retry_period_seconds, clock=clock)
+        self.sidecar = None
+        try:
+            identity = cfg.identity or default_identity()
+            self.elector = LeaderElector(
+                FileLeaseLock(cfg.lease_file, cfg.lease_duration_seconds),
+                identity, cfg.retry_period_seconds, clock=clock)
+        except BaseException:
+            # a partially constructed process must not leak the already-
+            # started metrics server (no handle would remain to close it)
+            if self.server is not None:
+                self.server.close()
+            raise
 
     def _serve(self, should_stop: Callable[[], bool]) -> None:
-        while not should_stop():
-            time.sleep(min(0.05, self.cfg.retry_period_seconds))
+        # the north-star RPC edge binds only WHILE LEADING: a standby must
+        # neither serve mutating Publish/Ingest/Schedule calls (split
+        # brain) nor hold the socket (it frees on step-down, letting a hot
+        # standby take over the same path)
+        sidecar = None
+        if self.cfg.sidecar_socket:
+            from koordinator_tpu.scheduler.sidecar import (
+                SchedulerSidecarServer,
+            )
+            sidecar = SchedulerSidecarServer(self.service,
+                                             self.cfg.sidecar_socket)
+        self.sidecar = sidecar
+        try:
+            while not should_stop():
+                time.sleep(min(0.05, self.cfg.retry_period_seconds))
+        finally:
+            if sidecar is not None:
+                sidecar.close()
+            self.sidecar = None
 
     def run(self, stop: Callable[[], bool]) -> None:
         try:
@@ -79,6 +105,7 @@ def build(argv: Optional[Sequence[str]] = None,
     p = argparse.ArgumentParser(prog="koord-scheduler")
     p.add_argument("--feature-gates", default="")
     p.add_argument("--metrics-port", type=int, default=0)
+    p.add_argument("--sidecar-socket", default="")
     p.add_argument("--lease-file", default="koord-scheduler.lease")
     p.add_argument("--enable-leader-election", dest="leader_election",
                    action="store_true", default=False)
@@ -86,6 +113,7 @@ def build(argv: Optional[Sequence[str]] = None,
     args = p.parse_args(argv)
     cfg = SchedulerProcessConfig(
         metrics_port=args.metrics_port,
+        sidecar_socket=args.sidecar_socket,
         lease_file=args.lease_file,
         enable_leader_election=args.leader_election,
         feature_gates=args.feature_gates,

@@ -199,6 +199,48 @@ def test_descheduler_process_cycles(tmp_path):
 
 # --- scheduler + koordlet entry points --------------------------------------
 
+def test_scheduler_process_serves_sidecar(tmp_path):
+    """--sidecar-socket makes the binary serve the RPC edge; a pod batch
+    scheduled over the socket lands assignments."""
+    import numpy as np
+
+    from koordinator_tpu.scheduler.sidecar import SchedulerSidecarClient
+    from koordinator_tpu.snapshot import SnapshotBuilder
+
+    sock = str(tmp_path / "sched.sock")
+    proc = cmd_scheduler.build(
+        ["--metrics-port", "-1", "--sidecar-socket", sock,
+         "--lease-file", str(tmp_path / "s.lease")])
+    stop = threading.Event()
+    t = threading.Thread(target=proc.run, args=(stop.is_set,), daemon=True)
+    t.start()
+    try:
+        b = SnapshotBuilder(max_nodes=2)
+        b.add_node(api.Node(meta=api.ObjectMeta(name="n0"),
+                            allocatable={RK.CPU: 8000.0,
+                                         RK.MEMORY: 16384.0}))
+        b.set_node_metric(api.NodeMetric(node_name="n0", update_time=1e9,
+                                         node_usage={}))
+        snap, ctx = b.build(now=1e9)
+        pod = api.Pod(meta=api.ObjectMeta(name="p"), priority=9000,
+                      requests={RK.CPU: 1000.0, RK.MEMORY: 256.0})
+        # the socket binds once the process serves
+        deadline = time.monotonic() + 10
+        while not __import__("os").path.exists(sock) and \
+                time.monotonic() < deadline:
+            time.sleep(0.01)
+        client = SchedulerSidecarClient(sock, timeout=120.0)
+        client.publish(snap)
+        out = client.schedule(b.build_pod_batch([pod], ctx))
+        assert int(np.asarray(out["assignment"])[0]) == 0
+    finally:
+        stop.set()
+        t.join(timeout=10)
+    # stepping down released the socket
+    import os
+    assert not os.path.exists(sock)
+
+
 def test_scheduler_process_serves_metrics(tmp_path):
     import json
     import urllib.request
