@@ -72,14 +72,22 @@ class SchedulerProcess:
         # the north-star RPC edge binds only WHILE LEADING: a standby must
         # neither serve mutating Publish/Ingest/Schedule calls (split
         # brain) nor hold the socket (it frees on step-down, letting a hot
-        # standby take over the same path)
+        # standby take over the same path). The bind RETRIES while the
+        # deposed leader's socket drains — failover must not crash the
+        # fresh leader.
         sidecar = None
         if self.cfg.sidecar_socket:
+            from koordinator_tpu.runtimeproxy.rpc import RpcError
             from koordinator_tpu.scheduler.sidecar import (
                 SchedulerSidecarServer,
             )
-            sidecar = SchedulerSidecarServer(self.service,
-                                             self.cfg.sidecar_socket)
+            while not should_stop():
+                try:
+                    sidecar = SchedulerSidecarServer(
+                        self.service, self.cfg.sidecar_socket)
+                    break
+                except RpcError:
+                    time.sleep(min(0.05, self.cfg.retry_period_seconds))
         self.sidecar = sidecar
         try:
             while not should_stop():

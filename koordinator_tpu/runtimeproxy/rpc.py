@@ -106,6 +106,8 @@ class RpcServer:
             except FileNotFoundError:
                 pass
         self._server = Server(sock_path, Handler)
+        st = os.stat(sock_path)
+        self._bound_inode = (st.st_dev, st.st_ino)
         self._thread = threading.Thread(target=self._server.serve_forever,
                                         daemon=True)
         self._thread.start()
@@ -113,8 +115,13 @@ class RpcServer:
     def close(self) -> None:
         self._server.shutdown()
         self._server.server_close()
+        # unlink only OUR socket file: a successor may have already
+        # replaced the path (leader failover), and deleting its fresh
+        # bind would leave it serving an unreachable unlinked inode
         try:
-            os.unlink(self.sock_path)
+            st = os.stat(self.sock_path)
+            if (st.st_dev, st.st_ino) == self._bound_inode:
+                os.unlink(self.sock_path)
         except FileNotFoundError:
             pass
 

@@ -231,3 +231,57 @@ def test_rebalance_loop_hot_node_to_migration():
             break
     assert len(ev.evictions) == len(victims)
     assert all(j.phase == "Succeeded" for j in mc.jobs.values())
+
+
+def test_full_loop_agent_to_scheduled_pod(tmp_path):
+    """The complete plane: koordlet measures the REAL (fake-FS) kernel ->
+    NodeMetric -> informer hub -> manager computes batch overcommit ->
+    syncer publishes the device snapshot -> a BE pod schedules onto
+    capacity that exists only because the agent reported low usage."""
+    import time as _time
+
+    from koordinator_tpu.cmd import manager as cmd_manager
+    from koordinator_tpu.scheduler.frameworkext import SchedulerService
+    from koordinator_tpu.snapshot import (
+        ClusterInformerHub,
+        SnapshotStore,
+        SnapshotSyncer,
+    )
+
+    now = _time.time()
+    # 1. the agent samples the kernel and reports a NodeMetric
+    host = FakeHost(str(tmp_path), num_cpus=8, mem_bytes=16 << 30)
+    daemon = Daemon(host, DaemonConfig(report_interval_seconds=10.0))
+    node = api.Node(meta=api.ObjectMeta(name="n0", labels={"pool": "colo"}),
+                    allocatable={RK.CPU: 8000.0, RK.MEMORY: 16384.0})
+    daemon.informer.set_node(node)
+    daemon.tick(now=now)
+    host.advance_cpu(busy_ticks=2000, idle_ticks=6000)  # 2 of 8 cores busy
+    host.set_meminfo(available=12 << 30)
+    nm = daemon.tick(now=now + 15)
+    assert nm is not None and nm.node_usage[RK.CPU] > 0
+
+    # 2. the edge feeds the hub; the manager computes batch capacity
+    hub = ClusterInformerHub()
+    hub.upsert_node(node)
+    nm.update_time = now + 15
+    hub.set_node_metric(nm)
+    mgr = cmd_manager.ManagerProcess(
+        cmd_manager.ManagerConfig(lease_file=str(tmp_path / "m.lease")),
+        hub)
+    mgr.tick(now=now + 15)
+    assert node.allocatable[RK.BATCH_CPU] > 0
+    hub.upsert_node(node)  # batch capacity republished
+
+    # 3. the syncer publishes the device snapshot; a BE pod schedules
+    store = SnapshotStore()
+    syncer = SnapshotSyncer(hub, store, max_nodes=2)
+    assert syncer.sync(now=now + 15) == "full"
+    service = SchedulerService(store=store)
+    be = api.Pod(meta=api.ObjectMeta(name="spark-0"), qos_label="BE",
+                 priority=5500,
+                 requests={RK.BATCH_CPU: 1000.0, RK.BATCH_MEMORY: 512.0})
+    batch = syncer.builder.build_pod_batch([be], syncer.ctx)
+    res = service.schedule(batch, typed_pods=[be])
+    assert int(np.asarray(res.assignment)[0]) == 0, \
+        "BE pod must land on the overcommitted capacity the agent enabled"

@@ -225,9 +225,9 @@ def test_scheduler_process_serves_sidecar(tmp_path):
         pod = api.Pod(meta=api.ObjectMeta(name="p"), priority=9000,
                       requests={RK.CPU: 1000.0, RK.MEMORY: 256.0})
         # the socket binds once the process serves
+        import os
         deadline = time.monotonic() + 10
-        while not __import__("os").path.exists(sock) and \
-                time.monotonic() < deadline:
+        while not os.path.exists(sock) and time.monotonic() < deadline:
             time.sleep(0.01)
         client = SchedulerSidecarClient(sock, timeout=120.0)
         client.publish(snap)
