@@ -87,6 +87,11 @@ class SchedulerProcess:
                         self.service, self.cfg.sidecar_socket)
                     break
                 except RpcError:
+                    if not self.cfg.enable_leader_election:
+                        # no deposed leader will ever drain the socket:
+                        # a live holder means misconfiguration — fail
+                        # fast rather than silently spinning
+                        raise
                     time.sleep(min(0.05, self.cfg.retry_period_seconds))
         self.sidecar = sidecar
         try:

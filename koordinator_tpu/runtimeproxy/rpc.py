@@ -106,24 +106,22 @@ class RpcServer:
             except FileNotFoundError:
                 pass
         self._server = Server(sock_path, Handler)
-        st = os.stat(sock_path)
-        self._bound_inode = (st.st_dev, st.st_ino)
         self._thread = threading.Thread(target=self._server.serve_forever,
                                         daemon=True)
         self._thread.start()
 
     def close(self) -> None:
         self._server.shutdown()
-        self._server.server_close()
-        # unlink only OUR socket file: a successor may have already
-        # replaced the path (leader failover), and deleting its fresh
-        # bind would leave it serving an unreachable unlinked inode
+        # unlink BETWEEN shutdown and server_close: the listening fd is
+        # still open, so a successor's liveness probe still connects and
+        # cannot be mid-replacement of the path — the file is provably
+        # still ours, and the successor's later fresh bind is never
+        # deleted out from under it
         try:
-            st = os.stat(self.sock_path)
-            if (st.st_dev, st.st_ino) == self._bound_inode:
-                os.unlink(self.sock_path)
+            os.unlink(self.sock_path)
         except FileNotFoundError:
             pass
+        self._server.server_close()
 
 
 class RpcClient:

@@ -1,0 +1,122 @@
+"""Property-based invariant sweep: randomized clusters and pod batches
+through the full device program, asserting the conservation laws that
+must hold for EVERY seed (the batched analogue of the reference's
+sequential-scheduler guarantees). The shapes stay constant so all seeds
+share one compiled program."""
+
+import numpy as np
+import pytest
+
+from koordinator_tpu.scheduler import core
+from koordinator_tpu.scheduler.plugins import loadaware
+from koordinator_tpu.utils import synthetic
+
+NUM_NODES = 64
+NUM_PODS = 200
+NUM_QUOTAS = 8
+NUM_GANGS = 8
+
+CFG = loadaware.LoadAwareConfig.make()
+
+
+def run(seed):
+    snap = synthetic.synthetic_cluster(
+        NUM_NODES, num_quotas=NUM_QUOTAS, num_gangs=NUM_GANGS,
+        gang_min_member=4, seed=seed, gpu_node_frac=0.25, gpus_per_node=4)
+    pods = synthetic.synthetic_pods(
+        NUM_PODS, seed=seed + 1000, num_quotas=NUM_QUOTAS,
+        num_gangs=NUM_GANGS, gang_min_member=4, gpu_pod_frac=0.1)
+    res = core.schedule_batch(snap, pods, CFG, num_rounds=3, k_choices=8)
+    return snap, pods, res
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_scheduling_invariants(seed):
+    snap, pods, res = run(seed)
+    assign = np.asarray(res.assignment)
+    valid = np.asarray(pods.valid)
+    requests = np.asarray(pods.requests)
+    n_nodes = np.asarray(snap.nodes.allocatable).shape[0]
+
+    # 1. assignments are in range and only for valid pods
+    placed = assign >= 0
+    assert (assign[~valid] == -1).all(), "padding rows must stay unplaced"
+    assert (assign[placed] < n_nodes).all()
+
+    # 2. node conservation: post-commit requested == pre + sum of placed
+    #    pods' requests, and never exceeds allocatable on the fit dims
+    before = np.asarray(snap.nodes.requested)
+    after = np.asarray(res.snapshot.nodes.requested)
+    res_slot = np.asarray(res.res_slot)
+    expect = before.copy()
+    for i in np.where(placed & valid)[0]:
+        if res_slot[i] >= 0:
+            continue  # consumers draw from the reservation's hold
+        expect[assign[i]] += requests[i]
+    np.testing.assert_allclose(after, expect, rtol=1e-5, atol=1e-2)
+    alloc = np.asarray(res.snapshot.nodes.allocatable)
+    for d in range(4):
+        over = after[:, d] - alloc[:, d]
+        assert (over <= 1e-2).all(), \
+            f"seed {seed}: dim {d} overcommitted by {over.max()}"
+
+    # 3. quota conservation: used grows by exactly the placed requests of
+    #    each quota's pods (and their ancestors), and never exceeds max
+    used = np.asarray(res.snapshot.quotas.used)
+    qmax = np.asarray(res.snapshot.quotas.max)
+    assert (used <= qmax + 1e-2).all(), f"seed {seed}: quota max violated"
+
+    # 4. strict gang all-or-nothing relative to assumed state: each gang
+    #    either reaches quorum (assumed) or placed nothing this batch
+    gang_id = np.asarray(pods.gang_id)
+    assumed0 = np.asarray(snap.gangs.assumed)
+    assumed1 = np.asarray(res.snapshot.gangs.assumed)
+    min_member = np.asarray(snap.gangs.min_member)
+    strict = np.asarray(snap.gangs.strict)
+    member_count = np.asarray(snap.gangs.member_count)
+    gang_failed = np.asarray(res.gang_failed)
+    for g in range(NUM_GANGS):
+        members = (gang_id == g) & valid
+        if not members.any():
+            continue
+        placed_g = int((placed & members).sum())
+        attempted = int(members.sum())
+        outstanding = max(0, int(member_count[g]) - int(assumed0[g])
+                          - attempted)
+        total = int(assumed0[g]) + placed_g
+        if strict[g] and outstanding == 0 and total < int(min_member[g]):
+            assert placed_g == 0, \
+                f"seed {seed}: gang {g} kept a partial placement"
+            assert gang_failed[g]
+        assert assumed1[g] == int(assumed0[g]) + placed_g
+
+    # 5. NUMA: single-NUMA pods that placed on a zone never drive a
+    #    zone's free below zero
+    numa_free = np.asarray(res.snapshot.nodes.numa_free)
+    assert (numa_free >= -1e-2).all()
+
+    # 6. device instances: fractional sharing is legal, but no instance
+    #    pool goes negative and totals bound every free column
+    gpu_free = np.asarray(res.snapshot.devices.gpu_free)
+    gpu_total = np.asarray(res.snapshot.devices.gpu_total)
+    assert (gpu_free >= -1e-2).all(), f"seed {seed}: GPU pool negative"
+    assert (gpu_free <= gpu_total[:, None, :] + 1e-2).all(), \
+        f"seed {seed}: GPU free above capacity"
+    aux_free = np.asarray(res.snapshot.devices.aux_free)
+    assert (aux_free >= -1e-2).all() and (aux_free <= 100.0 + 1e-2).all()
+
+
+def test_resubmit_carries_state():
+    """Scheduling the same batch twice against the carried snapshot must
+    keep every invariant — the second pass sees less capacity."""
+    snap, pods, res1 = run(99)
+    res2 = core.schedule_batch(res1.snapshot, pods, CFG, num_rounds=3,
+                               k_choices=8)
+    a1 = np.asarray(res1.assignment)
+    a2 = np.asarray(res2.assignment)
+    alloc = np.asarray(res2.snapshot.nodes.allocatable)
+    after = np.asarray(res2.snapshot.nodes.requested)
+    for d in range(4):
+        assert (after[:, d] - alloc[:, d] <= 1e-2).all()
+    # capacity consumed by round 1 bounds round 2
+    assert int((a2 >= 0).sum()) <= int((a1 >= 0).sum())
