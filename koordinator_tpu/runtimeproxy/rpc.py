@@ -106,22 +106,35 @@ class RpcServer:
             except FileNotFoundError:
                 pass
         self._server = Server(sock_path, Handler)
+        try:
+            st = os.stat(sock_path)
+            self._bound_inode = (st.st_dev, st.st_ino)
+        except OSError:
+            self._bound_inode = None  # raced away: never unlink blindly
         self._thread = threading.Thread(target=self._server.serve_forever,
                                         daemon=True)
         self._thread.start()
 
     def close(self) -> None:
         self._server.shutdown()
-        # unlink BETWEEN shutdown and server_close: the listening fd is
-        # still open, so a successor's liveness probe still connects and
-        # cannot be mid-replacement of the path — the file is provably
-        # still ours, and the successor's later fresh bind is never
-        # deleted out from under it
+        # two defenses against deleting a successor's fresh socket during
+        # leader handoff: (1) unlink BETWEEN shutdown and server_close —
+        # the listening fd still answers the successor's liveness probe in
+        # the common case, so the path is still ours; (2) the inode guard
+        # covers the probe's failure modes (a full accept backlog makes a
+        # live socket probe as dead), where the successor may already have
+        # replaced the path. server_close always runs — the listening fd
+        # must never leak to an unlink error.
         try:
-            os.unlink(self.sock_path)
-        except FileNotFoundError:
-            pass
-        self._server.server_close()
+            try:
+                st = os.stat(self.sock_path)
+                if self._bound_inode is not None and \
+                        (st.st_dev, st.st_ino) == self._bound_inode:
+                    os.unlink(self.sock_path)
+            except OSError:
+                pass
+        finally:
+            self._server.server_close()
 
 
 class RpcClient:

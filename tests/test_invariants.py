@@ -60,11 +60,23 @@ def test_scheduling_invariants(seed):
         assert (over <= 1e-2).all(), \
             f"seed {seed}: dim {d} overcommitted by {over.max()}"
 
-    # 3. quota conservation: used grows by exactly the placed requests of
-    #    each quota's pods (and their ancestors), and never exceeds max
+    # 3. quota conservation: used grows by EXACTLY the placed requests of
+    #    each quota's pods, propagated to their ancestors, and never
+    #    exceeds max
+    used0 = np.asarray(snap.quotas.used)
     used = np.asarray(res.snapshot.quotas.used)
     qmax = np.asarray(res.snapshot.quotas.max)
     assert (used <= qmax + 1e-2).all(), f"seed {seed}: quota max violated"
+    anc = np.asarray(snap.quotas.depth_ancestor)
+    quota_id = np.asarray(pods.quota_id)
+    expect_used = used0.copy()
+    for i in np.where(placed & valid & (quota_id >= 0))[0]:
+        for d in range(anc.shape[1]):
+            a = anc[quota_id[i], d]
+            if a >= 0:
+                expect_used[a] += requests[i]
+    np.testing.assert_allclose(used, expect_used, rtol=1e-5, atol=1e-2,
+                               err_msg=f"seed {seed}: quota accounting")
 
     # 4. strict gang all-or-nothing relative to assumed state: each gang
     #    either reaches quorum (assumed) or placed nothing this batch
@@ -104,6 +116,42 @@ def test_scheduling_invariants(seed):
         f"seed {seed}: GPU free above capacity"
     aux_free = np.asarray(res.snapshot.devices.aux_free)
     assert (aux_free >= -1e-2).all() and (aux_free <= 100.0 + 1e-2).all()
+
+
+def test_invariants_hold_on_sharded_mesh():
+    """The same conservation laws over the 8-virtual-device mesh: the
+    node axis shards over ICI and the collectives must not change any
+    accounting."""
+    import jax
+
+    from koordinator_tpu.parallel import mesh as meshlib
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs the 8-device virtual CPU mesh")
+    snap = synthetic.synthetic_cluster(
+        NUM_NODES, num_quotas=NUM_QUOTAS, num_gangs=NUM_GANGS,
+        gang_min_member=4, seed=3)
+    pods = synthetic.synthetic_pods(
+        NUM_PODS, seed=1003, num_quotas=NUM_QUOTAS, num_gangs=NUM_GANGS,
+        gang_min_member=4)
+    mesh = meshlib.make_mesh(jax.devices())
+    sharded = meshlib.shard_snapshot(snap, mesh)
+    with mesh:
+        res = core.schedule_batch(sharded, pods, CFG, num_rounds=3,
+                                  k_choices=8)
+    # identical program on one device must agree on the accounting sums
+    res1 = core.schedule_batch(snap, pods, CFG, num_rounds=3, k_choices=8)
+    a_mesh = np.asarray(res.assignment)
+    a_one = np.asarray(res1.assignment)
+    assert int((a_mesh >= 0).sum()) == int((a_one >= 0).sum())
+    np.testing.assert_allclose(
+        np.asarray(res.snapshot.nodes.requested).sum(axis=0),
+        np.asarray(res1.snapshot.nodes.requested).sum(axis=0),
+        rtol=1e-5, atol=1e-2)
+    alloc = np.asarray(res.snapshot.nodes.allocatable)
+    after = np.asarray(res.snapshot.nodes.requested)
+    for d in range(4):
+        assert (after[:, d] - alloc[:, d] <= 1e-2).all()
 
 
 def test_resubmit_carries_state():
