@@ -263,6 +263,85 @@ def parse_extended_resource_spec(annotations: Mapping) -> tuple:
     return pick(spec.get("requests")), pick(spec.get("limits"))
 
 
+# --- gang annotation protocol (apis/extension/coscheduling.go:26-61) -------
+ANNOTATION_GANG_PREFIX = "gang.scheduling.koordinator.sh"
+ANNOTATION_GANG_NAME = ANNOTATION_GANG_PREFIX + "/name"
+ANNOTATION_GANG_MIN_NUM = ANNOTATION_GANG_PREFIX + "/min-available"
+ANNOTATION_GANG_TOTAL_NUM = ANNOTATION_GANG_PREFIX + "/total-number"
+ANNOTATION_GANG_MODE = ANNOTATION_GANG_PREFIX + "/mode"
+ANNOTATION_GANG_WAIT_TIME = ANNOTATION_GANG_PREFIX + "/waiting-time"
+ANNOTATION_GANG_GROUPS = ANNOTATION_GANG_PREFIX + "/groups"
+# written BY the scheduler when a gang group's Permit wait expires
+ANNOTATION_GANG_TIMEOUT = ANNOTATION_GANG_PREFIX + "/timeout"
+ANNOTATION_GANG_MATCH_POLICY = ANNOTATION_GANG_PREFIX + "/match-policy"
+
+GANG_MODE_STRICT = "Strict"
+GANG_MODE_NON_STRICT = "NonStrict"
+GANG_MATCH_ONLY_WAITING = "only-waiting"
+GANG_MATCH_WAITING_AND_RUNNING = "waiting-and-running"
+GANG_MATCH_ONCE_SATISFIED = "once-satisfied"
+_GANG_MATCH_POLICIES = (GANG_MATCH_ONLY_WAITING,
+                        GANG_MATCH_WAITING_AND_RUNNING,
+                        GANG_MATCH_ONCE_SATISFIED)
+
+
+def parse_gang_annotations(annotations: Mapping) -> Optional[dict]:
+    """Pod annotations -> gang spec dict, or None when the pod declares no
+    gang. Lenient exactly like TryInitByPodConfig (core/gang.go:120-175):
+    illegal mode/match-policy/wait-time fall back to defaults with the
+    value dropped, min-available <= 0 or unparseable clamps to 1
+    (deviation: the reference leaves such a gang uninitialized and
+    rejects its pods in PreFilter; clamping keeps them schedulable as a
+    trivially-satisfied gang), total-number below min is raised to min.
+    The gang's own name is always part of its group. The `pod-group`
+    label (sigs convention) also names a gang when the annotation is
+    absent."""
+    name = annotations.get(ANNOTATION_GANG_NAME, "") or \
+        annotations.get(LABEL_PODGROUP, "")
+    if not name:
+        return None
+    try:
+        min_num = int(annotations.get(ANNOTATION_GANG_MIN_NUM, "1"))
+    except ValueError:
+        min_num = 1
+    if min_num <= 0:
+        min_num = 1
+    try:
+        total = int(annotations.get(ANNOTATION_GANG_TOTAL_NUM, str(min_num)))
+    except ValueError:
+        total = min_num
+    total = max(total, min_num)
+    mode = annotations.get(ANNOTATION_GANG_MODE, GANG_MODE_STRICT)
+    if mode not in (GANG_MODE_STRICT, GANG_MODE_NON_STRICT):
+        mode = GANG_MODE_STRICT
+    policy = annotations.get(ANNOTATION_GANG_MATCH_POLICY,
+                             GANG_MATCH_ONCE_SATISFIED)
+    if policy not in _GANG_MATCH_POLICIES:
+        policy = GANG_MATCH_ONCE_SATISFIED
+    try:
+        wait = float(annotations.get(ANNOTATION_GANG_WAIT_TIME, "0"))
+    except ValueError:
+        wait = 0.0
+    groups: list = []
+    raw_groups = annotations.get(ANNOTATION_GANG_GROUPS, "")
+    if raw_groups:
+        import json as _json
+        try:
+            parsed = _json.loads(raw_groups)
+            if isinstance(parsed, list):
+                groups = [str(x) for x in parsed]
+        except ValueError:
+            groups = []
+    if groups and name not in groups:
+        # a gang is always a member of its own group — otherwise group
+        # rejection/expiry could never release its waiting members
+        groups.insert(0, name)
+    return {"name": name, "min_member": min_num, "total_member": total,
+            "mode": mode, "match_policy": policy,
+            "wait_time_seconds": wait if wait > 0 else None,
+            "groups": groups or [name]}
+
+
 def translate_resource_by_priority(kind: ResourceKind,
                                    priority_class: PriorityClass) -> ResourceKind:
     """Map cpu/memory to the priority tier's extended resource.

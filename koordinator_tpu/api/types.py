@@ -386,6 +386,10 @@ class PodGroup:
     min_member: int = 1
     total_member: int = 0
     mode: str = "Strict"           # Strict | NonStrict
+    # How minMember satisfaction is counted (gang.go:68 GangMatchPolicy):
+    # once-satisfied (default; latches forever), waiting-and-running
+    # (waiting-at-Permit + bound), only-waiting (waiting-at-Permit only)
+    match_policy: str = "once-satisfied"
     wait_time_seconds: float = 600.0
     phase: str = "Pending"
 

@@ -19,6 +19,7 @@ import socket
 import socketserver
 import struct
 import threading
+import time
 from typing import Callable, Dict, Optional, Tuple, Type
 
 
@@ -138,15 +139,39 @@ class RpcServer:
 
 
 class RpcClient:
-    def __init__(self, sock_path: str, timeout: float = 5.0):
+    def __init__(self, sock_path: str, timeout: float = 5.0,
+                 connect_retry_seconds: float = 2.0):
         self.sock_path = sock_path
         self.timeout = timeout
+        self.connect_retry_seconds = connect_retry_seconds
+
+    def _connect(self) -> socket.socket:
+        """connect() with a short bounded retry on ECONNREFUSED/ENOENT: a
+        server mid-construction has bound the path but not yet listened,
+        and a leadership handoff leaves a gap between the old socket
+        draining and the successor binding. Connecting is idempotent —
+        nothing was sent yet — so retrying is always safe. A FRESH socket
+        per attempt: POSIX leaves a socket in unspecified state after a
+        failed connect()."""
+        deadline = time.monotonic() + self.connect_retry_seconds
+        while True:
+            sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+            sock.settimeout(self.timeout)
+            try:
+                sock.connect(self.sock_path)
+                return sock
+            except (ConnectionRefusedError, FileNotFoundError):
+                sock.close()
+                if time.monotonic() >= deadline:
+                    raise
+                time.sleep(0.02)
+            except BaseException:
+                sock.close()
+                raise
 
     def call(self, method: str, request, response_cls: Type):
-        sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
-        sock.settimeout(self.timeout)
+        sock = self._connect()
         try:
-            sock.connect(self.sock_path)
             name = method.encode()
             _write_frame(sock, bytes([len(name)]) + name
                          + request.SerializeToString())

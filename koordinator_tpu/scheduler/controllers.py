@@ -24,6 +24,7 @@ from __future__ import annotations
 import dataclasses
 from typing import Dict, List, Optional, Sequence
 
+from koordinator_tpu.api import extension
 from koordinator_tpu.api import types as api
 
 GC_DURATION_SECONDS = 24 * 3600.0  # terminal reservations kept for a day
@@ -77,16 +78,31 @@ class ReservationController:
 
 @dataclasses.dataclass
 class GangRecord:
-    """One gang's host state (core/gang.go:43-99)."""
+    """One gang's host state (core/gang.go:43-99).
+
+    `assumed` holds every member the scheduler placed (waiting at Permit
+    OR already bound); `bound` is the subset past Bind. The match policy
+    decides which of those counts toward minMember satisfaction
+    (core/core.go:157-174 IsGangMinSatisfied):
+    - only-waiting: only members still waiting at the Permit barrier
+    - waiting-and-running: every assumed member
+    - once-satisfied (default): every assumed member, and satisfaction
+      LATCHES — once reached, the gang stays satisfied forever even if
+      members terminate (gang.go:59-62 OnceResourceSatisfied)
+    """
 
     name: str
     min_member: int = 1
     total_member: int = 0
     mode: str = "Strict"          # Strict | NonStrict
+    match_policy: str = "once-satisfied"
     wait_time_seconds: float = 600.0
+    gang_group: tuple = ()        # gangs bundled for bind (gang.go:169-171)
     from_cr: bool = False         # PodGroup CR is authoritative for spec
     members: set = dataclasses.field(default_factory=set)
     assumed: set = dataclasses.field(default_factory=set)
+    bound: set = dataclasses.field(default_factory=set)
+    once_satisfied: bool = False
     first_assumed_at: Optional[float] = None
     timeout_count: int = 0
 
@@ -96,7 +112,11 @@ class GangRecord:
 
     @property
     def satisfied(self) -> bool:
-        return len(self.assumed) >= self.min_member
+        if self.match_policy == "only-waiting":
+            return len(self.assumed - self.bound) >= self.min_member
+        if self.match_policy == "waiting-and-running":
+            return len(self.assumed) >= self.min_member
+        return self.once_satisfied or len(self.assumed) >= self.min_member
 
 
 class GangDirectory:
@@ -115,20 +135,38 @@ class GangDirectory:
         g.from_cr = True
         g.min_member = pg.min_member
         g.mode = pg.mode
+        g.match_policy = pg.match_policy
         g.wait_time_seconds = pg.wait_time_seconds or self.default_wait_time
+        if not g.gang_group:
+            g.gang_group = (pg.meta.name,)
         return g
 
     def add_pod(self, gang_name: str, pod_uid: str,
-                min_member: Optional[int] = None) -> GangRecord:
+                min_member: Optional[int] = None,
+                annotations: Optional[dict] = None) -> GangRecord:
         """Pods may declare gangs by annotation without a PodGroup CR
         (gang_cache.go onPodAdd creates the gang lazily); a CR-backed
-        gang's spec is authoritative — pod annotations never override it."""
+        gang's spec is authoritative — pod annotations never override it.
+        `annotations` is the raw pod annotation map; the full gang spec
+        (mode/match-policy/wait-time/groups) is parsed from it through
+        extension.parse_gang_annotations (TryInitByPodConfig)."""
         g = self.gangs.get(gang_name)
         if g is None:
             g = self.gangs[gang_name] = GangRecord(
-                name=gang_name, wait_time_seconds=self.default_wait_time)
-        if min_member is not None and not g.from_cr:
-            g.min_member = min_member
+                name=gang_name, wait_time_seconds=self.default_wait_time,
+                gang_group=(gang_name,))
+        if not g.from_cr:
+            if annotations is not None:
+                spec = extension.parse_gang_annotations(annotations)
+                if spec is not None and spec["name"] == gang_name:
+                    g.min_member = spec["min_member"]
+                    g.mode = spec["mode"]
+                    g.match_policy = spec["match_policy"]
+                    if spec["wait_time_seconds"]:
+                        g.wait_time_seconds = spec["wait_time_seconds"]
+                    g.gang_group = tuple(spec["groups"])
+            if min_member is not None:
+                g.min_member = min_member
         g.members.add(pod_uid)
         g.total_member = len(g.members)
         return g
@@ -139,6 +177,9 @@ class GangDirectory:
             return
         g.members.discard(pod_uid)
         g.assumed.discard(pod_uid)
+        g.bound.discard(pod_uid)
+        if g.assumed == g.bound:
+            g.first_assumed_at = None  # nobody waiting: no pending timeout
         g.total_member = len(g.members)
         # annotation-created gangs vanish with their last member; a
         # CR-backed record keeps its spec until the CR is deleted
@@ -158,23 +199,66 @@ class GangDirectory:
         g.assumed.add(pod_uid)
         if g.first_assumed_at is None:
             g.first_assumed_at = now
+        if len(g.assumed) >= g.min_member:
+            g.once_satisfied = True  # gang.go:62 latch (setResourceSatisfied)
         if g.satisfied:
             g.first_assumed_at = None  # barrier passed; no timeout pending
+
+    def mark_bound(self, gang_name: str, pod_uid: str) -> None:
+        """Bind moved the member past the Permit barrier: for the
+        only-waiting match policy it stops counting toward minMember."""
+        g = self.gangs.get(gang_name)
+        if g is None or pod_uid not in g.assumed:
+            return
+        g.bound.add(pod_uid)
+        if g.assumed == g.bound:
+            g.first_assumed_at = None  # nobody waiting: no pending timeout
+
+    def group_satisfied(self, gang_name: str) -> bool:
+        """A gang goes to bind only when EVERY gang in its group is
+        satisfied (AnnotationGangGroups contract; Permit waits otherwise).
+        Unknown group members count as unsatisfied — the group cannot
+        complete until they register."""
+        g = self.gangs.get(gang_name)
+        if g is None:
+            return False
+        for name in (g.gang_group or (gang_name,)):
+            other = self.gangs.get(name)
+            if other is None or not other.satisfied:
+                return False
+        return True
 
     def expire_waits(self, now: float) -> List[str]:
         """The Permit WaitTime barrier: gangs waiting past wait_time get
         their assumed members released (core.go:311-341 rejection of
-        waiting pods). Returns the timed-out gang names; the caller
-        unbinds/requeues those pods."""
-        timed_out = []
-        for g in self.gangs.values():
+        waiting pods), at GANG GROUP granularity — rejectGangGroupById
+        releases every sibling gang's waiting members too, so one starved
+        gang cannot strand a half-assumed group. Returns the timed-out
+        gang names (including siblings released by group rejection); the
+        caller unbinds/requeues those pods."""
+        timed_out: List[str] = []
+        released = set()
+        for g in list(self.gangs.values()):
             if g.first_assumed_at is None or g.satisfied:
                 continue
             if now - g.first_assumed_at > g.wait_time_seconds:
-                g.assumed.clear()
-                g.first_assumed_at = None
-                g.timeout_count += 1
-                timed_out.append(g.name)
+                for name in (g.gang_group or (g.name,)):
+                    sib = self.gangs.get(name)
+                    if sib is None or name in released:
+                        continue
+                    # any timer is dead after a group rejection, whether
+                    # or not this sibling had waiters
+                    sib.first_assumed_at = None
+                    # bound members are past Permit; only waiting ones are
+                    # rejected — for EVERY gang in the group, satisfied or
+                    # not (rejectGangGroupById iterates all waiting pods
+                    # whose gang is in the group, core.go:362-381)
+                    if sib.assumed == sib.bound:
+                        continue  # nothing waiting to reject
+                    sib.assumed = set(sib.bound)
+                    sib.timeout_count += 1
+                    released.add(name)
+                    timed_out.append(name)
         return timed_out
 
     # -- snapshot feed -------------------------------------------------------
@@ -186,8 +270,17 @@ class GangDirectory:
                              min_member=g.min_member,
                              total_member=g.total_member,
                              mode=g.mode,
+                             match_policy=g.match_policy,
                              wait_time_seconds=g.wait_time_seconds)
                 for g in self.gangs.values()]
+
+    def feed_builder(self, builder) -> None:
+        """Feed every gang into a SnapshotBuilder with its assumed count
+        and match-policy satisfied latch (what the device gates read)."""
+        for pg in self.to_pod_groups():
+            g = self.gangs[pg.meta.name]
+            builder.add_gang(pg, assumed=len(g.assumed),
+                             satisfied=g.satisfied)
 
     def assumed_count(self, gang_name: str) -> int:
         g = self.gangs.get(gang_name)
@@ -198,5 +291,9 @@ class GangDirectory:
         return {g.name: {"minMember": g.min_member,
                          "members": len(g.members),
                          "assumed": len(g.assumed),
+                         "bound": len(g.bound),
+                         "matchPolicy": g.match_policy,
+                         "satisfied": g.satisfied,
+                         "gangGroup": list(g.gang_group or (g.name,)),
                          "timeouts": g.timeout_count}
                 for g in self.gangs.values()}

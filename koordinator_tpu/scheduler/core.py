@@ -150,9 +150,12 @@ def schedule_batch(snap: ClusterSnapshot, pods: PodBatch,
     sel = jnp.maximum(pods.selector_id, 0)
     sel_ok = (pods.selector_id[:, None] < 0) | \
         pods.selector_match[sel][:, nodes0.label_group]          # [P, N]
-    # gang quorum (PreFilter, coscheduling core/core.go:220-274)
+    # gang quorum (PreFilter, coscheduling core/core.go:220-274); a
+    # match-policy-satisfied gang short-circuits the quorum check — its
+    # members schedule individually (core.go:236 OnceSatisfied fast path)
     gid = jnp.maximum(pods.gang_id, 0)
-    gang_quorum = (gangs0.member_count >= gangs0.min_member) & gangs0.valid
+    gang_quorum = ((gangs0.member_count >= gangs0.min_member)
+                   | gangs0.satisfied) & gangs0.valid
     gang_ok = (pods.gang_id < 0) | gang_quorum[gid]              # [P]
 
     quota_id = jnp.maximum(pods.quota_id, 0)
@@ -628,7 +631,9 @@ def schedule_batch(snap: ClusterSnapshot, pods: PodBatch,
     outstanding = jnp.maximum(
         gangs0.member_count - gangs0.assumed - attempted, 0)
     gang_total = gangs0.assumed + gang_placed
-    gang_fail = (gangs0.valid & gangs0.strict
+    # satisfied gangs are never group-rejected (core.go:286 PostFilter skips
+    # the strict-mode gang rejection once the match policy latched)
+    gang_fail = (gangs0.valid & gangs0.strict & ~gangs0.satisfied
                  & (gang_total < gangs0.min_member)
                  & (outstanding == 0))
     revoke = (placed >= 0) & (pods.gang_id >= 0) & gang_fail[gid]

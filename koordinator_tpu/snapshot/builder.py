@@ -207,6 +207,7 @@ class SnapshotBuilder:
         self.gangs: List[PodGroup] = []
         self.gang_index: Dict[str, int] = {}
         self.gang_assumed: Dict[str, int] = {}
+        self.gang_satisfied: Dict[str, bool] = {}
         self.reservations: List[Reservation] = []
         self.devices: Dict[str, Device] = {}
 
@@ -242,13 +243,17 @@ class SnapshotBuilder:
         self.quota_index[quota.meta.name] = idx
         return idx
 
-    def add_gang(self, pg: PodGroup, assumed: int = 0) -> int:
+    def add_gang(self, pg: PodGroup, assumed: int = 0,
+                 satisfied: bool = False) -> int:
+        """`satisfied` is the match-policy latch computed by GangDirectory
+        (once-satisfied gangs short-circuit the gang gates, core.go:236)."""
         if len(self.gangs) >= self.max_gangs:
             raise ValueError("gang capacity exceeded")
         idx = len(self.gangs)
         self.gangs.append(pg)
         self.gang_index[pg.meta.name] = idx
         self.gang_assumed[pg.meta.name] = assumed
+        self.gang_satisfied[pg.meta.name] = satisfied
         return idx
 
     def add_reservation(self, res: Reservation) -> None:
@@ -435,15 +440,18 @@ class SnapshotBuilder:
         member_count = np.zeros((g,), np.int32)
         assumed = np.zeros((g,), np.int32)
         strict = np.ones((g,), bool)
+        satisfied = np.zeros((g,), bool)
         valid = np.zeros((g,), bool)
         for i, pg in enumerate(self.gangs):
             min_member[i] = pg.min_member
             member_count[i] = pg.total_member
             assumed[i] = self.gang_assumed.get(pg.meta.name, 0)
             strict[i] = pg.mode != "NonStrict"
+            satisfied[i] = self.gang_satisfied.get(pg.meta.name, False)
             valid[i] = True
         return GangState(min_member=min_member, member_count=member_count,
-                         assumed=assumed, strict=strict, valid=valid)
+                         assumed=assumed, strict=strict, satisfied=satisfied,
+                         valid=valid)
 
     def _pods_per_node(self) -> Dict[str, List[AssignedPod]]:
         out: Dict[str, List[AssignedPod]] = {}

@@ -171,6 +171,10 @@ class GangState:
     member_count: Array     # i32[G] total members seen (quorum check)
     assumed: Array          # i32[G] members already assumed/bound
     strict: Array           # bool[G] strict mode
+    satisfied: Array        # bool[G] match-policy satisfied latch: members
+    #   pass the gang gates individually and are exempt from all-or-nothing
+    #   rollback (core.go:236,286 — a once-satisfied gang short-circuits
+    #   PreFilter and is never group-rejected in PostFilter)
     valid: Array            # bool[G]
 
 
@@ -307,6 +311,7 @@ def zeros_snapshot(num_nodes: int, num_quotas: int = 1, num_gangs: int = 1,
         member_count=jnp.zeros((g,), jnp.int32),
         assumed=jnp.zeros((g,), jnp.int32),
         strict=jnp.ones((g,), bool),
+        satisfied=jnp.zeros((g,), bool),
         valid=jnp.zeros((g,), bool),
     )
     reservations = ReservationState(

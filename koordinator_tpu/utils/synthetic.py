@@ -156,6 +156,7 @@ def synthetic_cluster(num_nodes: int, seed: int = 0,
         member_count=np.full((g,), gang_min_member, np.int32),
         assumed=np.zeros((g,), np.int32),
         strict=np.ones((g,), bool),
+        satisfied=np.zeros((g,), bool),
         valid=np.arange(g) < num_gangs,
     )
     n_inst = gpus_per_node if gpu_node_frac > 0 else 0

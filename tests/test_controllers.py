@@ -265,3 +265,176 @@ def test_remove_pods_on_unschedulable_nodes():
         api.Node(meta=api.ObjectMeta(name="n0"), unschedulable=True),
         api.Node(meta=api.ObjectMeta(name="n1"))])
     assert [e.pod.meta.name for e in ev.evictions] == ["a"]
+
+
+# --- gang match policies + gang groups (coscheduling.go:55-61) --------------
+
+
+def test_gang_match_policy_only_waiting():
+    """only-waiting counts just the members still at the Permit barrier
+    (core.go:163-165): binding a member removes it from the count."""
+    d = GangDirectory()
+    d.upsert_pod_group(api.PodGroup(meta=api.ObjectMeta(name="g"),
+                                    min_member=2,
+                                    match_policy="only-waiting"))
+    for uid in ("p0", "p1"):
+        d.add_pod("g", uid)
+        d.mark_assumed("g", uid, now=0.0)
+    g = d.gangs["g"]
+    assert g.satisfied
+    d.mark_bound("g", "p0")
+    assert not g.satisfied          # 1 waiting < minMember 2
+    d.add_pod("g", "p2")
+    d.mark_assumed("g", "p2", now=1.0)
+    assert g.satisfied              # p1 + p2 waiting
+
+
+def test_gang_match_policy_waiting_and_running():
+    """waiting-and-running counts every assumed member, bound or not, but
+    does NOT latch: losing a member drops satisfaction (core.go:166-167)."""
+    d = GangDirectory()
+    d.upsert_pod_group(api.PodGroup(meta=api.ObjectMeta(name="g"),
+                                    min_member=2,
+                                    match_policy="waiting-and-running"))
+    for uid in ("p0", "p1"):
+        d.add_pod("g", uid)
+        d.mark_assumed("g", uid, now=0.0)
+    g = d.gangs["g"]
+    d.mark_bound("g", "p0")
+    assert g.satisfied              # bound still counts
+    d.remove_pod("g", "p1")
+    assert not g.satisfied          # member gone, no latch
+
+
+def test_gang_match_policy_once_satisfied_latches():
+    """The default policy latches forever once minMember was reached
+    (gang.go:59-62): later member churn cannot unsatisfy the gang, and a
+    latched gang never Permit-times-out."""
+    d = GangDirectory()
+    d.add_pod("g", "p0", min_member=2)
+    d.add_pod("g", "p1")
+    d.mark_assumed("g", "p0", now=0.0)
+    d.mark_assumed("g", "p1", now=0.0)
+    g = d.gangs["g"]
+    assert g.satisfied and g.once_satisfied
+    d.remove_pod("g", "p1")
+    assert g.satisfied              # latch holds below minMember
+    d.mark_assumed("g", "p2", now=10.0)
+    assert d.expire_waits(now=10_000.0) == []
+
+
+def test_gang_annotation_spec_parsing():
+    """The full pod-annotation gang protocol (TryInitByPodConfig,
+    gang.go:120-175): mode, match policy, waiting-time, groups; illegal
+    values fall back to defaults."""
+    from koordinator_tpu.api import extension as ext
+
+    d = GangDirectory(default_wait_time_seconds=600.0)
+    g = d.add_pod("ml/a", "p0", annotations={
+        ext.ANNOTATION_GANG_NAME: "ml/a",
+        ext.ANNOTATION_GANG_MIN_NUM: "2",
+        ext.ANNOTATION_GANG_MODE: "NonStrict",
+        ext.ANNOTATION_GANG_MATCH_POLICY: "only-waiting",
+        ext.ANNOTATION_GANG_WAIT_TIME: "120",
+        ext.ANNOTATION_GANG_GROUPS: '["ml/a", "ml/b"]',
+    })
+    assert (g.min_member, g.mode, g.match_policy) == \
+        (2, "NonStrict", "only-waiting")
+    assert g.wait_time_seconds == 120.0
+    assert g.gang_group == ("ml/a", "ml/b")
+    # illegal values: defaults win, the gang still forms
+    bad = d.add_pod("ml/bad", "q0", annotations={
+        ext.ANNOTATION_GANG_NAME: "ml/bad",
+        ext.ANNOTATION_GANG_MIN_NUM: "zero",
+        ext.ANNOTATION_GANG_MODE: "Sloppy",
+        ext.ANNOTATION_GANG_MATCH_POLICY: "sometimes",
+        ext.ANNOTATION_GANG_GROUPS: "not-json",
+    })
+    assert (bad.min_member, bad.mode, bad.match_policy) == \
+        (1, "Strict", "once-satisfied")
+    assert bad.gang_group == ("ml/bad",)
+    # no gang declared -> None
+    assert ext.parse_gang_annotations({}) is None
+
+
+def test_gang_group_bind_barrier_and_group_rejection():
+    """Gangs bundled by AnnotationGangGroups bind only together, and a
+    Permit timeout rejects the WHOLE group (rejectGangGroupById), sparing
+    already-bound members."""
+    from koordinator_tpu.api import extension as ext
+
+    d = GangDirectory(default_wait_time_seconds=60.0)
+    anno_a = {ext.ANNOTATION_GANG_NAME: "a",
+              ext.ANNOTATION_GANG_MIN_NUM: "1",
+              ext.ANNOTATION_GANG_GROUPS: '["a", "b"]'}
+    anno_b = {ext.ANNOTATION_GANG_NAME: "b",
+              ext.ANNOTATION_GANG_MIN_NUM: "2",
+              ext.ANNOTATION_GANG_GROUPS: '["a", "b"]'}
+    d.add_pod("a", "a0", annotations=anno_a)
+    d.add_pod("b", "b0", annotations=anno_b)
+    d.add_pod("b", "b1", annotations=anno_b)
+    d.mark_assumed("a", "a0", now=0.0)
+    assert d.gangs["a"].satisfied
+    assert not d.group_satisfied("a")       # sibling b not satisfied
+    d.mark_assumed("b", "b0", now=1.0)
+    d.mark_assumed("b", "b1", now=1.0)
+    assert d.group_satisfied("a") and d.group_satisfied("b")
+    # fresh group where b never completes: a's member is released too
+    d2 = GangDirectory(default_wait_time_seconds=60.0)
+    d2.add_pod("a", "a0", annotations=anno_a)
+    d2.add_pod("b", "b0", annotations=anno_b)
+    d2.add_pod("b", "b1", annotations=anno_b)
+    # a latched (min 1) but b waits with one member; the group can't bind
+    d2.gangs["a"].match_policy = "waiting-and-running"  # avoid latch skip
+    d2.mark_assumed("a", "a0", now=0.0)
+    d2.mark_bound("a", "a0")  # wrong in real flow (group gate), but proves
+    # bound members survive group rejection below
+    d2.mark_assumed("b", "b0", now=0.0)
+    timed = d2.expire_waits(now=100.0)
+    assert "b" in timed
+    assert d2.assumed_count("b") == 0
+    # a's bound member survives; its waiting set was empty
+    assert d2.gangs["a"].assumed == {"a0"}
+
+
+def test_gang_timer_resets_when_no_members_waiting():
+    """Regression: a stale first_assumed_at must not instantly expire the
+    next waiter. Deleting (or binding) the last waiting member clears the
+    pending-timeout timer."""
+    d = GangDirectory()
+    d.upsert_pod_group(api.PodGroup(meta=api.ObjectMeta(name="g"),
+                                    min_member=2, wait_time_seconds=60.0))
+    d.add_pod("g", "p0")
+    d.mark_assumed("g", "p0", now=0.0)
+    d.remove_pod("g", "p0")            # waiter gone -> timer gone
+    d.add_pod("g", "p1")
+    d.mark_assumed("g", "p1", now=100.5)
+    assert d.expire_waits(now=101.0) == []   # p1 waited 0.5s, not 100.5s
+    assert d.expire_waits(now=161.0) == ["g"]
+    # same via bind: only-waiting gang whose sole assumed member bound
+    d2 = GangDirectory()
+    d2.upsert_pod_group(api.PodGroup(meta=api.ObjectMeta(name="h"),
+                                     min_member=2, wait_time_seconds=60.0,
+                                     match_policy="only-waiting"))
+    d2.add_pod("h", "q0")
+    d2.mark_assumed("h", "q0", now=0.0)
+    d2.mark_bound("h", "q0")
+    d2.add_pod("h", "q1")
+    d2.mark_assumed("h", "q1", now=100.0)
+    assert d2.expire_waits(now=120.0) == []
+
+
+def test_gang_groups_always_include_own_name():
+    """Regression: groups='[\"b\"]' on gang a must still put a in its own
+    group, or expiry could never release a's waiters."""
+    from koordinator_tpu.api import extension as ext
+
+    d = GangDirectory(default_wait_time_seconds=60.0)
+    g = d.add_pod("a", "a0", annotations={
+        ext.ANNOTATION_GANG_NAME: "a",
+        ext.ANNOTATION_GANG_MIN_NUM: "2",
+        ext.ANNOTATION_GANG_GROUPS: '["b"]'})
+    assert g.gang_group == ("a", "b")
+    d.mark_assumed("a", "a0", now=0.0)
+    assert d.expire_waits(now=100.0) == ["a"]
+    assert d.assumed_count("a") == 0

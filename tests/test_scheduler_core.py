@@ -215,3 +215,29 @@ def test_node_selector_gate():
     batch = b.build_pod_batch(pods, ctx)
     res = core.schedule_batch(snap, batch, loadaware.LoadAwareConfig.make())
     assert int(res.assignment[0]) == 0
+
+
+def test_gang_satisfied_latch_bypasses_gates():
+    """A once-satisfied gang short-circuits quorum PreFilter and the
+    all-or-nothing rollback (core.go:236,286): members schedule
+    individually even when the gang is below quorum or partially fails."""
+    b = SnapshotBuilder(max_nodes=1, max_gangs=1)
+    b.add_node(Node(meta=ObjectMeta(name="n0"),
+                    allocatable={RK.CPU: 8000, RK.MEMORY: 16384}))
+    b.set_node_metric(NodeMetric(node_name="n0", update_time=NOW,
+                                 node_usage={}))
+    # below quorum (2 members seen < minMember 4) AND strict — without the
+    # latch both members would be rejected up front
+    b.add_gang(PodGroup(meta=ObjectMeta(name="g"), min_member=4,
+                        total_member=2), satisfied=True)
+    snap, ctx = b.build(now=NOW)
+    pods = [Pod(meta=ObjectMeta(name=f"p{j}"), priority=9000,
+                requests={RK.CPU: 6000.0}, gang_name="g")
+            for j in range(2)]
+    batch = b.build_pod_batch(pods, ctx)
+    res = core.schedule_batch(snap, batch, loadaware.LoadAwareConfig.make(),
+                              num_rounds=3)
+    a = np.asarray(res.assignment)
+    # only one fits (6000+6000 > 8000) — and it STAYS placed: a satisfied
+    # strict gang is exempt from group rollback
+    assert (a >= 0).sum() == 1
