@@ -104,6 +104,8 @@ class GangRecord:
     bound: set = dataclasses.field(default_factory=set)
     once_satisfied: bool = False
     first_assumed_at: Optional[float] = None
+    last_assumed_at: float = 0.0   # most recent mark_assumed time (re-arm
+    #   floor when satisfaction drops with waiters still at the barrier)
     timeout_count: int = 0
 
     @property
@@ -178,8 +180,7 @@ class GangDirectory:
         g.members.discard(pod_uid)
         g.assumed.discard(pod_uid)
         g.bound.discard(pod_uid)
-        if g.assumed == g.bound:
-            g.first_assumed_at = None  # nobody waiting: no pending timeout
+        self._sync_timer(g)
         g.total_member = len(g.members)
         # annotation-created gangs vanish with their last member; a
         # CR-backed record keeps its spec until the CR is deleted
@@ -197,6 +198,7 @@ class GangDirectory:
         if g is None:
             return
         g.assumed.add(pod_uid)
+        g.last_assumed_at = max(g.last_assumed_at, now)
         if g.first_assumed_at is None:
             g.first_assumed_at = now
         if len(g.assumed) >= g.min_member:
@@ -211,8 +213,19 @@ class GangDirectory:
         if g is None or pod_uid not in g.assumed:
             return
         g.bound.add(pod_uid)
+        self._sync_timer(g)
+
+    @staticmethod
+    def _sync_timer(g: GangRecord) -> None:
+        """Keep the Permit timer consistent with the waiting set: no
+        waiters -> no pending timeout; waiters on an UNsatisfied gang ->
+        a running timer (re-armed from the latest assume when a bind or
+        member loss dropped satisfaction after the timer was cleared, so
+        stranded waiters still expire and release their capacity)."""
         if g.assumed == g.bound:
-            g.first_assumed_at = None  # nobody waiting: no pending timeout
+            g.first_assumed_at = None
+        elif not g.satisfied and g.first_assumed_at is None:
+            g.first_assumed_at = g.last_assumed_at
 
     def group_satisfied(self, gang_name: str) -> bool:
         """A gang goes to bind only when EVERY gang in its group is

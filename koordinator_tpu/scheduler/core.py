@@ -92,6 +92,9 @@ class ScheduleResult:
                                  # through the forget/un-assume path without
                                  # waiting for the Permit timeout
     snapshot: ClusterSnapshot    # post-commit snapshot (requested/used updated)
+    amplified: bool = flax.struct.field(pytree_node=False, default=False)
+    # ^ whether the amplified-CPU gates produced this result; the forget/
+    #   un-assume path MUST mirror it so returned CPU equals charged CPU
 
 
 @functools.partial(jax.jit, static_argnames=("num_rounds", "k_choices",
@@ -101,7 +104,8 @@ class ScheduleResult:
                                              "enable_devices",
                                              "device_strategy",
                                              "quota_depth",
-                                             "fit_dims"))
+                                             "fit_dims",
+                                             "enable_amplification"))
 def schedule_batch(snap: ClusterSnapshot, pods: PodBatch,
                    cfg: loadaware.LoadAwareConfig,
                    num_rounds: int = 4, k_choices: int = 8,
@@ -113,7 +117,8 @@ def schedule_batch(snap: ClusterSnapshot, pods: PodBatch,
                    enable_devices: bool = True,
                    device_strategy: str = "least",
                    quota_depth: int = MAX_QUOTA_DEPTH,
-                   fit_dims: tuple = None) -> ScheduleResult:
+                   fit_dims: tuple = None,
+                   enable_amplification: bool = False) -> ScheduleResult:
     """Schedule a pod batch against the snapshot. Pure function; the caller
     publishes `result.snapshot` as the next version (store.update).
 
@@ -219,6 +224,22 @@ def schedule_batch(snap: ClusterSnapshot, pods: PodBatch,
     is_once = resv0.allocate_once                                # bool[V]
     slot_node_c = jnp.maximum(slot_node, 0)
 
+    # --- amplified CPU (nodenumaresource filterAmplifiedCPUs) -------------
+    # On a node with amplification ratio > 1 the webhook published
+    # AMPLIFIED allocatable; a CPU-bind (exclusive-cpuset) pod's cores cost
+    # request x ratio against it, charged amplified at commit so later
+    # pods see the true remaining capacity. Zone capacities stay raw:
+    # amplifying both the zone resources and the bind-pod zone request by
+    # the same ratio (util.go amplifyNUMANodeResources + getResourceOptions)
+    # cancels in the fit comparison. Reservation slot columns draw from the
+    # reservation's own hold and stay unamplified (documented deviation:
+    # the reference amplifies reserved cpusets as reusableResources).
+    ci = int(CPU_KIND)
+    if enable_amplification:
+        amp_ext = jnp.concatenate(
+            [nodes0.cpu_amplification,
+             jnp.ones((n_slots,), jnp.float32)], 0)              # [N+V]
+
     def to_real(ext_idx):
         """Map an extended node id to its real node (slots -> their node)."""
         if n_slots == 0:
@@ -281,6 +302,12 @@ def schedule_batch(snap: ClusterSnapshot, pods: PodBatch,
         # --- feasibility [P, N+V] (HOT LOOP #1) ---
         fit = jnp.all(dims(pods.requests)[:, None, :] + dims(requested)[None]
                       <= dims(ext_alloc)[None] + EPS, axis=-1)
+        if enable_amplification:
+            # CPU-bind pods must also fit their AMPLIFIED cpu request
+            amp_cpu = pods.requests[:, ci][:, None] * jnp.where(
+                pods.numa_single[:, None], amp_ext[None, :], 1.0)  # [P, N+V]
+            fit &= amp_cpu + requested[None, :, ci] \
+                <= ext_alloc[None, :, ci] + EPS
         feasible = fit & ext_static & active[:, None]
         if n_slots:
             # consumed AllocateOnce slots admit nobody (plugin.go:509-510)
@@ -355,8 +382,16 @@ def schedule_batch(snap: ClusterSnapshot, pods: PodBatch,
                 trying &= ~(on_slot & (is_once & once_taken)[slot_of])
             choice_eff = jnp.where(trying, choice, n_ext)
 
-            # node/slot capacity prefix in priority order
-            eff_req = jnp.where(trying[:, None], dims(pods.requests), 0.0)
+            # node/slot capacity prefix in priority order; a CPU-bind pod
+            # charges its amplified cpu request on amplified nodes
+            if enable_amplification:
+                f_amp = jnp.where(
+                    pods.numa_single,
+                    amp_ext[jnp.clip(choice_eff, 0, n_ext - 1)], 1.0)  # [P]
+                req_node = pods.requests.at[:, ci].mul(f_amp)
+            else:
+                req_node = pods.requests
+            eff_req = jnp.where(trying[:, None], dims(req_node), 0.0)
             accept = trying & segment_prefix_ok(
                 choice_eff, earlier, eff_req, dims(requested),
                 dims(ext_alloc), n_ext)
@@ -548,7 +583,12 @@ def schedule_batch(snap: ClusterSnapshot, pods: PodBatch,
                         jnp.where(took_a, aux_insts[t], out_aux[:, t]))
                 aux_free = aux_free_flat.reshape(aux_free.shape)
             acc_req = pods.requests * accept[:, None]
-            requested = requested.at[choice_eff].add(acc_req, mode="drop")
+            # node charge is amplified for CPU-bind pods; quota charges the
+            # RAW request (quota admission is about the pod's own ask)
+            acc_req_node = req_node * accept[:, None] \
+                if enable_amplification else acc_req
+            requested = requested.at[choice_eff].add(acc_req_node,
+                                                     mode="drop")
             for d in range(quota_depth):
                 anc = jnp.where(accept, pod_anc[:, d], -1)
                 quota_used = quota_used.at[
@@ -650,6 +690,12 @@ def schedule_batch(snap: ClusterSnapshot, pods: PodBatch,
     # reservation consumers don't grow node requested (covered capacity was
     # already charged by the reserve pod, plugin.go:521-613)
     node_req = fin_req * (res_slot < 0)[:, None]
+    if enable_amplification:
+        f_fin = jnp.where(
+            ok & pods.numa_single,
+            nodes0.cpu_amplification[jnp.clip(placed_real, 0,
+                                              n_nodes - 1)], 1.0)
+        node_req = node_req.at[:, ci].mul(f_fin)
     requested = nodes0.requested.at[tgt].add(node_req, mode="drop")
     assigned_est = nodes0.assigned_estimated.at[tgt].add(fin_est, mode="drop")
     prod_assigned_est = nodes0.prod_assigned_estimated.at[tgt].add(
@@ -731,4 +777,5 @@ def schedule_batch(snap: ClusterSnapshot, pods: PodBatch,
                           gpu_take=gpu_take,
                           aux_inst=aux_inst, res_slot=res_slot,
                           gang_failed=gang_fail,
-                          snapshot=new_snap)
+                          snapshot=new_snap,
+                          amplified=enable_amplification)

@@ -218,6 +218,7 @@ class SchedulerService:
         self.flags = flags or DebugFlags()
         self.registry = registry or ServiceRegistry()
         self.schedule_kwargs = schedule_kwargs
+        self._explicit_amp = "enable_amplification" in schedule_kwargs
         self.batches = 0
         self.pods_placed = 0
         self.last_elapsed = 0.0
@@ -249,6 +250,13 @@ class SchedulerService:
         """Returns the published version, read under the commit lock so a
         concurrent mutator cannot be misattributed."""
         with self._commit_lock:
+            # amplified-CPU auto-detection: a snapshot carrying any node
+            # ratio > 1 turns the amplified gates on (an explicit
+            # enable_amplification kwarg from the constructor wins)
+            if not self._explicit_amp:
+                self.schedule_kwargs["enable_amplification"] = bool(
+                    np.asarray(snapshot.nodes.cpu_amplification > 1.0)
+                    .any())
             self.store.publish(snapshot)
             self.last_committed_version = self.store.version
             return self.last_committed_version

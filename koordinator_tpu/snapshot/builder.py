@@ -15,12 +15,14 @@ Everything is plain numpy; `SnapshotStore` (store.py) owns device upload.
 from __future__ import annotations
 
 import dataclasses
+import json
 import time
 from typing import Dict, Iterable, List, Mapping, Optional, Sequence, Tuple
 
 import numpy as np
 
 from koordinator_tpu.api.extension import (
+    ANNOTATION_NODE_AMPLIFICATION_RATIOS,
     NUM_RESOURCES,
     PriorityClass,
     QoSClass,
@@ -301,9 +303,22 @@ class SnapshotBuilder:
         numa_valid = np.zeros((n, z), bool)
         numa_policy = np.zeros((n,), np.int32)
 
+        cpu_amp = np.ones((n,), np.float32)
         for i, node in enumerate(self.nodes):
             alloc[i] = resource_vec(node.allocatable)
             schedulable[i] = not node.unschedulable
+            # amplification ratio (resource-amplification-ratio annotation,
+            # published by the node webhook alongside AMPLIFIED allocatable;
+            # nodenumaresource util.go:65-85). Malformed values were
+            # rejected by the validating webhook; be lenient here.
+            raw_amp = node.meta.annotations.get(
+                ANNOTATION_NODE_AMPLIFICATION_RATIOS, "")
+            if raw_amp:
+                try:
+                    ratios = json.loads(raw_amp)
+                    cpu_amp[i] = max(float(ratios.get("cpu", 1.0)), 1.0)
+                except (ValueError, TypeError, AttributeError):
+                    pass
             if node.topology is not None:
                 for j, zone in enumerate(node.topology.zones[:z]):
                     numa_cap[i, j, 0] = zone.cpus_milli
@@ -318,14 +333,23 @@ class SnapshotBuilder:
             idx = self.node_index.get(pod.node_name)
             if idx is not None:
                 rv = resource_vec(pod.requests)
-                requested[idx] += rv
                 # restore zone usage of running NUMA-bound pods from their
                 # resource-status annotation (nodenumaresource
-                # resource_manager.go rebuilds allocations the same way)
+                # resource_manager.go rebuilds allocations the same way).
+                # Zone charges stay RAW — zone capacities are raw and the
+                # in-cycle commit charges zones raw too (core.py amplified
+                # CPU: ratio cancels in the zone fit)
                 zi = pod.allocated_numa_zone
                 if pod.required_cpu_bind and 0 <= zi < z:
                     numa_used[idx, zi, 0] += rv[int(ResourceKind.CPU)]
                     numa_used[idx, zi, 1] += rv[int(ResourceKind.MEMORY)]
+                if pod.required_cpu_bind and cpu_amp[idx] > 1.0:
+                    # exclusive cores cost amplified CPU against the
+                    # amplified allocatable (filterAmplifiedCPUs's
+                    # re-amplification of allocatedMilliCPU)
+                    rv = rv.copy()
+                    rv[int(ResourceKind.CPU)] *= cpu_amp[idx]
+                requested[idx] += rv
 
         # An Available reservation is a "reserve pod": its requests are
         # charged to node requested up front (reservation/transformer.go
@@ -369,6 +393,7 @@ class SnapshotBuilder:
             numa_free=np.maximum(numa_cap - numa_used, 0.0),
             numa_valid=numa_valid,
             numa_policy=numa_policy,
+            cpu_amplification=cpu_amp,
         )
         return state, groups
 

@@ -16,11 +16,17 @@ and re-upload. Fixed K means repeated ingests reuse one compiled program.
 
 from __future__ import annotations
 
+import functools
+from typing import Optional
+
 import flax.struct
 import jax
 import jax.numpy as jnp
 
+from koordinator_tpu.api.extension import ResourceKind as _RK
 from koordinator_tpu.snapshot.schema import Array, ClusterSnapshot
+
+_CPU = int(_RK.CPU)
 
 __all__ = ["NodeMetricDelta", "apply_metric_delta", "forget_pods"]
 
@@ -73,14 +79,19 @@ def apply_metric_delta(snap: ClusterSnapshot,
     return snap.replace(nodes=nodes, version=snap.version + 1)
 
 
-@jax.jit
+@functools.partial(jax.jit, static_argnames=("enable_amplification",))
 def forget_pods(snap: ClusterSnapshot, pods, result,
-                mask: jnp.ndarray) -> ClusterSnapshot:
+                mask: jnp.ndarray,
+                enable_amplification: Optional[bool] = None
+                ) -> ClusterSnapshot:
     """Un-assume: return the charges of `mask`ed pods from a
     schedule_batch result whose binds failed (scheduler_adapter.go
     Forget). The exact inverse of the post-commit rebuild: node requested
     / quota used / gang assumed / NUMA takes / GPU instances / aux VFs /
     reservation holds all flow back, so a retry sees the capacity again.
+    The amplified-CPU reversal follows `result.amplified` (the flag the
+    producing schedule_batch ran with) so the CPU returned equals the CPU
+    charged; pass `enable_amplification` only to override it.
     """
     from koordinator_tpu.scheduler.plugins import deviceshare
 
@@ -94,10 +105,21 @@ def forget_pods(snap: ClusterSnapshot, pods, result,
     req = pods.requests * und[:, None]
 
     # node requested: only non-consumers charged it (consumers drew from
-    # the reservation)
+    # the reservation). CPU-bind pods on amplified nodes were charged
+    # request x ratio (core.py amplified-CPU commit) — return the same.
+    amp = enable_amplification
+    if amp is None:
+        amp = bool(getattr(result, "amplified", False))
+    req_node = req
+    if amp:
+        f_amp = jnp.where(
+            und & pods.numa_single,
+            nodes.cpu_amplification[jnp.clip(result.assignment, 0, n - 1)],
+            1.0)
+        req_node = req.at[:, int(_CPU)].mul(f_amp)
     requested = nodes.requested.at[
         jnp.where(und & ~on_slot, result.assignment, n)].add(
-            -req, mode="drop")
+            -req_node, mode="drop")
     est = pods.estimated * und[:, None]
     assigned_est = nodes.assigned_estimated.at[node_tgt].add(
         -est, mode="drop")

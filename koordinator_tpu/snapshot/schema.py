@@ -85,6 +85,12 @@ class NodeState:
     numa_policy: Array      # i32[N] topology-manager policy code
                             # (scheduler/topologymanager.py POLICY_*;
                             # apis/extension numa-topology-policy label)
+    cpu_amplification: Array  # f32[N] node CPU amplification ratio (>= 1;
+                            # resource-amplification-ratio annotation). The
+                            # webhook publishes AMPLIFIED allocatable; a
+                            # CPU-bind pod's exclusive cores cost
+                            # request x ratio against it
+                            # (nodenumaresource filterAmplifiedCPUs)
 
     @property
     def num_nodes(self) -> int:
@@ -292,6 +298,7 @@ def zeros_snapshot(num_nodes: int, num_quotas: int = 1, num_gangs: int = 1,
         numa_free=jnp.zeros((n, z, 2), f32),
         numa_valid=jnp.zeros((n, z), bool),
         numa_policy=jnp.zeros((n,), jnp.int32),
+        cpu_amplification=jnp.ones((n,), f32),
     )
     quotas = QuotaState(
         min=jnp.zeros((q, r), f32),

@@ -77,7 +77,9 @@ class SnapshotStore:
 
     def forget(self, pods, result, mask) -> ClusterSnapshot:
         """Un-assume failed binds (scheduler_adapter.go Forget): returns
-        the masked pods' charges to the snapshot device-side."""
+        the masked pods' charges to the snapshot device-side. The
+        amplified-CPU reversal rides `result.amplified`, so callers can't
+        mismatch the flag the producing schedule ran with."""
         from koordinator_tpu.snapshot.delta import forget_pods
 
         return self.update(lambda s: forget_pods(s, pods, result, mask))

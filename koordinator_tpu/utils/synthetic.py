@@ -113,6 +113,7 @@ def synthetic_cluster(num_nodes: int, seed: int = 0,
         numa_free=np.zeros((n, 4, 2), f32),
         numa_valid=np.zeros((n, 4), bool),
         numa_policy=np.zeros((n,), np.int32),
+        cpu_amplification=np.ones((n,), f32),
     )
 
     q = max_quotas

@@ -438,3 +438,34 @@ def test_gang_groups_always_include_own_name():
     d.mark_assumed("a", "a0", now=0.0)
     assert d.expire_waits(now=100.0) == ["a"]
     assert d.assumed_count("a") == 0
+
+
+def test_gang_timer_rearms_when_satisfaction_drops():
+    """Regression: satisfaction dropping (bind under only-waiting, member
+    loss under waiting-and-running) after the timer cleared must re-arm
+    the Permit timer so stranded waiters still expire."""
+    d = GangDirectory()
+    d.upsert_pod_group(api.PodGroup(meta=api.ObjectMeta(name="g"),
+                                    min_member=2, wait_time_seconds=60.0,
+                                    match_policy="only-waiting"))
+    d.add_pod("g", "p0")
+    d.add_pod("g", "p1")
+    d.mark_assumed("g", "p0", now=0.0)
+    d.mark_assumed("g", "p1", now=10.0)
+    assert d.gangs["g"].first_assumed_at is None   # satisfied clears timer
+    d.mark_bound("g", "p0")                        # satisfaction drops
+    assert d.gangs["g"].first_assumed_at is not None
+    assert d.expire_waits(now=1_000.0) == ["g"]    # p1 is released
+    assert d.gangs["g"].assumed == {"p0"}
+    # member-loss variant under waiting-and-running
+    d2 = GangDirectory()
+    d2.upsert_pod_group(api.PodGroup(meta=api.ObjectMeta(name="h"),
+                                     min_member=2, wait_time_seconds=60.0,
+                                     match_policy="waiting-and-running"))
+    d2.add_pod("h", "q0")
+    d2.add_pod("h", "q1")
+    d2.mark_assumed("h", "q0", now=0.0)
+    d2.mark_assumed("h", "q1", now=5.0)
+    d2.remove_pod("h", "q0")
+    assert d2.gangs["h"].first_assumed_at is not None
+    assert d2.expire_waits(now=1_000.0) == ["h"]

@@ -266,3 +266,81 @@ def test_preferred_reservation_cpus_first():
     got = take_preferred_cpus(TOPO, ALL, preferred={4, 5}, allocated={},
                               num_needed=4, bind_policy="FullPCPUs")
     assert {4, 5}.issubset(got) and len(got) == 4
+
+
+# --- amplified CPU (filterAmplifiedCPUs, plugin.go:336-373) -----------------
+
+
+def amplified_node(name, zone_cpu=8000.0, zones=2, ratio=2.0):
+    """A node the webhook amplified: allocatable = raw x ratio, with the
+    ratio annotation alongside (resource_amplification.go)."""
+    import json
+
+    from koordinator_tpu.api.extension import (
+        ANNOTATION_NODE_AMPLIFICATION_RATIOS,
+    )
+
+    n = numa_node(name, zone_cpu=zone_cpu, zones=zones)
+    n.allocatable[RK.CPU] = zone_cpu * zones * ratio
+    n.meta.annotations[ANNOTATION_NODE_AMPLIFICATION_RATIOS] = json.dumps(
+        {"cpu": ratio})
+    return n
+
+
+def test_amplified_cpu_bind_pod_costs_ratio():
+    """On a ratio-2 node with 32000m amplified allocatable (16000m raw),
+    a CPU-bind pod asking 10000m costs 20000m; two of them cannot share
+    the node even though raw requests (20000m) fit the amplified 32000m."""
+    n = amplified_node("amp", zone_cpu=8000.0, zones=2, ratio=2.0)
+    # zones hold 8000m raw each -> a 10000m bind pod can never fit one
+    # zone; use 6000m pods instead (zone-fit ok, node amplified-fit tight)
+    pods = [bind_pod(f"p{i}", 6000.0, 1024.0) for i in range(3)]
+    res = build([n], pods, enable_amplification=True)
+    a = np.asarray(res.assignment)
+    # each costs 12000m amplified: 2 fit in 32000m, the third (24000+12000
+    # > 32000) is rejected; unamplified all three (18000m raw) would fit
+    assert (a >= 0).sum() == 2, a
+    req = np.asarray(res.snapshot.nodes.requested)
+    assert req[0, int(RK.CPU)] == pytest.approx(24000.0)
+
+
+def test_amplified_shared_pod_unaffected():
+    """Non-bind pods are checked raw against the amplified allocatable
+    (only state.requestCPUBind amplifies, plugin.go:352-354)."""
+    n = amplified_node("amp", zone_cpu=8000.0, zones=2, ratio=2.0)
+    shared = [Pod(meta=ObjectMeta(name=f"s{i}"), priority=9000,
+                  requests={RK.CPU: 10000.0, RK.MEMORY: 512.0})
+              for i in range(3)]
+    res = build([n], shared, enable_amplification=True)
+    assert (np.asarray(res.assignment) >= 0).sum() == 3  # 30000 <= 32000
+
+
+def test_amplified_running_pod_and_forget_roundtrip():
+    """A running CPU-bind pod charges amplified at build; forget returns
+    exactly the amplified charge of an in-cycle bind pod."""
+    from koordinator_tpu.snapshot.delta import forget_pods
+
+    n = amplified_node("amp", zone_cpu=8000.0, zones=2, ratio=2.0)
+    b = SnapshotBuilder(max_nodes=1)
+    b.add_node(n)
+    b.set_node_metric(NodeMetric(node_name="amp", update_time=NOW - 2,
+                                 node_usage={RK.CPU: 0.0}))
+    running = Pod(meta=ObjectMeta(name="r"), requests={RK.CPU: 4000.0},
+                  qos_label="LSR", required_cpu_bind=True, phase="Running",
+                  node_name="amp", allocated_numa_zone=0)
+    b.add_running_pod(running)
+    snap, ctx = b.build(now=NOW)
+    req0 = np.asarray(snap.nodes.requested)[0, int(RK.CPU)]
+    assert req0 == pytest.approx(8000.0)          # 4000 x 2
+    pods = [bind_pod("p", 6000.0, 1024.0)]
+    batch = b.build_pod_batch(pods, ctx)
+    res = core.schedule_batch(snap, batch, CFG, num_rounds=2,
+                              enable_amplification=True)
+    assert int(np.asarray(res.assignment)[0]) == 0
+    after = np.asarray(res.snapshot.nodes.requested)[0, int(RK.CPU)]
+    assert after == pytest.approx(8000.0 + 12000.0)
+    # no explicit flag: the reversal must follow result.amplified
+    back = forget_pods(res.snapshot, batch, res,
+                       np.ones((batch.valid.shape[0],), bool))
+    reverted = np.asarray(back.nodes.requested)[0, int(RK.CPU)]
+    assert reverted == pytest.approx(8000.0)
