@@ -263,6 +263,43 @@ def parse_extended_resource_spec(annotations: Mapping) -> tuple:
     return pick(spec.get("requests")), pick(spec.get("limits"))
 
 
+# --- SystemQOS (apis/extension/system_qos.go) -------------------------------
+ANNOTATION_NODE_SYSTEM_QOS_RESOURCE = (
+    NODE_DOMAIN_PREFIX + "/system-qos-resource")
+
+
+def parse_system_qos_resource(annotations: Mapping) -> Optional[dict]:
+    """node annotation -> {"cpuset": "0-3", "cpus": [0,1,2,3],
+    "exclusive": bool} or None when absent/malformed/empty.
+    CPUSetExclusive defaults to TRUE (system_qos.go:36-39): exclusive
+    system cores are carved out of every other tier's usable set."""
+    import json as _json
+
+    raw = annotations.get(ANNOTATION_NODE_SYSTEM_QOS_RESOURCE, "")
+    if not raw:
+        return None
+    try:
+        data = _json.loads(raw)
+        spec = str(data.get("cpuset", ""))
+        if not spec:
+            return None
+        cpus: list = []
+        for part in spec.split(","):
+            part = part.strip()
+            if not part:
+                continue
+            if "-" in part:
+                lo, hi = part.split("-", 1)
+                cpus.extend(range(int(lo), int(hi) + 1))
+            else:
+                cpus.append(int(part))
+        exclusive = data.get("cpusetExclusive")
+        return {"cpuset": spec, "cpus": sorted(set(cpus)),
+                "exclusive": True if exclusive is None else bool(exclusive)}
+    except (ValueError, TypeError, AttributeError):
+        return None
+
+
 # --- gang annotation protocol (apis/extension/coscheduling.go:26-61) -------
 ANNOTATION_GANG_PREFIX = "gang.scheduling.koordinator.sh"
 ANNOTATION_GANG_NAME = ANNOTATION_GANG_PREFIX + "/name"

@@ -325,6 +325,33 @@ class NodeSLO:
     system: SystemStrategy = dataclasses.field(default_factory=SystemStrategy)
     host_applications: List[HostApplication] = dataclasses.field(
         default_factory=list)
+    # per-block IO throttles (BlkIOQOS blocks, nodeslo_types.go:188-196)
+    blkio_blocks: List["BlockCfg"] = dataclasses.field(default_factory=list)
+
+
+@dataclasses.dataclass
+class BlockCfg:
+    """One block device's IO config (nodeslo_types.go BlockCfg + IOCfg).
+    `name` is a device path for type "device", or "namespace/claim" for
+    type "podvolume" (resolved to the bound volume through the PVC
+    informer's map)."""
+
+    name: str = ""
+    block_type: str = "device"     # device | podvolume | volumegroup
+    read_iops: int = 0             # 0 = unlimited (feature off)
+    write_iops: int = 0
+    read_bps: int = 0
+    write_bps: int = 0
+    io_weight_percent: int = 100
+
+
+@dataclasses.dataclass
+class PersistentVolumeClaim:
+    """The slice of corev1 PVC the agent needs: claim identity -> bound
+    volume name (statesinformer/impl/states_pvc.go volumeNameMap)."""
+
+    meta: ObjectMeta = dataclasses.field(default_factory=ObjectMeta)
+    volume_name: str = ""
 
 
 # --- Scheduling CRDs --------------------------------------------------------

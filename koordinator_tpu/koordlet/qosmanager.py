@@ -29,7 +29,11 @@ import os
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 from koordinator_tpu.api import types as api
-from koordinator_tpu.api.extension import QoSClass, ResourceKind
+from koordinator_tpu.api.extension import (
+    QoSClass,
+    ResourceKind,
+    parse_system_qos_resource,
+)
 from koordinator_tpu.koordlet import metriccache as mc
 from koordinator_tpu.koordlet.audit import Auditor, NULL_AUDITOR
 from koordinator_tpu.koordlet.metrics_defs import KoordletMetrics
@@ -169,13 +173,20 @@ class CPUSuppress:
                 max(0.0, node_used - be_used))
 
     def _lse_lsr_cpus(self) -> List[int]:
-        """CPUs pinned by LSE/LSR pods (read from their cpuset files)."""
+        """CPUs pinned by LSE/LSR pods (read from their cpuset files),
+        plus the node's exclusive SystemQOS cores — BE may never land on
+        either (cpu_suppress.go:366-376 getSystemQOSExclusiveCPU)."""
         out: List[int] = []
         for meta in self.informer.get_all_pods():
             if meta.pod.qos in (QoSClass.LSE, QoSClass.LSR):
                 cpus = self.executor.try_read(meta.cgroup_dir, "cpuset.cpus")
                 if cpus:
                     out.extend(parse_cpuset(cpus))
+        node = self.informer.get_node()
+        if node is not None:
+            res = parse_system_qos_resource(node.meta.annotations)
+            if res and res["exclusive"]:
+                out.extend(res["cpus"])
         return sorted(set(out))
 
     def reconcile(self, now: float) -> None:
@@ -596,9 +607,17 @@ BLKIO_TIER_WEIGHTS = {
 
 
 class BlkIOReconcile:
-    """blkio weight per QoS tier cgroup (qosmanager blkio strategy)."""
+    """blkio weight per QoS tier cgroup plus per-block IO throttles from
+    the NodeSLO blkio blocks (qosmanager blkio strategy,
+    blkio_reconcile.go): device blocks throttle by their own name,
+    podvolume blocks resolve "namespace/claim" through the PVC informer
+    map to the bound volume (blkio_reconcile.go:386-394 GetVolumeName)."""
 
     name = "blkio"
+    THROTTLE_FILES = (("read_iops", "blkio.throttle.read_iops_device"),
+                      ("write_iops", "blkio.throttle.write_iops_device"),
+                      ("read_bps", "blkio.throttle.read_bps_device"),
+                      ("write_bps", "blkio.throttle.write_bps_device"))
 
     def __init__(self, informer: StatesInformer, executor: Executor,
                  weights: Optional[Dict[str, int]] = None,
@@ -607,15 +626,49 @@ class BlkIOReconcile:
         self.executor = executor
         self.weights = dict(weights or BLKIO_TIER_WEIGHTS)
         self.auditor = auditor
+        # (file, device) -> value applied last reconcile; entries that
+        # drop out of the desired set are RESET (0 = unlimited for
+        # throttles, 100 = default cost weight) — otherwise a removed
+        # block config would leave its kernel limit in force forever
+        self._applied: Dict[tuple, int] = {}
+
+    def _resolve(self, block) -> str:
+        """Block name -> the device the throttle applies to; '' = skip
+        (unbound podvolume claims apply nowhere yet)."""
+        if block.block_type == "podvolume":
+            ns, _, claim = block.name.partition("/")
+            return self.informer.get_volume_name(ns, claim)
+        return block.name
 
     def reconcile(self, now: float) -> None:
         # IO weights only apply once the control plane distributed an SLO
         # (the reference strategy reads the NodeSLO blkio config)
-        if self.informer.get_node_slo() is None:
+        slo = self.informer.get_node_slo()
+        if slo is None:
             return
         for tier, weight in self.weights.items():
             self.executor.update(CgroupUpdate(tier, "blkio.weight",
                                               str(weight)))
+        desired: Dict[tuple, int] = {}
+        for block in slo.blkio_blocks:
+            dev = self._resolve(block)
+            if not dev:
+                continue
+            for attr, file in self.THROTTLE_FILES:
+                value = int(getattr(block, attr))
+                if value > 0:
+                    desired[(file, dev)] = value
+            if block.io_weight_percent != 100:
+                desired[("blkio.cost.weight", dev)] = \
+                    int(block.io_weight_percent)
+        for (file, dev), value in desired.items():
+            self.executor.update(CgroupUpdate(BE_ROOT, file,
+                                              f"{dev} {value}"))
+        for (file, dev) in set(self._applied) - set(desired):
+            reset = 100 if file == "blkio.cost.weight" else 0
+            self.executor.update(CgroupUpdate(BE_ROOT, file,
+                                              f"{dev} {reset}"))
+        self._applied = desired
 
 
 class QoSManager:

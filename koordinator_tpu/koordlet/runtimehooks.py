@@ -34,7 +34,11 @@ import json
 import math
 from typing import Dict, List, Optional, Protocol, Tuple
 
-from koordinator_tpu.api.extension import QoSClass, ResourceKind
+from koordinator_tpu.api.extension import (
+    QoSClass,
+    ResourceKind,
+    parse_system_qos_resource,
+)
 from koordinator_tpu.koordlet.resourceexecutor import CgroupUpdate, Executor
 from koordinator_tpu.koordlet.statesinformer import PodMeta, StatesInformer
 
@@ -167,12 +171,32 @@ class GroupIdentityHook:
 class CPUSetHook:
     """Scheduler's NUMA/cpuset decision -> cgroup (cpuset/rule.go). The
     annotation value is the JSON the NodeNUMAResource PreBind writes:
-    {"cpuset": "0-3", "numaNodes": [0]}."""
+    {"cpuset": "0-3", "numaNodes": [0]}. SYSTEM QoS pods instead get the
+    node's system-qos-resource cpuset when one is declared
+    (rule.go:105-111; informer optional — without it the SYSTEM branch is
+    inert)."""
 
     name = "cpuset"
     stages = (Stage.PRE_CREATE_CONTAINER, Stage.PRE_UPDATE_CONTAINER)
 
+    def __init__(self, informer: Optional[StatesInformer] = None):
+        self.informer = informer
+
+    def _system_qos_cpuset(self) -> str:
+        if self.informer is None:
+            return ""
+        node = self.informer.get_node()
+        if node is None:
+            return ""
+        res = parse_system_qos_resource(node.meta.annotations)
+        return res["cpuset"] if res else ""
+
     def apply(self, ctx: HookContext) -> None:
+        if ctx.pod.pod.qos == QoSClass.SYSTEM:
+            sys_set = self._system_qos_cpuset()
+            if sys_set:
+                ctx.add_update("cpuset.cpus", sys_set)
+            return
         raw = ctx.pod.pod.meta.annotations.get(ANNOTATION_RESOURCE_STATUS)
         if not raw:
             return
@@ -329,7 +353,7 @@ def default_hook_server(informer: StatesInformer,
                         ) -> HookServer:
     return HookServer([
         GroupIdentityHook(informer),
-        CPUSetHook(),
+        CPUSetHook(informer),
         BatchResourceHook(),
         CoreSchedHook(core_sched or FakeCoreSched()),
         GPUEnvHook(),

@@ -26,6 +26,7 @@ from koordinator_tpu.api.extension import (
     PriorityClass,
     QoSClass,
     ResourceKind,
+    parse_system_qos_resource,
 )
 from koordinator_tpu.koordlet import metriccache as mc
 from koordinator_tpu.koordlet.system import CgroupDriver, pod_cgroup_dir
@@ -36,6 +37,7 @@ STATE_PODS = "pods"
 STATE_NODE_SLO = "node_slo"
 STATE_TOPOLOGY = "node_topology"
 STATE_DEVICE = "device"
+STATE_PVCS = "pvcs"
 
 _BYTES_PER_MIB = float(1 << 20)
 
@@ -86,6 +88,7 @@ class StatesInformer:
         self._node_slo: Optional[api.NodeSLO] = None
         self._topology: Optional[api.NodeResourceTopology] = None
         self._device: Optional[api.Device] = None
+        self._pvc_volumes: Dict[str, str] = {}
         self._callbacks: Dict[str, List[Callable[[object], None]]] = {}
 
     def subscribe(self, state: str, cb: Callable[[object], None]) -> None:
@@ -122,6 +125,16 @@ class StatesInformer:
             self._device = device
         self._notify(STATE_DEVICE, device)
 
+    def set_pvcs(self, pvcs: List[api.PersistentVolumeClaim]) -> None:
+        """PVC informer update (states_pvc.go updateVolumeNameMap): keeps
+        the namespace/name -> bound-volume map the blkio strategy resolves
+        podvolume block configs through."""
+        with self._lock:
+            self._pvc_volumes = {
+                f"{p.meta.namespace}/{p.meta.name}": p.volume_name
+                for p in pvcs}
+        self._notify(STATE_PVCS, pvcs)
+
     # --- getters --------------------------------------------------------
     def get_node(self) -> Optional[api.Node]:
         with self._lock:
@@ -146,6 +159,12 @@ class StatesInformer:
     def get_device(self) -> Optional[api.Device]:
         with self._lock:
             return self._device
+
+    def get_volume_name(self, namespace: str, claim_name: str) -> str:
+        """'' when the claim is unknown/unbound (states_pvc.go
+        GetVolumeName)."""
+        with self._lock:
+            return self._pvc_volumes.get(f"{namespace}/{claim_name}", "")
 
 
 @dataclasses.dataclass
@@ -273,8 +292,22 @@ class TopologyReporter:
         self.informer = informer
         self.node_name = node_name
 
+    def _system_qos_exclusive(self) -> set:
+        """Exclusive SystemQOS cores (node system-qos-resource annotation)
+        are carved OUT of the reported topology — the scheduler must not
+        hand them to LS/LSR/BE pods (states_noderesourcetopology.go:359-360
+        removeSystemQOSCPUs)."""
+        node = self.informer.get_node()
+        if node is None:
+            return set()
+        res = parse_system_qos_resource(node.meta.annotations)
+        if res and res["exclusive"]:
+            return set(res["cpus"])
+        return set()
+
     def report(self) -> api.NodeResourceTopology:
         cpus = self.host.cpu_topology()
+        excl = self._system_qos_exclusive()
         by_node: Dict[int, List] = {}
         for c in cpus:
             by_node.setdefault(c.node_id, []).append(c)
@@ -282,7 +315,7 @@ class TopologyReporter:
         n_zones = max(len(by_node), 1)
         zones = []
         for node_id in sorted(by_node):
-            members = by_node[node_id]
+            members = [c for c in by_node[node_id] if c.cpu_id not in excl]
             mask = 0
             for c in members:
                 mask |= 1 << c.cpu_id

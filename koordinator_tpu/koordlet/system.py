@@ -81,6 +81,10 @@ RESOURCES: Dict[str, CgroupResource] = {r.name: r for r in [
     CgroupResource("io.pressure", "io", "io.pressure", "io.pressure"),
     CgroupResource("blkio.throttle.read_bps_device", "blkio", "blkio.throttle.read_bps_device", "io.max"),
     CgroupResource("blkio.throttle.write_bps_device", "blkio", "blkio.throttle.write_bps_device", "io.max"),
+    CgroupResource("blkio.throttle.read_iops_device", "blkio", "blkio.throttle.read_iops_device", "io.max"),
+    CgroupResource("blkio.throttle.write_iops_device", "blkio", "blkio.throttle.write_iops_device", "io.max"),
+    # "<device> <weight>" lines — no scalar range check
+    CgroupResource("blkio.cost.weight", "blkio", "blkio.cost.weight", "io.cost.weight"),
     CgroupResource("blkio.weight", "blkio", "blkio.weight", "io.weight", (1, 1000)),
 ]}
 

@@ -1,0 +1,295 @@
+"""Docker-engine variant of the CRI-interposing proxy.
+
+Capability parity with pkg/runtimeproxy/server/docker (SURVEY.md 2.5): the
+reference interposes the Docker Engine HTTP API between kubelet's
+dockershim and dockerd, pattern-matching /containers/create, .../start,
+.../update and .../stop (docker/server.go:63-66) and translating the
+request's HostConfig resources through the same RuntimeHookService
+protocol the CRI variant uses. Pod identity rides docker labels: a
+sandbox is `io.kubernetes.docker.type == "podsandbox"`, containers point
+at their sandbox via `io.kubernetes.sandbox.id`
+(docker/docker_types.go:27-30), and annotation-prefixed labels are split
+back out into annotations (docker/utils.go:123 splitLabelsAndAnnotations).
+
+Here the same interposition is a JSON-body transform layer: `handle(path,
+body)` routes exactly the reference's four endpoints, calls the hook
+server before forwarding to the `DockerBackend`, and merges the hook's
+LinuxContainerResources into the body's HostConfig — so a koordlet hook
+(batchresource, cpuset, groupidentity via unified) shapes docker
+containers the same way it shapes CRI ones.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import re
+from typing import Dict, Optional, Protocol
+
+from koordinator_tpu.runtimeproxy import api_pb2 as pb
+from koordinator_tpu.runtimeproxy.rpc import RpcClient, RpcError
+from koordinator_tpu.runtimeproxy.server import FailurePolicy
+from koordinator_tpu.runtimeproxy.store import (
+    ContainerInfo,
+    MetaStore,
+    PodSandboxInfo,
+)
+
+log = logging.getLogger(__name__)
+
+CONTAINER_TYPE_LABEL = "io.kubernetes.docker.type"
+CONTAINER_TYPE_SANDBOX = "podsandbox"
+SANDBOX_ID_LABEL = "io.kubernetes.sandbox.id"
+POD_NAME_LABEL = "io.kubernetes.pod.name"
+POD_NAMESPACE_LABEL = "io.kubernetes.pod.namespace"
+POD_UID_LABEL = "io.kubernetes.pod.uid"
+ANNOTATION_PREFIX = "annotation."
+
+# container references may be ids OR names: [a-zA-Z0-9][a-zA-Z0-9_.-]*
+# (docker's reference grammar) — \w+ would silently pass-through legal
+# by-name addressing like "my-app.1"
+_REF = r"(?P<id>[a-zA-Z0-9][a-zA-Z0-9_.-]*)"
+_ROUTES = (
+    (re.compile(r"^/(v\d\.\d+/)?containers/create$"), "create"),
+    (re.compile(r"^/(v\d\.\d+/)?containers/" + _REF + r"/start$"), "start"),
+    (re.compile(r"^/(v\d\.\d+/)?containers/" + _REF + r"/update$"), "update"),
+    (re.compile(r"^/(v\d\.\d+/)?containers/" + _REF + r"/stop"), "stop"),
+)
+
+
+class DockerBackend(Protocol):
+    """The real dockerd (stand-in): receives the merged body."""
+
+    def create(self, body: dict) -> str: ...       # returns container id
+    def start(self, container_id: str) -> None: ...
+    def update(self, container_id: str, body: dict) -> None: ...
+    def stop(self, container_id: str) -> None: ...
+
+
+def split_labels_and_annotations(configs: Dict[str, str]
+                                 ) -> (dict, dict):
+    """docker labels carry annotations under the `annotation.` prefix
+    (utils.go splitLabelsAndAnnotations)."""
+    labels, annos = {}, {}
+    for k, v in (configs or {}).items():
+        if k.startswith(ANNOTATION_PREFIX):
+            annos[k[len(ANNOTATION_PREFIX):]] = v
+        else:
+            labels[k] = v
+    return labels, annos
+
+
+def _host_config_to_pb(host_config: dict) -> pb.LinuxContainerResources:
+    res = pb.LinuxContainerResources(
+        cpu_shares=int(host_config.get("CpuShares", 0) or 0),
+        cpu_quota=int(host_config.get("CpuQuota", 0) or 0),
+        cpu_period=int(host_config.get("CpuPeriod", 0) or 0),
+        memory_limit_in_bytes=int(host_config.get("Memory", 0) or 0),
+        cpuset_cpus=str(host_config.get("CpusetCpus", "") or ""),
+        cpuset_mems=str(host_config.get("CpusetMems", "") or ""))
+    for k, v in (host_config.get("Unified") or {}).items():
+        res.unified[k] = v
+    return res
+
+
+def _merge_pb_into_host_config(res: pb.LinuxContainerResources,
+                               host_config: dict) -> None:
+    """Hook response resources override the forwarded HostConfig where set
+    (docker/utils.go UpdateHostConfigByResource)."""
+    if res.cpu_shares:
+        host_config["CpuShares"] = int(res.cpu_shares)
+    if res.cpu_quota:
+        host_config["CpuQuota"] = int(res.cpu_quota)
+    if res.cpu_period:
+        host_config["CpuPeriod"] = int(res.cpu_period)
+    if res.memory_limit_in_bytes:
+        host_config["Memory"] = int(res.memory_limit_in_bytes)
+    if res.cpuset_cpus:
+        host_config["CpusetCpus"] = str(res.cpuset_cpus)
+    if res.cpuset_mems:
+        host_config["CpusetMems"] = str(res.cpuset_mems)
+    if res.unified:
+        unified = dict(host_config.get("Unified") or {})
+        unified.update(dict(res.unified))
+        host_config["Unified"] = unified
+
+
+@dataclasses.dataclass
+class DockerResponse:
+    ok: bool = True
+    container_id: str = ""
+    error: str = ""
+
+
+class DockerProxy:
+    """The RuntimeManagerDockerServer equivalent over typed JSON bodies."""
+
+    def __init__(self, backend: DockerBackend,
+                 hook_client: Optional[RpcClient] = None,
+                 failure_policy: FailurePolicy = FailurePolicy.IGNORE,
+                 store: Optional[MetaStore] = None):
+        self.backend = backend
+        self.hooks = hook_client
+        self.failure_policy = failure_policy
+        self.store = store or MetaStore()
+        # container id -> last create body (docker /update bodies carry
+        # only the resource fields; identity comes from the create)
+        self._bodies: Dict[str, dict] = {}
+
+    # -- routing (docker/server.go:63-66) ------------------------------------
+
+    def handle(self, path: str, body: Optional[dict] = None,
+               ) -> DockerResponse:
+        for pattern, op in _ROUTES:
+            m = pattern.match(path)
+            if m:
+                cid = m.groupdict().get("id", "")
+                if op == "create":
+                    return self.create(body or {})
+                if op == "start":
+                    return self.start(cid)
+                if op == "update":
+                    return self.update(cid, body or {})
+                return self.stop(cid)
+        # everything else passes through untouched (the reference reverse-
+        # proxies unmatched paths directly to dockerd)
+        return DockerResponse(ok=True)
+
+    # -- hook plumbing --------------------------------------------------------
+
+    def _call_hook(self, method: str, request, response_cls):
+        if self.hooks is None:
+            return None
+        try:
+            return self.hooks.call(method, request, response_cls)
+        except (RpcError, OSError) as e:
+            if self.failure_policy is FailurePolicy.FAIL:
+                raise
+            log.warning("docker hook %s failed (policy Ignore): %s",
+                        method, e)
+            return None
+
+    # -- endpoints ------------------------------------------------------------
+
+    def create(self, body: dict) -> DockerResponse:
+        labels, annos = split_labels_and_annotations(body.get("Labels"))
+        host_config = body.setdefault("HostConfig", {})
+        is_sandbox = labels.get(CONTAINER_TYPE_LABEL) == CONTAINER_TYPE_SANDBOX
+        try:
+            if is_sandbox:
+                req = pb.PodSandboxHookRequest(
+                    pod_meta=pb.PodSandboxMetadata(
+                        name=labels.get(POD_NAME_LABEL, ""),
+                        namespace=labels.get(POD_NAMESPACE_LABEL, ""),
+                        uid=labels.get(POD_UID_LABEL, "")),
+                    cgroup_parent=host_config.get("CgroupParent", ""),
+                    resources=_host_config_to_pb(host_config))
+                for k, v in labels.items():
+                    req.labels[k] = v
+                for k, v in annos.items():
+                    req.annotations[k] = v
+                resp = self._call_hook("PreRunPodSandboxHook", req,
+                                       pb.PodSandboxHookResponse)
+                if resp is not None:
+                    if resp.cgroup_parent:
+                        host_config["CgroupParent"] = resp.cgroup_parent
+                    _merge_pb_into_host_config(resp.resources, host_config)
+            else:
+                sandbox = self.store.pods.get(
+                    labels.get(SANDBOX_ID_LABEL, "")) or PodSandboxInfo()
+                req = pb.ContainerResourceHookRequest(
+                    pod_meta=pb.PodSandboxMetadata(
+                        name=sandbox.name or labels.get(POD_NAME_LABEL, ""),
+                        namespace=sandbox.namespace
+                        or labels.get(POD_NAMESPACE_LABEL, ""),
+                        uid=sandbox.uid or labels.get(POD_UID_LABEL, "")),
+                    container_resources=_host_config_to_pb(host_config),
+                    pod_cgroup_parent=sandbox.cgroup_parent)
+                for k, v in annos.items():
+                    req.container_annotations[k] = v
+                for k, v in sandbox.labels.items():
+                    req.pod_labels[k] = v
+                for k, v in sandbox.annotations.items():
+                    req.pod_annotations[k] = v
+                resp = self._call_hook("PreCreateContainerHook", req,
+                                       pb.ContainerResourceHookResponse)
+                if resp is not None:
+                    _merge_pb_into_host_config(resp.container_resources,
+                                               host_config)
+        except (RpcError, OSError) as e:
+            return DockerResponse(ok=False, error=str(e))
+        cid = self.backend.create(body)
+        self._bodies[cid] = body
+        if is_sandbox:
+            self.store.put_pod(cid, PodSandboxInfo(
+                name=labels.get(POD_NAME_LABEL, ""),
+                namespace=labels.get(POD_NAMESPACE_LABEL, ""),
+                uid=labels.get(POD_UID_LABEL, ""),
+                cgroup_parent=host_config.get("CgroupParent", ""),
+                labels=labels, annotations=annos))
+        else:
+            self.store.put_container(cid, ContainerInfo(
+                name=labels.get("io.kubernetes.container.name", ""),
+                pod_sandbox_id=labels.get(SANDBOX_ID_LABEL, "")))
+        return DockerResponse(ok=True, container_id=cid)
+
+    def start(self, container_id: str) -> DockerResponse:
+        self.backend.start(container_id)
+        body = self._bodies.get(container_id, {})
+        labels, _ = split_labels_and_annotations(body.get("Labels"))
+        if labels.get(CONTAINER_TYPE_LABEL) != CONTAINER_TYPE_SANDBOX:
+            # PostStartContainerHook is a notification: failures never
+            # fail the already-started container
+            try:
+                self._call_hook(
+                    "PostStartContainerHook",
+                    pb.ContainerResourceHookRequest(
+                        container_meta=pb.ContainerMetadata(
+                            id=container_id)),
+                    pb.ContainerResourceHookResponse)
+            except (RpcError, OSError):
+                pass
+        return DockerResponse(ok=True, container_id=container_id)
+
+    def update(self, container_id: str, body: dict) -> DockerResponse:
+        host_config = body  # docker /update bodies ARE the resource set
+        try:
+            resp = self._call_hook(
+                "PreUpdateContainerResourcesHook",
+                pb.ContainerResourceHookRequest(
+                    container_meta=pb.ContainerMetadata(id=container_id),
+                    container_resources=_host_config_to_pb(host_config)),
+                pb.ContainerResourceHookResponse)
+        except (RpcError, OSError) as e:
+            return DockerResponse(ok=False, error=str(e))
+        if resp is not None:
+            _merge_pb_into_host_config(resp.container_resources, host_config)
+        self.backend.update(container_id, body)
+        return DockerResponse(ok=True, container_id=container_id)
+
+    def stop(self, container_id: str) -> DockerResponse:
+        self.backend.stop(container_id)
+        body = self._bodies.pop(container_id, {})
+        labels, _ = split_labels_and_annotations(body.get("Labels"))
+        method = ("PostStopPodSandboxHook"
+                  if labels.get(CONTAINER_TYPE_LABEL)
+                  == CONTAINER_TYPE_SANDBOX else "PostStopContainerHook")
+        # post-stop hooks are cleanup notifications — always Ignore
+        try:
+            if method == "PostStopPodSandboxHook":
+                self._call_hook(method, pb.PodSandboxHookRequest(),
+                                pb.PodSandboxHookResponse)
+            else:
+                self._call_hook(
+                    method,
+                    pb.ContainerResourceHookRequest(
+                        container_meta=pb.ContainerMetadata(
+                            id=container_id)),
+                    pb.ContainerResourceHookResponse)
+        except (RpcError, OSError):
+            pass
+        if labels.get(CONTAINER_TYPE_LABEL) == CONTAINER_TYPE_SANDBOX:
+            self.store.delete_pod(container_id)
+        else:
+            self.store.delete_container(container_id)
+        return DockerResponse(ok=True, container_id=container_id)

@@ -47,6 +47,39 @@ def resource_status_annotation(result, pod_index: int,
     return {ANNOTATION_RESOURCE_STATUS: json.dumps(status)}
 
 
+def resize_reserve_pod(snap: ClusterSnapshot, pods: PodBatch, result,
+                       pod_index: int, reservation, gate=None) -> bool:
+    """ResizePod: after Reserve, rewrite a placed RESERVE pod's resource
+    spec to the CONCRETE device allocation, so the Reservation's
+    allocatable reflects what was actually taken on the chosen node —
+    notably a gpu-memory-ratio request becomes exact gpu-memory for that
+    node's GPU model (frameworkext interface.go:176-180 ResizePodPlugin;
+    deviceshare plugin.go:461-481; gated by scheduler_features.go:59).
+    Returns True when the reservation's requests were rewritten."""
+    from koordinator_tpu.api.extension import ResourceKind as RK
+    from koordinator_tpu.features import DEFAULT_FEATURE_GATE
+    from koordinator_tpu.scheduler.plugins import deviceshare
+
+    gate = gate if gate is not None else DEFAULT_FEATURE_GATE
+    if not gate.enabled("ResizePod"):
+        return False
+    if int(np.asarray(result.assignment)[pod_index]) < 0:
+        return False
+    take = np.asarray(result.gpu_take)[pod_index]
+    n_taken = int(take.sum())
+    if n_taken == 0:
+        return False
+    _, per = deviceshare.per_instance_at(
+        snap.devices, pods, np.asarray(result.assignment))
+    per_row = np.asarray(per)[pod_index]
+    from koordinator_tpu.snapshot.schema import DEV_CORE, DEV_MEM
+    reservation.requests[RK.GPU_CORE] = float(per_row[DEV_CORE]) * n_taken
+    reservation.requests[RK.GPU_MEMORY] = float(per_row[DEV_MEM]) * n_taken
+    # the spec is now concrete: a ratio request no longer applies
+    reservation.gpu_memory_ratio = 0.0
+    return True
+
+
 def device_allocation_annotation(snap: ClusterSnapshot, pods: PodBatch,
                                  result, pod_index: int) -> Dict[str, str]:
     """The device-allocation annotation from the result's instance masks;

@@ -302,8 +302,10 @@ def schedule_batch(snap: ClusterSnapshot, pods: PodBatch,
         # --- feasibility [P, N+V] (HOT LOOP #1) ---
         fit = jnp.all(dims(pods.requests)[:, None, :] + dims(requested)[None]
                       <= dims(ext_alloc)[None] + EPS, axis=-1)
-        if enable_amplification:
-            # CPU-bind pods must also fit their AMPLIFIED cpu request
+        if enable_amplification and (fd is None or ci in fd):
+            # CPU-bind pods must also fit their AMPLIFIED cpu request —
+            # but only when the caller checks the CPU dim at all
+            # (fit_dims excluding CPU must stay excluded)
             amp_cpu = pods.requests[:, ci][:, None] * jnp.where(
                 pods.numa_single[:, None], amp_ext[None, :], 1.0)  # [P, N+V]
             fit &= amp_cpu + requested[None, :, ci] \

@@ -18,6 +18,7 @@ from koordinator_tpu.koordlet.prediction import (
 )
 from koordinator_tpu.koordlet.qosmanager import (
     BE_ROOT,
+    BlkIOReconcile,
     CPUBurst,
     CPUEvict,
     CPUSuppress,
@@ -467,3 +468,144 @@ def test_evictor_dedup_and_drain():
     assert len(ev.drain()) == 1
     ev(pod, "after drain")
     assert len(ev.evicted) == 1
+
+
+# --- SystemQOS (apis/extension/system_qos.go) -------------------------------
+
+def _set_system_qos(informer, spec: str):
+    from koordinator_tpu.api.extension import (
+        ANNOTATION_NODE_SYSTEM_QOS_RESOURCE,
+    )
+
+    node = informer.get_node()
+    node.meta.annotations[ANNOTATION_NODE_SYSTEM_QOS_RESOURCE] = spec
+    informer.set_node(node)
+
+
+def test_parse_system_qos_resource():
+    from koordinator_tpu.api.extension import (
+        ANNOTATION_NODE_SYSTEM_QOS_RESOURCE,
+        parse_system_qos_resource,
+    )
+
+    anno = {ANNOTATION_NODE_SYSTEM_QOS_RESOURCE:
+            '{"cpuset": "0-1,6", "cpusetExclusive": false}'}
+    got = parse_system_qos_resource(anno)
+    assert got == {"cpuset": "0-1,6", "cpus": [0, 1, 6], "exclusive": False}
+    # exclusive defaults TRUE (system_qos.go:36-39)
+    got = parse_system_qos_resource(
+        {ANNOTATION_NODE_SYSTEM_QOS_RESOURCE: '{"cpuset": "2"}'})
+    assert got["exclusive"] is True and got["cpus"] == [2]
+    assert parse_system_qos_resource({}) is None
+    assert parse_system_qos_resource(
+        {ANNOTATION_NODE_SYSTEM_QOS_RESOURCE: "not-json"}) is None
+    assert parse_system_qos_resource(
+        {ANNOTATION_NODE_SYSTEM_QOS_RESOURCE: '{"cpuset": ""}'}) is None
+
+
+def test_cpusuppress_avoids_exclusive_system_qos_cpus(env):
+    """BE suppress never lands on exclusive SystemQOS cores
+    (cpu_suppress.go:366-376)."""
+    host, informer, cache, executor = env
+    _set_system_qos(informer, '{"cpuset": "0-3"}')
+    informer.set_pods([])
+    for t in (0.0, 30.0):
+        cache.append(mc.NODE_CPU_USAGE, t, 1.0)
+        cache.append(mc.BE_CPU_USAGE, t, 0.5)
+        cache.append(mc.SYS_CPU_USAGE, t, 0.5)
+    CPUSuppress(informer, cache, executor).reconcile(now=30.0)
+    got = parse_cpuset(host.read_cgroup(BE_ROOT, "cpuset.cpus"))
+    assert got and not set(got) & {0, 1, 2, 3}
+    # non-exclusive system cpus are usable again
+    _set_system_qos(informer, '{"cpuset": "0-3", "cpusetExclusive": false}')
+    CPUSuppress(informer, cache, executor).reconcile(now=30.0)
+    got = parse_cpuset(host.read_cgroup(BE_ROOT, "cpuset.cpus"))
+    assert got  # policy free to use any cores now
+
+
+def test_system_qos_pod_gets_system_cpuset(env):
+    """SYSTEM QoS pods inherit the node system-qos cpuset
+    (cpuset/rule.go:105-111)."""
+    host, informer, cache, executor = env
+    _set_system_qos(informer, '{"cpuset": "6-7"}')
+    pod = make_pod("sysd", qos="SYSTEM")
+    host.make_cgroup(pod.cgroup_dir)
+    informer.set_pods([pod])
+    server = default_hook_server(informer)
+    ctx = HookContext(pod=pod, stage=Stage.PRE_CREATE_CONTAINER)
+    server.run_hooks(Stage.PRE_CREATE_CONTAINER, ctx)
+    writes = {u.resource: u.value for u in ctx.cgroup_updates}
+    assert writes.get("cpuset.cpus") == "6-7"
+
+
+def test_topology_reporter_excludes_system_qos(tmp_path):
+    """Exclusive SystemQOS cores vanish from the reported NRT zones
+    (states_noderesourcetopology.go removeSystemQOSCPUs)."""
+    from koordinator_tpu.koordlet.statesinformer import TopologyReporter
+
+    host = FakeHost(str(tmp_path), num_cpus=8, mem_bytes=16 << 30)
+    informer = StatesInformer()
+    informer.set_node(api.Node(meta=api.ObjectMeta(name="n0")))
+    _set_system_qos(informer, '{"cpuset": "0-1"}')
+    topo = TopologyReporter(host, informer, "n0").report()
+    total_cpu = sum(z.cpus_milli for z in topo.zones)
+    assert total_cpu == 6000.0
+    for z in topo.zones:
+        assert not (z.cpuset & 0b11)  # cpus 0,1 masked out
+
+
+# --- PVC informer + blkio block throttles (states_pvc.go, blkio) ------------
+
+def test_pvc_informer_and_blkio_blocks(env):
+    import os
+
+    host, informer, cache, executor = env
+    for tier in ("kubepods", "kubepods/burstable", "kubepods/besteffort"):
+        os.makedirs(os.path.join(host.cgroup_root, "blkio", tier),
+                    exist_ok=True)
+    informer.set_pvcs([api.PersistentVolumeClaim(
+        meta=api.ObjectMeta(name="data", namespace="default"),
+        volume_name="pv-123")])
+    assert informer.get_volume_name("default", "data") == "pv-123"
+    assert informer.get_volume_name("default", "missing") == ""
+    slo = informer.get_node_slo()
+    slo.blkio_blocks = [
+        api.BlockCfg(name="default/data", block_type="podvolume",
+                     read_iops=500, io_weight_percent=60),
+        api.BlockCfg(name="/dev/sdb", block_type="device", write_bps=1 << 20),
+        api.BlockCfg(name="default/unbound", block_type="podvolume",
+                     read_iops=100),  # unresolvable -> skipped
+    ]
+    informer.set_node_slo(slo)
+    BlkIOReconcile(informer, executor).reconcile(now=0.0)
+    assert host.read_cgroup(BE_ROOT,
+                            "blkio.throttle.read_iops_device") == "pv-123 500"
+    assert host.read_cgroup(BE_ROOT,
+                            "blkio.cost.weight") == "pv-123 60"
+    assert host.read_cgroup(
+        BE_ROOT, "blkio.throttle.write_bps_device") == f"/dev/sdb {1 << 20}"
+
+
+def test_blkio_removed_block_resets_throttle(env):
+    """Regression: dropping a block from the SLO (or zeroing its limit)
+    must reset the previously written kernel limit, not leave it live."""
+    import os
+
+    host, informer, cache, executor = env
+    for tier in ("kubepods", "kubepods/burstable", "kubepods/besteffort"):
+        os.makedirs(os.path.join(host.cgroup_root, "blkio", tier),
+                    exist_ok=True)
+    slo = informer.get_node_slo()
+    slo.blkio_blocks = [api.BlockCfg(name="/dev/sdb", read_iops=500,
+                                     io_weight_percent=60)]
+    informer.set_node_slo(slo)
+    r = BlkIOReconcile(informer, executor)
+    r.reconcile(now=0.0)
+    assert host.read_cgroup(
+        BE_ROOT, "blkio.throttle.read_iops_device") == "/dev/sdb 500"
+    slo.blkio_blocks = []
+    informer.set_node_slo(slo)
+    r.reconcile(now=10.0)
+    assert host.read_cgroup(
+        BE_ROOT, "blkio.throttle.read_iops_device") == "/dev/sdb 0"
+    assert host.read_cgroup(BE_ROOT, "blkio.cost.weight") == "/dev/sdb 100"

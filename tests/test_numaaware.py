@@ -344,3 +344,14 @@ def test_amplified_running_pod_and_forget_roundtrip():
                        np.ones((batch.valid.shape[0],), bool))
     reverted = np.asarray(back.nodes.requested)[0, int(RK.CPU)]
     assert reverted == pytest.approx(8000.0)
+
+
+def test_amplification_respects_fit_dims():
+    """Regression: fit_dims excluding CPU must keep CPU unchecked even
+    with the amplified gates compiled in."""
+    n = amplified_node("amp", zone_cpu=8000.0, zones=2, ratio=2.0)
+    over = [Pod(meta=ObjectMeta(name="big"), priority=9000,
+                requests={RK.CPU: 50_000.0, RK.MEMORY: 512.0})]
+    res = build([n], over, enable_amplification=True,
+                fit_dims=(int(RK.MEMORY),))
+    assert int(np.asarray(res.assignment)[0]) == 0  # CPU ignored

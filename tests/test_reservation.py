@@ -351,3 +351,44 @@ def test_shared_reservation_zone_hold_drains_across_consumers():
     assert z[0] == 0 and z[1] == 0
     rnf = np.asarray(res.snapshot.reservations.numa_free)[0]
     np.testing.assert_allclose(rnf[0, 0], 1_000.0)
+
+
+def test_resize_reserve_pod_makes_ratio_concrete():
+    """ResizePod (gated): a reserve pod requesting gpu-memory-ratio gets
+    its Reservation spec rewritten to the CONCRETE core/memory of the
+    chosen node's GPU model (deviceshare plugin.go:461-481)."""
+    from koordinator_tpu.api.types import Device, DeviceInfo
+    from koordinator_tpu.features import new_default_gate
+    from koordinator_tpu.scheduler.bind import resize_reserve_pod
+    from koordinator_tpu.scheduler.errorhandler import reserve_pod_for
+
+    b = SnapshotBuilder(max_nodes=1, max_gpu_inst=2)
+    b.add_node(Node(meta=ObjectMeta(name="n0"),
+                    allocatable={RK.CPU: 32000.0, RK.MEMORY: 64000.0}))
+    b.set_node_metric(NodeMetric(node_name="n0", update_time=NOW,
+                                 node_usage={}))
+    b.add_device(Device(node_name="n0", devices=[
+        DeviceInfo(minor=m, type="gpu",
+                   resources={RK.GPU_CORE: 100.0, RK.GPU_MEMORY: 16000.0})
+        for m in range(2)]))
+    snap, ctx = b.build(now=NOW)
+    r = Reservation(meta=ObjectMeta(name="r0", uid="u0"),
+                    requests={RK.CPU: 1000.0, RK.MEMORY: 1024.0,
+                              RK.GPU_CORE: 50.0},
+                    gpu_memory_ratio=50.0)
+    pod = reserve_pod_for(r)
+    pod.gpu_memory_ratio = r.gpu_memory_ratio
+    pod.priority = 9000
+    batch = b.build_pod_batch([pod], ctx)
+    res = core.schedule_batch(snap, batch, CFG, num_rounds=2)
+    assert int(np.asarray(res.assignment)[0]) == 0
+    gate = new_default_gate()
+    # gate off (default): spec untouched
+    assert not resize_reserve_pod(snap, batch, res, 0, r, gate=gate)
+    assert RK.GPU_MEMORY not in r.requests
+    gate.set("ResizePod", True)
+    assert resize_reserve_pod(snap, batch, res, 0, r, gate=gate)
+    # ratio 50% of a 16000-MiB GPU = 8000 MiB, 50 core
+    assert r.requests[RK.GPU_MEMORY] == 8000.0
+    assert r.requests[RK.GPU_CORE] == 50.0
+    assert r.gpu_memory_ratio == 0.0

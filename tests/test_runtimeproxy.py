@@ -333,3 +333,105 @@ def test_stop_container_cleans_store(hook_endpoint):
     proxy.stop_container(ContainerRequest(container_id="c1",
                                           sandbox_id="sb1", name="main"))
     assert "c1" not in proxy.store.containers
+
+
+# --- docker engine variant (runtimeproxy/server/docker) ---------------------
+
+
+class FakeDockerd:
+    """Records forwarded Docker Engine calls."""
+
+    def __init__(self):
+        self.calls = []
+        self._next = 0
+
+    def create(self, body):
+        self._next += 1
+        cid = f"d{self._next}"
+        self.calls.append(("create", cid, body))
+        return cid
+
+    def start(self, cid):
+        self.calls.append(("start", cid, None))
+
+    def update(self, cid, body):
+        self.calls.append(("update", cid, body))
+
+    def stop(self, cid):
+        self.calls.append(("stop", cid, None))
+
+
+def test_docker_proxy_interposes_lifecycle(hook_endpoint):
+    """A BE pod created through the docker API shape gets the same QoS
+    adjustments the CRI path applies (docker/handler.go), with routing by
+    the reference's path regexes (docker/server.go:63-66)."""
+    from koordinator_tpu.runtimeproxy.docker import DockerProxy
+
+    dockerd = FakeDockerd()
+    proxy = DockerProxy(dockerd, RpcClient(hook_endpoint),
+                        FailurePolicy.FAIL)
+    sandbox_body = {
+        "Labels": {
+            "io.kubernetes.docker.type": "podsandbox",
+            "io.kubernetes.pod.name": "spark-1",
+            "io.kubernetes.pod.namespace": "default",
+            "io.kubernetes.pod.uid": "u1",
+            LABEL_POD_QOS: "BE",
+        },
+        "HostConfig": {"CgroupParent": "kubepods/besteffort/podu1"},
+    }
+    resp = proxy.handle("/v1.41/containers/create", sandbox_body)
+    assert resp.ok
+    sb_id = resp.container_id
+    # BE group identity rides the created sandbox HostConfig
+    assert sandbox_body["HostConfig"]["Unified"]["cpu.bvt_warp_ns"] == "-1"
+    # container pointing at the sandbox; cpuset annotation applies
+    container_body = {
+        "Labels": {
+            "io.kubernetes.docker.type": "container",
+            "io.kubernetes.container.name": "main",
+            "io.kubernetes.sandbox.id": sb_id,
+        },
+        "HostConfig": {"CpuShares": 1024},
+    }
+    proxy.store.pods[sb_id].annotations[ANNOTATION_RESOURCE_STATUS] = \
+        json.dumps({"cpuset": "4-7", "numaNodes": [1]})
+    resp = proxy.handle("/v1.41/containers/create", container_body)
+    assert resp.ok
+    cid = resp.container_id
+    assert container_body["HostConfig"]["CpusetCpus"] == "4-7"
+    assert proxy.store.pod_of_container(cid).name == "spark-1"
+    proxy.handle(f"/v1.41/containers/{cid}/start")
+    # update bodies are bare resource sets
+    upd = {"CpuShares": 512}
+    assert proxy.handle(f"/v1.41/containers/{cid}/update", upd).ok
+    proxy.handle(f"/v1.41/containers/{cid}/stop?t=10")
+    proxy.handle(f"/v1.41/containers/{sb_id}/stop?t=10")
+    assert [c[0] for c in dockerd.calls] == [
+        "create", "create", "start", "update", "stop", "stop"]
+    assert not proxy.store.pods and not proxy.store.containers
+    # unmatched paths pass through untouched
+    assert proxy.handle("/v1.41/images/json").ok
+
+
+def test_docker_proxy_annotation_prefix_split():
+    from koordinator_tpu.runtimeproxy.docker import (
+        split_labels_and_annotations,
+    )
+
+    labels, annos = split_labels_and_annotations({
+        "annotation.scheduling.koordinator.sh/resource-status": "{}",
+        "io.kubernetes.pod.name": "p"})
+    assert labels == {"io.kubernetes.pod.name": "p"}
+    assert annos == {"scheduling.koordinator.sh/resource-status": "{}"}
+
+
+def test_docker_proxy_routes_by_container_name(hook_endpoint):
+    """Regression: docker references with '-'/'.' (by-name addressing)
+    must hit the routes, not fall through to pass-through."""
+    from koordinator_tpu.runtimeproxy.docker import DockerProxy
+
+    dockerd = FakeDockerd()
+    proxy = DockerProxy(dockerd, RpcClient(hook_endpoint))
+    assert proxy.handle("/v1.41/containers/my-app.1/stop?t=5").ok
+    assert dockerd.calls == [("stop", "my-app.1", None)]
