@@ -163,6 +163,11 @@ class NodeResourceTopology:
     cpus_per_core: int = 2  # SMT siblings per physical core
     kubelet_reserved_cpuset: int = 0
     policy: str = "None"    # kubelet topology manager policy
+    # CPU share pools (states_noderesourcetopology.go:359-360): the cpus
+    # LS pods may roam = all cpus - LSE/LSR-pinned - exclusive SystemQOS;
+    # the BE pool additionally serves suppress-managed BE pods
+    ls_share_pool: str = ""  # cpuset list string, "" = not reported
+    be_share_pool: str = ""
 
 
 @dataclasses.dataclass

@@ -1,0 +1,139 @@
+"""Kubelet pull edge: the agent reads pods from the kubelet's /pods
+endpoint instead of watching the apiserver.
+
+Capability parity with statesinformer/impl/kubelet_stub.go:38-80 — the
+reference polls `GET <scheme>://<addr>:<port>/pods/` on the pods informer
+resync and converts the returned PodList into the informer's pod state.
+Here the same pull: an HTTP GET with a bearer token (the reference rides
+the rest.Config transport), decoding a minimal PodList JSON (name/
+namespace/uid/labels/annotations, per-container requests/limits, phase,
+nodeName) into typed `api.Pod` rows pushed through
+`StatesInformer.set_pods`, so every downstream consumer (qosmanager,
+runtimehooks, reporters) is fed identically whether pods arrive by pull
+or by push.
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import logging
+import urllib.request
+from typing import List, Optional
+
+from koordinator_tpu.api import types as api
+from koordinator_tpu.api.extension import LABEL_POD_QOS, RESOURCE_NAMES
+from koordinator_tpu.koordlet.statesinformer import PodMeta, StatesInformer
+
+log = logging.getLogger(__name__)
+
+
+def _parse_quantity(v) -> float:
+    """k8s quantity -> this framework's native units (milli-cpu for cpu,
+    MiB for memory, raw float otherwise). Supports the suffixes kubelet
+    emits for pod resources: m, Ki/Mi/Gi/Ti, k/M/G/T, plain numbers."""
+    s = str(v).strip()
+    try:
+        return float(s)
+    except ValueError:
+        pass
+    suffixes = {"m": 1e-3, "k": 1e3, "M": 1e6, "G": 1e9, "T": 1e12,
+                "Ki": float(1 << 10), "Mi": float(1 << 20),
+                "Gi": float(1 << 30), "Ti": float(1 << 40)}
+    for suf in ("Ki", "Mi", "Gi", "Ti", "m", "k", "M", "G", "T"):
+        if s.endswith(suf):
+            try:
+                return float(s[:-len(suf)]) * suffixes[suf]
+            except ValueError:
+                return 0.0
+    return 0.0
+
+
+_MEMORY_NAMES = ("memory", "kubernetes.io/batch-memory",
+                 "kubernetes.io/mid-memory")
+
+
+def _resource_list(d: Optional[dict]) -> dict:
+    out = {}
+    for name, v in (d or {}).items():
+        kind = RESOURCE_NAMES.get(name)
+        if kind is None:
+            continue
+        q = _parse_quantity(v)
+        # native units: cpu -> milli, memory tiers -> MiB (extended cpu
+        # tiers are declared in milli already)
+        if name == "cpu":
+            q = q * 1000.0
+        elif name in _MEMORY_NAMES:
+            q = q / float(1 << 20)
+        out[kind] = out.get(kind, 0.0) + q
+    return out
+
+
+def pod_from_manifest(item: dict) -> api.Pod:
+    """One PodList item -> typed Pod (container requests/limits summed to
+    pod granularity, the shape the batched layers use)."""
+    meta = item.get("metadata", {})
+    spec = item.get("spec", {})
+    status = item.get("status", {})
+    requests: dict = {}
+    limits: dict = {}
+    for c in spec.get("containers", []):
+        res = c.get("resources", {})
+        for k, v in _resource_list(res.get("requests")).items():
+            requests[k] = requests.get(k, 0.0) + v
+        for k, v in _resource_list(res.get("limits")).items():
+            limits[k] = limits.get(k, 0.0) + v
+    labels = dict(meta.get("labels") or {})
+    return api.Pod(
+        meta=api.ObjectMeta(name=meta.get("name", ""),
+                            namespace=meta.get("namespace", "default"),
+                            uid=meta.get("uid", ""),
+                            labels=labels,
+                            annotations=dict(meta.get("annotations") or {})),
+        requests=requests, limits=limits,
+        qos_label=labels.get(LABEL_POD_QOS, ""),
+        priority=int(spec.get("priority", 0) or 0),
+        node_name=spec.get("nodeName", ""),
+        phase=status.get("phase", "Pending"))
+
+
+class KubeletStub:
+    """GET /pods/ on the kubelet (kubelet_stub.go GetAllPods)."""
+
+    def __init__(self, addr: str = "127.0.0.1", port: int = 10250,
+                 scheme: str = "https", token: str = "",
+                 timeout: float = 10.0):
+        self.url = f"{scheme}://{addr}:{port}/pods/"
+        self.token = token
+        self.timeout = timeout
+
+    def get_all_pods(self) -> List[api.Pod]:
+        req = urllib.request.Request(self.url)
+        if self.token:
+            req.add_header("Authorization", f"Bearer {self.token}")
+        with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+            data = json.loads(resp.read().decode("utf-8"))
+        return [pod_from_manifest(item) for item in data.get("items", [])]
+
+
+class PodsPuller:
+    """The pods-informer resync body: pull from the kubelet, push into the
+    StatesInformer (states_pods.go syncPods). Pull failures keep the last
+    good state (the reference logs and retries next resync)."""
+
+    def __init__(self, stub: KubeletStub, informer: StatesInformer):
+        self.stub = stub
+        self.informer = informer
+        self.last_error: Optional[str] = None
+
+    def sync(self) -> bool:
+        try:
+            pods = self.stub.get_all_pods()
+        except (OSError, ValueError, http.client.HTTPException) as e:
+            self.last_error = str(e)
+            log.warning("kubelet /pods pull failed: %s", e)
+            return False
+        self.last_error = None
+        self.informer.set_pods([PodMeta(pod=p) for p in pods])
+        return True

@@ -640,11 +640,21 @@ class BlkIOReconcile:
             return self.informer.get_volume_name(ns, claim)
         return block.name
 
+    def _reset_stale(self, desired: Dict[tuple, int]) -> None:
+        for (file, dev) in set(self._applied) - set(desired):
+            reset = 100 if file == "blkio.cost.weight" else 0
+            self.executor.update(CgroupUpdate(BE_ROOT, file,
+                                              f"{dev} {reset}"))
+        self._applied = desired
+
     def reconcile(self, now: float) -> None:
         # IO weights only apply once the control plane distributed an SLO
         # (the reference strategy reads the NodeSLO blkio config)
         slo = self.informer.get_node_slo()
         if slo is None:
+            # an SLO withdrawal still resets limits WE applied — the
+            # stale-limit hazard does not care why the config vanished
+            self._reset_stale({})
             return
         for tier, weight in self.weights.items():
             self.executor.update(CgroupUpdate(tier, "blkio.weight",
@@ -664,11 +674,7 @@ class BlkIOReconcile:
         for (file, dev), value in desired.items():
             self.executor.update(CgroupUpdate(BE_ROOT, file,
                                               f"{dev} {value}"))
-        for (file, dev) in set(self._applied) - set(desired):
-            reset = 100 if file == "blkio.cost.weight" else 0
-            self.executor.update(CgroupUpdate(BE_ROOT, file,
-                                              f"{dev} {reset}"))
-        self._applied = desired
+        self._reset_stale(desired)
 
 
 class QoSManager:

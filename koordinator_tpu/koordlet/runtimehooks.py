@@ -191,6 +191,12 @@ class CPUSetHook:
         res = parse_system_qos_resource(node.meta.annotations)
         return res["cpuset"] if res else ""
 
+    def _ls_share_pool(self) -> str:
+        if self.informer is None:
+            return ""
+        topo = self.informer.get_topology()
+        return topo.ls_share_pool if topo is not None else ""
+
     def apply(self, ctx: HookContext) -> None:
         if ctx.pod.pod.qos == QoSClass.SYSTEM:
             sys_set = self._system_qos_cpuset()
@@ -199,6 +205,13 @@ class CPUSetHook:
             return
         raw = ctx.pod.pod.meta.annotations.get(ANNOTATION_RESOURCE_STATUS)
         if not raw:
+            # no fine-grained assignment: LS pods roam the share pool
+            # (rule.go:113-124 — all share-pool cpus; BE stays empty, the
+            # suppress policy owns its cpuset)
+            if ctx.pod.pod.qos == QoSClass.LS:
+                pool = self._ls_share_pool()
+                if pool:
+                    ctx.add_update("cpuset.cpus", pool)
             return
         try:
             status = json.loads(raw)

@@ -23,13 +23,19 @@ from typing import Callable, Dict, List, Optional
 
 from koordinator_tpu.api import types as api
 from koordinator_tpu.api.extension import (
+    ANNOTATION_RESOURCE_STATUS,
     PriorityClass,
     QoSClass,
     ResourceKind,
     parse_system_qos_resource,
 )
 from koordinator_tpu.koordlet import metriccache as mc
-from koordinator_tpu.koordlet.system import CgroupDriver, pod_cgroup_dir
+from koordinator_tpu.koordlet.system import (
+    CgroupDriver,
+    format_cpuset,
+    parse_cpuset,
+    pod_cgroup_dir,
+)
 
 # state kinds for callback registration (impl/registry.go)
 STATE_NODE = "node"
@@ -49,6 +55,19 @@ def _qos_tier(qos: QoSClass) -> str:
     if qos in (QoSClass.LSE, QoSClass.LSR):
         return "guaranteed"
     return "burstable"
+
+
+def _pod_pinned_cpus(pod: api.Pod) -> List[int]:
+    """cpus pinned via the scheduler's resource-status annotation."""
+    import json as _json
+
+    raw = pod.meta.annotations.get(ANNOTATION_RESOURCE_STATUS, "")
+    if not raw:
+        return []
+    try:
+        return parse_cpuset(str(_json.loads(raw).get("cpuset", "")))
+    except (ValueError, AttributeError):
+        return []
 
 
 def host_app_cgroup_dir(app: api.HostApplication) -> str:
@@ -330,9 +349,21 @@ class TopologyReporter:
             key = (c.socket_id, c.core_id)
             by_core[key] = by_core.get(key, 0) + 1
         cpus_per_core = max(by_core.values(), default=1)
+        # CPU share pools: everything not pinned by an LSE/LSR pod and not
+        # exclusive-SystemQOS roams for LS; the BE pool is the same set
+        # (suppress narrows it live). Pinned sets come from the pods'
+        # resource-status annotations — the same source the reference's
+        # NRT reporter reads its pod CPU allocs from.
+        pinned: set = set(excl)
+        for meta in self.informer.get_all_pods():
+            if meta.pod.qos in (QoSClass.LSE, QoSClass.LSR):
+                pinned.update(_pod_pinned_cpus(meta.pod))
+        pool = sorted(c.cpu_id for c in cpus if c.cpu_id not in pinned)
+        pool_spec = format_cpuset(pool) if pool else ""
         topo = api.NodeResourceTopology(
             node_name=self.node_name, zones=zones,
-            cpus_per_core=cpus_per_core)
+            cpus_per_core=cpus_per_core,
+            ls_share_pool=pool_spec, be_share_pool=pool_spec)
         self.informer.set_topology(topo)
         return topo
 

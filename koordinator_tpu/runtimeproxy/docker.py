@@ -47,13 +47,20 @@ ANNOTATION_PREFIX = "annotation."
 
 # container references may be ids OR names: [a-zA-Z0-9][a-zA-Z0-9_.-]*
 # (docker's reference grammar) — \w+ would silently pass-through legal
-# by-name addressing like "my-app.1"
+# by-name addressing like "my-app.1". Every route tolerates a query
+# string: kubelet's dockershim always creates with ?name=k8s_..., and a
+# $-anchored create pattern would pass the REAL traffic through
+# uninterposed.
 _REF = r"(?P<id>[a-zA-Z0-9][a-zA-Z0-9_.-]*)"
+_Q = r"(\?(?P<query>.*))?$"
 _ROUTES = (
-    (re.compile(r"^/(v\d\.\d+/)?containers/create$"), "create"),
-    (re.compile(r"^/(v\d\.\d+/)?containers/" + _REF + r"/start$"), "start"),
-    (re.compile(r"^/(v\d\.\d+/)?containers/" + _REF + r"/update$"), "update"),
-    (re.compile(r"^/(v\d\.\d+/)?containers/" + _REF + r"/stop"), "stop"),
+    (re.compile(r"^/(v\d\.\d+/)?containers/create" + _Q), "create"),
+    (re.compile(r"^/(v\d\.\d+/)?containers/" + _REF + r"/start" + _Q),
+     "start"),
+    (re.compile(r"^/(v\d\.\d+/)?containers/" + _REF + r"/update" + _Q),
+     "update"),
+    (re.compile(r"^/(v\d\.\d+/)?containers/" + _REF + r"/stop" + _Q),
+     "stop"),
 )
 
 
@@ -135,6 +142,13 @@ class DockerProxy:
         # container id -> last create body (docker /update bodies carry
         # only the resource fields; identity comes from the create)
         self._bodies: Dict[str, dict] = {}
+        # container NAME (?name= on create) -> docker id, so by-name
+        # lifecycle addressing resolves to the same store/_bodies keys
+        self._names: Dict[str, str] = {}
+
+    def _resolve_ref(self, ref: str) -> str:
+        """A route reference may be the docker id or the create name."""
+        return self._names.get(ref, ref)
 
     # -- routing (docker/server.go:63-66) ------------------------------------
 
@@ -143,9 +157,14 @@ class DockerProxy:
         for pattern, op in _ROUTES:
             m = pattern.match(path)
             if m:
-                cid = m.groupdict().get("id", "")
+                gd = m.groupdict()
+                cid = self._resolve_ref(gd.get("id") or "")
                 if op == "create":
-                    return self.create(body or {})
+                    name = ""
+                    for part in (gd.get("query") or "").split("&"):
+                        if part.startswith("name="):
+                            name = part[len("name="):]
+                    return self.create(body or {}, name=name)
                 if op == "start":
                     return self.start(cid)
                 if op == "update":
@@ -171,7 +190,7 @@ class DockerProxy:
 
     # -- endpoints ------------------------------------------------------------
 
-    def create(self, body: dict) -> DockerResponse:
+    def create(self, body: dict, name: str = "") -> DockerResponse:
         labels, annos = split_labels_and_annotations(body.get("Labels"))
         host_config = body.setdefault("HostConfig", {})
         is_sandbox = labels.get(CONTAINER_TYPE_LABEL) == CONTAINER_TYPE_SANDBOX
@@ -220,6 +239,8 @@ class DockerProxy:
             return DockerResponse(ok=False, error=str(e))
         cid = self.backend.create(body)
         self._bodies[cid] = body
+        if name:
+            self._names[name] = cid
         if is_sandbox:
             self.store.put_pod(cid, PodSandboxInfo(
                 name=labels.get(POD_NAME_LABEL, ""),
@@ -292,4 +313,6 @@ class DockerProxy:
             self.store.delete_pod(container_id)
         else:
             self.store.delete_container(container_id)
+        self._names = {n: i for n, i in self._names.items()
+                       if i != container_id}
         return DockerResponse(ok=True, container_id=container_id)

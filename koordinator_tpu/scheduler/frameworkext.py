@@ -116,6 +116,55 @@ def debug_score_table(snap: ClusterSnapshot, pods: PodBatch,
     return "\n".join([header, "-" * len(header)] + lines)
 
 
+def debug_filter_table(snap: ClusterSnapshot, pods: PodBatch,
+                       cfg: LoadAwareConfig,
+                       pod_names: Optional[List[str]] = None) -> str:
+    """Per-pod filter diagnosis (debug.go DebugFiltersSetter
+    /debug/flags/f): how many nodes each gate rejects, recomputed from
+    the snapshot with the same prefilter kernels the batch uses — the
+    per-plugin failure breakdown the reference prints per pod."""
+    from koordinator_tpu.scheduler.plugins import (
+        deviceshare,
+        loadaware,
+        numaaware,
+    )
+
+    nodes = snap.nodes
+    n = int(nodes.num_nodes)
+    gates: List[tuple] = []
+    gates.append(("Unschedulable",
+                  np.broadcast_to(np.asarray(nodes.schedulable)[None, :],
+                                  (pods.num_pods, n))))
+    alloc = np.asarray(nodes.allocatable)
+    req = np.asarray(pods.requests)
+    fit = np.all(req[:, None, :] + np.asarray(nodes.requested)[None]
+                 <= alloc[None] + 1e-3, axis=-1)
+    gates.append(("NodeResourcesFit", fit))
+    gates.append(("LoadAwareScheduling",
+                  np.asarray(loadaware.filter_mask(nodes, pods, cfg))))
+    if np.asarray(nodes.numa_valid).any():
+        gates.append(("NodeNUMAResource",
+                      np.asarray(numaaware.zone_prefilter(nodes, pods))))
+    if snap.devices.gpu_free.shape[1] > 0:
+        gates.append(("DeviceShare",
+                      np.asarray(deviceshare.prefilter(snap.devices,
+                                                       pods))))
+    lines = []
+    for i in range(pods.num_pods):
+        name = pod_names[i] if pod_names else f"pod[{i}]"
+        feasible = np.ones((n,), bool)
+        cells = []
+        for gate_name, mask in gates:
+            rejected = int((~mask[i] & feasible).sum())
+            feasible &= mask[i]
+            if rejected:
+                cells.append(f"{gate_name}:-{rejected}")
+        cells.append(f"fit:{int(feasible.sum())}/{n}")
+        lines.append(f"{name:<24} | {' '.join(cells)}")
+    header = f"{'pod':<24} | nodes rejected per gate"
+    return "\n".join([header, "-" * len(header)] + lines)
+
+
 class ServiceRegistry:
     """APIServiceProvider registry: name -> summary() (services.go:44-51)."""
 
@@ -137,7 +186,8 @@ class DebugFlags:
     """Runtime debug toggles (debug.go DebugScoresSetter /debug/flags/s)."""
 
     def __init__(self):
-        self.score_top_n = 0  # 0 = disabled
+        self.score_top_n = 0     # 0 = disabled
+        self.filter_dump = False  # /debug/flags/f (DebugFiltersSetter)
 
 
 class ServicesServer:
@@ -183,6 +233,13 @@ class ServicesServer:
                         return
                     self.reply_json(200,
                                     {"scoreTopN": flags_ref.score_top_n})
+                    return
+                if self.path.startswith("/debug/flags/f"):
+                    length = int(self.headers.get("Content-Length", 0))
+                    raw = self.rfile.read(length).decode().strip().lower()
+                    flags_ref.filter_dump = raw in ("1", "true", "on")
+                    self.reply_json(200,
+                                    {"filterDump": flags_ref.filter_dump})
                     return
                 self.reply_json(404, {"error": "not found"})
 
@@ -312,6 +369,9 @@ class SchedulerService:
         if self.flags.score_top_n > 0:
             log.info("score table:\n%s", debug_score_table(
                 snap, pods, self.cfg, self.flags.score_top_n, pod_names))
+        if self.flags.filter_dump:
+            log.info("filter table:\n%s", debug_filter_table(
+                snap, pods, self.cfg, pod_names))
         return result
 
     def summary(self) -> dict:

@@ -149,3 +149,30 @@ def test_services_http_endpoints():
         assert flags.score_top_n == 5
     finally:
         server.close()
+
+
+def test_debug_filter_table_and_http_toggle():
+    """The /debug/flags/f counterpart (DebugFiltersSetter): per-gate
+    rejection counts per pod, toggled over HTTP."""
+    from koordinator_tpu.scheduler.frameworkext import debug_filter_table
+    from koordinator_tpu.scheduler.plugins.loadaware import LoadAwareConfig
+
+    snap = synthetic.synthetic_cluster(8)
+    pods = synthetic.synthetic_pods(3)
+    table = debug_filter_table(snap, pods, LoadAwareConfig.make(),
+                               pod_names=["a", "b", "c"])
+    lines = table.splitlines()
+    assert lines[0].startswith("pod") and len(lines) == 5
+    assert all("fit:" in ln for ln in lines[2:])
+    registry = ServiceRegistry()
+    flags = DebugFlags()
+    server = ServicesServer(registry, flags)
+    try:
+        base = f"http://127.0.0.1:{server.port}"
+        req = urllib.request.Request(f"{base}/debug/flags/f", data=b"true",
+                                     method="PUT")
+        with urllib.request.urlopen(req) as r:
+            assert json.load(r)["filterDump"] is True
+        assert flags.filter_dump is True
+    finally:
+        server.close()

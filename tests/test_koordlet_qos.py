@@ -609,3 +609,120 @@ def test_blkio_removed_block_resets_throttle(env):
     assert host.read_cgroup(
         BE_ROOT, "blkio.throttle.read_iops_device") == "/dev/sdb 0"
     assert host.read_cgroup(BE_ROOT, "blkio.cost.weight") == "/dev/sdb 100"
+
+
+# --- kubelet /pods pull + CPU share pools -----------------------------------
+
+def test_kubelet_stub_pull_and_units(tmp_path):
+    """The agent pulls pods from the kubelet /pods endpoint
+    (kubelet_stub.go:69-80) with bearer auth, converting quantities to
+    native units (cpu milli, memory MiB)."""
+    import http.server
+    import threading
+
+    from koordinator_tpu.koordlet.kubelet_stub import (
+        KubeletStub,
+        PodsPuller,
+    )
+
+    podlist = {"items": [{
+        "metadata": {"name": "w-1", "namespace": "default", "uid": "u1",
+                     "labels": {"koordinator.sh/qosClass": "BE"}},
+        "spec": {"priority": 5500, "nodeName": "n0", "containers": [
+            {"resources": {
+                "requests": {"cpu": "500m", "memory": "512Mi",
+                             "kubernetes.io/batch-cpu": "1000",
+                             "kubernetes.io/batch-memory": "1073741824"},
+                "limits": {"cpu": "1"}}},
+            {"resources": {"requests": {"cpu": "250m"}}},
+        ]},
+        "status": {"phase": "Running"},
+    }]}
+    seen = {}
+
+    class Handler(http.server.BaseHTTPRequestHandler):
+        def do_GET(self):
+            seen["path"] = self.path
+            seen["auth"] = self.headers.get("Authorization", "")
+            body = json.dumps(podlist).encode()
+            self.send_response(200)
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *a):
+            pass
+
+    srv = http.server.HTTPServer(("127.0.0.1", 0), Handler)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    try:
+        stub = KubeletStub(addr="127.0.0.1", port=srv.server_port,
+                           scheme="http", token="tok")
+        informer = StatesInformer()
+        puller = PodsPuller(stub, informer)
+        assert puller.sync()
+        assert seen["path"] == "/pods/" and seen["auth"] == "Bearer tok"
+        pods = informer.get_all_pods()
+        assert len(pods) == 1
+        p = pods[0].pod
+        assert p.requests[ResourceKind.CPU] == 750.0          # 500m + 250m
+        assert p.requests[ResourceKind.MEMORY] == 512.0       # MiB
+        assert p.requests[ResourceKind.BATCH_CPU] == 1000.0   # already milli
+        assert p.requests[ResourceKind.BATCH_MEMORY] == 1024.0  # bytes->MiB
+        assert p.limits[ResourceKind.CPU] == 1000.0
+        assert p.qos == QoSClass.BE and p.phase == "Running"
+        # pull failure keeps last good state
+        srv.shutdown()
+        assert not puller.sync()
+        assert puller.last_error and len(informer.get_all_pods()) == 1
+    finally:
+        srv.shutdown()
+
+
+def test_share_pool_reported_and_applied(tmp_path):
+    """LSE/LSR-pinned + exclusive SystemQOS cpus leave the share pool;
+    LS pods without a fine-grained assignment get the pool cpuset
+    (states_noderesourcetopology.go share pools + rule.go LS branch)."""
+    from koordinator_tpu.koordlet.statesinformer import TopologyReporter
+
+    host = FakeHost(str(tmp_path), num_cpus=8, mem_bytes=16 << 30)
+    informer = StatesInformer()
+    node = api.Node(meta=api.ObjectMeta(name="n0", annotations={
+        "node.koordinator.sh/system-qos-resource": '{"cpuset": "0"}'}))
+    informer.set_node(node)
+    lsr = make_pod("lsr-1", qos="LSR", annotations={
+        ANNOTATION_RESOURCE_STATUS: json.dumps({"cpuset": "2-3"})})
+    informer.set_pods([lsr])
+    topo = TopologyReporter(host, informer, "n0").report()
+    assert topo.ls_share_pool == "1,4-7"
+    # LS pod -> pool cpuset through the hook
+    ls = make_pod("ls-1", qos="LS")
+    server = default_hook_server(informer)
+    ctx = HookContext(pod=ls, stage=Stage.PRE_CREATE_CONTAINER)
+    server.run_hooks(Stage.PRE_CREATE_CONTAINER, ctx)
+    writes = {u.resource: u.value for u in ctx.cgroup_updates}
+    assert writes.get("cpuset.cpus") == "1,4-7"
+    # LSR pod keeps its pinned assignment, not the pool
+    ctx2 = HookContext(pod=lsr, stage=Stage.PRE_CREATE_CONTAINER)
+    server.run_hooks(Stage.PRE_CREATE_CONTAINER, ctx2)
+    writes2 = {u.resource: u.value for u in ctx2.cgroup_updates}
+    assert writes2.get("cpuset.cpus") == "2-3"
+
+
+def test_blkio_slo_withdrawal_resets_applied_limits(env):
+    """Regression: clearing the NodeSLO entirely still resets limits the
+    strategy applied earlier."""
+    import os
+
+    host, informer, cache, executor = env
+    for tier in ("kubepods", "kubepods/burstable", "kubepods/besteffort"):
+        os.makedirs(os.path.join(host.cgroup_root, "blkio", tier),
+                    exist_ok=True)
+    slo = informer.get_node_slo()
+    slo.blkio_blocks = [api.BlockCfg(name="/dev/sdb", read_iops=500)]
+    informer.set_node_slo(slo)
+    r = BlkIOReconcile(informer, executor)
+    r.reconcile(now=0.0)
+    informer.set_node_slo(None)
+    r.reconcile(now=10.0)
+    assert host.read_cgroup(
+        BE_ROOT, "blkio.throttle.read_iops_device") == "/dev/sdb 0"

@@ -435,3 +435,26 @@ def test_docker_proxy_routes_by_container_name(hook_endpoint):
     proxy = DockerProxy(dockerd, RpcClient(hook_endpoint))
     assert proxy.handle("/v1.41/containers/my-app.1/stop?t=5").ok
     assert dockerd.calls == [("stop", "my-app.1", None)]
+
+
+def test_docker_proxy_create_with_query_and_by_name_lifecycle(hook_endpoint):
+    """Regression: dockershim creates with ?name=k8s_... — the create
+    route must interpose it, and the name must resolve to the docker id
+    for later by-name lifecycle calls (store/_bodies stay consistent)."""
+    from koordinator_tpu.runtimeproxy.docker import DockerProxy
+
+    dockerd = FakeDockerd()
+    proxy = DockerProxy(dockerd, RpcClient(hook_endpoint))
+    body = {"Labels": {"io.kubernetes.docker.type": "podsandbox",
+                       "io.kubernetes.pod.name": "spark-1",
+                       LABEL_POD_QOS: "BE"},
+            "HostConfig": {}}
+    r = proxy.handle("/v1.41/containers/create?name=k8s_POD_spark-1", body)
+    assert r.ok
+    # interposed despite the query string
+    assert body["HostConfig"]["Unified"]["cpu.bvt_warp_ns"] == "-1"
+    assert r.container_id in proxy.store.pods
+    # stop BY NAME: classified as a sandbox, store + bodies cleaned up
+    proxy.handle("/v1.41/containers/k8s_POD_spark-1/stop?t=10")
+    assert dockerd.calls[-1] == ("stop", r.container_id, None)
+    assert not proxy.store.pods and not proxy._bodies and not proxy._names
