@@ -62,15 +62,17 @@ class Taint:
 
 @dataclasses.dataclass
 class Toleration:
-    """Pod toleration: empty value tolerates any value of the key
-    (operator Exists); empty effect tolerates every effect."""
+    """Pod toleration: empty key tolerates EVERY taint key (the blanket
+    operator-Exists toleration critical DaemonSets carry); empty value
+    tolerates any value of the key; empty effect tolerates every
+    effect (core/v1 Toleration.ToleratesTaint semantics)."""
 
     key: str = ""
     value: str = ""
     effect: str = ""
 
     def tolerates(self, taint: "Taint") -> bool:
-        if self.key != taint.key:
+        if self.key and self.key != taint.key:
             return False
         if self.value and self.value != taint.value:
             return False

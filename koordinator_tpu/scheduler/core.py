@@ -173,6 +173,22 @@ def schedule_batch(snap: ClusterSnapshot, pods: PodBatch,
     # touches no NodeInfo.requested), so compute it once for the batch.
     la_ok = loadaware.filter_mask(nodes0, pods, cfg)
     static_ok = la_ok & sel_ok & nodes0.schedulable[None, :]     # [P, N]
+    # TaintToleration (vanilla-framework plugin the reference's extender
+    # wraps): forbid on untolerated NoSchedule/NoExecute, penalize
+    # untolerated PreferNoSchedule. Matrices ride (toleration-set x
+    # taint-group) exactly like the selector gate; a [1, 1] matrix means
+    # the batch carries no toleration modeling (synthetic fast path) and
+    # the gates compile out.
+    use_taints = pods.tol_forbid.shape != (1, 1)
+    if use_taints:
+        tol_row = pods.tol_forbid[jnp.maximum(pods.toleration_id, 0)]
+        static_ok &= ~tol_row[:, nodes0.taint_group]             # [P, N]
+        prefer_cnt = pods.tol_prefer[
+            jnp.maximum(pods.toleration_id, 0)][:, nodes0.taint_group]
+        taint_penalty = prefer_cnt / jnp.maximum(
+            jnp.max(pods.tol_prefer), 1.0) * MAX_NODE_SCORE
+    else:
+        taint_penalty = None
     # the slot columns see the gates BEFORE the device/NUMA prefilters:
     # those prefilters reason about the node's open pools, but a consumer
     # draws from the reservation's own hold (restore semantics)
@@ -341,6 +357,11 @@ def schedule_batch(snap: ClusterSnapshot, pods: PodBatch,
         if use_gpu:
             # device preference likewise only affects GPU-requesting pods
             scores = scores + dev_scores
+        if taint_penalty is not None:
+            # demote, never filter (upstream tainttoleration only scores):
+            # the clamp keeps penalized-but-feasible nodes above the
+            # infeasible sentinel (-1.0) and the inner 'trying' threshold
+            scores = jnp.maximum(scores - taint_penalty, 0.0)
         if n_slots:
             # slot columns outscore any node sum: owners strictly prefer
             # their reservation (nominator preference); safe because slot-

@@ -103,6 +103,10 @@ def debug_score_table(snap: ClusterSnapshot, pods: PodBatch,
             deviceshare.score_matrix(snap.devices, pods))
     feasible = (np.asarray(loadaware.filter_mask(snap.nodes, pods, cfg))
                 & np.asarray(snap.nodes.schedulable)[None, :])
+    forbid, penalty = _taint_matrices(snap, pods)
+    if forbid is not None:
+        feasible &= ~forbid
+        scores = np.maximum(scores - penalty, 0.0)
     scores = np.where(feasible, scores, -1.0)
     lines = []
     p = pods.num_pods
@@ -114,6 +118,21 @@ def debug_score_table(snap: ClusterSnapshot, pods: PodBatch,
         lines.append(f"{name:<24} | {cells}")
     header = f"{'pod':<24} | top-{top_n} nodes by score"
     return "\n".join([header, "-" * len(header)] + lines)
+
+
+def _taint_matrices(snap: ClusterSnapshot, pods: PodBatch):
+    """(forbid [P, N], penalty [P, N]) from the TaintToleration matrices,
+    or (None, None) for a batch without taint modeling — the same math
+    the batch kernel applies (core.py use_taints block)."""
+    if pods.tol_forbid.shape == (1, 1):
+        return None, None
+    tid = np.maximum(np.asarray(pods.toleration_id), 0)
+    tg = np.asarray(snap.nodes.taint_group)
+    forbid = np.asarray(pods.tol_forbid)[tid][:, tg]
+    prefer = np.asarray(pods.tol_prefer)[tid][:, tg]
+    max_cnt = max(float(np.asarray(pods.tol_prefer).max()), 1.0)
+    from koordinator_tpu.scheduler.batching import MAX_NODE_SCORE
+    return forbid, prefer / max_cnt * MAX_NODE_SCORE
 
 
 def debug_filter_table(snap: ClusterSnapshot, pods: PodBatch,
@@ -142,6 +161,9 @@ def debug_filter_table(snap: ClusterSnapshot, pods: PodBatch,
     gates.append(("NodeResourcesFit", fit))
     gates.append(("LoadAwareScheduling",
                   np.asarray(loadaware.filter_mask(nodes, pods, cfg))))
+    forbid, _ = _taint_matrices(snap, pods)
+    if forbid is not None:
+        gates.append(("TaintToleration", ~forbid))
     if np.asarray(nodes.numa_valid).any():
         gates.append(("NodeNUMAResource",
                       np.asarray(numaaware.zone_prefilter(nodes, pods))))

@@ -39,6 +39,7 @@ from koordinator_tpu.api.types import (
     PodGroup,
     Reservation,
     ResourceList,
+    Taint,
 )
 from koordinator_tpu.snapshot.schema import (
     AGG_TYPES,
@@ -176,6 +177,7 @@ class SnapshotBuilder:
                  max_reservations: int = 8, max_zones: int = 4,
                  max_gpu_inst: int = 0, max_aux_inst: int = 0,
                  max_selectors: int = 8, max_label_groups: int = 64,
+                 max_tolerations: int = 8, max_taint_groups: int = 16,
                  metric_expiration_s: float = DEFAULT_NODE_METRIC_EXPIRATION_S,
                  estimator_weights: Optional[Mapping[ResourceKind, float]] = None,
                  estimator_scaling: Optional[Mapping[ResourceKind, float]] = None,
@@ -189,6 +191,9 @@ class SnapshotBuilder:
         self.max_aux_inst = max_aux_inst
         self.max_selectors = max_selectors
         self.max_label_groups = max_label_groups
+        self.max_tolerations = max_tolerations
+        self.max_taint_groups = max_taint_groups
+        self._taint_groups: Dict[tuple, int] = {}
         self.metric_expiration_s = metric_expiration_s
         # estimator config must match the LoadAware plugin args so that
         # PodBatch.estimated and the assign-cache columns agree with the
@@ -283,6 +288,25 @@ class SnapshotBuilder:
                 groups[key] = len(groups)
             lab_ids[i] = groups[key]
         return lab_ids, groups
+
+    def _node_taint_groups(self) -> np.ndarray:
+        """Partition nodes by taint set (TaintToleration gate; group 0 is
+        always the untainted set so toleration-less pods ride row 0 of
+        all-False matrices). Stashes the group dict for build()."""
+        ids = np.zeros((self.max_nodes,), np.int32)
+        groups: Dict[tuple, int] = {(): 0}
+        for i, node in enumerate(self.nodes):
+            key = tuple(sorted((t.key, t.value, t.effect)
+                               for t in node.taints))
+            if key not in groups:
+                if len(groups) >= self.max_taint_groups:
+                    raise ValueError(
+                        f"distinct node taint sets exceed max_taint_groups="
+                        f"{self.max_taint_groups}")
+                groups[key] = len(groups)
+            ids[i] = groups[key]
+        self._taint_groups = groups
+        return ids
 
     def build_nodes(self, now: Optional[float] = None) -> Tuple[NodeState, Dict[frozenset, int]]:
         now = time.time() if now is None else now
@@ -394,6 +418,7 @@ class SnapshotBuilder:
             numa_valid=numa_valid,
             numa_policy=numa_policy,
             cpu_amplification=cpu_amp,
+            taint_group=self._node_taint_groups(),
         )
         return state, groups
 
@@ -805,7 +830,8 @@ class SnapshotBuilder:
             devices=devices,
             version=np.int32(version),
         )
-        ctx = BuildContext(self, label_groups, owner_groups)
+        ctx = BuildContext(self, label_groups, owner_groups,
+                           dict(self._taint_groups))
         return snap, ctx
 
     # --- build: pod batch ---------------------------------------------------
@@ -828,9 +854,12 @@ class SnapshotBuilder:
         gpu_ratio = np.zeros((p,), np.float32)
         numa_single = np.zeros((p,), bool)
         daemonset = np.zeros((p,), bool)
+        tol_id = np.zeros((p,), np.int32)
         valid = np.zeros((p,), bool)
 
         selectors: Dict[frozenset, int] = {}
+        # toleration set -> (row, typed list); row 0 = empty set
+        tol_sets: Dict[tuple, tuple] = {(): (0, [])}
         for i, pod in enumerate(pods):
             requests[i] = resource_vec(pod.requests)
             estimated[i] = estimate_pod(pod, self.estimator_scaling,
@@ -854,6 +883,18 @@ class SnapshotBuilder:
             gpu_ratio[i] = pod.gpu_memory_ratio
             numa_single[i] = pod.required_cpu_bind
             daemonset[i] = pod.is_daemonset
+            if pod.tolerations:
+                tkey = tuple(sorted((t.key, t.value, t.effect)
+                                    for t in pod.tolerations))
+                entry = tol_sets.get(tkey)
+                if entry is None:
+                    if len(tol_sets) >= self.max_tolerations:
+                        raise ValueError(
+                            f"distinct pod toleration sets exceed "
+                            f"max_tolerations={self.max_tolerations}")
+                    entry = (len(tol_sets), list(pod.tolerations))
+                    tol_sets[tkey] = entry
+                tol_id[i] = entry[0]
             valid[i] = True
 
         # selector x node-label-group match matrix, padded to static
@@ -867,12 +908,39 @@ class SnapshotBuilder:
                 labels = dict(lab_key)
                 sel_match[si, li] = all(labels.get(k) == v
                                         for k, v in sel.items())
+        # toleration x node-taint-group matrices (TaintToleration: the
+        # filter forbids on any untolerated NoSchedule/NoExecute taint,
+        # the score counts untolerated PreferNoSchedule taints). A fully
+        # untainted, toleration-less batch collapses to [1, 1] so the
+        # scheduler's taint gates compile out entirely.
+        if len(ctx.node_taint_groups) == 1 and len(tol_sets) == 1:
+            tol_forbid = np.zeros((1, 1), bool)
+            tol_prefer = np.zeros((1, 1), np.float32)
+        else:
+            tol_forbid = np.zeros((self.max_tolerations,
+                                   self.max_taint_groups), bool)
+            tol_prefer = np.zeros((self.max_tolerations,
+                                   self.max_taint_groups), np.float32)
+            for taint_key, gi in ctx.node_taint_groups.items():
+                taints = [Taint(key=k, value=v, effect=e)
+                          for (k, v, e) in taint_key]
+                for _, (ti, tols) in tol_sets.items():
+                    for taint in taints:
+                        tolerated = any(t.tolerates(taint) for t in tols)
+                        if tolerated:
+                            continue
+                        if taint.effect in ("NoSchedule", "NoExecute"):
+                            tol_forbid[ti, gi] = True
+                        elif taint.effect == "PreferNoSchedule":
+                            tol_prefer[ti, gi] += 1.0
         return PodBatch(
             requests=requests, estimated=estimated, qos=qos,
             priority_class=prio_class, priority=prio, gang_id=gang_id,
             quota_id=quota_id, selector_id=sel_id, selector_match=sel_match,
             reservation_owner=res_owner, gpu_ratio=gpu_ratio,
-            numa_single=numa_single, daemonset=daemonset, valid=valid)
+            numa_single=numa_single, daemonset=daemonset,
+            toleration_id=tol_id, tol_forbid=tol_forbid,
+            tol_prefer=tol_prefer, valid=valid)
 
 
 def _selector_key(selector: Dict[str, str]) -> str:
@@ -896,3 +964,6 @@ class BuildContext:
     builder: SnapshotBuilder
     node_label_groups: Dict[frozenset, int]
     reservation_owner_groups: Dict[str, int]
+    # node taint set (sorted (key, value, effect) tuples) -> taint group
+    node_taint_groups: Dict[tuple, int] = dataclasses.field(
+        default_factory=lambda: {(): 0})

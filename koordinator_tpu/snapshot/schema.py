@@ -78,6 +78,9 @@ class NodeState:
     has_agg: Array          # bool[N] aggregated percentiles available
     schedulable: Array      # bool[N] node exists, not cordoned
     label_group: Array      # i32[N] node-label equivalence class (selector gate)
+    taint_group: Array      # i32[N] node-taint equivalence class (the
+                            # TaintToleration gate rides [T, TG] matrices
+                            # exactly like the selector gate)
     # NUMA (Z zones): cpu/mem capacity and free per zone
     numa_cap: Array         # f32[N, Z, 2] (cpu milli, mem MiB)
     numa_free: Array        # f32[N, Z, 2]
@@ -127,6 +130,14 @@ class PodBatch:
     numa_single: Array      # bool[P] requires single-NUMA-node placement
     daemonset: Array        # bool[P] DaemonSet pods bypass LoadAware filter
                             # (load_aware.go isDaemonSetPod)
+    toleration_id: Array    # i32[P] row into the toleration matrices
+                            # (row 0 = the empty toleration set)
+    tol_forbid: Array       # bool[T, TG] toleration set t leaves an
+                            # untolerated NoSchedule/NoExecute taint on
+                            # node-taint-group g (TaintToleration filter)
+    tol_prefer: Array       # f32[T, TG] count of untolerated
+                            # PreferNoSchedule taints (score penalty,
+                            # upstream tainttoleration scoring)
     valid: Array            # bool[P]
 
     @property
@@ -299,6 +310,7 @@ def zeros_snapshot(num_nodes: int, num_quotas: int = 1, num_gangs: int = 1,
         numa_valid=jnp.zeros((n, z), bool),
         numa_policy=jnp.zeros((n,), jnp.int32),
         cpu_amplification=jnp.ones((n,), f32),
+        taint_group=jnp.zeros((n,), jnp.int32),
     )
     quotas = QuotaState(
         min=jnp.zeros((q, r), f32),

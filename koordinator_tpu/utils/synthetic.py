@@ -114,6 +114,7 @@ def synthetic_cluster(num_nodes: int, seed: int = 0,
         numa_valid=np.zeros((n, 4), bool),
         numa_policy=np.zeros((n,), np.int32),
         cpu_amplification=np.ones((n,), f32),
+        taint_group=np.zeros((n,), np.int32),
     )
 
     q = max_quotas
@@ -265,6 +266,9 @@ def synthetic_pods(num_pods: int, seed: int = 1,
         gpu_ratio=gpu_ratio,
         numa_single=np.zeros((p,), bool),
         daemonset=np.zeros((p,), bool),
+        toleration_id=np.zeros((p,), np.int32),
+        tol_forbid=np.zeros((1, 1), bool),
+        tol_prefer=np.zeros((1, 1), f32),
         valid=np.ones((p,), bool),
     )
 
@@ -285,7 +289,7 @@ def stack_pod_chunks(pods: PodBatch, chunk: int) -> dict:
 PER_POD_FIELDS = ("requests", "estimated", "qos", "priority_class",
                   "priority", "gang_id", "quota_id", "selector_id",
                   "reservation_owner", "gpu_ratio", "numa_single",
-                  "daemonset", "valid")
+                  "daemonset", "toleration_id", "valid")
 
 
 def slice_batch(batch: PodBatch, start: int, size: int) -> PodBatch:

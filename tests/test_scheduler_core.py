@@ -241,3 +241,97 @@ def test_gang_satisfied_latch_bypasses_gates():
     # only one fits (6000+6000 > 8000) — and it STAYS placed: a satisfied
     # strict gang is exempt from group rollback
     assert (a >= 0).sum() == 1
+
+
+def test_taint_toleration_filter_and_prefer():
+    """TaintToleration (the vanilla-framework gate the reference's
+    extender wraps): NoSchedule taints reject non-tolerating pods,
+    tolerations admit, PreferNoSchedule only demotes."""
+    from koordinator_tpu.api.types import Taint, Toleration
+
+    b = SnapshotBuilder(max_nodes=3)
+    b.add_node(Node(meta=ObjectMeta(name="tainted"),
+                    allocatable={RK.CPU: 8000, RK.MEMORY: 16384},
+                    taints=[Taint(key="gpu", value="true",
+                                  effect="NoSchedule")]))
+    b.add_node(Node(meta=ObjectMeta(name="soft"),
+                    allocatable={RK.CPU: 8000, RK.MEMORY: 16384},
+                    taints=[Taint(key="maint", value="",
+                                  effect="PreferNoSchedule")]))
+    b.add_node(Node(meta=ObjectMeta(name="clean"),
+                    allocatable={RK.CPU: 8000, RK.MEMORY: 16384}))
+    for nm in ("tainted", "soft", "clean"):
+        b.set_node_metric(NodeMetric(node_name=nm, update_time=NOW,
+                                     node_usage={}))
+    snap, ctx = b.build(now=NOW)
+    plain = Pod(meta=ObjectMeta(name="plain"), priority=9000,
+                requests={RK.CPU: 100.0})
+    tolerant = Pod(meta=ObjectMeta(name="tolerant"), priority=9000,
+                   requests={RK.CPU: 100.0},
+                   tolerations=[Toleration(key="gpu", value="true",
+                                           effect="NoSchedule")],
+                   node_selector={})
+    batch = b.build_pod_batch([plain, tolerant], ctx)
+    res = core.schedule_batch(snap, batch, loadaware.LoadAwareConfig.make())
+    a = np.asarray(res.assignment)
+    # plain avoids the NoSchedule node AND prefers clean over soft
+    assert a[0] == 2, a
+    assert a[1] in (0, 1, 2)  # tolerant may land anywhere
+
+    # only the tainted node has capacity -> plain is unschedulable,
+    # tolerant lands there
+    b2 = SnapshotBuilder(max_nodes=1)
+    b2.add_node(Node(meta=ObjectMeta(name="tainted"),
+                     allocatable={RK.CPU: 8000, RK.MEMORY: 16384},
+                     taints=[Taint(key="gpu", value="true",
+                                   effect="NoSchedule")]))
+    b2.set_node_metric(NodeMetric(node_name="tainted", update_time=NOW,
+                                  node_usage={}))
+    snap2, ctx2 = b2.build(now=NOW)
+    batch2 = b2.build_pod_batch(
+        [Pod(meta=ObjectMeta(name="plain"), priority=9000,
+             requests={RK.CPU: 100.0}),
+         Pod(meta=ObjectMeta(name="tolerant"), priority=9000,
+             requests={RK.CPU: 100.0},
+             tolerations=[Toleration(key="gpu")])], ctx2)
+    res2 = core.schedule_batch(snap2, batch2,
+                               loadaware.LoadAwareConfig.make())
+    a2 = np.asarray(res2.assignment)
+    assert a2[0] == -1 and a2[1] == 0, a2
+
+
+def test_prefer_no_schedule_demotes_never_filters():
+    """Regression: the PreferNoSchedule penalty must not push a feasible
+    node below the infeasible sentinel — a busy soft-tainted node is
+    still chosen when it is the only option."""
+    from koordinator_tpu.api.types import Taint
+
+    b = SnapshotBuilder(max_nodes=1)
+    b.add_node(Node(meta=ObjectMeta(name="soft"),
+                    allocatable={RK.CPU: 8000, RK.MEMORY: 16384},
+                    taints=[Taint(key="maint",
+                                  effect="PreferNoSchedule")]))
+    # busy (but under the 65% filter threshold) -> low loadaware score;
+    # an unclamped penalty would sink it below the -0.5 trying gate
+    b.set_node_metric(NodeMetric(node_name="soft", update_time=NOW,
+                                 node_usage={RK.CPU: 5000.0,
+                                             RK.MEMORY: 10000.0}))
+    snap, ctx = b.build(now=NOW)
+    batch = b.build_pod_batch(
+        [Pod(meta=ObjectMeta(name="p"), priority=9000,
+             requests={RK.CPU: 100.0})], ctx)
+    res = core.schedule_batch(snap, batch, loadaware.LoadAwareConfig.make())
+    assert int(np.asarray(res.assignment)[0]) == 0
+
+
+def test_blanket_toleration_tolerates_everything():
+    """Regression: the empty-key (operator Exists) toleration critical
+    DaemonSets carry must pass every taint."""
+    from koordinator_tpu.api.types import Taint, Toleration
+
+    assert Toleration().tolerates(Taint(key="any", value="x",
+                                        effect="NoSchedule"))
+    assert Toleration(effect="NoSchedule").tolerates(
+        Taint(key="k", effect="NoSchedule"))
+    assert not Toleration(effect="NoExecute").tolerates(
+        Taint(key="k", effect="NoSchedule"))
