@@ -61,6 +61,51 @@ class Taint:
 
 
 @dataclasses.dataclass
+class NodeSelectorRequirement:
+    """One nodeAffinity match expression (core/v1
+    NodeSelectorRequirement): In | NotIn | Exists | DoesNotExist |
+    Gt | Lt over a node label key."""
+
+    key: str = ""
+    operator: str = "In"
+    values: List[str] = dataclasses.field(default_factory=list)
+
+    def matches(self, labels: Dict[str, str]) -> bool:
+        present = self.key in labels
+        value = labels.get(self.key, "")
+        if self.operator == "In":
+            return present and value in self.values
+        if self.operator == "NotIn":
+            return not present or value not in self.values
+        if self.operator == "Exists":
+            return present
+        if self.operator == "DoesNotExist":
+            return not present
+        try:
+            if self.operator == "Gt":
+                return present and int(value) > int(self.values[0])
+            if self.operator == "Lt":
+                return present and int(value) < int(self.values[0])
+        except (ValueError, IndexError):
+            return False
+        return False
+
+
+@dataclasses.dataclass
+class TopologySpreadConstraint:
+    """core/v1 TopologySpreadConstraint subset: spread pods matching
+    `label_selector` (own-namespace) across the node-label domains of
+    `topology_key`, keeping the count difference within `max_skew`.
+    DoNotSchedule filters; ScheduleAnyway only prefers (and is treated as
+    a no-op gate here — the LoadAware ranking already spreads load)."""
+
+    max_skew: int = 1
+    topology_key: str = "topology.kubernetes.io/zone"
+    when_unsatisfiable: str = "DoNotSchedule"  # | ScheduleAnyway
+    label_selector: Dict[str, str] = dataclasses.field(default_factory=dict)
+
+
+@dataclasses.dataclass
 class Toleration:
     """Pod toleration: empty key tolerates EVERY taint key (the blanket
     operator-Exists toleration critical DaemonSets carry); empty value
@@ -120,6 +165,15 @@ class Pod:
     reservation_name: str = ""
     # node selection
     node_selector: Dict[str, str] = dataclasses.field(default_factory=dict)
+    # required nodeAffinity match expressions, ANDed with node_selector
+    # (requiredDuringSchedulingIgnoredDuringExecution; preferred terms are
+    # a score concern the LoadAware ranking subsumes)
+    node_affinity: List[NodeSelectorRequirement] = dataclasses.field(
+        default_factory=list)
+    # topology spread (the FIRST hard constraint is modeled on device;
+    # upstream allows several — a documented narrowing)
+    spread_constraints: List[TopologySpreadConstraint] = dataclasses.field(
+        default_factory=list)
     # controller owner (ReplicaSet/StatefulSet...) — the migration
     # arbitrator bounds blast radius per workload (arbitrator/filter.go)
     owner_workload: str = ""     # "namespace/name" of the controller

@@ -304,6 +304,36 @@ def schedule_batch(snap: ClusterSnapshot, pods: PodBatch,
     else:
         numa_used0_x = numa_used0
 
+    # PodTopologySpread (upstream hard constraints): [1, 1] matrices mean
+    # no spread modeling and everything below compiles out. Within a
+    # batch the gate is exact: the round-level feasibility and the
+    # inner prefix cap both read counts derived from the carried
+    # assignment. ACROSS batches the counts come from spread_count0,
+    # which the builder recomputes from running + assumed pods — callers
+    # chunking one logical workload must rebuild batches through the
+    # builder (the informer/service flow) so each chunk sees the
+    # previous chunks' assumes.
+    use_spread = pods.spread_domain.shape != (1, 1)
+    if use_spread:
+        n_sg, n_dom = pods.spread_count0.shape
+        sid = jnp.maximum(pods.spread_id, 0)
+        if n_slots:
+            spread_domain_x = jnp.concatenate(
+                [pods.spread_domain, pods.spread_domain[:, slot_node_c]], 1)
+        else:
+            spread_domain_x = pods.spread_domain            # [Sg, N+V]
+
+        def spread_counts_flat(placed_now):
+            """flat [Sg*D] matching-pod counts = initial + the carried
+            assignment's placements (shared by the round feasibility
+            gate and the inner prefix cap so they can never diverge)."""
+            pdom = jnp.where(
+                (placed_now >= 0) & (pods.spread_id >= 0),
+                spread_domain_x[sid, jnp.maximum(placed_now, 0)], -1)
+            seg = jnp.where(pdom >= 0, sid * n_dom + pdom, n_sg * n_dom)
+            return pods.spread_count0.reshape(-1).at[seg].add(
+                1.0, mode="drop")
+
     def round_body(carry, _):
         requested, quota_used, numa_used, gpu_free, aux_free, once_taken, \
             assigned_est, prod_assigned_est, gang_placed, placed, out_score, \
@@ -331,6 +361,26 @@ def schedule_batch(snap: ClusterSnapshot, pods: PodBatch,
             # consumed AllocateOnce slots admit nobody (plugin.go:509-510)
             feasible &= ~jnp.concatenate(
                 [jnp.zeros((n_nodes,), bool), is_once & once_taken])[None, :]
+
+        if use_spread:
+            # counts = initial matching pods + this batch's placements
+            counts = spread_counts_flat(placed).reshape(n_sg, n_dom)
+            min_c = jnp.min(jnp.where(pods.spread_dvalid, counts,
+                                      jnp.inf), axis=1)             # [Sg]
+            cdom = spread_domain_x[sid]                          # [P, N+V]
+            ccount = jnp.take_along_axis(counts[sid],
+                                         jnp.maximum(cdom, 0), axis=1)
+            spread_ok = (cdom >= 0) & \
+                (ccount + 1.0 - min_c[sid][:, None]
+                 <= pods.spread_max_skew[sid][:, None] + EPS)
+            feasible &= (pods.spread_id < 0)[:, None] | spread_ok
+            # per-round domain cap for the inner prefix gate: a domain
+            # holds at most skew + min_round pods (min rises between
+            # rounds, releasing more) — without it one round piles the
+            # whole batch into the currently emptiest domain
+            spread_limit = jnp.broadcast_to(
+                (pods.spread_max_skew + min_c)[:, None],
+                (n_sg, n_dom)).reshape(-1, 1)             # [Sg*D, 1]
 
         # quota admission (ElasticQuota PreFilter, plugin.go:211-257):
         # used + request <= runtime at every tree level
@@ -418,6 +468,21 @@ def schedule_batch(snap: ClusterSnapshot, pods: PodBatch,
             accept = trying & segment_prefix_ok(
                 choice_eff, earlier, eff_req, dims(requested),
                 dims(ext_alloc), n_ext)
+
+            if use_spread:
+                # spread prefix: priority order caps each (group, domain)
+                # at skew + round-start min. Current counts come from the
+                # CARRIED assignment, so allowance consumed in earlier
+                # inner steps (kptr fall-throughs) is charged too.
+                counts_now = spread_counts_flat(placed).reshape(-1, 1)
+                sdom_c = spread_domain_x[sid, jnp.clip(choice_eff, 0,
+                                                       n_ext - 1)]
+                has_s = trying & (pods.spread_id >= 0) & (sdom_c >= 0)
+                sseg = jnp.where(has_s, sid * n_dom + sdom_c,
+                                 n_sg * n_dom)
+                accept &= segment_prefix_ok(
+                    sseg, earlier, has_s[:, None].astype(jnp.float32),
+                    counts_now, spread_limit, n_sg * n_dom)
 
             # quota prefix per tree level, same trick
             for d in range(quota_depth):

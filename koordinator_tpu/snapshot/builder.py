@@ -15,6 +15,7 @@ Everything is plain numpy; `SnapshotStore` (store.py) owns device upload.
 from __future__ import annotations
 
 import dataclasses
+import itertools
 import json
 import time
 from typing import Dict, Iterable, List, Mapping, Optional, Sequence, Tuple
@@ -178,6 +179,7 @@ class SnapshotBuilder:
                  max_gpu_inst: int = 0, max_aux_inst: int = 0,
                  max_selectors: int = 8, max_label_groups: int = 64,
                  max_tolerations: int = 8, max_taint_groups: int = 16,
+                 max_spread_groups: int = 8, max_spread_domains: int = 16,
                  metric_expiration_s: float = DEFAULT_NODE_METRIC_EXPIRATION_S,
                  estimator_weights: Optional[Mapping[ResourceKind, float]] = None,
                  estimator_scaling: Optional[Mapping[ResourceKind, float]] = None,
@@ -193,6 +195,8 @@ class SnapshotBuilder:
         self.max_label_groups = max_label_groups
         self.max_tolerations = max_tolerations
         self.max_taint_groups = max_taint_groups
+        self.max_spread_groups = max_spread_groups
+        self.max_spread_domains = max_spread_domains
         self._taint_groups: Dict[tuple, int] = {}
         self.metric_expiration_s = metric_expiration_s
         # estimator config must match the LoadAware plugin args so that
@@ -857,9 +861,13 @@ class SnapshotBuilder:
         tol_id = np.zeros((p,), np.int32)
         valid = np.zeros((p,), bool)
 
-        selectors: Dict[frozenset, int] = {}
+        # (selector items, affinity expr key) -> (row, typed requirements)
+        selectors: Dict[tuple, tuple] = {}
         # toleration set -> (row, typed list); row 0 = empty set
         tol_sets: Dict[tuple, tuple] = {(): (0, [])}
+        # spread constraint key -> (row, constraint, namespace)
+        spread_groups: Dict[tuple, tuple] = {}
+        spread_row = np.full((p,), -1, np.int32)
         for i, pod in enumerate(pods):
             requests[i] = resource_vec(pod.requests)
             estimated[i] = estimate_pod(pod, self.estimator_scaling,
@@ -869,13 +877,21 @@ class SnapshotBuilder:
             prio[i] = pod.priority if pod.priority is not None else 0
             gang_id[i] = self.gang_index.get(pod.gang_name, -1)
             quota_id[i] = self.quota_index.get(pod.quota_name, -1)
-            if pod.node_selector:
-                key = frozenset(pod.node_selector.items())
+            if pod.node_selector or pod.node_affinity:
+                # the selector row covers BOTH the equality selector and
+                # the required nodeAffinity expressions (ANDed, like the
+                # upstream NodeAffinity filter folds them together)
+                key = (frozenset(pod.node_selector.items()),
+                       tuple((r.key, r.operator, tuple(r.values))
+                             for r in pod.node_affinity))
                 if key not in selectors and len(selectors) >= self.max_selectors:
                     raise ValueError(
                         f"distinct pod nodeSelectors exceed max_selectors="
                         f"{self.max_selectors}")
-                sel_id[i] = selectors.setdefault(key, len(selectors))
+                if key not in selectors:
+                    selectors[key] = (len(selectors),
+                                      list(pod.node_affinity))
+                sel_id[i] = selectors[key][0]
             for sel_key, group in ctx.reservation_owner_groups.items():
                 if sel_key and _labels_match_key(pod.meta.labels, sel_key):
                     res_owner[i] = group
@@ -895,6 +911,31 @@ class SnapshotBuilder:
                     entry = (len(tol_sets), list(pod.tolerations))
                     tol_sets[tkey] = entry
                 tol_id[i] = entry[0]
+            # the first HARD spread constraint is modeled on device
+            # (ScheduleAnyway is a soft preference the ranking subsumes)
+            hard = next((c for c in pod.spread_constraints
+                         if c.when_unsatisfiable == "DoNotSchedule"), None)
+            if hard is not None:
+                # the group key includes the pod's own node constraints:
+                # domain eligibility (which domains count toward the
+                # skew minimum) follows the pods' reachable nodes
+                # (upstream nodeAffinityPolicy=Honor), so pods with
+                # different selectors must not share a group
+                skey = (pod.meta.namespace, hard.topology_key,
+                        hard.max_skew,
+                        tuple(sorted(hard.label_selector.items())),
+                        tuple(sorted(pod.node_selector.items())),
+                        tuple((r.key, r.operator, tuple(r.values))
+                              for r in pod.node_affinity))
+                entry = spread_groups.get(skey)
+                if entry is None:
+                    if len(spread_groups) >= self.max_spread_groups:
+                        raise ValueError(
+                            f"distinct spread constraints exceed "
+                            f"max_spread_groups={self.max_spread_groups}")
+                    entry = (len(spread_groups), hard, pod)
+                    spread_groups[skey] = entry
+                spread_row[i] = entry[0]
             valid[i] = True
 
         # selector x node-label-group match matrix, padded to static
@@ -902,12 +943,13 @@ class SnapshotBuilder:
         s = self.max_selectors
         l = self.max_label_groups
         sel_match = np.zeros((s, l), bool)
-        for sel_key, si in selectors.items():
-            sel = dict(sel_key)
+        for (sel_set, _), (si, reqs) in selectors.items():
+            sel = dict(sel_set)
             for lab_key, li in ctx.node_label_groups.items():
                 labels = dict(lab_key)
-                sel_match[si, li] = all(labels.get(k) == v
-                                        for k, v in sel.items())
+                sel_match[si, li] = (
+                    all(labels.get(k) == v for k, v in sel.items())
+                    and all(r.matches(labels) for r in reqs))
         # toleration x node-taint-group matrices (TaintToleration: the
         # filter forbids on any untolerated NoSchedule/NoExecute taint,
         # the score counts untolerated PreferNoSchedule taints). A fully
@@ -933,6 +975,61 @@ class SnapshotBuilder:
                             tol_forbid[ti, gi] = True
                         elif taint.effect == "PreferNoSchedule":
                             tol_prefer[ti, gi] += 1.0
+        # spread matrices: node domains per group + initial counts from
+        # matching running AND assumed pods (every other capacity path —
+        # requested, assigned_estimated, quota used — carries assumed
+        # state; spread counts must too, or consecutive batches
+        # undercount the domains they just filled)
+        if not spread_groups:
+            spread_max_skew = np.ones((1,), np.float32)
+            spread_domain = np.full((1, 1), -1, np.int32)
+            spread_count0 = np.zeros((1, 1), np.float32)
+            spread_dvalid = np.zeros((1, 1), bool)
+        else:
+            sg_cap = self.max_spread_groups
+            d_cap = self.max_spread_domains
+            spread_max_skew = np.ones((sg_cap,), np.float32)
+            spread_domain = np.full((sg_cap, self.max_nodes), -1, np.int32)
+            spread_count0 = np.zeros((sg_cap, d_cap), np.float32)
+            spread_dvalid = np.zeros((sg_cap, d_cap), bool)
+            for (row, c, proto) in spread_groups.values():
+                ns = proto.meta.namespace
+                spread_max_skew[row] = float(c.max_skew)
+                domains: Dict[str, int] = {}
+                for ni, node in enumerate(self.nodes):
+                    val = node.meta.labels.get(c.topology_key)
+                    if val is None:
+                        continue
+                    if val not in domains:
+                        if len(domains) >= d_cap:
+                            raise ValueError(
+                                f"distinct {c.topology_key!r} values "
+                                f"exceed max_spread_domains={d_cap}")
+                        domains[val] = len(domains)
+                    spread_domain[row, ni] = domains[val]
+                    # a domain counts toward the skew minimum only when
+                    # the group's pods can actually reach a node in it
+                    # (upstream nodeAffinityPolicy=Honor: unreachable
+                    # domains never pin the minimum at zero)
+                    reachable = (
+                        all(node.meta.labels.get(k) == v
+                            for k, v in proto.node_selector.items())
+                        and all(r.matches(node.meta.labels)
+                                for r in proto.node_affinity))
+                    if reachable:
+                        spread_dvalid[row, domains[val]] = True
+                counted = itertools.chain(
+                    ((rp, rp.node_name) for rp in self.running_pods),
+                    ((ap.pod, ap.node_name) for ap in self.assigned))
+                for cp, node_name in counted:
+                    if cp.meta.namespace != ns:
+                        continue
+                    if not all(cp.meta.labels.get(k) == v
+                               for k, v in c.label_selector.items()):
+                        continue
+                    ni = self.node_index.get(node_name)
+                    if ni is not None and spread_domain[row, ni] >= 0:
+                        spread_count0[row, spread_domain[row, ni]] += 1.0
         return PodBatch(
             requests=requests, estimated=estimated, qos=qos,
             priority_class=prio_class, priority=prio, gang_id=gang_id,
@@ -940,7 +1037,10 @@ class SnapshotBuilder:
             reservation_owner=res_owner, gpu_ratio=gpu_ratio,
             numa_single=numa_single, daemonset=daemonset,
             toleration_id=tol_id, tol_forbid=tol_forbid,
-            tol_prefer=tol_prefer, valid=valid)
+            tol_prefer=tol_prefer,
+            spread_id=spread_row, spread_max_skew=spread_max_skew,
+            spread_domain=spread_domain, spread_count0=spread_count0,
+            spread_dvalid=spread_dvalid, valid=valid)
 
 
 def _selector_key(selector: Dict[str, str]) -> str:

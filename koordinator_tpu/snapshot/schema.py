@@ -138,6 +138,18 @@ class PodBatch:
     tol_prefer: Array       # f32[T, TG] count of untolerated
                             # PreferNoSchedule taints (score penalty,
                             # upstream tainttoleration scoring)
+    # PodTopologySpread (upstream hard constraints), batched: pods with
+    # an identical (namespace, key, skew, selector) constraint share a
+    # spread group; [1, 1]-shaped matrices mean no spread modeling and
+    # the gate compiles out. Gating runs at ROUND granularity — exact at
+    # chunk size 1 like every other commit gate.
+    spread_id: Array        # i32[P] spread group, -1 = none
+    spread_max_skew: Array  # f32[Sg]
+    spread_domain: Array    # i32[Sg, N] node's domain for the group's
+                            # topology key, -1 = node lacks the label
+                            # (hard constraints reject such nodes)
+    spread_count0: Array    # f32[Sg, D] matching running pods per domain
+    spread_dvalid: Array    # bool[Sg, D] domain exists in the cluster
     valid: Array            # bool[P]
 
     @property

@@ -269,6 +269,11 @@ def synthetic_pods(num_pods: int, seed: int = 1,
         toleration_id=np.zeros((p,), np.int32),
         tol_forbid=np.zeros((1, 1), bool),
         tol_prefer=np.zeros((1, 1), f32),
+        spread_id=np.full((p,), -1, np.int32),
+        spread_max_skew=np.ones((1,), f32),
+        spread_domain=np.full((1, 1), -1, np.int32),
+        spread_count0=np.zeros((1, 1), f32),
+        spread_dvalid=np.zeros((1, 1), bool),
         valid=np.ones((p,), bool),
     )
 
@@ -289,7 +294,7 @@ def stack_pod_chunks(pods: PodBatch, chunk: int) -> dict:
 PER_POD_FIELDS = ("requests", "estimated", "qos", "priority_class",
                   "priority", "gang_id", "quota_id", "selector_id",
                   "reservation_owner", "gpu_ratio", "numa_single",
-                  "daemonset", "toleration_id", "valid")
+                  "daemonset", "toleration_id", "spread_id", "valid")
 
 
 def slice_batch(batch: PodBatch, start: int, size: int) -> PodBatch:

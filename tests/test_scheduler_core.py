@@ -335,3 +335,187 @@ def test_blanket_toleration_tolerates_everything():
         Taint(key="k", effect="NoSchedule"))
     assert not Toleration(effect="NoExecute").tolerates(
         Taint(key="k", effect="NoSchedule"))
+
+
+def test_node_affinity_expressions():
+    """Required nodeAffinity expressions (In/NotIn/Exists/Gt) gate like
+    the equality selector, ANDed with it (upstream NodeAffinity)."""
+    from koordinator_tpu.api.types import NodeSelectorRequirement as Req
+
+    b = SnapshotBuilder(max_nodes=3)
+    b.add_node(Node(meta=ObjectMeta(name="a",
+                                    labels={"zone": "z1", "gen": "7"}),
+                    allocatable={RK.CPU: 8000, RK.MEMORY: 16384}))
+    b.add_node(Node(meta=ObjectMeta(name="b",
+                                    labels={"zone": "z2", "gen": "9"}),
+                    allocatable={RK.CPU: 8000, RK.MEMORY: 16384}))
+    b.add_node(Node(meta=ObjectMeta(name="c", labels={"zone": "z3"}),
+                    allocatable={RK.CPU: 8000, RK.MEMORY: 16384}))
+    for nm in ("a", "b", "c"):
+        b.set_node_metric(NodeMetric(node_name=nm, update_time=NOW,
+                                     node_usage={}))
+    snap, ctx = b.build(now=NOW)
+    pods = [
+        Pod(meta=ObjectMeta(name="in"), priority=9000,
+            requests={RK.CPU: 100.0},
+            node_affinity=[Req(key="zone", operator="In",
+                               values=["z1", "z2"]),
+                           Req(key="gen", operator="Gt", values=["8"])]),
+        Pod(meta=ObjectMeta(name="notin"), priority=9000,
+            requests={RK.CPU: 100.0},
+            node_affinity=[Req(key="zone", operator="NotIn",
+                               values=["z1", "z2"])]),
+        Pod(meta=ObjectMeta(name="nogen"), priority=9000,
+            requests={RK.CPU: 100.0},
+            node_affinity=[Req(key="gen", operator="DoesNotExist")]),
+    ]
+    res = core.schedule_batch(snap, b.build_pod_batch(pods, ctx),
+                              loadaware.LoadAwareConfig.make())
+    a = np.asarray(res.assignment)
+    assert a[0] == 1   # zone in {z1,z2} AND gen > 8 -> only b
+    assert a[1] == 2   # NotIn z1/z2 -> c
+    assert a[2] == 2   # no gen label -> c
+
+
+def test_topology_spread_hard_constraint():
+    """PodTopologySpread DoNotSchedule: maxSkew 1 over a zone key spreads
+    members across domains; nodes lacking the key are rejected; existing
+    matching pods count toward their domains."""
+    from koordinator_tpu.api.types import TopologySpreadConstraint as TSC
+
+    b = SnapshotBuilder(max_nodes=4)
+    for i, zone in enumerate(("z1", "z1", "z2", None)):
+        labels = {"zone": zone} if zone else {}
+        b.add_node(Node(meta=ObjectMeta(name=f"n{i}", labels=labels),
+                        allocatable={RK.CPU: 64000, RK.MEMORY: 65536}))
+        b.set_node_metric(NodeMetric(node_name=f"n{i}", update_time=NOW,
+                                     node_usage={}))
+    # one member already running in z1
+    b.add_running_pod(Pod(meta=ObjectMeta(name="r0", namespace="d",
+                                          labels={"app": "web"}),
+                          requests={RK.CPU: 100.0}, phase="Running",
+                          node_name="n0"))
+    snap, ctx = b.build(now=NOW)
+    tsc = TSC(max_skew=1, topology_key="zone",
+              label_selector={"app": "web"})
+    members = [Pod(meta=ObjectMeta(name=f"w{j}", namespace="d",
+                                   labels={"app": "web"}),
+                   priority=9000, requests={RK.CPU: 100.0},
+                   spread_constraints=[tsc]) for j in range(3)]
+    res = core.schedule_batch(snap, b.build_pod_batch(members, ctx),
+                              loadaware.LoadAwareConfig.make(),
+                              num_rounds=4)
+    a = np.asarray(res.assignment)
+    assert (a >= 0).all(), a
+    assert (a != 3).all()          # keyless node rejected
+    zones = np.where(np.isin(a, [0, 1]), "z1", "z2")
+    # initial: z1=1, z2=0; after 3 more with skew 1 -> z1=2, z2=2
+    z1 = int((zones == "z1").sum()) + 1
+    z2 = int((zones == "z2").sum())
+    assert abs(z1 - z2) <= 1, (z1, z2)
+
+
+def test_topology_spread_rejects_when_skew_impossible():
+    """All capacity in one domain: members beyond skew stay pending."""
+    from koordinator_tpu.api.types import TopologySpreadConstraint as TSC
+
+    b = SnapshotBuilder(max_nodes=2)
+    for i, zone in enumerate(("z1", "z2")):
+        b.add_node(Node(meta=ObjectMeta(name=f"n{i}",
+                                        labels={"zone": zone}),
+                        allocatable={RK.CPU: 8000 if i == 0 else 200,
+                                     RK.MEMORY: 16384}))
+        b.set_node_metric(NodeMetric(node_name=f"n{i}", update_time=NOW,
+                                     node_usage={}))
+    snap, ctx = b.build(now=NOW)
+    tsc = TSC(max_skew=1, topology_key="zone",
+              label_selector={"app": "web"})
+    members = [Pod(meta=ObjectMeta(name=f"w{j}", namespace="d",
+                                   labels={"app": "web"}),
+                   priority=9000, requests={RK.CPU: 500.0},
+                   spread_constraints=[tsc]) for j in range(4)]
+    res = core.schedule_batch(snap, b.build_pod_batch(members, ctx),
+                              loadaware.LoadAwareConfig.make(),
+                              num_rounds=6)
+    a = np.asarray(res.assignment)
+    # z2 fits nothing (200m < 500m): z1 can take at most skew+0 = 1
+    assert (a == 1).sum() == 0
+    assert (a == 0).sum() == 1, a
+    assert (a == -1).sum() == 3
+
+
+def test_topology_spread_counts_assumed_across_batches():
+    """Regression: a second batch must see the first batch's assumed
+    placements in its spread counts (the builder counts running AND
+    assumed pods, like every other capacity path)."""
+    from koordinator_tpu.api.types import TopologySpreadConstraint as TSC
+
+    def fresh_builder():
+        b = SnapshotBuilder(max_nodes=2)
+        for i, zone in enumerate(("z1", "z2")):
+            b.add_node(Node(meta=ObjectMeta(name=f"n{i}",
+                                            labels={"zone": zone}),
+                            allocatable={RK.CPU: 64000,
+                                         RK.MEMORY: 65536}))
+            b.set_node_metric(NodeMetric(node_name=f"n{i}",
+                                         update_time=NOW, node_usage={}))
+        return b
+
+    tsc = TSC(max_skew=1, topology_key="zone",
+              label_selector={"app": "web"})
+
+    def member(j):
+        return Pod(meta=ObjectMeta(name=f"w{j}", namespace="d",
+                                   labels={"app": "web"}),
+                   priority=9000, requests={RK.CPU: 100.0},
+                   spread_constraints=[tsc])
+
+    b = fresh_builder()
+    snap, ctx = b.build(now=NOW)
+    res1 = core.schedule_batch(snap, b.build_pod_batch([member(0)], ctx),
+                               loadaware.LoadAwareConfig.make())
+    first = int(np.asarray(res1.assignment)[0])
+    assert first >= 0
+    # batch 2 via a rebuilt snapshot carrying the assume
+    b2 = fresh_builder()
+    b2.add_assigned(member(0), f"n{first}", timestamp=NOW)
+    snap2, ctx2 = b2.build(now=NOW)
+    batch2 = b2.build_pod_batch([member(1)], ctx2)
+    assert np.asarray(batch2.spread_count0).sum() == 1.0
+    res2 = core.schedule_batch(snap2, batch2,
+                               loadaware.LoadAwareConfig.make())
+    second = int(np.asarray(res2.assignment)[0])
+    assert second >= 0 and second != first  # spread to the other zone
+
+
+def test_topology_spread_min_ignores_unreachable_domains():
+    """Regression: a domain the group's pods can never enter (their own
+    node selector excludes it) must not pin the skew minimum at zero
+    (upstream nodeAffinityPolicy=Honor)."""
+    from koordinator_tpu.api.types import TopologySpreadConstraint as TSC
+
+    b = SnapshotBuilder(max_nodes=3)
+    for i, zone in enumerate(("z1", "z2", "z3")):
+        b.add_node(Node(meta=ObjectMeta(name=f"n{i}",
+                                        labels={"zone": zone,
+                                                "pool": "gpu" if zone == "z3"
+                                                else "cpu"}),
+                        allocatable={RK.CPU: 64000, RK.MEMORY: 65536}))
+        b.set_node_metric(NodeMetric(node_name=f"n{i}", update_time=NOW,
+                                     node_usage={}))
+    snap, ctx = b.build(now=NOW)
+    tsc = TSC(max_skew=1, topology_key="zone",
+              label_selector={"app": "web"})
+    members = [Pod(meta=ObjectMeta(name=f"w{j}", namespace="d",
+                                   labels={"app": "web"}),
+                   priority=9000, requests={RK.CPU: 100.0},
+                   node_selector={"pool": "cpu"},
+                   spread_constraints=[tsc]) for j in range(4)]
+    res = core.schedule_batch(snap, b.build_pod_batch(members, ctx),
+                              loadaware.LoadAwareConfig.make(),
+                              num_rounds=6)
+    a = np.asarray(res.assignment)
+    # z3 (gpu pool) is unreachable; 4 members split 2/2 over z1/z2 —
+    # with z3 wrongly pinning the min, only 2 would ever place
+    assert (a >= 0).all(), a
+    assert sorted(((a == 0).sum(), (a == 1).sum())) == [2, 2]
