@@ -106,6 +106,18 @@ class TopologySpreadConstraint:
 
 
 @dataclasses.dataclass
+class PodAffinityTerm:
+    """core/v1 PodAffinityTerm subset (requiredDuringScheduling...):
+    co-locate with (`anti`=False) or keep away from (`anti`=True) pods
+    matching `label_selector` (own namespace) within the node-label
+    domains of `topology_key`."""
+
+    topology_key: str = "kubernetes.io/hostname"
+    label_selector: Dict[str, str] = dataclasses.field(default_factory=dict)
+    anti: bool = False
+
+
+@dataclasses.dataclass
 class Toleration:
     """Pod toleration: empty key tolerates EVERY taint key (the blanket
     operator-Exists toleration critical DaemonSets carry); empty value
@@ -173,6 +185,10 @@ class Pod:
     # topology spread (the FIRST hard constraint is modeled on device;
     # upstream allows several — a documented narrowing)
     spread_constraints: List[TopologySpreadConstraint] = dataclasses.field(
+        default_factory=list)
+    # inter-pod affinity: the first required affinity term and the first
+    # required anti-affinity term are modeled on device
+    pod_affinity: List[PodAffinityTerm] = dataclasses.field(
         default_factory=list)
     # controller owner (ReplicaSet/StatefulSet...) — the migration
     # arbitrator bounds blast radius per workload (arbitrator/filter.go)

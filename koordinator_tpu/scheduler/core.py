@@ -313,26 +313,62 @@ def schedule_batch(snap: ClusterSnapshot, pods: PodBatch,
     # chunking one logical workload must rebuild batches through the
     # builder (the informer/service flow) so each chunk sees the
     # previous chunks' assumes.
+    def domain_machinery(dom_matrix, count0, member):
+        """Shared (group x topology-domain) machinery for spread and
+        inter-pod (anti-)affinity: the extended domain map (slot columns
+        inherit their node's domain) and a counts closure over the
+        carried assignment. `member[P, G]` marks which placed batch pods
+        charge group g's domain count — membership is by selector match,
+        so a matching pod placed in the same batch counts even when it
+        carries no such constraint itself."""
+        n_g, n_d = count0.shape
+        if n_slots:
+            dom_x = jnp.concatenate(
+                [dom_matrix, dom_matrix[:, slot_node_c]], 1)  # [G, N+V]
+        else:
+            dom_x = dom_matrix
+
+        def counts_flat(placed_now):
+            pl = jnp.maximum(placed_now, 0)
+            dom_pg = dom_x.T[pl]                              # [P, G]
+            ok = member & (placed_now >= 0)[:, None]
+            dom_pg = jnp.where(ok, dom_pg, -1)
+            g_idx = jnp.arange(n_g, dtype=jnp.int32)[None, :]
+            seg = jnp.where(dom_pg >= 0, g_idx * n_d + dom_pg,
+                            n_g * n_d).reshape(-1)
+            return count0.reshape(-1).at[seg].add(1.0, mode="drop")
+
+        return dom_x, counts_flat, n_g, n_d
+
     use_spread = pods.spread_domain.shape != (1, 1)
     if use_spread:
-        n_sg, n_dom = pods.spread_count0.shape
         sid = jnp.maximum(pods.spread_id, 0)
-        if n_slots:
-            spread_domain_x = jnp.concatenate(
-                [pods.spread_domain, pods.spread_domain[:, slot_node_c]], 1)
-        else:
-            spread_domain_x = pods.spread_domain            # [Sg, N+V]
-
-        def spread_counts_flat(placed_now):
-            """flat [Sg*D] matching-pod counts = initial + the carried
-            assignment's placements (shared by the round feasibility
-            gate and the inner prefix cap so they can never diverge)."""
-            pdom = jnp.where(
-                (placed_now >= 0) & (pods.spread_id >= 0),
-                spread_domain_x[sid, jnp.maximum(placed_now, 0)], -1)
-            seg = jnp.where(pdom >= 0, sid * n_dom + pdom, n_sg * n_dom)
-            return pods.spread_count0.reshape(-1).at[seg].add(
-                1.0, mode="drop")
+        spread_domain_x, spread_counts_flat, n_sg, n_dom = \
+            domain_machinery(pods.spread_domain, pods.spread_count0,
+                             pods.spread_member)
+    # inter-pod anti-affinity: a domain admits a gated pod only at count
+    # 0; nodes LACKING the topology key pass (no topology pair can
+    # exist there — upstream admits them).
+    use_anti = pods.anti_domain.shape != (1, 1)
+    if use_anti:
+        aid = jnp.maximum(pods.anti_id, 0)
+        anti_domain_x, anti_counts_flat, n_ag, n_ad = \
+            domain_machinery(pods.anti_domain, pods.anti_count0,
+                             pods.anti_member)
+    # inter-pod affinity: a domain admits a gated pod only when it holds
+    # a matching pod — except the bootstrap: when nothing matches
+    # anywhere, any self-matching member may OPEN a domain, capped to
+    # one opener per group per inner step so the group still converges
+    # to co-location (upstream's self-affinity special case, without
+    # pinning the bootstrap to one member that might be unschedulable).
+    use_aff = pods.aff_domain.shape != (1, 1)
+    if use_aff:
+        fid = jnp.maximum(pods.aff_id, 0)
+        aff_self_pod = jnp.take_along_axis(
+            pods.aff_member, fid[:, None], axis=1)[:, 0]    # bool[P]
+        aff_domain_x, aff_counts_flat, n_fg, n_fd = \
+            domain_machinery(pods.aff_domain, pods.aff_count0,
+                             pods.aff_member)
 
     def round_body(carry, _):
         requested, quota_used, numa_used, gpu_free, aux_free, once_taken, \
@@ -381,6 +417,27 @@ def schedule_batch(snap: ClusterSnapshot, pods: PodBatch,
             spread_limit = jnp.broadcast_to(
                 (pods.spread_max_skew + min_c)[:, None],
                 (n_sg, n_dom)).reshape(-1, 1)             # [Sg*D, 1]
+        if use_anti:
+            counts_an = anti_counts_flat(placed).reshape(n_ag, n_ad)
+            cdom_an = anti_domain_x[aid]                  # [P, N+V]
+            cc_an = jnp.take_along_axis(counts_an[aid],
+                                        jnp.maximum(cdom_an, 0), axis=1)
+            # keyless nodes pass: no topology pair can exist there
+            anti_ok = (cdom_an < 0) | (cc_an < 0.5)
+            feasible &= (pods.anti_id < 0)[:, None] | anti_ok
+        if use_aff:
+            counts_af = aff_counts_flat(placed).reshape(n_fg, n_fd)
+            total_af = jnp.sum(counts_af, axis=1)         # [Fg]
+            cdom_af = aff_domain_x[fid]                   # [P, N+V]
+            cc_af = jnp.take_along_axis(counts_af[fid],
+                                        jnp.maximum(cdom_af, 0), axis=1)
+            # bootstrap feasibility: ANY active self-matching member of
+            # an empty group may open a domain; the inner prefix caps
+            # openers to one per group per step
+            bootstrap = (active & (pods.aff_id >= 0) & aff_self_pod
+                         & (total_af[fid] < 0.5))
+            aff_ok = (cdom_af >= 0) & ((cc_af > 0.5) | bootstrap[:, None])
+            feasible &= (pods.aff_id < 0)[:, None] | aff_ok
 
         # quota admission (ElasticQuota PreFilter, plugin.go:211-257):
         # used + request <= runtime at every tree level
@@ -483,6 +540,50 @@ def schedule_batch(snap: ClusterSnapshot, pods: PodBatch,
                 accept &= segment_prefix_ok(
                     sseg, earlier, has_s[:, None].astype(jnp.float32),
                     counts_now, spread_limit, n_sg * n_dom)
+            if use_anti:
+                # anti-affinity within the step: per group, every trying
+                # MEMBER (selector-matching pod, gated or not) charges
+                # its chosen domain; gated pods are rejected when any
+                # earlier-ranked charge (or an initial count) occupies
+                # it. The per-group loop lets a pod contribute to
+                # several groups' accounting while being gated by only
+                # its own.
+                counts_an_now = anti_counts_flat(placed).reshape(
+                    n_ag, n_ad)
+                choice_dom = jnp.clip(choice_eff, 0, n_ext - 1)
+                for g in range(n_ag):
+                    dom_g = anti_domain_x[g, choice_dom]      # [P]
+                    contrib = ((trying & pods.anti_member[:, g]
+                                & (dom_g >= 0))
+                               .astype(jnp.float32))
+                    gated_g = trying & (pods.anti_id == g) & (dom_g >= 0)
+                    # occupancy of the pod's chosen domain BEFORE it:
+                    # initial/carried count + earlier-ranked in-step
+                    # contributions (members charge; gated non-members
+                    # are blocked by occupancy but add none)
+                    same_d = dom_g[:, None] == dom_g[None, :]
+                    charge = ((same_d & earlier).astype(jnp.float32)
+                              @ contrib)
+                    occ = counts_an_now[g, jnp.maximum(dom_g, 0)] + charge
+                    accept &= (occ < 0.5) | ~gated_g
+            if use_aff:
+                # bootstrap cap: attempts into an EMPTY domain of an
+                # empty group are limited to one per group per step
+                counts_af_now = aff_counts_flat(placed).reshape(n_fg,
+                                                                n_fd)
+                total_now = jnp.sum(counts_af_now, axis=1)  # [Fg]
+                fdom_c = aff_domain_x[fid, jnp.clip(choice_eff, 0,
+                                                    n_ext - 1)]
+                cc_now = jnp.take_along_axis(
+                    counts_af_now[fid],
+                    jnp.maximum(fdom_c, 0)[:, None], axis=1)[:, 0]
+                boot_try = (trying & (pods.aff_id >= 0)
+                            & (fdom_c >= 0) & (cc_now < 0.5))
+                fseg = jnp.where(boot_try, fid, n_fg)
+                accept &= segment_prefix_ok(
+                    fseg, earlier, boot_try[:, None].astype(jnp.float32),
+                    total_now.reshape(-1, 1),
+                    jnp.ones((n_fg, 1), jnp.float32), n_fg)
 
             # quota prefix per tree level, same trick
             for d in range(quota_depth):

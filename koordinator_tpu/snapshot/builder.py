@@ -868,6 +868,11 @@ class SnapshotBuilder:
         # spread constraint key -> (row, constraint, namespace)
         spread_groups: Dict[tuple, tuple] = {}
         spread_row = np.full((p,), -1, np.int32)
+        # inter-pod affinity: (ns, key, selector) -> (row, term, proto)
+        anti_groups: Dict[tuple, tuple] = {}
+        aff_groups: Dict[tuple, tuple] = {}
+        anti_row = np.full((p,), -1, np.int32)
+        aff_row = np.full((p,), -1, np.int32)
         for i, pod in enumerate(pods):
             requests[i] = resource_vec(pod.requests)
             estimated[i] = estimate_pod(pod, self.estimator_scaling,
@@ -936,6 +941,22 @@ class SnapshotBuilder:
                     entry = (len(spread_groups), hard, pod)
                     spread_groups[skey] = entry
                 spread_row[i] = entry[0]
+            for term in pod.pod_affinity:
+                groups = anti_groups if term.anti else aff_groups
+                rows = anti_row if term.anti else aff_row
+                if rows[i] >= 0:
+                    continue  # first term of each polarity is modeled
+                akey = (pod.meta.namespace, term.topology_key,
+                        tuple(sorted(term.label_selector.items())))
+                entry = groups.get(akey)
+                if entry is None:
+                    if len(groups) >= self.max_spread_groups:
+                        raise ValueError(
+                            f"distinct pod-affinity terms exceed "
+                            f"max_spread_groups={self.max_spread_groups}")
+                    entry = (len(groups), term, pod)
+                    groups[akey] = entry
+                rows[i] = entry[0]
             valid[i] = True
 
         # selector x node-label-group match matrix, padded to static
@@ -985,6 +1006,7 @@ class SnapshotBuilder:
             spread_domain = np.full((1, 1), -1, np.int32)
             spread_count0 = np.zeros((1, 1), np.float32)
             spread_dvalid = np.zeros((1, 1), bool)
+            spread_member = np.zeros((p, 1), bool)
         else:
             sg_cap = self.max_spread_groups
             d_cap = self.max_spread_domains
@@ -992,21 +1014,14 @@ class SnapshotBuilder:
             spread_domain = np.full((sg_cap, self.max_nodes), -1, np.int32)
             spread_count0 = np.zeros((sg_cap, d_cap), np.float32)
             spread_dvalid = np.zeros((sg_cap, d_cap), bool)
+            spread_member = np.zeros((p, sg_cap), bool)
             for (row, c, proto) in spread_groups.values():
                 ns = proto.meta.namespace
                 spread_max_skew[row] = float(c.max_skew)
-                domains: Dict[str, int] = {}
+                self._fill_domain_map(c.topology_key, row, spread_domain)
                 for ni, node in enumerate(self.nodes):
-                    val = node.meta.labels.get(c.topology_key)
-                    if val is None:
+                    if spread_domain[row, ni] < 0:
                         continue
-                    if val not in domains:
-                        if len(domains) >= d_cap:
-                            raise ValueError(
-                                f"distinct {c.topology_key!r} values "
-                                f"exceed max_spread_domains={d_cap}")
-                        domains[val] = len(domains)
-                    spread_domain[row, ni] = domains[val]
                     # a domain counts toward the skew minimum only when
                     # the group's pods can actually reach a node in it
                     # (upstream nodeAffinityPolicy=Honor: unreachable
@@ -1017,19 +1032,51 @@ class SnapshotBuilder:
                         and all(r.matches(node.meta.labels)
                                 for r in proto.node_affinity))
                     if reachable:
-                        spread_dvalid[row, domains[val]] = True
-                counted = itertools.chain(
-                    ((rp, rp.node_name) for rp in self.running_pods),
-                    ((ap.pod, ap.node_name) for ap in self.assigned))
-                for cp, node_name in counted:
-                    if cp.meta.namespace != ns:
-                        continue
-                    if not all(cp.meta.labels.get(k) == v
-                               for k, v in c.label_selector.items()):
-                        continue
-                    ni = self.node_index.get(node_name)
-                    if ni is not None and spread_domain[row, ni] >= 0:
-                        spread_count0[row, spread_domain[row, ni]] += 1.0
+                        spread_dvalid[row, spread_domain[row, ni]] = True
+                self._count_matching(ns, c.label_selector, row,
+                                     spread_domain, spread_count0)
+                for i, pod in enumerate(pods):
+                    spread_member[i, row] = self._matches(
+                        pod, ns, c.label_selector)
+        # existing pods' REQUIRED anti terms bind incoming pods too
+        # (satisfyExistingPodsAntiAffinity): each such term becomes an
+        # anti group whose carrier domain is forbidden; matching batch
+        # pods without their own anti gate are gated by it
+        carriers: List[tuple] = []
+        for ep, node_name in self._existing_pods():
+            for term in ep.pod_affinity:
+                if not term.anti:
+                    continue
+                akey = (ep.meta.namespace, term.topology_key,
+                        tuple(sorted(term.label_selector.items())))
+                entry = anti_groups.get(akey)
+                if entry is None:
+                    if len(anti_groups) >= self.max_spread_groups:
+                        raise ValueError(
+                            f"distinct pod-affinity terms exceed "
+                            f"max_spread_groups={self.max_spread_groups}")
+                    entry = (len(anti_groups), term, ep)
+                    anti_groups[akey] = entry
+                carriers.append((entry[0], node_name))
+        anti_domain, anti_count0, anti_member = self._affinity_matrices(
+            pods, anti_groups, p)
+        # forbid each carrier's own domain regardless of whether the
+        # carrier matches its own selector
+        for row, node_name in carriers:
+            ni = self.node_index.get(node_name)
+            if ni is not None and anti_domain[row, ni] >= 0:
+                anti_count0[row, anti_domain[row, ni]] = max(
+                    anti_count0[row, anti_domain[row, ni]], 1.0)
+        # gate matching batch pods that carry no anti term of their own
+        for i, pod in enumerate(pods):
+            if anti_row[i] < 0 and i < len(pods):
+                for (ns, _k, _s), (row, term, _proto) in \
+                        anti_groups.items():
+                    if self._matches(pod, ns, term.label_selector):
+                        anti_row[i] = row
+                        break
+        aff_domain, aff_count0, aff_member = self._affinity_matrices(
+            pods, aff_groups, p)
         return PodBatch(
             requests=requests, estimated=estimated, qos=qos,
             priority_class=prio_class, priority=prio, gang_id=gang_id,
@@ -1038,9 +1085,81 @@ class SnapshotBuilder:
             numa_single=numa_single, daemonset=daemonset,
             toleration_id=tol_id, tol_forbid=tol_forbid,
             tol_prefer=tol_prefer,
-            spread_id=spread_row, spread_max_skew=spread_max_skew,
+            spread_id=spread_row, spread_member=spread_member,
+            spread_max_skew=spread_max_skew,
             spread_domain=spread_domain, spread_count0=spread_count0,
-            spread_dvalid=spread_dvalid, valid=valid)
+            spread_dvalid=spread_dvalid,
+            anti_id=anti_row, anti_member=anti_member,
+            anti_domain=anti_domain, anti_count0=anti_count0,
+            aff_id=aff_row, aff_member=aff_member,
+            aff_domain=aff_domain, aff_count0=aff_count0, valid=valid)
+
+    def _fill_domain_map(self, topology_key: str, row: int,
+                         domain: np.ndarray) -> None:
+        """Write each node's domain id for `topology_key` into
+        domain[row] (-1 when the node lacks the label)."""
+        domains: Dict[str, int] = {}
+        for ni, node in enumerate(self.nodes):
+            val = node.meta.labels.get(topology_key)
+            if val is None:
+                continue
+            if val not in domains:
+                if len(domains) >= self.max_spread_domains:
+                    raise ValueError(
+                        f"distinct {topology_key!r} values exceed "
+                        f"max_spread_domains={self.max_spread_domains}")
+                domains[val] = len(domains)
+            domain[row, ni] = domains[val]
+
+    def _existing_pods(self):
+        """(pod, node_name) for every running AND assumed pod — the set
+        every count/constraint derived from cluster state must include."""
+        return itertools.chain(
+            ((rp, rp.node_name) for rp in self.running_pods),
+            ((ap.pod, ap.node_name) for ap in self.assigned))
+
+    @staticmethod
+    def _matches(pod: Pod, ns: str, selector: Dict[str, str]) -> bool:
+        return (pod.meta.namespace == ns
+                and all(pod.meta.labels.get(k) == v
+                        for k, v in selector.items()))
+
+    def _count_matching(self, ns: str, selector: Dict[str, str], row: int,
+                        domain: np.ndarray, count0: np.ndarray) -> None:
+        """Count matching running+assumed pods into count0[row] per
+        domain."""
+        for cp, node_name in self._existing_pods():
+            if not self._matches(cp, ns, selector):
+                continue
+            ni = self.node_index.get(node_name)
+            if ni is not None and domain[row, ni] >= 0:
+                count0[row, domain[row, ni]] += 1.0
+
+    def _affinity_matrices(self, pods: Sequence[Pod],
+                           groups: Dict[tuple, tuple], p: int):
+        """(domain [G, N], count0 [G, D], member [P, G]) for inter-pod
+        affinity groups; degenerate shapes when no group exists so the
+        device gates compile out. `member[i, g]` marks batch pods that
+        MATCH group g's selector — they charge its domain counts when
+        placed whether or not they carry the term themselves (upstream
+        counts all matching pods)."""
+        if not groups:
+            return (np.full((1, 1), -1, np.int32),
+                    np.zeros((1, 1), np.float32),
+                    np.zeros((p, 1), bool))
+        g_cap = self.max_spread_groups
+        d_cap = self.max_spread_domains
+        domain = np.full((g_cap, self.max_nodes), -1, np.int32)
+        count0 = np.zeros((g_cap, d_cap), np.float32)
+        member = np.zeros((p, g_cap), bool)
+        for (ns, _key, _sel), (row, term, proto) in groups.items():
+            self._fill_domain_map(term.topology_key, row, domain)
+            self._count_matching(ns, term.label_selector, row, domain,
+                                 count0)
+            for i, pod in enumerate(pods):
+                member[i, row] = self._matches(pod, ns,
+                                               term.label_selector)
+        return domain, count0, member
 
 
 def _selector_key(selector: Dict[str, str]) -> str:

@@ -144,12 +144,33 @@ class PodBatch:
     # the gate compiles out. Gating runs at ROUND granularity — exact at
     # chunk size 1 like every other commit gate.
     spread_id: Array        # i32[P] spread group, -1 = none
+    spread_member: Array    # bool[P, Sg] pod matches group's selector
+                            # (charges the domain count when placed, even
+                            # without carrying the constraint itself)
     spread_max_skew: Array  # f32[Sg]
     spread_domain: Array    # i32[Sg, N] node's domain for the group's
                             # topology key, -1 = node lacks the label
                             # (hard constraints reject such nodes)
     spread_count0: Array    # f32[Sg, D] matching running pods per domain
     spread_dvalid: Array    # bool[Sg, D] domain exists in the cluster
+    # inter-pod affinity/anti-affinity (upstream required terms), the
+    # same (group, domain) machinery: anti groups admit a domain only at
+    # count 0 (nodes LACKING the topology key pass — no pair can exist);
+    # affinity groups require count > 0, with a bootstrap when nothing
+    # matches anywhere and the pod matches its own selector. The
+    # per-(pod, group) member matrices mark which BATCH pods charge a
+    # group's domain counts when placed — membership is by selector
+    # match, so a matching pod that doesn't carry the term still counts
+    # (upstream counts all matching pods, not just constrained ones).
+    anti_id: Array          # i32[P] anti-affinity group the pod is GATED
+                            # by, -1 = none
+    anti_member: Array      # bool[P, Ag] pod matches group's selector
+    anti_domain: Array      # i32[Ag, N]
+    anti_count0: Array      # f32[Ag, D] matching running/assumed pods
+    aff_id: Array           # i32[P] affinity group, -1 = none
+    aff_member: Array       # bool[P, Fg]
+    aff_domain: Array       # i32[Fg, N]
+    aff_count0: Array       # f32[Fg, D]
     valid: Array            # bool[P]
 
     @property

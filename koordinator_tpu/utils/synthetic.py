@@ -270,10 +270,19 @@ def synthetic_pods(num_pods: int, seed: int = 1,
         tol_forbid=np.zeros((1, 1), bool),
         tol_prefer=np.zeros((1, 1), f32),
         spread_id=np.full((p,), -1, np.int32),
+        spread_member=np.zeros((p, 1), bool),
         spread_max_skew=np.ones((1,), f32),
         spread_domain=np.full((1, 1), -1, np.int32),
         spread_count0=np.zeros((1, 1), f32),
         spread_dvalid=np.zeros((1, 1), bool),
+        anti_id=np.full((p,), -1, np.int32),
+        anti_member=np.zeros((p, 1), bool),
+        anti_domain=np.full((1, 1), -1, np.int32),
+        anti_count0=np.zeros((1, 1), f32),
+        aff_id=np.full((p,), -1, np.int32),
+        aff_member=np.zeros((p, 1), bool),
+        aff_domain=np.full((1, 1), -1, np.int32),
+        aff_count0=np.zeros((1, 1), f32),
         valid=np.ones((p,), bool),
     )
 
@@ -294,7 +303,9 @@ def stack_pod_chunks(pods: PodBatch, chunk: int) -> dict:
 PER_POD_FIELDS = ("requests", "estimated", "qos", "priority_class",
                   "priority", "gang_id", "quota_id", "selector_id",
                   "reservation_owner", "gpu_ratio", "numa_single",
-                  "daemonset", "toleration_id", "spread_id", "valid")
+                  "daemonset", "toleration_id", "spread_id",
+                  "spread_member", "anti_id", "anti_member", "aff_id",
+                  "aff_member", "valid")
 
 
 def slice_batch(batch: PodBatch, start: int, size: int) -> PodBatch:

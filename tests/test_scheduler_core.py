@@ -519,3 +519,231 @@ def test_topology_spread_min_ignores_unreachable_domains():
     # with z3 wrongly pinning the min, only 2 would ever place
     assert (a >= 0).all(), a
     assert sorted(((a == 0).sum(), (a == 1).sum())) == [2, 2]
+
+
+# --- inter-pod affinity / anti-affinity -------------------------------------
+
+
+def _zone_cluster(zones=("z1", "z2", "z3"), cpu=64000.0):
+    b = SnapshotBuilder(max_nodes=len(zones))
+    for i, z in enumerate(zones):
+        b.add_node(Node(meta=ObjectMeta(name=f"n{i}", labels={"zone": z}),
+                        allocatable={RK.CPU: cpu, RK.MEMORY: 65536}))
+        b.set_node_metric(NodeMetric(node_name=f"n{i}", update_time=NOW,
+                                     node_usage={}))
+    return b
+
+
+def test_anti_affinity_mutual_one_per_domain():
+    """Mutually anti-affine replicas land one per zone; the surplus
+    member stays pending."""
+    from koordinator_tpu.api.types import PodAffinityTerm
+
+    b = _zone_cluster()
+    term = PodAffinityTerm(topology_key="zone",
+                           label_selector={"app": "etcd"}, anti=True)
+    members = [Pod(meta=ObjectMeta(name=f"e{j}", namespace="d",
+                                   labels={"app": "etcd"}),
+                   priority=9000, requests={RK.CPU: 100.0},
+                   pod_affinity=[term]) for j in range(4)]
+    snap, ctx = b.build(now=NOW)
+    res = core.schedule_batch(snap, b.build_pod_batch(members, ctx),
+                              loadaware.LoadAwareConfig.make(),
+                              num_rounds=5)
+    a = np.asarray(res.assignment)
+    placed = a[a >= 0]
+    assert len(placed) == 3 and len(set(placed.tolist())) == 3, a
+    assert (a == -1).sum() == 1
+
+
+def test_anti_affinity_against_other_app():
+    """An anti term targeting ANOTHER app's pods avoids its zones but
+    members do not exclude each other."""
+    from koordinator_tpu.api.types import PodAffinityTerm
+
+    b = _zone_cluster()
+    b.add_running_pod(Pod(meta=ObjectMeta(name="noisy", namespace="d",
+                                          labels={"app": "noisy"}),
+                          requests={RK.CPU: 100.0}, phase="Running",
+                          node_name="n0"))
+    term = PodAffinityTerm(topology_key="zone",
+                           label_selector={"app": "noisy"}, anti=True)
+    members = [Pod(meta=ObjectMeta(name=f"q{j}", namespace="d",
+                                   labels={"app": "quiet"}),
+                   priority=9000, requests={RK.CPU: 100.0},
+                   pod_affinity=[term]) for j in range(3)]
+    snap, ctx = b.build(now=NOW)
+    res = core.schedule_batch(snap, b.build_pod_batch(members, ctx),
+                              loadaware.LoadAwareConfig.make(),
+                              num_rounds=4)
+    a = np.asarray(res.assignment)
+    assert (a >= 0).all() and (a != 0).all(), a  # all avoid noisy's zone
+
+
+def test_pod_affinity_colocates_with_bootstrap():
+    """Self-matching required affinity: the first member opens a domain,
+    the rest follow it (upstream's self-affinity special case)."""
+    from koordinator_tpu.api.types import PodAffinityTerm
+
+    b = _zone_cluster()
+    term = PodAffinityTerm(topology_key="zone",
+                           label_selector={"group": "batch-job"})
+    members = [Pod(meta=ObjectMeta(name=f"m{j}", namespace="d",
+                                   labels={"group": "batch-job"}),
+                   priority=9000, requests={RK.CPU: 100.0},
+                   pod_affinity=[term]) for j in range(4)]
+    snap, ctx = b.build(now=NOW)
+    res = core.schedule_batch(snap, b.build_pod_batch(members, ctx),
+                              loadaware.LoadAwareConfig.make(),
+                              num_rounds=6)
+    a = np.asarray(res.assignment)
+    assert (a >= 0).all(), a
+    assert len(set(a.tolist())) == 1   # all co-located
+
+
+def test_pod_affinity_follows_existing_pod():
+    """Affinity toward an existing app lands in its domain; no
+    bootstrap when the group does not self-match."""
+    from koordinator_tpu.api.types import PodAffinityTerm
+
+    b = _zone_cluster()
+    b.add_running_pod(Pod(meta=ObjectMeta(name="db", namespace="d",
+                                          labels={"app": "db"}),
+                          requests={RK.CPU: 100.0}, phase="Running",
+                          node_name="n1"))
+    term = PodAffinityTerm(topology_key="zone",
+                           label_selector={"app": "db"})
+    web = Pod(meta=ObjectMeta(name="web", namespace="d",
+                              labels={"app": "web"}),
+              priority=9000, requests={RK.CPU: 100.0},
+              pod_affinity=[term])
+    snap, ctx = b.build(now=NOW)
+    res = core.schedule_batch(snap, b.build_pod_batch([web], ctx),
+                              loadaware.LoadAwareConfig.make())
+    assert int(np.asarray(res.assignment)[0]) == 1
+
+
+def test_anti_affinity_heterogeneous_batch_labels():
+    """Regression: membership is per-pod selector match, not inherited
+    from the group's first pod — a non-matching pod sharing the term
+    must not disable mutual exclusion for the matching ones."""
+    from koordinator_tpu.api.types import PodAffinityTerm
+
+    b = _zone_cluster()
+    term = PodAffinityTerm(topology_key="zone",
+                           label_selector={"app": "etcd"}, anti=True)
+    batch = [Pod(meta=ObjectMeta(name="w0", namespace="d",
+                                 labels={"app": "web"}),
+                 priority=9500, requests={RK.CPU: 100.0},
+                 pod_affinity=[term])]
+    batch += [Pod(meta=ObjectMeta(name=f"e{j}", namespace="d",
+                                  labels={"app": "etcd"}),
+                  priority=9000, requests={RK.CPU: 100.0},
+                  pod_affinity=[term]) for j in range(3)]
+    snap, ctx = b.build(now=NOW)
+    res = core.schedule_batch(snap, b.build_pod_batch(batch, ctx),
+                              loadaware.LoadAwareConfig.make(),
+                              num_rounds=5)
+    a = np.asarray(res.assignment)
+    etcd = a[1:]
+    assert (etcd >= 0).all()
+    assert len(set(etcd.tolist())) == 3, a   # one per zone
+
+
+def test_anti_affinity_sees_same_batch_non_member_placement():
+    """Regression: a matching pod scheduled in the SAME batch without
+    the term still forbids its domain to the gated pods."""
+    from koordinator_tpu.api.types import PodAffinityTerm
+
+    b = _zone_cluster()
+    term = PodAffinityTerm(topology_key="zone",
+                           label_selector={"app": "noisy"}, anti=True)
+    batch = [Pod(meta=ObjectMeta(name="noisy", namespace="d",
+                                 labels={"app": "noisy"}),
+                 priority=9500, requests={RK.CPU: 100.0})]
+    batch += [Pod(meta=ObjectMeta(name=f"q{j}", namespace="d",
+                                  labels={"app": "quiet"}),
+                  priority=9000, requests={RK.CPU: 100.0},
+                  pod_affinity=[term]) for j in range(2)]
+    snap, ctx = b.build(now=NOW)
+    res = core.schedule_batch(snap, b.build_pod_batch(batch, ctx),
+                              loadaware.LoadAwareConfig.make(),
+                              num_rounds=5)
+    a = np.asarray(res.assignment)
+    assert a[0] >= 0
+    assert (a[1:] >= 0).all()
+    assert (a[1:] != a[0]).all(), a   # quiet avoid noisy's zone
+
+
+def test_existing_pod_anti_term_binds_incoming():
+    """Regression: a RUNNING pod's required anti term forbids matching
+    incoming pods from its domain (satisfyExistingPodsAntiAffinity)."""
+    from koordinator_tpu.api.types import PodAffinityTerm
+
+    b = _zone_cluster()
+    term = PodAffinityTerm(topology_key="zone",
+                           label_selector={"app": "web"}, anti=True)
+    b.add_running_pod(Pod(meta=ObjectMeta(name="etcd-0", namespace="d",
+                                          labels={"app": "etcd"}),
+                          requests={RK.CPU: 100.0}, phase="Running",
+                          node_name="n0", pod_affinity=[term]))
+    web = Pod(meta=ObjectMeta(name="web-0", namespace="d",
+                              labels={"app": "web"}),
+              priority=9000, requests={RK.CPU: 100.0})
+    snap, ctx = b.build(now=NOW)
+    res = core.schedule_batch(snap, b.build_pod_batch([web], ctx),
+                              loadaware.LoadAwareConfig.make())
+    assert int(np.asarray(res.assignment)[0]) in (1, 2)  # not n0
+
+
+def test_anti_affinity_admits_keyless_nodes():
+    """Regression: a node without the topology key can host the pod —
+    no topology pair can exist there (upstream admits)."""
+    from koordinator_tpu.api.types import PodAffinityTerm
+
+    b = SnapshotBuilder(max_nodes=2)
+    b.add_node(Node(meta=ObjectMeta(name="z", labels={"zone": "z1"}),
+                    allocatable={RK.CPU: 300.0, RK.MEMORY: 65536}))
+    b.add_node(Node(meta=ObjectMeta(name="keyless"),
+                    allocatable={RK.CPU: 64000, RK.MEMORY: 65536}))
+    for nm in ("z", "keyless"):
+        b.set_node_metric(NodeMetric(node_name=nm, update_time=NOW,
+                                     node_usage={}))
+    term = PodAffinityTerm(topology_key="zone",
+                           label_selector={"app": "e"}, anti=True)
+    members = [Pod(meta=ObjectMeta(name=f"e{j}", namespace="d",
+                                   labels={"app": "e"}),
+                   priority=9000, requests={RK.CPU: 200.0},
+                   pod_affinity=[term]) for j in range(2)]
+    snap, ctx = b.build(now=NOW)
+    res = core.schedule_batch(snap, b.build_pod_batch(members, ctx),
+                              loadaware.LoadAwareConfig.make(),
+                              num_rounds=4)
+    a = np.asarray(res.assignment)
+    assert (a >= 0).all(), a   # second member lands on the keyless node
+
+
+def test_affinity_bootstrap_not_pinned_to_stuck_member():
+    """Regression: when the highest-priority member is unschedulable,
+    another member still bootstraps the group."""
+    from koordinator_tpu.api.types import PodAffinityTerm
+
+    b = _zone_cluster(cpu=4000.0)
+    term = PodAffinityTerm(topology_key="zone",
+                           label_selector={"g": "job"})
+    huge = Pod(meta=ObjectMeta(name="huge", namespace="d",
+                               labels={"g": "job"}),
+               priority=9500, requests={RK.CPU: 99000.0},
+               pod_affinity=[term])
+    small = [Pod(meta=ObjectMeta(name=f"s{j}", namespace="d",
+                                 labels={"g": "job"}),
+                 priority=9000, requests={RK.CPU: 500.0},
+                 pod_affinity=[term]) for j in range(2)]
+    snap, ctx = b.build(now=NOW)
+    res = core.schedule_batch(snap, b.build_pod_batch([huge] + small, ctx),
+                              loadaware.LoadAwareConfig.make(),
+                              num_rounds=5)
+    a = np.asarray(res.assignment)
+    assert a[0] == -1               # huge can never fit
+    assert (a[1:] >= 0).all(), a    # the rest bootstrap and co-locate
+    assert a[1] == a[2]
