@@ -26,6 +26,15 @@ import sys
 import time
 
 import jax
+
+# honor an explicit JAX_PLATFORMS (the hermetic test harness sets cpu);
+# site config can pin jax_platforms to the TPU tunnel, which silently
+# overrides the env var and sends subprocess smoke runs through slow
+# remote compiles
+_plat = os.environ.get("JAX_PLATFORMS")
+if _plat:
+    jax.config.update("jax_platforms", _plat)
+
 import jax.numpy as jnp
 import numpy as np
 

@@ -179,7 +179,7 @@ def schedule_batch(snap: ClusterSnapshot, pods: PodBatch,
     # taint-group) exactly like the selector gate; a [1, 1] matrix means
     # the batch carries no toleration modeling (synthetic fast path) and
     # the gates compile out.
-    use_taints = pods.tol_forbid.shape != (1, 1)
+    use_taints = pods.has_taints
     if use_taints:
         tol_row = pods.tol_forbid[jnp.maximum(pods.toleration_id, 0)]
         static_ok &= ~tol_row[:, nodes0.taint_group]             # [P, N]
@@ -340,7 +340,7 @@ def schedule_batch(snap: ClusterSnapshot, pods: PodBatch,
 
         return dom_x, counts_flat, n_g, n_d
 
-    use_spread = pods.spread_domain.shape != (1, 1)
+    use_spread = pods.has_spread
     if use_spread:
         sid = jnp.maximum(pods.spread_id, 0)
         spread_domain_x, spread_counts_flat, n_sg, n_dom = \
@@ -349,19 +349,23 @@ def schedule_batch(snap: ClusterSnapshot, pods: PodBatch,
     # inter-pod anti-affinity: a domain admits a gated pod only at count
     # 0; nodes LACKING the topology key pass (no topology pair can
     # exist there — upstream admits them).
-    use_anti = pods.anti_domain.shape != (1, 1)
+    use_anti = pods.has_anti
     if use_anti:
         aid = jnp.maximum(pods.anti_id, 0)
         anti_domain_x, anti_counts_flat, n_ag, n_ad = \
             domain_machinery(pods.anti_domain, pods.anti_count0,
                              pods.anti_member)
+        # direction (b): carrier occupancy per (group, domain)
+        _, anti_carrier_flat, _, _ = \
+            domain_machinery(pods.anti_domain, pods.anti_carrier_count0,
+                             pods.anti_carrier)
     # inter-pod affinity: a domain admits a gated pod only when it holds
     # a matching pod — except the bootstrap: when nothing matches
     # anywhere, any self-matching member may OPEN a domain, capped to
     # one opener per group per inner step so the group still converges
     # to co-location (upstream's self-affinity special case, without
     # pinning the bootstrap to one member that might be unschedulable).
-    use_aff = pods.aff_domain.shape != (1, 1)
+    use_aff = pods.has_aff
     if use_aff:
         fid = jnp.maximum(pods.aff_id, 0)
         aff_self_pod = jnp.take_along_axis(
@@ -419,12 +423,23 @@ def schedule_batch(snap: ClusterSnapshot, pods: PodBatch,
                 (n_sg, n_dom)).reshape(-1, 1)             # [Sg*D, 1]
         if use_anti:
             counts_an = anti_counts_flat(placed).reshape(n_ag, n_ad)
+            # (a) carriers avoid domains holding selector-matching pods
             cdom_an = anti_domain_x[aid]                  # [P, N+V]
             cc_an = jnp.take_along_axis(counts_an[aid],
                                         jnp.maximum(cdom_an, 0), axis=1)
             # keyless nodes pass: no topology pair can exist there
             anti_ok = (cdom_an < 0) | (cc_an < 0.5)
             feasible &= (pods.anti_id < 0)[:, None] | anti_ok
+            # (b) selector-matching pods avoid CARRIER domains — one
+            # bool matmul over groups covers pods matching several terms
+            carr = anti_carrier_flat(placed).reshape(n_ag, n_ad)
+            occ_b = (jnp.where(
+                anti_domain_x >= 0,
+                jnp.take_along_axis(carr, jnp.maximum(anti_domain_x, 0),
+                                    axis=1), 0.0) > 0.5)  # [Ag, N+V]
+            blocked_b = (pods.anti_member.astype(jnp.float32)
+                         @ occ_b.astype(jnp.float32)) > 0.5
+            feasible &= ~blocked_b
         if use_aff:
             counts_af = aff_counts_flat(placed).reshape(n_fg, n_fd)
             total_af = jnp.sum(counts_af, axis=1)         # [Fg]
@@ -550,22 +565,28 @@ def schedule_batch(snap: ClusterSnapshot, pods: PodBatch,
                 # its own.
                 counts_an_now = anti_counts_flat(placed).reshape(
                     n_ag, n_ad)
+                carr_now = anti_carrier_flat(placed).reshape(n_ag, n_ad)
                 choice_dom = jnp.clip(choice_eff, 0, n_ext - 1)
                 for g in range(n_ag):
                     dom_g = anti_domain_x[g, choice_dom]      # [P]
-                    contrib = ((trying & pods.anti_member[:, g]
-                                & (dom_g >= 0))
-                               .astype(jnp.float32))
-                    gated_g = trying & (pods.anti_id == g) & (dom_g >= 0)
-                    # occupancy of the pod's chosen domain BEFORE it:
-                    # initial/carried count + earlier-ranked in-step
-                    # contributions (members charge; gated non-members
-                    # are blocked by occupancy but add none)
+                    has_dom = dom_g >= 0
                     same_d = dom_g[:, None] == dom_g[None, :]
-                    charge = ((same_d & earlier).astype(jnp.float32)
-                              @ contrib)
-                    occ = counts_an_now[g, jnp.maximum(dom_g, 0)] + charge
-                    accept &= (occ < 0.5) | ~gated_g
+                    e_mask = (same_d & earlier).astype(jnp.float32)
+                    dom_c = jnp.maximum(dom_g, 0)
+                    # occupancy of the pod's chosen domain BEFORE it:
+                    # carried counts + earlier-ranked in-step charges
+                    # (a) matching pods charge; carriers are gated
+                    contrib_a = ((trying & pods.anti_member[:, g]
+                                  & has_dom).astype(jnp.float32))
+                    gated_a = trying & (pods.anti_id == g) & has_dom
+                    occ_a = counts_an_now[g, dom_c] + e_mask @ contrib_a
+                    accept &= (occ_a < 0.5) | ~gated_a
+                    # (b) carriers charge; matching pods are gated
+                    contrib_b = ((trying & pods.anti_carrier[:, g]
+                                  & has_dom).astype(jnp.float32))
+                    gated_b = trying & pods.anti_member[:, g] & has_dom
+                    occ_b_g = carr_now[g, dom_c] + e_mask @ contrib_b
+                    accept &= (occ_b_g < 0.5) | ~gated_b
             if use_aff:
                 # bootstrap cap: attempts into an EMPTY domain of an
                 # empty group are limited to one per group per step

@@ -124,7 +124,7 @@ def _taint_matrices(snap: ClusterSnapshot, pods: PodBatch):
     """(forbid [P, N], penalty [P, N]) from the TaintToleration matrices,
     or (None, None) for a batch without taint modeling — the same math
     the batch kernel applies (core.py use_taints block)."""
-    if pods.tol_forbid.shape == (1, 1):
+    if not pods.has_taints:
         return None, None
     tid = np.maximum(np.asarray(pods.toleration_id), 0)
     tg = np.asarray(snap.nodes.taint_group)
@@ -164,6 +164,46 @@ def debug_filter_table(snap: ClusterSnapshot, pods: PodBatch,
     forbid, _ = _taint_matrices(snap, pods)
     if forbid is not None:
         gates.append(("TaintToleration", ~forbid))
+    if pods.has_spread:
+        sid = np.maximum(np.asarray(pods.spread_id), 0)
+        dom = np.asarray(pods.spread_domain)[sid]          # [P, N]
+        counts = np.asarray(pods.spread_count0)
+        dvalid = np.asarray(pods.spread_dvalid)
+        min_c = np.min(np.where(dvalid, counts, np.inf), axis=1)
+        cc = np.take_along_axis(counts[sid], np.maximum(dom, 0), axis=1)
+        ok = (dom >= 0) & (cc + 1.0 - min_c[sid][:, None]
+                           <= np.asarray(pods.spread_max_skew)[sid][:, None]
+                           + 1e-3)
+        gates.append(("PodTopologySpread",
+                      ok | (np.asarray(pods.spread_id) < 0)[:, None]))
+    if pods.has_anti:
+        aid = np.maximum(np.asarray(pods.anti_id), 0)
+        dom = np.asarray(pods.anti_domain)[aid]
+        cc = np.take_along_axis(np.asarray(pods.anti_count0)[aid],
+                                np.maximum(dom, 0), axis=1)
+        ok = (dom < 0) | (cc < 0.5)
+        ok |= (np.asarray(pods.anti_id) < 0)[:, None]
+        # direction (b): matching pods avoid carrier domains
+        dom_all = np.asarray(pods.anti_domain)
+        carr = np.asarray(pods.anti_carrier_count0)
+        occ = np.where(dom_all >= 0,
+                       np.take_along_axis(carr, np.maximum(dom_all, 0),
+                                          axis=1), 0.0) > 0.5
+        blocked = (np.asarray(pods.anti_member).astype(float)
+                   @ occ.astype(float)) > 0.5
+        gates.append(("InterPodAntiAffinity", ok & ~blocked))
+    if pods.has_aff:
+        fid = np.maximum(np.asarray(pods.aff_id), 0)
+        dom = np.asarray(pods.aff_domain)[fid]
+        counts = np.asarray(pods.aff_count0)
+        cc = np.take_along_axis(counts[fid], np.maximum(dom, 0), axis=1)
+        total = counts.sum(axis=1)
+        self_pod = np.take_along_axis(np.asarray(pods.aff_member),
+                                      fid[:, None], axis=1)[:, 0]
+        boot = ((total[fid] < 0.5) & self_pod)[:, None]
+        ok = (dom >= 0) & ((cc > 0.5) | boot)
+        gates.append(("InterPodAffinity",
+                      ok | (np.asarray(pods.aff_id) < 0)[:, None]))
     if np.asarray(nodes.numa_valid).any():
         gates.append(("NodeNUMAResource",
                       np.asarray(numaaware.zone_prefilter(nodes, pods))))

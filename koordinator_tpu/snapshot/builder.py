@@ -1041,7 +1041,10 @@ class SnapshotBuilder:
         # existing pods' REQUIRED anti terms bind incoming pods too
         # (satisfyExistingPodsAntiAffinity): each such term becomes an
         # anti group whose carrier domain is forbidden; matching batch
-        # pods without their own anti gate are gated by it
+        # pods without their own anti gate are gated by it. Only terms
+        # RELEVANT to this batch (some batch pod matches the selector)
+        # materialize — cluster-wide term diversity must neither exhaust
+        # the group cap nor unroll dead work into the commit loop.
         carriers: List[tuple] = []
         for ep, node_name in self._existing_pods():
             for term in ep.pod_affinity:
@@ -1051,6 +1054,10 @@ class SnapshotBuilder:
                         tuple(sorted(term.label_selector.items())))
                 entry = anti_groups.get(akey)
                 if entry is None:
+                    if not any(self._matches(pod, ep.meta.namespace,
+                                             term.label_selector)
+                               for pod in pods):
+                        continue  # irrelevant to this batch
                     if len(anti_groups) >= self.max_spread_groups:
                         raise ValueError(
                             f"distinct pod-affinity terms exceed "
@@ -1060,21 +1067,23 @@ class SnapshotBuilder:
                 carriers.append((entry[0], node_name))
         anti_domain, anti_count0, anti_member = self._affinity_matrices(
             pods, anti_groups, p)
-        # forbid each carrier's own domain regardless of whether the
-        # carrier matches its own selector
-        for row, node_name in carriers:
-            ni = self.node_index.get(node_name)
-            if ni is not None and anti_domain[row, ni] >= 0:
-                anti_count0[row, anti_domain[row, ni]] = max(
-                    anti_count0[row, anti_domain[row, ni]], 1.0)
-        # gate matching batch pods that carry no anti term of their own
-        for i, pod in enumerate(pods):
-            if anti_row[i] < 0 and i < len(pods):
-                for (ns, _k, _s), (row, term, _proto) in \
-                        anti_groups.items():
-                    if self._matches(pod, ns, term.label_selector):
-                        anti_row[i] = row
-                        break
+        # direction (b) surfaces: which pods CARRY each group's term, and
+        # where existing carriers sit
+        if not anti_groups:
+            anti_carrier = np.zeros((p, 1), bool)
+            anti_carrier_count0 = np.zeros((1, 1), np.float32)
+        else:
+            g_used = len(anti_groups)
+            anti_carrier = np.zeros((p, g_used), bool)
+            anti_carrier_count0 = np.zeros(
+                (g_used, self.max_spread_domains), np.float32)
+            for i in range(len(pods)):
+                if anti_row[i] >= 0:
+                    anti_carrier[i, anti_row[i]] = True
+            for row, node_name in carriers:
+                ni = self.node_index.get(node_name)
+                if ni is not None and anti_domain[row, ni] >= 0:
+                    anti_carrier_count0[row, anti_domain[row, ni]] += 1.0
         aff_domain, aff_count0, aff_member = self._affinity_matrices(
             pods, aff_groups, p)
         return PodBatch(
@@ -1090,9 +1099,16 @@ class SnapshotBuilder:
             spread_domain=spread_domain, spread_count0=spread_count0,
             spread_dvalid=spread_dvalid,
             anti_id=anti_row, anti_member=anti_member,
+            anti_carrier=anti_carrier,
             anti_domain=anti_domain, anti_count0=anti_count0,
+            anti_carrier_count0=anti_carrier_count0,
             aff_id=aff_row, aff_member=aff_member,
-            aff_domain=aff_domain, aff_count0=aff_count0, valid=valid)
+            aff_domain=aff_domain, aff_count0=aff_count0, valid=valid,
+            has_taints=not (len(ctx.node_taint_groups) == 1
+                            and len(tol_sets) == 1),
+            has_spread=bool(spread_groups),
+            has_anti=bool(anti_groups),
+            has_aff=bool(aff_groups))
 
     def _fill_domain_map(self, topology_key: str, row: int,
                          domain: np.ndarray) -> None:
@@ -1147,11 +1163,14 @@ class SnapshotBuilder:
             return (np.full((1, 1), -1, np.int32),
                     np.zeros((1, 1), np.float32),
                     np.zeros((p, 1), bool))
-        g_cap = self.max_spread_groups
+        # matrices sized to the ACTUAL group count — the device gates
+        # loop over rows, so cap-padding would unroll dead [P, P] work
+        # into the jitted commit loop
+        g_used = len(groups)
         d_cap = self.max_spread_domains
-        domain = np.full((g_cap, self.max_nodes), -1, np.int32)
-        count0 = np.zeros((g_cap, d_cap), np.float32)
-        member = np.zeros((p, g_cap), bool)
+        domain = np.full((g_used, self.max_nodes), -1, np.int32)
+        count0 = np.zeros((g_used, d_cap), np.float32)
+        member = np.zeros((p, g_used), bool)
         for (ns, _key, _sel), (row, term, proto) in groups.items():
             self._fill_domain_map(term.topology_key, row, domain)
             self._count_matching(ns, term.label_selector, row, domain,

@@ -162,16 +162,35 @@ class PodBatch:
     # group's domain counts when placed — membership is by selector
     # match, so a matching pod that doesn't carry the term still counts
     # (upstream counts all matching pods, not just constrained ones).
-    anti_id: Array          # i32[P] anti-affinity group the pod is GATED
-                            # by, -1 = none
+    # Anti-affinity is enforced in BOTH directions with separate count
+    # surfaces per group (one per distinct required term):
+    # (a) a pod CARRYING the term avoids domains holding selector-
+    #     matching pods (anti_id gates against anti_count0 + placed
+    #     anti_member charges);
+    # (b) a pod MATCHING the selector avoids domains holding term
+    #     CARRIERS (anti_member gates against anti_carrier_count0 +
+    #     placed anti_carrier charges) — satisfyExistingPodsAntiAffinity
+    #     generalized to same-batch carriers.
+    anti_id: Array          # i32[P] group whose term the pod CARRIES, -1
     anti_member: Array      # bool[P, Ag] pod matches group's selector
+    anti_carrier: Array     # bool[P, Ag] pod carries group's term
     anti_domain: Array      # i32[Ag, N]
     anti_count0: Array      # f32[Ag, D] matching running/assumed pods
+    anti_carrier_count0: Array  # f32[Ag, D] carrier running/assumed pods
     aff_id: Array           # i32[P] affinity group, -1 = none
     aff_member: Array       # bool[P, Fg]
     aff_domain: Array       # i32[Fg, N]
     aff_count0: Array       # f32[Fg, D]
     valid: Array            # bool[P]
+    # STATIC gate switches (aux data, not arrays): whether the batch
+    # models each constraint family. Shape-based sentinels are ambiguous
+    # — a legitimate 1-group/1-domain config collides with the [1, 1]
+    # degenerate — so the builder sets these explicitly and the
+    # scheduler compiles each gate in/out on them.
+    has_taints: bool = flax.struct.field(pytree_node=False, default=False)
+    has_spread: bool = flax.struct.field(pytree_node=False, default=False)
+    has_anti: bool = flax.struct.field(pytree_node=False, default=False)
+    has_aff: bool = flax.struct.field(pytree_node=False, default=False)
 
     @property
     def num_pods(self) -> int:

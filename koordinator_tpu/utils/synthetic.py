@@ -277,8 +277,10 @@ def synthetic_pods(num_pods: int, seed: int = 1,
         spread_dvalid=np.zeros((1, 1), bool),
         anti_id=np.full((p,), -1, np.int32),
         anti_member=np.zeros((p, 1), bool),
+        anti_carrier=np.zeros((p, 1), bool),
         anti_domain=np.full((1, 1), -1, np.int32),
         anti_count0=np.zeros((1, 1), f32),
+        anti_carrier_count0=np.zeros((1, 1), f32),
         aff_id=np.full((p,), -1, np.int32),
         aff_member=np.zeros((p, 1), bool),
         aff_domain=np.full((1, 1), -1, np.int32),
@@ -304,8 +306,8 @@ PER_POD_FIELDS = ("requests", "estimated", "qos", "priority_class",
                   "priority", "gang_id", "quota_id", "selector_id",
                   "reservation_owner", "gpu_ratio", "numa_single",
                   "daemonset", "toleration_id", "spread_id",
-                  "spread_member", "anti_id", "anti_member", "aff_id",
-                  "aff_member", "valid")
+                  "spread_member", "anti_id", "anti_member",
+                  "anti_carrier", "aff_id", "aff_member", "valid")
 
 
 def slice_batch(batch: PodBatch, start: int, size: int) -> PodBatch:

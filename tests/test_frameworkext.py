@@ -176,3 +176,63 @@ def test_debug_filter_table_and_http_toggle():
         assert flags.filter_dump is True
     finally:
         server.close()
+
+
+def test_debug_filter_table_covers_topology_gates():
+    """The filter table mirrors the taint/spread/affinity gates too."""
+    from koordinator_tpu.api.types import (
+        Node, NodeMetric, ObjectMeta, Pod, PodAffinityTerm, Taint,
+        TopologySpreadConstraint,
+    )
+    from koordinator_tpu.api.extension import ResourceKind as RK
+    from koordinator_tpu.scheduler.frameworkext import debug_filter_table
+    from koordinator_tpu.scheduler.plugins.loadaware import LoadAwareConfig
+    from koordinator_tpu.snapshot import SnapshotBuilder
+
+    b = SnapshotBuilder(max_nodes=3)
+    for i in range(3):
+        b.add_node(Node(
+            meta=ObjectMeta(name=f"n{i}", labels={"zone": f"z{i % 2}"}),
+            taints=[Taint(key="x", effect="NoSchedule")] if i == 2 else [],
+            allocatable={RK.CPU: 8000.0, RK.MEMORY: 16384.0}))
+        b.set_node_metric(NodeMetric(node_name=f"n{i}", update_time=1e9,
+                                     node_usage={}))
+    b.add_running_pod(Pod(meta=ObjectMeta(name="r", namespace="d",
+                                          labels={"app": "x"}),
+                          requests={RK.CPU: 100.0}, phase="Running",
+                          node_name="n0"))
+    snap, ctx = b.build(now=1e9)
+    pods = [Pod(meta=ObjectMeta(name="p", namespace="d",
+                                labels={"app": "x"}),
+                priority=9000, requests={RK.CPU: 100.0},
+                spread_constraints=[TopologySpreadConstraint(
+                    topology_key="zone", label_selector={"app": "x"})],
+                pod_affinity=[PodAffinityTerm(
+                    topology_key="zone", label_selector={"app": "x"},
+                    anti=True)])]
+    table = debug_filter_table(snap, b.build_pod_batch(pods, ctx),
+                               LoadAwareConfig.make(), pod_names=["p"])
+    assert "TaintToleration:-1" in table
+    assert "PodTopologySpread:-1" in table
+    assert "fit:1/3" in table
+    # anti row: rebuild with only the anti term so its rejection is not
+    # shadowed by spread (gates subtract in order)
+    pods2 = [Pod(meta=ObjectMeta(name="q", namespace="d",
+                                 labels={"app": "x"}),
+                 priority=9000, requests={RK.CPU: 100.0},
+                 pod_affinity=[PodAffinityTerm(
+                     topology_key="zone", label_selector={"app": "x"},
+                     anti=True)])]
+    t2 = debug_filter_table(snap, b.build_pod_batch(pods2, ctx),
+                            LoadAwareConfig.make(), pod_names=["q"])
+    assert "InterPodAntiAffinity:-" in t2
+    # affinity row: a follower of a nonexistent app is rejected everywhere
+    pods3 = [Pod(meta=ObjectMeta(name="r", namespace="d",
+                                 labels={"app": "y"}),
+                 priority=9000, requests={RK.CPU: 100.0},
+                 pod_affinity=[PodAffinityTerm(
+                     topology_key="zone",
+                     label_selector={"app": "nothing"})])]
+    t3 = debug_filter_table(snap, b.build_pod_batch(pods3, ctx),
+                            LoadAwareConfig.make(), pod_names=["r"])
+    assert "InterPodAffinity:-" in t3 and "fit:0/3" in t3

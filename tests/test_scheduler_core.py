@@ -625,8 +625,10 @@ def test_pod_affinity_follows_existing_pod():
 
 def test_anti_affinity_heterogeneous_batch_labels():
     """Regression: membership is per-pod selector match, not inherited
-    from the group's first pod — a non-matching pod sharing the term
-    must not disable mutual exclusion for the matching ones."""
+    from the group's first pod. w0 (app=web) CARRIES the anti-etcd term:
+    its own zone excludes etcd (direction b), and the etcd members still
+    mutually exclude (direction a) — so exactly two of three etcd fit
+    the remaining zones."""
     from koordinator_tpu.api.types import PodAffinityTerm
 
     b = _zone_cluster()
@@ -645,9 +647,12 @@ def test_anti_affinity_heterogeneous_batch_labels():
                               loadaware.LoadAwareConfig.make(),
                               num_rounds=5)
     a = np.asarray(res.assignment)
+    assert a[0] >= 0
     etcd = a[1:]
-    assert (etcd >= 0).all()
-    assert len(set(etcd.tolist())) == 3, a   # one per zone
+    placed = etcd[etcd >= 0]
+    assert len(placed) == 2 and len(set(placed.tolist())) == 2
+    assert (placed != a[0]).all()     # never in the carrier's zone
+    assert (etcd == -1).sum() == 1
 
 
 def test_anti_affinity_sees_same_batch_non_member_placement():
@@ -747,3 +752,107 @@ def test_affinity_bootstrap_not_pinned_to_stuck_member():
     assert a[0] == -1               # huge can never fit
     assert (a[1:] >= 0).all(), a    # the rest bootstrap and co-locate
     assert a[1] == a[2]
+
+
+def test_same_batch_carrier_anti_term_binds_matching_pod():
+    """Regression: a batch pod's own anti term forbids its landing
+    domain to matching pods placed LATER in the same batch."""
+    from koordinator_tpu.api.types import PodAffinityTerm
+
+    b = _zone_cluster(zones=("z1",))
+    term = PodAffinityTerm(topology_key="zone",
+                           label_selector={"app": "noisy"}, anti=True)
+    quiet = Pod(meta=ObjectMeta(name="quiet", namespace="d",
+                                labels={"app": "quiet"}),
+                priority=9500, requests={RK.CPU: 100.0},
+                pod_affinity=[term])
+    noisy = Pod(meta=ObjectMeta(name="noisy", namespace="d",
+                                labels={"app": "noisy"}),
+                priority=9000, requests={RK.CPU: 100.0})
+    snap, ctx = b.build(now=NOW)
+    res = core.schedule_batch(snap, b.build_pod_batch([quiet, noisy], ctx),
+                              loadaware.LoadAwareConfig.make(),
+                              num_rounds=4)
+    a = np.asarray(res.assignment)
+    assert a[0] == 0 and a[1] == -1, a  # noisy pending, not co-located
+
+
+def test_carrier_gating_blocks_only_carrier_domains():
+    """Regression: a pod matching a carrier's selector is blocked only
+    from CARRIER domains, not from every domain holding other matching
+    pods."""
+    from koordinator_tpu.api.types import PodAffinityTerm
+
+    b = _zone_cluster(zones=("z1", "z2"))
+    term = PodAffinityTerm(topology_key="zone",
+                           label_selector={"app": "web"}, anti=True)
+    b.add_running_pod(Pod(meta=ObjectMeta(name="etcd", namespace="d",
+                                          labels={"app": "etcd"}),
+                          requests={RK.CPU: 100.0}, phase="Running",
+                          node_name="n0", pod_affinity=[term]))
+    b.add_running_pod(Pod(meta=ObjectMeta(name="web-old", namespace="d",
+                                          labels={"app": "web"}),
+                          requests={RK.CPU: 100.0}, phase="Running",
+                          node_name="n1"))
+    web_new = Pod(meta=ObjectMeta(name="web-new", namespace="d",
+                                  labels={"app": "web"}),
+                  priority=9000, requests={RK.CPU: 100.0})
+    snap, ctx = b.build(now=NOW)
+    res = core.schedule_batch(snap, b.build_pod_batch([web_new], ctx),
+                              loadaware.LoadAwareConfig.make())
+    # z1 holds the carrier -> forbidden; z2 holds only web-old -> fine
+    assert int(np.asarray(res.assignment)[0]) == 1
+
+
+def test_irrelevant_existing_anti_terms_do_not_exhaust_cap():
+    """Regression: cluster-wide anti-term diversity must not DoS the
+    batch builder — only terms a batch pod matches materialize."""
+    from koordinator_tpu.api.types import PodAffinityTerm
+
+    b = _zone_cluster()
+    for i in range(12):  # > max_spread_groups distinct terms
+        b.add_running_pod(Pod(
+            meta=ObjectMeta(name=f"svc{i}", namespace="d",
+                            labels={"app": f"svc{i}"}),
+            requests={RK.CPU: 10.0}, phase="Running", node_name="n0",
+            pod_affinity=[PodAffinityTerm(
+                topology_key="zone",
+                label_selector={"app": f"svc{i}"}, anti=True)]))
+    plain = Pod(meta=ObjectMeta(name="plain", namespace="d",
+                                labels={"app": "web"}),
+                priority=9000, requests={RK.CPU: 100.0})
+    snap, ctx = b.build(now=NOW)
+    batch = b.build_pod_batch([plain], ctx)   # must not raise
+    assert not batch.has_anti
+    res = core.schedule_batch(snap, batch,
+                              loadaware.LoadAwareConfig.make())
+    assert int(np.asarray(res.assignment)[0]) >= 0
+
+
+def test_single_domain_cap_still_gates():
+    """Regression: max_spread_domains=1 with one group used to collide
+    with the [1, 1] degenerate sentinel and silently disable the gate."""
+    from koordinator_tpu.api.types import PodAffinityTerm
+
+    b = SnapshotBuilder(max_nodes=2, max_spread_domains=1)
+    for i in range(2):
+        b.add_node(Node(meta=ObjectMeta(name=f"n{i}",
+                                        labels={"zone": "z1"}),
+                        allocatable={RK.CPU: 64000, RK.MEMORY: 65536}))
+        b.set_node_metric(NodeMetric(node_name=f"n{i}", update_time=NOW,
+                                     node_usage={}))
+    term = PodAffinityTerm(topology_key="zone",
+                           label_selector={"app": "e"}, anti=True)
+    members = [Pod(meta=ObjectMeta(name=f"e{j}", namespace="d",
+                                   labels={"app": "e"}),
+                   priority=9000, requests={RK.CPU: 100.0},
+                   pod_affinity=[term]) for j in range(2)]
+    snap, ctx = b.build(now=NOW)
+    batch = b.build_pod_batch(members, ctx)
+    assert batch.has_anti
+    res = core.schedule_batch(snap, batch,
+                              loadaware.LoadAwareConfig.make(),
+                              num_rounds=3)
+    a = np.asarray(res.assignment)
+    # one zone only -> exactly one member fits, the other stays pending
+    assert (a >= 0).sum() == 1 and (a == -1).sum() == 1, a
