@@ -263,6 +263,32 @@ def parse_extended_resource_spec(annotations: Mapping) -> tuple:
     return pick(spec.get("requests")), pick(spec.get("limits"))
 
 
+# combined GPU request conveniences (device_share.go:36-46; deviceshare
+# utils.go:110-125 translates them to core + memory-ratio pairs)
+RESOURCE_GPU_COMBINED = "koordinator.sh/gpu"
+RESOURCE_NVIDIA_GPU = "nvidia.com/gpu"
+
+
+def normalize_gpu_request(requests_by_name: Mapping,
+                          parse=float) -> tuple:
+    """({name: qty} minus combined GPU names, gpu_core, memory_ratio).
+    `koordinator.sh/gpu: X` means X percent of a GPU (core AND memory);
+    `nvidia.com/gpu: N` means N whole GPUs (100N percent each).
+    `parse` converts raw quantity values (pass the caller's k8s-quantity
+    parser; bare float would raise on suffixed serializations)."""
+    out = dict(requests_by_name)
+    core = ratio = 0.0
+    if RESOURCE_GPU_COMBINED in out:
+        v = parse(out.pop(RESOURCE_GPU_COMBINED))
+        core += v
+        ratio += v
+    if RESOURCE_NVIDIA_GPU in out:
+        v = parse(out.pop(RESOURCE_NVIDIA_GPU)) * 100.0
+        core += v
+        ratio += v
+    return out, core, ratio
+
+
 # --- SystemQOS (apis/extension/system_qos.go) -------------------------------
 ANNOTATION_NODE_SYSTEM_QOS_RESOURCE = (
     NODE_DOMAIN_PREFIX + "/system-qos-resource")

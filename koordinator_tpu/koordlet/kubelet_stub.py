@@ -22,7 +22,11 @@ import urllib.request
 from typing import List, Optional
 
 from koordinator_tpu.api import types as api
-from koordinator_tpu.api.extension import LABEL_POD_QOS, RESOURCE_NAMES
+from koordinator_tpu.api.extension import (
+    LABEL_POD_QOS,
+    RESOURCE_NAMES,
+    normalize_gpu_request,
+)
 from koordinator_tpu.koordlet.statesinformer import PodMeta, StatesInformer
 
 log = logging.getLogger(__name__)
@@ -78,12 +82,27 @@ def pod_from_manifest(item: dict) -> api.Pod:
     status = item.get("status", {})
     requests: dict = {}
     limits: dict = {}
+    gpu_ratio = 0.0
     for c in spec.get("containers", []):
         res = c.get("resources", {})
-        for k, v in _resource_list(res.get("requests")).items():
+        raw_req, core, ratio = normalize_gpu_request(
+            res.get("requests") or {}, parse=_parse_quantity)
+        for k, v in _resource_list(raw_req).items():
             requests[k] = requests.get(k, 0.0) + v
-        for k, v in _resource_list(res.get("limits")).items():
+        if core > 0:
+            requests[RESOURCE_NAMES["koordinator.sh/gpu-core"]] = \
+                requests.get(RESOURCE_NAMES["koordinator.sh/gpu-core"],
+                             0.0) + core
+        raw_lim, lcore, lratio = normalize_gpu_request(
+            res.get("limits") or {}, parse=_parse_quantity)
+        # limits-only combined GPU authoring still models memory share
+        gpu_ratio += ratio if ratio > 0 else lratio
+        for k, v in _resource_list(raw_lim).items():
             limits[k] = limits.get(k, 0.0) + v
+        if lcore > 0:
+            limits[RESOURCE_NAMES["koordinator.sh/gpu-core"]] = \
+                limits.get(RESOURCE_NAMES["koordinator.sh/gpu-core"],
+                           0.0) + lcore
     labels = dict(meta.get("labels") or {})
     return api.Pod(
         meta=api.ObjectMeta(name=meta.get("name", ""),
@@ -95,6 +114,7 @@ def pod_from_manifest(item: dict) -> api.Pod:
         qos_label=labels.get(LABEL_POD_QOS, ""),
         priority=int(spec.get("priority", 0) or 0),
         node_name=spec.get("nodeName", ""),
+        gpu_memory_ratio=gpu_ratio,
         phase=status.get("phase", "Pending"))
 
 

@@ -976,7 +976,9 @@ class SnapshotBuilder:
         # the score counts untolerated PreferNoSchedule taints). A fully
         # untainted, toleration-less batch collapses to [1, 1] so the
         # scheduler's taint gates compile out entirely.
-        if len(ctx.node_taint_groups) == 1 and len(tol_sets) == 1:
+        taints_modeled = not (len(ctx.node_taint_groups) == 1
+                              and len(tol_sets) == 1)
+        if not taints_modeled:
             tol_forbid = np.zeros((1, 1), bool)
             tol_prefer = np.zeros((1, 1), np.float32)
         else:
@@ -1046,18 +1048,24 @@ class SnapshotBuilder:
         # materialize — cluster-wide term diversity must neither exhaust
         # the group cap nor unroll dead work into the commit loop.
         carriers: List[tuple] = []
+        irrelevant_terms: set = set()
         for ep, node_name in self._existing_pods():
             for term in ep.pod_affinity:
                 if not term.anti:
                     continue
                 akey = (ep.meta.namespace, term.topology_key,
                         tuple(sorted(term.label_selector.items())))
+                if akey in irrelevant_terms:
+                    continue
                 entry = anti_groups.get(akey)
                 if entry is None:
                     if not any(self._matches(pod, ep.meta.namespace,
                                              term.label_selector)
                                for pod in pods):
-                        continue  # irrelevant to this batch
+                        # memoized: thousands of carriers of one term
+                        # must not rescan the batch per carrier
+                        irrelevant_terms.add(akey)
+                        continue
                     if len(anti_groups) >= self.max_spread_groups:
                         raise ValueError(
                             f"distinct pod-affinity terms exceed "
@@ -1104,8 +1112,7 @@ class SnapshotBuilder:
             anti_carrier_count0=anti_carrier_count0,
             aff_id=aff_row, aff_member=aff_member,
             aff_domain=aff_domain, aff_count0=aff_count0, valid=valid,
-            has_taints=not (len(ctx.node_taint_groups) == 1
-                            and len(tol_sets) == 1),
+            has_taints=taints_modeled,
             has_spread=bool(spread_groups),
             has_anti=bool(anti_groups),
             has_aff=bool(aff_groups))

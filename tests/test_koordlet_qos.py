@@ -726,3 +726,46 @@ def test_blkio_slo_withdrawal_resets_applied_limits(env):
     r.reconcile(now=10.0)
     assert host.read_cgroup(
         BE_ROOT, "blkio.throttle.read_iops_device") == "/dev/sdb 0"
+
+
+def test_kubelet_pull_combined_gpu_requests():
+    """koordinator.sh/gpu and nvidia.com/gpu translate to gpu-core +
+    memory-ratio (deviceshare utils.go:110-125)."""
+    from koordinator_tpu.koordlet.kubelet_stub import pod_from_manifest
+
+    pod = pod_from_manifest({
+        "metadata": {"name": "g", "namespace": "d", "uid": "u"},
+        "spec": {"containers": [
+            {"resources": {"requests": {"koordinator.sh/gpu": "50",
+                                        "cpu": "1"}}},
+            {"resources": {"requests": {"nvidia.com/gpu": "2"}}},
+        ]},
+        "status": {},
+    })
+    assert pod.requests[ResourceKind.GPU_CORE] == 50.0 + 200.0
+    assert pod.gpu_memory_ratio == 250.0
+    assert pod.requests[ResourceKind.CPU] == 1000.0
+
+
+def test_kubelet_pull_combined_gpu_limits_and_suffixes():
+    """Regression: limits-only combined GPU authoring still models the
+    memory share, and suffixed quantities don't abort the pull."""
+    from koordinator_tpu.koordlet.kubelet_stub import pod_from_manifest
+
+    pod = pod_from_manifest({
+        "metadata": {"name": "g", "namespace": "d", "uid": "u"},
+        "spec": {"containers": [
+            {"resources": {"limits": {"koordinator.sh/gpu": "50"}}}]},
+        "status": {},
+    })
+    assert pod.gpu_memory_ratio == 50.0
+    assert pod.limits[ResourceKind.GPU_CORE] == 50.0
+    # malformed/suffixed combined quantity falls back to 0, no raise
+    pod2 = pod_from_manifest({
+        "metadata": {"name": "h", "namespace": "d", "uid": "u2"},
+        "spec": {"containers": [
+            {"resources": {"requests": {"koordinator.sh/gpu": "bad",
+                                        "cpu": "1"}}}]},
+        "status": {},
+    })
+    assert pod2.requests[ResourceKind.CPU] == 1000.0
