@@ -271,22 +271,19 @@ RESOURCE_NVIDIA_GPU = "nvidia.com/gpu"
 
 def normalize_gpu_request(requests_by_name: Mapping,
                           parse=float) -> tuple:
-    """({name: qty} minus combined GPU names, gpu_core, memory_ratio).
-    `koordinator.sh/gpu: X` means X percent of a GPU (core AND memory);
-    `nvidia.com/gpu: N` means N whole GPUs (100N percent each).
-    `parse` converts raw quantity values (pass the caller's k8s-quantity
-    parser; bare float would raise on suffixed serializations)."""
+    """({name: qty} minus combined GPU names, percent). The percent maps
+    to BOTH gpu-core and gpu-memory-ratio (deviceshare utils.go:110-125):
+    `koordinator.sh/gpu: X` means X percent of a GPU; `nvidia.com/gpu: N`
+    means N whole GPUs (100N percent). `parse` converts raw quantity
+    values (pass the caller's k8s-quantity parser; bare float would raise
+    on suffixed serializations)."""
     out = dict(requests_by_name)
-    core = ratio = 0.0
+    percent = 0.0
     if RESOURCE_GPU_COMBINED in out:
-        v = parse(out.pop(RESOURCE_GPU_COMBINED))
-        core += v
-        ratio += v
+        percent += parse(out.pop(RESOURCE_GPU_COMBINED))
     if RESOURCE_NVIDIA_GPU in out:
-        v = parse(out.pop(RESOURCE_NVIDIA_GPU)) * 100.0
-        core += v
-        ratio += v
-    return out, core, ratio
+        percent += parse(out.pop(RESOURCE_NVIDIA_GPU)) * 100.0
+    return out, percent
 
 
 # --- SystemQOS (apis/extension/system_qos.go) -------------------------------

@@ -83,26 +83,28 @@ def pod_from_manifest(item: dict) -> api.Pod:
     requests: dict = {}
     limits: dict = {}
     gpu_ratio = 0.0
+    gpu_core_kind = RESOURCE_NAMES["koordinator.sh/gpu-core"]
     for c in spec.get("containers", []):
         res = c.get("resources", {})
-        raw_req, core, ratio = normalize_gpu_request(
+        raw_req, pct_req = normalize_gpu_request(
             res.get("requests") or {}, parse=_parse_quantity)
+        raw_lim, pct_lim = normalize_gpu_request(
+            res.get("limits") or {}, parse=_parse_quantity)
+        # extended resources: requests default to limits when only the
+        # limits block is authored (k8s defaulting) — BOTH the core and
+        # the memory-ratio halves, never just one
+        pct_eff = pct_req if pct_req > 0 else pct_lim
+        gpu_ratio += pct_eff
         for k, v in _resource_list(raw_req).items():
             requests[k] = requests.get(k, 0.0) + v
-        if core > 0:
-            requests[RESOURCE_NAMES["koordinator.sh/gpu-core"]] = \
-                requests.get(RESOURCE_NAMES["koordinator.sh/gpu-core"],
-                             0.0) + core
-        raw_lim, lcore, lratio = normalize_gpu_request(
-            res.get("limits") or {}, parse=_parse_quantity)
-        # limits-only combined GPU authoring still models memory share
-        gpu_ratio += ratio if ratio > 0 else lratio
+        if pct_eff > 0:
+            requests[gpu_core_kind] = \
+                requests.get(gpu_core_kind, 0.0) + pct_eff
         for k, v in _resource_list(raw_lim).items():
             limits[k] = limits.get(k, 0.0) + v
-        if lcore > 0:
-            limits[RESOURCE_NAMES["koordinator.sh/gpu-core"]] = \
-                limits.get(RESOURCE_NAMES["koordinator.sh/gpu-core"],
-                           0.0) + lcore
+        if pct_lim > 0:
+            limits[gpu_core_kind] = \
+                limits.get(gpu_core_kind, 0.0) + pct_lim
     labels = dict(meta.get("labels") or {})
     return api.Pod(
         meta=api.ObjectMeta(name=meta.get("name", ""),

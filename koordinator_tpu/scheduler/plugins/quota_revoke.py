@@ -160,25 +160,16 @@ def select_victims_on_node(preemptor: api.Pod,
                     for p in candidates), np.zeros_like(req))
     q_used = quota_used.astype(np.float64) - cand_req
 
-    def ok(extra_node: np.ndarray, extra_quota: np.ndarray) -> bool:
-        return (_fits(base_used + extra_node + req, node_allocatable)
-                and _fits(q_used + extra_quota + req, quota_runtime))
+    # the same remove-all-then-reprieve minimal-set core the default
+    # preemption uses, with the quota runtime as the extra fit surface
+    from koordinator_tpu.scheduler.preemption import reprieve_victims
 
-    if not ok(np.zeros_like(req), np.zeros_like(req)):
-        return None  # does not fit even with all candidates gone
-
-    # reprieve from most important down; keep as victims only those whose
-    # return breaks the fit
-    victims: List[api.Pod] = []
-    back_node = np.zeros_like(req)
-    back_quota = np.zeros_like(req)
-    for p in sorted(candidates, key=lambda p: -(p.priority or 0)):
-        p_req = resource_vec(p.requests).astype(np.float64)
-        if ok(back_node + p_req, back_quota + p_req):
-            back_node += p_req
-            back_quota += p_req
-        else:
-            victims.append(p)
-    if not victims:
+    victims = reprieve_victims(
+        req, candidates,
+        lambda returned: (_fits(base_used + returned + req,
+                                node_allocatable)
+                          and _fits(q_used + returned + req,
+                                    quota_runtime)))
+    if victims is None:
         return None
     return PreemptionResult(victims=victims)

@@ -1,0 +1,134 @@
+"""Default priority preemption (the vanilla PostFilter the reference
+inherits from upstream kube-scheduler, complementing the quota-scoped
+preemption in plugins/quota_revoke.py — whose victim selection wraps the
+shared reprieve helper here).
+
+When a pod is unschedulable, dry-run every node the preemptor could
+actually schedule onto (nodeSelector/affinity/toleration recheck — the
+upstream reruns Filter after hypothetically removing victims, so a
+nominated node must never be one the next batch's gates will reject):
+lower-priority pods are removed hypothetically, the preemptor's fit is
+rechecked, and reprieve adds candidates back from the most important
+down, keeping as victims only those whose return breaks the fit (the
+minimal-set shape of upstream selectVictimsOnNode). Among nodes where
+preemption helps, pickOneNodeForPreemption's ordering applies: lowest
+highest-victim priority, then lowest priority sum, then fewest victims.
+
+Host-side by design: preemption is the cold path (it runs only for pods
+the device program could not place), operates on the typed host view,
+and its output — victims to evict + the nominated node — feeds the
+eviction edge and the NEXT batch, exactly like the reference's
+nominatedNodeName handshake.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from koordinator_tpu.api import types as api
+from koordinator_tpu.scheduler.batching import EPS
+from koordinator_tpu.snapshot.builder import resource_vec
+
+ANNOTATION_PREEMPTIBLE = "scheduling.koordinator.sh/preemptible"
+
+
+@dataclasses.dataclass
+class NominatedPreemption:
+    node_name: str
+    victims: List[api.Pod]
+
+
+def fits(used: np.ndarray, capacity: np.ndarray) -> bool:
+    """Shared fit tolerance — the same EPS the device kernels use, so
+    host preemption and the device program agree on boundary fits."""
+    return bool((used <= capacity + EPS).all())
+
+
+def preemptible(p: api.Pod) -> bool:
+    return p.meta.annotations.get(ANNOTATION_PREEMPTIBLE) != "false"
+
+
+def reprieve_victims(preemptor_req: np.ndarray,
+                     candidates: Sequence[api.Pod],
+                     extra_fit: Callable[[np.ndarray], bool]
+                     ) -> Optional[List[api.Pod]]:
+    """The remove-all-then-reprieve minimal-set core shared by default
+    and quota-scoped preemption. `extra_fit(returned)` must hold with
+    `returned` = the summed requests of reprieved candidates; it already
+    accounts for the preemptor and non-candidates."""
+    if not candidates:
+        return None
+    if not extra_fit(np.zeros_like(preemptor_req)):
+        return None  # even evicting every candidate is not enough
+    victims: List[api.Pod] = []
+    kept = np.zeros_like(preemptor_req)
+    for p in sorted(candidates, key=lambda p: -(p.priority or 0)):
+        p_req = resource_vec(p.requests).astype(np.float64)
+        if extra_fit(kept + p_req):
+            kept += p_req
+        else:
+            victims.append(p)
+    return victims or None
+
+
+def node_admits(pod: api.Pod, node: api.Node) -> bool:
+    """The pod-level gates the device program will re-apply next batch:
+    schedulable, nodeSelector, nodeAffinity expressions, tolerations."""
+    if node.unschedulable:
+        return False
+    labels = node.meta.labels
+    if not all(labels.get(k) == v for k, v in pod.node_selector.items()):
+        return False
+    if not all(r.matches(labels) for r in pod.node_affinity):
+        return False
+    for taint in node.taints:
+        if taint.effect in ("NoSchedule", "NoExecute") and not any(
+                t.tolerates(taint) for t in pod.tolerations):
+            return False
+    return True
+
+
+def select_victims_on_node(preemptor: api.Pod,
+                           node_allocatable: np.ndarray,
+                           pods_on_node: Sequence[api.Pod]
+                           ) -> Optional[List[api.Pod]]:
+    """Minimal victim set on one node, or None when preemption there
+    cannot admit the preemptor."""
+    prio = preemptor.priority or 0
+    candidates = [p for p in pods_on_node
+                  if (p.priority or 0) < prio and preemptible(p)]
+    req = resource_vec(preemptor.requests).astype(np.float64)
+    base = sum((resource_vec(p.requests).astype(np.float64)
+                for p in pods_on_node if p not in candidates),
+               np.zeros_like(req))
+    cap = node_allocatable.astype(np.float64)
+    return reprieve_victims(
+        req, candidates, lambda returned: fits(base + returned + req, cap))
+
+
+def find_preemption(preemptor: api.Pod,
+                    nodes: Sequence[api.Node],
+                    pods_by_node: Dict[str, Sequence[api.Pod]]
+                    ) -> Optional[NominatedPreemption]:
+    """Dry-run every ADMISSIBLE node; pick per pickOneNodeForPreemption
+    ordering."""
+    best: Optional[NominatedPreemption] = None
+    best_key = None
+    for node in nodes:
+        if not node_admits(preemptor, node):
+            continue
+        victims = select_victims_on_node(
+            preemptor, resource_vec(node.allocatable),
+            pods_by_node.get(node.meta.name, ()))
+        if victims is None:
+            continue
+        prios = sorted((p.priority or 0) for p in victims)
+        key = (max(prios), sum(prios), len(victims))
+        if best_key is None or key < best_key:
+            best_key = key
+            best = NominatedPreemption(node_name=node.meta.name,
+                                       victims=victims)
+    return best

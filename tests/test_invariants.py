@@ -168,3 +168,102 @@ def test_resubmit_carries_state():
         assert (after[:, d] - alloc[:, d] <= 1e-2).all()
     # capacity consumed by round 1 bounds round 2
     assert int((a2 >= 0).sum()) <= int((a1 >= 0).sum())
+
+
+# --- topology-gate invariant sweep (taints/spread/affinity) -----------------
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_topology_gate_invariants(seed):
+    """Randomized zones/taints/membership through the builder path; the
+    vanilla-gate guarantees must hold for every seed: no untolerated
+    NoSchedule placement, spread skew bounded over eligible domains,
+    mutual anti-affinity one-per-domain, affinity members co-domained
+    with a match."""
+    from koordinator_tpu.api.extension import ResourceKind as RK
+    from koordinator_tpu.api.types import (
+        Node, NodeMetric, ObjectMeta, Pod, PodAffinityTerm, Taint,
+        Toleration, TopologySpreadConstraint,
+    )
+    from koordinator_tpu.snapshot.builder import SnapshotBuilder
+
+    rng = np.random.default_rng(seed)
+    n_nodes = 12
+    zones = [f"z{int(z)}" for z in rng.integers(0, 4, n_nodes)]
+    tainted = rng.random(n_nodes) < 0.3
+    b = SnapshotBuilder(max_nodes=n_nodes)
+    for i in range(n_nodes):
+        taints = [Taint(key="dedicated", value="infra",
+                        effect="NoSchedule")] if tainted[i] else []
+        b.add_node(Node(meta=ObjectMeta(name=f"n{i}",
+                                        labels={"zone": zones[i]}),
+                        allocatable={RK.CPU: 32000.0,
+                                     RK.MEMORY: 65536.0},
+                        taints=taints))
+        b.set_node_metric(NodeMetric(node_name=f"n{i}", update_time=1e9,
+                                     node_usage={}))
+    snap, ctx = b.build(now=1e9)
+
+    spread = TopologySpreadConstraint(max_skew=1, topology_key="zone",
+                                      label_selector={"app": "web"})
+    anti = PodAffinityTerm(topology_key="zone",
+                           label_selector={"app": "etcd"}, anti=True)
+    aff = PodAffinityTerm(topology_key="zone",
+                          label_selector={"app": "job"})
+    tol = [Toleration(key="dedicated", value="infra",
+                      effect="NoSchedule")]
+    pods = []
+    roles = rng.integers(0, 4, 24)
+    for j, role in enumerate(roles):
+        tolerant = bool(rng.random() < 0.5)
+        kw = dict(priority=9000 + int(rng.integers(0, 500)),
+                  requests={RK.CPU: 500.0, RK.MEMORY: 512.0},
+                  tolerations=tol if tolerant else [])
+        if role == 0:
+            pods.append(Pod(meta=ObjectMeta(name=f"w{j}", namespace="d",
+                                            labels={"app": "web"}),
+                            spread_constraints=[spread], **kw))
+        elif role == 1:
+            pods.append(Pod(meta=ObjectMeta(name=f"e{j}", namespace="d",
+                                            labels={"app": "etcd"}),
+                            pod_affinity=[anti], **kw))
+        elif role == 2:
+            pods.append(Pod(meta=ObjectMeta(name=f"j{j}", namespace="d",
+                                            labels={"app": "job"}),
+                            pod_affinity=[aff], **kw))
+        else:
+            pods.append(Pod(meta=ObjectMeta(name=f"p{j}", namespace="d",
+                                            labels={"app": "plain"}),
+                            **kw))
+    batch = b.build_pod_batch(pods, ctx)
+    res = core.schedule_batch(snap, batch, CFG, num_rounds=5)
+    a = np.asarray(res.assignment)
+
+    # 1. taints
+    for j, pod in enumerate(pods):
+        if a[j] >= 0 and tainted[a[j]]:
+            assert pod.tolerations, \
+                f"seed {seed}: pod {j} on tainted node untolerated"
+    # 2. spread skew over eligible domains (initial counts are zero)
+    web = [j for j, p in enumerate(pods)
+           if p.meta.labels["app"] == "web"]
+    placed_zones = [zones[a[j]] for j in web if a[j] >= 0]
+    # every zone is eligible: dvalid honors the group's own node
+    # constraints (none here); taints don't narrow eligibility, matching
+    # upstream's default nodeTaintsPolicy=Ignore
+    eligible = set(zones)
+    if placed_zones:
+        counts = {z: placed_zones.count(z) for z in eligible}
+        assert max(counts.values()) - min(counts.values()) <= 1, \
+            f"seed {seed}: skew violated {counts}"
+    # 3. mutual anti: one etcd per zone
+    etcd_zones = [zones[a[j]] for j, p in enumerate(pods)
+                  if p.meta.labels["app"] == "etcd" and a[j] >= 0]
+    assert len(etcd_zones) == len(set(etcd_zones)), \
+        f"seed {seed}: anti-affine pods co-domained {etcd_zones}"
+    # 4. affinity: every placed job shares a zone with another job
+    job_zones = [zones[a[j]] for j, p in enumerate(pods)
+                 if p.meta.labels["app"] == "job" and a[j] >= 0]
+    if len(job_zones) > 1:
+        assert len(set(job_zones)) == 1, \
+            f"seed {seed}: affinity group split {job_zones}"

@@ -760,6 +760,8 @@ def test_kubelet_pull_combined_gpu_limits_and_suffixes():
     })
     assert pod.gpu_memory_ratio == 50.0
     assert pod.limits[ResourceKind.GPU_CORE] == 50.0
+    # requests default to limits for extended resources: BOTH halves
+    assert pod.requests[ResourceKind.GPU_CORE] == 50.0
     # malformed/suffixed combined quantity falls back to 0, no raise
     pod2 = pod_from_manifest({
         "metadata": {"name": "h", "namespace": "d", "uid": "u2"},

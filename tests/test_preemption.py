@@ -1,0 +1,123 @@
+"""Default priority preemption (upstream PostFilter; complements the
+quota-scoped preemption in plugins/quota_revoke.py)."""
+
+import numpy as np
+
+from koordinator_tpu.api import types as api
+from koordinator_tpu.api.extension import ResourceKind as RK
+from koordinator_tpu.api.types import Node, NodeMetric, ObjectMeta, Pod
+from koordinator_tpu.scheduler.preemption import (
+    find_preemption,
+    select_victims_on_node,
+)
+from koordinator_tpu.snapshot.builder import SnapshotBuilder, resource_vec
+
+
+def mk_pod(name, prio, cpu, preemptible=True):
+    anns = {} if preemptible else {
+        "scheduling.koordinator.sh/preemptible": "false"}
+    return Pod(meta=ObjectMeta(name=name, annotations=anns),
+               priority=prio, requests={RK.CPU: cpu, RK.MEMORY: 256.0})
+
+
+def test_minimal_victim_set_with_reprieve():
+    alloc = resource_vec({RK.CPU: 8000.0, RK.MEMORY: 16384.0})
+    running = [mk_pod("low-a", 5000, 3000.0),
+               mk_pod("low-b", 5500, 3000.0),
+               mk_pod("peer", 9100, 2000.0)]
+    preemptor = mk_pod("prod", 9500, 3000.0)
+    victims = select_victims_on_node(preemptor, alloc, running)
+    # 2000 (peer kept) + 3000 needed: freeing ONE 3000m victim suffices;
+    # reprieve keeps the more important (5500) candidate
+    assert victims is not None
+    assert [v.meta.name for v in victims] == ["low-a"]
+
+
+def test_non_preemptible_and_higher_priority_protected():
+    alloc = resource_vec({RK.CPU: 4000.0, RK.MEMORY: 16384.0})
+    running = [mk_pod("protected", 5000, 4000.0, preemptible=False)]
+    assert select_victims_on_node(mk_pod("p", 9000, 2000.0), alloc,
+                                  running) is None
+    running2 = [mk_pod("higher", 9600, 4000.0)]
+    assert select_victims_on_node(mk_pod("p", 9000, 2000.0), alloc,
+                                  running2) is None
+
+
+def test_pick_node_prefers_cheapest_victims():
+    nodes = [Node(meta=ObjectMeta(name="a"),
+                  allocatable={RK.CPU: 8000.0, RK.MEMORY: 16384.0}),
+             Node(meta=ObjectMeta(name="b"),
+                  allocatable={RK.CPU: 8000.0, RK.MEMORY: 16384.0})]
+    pods_by_node = {
+        "a": [mk_pod("mid", 7000, 8000.0)],      # victim priority 7000
+        "b": [mk_pod("batch", 5000, 8000.0)],    # victim priority 5000
+    }
+    got = find_preemption(mk_pod("prod", 9500, 4000.0), nodes,
+                          pods_by_node)
+    assert got is not None and got.node_name == "b"
+    assert [v.meta.name for v in got.victims] == ["batch"]
+
+
+def test_preemption_feeds_next_batch():
+    """End-to-end: unschedulable -> preempt -> evict victims -> rebuild
+    -> the preemptor lands on the nominated node."""
+    from koordinator_tpu.scheduler import core
+    from koordinator_tpu.scheduler.plugins.loadaware import LoadAwareConfig
+
+    def build(running):
+        b = SnapshotBuilder(max_nodes=1)
+        b.add_node(Node(meta=ObjectMeta(name="n0"),
+                        allocatable={RK.CPU: 8000.0, RK.MEMORY: 16384.0}))
+        b.set_node_metric(NodeMetric(node_name="n0", update_time=1e9,
+                                     node_usage={}))
+        for p in running:
+            p.phase = "Running"
+            p.node_name = "n0"
+            b.add_running_pod(p)
+        return b
+
+    victim = mk_pod("be", 5000, 6000.0)
+    preemptor = mk_pod("prod", 9500, 4000.0)
+    b = build([victim])
+    snap, ctx = b.build(now=1e9)
+    res = core.schedule_batch(snap, b.build_pod_batch([preemptor], ctx),
+                              LoadAwareConfig.make())
+    assert int(np.asarray(res.assignment)[0]) == -1  # full node
+    nom = find_preemption(preemptor,
+                          [Node(meta=ObjectMeta(name="n0"),
+                                allocatable={RK.CPU: 8000.0,
+                                             RK.MEMORY: 16384.0})],
+                          {"n0": [victim]})
+    assert nom and [v.meta.name for v in nom.victims] == ["be"]
+    b2 = build([])  # victims evicted
+    snap2, ctx2 = b2.build(now=1e9)
+    res2 = core.schedule_batch(snap2, b2.build_pod_batch([preemptor],
+                                                         ctx2),
+                               LoadAwareConfig.make())
+    assert int(np.asarray(res2.assignment)[0]) == 0
+
+
+def test_find_preemption_honors_pod_level_gates():
+    """Regression: never nominate a node the next batch's gates will
+    reject — victims must not die for an impossible nomination."""
+    from koordinator_tpu.api.types import Taint, Toleration
+
+    nodes = [Node(meta=ObjectMeta(name="wrong-zone",
+                                  labels={"zone": "b"}),
+                  allocatable={RK.CPU: 8000.0, RK.MEMORY: 16384.0}),
+             Node(meta=ObjectMeta(name="tainted",
+                                  labels={"zone": "a"}),
+                  allocatable={RK.CPU: 8000.0, RK.MEMORY: 16384.0},
+                  taints=[Taint(key="x", effect="NoSchedule")]),
+             Node(meta=ObjectMeta(name="good", labels={"zone": "a"}),
+                  allocatable={RK.CPU: 8000.0, RK.MEMORY: 16384.0})]
+    pods_by_node = {n.meta.name: [mk_pod(f"v-{n.meta.name}", 5000, 8000.0)]
+                    for n in nodes}
+    preemptor = mk_pod("prod", 9500, 4000.0)
+    preemptor.node_selector = {"zone": "a"}
+    got = find_preemption(preemptor, nodes, pods_by_node)
+    assert got is not None and got.node_name == "good"
+    # tolerating the taint widens the choice to both zone-a nodes
+    preemptor.tolerations = [Toleration(key="x")]
+    got2 = find_preemption(preemptor, nodes, pods_by_node)
+    assert got2 is not None and got2.node_name in ("tainted", "good")
