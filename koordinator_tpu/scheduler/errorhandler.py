@@ -81,6 +81,35 @@ class ErrorHandlerDispatcher:
                     break
 
 
+def make_preemption_post_filter(
+        get_nodes: Callable[[], List[api.Node]],
+        get_pods_by_node: Callable[[], dict],
+        on_nominate: Callable) -> ErrorFilter:
+    """The default-preemption PostFilter as an error-chain post filter:
+    an unschedulable pod with a priority dry-runs the cluster view for a
+    minimal victim set (scheduler/preemption.py); `on_nominate(pod,
+    nomination)` receives the winner — the caller evicts the victims and
+    requeues the pod against the next snapshot (the nominatedNodeName
+    handshake). Returns True when a nomination was made so later post
+    filters can skip."""
+    from koordinator_tpu.scheduler.preemption import find_preemption
+
+    def post(pod_info: QueuedPodInfo, err: SchedulingError) -> bool:
+        pod = pod_info.pod
+        # infrastructure errors retry as-is — never evict for them
+        # (upstream's PostFilter runs only for Unschedulable status)
+        if not err.unschedulable or not pod.priority:
+            return False
+        nomination = find_preemption(pod, get_nodes(),
+                                     get_pods_by_node())
+        if nomination is None:
+            return False
+        on_nominate(pod, nomination)
+        return True
+
+    return post
+
+
 def set_reservation_unschedulable(r: api.Reservation, msg: str,
                                   now: Optional[float] = None) -> None:
     """setReservationUnschedulable (reservation_handler.go:155-190):

@@ -33,7 +33,11 @@ from koordinator_tpu.snapshot.builder import resource_vec
 
 
 def _fits(used: np.ndarray, limit: np.ndarray) -> bool:
-    return bool((used <= limit + 0.5).all())
+    # the SAME tolerance as scheduler/preemption.fits and the device
+    # kernels (batching.EPS) — the two preemption paths and the device
+    # program must agree on boundary fits
+    from koordinator_tpu.scheduler.batching import EPS
+    return bool((used <= limit + EPS).all())
 
 
 # --- overuse revoke ---------------------------------------------------------
@@ -143,8 +147,10 @@ def select_victims_on_node(preemptor: api.Pod,
     prio = preemptor.priority or 0
 
     def is_candidate(p: api.Pod) -> bool:
+        from koordinator_tpu.scheduler.preemption import preemptible
         return ((p.priority or 0) < prio
-                and p.quota_name == preemptor.quota_name)
+                and p.quota_name == preemptor.quota_name
+                and preemptible(p))
 
     candidates = [p for p in pods_on_node if is_candidate(p)]
     if not candidates:

@@ -98,15 +98,108 @@ def select_victims_on_node(preemptor: api.Pod,
     """Minimal victim set on one node, or None when preemption there
     cannot admit the preemptor."""
     prio = preemptor.priority or 0
-    candidates = [p for p in pods_on_node
-                  if (p.priority or 0) < prio and preemptible(p)]
+
+    def is_candidate(p: api.Pod) -> bool:
+        return (p.priority or 0) < prio and preemptible(p)
+
+    candidates = [p for p in pods_on_node if is_candidate(p)]
+    others = [p for p in pods_on_node if not is_candidate(p)]
     req = resource_vec(preemptor.requests).astype(np.float64)
     base = sum((resource_vec(p.requests).astype(np.float64)
-                for p in pods_on_node if p not in candidates),
-               np.zeros_like(req))
+                for p in others), np.zeros_like(req))
     cap = node_allocatable.astype(np.float64)
     return reprieve_victims(
         req, candidates, lambda returned: fits(base + returned + req, cap))
+
+
+def _pod_matches(p: api.Pod, ns: str, selector) -> bool:
+    return (p.meta.namespace == ns
+            and all(p.meta.labels.get(k) == v
+                    for k, v in selector.items()))
+
+
+def constraints_admit(pod: api.Pod, node: api.Node,
+                      nodes: Sequence[api.Node],
+                      pods_by_node: Dict[str, Sequence[api.Pod]],
+                      removed_ids: frozenset) -> bool:
+    """The topology gates the device program re-applies next batch —
+    required (anti-)affinity in both directions and hard spread —
+    evaluated against the SURVIVING cluster view (victims removed). A
+    nomination that fails any of these would cost victims their lives
+    for a node the preemptor still cannot take."""
+    labels = node.meta.labels
+    node_of = {n.meta.name: n for n in nodes}
+
+    def survivors():
+        for n_name, plist in pods_by_node.items():
+            other = node_of.get(n_name)
+            if other is None:
+                continue
+            for p in plist:
+                if id(p) not in removed_ids:
+                    yield other, p
+
+    ns = pod.meta.namespace
+    for term in pod.pod_affinity:
+        dom = labels.get(term.topology_key)
+        if term.anti:
+            if dom is None:
+                continue  # keyless nodes pass (no pair can exist)
+            for other, p in survivors():
+                if (other.meta.labels.get(term.topology_key) == dom
+                        and _pod_matches(p, ns, term.label_selector)):
+                    return False
+        else:
+            if dom is None:
+                return False
+            total = 0
+            here = False
+            for other, p in survivors():
+                if _pod_matches(p, ns, term.label_selector):
+                    total += 1
+                    if other.meta.labels.get(term.topology_key) == dom:
+                        here = True
+            if not here and not (
+                    total == 0
+                    and _pod_matches(pod, ns, term.label_selector)):
+                return False
+    # direction (b): surviving carriers' anti terms against the pod
+    for other, p in survivors():
+        for term in p.pod_affinity:
+            if not term.anti:
+                continue
+            if not _pod_matches(pod, p.meta.namespace,
+                                term.label_selector):
+                continue
+            cd = other.meta.labels.get(term.topology_key)
+            if cd is not None and labels.get(term.topology_key) == cd:
+                return False
+    for c in pod.spread_constraints:
+        if c.when_unsatisfiable != "DoNotSchedule":
+            continue
+        dom = labels.get(c.topology_key)
+        if dom is None:
+            return False
+        counts: Dict[str, int] = {}
+        eligible = set()
+        for n in nodes:
+            d = n.meta.labels.get(c.topology_key)
+            if d is None:
+                continue
+            counts.setdefault(d, 0)
+            if (all(n.meta.labels.get(k) == v
+                    for k, v in pod.node_selector.items())
+                    and all(r.matches(n.meta.labels)
+                            for r in pod.node_affinity)):
+                eligible.add(d)
+        for other, p in survivors():
+            d = other.meta.labels.get(c.topology_key)
+            if d is not None and _pod_matches(p, ns, c.label_selector):
+                counts[d] = counts.get(d, 0) + 1
+        min_c = min((counts.get(d, 0) for d in eligible), default=0)
+        if counts.get(dom, 0) + 1 - min_c > c.max_skew:
+            return False
+    return True
 
 
 def find_preemption(preemptor: api.Pod,
@@ -114,7 +207,8 @@ def find_preemption(preemptor: api.Pod,
                     pods_by_node: Dict[str, Sequence[api.Pod]]
                     ) -> Optional[NominatedPreemption]:
     """Dry-run every ADMISSIBLE node; pick per pickOneNodeForPreemption
-    ordering."""
+    ordering. Admissibility covers the node-level gates up front and the
+    topology gates (spread/affinity) against the post-eviction view."""
     best: Optional[NominatedPreemption] = None
     best_key = None
     for node in nodes:
@@ -124,6 +218,9 @@ def find_preemption(preemptor: api.Pod,
             preemptor, resource_vec(node.allocatable),
             pods_by_node.get(node.meta.name, ()))
         if victims is None:
+            continue
+        if not constraints_admit(preemptor, node, nodes, pods_by_node,
+                                 frozenset(id(v) for v in victims)):
             continue
         prios = sorted((p.priority or 0) for p in victims)
         key = (max(prios), sum(prios), len(victims))

@@ -285,3 +285,57 @@ def test_full_loop_agent_to_scheduled_pod(tmp_path):
     res = service.schedule(batch, typed_pods=[be])
     assert int(np.asarray(res.assignment)[0]) == 0, \
         "BE pod must land on the overcommitted capacity the agent enabled"
+
+
+def test_e2e_preemption_nominates_and_places(tmp_path):
+    """Unschedulable prod pod -> error chain -> preemption nomination
+    from the hub's cluster view -> victims evicted -> next sync places
+    the preemptor on the nominated node."""
+    import time as _time
+
+    from koordinator_tpu.scheduler.errorhandler import (
+        make_preemption_post_filter,
+    )
+    from koordinator_tpu.scheduler.frameworkext import SchedulerService
+    from koordinator_tpu.snapshot import (
+        ClusterInformerHub,
+        SnapshotStore,
+        SnapshotSyncer,
+    )
+
+    now = _time.time()
+    hub = ClusterInformerHub()
+    node = api.Node(meta=api.ObjectMeta(name="n0"),
+                    allocatable={RK.CPU: 8000.0, RK.MEMORY: 16384.0})
+    hub.upsert_node(node)
+    hub.set_node_metric(api.NodeMetric(node_name="n0", update_time=now,
+                                       node_usage={}))
+    be = api.Pod(meta=api.ObjectMeta(name="be-0", uid="be-0"),
+                 priority=5000, phase="Running", node_name="n0",
+                 requests={RK.CPU: 6000.0, RK.MEMORY: 512.0})
+    hub.upsert_pod(be)
+    store = SnapshotStore()
+    syncer = SnapshotSyncer(hub, store, max_nodes=1)
+    syncer.sync(now=now)
+    service = SchedulerService(store=store)
+    nominations = []
+    service.error_dispatcher.register(post=make_preemption_post_filter(
+        lambda: hub.read_all()["nodes"],
+        lambda: hub.read_all()["pods_by_node"],
+        lambda pod, nom: nominations.append((pod, nom))))
+
+    prod = api.Pod(meta=api.ObjectMeta(name="prod-0"), priority=9500,
+                   requests={RK.CPU: 5000.0, RK.MEMORY: 512.0})
+    batch = syncer.builder.build_pod_batch([prod], syncer.ctx)
+    res = service.schedule(batch, typed_pods=[prod])
+    assert int(np.asarray(res.assignment)[0]) == -1
+    assert len(nominations) == 1
+    pod, nom = nominations[0]
+    assert nom.node_name == "n0"
+    # the eviction edge removes the victims; next sync frees the capacity
+    for v in nom.victims:
+        hub.delete_pod(v.meta.uid)
+    assert syncer.sync(now=now + 1) == "full"
+    batch2 = syncer.builder.build_pod_batch([prod], syncer.ctx)
+    res2 = service.schedule(batch2, typed_pods=[prod])
+    assert int(np.asarray(res2.assignment)[0]) == 0

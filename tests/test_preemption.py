@@ -121,3 +121,96 @@ def test_find_preemption_honors_pod_level_gates():
     preemptor.tolerations = [Toleration(key="x")]
     got2 = find_preemption(preemptor, nodes, pods_by_node)
     assert got2 is not None and got2.node_name in ("tainted", "good")
+
+
+def test_preemption_post_filter_in_error_chain():
+    """The chain wiring: an unschedulable prod pod dispatched through
+    the error handlers produces a nomination from the cluster view."""
+    from koordinator_tpu.scheduler.errorhandler import (
+        ErrorHandlerDispatcher,
+        QueuedPodInfo,
+        SchedulingError,
+        make_preemption_post_filter,
+    )
+
+    node = Node(meta=ObjectMeta(name="n0"),
+                allocatable={RK.CPU: 8000.0, RK.MEMORY: 16384.0})
+    victim = mk_pod("be", 5000, 8000.0)
+    nominations = []
+    dispatcher = ErrorHandlerDispatcher()
+    dispatcher.register(post=make_preemption_post_filter(
+        lambda: [node], lambda: {"n0": [victim]},
+        lambda pod, nom: nominations.append((pod.meta.name, nom))))
+    dispatcher.error(QueuedPodInfo(pod=mk_pod("prod", 9500, 4000.0)),
+                     SchedulingError("no node fits"))
+    assert len(nominations) == 1
+    name, nom = nominations[0]
+    assert name == "prod" and nom.node_name == "n0"
+    assert [v.meta.name for v in nom.victims] == ["be"]
+    # a priority-less pod never preempts
+    dispatcher.error(QueuedPodInfo(pod=mk_pod("free", 0, 100.0)),
+                     SchedulingError("no node fits"))
+    assert len(nominations) == 1
+
+
+def test_constraints_admit_blocks_impossible_nomination():
+    """Regression: the topology gates are rechecked against the
+    POST-eviction view — a node whose surviving pods still violate the
+    preemptor's anti term is never nominated."""
+    from koordinator_tpu.api.types import PodAffinityTerm
+
+    term = PodAffinityTerm(topology_key="zone",
+                           label_selector={"app": "db"}, anti=True)
+    nodes = [Node(meta=ObjectMeta(name="n0", labels={"zone": "a"}),
+                  allocatable={RK.CPU: 8000.0, RK.MEMORY: 16384.0}),
+             Node(meta=ObjectMeta(name="n1", labels={"zone": "b"}),
+                  allocatable={RK.CPU: 8000.0, RK.MEMORY: 16384.0})]
+    # n0: a cheap victim AND a higher-priority db pod that survives;
+    # n1: an expensive victim but no db pod
+    db = mk_pod("db", 9600, 1000.0)
+    db.meta.labels["app"] = "db"
+    pods_by_node = {"n0": [mk_pod("cheap", 5000, 7000.0), db],
+                    "n1": [mk_pod("mid", 7000, 8000.0)]}
+    preemptor = mk_pod("prod", 9500, 6000.0)
+    preemptor.pod_affinity = [term]
+    got = find_preemption(preemptor, nodes, pods_by_node)
+    # n0 would be cheaper but the surviving db pod shares its zone
+    assert got is not None and got.node_name == "n1"
+
+
+def test_infra_errors_never_preempt():
+    from koordinator_tpu.scheduler.errorhandler import (
+        ErrorHandlerDispatcher,
+        QueuedPodInfo,
+        SchedulingError,
+        make_preemption_post_filter,
+    )
+
+    node = Node(meta=ObjectMeta(name="n0"),
+                allocatable={RK.CPU: 8000.0, RK.MEMORY: 16384.0})
+    nominations = []
+    d = ErrorHandlerDispatcher()
+    d.register(post=make_preemption_post_filter(
+        lambda: [node], lambda: {"n0": [mk_pod("be", 5000, 8000.0)]},
+        lambda pod, nom: nominations.append(nom)))
+    d.error(QueuedPodInfo(pod=mk_pod("prod", 9500, 4000.0)),
+            SchedulingError("etcd timeout", unschedulable=False))
+    assert nominations == []
+
+
+def test_quota_preemption_honors_preemptible_annotation():
+    from koordinator_tpu.scheduler.plugins.quota_revoke import (
+        select_victims_on_node as quota_select,
+    )
+    from koordinator_tpu.snapshot.builder import resource_vec as rv
+
+    protected = mk_pod("keep", 5000, 6000.0, preemptible=False)
+    protected.quota_name = "q"
+    preemptor = mk_pod("prod", 9500, 4000.0)
+    preemptor.quota_name = "q"
+    got = quota_select(preemptor,
+                       rv({RK.CPU: 8000.0, RK.MEMORY: 16384.0}),
+                       [protected],
+                       rv({RK.CPU: 6000.0}),
+                       rv({RK.CPU: 64000.0, RK.MEMORY: 64000.0}))
+    assert got is None
