@@ -25,6 +25,14 @@ def build(argv: Optional[Sequence[str]] = None,
     p.add_argument("--report-interval-seconds", type=float, default=60.0)
     p.add_argument("--checkpoint-path", default="")
     p.add_argument("--audit-http-port", type=int, default=0)
+    # kubelet /pods pull (kubelet_stub.go flags: --kubelet-* options);
+    # empty address keeps the push edge (set_pods) in charge
+    p.add_argument("--kubelet-addr", default="")
+    p.add_argument("--kubelet-port", type=int, default=10250)
+    p.add_argument("--kubelet-scheme", default="https")
+    p.add_argument("--kubelet-token-file", default="")
+    p.add_argument("--kubelet-insecure-tls", action="store_true")
+    p.add_argument("--kubelet-resync-seconds", type=float, default=60.0)
     args = p.parse_args(argv)
     gate = new_default_gate()
     parse_feature_gates(gate, args.feature_gates)
@@ -37,7 +45,24 @@ def build(argv: Optional[Sequence[str]] = None,
         enable_core_sched=gate.enabled("CoreSched"),
         audit_http_port=(args.audit_http_port
                          if gate.enabled("AuditEventsHTTPHandler") else -1))
-    return Daemon(host or Host(args.host_root), cfg)
+    daemon = Daemon(host or Host(args.host_root), cfg)
+    if args.kubelet_addr:
+        from koordinator_tpu.koordlet.kubelet_stub import (
+            KubeletStub,
+            PodsPuller,
+        )
+
+        token = ""
+        if args.kubelet_token_file:
+            with open(args.kubelet_token_file, encoding="utf-8") as f:
+                token = f.read().strip()
+        daemon.pods_puller = PodsPuller(
+            KubeletStub(args.kubelet_addr, args.kubelet_port,
+                        args.kubelet_scheme, token=token,
+                        insecure_tls=args.kubelet_insecure_tls),
+            daemon.informer,
+            resync_interval_seconds=args.kubelet_resync_seconds)
+    return daemon
 
 
 def main(argv: Optional[Sequence[str]] = None,

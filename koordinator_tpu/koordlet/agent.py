@@ -83,6 +83,9 @@ class Daemon:
         self.executor = Executor(host, auditor)
         self.metric_cache = mc.MetricCache()
         self.informer = StatesInformer()
+        # optional kubelet /pods pull edge (cmd/koordlet --kubelet-addr);
+        # None = pods arrive by push (set_pods)
+        self.pods_puller = None
         if perf_reader is None and cfg.enable_perf_group:
             from koordinator_tpu.native import cycles_instructions_reader
             perf_reader = cycles_instructions_reader()
@@ -135,6 +138,10 @@ class Daemon:
         """One agent cycle; returns a NodeMetric when the report interval
         elapsed."""
         now = time.time() if now is None else now
+        if self.pods_puller is not None:
+            # pull edge (kubelet /pods), interval-gated so a slow kubelet
+            # never stalls the sampling loop; failures keep last state
+            self.pods_puller.maybe_sync(now)
         self.advisor.collect_once(now)
         self.pleg.poll_once()
         self._publish_metrics(now)

@@ -121,20 +121,31 @@ def pod_from_manifest(item: dict) -> api.Pod:
 
 
 class KubeletStub:
-    """GET /pods/ on the kubelet (kubelet_stub.go GetAllPods)."""
+    """GET /pods/ on the kubelet (kubelet_stub.go GetAllPods).
+    `insecure_tls` skips certificate verification — kubelet serving
+    certs are typically self-signed, and the reference's rest.Config
+    transport runs with InsecureSkipVerify in the same deployment."""
 
     def __init__(self, addr: str = "127.0.0.1", port: int = 10250,
                  scheme: str = "https", token: str = "",
-                 timeout: float = 10.0):
+                 timeout: float = 10.0, insecure_tls: bool = False):
         self.url = f"{scheme}://{addr}:{port}/pods/"
         self.token = token
         self.timeout = timeout
+        self._ctx = None
+        if scheme == "https" and insecure_tls:
+            import ssl
+
+            self._ctx = ssl.create_default_context()
+            self._ctx.check_hostname = False
+            self._ctx.verify_mode = ssl.CERT_NONE
 
     def get_all_pods(self) -> List[api.Pod]:
         req = urllib.request.Request(self.url)
         if self.token:
             req.add_header("Authorization", f"Bearer {self.token}")
-        with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+        with urllib.request.urlopen(req, timeout=self.timeout,
+                                    context=self._ctx) as resp:
             data = json.loads(resp.read().decode("utf-8"))
         return [pod_from_manifest(item) for item in data.get("items", [])]
 
@@ -144,10 +155,24 @@ class PodsPuller:
     StatesInformer (states_pods.go syncPods). Pull failures keep the last
     good state (the reference logs and retries next resync)."""
 
-    def __init__(self, stub: KubeletStub, informer: StatesInformer):
+    def __init__(self, stub: KubeletStub, informer: StatesInformer,
+                 resync_interval_seconds: float = 60.0):
         self.stub = stub
         self.informer = informer
+        self.resync_interval = resync_interval_seconds
         self.last_error: Optional[str] = None
+        self._last_sync: Optional[float] = None
+
+    def maybe_sync(self, now: float) -> bool:
+        """Interval-gated sync for callers on a fast tick loop: the
+        kubelet is polled on the resync interval (the reference's
+        informer resync, ~minutes), never per agent tick — a slow
+        kubelet must not stall metric sampling and QoS enforcement."""
+        if (self._last_sync is not None
+                and now - self._last_sync < self.resync_interval):
+            return False
+        self._last_sync = now
+        return self.sync()
 
     def sync(self) -> bool:
         try:

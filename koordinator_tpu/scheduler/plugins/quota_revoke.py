@@ -29,6 +29,11 @@ from typing import Dict, List, Optional, Sequence
 import numpy as np
 
 from koordinator_tpu.api import types as api
+from koordinator_tpu.scheduler.batching import EPS
+from koordinator_tpu.scheduler.preemption import (
+    preemptible,
+    reprieve_victims,
+)
 from koordinator_tpu.snapshot.builder import resource_vec
 
 
@@ -36,7 +41,6 @@ def _fits(used: np.ndarray, limit: np.ndarray) -> bool:
     # the SAME tolerance as scheduler/preemption.fits and the device
     # kernels (batching.EPS) — the two preemption paths and the device
     # program must agree on boundary fits
-    from koordinator_tpu.scheduler.batching import EPS
     return bool((used <= limit + EPS).all())
 
 
@@ -147,7 +151,6 @@ def select_victims_on_node(preemptor: api.Pod,
     prio = preemptor.priority or 0
 
     def is_candidate(p: api.Pod) -> bool:
-        from koordinator_tpu.scheduler.preemption import preemptible
         return ((p.priority or 0) < prio
                 and p.quota_name == preemptor.quota_name
                 and preemptible(p))
@@ -168,14 +171,11 @@ def select_victims_on_node(preemptor: api.Pod,
 
     # the same remove-all-then-reprieve minimal-set core the default
     # preemption uses, with the quota runtime as the extra fit surface
-    from koordinator_tpu.scheduler.preemption import reprieve_victims
-
     victims = reprieve_victims(
         req, candidates,
-        lambda returned: (_fits(base_used + returned + req,
-                                node_allocatable)
-                          and _fits(q_used + returned + req,
-                                    quota_runtime)))
+        lambda returned, _reprieved: (
+            _fits(base_used + returned + req, node_allocatable)
+            and _fits(q_used + returned + req, quota_runtime)))
     if victims is None:
         return None
     return PreemptionResult(victims=victims)

@@ -53,22 +53,29 @@ def preemptible(p: api.Pod) -> bool:
 
 def reprieve_victims(preemptor_req: np.ndarray,
                      candidates: Sequence[api.Pod],
-                     extra_fit: Callable[[np.ndarray], bool]
+                     extra_fit: Callable[[np.ndarray, List[api.Pod]],
+                                         bool]
                      ) -> Optional[List[api.Pod]]:
     """The remove-all-then-reprieve minimal-set core shared by default
-    and quota-scoped preemption. `extra_fit(returned)` must hold with
-    `returned` = the summed requests of reprieved candidates; it already
-    accounts for the preemptor and non-candidates."""
+    and quota-scoped preemption. `extra_fit(returned, reprieved)` must
+    hold with `returned` = the summed requests of the reprieved
+    candidates and `reprieved` their identities (so callers can re-run
+    non-resource gates per reprieve step — upstream reruns the Filter
+    plugins inside selectVictimsOnNode, which is what lets a pod blocked
+    by anti-affinity against a PREEMPTIBLE pod evict it even when
+    resources alone would fit)."""
     if not candidates:
         return None
-    if not extra_fit(np.zeros_like(preemptor_req)):
+    if not extra_fit(np.zeros_like(preemptor_req), []):
         return None  # even evicting every candidate is not enough
     victims: List[api.Pod] = []
     kept = np.zeros_like(preemptor_req)
+    reprieved: List[api.Pod] = []
     for p in sorted(candidates, key=lambda p: -(p.priority or 0)):
         p_req = resource_vec(p.requests).astype(np.float64)
-        if extra_fit(kept + p_req):
+        if extra_fit(kept + p_req, reprieved + [p]):
             kept += p_req
+            reprieved.append(p)
         else:
             victims.append(p)
     return victims or None
@@ -93,10 +100,13 @@ def node_admits(pod: api.Pod, node: api.Node) -> bool:
 
 def select_victims_on_node(preemptor: api.Pod,
                            node_allocatable: np.ndarray,
-                           pods_on_node: Sequence[api.Pod]
+                           pods_on_node: Sequence[api.Pod],
+                           admit: Optional[Callable] = None
                            ) -> Optional[List[api.Pod]]:
     """Minimal victim set on one node, or None when preemption there
-    cannot admit the preemptor."""
+    cannot admit the preemptor. `admit(removed_ids)` re-runs the
+    non-resource gates with that candidate subset hypothetically
+    evicted (None = resources only)."""
     prio = preemptor.priority or 0
 
     def is_candidate(p: api.Pod) -> bool:
@@ -108,8 +118,18 @@ def select_victims_on_node(preemptor: api.Pod,
     base = sum((resource_vec(p.requests).astype(np.float64)
                 for p in others), np.zeros_like(req))
     cap = node_allocatable.astype(np.float64)
-    return reprieve_victims(
-        req, candidates, lambda returned: fits(base + returned + req, cap))
+    cand_ids = {id(p) for p in candidates}
+
+    def extra_fit(returned: np.ndarray,
+                  reprieved: List[api.Pod]) -> bool:
+        if not fits(base + returned + req, cap):
+            return False
+        if admit is None:
+            return True
+        removed = frozenset(cand_ids - {id(p) for p in reprieved})
+        return admit(removed)
+
+    return reprieve_victims(req, candidates, extra_fit)
 
 
 def _pod_matches(p: api.Pod, ns: str, selector) -> bool:
@@ -121,23 +141,26 @@ def _pod_matches(p: api.Pod, ns: str, selector) -> bool:
 def constraints_admit(pod: api.Pod, node: api.Node,
                       nodes: Sequence[api.Node],
                       pods_by_node: Dict[str, Sequence[api.Pod]],
-                      removed_ids: frozenset) -> bool:
+                      removed_ids: frozenset,
+                      placed: Optional[List[tuple]] = None) -> bool:
     """The topology gates the device program re-applies next batch —
     required (anti-)affinity in both directions and hard spread —
     evaluated against the SURVIVING cluster view (victims removed). A
     nomination that fails any of these would cost victims their lives
-    for a node the preemptor still cannot take."""
+    for a node the preemptor still cannot take. `placed` is the
+    pre-materialized [(node, pod)] view (hoisted by find_preemption so
+    repeated admission checks don't rebuild it)."""
     labels = node.meta.labels
-    node_of = {n.meta.name: n for n in nodes}
+    if placed is None:
+        node_of = {n.meta.name: n for n in nodes}
+        placed = [(node_of[n_name], p)
+                  for n_name, plist in pods_by_node.items()
+                  if n_name in node_of for p in plist]
 
     def survivors():
-        for n_name, plist in pods_by_node.items():
-            other = node_of.get(n_name)
-            if other is None:
-                continue
-            for p in plist:
-                if id(p) not in removed_ids:
-                    yield other, p
+        for other, p in placed:
+            if id(p) not in removed_ids:
+                yield other, p
 
     ns = pod.meta.namespace
     for term in pod.pod_affinity:
@@ -211,16 +234,27 @@ def find_preemption(preemptor: api.Pod,
     topology gates (spread/affinity) against the post-eviction view."""
     best: Optional[NominatedPreemption] = None
     best_key = None
+    node_of = {n.meta.name: n for n in nodes}
+    placed = [(node_of[n_name], p)
+              for n_name, plist in pods_by_node.items()
+              if n_name in node_of for p in plist]
+    has_topology = bool(preemptor.pod_affinity
+                        or preemptor.spread_constraints
+                        or any(t.anti for _, p in placed
+                               for t in p.pod_affinity))
     for node in nodes:
         if not node_admits(preemptor, node):
             continue
+        admit = None
+        if has_topology:
+            def admit(removed_ids, _node=node):
+                return constraints_admit(preemptor, _node, nodes,
+                                         pods_by_node, removed_ids,
+                                         placed=placed)
         victims = select_victims_on_node(
             preemptor, resource_vec(node.allocatable),
-            pods_by_node.get(node.meta.name, ()))
+            pods_by_node.get(node.meta.name, ()), admit=admit)
         if victims is None:
-            continue
-        if not constraints_admit(preemptor, node, nodes, pods_by_node,
-                                 frozenset(id(v) for v in victims)):
             continue
         prios = sorted((p.priority or 0) for p in victims)
         key = (max(prios), sum(prios), len(victims))

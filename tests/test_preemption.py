@@ -214,3 +214,22 @@ def test_quota_preemption_honors_preemptible_annotation():
                        rv({RK.CPU: 6000.0}),
                        rv({RK.CPU: 64000.0, RK.MEMORY: 64000.0}))
     assert got is None
+
+
+def test_topology_blocked_preemption_evicts_the_blocker():
+    """Regression: a preemptor blocked SOLELY by anti-affinity against a
+    lower-priority preemptible pod evicts that pod (upstream reruns the
+    Filter inside victim selection)."""
+    from koordinator_tpu.api.types import PodAffinityTerm
+
+    term = PodAffinityTerm(topology_key="zone",
+                           label_selector={"app": "be"}, anti=True)
+    nodes = [Node(meta=ObjectMeta(name="n0", labels={"zone": "a"}),
+                  allocatable={RK.CPU: 64000.0, RK.MEMORY: 65536.0})]
+    blocker = mk_pod("be-0", 5000, 1000.0)
+    blocker.meta.labels["app"] = "be"
+    preemptor = mk_pod("prod", 9500, 1000.0)  # resources trivially fit
+    preemptor.pod_affinity = [term]
+    got = find_preemption(preemptor, nodes, {"n0": [blocker]})
+    assert got is not None and got.node_name == "n0"
+    assert [v.meta.name for v in got.victims] == ["be-0"]

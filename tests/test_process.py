@@ -348,3 +348,50 @@ def test_trio_end_to_end_graceful_shutdown(tmp_path):
         assert not t.is_alive(), "process failed to shut down"
     assert mgr.ticks > 0 and desched.cycles > 0
     assert all(n.allocatable.get(RK.BATCH_CPU, 0) > 0 for n in nodes)
+
+
+def test_koordlet_kubelet_pull_flag(tmp_path):
+    """--kubelet-addr attaches the /pods pull edge; each tick resyncs
+    pods from the kubelet into the informer."""
+    import http.server
+    import json as _json
+    import threading
+
+    from koordinator_tpu.cmd import koordlet as cmd_koordlet
+    from koordinator_tpu.koordlet.testing import FakeHost
+
+    podlist = {"items": [{
+        "metadata": {"name": "w", "namespace": "d", "uid": "u1",
+                     "labels": {"koordinator.sh/qosClass": "LS"}},
+        "spec": {"priority": 9000, "nodeName": "n0", "containers":
+                 [{"resources": {"requests": {"cpu": "1"}}}]},
+        "status": {"phase": "Running"}}]}
+
+    class H(http.server.BaseHTTPRequestHandler):
+        def do_GET(self):
+            body = _json.dumps(podlist).encode()
+            self.send_response(200)
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *a):
+            pass
+
+    srv = http.server.HTTPServer(("127.0.0.1", 0), H)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    try:
+        tok = tmp_path / "token"
+        tok.write_text("secret")
+        host = FakeHost(str(tmp_path / "host"), num_cpus=4,
+                        mem_bytes=8 << 30)
+        daemon = cmd_koordlet.build(
+            ["--kubelet-addr", "127.0.0.1",
+             "--kubelet-port", str(srv.server_port),
+             "--kubelet-scheme", "http",
+             "--kubelet-token-file", str(tok)], host=host)
+        assert daemon.pods_puller is not None
+        daemon.tick(now=0.0)
+        pods = daemon.informer.get_all_pods()
+        assert len(pods) == 1 and pods[0].pod.meta.name == "w"
+    finally:
+        srv.shutdown()
