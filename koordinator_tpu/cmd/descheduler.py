@@ -18,6 +18,9 @@ from koordinator_tpu.cmd.runtime import (
     FileLeaseLock,
     LeaderElector,
     StopHandle,
+    add_metrics_flags,
+    attach_metrics_server,
+    close_metrics_server,
     default_identity,
     parse_feature_gates,
 )
@@ -46,6 +49,7 @@ class DeschedulerProcess:
                  gate: Optional[FeatureGate] = None,
                  clock: Callable[[], float] = time.time):
         self.cfg = cfg
+        self.metrics_server = None
         self.runner = runner
         self.get_nodes = get_nodes
         self.gate = gate or new_default_gate()
@@ -86,6 +90,7 @@ def build(argv: Optional[Sequence[str]] = None,
     p.add_argument("--descheduling-interval-seconds", type=float,
                    default=120.0)
     p.add_argument("--identity", default="")
+    add_metrics_flags(p)
     args = p.parse_args(argv)
     cfg = DeschedulerConfig(
         descheduling_interval_seconds=args.descheduling_interval_seconds,
@@ -96,7 +101,7 @@ def build(argv: Optional[Sequence[str]] = None,
     if runner is None or get_nodes is None:
         raise SystemExit("koord-descheduler needs a CycleRunner and a node "
                          "source; pass them via build(runner=, get_nodes=)")
-    return DeschedulerProcess(cfg, runner, get_nodes)
+    return attach_metrics_server(DeschedulerProcess(cfg, runner, get_nodes), args)
 
 
 def main(argv: Optional[Sequence[str]] = None,
@@ -104,5 +109,8 @@ def main(argv: Optional[Sequence[str]] = None,
          get_nodes: Optional[Callable[[], Sequence[api.Node]]] = None) -> int:
     proc = build(argv, runner, get_nodes)
     stop = StopHandle().install_signal_handlers()
-    proc.run(stop.stopped)
+    try:
+        proc.run(stop.stopped)
+    finally:
+        close_metrics_server(proc)
     return 0

@@ -10,7 +10,13 @@ from __future__ import annotations
 import argparse
 from typing import Optional, Sequence
 
-from koordinator_tpu.cmd.runtime import StopHandle, parse_feature_gates
+from koordinator_tpu.cmd.runtime import (
+    StopHandle,
+    add_metrics_flags,
+    attach_metrics_server,
+    close_metrics_server,
+    parse_feature_gates,
+)
 from koordinator_tpu.features import new_default_gate
 from koordinator_tpu.koordlet.agent import Daemon, DaemonConfig
 from koordinator_tpu.koordlet.system import Host
@@ -25,6 +31,7 @@ def build(argv: Optional[Sequence[str]] = None,
     p.add_argument("--report-interval-seconds", type=float, default=60.0)
     p.add_argument("--checkpoint-path", default="")
     p.add_argument("--audit-http-port", type=int, default=0)
+    add_metrics_flags(p)
     # kubelet /pods pull (kubelet_stub.go flags: --kubelet-* options);
     # empty address keeps the push edge (set_pods) in charge
     p.add_argument("--kubelet-addr", default="")
@@ -46,6 +53,7 @@ def build(argv: Optional[Sequence[str]] = None,
         audit_http_port=(args.audit_http_port
                          if gate.enabled("AuditEventsHTTPHandler") else -1))
     daemon = Daemon(host or Host(args.host_root), cfg)
+    attach_metrics_server(daemon, args)
     if args.kubelet_addr:
         from koordinator_tpu.koordlet.kubelet_stub import (
             KubeletStub,
@@ -69,5 +77,8 @@ def main(argv: Optional[Sequence[str]] = None,
          host: Optional[Host] = None) -> int:
     daemon = build(argv, host)
     stop = StopHandle().install_signal_handlers()
-    daemon.run(stop.stopped)
+    try:
+        daemon.run(stop.stopped)
+    finally:
+        close_metrics_server(daemon)
     return 0

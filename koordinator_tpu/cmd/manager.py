@@ -24,6 +24,9 @@ from koordinator_tpu.cmd.runtime import (
     FileLeaseLock,
     LeaderElector,
     StopHandle,
+    add_metrics_flags,
+    attach_metrics_server,
+    close_metrics_server,
     default_identity,
     parse_feature_gates,
 )
@@ -97,6 +100,7 @@ class ManagerProcess:
                  slo_config: Optional[SLOControllerConfig] = None,
                  clock: Callable[[], float] = time.time):
         self.cfg = cfg
+        self.metrics_server = None
         self.source = source
         self.sink = sink or InMemorySink()
         self.gate = gate or new_default_gate()
@@ -178,6 +182,7 @@ def build(argv: Optional[Sequence[str]] = None,
                    action="store_false")
     p.add_argument("--reconcile-interval-seconds", type=float, default=30.0)
     p.add_argument("--identity", default="")
+    add_metrics_flags(p)
     args = p.parse_args(argv)
     cfg = ManagerConfig(
         reconcile_interval_seconds=args.reconcile_interval_seconds,
@@ -188,7 +193,7 @@ def build(argv: Optional[Sequence[str]] = None,
     if source is None:
         raise SystemExit("koord-manager needs a cluster source (the edge "
                          "informer plane); pass one via build(source=...)")
-    return ManagerProcess(cfg, source, sink)
+    return attach_metrics_server(ManagerProcess(cfg, source, sink), args)
 
 
 def main(argv: Optional[Sequence[str]] = None,
@@ -196,5 +201,8 @@ def main(argv: Optional[Sequence[str]] = None,
          sink: Optional[ClusterSink] = None) -> int:
     proc = build(argv, source, sink)
     stop = StopHandle().install_signal_handlers()
-    proc.run(stop.stopped)
+    try:
+        proc.run(stop.stopped)
+    finally:
+        close_metrics_server(proc)
     return 0

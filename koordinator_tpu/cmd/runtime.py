@@ -192,3 +192,28 @@ def parse_feature_gates(gate: FeatureGate, spec: str) -> None:
 
 def default_identity() -> str:
     return f"{os.uname().nodename}_{os.getpid()}"
+
+
+def add_metrics_flags(parser) -> None:
+    """The shared Prometheus scrape-surface flags every daemon carries."""
+    # -1 disables the endpoint (metrics stay in-process)
+    parser.add_argument("--metrics-port", type=int, default=-1)
+    parser.add_argument("--metrics-host", default="0.0.0.0")
+
+
+def attach_metrics_server(proc, args):
+    """Start the /metrics endpoint on `proc.metrics_server` when the
+    flags enable it (every Process/Daemon declares the attribute)."""
+    if args.metrics_port >= 0:
+        from koordinator_tpu.metrics import global_registry
+        from koordinator_tpu.utils.httpserver import MetricsServer
+
+        proc.metrics_server = MetricsServer(global_registry(),
+                                            host=args.metrics_host,
+                                            port=args.metrics_port)
+    return proc
+
+
+def close_metrics_server(proc) -> None:
+    if proc.metrics_server is not None:
+        proc.metrics_server.close()

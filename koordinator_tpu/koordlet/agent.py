@@ -86,6 +86,8 @@ class Daemon:
         # optional kubelet /pods pull edge (cmd/koordlet --kubelet-addr);
         # None = pods arrive by push (set_pods)
         self.pods_puller = None
+        # optional /metrics endpoint (cmd/koordlet --metrics-port)
+        self.metrics_server = None
         if perf_reader is None and cfg.enable_perf_group:
             from koordinator_tpu.native import cycles_instructions_reader
             perf_reader = cycles_instructions_reader()

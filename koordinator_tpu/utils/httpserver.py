@@ -44,3 +44,29 @@ class BackgroundHTTPServer:
     def close(self) -> None:
         self._httpd.shutdown()
         self._httpd.server_close()
+
+
+class MetricsServer:
+    """A minimal /metrics exposition endpoint over a Registry — the
+    per-daemon Prometheus scrape surface (the reference's koordlet/
+    manager/descheduler each serve client_golang's promhttp handler)."""
+
+    def __init__(self, registry, host: str = "0.0.0.0", port: int = 0):
+        registry_ref = registry
+
+        class Handler(QuietJsonHandler):
+            def do_GET(self):
+                if self.path.startswith("/metrics"):
+                    self.reply_raw(200, "text/plain; version=0.0.4",
+                                   registry_ref.expose().encode("utf-8"))
+                    return
+                if self.path.startswith("/healthz"):
+                    self.reply_json(200, {"ok": True})
+                    return
+                self.reply_json(404, {"error": "not found"})
+
+        self._server = BackgroundHTTPServer(Handler, host, port)
+        self.port = self._server.port
+
+    def close(self) -> None:
+        self._server.close()

@@ -395,3 +395,58 @@ def test_koordlet_kubelet_pull_flag(tmp_path):
         assert len(pods) == 1 and pods[0].pod.meta.name == "w"
     finally:
         srv.shutdown()
+
+
+def test_koordlet_metrics_endpoint(tmp_path):
+    """--metrics-port serves the Prometheus scrape surface."""
+    import urllib.request
+
+    from koordinator_tpu.cmd import koordlet as cmd_koordlet
+    from koordinator_tpu.koordlet.testing import FakeHost
+
+    host = FakeHost(str(tmp_path), num_cpus=4, mem_bytes=8 << 30)
+    daemon = cmd_koordlet.build(["--metrics-port", "0"], host=host)
+    try:
+        assert daemon.metrics_server is not None
+        daemon.tick(now=0.0)
+        url = f"http://127.0.0.1:{daemon.metrics_server.port}/metrics"
+        with urllib.request.urlopen(url) as r:
+            body = r.read().decode()
+        assert "# TYPE" in body
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{daemon.metrics_server.port}/healthz") as r:
+            assert r.status == 200
+    finally:
+        daemon.metrics_server.close()
+
+
+def test_manager_and_descheduler_metrics_flag(tmp_path):
+    import urllib.request
+
+    from koordinator_tpu.cmd import descheduler as cmd_desched
+    from koordinator_tpu.cmd import manager as cmd_manager
+    from koordinator_tpu.snapshot import ClusterInformerHub
+
+    hub = ClusterInformerHub()
+    mgr = cmd_manager.build(["--lease-file", str(tmp_path / "m.lease"),
+                             "--metrics-port", "0"], source=hub)
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{mgr.metrics_server.port}/metrics") as r:
+            assert r.status == 200
+    finally:
+        mgr.metrics_server.close()
+
+    class Runner:
+        def run_once(self, now):
+            return None
+
+    d = cmd_desched.build(["--lease-file", str(tmp_path / "d.lease"),
+                           "--metrics-port", "0"],
+                          runner=Runner(), get_nodes=lambda: [])
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{d.metrics_server.port}/metrics") as r:
+            assert r.status == 200
+    finally:
+        d.metrics_server.close()
