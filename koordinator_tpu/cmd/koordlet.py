@@ -53,7 +53,6 @@ def build(argv: Optional[Sequence[str]] = None,
         audit_http_port=(args.audit_http_port
                          if gate.enabled("AuditEventsHTTPHandler") else -1))
     daemon = Daemon(host or Host(args.host_root), cfg)
-    attach_metrics_server(daemon, args)
     if args.kubelet_addr:
         from koordinator_tpu.koordlet.kubelet_stub import (
             KubeletStub,
@@ -70,7 +69,9 @@ def build(argv: Optional[Sequence[str]] = None,
                         insecure_tls=args.kubelet_insecure_tls),
             daemon.informer,
             resync_interval_seconds=args.kubelet_resync_seconds)
-    return daemon
+    # LAST: anything above may raise, and a half-built daemon must not
+    # leak a bound /metrics listener
+    return attach_metrics_server(daemon, args)
 
 
 def main(argv: Optional[Sequence[str]] = None,

@@ -143,18 +143,47 @@ class OracleQuota:
 
 
 class OracleScheduler:
-    """Sequential scheduler: fit + LoadAware + quota gate + gang rollback."""
+    """Sequential scheduler: fit + LoadAware + quota gate + gang rollback
+    + the vanilla topology gates (hard taints, hard spread, required
+    (anti-)affinity both directions) evaluated per pod in strict
+    sequence — the reference semantics the batched program must match at
+    chunk size 1."""
 
     def __init__(self, nodes: List[OracleNode], args: OracleArgs,
                  quotas: Optional[Dict[str, OracleQuota]] = None,
                  gang_min: Optional[Dict[str, int]] = None,
-                 gang_members: Optional[Dict[str, int]] = None):
+                 gang_members: Optional[Dict[str, int]] = None,
+                 running_pods: Optional[List[Tuple[Pod, int]]] = None):
         self.nodes = nodes
         self.args = args
         self.quotas = quotas or {}
         self.gang_min = gang_min or {}
         self.gang_members = gang_members or {}
         self.gang_placed: Dict[str, List[Tuple[int, int]]] = {}
+        # (pod, node index) of running + sequentially-assumed pods — the
+        # view the topology gates read
+        self.cluster_pods: List[Tuple[Pod, int]] = list(running_pods or [])
+
+    def _topology_ok(self, pod: Pod, node_idx: int) -> bool:
+        """ONE sequential reference implementation validates both the
+        device kernels (through this oracle) and the preemption
+        nominator: node_admits + constraints_admit from
+        scheduler/preemption.py ARE the sequential semantics."""
+        from koordinator_tpu.scheduler.preemption import (
+            constraints_admit,
+            node_admits,
+        )
+
+        node = self.nodes[node_idx].node
+        if not node_admits(pod, node):
+            return False
+        pods_by_node: Dict[str, List[Pod]] = {}
+        for p, ni in self.cluster_pods:
+            pods_by_node.setdefault(self.nodes[ni].node.meta.name,
+                                    []).append(p)
+        return constraints_admit(pod, node,
+                                 [on.node for on in self.nodes],
+                                 pods_by_node, frozenset())
 
     def _quota_chain(self, name: str) -> List[OracleQuota]:
         chain = []
@@ -190,6 +219,8 @@ class OracleScheduler:
                 continue
             if not oracle_filter(on, pod, self.args):
                 continue
+            if not self._topology_ok(pod, i):
+                continue
             s = oracle_score(on, pod, self.args)
             if s > best_score:
                 best_node, best_score = i, s
@@ -207,6 +238,7 @@ class OracleScheduler:
         if pod.gang_name:
             self.gang_placed.setdefault(pod.gang_name, []).append(
                 (pod_idx, best_node))
+        self.cluster_pods.append((pod, best_node))
         return best_node
 
     def schedule(self, pods: List[Pod]) -> np.ndarray:
@@ -222,6 +254,9 @@ class OracleScheduler:
             prior = 0
             if len(placed) + prior < self.gang_min.get(gang, 1):
                 for pod_idx, node_idx in placed:
+                    self.cluster_pods = [
+                        (p, n) for p, n in self.cluster_pods
+                        if p is not pods[pod_idx]]
                     on = self.nodes[node_idx]
                     pod = pods[pod_idx]
                     req = resource_vec(pod.requests)

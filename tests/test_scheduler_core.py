@@ -856,3 +856,143 @@ def test_single_domain_cap_still_gates():
     a = np.asarray(res.assignment)
     # one zone only -> exactly one member fits, the other stays pending
     assert (a >= 0).sum() == 1 and (a == -1).sum() == 1, a
+
+
+def test_chunk1_equivalence_with_topology_gates():
+    """Chunk-1 equivalence for the vanilla topology gates: feeding pods
+    one at a time through the batched kernel (rebuilding via the builder
+    so the domain counts carry) reproduces the sequential oracle with
+    taints, spread, and (anti-)affinity."""
+    from koordinator_tpu.api.types import (
+        PodAffinityTerm, Taint, Toleration, TopologySpreadConstraint,
+    )
+    from oracle import OracleArgs, OracleScheduler
+
+    zones = ["z0", "z0", "z1", "z1", "z2", "z2"]
+    taints = [[], [Taint(key="ded", value="x", effect="NoSchedule")],
+              [], [], [], []]
+
+    def make_nodes():
+        out = []
+        for i, z in enumerate(zones):
+            out.append(Node(meta=ObjectMeta(name=f"n{i}",
+                                            labels={"zone": z}),
+                            allocatable={RK.CPU: 8000.0 + i * 4000.0,
+                                         RK.MEMORY: 65536.0},
+                            taints=list(taints[i])))
+        return out
+
+    spread = TopologySpreadConstraint(max_skew=1, topology_key="zone",
+                                     label_selector={"app": "web"})
+    anti = PodAffinityTerm(topology_key="zone",
+                           label_selector={"app": "kv"}, anti=True)
+    tol = [Toleration(key="ded", value="x", effect="NoSchedule")]
+    pods = []
+    for j in range(12):
+        kind = j % 3
+        prio = 9000 + (12 - j) * 13    # distinct priorities: stable order
+        cpu = 700.0 + j * 31.0         # distinct requests: no score ties
+        if kind == 0:
+            pods.append(Pod(meta=ObjectMeta(name=f"w{j}", namespace="d",
+                                            labels={"app": "web"}),
+                            priority=prio, requests={RK.CPU: cpu},
+                            spread_constraints=[spread],
+                            tolerations=tol if j % 2 else []))
+        elif kind == 1:
+            pods.append(Pod(meta=ObjectMeta(name=f"k{j}", namespace="d",
+                                            labels={"app": "kv"}),
+                            priority=prio, requests={RK.CPU: cpu},
+                            pod_affinity=[anti]))
+        else:
+            pods.append(Pod(meta=ObjectMeta(name=f"p{j}", namespace="d",
+                                            labels={"app": "plain"}),
+                            priority=prio, requests={RK.CPU: cpu},
+                            tolerations=tol))
+
+    # oracle: sequential, priority order (state built the same way the
+    # existing golden tests do — through make_oracle_nodes)
+    ob = SnapshotBuilder(max_nodes=len(zones))
+    for n in make_nodes():
+        ob.add_node(n)
+        ob.set_node_metric(NodeMetric(node_name=n.meta.name,
+                                      update_time=NOW, node_usage={}))
+    oracle = OracleScheduler(make_oracle_nodes(ob, now=NOW),
+                             OracleArgs.default())
+    want = oracle.schedule(pods)
+
+    # device: one pod per batch in the same order, builder-rebuilt so
+    # assumed pods feed every count surface
+    order = sorted(range(len(pods)),
+                   key=lambda i: (-(pods[i].priority or 0), i))
+    assigned = []  # (pod, node_name)
+    got = np.full((len(pods),), -1, np.int64)
+    for i in order:
+        b = SnapshotBuilder(max_nodes=len(zones))
+        for n in make_nodes():
+            b.add_node(n)
+            b.set_node_metric(NodeMetric(node_name=n.meta.name,
+                                         update_time=NOW, node_usage={}))
+        for p, node_name in assigned:
+            b.add_assigned(p, node_name, timestamp=NOW)
+        snap, ctx = b.build(now=NOW)
+        res = core.schedule_batch(snap, b.build_pod_batch([pods[i]], ctx),
+                                  loadaware.LoadAwareConfig.make(),
+                                  num_rounds=2)
+        a = int(np.asarray(res.assignment)[0])
+        got[i] = a
+        if a >= 0:
+            assigned.append((pods[i], f"n{a}"))
+    np.testing.assert_array_equal(got, want)
+
+
+def test_chunk1_equivalence_with_running_pods():
+    """Regression: the oracle's running-pod seed and the builder's
+    running-pod ingest agree — an existing kv pod forbids its zone to
+    anti-affine members on both paths."""
+    from koordinator_tpu.api.types import PodAffinityTerm
+    from oracle import OracleArgs, OracleScheduler, make_oracle_nodes
+
+    anti = PodAffinityTerm(topology_key="zone",
+                           label_selector={"app": "kv"}, anti=True)
+
+    def make_nodes():
+        return [Node(meta=ObjectMeta(name=f"n{i}",
+                                     labels={"zone": f"z{i}"}),
+                     allocatable={RK.CPU: 8000.0 + i * 1000.0,
+                                  RK.MEMORY: 65536.0})
+                for i in range(3)]
+
+    running = Pod(meta=ObjectMeta(name="kv-old", namespace="d",
+                                  labels={"app": "kv"}),
+                  requests={RK.CPU: 500.0}, phase="Running",
+                  node_name="n2")
+    members = [Pod(meta=ObjectMeta(name=f"kv-{j}", namespace="d",
+                                   labels={"app": "kv"}),
+                   priority=9000 + j * 7,
+                   requests={RK.CPU: 600.0 + j * 11.0},
+                   pod_affinity=[anti]) for j in range(3)]
+
+    ob = SnapshotBuilder(max_nodes=3)
+    for n in make_nodes():
+        ob.add_node(n)
+        ob.set_node_metric(NodeMetric(node_name=n.meta.name,
+                                      update_time=NOW, node_usage={}))
+    ob.add_running_pod(running)
+    oracle = OracleScheduler(make_oracle_nodes(ob, now=NOW),
+                             OracleArgs.default(),
+                             running_pods=[(running, 2)])
+    want = oracle.schedule(members)
+
+    b = SnapshotBuilder(max_nodes=3)
+    for n in make_nodes():
+        b.add_node(n)
+        b.set_node_metric(NodeMetric(node_name=n.meta.name,
+                                     update_time=NOW, node_usage={}))
+    b.add_running_pod(running)
+    snap, ctx = b.build(now=NOW)
+    res = core.schedule_batch(snap, b.build_pod_batch(members, ctx),
+                              loadaware.LoadAwareConfig.make(),
+                              num_rounds=4)
+    got = np.asarray(res.assignment)
+    np.testing.assert_array_equal(np.sort(got), np.sort(np.asarray(want)))
+    assert (got != 2).all() and (np.asarray(want) != 2).all()
