@@ -45,9 +45,34 @@ def _snapshot_template() -> ClusterSnapshot:
 
 
 def _flat_template(cls):
-    """Restore target for a FLAT flax struct (every field an array)."""
+    """Restore target for a FLAT flax struct: array dummies for pytree
+    leaves only — STATIC (pytree_node=False) fields keep their defaults,
+    because flax to_bytes/from_bytes carries leaves, not aux data (the
+    gate flags ride the proto instead)."""
     return cls(**{f.name: jnp.zeros((1,), jnp.float32)
-                  for f in dataclasses.fields(cls)})
+                  for f in dataclasses.fields(cls)
+                  if f.metadata.get("pytree_node", True)})
+
+
+_GATE_FIELDS = ("has_taints", "has_spread", "has_anti", "has_aff")
+# drift guard: every static PodBatch field MUST ride the proto bits — a
+# new pytree_node=False gate silently resetting to its default across
+# the wire is the exact bug class the flags transport exists to fix.
+# (The tuple stays hand-ordered because bit positions are wire-stable.)
+assert set(_GATE_FIELDS) == {
+    f.name for f in dataclasses.fields(PodBatch)
+    if not f.metadata.get("pytree_node", True)
+}, "PodBatch static fields diverged from the sidecar gate-flag transport"
+
+
+def _pack_gate_flags(pods: PodBatch) -> int:
+    return sum(1 << i for i, f in enumerate(_GATE_FIELDS)
+               if getattr(pods, f))
+
+
+def _apply_gate_flags(pods: PodBatch, flags: int) -> PodBatch:
+    return pods.replace(**{f: bool(flags & (1 << i))
+                           for i, f in enumerate(_GATE_FIELDS)})
 
 
 class SchedulerSidecarServer:
@@ -84,8 +109,10 @@ class SchedulerSidecarServer:
         return pb.IngestDeltaResponse(version=self.service.ingest(delta))
 
     def _schedule(self, req: pb.ScheduleRequest) -> pb.ScheduleResponse:
-        pods = flax.serialization.from_bytes(_flat_template(PodBatch),
-                                             req.pods_msgpack)
+        pods = _apply_gate_flags(
+            flax.serialization.from_bytes(_flat_template(PodBatch),
+                                          req.pods_msgpack),
+            req.gate_flags)
         result = self.service.schedule(
             pods, pod_names=list(req.pod_names) or None)
         return pb.ScheduleResponse(
@@ -130,7 +157,8 @@ class SchedulerSidecarClient:
             "Schedule",
             pb.ScheduleRequest(
                 pods_msgpack=flax.serialization.to_bytes(pods),
-                pod_names=list(pod_names or [])),
+                pod_names=list(pod_names or []),
+                gate_flags=_pack_gate_flags(pods)),
             pb.ScheduleResponse)
         return {
             "assignment": np.asarray(resp.assignment, np.int32),

@@ -110,3 +110,33 @@ def test_wire_preserves_dtypes_and_shapes(tmp_path, cluster):
             np.testing.assert_array_equal(s, g)
     finally:
         server.close()
+
+
+def test_gate_flags_survive_the_wire(tmp_path):
+    """Regression: the STATIC gate switches (aux data, not msgpack
+    leaves) ride the proto — a taint-gated batch scheduled over the
+    socket must still reject untolerated nodes."""
+    from koordinator_tpu.api.types import Taint
+
+    service = SchedulerService()
+    sock = str(tmp_path / "s.sock")
+    server = SchedulerSidecarServer(service, sock)
+    try:
+        b = SnapshotBuilder(max_nodes=1)
+        b.add_node(api.Node(meta=api.ObjectMeta(name="n0"),
+                            allocatable={RK.CPU: 8000.0,
+                                         RK.MEMORY: 16384.0},
+                            taints=[Taint(key="x", effect="NoSchedule")]))
+        b.set_node_metric(api.NodeMetric(node_name="n0", update_time=1e9,
+                                         node_usage={}))
+        snap, ctx = b.build(now=1e9)
+        client = SchedulerSidecarClient(sock, timeout=120.0)
+        client.publish(snap)
+        batch = b.build_pod_batch(
+            [api.Pod(meta=api.ObjectMeta(name="p"), priority=9000,
+                     requests={RK.CPU: 100.0})], ctx)
+        assert batch.has_taints
+        out = client.schedule(batch)
+        assert int(out["assignment"][0]) == -1  # gate held over the wire
+    finally:
+        server.close()
