@@ -59,10 +59,14 @@ _GATE_FIELDS = ("has_taints", "has_spread", "has_anti", "has_aff")
 # new pytree_node=False gate silently resetting to its default across
 # the wire is the exact bug class the flags transport exists to fix.
 # (The tuple stays hand-ordered because bit positions are wire-stable.)
-assert set(_GATE_FIELDS) == {
-    f.name for f in dataclasses.fields(PodBatch)
-    if not f.metadata.get("pytree_node", True)
-}, "PodBatch static fields diverged from the sidecar gate-flag transport"
+if set(_GATE_FIELDS) != {
+        f.name for f in dataclasses.fields(PodBatch)
+        if not f.metadata.get("pytree_node", True)}:
+    # NOT an assert: it must fire under python -O too — a new static
+    # field silently resetting over the wire is the exact bug class the
+    # flags transport exists to fix
+    raise RuntimeError("PodBatch static fields diverged from the "
+                       "sidecar gate-flag transport")
 
 
 def _pack_gate_flags(pods: PodBatch) -> int:

@@ -19,6 +19,8 @@ import threading
 import time
 from typing import Callable, Dict, List, Optional, Sequence
 
+import numpy as np
+
 from koordinator_tpu.api import types as api
 from koordinator_tpu.snapshot.builder import SnapshotBuilder
 from koordinator_tpu.snapshot.store import SnapshotStore
@@ -235,6 +237,9 @@ class SnapshotSyncer:
         self._full_dirty = True
         self._dirty_metrics: set = set()
         self._lock = threading.Lock()
+        # guards the (store snapshot, builder indexes) pair for readers
+        # on other threads (the ServicesServer summary providers)
+        self._view_lock = threading.Lock()
         self.full_rebuilds = 0
         self.delta_ingests = 0
         for kind in (KIND_NODE, KIND_POD, KIND_RESERVATION, KIND_POD_GROUP,
@@ -279,6 +284,60 @@ class SnapshotSyncer:
             return "delta"
         return "noop"
 
+    def quota_summary(self) -> dict:
+        """The elastic-quota service payload (frameworkext services.go
+        quota summaries): per quota name, min / used / runtime from the
+        CURRENT device snapshot. Empty before the first sync."""
+        with self._view_lock:
+            if self.builder is None:
+                return {}
+            snap = self.store.current()
+            builder = self.builder
+        used = np.asarray(snap.quotas.used)
+        runtime = np.asarray(snap.quotas.runtime)
+        qmin = np.asarray(snap.quotas.min)
+        out = {}
+        for name, qi in builder.quota_index.items():
+            out[name] = {
+                "min": [float(v) for v in qmin[qi]],
+                "used": [float(v) for v in used[qi]],
+                "runtime": [None if not np.isfinite(v) else float(v)
+                            for v in runtime[qi]],
+            }
+        return out
+
+    def device_summary(self) -> dict:
+        """The deviceshare service payload: per node, the aggregate GPU
+        capacity (per-instance totals x instance count) and each
+        instance's remaining free."""
+        from koordinator_tpu.snapshot.schema import DEV_CORE, DEV_MEM
+
+        with self._view_lock:
+            if self.builder is None:
+                return {}
+            snap = self.store.current()
+            builder = self.builder
+        gpu_free = np.asarray(snap.devices.gpu_free)
+        gpu_total = np.asarray(snap.devices.gpu_total)
+        gpu_valid = np.asarray(snap.devices.gpu_valid)
+        out = {}
+        for name, ni in builder.node_index.items():
+            count = int(gpu_valid[ni].sum())
+            if count == 0:
+                continue
+            out[name] = {
+                "gpuTotal": {
+                    "count": count,
+                    "core": float(gpu_total[ni, DEV_CORE]) * count,
+                    "memoryMiB": float(gpu_total[ni, DEV_MEM]) * count},
+                "instances": [
+                    {"minor": int(m),
+                     "coreFree": float(gpu_free[ni, m, DEV_CORE]),
+                     "memoryFreeMiB": float(gpu_free[ni, m, DEV_MEM])}
+                    for m in np.nonzero(gpu_valid[ni])[0]],
+            }
+        return out
+
     def _rebuild(self, now: float) -> None:
         state = self.hub.read_all()  # one consistent version
         b = SnapshotBuilder(max_nodes=self.max_nodes, **self.builder_caps)
@@ -299,6 +358,10 @@ class SnapshotSyncer:
         for d in state["devices"]:
             b.add_device(d)
         snap, ctx = b.build(now=now)
-        self.store.publish(snap)
-        self.builder, self.ctx = b, ctx
+        # the (snapshot, builder) PAIR swaps atomically under the view
+        # lock: a summary request racing the swap must never index the
+        # new arrays with the old builder's name->row mapping
+        with self._view_lock:
+            self.store.publish(snap)
+            self.builder, self.ctx = b, ctx
         self.full_rebuilds += 1

@@ -137,3 +137,86 @@ def test_hub_is_a_manager_cluster_source(tmp_path):
     node = hub.nodes()[0]
     assert node.allocatable.get(RK.BATCH_CPU, 0) > 0
     assert "root" in proc.quota_reconciler.quotas
+
+
+def test_quota_summary_service_payload():
+    """The elastic-quota service payload from the live snapshot
+    (frameworkext services: /apis/v1/plugins/elasticquota)."""
+    import urllib.request
+
+    from koordinator_tpu.api.extension import ResourceKind as RK
+    from koordinator_tpu.api.types import (
+        ElasticQuota, Node, NodeMetric, ObjectMeta,
+    )
+    from koordinator_tpu.scheduler.frameworkext import (
+        DebugFlags,
+        ServiceRegistry,
+        ServicesServer,
+    )
+    from koordinator_tpu.snapshot import (
+        ClusterInformerHub,
+        SnapshotStore,
+        SnapshotSyncer,
+    )
+
+    hub = ClusterInformerHub()
+    hub.upsert_node(Node(meta=ObjectMeta(name="n0"),
+                         allocatable={RK.CPU: 8000.0,
+                                      RK.MEMORY: 16384.0}))
+    hub.set_node_metric(NodeMetric(node_name="n0", update_time=1e9,
+                                   node_usage={}))
+    q = ElasticQuota(meta=ObjectMeta(name="team-a"))
+    q.min = {RK.CPU: 2000.0}
+    q.max = {RK.CPU: 4000.0}
+    hub.upsert_quota(q)
+    store = SnapshotStore()
+    syncer = SnapshotSyncer(hub, store, max_nodes=1)
+    syncer.sync(now=1e9)
+    summary = syncer.quota_summary()
+    assert "team-a" in summary
+    assert summary["team-a"]["min"][int(RK.CPU)] == 2000.0
+    # and it plugs into the services engine like any provider
+    registry = ServiceRegistry()
+    registry.register("elasticquota", syncer.quota_summary)
+    server = ServicesServer(registry, DebugFlags())
+    try:
+        url = (f"http://127.0.0.1:{server.port}"
+               f"/apis/v1/plugins/elasticquota")
+        with urllib.request.urlopen(url) as r:
+            import json as _json
+            body = _json.load(r)
+        assert body["team-a"]["min"][int(RK.CPU)] == 2000.0
+    finally:
+        server.close()
+
+
+def test_device_summary_service_payload():
+    from koordinator_tpu.api.extension import ResourceKind as RK
+    from koordinator_tpu.api.types import (
+        Device, DeviceInfo, Node, NodeMetric, ObjectMeta,
+    )
+    from koordinator_tpu.snapshot import (
+        ClusterInformerHub,
+        SnapshotStore,
+        SnapshotSyncer,
+    )
+
+    hub = ClusterInformerHub()
+    hub.upsert_node(Node(meta=ObjectMeta(name="g0"),
+                         allocatable={RK.CPU: 8000.0,
+                                      RK.MEMORY: 16384.0}))
+    hub.set_node_metric(NodeMetric(node_name="g0", update_time=1e9,
+                                   node_usage={}))
+    hub.set_device(Device(node_name="g0", devices=[
+        DeviceInfo(minor=m, type="gpu",
+                   resources={RK.GPU_CORE: 100.0,
+                              RK.GPU_MEMORY: 16000.0})
+        for m in range(2)]))
+    store = SnapshotStore()
+    syncer = SnapshotSyncer(hub, store, max_nodes=1, max_gpu_inst=2)
+    syncer.sync(now=1e9)
+    summary = syncer.device_summary()
+    assert summary["g0"]["gpuTotal"]["memoryMiB"] == 32000.0  # 2 x 16000
+    assert summary["g0"]["gpuTotal"]["count"] == 2
+    assert len(summary["g0"]["instances"]) == 2
+    assert summary["g0"]["instances"][0]["coreFree"] == 100.0
