@@ -26,15 +26,6 @@ import sys
 import time
 
 import jax
-
-# honor an explicit JAX_PLATFORMS (the hermetic test harness sets cpu);
-# site config can pin jax_platforms to the TPU tunnel, which silently
-# overrides the env var and sends subprocess smoke runs through slow
-# remote compiles
-_plat = os.environ.get("JAX_PLATFORMS")
-if _plat:
-    jax.config.update("jax_platforms", _plat)
-
 import jax.numpy as jnp
 import numpy as np
 
@@ -45,6 +36,44 @@ NUM_PODS = int(os.environ.get("BENCH_PODS", 100_000))
 CHUNK = int(os.environ.get("BENCH_CHUNK", 2_000))
 TAIL_PASSES = 2     # each retries up to CHUNK leftovers with a wider search
 BASELINE_SECONDS = 2.0
+
+
+def ensure_platform(probe_timeout: float = None) -> None:
+    """Honor JAX_PLATFORMS and guard non-cpu targets with a subprocess
+    probe (hard timeout): a wedged TPU tunnel hangs even trivial
+    compiles at 0% CPU (observed 2026-07-30, a multi-hour outage), and a
+    bench that hangs forever records nothing — on probe failure fall
+    back to CPU and SAY so. An explicit helper, not an import side
+    effect: callers pay the probe only when they run a bench."""
+    plat = os.environ.get("JAX_PLATFORMS")
+    if plat:
+        jax.config.update("jax_platforms", plat)
+    if plat == "cpu":
+        return
+    import subprocess
+
+    if probe_timeout is None:
+        probe_timeout = float(os.environ.get("BENCH_PROBE_TIMEOUT", "180"))
+    ok = True
+    try:
+        # DEVNULL, not pipes: the platform plugin can spawn a tunnel
+        # grandchild that would keep captured pipes open after the
+        # timeout kill, wedging run() in communicate() forever
+        probe = subprocess.run(
+            [sys.executable, "-c",
+             "import jax, jax.numpy as jnp;"
+             "jax.jit(lambda a: (a @ a.T).sum())(jnp.ones((64, 8)))"
+             ".block_until_ready()"],
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+            timeout=probe_timeout)
+        ok = probe.returncode == 0
+    except subprocess.TimeoutExpired:
+        ok = False
+    if not ok:
+        print("bench: WARNING: platform probe failed; falling back to "
+              "CPU — the recorded number is NOT a TPU result",
+              file=sys.stderr)
+        jax.config.update("jax_platforms", "cpu")
 
 
 def main():
@@ -190,9 +219,11 @@ def main():
         "never_retried": never_retried,
         "tail_retry_capacity": retry_capacity,
         "devices": len(jax.devices()),
+        "platform": jax.devices()[0].platform,
     }
     print(json.dumps(result))
 
 
 if __name__ == "__main__":
+    ensure_platform()
     main()

@@ -195,4 +195,7 @@ def main():
 
 
 if __name__ == "__main__":
+    import bench
+
+    bench.ensure_platform()
     main()

@@ -284,6 +284,15 @@ class SnapshotSyncer:
             return "delta"
         return "noop"
 
+    def register_services(self, registry) -> None:
+        """Register the syncer-backed service payloads on a frameworkext
+        ServiceRegistry — the production wiring for the
+        /apis/v1/plugins/{elasticquota,deviceshare} endpoints (embedded
+        deployments compose hub + syncer + SchedulerService in one
+        process; the sidecar edge serves its own summaries)."""
+        registry.register("elasticquota", self.quota_summary)
+        registry.register("deviceshare", self.device_summary)
+
     def quota_summary(self) -> dict:
         """The elastic-quota service payload (frameworkext services.go
         quota summaries): per quota name, min / used / runtime from the

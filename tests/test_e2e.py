@@ -278,6 +278,8 @@ def test_full_loop_agent_to_scheduled_pod(tmp_path):
     syncer = SnapshotSyncer(hub, store, max_nodes=2)
     assert syncer.sync(now=now + 15) == "full"
     service = SchedulerService(store=store)
+    syncer.register_services(service.registry)
+    assert "elasticquota" in service.registry.names()
     be = api.Pod(meta=api.ObjectMeta(name="spark-0"), qos_label="BE",
                  priority=5500,
                  requests={RK.BATCH_CPU: 1000.0, RK.BATCH_MEMORY: 512.0})
