@@ -61,7 +61,12 @@ def ensure_platform(probe_timeout: float = None) -> None:
         # timeout kill, wedging run() in communicate() forever
         probe = subprocess.run(
             [sys.executable, "-c",
-             "import jax, jax.numpy as jnp;"
+             # the child must pin the SAME platform the parent will run
+             # on (site config silently overrides the env var otherwise)
+             "import os, jax;"
+             "p = os.environ.get('JAX_PLATFORMS');"
+             "p and jax.config.update('jax_platforms', p);"
+             "import jax.numpy as jnp;"
              "jax.jit(lambda a: (a @ a.T).sum())(jnp.ones((64, 8)))"
              ".block_until_ready()"],
             stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
