@@ -407,17 +407,35 @@ def schedule_batch(snap: ClusterSnapshot, pods: PodBatch,
             counts = spread_counts_flat(placed).reshape(n_sg, n_dom)
             min_c = jnp.min(jnp.where(pods.spread_dvalid, counts,
                                       jnp.inf), axis=1)             # [Sg]
+            # no eligible domain -> minimum 0 (the sequential reference
+            # in preemption.constraints_admit uses default=0, keeping a
+            # hard group with unreachable domains RESTRICTIVE, not open)
+            min_c = jnp.where(jnp.isfinite(min_c), min_c, 0.0)
             cdom = spread_domain_x[sid]                          # [P, N+V]
             ccount = jnp.take_along_axis(counts[sid],
                                          jnp.maximum(cdom, 0), axis=1)
+            # SOFT groups (ScheduleAnyway) carry skew = inf from the
+            # builder; they never filter — keyless nodes included
+            soft_g = ~jnp.isfinite(pods.spread_max_skew)         # [Sg]
             spread_ok = (cdom >= 0) & \
                 (ccount + 1.0 - min_c[sid][:, None]
                  <= pods.spread_max_skew[sid][:, None] + EPS)
-            feasible &= (pods.spread_id < 0)[:, None] | spread_ok
+            feasible &= ((pods.spread_id < 0)[:, None]
+                         | soft_g[sid][:, None] | spread_ok)
+            # preference (upstream spread Score): emptier domains rank
+            # higher for BOTH hard and soft spread pods
+            # normalize PER GROUP (a crowded unrelated group must not
+            # flatten another group's preference; the oracle mirrors)
+            group_max = jnp.max(counts, axis=1)[sid][:, None]    # [P, 1]
+            spread_penalty = jnp.where(
+                (pods.spread_id >= 0)[:, None] & (cdom >= 0),
+                ccount / jnp.maximum(group_max, 1.0)
+                * MAX_NODE_SCORE, 0.0)
             # per-round domain cap for the inner prefix gate: a domain
             # holds at most skew + min_round pods (min rises between
-            # rounds, releasing more) — without it one round piles the
-            # whole batch into the currently emptiest domain
+            # rounds, releasing more; inf for SOFT groups = uncapped) —
+            # without it one round piles the whole batch into the
+            # currently emptiest domain
             spread_limit = jnp.broadcast_to(
                 (pods.spread_max_skew + min_c)[:, None],
                 (n_sg, n_dom)).reshape(-1, 1)             # [Sg*D, 1]
@@ -484,6 +502,11 @@ def schedule_batch(snap: ClusterSnapshot, pods: PodBatch,
             # the clamp keeps penalized-but-feasible nodes above the
             # infeasible sentinel (-1.0) and the inner 'trying' threshold
             scores = jnp.maximum(scores - taint_penalty, 0.0)
+        if use_spread:
+            # real-node columns only: slot columns carry their fixed
+            # owner preference above any node score
+            scores = jnp.maximum(scores - spread_penalty[:, :n_nodes],
+                                 0.0)
         if n_slots:
             # slot columns outscore any node sum: owners strictly prefer
             # their reservation (nominator preference); safe because slot-

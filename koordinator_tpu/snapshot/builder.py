@@ -916,10 +916,14 @@ class SnapshotBuilder:
                     entry = (len(tol_sets), list(pod.tolerations))
                     tol_sets[tkey] = entry
                 tol_id[i] = entry[0]
-            # the first HARD spread constraint is modeled on device
-            # (ScheduleAnyway is a soft preference the ranking subsumes)
+            # the first HARD spread constraint gates on device; a pod
+            # with only ScheduleAnyway constraints joins as a SOFT group
+            # (dvalid all-False makes the skew gate vacuous; the score
+            # penalty still prefers emptier domains, upstream's scoring)
             hard = next((c for c in pod.spread_constraints
                          if c.when_unsatisfiable == "DoNotSchedule"), None)
+            if hard is None:
+                hard = next(iter(pod.spread_constraints), None)
             if hard is not None:
                 # the group key includes the pod's own node constraints:
                 # domain eligibility (which domains count toward the
@@ -927,7 +931,7 @@ class SnapshotBuilder:
                 # (upstream nodeAffinityPolicy=Honor), so pods with
                 # different selectors must not share a group
                 skey = (pod.meta.namespace, hard.topology_key,
-                        hard.max_skew,
+                        hard.max_skew, hard.when_unsatisfiable,
                         tuple(sorted(hard.label_selector.items())),
                         tuple(sorted(pod.node_selector.items())),
                         tuple((r.key, r.operator, tuple(r.values))
@@ -1019,22 +1023,34 @@ class SnapshotBuilder:
             spread_member = np.zeros((p, sg_cap), bool)
             for (row, c, proto) in spread_groups.values():
                 ns = proto.meta.namespace
-                spread_max_skew[row] = float(c.max_skew)
+                # SOFT groups carry skew = inf: the device derives
+                # softness from non-finite skew (never from dvalid — a
+                # hard group whose domains are all unreachable must stay
+                # hard)
+                spread_max_skew[row] = (
+                    float(c.max_skew)
+                    if c.when_unsatisfiable == "DoNotSchedule"
+                    else np.inf)
                 self._fill_domain_map(c.topology_key, row, spread_domain)
-                for ni, node in enumerate(self.nodes):
-                    if spread_domain[row, ni] < 0:
-                        continue
-                    # a domain counts toward the skew minimum only when
-                    # the group's pods can actually reach a node in it
-                    # (upstream nodeAffinityPolicy=Honor: unreachable
-                    # domains never pin the minimum at zero)
-                    reachable = (
-                        all(node.meta.labels.get(k) == v
-                            for k, v in proto.node_selector.items())
-                        and all(r.matches(node.meta.labels)
-                                for r in proto.node_affinity))
-                    if reachable:
-                        spread_dvalid[row, spread_domain[row, ni]] = True
+                if c.when_unsatisfiable == "DoNotSchedule":
+                    for ni, node in enumerate(self.nodes):
+                        if spread_domain[row, ni] < 0:
+                            continue
+                        # a domain counts toward the skew minimum only
+                        # when the group's pods can actually reach a node
+                        # in it (upstream nodeAffinityPolicy=Honor:
+                        # unreachable domains never pin the minimum)
+                        reachable = (
+                            all(node.meta.labels.get(k) == v
+                                for k, v in proto.node_selector.items())
+                            and all(r.matches(node.meta.labels)
+                                    for r in proto.node_affinity))
+                        if reachable:
+                            spread_dvalid[row,
+                                          spread_domain[row, ni]] = True
+                # else: SOFT group — dvalid stays all-False, making the
+                # skew gate vacuous (min over no domains = inf); only
+                # the score preference applies
                 self._count_matching(ns, c.label_selector, row,
                                      spread_domain, spread_count0)
                 for i, pod in enumerate(pods):

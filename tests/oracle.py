@@ -185,6 +185,28 @@ class OracleScheduler:
                                  [on.node for on in self.nodes],
                                  pods_by_node, frozenset())
 
+    def _spread_counts(self, pod: Pod):
+        """(constraint, per-domain counts, max count) for the pod's
+        modeled constraint — computed ONCE per pod; the per-node penalty
+        just looks the node's domain up. Mirrors core.py spread_penalty
+        (per-group normalization)."""
+        if not pod.spread_constraints:
+            return None
+        c = next((c for c in pod.spread_constraints
+                  if c.when_unsatisfiable == "DoNotSchedule"),
+                 pod.spread_constraints[0])
+        counts: Dict[str, int] = {}
+        for n in self.nodes:
+            d = n.node.meta.labels.get(c.topology_key)
+            if d is not None:
+                counts.setdefault(d, 0)
+        for p, ni in self.cluster_pods:
+            d = self.nodes[ni].node.meta.labels.get(c.topology_key)
+            if d is not None and _matches(p, pod.meta.namespace,
+                                          c.label_selector):
+                counts[d] = counts.get(d, 0) + 1
+        return c, counts, max(counts.values(), default=0)
+
     def _quota_chain(self, name: str) -> List[OracleQuota]:
         chain = []
         while name:
@@ -208,6 +230,7 @@ class OracleScheduler:
             if np.any(q.used + req > q.runtime + 0.5):
                 return -1
         best_node, best_score = -1, -1.0
+        spread_info = self._spread_counts(pod)
         for i, on in enumerate(self.nodes):
             if on.node.unschedulable:
                 continue
@@ -222,6 +245,12 @@ class OracleScheduler:
             if not self._topology_ok(pod, i):
                 continue
             s = oracle_score(on, pod, self.args)
+            if spread_info is not None:
+                c, counts, max_c = spread_info
+                dom = on.node.meta.labels.get(c.topology_key)
+                if dom is not None:
+                    s = max(s - counts.get(dom, 0) / max(max_c, 1.0)
+                            * 100.0, 0.0)
             if s > best_score:
                 best_node, best_score = i, s
         if best_node < 0:
@@ -270,6 +299,12 @@ class OracleScheduler:
                         q.used = q.used - req
                     out[pod_idx] = -1
         return out
+
+
+def _matches(p: Pod, ns: str, selector) -> bool:
+    """One selector matcher (the builder's semantics)."""
+    from koordinator_tpu.snapshot.builder import SnapshotBuilder
+    return SnapshotBuilder._matches(p, ns, selector)
 
 
 def make_oracle_nodes(builder, now: Optional[float] = None) -> List[OracleNode]:

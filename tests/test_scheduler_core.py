@@ -996,3 +996,52 @@ def test_chunk1_equivalence_with_running_pods():
     got = np.asarray(res.assignment)
     np.testing.assert_array_equal(np.sort(got), np.sort(np.asarray(want)))
     assert (got != 2).all() and (np.asarray(want) != 2).all()
+
+
+def test_schedule_anyway_spread_scores_without_filtering():
+    """ScheduleAnyway constraints never filter (keyless nodes included)
+    but prefer emptier domains; contrast with DoNotSchedule."""
+    from koordinator_tpu.api.types import TopologySpreadConstraint as TSC
+
+    def cluster():
+        b = SnapshotBuilder(max_nodes=3)
+        for i, zone in enumerate(("z1", "z1", None)):
+            labels = {"zone": zone} if zone else {}
+            b.add_node(Node(meta=ObjectMeta(name=f"n{i}", labels=labels),
+                            allocatable={RK.CPU: 64000,
+                                         RK.MEMORY: 65536}))
+            b.set_node_metric(NodeMetric(node_name=f"n{i}",
+                                         update_time=NOW, node_usage={}))
+        return b
+
+    soft = TSC(max_skew=1, topology_key="zone",
+               when_unsatisfiable="ScheduleAnyway",
+               label_selector={"app": "web"})
+    members = [Pod(meta=ObjectMeta(name=f"w{j}", namespace="d",
+                                   labels={"app": "web"}),
+                   priority=9000, requests={RK.CPU: 100.0},
+                   spread_constraints=[soft]) for j in range(4)]
+    b = cluster()
+    snap, ctx = b.build(now=NOW)
+    batch = b.build_pod_batch(members, ctx)
+    assert batch.has_spread
+    res = core.schedule_batch(snap, batch,
+                              loadaware.LoadAwareConfig.make(),
+                              num_rounds=5)
+    a = np.asarray(res.assignment)
+    # soft: ALL place (even though hard skew over one z1 domain would
+    # strand some, and the keyless node stays usable)
+    assert (a >= 0).all(), a
+
+    # the preference still pushes members toward the emptier domain:
+    # seed one member in z1 and one chunk-1 member must not pile on
+    b2 = cluster()
+    b2.add_running_pod(Pod(meta=ObjectMeta(name="r", namespace="d",
+                                           labels={"app": "web"}),
+                           requests={RK.CPU: 100.0}, phase="Running",
+                           node_name="n0"))
+    snap2, ctx2 = b2.build(now=NOW)
+    one = b2.build_pod_batch([members[0]], ctx2)
+    res2 = core.schedule_batch(snap2, one,
+                               loadaware.LoadAwareConfig.make())
+    assert int(np.asarray(res2.assignment)[0]) == 2  # keyless = empty
