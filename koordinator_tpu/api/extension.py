@@ -184,6 +184,23 @@ LABEL_MEMORY_RECLAIM_RATIO = NODE_DOMAIN_PREFIX + "/memory-reclaim-ratio"
 ANNOTATION_NODE_RAW_ALLOCATABLE = NODE_DOMAIN_PREFIX + "/raw-allocatable"
 ANNOTATION_NODE_AMPLIFICATION_RATIOS = (
     NODE_DOMAIN_PREFIX + "/resource-amplification-ratio")
+
+
+def node_cpu_amplification_ratio(annotations: Mapping[str, str]) -> float:
+    """The node's published CPU amplification ratio, clamped to >= 1
+    (nodenumaresource util.go:65-85). THE one parser for the annotation
+    — snapshot builder and host preemption must agree. Lenient on
+    malformed values: the validating webhook already rejected those, so
+    a bad value reaching here means an out-of-band writer; degrade to
+    raw accounting rather than fail ingest."""
+    import json
+    raw = (annotations or {}).get(ANNOTATION_NODE_AMPLIFICATION_RATIOS, "")
+    if not raw:
+        return 1.0
+    try:
+        return max(float(json.loads(raw).get("cpu", 1.0)), 1.0)
+    except (ValueError, TypeError, AttributeError):
+        return 1.0
 ANNOTATION_NODE_RESERVATION = NODE_DOMAIN_PREFIX + "/reservation"
 LABEL_NUMA_TOPOLOGY_POLICY = NODE_DOMAIN_PREFIX + "/numa-topology-policy"
 

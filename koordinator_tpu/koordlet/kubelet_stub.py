@@ -74,37 +74,87 @@ def _resource_list(d: Optional[dict]) -> dict:
     return out
 
 
+def _container_resources(c: dict) -> tuple:
+    """One container spec -> (requests, limits, gpu_ratio) in native
+    units. Extended GPU resources: requests default to limits when only
+    the limits block is authored (k8s defaulting) — BOTH the core and
+    the memory-ratio halves, never just one."""
+    gpu_core_kind = RESOURCE_NAMES["koordinator.sh/gpu-core"]
+    res = c.get("resources", {})
+    raw_req, pct_req = normalize_gpu_request(
+        res.get("requests") or {}, parse=_parse_quantity)
+    raw_lim, pct_lim = normalize_gpu_request(
+        res.get("limits") or {}, parse=_parse_quantity)
+    pct_eff = pct_req if pct_req > 0 else pct_lim
+    req = _resource_list(raw_req)
+    lim = _resource_list(raw_lim)
+    if pct_eff > 0:
+        req[gpu_core_kind] = req.get(gpu_core_kind, 0.0) + pct_eff
+    if pct_lim > 0:
+        lim[gpu_core_kind] = lim.get(gpu_core_kind, 0.0) + pct_lim
+    return req, lim, pct_eff
+
+
 def pod_from_manifest(item: dict) -> api.Pod:
     """One PodList item -> typed Pod (container requests/limits summed to
-    pod granularity, the shape the batched layers use)."""
+    pod granularity, the shape the batched layers use). The pod-level
+    footprint follows k8s effective-request rules: regular init
+    containers run sequentially BEFORE the main set (each one's peak is
+    its own request plus any sidecars already started); sidecars
+    (initContainers with restartPolicy: Always) keep running alongside
+    the main set and SUM with it; the pod charges
+    max(sum(containers)+sum(sidecars), each init peak). spec.overhead
+    adds to requests always, and to limits only where a limit already
+    exists (kubelet never fabricates a limit for an unlimited pod)."""
     meta = item.get("metadata", {})
     spec = item.get("spec", {})
     status = item.get("status", {})
     requests: dict = {}
     limits: dict = {}
     gpu_ratio = 0.0
-    gpu_core_kind = RESOURCE_NAMES["koordinator.sh/gpu-core"]
     for c in spec.get("containers", []):
-        res = c.get("resources", {})
-        raw_req, pct_req = normalize_gpu_request(
-            res.get("requests") or {}, parse=_parse_quantity)
-        raw_lim, pct_lim = normalize_gpu_request(
-            res.get("limits") or {}, parse=_parse_quantity)
-        # extended resources: requests default to limits when only the
-        # limits block is authored (k8s defaulting) — BOTH the core and
-        # the memory-ratio halves, never just one
-        pct_eff = pct_req if pct_req > 0 else pct_lim
-        gpu_ratio += pct_eff
-        for k, v in _resource_list(raw_req).items():
+        req, lim, pct = _container_resources(c)
+        gpu_ratio += pct
+        for k, v in req.items():
             requests[k] = requests.get(k, 0.0) + v
-        if pct_eff > 0:
-            requests[gpu_core_kind] = \
-                requests.get(gpu_core_kind, 0.0) + pct_eff
-        for k, v in _resource_list(raw_lim).items():
+        for k, v in lim.items():
             limits[k] = limits.get(k, 0.0) + v
-        if pct_lim > 0:
-            limits[gpu_core_kind] = \
-                limits.get(gpu_core_kind, 0.0) + pct_lim
+    side_req: dict = {}
+    side_lim: dict = {}
+    side_pct = 0.0
+    init_req_peak: dict = {}
+    init_lim_peak: dict = {}
+    init_pct_peak = 0.0
+    for c in spec.get("initContainers", []):
+        req, lim, pct = _container_resources(c)
+        if c.get("restartPolicy") == "Always":  # native sidecar
+            for k, v in req.items():
+                side_req[k] = side_req.get(k, 0.0) + v
+            for k, v in lim.items():
+                side_lim[k] = side_lim.get(k, 0.0) + v
+            side_pct += pct
+        else:
+            for k, v in req.items():
+                init_req_peak[k] = max(init_req_peak.get(k, 0.0),
+                                       v + side_req.get(k, 0.0))
+            for k, v in lim.items():
+                init_lim_peak[k] = max(init_lim_peak.get(k, 0.0),
+                                       v + side_lim.get(k, 0.0))
+            init_pct_peak = max(init_pct_peak, pct + side_pct)
+    for k, v in side_req.items():
+        requests[k] = requests.get(k, 0.0) + v
+    for k, v in side_lim.items():
+        limits[k] = limits.get(k, 0.0) + v
+    gpu_ratio += side_pct
+    for k, v in init_req_peak.items():
+        requests[k] = max(requests.get(k, 0.0), v)
+    for k, v in init_lim_peak.items():
+        limits[k] = max(limits.get(k, 0.0), v)
+    gpu_ratio = max(gpu_ratio, init_pct_peak)
+    for k, v in _resource_list(spec.get("overhead") or {}).items():
+        requests[k] = requests.get(k, 0.0) + v
+        if limits.get(k, 0.0) > 0:
+            limits[k] += v
     labels = dict(meta.get("labels") or {})
     return api.Pod(
         meta=api.ObjectMeta(name=meta.get("name", ""),

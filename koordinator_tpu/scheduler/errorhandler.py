@@ -97,8 +97,11 @@ def make_preemption_post_filter(
     def post(pod_info: QueuedPodInfo, err: SchedulingError) -> bool:
         pod = pod_info.pod
         # infrastructure errors retry as-is — never evict for them
-        # (upstream's PostFilter runs only for Unschedulable status)
-        if not err.unschedulable or not pod.priority:
+        # (upstream's PostFilter runs only for Unschedulable status).
+        # A priority of 0 is a legitimate preemptor against negative
+        # (e.g. BE) victims — only a pod with NO priority at all skips;
+        # select_victims_on_node's `< prio` comparison does the rest.
+        if not err.unschedulable or pod.priority is None:
             return False
         nomination = find_preemption(pod, get_nodes(),
                                      get_pods_by_node())

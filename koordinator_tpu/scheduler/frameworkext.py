@@ -369,13 +369,6 @@ class SchedulerService:
         """Returns the published version, read under the commit lock so a
         concurrent mutator cannot be misattributed."""
         with self._commit_lock:
-            # amplified-CPU auto-detection: a snapshot carrying any node
-            # ratio > 1 turns the amplified gates on (an explicit
-            # enable_amplification kwarg from the constructor wins)
-            if not self._explicit_amp:
-                self.schedule_kwargs["enable_amplification"] = bool(
-                    np.asarray(snapshot.nodes.cpu_amplification > 1.0)
-                    .any())
             self.store.publish(snapshot)
             self.last_committed_version = self.store.version
             return self.last_committed_version
@@ -399,6 +392,16 @@ class SchedulerService:
         token = self.monitor.start_cycle()
         with self._commit_lock:
             snap = self.store.current()
+            # amplified-CPU auto-detection happens on the snapshot the
+            # batch actually runs against (an explicit
+            # enable_amplification kwarg from the constructor wins).
+            # Deriving here rather than at publish time keeps the flag
+            # correct for writers that bypass service.publish() and put
+            # snapshots straight into the shared SnapshotStore
+            # (SnapshotSyncer._rebuild, embedded compositions).
+            if not self._explicit_amp:
+                self.schedule_kwargs["enable_amplification"] = bool(
+                    np.asarray(snap.nodes.cpu_amplification > 1.0).any())
             with kernel_timer(self.metrics.kernel_seconds,
                               "koord/schedule_batch"):
                 result = core.schedule_batch(snap, pods, self.cfg,

@@ -141,13 +141,18 @@ def select_victims_on_node(preemptor: api.Pod,
                            node_allocatable: np.ndarray,
                            pods_on_node: Sequence[api.Pod],
                            quota_used: np.ndarray,
-                           quota_runtime: np.ndarray
+                           quota_runtime: np.ndarray,
+                           cpu_amplification: float = 1.0
                            ) -> Optional[PreemptionResult]:
     """SelectVictimsOnNode (preempt.go:111-220), quota-constrained: only
     lower-priority pods of the preemptor's OWN quota are candidates
     (canPreempt), and the preemptor must fit both the node and its quota
     runtime after the removals. Returns None when preemption on this node
-    cannot help."""
+    cannot help. The NODE fit charges amplified CPU for bind pods
+    (matching the device gate); quota accounting stays RAW — quota trees
+    meter requests, not node capacity."""
+    from koordinator_tpu.scheduler.preemption import charged_request
+
     prio = preemptor.priority or 0
 
     def is_candidate(p: api.Pod) -> bool:
@@ -155,27 +160,37 @@ def select_victims_on_node(preemptor: api.Pod,
                 and p.quota_name == preemptor.quota_name
                 and preemptible(p))
 
+    def raw(p: api.Pod) -> np.ndarray:
+        return resource_vec(p.requests).astype(np.float64)
+
+    def charged(p: api.Pod) -> np.ndarray:
+        return charged_request(p, cpu_amplification)
+
     candidates = [p for p in pods_on_node if is_candidate(p)]
     if not candidates:
         return None
 
     others = [p for p in pods_on_node if not is_candidate(p)]
-    req = resource_vec(preemptor.requests).astype(np.float64)
-    base_used = sum((resource_vec(p.requests).astype(np.float64)
-                     for p in others),
-                    np.zeros_like(req))
+    req_node = charged(preemptor)
+    req_raw = raw(preemptor)
+    base_used = sum((charged(p) for p in others),
+                    np.zeros_like(req_node))
     # quota used excluding every candidate (they are all removed first)
-    cand_req = sum((resource_vec(p.requests).astype(np.float64)
-                    for p in candidates), np.zeros_like(req))
+    cand_req = sum((raw(p) for p in candidates),
+                   np.zeros_like(req_raw))
     q_used = quota_used.astype(np.float64) - cand_req
 
     # the same remove-all-then-reprieve minimal-set core the default
     # preemption uses, with the quota runtime as the extra fit surface
-    victims = reprieve_victims(
-        req, candidates,
-        lambda returned, _reprieved: (
-            _fits(base_used + returned + req, node_allocatable)
-            and _fits(q_used + returned + req, quota_runtime)))
+    def extra_fit(returned: np.ndarray, reprieved) -> bool:
+        raw_returned = sum((raw(p) for p in reprieved),
+                           np.zeros_like(req_raw))
+        return (_fits(base_used + returned + req_node, node_allocatable)
+                and _fits(q_used + raw_returned + req_raw,
+                          quota_runtime))
+
+    victims = reprieve_victims(req_node, candidates, extra_fit,
+                               req_fn=charged)
     if victims is None:
         return None
     return PreemptionResult(victims=victims)

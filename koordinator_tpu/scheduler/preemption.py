@@ -19,6 +19,16 @@ the device program could not place), operates on the typed host view,
 and its output — victims to evict + the nominated node — feeds the
 eviction edge and the NEXT batch, exactly like the reference's
 nominatedNodeName handshake.
+
+Recheck coverage (documented narrowing): the dry-run re-applies the
+node-level gates, the flat resource fit WITH amplified-CPU charging
+(cpu-bind pods cost request x the node's amplification ratio, matching
+the device gate in core.py), and the topology gates
+(spread/affinity). NUMA-zone placement and device (GPU instance) fit
+are NOT rechecked — a nomination can still be rejected by those gates
+next batch, in which case the preemptor requeues (the evictions are
+potentially wasted but correctness holds: the reference's
+nominatedNodeName is equally advisory and re-filtered at retry).
 """
 
 from __future__ import annotations
@@ -54,7 +64,9 @@ def preemptible(p: api.Pod) -> bool:
 def reprieve_victims(preemptor_req: np.ndarray,
                      candidates: Sequence[api.Pod],
                      extra_fit: Callable[[np.ndarray, List[api.Pod]],
-                                         bool]
+                                         bool],
+                     req_fn: Optional[Callable[[api.Pod],
+                                               np.ndarray]] = None
                      ) -> Optional[List[api.Pod]]:
     """The remove-all-then-reprieve minimal-set core shared by default
     and quota-scoped preemption. `extra_fit(returned, reprieved)` must
@@ -63,7 +75,11 @@ def reprieve_victims(preemptor_req: np.ndarray,
     non-resource gates per reprieve step — upstream reruns the Filter
     plugins inside selectVictimsOnNode, which is what lets a pod blocked
     by anti-affinity against a PREEMPTIBLE pod evict it even when
-    resources alone would fit)."""
+    resources alone would fit). `req_fn` maps a candidate to its CHARGED
+    request vector (defaults to raw requests; callers pass an amplifying
+    variant on amplified nodes)."""
+    if req_fn is None:
+        req_fn = lambda p: resource_vec(p.requests).astype(np.float64)
     if not candidates:
         return None
     if not extra_fit(np.zeros_like(preemptor_req), []):
@@ -72,7 +88,7 @@ def reprieve_victims(preemptor_req: np.ndarray,
     kept = np.zeros_like(preemptor_req)
     reprieved: List[api.Pod] = []
     for p in sorted(candidates, key=lambda p: -(p.priority or 0)):
-        p_req = resource_vec(p.requests).astype(np.float64)
+        p_req = req_fn(p)
         if extra_fit(kept + p_req, reprieved + [p]):
             kept += p_req
             reprieved.append(p)
@@ -98,25 +114,43 @@ def node_admits(pod: api.Pod, node: api.Node) -> bool:
     return True
 
 
+def charged_request(p: api.Pod, cpu_amplification: float) -> np.ndarray:
+    """What the pod costs against (amplified) node allocatable — the
+    host twin of the device gate (core.py amplified-CPU commit): a
+    CPU-bind (exclusive-cpuset) pod's cores cost request x ratio on a
+    node whose webhook published amplified allocatable; shared-CPU pods
+    charge raw."""
+    v = resource_vec(p.requests).astype(np.float64)
+    if cpu_amplification > 1.0 and p.required_cpu_bind:
+        from koordinator_tpu.api.extension import ResourceKind
+        v[int(ResourceKind.CPU)] *= cpu_amplification
+    return v
+
+
 def select_victims_on_node(preemptor: api.Pod,
                            node_allocatable: np.ndarray,
                            pods_on_node: Sequence[api.Pod],
-                           admit: Optional[Callable] = None
+                           admit: Optional[Callable] = None,
+                           cpu_amplification: float = 1.0
                            ) -> Optional[List[api.Pod]]:
     """Minimal victim set on one node, or None when preemption there
     cannot admit the preemptor. `admit(removed_ids)` re-runs the
     non-resource gates with that candidate subset hypothetically
-    evicted (None = resources only)."""
+    evicted (None = resources only). `cpu_amplification` is the node's
+    published ratio: bind-pod CPU charges amplified, matching what the
+    device gates will re-check next batch."""
     prio = preemptor.priority or 0
 
     def is_candidate(p: api.Pod) -> bool:
         return (p.priority or 0) < prio and preemptible(p)
 
+    def req_of(p: api.Pod) -> np.ndarray:
+        return charged_request(p, cpu_amplification)
+
     candidates = [p for p in pods_on_node if is_candidate(p)]
     others = [p for p in pods_on_node if not is_candidate(p)]
-    req = resource_vec(preemptor.requests).astype(np.float64)
-    base = sum((resource_vec(p.requests).astype(np.float64)
-                for p in others), np.zeros_like(req))
+    req = req_of(preemptor)
+    base = sum((req_of(p) for p in others), np.zeros_like(req))
     cap = node_allocatable.astype(np.float64)
     cand_ids = {id(p) for p in candidates}
 
@@ -129,7 +163,16 @@ def select_victims_on_node(preemptor: api.Pod,
         removed = frozenset(cand_ids - {id(p) for p in reprieved})
         return admit(removed)
 
-    return reprieve_victims(req, candidates, extra_fit)
+    return reprieve_victims(req, candidates, extra_fit, req_fn=req_of)
+
+
+def node_cpu_amplification(node: api.Node) -> float:
+    """The node's published CPU amplification ratio — the shared parser
+    in api/extension, so the snapshot builder and this dry run agree."""
+    from koordinator_tpu.api.extension import (
+        node_cpu_amplification_ratio,
+    )
+    return node_cpu_amplification_ratio(node.meta.annotations)
 
 
 def _pod_matches(p: api.Pod, ns: str, selector) -> bool:
@@ -253,7 +296,8 @@ def find_preemption(preemptor: api.Pod,
                                          placed=placed)
         victims = select_victims_on_node(
             preemptor, resource_vec(node.allocatable),
-            pods_by_node.get(node.meta.name, ()), admit=admit)
+            pods_by_node.get(node.meta.name, ()), admit=admit,
+            cpu_amplification=node_cpu_amplification(node))
         if victims is None:
             continue
         prios = sorted((p.priority or 0) for p in victims)

@@ -16,18 +16,17 @@ from __future__ import annotations
 
 import dataclasses
 import itertools
-import json
 import time
 from typing import Dict, Iterable, List, Mapping, Optional, Sequence, Tuple
 
 import numpy as np
 
 from koordinator_tpu.api.extension import (
-    ANNOTATION_NODE_AMPLIFICATION_RATIOS,
     NUM_RESOURCES,
     PriorityClass,
     QoSClass,
     ResourceKind,
+    node_cpu_amplification_ratio,
     numa_policy_code,
     translate_resource_by_priority,
 )
@@ -337,16 +336,10 @@ class SnapshotBuilder:
             schedulable[i] = not node.unschedulable
             # amplification ratio (resource-amplification-ratio annotation,
             # published by the node webhook alongside AMPLIFIED allocatable;
-            # nodenumaresource util.go:65-85). Malformed values were
-            # rejected by the validating webhook; be lenient here.
-            raw_amp = node.meta.annotations.get(
-                ANNOTATION_NODE_AMPLIFICATION_RATIOS, "")
-            if raw_amp:
-                try:
-                    ratios = json.loads(raw_amp)
-                    cpu_amp[i] = max(float(ratios.get("cpu", 1.0)), 1.0)
-                except (ValueError, TypeError, AttributeError):
-                    pass
+            # nodenumaresource util.go:65-85) — the shared parser, so
+            # host preemption's dry run and the device gate agree.
+            cpu_amp[i] = node_cpu_amplification_ratio(
+                node.meta.annotations)
             if node.topology is not None:
                 for j, zone in enumerate(node.topology.zones[:z]):
                     numa_cap[i, j, 0] = zone.cpus_milli

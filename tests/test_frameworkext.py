@@ -120,6 +120,36 @@ def test_scheduler_service_end_to_end():
     assert service.batches == 2
 
 
+def test_amplification_derived_from_scheduled_snapshot():
+    """Regression (ADVICE r3): the amplified-CPU auto-detection keys
+    off the snapshot the batch actually READS — writers that bypass
+    service.publish() and put snapshots straight into the shared store
+    (SnapshotSyncer._rebuild, embedded compositions) still flip the
+    gate on."""
+    import numpy as np
+
+    service = SchedulerService(num_rounds=1, k_choices=4)
+    snap = synthetic.synthetic_cluster(16)
+    amp = np.array(snap.nodes.cpu_amplification)
+    amp[3] = 1.5
+    snap_amp = snap.replace(nodes=snap.nodes.replace(
+        cpu_amplification=amp))
+    # bypass service.publish on purpose
+    service.store.publish(snap_amp)
+    service.schedule(synthetic.synthetic_pods(8))
+    assert service.schedule_kwargs["enable_amplification"] is True
+    # a ratio-1 snapshot published the same way turns it back off
+    service.store.publish(synthetic.synthetic_cluster(16, seed=3))
+    service.schedule(synthetic.synthetic_pods(8, seed=1))
+    assert service.schedule_kwargs["enable_amplification"] is False
+    # an explicit constructor kwarg always wins
+    svc2 = SchedulerService(num_rounds=1, k_choices=4,
+                            enable_amplification=False)
+    svc2.store.publish(snap_amp)
+    svc2.schedule(synthetic.synthetic_pods(8))
+    assert svc2.schedule_kwargs["enable_amplification"] is False
+
+
 def test_debug_score_table_renders():
     snap = synthetic.synthetic_cluster(8)
     pods = synthetic.synthetic_pods(3)

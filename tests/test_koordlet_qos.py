@@ -771,3 +771,75 @@ def test_kubelet_pull_combined_gpu_limits_and_suffixes():
         "status": {},
     })
     assert pod2.requests[ResourceKind.CPU] == 1000.0
+
+
+def test_kubelet_pull_init_containers_and_overhead():
+    """Regression (ADVICE r3): pod footprint follows the k8s effective
+    request rule max(sum(containers), each initContainer) + overhead —
+    an init-heavy pod no longer under-reports to qosmanager/reporters."""
+    from koordinator_tpu.koordlet.kubelet_stub import pod_from_manifest
+
+    pod = pod_from_manifest({
+        "metadata": {"name": "i", "namespace": "d", "uid": "u"},
+        "spec": {
+            "containers": [
+                {"resources": {"requests": {"cpu": "1",
+                                            "memory": "256Mi"}}},
+                {"resources": {"requests": {"cpu": "1"}}},
+            ],
+            "initContainers": [
+                # bigger than the main set on cpu (4 > 2), smaller on mem
+                {"resources": {"requests": {"cpu": "4",
+                                            "memory": "128Mi"}}},
+            ],
+            "overhead": {"cpu": "250m", "memory": "64Mi"},
+        },
+        "status": {},
+    })
+    # cpu: max(2000, 4000) + 250 ; memory: max(256, 128) + 64
+    assert pod.requests[ResourceKind.CPU] == 4250.0
+    assert pod.requests[ResourceKind.MEMORY] == 320.0
+    # overhead never fabricates a limit for an unlimited pod
+    assert ResourceKind.CPU not in pod.limits
+    # a small init container changes nothing
+    pod2 = pod_from_manifest({
+        "metadata": {"name": "j", "namespace": "d", "uid": "u2"},
+        "spec": {
+            "containers": [{"resources": {"requests": {"cpu": "2"}}}],
+            "initContainers": [
+                {"resources": {"requests": {"cpu": "1"}}}],
+        },
+        "status": {},
+    })
+    assert pod2.requests[ResourceKind.CPU] == 2000.0
+
+
+def test_kubelet_pull_sidecar_containers_sum():
+    """A native sidecar (initContainer restartPolicy: Always) runs
+    ALONGSIDE the main set: it sums with the containers instead of
+    folding into the per-init max, and a later regular init charges its
+    own request plus the sidecars already started."""
+    from koordinator_tpu.koordlet.kubelet_stub import pod_from_manifest
+
+    pod = pod_from_manifest({
+        "metadata": {"name": "s", "namespace": "d", "uid": "u"},
+        "spec": {
+            "containers": [
+                {"resources": {"requests": {"cpu": "1"},
+                               "limits": {"cpu": "2"}}}],
+            "initContainers": [
+                {"restartPolicy": "Always",
+                 "resources": {"requests": {"cpu": "1"},
+                               "limits": {"cpu": "1"}}},
+                # starts after the sidecar: peak = 3 + 1 sidecar = 4
+                {"resources": {"requests": {"cpu": "3"}}},
+            ],
+            "overhead": {"cpu": "500m"},
+        },
+        "status": {},
+    })
+    # requests: max(main 1000 + sidecar 1000, init 3000 + sidecar 1000)
+    #           + overhead 500
+    assert pod.requests[ResourceKind.CPU] == 4500.0
+    # limits exist (main 2000 + sidecar 1000) so overhead adds there too
+    assert pod.limits[ResourceKind.CPU] == 3500.0

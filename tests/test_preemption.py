@@ -147,10 +147,67 @@ def test_preemption_post_filter_in_error_chain():
     name, nom = nominations[0]
     assert name == "prod" and nom.node_name == "n0"
     assert [v.meta.name for v in nom.victims] == ["be"]
-    # a priority-less pod never preempts
-    dispatcher.error(QueuedPodInfo(pod=mk_pod("free", 0, 100.0)),
+    # a priority-LESS pod (None) never preempts
+    dispatcher.error(QueuedPodInfo(pod=mk_pod("free", None, 100.0)),
                      SchedulingError("no node fits"))
     assert len(nominations) == 1
+
+
+def test_priority_zero_preempts_negative_victims():
+    """Regression (ADVICE r3): upstream's PostFilter runs for ANY
+    unschedulable pod with a priority — a priority-0 pod legitimately
+    preempts negative-priority victims; only a pod with no priority at
+    all skips the dry run."""
+    from koordinator_tpu.scheduler.errorhandler import (
+        ErrorHandlerDispatcher,
+        QueuedPodInfo,
+        SchedulingError,
+        make_preemption_post_filter,
+    )
+
+    node = Node(meta=ObjectMeta(name="n0"),
+                allocatable={RK.CPU: 8000.0, RK.MEMORY: 16384.0})
+    victim = mk_pod("neg", -10, 8000.0)
+    nominations = []
+    d = ErrorHandlerDispatcher()
+    d.register(post=make_preemption_post_filter(
+        lambda: [node], lambda: {"n0": [victim]},
+        lambda pod, nom: nominations.append(nom)))
+    d.error(QueuedPodInfo(pod=mk_pod("zero", 0, 4000.0)),
+            SchedulingError("no node fits"))
+    assert len(nominations) == 1
+    assert [v.meta.name for v in nominations[0].victims] == ["neg"]
+
+
+def test_amplified_cpu_charging_in_victim_selection():
+    """Regression (ADVICE r3): on a node whose webhook published
+    amplified allocatable, a CPU-bind preemptor/victim charges
+    request x ratio — the host dry run agrees with the device gate, so
+    a nomination is never made for a node the amplified commit would
+    reject."""
+    import json
+
+    amp_ann = {"node.koordinator.sh/resource-amplification-ratio":
+               json.dumps({"cpu": 2.0})}
+    # amplified allocatable: 8000m raw published as 16000m
+    node = Node(meta=ObjectMeta(name="n0", annotations=amp_ann),
+                allocatable={RK.CPU: 16000.0, RK.MEMORY: 16384.0})
+    # bind preemptor wants 6000m -> charges 12000m amplified
+    preemptor = mk_pod("prod", 9500, 6000.0)
+    preemptor.required_cpu_bind = True
+    # bind victim holds 3000m -> charges 6000m; shared victim 4000m raw
+    bind_victim = mk_pod("bind-be", 5000, 3000.0)
+    bind_victim.required_cpu_bind = True
+    shared = mk_pod("shared-be", 5500, 4000.0)
+    got = find_preemption(preemptor, [node],
+                          {"n0": [bind_victim, shared]})
+    # amplified math: need 12000 of 16000 -> must free >= 2000 amplified.
+    # Reprieve keeps the MORE important candidate (shared 5500, 4000
+    # charged) -> 12000+4000 fits exactly; evicting bind-be (6000
+    # charged) suffices. Raw math would have kept both (6000+3000+4000
+    # <= 16000) and nominated with NO victims.
+    assert got is not None
+    assert [v.meta.name for v in got.victims] == ["bind-be"]
 
 
 def test_constraints_admit_blocks_impossible_nomination():
