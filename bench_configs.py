@@ -87,20 +87,8 @@ def config_2_numa():
     from koordinator_tpu.scheduler.plugins.loadaware import LoadAwareConfig
     from koordinator_tpu.utils import synthetic
 
-    snap = synthetic.synthetic_cluster(1000, num_quotas=32, seed=0)
-    nodes = snap.nodes
-    alloc = np.asarray(nodes.allocatable)
-    n = alloc.shape[0]
-    numa_cap = np.zeros((n, 4, 2), np.float32)
-    numa_cap[:, 0, 0] = alloc[:, 0] / 2
-    numa_cap[:, 1, 0] = alloc[:, 0] / 2
-    numa_cap[:, 0, 1] = alloc[:, 1] / 2
-    numa_cap[:, 1, 1] = alloc[:, 1] / 2
-    numa_valid = np.zeros((n, 4), bool)
-    numa_valid[:, :2] = True
-    snap = snap.replace(nodes=nodes.replace(
-        numa_cap=jnp.asarray(numa_cap), numa_free=jnp.asarray(numa_cap),
-        numa_valid=jnp.asarray(numa_valid)))
+    snap = synthetic.with_two_numa_zones(
+        synthetic.synthetic_cluster(1000, num_quotas=32, seed=0))
 
     pods = synthetic.synthetic_pods(10_000, seed=1, prod_frac=0.6,
                                     num_quotas=32)

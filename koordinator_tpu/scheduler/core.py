@@ -329,14 +329,12 @@ def schedule_batch(snap: ClusterSnapshot, pods: PodBatch,
             dom_x = dom_matrix
 
         def counts_flat(placed_now):
-            pl = jnp.maximum(placed_now, 0)
-            dom_pg = dom_x.T[pl]                              # [P, G]
-            ok = member & (placed_now >= 0)[:, None]
-            dom_pg = jnp.where(ok, dom_pg, -1)
-            g_idx = jnp.arange(n_g, dtype=jnp.int32)[None, :]
-            seg = jnp.where(dom_pg >= 0, g_idx * n_d + dom_pg,
-                            n_g * n_d).reshape(-1)
-            return count0.reshape(-1).at[seg].add(1.0, mode="drop")
+            # one charging implementation for in-batch and cross-batch
+            # counts (charge_domain_counts); dom_x here is the
+            # slot-extended map, so extended placements land on their
+            # node's domain
+            return charge_domain_counts(count0, dom_x, member,
+                                        placed_now).reshape(-1)
 
         return dom_x, counts_flat, n_g, n_d
 
@@ -1012,3 +1010,31 @@ def schedule_batch(snap: ClusterSnapshot, pods: PodBatch,
                           gang_failed=gang_fail,
                           snapshot=new_snap,
                           amplified=enable_amplification)
+
+
+def charge_domain_counts(count0: jnp.ndarray, dom_matrix: jnp.ndarray,
+                         member: jnp.ndarray,
+                         assignment: jnp.ndarray) -> jnp.ndarray:
+    """Post-batch (group x domain) count update — the cross-batch
+    analogue of the builder recomputing spread/anti/aff count0 from
+    running + assumed pods. Callers chunking one logical workload
+    through repeated schedule_batch calls thread the returned counts
+    into the next chunk's count0 so each chunk sees the previous
+    chunks' assumes (the same rule the domain_machinery docstring
+    states for the informer flow).
+
+    `assignment` must be NODE-level indices (< N; map reservation-slot
+    placements to their node first). Same segment-sum as the in-batch
+    counts closure: every placed member of group g charges g's domain
+    for its node; non-members and unplaced rows drop out.
+    """
+    n_g, n_d = count0.shape
+    pl = jnp.maximum(assignment, 0)
+    dom_pg = dom_matrix.T[pl]                              # [P, G]
+    ok = member & (assignment >= 0)[:, None]
+    dom_pg = jnp.where(ok, dom_pg, -1)
+    g_idx = jnp.arange(n_g, dtype=jnp.int32)[None, :]
+    seg = jnp.where(dom_pg >= 0, g_idx * n_d + dom_pg,
+                    n_g * n_d).reshape(-1)
+    return count0.reshape(-1).at[seg].add(
+        1.0, mode="drop").reshape(n_g, n_d)

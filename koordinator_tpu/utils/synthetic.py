@@ -289,6 +289,166 @@ def synthetic_pods(num_pods: int, seed: int = 1,
     )
 
 
+def with_two_numa_zones(snap: ClusterSnapshot) -> ClusterSnapshot:
+    """Populate every node with two NUMA zones at half capacity each
+    (the dual-socket shape; shared by the full-gate flagship workload
+    and BASELINE config 2 so the zone model cannot drift)."""
+    nodes = snap.nodes
+    alloc = np.asarray(nodes.allocatable)
+    n = alloc.shape[0]
+    z = np.asarray(nodes.numa_cap).shape[1]
+    numa_cap = np.zeros((n, z, 2), np.float32)
+    numa_cap[:, 0, 0] = alloc[:, CPU] / 2
+    numa_cap[:, 1, 0] = alloc[:, CPU] / 2
+    numa_cap[:, 0, 1] = alloc[:, MEM] / 2
+    numa_cap[:, 1, 1] = alloc[:, MEM] / 2
+    numa_valid = np.zeros((n, z), bool)
+    numa_valid[:, :2] = True
+    return snap.replace(nodes=nodes.replace(
+        numa_cap=numa_cap, numa_free=numa_cap.copy(),
+        numa_valid=numa_valid))
+
+
+def full_gate_cluster(num_nodes: int, seed: int = 0,
+                      num_quotas: int = 32, max_quotas: int = 64,
+                      num_gangs: int = 64, max_gangs: int = 64,
+                      gpu_node_frac: float = 0.25,
+                      gpus_per_node: int = 8) -> ClusterSnapshot:
+    """The FULL-gate flagship cluster: everything the slim bench cluster
+    has, plus two populated NUMA zones per node, GPU nodes with
+    per-instance pools, and a 3-class taint landscape (none/dedicated/
+    gpu-exclusive). The reference's hot loop runs every registered
+    plugin for every pod (framework_extender.go:204-259); this workload
+    makes the batched program compile every gate in."""
+    snap = synthetic_cluster(num_nodes, seed=seed, num_quotas=num_quotas,
+                             max_quotas=max_quotas, num_gangs=num_gangs,
+                             max_gangs=max_gangs,
+                             gpu_node_frac=gpu_node_frac,
+                             gpus_per_node=gpus_per_node)
+    snap = with_two_numa_zones(snap)
+    rng = np.random.default_rng(seed + 17)
+    # taint classes: 0 = untainted, 1 = dedicated, 2 = gpu-exclusive
+    taint_group = rng.choice(3, num_nodes,
+                             p=[0.8, 0.15, 0.05]).astype(np.int32)
+    return snap.replace(nodes=snap.nodes.replace(taint_group=taint_group))
+
+
+def full_gate_pods(num_pods: int, num_nodes: int, seed: int = 1,
+                   num_quotas: int = 32, num_gangs: int = 64,
+                   gang_min_member: int = 8, num_zones: int = 16,
+                   gpu_pod_frac: float = 0.1,
+                   numa_bind_frac: float = 0.33,
+                   n_spread_groups: int = 8, spread_frac: float = 0.15,
+                   max_skew: float = 64.0,
+                   n_anti_groups: int = 16, anti_members: int = 64,
+                   n_aff_groups: int = 8, aff_members: int = 48
+                   ) -> PodBatch:
+    """The FULL-gate flagship workload: quota + gang pods plus NUMA-bound
+    prod pods, GPU pods, three toleration classes, PodTopologySpread
+    groups over zone domains, required anti-affinity over hostname
+    domains, and affinity groups co-locating over zones. Every static
+    gate switch is on, so schedule_batch compiles the complete plugin
+    chain — the faithful analogue of the reference running all plugins
+    per pod."""
+    pods = synthetic_pods(num_pods, seed=seed, num_quotas=num_quotas,
+                          num_gangs=num_gangs,
+                          gang_min_member=gang_min_member,
+                          gpu_pod_frac=gpu_pod_frac)
+    rng = np.random.default_rng(seed + 29)
+    p = num_pods
+    f32 = np.float32
+
+    # a third of prod (native-CPU) pods are single-NUMA bound (the
+    # resource-spec annotation + LSR path, bench config 2 semantics)
+    is_prod = np.asarray(pods.priority_class) == int(PriorityClass.PROD)
+    numa_single = is_prod & (rng.uniform(size=p) < numa_bind_frac)
+
+    # tolerations: set 0 tolerates nothing, set 1 tolerates dedicated,
+    # set 2 tolerates both taint classes
+    toleration_id = rng.choice(3, p, p=[0.7, 0.2, 0.1]).astype(np.int32)
+    tol_forbid = np.array([[False, True, True],
+                           [False, False, True],
+                           [False, False, False]])
+    # dedicated nodes carry one PreferNoSchedule taint for the
+    # non-tolerating set (engages the taint score penalty too)
+    tol_prefer = np.array([[0.0, 1.0, 1.0],
+                           [0.0, 0.0, 1.0],
+                           [0.0, 0.0, 0.0]], f32)
+
+    # spread groups over zone domains (zone = node % num_zones)
+    zone_of_node = (np.arange(num_nodes) % num_zones).astype(np.int32)
+    spread_domain = np.broadcast_to(
+        zone_of_node, (n_spread_groups, num_nodes)).copy()
+    in_spread = rng.uniform(size=p) < spread_frac
+    sgrp = rng.integers(0, n_spread_groups, p).astype(np.int32)
+    spread_id = np.where(in_spread, sgrp, -1).astype(np.int32)
+    spread_member = np.zeros((p, n_spread_groups), bool)
+    spread_member[np.flatnonzero(in_spread), sgrp[in_spread]] = True
+    spread_count0 = np.zeros((n_spread_groups, num_zones), f32)
+    spread_dvalid = np.ones((n_spread_groups, num_zones), bool)
+
+    # group memberships scale DOWN with small batches (the constrained
+    # pods stay <= ~half the batch) instead of crashing an undersized
+    # run with an opaque sampling error
+    anti_members = max(min(anti_members, p // (4 * n_anti_groups)), 1)
+    aff_members = max(min(aff_members, p // (4 * n_aff_groups)), 1)
+    total_anti = n_anti_groups * anti_members
+    total_aff = n_aff_groups * aff_members
+    if total_anti + total_aff > p:
+        raise ValueError(
+            f"full_gate_pods needs at least {n_anti_groups + n_aff_groups}"
+            f" pods for {n_anti_groups} anti + {n_aff_groups} affinity "
+            f"groups; got {p}")
+
+    # required anti-affinity over HOSTNAME domains: each group's
+    # carriers must land on distinct nodes (the kv-service shape)
+    host_domain = np.arange(num_nodes, dtype=np.int32)
+    anti_domain = np.broadcast_to(
+        host_domain, (n_anti_groups, num_nodes)).copy()
+    anti_id = np.full((p,), -1, np.int32)
+    anti_member = np.zeros((p, n_anti_groups), bool)
+    anti_carrier = np.zeros((p, n_anti_groups), bool)
+    a_idx = rng.choice(p, total_anti, replace=False)
+    a_grp = np.repeat(np.arange(n_anti_groups, dtype=np.int32),
+                      anti_members)
+    anti_id[a_idx] = a_grp
+    anti_member[a_idx, a_grp] = True
+    anti_carrier[a_idx, a_grp] = True
+    anti_count0 = np.zeros((n_anti_groups, num_nodes), f32)
+    anti_carrier_count0 = np.zeros((n_anti_groups, num_nodes), f32)
+
+    # affinity groups co-locating over zones (self-bootstrap opens the
+    # first domain, the rest must follow)
+    aff_domain = np.broadcast_to(
+        zone_of_node, (n_aff_groups, num_nodes)).copy()
+    aff_id = np.full((p,), -1, np.int32)
+    aff_member = np.zeros((p, n_aff_groups), bool)
+    # disjoint from the anti pods so one pod never carries both terms
+    remaining = np.setdiff1d(np.arange(p), a_idx, assume_unique=False)
+    f_idx = rng.choice(remaining, total_aff, replace=False)
+    f_grp = np.repeat(np.arange(n_aff_groups, dtype=np.int32),
+                      aff_members)
+    aff_id[f_idx] = f_grp
+    aff_member[f_idx, f_grp] = True
+    aff_count0 = np.zeros((n_aff_groups, num_zones), f32)
+
+    return pods.replace(
+        numa_single=numa_single,
+        toleration_id=toleration_id, tol_forbid=tol_forbid,
+        tol_prefer=tol_prefer,
+        spread_id=spread_id, spread_member=spread_member,
+        spread_max_skew=np.full((n_spread_groups,), max_skew, f32),
+        spread_domain=spread_domain, spread_count0=spread_count0,
+        spread_dvalid=spread_dvalid,
+        anti_id=anti_id, anti_member=anti_member,
+        anti_carrier=anti_carrier, anti_domain=anti_domain,
+        anti_count0=anti_count0,
+        anti_carrier_count0=anti_carrier_count0,
+        aff_id=aff_id, aff_member=aff_member, aff_domain=aff_domain,
+        aff_count0=aff_count0,
+        has_taints=True, has_spread=True, has_anti=True, has_aff=True)
+
+
 def stack_pod_chunks(pods: PodBatch, chunk: int) -> dict:
     """[P, ...] per-pod columns -> [C, CHUNK, ...] scan operands (the
     bench sweep shape; zero-copy reshape of the contiguous batch). Shared

@@ -11,6 +11,8 @@ import json
 import os
 
 import jax
+import jax.numpy as jnp
+import numpy as np
 
 
 def test_bench_runs_sharded_on_8_device_mesh(capsys, monkeypatch):
@@ -18,6 +20,9 @@ def test_bench_runs_sharded_on_8_device_mesh(capsys, monkeypatch):
     monkeypatch.setenv("BENCH_NODES", "800")
     monkeypatch.setenv("BENCH_PODS", "4000")
     monkeypatch.setenv("BENCH_CHUNK", "500")
+    # extras (configs 2-5 + full gate) run at their own scales; the
+    # full-gate sharded path has its own test below
+    monkeypatch.setenv("BENCH_EXTRAS", "0")
     import bench
     importlib.reload(bench)
     bench.main()
@@ -26,3 +31,70 @@ def test_bench_runs_sharded_on_8_device_mesh(capsys, monkeypatch):
     assert result["devices"] == 8
     assert result["placed"] == 4000
     assert result["value"] > 0
+
+
+def test_bench_full_gate_sharded(capsys, monkeypatch):
+    """The FULL-gate flagship path (NUMA + GPU + taints + spread +
+    anti/affinity all compiled in) on the 8-device mesh, with the
+    topology counts carried across chunks."""
+    monkeypatch.setenv("BENCH_NODES", "800")
+    monkeypatch.setenv("BENCH_PODS", "4000")
+    monkeypatch.setenv("BENCH_FULL_CHUNK", "500")
+    import bench
+    importlib.reload(bench)
+    result = bench.run_northstar(full_gate=True)
+    assert result["devices"] == 8
+    # tight topology constraints leave stragglers; the bulk must place
+    assert result["placed"] > 3000
+    assert result["metric"].endswith("full_gate")
+    assert result["never_retried"] == 0
+
+
+def test_anti_affinity_holds_across_chunks():
+    """Regression for the cross-chunk count rule: carriers of one anti
+    group scheduled in DIFFERENT chunks still land in distinct domains,
+    because the bench threads core.charge_domain_counts output into the
+    next chunk's count0 (core.domain_machinery's cross-batch contract).
+    """
+    from koordinator_tpu.scheduler import core
+    from koordinator_tpu.scheduler.plugins.loadaware import LoadAwareConfig
+    from koordinator_tpu.utils import synthetic
+
+    n_nodes, n_zones = 16, 4
+    snap = synthetic.synthetic_cluster(n_nodes, seed=0)
+    zone_of_node = (np.arange(n_nodes) % n_zones).astype(np.int32)
+
+    def carriers(num):
+        pods = synthetic.synthetic_pods(num, seed=3, prod_frac=1.0)
+        return pods.replace(
+            anti_id=np.zeros((num,), np.int32),
+            anti_member=np.ones((num, 1), bool),
+            anti_carrier=np.ones((num, 1), bool),
+            anti_domain=zone_of_node[None, :].copy(),
+            anti_count0=np.zeros((1, n_zones), np.float32),
+            anti_carrier_count0=np.zeros((1, n_zones), np.float32),
+            has_anti=True)
+
+    counts = (jnp.zeros((1, n_zones), jnp.float32),
+              jnp.zeros((1, n_zones), jnp.float32))
+    zones = []
+    for _ in range(2):  # two chunks of 2 carriers each
+        batch = carriers(2).replace(anti_count0=counts[0],
+                                    anti_carrier_count0=counts[1])
+        res = core.schedule_batch(snap, batch, LoadAwareConfig.make(),
+                                  num_rounds=2, k_choices=4,
+                                  enable_numa=False)
+        a = np.asarray(res.assignment)
+        assert (a >= 0).all()
+        zones.extend(zone_of_node[a].tolist())
+        snap = res.snapshot
+        counts = (
+            core.charge_domain_counts(counts[0], batch.anti_domain,
+                                      batch.anti_member, res.assignment),
+            core.charge_domain_counts(counts[1], batch.anti_domain,
+                                      batch.anti_carrier, res.assignment),
+        )
+    # 4 carriers over 4 zones: all distinct IFF the second chunk saw the
+    # first chunk's charges
+    assert len(set(zones)) == 4, zones
+    assert np.asarray(counts[0]).sum() == 4.0
