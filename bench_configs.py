@@ -129,12 +129,14 @@ def config_4_quota():
 
 
 def config_5_descheduler():
-    """LowNodeLoad rebalance plan over 10k nodes: classify + plan the
-    eviction set (host/numpy path — the plan is control-plane work)."""
+    """LowNodeLoad rebalance plan over 10k nodes: classification +
+    budgeted eviction selection as ONE jitted program (the prefix-sum
+    formulation in descheduler/lownodeload_device.py; golden-equal to
+    the host loop per tests/test_descheduler_device.py)."""
     from koordinator_tpu.api import types as api
     from koordinator_tpu.api.extension import ResourceKind as RK
     from koordinator_tpu.descheduler import (
-        LowNodeLoad,
+        DeviceLowNodeLoad,
         LowNodeLoadArgs,
         RecordingEvictor,
     )
@@ -163,15 +165,15 @@ def config_5_descheduler():
 
     evictor = RecordingEvictor()
     args = LowNodeLoadArgs(consecutive_abnormalities=1)
-    plugin = LowNodeLoad(args, evictor)
-    plugin.balance_once(nodes, metrics, pods_by_node, now)  # warm gates
+    plugin = DeviceLowNodeLoad(args, evictor)
+    plugin.balance_once(nodes, metrics, pods_by_node, now)  # warm/compile
     evictor.limiter.reset()
     evictor.evictions.clear()  # the warm run's plan must not double-count
     t0 = time.perf_counter()
     plugin.balance_once(nodes, metrics, pods_by_node, now)
     elapsed = time.perf_counter() - t0
     _emit("baseline_cfg5_descheduler_10k", elapsed, nodes=n,
-          evictions_planned=len(evictor.evictions))
+          evictions_planned=len(evictor.evictions), device_plan=True)
 
 
 def main():

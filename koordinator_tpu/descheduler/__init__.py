@@ -14,6 +14,9 @@ from koordinator_tpu.descheduler.lownodeload import (  # noqa: F401
     LowNodeLoadArgs,
     LowNodeLoad,
 )
+from koordinator_tpu.descheduler.lownodeload_device import (  # noqa: F401
+    DeviceLowNodeLoad,
+)
 from koordinator_tpu.descheduler.migration import (  # noqa: F401
     Arbitrator,
     MigrationController,

@@ -109,16 +109,16 @@ class LowNodeLoad:
 
     # -- classification (vectorized) ----------------------------------------
 
-    def classify(self, nodes: Sequence[api.Node],
-                 metrics: Mapping[str, api.NodeMetric],
-                 now: float) -> Tuple[np.ndarray, np.ndarray, np.ndarray,
-                                      np.ndarray, List[int]]:
-        """Returns (usage [N,R], capacity [N,R], low_mask [N], high_mask
-        [N], rdims) over the given nodes; nodes with missing/expired
-        NodeMetric are neither low nor high (getNodeUsage skips them)."""
+    def node_columns(self, nodes: Sequence[api.Node],
+                     metrics: Mapping[str, api.NodeMetric],
+                     now: float) -> Tuple[np.ndarray, np.ndarray,
+                                          np.ndarray]:
+        """One flattening pass: (usage [N,R], capacity [N,R], fresh [N]);
+        nodes with missing/expired NodeMetric are not fresh (getNodeUsage
+        skips them). Shared with the device path so the typed->columnar
+        work happens exactly once."""
         args = self.args
         n = len(nodes)
-        rdims = sorted({int(k) for k in args.high_thresholds})
         usage = np.zeros((n, NUM_RESOURCES), np.float32)
         capacity = np.zeros((n, NUM_RESOURCES), np.float32)
         fresh = np.zeros((n,), bool)
@@ -129,6 +129,24 @@ class LowNodeLoad:
                     args.node_metric_expiration_seconds, now):
                 usage[i] = resource_vec(m.node_usage)
                 fresh[i] = True
+        return usage, capacity, fresh
+
+    def classify(self, nodes: Sequence[api.Node],
+                 metrics: Mapping[str, api.NodeMetric],
+                 now: float) -> Tuple[np.ndarray, np.ndarray, np.ndarray,
+                                      np.ndarray, List[int]]:
+        """Returns (usage [N,R], capacity [N,R], low_mask [N], high_mask
+        [N], rdims) over the given nodes."""
+        return self.classify_columns(
+            *self.node_columns(nodes, metrics, now))
+
+    def classify_columns(self, usage: np.ndarray, capacity: np.ndarray,
+                         fresh: np.ndarray
+                         ) -> Tuple[np.ndarray, np.ndarray, np.ndarray,
+                                    np.ndarray, List[int]]:
+        """The threshold math over prebuilt columns."""
+        args = self.args
+        rdims = sorted({int(k) for k in args.high_thresholds})
         pct = _usage_pct(usage, capacity)
 
         low = np.array([args.low_thresholds.get(ResourceKind(d), 0.0)

@@ -1,0 +1,270 @@
+"""Device-resident LowNodeLoad plan (BASELINE config 5).
+
+The host plugin (lownodeload.py) walks source nodes and their pods
+sequentially — the faithful mirror of evictPodsFromSourceNodes
+(/root/reference/pkg/descheduler/framework/plugins/loadaware/
+low_node_load.go:232-305). That greedy is in fact PREFIX-STRUCTURED, so
+the whole plan vectorizes with no per-pod loop at all:
+
+- Within one source node, pods are evicted in sorted order while the
+  node is still over its high threshold. Usage only decreases as pods
+  leave, so "still over" is monotone: the evicted set is a PREFIX of
+  the node's sorted removable pods — computable for every node at once
+  with a segment exclusive-cumsum.
+- Across nodes, the shared destination budget only decreases, and the
+  reference stops as soon as any dimension is exhausted — so "budget
+  still open" is ALSO monotone along the global eviction order: one
+  exclusive cumsum over the would-be-evicted pods. Same for the
+  per-cycle eviction cap.
+- A pod is planned iff (node prefix holds) AND (budget prefix holds):
+  two cumsums and a gather replace the reference's nested loop. This is
+  the TPU-native shape of the "batched ILP relax" BASELINE.json names:
+  the LP's greedy rounding collapses into prefix sums.
+
+Classification (thresholds, deviation mode, freshness) and node_fit run
+batched on device too. Host keeps only the typed->columnar flattening,
+the anomaly counters (stateful across cycles), and offering the planned
+pods to the evictor.
+
+Narrowing (documented): the plan assumes the evictor accepts every
+offered pod. A per-cycle cap is modeled ON device (`max_evictions`);
+per-node / per-namespace caps are not — `DeviceLowNodeLoad` falls back
+to the host loop when those are configured, so plans never silently
+diverge from the limiter.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, List, Mapping, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from koordinator_tpu.api import types as api
+from koordinator_tpu.api.extension import NUM_RESOURCES, ResourceKind
+from koordinator_tpu.descheduler.lownodeload import (
+    LowNodeLoad,
+    LowNodeLoadArgs,
+)
+from koordinator_tpu.snapshot.builder import resource_vec
+
+
+@functools.partial(jax.jit, static_argnames=("use_deviation", "node_fit",
+                                             "fit_dims"))
+def plan_kernel(usage, capacity, fresh, source_mask,
+                pod_node, pod_usage_r, pod_req, pod_eligible,
+                low, high, weights, rdims_onehot,
+                max_evictions,
+                use_deviation: bool = False, node_fit: bool = True,
+                fit_dims: tuple = None):
+    """The full balance plan as one jitted program.
+
+    Shapes: usage/capacity f32[N, R]; pod_* over P pods with
+    pod_usage_r f32[P, Rd] already restricted to the threshold dims;
+    rdims_onehot f32[Rd, R] selects those dims out of R columns;
+    low/high/weights f32[Rd]. Returns (take bool[P], order i32[P]):
+    take[p] marks planned pods, order is the global eviction order (the
+    plan is `[int(i) for i in order if take[i]]`).
+    """
+    eps = 1e-9
+    sel = lambda x: x @ rdims_onehot.T                    # [.., R]->[.., Rd]
+    pct = 100.0 * sel(usage) / jnp.maximum(sel(capacity), eps)  # [N, Rd]
+    if use_deviation:
+        nf = jnp.maximum(fresh.sum(), 1)
+        avg = jnp.where(fresh[:, None], pct, 0.0).sum(0) / nf
+        low = jnp.clip(avg - low, 0.0, 100.0)
+        high = jnp.clip(avg + high, 0.0, 100.0)
+    low_mask = fresh & (pct < low[None, :]).all(1)        # [N]
+    high_mask = fresh & (pct > high[None, :]).any(1)      # [N]
+    high_abs = sel(capacity) * high[None, :] / 100.0      # [N, Rd]
+    source = source_mask & high_mask                      # [N]
+
+    # budget: spare headroom under the HIGH threshold of destinations
+    budget0 = jnp.where(low_mask[:, None],
+                        high_abs - sel(usage), 0.0).sum(0)  # [Rd]
+
+    # node_fit: pod must fit on >= 1 underutilized node, against
+    # allocatable - Σ requests of that node's pods. `fit_dims` (static)
+    # restricts the [P, N, R] comparison to dims ANY pod requests —
+    # exact, because an unrequested dim compares 0 <= capacity + 0.5,
+    # always true (the scheduler bench's fit_dims argument, same idea).
+    if node_fit:
+        node_req = jnp.zeros_like(capacity).at[pod_node].add(pod_req)
+        dest_free = capacity - node_req                   # [N, R]
+        fd = list(fit_dims) if fit_dims is not None else slice(None)
+        fits_pn = (pod_req[:, None, fd] <= dest_free[None][:, :, fd]
+                   + 0.5).all(-1)                         # [P, N]
+        fits = (fits_pn & low_mask[None, :]).any(-1)      # [P]
+        pod_eligible = pod_eligible & fits
+
+    active = pod_eligible & source[pod_node]              # [P]
+
+    # --- global eviction order: source nodes by weighted usage%% desc,
+    # pods within a node by weighted usage desc (stable = list order) --
+    node_w = (pct * weights[None, :]).sum(1)              # [N]
+    n = usage.shape[0]
+    src_rank = jnp.zeros((n,), jnp.int32).at[
+        jnp.argsort(-jnp.where(source, node_w, -jnp.inf))].set(
+        jnp.arange(n, dtype=jnp.int32))
+    pod_w = (pod_usage_r * weights[None, :]).sum(1)       # [P]
+    ord1 = jnp.argsort(-pod_w, stable=True)
+    order = ord1[jnp.argsort(src_rank[pod_node[ord1]], stable=True)]
+
+    ns = pod_node[order]                                  # sorted node ids
+    x = jnp.where(active[order, None], pod_usage_r[order], 0.0)  # [P, Rd]
+
+    # segment (per-node) EXCLUSIVE cumsum along the sorted order
+    ex = jnp.cumsum(x, 0) - x
+    p = x.shape[0]
+    is_start = jnp.concatenate(
+        [jnp.ones((1,), bool), ns[1:] != ns[:-1]])
+    start_idx = lax_cummax(jnp.where(is_start,
+                                     jnp.arange(p, dtype=jnp.int32), -1))
+    seg_ex = ex - ex[jnp.maximum(start_idx, 0)]           # [P, Rd]
+
+    # node prefix: evict while the node is STILL over before this pod
+    still_over = ((sel(usage)[ns] - seg_ex) > high_abs[ns]).any(1)  # [P]
+    take0 = active[order] & still_over
+
+    # budget prefix (and per-cycle cap): both monotone along the order
+    taken_x = jnp.where(take0[:, None], pod_usage_r[order], 0.0)
+    cum_before = jnp.cumsum(taken_x, 0) - taken_x
+    budget_ok = (budget0[None, :] - cum_before > 0.0).all(1)
+    cnt_before = jnp.cumsum(take0.astype(jnp.int32)) - take0
+    take_sorted = take0 & budget_ok & (cnt_before < max_evictions)
+
+    take = jnp.zeros((p,), bool).at[order].set(take_sorted)
+    return take, order
+
+
+def lax_cummax(x: jnp.ndarray) -> jnp.ndarray:
+    return jax.lax.associative_scan(jnp.maximum, x)
+
+
+def columnarize(nodes: Sequence[api.Node],
+                metrics: Mapping[str, api.NodeMetric],
+                pods_by_node: Mapping[str, Sequence[api.Pod]],
+                args: LowNodeLoadArgs,
+                usage: np.ndarray, capacity: np.ndarray,
+                fresh: np.ndarray) -> Optional[dict]:
+    """Typed host objects -> the kernel's POD columns (the node columns
+    come in prebuilt from LowNodeLoad.node_columns, so flattening
+    happens once). No per-pod decision logic here — that is the
+    kernel's job. Pod usage is collected from EVERY NodeMetric,
+    expired or not, matching the host plugin's pod_usage build (only
+    node freshness gates classification)."""
+    rdims = sorted({int(k) for k in args.high_thresholds})
+    name_to_idx = {node.meta.name: i for i, node in enumerate(nodes)}
+    pod_usage_map: Dict[str, np.ndarray] = {}
+    for name in name_to_idx:
+        m = metrics.get(name)
+        if m is not None:
+            for pm in m.pods_metric:
+                pod_usage_map[pm.namespaced_name] = resource_vec(pm.usage)
+
+    pods: List[api.Pod] = []
+    pod_node_l: List[int] = []
+    for name, plist in pods_by_node.items():
+        i = name_to_idx.get(name)
+        if i is None:
+            continue
+        for pod in plist:
+            pods.append(pod)
+            pod_node_l.append(i)
+    p = len(pods)
+    if p == 0:
+        return None
+    pod_node = np.asarray(pod_node_l, np.int32)
+    pod_req = np.zeros((p, NUM_RESOURCES), np.float32)
+    pod_usage_r = np.zeros((p, len(rdims)), np.float32)
+    pod_eligible = np.zeros((p,), bool)
+    for j, pod in enumerate(pods):
+        pod_req[j] = resource_vec(pod.requests)
+        u = pod_usage_map.get(pod.meta.namespaced_name)
+        if u is None:
+            u = pod_req[j]
+        pod_usage_r[j] = u[rdims]
+        pod_eligible[j] = not pod.is_daemonset and (
+            args.pod_filter is None or args.pod_filter(pod))
+
+    low = np.array([args.low_thresholds.get(ResourceKind(d), 0.0)
+                    for d in rdims], np.float32)
+    high = np.array([args.high_thresholds.get(ResourceKind(d), 100.0)
+                     for d in rdims], np.float32)
+    weights = np.array([args.resource_weights.get(ResourceKind(d), 0.0)
+                        for d in rdims], np.float32)
+    rdims_onehot = np.zeros((len(rdims), NUM_RESOURCES), np.float32)
+    rdims_onehot[np.arange(len(rdims)), rdims] = 1.0
+    fit_dims = tuple(int(d) for d in np.flatnonzero(pod_req.any(0)))
+    return dict(usage=usage, capacity=capacity, fresh=fresh,
+                pod_node=pod_node, pod_usage_r=pod_usage_r,
+                pod_req=pod_req, pod_eligible=pod_eligible,
+                low=low, high=high, weights=weights,
+                rdims_onehot=rdims_onehot, pods=pods,
+                fit_dims=fit_dims)
+
+
+class DeviceLowNodeLoad(LowNodeLoad):
+    """LowNodeLoad with the balance plan computed on device.
+
+    Classification for the anomaly counters reuses the host classify()
+    (cheap, stateful); the eviction selection — the O(N x P) part — is
+    one jitted program. Falls back to the host loop when the evictor
+    carries per-node/per-namespace limits the kernel does not model.
+    """
+
+    name = "LowNodeLoad"
+
+    def _device_cap(self) -> Optional[int]:
+        """max_per_cycle when device planning is sound, else None."""
+        limiter = getattr(self.evictor, "limiter", None)
+        if limiter is None:
+            return 1 << 30
+        if (limiter.max_per_node is not None
+                or limiter.max_per_namespace is not None):
+            return None
+        if limiter.max_per_cycle is None:
+            return 1 << 30
+        return limiter.max_per_cycle - limiter._total
+
+    def balance_once(self, nodes, metrics, pods_by_node, now):
+        args = self.args
+        # the host plugin never consults the evictor in dry_run —
+        # neither may the device cap (golden parity)
+        cap = (1 << 30) if args.dry_run else self._device_cap()
+        if cap is None:
+            return super().balance_once(nodes, metrics, pods_by_node,
+                                        now)
+        if not nodes:
+            return []
+        # ONE flattening pass; anomaly gating stays host-side
+        # (stateful across cycles)
+        usage, capacity, fresh = self.node_columns(nodes, metrics, now)
+        _, _, low_mask, high_mask, _ = self.classify_columns(
+            usage, capacity, fresh)
+        names = [nd.meta.name for nd in nodes]
+        source_mask = self._gate_anomalies(names, high_mask)
+        if not low_mask.any() or not source_mask.any():
+            return []
+        cols = columnarize(nodes, metrics, pods_by_node, args,
+                           usage, capacity, fresh)
+        if cols is None:
+            return []
+        pods = cols.pop("pods")
+        pod_node = cols["pod_node"]
+        take, order = plan_kernel(
+            source_mask=source_mask,
+            max_evictions=np.int32(max(cap, 0)),
+            use_deviation=args.use_deviation_thresholds,
+            node_fit=args.node_fit, **cols)
+        take = np.asarray(take)
+        sel_idx = [int(i) for i in np.asarray(order) if take[int(i)]]
+        selected = [pods[i] for i in sel_idx]
+        if not args.dry_run and self.evictor is not None:
+            for i in sel_idx:
+                self.evictor.evict(
+                    pods[i], f"node {names[int(pod_node[i])]} is "
+                             f"overutilized")
+        return selected
