@@ -1,0 +1,301 @@
+"""Wire-format conformance for the scheduler sidecar (VERDICT r3 #5).
+
+The BASELINE architecture has the GO control plane calling this sidecar
+(framework_extender.go:167-292 is the seam being replaced), so the wire
+must be implementable without Python. These tests pin it three ways
+against FROZEN byte fixtures in tests/fixtures/sidecar/:
+
+1. decode: the frozen request frames — bytes exactly as a foreign
+   client would put them on the socket — parse with the documented
+   framing rules (re-implemented here, independent of rpc.py) and
+   decode to the expected semantic values;
+2. encode: serializing the same canonical objects today reproduces the
+   frozen bytes bit-for-bit — any library/layout change that would
+   break a non-Python peer fails loudly;
+3. serve: the frozen frames drive a LIVE SchedulerSidecarServer over a
+   raw unix socket and yield well-formed responses.
+
+The framing and payload layout are documented for implementers in
+docs/SIDECAR_WIRE.md. Regenerate fixtures (after a DELIBERATE wire
+change) with:  python tests/test_sidecar_wire.py --regen
+"""
+
+import json
+import os
+import socket
+import struct
+
+import flax.serialization
+import numpy as np
+
+from koordinator_tpu.api.extension import NUM_RESOURCES
+from koordinator_tpu.snapshot.delta import NodeMetricDelta
+from koordinator_tpu.snapshot.schema import (
+    NUM_AGG,
+    PodBatch,
+    zeros_snapshot,
+)
+
+FIXDIR = os.path.join(os.path.dirname(__file__), "fixtures", "sidecar")
+R = NUM_RESOURCES
+
+
+# --- canonical objects (hand-built, zero randomness) ------------------------
+
+
+def canonical_snapshot():
+    """2 nodes, 2 quotas, 1 gang: every capacity axis tiny but real."""
+    snap = zeros_snapshot(num_nodes=2, num_quotas=2, num_gangs=1,
+                          num_reservations=1, num_zones=2)
+    alloc = np.zeros((2, R), np.float32)
+    alloc[:, 0] = (16000.0, 8000.0)   # cpu milli
+    alloc[:, 1] = (32768.0, 16384.0)  # memory MiB
+    usage = np.zeros((2, R), np.float32)
+    usage[:, 0] = (2000.0, 1000.0)
+    nodes = snap.nodes.replace(
+        allocatable=alloc, usage=usage,
+        metric_fresh=np.array([True, True]),
+        schedulable=np.array([True, True]))
+    quotas = snap.quotas.replace(valid=np.array([True, False]))
+    return snap.replace(nodes=nodes, quotas=quotas)
+
+
+def canonical_delta():
+    z = np.zeros((1, R), np.float32)
+    usage = z.copy()
+    usage[0, 0] = 3000.0
+    return NodeMetricDelta(
+        idx=np.array([0], np.int32),
+        metric_fresh=np.array([True]),
+        usage=usage, prod_usage=z.copy(),
+        agg_usage=np.zeros((1, NUM_AGG, R), np.float32),
+        has_agg=np.array([False]),
+        assigned_estimated=z.copy(), assigned_correction=z.copy(),
+        prod_assigned_estimated=z.copy(),
+        prod_assigned_correction=z.copy())
+
+
+def canonical_pods():
+    """2 pods; has_taints=True pins bit 0 of the gate_flags transport."""
+    p = 2
+    f32, i32 = np.float32, np.int32
+    requests = np.zeros((p, R), f32)
+    requests[:, 0] = (1000.0, 2000.0)
+    requests[:, 1] = (512.0, 1024.0)
+    estimated = np.zeros((p, R), f32)
+    estimated[:, 0] = (850.0, 1700.0)
+    estimated[:, 1] = (512.0, 1024.0)
+    return PodBatch(
+        requests=requests, estimated=estimated,
+        qos=np.array([4, 4], np.int8),
+        priority_class=np.array([4, 4], np.int8),
+        priority=np.array([9100, 9050], i32),
+        gang_id=np.full((p,), -1, i32),
+        quota_id=np.array([0, -1], i32),
+        selector_id=np.full((p,), -1, i32),
+        selector_match=np.zeros((1, 1), bool),
+        reservation_owner=np.full((p,), -1, i32),
+        gpu_ratio=np.zeros((p,), f32),
+        numa_single=np.zeros((p,), bool),
+        daemonset=np.zeros((p,), bool),
+        toleration_id=np.zeros((p,), i32),
+        tol_forbid=np.zeros((1, 1), bool),
+        tol_prefer=np.zeros((1, 1), f32),
+        spread_id=np.full((p,), -1, i32),
+        spread_member=np.zeros((p, 1), bool),
+        spread_max_skew=np.ones((1,), f32),
+        spread_domain=np.full((1, 1), -1, i32),
+        spread_count0=np.zeros((1, 1), f32),
+        spread_dvalid=np.zeros((1, 1), bool),
+        anti_id=np.full((p,), -1, i32),
+        anti_member=np.zeros((p, 1), bool),
+        anti_carrier=np.zeros((p, 1), bool),
+        anti_domain=np.full((1, 1), -1, i32),
+        anti_count0=np.zeros((1, 1), f32),
+        anti_carrier_count0=np.zeros((1, 1), f32),
+        aff_id=np.full((p,), -1, i32),
+        aff_member=np.zeros((p, 1), bool),
+        aff_domain=np.full((1, 1), -1, i32),
+        aff_count0=np.zeros((1, 1), f32),
+        valid=np.ones((p,), bool),
+        has_taints=True)
+
+
+# --- the documented framing, re-implemented independently of rpc.py ---------
+
+
+def frame(method: str, proto_bytes: bytes) -> bytes:
+    """request frame := u32_be(len) ++ u8(len(method)) ++ method ++ body"""
+    name = method.encode()
+    payload = bytes([len(name)]) + name + proto_bytes
+    return struct.pack(">I", len(payload)) + payload
+
+
+def unframe_request(buf: bytes):
+    (length,) = struct.unpack(">I", buf[:4])
+    payload = buf[4:4 + length]
+    assert len(payload) == length, "frame length mismatch"
+    mlen = payload[0]
+    return payload[1:1 + mlen].decode(), payload[1 + mlen:]
+
+
+def build_request_frames() -> dict:
+    from koordinator_tpu.scheduler import sidecar_pb2 as pb
+    from koordinator_tpu.scheduler.sidecar import _pack_gate_flags
+
+    pods = canonical_pods()
+    return {
+        "publish_request.bin": frame(
+            "PublishSnapshot",
+            pb.PublishSnapshotRequest(
+                snapshot_msgpack=flax.serialization.to_bytes(
+                    canonical_snapshot())).SerializeToString()),
+        "ingest_request.bin": frame(
+            "IngestDelta",
+            pb.IngestDeltaRequest(
+                delta_msgpack=flax.serialization.to_bytes(
+                    canonical_delta())).SerializeToString()),
+        "schedule_request.bin": frame(
+            "Schedule",
+            pb.ScheduleRequest(
+                pods_msgpack=flax.serialization.to_bytes(pods),
+                pod_names=["pod-a", "pod-b"],
+                gate_flags=_pack_gate_flags(pods)).SerializeToString()),
+        "summary_request.bin": frame(
+            "Summary", b""),
+    }
+
+
+def _read(name: str) -> bytes:
+    with open(os.path.join(FIXDIR, name), "rb") as f:
+        return f.read()
+
+
+# --- 1. decode: frozen foreign bytes -> expected semantics ------------------
+
+
+def test_frozen_publish_request_decodes():
+    from koordinator_tpu.scheduler import sidecar_pb2 as pb
+
+    method, body = unframe_request(_read("publish_request.bin"))
+    assert method == "PublishSnapshot"
+    req = pb.PublishSnapshotRequest.FromString(body)
+    snap = flax.serialization.from_bytes(zeros_snapshot(num_nodes=1),
+                                         req.snapshot_msgpack)
+    alloc = np.asarray(snap.nodes.allocatable)
+    assert alloc.shape == (2, R) and alloc.dtype == np.float32
+    assert alloc[0, 0] == 16000.0 and alloc[1, 1] == 16384.0
+    assert np.asarray(snap.quotas.valid).tolist() == [True, False]
+
+
+def test_frozen_ingest_request_decodes():
+    from koordinator_tpu.scheduler import sidecar_pb2 as pb
+    from koordinator_tpu.scheduler.sidecar import _flat_template
+
+    method, body = unframe_request(_read("ingest_request.bin"))
+    assert method == "IngestDelta"
+    req = pb.IngestDeltaRequest.FromString(body)
+    delta = flax.serialization.from_bytes(_flat_template(NodeMetricDelta),
+                                          req.delta_msgpack)
+    assert np.asarray(delta.idx).tolist() == [0]
+    assert np.asarray(delta.usage)[0, 0] == 3000.0
+
+
+def test_frozen_schedule_request_decodes():
+    from koordinator_tpu.scheduler import sidecar_pb2 as pb
+    from koordinator_tpu.scheduler.sidecar import (
+        _apply_gate_flags,
+        _flat_template,
+    )
+
+    method, body = unframe_request(_read("schedule_request.bin"))
+    assert method == "Schedule"
+    req = pb.ScheduleRequest.FromString(body)
+    assert list(req.pod_names) == ["pod-a", "pod-b"]
+    assert req.gate_flags == 1  # bit0 = has_taints
+    pods = _apply_gate_flags(
+        flax.serialization.from_bytes(_flat_template(PodBatch),
+                                      req.pods_msgpack),
+        req.gate_flags)
+    assert pods.has_taints and not pods.has_spread
+    assert np.asarray(pods.requests)[1, 0] == 2000.0
+    assert np.asarray(pods.priority).tolist() == [9100, 9050]
+
+
+# --- 2. encode: today's serialization == frozen bytes -----------------------
+
+
+def test_encoding_is_wire_stable():
+    """Bit-for-bit: a library or layout change that would break a
+    non-Python peer must fail HERE, not in production. Regenerate the
+    fixtures only for a deliberate, documented wire change."""
+    for name, data in build_request_frames().items():
+        frozen = _read(name)
+        assert data == frozen, (
+            f"{name}: serialization drifted from the frozen wire bytes "
+            f"({len(data)} vs {len(frozen)} bytes); if this change is "
+            f"intentional, regenerate with "
+            f"`python tests/test_sidecar_wire.py --regen` and document "
+            f"it in docs/SIDECAR_WIRE.md")
+
+
+# --- 3. serve: the frozen frames drive a live server ------------------------
+
+
+def test_frozen_frames_drive_a_live_server(tmp_path):
+    from koordinator_tpu.scheduler import sidecar_pb2 as pb
+    from koordinator_tpu.scheduler.frameworkext import SchedulerService
+    from koordinator_tpu.scheduler.sidecar import SchedulerSidecarServer
+
+    service = SchedulerService(num_rounds=2, k_choices=2)
+    server = SchedulerSidecarServer(service, str(tmp_path / "s.sock"))
+    try:
+        def roundtrip(name):
+            s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+            s.settimeout(120.0)
+            s.connect(server.sock_path)
+            s.sendall(_read(name))
+            (ln,) = struct.unpack(">I", _recv_exact(s, 4))
+            raw = _recv_exact(s, ln)
+            s.close()
+            assert raw[0] == 0, raw[1:].decode(errors="replace")
+            return raw[1:]
+
+        resp = pb.PublishSnapshotResponse.FromString(
+            roundtrip("publish_request.bin"))
+        assert resp.version == 1
+        resp = pb.IngestDeltaResponse.FromString(
+            roundtrip("ingest_request.bin"))
+        assert resp.version == 2
+        sched = pb.ScheduleResponse.FromString(
+            roundtrip("schedule_request.bin"))
+        assert len(sched.assignment) == 2
+        assert all(a in (0, 1) for a in sched.assignment)
+        assert sched.snapshot_version == 3
+        resp = pb.SummaryResponse.FromString(
+            roundtrip("summary_request.bin"))
+        assert json.loads(resp.json)["podsPlaced"] == sum(
+            1 for a in sched.assignment if a >= 0)
+    finally:
+        server.close()
+
+
+def _recv_exact(s: socket.socket, n: int) -> bytes:
+    buf = b""
+    while len(buf) < n:
+        chunk = s.recv(n - len(buf))
+        assert chunk, "connection closed mid-frame"
+        buf += chunk
+    return buf
+
+
+if __name__ == "__main__":
+    import sys
+    if "--regen" in sys.argv:
+        os.makedirs(FIXDIR, exist_ok=True)
+        for name, data in build_request_frames().items():
+            with open(os.path.join(FIXDIR, name), "wb") as f:
+                f.write(data)
+            print(f"wrote {name} ({len(data)} bytes)")
+    else:
+        print(__doc__)
