@@ -44,7 +44,7 @@ import numpy as np
 NUM_NODES = int(os.environ.get("BENCH_NODES", 10_000))
 NUM_PODS = int(os.environ.get("BENCH_PODS", 100_000))
 CHUNK = int(os.environ.get("BENCH_CHUNK", 2_000))
-FULL_CHUNK = int(os.environ.get("BENCH_FULL_CHUNK", 2_000))
+FULL_CHUNK = int(os.environ.get("BENCH_FULL_CHUNK", CHUNK))
 MIN_TAIL_PASSES = 2   # always run (keeps the tail program warm)
 MAX_TAIL_PASSES = int(os.environ.get("BENCH_MAX_TAIL_PASSES", 6))
 BASELINE_SECONDS = 2.0

@@ -17,7 +17,9 @@ from koordinator_tpu.snapshot.schema import (  # noqa: F401
 from koordinator_tpu.snapshot.builder import SnapshotBuilder  # noqa: F401
 from koordinator_tpu.snapshot.delta import (  # noqa: F401
     NodeMetricDelta,
+    NodeTopologyDelta,
     apply_metric_delta,
+    apply_topology_delta,
     forget_pods,
 )
 from koordinator_tpu.snapshot.store import SnapshotStore  # noqa: F401

@@ -207,8 +207,16 @@ class SnapshotBuilder:
         # pods are estimated (load_aware.go:355-360 fourth clause)
         self.score_with_aggregation = score_with_aggregation
 
-        self.nodes: List[Node] = []
+        self.nodes: List[Optional[Node]] = []  # None = freed row
         self.node_index: Dict[str, int] = {}
+        # incremental topology state: freed rows for reuse, rows of
+        # recently-removed nodes awaiting their zeroing delta, and the
+        # persistent group/id tables that keep incremental rows
+        # consistent with the last full build's snapshot
+        self._free_rows: List[int] = []
+        self._removed_rows: Dict[str, int] = {}
+        self._label_groups: Dict[frozenset, int] = {}
+        self._pcie_ids: Dict[str, int] = {}
         self.metrics: Dict[str, NodeMetric] = {}
         self.running_pods: List[Pod] = []
         self.assigned: List[AssignedPod] = []
@@ -224,11 +232,35 @@ class SnapshotBuilder:
     # --- ingest -------------------------------------------------------------
 
     def add_node(self, node: Node) -> int:
-        if len(self.nodes) >= self.max_nodes:
-            raise ValueError("node capacity exceeded")
-        idx = len(self.nodes)
-        self.nodes.append(node)
+        """Upsert: a known name updates its row in place; a new name
+        reuses a freed row before growing (rows stay stable so device
+        columns can be patched incrementally)."""
+        existing = self.node_index.get(node.meta.name)
+        if existing is not None:
+            self.nodes[existing] = node
+            return existing
+        if self._free_rows:
+            idx = self._free_rows.pop()
+            self.nodes[idx] = node
+        else:
+            if len(self.nodes) >= self.max_nodes:
+                raise ValueError("node capacity exceeded")
+            idx = len(self.nodes)
+            self.nodes.append(node)
         self.node_index[node.meta.name] = idx
+        self._removed_rows.pop(node.meta.name, None)
+        return idx
+
+    def remove_node(self, name: str) -> int:
+        """Free a node's row (the incremental topology path). The row id
+        is stashed so topology_delta() emits its zeroing row; the row is
+        reused by later add_node calls."""
+        idx = self.node_index.pop(name)
+        self.nodes[idx] = None
+        self._free_rows.append(idx)
+        self._removed_rows[name] = idx
+        self.metrics.pop(name, None)
+        self.devices.pop(name, None)
         return idx
 
     def set_node_metric(self, metric: NodeMetric) -> None:
@@ -278,38 +310,73 @@ class SnapshotBuilder:
 
     # --- build: nodes -------------------------------------------------------
 
+    def _label_group_id(self, node: Node) -> int:
+        key = frozenset(node.meta.labels.items())
+        groups = self._label_groups
+        if key not in groups:
+            if len(groups) >= self.max_label_groups:
+                raise ValueError(
+                    f"distinct node label sets exceed max_label_groups="
+                    f"{self.max_label_groups}")
+            groups[key] = len(groups)
+        return groups[key]
+
+    def _taint_group_id(self, node: Node) -> int:
+        key = tuple(sorted((t.key, t.value, t.effect)
+                           for t in node.taints))
+        groups = self._taint_groups
+        if key not in groups:
+            if len(groups) >= self.max_taint_groups:
+                raise ValueError(
+                    f"distinct node taint sets exceed max_taint_groups="
+                    f"{self.max_taint_groups}")
+            groups[key] = len(groups)
+        return groups[key]
+
     def _node_label_groups(self) -> Tuple[np.ndarray, Dict[frozenset, int]]:
         lab_ids = np.zeros((self.max_nodes,), np.int32)
-        groups: Dict[frozenset, int] = {}
+        self._label_groups = {}
         for i, node in enumerate(self.nodes):
-            key = frozenset(node.meta.labels.items())
-            if key not in groups:
-                if len(groups) >= self.max_label_groups:
-                    raise ValueError(
-                        f"distinct node label sets exceed max_label_groups="
-                        f"{self.max_label_groups}")
-                groups[key] = len(groups)
-            lab_ids[i] = groups[key]
-        return lab_ids, groups
+            if node is None:
+                continue
+            lab_ids[i] = self._label_group_id(node)
+        return lab_ids, self._label_groups
 
     def _node_taint_groups(self) -> np.ndarray:
         """Partition nodes by taint set (TaintToleration gate; group 0 is
         always the untainted set so toleration-less pods ride row 0 of
-        all-False matrices). Stashes the group dict for build()."""
+        all-False matrices). Stashes the group dict for build() and for
+        incremental topology rows."""
         ids = np.zeros((self.max_nodes,), np.int32)
-        groups: Dict[tuple, int] = {(): 0}
+        self._taint_groups = {(): 0}
         for i, node in enumerate(self.nodes):
-            key = tuple(sorted((t.key, t.value, t.effect)
-                               for t in node.taints))
-            if key not in groups:
-                if len(groups) >= self.max_taint_groups:
-                    raise ValueError(
-                        f"distinct node taint sets exceed max_taint_groups="
-                        f"{self.max_taint_groups}")
-                groups[key] = len(groups)
-            ids[i] = groups[key]
-        self._taint_groups = groups
+            if node is None:
+                continue
+            ids[i] = self._taint_group_id(node)
         return ids
+
+    def _fill_identity_row(self, node: Node, i: int, alloc, schedulable,
+                           cpu_amp, numa_cap, numa_valid,
+                           numa_policy) -> None:
+        """One node's identity columns, written into row i of the given
+        arrays — shared by the full build and topology_delta so the two
+        paths cannot drift."""
+        z = self.max_zones
+        alloc[i] = resource_vec(node.allocatable)
+        schedulable[i] = not node.unschedulable
+        # amplification ratio (resource-amplification-ratio annotation,
+        # published by the node webhook alongside AMPLIFIED allocatable;
+        # nodenumaresource util.go:65-85) — the shared parser, so
+        # host preemption's dry run and the device gate agree.
+        cpu_amp[i] = node_cpu_amplification_ratio(node.meta.annotations)
+        if node.topology is not None:
+            for j, zone in enumerate(node.topology.zones[:z]):
+                numa_cap[i, j, 0] = zone.cpus_milli
+                numa_cap[i, j, 1] = zone.memory_mib
+                numa_valid[i, j] = True
+            # kubelet/NRT topology policy -> the scheduler-side
+            # topology manager (numa_aware.go GetNodeNUMATopologyPolicy)
+            numa_policy[i] = numa_policy_code(node.topology.policy)
 
     def build_nodes(self, now: Optional[float] = None) -> Tuple[NodeState, Dict[frozenset, int]]:
         now = time.time() if now is None else now
@@ -332,22 +399,10 @@ class SnapshotBuilder:
 
         cpu_amp = np.ones((n,), np.float32)
         for i, node in enumerate(self.nodes):
-            alloc[i] = resource_vec(node.allocatable)
-            schedulable[i] = not node.unschedulable
-            # amplification ratio (resource-amplification-ratio annotation,
-            # published by the node webhook alongside AMPLIFIED allocatable;
-            # nodenumaresource util.go:65-85) — the shared parser, so
-            # host preemption's dry run and the device gate agree.
-            cpu_amp[i] = node_cpu_amplification_ratio(
-                node.meta.annotations)
-            if node.topology is not None:
-                for j, zone in enumerate(node.topology.zones[:z]):
-                    numa_cap[i, j, 0] = zone.cpus_milli
-                    numa_cap[i, j, 1] = zone.memory_mib
-                    numa_valid[i, j] = True
-                # kubelet/NRT topology policy -> the scheduler-side
-                # topology manager (numa_aware.go GetNodeNUMATopologyPolicy)
-                numa_policy[i] = numa_policy_code(node.topology.policy)
+            if node is None:
+                continue
+            self._fill_identity_row(node, i, alloc, schedulable, cpu_amp,
+                                    numa_cap, numa_valid, numa_policy)
 
         numa_used = np.zeros((n, z, 2), np.float32)
         for pod in self.running_pods:
@@ -606,6 +661,169 @@ class SnapshotBuilder:
             assigned_correction=corr, prod_assigned_estimated=p_est,
             prod_assigned_correction=p_corr)
 
+    def topology_delta(self, names: Sequence[str],
+                       now: Optional[float] = None,
+                       pad_to: Optional[int] = None) -> "NodeTopologyDelta":
+        """Node add/remove/update as an O(K) column delta (snapshot/
+        delta.py NodeTopologyDelta): for each name, the node's complete
+        identity + device + metric row exactly as a full rebuild would
+        produce it — a name no longer present emits its zeroing row
+        (remove_node stashed the freed row id). Row-for-row parity with
+        the full rebuild is pinned by tests/test_topology_delta.py.
+
+        Cost: O(K) array rows plus one linear pass over running pods /
+        reservations restricted to the K nodes — never O(max_nodes)."""
+        from koordinator_tpu.snapshot.delta import (
+            NodeMetricDelta,
+            NodeTopologyDelta,
+        )
+
+        now = time.time() if now is None else now
+        k = pad_to if pad_to is not None else max(len(names), 1)
+        if len(names) > k:
+            raise ValueError(
+                f"{len(names)} topology updates exceed pad_to={k}")
+        r, z = NUM_RESOURCES, self.max_zones
+        gi, aj = self.max_gpu_inst, self.max_aux_inst
+        f32 = np.float32
+        idx = np.full((k,), -1, np.int32)
+        alloc = np.zeros((k, r), f32)
+        requested = np.zeros((k, r), f32)
+        schedulable = np.zeros((k,), bool)
+        label_group = np.zeros((k,), np.int32)
+        taint_group = np.zeros((k,), np.int32)
+        numa_cap = np.zeros((k, z, 2), f32)
+        numa_valid = np.zeros((k, z), bool)
+        numa_policy = np.zeros((k,), np.int32)
+        cpu_amp = np.ones((k,), f32)
+        gpu_total = np.zeros((k, NUM_DEV_DIMS), f32)
+        gpu_free = np.zeros((k, gi, NUM_DEV_DIMS), f32)
+        gpu_valid = np.zeros((k, gi), bool)
+        gpu_numa = np.full((k, gi), -1, np.int32)
+        gpu_pcie = np.full((k, gi), -1, np.int32)
+        aux_free = np.zeros((k, NUM_AUX_TYPES, aj), f32)
+        aux_valid = np.zeros((k, NUM_AUX_TYPES, aj), bool)
+
+        present = {n: j for j, n in enumerate(names)
+                   if n in self.node_index}
+        # one filtered pass: requested + zone usage of running pods /
+        # reservations landing on the K nodes (mirrors build_nodes)
+        numa_used = np.zeros((k, z, 2), f32)
+        amp_of = {n: node_cpu_amplification_ratio(
+            self.nodes[self.node_index[n]].meta.annotations)
+            for n in present}
+        running_here: Dict[str, List[Pod]] = {}
+        for pod in self.running_pods:
+            j = present.get(pod.node_name)
+            if j is None:
+                continue
+            running_here.setdefault(pod.node_name, []).append(pod)
+            rv = resource_vec(pod.requests)
+            zi = pod.allocated_numa_zone
+            if pod.required_cpu_bind and 0 <= zi < z:
+                numa_used[j, zi, 0] += rv[int(ResourceKind.CPU)]
+                numa_used[j, zi, 1] += rv[int(ResourceKind.MEMORY)]
+            if pod.required_cpu_bind and amp_of[pod.node_name] > 1.0:
+                rv = rv.copy()
+                rv[int(ResourceKind.CPU)] *= amp_of[pod.node_name]
+            requested[j] += rv
+        for res in self.reservations:
+            j = present.get(res.node_name)
+            if j is not None and res.phase == "Available":
+                requested[j] += np.maximum(
+                    resource_vec(res.requests)
+                    - resource_vec(res.allocated), 0.0)
+
+        pods_per_node = self._pods_per_node()
+        fresh = np.zeros((k,), bool)
+        usage = np.zeros((k, r), f32)
+        prod_usage = np.zeros((k, r), f32)
+        agg = np.zeros((k, NUM_AGG, r), f32)
+        has_agg = np.zeros((k,), bool)
+        est = np.zeros((k, r), f32)
+        corr = np.zeros((k, r), f32)
+        p_est = np.zeros((k, r), f32)
+        p_corr = np.zeros((k, r), f32)
+        gc, gm = int(ResourceKind.GPU_CORE), int(ResourceKind.GPU_MEMORY)
+        for jrow, name in enumerate(names):
+            ni = self.node_index.get(name)
+            if ni is None:
+                freed = self._removed_rows.pop(name, None)
+                # a freed row already REUSED by another node in this
+                # same delta window must not also get a zeroing row:
+                # duplicate scatter targets are nondeterministic in
+                # jnp .at[].set — the occupant's row supersedes it
+                if freed is not None and self.nodes[freed] is None:
+                    idx[jrow] = freed  # zeroing row: defaults stand
+                continue
+            node = self.nodes[ni]
+            idx[jrow] = ni
+            self._fill_identity_row(node, jrow, alloc, schedulable,
+                                    cpu_amp, numa_cap, numa_valid,
+                                    numa_policy)
+            label_group[jrow] = self._label_group_id(node)
+            taint_group[jrow] = self._taint_group_id(node)
+            device = self.devices.get(name)
+            if device is not None:
+                self._fill_device_row(name, device, jrow, gpu_total,
+                                      gpu_free, gpu_valid, gpu_numa,
+                                      gpu_pcie, aux_free, aux_valid)
+                # running-pod grants shrink instance free (build_devices)
+                for pod in running_here.get(name, []):
+                    if pod.allocated_gpu_minors:
+                        _, per_inst = gpu_per_instance_host(
+                            gpu_total[jrow, DEV_MEM], pod)
+                        for minor in pod.allocated_gpu_minors:
+                            if 0 <= minor < gi:
+                                gpu_free[jrow, minor] = np.maximum(
+                                    gpu_free[jrow, minor] - per_inst, 0.0)
+                    for t, inst in ((AUX_RDMA, pod.allocated_rdma_inst),
+                                    (AUX_FPGA, pod.allocated_fpga_inst)):
+                        kind = (ResourceKind.RDMA if t == AUX_RDMA
+                                else ResourceKind.FPGA)
+                        a_req = float(pod.requests.get(kind, 0.0))
+                        if a_req > 0 and 0 <= inst < aj:
+                            aux_free[jrow, t, inst] = max(
+                                aux_free[jrow, t, inst] - a_req, 0.0)
+                # aggregate device capacity rides node allocatable
+                # unless the Node already reported it (build())
+                vc = float(gpu_valid[jrow].sum())
+                if alloc[jrow, gc] == 0:
+                    alloc[jrow, gc] = gpu_total[jrow, DEV_CORE] * vc
+                if alloc[jrow, gm] == 0:
+                    alloc[jrow, gm] = gpu_total[jrow, DEV_MEM] * vc
+                for kind, typ in ((ResourceKind.RDMA, "rdma"),
+                                  (ResourceKind.FPGA, "fpga")):
+                    kk = int(kind)
+                    if alloc[jrow, kk] == 0:
+                        alloc[jrow, kk] = sum(
+                            float(info.resources.get(kind, 100.0))
+                            for info in device.devices
+                            if info.type == typ and info.health)
+            metric = self.metrics.get(name)
+            if metric is not None:
+                row = self._metric_row(name, metric, now, pods_per_node)
+                if row is not None:
+                    (fresh[jrow], usage[jrow], prod_usage[jrow],
+                     agg[jrow], has_agg[jrow], est[jrow], corr[jrow],
+                     p_est[jrow], p_corr[jrow]) = row
+        return NodeTopologyDelta(
+            idx=idx, allocatable=alloc, requested=requested,
+            schedulable=schedulable, label_group=label_group,
+            taint_group=taint_group, numa_cap=numa_cap,
+            numa_free=np.maximum(numa_cap - numa_used, 0.0),
+            numa_valid=numa_valid, numa_policy=numa_policy,
+            cpu_amplification=cpu_amp,
+            gpu_total=gpu_total, gpu_free=gpu_free, gpu_valid=gpu_valid,
+            gpu_numa=gpu_numa, gpu_pcie=gpu_pcie,
+            aux_free=aux_free, aux_valid=aux_valid,
+            metric=NodeMetricDelta(
+                idx=idx, metric_fresh=fresh, usage=usage,
+                prod_usage=prod_usage, agg_usage=agg, has_agg=has_agg,
+                assigned_estimated=est, assigned_correction=corr,
+                prod_assigned_estimated=p_est,
+                prod_assigned_correction=p_corr))
+
     def build_reservations(self, owner_groups: Dict[str, int],
                            nodes: "NodeState",
                            devices: "DeviceState") -> ReservationState:
@@ -695,6 +913,70 @@ class SnapshotBuilder:
                                 numa_free=numa_free_v,
                                 numa_valid=numa_valid_v)
 
+    def _fill_device_row(self, node_name: str, device: Device, ni: int,
+                         gpu_total, gpu_free, gpu_valid, gpu_numa,
+                         gpu_pcie, aux_free, aux_valid) -> None:
+        """One node's Device CR, written into row ni of the given arrays
+        — shared by build_devices and topology_delta. PCIe root ids come
+        from the persistent self._pcie_ids table so incremental rows
+        stay consistent with the snapshot's existing gpu_pcie values.
+
+        Columns are indexed by DeviceInfo.minor — running-pod restore
+        and the scheduler's gpu_take/aux_inst outputs (the device-
+        allocation annotation) address instances by minor, so list
+        position must not matter."""
+        i, j = self.max_gpu_inst, self.max_aux_inst
+        aux_pool = {"rdma": AUX_RDMA, "fpga": AUX_FPGA}
+        seen_gpu = set()
+        seen_aux = {AUX_RDMA: set(), AUX_FPGA: set()}
+        for info in device.devices:
+            if info.type == "gpu":
+                m = info.minor
+                if not 0 <= m < i:
+                    raise ValueError(
+                        f"GPU minor {m} on {node_name!r} outside "
+                        f"max_gpu_inst={i}")
+                if m in seen_gpu:
+                    raise ValueError(
+                        f"duplicate GPU minor {m} on {node_name!r}")
+                seen_gpu.add(m)
+                mem = float(info.resources.get(ResourceKind.GPU_MEMORY,
+                                               0.0))
+                # gpu_total[ni] is the per-node memory↔ratio conversion
+                # basis (memory per 100% of one instance); mixed GPU
+                # sizes on one node have no single basis, so reject
+                # them instead of silently keeping the last value
+                if seen_gpu != {m} and gpu_total[ni][1] != mem:
+                    raise ValueError(
+                        f"heterogeneous GPU memory on {node_name!r}: "
+                        f"{gpu_total[ni][1]} vs {mem} (minor {m})")
+                gpu_total[ni] = (100.0, mem, 100.0)
+                if info.health:
+                    gpu_free[ni, m] = (100.0, mem, 100.0)
+                    gpu_valid[ni, m] = True
+                gpu_numa[ni, m] = info.numa_node
+                if info.pcie_id:
+                    gpu_pcie[ni, m] = self._pcie_ids.setdefault(
+                        info.pcie_id, len(self._pcie_ids))
+            elif info.type in aux_pool:
+                t = aux_pool[info.type]
+                m = info.minor
+                if not 0 <= m < j:
+                    raise ValueError(
+                        f"{info.type} minor {m} on {node_name!r} "
+                        f"outside max_aux_inst={j}")
+                if m in seen_aux[t]:
+                    raise ValueError(
+                        f"duplicate {info.type} minor {m} on "
+                        f"{node_name!r}")
+                seen_aux[t].add(m)
+                if info.health:
+                    kind = (ResourceKind.RDMA if t == AUX_RDMA
+                            else ResourceKind.FPGA)
+                    aux_free[ni, t, m] = float(
+                        info.resources.get(kind, 100.0))
+                    aux_valid[ni, t, m] = True
+
     def build_devices(self) -> DeviceState:
         """Columnarize Device CRs; running pods' granted instances (the
         device-allocation annotation) are subtracted from free, mirroring
@@ -708,65 +990,14 @@ class SnapshotBuilder:
         gpu_pcie = np.full((n, i), -1, np.int32)
         aux_free = np.zeros((n, NUM_AUX_TYPES, j), f32)
         aux_valid = np.zeros((n, NUM_AUX_TYPES, j), bool)
-        aux_pool = {"rdma": AUX_RDMA, "fpga": AUX_FPGA}
-        pcie_ids: Dict[str, int] = {}
+        self._pcie_ids = {}
         for node_name, device in self.devices.items():
             ni = self.node_index.get(node_name)
             if ni is None:
                 continue
-            # columns are indexed by DeviceInfo.minor — running-pod restore
-            # and the scheduler's gpu_take/aux_inst outputs (the device-
-            # allocation annotation) address instances by minor, so list
-            # position must not matter
-            seen_gpu = set()
-            seen_aux = {AUX_RDMA: set(), AUX_FPGA: set()}
-            for info in device.devices:
-                if info.type == "gpu":
-                    m = info.minor
-                    if not 0 <= m < i:
-                        raise ValueError(
-                            f"GPU minor {m} on {node_name!r} outside "
-                            f"max_gpu_inst={i}")
-                    if m in seen_gpu:
-                        raise ValueError(
-                            f"duplicate GPU minor {m} on {node_name!r}")
-                    seen_gpu.add(m)
-                    mem = float(info.resources.get(ResourceKind.GPU_MEMORY,
-                                                   0.0))
-                    # gpu_total[ni] is the per-node memory↔ratio conversion
-                    # basis (memory per 100% of one instance); mixed GPU
-                    # sizes on one node have no single basis, so reject
-                    # them instead of silently keeping the last value
-                    if seen_gpu != {m} and gpu_total[ni][1] != mem:
-                        raise ValueError(
-                            f"heterogeneous GPU memory on {node_name!r}: "
-                            f"{gpu_total[ni][1]} vs {mem} (minor {m})")
-                    gpu_total[ni] = (100.0, mem, 100.0)
-                    if info.health:
-                        gpu_free[ni, m] = (100.0, mem, 100.0)
-                        gpu_valid[ni, m] = True
-                    gpu_numa[ni, m] = info.numa_node
-                    if info.pcie_id:
-                        gpu_pcie[ni, m] = pcie_ids.setdefault(
-                            info.pcie_id, len(pcie_ids))
-                elif info.type in aux_pool:
-                    t = aux_pool[info.type]
-                    m = info.minor
-                    if not 0 <= m < j:
-                        raise ValueError(
-                            f"{info.type} minor {m} on {node_name!r} "
-                            f"outside max_aux_inst={j}")
-                    if m in seen_aux[t]:
-                        raise ValueError(
-                            f"duplicate {info.type} minor {m} on "
-                            f"{node_name!r}")
-                    seen_aux[t].add(m)
-                    if info.health:
-                        kind = (ResourceKind.RDMA if t == AUX_RDMA
-                                else ResourceKind.FPGA)
-                        aux_free[ni, t, m] = float(
-                            info.resources.get(kind, 100.0))
-                        aux_valid[ni, t, m] = True
+            self._fill_device_row(node_name, device, ni, gpu_total,
+                                  gpu_free, gpu_valid, gpu_numa, gpu_pcie,
+                                  aux_free, aux_valid)
         for pod in self.running_pods:
             ni = self.node_index.get(pod.node_name)
             if ni is None:
@@ -827,8 +1058,12 @@ class SnapshotBuilder:
             devices=devices,
             version=np.int32(version),
         )
+        # ctx holds the LIVE group tables (not copies): taint/label
+        # groups minted later by the incremental topology_delta path
+        # must reach build_pod_batch's matrices, or fresh taints would
+        # be silently unenforced until the next full rebuild
         ctx = BuildContext(self, label_groups, owner_groups,
-                           dict(self._taint_groups))
+                           self._taint_groups)
         return snap, ctx
 
     # --- build: pod batch ---------------------------------------------------
@@ -1027,7 +1262,7 @@ class SnapshotBuilder:
                 self._fill_domain_map(c.topology_key, row, spread_domain)
                 if c.when_unsatisfiable == "DoNotSchedule":
                     for ni, node in enumerate(self.nodes):
-                        if spread_domain[row, ni] < 0:
+                        if node is None or spread_domain[row, ni] < 0:
                             continue
                         # a domain counts toward the skew minimum only
                         # when the group's pods can actually reach a node
@@ -1132,6 +1367,8 @@ class SnapshotBuilder:
         domain[row] (-1 when the node lacks the label)."""
         domains: Dict[str, int] = {}
         for ni, node in enumerate(self.nodes):
+            if node is None:
+                continue
             val = node.meta.labels.get(topology_key)
             if val is None:
                 continue

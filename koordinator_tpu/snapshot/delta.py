@@ -28,7 +28,8 @@ from koordinator_tpu.snapshot.schema import Array, ClusterSnapshot
 
 _CPU = int(_RK.CPU)
 
-__all__ = ["NodeMetricDelta", "apply_metric_delta", "forget_pods"]
+__all__ = ["NodeMetricDelta", "NodeTopologyDelta", "apply_metric_delta",
+           "apply_topology_delta", "forget_pods"]
 
 
 @flax.struct.dataclass
@@ -77,6 +78,86 @@ def apply_metric_delta(snap: ClusterSnapshot,
                                      delta.prod_assigned_correction),
     )
     return snap.replace(nodes=nodes, version=snap.version + 1)
+
+
+@flax.struct.dataclass
+class NodeTopologyDelta:
+    """K node rows of IDENTITY columns — the append/compact delta for
+    node add/remove/update churn (VERDICT r3 #7). The reference's
+    informers absorb node churn incrementally (frameworkext/
+    informers.go event handlers patching the cache); here each row is
+    the node's complete recomputed identity view, scattered into the
+    padded column capacity, so scale-up/down of K nodes costs an O(K)
+    transfer instead of the O(N) rebuild + ~10 s full publish.
+
+    A REMOVED node is simply a zeroed row (schedulable=False,
+    allocatable=0, fresh=False): there is no remove flag on the wire.
+    The metric columns ride along as a nested NodeMetricDelta sharing
+    the same idx (a new node usually has no metric yet — fresh=False).
+    Capacity (the padded N) never changes on this path; exhausting it
+    falls back to a full rebuild, which may re-bucket.
+    """
+
+    idx: Array                # i32[K] node row, -1 = pad
+    allocatable: Array        # f32[K, R]
+    requested: Array          # f32[K, R] (0 for empty added nodes)
+    schedulable: Array        # bool[K]
+    label_group: Array        # i32[K]
+    taint_group: Array        # i32[K]
+    numa_cap: Array           # f32[K, Z, 2]
+    numa_free: Array          # f32[K, Z, 2]
+    numa_valid: Array         # bool[K, Z]
+    numa_policy: Array        # i32[K]
+    cpu_amplification: Array  # f32[K]
+    # per-node device pools (I instances; zero-capacity axes compile out)
+    gpu_total: Array          # f32[K, 3]
+    gpu_free: Array           # f32[K, I, 3]
+    gpu_valid: Array          # bool[K, I]
+    gpu_numa: Array           # i32[K, I]
+    gpu_pcie: Array           # i32[K, I]
+    aux_free: Array           # f32[K, A, J]
+    aux_valid: Array          # bool[K, A, J]
+    metric: NodeMetricDelta = None  # same idx; None only pre-init
+
+
+@jax.jit
+def apply_topology_delta(snap: ClusterSnapshot,
+                         delta: NodeTopologyDelta) -> ClusterSnapshot:
+    """Scatter the identity rows, then the metric rows (replace
+    semantics, like apply_metric_delta: each row is exactly what a full
+    rebuild would have produced for that node)."""
+    nodes = snap.nodes
+    devices = snap.devices
+    n = nodes.num_nodes
+    tgt = jnp.where(delta.idx >= 0, delta.idx, n)
+
+    def put(col, rows):
+        return col.at[tgt].set(rows, mode="drop")
+
+    nodes = nodes.replace(
+        allocatable=put(nodes.allocatable, delta.allocatable),
+        requested=put(nodes.requested, delta.requested),
+        schedulable=put(nodes.schedulable, delta.schedulable),
+        label_group=put(nodes.label_group, delta.label_group),
+        taint_group=put(nodes.taint_group, delta.taint_group),
+        numa_cap=put(nodes.numa_cap, delta.numa_cap),
+        numa_free=put(nodes.numa_free, delta.numa_free),
+        numa_valid=put(nodes.numa_valid, delta.numa_valid),
+        numa_policy=put(nodes.numa_policy, delta.numa_policy),
+        cpu_amplification=put(nodes.cpu_amplification,
+                              delta.cpu_amplification),
+    )
+    devices = devices.replace(
+        gpu_total=put(devices.gpu_total, delta.gpu_total),
+        gpu_free=put(devices.gpu_free, delta.gpu_free),
+        gpu_valid=put(devices.gpu_valid, delta.gpu_valid),
+        gpu_numa=put(devices.gpu_numa, delta.gpu_numa),
+        gpu_pcie=put(devices.gpu_pcie, delta.gpu_pcie),
+        aux_free=put(devices.aux_free, delta.aux_free),
+        aux_valid=put(devices.aux_valid, delta.aux_valid),
+    )
+    snap = snap.replace(nodes=nodes, devices=devices)
+    return apply_metric_delta(snap, delta.metric)
 
 
 @functools.partial(jax.jit, static_argnames=("enable_amplification",))

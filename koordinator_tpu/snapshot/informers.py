@@ -8,9 +8,11 @@ scheduler eventhandlers; SURVEY.md 2.7 and §7 hard part (e)): watch
 events land in per-kind caches, handlers fan out, and the scheduler's
 view stays fresh WITHIN the cycle budget — NodeMetric churn (the
 dominant stream: every node re-reports each minute) flows as an O(K)
-device-side delta ingest, while topology churn (nodes/pods/quotas/
-reservations appearing or vanishing) triggers a full columnar rebuild,
-the TPU analogue of the reference's cache invalidation.
+device-side delta ingest; node/device churn (scale-up/down) patches
+node rows incrementally as an O(K) NodeTopologyDelta within the padded
+capacity; only pod/quota/gang/reservation churn or capacity overflow
+triggers the full columnar rebuild, the TPU analogue of the
+reference's cache invalidation.
 """
 
 from __future__ import annotations
@@ -197,6 +199,14 @@ class ClusterInformerHub:
                 "resource_version": self.resource_version,
             }
 
+    def get_node(self, name: str) -> Optional[api.Node]:
+        with self._lock:
+            return self._nodes.get(name)
+
+    def get_device(self, node_name: str) -> Optional[api.Device]:
+        with self._lock:
+            return self._devices.get(node_name)
+
     # --- ClusterSource protocol (cmd/manager.py) ------------------------
     def nodes(self) -> List[api.Node]:
         with self._lock:
@@ -242,30 +252,89 @@ class SnapshotSyncer:
         self._view_lock = threading.Lock()
         self.full_rebuilds = 0
         self.delta_ingests = 0
-        for kind in (KIND_NODE, KIND_POD, KIND_RESERVATION, KIND_POD_GROUP,
-                     KIND_QUOTA, KIND_DEVICE):
+        self.topology_ingests = 0
+        self._dirty_topology: set = set()
+        for kind in (KIND_POD, KIND_RESERVATION, KIND_POD_GROUP,
+                     KIND_QUOTA):
             hub.subscribe(kind, self._on_shape_event)
+        # node add/remove/update and Device CR churn patch node rows
+        # incrementally (NodeTopologyDelta) — the reference's informers
+        # absorb node churn without cache invalidation too
+        hub.subscribe(KIND_NODE, self._on_node_event)
+        hub.subscribe(KIND_DEVICE, self._on_device_event)
         hub.subscribe(KIND_NODE_METRIC, self._on_metric_event)
 
     def _on_shape_event(self, event: str, obj: object) -> None:
         with self._lock:
             self._full_dirty = True
 
+    def _on_node_event(self, event: str, obj) -> None:
+        with self._lock:
+            self._dirty_topology.add(obj.meta.name)
+
+    def _on_device_event(self, event: str, obj) -> None:
+        with self._lock:
+            self._dirty_topology.add(obj.node_name)
+
     def _on_metric_event(self, event: str, obj) -> None:
         with self._lock:
             self._dirty_metrics.add(obj.node_name)
 
     def sync(self, now: Optional[float] = None) -> str:
-        """One sync pass; returns "full" | "delta" | "noop"."""
+        """One sync pass; returns "full" | "topology" | "delta" | "noop".
+
+        Precedence: anything that invalidates non-node state rebuilds;
+        pure node/device churn within one delta's capacity patches the
+        node rows device-side (O(K)); metric churn is the O(K) metric
+        delta. Overflow or capacity pressure (rows, label/taint groups,
+        PCIe ids) falls back to the rebuild — never silent truncation."""
         now = self.now_fn() if now is None else now
         with self._lock:
             full = self._full_dirty
+            topo = sorted(self._dirty_topology)
             dirty = sorted(self._dirty_metrics)
             self._full_dirty = False
+            self._dirty_topology.clear()
             self._dirty_metrics.clear()
-        if full:
+        if full or (topo and self.builder is None):
             self._rebuild(now)
             return "full"
+        if topo:
+            if len(topo) > self.delta_pad:
+                self._rebuild(now)
+                return "full"
+            metrics = self.hub.node_metrics()
+            try:
+                # under the view lock: the summary providers iterate
+                # builder.node_index against store.current() — the
+                # index mutation and the ingest must land as one unit,
+                # exactly like _rebuild's (snapshot, builder) swap
+                with self._view_lock:
+                    for name in topo:
+                        node = self.hub.get_node(name)
+                        if node is None:
+                            if name in self.builder.node_index:
+                                self.builder.remove_node(name)
+                            continue
+                        self.builder.add_node(node)
+                        device = self.hub.get_device(name)
+                        if device is not None:
+                            self.builder.devices[name] = device
+                        metric = metrics.get(name)
+                        if metric is not None:
+                            self.builder.set_node_metric(metric)
+                    delta = self.builder.topology_delta(
+                        topo, now=now, pad_to=self.delta_pad)
+                    self.store.ingest(delta)
+            except ValueError:
+                # capacity pressure (rows / label groups / taint groups
+                # / minors): the rebuild re-buckets
+                self._rebuild(now)
+                return "full"
+            self.topology_ingests += 1
+            # metric churn for OTHER nodes still applies below (the
+            # topology rows already carried their own metric columns)
+            dirty = [d for d in dirty if d not in set(topo)]
         if dirty:
             if len(dirty) > self.delta_pad:
                 # more churn than one delta's capacity: a rebuild is the
@@ -281,8 +350,8 @@ class SnapshotSyncer:
             self.store.ingest(self.builder.metric_delta(
                 dirty, now=now, pad_to=self.delta_pad))
             self.delta_ingests += 1
-            return "delta"
-        return "noop"
+            return "topology" if topo else "delta"
+        return "topology" if topo else "noop"
 
     def register_services(self, registry) -> None:
         """Register the syncer-backed service payloads on a frameworkext

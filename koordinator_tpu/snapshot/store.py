@@ -68,11 +68,20 @@ class SnapshotStore:
             return self._current
 
     def ingest(self, delta) -> ClusterSnapshot:
-        """Apply a NodeMetricDelta device-side (snapshot/delta.py): an
-        O(K) upload + scatter instead of an O(N) rebuild — the informer
-        event-handler path of the reference, on columns."""
-        from koordinator_tpu.snapshot.delta import apply_metric_delta
+        """Apply a NodeMetricDelta or NodeTopologyDelta device-side
+        (snapshot/delta.py): an O(K) upload + scatter instead of an O(N)
+        rebuild — the informer event-handler path of the reference, on
+        columns. Topology deltas patch node identity (add/remove/update
+        rows) within the padded capacity; metric deltas refresh the
+        NodeMetric-derived columns."""
+        from koordinator_tpu.snapshot.delta import (
+            NodeTopologyDelta,
+            apply_metric_delta,
+            apply_topology_delta,
+        )
 
+        if isinstance(delta, NodeTopologyDelta):
+            return self.update(lambda s: apply_topology_delta(s, delta))
         return self.update(lambda s: apply_metric_delta(s, delta))
 
     def forget(self, pods, result, mask) -> ClusterSnapshot:

@@ -163,16 +163,19 @@ def test_service_gang_failed_hook_reclaims_held_members():
 
 
 def test_bench_straggler_overflow_warns():
-    """>TAIL_PASSES*CHUNK stragglers: the bench must SAY the retry bound
-    was exceeded (stderr warning + JSON fields), not silently report the
-    overflow unschedulable (r2 verdict weak #4)."""
+    """More stragglers than the CAPPED adaptive tail can retry: the
+    bench must SAY so (stderr warning + JSON fields), not silently
+    report the overflow unschedulable (r2 verdict weak #4). With the
+    adaptive tail only the BENCH_MAX_TAIL_PASSES cap can strand
+    never-retried pods, so the cap is pinned low here."""
     env = dict(os.environ,
                JAX_PLATFORMS="cpu",
                # the parent test process forces an 8-device virtual CPU
                # platform; this single-chip smoke must not inherit it (2
                # nodes cannot shard 8 ways)
                XLA_FLAGS="",
-               BENCH_NODES="2", BENCH_PODS="200", BENCH_CHUNK="20")
+               BENCH_NODES="2", BENCH_PODS="200", BENCH_CHUNK="20",
+               BENCH_MAX_TAIL_PASSES="2", BENCH_EXTRAS="0")
     # generous: the subprocess pays its own XLA compile, and a cold/evicted
     # compilation cache under a loaded host has been seen past 420s
     out = subprocess.run(
@@ -181,8 +184,7 @@ def test_bench_straggler_overflow_warns():
     assert out.returncode == 0, out.stderr
     line = [l for l in out.stdout.splitlines() if l.startswith("{")][-1]
     result = json.loads(line)
-    capacity = result["tail_retry_capacity"]
-    assert capacity == 40  # 2 passes x chunk 20
-    assert result["stragglers_after_sweep"] > capacity
+    assert result["tail_passes"] == 2
+    assert result["stragglers_after_sweep"] > 40  # 2 passes x chunk 20
     assert result["never_retried"] > 0
     assert "were never retried" in out.stderr

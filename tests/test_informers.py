@@ -80,11 +80,18 @@ def test_syncer_full_then_delta_then_rebuild_on_shape_change():
 
     assert syncer.sync(now=NOW) == "noop"
 
-    # a new node is a SHAPE change -> full rebuild
+    # a new node patches its rows incrementally (NodeTopologyDelta)
     hub.upsert_node(mk_node("n2"))
     hub.set_node_metric(mk_metric("n2"))
-    assert syncer.sync(now=NOW) == "full"
+    assert syncer.sync(now=NOW) == "topology"
     assert np.asarray(store.current().nodes.schedulable).sum() == 3
+    assert syncer.full_rebuilds == 1 and syncer.topology_ingests == 1
+
+    # non-node shape churn (a running pod) still rebuilds
+    hub.upsert_pod(api.Pod(meta=api.ObjectMeta(name="p", uid="u"),
+                           node_name="n0", phase="Running",
+                           requests={RK.CPU: 100.0}))
+    assert syncer.sync(now=NOW) == "full"
     assert syncer.full_rebuilds == 2 and syncer.delta_ingests == 1
 
 
