@@ -683,6 +683,18 @@ class SnapshotBuilder:
         if len(names) > k:
             raise ValueError(
                 f"{len(names)} topology updates exceed pad_to={k}")
+        # a node hosting an Available reservation carries instance/zone
+        # HOLDS that only build_reservations can subtract — and a
+        # removed node may still be referenced by ReservationState.node
+        # (row indices: a reused row would silently re-target it).
+        # Both demand the rebuild path; raising routes the syncer there.
+        touched = set(names)
+        for res in self.reservations:
+            if res.phase == "Available" and res.node_name in touched:
+                raise ValueError(
+                    f"node {res.node_name!r} hosts an Available "
+                    f"reservation; topology rows cannot carry "
+                    f"reservation holds — rebuild")
         r, z = NUM_RESOURCES, self.max_zones
         gi, aj = self.max_gpu_inst, self.max_aux_inst
         f32 = np.float32
@@ -744,7 +756,6 @@ class SnapshotBuilder:
         corr = np.zeros((k, r), f32)
         p_est = np.zeros((k, r), f32)
         p_corr = np.zeros((k, r), f32)
-        gc, gm = int(ResourceKind.GPU_CORE), int(ResourceKind.GPU_MEMORY)
         for jrow, name in enumerate(names):
             ni = self.node_index.get(name)
             if ni is None:
@@ -768,38 +779,14 @@ class SnapshotBuilder:
                 self._fill_device_row(name, device, jrow, gpu_total,
                                       gpu_free, gpu_valid, gpu_numa,
                                       gpu_pcie, aux_free, aux_valid)
-                # running-pod grants shrink instance free (build_devices)
+                # running-pod grants shrink instance free, and aggregate
+                # device capacity rides allocatable — the same per-row
+                # helpers the full build uses
                 for pod in running_here.get(name, []):
-                    if pod.allocated_gpu_minors:
-                        _, per_inst = gpu_per_instance_host(
-                            gpu_total[jrow, DEV_MEM], pod)
-                        for minor in pod.allocated_gpu_minors:
-                            if 0 <= minor < gi:
-                                gpu_free[jrow, minor] = np.maximum(
-                                    gpu_free[jrow, minor] - per_inst, 0.0)
-                    for t, inst in ((AUX_RDMA, pod.allocated_rdma_inst),
-                                    (AUX_FPGA, pod.allocated_fpga_inst)):
-                        kind = (ResourceKind.RDMA if t == AUX_RDMA
-                                else ResourceKind.FPGA)
-                        a_req = float(pod.requests.get(kind, 0.0))
-                        if a_req > 0 and 0 <= inst < aj:
-                            aux_free[jrow, t, inst] = max(
-                                aux_free[jrow, t, inst] - a_req, 0.0)
-                # aggregate device capacity rides node allocatable
-                # unless the Node already reported it (build())
-                vc = float(gpu_valid[jrow].sum())
-                if alloc[jrow, gc] == 0:
-                    alloc[jrow, gc] = gpu_total[jrow, DEV_CORE] * vc
-                if alloc[jrow, gm] == 0:
-                    alloc[jrow, gm] = gpu_total[jrow, DEV_MEM] * vc
-                for kind, typ in ((ResourceKind.RDMA, "rdma"),
-                                  (ResourceKind.FPGA, "fpga")):
-                    kk = int(kind)
-                    if alloc[jrow, kk] == 0:
-                        alloc[jrow, kk] = sum(
-                            float(info.resources.get(kind, 100.0))
-                            for info in device.devices
-                            if info.type == typ and info.health)
+                    self._subtract_pod_grants(pod, jrow, gpu_total,
+                                              gpu_free, aux_free)
+                self._merge_device_allocatable(device, jrow, alloc,
+                                               gpu_total, gpu_valid)
             metric = self.metrics.get(name)
             if metric is not None:
                 row = self._metric_row(name, metric, now, pods_per_node)
@@ -977,6 +964,47 @@ class SnapshotBuilder:
                         info.resources.get(kind, 100.0))
                     aux_valid[ni, t, m] = True
 
+    def _subtract_pod_grants(self, pod: Pod, ni: int, gpu_total,
+                             gpu_free, aux_free) -> None:
+        """A running pod's granted device instances (the device-
+        allocation annotation) shrink row ni's free pools — shared by
+        build_devices and topology_delta."""
+        i, j = self.max_gpu_inst, self.max_aux_inst
+        if pod.allocated_gpu_minors:
+            _, per_inst = gpu_per_instance_host(
+                gpu_total[ni, DEV_MEM], pod)
+            for minor in pod.allocated_gpu_minors:
+                if 0 <= minor < i:
+                    gpu_free[ni, minor] = np.maximum(
+                        gpu_free[ni, minor] - per_inst, 0.0)
+        for t, inst in ((AUX_RDMA, pod.allocated_rdma_inst),
+                        (AUX_FPGA, pod.allocated_fpga_inst)):
+            kind = ResourceKind.RDMA if t == AUX_RDMA else ResourceKind.FPGA
+            req = float(pod.requests.get(kind, 0.0))
+            if req > 0 and 0 <= inst < j:
+                aux_free[ni, t, inst] = max(aux_free[ni, t, inst] - req,
+                                            0.0)
+
+    def _merge_device_allocatable(self, device: Device, ni: int, alloc,
+                                  gpu_total, gpu_valid) -> None:
+        """Aggregate device capacity rides node allocatable (the device
+        plugin reports extended resources) unless the Node already did
+        — shared by build() and topology_delta."""
+        gc, gm = int(ResourceKind.GPU_CORE), int(ResourceKind.GPU_MEMORY)
+        vc = float(gpu_valid[ni].sum())
+        if alloc[ni, gc] == 0:
+            alloc[ni, gc] = gpu_total[ni, DEV_CORE] * vc
+        if alloc[ni, gm] == 0:
+            alloc[ni, gm] = gpu_total[ni, DEV_MEM] * vc
+        for kind, typ in ((ResourceKind.RDMA, "rdma"),
+                          (ResourceKind.FPGA, "fpga")):
+            kk = int(kind)
+            if alloc[ni, kk] == 0:
+                alloc[ni, kk] = sum(
+                    float(info.resources.get(kind, 100.0))
+                    for info in device.devices
+                    if info.type == typ and info.health)
+
     def build_devices(self) -> DeviceState:
         """Columnarize Device CRs; running pods' granted instances (the
         device-allocation annotation) are subtracted from free, mirroring
@@ -1002,20 +1030,8 @@ class SnapshotBuilder:
             ni = self.node_index.get(pod.node_name)
             if ni is None:
                 continue
-            if pod.allocated_gpu_minors:
-                _, per_inst = gpu_per_instance_host(
-                    gpu_total[ni, DEV_MEM], pod)
-                for minor in pod.allocated_gpu_minors:
-                    if 0 <= minor < i:
-                        gpu_free[ni, minor] = np.maximum(
-                            gpu_free[ni, minor] - per_inst, 0.0)
-            for t, inst in ((AUX_RDMA, pod.allocated_rdma_inst),
-                            (AUX_FPGA, pod.allocated_fpga_inst)):
-                kind = ResourceKind.RDMA if t == AUX_RDMA else ResourceKind.FPGA
-                req = float(pod.requests.get(kind, 0.0))
-                if req > 0 and 0 <= inst < j:
-                    aux_free[ni, t, inst] = max(aux_free[ni, t, inst] - req,
-                                                0.0)
+            self._subtract_pod_grants(pod, ni, gpu_total, gpu_free,
+                                      aux_free)
         return DeviceState(gpu_total=gpu_total, gpu_free=gpu_free,
                            gpu_valid=gpu_valid, gpu_numa=gpu_numa,
                            gpu_pcie=gpu_pcie, aux_free=aux_free,
@@ -1025,27 +1041,16 @@ class SnapshotBuilder:
               version: int = 0) -> Tuple[ClusterSnapshot, "BuildContext"]:
         nodes, label_groups = self.build_nodes(now)
         devices = self.build_devices()
-        # aggregate device capacity rides node allocatable (the device
-        # plugin reports extended resources) unless the Node already did,
-        # feeding the cheap node-level fit gate before the instance gates
-        gc, gm = int(ResourceKind.GPU_CORE), int(ResourceKind.GPU_MEMORY)
-        valid_count = np.sum(devices.gpu_valid, axis=1, dtype=np.float32)
-        agg_core = devices.gpu_total[:, DEV_CORE] * valid_count
-        agg_mem = devices.gpu_total[:, DEV_MEM] * valid_count
+        # aggregate device capacity rides node allocatable, feeding the
+        # cheap node-level fit gate before the instance gates
         alloc = nodes.allocatable
-        alloc[:, gc] = np.where(alloc[:, gc] > 0, alloc[:, gc], agg_core)
-        alloc[:, gm] = np.where(alloc[:, gm] > 0, alloc[:, gm], agg_mem)
-        for kind, typ in ((ResourceKind.RDMA, "rdma"),
-                          (ResourceKind.FPGA, "fpga")):
-            k = int(kind)
-            for node_name, device in self.devices.items():
-                ni = self.node_index.get(node_name)
-                if ni is None or alloc[ni, k] > 0:
-                    continue
-                alloc[ni, k] = sum(
-                    float(info.resources.get(kind, 100.0))
-                    for info in device.devices
-                    if info.type == typ and info.health)
+        for node_name, device in self.devices.items():
+            ni = self.node_index.get(node_name)
+            if ni is None:
+                continue
+            self._merge_device_allocatable(device, ni, alloc,
+                                           devices.gpu_total,
+                                           devices.gpu_valid)
         owner_groups: Dict[str, int] = {}
         # reservations may move remaining fine-grained holds out of the
         # node/device pools, so build them against the materialized arrays

@@ -310,11 +310,18 @@ class SnapshotSyncer:
                 # index mutation and the ingest must land as one unit,
                 # exactly like _rebuild's (snapshot, builder) swap
                 with self._view_lock:
-                    for name in topo:
-                        node = self.hub.get_node(name)
+                    # removals FIRST: a same-window replacement at full
+                    # row capacity must free the row before the add
+                    # claims it (otherwise a spurious capacity error
+                    # forfeits the O(K) path)
+                    resolved = [(name, self.hub.get_node(name))
+                                for name in topo]
+                    for name, node in resolved:
+                        if node is None and \
+                                name in self.builder.node_index:
+                            self.builder.remove_node(name)
+                    for name, node in resolved:
                         if node is None:
-                            if name in self.builder.node_index:
-                                self.builder.remove_node(name)
                             continue
                         self.builder.add_node(node)
                         device = self.hub.get_device(name)

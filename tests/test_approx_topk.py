@@ -1,0 +1,79 @@
+"""Placement-quality bound for approx_topk (VERDICT r3 #8).
+
+The bench's hot path selects each pod's k candidate nodes with
+jax.lax.approx_max_k (TPU-optimized partial reduction, default recall
+target 0.95) instead of exact lax.top_k. The choice list is a heuristic
+preference order and missed candidates are recovered by later rounds
+and the adaptive tail retries, so bounded recall costs placement
+QUALITY (a pod occasionally takes its 2nd-best node), not correctness.
+
+The DOCUMENTED bound these tests pin, on whatever platform runs them:
+
+  - placements: placed_approx >= 0.99 x placed_exact
+  - quality:    sum(chosen_score of placed) >= 0.95 x exact score-sum
+
+On CPU, XLA lowers approx_max_k to the exact reduction, so this suite
+additionally pins bit-identical assignments there — i.e. the bound is
+about the TPU partial-reduction mode; run `BENCH_APPROX=0 python
+bench.py` next to the default on real hardware to measure the live
+delta (both placed counts and scores land in the emitted JSON).
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from koordinator_tpu.scheduler import core
+from koordinator_tpu.scheduler.plugins.loadaware import LoadAwareConfig
+from koordinator_tpu.utils import synthetic
+
+
+def run(approx: bool, num_rounds=2, k_choices=8):
+    """One contended small-shape schedule (pods ~2x node headroom so
+    the top-k choice list actually matters)."""
+    snap = synthetic.synthetic_cluster(64, num_quotas=8, seed=5)
+    pods = synthetic.synthetic_pods(512, num_quotas=8, seed=6)
+    res = core.schedule_batch(snap, pods, LoadAwareConfig.make(),
+                              num_rounds=num_rounds, k_choices=k_choices,
+                              approx_topk=approx, tie_break=True,
+                              enable_numa=False)
+    a = np.asarray(res.assignment)
+    placed = a >= 0
+    score_sum = float(np.asarray(res.chosen_score)[placed].sum())
+    return a, int(placed.sum()), score_sum
+
+
+def test_approx_topk_placement_quality_bound():
+    a_exact, placed_exact, score_exact = run(approx=False)
+    a_approx, placed_approx, score_approx = run(approx=True)
+    assert placed_exact > 0
+    # the documented bound (see module docstring)
+    assert placed_approx >= 0.99 * placed_exact, (placed_approx,
+                                                  placed_exact)
+    assert score_approx >= 0.95 * score_exact, (score_approx,
+                                                score_exact)
+
+
+def test_cpu_lowering_is_exact():
+    """On CPU approx_max_k IS top_k — pin that, so the bound above is
+    understood as a statement about the TPU partial reduction."""
+    if jax.devices()[0].platform != "cpu":
+        pytest.skip("cpu-lowering check")
+    a_exact, _, _ = run(approx=False)
+    a_approx, _, _ = run(approx=True)
+    np.testing.assert_array_equal(a_approx, a_exact)
+
+
+def test_recall_misses_fall_through_to_later_rounds():
+    """The recovery mechanism the bound relies on: dropping 2 of the 8
+    choices outright (a 25%% loss — five times approx_max_k's ~5%%
+    expected recall miss) costs under 3%% of single-batch placements
+    once rounds retry, showing missed candidates overwhelmingly cost
+    score, not placements — and the bench's k=32 tail passes close the
+    remainder. (A drastic handicap like k=2 DOES cost placements in a
+    single batch; the bound here calibrates the regime approx_max_k
+    actually operates in.)"""
+    _, placed_full, _ = run(approx=False, num_rounds=4, k_choices=8)
+    _, placed_narrow, _ = run(approx=False, num_rounds=4, k_choices=6)
+    assert placed_narrow >= 0.97 * placed_full, (placed_narrow,
+                                                 placed_full)

@@ -180,6 +180,62 @@ def test_incremental_taint_reaches_pod_batch_matrices():
     assert int(np.asarray(res.assignment)[0]) == -1
 
 
+def test_reservation_hosting_nodes_force_the_rebuild_path():
+    """Regression: topology rows cannot carry reservation holds, and a
+    removed node may still be referenced by ReservationState.node row
+    indices — churn touching a reservation-hosting node must take the
+    rebuild path (topology_delta raises; the syncer falls back)."""
+    b = SnapshotBuilder(max_nodes=4)
+    b.add_node(mk_node("host"))
+    b.add_node(mk_node("other"))
+    b.add_reservation(api.Reservation(
+        meta=api.ObjectMeta(name="r"), node_name="host",
+        phase="Available", requests={RK.CPU: 2000.0}))
+    b.build(now=NOW)
+    b.add_node(mk_node("host", cpu=48000.0))  # update in place
+    with pytest.raises(ValueError, match="reservation"):
+        b.topology_delta(["host"], now=NOW, pad_to=2)
+    # churn on nodes WITHOUT reservations still works
+    b.add_node(mk_node("fresh"))
+    delta = b.topology_delta(["fresh"], now=NOW, pad_to=2)
+    assert int(np.asarray(delta.idx)[0]) == b.node_index["fresh"]
+
+    # syncer route: the ValueError lands as a full rebuild, not a crash
+    hub = ClusterInformerHub()
+    store = SnapshotStore()
+    syncer = SnapshotSyncer(hub, store, max_nodes=4, delta_pad=2)
+    hub.upsert_node(mk_node("host"))
+    hub.upsert_reservation(api.Reservation(
+        meta=api.ObjectMeta(name="r"), node_name="host",
+        phase="Available", requests={RK.CPU: 2000.0}))
+    assert syncer.sync(now=NOW) == "full"
+    hub.delete_node("host")  # reservation CR deletion lags
+    assert syncer.sync(now=NOW) == "full"
+    assert syncer.topology_ingests == 0
+
+
+def test_replacement_at_full_capacity_stays_incremental():
+    """Regression: removals are processed before adds, so a same-window
+    node replacement at max_nodes capacity keeps the O(K) path instead
+    of tripping a spurious capacity error."""
+    hub = ClusterInformerHub()
+    store = SnapshotStore()
+    syncer = SnapshotSyncer(hub, store, max_nodes=2, delta_pad=4)
+    hub.upsert_node(mk_node("aaa"))
+    hub.upsert_node(mk_node("bbb"))
+    assert syncer.sync(now=NOW) == "full"
+    # 'aa-new' sorts BEFORE 'bbb': without removals-first ordering the
+    # add would hit the capacity ceiling before the remove frees a row
+    hub.delete_node("bbb")
+    hub.upsert_node(mk_node("aa-new", cpu=48000.0))
+    assert syncer.sync(now=NOW) == "topology"
+    assert syncer.full_rebuilds == 1
+    snap = store.current()
+    i_new = syncer.builder.node_index["aa-new"]
+    assert float(np.asarray(snap.nodes.allocatable)[i_new, int(RK.CPU)]) \
+        == 48000.0
+
+
 def test_freed_rows_are_reused():
     b = SnapshotBuilder(max_nodes=2)
     b.add_node(mk_node("a"))
