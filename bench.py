@@ -78,27 +78,29 @@ def _probe_once(timeout: float) -> bool:
         return False
 
 
-def ensure_platform(probe_timeout: float = None) -> None:
+def ensure_platform(probe_timeout: float = None) -> bool:
     """Honor JAX_PLATFORMS and guard non-cpu targets with RETRIED
     subprocess probes before any CPU fallback: tunnel outages are often
     transient, and a single-shot probe converts any blip into a lost
     round (round-3 lesson). BENCH_PROBE_ATTEMPTS probes run
     BENCH_PROBE_RETRY_DELAY seconds apart; only when ALL fail does the
     bench fall back to CPU — loudly, and the recorded `platform` field
-    stays honest either way. An explicit helper, not an import side
-    effect: callers pay the probes only when they run a bench."""
+    stays honest either way. Returns True when the requested platform
+    is healthy (or explicitly cpu), False on the degraded fallback. An
+    explicit helper, not an import side effect: callers pay the probes
+    only when they run a bench."""
     plat = os.environ.get("JAX_PLATFORMS")
     if plat:
         jax.config.update("jax_platforms", plat)
     if plat == "cpu":
-        return
+        return True
     if probe_timeout is None:
         probe_timeout = float(os.environ.get("BENCH_PROBE_TIMEOUT", "180"))
     attempts = max(int(os.environ.get("BENCH_PROBE_ATTEMPTS", "3")), 1)
     delay = float(os.environ.get("BENCH_PROBE_RETRY_DELAY", "90"))
     for i in range(attempts):
         if _probe_once(probe_timeout):
-            return
+            return True
         if i + 1 < attempts:
             print(f"bench: platform probe {i + 1}/{attempts} failed; "
                   f"retrying in {delay:.0f}s", file=sys.stderr)
@@ -107,6 +109,7 @@ def ensure_platform(probe_timeout: float = None) -> None:
           "falling back to CPU — the recorded number is NOT a TPU result",
           file=sys.stderr)
     jax.config.update("jax_platforms", "cpu")
+    return False
 
 
 def run_northstar(full_gate: bool = False) -> dict:
@@ -314,8 +317,18 @@ def run_northstar(full_gate: bool = False) -> dict:
     return result
 
 
-def main():
+def main(platform_healthy: bool = True):
     extras = os.environ.get("BENCH_EXTRAS", "1") not in ("0", "false", "")
+    if extras and not platform_healthy \
+            and os.environ.get("BENCH_EXTRAS") != "force":
+        # degraded CPU fallback: the extra configs would take many
+        # minutes on host and record nothing a TPU round can use —
+        # keep the fallback bounded to the canonical line (the r3
+        # wedged-tunnel lesson). BENCH_EXTRAS=force overrides.
+        print("bench: skipping extra configs on the degraded CPU "
+              "fallback (BENCH_EXTRAS=force to override)",
+              file=sys.stderr)
+        extras = False
     if extras:
         # BASELINE configs 2-5 + the full-gate flagship, driver-captured
         # per round (VERDICT r3: self-reported tables don't count)
@@ -330,5 +343,4 @@ def main():
 
 
 if __name__ == "__main__":
-    ensure_platform()
-    main()
+    main(platform_healthy=ensure_platform())

@@ -344,6 +344,12 @@ def schedule_batch(snap: ClusterSnapshot, pods: PodBatch,
         spread_domain_x, spread_counts_flat, n_sg, n_dom = \
             domain_machinery(pods.spread_domain, pods.spread_count0,
                              pods.spread_member)
+        # the per-(pod, node) domain map is ROUND-invariant; hoisted out
+        # of the scanned round body because XLA does not move gathers
+        # across the while-loop boundary (one [P, N] gather per batch
+        # instead of one per round)
+        cdom = spread_domain_x[sid]                           # [P, N+V]
+        soft_sid = (~jnp.isfinite(pods.spread_max_skew))[sid]  # [P]
     # inter-pod anti-affinity: a domain admits a gated pod only at count
     # 0; nodes LACKING the topology key pass (no topology pair can
     # exist there — upstream admits them).
@@ -353,10 +359,12 @@ def schedule_batch(snap: ClusterSnapshot, pods: PodBatch,
         anti_domain_x, anti_counts_flat, n_ag, n_ad = \
             domain_machinery(pods.anti_domain, pods.anti_count0,
                              pods.anti_member)
+        cdom_an = anti_domain_x[aid]                          # [P, N+V]
         # direction (b): carrier occupancy per (group, domain)
         _, anti_carrier_flat, _, _ = \
             domain_machinery(pods.anti_domain, pods.anti_carrier_count0,
                              pods.anti_carrier)
+        anti_member_f = pods.anti_member.astype(jnp.float32)  # [P, Ag]
     # inter-pod affinity: a domain admits a gated pod only when it holds
     # a matching pod — except the bootstrap: when nothing matches
     # anywhere, any self-matching member may OPEN a domain, capped to
@@ -371,6 +379,7 @@ def schedule_batch(snap: ClusterSnapshot, pods: PodBatch,
         aff_domain_x, aff_counts_flat, n_fg, n_fd = \
             domain_machinery(pods.aff_domain, pods.aff_count0,
                              pods.aff_member)
+        cdom_af = aff_domain_x[fid]                           # [P, N+V]
 
     def round_body(carry, _):
         requested, quota_used, numa_used, gpu_free, aux_free, once_taken, \
@@ -409,17 +418,15 @@ def schedule_batch(snap: ClusterSnapshot, pods: PodBatch,
             # in preemption.constraints_admit uses default=0, keeping a
             # hard group with unreachable domains RESTRICTIVE, not open)
             min_c = jnp.where(jnp.isfinite(min_c), min_c, 0.0)
-            cdom = spread_domain_x[sid]                          # [P, N+V]
             ccount = jnp.take_along_axis(counts[sid],
                                          jnp.maximum(cdom, 0), axis=1)
             # SOFT groups (ScheduleAnyway) carry skew = inf from the
             # builder; they never filter — keyless nodes included
-            soft_g = ~jnp.isfinite(pods.spread_max_skew)         # [Sg]
             spread_ok = (cdom >= 0) & \
                 (ccount + 1.0 - min_c[sid][:, None]
                  <= pods.spread_max_skew[sid][:, None] + EPS)
             feasible &= ((pods.spread_id < 0)[:, None]
-                         | soft_g[sid][:, None] | spread_ok)
+                         | soft_sid[:, None] | spread_ok)
             # preference (upstream spread Score): emptier domains rank
             # higher for BOTH hard and soft spread pods
             # normalize PER GROUP (a crowded unrelated group must not
@@ -440,7 +447,6 @@ def schedule_batch(snap: ClusterSnapshot, pods: PodBatch,
         if use_anti:
             counts_an = anti_counts_flat(placed).reshape(n_ag, n_ad)
             # (a) carriers avoid domains holding selector-matching pods
-            cdom_an = anti_domain_x[aid]                  # [P, N+V]
             cc_an = jnp.take_along_axis(counts_an[aid],
                                         jnp.maximum(cdom_an, 0), axis=1)
             # keyless nodes pass: no topology pair can exist there
@@ -453,13 +459,11 @@ def schedule_batch(snap: ClusterSnapshot, pods: PodBatch,
                 anti_domain_x >= 0,
                 jnp.take_along_axis(carr, jnp.maximum(anti_domain_x, 0),
                                     axis=1), 0.0) > 0.5)  # [Ag, N+V]
-            blocked_b = (pods.anti_member.astype(jnp.float32)
-                         @ occ_b.astype(jnp.float32)) > 0.5
+            blocked_b = (anti_member_f @ occ_b.astype(jnp.float32)) > 0.5
             feasible &= ~blocked_b
         if use_aff:
             counts_af = aff_counts_flat(placed).reshape(n_fg, n_fd)
             total_af = jnp.sum(counts_af, axis=1)         # [Fg]
-            cdom_af = aff_domain_x[fid]                   # [P, N+V]
             cc_af = jnp.take_along_axis(counts_af[fid],
                                         jnp.maximum(cdom_af, 0), axis=1)
             # bootstrap feasibility: ANY active self-matching member of

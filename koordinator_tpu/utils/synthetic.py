@@ -292,21 +292,33 @@ def synthetic_pods(num_pods: int, seed: int = 1,
 def with_two_numa_zones(snap: ClusterSnapshot) -> ClusterSnapshot:
     """Populate every node with two NUMA zones at half capacity each
     (the dual-socket shape; shared by the full-gate flagship workload
-    and BASELINE config 2 so the zone model cannot drift)."""
+    and BASELINE config 2 so the zone model cannot drift). The zone
+    AXIS is compacted to exactly 2: every [.., Z, 2] intermediate in
+    the zone kernels ([P, N, Z, 2] score/fit tensors) halves versus the
+    4-slot default, and the reservation zone columns are sliced to
+    match (the extended-pool concat requires one Z)."""
     nodes = snap.nodes
     alloc = np.asarray(nodes.allocatable)
     n = alloc.shape[0]
-    z = np.asarray(nodes.numa_cap).shape[1]
+    z = 2
+    if np.asarray(snap.reservations.numa_free).shape[1] < z:
+        raise ValueError(
+            "with_two_numa_zones needs >= 2 reservation zone slots to "
+            "keep the node/reservation zone axes consistent")
     numa_cap = np.zeros((n, z, 2), np.float32)
     numa_cap[:, 0, 0] = alloc[:, CPU] / 2
     numa_cap[:, 1, 0] = alloc[:, CPU] / 2
     numa_cap[:, 0, 1] = alloc[:, MEM] / 2
     numa_cap[:, 1, 1] = alloc[:, MEM] / 2
-    numa_valid = np.zeros((n, z), bool)
-    numa_valid[:, :2] = True
-    return snap.replace(nodes=nodes.replace(
-        numa_cap=numa_cap, numa_free=numa_cap.copy(),
-        numa_valid=numa_valid))
+    numa_valid = np.ones((n, z), bool)
+    resv = snap.reservations
+    return snap.replace(
+        nodes=nodes.replace(
+            numa_cap=numa_cap, numa_free=numa_cap.copy(),
+            numa_valid=numa_valid),
+        reservations=resv.replace(
+            numa_free=np.asarray(resv.numa_free)[:, :z],
+            numa_valid=np.asarray(resv.numa_valid)[:, :z]))
 
 
 def full_gate_cluster(num_nodes: int, seed: int = 0,
