@@ -301,10 +301,16 @@ def with_two_numa_zones(snap: ClusterSnapshot) -> ClusterSnapshot:
     alloc = np.asarray(nodes.allocatable)
     n = alloc.shape[0]
     z = 2
-    if np.asarray(snap.reservations.numa_free).shape[1] < z:
+    resv_valid = np.asarray(snap.reservations.numa_valid)
+    if resv_valid.shape[1] < z:
         raise ValueError(
             "with_two_numa_zones needs >= 2 reservation zone slots to "
             "keep the node/reservation zone axes consistent")
+    if resv_valid[:, z:].any():
+        raise ValueError(
+            "with_two_numa_zones would silently drop reservation NUMA "
+            "holds in zones >= 2; this helper is for dual-socket "
+            "workloads only")
     numa_cap = np.zeros((n, z, 2), np.float32)
     numa_cap[:, 0, 0] = alloc[:, CPU] / 2
     numa_cap[:, 1, 0] = alloc[:, CPU] / 2
