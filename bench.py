@@ -49,8 +49,6 @@ MIN_TAIL_PASSES = 2   # always run (keeps the tail program warm)
 MAX_TAIL_PASSES = int(os.environ.get("BENCH_MAX_TAIL_PASSES", 6))
 BASELINE_SECONDS = 2.0
 
-COUNT_FIELDS = ("spread_count0", "anti_count0", "anti_carrier_count0",
-                "aff_count0")
 
 
 def _probe_once(timeout: float) -> bool:
@@ -160,7 +158,7 @@ def run_northstar(full_gate: bool = False) -> dict:
     stacked = put_repl(stacked)
     pods_dev = put_repl(pods)
     cfg = put_repl(cfg)
-    counts0 = put_repl(tuple(getattr(pods, f) for f in COUNT_FIELDS))
+    counts0 = put_repl(tuple(getattr(pods, f) for f in core.COUNT_FIELDS))
 
     # BENCH_APPROX=0 switches to exact lax.top_k so the approx_max_k
     # placement-quality delta can be measured on real hardware (on CPU
@@ -179,23 +177,14 @@ def run_northstar(full_gate: bool = False) -> dict:
 
     def charge_all(counts, batch, assignment):
         """Thread placed topology charges into the carried counts (the
-        cross-batch count rule; no-op compile-out on the slim path)."""
+        cross-batch count rule, core.charge_all_counts; no-op
+        compile-out on the slim path)."""
         if not full_gate:
             return counts
-        s, an, ac, af = counts
-        return (
-            core.charge_domain_counts(s, batch.spread_domain,
-                                      batch.spread_member, assignment),
-            core.charge_domain_counts(an, batch.anti_domain,
-                                      batch.anti_member, assignment),
-            core.charge_domain_counts(ac, batch.anti_domain,
-                                      batch.anti_carrier, assignment),
-            core.charge_domain_counts(af, batch.aff_domain,
-                                      batch.aff_member, assignment),
-        )
+        return core.charge_all_counts(counts, batch, assignment)
 
     def with_counts(batch, counts):
-        return batch.replace(**dict(zip(COUNT_FIELDS, counts)))
+        return batch.replace(**dict(zip(core.COUNT_FIELDS, counts)))
 
     @functools.partial(jax.jit, donate_argnums=(0, 1))
     def sweep(snap, counts, stacked, pods_dev, cfg):
@@ -285,7 +274,7 @@ def run_northstar(full_gate: bool = False) -> dict:
 
     # timed steady-state pass on a fresh snapshot
     snap1 = put_snap(make_snap(seed=7))
-    counts1 = put_repl(tuple(getattr(pods, f) for f in COUNT_FIELDS))
+    counts1 = put_repl(tuple(getattr(pods, f) for f in core.COUNT_FIELDS))
     t0 = time.perf_counter()
     (snap, counts, assign, left_after_sweep, left_final, never_retried,
      passes) = full_pass(snap1, counts1)

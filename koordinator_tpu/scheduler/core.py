@@ -1016,6 +1016,29 @@ def schedule_batch(snap: ClusterSnapshot, pods: PodBatch,
                           amplified=enable_amplification)
 
 
+# the (count field, domain field, member field) triples of the
+# cross-batch count rule — THE one place the pairing is encoded;
+# bench.py, the dryrun, and the mesh tests all consume it
+COUNT_FIELDS = ("spread_count0", "anti_count0", "anti_carrier_count0",
+                "aff_count0")
+_COUNT_RULE = (("spread_count0", "spread_domain", "spread_member"),
+               ("anti_count0", "anti_domain", "anti_member"),
+               ("anti_carrier_count0", "anti_domain", "anti_carrier"),
+               ("aff_count0", "aff_domain", "aff_member"))
+
+
+def charge_all_counts(counts: tuple, batch, assignment) -> tuple:
+    """Thread a batch's placements into the carried (spread, anti,
+    anti-carrier, affinity) counts — the cross-batch analogue of the
+    builder recomputing count0 from running + assumed pods. `counts`
+    is ordered per COUNT_FIELDS; callers chunking one logical workload
+    replace the next chunk's count0 fields with the result."""
+    return tuple(
+        charge_domain_counts(c, getattr(batch, dom), getattr(batch, mem),
+                             assignment)
+        for c, (_, dom, mem) in zip(counts, _COUNT_RULE))
+
+
 def charge_domain_counts(count0: jnp.ndarray, dom_matrix: jnp.ndarray,
                          member: jnp.ndarray,
                          assignment: jnp.ndarray) -> jnp.ndarray:

@@ -42,10 +42,14 @@ class SnapshotStore:
         return self._version
 
     def publish(self, snapshot: ClusterSnapshot) -> ClusterSnapshot:
-        """Upload a host-built snapshot; returns the device-resident pytree."""
-        put = (lambda x: jax.device_put(x, self._sharding)
-               if self._sharding is not None else jax.device_put(x))
-        on_device = jax.tree_util.tree_map(put, snapshot)
+        """Upload a host-built snapshot; returns the device-resident
+        pytree. `sharding` may be a single sharding or a pytree of
+        shardings matching the snapshot (parallel.snapshot_sharding's
+        node-axis layout) — device_put handles either as a prefix."""
+        if self._sharding is not None:
+            on_device = jax.device_put(snapshot, self._sharding)
+        else:
+            on_device = jax.device_put(snapshot)
         with self._lock:
             self._version += 1
             self._current = on_device

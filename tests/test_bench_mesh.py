@@ -50,6 +50,51 @@ def test_bench_full_gate_sharded(capsys, monkeypatch):
     assert result["never_retried"] == 0
 
 
+def test_topology_delta_ingests_into_a_sharded_store():
+    """Node churn must stay O(K) on a MESH deployment too: the jitted
+    topology scatter runs against node columns sharded over the
+    8-device mesh (GSPMD handles the scatter placement), and the
+    patched row is visible to a subsequent sharded schedule step."""
+    from koordinator_tpu.api import types as api
+    from koordinator_tpu.api.extension import ResourceKind as RK
+    from koordinator_tpu.parallel import make_mesh, snapshot_sharding
+    from koordinator_tpu.snapshot import SnapshotStore
+    from koordinator_tpu.snapshot.builder import SnapshotBuilder
+
+    mesh = make_mesh(jax.devices())
+    store = SnapshotStore(sharding=snapshot_sharding(mesh))
+    b = SnapshotBuilder(max_nodes=16)
+    for i in range(16):
+        b.add_node(api.Node(meta=api.ObjectMeta(name=f"n{i}"),
+                            allocatable={RK.CPU: 16000.0,
+                                         RK.MEMORY: 32768.0}))
+    snap, ctx = b.build(now=1e9)
+    store.publish(snap)
+
+    b.add_node(api.Node(meta=api.ObjectMeta(name="n3"),
+                        allocatable={RK.CPU: 96000.0,
+                                     RK.MEMORY: 262144.0}))
+    with mesh:
+        store.ingest(b.topology_delta(["n3"], now=1e9, pad_to=4))
+    got = store.current()
+    assert float(np.asarray(got.nodes.allocatable)[3, int(RK.CPU)]) \
+        == 96000.0
+
+    # a sharded schedule step sees the patched capacity
+    from koordinator_tpu.scheduler import core
+    from koordinator_tpu.scheduler.plugins.loadaware import LoadAwareConfig
+
+    pods = [api.Pod(meta=api.ObjectMeta(name=f"p{j}"), priority=9000,
+                    requests={RK.CPU: 20000.0, RK.MEMORY: 4096.0})
+            for j in range(2)]
+    batch = b.build_pod_batch(pods, ctx)
+    with mesh:
+        res = core.schedule_batch(got, batch, LoadAwareConfig.make(),
+                                  num_rounds=2, k_choices=2)
+    a = np.asarray(res.assignment)
+    assert (a == 3).all()  # only the upgraded node fits 20-core pods
+
+
 def test_anti_affinity_holds_across_chunks():
     """Regression for the cross-chunk count rule: carriers of one anti
     group scheduled in DIFFERENT chunks still land in distinct domains,

@@ -341,3 +341,103 @@ def test_e2e_preemption_nominates_and_places(tmp_path):
     batch2 = syncer.builder.build_pod_batch([prod], syncer.ctx)
     res2 = service.schedule(batch2, typed_pods=[prod])
     assert int(np.asarray(res2.assignment)[0]) == 0
+
+
+def test_e2e_scale_up_under_pressure_then_device_rebalance():
+    """Round-4 story: a full cluster rejects incoming prod pods; the
+    autoscaler's scale-up arrives as an O(K) topology ingest (no
+    rebuild) and the retried pods land on the new capacity; the
+    DEVICE LowNodeLoad plan then rebalances the original hot node
+    through reservation-first migration."""
+    from koordinator_tpu.descheduler import DeviceLowNodeLoad
+    from koordinator_tpu.snapshot import SnapshotStore
+    from koordinator_tpu.snapshot.informers import (
+        ClusterInformerHub,
+        SnapshotSyncer,
+    )
+
+    now = 1e9
+    hub, store = ClusterInformerHub(), SnapshotStore()
+    syncer = SnapshotSyncer(hub, store, max_nodes=4, delta_pad=2)
+    service = SchedulerService(store=store, num_rounds=2, k_choices=2)
+
+    # a small full cluster: one node, mostly used
+    hub.upsert_node(api.Node(meta=api.ObjectMeta(name="n0"),
+                             allocatable={RK.CPU: 16000.0,
+                                          RK.MEMORY: 32768.0}))
+    hub.set_node_metric(fresh_metric("n0", 14000.0, 24000.0))
+    assert syncer.sync(now=now) == "full"
+
+    wave = [api.Pod(meta=api.ObjectMeta(name=f"w{j}"), priority=9000,
+                    requests={RK.CPU: 8000.0, RK.MEMORY: 8192.0})
+            for j in range(4)]
+    res = service.schedule(syncer.builder.build_pod_batch(
+        wave, syncer.ctx, max_pods=4))
+    a1 = np.asarray(res.assignment)
+    unplaced = [wave[j] for j in range(4) if a1[j] < 0]
+    assert len(unplaced) >= 3  # the cluster is genuinely full
+
+    # scale-up: two big nodes arrive -> O(K) topology ingest, NOT a
+    # rebuild; the retried pods land on the fresh capacity
+    for name in ("big0", "big1"):
+        hub.upsert_node(api.Node(meta=api.ObjectMeta(name=name),
+                                 allocatable={RK.CPU: 64000.0,
+                                              RK.MEMORY: 131072.0}))
+    assert syncer.sync(now=now) == "topology"
+    assert syncer.full_rebuilds == 1
+    res2 = service.schedule(syncer.builder.build_pod_batch(
+        unplaced, syncer.ctx, max_pods=4))
+    a2 = np.asarray(res2.assignment)[:len(unplaced)]
+    big = {syncer.builder.node_index["big0"],
+           syncer.builder.node_index["big1"]}
+    assert (a2 >= 0).all() and set(a2.tolist()) <= big
+
+    # the hot node rebalances via the DEVICE plan -> migration evicts
+    running = [api.Pod(meta=api.ObjectMeta(name=f"r{i}", uid=f"r{i}"),
+                       requests={RK.CPU: 3000.0, RK.MEMORY: 4096.0},
+                       priority=9100, node_name="n0",
+                       owner_workload="default/rs", workload_replicas=10)
+               for i in range(4)]
+    metrics = {
+        "n0": fresh_metric("n0", 15000.0, 26000.0,
+                           pods=[api.PodMetricInfo(
+                               namespace="default", name=p.meta.name,
+                               usage={RK.CPU: 3500.0, RK.MEMORY: 4096.0})
+                               for p in running]),
+        "big0": fresh_metric("big0", 6000.0, 16000.0),
+        "big1": fresh_metric("big1", 6000.0, 16000.0),
+    }
+    nodes_t = [hub.get_node(n) for n in ("n0", "big0", "big1")]
+    plugin = DeviceLowNodeLoad(
+        LowNodeLoadArgs(consecutive_abnormalities=1, dry_run=True))
+    victims = plugin.balance_once(nodes_t, metrics, {"n0": running},
+                                  now=now)
+    assert victims  # the hot node sheds load through the device plan
+
+    ev = RecordingEvictor()
+    directory = {p.meta.namespaced_name: p for p in running}
+    ready = {}
+
+    def reserve(pod):
+        rp = api.Pod(meta=api.ObjectMeta(name=f"resv-{pod.meta.name}"),
+                     requests=dict(pod.requests), priority=9100)
+        r = service.schedule(syncer.builder.build_pod_batch(
+            [rp], syncer.ctx, max_pods=4))
+        tgt = int(np.asarray(r.assignment)[0])
+        assert tgt in big  # replacement capacity off the hot node
+        ready[rp.meta.name] = True
+        return rp.meta.name
+
+    mc = MigrationController(
+        ev, MigrationControllerArgs(max_migrating_per_node=None),
+        reserve=reserve, reservation_available=ready.get,
+        get_pod=directory.get)
+    for v in victims:
+        mc.submit_for_pod(v, "hot node", now=0.0)
+    for r in range(1, 8):
+        mc.reconcile_once(now=float(r))
+        if all(j.phase in ("Succeeded", "Failed")
+               for j in mc.jobs.values()):
+            break
+    assert len(ev.evictions) == len(victims)
+    assert all(j.phase == "Succeeded" for j in mc.jobs.values())
