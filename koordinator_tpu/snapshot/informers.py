@@ -377,12 +377,16 @@ class SnapshotSyncer:
             if self.builder is None:
                 return {}
             snap = self.store.current()
-            builder = self.builder
+            # COPY the index inside the lock: the incremental topology
+            # path mutates the live builder dicts in place (no swap,
+            # unlike _rebuild), so iterating them after release races
+            # a concurrent sync
+            quota_index = dict(self.builder.quota_index)
         used = np.asarray(snap.quotas.used)
         runtime = np.asarray(snap.quotas.runtime)
         qmin = np.asarray(snap.quotas.min)
         out = {}
-        for name, qi in builder.quota_index.items():
+        for name, qi in quota_index.items():
             out[name] = {
                 "min": [float(v) for v in qmin[qi]],
                 "used": [float(v) for v in used[qi]],
@@ -401,12 +405,13 @@ class SnapshotSyncer:
             if self.builder is None:
                 return {}
             snap = self.store.current()
-            builder = self.builder
+            # copy inside the lock — see quota_summary
+            node_index = dict(self.builder.node_index)
         gpu_free = np.asarray(snap.devices.gpu_free)
         gpu_total = np.asarray(snap.devices.gpu_total)
         gpu_valid = np.asarray(snap.devices.gpu_valid)
         out = {}
-        for name, ni in builder.node_index.items():
+        for name, ni in node_index.items():
             count = int(gpu_valid[ni].sum())
             if count == 0:
                 continue

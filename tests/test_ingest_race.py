@@ -150,3 +150,90 @@ def test_concurrent_writers_syncer_and_reader():
     batch = syncer.builder.build_pod_batch([pod], syncer.ctx)
     res = core.schedule_batch(final, batch, cfg)
     assert int(np.asarray(res.assignment)[0]) >= 0
+
+
+def test_concurrent_topology_churn_and_summary_readers():
+    """The round-4 risk surface: the incremental topology path mutates
+    builder.node_index while summary providers iterate it (the
+    _view_lock pairs the index with the snapshot) and node writers
+    churn the hub. No RuntimeError('dictionary changed size'), no
+    partial states, and the end state must match the hub exactly."""
+    import time
+
+    hub = ClusterInformerHub()
+    store = SnapshotStore()
+    syncer = SnapshotSyncer(hub, store, max_nodes=32, delta_pad=8)
+    for i in range(8):
+        hub.upsert_node(api.Node(
+            meta=api.ObjectMeta(name=f"base{i}"),
+            allocatable={RK.CPU: 32000.0, RK.MEMORY: 65536.0}))
+    syncer.sync(now=NOW)
+    errors = []
+    stop = threading.Event()
+
+    def node_churner(seed):
+        rng = np.random.default_rng(seed)
+        try:
+            j = 0
+            while not stop.is_set():
+                # 3 names per churner (6 total) stays under delta_pad=8
+                # so the steady state actually exercises the O(K) path
+                # instead of tripping the overflow rebuild every pass
+                name = f"dyn{seed}-{j % 3}"
+                if rng.uniform() < 0.6:
+                    hub.upsert_node(api.Node(
+                        meta=api.ObjectMeta(name=name),
+                        allocatable={RK.CPU: float(
+                            rng.choice([16000, 48000])),
+                            RK.MEMORY: 65536.0}))
+                else:
+                    hub.delete_node(name)
+                j += 1
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    def sync_loop():
+        try:
+            while not stop.is_set():
+                syncer.sync(now=NOW)
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    def summary_reader():
+        try:
+            while not stop.is_set():
+                # iterates builder indexes against store.current()
+                # under the view lock — must never see a torn pair
+                syncer.quota_summary()
+                syncer.device_summary()
+                snap = store.current()
+                assert np.asarray(snap.nodes.allocatable).shape[0] == 32
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    threads = [threading.Thread(target=node_churner, args=(s,))
+               for s in (3, 4)]
+    threads += [threading.Thread(target=sync_loop),
+                threading.Thread(target=summary_reader)]
+    for t in threads:
+        t.start()
+    time.sleep(2.0)
+    stop.set()
+    for t in threads:
+        t.join(timeout=30)
+        assert not t.is_alive()
+    assert not errors, errors
+    assert syncer.topology_ingests > 0, \
+        "the churn must exercise the O(K) topology path"
+
+    # quiesce: the final synced state mirrors the hub node set
+    syncer.sync(now=NOW)
+    final = store.current()
+    sched = np.asarray(final.nodes.schedulable)
+    hub_names = {n.meta.name for n in hub.nodes()}
+    assert set(syncer.builder.node_index) == hub_names
+    assert int(sched.sum()) == len(hub_names)
+    for name, idx in syncer.builder.node_index.items():
+        want = hub.get_node(name).allocatable[RK.CPU]
+        got = float(np.asarray(final.nodes.allocatable)[idx, 0])
+        assert got == np.float32(want), (name, got, want)
