@@ -31,7 +31,7 @@ import numpy as np
 from koordinator_tpu.runtimeproxy.rpc import RpcClient, RpcServer
 from koordinator_tpu.scheduler import sidecar_pb2 as pb
 from koordinator_tpu.scheduler.frameworkext import SchedulerService
-from koordinator_tpu.snapshot.delta import NodeMetricDelta
+from koordinator_tpu.snapshot.delta import NodeMetricDelta, NodeTopologyDelta
 from koordinator_tpu.snapshot.schema import (
     ClusterSnapshot,
     PodBatch,
@@ -52,6 +52,17 @@ def _flat_template(cls):
     return cls(**{f.name: jnp.zeros((1,), jnp.float32)
                   for f in dataclasses.fields(cls)
                   if f.metadata.get("pytree_node", True)})
+
+
+def _topology_template() -> NodeTopologyDelta:
+    """NodeTopologyDelta nests a NodeMetricDelta, so its restore target
+    needs the nested structure (leaf shapes are irrelevant)."""
+    arrays = {f.name: jnp.zeros((1,), jnp.float32)
+              for f in dataclasses.fields(NodeTopologyDelta)
+              if f.name != "metric"
+              and f.metadata.get("pytree_node", True)}
+    return NodeTopologyDelta(**arrays,
+                             metric=_flat_template(NodeMetricDelta))
 
 
 _GATE_FIELDS = ("has_taints", "has_spread", "has_anti", "has_aff")
@@ -87,6 +98,8 @@ class SchedulerSidecarServer:
         self._rpc = RpcServer(sock_path, {
             "PublishSnapshot": (pb.PublishSnapshotRequest, self._publish),
             "IngestDelta": (pb.IngestDeltaRequest, self._ingest),
+            "IngestTopology": (pb.IngestTopologyRequest,
+                               self._ingest_topology),
             "Schedule": (pb.ScheduleRequest, self._schedule),
             "Summary": (pb.SummaryRequest, self._summary),
         })
@@ -111,6 +124,17 @@ class SchedulerSidecarServer:
         # service.ingest, NOT store.ingest: the RPC server is threaded and
         # a delta racing a Schedule call must serialize with the commit
         return pb.IngestDeltaResponse(version=self.service.ingest(delta))
+
+    def _ingest_topology(self, req: pb.IngestTopologyRequest
+                         ) -> pb.IngestTopologyResponse:
+        """Node add/remove/update churn over the wire as an O(K) row
+        patch — WITHOUT this, a sidecar deployment's topology churn
+        falls back to the ~10 s full snapshot publish the delta plane
+        exists to avoid (store.ingest dispatches on the delta type)."""
+        delta = flax.serialization.from_bytes(_topology_template(),
+                                              req.delta_msgpack)
+        return pb.IngestTopologyResponse(
+            version=self.service.ingest(delta))
 
     def _schedule(self, req: pb.ScheduleRequest) -> pb.ScheduleResponse:
         pods = _apply_gate_flags(
@@ -153,6 +177,14 @@ class SchedulerSidecarClient:
             pb.IngestDeltaRequest(
                 delta_msgpack=flax.serialization.to_bytes(delta)),
             pb.IngestDeltaResponse)
+        return resp.version
+
+    def ingest_topology(self, delta: NodeTopologyDelta) -> int:
+        resp = self._rpc.call(
+            "IngestTopology",
+            pb.IngestTopologyRequest(
+                delta_msgpack=flax.serialization.to_bytes(delta)),
+            pb.IngestTopologyResponse)
         return resp.version
 
     def schedule(self, pods: PodBatch,

@@ -86,6 +86,17 @@ def test_delta_ingest_over_socket(tmp_path, cluster):
         assert v == 2
         usage = np.asarray(service.store.current().nodes.usage)
         assert usage[0, 0] == pytest.approx(15000.0)
+
+        # node churn rides the wire too: an upgraded node arrives as an
+        # O(K) topology delta through the CLIENT method
+        b.add_node(api.Node(meta=api.ObjectMeta(name="n1"),
+                            allocatable={RK.CPU: 48000.0,
+                                         RK.MEMORY: 131072.0}))
+        v = client.ingest_topology(
+            b.topology_delta(["n1"], now=NOW, pad_to=4))
+        assert v == 3
+        alloc = np.asarray(service.store.current().nodes.allocatable)
+        assert alloc[1, 0] == pytest.approx(48000.0)
     finally:
         server.close()
 

@@ -75,6 +75,38 @@ def canonical_delta():
         prod_assigned_correction=z.copy())
 
 
+def canonical_topology_delta():
+    """One row: node 1 upgraded to a 48-core box (identity + nested
+    metric columns share idx)."""
+    from koordinator_tpu.snapshot.delta import NodeTopologyDelta
+
+    f32 = np.float32
+    alloc = np.zeros((1, R), f32)
+    alloc[0, 0] = 48000.0
+    alloc[0, 1] = 131072.0
+    return NodeTopologyDelta(
+        idx=np.array([1], np.int32),
+        allocatable=alloc,
+        requested=np.zeros((1, R), f32),
+        schedulable=np.array([True]),
+        label_group=np.zeros((1,), np.int32),
+        taint_group=np.zeros((1,), np.int32),
+        numa_cap=np.zeros((1, 2, 2), f32),
+        numa_free=np.zeros((1, 2, 2), f32),
+        numa_valid=np.zeros((1, 2), bool),
+        numa_policy=np.zeros((1,), np.int32),
+        cpu_amplification=np.ones((1,), f32),
+        gpu_total=np.zeros((1, 3), f32),
+        gpu_free=np.zeros((1, 0, 3), f32),
+        gpu_valid=np.zeros((1, 0), bool),
+        gpu_numa=np.full((1, 0), -1, np.int32),
+        gpu_pcie=np.full((1, 0), -1, np.int32),
+        aux_free=np.zeros((1, 2, 0), f32),
+        aux_valid=np.zeros((1, 2, 0), bool),
+        metric=canonical_delta().replace(
+            idx=np.array([1], np.int32)))
+
+
 def canonical_pods():
     """2 pods; has_taints=True pins bit 0 of the gate_flags transport."""
     p = 2
@@ -155,6 +187,11 @@ def build_request_frames() -> dict:
             pb.IngestDeltaRequest(
                 delta_msgpack=flax.serialization.to_bytes(
                     canonical_delta())).SerializeToString()),
+        "ingest_topology_request.bin": frame(
+            "IngestTopology",
+            pb.IngestTopologyRequest(
+                delta_msgpack=flax.serialization.to_bytes(
+                    canonical_topology_delta())).SerializeToString()),
         "schedule_request.bin": frame(
             "Schedule",
             pb.ScheduleRequest(
@@ -199,6 +236,23 @@ def test_frozen_ingest_request_decodes():
                                           req.delta_msgpack)
     assert np.asarray(delta.idx).tolist() == [0]
     assert np.asarray(delta.usage)[0, 0] == 3000.0
+
+
+def test_frozen_topology_request_decodes():
+    from koordinator_tpu.scheduler import sidecar_pb2 as pb
+    from koordinator_tpu.scheduler.sidecar import _topology_template
+
+    method, body = unframe_request(_read("ingest_topology_request.bin"))
+    assert method == "IngestTopology"
+    req = pb.IngestTopologyRequest.FromString(body)
+    delta = flax.serialization.from_bytes(_topology_template(),
+                                          req.delta_msgpack)
+    assert np.asarray(delta.idx).tolist() == [1]
+    assert np.asarray(delta.allocatable)[0, 0] == 48000.0
+    assert bool(np.asarray(delta.schedulable)[0])
+    # the nested metric rows share the row index
+    assert np.asarray(delta.metric.idx).tolist() == [1]
+    assert np.asarray(delta.metric.usage)[0, 0] == 3000.0
 
 
 def test_frozen_schedule_request_decodes():
@@ -267,11 +321,18 @@ def test_frozen_frames_drive_a_live_server(tmp_path):
         resp = pb.IngestDeltaResponse.FromString(
             roundtrip("ingest_request.bin"))
         assert resp.version == 2
+        resp = pb.IngestTopologyResponse.FromString(
+            roundtrip("ingest_topology_request.bin"))
+        assert resp.version == 3
+        # the topology row landed: node 1 now reports the upgraded box
+        alloc = np.asarray(
+            service.store.current().nodes.allocatable)
+        assert alloc[1, 0] == 48000.0
         sched = pb.ScheduleResponse.FromString(
             roundtrip("schedule_request.bin"))
         assert len(sched.assignment) == 2
         assert all(a in (0, 1) for a in sched.assignment)
-        assert sched.snapshot_version == 3
+        assert sched.snapshot_version == 4
         resp = pb.SummaryResponse.FromString(
             roundtrip("summary_request.bin"))
         assert json.loads(resp.json)["podsPlaced"] == sum(
