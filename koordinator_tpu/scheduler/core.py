@@ -355,16 +355,15 @@ def schedule_batch(snap: ClusterSnapshot, pods: PodBatch,
     # exist there — upstream admits them).
     use_anti = pods.has_anti
     if use_anti:
-        aid = jnp.maximum(pods.anti_id, 0)
         anti_domain_x, anti_counts_flat, n_ag, n_ad = \
             domain_machinery(pods.anti_domain, pods.anti_count0,
                              pods.anti_member)
-        cdom_an = anti_domain_x[aid]                          # [P, N+V]
         # direction (b): carrier occupancy per (group, domain)
         _, anti_carrier_flat, _, _ = \
             domain_machinery(pods.anti_domain, pods.anti_carrier_count0,
                              pods.anti_carrier)
         anti_member_f = pods.anti_member.astype(jnp.float32)  # [P, Ag]
+        anti_carrier_f = pods.anti_carrier.astype(jnp.float32)
     # inter-pod affinity: a domain admits a gated pod only when it holds
     # a matching pod — except the bootstrap: when nothing matches
     # anywhere, any self-matching member may OPEN a domain, capped to
@@ -447,11 +446,18 @@ def schedule_batch(snap: ClusterSnapshot, pods: PodBatch,
         if use_anti:
             counts_an = anti_counts_flat(placed).reshape(n_ag, n_ad)
             # (a) carriers avoid domains holding selector-matching pods
-            cc_an = jnp.take_along_axis(counts_an[aid],
-                                        jnp.maximum(cdom_an, 0), axis=1)
-            # keyless nodes pass: no topology pair can exist there
-            anti_ok = (cdom_an < 0) | (cc_an < 0.5)
-            feasible &= (pods.anti_id < 0)[:, None] | anti_ok
+            # — a per-group [Ag, N+V] occupancy map and one bool matmul
+            # over the CARRIED groups, so a pod carrying SEVERAL anti
+            # terms is gated by each (multi-term pods; same shape as
+            # direction (b)). Keyless nodes stay open per group: no
+            # topology pair can exist there.
+            occ_a = (jnp.where(
+                anti_domain_x >= 0,
+                jnp.take_along_axis(counts_an,
+                                    jnp.maximum(anti_domain_x, 0),
+                                    axis=1), 0.0) > 0.5)  # [Ag, N+V]
+            blocked_a = (anti_carrier_f @ occ_a.astype(jnp.float32)) > 0.5
+            feasible &= ~blocked_a
             # (b) selector-matching pods avoid CARRIER domains — one
             # bool matmul over groups covers pods matching several terms
             carr = anti_carrier_flat(placed).reshape(n_ag, n_ad)
@@ -603,7 +609,7 @@ def schedule_batch(snap: ClusterSnapshot, pods: PodBatch,
                     # (a) matching pods charge; carriers are gated
                     contrib_a = ((trying & pods.anti_member[:, g]
                                   & has_dom).astype(jnp.float32))
-                    gated_a = trying & (pods.anti_id == g) & has_dom
+                    gated_a = trying & pods.anti_carrier[:, g] & has_dom
                     occ_a = counts_an_now[g, dom_c] + e_mask @ contrib_a
                     accept &= (occ_a < 0.5) | ~gated_a
                     # (b) carriers charge; matching pods are gated

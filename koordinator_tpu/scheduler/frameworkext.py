@@ -177,21 +177,23 @@ def debug_filter_table(snap: ClusterSnapshot, pods: PodBatch,
         gates.append(("PodTopologySpread",
                       ok | (np.asarray(pods.spread_id) < 0)[:, None]))
     if pods.has_anti:
-        aid = np.maximum(np.asarray(pods.anti_id), 0)
-        dom = np.asarray(pods.anti_domain)[aid]
-        cc = np.take_along_axis(np.asarray(pods.anti_count0)[aid],
-                                np.maximum(dom, 0), axis=1)
-        ok = (dom < 0) | (cc < 0.5)
-        ok |= (np.asarray(pods.anti_id) < 0)[:, None]
-        # direction (b): matching pods avoid carrier domains
+        # (a) per-group occupancy gated by the CARRIER matrix (a pod
+        # carrying several terms is gated by each — mirrors core.py)
         dom_all = np.asarray(pods.anti_domain)
+        occ_a = np.where(dom_all >= 0,
+                         np.take_along_axis(
+                             np.asarray(pods.anti_count0),
+                             np.maximum(dom_all, 0), axis=1), 0.0) > 0.5
+        blocked_a = (np.asarray(pods.anti_carrier).astype(float)
+                     @ occ_a.astype(float)) > 0.5
+        # direction (b): matching pods avoid carrier domains
         carr = np.asarray(pods.anti_carrier_count0)
         occ = np.where(dom_all >= 0,
                        np.take_along_axis(carr, np.maximum(dom_all, 0),
                                           axis=1), 0.0) > 0.5
         blocked = (np.asarray(pods.anti_member).astype(float)
                    @ occ.astype(float)) > 0.5
-        gates.append(("InterPodAntiAffinity", ok & ~blocked))
+        gates.append(("InterPodAntiAffinity", ~blocked_a & ~blocked))
     if pods.has_aff:
         fid = np.maximum(np.asarray(pods.aff_id), 0)
         dom = np.asarray(pods.aff_domain)[fid]

@@ -1106,6 +1106,7 @@ class SnapshotBuilder:
         aff_groups: Dict[tuple, tuple] = {}
         anti_row = np.full((p,), -1, np.int32)
         aff_row = np.full((p,), -1, np.int32)
+        anti_carried: List[tuple] = []  # (pod i, group row) per term
         for i, pod in enumerate(pods):
             requests[i] = resource_vec(pod.requests)
             estimated[i] = estimate_pod(pod, self.estimator_scaling,
@@ -1178,23 +1179,40 @@ class SnapshotBuilder:
                     entry = (len(spread_groups), hard, pod)
                     spread_groups[skey] = entry
                 spread_row[i] = entry[0]
+            degraded = False
             for term in pod.pod_affinity:
                 groups = anti_groups if term.anti else aff_groups
                 rows = anti_row if term.anti else aff_row
-                if rows[i] >= 0:
-                    continue  # first term of each polarity is modeled
+                # ANTI terms: EVERY carried term is registered — the
+                # carrier matrix gates a pod by each term it carries
+                # (multi-term pods). Affinity keeps the documented
+                # first-term narrowing (aff gating rides a single id).
+                if not term.anti and rows[i] >= 0:
+                    continue
                 akey = (pod.meta.namespace, term.topology_key,
                         tuple(sorted(term.label_selector.items())))
                 entry = groups.get(akey)
                 if entry is None:
                     if len(groups) >= self.max_spread_groups:
+                        if term.anti and rows[i] >= 0:
+                            # an EXTRA anti term of one pod overflowing
+                            # the group cap must not abort the whole
+                            # batch: the pod degrades to unschedulable
+                            # (never placed with an unmodeled term; the
+                            # error chain retries/reports it), everyone
+                            # else schedules
+                            degraded = True
+                            break
                         raise ValueError(
                             f"distinct pod-affinity terms exceed "
                             f"max_spread_groups={self.max_spread_groups}")
                     entry = (len(groups), term, pod)
                     groups[akey] = entry
-                rows[i] = entry[0]
-            valid[i] = True
+                if rows[i] < 0:
+                    rows[i] = entry[0]
+                if term.anti:
+                    anti_carried.append((i, entry[0]))
+            valid[i] = not degraded
 
         # selector x node-label-group match matrix, padded to static
         # capacities so jitted programs never retrace across batches
@@ -1334,9 +1352,8 @@ class SnapshotBuilder:
             anti_carrier = np.zeros((p, g_used), bool)
             anti_carrier_count0 = np.zeros(
                 (g_used, self.max_spread_domains), np.float32)
-            for i in range(len(pods)):
-                if anti_row[i] >= 0:
-                    anti_carrier[i, anti_row[i]] = True
+            for i, row in anti_carried:
+                anti_carrier[i, row] = True
             for row, node_name in carriers:
                 ni = self.node_index.get(node_name)
                 if ni is not None and anti_domain[row, ni] >= 0:

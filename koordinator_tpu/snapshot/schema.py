@@ -164,14 +164,16 @@ class PodBatch:
     # (upstream counts all matching pods, not just constrained ones).
     # Anti-affinity is enforced in BOTH directions with separate count
     # surfaces per group (one per distinct required term):
-    # (a) a pod CARRYING the term avoids domains holding selector-
-    #     matching pods (anti_id gates against anti_count0 + placed
-    #     anti_member charges);
+    # (a) a pod CARRYING a term avoids domains holding selector-
+    #     matching pods (the anti_carrier MATRIX gates against
+    #     anti_count0 + placed anti_member charges — a pod carrying
+    #     SEVERAL terms is gated by each);
     # (b) a pod MATCHING the selector avoids domains holding term
     #     CARRIERS (anti_member gates against anti_carrier_count0 +
     #     placed anti_carrier charges) — satisfyExistingPodsAntiAffinity
     #     generalized to same-batch carriers.
-    anti_id: Array          # i32[P] group whose term the pod CARRIES, -1
+    anti_id: Array          # i32[P] FIRST carried group (diagnostics;
+                            # gating rides anti_carrier), -1 = none
     anti_member: Array      # bool[P, Ag] pod matches group's selector
     anti_carrier: Array     # bool[P, Ag] pod carries group's term
     anti_domain: Array      # i32[Ag, N]

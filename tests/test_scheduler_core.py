@@ -556,6 +556,87 @@ def test_anti_affinity_mutual_one_per_domain():
     assert (a == -1).sum() == 1
 
 
+def test_multi_term_anti_affinity_gates_every_term():
+    """Round-4: a pod carrying TWO required anti terms (different
+    topology keys / selectors) must avoid BOTH — the carrier matrix
+    gates each carried group, not just the first (the old first-term
+    narrowing). Cross-checked against the sequential reference
+    (preemption.constraints_admit, which always handled multi-term)."""
+    from koordinator_tpu.api.types import PodAffinityTerm
+    from koordinator_tpu.scheduler.preemption import constraints_admit
+
+    b = SnapshotBuilder(max_nodes=4)
+    nodes = []
+    for i, (zone, rack) in enumerate(
+            [("z1", "r1"), ("z1", "r2"), ("z2", "r1"), ("z2", "r2")]):
+        n = Node(meta=ObjectMeta(name=f"n{i}",
+                                 labels={"zone": zone, "rack": rack}),
+                 allocatable={RK.CPU: 64000.0, RK.MEMORY: 65536})
+        nodes.append(n)
+        b.add_node(n)
+        b.set_node_metric(NodeMetric(node_name=f"n{i}", update_time=NOW,
+                                     node_usage={}))
+    # db occupies zone z1 (n0); cache occupies rack r1 (n2)
+    db = Pod(meta=ObjectMeta(name="db", namespace="d",
+                             labels={"app": "db"}),
+             requests={RK.CPU: 100.0}, phase="Running", node_name="n0")
+    cache = Pod(meta=ObjectMeta(name="cache", namespace="d",
+                                labels={"app": "cache"}),
+                requests={RK.CPU: 100.0}, phase="Running",
+                node_name="n2")
+    b.add_running_pod(db)
+    b.add_running_pod(cache)
+    terms = [PodAffinityTerm(topology_key="zone",
+                             label_selector={"app": "db"}, anti=True),
+             PodAffinityTerm(topology_key="rack",
+                             label_selector={"app": "cache"}, anti=True)]
+    pod = Pod(meta=ObjectMeta(name="p", namespace="d"),
+              priority=9000, requests={RK.CPU: 100.0},
+              pod_affinity=terms)
+    snap, ctx = b.build(now=NOW)
+    batch = b.build_pod_batch([pod], ctx)
+    res = core.schedule_batch(snap, batch,
+                              loadaware.LoadAwareConfig.make(),
+                              num_rounds=4)
+    got = int(np.asarray(res.assignment)[0])
+    # n0/n1 share zone z1 (db); n0/n2 share rack r1 (cache): only n3
+    # (z2, r2) violates neither — the first-term-only gate would have
+    # allowed n1 as well
+    assert got == 3, got
+    # sequential reference agreement, node by node
+    pods_by_node = {"n0": [db], "n2": [cache]}
+    for i, n in enumerate(nodes):
+        want = constraints_admit(pod, n, nodes, pods_by_node,
+                                 removed_ids=frozenset())
+        assert want == (i == 3), (i, want)
+
+
+def test_anti_term_overload_degrades_one_pod_not_the_batch():
+    """A pod whose anti terms alone overflow the group cap degrades to
+    unschedulable; the rest of the batch still builds and schedules
+    (never abort everyone for one pathological spec)."""
+    from koordinator_tpu.api.types import PodAffinityTerm
+
+    b = _zone_cluster()
+    terms = [PodAffinityTerm(topology_key=f"k{t}",
+                             label_selector={"app": f"a{t}"}, anti=True)
+             for t in range(12)]  # > max_spread_groups (8)
+    monster = Pod(meta=ObjectMeta(name="monster", namespace="d"),
+                  priority=9000, requests={RK.CPU: 100.0},
+                  pod_affinity=terms)
+    normal = Pod(meta=ObjectMeta(name="normal", namespace="d"),
+                 priority=9000, requests={RK.CPU: 100.0})
+    snap, ctx = b.build(now=NOW)
+    batch = b.build_pod_batch([monster, normal], ctx)
+    assert not bool(np.asarray(batch.valid)[0])
+    assert bool(np.asarray(batch.valid)[1])
+    res = core.schedule_batch(snap, batch,
+                              loadaware.LoadAwareConfig.make(),
+                              num_rounds=2)
+    a = np.asarray(res.assignment)
+    assert a[0] == -1 and a[1] >= 0
+
+
 def test_anti_affinity_against_other_app():
     """An anti term targeting ANOTHER app's pods avoids its zones but
     members do not exclude each other."""
