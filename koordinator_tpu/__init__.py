@@ -19,4 +19,4 @@ file:line citations appear in docstrings throughout so behavior parity can be
 checked; the implementation is original and TPU-native.
 """
 
-__version__ = "0.3.0"
+__version__ = "0.4.0"
