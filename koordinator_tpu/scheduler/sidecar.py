@@ -13,9 +13,14 @@ so a capacity-1 `zeros_snapshot()` template restores a snapshot of ANY
 static shape — the wire needs no shape negotiation.
 
 Cost model (measured on one v5e chip): a FULL 10k-node snapshot publish
-is ~10 s on the wire — the rare topology-churn path; the steady state is
-O(K) metric deltas (`ingest`) plus ~0.14 s RPC overhead per 2k-pod
-schedule call, against ~0.15 s device time for the batch itself.
+is ~10 s on the wire — needed when capacity grows, when churn exceeds
+one delta's row pad, or when a churned node hosts an Available
+reservation (topology rows cannot carry reservation holds; see
+snapshot/delta.py + builder.topology_delta). All other node add/
+remove/update rides `ingest_topology` (O(K) rows, like the metric
+deltas), so the steady state is O(K) deltas plus ~0.14 s RPC overhead
+per 2k-pod schedule call, against ~0.15 s device time for the batch
+itself.
 """
 
 from __future__ import annotations

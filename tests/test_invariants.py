@@ -190,13 +190,15 @@ def test_topology_gate_invariants(seed):
     rng = np.random.default_rng(seed)
     n_nodes = 12
     zones = [f"z{int(z)}" for z in rng.integers(0, 4, n_nodes)]
+    racks = [f"r{i % 3}" for i in range(n_nodes)]
     tainted = rng.random(n_nodes) < 0.3
     b = SnapshotBuilder(max_nodes=n_nodes)
     for i in range(n_nodes):
         taints = [Taint(key="dedicated", value="infra",
                         effect="NoSchedule")] if tainted[i] else []
         b.add_node(Node(meta=ObjectMeta(name=f"n{i}",
-                                        labels={"zone": zones[i]}),
+                                        labels={"zone": zones[i],
+                                                "rack": racks[i]}),
                         allocatable={RK.CPU: 32000.0,
                                      RK.MEMORY: 65536.0},
                         taints=taints))
@@ -208,6 +210,9 @@ def test_topology_gate_invariants(seed):
                                       label_selector={"app": "web"})
     anti = PodAffinityTerm(topology_key="zone",
                            label_selector={"app": "etcd"}, anti=True)
+    # a SECOND carried anti term for some etcd pods (multi-term gating)
+    anti_web = PodAffinityTerm(topology_key="rack",
+                               label_selector={"app": "web"}, anti=True)
     aff = PodAffinityTerm(topology_key="zone",
                           label_selector={"app": "job"})
     tol = [Toleration(key="dedicated", value="infra",
@@ -224,9 +229,11 @@ def test_topology_gate_invariants(seed):
                                             labels={"app": "web"}),
                             spread_constraints=[spread], **kw))
         elif role == 1:
+            two_terms = bool(rng.random() < 0.5)
             pods.append(Pod(meta=ObjectMeta(name=f"e{j}", namespace="d",
                                             labels={"app": "etcd"}),
-                            pod_affinity=[anti], **kw))
+                            pod_affinity=[anti, anti_web] if two_terms
+                            else [anti], **kw))
         elif role == 2:
             pods.append(Pod(meta=ObjectMeta(name=f"j{j}", namespace="d",
                                             labels={"app": "job"}),
@@ -261,6 +268,15 @@ def test_topology_gate_invariants(seed):
                   if p.meta.labels["app"] == "etcd" and a[j] >= 0]
     assert len(etcd_zones) == len(set(etcd_zones)), \
         f"seed {seed}: anti-affine pods co-domained {etcd_zones}"
+    # 3b. the SECOND carried term binds too: a two-term etcd pod never
+    # shares a rack with any placed web pod
+    web_racks = {racks[a[j]] for j, p in enumerate(pods)
+                 if p.meta.labels["app"] == "web" and a[j] >= 0}
+    for j, p in enumerate(pods):
+        if (p.meta.labels["app"] == "etcd" and a[j] >= 0
+                and len(p.pod_affinity) == 2):
+            assert racks[a[j]] not in web_racks, \
+                f"seed {seed}: second anti term violated (pod {j})"
     # 4. affinity: every placed job shares a zone with another job
     job_zones = [zones[a[j]] for j, p in enumerate(pods)
                  if p.meta.labels["app"] == "job" and a[j] >= 0]
