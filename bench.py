@@ -319,9 +319,10 @@ def main(platform_healthy: bool = True):
               file=sys.stderr)
         extras = False
     if extras:
-        # BASELINE configs 2-5 + the full-gate flagship, driver-captured
+        # BASELINE configs 1-5 + the full-gate flagship, driver-captured
         # per round (VERDICT r3: self-reported tables don't count)
         import bench_configs
+        bench_configs.config_1_spark()
         bench_configs.config_2_numa()
         bench_configs.config_3_gangs()
         bench_configs.config_4_quota()
