@@ -84,7 +84,8 @@ class ErrorHandlerDispatcher:
 def make_preemption_post_filter(
         get_nodes: Callable[[], List[api.Node]],
         get_pods_by_node: Callable[[], dict],
-        on_nominate: Callable) -> ErrorFilter:
+        on_nominate: Callable,
+        get_devices: Optional[Callable[[], dict]] = None) -> ErrorFilter:
     """The default-preemption PostFilter as an error-chain post filter:
     an unschedulable pod with a priority dry-runs the cluster view for a
     minimal victim set (scheduler/preemption.py); `on_nominate(pod,
@@ -103,8 +104,9 @@ def make_preemption_post_filter(
         # select_victims_on_node's `< prio` comparison does the rest.
         if not err.unschedulable or pod.priority is None:
             return False
-        nomination = find_preemption(pod, get_nodes(),
-                                     get_pods_by_node())
+        nomination = find_preemption(
+            pod, get_nodes(), get_pods_by_node(),
+            devices=get_devices() if get_devices is not None else None)
         if nomination is None:
             return False
         on_nominate(pod, nomination)

@@ -20,15 +20,19 @@ and its output — victims to evict + the nominated node — feeds the
 eviction edge and the NEXT batch, exactly like the reference's
 nominatedNodeName handshake.
 
-Recheck coverage (documented narrowing): the dry-run re-applies the
-node-level gates, the flat resource fit WITH amplified-CPU charging
-(cpu-bind pods cost request x the node's amplification ratio, matching
-the device gate in core.py), and the topology gates
-(spread/affinity). NUMA-zone placement and device (GPU instance) fit
-are NOT rechecked — a nomination can still be rejected by those gates
-next batch, in which case the preemptor requeues (the evictions are
-potentially wasted but correctness holds: the reference's
-nominatedNodeName is equally advisory and re-filtered at retry).
+Recheck coverage: the dry-run re-applies the node-level gates, the
+flat resource fit WITH amplified-CPU charging (cpu-bind pods cost
+request x the node's amplification ratio, matching the device gate in
+core.py), the topology gates (spread/affinity), the single-NUMA zone
+fit for CPU-bind preemptors (zone_admits — zone charges stay raw, the
+ratio cancels), and, when the caller provides the Device CRs, the
+per-instance GPU and aux (RDMA/FPGA) fit against surviving grants
+(device_admits). The one remaining narrowing: with no `devices`
+mapping the per-instance gates are skipped (aggregate capacity is
+still checked via the flat vector) — such a nomination can be
+rejected by the instance gates next batch, in which case the
+preemptor requeues (the reference's nominatedNodeName is equally
+advisory and re-filtered at retry).
 """
 
 from __future__ import annotations
@@ -131,14 +135,17 @@ def select_victims_on_node(preemptor: api.Pod,
                            node_allocatable: np.ndarray,
                            pods_on_node: Sequence[api.Pod],
                            admit: Optional[Callable] = None,
-                           cpu_amplification: float = 1.0
+                           cpu_amplification: float = 1.0,
+                           fine_fit: Optional[Callable] = None
                            ) -> Optional[List[api.Pod]]:
     """Minimal victim set on one node, or None when preemption there
     cannot admit the preemptor. `admit(removed_ids)` re-runs the
     non-resource gates with that candidate subset hypothetically
     evicted (None = resources only). `cpu_amplification` is the node's
     published ratio: bind-pod CPU charges amplified, matching what the
-    device gates will re-check next batch."""
+    device gates will re-check next batch. `fine_fit(survivors)`
+    re-runs the fine-grained gates (NUMA zone / GPU instances) against
+    the surviving pod set per reprieve step."""
     prio = preemptor.priority or 0
 
     def is_candidate(p: api.Pod) -> bool:
@@ -158,12 +165,115 @@ def select_victims_on_node(preemptor: api.Pod,
                   reprieved: List[api.Pod]) -> bool:
         if not fits(base + returned + req, cap):
             return False
+        if fine_fit is not None and not fine_fit(others + reprieved):
+            return False
         if admit is None:
             return True
         removed = frozenset(cand_ids - {id(p) for p in reprieved})
         return admit(removed)
 
     return reprieve_victims(req, candidates, extra_fit, req_fn=req_of)
+
+
+def zone_admits(preemptor: api.Pod, node: api.Node,
+                survivors: Sequence[api.Pod]) -> bool:
+    """Single-NUMA fit for a CPU-bind preemptor against the SURVIVING
+    bound pods' zone usage — the numa_single gate the next batch
+    re-runs (numaaware.zone_prefilter + the exact commit gate). Zone
+    charges stay RAW: zone capacities are raw and the amplification
+    ratio cancels in the fit (core.py amplified-CPU note). Non-bind
+    preemptors and topology-less nodes pass."""
+    from koordinator_tpu.api.extension import ResourceKind as RK
+
+    if not preemptor.required_cpu_bind:
+        return True
+    # a bind preemptor can NEVER schedule on a node without zones (the
+    # device zone gate's numa_valid is all-False there) — nominating it
+    # would waste the evictions
+    if node.topology is None or not node.topology.zones:
+        return False
+    zones = node.topology.zones
+    req_cpu = float(preemptor.requests.get(RK.CPU, 0.0))
+    req_mem = float(preemptor.requests.get(RK.MEMORY, 0.0))
+    used = [[0.0, 0.0] for _ in zones]
+    for p in survivors:
+        zi = p.allocated_numa_zone
+        if p.required_cpu_bind and 0 <= zi < len(zones):
+            used[zi][0] += float(p.requests.get(RK.CPU, 0.0))
+            used[zi][1] += float(p.requests.get(RK.MEMORY, 0.0))
+    return any(z.cpus_milli - u[0] + EPS >= req_cpu
+               and z.memory_mib - u[1] + EPS >= req_mem
+               for z, u in zip(zones, used))
+
+
+def device_admits(preemptor: api.Pod, device: Optional[api.Device],
+                  survivors: Sequence[api.Pod]) -> bool:
+    """Per-instance GPU and aux (RDMA/FPGA) fit against the surviving
+    pods' grants (the deviceshare instance gates the next batch
+    re-runs). `device` is the node's Device CR; a device-requesting
+    preemptor on a device-less node never fits. Pass-through for
+    preemptors requesting no device."""
+    from koordinator_tpu.api.extension import ResourceKind as RK
+    from koordinator_tpu.snapshot.builder import gpu_per_instance_host
+
+    if not wants_device(preemptor):
+        return True
+    if device is None:
+        return False
+    if wants_gpu(preemptor):
+        free = {}
+        total_mem = 0.0
+        for info in device.devices:
+            if info.type == "gpu" and info.health:
+                total_mem = float(
+                    info.resources.get(RK.GPU_MEMORY, 0.0))
+                free[info.minor] = np.array([100.0, total_mem, 100.0])
+        for p in survivors:
+            if p.allocated_gpu_minors:
+                _, per = gpu_per_instance_host(total_mem, p)
+                for m in p.allocated_gpu_minors:
+                    if m in free:
+                        free[m] = np.maximum(free[m] - per, 0.0)
+        count, per = gpu_per_instance_host(total_mem, preemptor)
+        if count > 0 and sum(1 for f in free.values()
+                             if (f + EPS >= per).all()) < count:
+            return False
+    # aux pools: one instance must hold the WHOLE request
+    # (deviceshare's desiredCount-1 semantics)
+    for typ, inst_attr, kind in (("rdma", "allocated_rdma_inst",
+                                  RK.RDMA),
+                                 ("fpga", "allocated_fpga_inst",
+                                  RK.FPGA)):
+        a_req = float(preemptor.requests.get(kind, 0.0))
+        if a_req <= 0:
+            continue
+        free_aux = {info.minor: float(info.resources.get(kind, 100.0))
+                    for info in device.devices
+                    if info.type == typ and info.health}
+        for p in survivors:
+            p_req = float(p.requests.get(kind, 0.0))
+            inst = getattr(p, inst_attr)
+            if p_req > 0 and inst in free_aux:
+                free_aux[inst] = max(free_aux[inst] - p_req, 0.0)
+        if not any(f + EPS >= a_req for f in free_aux.values()):
+            return False
+    return True
+
+
+def wants_gpu(pod: api.Pod) -> bool:
+    from koordinator_tpu.api.extension import ResourceKind as RK
+    return (float(pod.requests.get(RK.GPU_CORE, 0.0)) > 0
+            or float(pod.requests.get(RK.GPU_MEMORY, 0.0)) > 0
+            or pod.gpu_memory_ratio > 0)
+
+
+def wants_device(pod: api.Pod) -> bool:
+    """THE one predicate for 'this pod needs the per-instance device
+    recheck' — shared by find_preemption's gating and device_admits."""
+    from koordinator_tpu.api.extension import ResourceKind as RK
+    return (wants_gpu(pod)
+            or float(pod.requests.get(RK.RDMA, 0.0)) > 0
+            or float(pod.requests.get(RK.FPGA, 0.0)) > 0)
 
 
 def node_cpu_amplification(node: api.Node) -> float:
@@ -270,11 +380,14 @@ def constraints_admit(pod: api.Pod, node: api.Node,
 
 def find_preemption(preemptor: api.Pod,
                     nodes: Sequence[api.Node],
-                    pods_by_node: Dict[str, Sequence[api.Pod]]
+                    pods_by_node: Dict[str, Sequence[api.Pod]],
+                    devices: Optional[Dict[str, api.Device]] = None
                     ) -> Optional[NominatedPreemption]:
     """Dry-run every ADMISSIBLE node; pick per pickOneNodeForPreemption
-    ordering. Admissibility covers the node-level gates up front and the
-    topology gates (spread/affinity) against the post-eviction view."""
+    ordering. Admissibility covers the node-level gates up front, the
+    topology gates (spread/affinity), the NUMA-zone fit for bind
+    preemptors, and — when `devices` maps node name -> Device CR — the
+    per-instance GPU fit, all against the post-eviction view."""
     best: Optional[NominatedPreemption] = None
     best_key = None
     node_of = {n.meta.name: n for n in nodes}
@@ -285,6 +398,8 @@ def find_preemption(preemptor: api.Pod,
                         or preemptor.spread_constraints
                         or any(t.anti for _, p in placed
                                for t in p.pod_affinity))
+    needs_fine = preemptor.required_cpu_bind or (
+        devices is not None and wants_device(preemptor))
     for node in nodes:
         if not node_admits(preemptor, node):
             continue
@@ -294,10 +409,20 @@ def find_preemption(preemptor: api.Pod,
                 return constraints_admit(preemptor, _node, nodes,
                                          pods_by_node, removed_ids,
                                          placed=placed)
+        fine = None
+        if needs_fine:
+            dev = devices.get(node.meta.name) if devices else None
+
+            def fine(survivors, _node=node, _dev=dev):
+                return (zone_admits(preemptor, _node, survivors)
+                        and (devices is None
+                             or device_admits(preemptor, _dev,
+                                              survivors)))
         victims = select_victims_on_node(
             preemptor, resource_vec(node.allocatable),
             pods_by_node.get(node.meta.name, ()), admit=admit,
-            cpu_amplification=node_cpu_amplification(node))
+            cpu_amplification=node_cpu_amplification(node),
+            fine_fit=fine)
         if victims is None:
             continue
         prios = sorted((p.priority or 0) for p in victims)

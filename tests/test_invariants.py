@@ -280,11 +280,11 @@ def test_topology_gate_invariants(seed):
                  if p.meta.labels["app"] == "web" and a[j] >= 0}
     two_term = [j for j, p in enumerate(pods)
                 if anti_web in p.pod_affinity]
-    # non-degenerate construction: both sides of the term exist (a seed
-    # may legitimately place zero two-term pods under contention — the
-    # DETERMINISTIC binding case is
+    # non-vacuity: at least one two-term pod PLACED and a web rack
+    # occupied, so the loop below actually checks something (the
+    # deterministic single-pod case is
     # test_scheduler_core.test_multi_term_anti_affinity_gates_every_term)
-    assert two_term and web_racks, \
+    assert any(a[j] >= 0 for j in two_term) and web_racks, \
         f"seed {seed}: 3b is vacuous (retune the workload)"
     for j in two_term:
         if a[j] >= 0:

@@ -179,6 +179,97 @@ def test_priority_zero_preempts_negative_victims():
     assert [v.meta.name for v in nominations[0].victims] == ["neg"]
 
 
+def test_zone_fit_rechecked_for_bind_preemptors():
+    """A CPU-bind preemptor's nomination must survive the single-NUMA
+    gate the next batch re-runs: evicting flat-fit victims that free no
+    ZONE capacity is never nominated; evicting the zone-hogging bound
+    victim is."""
+    from koordinator_tpu.api.types import NodeResourceTopology, NUMAZone
+
+    topo = NodeResourceTopology(zones=[
+        NUMAZone(cpus_milli=8000.0, memory_mib=16384.0),
+        NUMAZone(cpus_milli=8000.0, memory_mib=16384.0)])
+    node = Node(meta=ObjectMeta(name="n0"),
+                allocatable={RK.CPU: 16000.0, RK.MEMORY: 32768.0},
+                topology=topo)
+    # both zones hogged by BOUND lower-priority pods; an UNBOUND victim
+    # holds flat capacity only
+    bound0 = mk_pod("bound0", 5000, 6000.0)
+    bound0.required_cpu_bind = True
+    bound0.allocated_numa_zone = 0
+    bound1 = mk_pod("bound1", 5500, 6000.0)
+    bound1.required_cpu_bind = True
+    bound1.allocated_numa_zone = 1
+    flat = mk_pod("flat", 4000, 4000.0)
+    preemptor = mk_pod("prod", 9500, 5000.0)
+    preemptor.required_cpu_bind = True
+    got = find_preemption(preemptor, [node],
+                          {"n0": [bound0, bound1, flat]})
+    # flat eviction alone frees 4000m flat but NO zone room (zones hold
+    # 6000/8000 each; 5000m bind needs 5000 free in ONE zone) — the
+    # minimal set must evict a BOUND pod; reprieve keeps the more
+    # important bound1, so bound0 goes (flat stays: resources fit)
+    assert got is not None
+    assert "bound0" in [v.meta.name for v in got.victims]
+    # an unbound preemptor of the same size needs no zone: the
+    # resources-only reprieve keeps the MOST important candidates
+    # (bound1 5500, then flat fits too) and evicts bound0 — no zone
+    # logic engages
+    got2 = find_preemption(mk_pod("prod2", 9500, 5000.0), [node],
+                           {"n0": [bound0, bound1, flat]})
+    assert got2 is not None
+    assert [v.meta.name for v in got2.victims] == ["bound0"]
+
+
+def test_gpu_instance_fit_rechecked_when_devices_known():
+    """With the Device CRs provided, a GPU preemptor's nomination must
+    survive the per-instance gate: shared-GPU survivors block a
+    full-instance preemptor even when aggregate GPU capacity fits."""
+    from koordinator_tpu.api.types import Device, DeviceInfo
+
+    node = Node(meta=ObjectMeta(name="n0"),
+                allocatable={RK.CPU: 64000.0, RK.MEMORY: 65536.0,
+                             RK.GPU_CORE: 200.0,
+                             RK.GPU_MEMORY: 32768.0})
+    device = Device(node_name="n0", devices=[
+        DeviceInfo(type="gpu", minor=m, health=True,
+                   resources={RK.GPU_MEMORY: 16384.0}) for m in (0, 1)])
+    # a HIGH-priority shared pod holds 50% of each instance: aggregate
+    # free = 100% (one full GPU's worth) but no single instance is free
+    holder = mk_pod("holder", 9600, 1000.0)
+    holder.requests[RK.GPU_CORE] = 100.0
+    holder.gpu_memory_ratio = 100.0
+    holder.allocated_gpu_minors = [0, 1]
+    # a cheap non-GPU victim exists — evicting it cannot help the GPU
+    be = mk_pod("be", 5000, 1000.0)
+    preemptor = mk_pod("train", 9500, 1000.0)
+    preemptor.requests[RK.GPU_CORE] = 100.0
+    preemptor.gpu_memory_ratio = 100.0
+    got = find_preemption(preemptor, [node], {"n0": [holder, be]},
+                          devices={"n0": device})
+    assert got is None  # no eviction of `be` frees an instance
+    # a lower-priority holder IS evictable: nomination frees instances
+    holder.priority = 5500
+    got2 = find_preemption(preemptor, [node], {"n0": [holder, be]},
+                           devices={"n0": device})
+    assert got2 is not None
+    assert [v.meta.name for v in got2.victims] == ["holder"]
+    # without the devices mapping the instance gate is skipped
+    # (documented narrowing): with flat pressure forcing an eviction,
+    # the shared-GPU blockage goes unseen and `be` is nominated anyway
+    holder.priority = 9600
+    tight = Node(meta=ObjectMeta(name="n0"),
+                 allocatable={RK.CPU: 2500.0, RK.MEMORY: 65536.0,
+                              RK.GPU_CORE: 200.0,
+                              RK.GPU_MEMORY: 32768.0})
+    got3 = find_preemption(preemptor, [tight], {"n0": [holder, be]})
+    assert got3 is not None
+    assert [v.meta.name for v in got3.victims] == ["be"]
+    # the SAME scenario with devices known is (correctly) refused
+    assert find_preemption(preemptor, [tight], {"n0": [holder, be]},
+                           devices={"n0": device}) is None
+
+
 def test_amplified_cpu_charging_in_victim_selection():
     """Regression (ADVICE r3): on a node whose webhook published
     amplified allocatable, a CPU-bind preemptor/victim charges
@@ -187,11 +278,17 @@ def test_amplified_cpu_charging_in_victim_selection():
     reject."""
     import json
 
+    from koordinator_tpu.api.types import NodeResourceTopology, NUMAZone
+
     amp_ann = {"node.koordinator.sh/resource-amplification-ratio":
                json.dumps({"cpu": 2.0})}
-    # amplified allocatable: 8000m raw published as 16000m
+    # amplified allocatable: 8000m raw published as 16000m; zones stay
+    # RAW (a bind preemptor needs a zone to exist at all)
     node = Node(meta=ObjectMeta(name="n0", annotations=amp_ann),
-                allocatable={RK.CPU: 16000.0, RK.MEMORY: 16384.0})
+                allocatable={RK.CPU: 16000.0, RK.MEMORY: 16384.0},
+                topology=NodeResourceTopology(zones=[
+                    NUMAZone(cpus_milli=8000.0, memory_mib=8192.0),
+                    NUMAZone(cpus_milli=8000.0, memory_mib=8192.0)]))
     # bind preemptor wants 6000m -> charges 12000m amplified
     preemptor = mk_pod("prod", 9500, 6000.0)
     preemptor.required_cpu_bind = True
