@@ -27,12 +27,14 @@ core.py), the topology gates (spread/affinity), the single-NUMA zone
 fit for CPU-bind preemptors (zone_admits — zone charges stay raw, the
 ratio cancels), and, when the caller provides the Device CRs, the
 per-instance GPU and aux (RDMA/FPGA) fit against surviving grants
-(device_admits). The one remaining narrowing: with no `devices`
-mapping the per-instance gates are skipped (aggregate capacity is
-still checked via the flat vector) — such a nomination can be
-rejected by the instance gates next batch, in which case the
-preemptor requeues (the reference's nominatedNodeName is equally
-advisory and re-filtered at retry).
+plus the zone/instance AGREEMENT for bind+GPU preemptors
+(fine_grained_admits — a best-effort mirror of the topology-manager
+hint merge, truncated to the builder's zone capacity). Remaining
+narrowings: with no `devices` mapping the per-instance gates are
+skipped (aggregate capacity is still checked via the flat vector),
+and exotic merged-hint policies are not reproduced — either way a
+rejected nomination requeues (the reference's nominatedNodeName is
+equally advisory and re-filtered at retry).
 """
 
 from __future__ import annotations
@@ -175,24 +177,28 @@ def select_victims_on_node(preemptor: api.Pod,
     return reprieve_victims(req, candidates, extra_fit, req_fn=req_of)
 
 
-def zone_admits(preemptor: api.Pod, node: api.Node,
-                survivors: Sequence[api.Pod]) -> bool:
-    """Single-NUMA fit for a CPU-bind preemptor against the SURVIVING
-    bound pods' zone usage — the numa_single gate the next batch
-    re-runs (numaaware.zone_prefilter + the exact commit gate). Zone
+# the snapshot builder truncates zones to its max_zones capacity
+# (_fill_identity_row zones[:z]); the dry run must never count a zone
+# the device gate cannot model
+DEFAULT_MAX_ZONES = 4
+
+
+def _zone_fit_list(preemptor: api.Pod, node: api.Node,
+                   survivors: Sequence[api.Pod],
+                   max_zones: int) -> Optional[List[bool]]:
+    """Per-zone cpu/mem fit for a CPU-bind preemptor against the
+    SURVIVING bound pods' zone usage, over the zones the snapshot
+    actually models. None = no zone gate applies (non-bind preemptor);
+    [] = bind preemptor on a zone-less node (never admissible). Zone
     charges stay RAW: zone capacities are raw and the amplification
-    ratio cancels in the fit (core.py amplified-CPU note). Non-bind
-    preemptors and topology-less nodes pass."""
+    ratio cancels in the fit (core.py amplified-CPU note)."""
     from koordinator_tpu.api.extension import ResourceKind as RK
 
     if not preemptor.required_cpu_bind:
-        return True
-    # a bind preemptor can NEVER schedule on a node without zones (the
-    # device zone gate's numa_valid is all-False there) — nominating it
-    # would waste the evictions
+        return None
     if node.topology is None or not node.topology.zones:
-        return False
-    zones = node.topology.zones
+        return []
+    zones = node.topology.zones[:max_zones]
     req_cpu = float(preemptor.requests.get(RK.CPU, 0.0))
     req_mem = float(preemptor.requests.get(RK.MEMORY, 0.0))
     used = [[0.0, 0.0] for _ in zones]
@@ -201,9 +207,20 @@ def zone_admits(preemptor: api.Pod, node: api.Node,
         if p.required_cpu_bind and 0 <= zi < len(zones):
             used[zi][0] += float(p.requests.get(RK.CPU, 0.0))
             used[zi][1] += float(p.requests.get(RK.MEMORY, 0.0))
-    return any(z.cpus_milli - u[0] + EPS >= req_cpu
-               and z.memory_mib - u[1] + EPS >= req_mem
-               for z, u in zip(zones, used))
+    return [z.cpus_milli - u[0] + EPS >= req_cpu
+            and z.memory_mib - u[1] + EPS >= req_mem
+            for z, u in zip(zones, used)]
+
+
+def zone_admits(preemptor: api.Pod, node: api.Node,
+                survivors: Sequence[api.Pod],
+                max_zones: int = DEFAULT_MAX_ZONES) -> bool:
+    """Single-NUMA fit for a CPU-bind preemptor — the numa_single gate
+    the next batch re-runs (numaaware.zone_prefilter + the exact commit
+    gate). Non-bind preemptors pass; bind preemptors on zone-less nodes
+    never do (the gate's numa_valid is all-False there)."""
+    fit = _zone_fit_list(preemptor, node, survivors, max_zones)
+    return True if fit is None else any(fit)
 
 
 def device_admits(preemptor: api.Pod, device: Optional[api.Device],
@@ -221,19 +238,7 @@ def device_admits(preemptor: api.Pod, device: Optional[api.Device],
     if device is None:
         return False
     if wants_gpu(preemptor):
-        free = {}
-        total_mem = 0.0
-        for info in device.devices:
-            if info.type == "gpu" and info.health:
-                total_mem = float(
-                    info.resources.get(RK.GPU_MEMORY, 0.0))
-                free[info.minor] = np.array([100.0, total_mem, 100.0])
-        for p in survivors:
-            if p.allocated_gpu_minors:
-                _, per = gpu_per_instance_host(total_mem, p)
-                for m in p.allocated_gpu_minors:
-                    if m in free:
-                        free[m] = np.maximum(free[m] - per, 0.0)
+        free, _, total_mem = _gpu_free_map(device, survivors)
         count, per = gpu_per_instance_host(total_mem, preemptor)
         if count > 0 and sum(1 for f in free.values()
                              if (f + EPS >= per).all()) < count:
@@ -257,6 +262,65 @@ def device_admits(preemptor: api.Pod, device: Optional[api.Device],
                 free_aux[inst] = max(free_aux[inst] - p_req, 0.0)
         if not any(f + EPS >= a_req for f in free_aux.values()):
             return False
+    return True
+
+
+def _gpu_free_map(device: api.Device, survivors: Sequence[api.Pod]):
+    """(per-minor free [core, mem, ratio] after surviving grants,
+    minor -> numa node, per-instance total memory)."""
+    from koordinator_tpu.api.extension import ResourceKind as RK
+    from koordinator_tpu.snapshot.builder import gpu_per_instance_host
+
+    free, numa, total_mem = {}, {}, 0.0
+    for info in device.devices:
+        if info.type == "gpu" and info.health:
+            total_mem = float(info.resources.get(RK.GPU_MEMORY, 0.0))
+            free[info.minor] = np.array([100.0, total_mem, 100.0])
+            numa[info.minor] = info.numa_node
+    for p in survivors:
+        if p.allocated_gpu_minors:
+            _, per = gpu_per_instance_host(total_mem, p)
+            for m in p.allocated_gpu_minors:
+                if m in free:
+                    free[m] = np.maximum(free[m] - per, 0.0)
+    return free, numa, total_mem
+
+
+def fine_grained_admits(preemptor: api.Pod, node: api.Node,
+                        device: Optional[api.Device],
+                        survivors: Sequence[api.Pod],
+                        devices_known: bool,
+                        max_zones: int = DEFAULT_MAX_ZONES) -> bool:
+    """Best-effort host mirror of the fine-grained gates the next batch
+    re-runs: single-NUMA zone fit, per-instance GPU/aux fit, and — for
+    a bind+GPU preemptor — their AGREEMENT on one zone (the topology-
+    manager hint merge: the zone that holds the cpus must also hold
+    enough free instances; instances with unknown NUMA (-1) count
+    toward every zone). The EXACT merged-hint policy semantics live in
+    scheduler/topologymanager.py; residual divergence is advisory-only
+    (a rejected nomination requeues, like the reference's
+    nominatedNodeName)."""
+    from koordinator_tpu.snapshot.builder import gpu_per_instance_host
+
+    zone_fit = _zone_fit_list(preemptor, node, survivors, max_zones)
+    if zone_fit is not None and not any(zone_fit):
+        return False
+    if not devices_known:
+        return True
+    if not device_admits(preemptor, device, survivors):
+        return False
+    if zone_fit and device is not None and wants_gpu(preemptor):
+        free, numa, total_mem = _gpu_free_map(device, survivors)
+        count, per = gpu_per_instance_host(total_mem, preemptor)
+        if count > 0:
+            def zone_holds(z: int) -> bool:
+                return sum(1 for m, f in free.items()
+                           if numa.get(m, -1) in (z, -1)
+                           and (f + EPS >= per).all()) >= count
+
+            if not any(ok and zone_holds(z)
+                       for z, ok in enumerate(zone_fit)):
+                return False
     return True
 
 
@@ -414,10 +478,10 @@ def find_preemption(preemptor: api.Pod,
             dev = devices.get(node.meta.name) if devices else None
 
             def fine(survivors, _node=node, _dev=dev):
-                return (zone_admits(preemptor, _node, survivors)
-                        and (devices is None
-                             or device_admits(preemptor, _dev,
-                                              survivors)))
+                return fine_grained_admits(preemptor, _node, _dev,
+                                           survivors,
+                                           devices_known=devices
+                                           is not None)
         victims = select_victims_on_node(
             preemptor, resource_vec(node.allocatable),
             pods_by_node.get(node.meta.name, ()), admit=admit,

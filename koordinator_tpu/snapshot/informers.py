@@ -207,6 +207,12 @@ class ClusterInformerHub:
         with self._lock:
             return self._devices.get(node_name)
 
+    def devices_by_node(self) -> Dict[str, api.Device]:
+        """node name -> Device CR (the mapping the preemption post
+        filter's get_devices provider wants)."""
+        with self._lock:
+            return dict(self._devices)
+
     # --- ClusterSource protocol (cmd/manager.py) ------------------------
     def nodes(self) -> List[api.Node]:
         with self._lock:

@@ -324,7 +324,8 @@ def test_e2e_preemption_nominates_and_places(tmp_path):
     service.error_dispatcher.register(post=make_preemption_post_filter(
         lambda: hub.read_all()["nodes"],
         lambda: hub.read_all()["pods_by_node"],
-        lambda pod, nom: nominations.append((pod, nom))))
+        lambda pod, nom: nominations.append((pod, nom)),
+        get_devices=hub.devices_by_node))
 
     prod = api.Pod(meta=api.ObjectMeta(name="prod-0"), priority=9500,
                    requests={RK.CPU: 5000.0, RK.MEMORY: 512.0})

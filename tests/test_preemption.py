@@ -270,6 +270,71 @@ def test_gpu_instance_fit_rechecked_when_devices_known():
                            devices={"n0": device}) is None
 
 
+def test_zone_instance_agreement_for_bind_gpu_preemptors():
+    """A bind+GPU preemptor needs ONE zone holding both the cpus and
+    the free instance (the hint-merge mirror): cpu room in zone 0 with
+    the free GPU in zone 1 is refused; freeing zone 0's GPU via a
+    victim is nominated. Also pins the max_zones clamp: a zone beyond
+    the builder's capacity never admits."""
+    from koordinator_tpu.api.types import (
+        Device,
+        DeviceInfo,
+        NodeResourceTopology,
+        NUMAZone,
+    )
+    from koordinator_tpu.scheduler.preemption import zone_admits
+
+    topo = NodeResourceTopology(zones=[
+        NUMAZone(cpus_milli=16000.0, memory_mib=32768.0),
+        NUMAZone(cpus_milli=2000.0, memory_mib=32768.0)])
+    node = Node(meta=ObjectMeta(name="n0"),
+                allocatable={RK.CPU: 18000.0, RK.MEMORY: 65536.0,
+                             RK.GPU_CORE: 200.0,
+                             RK.GPU_MEMORY: 32768.0},
+                topology=topo)
+    device = Device(node_name="n0", devices=[
+        DeviceInfo(type="gpu", minor=0, health=True, numa_node=0,
+                   resources={RK.GPU_MEMORY: 16384.0}),
+        DeviceInfo(type="gpu", minor=1, health=True, numa_node=1,
+                   resources={RK.GPU_MEMORY: 16384.0})])
+    # zone-0's GPU held by a LOW-priority bind pod; zone 1 has a free
+    # GPU but no cpu room for the preemptor
+    holder = mk_pod("holder", 5000, 1000.0)
+    holder.requests[RK.GPU_CORE] = 100.0
+    holder.gpu_memory_ratio = 100.0
+    holder.allocated_gpu_minors = [0]
+    holder.required_cpu_bind = True
+    holder.allocated_numa_zone = 0
+    preemptor = mk_pod("train", 9500, 8000.0)
+    preemptor.requests[RK.GPU_CORE] = 100.0
+    preemptor.gpu_memory_ratio = 100.0
+    preemptor.required_cpu_bind = True
+    got = find_preemption(preemptor, [node], {"n0": [holder]},
+                          devices={"n0": device})
+    # evicting holder frees zone-0's GPU, making zone 0 satisfy BOTH
+    assert got is not None
+    assert [v.meta.name for v in got.victims] == ["holder"]
+    # with the holder protected, no zone satisfies both -> refused
+    holder.priority = 9600
+    assert find_preemption(preemptor, [node], {"n0": [holder]},
+                           devices={"n0": device}) is None
+    # max_zones clamp: room only in zone index 4 (beyond the builder's
+    # 4-zone snapshot capacity) must not admit a bind preemptor
+    topo6 = NodeResourceTopology(zones=[
+        NUMAZone(cpus_milli=100.0, memory_mib=128.0)] * 4 + [
+        NUMAZone(cpus_milli=16000.0, memory_mib=32768.0)])
+    node6 = Node(meta=ObjectMeta(name="n6"),
+                 allocatable={RK.CPU: 16400.0, RK.MEMORY: 33280.0},
+                 topology=topo6)
+    assert not zone_admits(mk_bind_pod(), node6, [])
+
+
+def mk_bind_pod():
+    p = mk_pod("bind", 9500, 8000.0)
+    p.required_cpu_bind = True
+    return p
+
+
 def test_amplified_cpu_charging_in_victim_selection():
     """Regression (ADVICE r3): on a node whose webhook published
     amplified allocatable, a CPU-bind preemptor/victim charges
