@@ -357,6 +357,9 @@ class SchedulerService:
         # commit lock; `store.version` alone can already reflect another
         # thread's later commit)
         self.last_committed_version = 0
+        # per-thread (version, elapsed) of the calling thread's last
+        # schedule() — see last_schedule_info
+        self._tls = threading.local()
         # called with (failed_gang_indices, result) when a batch PROVES
         # strict gangs short of quorum; the gang controller un-assumes
         # their held members through store.forget with the batches it
@@ -412,9 +415,18 @@ class SchedulerService:
                 # (and makes the kernel timer measure device time)
                 assignment = np.asarray(result.assignment)
             self.store.update(lambda _old: result.snapshot)
-            self.last_committed_version = self.store.version
-        self.last_elapsed = self.monitor.complete_cycle(token)
-        self.metrics.cycle_seconds.observe(self.last_elapsed)
+            # THIS call's commit version, captured under the lock — the
+            # shared last_committed_version attribute can already
+            # reflect a racing ingest by the time a caller reads it
+            version = self.store.version
+            self.last_committed_version = version
+        self.last_elapsed = elapsed = self.monitor.complete_cycle(token)
+        # per-CALL (version, elapsed) for the calling thread: the
+        # threaded sidecar reads them after scheduling, and the shared
+        # attributes race with concurrent ingests/schedules
+        self._tls.version = version
+        self._tls.elapsed = elapsed
+        self.metrics.cycle_seconds.observe(elapsed)
         self.batches += 1
         valid = np.asarray(pods.valid)
         placed_n = int(((assignment >= 0) & valid).sum())
@@ -440,6 +452,21 @@ class SchedulerService:
             log.info("filter table:\n%s", debug_filter_table(
                 snap, pods, self.cfg, pod_names))
         return result
+
+    def last_schedule_info(self) -> tuple:
+        """(commit version, elapsed seconds) of THE CALLING THREAD's
+        most recent schedule() — race-free under the threaded sidecar,
+        where the shared last_* attributes can reflect another
+        connection's commit. Raises for a thread that never scheduled:
+        a silent fallback to the shared attributes would reintroduce
+        the exact misattribution this API exists to prevent."""
+        version = getattr(self._tls, "version", None)
+        if version is None:
+            raise RuntimeError(
+                "last_schedule_info: this thread has not called "
+                "schedule(); read last_committed_version/last_elapsed "
+                "for the shared (racy) values instead")
+        return version, self._tls.elapsed
 
     def summary(self) -> dict:
         return {

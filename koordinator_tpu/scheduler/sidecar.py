@@ -148,6 +148,9 @@ class SchedulerSidecarServer:
             req.gate_flags)
         result = self.service.schedule(
             pods, pod_names=list(req.pod_names) or None)
+        # per-call values: the shared last_* attributes can already
+        # reflect a RACING ingest/schedule on another connection thread
+        version, elapsed = self.service.last_schedule_info()
         return pb.ScheduleResponse(
             assignment=np.asarray(result.assignment,
                                   np.int32).tolist(),
@@ -155,8 +158,8 @@ class SchedulerSidecarServer:
                                     np.float32).tolist(),
             numa_zone=np.asarray(result.numa_zone, np.int32).tolist(),
             gang_failed=np.asarray(result.gang_failed, bool).tolist(),
-            snapshot_version=self.service.last_committed_version,
-            elapsed_seconds=self.service.last_elapsed)
+            snapshot_version=version,
+            elapsed_seconds=elapsed)
 
     def _summary(self, _req: pb.SummaryRequest) -> pb.SummaryResponse:
         return pb.SummaryResponse(json=json.dumps(self.service.summary()))

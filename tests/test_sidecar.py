@@ -151,3 +151,55 @@ def test_gate_flags_survive_the_wire(tmp_path):
         assert int(out["assignment"][0]) == -1  # gate held over the wire
     finally:
         server.close()
+
+
+def test_concurrent_topology_and_schedule_over_socket(tmp_path, cluster):
+    """The RPC server is threaded: topology ingests racing Schedule
+    calls must serialize under the commit lock — versions stay
+    monotonic, no commit is lost, and the final snapshot reflects every
+    ingest."""
+    import threading
+
+    b, snap, ctx = cluster
+    service = SchedulerService(num_rounds=1, k_choices=2)
+    server = SchedulerSidecarServer(service, str(tmp_path / "c.sock"))
+    try:
+        client = SchedulerSidecarClient(server.sock_path, timeout=120.0)
+        client.publish(snap)
+        errors = []
+        versions = []
+
+        def churner():
+            try:
+                for i in range(8):
+                    b.add_node(api.Node(
+                        meta=api.ObjectMeta(name="n3"),
+                        allocatable={RK.CPU: 16000.0 + i * 100,
+                                     RK.MEMORY: 32768.0}))
+                    versions.append(client.ingest_topology(
+                        b.topology_delta(["n3"], now=NOW, pad_to=4)))
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+
+        def scheduler_loop():
+            try:
+                for i in range(8):
+                    versions.append(int(client.schedule(
+                        mk_pods(b, ctx, n=2))["snapshot_version"]))
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+
+        threads = [threading.Thread(target=churner),
+                   threading.Thread(target=scheduler_loop)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+            assert not t.is_alive()
+        assert not errors, errors
+        # 1 publish + 8 ingests + 8 schedules, every commit distinct
+        assert sorted(versions) == list(range(2, 18))
+        alloc = np.asarray(service.store.current().nodes.allocatable)
+        assert alloc[3, 0] == 16700.0  # the LAST ingest won row 3
+    finally:
+        server.close()
