@@ -67,9 +67,12 @@ class SchedulerMonitor:
         now = time.monotonic() if now is None else now
         with self._lock:
             started = self._inflight.pop(token, now)
-        elapsed = now - started
+            elapsed = now - started
+            if elapsed > self.timeout:
+                # inside the lock: concurrent sidecar cycles would
+                # otherwise lose timeout increments
+                self.timeouts += 1
         if elapsed > self.timeout:
-            self.timeouts += 1
             if self.metrics is not None:
                 self.metrics.scheduling_timeout.labels("default").inc()
             log.warning("scheduling cycle exceeded %.0fs: %.2fs",
@@ -360,6 +363,7 @@ class SchedulerService:
         # per-thread (version, elapsed) of the calling thread's last
         # schedule() — see last_schedule_info
         self._tls = threading.local()
+        self._counter_lock = threading.Lock()
         # called with (failed_gang_indices, result) when a batch PROVES
         # strict gangs short of quorum; the gang controller un-assumes
         # their held members through store.forget with the batches it
@@ -427,10 +431,13 @@ class SchedulerService:
         self._tls.version = version
         self._tls.elapsed = elapsed
         self.metrics.cycle_seconds.observe(elapsed)
-        self.batches += 1
         valid = np.asarray(pods.valid)
         placed_n = int(((assignment >= 0) & valid).sum())
-        self.pods_placed += placed_n
+        with self._counter_lock:
+            # += on the shared counters is not atomic across threads;
+            # the threaded sidecar schedules concurrently
+            self.batches += 1
+            self.pods_placed += placed_n
         self.metrics.pods_scheduled.labels("placed").inc(placed_n)
         self.metrics.pods_scheduled.labels("unschedulable").inc(
             int(((assignment < 0) & valid).sum()))

@@ -341,6 +341,36 @@ def test_frozen_frames_drive_a_live_server(tmp_path):
         server.close()
 
 
+def test_error_frames_follow_the_status_byte_contract(tmp_path):
+    """Foreign-client failure modes must come back as status-1 frames
+    with utf-8 text (docs/SIDECAR_WIRE.md §1), never hangs or closed
+    sockets: an unknown method and a malformed protobuf body."""
+    from koordinator_tpu.scheduler.frameworkext import SchedulerService
+    from koordinator_tpu.scheduler.sidecar import SchedulerSidecarServer
+
+    server = SchedulerSidecarServer(SchedulerService(),
+                                    str(tmp_path / "e.sock"))
+    try:
+        def roundtrip_raw(frame_bytes):
+            s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+            s.settimeout(30.0)
+            s.connect(server.sock_path)
+            s.sendall(frame_bytes)
+            (ln,) = struct.unpack(">I", _recv_exact(s, 4))
+            raw = _recv_exact(s, ln)
+            s.close()
+            return raw
+
+        resp = roundtrip_raw(frame("NoSuchMethod", b""))
+        assert resp[0] == 1
+        assert "NoSuchMethod" in resp[1:].decode()
+
+        resp = roundtrip_raw(frame("Schedule", b"\xff\xff\xff garbage"))
+        assert resp[0] == 1 and len(resp) > 1
+    finally:
+        server.close()
+
+
 def _recv_exact(s: socket.socket, n: int) -> bytes:
     buf = b""
     while len(buf) < n:
