@@ -950,14 +950,16 @@ def test_chunk1_equivalence_with_topology_gates():
     from oracle import OracleArgs, OracleScheduler
 
     zones = ["z0", "z0", "z1", "z1", "z2", "z2"]
+    racks = ["r0", "r1", "r0", "r1", "r0", "r1"]
     taints = [[], [Taint(key="ded", value="x", effect="NoSchedule")],
               [], [], [], []]
 
     def make_nodes():
         out = []
-        for i, z in enumerate(zones):
+        for i, (z, r) in enumerate(zip(zones, racks)):
             out.append(Node(meta=ObjectMeta(name=f"n{i}",
-                                            labels={"zone": z}),
+                                            labels={"zone": z,
+                                                    "rack": r}),
                             allocatable={RK.CPU: 8000.0 + i * 4000.0,
                                          RK.MEMORY: 65536.0},
                             taints=list(taints[i])))
@@ -967,23 +969,34 @@ def test_chunk1_equivalence_with_topology_gates():
                                      label_selector={"app": "web"})
     anti = PodAffinityTerm(topology_key="zone",
                            label_selector={"app": "kv"}, anti=True)
+    # a SECOND anti term for the multi-term kind (rack vs web)
+    anti2 = PodAffinityTerm(topology_key="rack",
+                            label_selector={"app": "web"}, anti=True)
     tol = [Toleration(key="ded", value="x", effect="NoSchedule")]
     pods = []
     for j in range(12):
-        kind = j % 3
+        kind = j % 4
         prio = 9000 + (12 - j) * 13    # distinct priorities: stable order
         cpu = 700.0 + j * 31.0         # distinct requests: no score ties
         if kind == 0:
+            # web pods land on j in {0, 4, 8}: j % 8 keeps SOME of them
+            # tolerant so the taint-x-spread interplay stays covered
             pods.append(Pod(meta=ObjectMeta(name=f"w{j}", namespace="d",
                                             labels={"app": "web"}),
                             priority=prio, requests={RK.CPU: cpu},
                             spread_constraints=[spread],
-                            tolerations=tol if j % 2 else []))
+                            tolerations=tol if j % 8 else []))
         elif kind == 1:
             pods.append(Pod(meta=ObjectMeta(name=f"k{j}", namespace="d",
                                             labels={"app": "kv"}),
                             priority=prio, requests={RK.CPU: cpu},
                             pod_affinity=[anti]))
+        elif kind == 2:
+            # MULTI-TERM carrier: both anti terms must hold at once
+            pods.append(Pod(meta=ObjectMeta(name=f"m{j}", namespace="d",
+                                            labels={"app": "kv"}),
+                            priority=prio, requests={RK.CPU: cpu},
+                            pod_affinity=[anti, anti2]))
         else:
             pods.append(Pod(meta=ObjectMeta(name=f"p{j}", namespace="d",
                                             labels={"app": "plain"}),
