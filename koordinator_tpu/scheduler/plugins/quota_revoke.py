@@ -142,7 +142,8 @@ def select_victims_on_node(preemptor: api.Pod,
                            pods_on_node: Sequence[api.Pod],
                            quota_used: np.ndarray,
                            quota_runtime: np.ndarray,
-                           cpu_amplification: float = 1.0
+                           cpu_amplification: float = 1.0,
+                           fine_fit=None
                            ) -> Optional[PreemptionResult]:
     """SelectVictimsOnNode (preempt.go:111-220), quota-constrained: only
     lower-priority pods of the preemptor's OWN quota are candidates
@@ -150,7 +151,9 @@ def select_victims_on_node(preemptor: api.Pod,
     runtime after the removals. Returns None when preemption on this node
     cannot help. The NODE fit charges amplified CPU for bind pods
     (matching the device gate); quota accounting stays RAW — quota trees
-    meter requests, not node capacity."""
+    meter requests, not node capacity. `fine_fit(survivors)` re-runs
+    the fine-grained gates per reprieve step (preemption.
+    fine_grained_admits — same contract as default preemption)."""
     from koordinator_tpu.scheduler.preemption import charged_request
 
     prio = preemptor.priority or 0
@@ -185,9 +188,11 @@ def select_victims_on_node(preemptor: api.Pod,
     def extra_fit(returned: np.ndarray, reprieved) -> bool:
         raw_returned = sum((raw(p) for p in reprieved),
                            np.zeros_like(req_raw))
-        return (_fits(base_used + returned + req_node, node_allocatable)
+        if not (_fits(base_used + returned + req_node, node_allocatable)
                 and _fits(q_used + raw_returned + req_raw,
-                          quota_runtime))
+                          quota_runtime)):
+            return False
+        return fine_fit is None or fine_fit(others + list(reprieved))
 
     victims = reprieve_victims(req_node, candidates, extra_fit,
                                req_fn=charged)

@@ -417,6 +417,42 @@ def test_infra_errors_never_preempt():
     assert nominations == []
 
 
+def test_quota_preemption_honors_fine_fit():
+    """Quota-scoped victim selection accepts the same fine_fit hook as
+    default preemption: a bind preemptor whose zone never frees is
+    refused even when flat node+quota math passes."""
+    from koordinator_tpu.api.types import NodeResourceTopology, NUMAZone
+    from koordinator_tpu.scheduler.plugins.quota_revoke import (
+        select_victims_on_node as quota_select,
+    )
+    from koordinator_tpu.scheduler.preemption import fine_grained_admits
+    from koordinator_tpu.snapshot.builder import resource_vec as rv
+
+    node = Node(meta=ObjectMeta(name="n0"),
+                allocatable={RK.CPU: 16000.0, RK.MEMORY: 32768.0},
+                topology=NodeResourceTopology(zones=[
+                    NUMAZone(cpus_milli=4000.0, memory_mib=16384.0),
+                    NUMAZone(cpus_milli=4000.0, memory_mib=16384.0)]))
+    victim = mk_pod("v", 5000, 4000.0)
+    victim.quota_name = "q"
+    preemptor = mk_pod("prod", 9500, 6000.0)  # > any zone's 4000m
+    preemptor.quota_name = "q"
+    preemptor.required_cpu_bind = True
+    fine = lambda survivors: fine_grained_admits(
+        preemptor, node, None, survivors, devices_known=False)
+    # runtime tight enough that the victim MUST go for flat math
+    runtime = rv({RK.CPU: 7000.0, RK.MEMORY: 64000.0})
+    got = quota_select(preemptor, rv(node.allocatable), [victim],
+                       rv({RK.CPU: 4000.0}), runtime, fine_fit=fine)
+    assert got is None  # no zone can ever hold 6000m bind cpus
+    # an unbound twin under the same flat pressure evicts the victim
+    preemptor.required_cpu_bind = False
+    got2 = quota_select(preemptor, rv(node.allocatable), [victim],
+                        rv({RK.CPU: 4000.0}), runtime, fine_fit=fine)
+    assert got2 is not None
+    assert [v.meta.name for v in got2.victims] == ["v"]
+
+
 def test_quota_preemption_honors_preemptible_annotation():
     from koordinator_tpu.scheduler.plugins.quota_revoke import (
         select_victims_on_node as quota_select,
