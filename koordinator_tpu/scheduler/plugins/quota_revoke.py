@@ -24,7 +24,7 @@ the batched device kernels by design.
 from __future__ import annotations
 
 import dataclasses
-from typing import Dict, List, Optional, Sequence
+from typing import Callable, Dict, List, Optional, Sequence
 
 import numpy as np
 
@@ -143,7 +143,7 @@ def select_victims_on_node(preemptor: api.Pod,
                            quota_used: np.ndarray,
                            quota_runtime: np.ndarray,
                            cpu_amplification: float = 1.0,
-                           fine_fit=None
+                           fine_fit: Optional[Callable] = None
                            ) -> Optional[PreemptionResult]:
     """SelectVictimsOnNode (preempt.go:111-220), quota-constrained: only
     lower-priority pods of the preemptor's OWN quota are candidates
