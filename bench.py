@@ -47,7 +47,28 @@ CHUNK = int(os.environ.get("BENCH_CHUNK", 2_000))
 FULL_CHUNK = int(os.environ.get("BENCH_FULL_CHUNK", CHUNK))
 MIN_TAIL_PASSES = 2   # always run (keeps the tail program warm)
 MAX_TAIL_PASSES = int(os.environ.get("BENCH_MAX_TAIL_PASSES", 6))
+# Protocol note (round 4 -> 5): since round 4 the timed region includes one
+# host sync after the sweep plus one scalar readback per ADAPTIVE tail pass
+# (round 3 ran a fixed TAIL_PASSES count with no mid-region sync).  Cross-
+# round comparisons against BENCH_r03 and earlier are therefore not strictly
+# apples-to-apples; `tail_passes` is recorded in every line so a reader can
+# normalize.  The 2 s target itself is unchanged (BASELINE.json).
 BASELINE_SECONDS = 2.0
+
+# mid-round TPU capture stamped by tools/tpu_capture.py; surfaced on the
+# degraded CPU fallback so a round-end tunnel outage no longer erases
+# evidence captured while the tunnel was healthy (rounds 3+4 lesson)
+CAPTURE_ARTIFACT = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "bench_tpu_capture.json")
+
+
+def host_fields() -> dict:
+    """Host fingerprint recorded in every bench line: these CI hosts
+    live-migrate and resize mid-session (observed nproc 8 -> 1), and
+    without cores/host in the artifact a degraded-host number is
+    indistinguishable from a kernel regression (VERDICT r4 weak #3)."""
+    from koordinator_tpu.utils.hostinfo import host_fields as hf
+    return hf()
 
 
 
@@ -110,28 +131,33 @@ def ensure_platform(probe_timeout: float = None) -> bool:
     return False
 
 
-def run_northstar(full_gate: bool = False) -> dict:
+def run_northstar(full_gate: bool = False, num_pods: int = None,
+                  num_nodes: int = None, chunk: int = None,
+                  metric: str = None) -> dict:
     from koordinator_tpu.parallel import mesh as meshlib
     from koordinator_tpu.scheduler import core
     from koordinator_tpu.scheduler.plugins.loadaware import LoadAwareConfig
     from koordinator_tpu.utils import synthetic
 
-    chunk = FULL_CHUNK if full_gate else CHUNK
-    if NUM_PODS % chunk:
-        raise SystemExit(f"BENCH_PODS={NUM_PODS} must be a multiple of "
+    num_pods = NUM_PODS if num_pods is None else num_pods
+    num_nodes = NUM_NODES if num_nodes is None else num_nodes
+    if chunk is None:
+        chunk = FULL_CHUNK if full_gate else CHUNK
+    if num_pods % chunk:
+        raise SystemExit(f"BENCH_PODS={num_pods} must be a multiple of "
                          f"the chunk size {chunk}")
     if full_gate:
-        pods = synthetic.full_gate_pods(NUM_PODS, NUM_NODES, seed=1,
+        pods = synthetic.full_gate_pods(num_pods, num_nodes, seed=1,
                                         num_quotas=32)
         make_snap = functools.partial(synthetic.full_gate_cluster,
-                                      NUM_NODES, num_quotas=32)
-        metric = "score_bind_100k_pods_10k_nodes_full_gate"
+                                      num_nodes, num_quotas=32)
+        metric = metric or "score_bind_100k_pods_10k_nodes_full_gate"
         step_kw = dict(enable_numa=True, enable_devices=True)
     else:
-        pods = synthetic.synthetic_pods(NUM_PODS, seed=1, num_quotas=32)
+        pods = synthetic.synthetic_pods(num_pods, seed=1, num_quotas=32)
         make_snap = functools.partial(synthetic.synthetic_cluster,
-                                      NUM_NODES, num_quotas=32)
-        metric = "score_bind_100k_pods_10k_nodes"
+                                      num_nodes, num_quotas=32)
+        metric = metric or "score_bind_100k_pods_10k_nodes"
         # no pod in the slim workload requests CPU binding or devices —
         # the batched analogue of the reference's state.skip fast paths
         step_kw = dict(enable_numa=False)
@@ -240,7 +266,7 @@ def run_northstar(full_gate: bool = False) -> dict:
     def full_pass(snap, counts):
         snap, counts, assign = sweep(snap, counts, stacked, pods_dev, cfg)
         left_after_sweep = int(count_left(assign, pods_dev))
-        tried = jnp.zeros((NUM_PODS,), bool)
+        tried = jnp.zeros((num_pods,), bool)
         left = left_after_sweep
         passes = 0
         never_retried = left
@@ -293,7 +319,7 @@ def run_northstar(full_gate: bool = False) -> dict:
         "value": round(elapsed, 4),
         "unit": "s",
         "vs_baseline": round(BASELINE_SECONDS / elapsed, 2),
-        "pods_per_sec": round(NUM_PODS / elapsed),
+        "pods_per_sec": round(num_pods / elapsed),
         "placed": placed,
         "stragglers_after_sweep": left_after_sweep,
         "stragglers_final": left_final,
@@ -301,9 +327,57 @@ def run_northstar(full_gate: bool = False) -> dict:
         "tail_passes": passes,
         "devices": len(jax.devices()),
         "platform": jax.devices()[0].platform,
+        **host_fields(),
     }
     print(json.dumps(result))
     return result
+
+
+def surface_stamped_capture() -> bool:
+    """Re-emit the mid-round TPU capture (tools/tpu_capture.py) on the
+    degraded fallback, each line labeled stamped_capture + captured_at so
+    the driver tail records TPU evidence even when the round-end tunnel is
+    wedged.  The LIVE canonical line still prints last (and is the one the
+    driver parses); these stamped lines are the documented evidence trail,
+    never presented as the live run.
+
+    Best-effort by construction: NOTHING here may crash the degraded
+    bench run (that would destroy the round's only remaining evidence),
+    and an artifact older than BENCH_STAMP_MAX_AGE (default 12 h, one
+    round) is rejected — a leftover from a previous round must not be
+    presented as this round's capture.  The artifact is also gitignored
+    for the same reason."""
+    max_age = float(os.environ.get("BENCH_STAMP_MAX_AGE", "43200"))
+    try:
+        with open(CAPTURE_ARTIFACT) as f:
+            art = json.load(f)
+        lines = [l for l in art["lines"] if isinstance(l, dict)]
+        captured_at = str(art["captured_at"])
+        import datetime
+        age = (datetime.datetime.now(datetime.timezone.utc)
+               - datetime.datetime.fromisoformat(captured_at)
+               ).total_seconds()
+        if not lines:
+            return False
+        if not (0 <= age < max_age):
+            print(f"bench: ignoring stamped capture from {captured_at} "
+                  f"(age {age:.0f}s exceeds BENCH_STAMP_MAX_AGE "
+                  f"{max_age:.0f}s)", file=sys.stderr)
+            return False
+        print(f"bench: surfacing {len(lines)} stamped TPU line(s) "
+              f"captured mid-round at {captured_at} (age {age:.0f}s, "
+              "tools/tpu_capture.py)", file=sys.stderr)
+        for line in lines:
+            out = dict(line)
+            out["stamped_capture"] = True
+            out["captured_at"] = captured_at
+            out["stamped_age_seconds"] = round(age)
+            print(json.dumps(out))
+        return True
+    except Exception as exc:  # noqa: BLE001 — see docstring
+        print(f"bench: stamped capture unreadable ({exc!r}); continuing",
+              file=sys.stderr)
+        return False
 
 
 def main(platform_healthy: bool = True):
@@ -318,6 +392,17 @@ def main(platform_healthy: bool = True):
               "fallback (BENCH_EXTRAS=force to override)",
               file=sys.stderr)
         extras = False
+    if not platform_healthy:
+        # any mid-round TPU capture is the round's real evidence
+        surface_stamped_capture()
+        if os.environ.get("BENCH_FULL_DEGRADED", "1") not in ("0", "false"):
+            # scaled-down full-gate regression line: without it a wedged
+            # tunnel means the full plugin chain records NOTHING at scale
+            # for the whole round (VERDICT r4 weak #1); 20k x 2k is cheap
+            # enough for the 1-core fallback hosts
+            run_northstar(full_gate=True, num_pods=20_000, num_nodes=2_000,
+                          chunk=2_000,
+                          metric="score_bind_20k_pods_2k_nodes_full_gate_degraded")
     if extras:
         # BASELINE configs 1-5 + the full-gate flagship, driver-captured
         # per round (VERDICT r3: self-reported tables don't count)

@@ -27,8 +27,11 @@ import numpy as np
 
 
 def _emit(name, elapsed, **extra):
+    from koordinator_tpu.utils.hostinfo import host_fields
     out = {"metric": name, "value": round(elapsed, 4), "unit": "s"}
     out.update(extra)
+    out.update(host_fields())
+    out.setdefault("platform", jax.devices()[0].platform)
     print(json.dumps(out))
 
 

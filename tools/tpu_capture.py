@@ -1,0 +1,191 @@
+"""Opportunistic TPU bench capture (round-5 protocol).
+
+Rounds 3 and 4 both lost their headline TPU evidence because the ONLY
+capture window was the driver's round-end `python bench.py`, and the axon
+tunnel happened to be wedged at that moment both times (BENCH_r03/r04.json
+are honest CPU fallbacks).  This tool decouples capture time from round-end
+time: a watcher loop (tools/tpu_watcher.sh) probes the tunnel every few
+minutes for the whole round and, on the first healthy probe, runs the FULL
+bench suite (BASELINE configs 1-5, the full-gate flagship, the canonical
+north-star, plus a BENCH_APPROX=0 exact-top-k comparison line) and freezes
+every emitted JSON line into a timestamped artifact:
+
+    /root/repo/bench_tpu_capture.json
+
+`bench.py` surfaces that artifact in its output tail whenever its own live
+run degrades to the CPU fallback, each stamped line clearly labeled with
+`"stamped_capture": true` and the capture timestamp — so a round-end outage
+no longer erases evidence captured mid-round while the tunnel was healthy.
+
+Probe/run hygiene (the round-3/4 lessons, see bench.py:_probe_once):
+- probes run in a subprocess with DEVNULL stdio and a hard timeout — a
+  wedged tunnel hangs trivial compiles at 0% CPU and the platform plugin
+  can leave a tunnel grandchild holding captured pipes open forever;
+- the bench run itself writes stdout/stderr to FILES, never pipes, and is
+  killed (process group) past a hard deadline.
+"""
+
+import datetime
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+ARTIFACT = os.path.join(REPO, "bench_tpu_capture.json")
+LOG = os.path.join(REPO, "tools", "tpu_capture.log")
+PROBE_TIMEOUT = float(os.environ.get("CAPTURE_PROBE_TIMEOUT", "150"))
+BENCH_TIMEOUT = float(os.environ.get("CAPTURE_BENCH_TIMEOUT", "3300"))
+APPROX_TIMEOUT = float(os.environ.get("CAPTURE_APPROX_TIMEOUT", "1500"))
+FRESH_SECONDS = float(os.environ.get("CAPTURE_FRESH_SECONDS", "7200"))
+
+
+def log(msg: str) -> None:
+    stamp = datetime.datetime.now(datetime.timezone.utc).isoformat()
+    line = f"[{stamp}] {msg}"
+    print(line, flush=True)
+    with open(LOG, "a") as f:
+        f.write(line + "\n")
+
+
+def probe_once(timeout: float = PROBE_TIMEOUT) -> bool:
+    """One hard-timeout subprocess probe of the configured platform.
+
+    Delegates to bench._probe_once — the probe child program is subtle
+    (it must re-pin JAX_PLATFORMS inside the child or site config
+    silently overrides it) and must not drift between the watcher and
+    the bench's own guard."""
+    import bench
+    return bench._probe_once(timeout)
+
+
+def _run_to_files(cmd, env, timeout, tag):
+    """Run cmd with stdout/stderr redirected to files (pipes wedge when a
+    tunnel grandchild inherits them); kill the whole process group on
+    deadline.  Returns (returncode_or_None, stdout_text)."""
+    out_path = os.path.join(REPO, "tools", f"capture_{tag}.out")
+    err_path = os.path.join(REPO, "tools", f"capture_{tag}.err")
+    with open(out_path, "wb") as out, open(err_path, "wb") as err:
+        proc = subprocess.Popen(cmd, cwd=REPO, env=env, stdout=out,
+                                stderr=err, start_new_session=True)
+        try:
+            rc = proc.wait(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            os.killpg(proc.pid, signal.SIGKILL)
+            proc.wait()
+            rc = None
+    with open(out_path) as f:
+        return rc, f.read()
+
+
+def _json_lines(text: str):
+    lines = []
+    for raw in text.splitlines():
+        raw = raw.strip()
+        if not raw.startswith("{"):
+            continue
+        try:
+            obj = json.loads(raw)
+        except json.JSONDecodeError:
+            continue
+        if isinstance(obj, dict) and "metric" in obj:
+            lines.append(obj)
+    return lines
+
+
+def capture() -> bool:
+    """Run the full bench suite + the BENCH_APPROX=0 comparison; write the
+    artifact.  Returns True when a TPU-platform canonical line landed."""
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "axon")
+    # the watcher just probed; don't spend 3x180s re-probing in-bench
+    env["BENCH_PROBE_ATTEMPTS"] = "2"
+    env["BENCH_PROBE_TIMEOUT"] = "180"
+    env["BENCH_PROBE_RETRY_DELAY"] = "45"
+
+    log(f"capture: running full bench suite (timeout {BENCH_TIMEOUT:.0f}s)")
+    rc, out = _run_to_files([sys.executable, "bench.py"], env,
+                            BENCH_TIMEOUT, "bench")
+    # keep only LIVE non-cpu lines: if the tunnel wedges between the
+    # watcher probe and the bench's own probes, bench degrades to CPU and
+    # may re-surface a PREVIOUS stamped artifact — re-ingesting those (or
+    # the live cpu lines) would launder stale evidence under a fresh
+    # captured_at timestamp
+    lines = [l for l in _json_lines(out)
+             if l.get("platform") != "cpu"
+             and not l.get("stamped_capture")]
+    log(f"capture: bench rc={rc} live non-cpu lines={len(lines)}")
+    if rc != 0 or not lines:
+        log("capture: no live TPU lines; not stamping")
+        return False
+    platforms = {l.get("platform") for l in lines}
+
+    env_approx = dict(env)
+    env_approx["BENCH_APPROX"] = "0"
+    env_approx["BENCH_EXTRAS"] = "0"
+    log("capture: running BENCH_APPROX=0 canonical comparison")
+    rc2, out2 = _run_to_files([sys.executable, "bench.py"], env_approx,
+                              APPROX_TIMEOUT, "approx0")
+    approx_lines = [l for l in _json_lines(out2)
+                    if l.get("platform") != "cpu"
+                    and not l.get("stamped_capture")]
+    log(f"capture: approx0 rc={rc2} live non-cpu lines={len(approx_lines)}")
+    for l in approx_lines:
+        l["approx_topk"] = False
+
+    artifact = {
+        "captured_at": datetime.datetime.now(
+            datetime.timezone.utc).isoformat(),
+        "platforms": sorted(p for p in platforms if p),
+        "lines": lines + approx_lines,
+    }
+    tmp = ARTIFACT + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(artifact, f, indent=1)
+        f.write("\n")
+    os.replace(tmp, ARTIFACT)
+    log(f"capture: wrote {ARTIFACT} with {len(artifact['lines'])} lines")
+    return True
+
+
+def artifact_fresh() -> bool:
+    try:
+        with open(ARTIFACT) as f:
+            art = json.load(f)
+        captured = datetime.datetime.fromisoformat(art["captured_at"])
+        age = (datetime.datetime.now(datetime.timezone.utc)
+               - captured).total_seconds()
+        return age < FRESH_SECONDS and bool(art.get("lines"))
+    except (OSError, ValueError, KeyError):
+        return False
+
+
+def main() -> int:
+    once = "--once" in sys.argv
+    interval = float(os.environ.get("CAPTURE_PROBE_INTERVAL", "480"))
+    while True:
+        if artifact_fresh():
+            log("watcher: artifact fresh; sleeping long")
+            if once:
+                return 0
+            time.sleep(FRESH_SECONDS / 2)
+            continue
+        healthy = probe_once()
+        log(f"watcher: probe healthy={healthy}")
+        if healthy:
+            if capture():
+                if once:
+                    return 0
+                # refresh later so the stamped number stays recent
+                time.sleep(FRESH_SECONDS / 2)
+                continue
+        if once:
+            return 1
+        time.sleep(interval)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
