@@ -374,6 +374,8 @@ def surface_stamped_capture() -> bool:
             out["stamped_age_seconds"] = round(age)
             print(json.dumps(out))
         return True
+    except FileNotFoundError:
+        return False  # no mid-round capture happened — the normal case
     except Exception as exc:  # noqa: BLE001 — see docstring
         print(f"bench: stamped capture unreadable ({exc!r}); continuing",
               file=sys.stderr)
@@ -399,10 +401,17 @@ def main(platform_healthy: bool = True):
             # scaled-down full-gate regression line: without it a wedged
             # tunnel means the full plugin chain records NOTHING at scale
             # for the whole round (VERDICT r4 weak #1); 20k x 2k is cheap
-            # enough for the 1-core fallback hosts
-            run_northstar(full_gate=True, num_pods=20_000, num_nodes=2_000,
-                          chunk=2_000,
-                          metric="score_bind_20k_pods_2k_nodes_full_gate_degraded")
+            # enough for the 1-core fallback hosts. Best-effort like the
+            # stamped surfacing: a failure here must not abort the run
+            # before the canonical fallback line prints.
+            try:
+                run_northstar(
+                    full_gate=True, num_pods=20_000, num_nodes=2_000,
+                    chunk=2_000,
+                    metric="score_bind_20k_pods_2k_nodes_full_gate_degraded")
+            except Exception as exc:  # noqa: BLE001 — evidence guard
+                print(f"bench: degraded full-gate line failed ({exc!r}); "
+                      "continuing to the canonical line", file=sys.stderr)
     if extras:
         # BASELINE configs 1-5 + the full-gate flagship, driver-captured
         # per round (VERDICT r3: self-reported tables don't count)

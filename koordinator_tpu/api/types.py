@@ -465,6 +465,11 @@ class Reservation:
     node_name: str = ""         # set when the reservation is scheduled
     phase: str = "Pending"      # Pending|Available|Succeeded|Failed|Expired
     allocated: ResourceList = dataclasses.field(default_factory=dict)
+    # uids of pods whose allocation is included in `allocated`
+    # (status.currentOwners, reservation_types.go) — lets the assume
+    # cache retire a consumer the moment the CR accounts for it, so the
+    # consumer is never subtracted from the hold twice
+    current_owners: Tuple[str, ...] = ()
     create_time: float = 0.0
     conditions: List[ReservationCondition] = dataclasses.field(
         default_factory=list)

@@ -372,7 +372,22 @@ class SchedulerService:
         # simply never reappear)
         self.on_gang_failed: Optional[Callable] = None
         self.last_gang_failed: Optional[np.ndarray] = None
+        # called with (assignment, typed_pods, result) after each commit
+        # when typed_pods was provided: the host assume-cache hook
+        # (SnapshotSyncer.attach_scheduler) records placed pods so
+        # rebuilds/topology deltas keep the in-flight charges
+        self.on_assumed: Optional[Callable] = None
         self.registry.register("scheduler", self.summary)
+
+    def commit_guard(self):
+        """The batch-commit lock, exposed so host-side snapshot writers
+        (SnapshotSyncer) can serialize rebuild/ingest publishes with
+        in-flight schedule commits: an unserialized rebuild landing
+        between a batch's snapshot read and its post-commit publish
+        would be silently overwritten (lost update), and the assume
+        hook would resolve result rows against a swapped builder.
+        Lock order is commit -> view, everywhere."""
+        return self._commit_lock
 
     def publish(self, snapshot: ClusterSnapshot) -> int:
         """Returns the published version, read under the commit lock so a
@@ -424,6 +439,12 @@ class SchedulerService:
             # reflect a racing ingest by the time a caller reads it
             version = self.store.version
             self.last_committed_version = version
+            if self.on_assumed is not None and typed_pods is not None:
+                # under the commit lock: an attached syncer's rebuild
+                # (which serializes on the same lock) cannot swap the
+                # builder between this batch's snapshot and the hook's
+                # row-name resolution
+                self.on_assumed(assignment, typed_pods, result)
         self.last_elapsed = elapsed = self.monitor.complete_cycle(token)
         # per-CALL (version, elapsed) for the calling thread: the
         # threaded sidecar reads them after scheduling, and the shared

@@ -220,6 +220,10 @@ class SnapshotBuilder:
         self.metrics: Dict[str, NodeMetric] = {}
         self.running_pods: List[Pod] = []
         self.assigned: List[AssignedPod] = []
+        # assume-cache mirror: pods committed DEVICE-side whose watch
+        # write-back has not arrived yet (scheduler cache assume,
+        # scheduler_adapter.go) — they hold capacity in every recompute
+        self.assumed_pods: List[Pod] = []
         self.quotas: List[ElasticQuota] = []
         self.quota_index: Dict[str, int] = {}
         self.gangs: List[PodGroup] = []
@@ -276,6 +280,40 @@ class SnapshotBuilder:
         self.assigned.append(
             AssignedPod(pod, node_name, time.time() if timestamp is None
                         else timestamp))
+
+    def set_assumed_pods(self, entries, estimation_entries=None) -> None:
+        """Wholesale-mirror the hub's assume cache (ClusterInformerHub
+        .note_assumed): `entries` is a sequence of (pod, timestamp) where
+        each pod carries node_name + its fine-grained allocations (zone /
+        GPU minors / aux instances / reservation) exactly as the device
+        commit charged them — they hold CAPACITY (requested, NUMA,
+        device grants, quota used; the scheduler cache's merged NodeInfo
+        view). `estimation_entries` (default: `entries`) feeds the
+        recently-assigned usage estimation instead (podAssignCache,
+        load_aware.go:260-267) — it may additionally contain entries
+        whose capacity charge already moved to the watched bound pod but
+        whose usage the NodeMetric does not reflect yet. Replaces any
+        earlier mirror."""
+        self.assumed_pods = [p for p, _ in entries]
+        if estimation_entries is None:
+            estimation_entries = entries
+        self.assigned = [AssignedPod(p, p.node_name, ts)
+                         for p, ts in estimation_entries]
+
+    def _capacity_pods(self):
+        """Running pods plus assumed-but-not-yet-watched pods — the
+        merged NodeInfo view the reference scheduler filters against
+        (assume cache entries hold capacity until the watch delivers the
+        bound pod; scheduler_adapter.go assume/forget). Yields
+        (pod, is_assumed); an assumed uid the watch already delivered is
+        skipped (the watched object carries the charge)."""
+        seen = set()
+        for p in self.running_pods:
+            seen.add(p.meta.uid)
+            yield p, False
+        for p in self.assumed_pods:
+            if p.meta.uid not in seen:
+                yield p, True
 
     def add_quota(self, quota: ElasticQuota) -> int:
         if len(self.quotas) >= self.max_quotas:
@@ -405,7 +443,7 @@ class SnapshotBuilder:
                                     numa_cap, numa_valid, numa_policy)
 
         numa_used = np.zeros((n, z, 2), np.float32)
-        for pod in self.running_pods:
+        for pod, is_assumed in self._capacity_pods():
             idx = self.node_index.get(pod.node_name)
             if idx is not None:
                 rv = resource_vec(pod.requests)
@@ -419,6 +457,13 @@ class SnapshotBuilder:
                 if pod.required_cpu_bind and 0 <= zi < z:
                     numa_used[idx, zi, 0] += rv[int(ResourceKind.CPU)]
                     numa_used[idx, zi, 1] += rv[int(ResourceKind.MEMORY)]
+                if is_assumed and pod.reservation_name:
+                    # an assumed reservation CONSUMER drew from the slot
+                    # hold, not the node pool (core.py res_slot commit);
+                    # build_reservations subtracts it from the hold's
+                    # free instead — charging requested here would
+                    # double-count until the CR's allocated catches up
+                    continue
                 if pod.required_cpu_bind and cpu_amp[idx] > 1.0:
                     # exclusive cores cost amplified CPU against the
                     # amplified allocatable (filterAmplifiedCPUs's
@@ -519,7 +564,9 @@ class SnapshotBuilder:
             for d, a in enumerate(reversed(chain)):
                 depth_anc[i, d] = a
         direct_used = np.zeros((q, r), np.float32)
-        for pod in self.running_pods:
+        # assumed pods count: the device commit already charged quota
+        # used for them (core.py), and a rebuild must not return it
+        for pod, _ in self._capacity_pods():
             qi = self.quota_index.get(pod.quota_name, -1)
             if qi >= 0:
                 direct_used[qi] += resource_vec(pod.requests)
@@ -718,14 +765,19 @@ class SnapshotBuilder:
 
         present = {n: j for j, n in enumerate(names)
                    if n in self.node_index}
-        # one filtered pass: requested + zone usage of running pods /
-        # reservations landing on the K nodes (mirrors build_nodes)
+        # one filtered pass: requested + zone usage of running AND
+        # assumed pods / reservations landing on the K nodes (mirrors
+        # build_nodes; ADVICE r4 — a node heartbeat ingest must not
+        # erase device-side commit charges carried by assumed pods).
+        # Assumed reservation CONSUMERS cannot appear here: they imply
+        # an Available reservation on the node, which the guard above
+        # already routed to the rebuild.
         numa_used = np.zeros((k, z, 2), f32)
         amp_of = {n: node_cpu_amplification_ratio(
             self.nodes[self.node_index[n]].meta.annotations)
             for n in present}
         running_here: Dict[str, List[Pod]] = {}
-        for pod in self.running_pods:
+        for pod, _ in self._capacity_pods():
             j = present.get(pod.node_name)
             if j is None:
                 continue
@@ -835,9 +887,13 @@ class SnapshotBuilder:
         numa_valid_v = np.zeros((v, n_zones), bool)
 
         consumers: Dict[str, List[Pod]] = {}
-        for pod in self.running_pods:
+        assumed_consumers: Dict[str, List[Pod]] = {}
+        for pod, is_assumed in self._capacity_pods():
             if pod.reservation_name:
                 consumers.setdefault(pod.reservation_name, []).append(pod)
+                if is_assumed:
+                    assumed_consumers.setdefault(
+                        pod.reservation_name, []).append(pod)
 
         for i, res in enumerate(self.reservations):
             if res.phase != "Available" or not res.node_name:
@@ -847,6 +903,18 @@ class SnapshotBuilder:
                 continue
             node[i] = ni
             free[i] = resource_vec(res.requests) - resource_vec(res.allocated)
+            # assumed consumers drew from the hold device-side but are
+            # not in the CR's `allocated` yet — subtract them here (and
+            # skip their node `requested` charge, see build_nodes).
+            # current_owners is the belt: a consumer the CR already
+            # accounts for must not be subtracted twice (the hub retires
+            # such assumes on the reservation watch, but compositions
+            # feeding the builder directly bypass that).
+            for c in assumed_consumers.get(res.meta.name, ()):
+                if (c.node_name == res.node_name
+                        and c.meta.uid not in res.current_owners):
+                    free[i] -= resource_vec(c.requests)
+            free[i] = np.maximum(free[i], 0.0)
             key = _selector_key(res.owner_label_selector)
             owner[i] = owner_groups.setdefault(key, len(owner_groups))
             once[i] = res.allocate_once
@@ -1026,7 +1094,7 @@ class SnapshotBuilder:
             self._fill_device_row(node_name, device, ni, gpu_total,
                                   gpu_free, gpu_valid, gpu_numa, gpu_pcie,
                                   aux_free, aux_valid)
-        for pod in self.running_pods:
+        for pod, _ in self._capacity_pods():
             ni = self.node_index.get(pod.node_name)
             if ni is None:
                 continue

@@ -62,6 +62,21 @@ class ClusterInformerHub:
         self._pods_by_node: Dict[str, Dict[str, api.Pod]] = {}
         self._pods_by_owner: Dict[str, Dict[str, api.Pod]] = {}
         self._handlers: Dict[str, List[Callable[[str, object], None]]] = {}
+        # assume cache (scheduler cache assume / podAssignCache): uid ->
+        # (enriched pod, timestamp) for pods committed device-side whose
+        # watch write-back has not arrived. Entries hold capacity in
+        # every host recompute (rebuild + O(K) topology delta) and clear
+        # when the watch delivers the bound pod, the pod is deleted, an
+        # explicit forget returns the charge, or the assume TTL expires
+        # (the k8s scheduler cache expires assumed pods the same way —
+        # a lost bind must not leak phantom capacity forever).
+        self._assumed: Dict[str, tuple] = {}
+        # recently-assigned estimation window (podAssignCache,
+        # load_aware.go:260-267): when the watch delivers the bound pod
+        # the CAPACITY charge moves to the watched object, but the
+        # NodeMetric will not reflect the pod for up to a report
+        # interval — the estimation entry must survive the bind
+        self._recent_assigned: Dict[str, tuple] = {}
 
     def subscribe(self, kind: str,
                   handler: Callable[[str, object], None]) -> None:
@@ -98,6 +113,14 @@ class ClusterInformerHub:
                 self._unindex_pod(old)
             self._pods[uid] = pod
             self._index_pod(pod)
+            if pod.phase in ("Succeeded", "Failed"):
+                self._assumed.pop(uid, None)
+                self._recent_assigned.pop(uid, None)
+            elif pod.node_name:
+                # the watch caught up: the bound watched object now
+                # carries the capacity charge; the estimation entry
+                # survives into the recently-assigned window
+                self._retire_assumed(uid)
             self._notify(KIND_POD,
                          EVENT_UPDATE if old is not None else EVENT_ADD,
                          pod)
@@ -105,9 +128,71 @@ class ClusterInformerHub:
     def delete_pod(self, uid: str) -> None:
         with self._lock:
             pod = self._pods.pop(uid, None)
+            self._assumed.pop(uid, None)
+            self._recent_assigned.pop(uid, None)
             if pod is not None:
                 self._unindex_pod(pod)
                 self._notify(KIND_POD, EVENT_DELETE, pod)
+
+    def _retire_assumed(self, uid: str) -> None:
+        """Capacity charge handed over (watched bound pod / reservation
+        CR); keep the estimation entry for the report-interval window."""
+        entry = self._assumed.pop(uid, None)
+        if entry is not None:
+            self._recent_assigned[uid] = entry
+
+    # --- assume cache (scheduler_adapter.go assume/forget) --------------
+    def note_assumed(self, pod: api.Pod,
+                     timestamp: Optional[float] = None) -> None:
+        """Record a device-side commit: `pod` must carry node_name and
+        its fine-grained allocations (zone / GPU minors / aux instance /
+        reservation) exactly as the commit charged them — the snapshot
+        recomputes (rebuild and O(K) topology delta) mirror the charges
+        from this record until the watch delivers the bound pod. Fires
+        no event: the device snapshot already holds the charge; only
+        future host recomputes need the record."""
+        if not pod.node_name:
+            raise ValueError("note_assumed: pod has no node_name")
+        with self._lock:
+            self._assumed[pod.meta.uid] = (
+                pod, time.time() if timestamp is None else timestamp)
+
+    def forget_assumed(self, uid: str) -> None:
+        """Drop an assume record whose bind failed — pair with
+        SnapshotStore.forget, which returns the device-side charges.
+        The estimation entry goes too: a pod that never ran must not
+        inflate the node's estimated usage."""
+        with self._lock:
+            self._assumed.pop(uid, None)
+            self._recent_assigned.pop(uid, None)
+
+    def expire_assumed(self, now: float, assume_ttl: float,
+                       estimation_ttl: float) -> None:
+        """TTL backstop (the k8s scheduler cache's assumed-pod expiry):
+        an assume whose bind outcome never arrived is dropped after
+        `assume_ttl` so a lost bind cannot leak phantom capacity
+        forever; retired estimation entries age out after
+        `estimation_ttl` (~ the NodeMetric report interval)."""
+        with self._lock:
+            for uid, (_, ts) in list(self._assumed.items()):
+                if now - ts > assume_ttl:
+                    del self._assumed[uid]
+            for uid, (_, ts) in list(self._recent_assigned.items()):
+                if now - ts > estimation_ttl:
+                    del self._recent_assigned[uid]
+
+    def assumed_entries(self) -> List[tuple]:
+        """[(pod, timestamp)] of every capacity-holding assume."""
+        with self._lock:
+            return list(self._assumed.values())
+
+    def estimation_entries(self) -> List[tuple]:
+        """[(pod, timestamp)] feeding the recently-assigned usage
+        estimation: outstanding assumes PLUS retired entries still in
+        the report-interval window."""
+        with self._lock:
+            return (list(self._assumed.values())
+                    + list(self._recent_assigned.values()))
 
     def _index_pod(self, pod: api.Pod) -> None:
         if pod.node_name:
@@ -139,6 +224,12 @@ class ClusterInformerHub:
                      metric)
 
     def upsert_reservation(self, r: api.Reservation) -> None:
+        with self._lock:
+            # consumers the CR's `allocated` now accounts for retire
+            # from the assume cache — the hold must not be charged for
+            # the same consumer twice (status.currentOwners)
+            for uid in r.current_owners:
+                self._retire_assumed(uid)
         self._upsert(self._reservations, r.meta.name, KIND_RESERVATION, r)
 
     def delete_reservation(self, name: str) -> None:
@@ -196,6 +287,8 @@ class ClusterInformerHub:
                 "pod_groups": list(self._pod_groups.values()),
                 "reservations": list(self._reservations.values()),
                 "devices": list(self._devices.values()),
+                "assumed": list(self._assumed.values()),
+                "recent_assigned": list(self._recent_assigned.values()),
                 "resource_version": self.resource_version,
             }
 
@@ -232,6 +325,30 @@ class ClusterInformerHub:
             return list(self._quota_profiles.values())
 
 
+def _node_identity(node: api.Node) -> tuple:
+    """Hashable fingerprint of every node field that flows into a
+    snapshot row (labels, annotations, allocatable, taints,
+    schedulability, NUMA topology). Real clusters heartbeat node STATUS
+    every sync window; without this filter each heartbeat dirties the
+    node and >delta_pad heartbeats collapse the O(K) topology path into
+    the full rebuild it exists to avoid (the reference informers filter
+    updates the same way)."""
+    topo = node.topology
+    tfp = None
+    if topo is not None:
+        tfp = (topo.policy, topo.cpus_per_core,
+               topo.kubelet_reserved_cpuset, topo.ls_share_pool,
+               topo.be_share_pool,
+               tuple((z.cpus_milli, z.memory_mib, z.cpuset)
+                     for z in topo.zones))
+    return (tuple(sorted(node.meta.labels.items())),
+            tuple(sorted(node.meta.annotations.items())),
+            tuple(sorted((str(k), float(v))
+                         for k, v in node.allocatable.items())),
+            tuple((t.key, t.value, t.effect) for t in node.taints),
+            node.unschedulable, tfp)
+
+
 class SnapshotSyncer:
     """Keeps a SnapshotStore fresh from a hub: NodeMetric churn becomes
     an O(K) device-side delta (store.ingest), anything that changes the
@@ -241,12 +358,24 @@ class SnapshotSyncer:
     def __init__(self, hub: ClusterInformerHub, store: SnapshotStore,
                  max_nodes: int, delta_pad: int = 64,
                  now_fn: Callable[[], float] = time.time,
+                 assume_ttl_seconds: float = 900.0,
+                 estimation_ttl_seconds: float = 180.0,
                  **builder_caps):
         self.hub = hub
         self.store = store
         self.max_nodes = max_nodes
         self.delta_pad = delta_pad
         self.now_fn = now_fn
+        # assume expiry backstop (k8s assumed-pod TTL: a bind whose
+        # outcome never arrives must not leak capacity forever) and the
+        # recently-assigned estimation window (~NodeMetric report
+        # interval + slack)
+        self.assume_ttl = assume_ttl_seconds
+        self.estimation_ttl = estimation_ttl_seconds
+        # set by attach_scheduler: snapshot publishes/ingests serialize
+        # with the service's batch commits (lost-update + assume-hook
+        # TOCTOU guard); lock order is commit -> view, everywhere
+        self._service = None
         self.builder_caps = builder_caps
         self.builder: Optional[SnapshotBuilder] = None
         self.ctx = None
@@ -260,6 +389,8 @@ class SnapshotSyncer:
         self.delta_ingests = 0
         self.topology_ingests = 0
         self._dirty_topology: set = set()
+        # last ingested identity fingerprint per node (heartbeat filter)
+        self._node_seen: Dict[str, tuple] = {}
         for kind in (KIND_POD, KIND_RESERVATION, KIND_POD_GROUP,
                      KIND_QUOTA):
             hub.subscribe(kind, self._on_shape_event)
@@ -275,8 +406,16 @@ class SnapshotSyncer:
             self._full_dirty = True
 
     def _on_node_event(self, event: str, obj) -> None:
+        name = obj.meta.name
+        fp = None if event == EVENT_DELETE else _node_identity(obj)
         with self._lock:
-            self._dirty_topology.add(obj.meta.name)
+            if fp is not None and self._node_seen.get(name) == fp:
+                return  # pure status heartbeat — identity unchanged
+            if fp is None:
+                self._node_seen.pop(name, None)
+            else:
+                self._node_seen[name] = fp
+            self._dirty_topology.add(name)
 
     def _on_device_event(self, event: str, obj) -> None:
         with self._lock:
@@ -295,6 +434,7 @@ class SnapshotSyncer:
         delta. Overflow or capacity pressure (rows, label/taint groups,
         PCIe ids) falls back to the rebuild — never silent truncation."""
         now = self.now_fn() if now is None else now
+        self.hub.expire_assumed(now, self.assume_ttl, self.estimation_ttl)
         with self._lock:
             full = self._full_dirty
             topo = sorted(self._dirty_topology)
@@ -302,6 +442,23 @@ class SnapshotSyncer:
             self._full_dirty = False
             self._dirty_topology.clear()
             self._dirty_metrics.clear()
+        # serialize the whole apply phase with in-flight batch commits
+        # when a scheduler is attached: an unserialized rebuild landing
+        # between a batch's snapshot read and its post-commit publish
+        # would be silently overwritten, and the assume hook would
+        # resolve result rows against a swapped builder
+        with self._commit_guard():
+            return self._sync_locked(full, topo, dirty, now)
+
+    def _commit_guard(self):
+        import contextlib
+
+        if self._service is None:
+            return contextlib.nullcontext()
+        return self._service.commit_guard()
+
+    def _sync_locked(self, full: bool, topo: List[str],
+                     dirty: List[str], now: float) -> str:
         if full or (topo and self.builder is None):
             self._rebuild(now)
             return "full"
@@ -311,6 +468,14 @@ class SnapshotSyncer:
                 return "full"
             metrics = self.hub.node_metrics()
             try:
+                # refresh the assume-cache mirror FIRST: the delta
+                # recomputes each touched row from the builder's host
+                # view, and a row recompute that missed an in-flight
+                # assume would erase its device-side commit charges
+                # (ADVICE r4 medium)
+                self.builder.set_assumed_pods(
+                    self.hub.assumed_entries(),
+                    self.hub.estimation_entries())
                 # under the view lock: the summary providers iterate
                 # builder.node_index against store.current() — the
                 # index mutation and the ingest must land as one unit,
@@ -356,6 +521,10 @@ class SnapshotSyncer:
                 return "full"
             assert self.builder is not None
             metrics = self.hub.node_metrics()
+            # the metric rows' assigned-estimation columns recompute
+            # from the assume-cache mirror — keep it fresh here too
+            self.builder.set_assumed_pods(self.hub.assumed_entries(),
+                                          self.hub.estimation_entries())
             for name in dirty:
                 metric = metrics.get(name)
                 if metric is not None:
@@ -365,6 +534,101 @@ class SnapshotSyncer:
             self.delta_ingests += 1
             return "topology" if topo else "delta"
         return "topology" if topo else "noop"
+
+    def attach_scheduler(self, service) -> None:
+        """Wire the service's post-commit hook into the hub's assume
+        cache: every placed pod is recorded host-side with the fine-
+        grained allocations the device commit actually charged (zone /
+        GPU minors / aux instance / reservation slot), so subsequent
+        rebuilds and O(K) topology deltas recompute rows WITH the
+        in-flight charges (the reference's scheduler cache assume +
+        podAssignCache, scheduler_adapter.go; ADVICE r4: a routine node
+        heartbeat must not erase commit charges). Callers that forget a
+        failed bind via store.forget must also hub.forget_assumed.
+
+        Also serializes this syncer's publishes/ingests with the
+        service's batch commits (sync() takes service.commit_guard());
+        the service invokes the hook under the same lock, so result
+        rows always resolve against the builder generation the batch
+        actually scheduled on."""
+        service.on_assumed = self._record_assumes
+        self._service = service
+        # chain the gang-failure tier: a strict gang PROVEN short
+        # releases its earlier-assumed members' host records immediately
+        # (the device-side charges return through the embedding's
+        # store.forget tier / the Permit wait-expiry backstop; the
+        # assume TTL is the final host backstop)
+        prev_gang_failed = service.on_gang_failed
+
+        def _on_gang_failed(gids, result):
+            self._forget_failed_gang_assumes(gids)
+            if prev_gang_failed is not None:
+                prev_gang_failed(gids, result)
+
+        service.on_gang_failed = _on_gang_failed
+
+    def _forget_failed_gang_assumes(self, gang_indices) -> None:
+        with self._view_lock:
+            if self.builder is None:
+                return
+            names = {self.builder.gangs[int(g)].meta.name
+                     for g in gang_indices
+                     if 0 <= int(g) < len(self.builder.gangs)}
+        if not names:
+            return
+        for pod, _ in self.hub.assumed_entries():
+            if pod.gang_name in names:
+                self.hub.forget_assumed(pod.meta.uid)
+
+    def _record_assumes(self, assignment, typed_pods, result) -> None:
+        import dataclasses as _dc
+
+        from koordinator_tpu.snapshot.schema import AUX_FPGA, AUX_RDMA
+
+        now = self.now_fn()
+        with self._view_lock:
+            if self.builder is None:
+                return
+            row_name = {i: n for n, i in self.builder.node_index.items()}
+            res_names = [r.meta.name for r in self.builder.reservations]
+        assignment = np.asarray(assignment)
+        numa_zone = np.asarray(result.numa_zone)
+        gpu_take = np.asarray(result.gpu_take)
+        aux_inst = np.asarray(result.aux_inst)
+        res_slot = np.asarray(result.res_slot)
+        for i, pod in enumerate(typed_pods):
+            if pod is None or i >= assignment.shape[0]:
+                continue
+            ni = int(assignment[i])
+            if ni < 0:
+                continue
+            name = row_name.get(ni)
+            if name is None:
+                continue
+            minors = ()
+            if gpu_take.ndim == 2 and gpu_take.shape[1]:
+                minors = tuple(int(m) for m in np.nonzero(gpu_take[i])[0])
+            rdma = fpga = -1
+            if aux_inst.ndim == 2 and aux_inst.shape[1] > max(AUX_RDMA,
+                                                              AUX_FPGA):
+                rdma = int(aux_inst[i, AUX_RDMA])
+                fpga = int(aux_inst[i, AUX_FPGA])
+            slot = int(res_slot[i]) if res_slot.size else -1
+            # NOTE: multi-zone best-effort NUMA takes are mirrored to the
+            # single reported zone (result.numa_zone) — the exact split
+            # lives only in the device commit until the watch delivers
+            # the bound pod's resource-status annotation
+            self.hub.note_assumed(_dc.replace(
+                pod, node_name=name,
+                allocated_numa_zone=(int(numa_zone[i])
+                                     if numa_zone.size else -1),
+                allocated_gpu_minors=minors,
+                allocated_rdma_inst=rdma,
+                allocated_fpga_inst=fpga,
+                reservation_name=(res_names[slot]
+                                  if 0 <= slot < len(res_names)
+                                  else pod.reservation_name),
+            ), timestamp=now)
 
     def register_services(self, registry) -> None:
         """Register the syncer-backed service payloads on a frameworkext
@@ -441,14 +705,32 @@ class SnapshotSyncer:
             b.add_node(node)
         for metric in state["metrics"].values():
             b.set_node_metric(metric)
+        gang_held: Dict[str, int] = {}
         for pods in state["pods_by_node"].values():
             for pod in pods:
-                if pod.phase == "Running":
+                # every bound non-terminal pod holds capacity (upstream
+                # NodeInfo semantics): a bound-but-not-yet-running pod
+                # must keep the charge its assume entry held before the
+                # watch delivered it
+                if pod.phase not in ("Succeeded", "Failed"):
                     b.add_running_pod(pod)
+                    if pod.gang_name:
+                        gang_held[pod.gang_name] = \
+                            gang_held.get(pod.gang_name, 0) + 1
+        b.set_assumed_pods(state["assumed"],
+                           state["assumed"] + state["recent_assigned"])
+        bound_uids = {p.meta.uid for p in b.running_pods}
+        for pod, _ in state["assumed"]:
+            if pod.gang_name and pod.meta.uid not in bound_uids:
+                gang_held[pod.gang_name] = \
+                    gang_held.get(pod.gang_name, 0) + 1
         for q in state["quotas"]:
             b.add_quota(q)
         for pg in state["pod_groups"]:
-            b.add_gang(pg)
+            # bound + assumed members count toward quorum (GangState
+            # .assumed is "members already assumed/bound"; a rebuild
+            # must not forget a gang's held members)
+            b.add_gang(pg, assumed=gang_held.get(pg.meta.name, 0))
         for r in state["reservations"]:
             b.add_reservation(r)
         for d in state["devices"]:

@@ -227,3 +227,218 @@ def test_device_summary_service_payload():
     assert summary["g0"]["gpuTotal"]["count"] == 2
     assert len(summary["g0"]["instances"]) == 2
     assert summary["g0"]["instances"][0]["coreFree"] == 100.0
+
+
+# --- assume cache: device commits survive host recomputes -----------------
+# (scheduler cache assume + podAssignCache; scheduler_adapter.go. ADVICE r4
+# medium: an O(K) topology ingest recomputing a touched node's row from
+# host-side running pods alone silently dropped device-side commit charges.)
+
+def _assume_wiring(max_nodes=4, delta_pad=2):
+    from koordinator_tpu.scheduler.frameworkext import SchedulerService
+
+    hub, store = ClusterInformerHub(), SnapshotStore()
+    syncer = SnapshotSyncer(hub, store, max_nodes=max_nodes,
+                            delta_pad=delta_pad)
+    service = SchedulerService(store=store, num_rounds=2, k_choices=2)
+    syncer.attach_scheduler(service)
+    return hub, store, syncer, service
+
+
+def _place_one(hub, syncer, service, cpu=4000.0, quota_name=""):
+    pod = api.Pod(meta=api.ObjectMeta(name="pp", uid="pp"), priority=9500,
+                  quota_name=quota_name,
+                  requests={RK.CPU: cpu, RK.MEMORY: 4096.0})
+    batch = syncer.builder.build_pod_batch([pod], syncer.ctx)
+    res = service.schedule(batch, typed_pods=[pod])
+    ni = int(np.asarray(res.assignment)[0])
+    assert ni >= 0
+    name = next(n for n, i in syncer.builder.node_index.items() if i == ni)
+    return pod, res, ni, name
+
+
+def test_topology_ingest_keeps_assumed_charges():
+    """A label-only node update (O(K) topology path) must recompute the
+    row WITH the in-flight assumed pod's requested charge."""
+    hub, store, syncer, service = _assume_wiring()
+    for n in ("n0", "n1"):
+        hub.upsert_node(mk_node(n))
+        hub.set_node_metric(mk_metric(n))
+    assert syncer.sync(now=NOW) == "full"
+    pod, res, ni, name = _place_one(hub, syncer, service)
+    assert len(hub.assumed_entries()) == 1
+    assert np.asarray(store.current().nodes.requested)[ni, 0] \
+        == pytest.approx(4000.0)
+
+    updated = mk_node(name)
+    updated.meta.labels = dict(updated.meta.labels, tier="gold")
+    hub.upsert_node(updated)
+    assert syncer.sync(now=NOW) == "topology"
+    assert np.asarray(store.current().nodes.requested)[ni, 0] \
+        == pytest.approx(4000.0)
+    # golden: the incremental row equals what a full rebuild produces
+    hub.upsert_quota(api.ElasticQuota(meta=api.ObjectMeta(name="q")))
+    assert syncer.sync(now=NOW) == "full"
+    assert np.asarray(store.current().nodes.requested)[
+        syncer.builder.node_index[name], 0] == pytest.approx(4000.0)
+
+
+def test_identity_unchanged_heartbeat_is_filtered():
+    """A node re-upsert with identical identity (a pure status
+    heartbeat) must not dirty the topology path at all (ADVICE r4:
+    heartbeats would otherwise overflow delta_pad every window)."""
+    hub, store, syncer, _ = _assume_wiring()
+    hub.upsert_node(mk_node("n0"))
+    hub.set_node_metric(mk_metric("n0"))
+    assert syncer.sync(now=NOW) == "full"
+    hub.upsert_node(mk_node("n0"))   # identical identity
+    assert syncer.sync(now=NOW) == "noop"
+    assert syncer.topology_ingests == 0
+
+
+def test_watch_catchup_counts_charge_once():
+    """When the watch delivers the bound pod, the assume entry clears
+    and the rebuild counts the charge exactly once — including a
+    bound-but-not-yet-Running pod (upstream NodeInfo semantics) and the
+    pod's quota used."""
+    hub, store, syncer, service = _assume_wiring()
+    hub.upsert_quota(api.ElasticQuota(meta=api.ObjectMeta(name="tenant"),
+                                      min={RK.CPU: 8000.0}))
+    for n in ("n0", "n1"):
+        hub.upsert_node(mk_node(n))
+        hub.set_node_metric(mk_metric(n))
+    assert syncer.sync(now=NOW) == "full"
+    pod, res, ni, name = _place_one(hub, syncer, service,
+                                    quota_name="tenant")
+    qi = syncer.builder.quota_index["tenant"]
+    assert np.asarray(store.current().quotas.used)[qi, 0] \
+        == pytest.approx(4000.0)
+
+    # watch catches up: bound but still Pending -> assume entry clears,
+    # rebuild keeps the charge through the watched object
+    bound = api.Pod(meta=api.ObjectMeta(name="pp", uid="pp"),
+                    priority=9500, node_name=name, phase="Pending",
+                    quota_name="tenant",
+                    requests={RK.CPU: 4000.0, RK.MEMORY: 4096.0})
+    hub.upsert_pod(bound)
+    assert hub.assumed_entries() == []
+    assert syncer.sync(now=NOW) == "full"
+    ni2 = syncer.builder.node_index[name]
+    assert np.asarray(store.current().nodes.requested)[ni2, 0] \
+        == pytest.approx(4000.0)
+    assert np.asarray(store.current().quotas.used)[
+        syncer.builder.quota_index["tenant"], 0] == pytest.approx(4000.0)
+
+
+def test_forget_assumed_releases_charge_everywhere():
+    """store.forget + hub.forget_assumed: the device returns the charge
+    and the next host recompute agrees (no resurrection)."""
+    hub, store, syncer, service = _assume_wiring()
+    for n in ("n0", "n1"):
+        hub.upsert_node(mk_node(n))
+        hub.set_node_metric(mk_metric(n))
+    assert syncer.sync(now=NOW) == "full"
+    pod, res, ni, name = _place_one(hub, syncer, service)
+    batch = syncer.builder.build_pod_batch([pod], syncer.ctx)
+    store.forget(batch, res, np.array([True]))
+    hub.forget_assumed("pp")
+    assert np.asarray(store.current().nodes.requested)[ni, 0] \
+        == pytest.approx(0.0)
+    updated = mk_node(name)
+    updated.meta.labels = dict(updated.meta.labels, redo="1")
+    hub.upsert_node(updated)
+    assert syncer.sync(now=NOW) == "topology"
+    assert np.asarray(store.current().nodes.requested)[ni, 0] \
+        == pytest.approx(0.0)
+
+
+def test_rebuild_counts_assumed_gang_members():
+    """A rebuild must not forget a gang's held members: assumed members
+    count into GangState.assumed (members already assumed/bound)."""
+    hub, store, syncer, service = _assume_wiring()
+    hub.upsert_pod_group(api.PodGroup(meta=api.ObjectMeta(name="g"),
+                                      min_member=2, total_member=2))
+    for n in ("n0", "n1"):
+        hub.upsert_node(mk_node(n))
+        hub.set_node_metric(mk_metric(n))
+    assert syncer.sync(now=NOW) == "full"
+    pod = api.Pod(meta=api.ObjectMeta(name="m0", uid="m0"), priority=9500,
+                  gang_name="g", requests={RK.CPU: 1000.0,
+                                           RK.MEMORY: 1024.0})
+    batch = syncer.builder.build_pod_batch([pod], syncer.ctx)
+    res = service.schedule(batch, typed_pods=[pod])
+    assert int(np.asarray(res.assignment)[0]) >= 0
+    hub.upsert_quota(api.ElasticQuota(meta=api.ObjectMeta(name="q")))
+    assert syncer.sync(now=NOW) == "full"
+    gi = syncer.builder.gang_index["g"]
+    assert int(np.asarray(store.current().gangs.assumed)[gi]) == 1
+
+
+def test_assume_ttl_expires_lost_binds():
+    """An assume whose bind outcome never arrives expires after the
+    TTL (the k8s assumed-pod expiry) — no permanent phantom capacity."""
+    hub, store, syncer, service = _assume_wiring()
+    syncer.assume_ttl = 900.0
+    for n in ("n0", "n1"):
+        hub.upsert_node(mk_node(n))
+        hub.set_node_metric(mk_metric(n))
+    assert syncer.sync(now=NOW) == "full"
+    pod, res, ni, name = _place_one(hub, syncer, service)
+    # _record_assumes stamps wall-clock time; normalize for the test
+    entry, _ = hub._assumed["pp"]
+    hub._assumed["pp"] = (entry, NOW)
+    hub.upsert_quota(api.ElasticQuota(meta=api.ObjectMeta(name="q")))
+    assert syncer.sync(now=NOW + 10) == "full"
+    assert np.asarray(store.current().nodes.requested)[
+        syncer.builder.node_index[name], 0] == pytest.approx(4000.0)
+    # past the TTL the entry expires and the next recompute drops it
+    hub.upsert_quota(api.ElasticQuota(meta=api.ObjectMeta(name="q2")))
+    assert syncer.sync(now=NOW + 1000) == "full"
+    assert hub.assumed_entries() == []
+    assert np.asarray(store.current().nodes.requested)[
+        syncer.builder.node_index[name], 0] == pytest.approx(0.0)
+
+
+def test_estimation_survives_watch_catchup():
+    """When the watch delivers the bound pod the capacity charge moves
+    to the watched object, but the recently-assigned ESTIMATION entry
+    must survive for the report-interval window (podAssignCache,
+    load_aware.go:260-267) and then age out."""
+    hub, store, syncer, service = _assume_wiring()
+    hub.upsert_node(mk_node("n0"))
+    hub.set_node_metric(mk_metric("n0"))
+    assert syncer.sync(now=NOW) == "full"
+    pod, res, ni, name = _place_one(hub, syncer, service)
+    entry, _ = hub._assumed["pp"]
+    hub._assumed["pp"] = (entry, NOW)
+    bound = api.Pod(meta=api.ObjectMeta(name="pp", uid="pp"),
+                    priority=9500, node_name=name, phase="Pending",
+                    requests={RK.CPU: 4000.0, RK.MEMORY: 4096.0})
+    hub.upsert_pod(bound)
+    assert hub.assumed_entries() == []
+    assert len(hub.estimation_entries()) == 1
+    assert syncer.sync(now=NOW + 10) == "full"
+    est = np.asarray(store.current().nodes.assigned_estimated)
+    assert est[syncer.builder.node_index[name], 0] > 0
+    # the estimation window closes after estimation_ttl
+    hub.upsert_quota(api.ElasticQuota(meta=api.ObjectMeta(name="q")))
+    assert syncer.sync(now=NOW + 500) == "full"
+    assert hub.estimation_entries() == []
+
+
+def test_reservation_owner_update_retires_assumed_consumer():
+    """A Reservation CR update whose current_owners lists an assumed
+    consumer retires the assume entry — the hold is never charged for
+    the same consumer twice."""
+    hub = ClusterInformerHub()
+    consumer = api.Pod(meta=api.ObjectMeta(name="c", uid="c-uid"),
+                       node_name="n0", reservation_name="resv",
+                       requests={RK.CPU: 1000.0})
+    hub.note_assumed(consumer, timestamp=NOW)
+    assert len(hub.assumed_entries()) == 1
+    hub.upsert_reservation(api.Reservation(
+        meta=api.ObjectMeta(name="resv"), node_name="n0",
+        phase="Available", requests={RK.CPU: 4000.0},
+        allocated={RK.CPU: 1000.0}, current_owners=("c-uid",)))
+    assert hub.assumed_entries() == []
+    assert len(hub.estimation_entries()) == 1  # estimation window stays

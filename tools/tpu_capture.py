@@ -4,9 +4,10 @@ Rounds 3 and 4 both lost their headline TPU evidence because the ONLY
 capture window was the driver's round-end `python bench.py`, and the axon
 tunnel happened to be wedged at that moment both times (BENCH_r03/r04.json
 are honest CPU fallbacks).  This tool decouples capture time from round-end
-time: a watcher loop (tools/tpu_watcher.sh) probes the tunnel every few
-minutes for the whole round and, on the first healthy probe, runs the FULL
-bench suite (BASELINE configs 1-5, the full-gate flagship, the canonical
+time: its own watcher loop (`python tools/tpu_capture.py`, the main()
+below; `--once` for a single probe+capture attempt) probes the tunnel
+every few minutes for the whole round and, on the first healthy probe,
+runs the FULL bench suite (BASELINE configs 1-5, the full-gate flagship, the canonical
 north-star, plus a BENCH_APPROX=0 exact-top-k comparison line) and freezes
 every emitted JSON line into a timestamped artifact:
 
@@ -45,10 +46,8 @@ FRESH_SECONDS = float(os.environ.get("CAPTURE_FRESH_SECONDS", "7200"))
 
 def log(msg: str) -> None:
     stamp = datetime.datetime.now(datetime.timezone.utc).isoformat()
-    line = f"[{stamp}] {msg}"
-    print(line, flush=True)
     with open(LOG, "a") as f:
-        f.write(line + "\n")
+        f.write(f"[{stamp}] {msg}\n")
 
 
 def probe_once(timeout: float = PROBE_TIMEOUT) -> bool:
