@@ -182,12 +182,14 @@ class Pod:
     # a score concern the LoadAware ranking subsumes)
     node_affinity: List[NodeSelectorRequirement] = dataclasses.field(
         default_factory=list)
-    # topology spread (the FIRST hard constraint is modeled on device;
-    # upstream allows several — a documented narrowing)
+    # topology spread: EVERY constraint is modeled on device (hard ones
+    # gate by skew, ScheduleAnyway ones only score) — multi-constraint
+    # pods (zone + hostname, the upstream default profile) are gated by
+    # each via the carrier matrix
     spread_constraints: List[TopologySpreadConstraint] = dataclasses.field(
         default_factory=list)
-    # inter-pod affinity: the first required affinity term and the first
-    # required anti-affinity term are modeled on device
+    # inter-pod affinity: EVERY required term is modeled on device,
+    # affinity and anti-affinity alike (carrier matrices)
     pod_affinity: List[PodAffinityTerm] = dataclasses.field(
         default_factory=list)
     # controller owner (ReplicaSet/StatefulSet...) — the migration

@@ -340,16 +340,17 @@ def schedule_batch(snap: ClusterSnapshot, pods: PodBatch,
 
     use_spread = pods.has_spread
     if use_spread:
-        sid = jnp.maximum(pods.spread_id, 0)
         spread_domain_x, spread_counts_flat, n_sg, n_dom = \
             domain_machinery(pods.spread_domain, pods.spread_count0,
                              pods.spread_member)
-        # the per-(pod, node) domain map is ROUND-invariant; hoisted out
-        # of the scanned round body because XLA does not move gathers
-        # across the while-loop boundary (one [P, N] gather per batch
-        # instead of one per round)
-        cdom = spread_domain_x[sid]                           # [P, N+V]
-        soft_sid = (~jnp.isfinite(pods.spread_max_skew))[sid]  # [P]
+        # multi-constraint gating rides the carrier MATRIX (zone +
+        # hostname together is the upstream default profile): per-group
+        # [Sg, N+V] admissibility maps combined by one bool matmul over
+        # the CARRIED groups — the same shape as the anti gates
+        spread_carrier_f = pods.spread_carrier.astype(jnp.float32)
+        # SOFT groups (ScheduleAnyway) carry skew = inf from the
+        # builder; they never filter — keyless nodes included
+        spread_soft = ~jnp.isfinite(pods.spread_max_skew)      # [Sg]
     # inter-pod anti-affinity: a domain admits a gated pod only at count
     # 0; nodes LACKING the topology key pass (no topology pair can
     # exist there — upstream admits them).
@@ -372,13 +373,13 @@ def schedule_batch(snap: ClusterSnapshot, pods: PodBatch,
     # pinning the bootstrap to one member that might be unschedulable).
     use_aff = pods.has_aff
     if use_aff:
-        fid = jnp.maximum(pods.aff_id, 0)
-        aff_self_pod = jnp.take_along_axis(
-            pods.aff_member, fid[:, None], axis=1)[:, 0]    # bool[P]
+        # multi-term gating rides the carrier matrix; the bootstrap is
+        # per (pod, carried group): a self-matching member of an EMPTY
+        # group may open any domain of that group
+        aff_self = pods.aff_member & pods.aff_carrier       # bool[P, Fg]
         aff_domain_x, aff_counts_flat, n_fg, n_fd = \
             domain_machinery(pods.aff_domain, pods.aff_count0,
                              pods.aff_member)
-        cdom_af = aff_domain_x[fid]                           # [P, N+V]
 
     def round_body(carry, _):
         requested, quota_used, numa_used, gpu_free, aux_free, once_taken, \
@@ -417,32 +418,33 @@ def schedule_batch(snap: ClusterSnapshot, pods: PodBatch,
             # in preemption.constraints_admit uses default=0, keeping a
             # hard group with unreachable domains RESTRICTIVE, not open)
             min_c = jnp.where(jnp.isfinite(min_c), min_c, 0.0)
-            ccount = jnp.take_along_axis(counts[sid],
-                                         jnp.maximum(cdom, 0), axis=1)
-            # SOFT groups (ScheduleAnyway) carry skew = inf from the
-            # builder; they never filter — keyless nodes included
-            spread_ok = (cdom >= 0) & \
-                (ccount + 1.0 - min_c[sid][:, None]
-                 <= pods.spread_max_skew[sid][:, None] + EPS)
-            feasible &= ((pods.spread_id < 0)[:, None]
-                         | soft_sid[:, None] | spread_ok)
+            # per-(group, node) admissibility: placing one more pod in
+            # the node's domain keeps the skew within the group's bound
+            cnt_at = jnp.where(
+                spread_domain_x >= 0,
+                jnp.take_along_axis(counts,
+                                    jnp.maximum(spread_domain_x, 0),
+                                    axis=1), 0.0)        # [Sg, N+V]
+            ok_map = (spread_soft[:, None]
+                      | ((spread_domain_x >= 0)
+                         & (cnt_at + 1.0 - min_c[:, None]
+                            <= pods.spread_max_skew[:, None] + EPS)))
+            # a pod is blocked where ANY carried group rejects the node
+            blocked_s = (spread_carrier_f
+                         @ (~ok_map).astype(jnp.float32)) > 0.5
+            feasible &= ~blocked_s
             # preference (upstream spread Score): emptier domains rank
-            # higher for BOTH hard and soft spread pods
-            # normalize PER GROUP (a crowded unrelated group must not
-            # flatten another group's preference; the oracle mirrors)
-            group_max = jnp.max(counts, axis=1)[sid][:, None]    # [P, 1]
-            spread_penalty = jnp.where(
-                (pods.spread_id >= 0)[:, None] & (cdom >= 0),
-                ccount / jnp.maximum(group_max, 1.0)
-                * MAX_NODE_SCORE, 0.0)
-            # per-round domain cap for the inner prefix gate: a domain
-            # holds at most skew + min_round pods (min rises between
-            # rounds, releasing more; inf for SOFT groups = uncapped) —
-            # without it one round piles the whole batch into the
-            # currently emptiest domain
-            spread_limit = jnp.broadcast_to(
-                (pods.spread_max_skew + min_c)[:, None],
-                (n_sg, n_dom)).reshape(-1, 1)             # [Sg*D, 1]
+            # higher for BOTH hard and soft spread pods; normalize PER
+            # GROUP (a crowded unrelated group must not flatten another
+            # group's preference; the oracle mirrors) and SUM over the
+            # pod's carried constraints (upstream sums per-constraint
+            # scores)
+            group_max = jnp.max(counts, axis=1)              # [Sg]
+            penalty_map = jnp.where(
+                spread_domain_x >= 0,
+                cnt_at / jnp.maximum(group_max[:, None], 1.0)
+                * MAX_NODE_SCORE, 0.0)                   # [Sg, N+V]
+            spread_penalty = spread_carrier_f @ penalty_map  # [P, N+V]
         if use_anti:
             counts_an = anti_counts_flat(placed).reshape(n_ag, n_ad)
             # (a) carriers avoid domains holding selector-matching pods
@@ -470,15 +472,27 @@ def schedule_batch(snap: ClusterSnapshot, pods: PodBatch,
         if use_aff:
             counts_af = aff_counts_flat(placed).reshape(n_fg, n_fd)
             total_af = jnp.sum(counts_af, axis=1)         # [Fg]
-            cc_af = jnp.take_along_axis(counts_af[fid],
-                                        jnp.maximum(cdom_af, 0), axis=1)
-            # bootstrap feasibility: ANY active self-matching member of
-            # an empty group may open a domain; the inner prefix caps
-            # openers to one per group per step
-            bootstrap = (active & (pods.aff_id >= 0) & aff_self_pod
-                         & (total_af[fid] < 0.5))
-            aff_ok = (cdom_af >= 0) & ((cc_af > 0.5) | bootstrap[:, None])
-            feasible &= (pods.aff_id < 0)[:, None] | aff_ok
+            cc_map = jnp.where(
+                aff_domain_x >= 0,
+                jnp.take_along_axis(counts_af,
+                                    jnp.maximum(aff_domain_x, 0),
+                                    axis=1), 0.0)         # [Fg, N+V]
+            # bootstrap feasibility per (pod, carried group): ANY active
+            # self-matching member of an empty group may open any of its
+            # domains; the inner prefix caps openers to one per group
+            # per step
+            boot_pg = (active[:, None] & aff_self
+                       & (total_af < 0.5)[None, :])       # [P, Fg]
+            carried = pods.aff_carrier
+            # non-boot carried groups need a matching pod in the node's
+            # domain; boot groups only need the domain to exist
+            bad_nonboot = ((aff_domain_x < 0)
+                           | (cc_map <= 0.5)).astype(jnp.float32)
+            bad_boot = (aff_domain_x < 0).astype(jnp.float32)
+            blocked_f = (
+                (carried & ~boot_pg).astype(jnp.float32) @ bad_nonboot
+                + boot_pg.astype(jnp.float32) @ bad_boot) > 0.5
+            feasible &= ~blocked_f
 
         # quota admission (ElasticQuota PreFilter, plugin.go:211-257):
         # used + request <= runtime at every tree level
@@ -573,19 +587,31 @@ def schedule_batch(snap: ClusterSnapshot, pods: PodBatch,
                 dims(ext_alloc), n_ext)
 
             if use_spread:
-                # spread prefix: priority order caps each (group, domain)
-                # at skew + round-start min. Current counts come from the
-                # CARRIED assignment, so allowance consumed in earlier
-                # inner steps (kptr fall-throughs) is charged too.
-                counts_now = spread_counts_flat(placed).reshape(-1, 1)
-                sdom_c = spread_domain_x[sid, jnp.clip(choice_eff, 0,
-                                                       n_ext - 1)]
-                has_s = trying & (pods.spread_id >= 0) & (sdom_c >= 0)
-                sseg = jnp.where(has_s, sid * n_dom + sdom_c,
-                                 n_sg * n_dom)
-                accept &= segment_prefix_ok(
-                    sseg, earlier, has_s[:, None].astype(jnp.float32),
-                    counts_now, spread_limit, n_sg * n_dom)
+                # spread within the step: per group, priority order caps
+                # each domain at skew + round-start min (min rises
+                # between rounds, releasing more; SOFT groups never
+                # gate). Current counts come from the CARRIED
+                # assignment, so allowance consumed in earlier inner
+                # steps (kptr fall-throughs) is charged too. The
+                # per-group loop (anti pattern) lets a pod charge every
+                # group it MATCHES while being gated by every group it
+                # CARRIES — multi-constraint pods.
+                counts_s_now = spread_counts_flat(placed).reshape(
+                    n_sg, n_dom)
+                choice_dom_s = jnp.clip(choice_eff, 0, n_ext - 1)
+                for g in range(n_sg):
+                    dom_g = spread_domain_x[g, choice_dom_s]      # [P]
+                    has_dom = dom_g >= 0
+                    same_d = dom_g[:, None] == dom_g[None, :]
+                    e_mask = (same_d & earlier).astype(jnp.float32)
+                    dom_c = jnp.maximum(dom_g, 0)
+                    contrib = (trying & pods.spread_member[:, g]
+                               & has_dom).astype(jnp.float32)
+                    gated = (trying & pods.spread_carrier[:, g]
+                             & has_dom & ~spread_soft[g])
+                    occ = counts_s_now[g, dom_c] + e_mask @ contrib
+                    limit_g = pods.spread_max_skew[g] + min_c[g]
+                    accept &= ~gated | (occ + 1.0 <= limit_g + EPS)
             if use_anti:
                 # anti-affinity within the step: per group, every trying
                 # MEMBER (selector-matching pod, gated or not) charges
@@ -620,22 +646,29 @@ def schedule_batch(snap: ClusterSnapshot, pods: PodBatch,
                     accept &= (occ_b_g < 0.5) | ~gated_b
             if use_aff:
                 # bootstrap cap: attempts into an EMPTY domain of an
-                # empty group are limited to one per group per step
+                # empty group are limited to one per group per step —
+                # per carried group, so a pod opening several groups is
+                # capped in each (multi-term pods)
                 counts_af_now = aff_counts_flat(placed).reshape(n_fg,
                                                                 n_fd)
                 total_now = jnp.sum(counts_af_now, axis=1)  # [Fg]
-                fdom_c = aff_domain_x[fid, jnp.clip(choice_eff, 0,
-                                                    n_ext - 1)]
-                cc_now = jnp.take_along_axis(
-                    counts_af_now[fid],
-                    jnp.maximum(fdom_c, 0)[:, None], axis=1)[:, 0]
-                boot_try = (trying & (pods.aff_id >= 0)
-                            & (fdom_c >= 0) & (cc_now < 0.5))
-                fseg = jnp.where(boot_try, fid, n_fg)
-                accept &= segment_prefix_ok(
-                    fseg, earlier, boot_try[:, None].astype(jnp.float32),
-                    total_now.reshape(-1, 1),
-                    jnp.ones((n_fg, 1), jnp.float32), n_fg)
+                choice_dom_f = jnp.clip(choice_eff, 0, n_ext - 1)
+                e_full = earlier.astype(jnp.float32)
+                for g in range(n_fg):
+                    dom_g = aff_domain_x[g, choice_dom_f]     # [P]
+                    cc_now_g = counts_af_now[g, jnp.maximum(dom_g, 0)]
+                    # a carried pod trying an EMPTY domain of g is an
+                    # opener attempt; it succeeds only when the whole
+                    # group is still empty AND no earlier-ranked opener
+                    # exists — once g is populated, empty-domain tries
+                    # are rejected so the pod falls through (kptr) to
+                    # the opened domain
+                    boot_try_g = (trying & pods.aff_carrier[:, g]
+                                  & (dom_g >= 0) & (cc_now_g < 0.5))
+                    openers_before = e_full @ boot_try_g.astype(
+                        jnp.float32)                          # [P]
+                    accept &= ~boot_try_g | (
+                        total_now[g] + openers_before < 0.5)
 
             # quota prefix per tree level, same trick
             for d in range(quota_depth):

@@ -168,17 +168,24 @@ def debug_filter_table(snap: ClusterSnapshot, pods: PodBatch,
     if forbid is not None:
         gates.append(("TaintToleration", ~forbid))
     if pods.has_spread:
-        sid = np.maximum(np.asarray(pods.spread_id), 0)
-        dom = np.asarray(pods.spread_domain)[sid]          # [P, N]
+        # carrier-matrix gating (multi-constraint pods) — mirrors core
+        dom_all = np.asarray(pods.spread_domain)           # [Sg, N]
         counts = np.asarray(pods.spread_count0)
         dvalid = np.asarray(pods.spread_dvalid)
+        skew = np.asarray(pods.spread_max_skew)
+        soft = ~np.isfinite(skew)
         min_c = np.min(np.where(dvalid, counts, np.inf), axis=1)
-        cc = np.take_along_axis(counts[sid], np.maximum(dom, 0), axis=1)
-        ok = (dom >= 0) & (cc + 1.0 - min_c[sid][:, None]
-                           <= np.asarray(pods.spread_max_skew)[sid][:, None]
-                           + 1e-3)
-        gates.append(("PodTopologySpread",
-                      ok | (np.asarray(pods.spread_id) < 0)[:, None]))
+        min_c = np.where(np.isfinite(min_c), min_c, 0.0)
+        cnt_at = np.where(dom_all >= 0,
+                          np.take_along_axis(counts,
+                                             np.maximum(dom_all, 0),
+                                             axis=1), 0.0)
+        ok_map = soft[:, None] | ((dom_all >= 0)
+                                  & (cnt_at + 1.0 - min_c[:, None]
+                                     <= skew[:, None] + 1e-3))
+        blocked = (np.asarray(pods.spread_carrier).astype(float)
+                   @ (~ok_map).astype(float)) > 0.5
+        gates.append(("PodTopologySpread", ~blocked))
     if pods.has_anti:
         # (a) per-group occupancy gated by the CARRIER matrix (a pod
         # carrying several terms is gated by each — mirrors core.py)
@@ -198,17 +205,22 @@ def debug_filter_table(snap: ClusterSnapshot, pods: PodBatch,
                    @ occ.astype(float)) > 0.5
         gates.append(("InterPodAntiAffinity", ~blocked_a & ~blocked))
     if pods.has_aff:
-        fid = np.maximum(np.asarray(pods.aff_id), 0)
-        dom = np.asarray(pods.aff_domain)[fid]
+        # carrier-matrix gating with per-(pod, group) bootstrap
+        dom_all = np.asarray(pods.aff_domain)              # [Fg, N]
         counts = np.asarray(pods.aff_count0)
-        cc = np.take_along_axis(counts[fid], np.maximum(dom, 0), axis=1)
+        carrier = np.asarray(pods.aff_carrier)
+        member = np.asarray(pods.aff_member)
         total = counts.sum(axis=1)
-        self_pod = np.take_along_axis(np.asarray(pods.aff_member),
-                                      fid[:, None], axis=1)[:, 0]
-        boot = ((total[fid] < 0.5) & self_pod)[:, None]
-        ok = (dom >= 0) & ((cc > 0.5) | boot)
-        gates.append(("InterPodAffinity",
-                      ok | (np.asarray(pods.aff_id) < 0)[:, None]))
+        cc_map = np.where(dom_all >= 0,
+                          np.take_along_axis(counts,
+                                             np.maximum(dom_all, 0),
+                                             axis=1), 0.0)
+        boot_pg = carrier & member & (total < 0.5)[None, :]
+        bad_nonboot = ((dom_all < 0) | (cc_map <= 0.5)).astype(float)
+        bad_boot = (dom_all < 0).astype(float)
+        blocked = ((carrier & ~boot_pg).astype(float) @ bad_nonboot
+                   + boot_pg.astype(float) @ bad_boot) > 0.5
+        gates.append(("InterPodAffinity", ~blocked))
     if np.asarray(nodes.numa_valid).any():
         gates.append(("NodeNUMAResource",
                       np.asarray(numaaware.zone_prefilter(nodes, pods))))

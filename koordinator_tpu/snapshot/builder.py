@@ -443,6 +443,7 @@ class SnapshotBuilder:
                                     numa_cap, numa_valid, numa_policy)
 
         numa_used = np.zeros((n, z, 2), np.float32)
+        res_by_name = {r.meta.name: r for r in self.reservations}
         for pod, is_assumed in self._capacity_pods():
             idx = self.node_index.get(pod.node_name)
             if idx is not None:
@@ -462,8 +463,17 @@ class SnapshotBuilder:
                     # hold, not the node pool (core.py res_slot commit);
                     # build_reservations subtracts it from the hold's
                     # free instead — charging requested here would
-                    # double-count until the CR's allocated catches up
-                    continue
+                    # double-count until the CR's allocated catches up.
+                    # Skip ONLY under build_reservations' exact subtract
+                    # condition: a consumer of a non-Available (e.g.
+                    # Succeeded allocate-once) or already-accounted
+                    # (current_owners) reservation has no hold absorbing
+                    # its charge and must hit node requested normally.
+                    res = res_by_name.get(pod.reservation_name)
+                    if (res is not None and res.phase == "Available"
+                            and res.node_name == pod.node_name
+                            and pod.meta.uid not in res.current_owners):
+                        continue
                 if pod.required_cpu_bind and cpu_amp[idx] > 1.0:
                     # exclusive cores cost amplified CPU against the
                     # amplified allocatable (filterAmplifiedCPUs's
@@ -1175,6 +1185,8 @@ class SnapshotBuilder:
         anti_row = np.full((p,), -1, np.int32)
         aff_row = np.full((p,), -1, np.int32)
         anti_carried: List[tuple] = []  # (pod i, group row) per term
+        aff_carried: List[tuple] = []
+        spread_carried: List[tuple] = []  # (pod i, group row) per constraint
         for i, pod in enumerate(pods):
             requests[i] = resource_vec(pod.requests)
             estimated[i] = estimate_pod(pod, self.estimator_scaling,
@@ -1218,57 +1230,57 @@ class SnapshotBuilder:
                     entry = (len(tol_sets), list(pod.tolerations))
                     tol_sets[tkey] = entry
                 tol_id[i] = entry[0]
-            # the first HARD spread constraint gates on device; a pod
-            # with only ScheduleAnyway constraints joins as a SOFT group
-            # (dvalid all-False makes the skew gate vacuous; the score
-            # penalty still prefers emptier domains, upstream's scoring)
-            hard = next((c for c in pod.spread_constraints
-                         if c.when_unsatisfiable == "DoNotSchedule"), None)
-            if hard is None:
-                hard = next(iter(pod.spread_constraints), None)
-            if hard is not None:
+            # EVERY spread constraint is registered and gated (upstream
+            # pods routinely carry zone + hostname together): hard
+            # (DoNotSchedule) constraints gate by skew; ScheduleAnyway
+            # constraints join as SOFT groups (skew = inf makes the gate
+            # vacuous; the score penalty still prefers emptier domains,
+            # upstream's scoring)
+            degraded = False
+            for c in pod.spread_constraints:
                 # the group key includes the pod's own node constraints:
                 # domain eligibility (which domains count toward the
                 # skew minimum) follows the pods' reachable nodes
                 # (upstream nodeAffinityPolicy=Honor), so pods with
                 # different selectors must not share a group
-                skey = (pod.meta.namespace, hard.topology_key,
-                        hard.max_skew, hard.when_unsatisfiable,
-                        tuple(sorted(hard.label_selector.items())),
+                skey = (pod.meta.namespace, c.topology_key,
+                        c.max_skew, c.when_unsatisfiable,
+                        tuple(sorted(c.label_selector.items())),
                         tuple(sorted(pod.node_selector.items())),
                         tuple((r.key, r.operator, tuple(r.values))
                               for r in pod.node_affinity))
                 entry = spread_groups.get(skey)
                 if entry is None:
                     if len(spread_groups) >= self.max_spread_groups:
+                        if spread_row[i] >= 0:
+                            # an EXTRA constraint of one pod overflowing
+                            # the group cap must not abort the whole
+                            # batch: the pod degrades to unschedulable
+                            # (never placed with an unmodeled
+                            # constraint), everyone else schedules
+                            degraded = True
+                            break
                         raise ValueError(
                             f"distinct spread constraints exceed "
                             f"max_spread_groups={self.max_spread_groups}")
-                    entry = (len(spread_groups), hard, pod)
+                    entry = (len(spread_groups), c, pod)
                     spread_groups[skey] = entry
-                spread_row[i] = entry[0]
-            degraded = False
+                if spread_row[i] < 0:
+                    spread_row[i] = entry[0]
+                spread_carried.append((i, entry[0]))
             for term in pod.pod_affinity:
+                # EVERY carried term is registered, anti AND affinity —
+                # the carrier matrices gate a pod by each term it
+                # carries (multi-term pods)
                 groups = anti_groups if term.anti else aff_groups
                 rows = anti_row if term.anti else aff_row
-                # ANTI terms: EVERY carried term is registered — the
-                # carrier matrix gates a pod by each term it carries
-                # (multi-term pods). Affinity keeps the documented
-                # first-term narrowing (aff gating rides a single id).
-                if not term.anti and rows[i] >= 0:
-                    continue
                 akey = (pod.meta.namespace, term.topology_key,
                         tuple(sorted(term.label_selector.items())))
                 entry = groups.get(akey)
                 if entry is None:
                     if len(groups) >= self.max_spread_groups:
-                        if term.anti and rows[i] >= 0:
-                            # an EXTRA anti term of one pod overflowing
-                            # the group cap must not abort the whole
-                            # batch: the pod degrades to unschedulable
-                            # (never placed with an unmodeled term; the
-                            # error chain retries/reports it), everyone
-                            # else schedules
+                        if rows[i] >= 0:
+                            # extra term over the cap: same degrade rule
                             degraded = True
                             break
                         raise ValueError(
@@ -1280,6 +1292,8 @@ class SnapshotBuilder:
                     rows[i] = entry[0]
                 if term.anti:
                     anti_carried.append((i, entry[0]))
+                else:
+                    aff_carried.append((i, entry[0]))
             valid[i] = not degraded
 
         # selector x node-label-group match matrix, padded to static
@@ -1332,6 +1346,7 @@ class SnapshotBuilder:
             spread_count0 = np.zeros((1, 1), np.float32)
             spread_dvalid = np.zeros((1, 1), bool)
             spread_member = np.zeros((p, 1), bool)
+            spread_carrier = np.zeros((p, 1), bool)
         else:
             sg_cap = self.max_spread_groups
             d_cap = self.max_spread_domains
@@ -1340,6 +1355,9 @@ class SnapshotBuilder:
             spread_count0 = np.zeros((sg_cap, d_cap), np.float32)
             spread_dvalid = np.zeros((sg_cap, d_cap), bool)
             spread_member = np.zeros((p, sg_cap), bool)
+            spread_carrier = np.zeros((p, sg_cap), bool)
+            for i, row in spread_carried:
+                spread_carrier[i, row] = True
             for (row, c, proto) in spread_groups.values():
                 ns = proto.meta.namespace
                 # SOFT groups carry skew = inf: the device derives
@@ -1428,6 +1446,12 @@ class SnapshotBuilder:
                     anti_carrier_count0[row, anti_domain[row, ni]] += 1.0
         aff_domain, aff_count0, aff_member = self._affinity_matrices(
             pods, aff_groups, p)
+        if not aff_groups:
+            aff_carrier = np.zeros((p, 1), bool)
+        else:
+            aff_carrier = np.zeros((p, len(aff_groups)), bool)
+            for i, row in aff_carried:
+                aff_carrier[i, row] = True
         return PodBatch(
             requests=requests, estimated=estimated, qos=qos,
             priority_class=prio_class, priority=prio, gang_id=gang_id,
@@ -1436,7 +1460,8 @@ class SnapshotBuilder:
             numa_single=numa_single, daemonset=daemonset,
             toleration_id=tol_id, tol_forbid=tol_forbid,
             tol_prefer=tol_prefer,
-            spread_id=spread_row, spread_member=spread_member,
+            spread_id=spread_row, spread_carrier=spread_carrier,
+            spread_member=spread_member,
             spread_max_skew=spread_max_skew,
             spread_domain=spread_domain, spread_count0=spread_count0,
             spread_dvalid=spread_dvalid,
@@ -1444,7 +1469,8 @@ class SnapshotBuilder:
             anti_carrier=anti_carrier,
             anti_domain=anti_domain, anti_count0=anti_count0,
             anti_carrier_count0=anti_carrier_count0,
-            aff_id=aff_row, aff_member=aff_member,
+            aff_id=aff_row, aff_carrier=aff_carrier,
+            aff_member=aff_member,
             aff_domain=aff_domain, aff_count0=aff_count0, valid=valid,
             has_taints=taints_modeled,
             has_spread=bool(spread_groups),

@@ -142,8 +142,12 @@ class PodBatch:
     # an identical (namespace, key, skew, selector) constraint share a
     # spread group; [1, 1]-shaped matrices mean no spread modeling and
     # the gate compiles out. Gating runs at ROUND granularity — exact at
-    # chunk size 1 like every other commit gate.
-    spread_id: Array        # i32[P] spread group, -1 = none
+    # chunk size 1 like every other commit gate. A pod carrying SEVERAL
+    # constraints (zone + hostname is the upstream default profile) is
+    # gated by each via the carrier MATRIX, the same shape as anti.
+    spread_id: Array        # i32[P] FIRST carried group (diagnostics;
+                            # gating rides spread_carrier), -1 = none
+    spread_carrier: Array   # bool[P, Sg] pod carries group's constraint
     spread_member: Array    # bool[P, Sg] pod matches group's selector
                             # (charges the domain count when placed, even
                             # without carrying the constraint itself)
@@ -179,7 +183,11 @@ class PodBatch:
     anti_domain: Array      # i32[Ag, N]
     anti_count0: Array      # f32[Ag, D] matching running/assumed pods
     anti_carrier_count0: Array  # f32[Ag, D] carrier running/assumed pods
-    aff_id: Array           # i32[P] affinity group, -1 = none
+    # affinity: a pod carrying several required terms must satisfy each
+    # (carrier matrix, like anti/spread)
+    aff_id: Array           # i32[P] FIRST carried group (diagnostics;
+                            # gating rides aff_carrier), -1 = none
+    aff_carrier: Array      # bool[P, Fg] pod carries group's term
     aff_member: Array       # bool[P, Fg]
     aff_domain: Array       # i32[Fg, N]
     aff_count0: Array       # f32[Fg, D]

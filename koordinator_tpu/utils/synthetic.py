@@ -270,6 +270,7 @@ def synthetic_pods(num_pods: int, seed: int = 1,
         tol_forbid=np.zeros((1, 1), bool),
         tol_prefer=np.zeros((1, 1), f32),
         spread_id=np.full((p,), -1, np.int32),
+        spread_carrier=np.zeros((p, 1), bool),
         spread_member=np.zeros((p, 1), bool),
         spread_max_skew=np.ones((1,), f32),
         spread_domain=np.full((1, 1), -1, np.int32),
@@ -282,6 +283,7 @@ def synthetic_pods(num_pods: int, seed: int = 1,
         anti_count0=np.zeros((1, 1), f32),
         anti_carrier_count0=np.zeros((1, 1), f32),
         aff_id=np.full((p,), -1, np.int32),
+        aff_carrier=np.zeros((p, 1), bool),
         aff_member=np.zeros((p, 1), bool),
         aff_domain=np.full((1, 1), -1, np.int32),
         aff_count0=np.zeros((1, 1), f32),
@@ -393,17 +395,40 @@ def full_gate_pods(num_pods: int, num_nodes: int, seed: int = 1,
                            [0.0, 0.0, 1.0],
                            [0.0, 0.0, 0.0]], f32)
 
-    # spread groups over zone domains (zone = node % num_zones)
+    # MULTI-CONSTRAINT spread, the upstream default profile: every
+    # spread pod carries a ZONE constraint (group g) AND a HOSTNAME
+    # constraint (companion group g + n_spread_groups) together — the
+    # carrier matrix gates it by both. Zone groups spread over
+    # num_zones domains; hostname groups spread over per-node domains
+    # with a loose skew (the kube-scheduler zone+hostname pair).
     zone_of_node = (np.arange(num_nodes) % num_zones).astype(np.int32)
-    spread_domain = np.broadcast_to(
-        zone_of_node, (n_spread_groups, num_nodes)).copy()
+    host_of_node = np.arange(num_nodes, dtype=np.int32)
+    n_sg_total = 2 * n_spread_groups
+    d_cap = max(num_zones, num_nodes)
+    spread_domain = np.empty((n_sg_total, num_nodes), np.int32)
+    spread_domain[:n_spread_groups] = zone_of_node
+    spread_domain[n_spread_groups:] = host_of_node
     in_spread = rng.uniform(size=p) < spread_frac
     sgrp = rng.integers(0, n_spread_groups, p).astype(np.int32)
     spread_id = np.where(in_spread, sgrp, -1).astype(np.int32)
-    spread_member = np.zeros((p, n_spread_groups), bool)
-    spread_member[np.flatnonzero(in_spread), sgrp[in_spread]] = True
-    spread_count0 = np.zeros((n_spread_groups, num_zones), f32)
-    spread_dvalid = np.ones((n_spread_groups, num_zones), bool)
+    spread_member = np.zeros((p, n_sg_total), bool)
+    spread_carrier = np.zeros((p, n_sg_total), bool)
+    rows = np.flatnonzero(in_spread)
+    spread_member[rows, sgrp[in_spread]] = True
+    spread_member[rows, sgrp[in_spread] + n_spread_groups] = True
+    spread_carrier[rows, sgrp[in_spread]] = True
+    spread_carrier[rows, sgrp[in_spread] + n_spread_groups] = True
+    spread_count0 = np.zeros((n_sg_total, d_cap), f32)
+    spread_dvalid = np.zeros((n_sg_total, d_cap), bool)
+    spread_dvalid[:n_spread_groups, :num_zones] = True
+    spread_dvalid[n_spread_groups:, :num_nodes] = True
+    # hostname skew stays loose relative to members-per-group so the
+    # workload remains schedulable while the per-node cap still gates
+    host_skew = max(float(np.ceil(p * spread_frac / n_spread_groups
+                                  / max(num_nodes, 1))) + 3.0, 4.0)
+    spread_max_skew = np.concatenate([
+        np.full((n_spread_groups,), max_skew, f32),
+        np.full((n_spread_groups,), host_skew, f32)])
 
     # group memberships scale DOWN with small batches (the constrained
     # pods stay <= ~half the batch) instead of crashing an undersized
@@ -436,11 +461,18 @@ def full_gate_pods(num_pods: int, num_nodes: int, seed: int = 1,
     anti_carrier_count0 = np.zeros((n_anti_groups, num_nodes), f32)
 
     # affinity groups co-locating over zones (self-bootstrap opens the
-    # first domain, the rest must follow)
+    # first domain, the rest must follow); groups come in PAIRS — every
+    # odd group's member ALSO carries the even partner's term
+    # (multi-term pods: both groups must hold where they land). All
+    # members are dual so the pair CONVERGES: a partial overlap would
+    # let the two groups bootstrap different zones and strand the
+    # multi-term pods with an empty intersection — a workload bug, not
+    # a scheduler property.
     aff_domain = np.broadcast_to(
         zone_of_node, (n_aff_groups, num_nodes)).copy()
     aff_id = np.full((p,), -1, np.int32)
     aff_member = np.zeros((p, n_aff_groups), bool)
+    aff_carrier = np.zeros((p, n_aff_groups), bool)
     # disjoint from the anti pods so one pod never carries both terms
     remaining = np.setdiff1d(np.arange(p), a_idx, assume_unique=False)
     f_idx = rng.choice(remaining, total_aff, replace=False)
@@ -448,22 +480,28 @@ def full_gate_pods(num_pods: int, num_nodes: int, seed: int = 1,
                       aff_members)
     aff_id[f_idx] = f_grp
     aff_member[f_idx, f_grp] = True
+    aff_carrier[f_idx, f_grp] = True
+    for g in range(1, n_aff_groups, 2):
+        dual = f_idx[(f_grp == g)]
+        aff_member[dual, g - 1] = True
+        aff_carrier[dual, g - 1] = True
     aff_count0 = np.zeros((n_aff_groups, num_zones), f32)
 
     return pods.replace(
         numa_single=numa_single,
         toleration_id=toleration_id, tol_forbid=tol_forbid,
         tol_prefer=tol_prefer,
-        spread_id=spread_id, spread_member=spread_member,
-        spread_max_skew=np.full((n_spread_groups,), max_skew, f32),
+        spread_id=spread_id, spread_carrier=spread_carrier,
+        spread_member=spread_member,
+        spread_max_skew=spread_max_skew,
         spread_domain=spread_domain, spread_count0=spread_count0,
         spread_dvalid=spread_dvalid,
         anti_id=anti_id, anti_member=anti_member,
         anti_carrier=anti_carrier, anti_domain=anti_domain,
         anti_count0=anti_count0,
         anti_carrier_count0=anti_carrier_count0,
-        aff_id=aff_id, aff_member=aff_member, aff_domain=aff_domain,
-        aff_count0=aff_count0,
+        aff_id=aff_id, aff_carrier=aff_carrier, aff_member=aff_member,
+        aff_domain=aff_domain, aff_count0=aff_count0,
         has_taints=True, has_spread=True, has_anti=True, has_aff=True)
 
 
@@ -484,8 +522,9 @@ PER_POD_FIELDS = ("requests", "estimated", "qos", "priority_class",
                   "priority", "gang_id", "quota_id", "selector_id",
                   "reservation_owner", "gpu_ratio", "numa_single",
                   "daemonset", "toleration_id", "spread_id",
-                  "spread_member", "anti_id", "anti_member",
-                  "anti_carrier", "aff_id", "aff_member", "valid")
+                  "spread_carrier", "spread_member", "anti_id",
+                  "anti_member", "anti_carrier", "aff_id", "aff_carrier",
+                  "aff_member", "valid")
 
 
 def slice_batch(batch: PodBatch, start: int, size: int) -> PodBatch:

@@ -186,26 +186,25 @@ class OracleScheduler:
                                  pods_by_node, frozenset())
 
     def _spread_counts(self, pod: Pod):
-        """(constraint, per-domain counts, max count) for the pod's
-        modeled constraint — computed ONCE per pod; the per-node penalty
-        just looks the node's domain up. Mirrors core.py spread_penalty
-        (per-group normalization)."""
-        if not pod.spread_constraints:
-            return None
-        c = next((c for c in pod.spread_constraints
-                  if c.when_unsatisfiable == "DoNotSchedule"),
-                 pod.spread_constraints[0])
-        counts: Dict[str, int] = {}
-        for n in self.nodes:
-            d = n.node.meta.labels.get(c.topology_key)
-            if d is not None:
-                counts.setdefault(d, 0)
-        for p, ni in self.cluster_pods:
-            d = self.nodes[ni].node.meta.labels.get(c.topology_key)
-            if d is not None and _matches(p, pod.meta.namespace,
-                                          c.label_selector):
-                counts[d] = counts.get(d, 0) + 1
-        return c, counts, max(counts.values(), default=0)
+        """[(constraint, per-domain counts, max count)] for EVERY
+        carried constraint — computed ONCE per pod; the per-node penalty
+        looks the node's domain up and SUMS over constraints. Mirrors
+        core.py spread_penalty (per-group normalization, summed over the
+        carrier matrix)."""
+        out = []
+        for c in pod.spread_constraints:
+            counts: Dict[str, int] = {}
+            for n in self.nodes:
+                d = n.node.meta.labels.get(c.topology_key)
+                if d is not None:
+                    counts.setdefault(d, 0)
+            for p, ni in self.cluster_pods:
+                d = self.nodes[ni].node.meta.labels.get(c.topology_key)
+                if d is not None and _matches(p, pod.meta.namespace,
+                                              c.label_selector):
+                    counts[d] = counts.get(d, 0) + 1
+            out.append((c, counts, max(counts.values(), default=0)))
+        return out or None
 
     def _quota_chain(self, name: str) -> List[OracleQuota]:
         chain = []
@@ -246,11 +245,13 @@ class OracleScheduler:
                 continue
             s = oracle_score(on, pod, self.args)
             if spread_info is not None:
-                c, counts, max_c = spread_info
-                dom = on.node.meta.labels.get(c.topology_key)
-                if dom is not None:
-                    s = max(s - counts.get(dom, 0) / max(max_c, 1.0)
-                            * 100.0, 0.0)
+                penalty = 0.0
+                for c, counts, max_c in spread_info:
+                    dom = on.node.meta.labels.get(c.topology_key)
+                    if dom is not None:
+                        penalty += counts.get(dom, 0) / max(max_c, 1.0) \
+                            * 100.0
+                s = max(s - penalty, 0.0)
             if s > best_score:
                 best_node, best_score = i, s
         if best_node < 0:

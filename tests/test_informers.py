@@ -9,6 +9,7 @@ from koordinator_tpu.api import types as api
 from koordinator_tpu.api.extension import ResourceKind as RK
 from koordinator_tpu.scheduler import core
 from koordinator_tpu.scheduler.plugins import loadaware
+from koordinator_tpu.snapshot.builder import SnapshotBuilder
 from koordinator_tpu.snapshot import (
     ClusterInformerHub,
     SnapshotStore,
@@ -442,3 +443,46 @@ def test_reservation_owner_update_retires_assumed_consumer():
         allocated={RK.CPU: 1000.0}, current_owners=("c-uid",)))
     assert hub.assumed_entries() == []
     assert len(hub.estimation_entries()) == 1  # estimation window stays
+
+
+def test_assumed_consumer_of_retired_reservation_charges_node():
+    """An assumed consumer whose reservation is no longer Available (or
+    already lists it in current_owners) has no hold absorbing its
+    charge — it must hit node requested like any assumed pod."""
+    b = SnapshotBuilder(max_nodes=2)
+    b.add_node(mk_node("n0"))
+    b.add_reservation(api.Reservation(
+        meta=api.ObjectMeta(name="resv"), node_name="n0",
+        phase="Succeeded", requests={RK.CPU: 4000.0}))
+    consumer = api.Pod(meta=api.ObjectMeta(name="c", uid="c"),
+                       node_name="n0", reservation_name="resv",
+                       requests={RK.CPU: 1000.0})
+    b.set_assumed_pods([(consumer, NOW)])
+    snap, _ = b.build(now=NOW)
+    # Succeeded reservation charges nothing; the consumer must
+    assert np.asarray(snap.nodes.requested)[0, 0] == 1000.0
+
+    # Available + current_owners: the CR's allocated carries the share,
+    # the consumer charges requested like a running consumer would
+    b2 = SnapshotBuilder(max_nodes=2)
+    b2.add_node(mk_node("n0"))
+    b2.add_reservation(api.Reservation(
+        meta=api.ObjectMeta(name="resv"), node_name="n0",
+        phase="Available", requests={RK.CPU: 4000.0},
+        allocated={RK.CPU: 1000.0}, current_owners=("c",)))
+    b2.set_assumed_pods([(consumer, NOW)])
+    snap2, _ = b2.build(now=NOW)
+    # consumer 1000 + remaining hold 3000 = full reservation footprint
+    assert np.asarray(snap2.nodes.requested)[0, 0] == 4000.0
+
+    # Available, NOT yet accounted: hold absorbs it — requested stays
+    # the full reservation, free drops by the consumer
+    b3 = SnapshotBuilder(max_nodes=2)
+    b3.add_node(mk_node("n0"))
+    b3.add_reservation(api.Reservation(
+        meta=api.ObjectMeta(name="resv"), node_name="n0",
+        phase="Available", requests={RK.CPU: 4000.0}))
+    b3.set_assumed_pods([(consumer, NOW)])
+    snap3, _ = b3.build(now=NOW)
+    assert np.asarray(snap3.nodes.requested)[0, 0] == 4000.0
+    assert np.asarray(snap3.reservations.free)[0, 0] == 3000.0

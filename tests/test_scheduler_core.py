@@ -1139,3 +1139,124 @@ def test_schedule_anyway_spread_scores_without_filtering():
     res2 = core.schedule_batch(snap2, one,
                                loadaware.LoadAwareConfig.make())
     assert int(np.asarray(res2.assignment)[0]) == 2  # keyless = empty
+
+
+def test_chunk1_equivalence_multi_spread_affinity():
+    """Chunk-1 equivalence for MULTI-constraint spread (zone + hostname
+    carried together, the upstream default profile) and MULTI-term
+    required affinity (two terms that must both hold): the batched
+    carrier-matrix gates reproduce the sequential oracle, whose
+    constraints_admit already enforces every carried constraint."""
+    from koordinator_tpu.api.types import (
+        PodAffinityTerm, TopologySpreadConstraint,
+    )
+    from oracle import OracleArgs, OracleScheduler
+
+    zones = ["z0", "z0", "z1", "z1", "z2", "z2"]
+    racks = ["r0", "r1", "r0", "r1", "r0", "r1"]
+
+    def make_nodes():
+        out = []
+        for i, (z, r) in enumerate(zip(zones, racks)):
+            out.append(Node(meta=ObjectMeta(
+                name=f"n{i}", labels={"zone": z, "rack": r,
+                                      "host": f"n{i}"}),
+                allocatable={RK.CPU: 8000.0 + i * 4000.0,
+                             RK.MEMORY: 65536.0}))
+        return out
+
+    spread_zone = TopologySpreadConstraint(
+        max_skew=1, topology_key="zone", label_selector={"app": "web"})
+    spread_host = TopologySpreadConstraint(
+        max_skew=1, topology_key="host", label_selector={"app": "web"})
+    aff_db = PodAffinityTerm(topology_key="zone",
+                             label_selector={"tier": "db"})
+    aff_cache = PodAffinityTerm(topology_key="zone",
+                                label_selector={"app": "cache"})
+    aff_duo_zone = PodAffinityTerm(topology_key="zone",
+                                   label_selector={"app": "duo"})
+    aff_duo_rack = PodAffinityTerm(topology_key="rack",
+                                   label_selector={"app": "duo"})
+
+    # running targets: db in z0 AND z1, cache only in z1 — the
+    # two-term svc pods must take the INTERSECTION (z1)
+    running = [
+        (Pod(meta=ObjectMeta(name="db0", namespace="d",
+                             labels={"tier": "db"}),
+             requests={RK.CPU: 100.0}, phase="Running",
+             node_name="n0"), "n0"),
+        (Pod(meta=ObjectMeta(name="db1", namespace="d",
+                             labels={"tier": "db"}),
+             requests={RK.CPU: 100.0}, phase="Running",
+             node_name="n2"), "n2"),
+        (Pod(meta=ObjectMeta(name="cache0", namespace="d",
+                             labels={"app": "cache"}),
+             requests={RK.CPU: 100.0}, phase="Running",
+             node_name="n3"), "n3"),
+    ]
+
+    pods = []
+    for j in range(14):
+        kind = j % 4
+        prio = 9000 + (14 - j) * 13
+        cpu = 650.0 + j * 37.0
+        if kind in (0, 1):
+            # multi-constraint spread: zone AND hostname together
+            pods.append(Pod(meta=ObjectMeta(name=f"w{j}", namespace="d",
+                                            labels={"app": "web"}),
+                            priority=prio, requests={RK.CPU: cpu},
+                            spread_constraints=[spread_zone,
+                                                spread_host]))
+        elif kind == 2:
+            # multi-term affinity: near db AND near cache
+            pods.append(Pod(meta=ObjectMeta(name=f"s{j}", namespace="d",
+                                            labels={"app": "svc"}),
+                            priority=prio, requests={RK.CPU: cpu},
+                            pod_affinity=[aff_db, aff_cache]))
+        else:
+            # multi-term SELF affinity: zone and rack must both match,
+            # bootstrap opens both with the first member
+            pods.append(Pod(meta=ObjectMeta(name=f"d{j}", namespace="d",
+                                            labels={"app": "duo"}),
+                            priority=prio, requests={RK.CPU: cpu},
+                            pod_affinity=[aff_duo_zone, aff_duo_rack]))
+
+    ob = SnapshotBuilder(max_nodes=len(zones))
+    for n in make_nodes():
+        ob.add_node(n)
+        ob.set_node_metric(NodeMetric(node_name=n.meta.name,
+                                      update_time=NOW, node_usage={}))
+    name_to_idx = {f"n{i}": i for i in range(len(zones))}
+    oracle = OracleScheduler(
+        make_oracle_nodes(ob, now=NOW), OracleArgs.default(),
+        running_pods=[(p, name_to_idx[nn]) for p, nn in running])
+    want = oracle.schedule(pods)
+    # the workload must actually exercise the gates: the svc pods land
+    # in the intersection zone and the web pods respect hostname skew
+    for j, a in enumerate(want):
+        if j % 4 == 2 and a >= 0:
+            assert zones[a] == "z1", (j, a)
+
+    order = sorted(range(len(pods)),
+                   key=lambda i: (-(pods[i].priority or 0), i))
+    assigned = []
+    got = np.full((len(pods),), -1, np.int64)
+    for i in order:
+        b = SnapshotBuilder(max_nodes=len(zones))
+        for n in make_nodes():
+            b.add_node(n)
+            b.set_node_metric(NodeMetric(node_name=n.meta.name,
+                                         update_time=NOW, node_usage={}))
+        for p, node_name in running:
+            b.add_running_pod(p)
+        for p, node_name in assigned:
+            b.add_assigned(p, node_name, timestamp=NOW)
+        snap, ctx = b.build(now=NOW)
+        res = core.schedule_batch(snap, b.build_pod_batch([pods[i]], ctx),
+                                  loadaware.LoadAwareConfig.make(),
+                                  num_rounds=2)
+        a = int(np.asarray(res.assignment)[0])
+        got[i] = a
+        if a >= 0:
+            assigned.append((pods[i], f"n{a}"))
+    np.testing.assert_array_equal(got, want)

@@ -134,6 +134,7 @@ def canonical_pods():
         tol_forbid=np.zeros((1, 1), bool),
         tol_prefer=np.zeros((1, 1), f32),
         spread_id=np.full((p,), -1, i32),
+        spread_carrier=np.zeros((p, 1), bool),
         spread_member=np.zeros((p, 1), bool),
         spread_max_skew=np.ones((1,), f32),
         spread_domain=np.full((1, 1), -1, i32),
@@ -146,6 +147,7 @@ def canonical_pods():
         anti_count0=np.zeros((1, 1), f32),
         anti_carrier_count0=np.zeros((1, 1), f32),
         aff_id=np.full((p,), -1, i32),
+        aff_carrier=np.zeros((p, 1), bool),
         aff_member=np.zeros((p, 1), bool),
         aff_domain=np.full((1, 1), -1, i32),
         aff_count0=np.zeros((1, 1), f32),
