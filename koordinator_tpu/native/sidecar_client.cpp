@@ -1,0 +1,240 @@
+// Minimal C++ sidecar wire client — proves the Go-callable claim of the
+// scheduler sidecar seam from a second language with zero dependencies
+// beyond POSIX sockets (the reference keeps this seam in Go:
+// /root/reference/pkg/scheduler/frameworkext/framework_extender.go:167-292;
+// docs/SIDECAR_WIRE.md specifies the bytes this file speaks).
+//
+// Usage: sidecar_client <unix-socket-path> <fixture-dir>
+//
+// Replays the frozen conformance frames (tests/fixtures/sidecar/*.bin)
+// against a live server in the documented order — PublishSnapshot,
+// IngestDelta, IngestTopology, Schedule, Summary — one connection per
+// RPC, and checks each response: status byte 0, a well-formed protobuf
+// body, monotonically non-decreasing commit versions, a 2-pod Schedule
+// assignment with in-range node indexes, and a Summary JSON object.
+// Exit 0 = full round-trip OK; non-zero prints the failure.
+
+#include <arpa/inet.h>
+#include <sys/socket.h>
+#include <sys/un.h>
+#include <unistd.h>
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <fstream>
+#include <string>
+#include <vector>
+
+namespace {
+
+[[noreturn]] void die(const std::string &msg) {
+  std::fprintf(stderr, "sidecar_client: FAIL: %s\n", msg.c_str());
+  std::exit(1);
+}
+
+std::vector<uint8_t> read_file(const std::string &path) {
+  std::ifstream f(path, std::ios::binary);
+  if (!f) die("cannot read fixture " + path);
+  return std::vector<uint8_t>(std::istreambuf_iterator<char>(f),
+                              std::istreambuf_iterator<char>());
+}
+
+void write_all(int fd, const uint8_t *buf, size_t n) {
+  while (n) {
+    ssize_t w = ::write(fd, buf, n);
+    if (w <= 0) die("short write to socket");
+    buf += w;
+    n -= static_cast<size_t>(w);
+  }
+}
+
+void read_all(int fd, uint8_t *buf, size_t n) {
+  while (n) {
+    ssize_t r = ::read(fd, buf, n);
+    if (r <= 0) die("short read from socket");
+    buf += r;
+    n -= static_cast<size_t>(r);
+  }
+}
+
+// One RPC per connection (SIDECAR_WIRE.md §1): send the pre-framed
+// request verbatim, return the response body after the status byte.
+std::vector<uint8_t> rpc(const std::string &sock_path,
+                         const std::vector<uint8_t> &frame) {
+  int fd = ::socket(AF_UNIX, SOCK_STREAM, 0);
+  if (fd < 0) die("socket()");
+  sockaddr_un addr{};
+  addr.sun_family = AF_UNIX;
+  if (sock_path.size() >= sizeof(addr.sun_path)) die("socket path too long");
+  std::memcpy(addr.sun_path, sock_path.c_str(), sock_path.size() + 1);
+  if (::connect(fd, reinterpret_cast<sockaddr *>(&addr), sizeof(addr)) != 0)
+    die("connect(" + sock_path + ")");
+  write_all(fd, frame.data(), frame.size());
+  uint8_t len_be[4];
+  read_all(fd, len_be, 4);
+  uint32_t len;
+  std::memcpy(&len, len_be, 4);
+  len = ntohl(len);
+  if (len == 0 || len > (64u << 20)) die("bad response frame length");
+  std::vector<uint8_t> payload(len);
+  read_all(fd, payload.data(), len);
+  ::close(fd);
+  if (payload[0] != 0)
+    die("status=1 error: " + std::string(payload.begin() + 1, payload.end()));
+  return std::vector<uint8_t>(payload.begin() + 1, payload.end());
+}
+
+// --- minimal protobuf wire walker (proto3) --------------------------------
+
+bool get_varint(const std::vector<uint8_t> &b, size_t &i, uint64_t *out) {
+  uint64_t v = 0;
+  for (int shift = 0; shift < 64; shift += 7) {
+    if (i >= b.size()) return false;
+    uint8_t byte = b[i++];
+    v |= static_cast<uint64_t>(byte & 0x7f) << shift;
+    if (!(byte & 0x80)) {
+      *out = v;
+      return true;
+    }
+  }
+  return false;
+}
+
+struct Field {
+  uint32_t number;
+  uint32_t wire_type;          // 0 varint, 1 fixed64, 2 bytes, 5 fixed32
+  uint64_t varint;             // wire type 0
+  const uint8_t *data;         // wire type 2
+  size_t size;
+};
+
+// Walks every field; returns false on malformed wire data. `fields`
+// collects them in order (repeated fields appear repeatedly).
+bool walk(const std::vector<uint8_t> &b, std::vector<Field> *fields) {
+  size_t i = 0;
+  while (i < b.size()) {
+    uint64_t key;
+    if (!get_varint(b, i, &key)) return false;
+    Field f{};
+    f.number = static_cast<uint32_t>(key >> 3);
+    f.wire_type = static_cast<uint32_t>(key & 7);
+    if (f.number == 0) return false;
+    switch (f.wire_type) {
+      case 0:
+        if (!get_varint(b, i, &f.varint)) return false;
+        break;
+      case 1:
+        if (i + 8 > b.size()) return false;
+        i += 8;
+        break;
+      case 2: {
+        uint64_t len;
+        // len > size - i (not i + len > size): a near-2^64 varint must
+        // fail cleanly, not wrap the addition past the bounds check
+        if (!get_varint(b, i, &len) || len > b.size() - i) return false;
+        f.data = b.data() + i;
+        f.size = static_cast<size_t>(len);
+        i += len;
+        break;
+      }
+      case 5:
+        if (i + 4 > b.size()) return false;
+        i += 4;
+        break;
+      default:
+        return false;
+    }
+    fields->push_back(f);
+  }
+  return true;
+}
+
+int64_t version_field(const std::vector<uint8_t> &body, const char *method) {
+  std::vector<Field> fields;
+  if (!walk(body, &fields))
+    die(std::string(method) + ": response is not well-formed protobuf");
+  for (const Field &f : fields)
+    if (f.number == 1 && f.wire_type == 0)
+      return static_cast<int64_t>(f.varint);
+  die(std::string(method) + ": no version field in response");
+}
+
+}  // namespace
+
+int main(int argc, char **argv) {
+  if (argc != 3) {
+    std::fprintf(stderr, "usage: %s <socket> <fixture-dir>\n", argv[0]);
+    return 2;
+  }
+  const std::string sock = argv[1];
+  const std::string dir = argv[2];
+
+  int64_t last_version = -1;
+  const char *versioned[][2] = {
+      {"PublishSnapshot", "publish_request.bin"},
+      {"IngestDelta", "ingest_request.bin"},
+      {"IngestTopology", "ingest_topology_request.bin"},
+  };
+  for (auto &m : versioned) {
+    std::vector<uint8_t> body = rpc(sock, read_file(dir + "/" + m[1]));
+    int64_t v = version_field(body, m[0]);
+    if (v < last_version)
+      die(std::string(m[0]) + ": commit version went backwards");
+    last_version = v;
+    std::printf("sidecar_client: %s -> version %lld\n", m[0],
+                static_cast<long long>(v));
+  }
+
+  // Schedule: 2-pod canonical batch against the 2-node snapshot
+  std::vector<uint8_t> body =
+      rpc(sock, read_file(dir + "/schedule_request.bin"));
+  std::vector<Field> fields;
+  if (!walk(body, &fields)) die("Schedule: malformed protobuf response");
+  std::vector<int32_t> assignment;
+  int64_t snap_version = -1;
+  for (const Field &f : fields) {
+    if (f.number == 1 && f.wire_type == 2) {  // packed repeated int32
+      std::vector<uint8_t> packed(f.data, f.data + f.size);
+      size_t i = 0;
+      while (i < packed.size()) {
+        uint64_t v;
+        if (!get_varint(packed, i, &v))
+          die("Schedule: malformed packed assignment");
+        assignment.push_back(static_cast<int32_t>(v));
+      }
+    } else if (f.number == 1 && f.wire_type == 0) {  // unpacked fallback
+      assignment.push_back(static_cast<int32_t>(f.varint));
+    } else if (f.number == 5 && f.wire_type == 0) {
+      snap_version = static_cast<int64_t>(f.varint);
+    }
+  }
+  if (assignment.size() != 2)
+    die("Schedule: expected 2 assignment entries, got " +
+        std::to_string(assignment.size()));
+  for (int32_t a : assignment)
+    if (a < -1 || a >= 2)
+      die("Schedule: assignment " + std::to_string(a) +
+          " out of range for the 2-node snapshot");
+  if (snap_version < last_version)
+    die("Schedule: post-commit version went backwards");
+  std::printf("sidecar_client: Schedule -> assignment [%d, %d], version %lld\n",
+              assignment[0], assignment[1],
+              static_cast<long long>(snap_version));
+
+  // Summary: JSON counters reflecting the schedule we just ran
+  body = rpc(sock, read_file(dir + "/summary_request.bin"));
+  fields.clear();
+  if (!walk(body, &fields)) die("Summary: malformed protobuf response");
+  std::string json;
+  for (const Field &f : fields)
+    if (f.number == 1 && f.wire_type == 2)
+      json.assign(reinterpret_cast<const char *>(f.data), f.size);
+  if (json.empty() || json.front() != '{')
+    die("Summary: body is not a JSON object: " + json);
+  if (json.find("podsPlaced") == std::string::npos)
+    die("Summary: missing podsPlaced counter: " + json);
+  std::printf("sidecar_client: Summary -> %s\n", json.c_str());
+  std::puts("sidecar_client: OK (5/5 RPCs round-tripped)");
+  return 0;
+}

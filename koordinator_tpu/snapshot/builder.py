@@ -1268,10 +1268,13 @@ class SnapshotBuilder:
                 if spread_row[i] < 0:
                     spread_row[i] = entry[0]
                 spread_carried.append((i, entry[0]))
-            for term in pod.pod_affinity:
+            for term in pod.pod_affinity if not degraded else ():
                 # EVERY carried term is registered, anti AND affinity —
                 # the carrier matrices gate a pod by each term it
-                # carries (multi-term pods)
+                # carries (multi-term pods). A pod already degraded by
+                # spread overflow registers nothing: it will never be
+                # placed, and its terms must neither consume scarce
+                # group slots nor trip the cap into the abort path
                 groups = anti_groups if term.anti else aff_groups
                 rows = anti_row if term.anti else aff_row
                 akey = (pod.meta.namespace, term.topology_key,
@@ -1348,7 +1351,10 @@ class SnapshotBuilder:
             spread_member = np.zeros((p, 1), bool)
             spread_carrier = np.zeros((p, 1), bool)
         else:
-            sg_cap = self.max_spread_groups
+            # matrices sized to the ACTUAL group count, like
+            # _affinity_matrices: the commit gates now loop per group,
+            # so cap-padding would unroll dead [P, P] work per empty row
+            sg_cap = len(spread_groups)
             d_cap = self.max_spread_domains
             spread_max_skew = np.ones((sg_cap,), np.float32)
             spread_domain = np.full((sg_cap, self.max_nodes), -1, np.int32)

@@ -392,3 +392,35 @@ if __name__ == "__main__":
             print(f"wrote {name} ({len(data)} bytes)")
     else:
         print(__doc__)
+
+
+def test_cpp_client_roundtrips_the_wire(tmp_path):
+    """A NON-PYTHON process speaks the wire: the C++ conformance client
+    (native/sidecar_client.cpp, POSIX sockets only) replays the frozen
+    frames against a live server and validates the responses — the
+    second-language exercise of the Go-callable seam
+    (framework_extender.go:167-292)."""
+    import subprocess
+
+    from koordinator_tpu.scheduler.frameworkext import SchedulerService
+    from koordinator_tpu.scheduler.sidecar import SchedulerSidecarServer
+
+    native = os.path.join(os.path.dirname(__file__), "..",
+                          "koordinator_tpu", "native")
+    build = subprocess.run(["make", "-C", native, "sidecar_client"],
+                           capture_output=True, text=True)
+    assert build.returncode == 0, build.stderr
+
+    service = SchedulerService(num_rounds=2, k_choices=2)
+    server = SchedulerSidecarServer(service, str(tmp_path / "s.sock"))
+    try:
+        run = subprocess.run(
+            [os.path.join(native, "sidecar_client"), server.sock_path,
+             FIXDIR],
+            capture_output=True, text=True, timeout=300)
+        assert run.returncode == 0, (run.stdout, run.stderr)
+        assert "OK (5/5 RPCs round-tripped)" in run.stdout
+        # the C++ client's schedule really committed on the server
+        assert service.batches == 1 and service.pods_placed >= 1
+    finally:
+        server.close()
