@@ -26,11 +26,24 @@ batched on device too. Host keeps only the typed->columnar flattening,
 the anomaly counters (stateful across cycles), and offering the planned
 pods to the evictor.
 
-Narrowing (documented): the plan assumes the evictor accepts every
-offered pod. A per-cycle cap is modeled ON device (`max_evictions`);
-per-node / per-namespace caps are not — `DeviceLowNodeLoad` falls back
-to the host loop when those are configured, so plans never silently
-diverge from the limiter.
+Per-node / per-namespace / per-cycle eviction caps (the
+EvictionLimiter production configuration — migration arbitrator
+blast-radius bounding, /root/reference/pkg/descheduler/controllers/
+migration/arbitrator/filter.go) are ALSO modeled on device. Unlike the
+uncapped plan they are not prefix-structured: the host loop SKIPS a
+refused pod (no usage/budget subtraction) and continues, so acceptance
+within a node is not a prefix of its sorted pods (ns-capped pods
+interleave with accepted ones). The capped kernel therefore runs ONE
+`lax.scan` along the global eviction order with a small carry (current
+node's removed usage + count, global budget, total, per-namespace
+counts) — still a single device program over the same columns, with
+the classification/ordering prelude shared with the prefix kernel.
+
+Narrowing (documented): the device plans predict the EvictionLimiter
+exactly; a CUSTOM evictor that refuses arbitrary pods is honored by
+filtering the returned selection on evict()'s result, but refusals do
+not re-plan (the freed allowance is not re-offered to later pods until
+the next cycle).
 """
 
 from __future__ import annotations
@@ -51,23 +64,13 @@ from koordinator_tpu.descheduler.lownodeload import (
 from koordinator_tpu.snapshot.builder import resource_vec
 
 
-@functools.partial(jax.jit, static_argnames=("use_deviation", "node_fit",
-                                             "fit_dims"))
-def plan_kernel(usage, capacity, fresh, source_mask,
-                pod_node, pod_usage_r, pod_req, pod_eligible,
-                low, high, weights, rdims_onehot,
-                max_evictions,
-                use_deviation: bool = False, node_fit: bool = True,
-                fit_dims: tuple = None):
-    """The full balance plan as one jitted program.
-
-    Shapes: usage/capacity f32[N, R]; pod_* over P pods with
-    pod_usage_r f32[P, Rd] already restricted to the threshold dims;
-    rdims_onehot f32[Rd, R] selects those dims out of R columns;
-    low/high/weights f32[Rd]. Returns (take bool[P], order i32[P]):
-    take[p] marks planned pods, order is the global eviction order (the
-    plan is `[int(i) for i in order if take[i]]`).
-    """
+def _plan_prelude(usage, capacity, fresh, source_mask,
+                  pod_node, pod_usage_r, pod_req, pod_eligible,
+                  low, high, weights, rdims_onehot,
+                  use_deviation: bool, node_fit: bool, fit_dims: tuple):
+    """Shared front half of both plan kernels: classification, budget,
+    node_fit eligibility, and the global eviction order. Traced inside
+    a jit, never called eagerly."""
     eps = 1e-9
     sel = lambda x: x @ rdims_onehot.T                    # [.., R]->[.., Rd]
     pct = 100.0 * sel(usage) / jnp.maximum(sel(capacity), eps)  # [N, Rd]
@@ -111,6 +114,30 @@ def plan_kernel(usage, capacity, fresh, source_mask,
     pod_w = (pod_usage_r * weights[None, :]).sum(1)       # [P]
     ord1 = jnp.argsort(-pod_w, stable=True)
     order = ord1[jnp.argsort(src_rank[pod_node[ord1]], stable=True)]
+    return sel, active, order, budget0, high_abs
+
+
+@functools.partial(jax.jit, static_argnames=("use_deviation", "node_fit",
+                                             "fit_dims"))
+def plan_kernel(usage, capacity, fresh, source_mask,
+                pod_node, pod_usage_r, pod_req, pod_eligible,
+                low, high, weights, rdims_onehot,
+                max_evictions,
+                use_deviation: bool = False, node_fit: bool = True,
+                fit_dims: tuple = None):
+    """The full balance plan as one jitted program.
+
+    Shapes: usage/capacity f32[N, R]; pod_* over P pods with
+    pod_usage_r f32[P, Rd] already restricted to the threshold dims;
+    rdims_onehot f32[Rd, R] selects those dims out of R columns;
+    low/high/weights f32[Rd]. Returns (take bool[P], order i32[P]):
+    take[p] marks planned pods, order is the global eviction order (the
+    plan is `[int(i) for i in order if take[i]]`).
+    """
+    sel, active, order, budget0, high_abs = _plan_prelude(
+        usage, capacity, fresh, source_mask, pod_node, pod_usage_r,
+        pod_req, pod_eligible, low, high, weights, rdims_onehot,
+        use_deviation, node_fit, fit_dims)
 
     ns = pod_node[order]                                  # sorted node ids
     x = jnp.where(active[order, None], pod_usage_r[order], 0.0)  # [P, Rd]
@@ -141,6 +168,84 @@ def plan_kernel(usage, capacity, fresh, source_mask,
 
 def lax_cummax(x: jnp.ndarray) -> jnp.ndarray:
     return jax.lax.associative_scan(jnp.maximum, x)
+
+
+@functools.partial(jax.jit, static_argnames=("use_deviation", "node_fit",
+                                             "fit_dims"))
+def plan_kernel_capped(usage, capacity, fresh, source_mask,
+                       pod_node, pod_usage_r, pod_req, pod_eligible,
+                       low, high, weights, rdims_onehot,
+                       pod_ns, ns_counts0, per_node0,
+                       max_evictions, max_per_node, max_per_ns,
+                       use_deviation: bool = False, node_fit: bool = True,
+                       fit_dims: tuple = None):
+    """The balance plan under per-node / per-namespace / per-cycle caps.
+
+    The host loop SKIPS a limiter-refused pod (no usage or budget
+    subtraction) and keeps walking, so acceptance is not prefix-
+    structured; this kernel replays that exact decision sequence as one
+    `lax.scan` along the global eviction order. Carry: the CURRENT
+    node's removed usage + eviction count (the order is node-contiguous,
+    so one scalar pair suffices), the global budget/total, and the
+    per-namespace counts (`ns_counts0`, padded — see columnarize_ns).
+    `per_node0[n]` seeds node n's count from the limiter's existing
+    state (mid-cycle reuse), as ns_counts0 does for namespaces.
+    Returns (take bool[P], order i32[P]) like plan_kernel.
+    """
+    sel, active, order, budget0, high_abs = _plan_prelude(
+        usage, capacity, fresh, source_mask, pod_node, pod_usage_r,
+        pod_req, pod_eligible, low, high, weights, rdims_onehot,
+        use_deviation, node_fit, fit_dims)
+
+    ns = pod_node[order]
+    usage_node = sel(usage)[ns]                           # [P, Rd]
+    high_abs_s = high_abs[ns]                             # [P, Rd]
+    pod_ns_s = pod_ns[order]                              # [P]
+    u_s = pod_usage_r[order]                              # [P, Rd]
+    active_s = active[order]
+    p = u_s.shape[0]
+    is_start = jnp.concatenate(
+        [jnp.ones((1,), bool), ns[1:] != ns[:-1]])
+    node_cnt0_s = per_node0[ns]                           # [P]
+
+    def step(carry, xs):
+        removed, node_cnt, budget, total, ns_counts = carry
+        (start, u, un, ha, nsid, act, cnt0) = xs
+        removed = jnp.where(start, jnp.zeros_like(removed), removed)
+        node_cnt = jnp.where(start, cnt0, node_cnt)
+        # host order: the still_over/budget break check runs BEFORE the
+        # evict() limiter call; a limiter refusal subtracts nothing
+        still_over = ((un - removed) > ha).any()
+        budget_open = (budget > 0.0).all()
+        want = act & still_over & budget_open
+        allow = ((total < max_evictions)
+                 & (node_cnt < max_per_node)
+                 & (ns_counts[nsid] < max_per_ns))
+        take = want & allow
+        tf = take.astype(u.dtype)
+        removed = removed + u * tf
+        budget = budget - u * tf
+        total = total + take.astype(total.dtype)
+        node_cnt = node_cnt + take.astype(node_cnt.dtype)
+        ns_counts = ns_counts.at[nsid].add(take.astype(ns_counts.dtype))
+        return (removed, node_cnt, budget, total, ns_counts), take
+
+    rd = u_s.shape[1]
+    carry0 = (jnp.zeros((rd,), u_s.dtype), jnp.int32(0), budget0,
+              jnp.int32(0), ns_counts0.astype(jnp.int32))
+    _, take_sorted = jax.lax.scan(
+        step, carry0,
+        (is_start, u_s, usage_node, high_abs_s, pod_ns_s, active_s,
+         node_cnt0_s))
+    take = jnp.zeros((p,), bool).at[order].set(take_sorted)
+    return take, order
+
+
+def _pad_pow2(n: int, lo: int = 8) -> int:
+    k = lo
+    while k < n:
+        k *= 2
+    return k
 
 
 def columnarize(nodes: Sequence[api.Node],
@@ -211,32 +316,41 @@ class DeviceLowNodeLoad(LowNodeLoad):
 
     Classification for the anomaly counters reuses the host classify()
     (cheap, stateful); the eviction selection — the O(N x P) part — is
-    one jitted program. Falls back to the host loop when the evictor
-    carries per-node/per-namespace limits the kernel does not model.
+    one jitted program. Per-cycle caps ride the prefix kernel; per-node
+    / per-namespace caps (the production blast-radius configuration)
+    switch to the scan kernel, which replays the limiter's exact
+    skip-and-continue decisions. A custom evictor that refuses pods the
+    limiter model did not predict is honored by filtering the returned
+    selection on evict()'s result — refusals do not re-plan.
     """
 
     name = "LowNodeLoad"
 
-    def _device_cap(self) -> Optional[int]:
-        """max_per_cycle when device planning is sound, else None."""
+    _BIG = 1 << 30
+
+    def _limiter_caps(self):
+        """(cycle_remaining, max_per_node, max_per_ns, limiter), with
+        _BIG sentinels for unlimited dimensions."""
         limiter = getattr(self.evictor, "limiter", None)
         if limiter is None:
-            return 1 << 30
-        if (limiter.max_per_node is not None
-                or limiter.max_per_namespace is not None):
-            return None
-        if limiter.max_per_cycle is None:
-            return 1 << 30
-        return limiter.max_per_cycle - limiter._total
+            return self._BIG, self._BIG, self._BIG, None
+        cyc = (self._BIG if limiter.max_per_cycle is None
+               else limiter.max_per_cycle - limiter._total)
+        per_node = (self._BIG if limiter.max_per_node is None
+                    else limiter.max_per_node)
+        per_ns = (self._BIG if limiter.max_per_namespace is None
+                  else limiter.max_per_namespace)
+        return cyc, per_node, per_ns, limiter
 
     def balance_once(self, nodes, metrics, pods_by_node, now):
         args = self.args
         # the host plugin never consults the evictor in dry_run —
-        # neither may the device cap (golden parity)
-        cap = (1 << 30) if args.dry_run else self._device_cap()
-        if cap is None:
-            return super().balance_once(nodes, metrics, pods_by_node,
-                                        now)
+        # neither may the device caps (golden parity)
+        if args.dry_run:
+            cyc, per_node, per_ns, limiter = (self._BIG, self._BIG,
+                                              self._BIG, None)
+        else:
+            cyc, per_node, per_ns, limiter = self._limiter_caps()
         if not nodes:
             return []
         # ONE flattening pass; anomaly gating stays host-side
@@ -254,17 +368,45 @@ class DeviceLowNodeLoad(LowNodeLoad):
             return []
         pods = cols.pop("pods")
         pod_node = cols["pod_node"]
-        take, order = plan_kernel(
-            source_mask=source_mask,
-            max_evictions=np.int32(max(cap, 0)),
-            use_deviation=args.use_deviation_thresholds,
-            node_fit=args.node_fit, **cols)
+        if per_node < self._BIG or per_ns < self._BIG:
+            # namespace ids + seeded limiter state (mid-cycle reuse)
+            ns_names = sorted({p.meta.namespace for p in pods})
+            ns_of = {s: j for j, s in enumerate(ns_names)}
+            pod_ns = np.asarray([ns_of[p.meta.namespace] for p in pods],
+                                np.int32)
+            ns_counts0 = np.zeros((_pad_pow2(len(ns_names)),), np.int32)
+            per_node0 = np.zeros((len(nodes),), np.int32)
+            if limiter is not None:
+                for s, j in ns_of.items():
+                    ns_counts0[j] = limiter._per_ns.get(s, 0)
+                for i, name in enumerate(names):
+                    per_node0[i] = limiter._per_node.get(name, 0)
+            take, order = plan_kernel_capped(
+                source_mask=source_mask,
+                pod_ns=pod_ns, ns_counts0=ns_counts0,
+                per_node0=per_node0,
+                max_evictions=np.int32(max(min(cyc, self._BIG), 0)),
+                max_per_node=np.int32(min(per_node, self._BIG)),
+                max_per_ns=np.int32(min(per_ns, self._BIG)),
+                use_deviation=args.use_deviation_thresholds,
+                node_fit=args.node_fit, **cols)
+        else:
+            take, order = plan_kernel(
+                source_mask=source_mask,
+                max_evictions=np.int32(max(min(cyc, self._BIG), 0)),
+                use_deviation=args.use_deviation_thresholds,
+                node_fit=args.node_fit, **cols)
         take = np.asarray(take)
         sel_idx = [int(i) for i in np.asarray(order) if take[int(i)]]
-        selected = [pods[i] for i in sel_idx]
-        if not args.dry_run and self.evictor is not None:
-            for i in sel_idx:
-                self.evictor.evict(
+        if args.dry_run or self.evictor is None:
+            return [pods[i] for i in sel_idx]
+        selected = []
+        for i in sel_idx:
+            # honor the live verdict: a custom evictor may refuse pods
+            # the limiter model did not predict (refused pods are NOT
+            # re-planned — the host loop drops them the same way)
+            if self.evictor.evict(
                     pods[i], f"node {names[int(pod_node[i])]} is "
-                             f"overutilized")
+                             f"overutilized"):
+                selected.append(pods[i])
         return selected

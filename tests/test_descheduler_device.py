@@ -132,19 +132,70 @@ def test_pod_usage_from_expired_metrics_still_counts():
     assert got_dev == got_host
 
 
-def test_per_node_limits_fall_back_to_host_loop():
-    """Per-node/per-ns caps are not modeled on device: the wrapper must
-    take the host path (plans still equal a pure-host plugin run)."""
-    nodes, metrics, by_node = random_cluster(9)
+@pytest.mark.parametrize("seed", [3, 9, 21])
+@pytest.mark.parametrize("caps", [
+    dict(max_per_node=1),
+    dict(max_per_namespace=1),
+    dict(max_per_node=2, max_per_namespace=2, max_per_cycle=5),
+])
+def test_per_node_and_ns_caps_match_host(seed, caps):
+    """Per-node / per-namespace / per-cycle caps run ON DEVICE (the
+    scan kernel replays the limiter's skip-and-continue), golden-equal
+    to the host loop — including the non-prefix acceptance shape where
+    a capped pod is skipped and a later pod on the same node evicts."""
+    nodes, metrics, by_node = random_cluster(seed)
     args = LowNodeLoadArgs(consecutive_abnormalities=1)
-    host_ev = RecordingEvictor(EvictionLimiter(max_per_node=1))
-    dev_ev = RecordingEvictor(EvictionLimiter(max_per_node=1))
+    host_ev = RecordingEvictor(EvictionLimiter(**caps))
+    dev_ev = RecordingEvictor(EvictionLimiter(**caps))
     host = LowNodeLoad(args, host_ev)
     dev = DeviceLowNodeLoad(args, dev_ev)
     host.balance_once(nodes, metrics, by_node, NOW)
-    dev.balance_once(nodes, metrics, by_node, NOW)
+    got = dev.balance_once(nodes, metrics, by_node, NOW)
     assert ([e.pod.meta.namespaced_name for e in dev_ev.evictions]
             == [e.pod.meta.namespaced_name for e in host_ev.evictions])
+    # the returned selection is exactly what the evictor accepted
+    assert ([p.meta.namespaced_name for p in got]
+            == [e.pod.meta.namespaced_name for e in dev_ev.evictions])
+
+
+def test_capped_plan_seeds_mid_cycle_limiter_state():
+    """A second balance call WITHOUT a limiter reset must respect the
+    counts the first call consumed, exactly like the host loop."""
+    nodes, metrics, by_node = random_cluster(5)
+    caps = dict(max_per_node=1, max_per_namespace=2, max_per_cycle=6)
+    host_ev = RecordingEvictor(EvictionLimiter(**caps))
+    dev_ev = RecordingEvictor(EvictionLimiter(**caps))
+    host = LowNodeLoad(LowNodeLoadArgs(consecutive_abnormalities=1),
+                       host_ev)
+    dev = DeviceLowNodeLoad(
+        LowNodeLoadArgs(consecutive_abnormalities=1), dev_ev)
+    for _ in range(2):   # no reset between calls
+        host.balance_once(nodes, metrics, by_node, NOW)
+        dev.balance_once(nodes, metrics, by_node, NOW)
+    assert ([e.pod.meta.namespaced_name for e in dev_ev.evictions]
+            == [e.pod.meta.namespaced_name for e in host_ev.evictions])
+
+
+def test_custom_evictor_refusals_filter_the_selection():
+    """An evictor that refuses pods outside the limiter model: the
+    device wrapper must drop refused pods from `selected` (the host
+    loop's behavior), not report them as evicted."""
+    nodes, metrics, by_node = random_cluster(7)
+
+    class PickyEvictor(RecordingEvictor):
+        def evict(self, pod, reason):
+            if pod.meta.name.endswith("p0"):
+                return False
+            return super().evict(pod, reason)
+
+    dev = DeviceLowNodeLoad(
+        LowNodeLoadArgs(consecutive_abnormalities=1), PickyEvictor())
+    got = dev.balance_once(nodes, metrics, by_node, NOW)
+    assert got, "workload must actually evict something"
+    assert all(not p.meta.name.endswith("p0") for p in got)
+    assert ([p.meta.namespaced_name for p in got]
+            == [e.pod.meta.namespaced_name
+                for e in dev.evictor.evictions])
 
 
 def test_scale_regression_2k_nodes():
