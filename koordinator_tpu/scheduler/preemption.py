@@ -103,6 +103,38 @@ def reprieve_victims(preemptor_req: np.ndarray,
     return victims or None
 
 
+def effective_allocatable(node: api.Node,
+                          device: Optional[api.Device]) -> np.ndarray:
+    """Node allocatable with aggregate device capacity merged — the
+    typed twin of builder._merge_device_allocatable: the device plugin
+    reports GPU/aux extended resources unless the Node already did.
+    Without this merge the flat preemption fit would reject EVERY
+    device-requesting preemptor (capacity 0 in the Node CR)."""
+    from koordinator_tpu.api.extension import ResourceKind as RK
+
+    v = resource_vec(node.allocatable).astype(np.float64)
+    if device is None:
+        return v
+    gc, gm = int(RK.GPU_CORE), int(RK.GPU_MEMORY)
+    gpus = [d for d in device.devices if d.type == "gpu" and d.health]
+    if gpus:
+        if v[gc] == 0:
+            # core is 100% per instance BY DEFINITION (the builder's
+            # gpu_total row hardcodes (100, mem, 100) — GPU_CORE in the
+            # CR's resources is ignored there and must be here too)
+            v[gc] = 100.0 * len(gpus)
+        if v[gm] == 0:
+            v[gm] = sum(float(d.resources.get(RK.GPU_MEMORY, 0.0))
+                        for d in gpus)
+    for kind, typ in ((RK.RDMA, "rdma"), (RK.FPGA, "fpga")):
+        kk = int(kind)
+        if v[kk] == 0:
+            v[kk] = sum(float(d.resources.get(kind, 100.0))
+                        for d in device.devices
+                        if d.type == typ and d.health)
+    return v
+
+
 def node_admits(pod: api.Pod, node: api.Node) -> bool:
     """The pod-level gates the device program will re-apply next batch:
     schedulable, nodeSelector, nodeAffinity expressions, tolerations."""
@@ -473,17 +505,16 @@ def find_preemption(preemptor: api.Pod,
                 return constraints_admit(preemptor, _node, nodes,
                                          pods_by_node, removed_ids,
                                          placed=placed)
+        dev = devices.get(node.meta.name) if devices else None
         fine = None
         if needs_fine:
-            dev = devices.get(node.meta.name) if devices else None
-
             def fine(survivors, _node=node, _dev=dev):
                 return fine_grained_admits(preemptor, _node, _dev,
                                            survivors,
                                            devices_known=devices
                                            is not None)
         victims = select_victims_on_node(
-            preemptor, resource_vec(node.allocatable),
+            preemptor, effective_allocatable(node, dev),
             pods_by_node.get(node.meta.name, ()), admit=admit,
             cpu_amplification=node_cpu_amplification(node),
             fine_fit=fine)

@@ -320,6 +320,20 @@ class ClusterInformerHub:
             return {n: list(b.values())
                     for n, b in self._pods_by_node.items()}
 
+    def capacity_pods_by_node(self) -> Dict[str, List[api.Pod]]:
+        """pods_by_node MERGED with the assume cache — the surviving-
+        capacity view the preemption dry run must evaluate (assumed
+        pods hold capacity exactly like bound ones; the scheduler
+        cache's merged NodeInfo view)."""
+        with self._lock:
+            out = {n: list(b.values())
+                   for n, b in self._pods_by_node.items()}
+            seen = {uid for b in self._pods_by_node.values() for uid in b}
+            for uid, (pod, _) in self._assumed.items():
+                if uid not in seen and pod.node_name:
+                    out.setdefault(pod.node_name, []).append(pod)
+            return out
+
     def quota_profiles(self) -> List[api.ElasticQuotaProfile]:
         with self._lock:
             return list(self._quota_profiles.values())
@@ -629,6 +643,21 @@ class SnapshotSyncer:
                                   if 0 <= slot < len(res_names)
                                   else pod.reservation_name),
             ), timestamp=now)
+
+    def register_preemption(self, service, on_nominate) -> None:
+        """Register the default-preemption PostFilter on the service's
+        error chain with HUB-backed providers. devices_by_node is wired
+        BY DEFAULT (VERDICT r4 #5: the per-instance GPU/aux recheck
+        narrowing must apply only when no Device CRs exist, not
+        whenever a caller forgets the optional argument), and the pod
+        view includes assume-cache entries so the dry run sees
+        in-flight capacity."""
+        from koordinator_tpu.scheduler.errorhandler import (
+            make_preemption_post_filter,
+        )
+        service.error_dispatcher.register(post=make_preemption_post_filter(
+            self.hub.nodes, self.hub.capacity_pods_by_node, on_nominate,
+            get_devices=self.hub.devices_by_node))
 
     def register_services(self, registry) -> None:
         """Register the syncer-backed service payloads on a frameworkext

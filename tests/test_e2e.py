@@ -442,3 +442,89 @@ def test_e2e_scale_up_under_pressure_then_device_rebalance():
             break
     assert len(ev.evictions) == len(victims)
     assert all(j.phase == "Succeeded" for j in mc.jobs.values())
+
+
+def test_e2e_gpu_preemption_respects_surviving_instances():
+    """GPU preemption with the DEFAULT device wiring
+    (SnapshotSyncer.register_preemption): a node whose surviving GPU
+    instances cannot host the preemptor is never nominated, even when
+    its flat aggregate capacity and a cheap victim would pass the
+    coarse math (upstream selectVictimsOnNode re-runs the full Filter;
+    /root/reference/pkg/scheduler/plugins/elasticquota/preempt.go)."""
+    import time as _time
+
+    from koordinator_tpu.scheduler.frameworkext import SchedulerService
+    from koordinator_tpu.snapshot import (
+        ClusterInformerHub,
+        SnapshotStore,
+        SnapshotSyncer,
+    )
+
+    now = _time.time()
+    hub = ClusterInformerHub()
+    for name in ("gA", "gB"):
+        hub.upsert_node(api.Node(meta=api.ObjectMeta(name=name),
+                                 allocatable={RK.CPU: 32000.0,
+                                              RK.MEMORY: 65536.0}))
+        hub.set_node_metric(api.NodeMetric(node_name=name,
+                                           update_time=now,
+                                           node_usage={}))
+        hub.set_device(api.Device(node_name=name, devices=[
+            api.DeviceInfo(minor=m, type="gpu",
+                           resources={RK.GPU_CORE: 100.0,
+                                      RK.GPU_MEMORY: 16000.0})
+            for m in range(2)]))
+    # gA: two HIGH-priority GPU pods at 50% of EACH instance — flat
+    # free is a whole GPU but no single instance can host one — plus a
+    # cheap low-priority CPU victim whose eviction frees no GPU
+    for m in range(2):
+        hub.upsert_pod(api.Pod(
+            meta=api.ObjectMeta(name=f"hi{m}", uid=f"hi{m}"),
+            priority=9900, phase="Running", node_name="gA",
+            requests={RK.GPU_CORE: 50.0, RK.GPU_MEMORY: 8000.0},
+            allocated_gpu_minors=(m,)))
+    hub.upsert_pod(api.Pod(
+        meta=api.ObjectMeta(name="cheap", uid="cheap"),
+        priority=5000, phase="Running", node_name="gA",
+        requests={RK.CPU: 2000.0, RK.MEMORY: 1024.0}))
+    # gB: two LOW-priority GPU pods fully holding one instance each —
+    # evicting one frees a whole GPU
+    for m in range(2):
+        hub.upsert_pod(api.Pod(
+            meta=api.ObjectMeta(name=f"lo{m}", uid=f"lo{m}"),
+            priority=5000, phase="Running", node_name="gB",
+            requests={RK.GPU_CORE: 100.0, RK.GPU_MEMORY: 16000.0},
+            allocated_gpu_minors=(m,)))
+
+    store = SnapshotStore()
+    syncer = SnapshotSyncer(hub, store, max_nodes=2, max_gpu_inst=2)
+    syncer.sync(now=now)
+    service = SchedulerService(store=store, enable_devices=True)
+    syncer.attach_scheduler(service)
+    nominations = []
+    syncer.register_preemption(
+        service, lambda pod, nom: nominations.append((pod, nom)))
+
+    preemptor = api.Pod(meta=api.ObjectMeta(name="train", uid="train"),
+                        priority=9500,
+                        requests={RK.CPU: 1000.0, RK.MEMORY: 1024.0,
+                                  RK.GPU_CORE: 100.0,
+                                  RK.GPU_MEMORY: 16000.0})
+    batch = syncer.builder.build_pod_batch([preemptor], syncer.ctx)
+    res = service.schedule(batch, typed_pods=[preemptor])
+    assert int(np.asarray(res.assignment)[0]) == -1  # no free instance
+    assert len(nominations) == 1
+    _, nom = nominations[0]
+    # gA's surviving instances can never host a full GPU: the default
+    # device wiring must reject it; gB frees one by evicting a lo pod
+    assert nom.node_name == "gB"
+    assert len(nom.victims) == 1
+    assert nom.victims[0].meta.name.startswith("lo")
+
+    # the handshake completes: evict the victim, resync, re-schedule
+    hub.delete_pod(nom.victims[0].meta.uid)
+    syncer.sync(now=now + 1)
+    batch2 = syncer.builder.build_pod_batch([preemptor], syncer.ctx)
+    res2 = service.schedule(batch2, typed_pods=[preemptor])
+    assert int(np.asarray(res2.assignment)[0]) \
+        == syncer.builder.node_index["gB"]
