@@ -644,6 +644,31 @@ class SnapshotSyncer:
                                   else pod.reservation_name),
             ), timestamp=now)
 
+    def build_pod_batch(self, pods, max_pods: Optional[int] = None):
+        """Build a PodBatch against the CURRENT builder with a FRESH
+        assume-cache mirror. This is the structural home of the
+        cross-batch count contract (core.py charge_domain_counts): the
+        topology count0 surfaces recompute from running + assumed pods,
+        so a batch built here sees every earlier schedule() call's
+        placements in its spread/anti/affinity counts even when no sync
+        ran in between (the bench threads counts explicitly through the
+        scan carry; the service path threads them through here)."""
+        self.hub.expire_assumed(self.now_fn(), self.assume_ttl,
+                                self.estimation_ttl)
+        # commit guard FIRST (the one lock order: commit -> view): the
+        # mirror swap must not race a sync() or an in-flight schedule
+        # commit whose assume hook has not recorded yet
+        with self._commit_guard():
+            with self._view_lock:
+                if self.builder is None:
+                    raise RuntimeError(
+                        "build_pod_batch before first sync()")
+                self.builder.set_assumed_pods(
+                    self.hub.assumed_entries(),
+                    self.hub.estimation_entries())
+                return self.builder.build_pod_batch(pods, self.ctx,
+                                                    max_pods=max_pods)
+
     def register_preemption(self, service, on_nominate) -> None:
         """Register the default-preemption PostFilter on the service's
         error chain with HUB-backed providers. devices_by_node is wired

@@ -528,3 +528,97 @@ def test_e2e_gpu_preemption_respects_surviving_instances():
     res2 = service.schedule(batch2, typed_pods=[preemptor])
     assert int(np.asarray(res2.assignment)[0]) \
         == syncer.builder.node_index["gB"]
+
+
+def test_e2e_service_path_carries_topology_counts_across_calls():
+    """Cross-call topology counts on the SERVICE path (the bench
+    threads counts explicitly through its scan carry; the service flow
+    relies on the builder recomputing count0 from running + ASSUMED
+    pods — core.py's cross-batch count contract). One spread group and
+    one anti group scheduled across SEPARATE SchedulerService.schedule
+    calls must see every earlier call's assumes in their counts, and
+    the final placement must equal the single-run sequential oracle."""
+    from koordinator_tpu.scheduler.frameworkext import SchedulerService
+    from koordinator_tpu.snapshot import (
+        ClusterInformerHub,
+        SnapshotStore,
+        SnapshotSyncer,
+    )
+    from koordinator_tpu.snapshot.builder import SnapshotBuilder
+    from oracle import OracleArgs, OracleScheduler, make_oracle_nodes
+
+    now = 1e9
+    zones = ["z0", "z0", "z1", "z1"]
+
+    def make_nodes():
+        return [api.Node(meta=api.ObjectMeta(
+            name=f"n{i}", labels={"zone": z, "host": f"n{i}"}),
+            allocatable={RK.CPU: 16000.0 + i * 1000.0,
+                         RK.MEMORY: 65536.0})
+            for i, z in enumerate(zones)]
+
+    spread = api.TopologySpreadConstraint(
+        max_skew=1, topology_key="zone", label_selector={"app": "web"})
+    anti = api.PodAffinityTerm(topology_key="host",
+                               label_selector={"app": "kv"}, anti=True)
+    pods = []
+    for j in range(6):
+        prio = 9300 - j * 10
+        cpu = 900.0 + j * 41.0
+        if j % 2 == 0:
+            pods.append(api.Pod(
+                meta=api.ObjectMeta(name=f"web{j}", uid=f"web{j}",
+                                    namespace="d",
+                                    labels={"app": "web"}),
+                priority=prio, requests={RK.CPU: cpu},
+                spread_constraints=[spread]))
+        else:
+            pods.append(api.Pod(
+                meta=api.ObjectMeta(name=f"kv{j}", uid=f"kv{j}",
+                                    namespace="d", labels={"app": "kv"}),
+                priority=prio, requests={RK.CPU: cpu},
+                pod_affinity=[anti]))
+
+    # oracle: all six sequentially in one run
+    ob = SnapshotBuilder(max_nodes=4)
+    for n in make_nodes():
+        ob.add_node(n)
+        ob.set_node_metric(api.NodeMetric(node_name=n.meta.name,
+                                          update_time=now, node_usage={}))
+    oracle = OracleScheduler(make_oracle_nodes(ob, now=now),
+                             OracleArgs.default())
+    want = oracle.schedule(pods)
+    assert (want >= 0).all()
+
+    # service path: one schedule() call per pod, no manual count
+    # threading — the assume cache carries the counts between calls
+    hub, store = ClusterInformerHub(), SnapshotStore()
+    syncer = SnapshotSyncer(hub, store, max_nodes=4)
+    service = SchedulerService(store=store, num_rounds=2, k_choices=2)
+    syncer.attach_scheduler(service)
+    for n in make_nodes():
+        hub.upsert_node(n)
+        hub.set_node_metric(api.NodeMetric(node_name=n.meta.name,
+                                           update_time=now,
+                                           node_usage={}))
+    assert syncer.sync(now=now) == "full"
+    got = []
+    for j, pod in enumerate(pods):
+        batch = syncer.build_pod_batch([pod])
+        if j == 4:
+            # the last WEB call must see both earlier web assumes in
+            # its spread counts (a group only materializes in batches
+            # whose pods carry it — kv batches compile the gate out)
+            assert float(np.asarray(batch.spread_count0).sum()) == 2.0
+        if j == 5:
+            # the last KV call must see both earlier kv carriers
+            assert float(
+                np.asarray(batch.anti_carrier_count0).sum()) == 2.0
+        res = service.schedule(batch, typed_pods=[pod])
+        got.append(int(np.asarray(res.assignment)[0]))
+    assert got == [int(a) for a in want]
+    # the constraints held: kv pods on distinct hosts, web zone skew <= 1
+    kv_nodes = [got[j] for j in (1, 3, 5)]
+    assert len(set(kv_nodes)) == 3
+    web_zones = [zones[got[j]] for j in (0, 2, 4)]
+    assert abs(web_zones.count("z0") - web_zones.count("z1")) <= 1
