@@ -9,8 +9,9 @@ a recorded scale number:
   4. ElasticQuota fair-share: 500-quota tree, 50k pending pods
   5. descheduler LowNodeLoad: 10k-node eviction/migration plan
 
-Prints ONE JSON line PER CONFIG:
-  {"metric": "...", "value": <seconds>, "unit": "s", ...}
+Prints one JSON line per measured path ({"metric": ..., "value":
+<seconds>, "unit": "s", ...}); config 5 emits TWO lines (the uncapped
+prefix-kernel plan and the capped scan-kernel plan).
 The reference publishes no numbers for these paths (BASELINE.md), so
 there is no vs_baseline; the lines exist to make regressions visible
 round over round.
@@ -140,6 +141,7 @@ def config_5_descheduler():
     from koordinator_tpu.api.extension import ResourceKind as RK
     from koordinator_tpu.descheduler import (
         DeviceLowNodeLoad,
+        EvictionLimiter,
         LowNodeLoadArgs,
         RecordingEvictor,
     )
@@ -159,24 +161,38 @@ def config_5_descheduler():
             node_usage={RK.CPU: 64000.0 * usage_frac[i],
                         RK.MEMORY: 262144.0 * usage_frac[i]})
         if usage_frac[i] > 0.7:  # candidates carry evictable pods
+            # node_name matters: the EvictionLimiter keys its per-node
+            # counts on it (a pod without one would collapse every pod
+            # into a single "" bucket under per-node caps)
             pods_by_node[name] = [
                 api.Pod(meta=api.ObjectMeta(name=f"{name}-p{j}",
                                             uid=f"{name}-p{j}"),
-                        priority=5500, qos_label="BE",
+                        priority=5500, qos_label="BE", node_name=name,
                         requests={RK.CPU: 4000.0, RK.MEMORY: 8192.0})
                 for j in range(4)]
 
-    evictor = RecordingEvictor()
     args = LowNodeLoadArgs(consecutive_abnormalities=1)
-    plugin = DeviceLowNodeLoad(args, evictor)
-    plugin.balance_once(nodes, metrics, pods_by_node, now)  # warm/compile
-    evictor.limiter.reset()
-    evictor.evictions.clear()  # the warm run's plan must not double-count
-    t0 = time.perf_counter()
-    plugin.balance_once(nodes, metrics, pods_by_node, now)
-    elapsed = time.perf_counter() - t0
-    _emit("baseline_cfg5_descheduler_10k", elapsed, nodes=n,
-          evictions_planned=len(evictor.evictions), device_plan=True)
+
+    def measure(evictor, metric, **extra):
+        plugin = DeviceLowNodeLoad(args, evictor)
+        plugin.balance_once(nodes, metrics, pods_by_node, now)  # warm
+        evictor.limiter.reset()
+        evictor.evictions.clear()  # the warm plan must not double-count
+        t0 = time.perf_counter()
+        plugin.balance_once(nodes, metrics, pods_by_node, now)
+        _emit(metric, time.perf_counter() - t0, nodes=n,
+              evictions_planned=len(evictor.evictions),
+              device_plan=True, **extra)
+
+    measure(RecordingEvictor(), "baseline_cfg5_descheduler_10k")
+    # the CAPPED variant (per-node/per-namespace/per-cycle limits — the
+    # production blast-radius configuration, round 5): the lax.scan
+    # kernel replaces the prefix kernel; this line keeps its latency
+    # regression-visible round over round
+    measure(RecordingEvictor(EvictionLimiter(
+        max_per_cycle=4000, max_per_node=2, max_per_namespace=2000)),
+        "baseline_cfg5_descheduler_10k_capped",
+        caps="node=2,ns=2000,cycle=4000")
 
 
 def main():

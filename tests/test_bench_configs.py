@@ -17,8 +17,13 @@ def test_config_1_emits_json(capsys):
 
 def test_config_5_descheduler_emits_json(capsys):
     bench_configs.config_5_descheduler()
-    line = capsys.readouterr().out.strip().splitlines()[-1]
-    out = json.loads(line)
+    lines = capsys.readouterr().out.strip().splitlines()
+    out = json.loads(lines[-2])
     assert out["metric"] == "baseline_cfg5_descheduler_10k"
     assert out["nodes"] == 10_000
     assert out["evictions_planned"] > 0
+    capped = json.loads(lines[-1])
+    assert capped["metric"] == "baseline_cfg5_descheduler_10k_capped"
+    # the ns cap binds: 2000 of the ~9k uncapped evictions survive
+    assert 0 < capped["evictions_planned"] <= 2000
+    assert capped["evictions_planned"] < out["evictions_planned"]
