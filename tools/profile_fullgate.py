@@ -1,0 +1,113 @@
+"""Bisect the full-gate per-chunk cost on live hardware.
+
+Runs the SWEEP only (no tail) at a reduced pod count so each compile is
+cheap, toggling one gate family off at a time; the delta against the
+all-on baseline localizes where the 100k x 10k full-gate time goes.
+Usage: JAX_PLATFORMS=axon python tools/profile_fullgate.py [pods] [nodes]
+"""
+
+import functools
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import jax
+import jax.numpy as jnp
+
+from koordinator_tpu.scheduler import core
+from koordinator_tpu.scheduler.plugins.loadaware import LoadAwareConfig
+from koordinator_tpu.utils import synthetic
+
+P = int(sys.argv[1]) if len(sys.argv) > 1 else 10_000
+N = int(sys.argv[2]) if len(sys.argv) > 2 else 10_000
+CHUNK = 2_000
+
+
+def time_sweep(tag, pods, step_kw, slim=False):
+    cfg = LoadAwareConfig.make()
+    stacked = synthetic.stack_pod_chunks(pods, CHUNK)
+    snap = jax.device_put(synthetic.full_gate_cluster(N, num_quotas=32,
+                                                      seed=0))
+    stacked = jax.device_put(stacked)
+    pods_d = jax.device_put(pods)
+    counts = jax.device_put(tuple(getattr(pods, f)
+                                  for f in core.COUNT_FIELDS))
+    step = functools.partial(core.schedule_batch, num_rounds=2,
+                             k_choices=8, score_dims=(0, 1),
+                             approx_topk=True, tie_break=True,
+                             quota_depth=2, fit_dims=(0, 1, 2, 3),
+                             **step_kw)
+
+    def charge(counts, batch, assignment):
+        # mirror bench.py: the full-gate bench pays charge_all_counts
+        # regardless of which gate families are compiled in, so gate-off
+        # rows must keep paying it too or the bisection mislocalizes
+        if slim:
+            return counts
+        return core.charge_all_counts(counts, batch, assignment)
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
+    def sweep(snap, counts, stacked, pods_d, cfg):
+        def body(carry, cols):
+            snap, counts = carry
+            batch = pods_d.replace(**cols).replace(
+                **dict(zip(core.COUNT_FIELDS, counts)))
+            res = step(snap, batch, cfg)
+            counts = charge(counts, batch, res.assignment)
+            return (res.snapshot, counts), res.assignment
+        (snap, counts), assign = jax.lax.scan(body, (snap, counts),
+                                              stacked)
+        return snap, counts, assign.reshape(-1)
+
+    jax.block_until_ready((stacked, pods_d, cfg, snap, counts))
+    t0 = time.perf_counter()
+    out = sweep(snap, counts, stacked, pods_d, cfg)
+    jax.block_until_ready(out)
+    compile_s = time.perf_counter() - t0
+
+    runs = []
+    placed = -1
+    for rep in range(3):
+        snap = jax.device_put(synthetic.full_gate_cluster(
+            N, num_quotas=32, seed=7 + rep))
+        counts = jax.device_put(tuple(getattr(pods, f)
+                                      for f in core.COUNT_FIELDS))
+        jax.block_until_ready((snap, counts))
+        t0 = time.perf_counter()
+        out = sweep(snap, counts, stacked, pods_d, cfg)
+        jax.block_until_ready(out)
+        runs.append(time.perf_counter() - t0)
+        placed = int((out[2] >= 0).sum())
+    run_s = min(runs)
+    per_chunk = run_s / (P / CHUNK)
+    print(f"{tag:28s} min={run_s:7.3f}s per_chunk={per_chunk * 1e3:8.1f}ms"
+          f" all={['%.3f' % r for r in runs]}"
+          f" placed={placed} compile={compile_s:6.1f}s", flush=True)
+    return run_s
+
+
+def main():
+    print(f"platform={jax.devices()[0].platform} P={P} N={N} chunk={CHUNK}",
+          flush=True)
+    pods = synthetic.full_gate_pods(P, N, seed=1, num_quotas=32)
+    full_kw = dict(enable_numa=True, enable_devices=True)
+    time_sweep("ALL-ON (full gate)", pods, full_kw)
+    time_sweep("numa off", pods, dict(enable_numa=False,
+                                      enable_devices=True))
+    time_sweep("devices off", pods, dict(enable_numa=True,
+                                         enable_devices=False))
+    time_sweep("spread off", pods.replace(has_spread=False), full_kw)
+    time_sweep("anti off", pods.replace(has_anti=False), full_kw)
+    time_sweep("aff off", pods.replace(has_aff=False), full_kw)
+    time_sweep("taints off", pods.replace(has_taints=False), full_kw)
+    time_sweep("topo all off", pods.replace(
+        has_spread=False, has_anti=False, has_aff=False), full_kw)
+    slim_pods = synthetic.synthetic_pods(P, seed=1, num_quotas=32)
+    time_sweep("slim workload (ref)", slim_pods, dict(enable_numa=False), slim=True)
+
+
+if __name__ == "__main__":
+    main()
