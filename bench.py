@@ -152,11 +152,19 @@ def run_northstar(full_gate: bool = False, num_pods: int = None,
     if full_gate:
         pods = synthetic.full_gate_pods(num_pods, num_nodes, seed=1,
                                         num_quotas=32)
+        # constrained-prefix packing: ~17% of the workload carries a
+        # spread/anti/aff term; packing them to a static chunk prefix
+        # shrinks the in-step same-domain [P, P] machinery ~16x
+        # (core.schedule_batch topo_prefix contract)
+        pods, topo_prefix, topo_mask = synthetic.pack_topo_prefix(
+            pods, chunk)
         make_snap = functools.partial(synthetic.full_gate_cluster,
                                       num_nodes, num_quotas=32)
         metric = metric or "score_bind_100k_pods_10k_nodes_full_gate"
-        step_kw = dict(enable_numa=True, enable_devices=True)
+        step_kw = dict(enable_numa=True, enable_devices=True,
+                       topo_prefix=topo_prefix)
     else:
+        topo_prefix, topo_mask = None, None
         pods = synthetic.synthetic_pods(num_pods, seed=1, num_quotas=32)
         make_snap = functools.partial(synthetic.synthetic_cluster,
                                       num_nodes, num_quotas=32)
@@ -203,6 +211,8 @@ def run_northstar(full_gate: bool = False, num_pods: int = None,
                                   approx_topk=approx, tie_break=True,
                                   quota_depth=2, fit_dims=(0, 1, 2, 3),
                                   **step_kw)
+    if topo_mask is not None:
+        topo_mask = put_repl(jnp.asarray(topo_mask))
 
     def charge_all(counts, batch, assignment):
         """Thread placed topology charges into the carried counts (the
@@ -233,27 +243,65 @@ def run_northstar(full_gate: bool = False, num_pods: int = None,
     def tail_pass(snap, counts, assign, tried, pods_dev, cfg):
         """Retry up to CHUNK unplaced pods, packed device-side.
 
-        Selection prefers NEVER-RETRIED leftovers (sort key 0) over
-        already-retried ones (key 1), so retry capacity is genuinely
-        exhausted: without the `tried` mask, a pass that placed nothing
-        would re-select the same window and silently starve the rest.
-        The gathered retry batch marks only true leftovers valid, so a
-        pass with nothing left is a no-op on the snapshot.
+        Selection prefers NEVER-RETRIED leftovers over already-retried
+        ones, so retry capacity is genuinely exhausted: without the
+        `tried` mask, a pass that placed nothing would re-select the
+        same window and silently starve the rest. The gathered retry
+        batch marks only true leftovers valid, so a pass with nothing
+        left is a no-op on the snapshot.
+
+        Full-gate (topo_prefix set): at most topo_prefix constrained
+        stragglers (untried first) sort to the FRONT of the window —
+        inside the scheduler's packing prefix — and the remaining slots
+        go to unconstrained stragglers. Constrained overflow is excluded
+        from the pass AND left unmarked in `tried`, so it stays in the
+        never-retried pool and the adaptive loop keeps running until it
+        drains; the in-prefix mask below is the safety net for the
+        degenerate few-stragglers case.
         """
         bad = pods_dev.valid & (assign < 0)
-        key = jnp.where(bad & ~tried, 0, jnp.where(bad, 1, 2))
+        if topo_prefix is None:
+            key = jnp.where(bad & ~tried, 0, jnp.where(bad, 1, 2))
+        else:
+            # budgeted constrained selection: rank constrained
+            # stragglers untried-first and admit only the first
+            # topo_prefix of them to this pass — the REST of the window
+            # goes to unconstrained stragglers (untried first), so
+            # constrained overflow occupies no dead slots and can never
+            # starve unconstrained retries
+            cb = bad & topo_mask
+            ckey = jnp.where(cb & ~tried, 0, jnp.where(cb, 1, 2))
+            corder = jnp.argsort(ckey, stable=True)
+            rank_c = jnp.zeros((num_pods,), jnp.int32).at[corder].set(
+                jnp.arange(num_pods, dtype=jnp.int32))
+            adm = cb & (rank_c < topo_prefix)
+            # untried pods of EITHER class outrank every tried pod
+            # (admitted-constrained tried included), so no untried
+            # straggler can be starved by retry loops of failing pods;
+            # admitted-tried rows displaced beyond the prefix are
+            # caught by the in_prefix mask
+            key = jnp.where(
+                adm & ~tried, 0,
+                jnp.where(bad & ~topo_mask & ~tried, 1,
+                          jnp.where(adm, 2,
+                                    jnp.where(bad & ~topo_mask, 3,
+                                              jnp.where(bad, 4, 5)))))
         order = jnp.argsort(key, stable=True)
         idx = order[:chunk]
+        attempt = bad[idx]
+        if topo_prefix is not None:
+            in_prefix = jnp.arange(chunk) < topo_prefix
+            attempt &= ~topo_mask[idx] | in_prefix
         retry = with_counts(
             pods_dev.replace(
                 **{f: getattr(pods_dev, f)[idx]
                    for f in synthetic.PER_POD_FIELDS if f != "valid"},
-                valid=bad[idx]),
+                valid=attempt),
             counts)
-        tried = tried.at[idx].set(tried[idx] | bad[idx])
+        tried = tried.at[idx].set(tried[idx] | attempt)
         res = tail_step(snap, retry, cfg)
         counts = charge_all(counts, retry, res.assignment)
-        got = bad[idx] & (res.assignment >= 0)
+        got = attempt & (res.assignment >= 0)
         assign = assign.at[idx].set(
             jnp.where(got, res.assignment, assign[idx]))
         return res.snapshot, counts, assign, tried

@@ -1,0 +1,137 @@
+"""Constrained-prefix packing (core.schedule_batch topo_prefix).
+
+The packing contract: every spread/anti/aff member or carrier pod sits
+in batch rows [0, topo_prefix). Under that contract the prefix-sliced
+in-step machinery must be BIT-IDENTICAL to the full-width gates — the
+slices drop only rows that can neither charge nor be gated. The packer
+(synthetic.pack_topo_prefix) establishes the contract host-side; these
+tests pin both the packer and the equivalence.
+
+Ref: the reference's hot loop runs the vanilla spread/affinity plugins
+for every pod (/root/reference/pkg/scheduler/frameworkext/
+framework_extender.go:204-226); the prefix is a batching-layer
+optimization with no semantic counterpart there, so equivalence against
+the unpacked program IS the parity statement.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from koordinator_tpu.scheduler import core
+from koordinator_tpu.scheduler.plugins.loadaware import LoadAwareConfig
+from koordinator_tpu.utils import synthetic
+
+P, N, CHUNK = 1_024, 200, 256
+
+
+def _packed_workload(seed=1):
+    pods = synthetic.full_gate_pods(P, N, seed=seed, num_quotas=8,
+                                    num_gangs=8)
+    return synthetic.pack_topo_prefix(pods, CHUNK)
+
+
+def test_packer_establishes_the_contract():
+    pods, prefix, mask = _packed_workload()
+    assert prefix % 128 == 0 and 0 < prefix <= CHUNK
+    cons = synthetic.topo_constrained_mask(pods)
+    np.testing.assert_array_equal(cons, mask)
+    for s in range(0, P, CHUNK):
+        chunk_mask = mask[s:s + CHUNK]
+        assert not chunk_mask[prefix:].any()
+        # stable within the two classes: constrained pods keep their
+        # relative order, as do unconstrained ones
+        assert (np.diff(np.flatnonzero(chunk_mask)) > 0).all()
+
+
+def test_packer_preserves_the_multiset_of_pods():
+    pods = synthetic.full_gate_pods(P, N, seed=3, num_quotas=8,
+                                    num_gangs=8)
+    packed, _, _ = synthetic.pack_topo_prefix(pods, CHUNK)
+    for f in ("priority", "quota_id", "gang_id", "spread_id", "anti_id",
+              "aff_id"):
+        a = np.sort(np.asarray(getattr(pods, f)))
+        b = np.sort(np.asarray(getattr(packed, f)))
+        np.testing.assert_array_equal(a, b)
+    np.testing.assert_allclose(
+        np.asarray(pods.requests).sum(0), np.asarray(packed.requests).sum(0))
+
+
+def test_prefix_program_is_bit_identical_to_full_width():
+    """The parity pin: same packed chunk, topo_prefix on vs off."""
+    pods, prefix, _ = _packed_workload()
+    snap = synthetic.full_gate_cluster(N, seed=0, num_quotas=8,
+                                       num_gangs=8)
+    cfg = LoadAwareConfig.make()
+    batch = synthetic.slice_batch(pods, 0, CHUNK)
+    kw = dict(num_rounds=2, k_choices=8, score_dims=(0, 1),
+              tie_break=True, quota_depth=2, fit_dims=(0, 1, 2, 3),
+              enable_numa=True, enable_devices=True)
+    full = core.schedule_batch(snap, batch, cfg, **kw)
+    pref = core.schedule_batch(snap, batch, cfg, topo_prefix=prefix, **kw)
+    np.testing.assert_array_equal(np.asarray(full.assignment),
+                                  np.asarray(pref.assignment))
+    np.testing.assert_array_equal(np.asarray(full.chosen_score),
+                                  np.asarray(pref.chosen_score))
+    np.testing.assert_array_equal(np.asarray(full.numa_zone),
+                                  np.asarray(pref.numa_zone))
+    np.testing.assert_array_equal(np.asarray(full.gpu_take),
+                                  np.asarray(pref.gpu_take))
+    for a, b in zip(jax.tree_util.tree_leaves(full.snapshot),
+                    jax.tree_util.tree_leaves(pref.snapshot)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert int((full.assignment >= 0).sum()) > 0
+
+
+def test_prefix_equivalence_across_carried_chunks():
+    """Chunked scheduling with carried topology counts: the packed
+    prefix program and the full-width program must agree chunk by
+    chunk when counts thread through charge_all_counts (the bench
+    sweep contract)."""
+    pods, prefix, _ = _packed_workload(seed=5)
+    snap_a = synthetic.full_gate_cluster(N, seed=2, num_quotas=8,
+                                         num_gangs=8)
+    snap_b = snap_a
+    cfg = LoadAwareConfig.make()
+    kw = dict(num_rounds=2, k_choices=8, score_dims=(0, 1),
+              tie_break=True, quota_depth=2, fit_dims=(0, 1, 2, 3),
+              enable_numa=True, enable_devices=True)
+    counts_a = tuple(jnp.asarray(getattr(pods, f))
+                     for f in core.COUNT_FIELDS)
+    counts_b = counts_a
+    for s in range(0, P, CHUNK):
+        batch = synthetic.slice_batch(pods, s, CHUNK)
+        batch_a = batch.replace(**dict(zip(core.COUNT_FIELDS, counts_a)))
+        batch_b = batch.replace(**dict(zip(core.COUNT_FIELDS, counts_b)))
+        res_a = core.schedule_batch(snap_a, batch_a, cfg, **kw)
+        res_b = core.schedule_batch(snap_b, batch_b, cfg,
+                                    topo_prefix=prefix, **kw)
+        np.testing.assert_array_equal(np.asarray(res_a.assignment),
+                                      np.asarray(res_b.assignment))
+        counts_a = core.charge_all_counts(counts_a, batch_a,
+                                          res_a.assignment)
+        counts_b = core.charge_all_counts(counts_b, batch_b,
+                                          res_b.assignment)
+        snap_a, snap_b = res_a.snapshot, res_b.snapshot
+    for a, b in zip(counts_a, counts_b):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_full_width_default_untouched_by_unpacked_order():
+    """topo_prefix=None on an UNPACKED batch (constrained pods anywhere)
+    stays the exact reference behavior — the new argument must not
+    perturb the default path."""
+    pods = synthetic.full_gate_pods(P, N, seed=9, num_quotas=8,
+                                    num_gangs=8)
+    snap = synthetic.full_gate_cluster(N, seed=4, num_quotas=8,
+                                       num_gangs=8)
+    cfg = LoadAwareConfig.make()
+    batch = synthetic.slice_batch(pods, 0, CHUNK)
+    kw = dict(num_rounds=2, k_choices=8, score_dims=(0, 1),
+              tie_break=True, quota_depth=2, fit_dims=(0, 1, 2, 3),
+              enable_numa=True, enable_devices=True)
+    res = core.schedule_batch(snap, batch, cfg, **kw)
+    res2 = core.schedule_batch(snap, batch, cfg, topo_prefix=CHUNK, **kw)
+    # prefix == chunk width is the same program by construction
+    np.testing.assert_array_equal(np.asarray(res.assignment),
+                                  np.asarray(res2.assignment))
