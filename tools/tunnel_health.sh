@@ -1,0 +1,18 @@
+#!/bin/sh
+# Lightweight tunnel-health logger: one hard-timeout probe every ~7 min,
+# appended to tools/tunnel_health.log. Complements tools/tpu_capture.py
+# (whose watcher sleeps long once an artifact is fresh) so a mid-round
+# heal is visible within minutes.
+cd "$(dirname "$0")/.." || exit 1
+while true; do
+  if timeout 120 python -c "
+import bench
+import sys
+sys.exit(0 if bench._probe_once(100) else 1)
+" >/dev/null 2>&1; then
+    echo "$(date -u +%FT%TZ) healthy" >> tools/tunnel_health.log
+  else
+    echo "$(date -u +%FT%TZ) wedged" >> tools/tunnel_health.log
+  fi
+  sleep 420
+done
