@@ -15,7 +15,13 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
 import jax
-import jax.numpy as jnp
+
+# honor JAX_PLATFORMS explicitly: the CI hosts' site config pins the
+# axon tunnel platform and silently overrides the env var (the
+# tests/conftest.py lesson) — a "cpu" run would otherwise hang on a
+# wedged tunnel at first device touch
+if os.environ.get("JAX_PLATFORMS"):
+    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
 
 from koordinator_tpu.scheduler import core
 from koordinator_tpu.scheduler.plugins.loadaware import LoadAwareConfig
@@ -26,8 +32,11 @@ N = int(sys.argv[2]) if len(sys.argv) > 2 else 10_000
 CHUNK = 2_000
 
 
-def time_sweep(tag, pods, step_kw, slim=False):
+def time_sweep(tag, pods, step_kw, slim=False, pack=False):
     cfg = LoadAwareConfig.make()
+    if pack:
+        pods, prefix, _ = synthetic.pack_topo_prefix(pods, CHUNK)
+        step_kw = dict(step_kw, topo_prefix=prefix)
     stacked = synthetic.stack_pod_chunks(pods, CHUNK)
     snap = jax.device_put(synthetic.full_gate_cluster(N, num_quotas=32,
                                                       seed=0))
@@ -94,19 +103,28 @@ def main():
           flush=True)
     pods = synthetic.full_gate_pods(P, N, seed=1, num_quotas=32)
     full_kw = dict(enable_numa=True, enable_devices=True)
-    time_sweep("ALL-ON (full gate)", pods, full_kw)
-    time_sweep("numa off", pods, dict(enable_numa=False,
-                                      enable_devices=True))
-    time_sweep("devices off", pods, dict(enable_numa=True,
-                                         enable_devices=False))
-    time_sweep("spread off", pods.replace(has_spread=False), full_kw)
-    time_sweep("anti off", pods.replace(has_anti=False), full_kw)
-    time_sweep("aff off", pods.replace(has_aff=False), full_kw)
-    time_sweep("taints off", pods.replace(has_taints=False), full_kw)
-    time_sweep("topo all off", pods.replace(
-        has_spread=False, has_anti=False, has_aff=False), full_kw)
+    time_sweep("ALL-ON unpacked (ref)", pods, full_kw)
+    time_sweep("ALL-ON packed", pods, full_kw, pack=True)
+    time_sweep("packed, numa off", pods, dict(enable_numa=False,
+                                              enable_devices=True),
+               pack=True)
+    time_sweep("packed, devices off", pods, dict(enable_numa=True,
+                                                 enable_devices=False),
+               pack=True)
+    time_sweep("packed, spread off", pods.replace(has_spread=False),
+               full_kw, pack=True)
+    time_sweep("packed, anti off", pods.replace(has_anti=False),
+               full_kw, pack=True)
+    time_sweep("packed, aff off", pods.replace(has_aff=False),
+               full_kw, pack=True)
+    time_sweep("packed, taints off", pods.replace(has_taints=False),
+               full_kw, pack=True)
+    time_sweep("packed, topo all off", pods.replace(
+        has_spread=False, has_anti=False, has_aff=False), full_kw,
+        pack=True)
     slim_pods = synthetic.synthetic_pods(P, seed=1, num_quotas=32)
-    time_sweep("slim workload (ref)", slim_pods, dict(enable_numa=False), slim=True)
+    time_sweep("slim workload (ref)", slim_pods, dict(enable_numa=False),
+               slim=True)
 
 
 if __name__ == "__main__":
