@@ -1,0 +1,109 @@
+"""One-shot TPU tuning battery, armed while the tunnel is wedged.
+
+Probes the tunnel every few minutes; on the first healthy window it
+runs the round-5 hardware experiments back-to-back and exits:
+
+  1. canonical bench (batched-readback protocol) + exact-top-k variant
+  2. approx_max_k quality bound where it binds (KOORD_TEST_PLATFORM)
+  3. packed full-gate bisection (tools/profile_fullgate.py)
+  4. full-gate chunk sweep (BENCH_FULL_CHUNK 1000 / 500)
+  5. full-gate rounds sweep (BENCH_ROUNDS=1 BENCH_K=16)
+
+Coordination with tools/tpu_capture.py: the capture artifact is the
+round's EVIDENCE and takes priority — while it is stale the tuner
+yields (sleeps) so the watcher can freeze a fresh artifact first.
+Everything is logged to tools/tpu_tuner.log; each experiment's stdout
+tail is inlined so one file tells the whole story.
+"""
+
+import datetime
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+LOG = os.path.join(REPO, "tools", "tpu_tuner.log")
+PROBE_INTERVAL = float(os.environ.get("TUNER_PROBE_INTERVAL", "300"))
+
+
+def log(msg):
+    stamp = datetime.datetime.now(datetime.timezone.utc).isoformat()
+    with open(LOG, "a") as f:
+        f.write(f"[{stamp}] {msg}\n")
+
+
+def run_exp(tag, cmd, env_extra, timeout):
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "axon")
+    env["BENCH_PROBE_ATTEMPTS"] = "1"
+    env["BENCH_PROBE_TIMEOUT"] = "120"
+    env.update(env_extra)
+    log(f"exp {tag}: {' '.join(cmd)} env={env_extra}")
+    out_path = os.path.join(REPO, "tools", f"tuner_{tag}.out")
+    with open(out_path, "wb") as out:
+        try:
+            rc = subprocess.run(cmd, cwd=REPO, env=env, stdout=out,
+                                stderr=subprocess.STDOUT,
+                                timeout=timeout).returncode
+        except subprocess.TimeoutExpired:
+            log(f"exp {tag}: TIMEOUT after {timeout}s")
+            return False
+    with open(out_path, errors="replace") as f:
+        lines = [l.rstrip() for l in f if l.strip()]
+    for l in lines[-8:]:
+        log(f"  {tag}| {l}")
+    log(f"exp {tag}: rc={rc}")
+    return rc == 0
+
+
+def capture_fresh():
+    try:
+        with open(os.path.join(REPO, "bench_tpu_capture.json")) as f:
+            art = json.load(f)
+        age = (datetime.datetime.now(datetime.timezone.utc)
+               - datetime.datetime.fromisoformat(art["captured_at"])
+               ).total_seconds()
+        return age < 7200
+    except (OSError, ValueError, KeyError):
+        return False
+
+
+def main():
+    import bench
+    while True:
+        if not bench._probe_once(100):
+            time.sleep(PROBE_INTERVAL)
+            continue
+        log("tunnel healthy")
+        if not capture_fresh():
+            # the watcher's capture is the round's evidence; yield
+            log("capture artifact stale - yielding to tpu_capture")
+            time.sleep(240)
+            continue
+        break
+    py = sys.executable
+    bench_one = [py, "-c",
+                 "import bench; bench.main(bench.ensure_platform())"]
+    run_exp("canonical", bench_one, {"BENCH_EXTRAS": "0"}, 1500)
+    run_exp("canonical_exact", bench_one,
+            {"BENCH_EXTRAS": "0", "BENCH_APPROX": "0"}, 1500)
+    run_exp("approx_bound",
+            [py, "-m", "pytest", "tests/test_approx_topk.py", "-q"],
+            {"KOORD_TEST_PLATFORM": "axon"}, 1500)
+    run_exp("bisect", [py, "tools/profile_fullgate.py", "10000", "10000"],
+            {}, 2400)
+    fg = [py, "-c", ("import bench; bench.ensure_platform(); "
+                     "bench.run_northstar(full_gate=True)")]
+    run_exp("fg_chunk1000", fg, {"BENCH_FULL_CHUNK": "1000"}, 2400)
+    run_exp("fg_chunk500", fg, {"BENCH_FULL_CHUNK": "500"}, 2400)
+    run_exp("fg_rounds1", fg, {"BENCH_ROUNDS": "1", "BENCH_K": "16"},
+            2400)
+    log("tuner battery complete")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
