@@ -162,7 +162,8 @@ def run_northstar(full_gate: bool = False, num_pods: int = None,
                                       num_nodes, num_quotas=32)
         metric = metric or "score_bind_100k_pods_10k_nodes_full_gate"
         step_kw = dict(enable_numa=True, enable_devices=True,
-                       topo_prefix=topo_prefix)
+                       topo_prefix=topo_prefix,
+                       dom_classes=synthetic.dom_classes(pods))
     else:
         topo_prefix, topo_mask = None, None
         pods = synthetic.synthetic_pods(num_pods, seed=1, num_quotas=32)

@@ -44,6 +44,7 @@ from typing import Tuple
 import flax.struct
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from koordinator_tpu.scheduler.batching import (
     EPS,
@@ -106,7 +107,8 @@ class ScheduleResult:
                                              "quota_depth",
                                              "fit_dims",
                                              "enable_amplification",
-                                             "topo_prefix"))
+                                             "topo_prefix",
+                                             "dom_classes"))
 def schedule_batch(snap: ClusterSnapshot, pods: PodBatch,
                    cfg: loadaware.LoadAwareConfig,
                    num_rounds: int = 4, k_choices: int = 8,
@@ -120,7 +122,8 @@ def schedule_batch(snap: ClusterSnapshot, pods: PodBatch,
                    quota_depth: int = MAX_QUOTA_DEPTH,
                    fit_dims: tuple = None,
                    enable_amplification: bool = False,
-                   topo_prefix: int = None) -> ScheduleResult:
+                   topo_prefix: int = None,
+                   dom_classes: tuple = None) -> ScheduleResult:
     """Schedule a pod batch against the snapshot. Pure function; the caller
     publishes `result.snapshot` as the next version (store.update).
 
@@ -140,7 +143,21 @@ def schedule_batch(snap: ClusterSnapshot, pods: PodBatch,
     (synthetic.pack_topo_prefix validates; the bench tail masks overflow
     pods to a later pass): a member outside the prefix would silently skip
     in-step charging while still charging at round level. None = full
-    width (every row gated; no contract)."""
+    width (every row gated; no contract).
+
+    `dom_classes` (static): DOMAIN-CLASS CONTRACT — groups sharing an
+    upstream topologyKey have IDENTICAL rows in their domain matrix, so
+    their in-step same-domain masks are equal. A 3-tuple
+    (spread_classes, anti_classes, aff_classes), each a tuple of
+    group-id tuples partitioning that family's groups into equal-row
+    classes: the inner commit then builds ONE mask per class and
+    batches the per-group matvecs into a single [pc, pc] x [pc, Gc]
+    matmul — group-count-independent cost. The sums are 0/1 floats, so
+    batching is bit-identical to the per-group loop. Callers derive
+    classes host-side from the actual domain rows
+    (synthetic.dom_classes); a class containing groups with UNEQUAL
+    rows silently mis-gates. None = every group its own class (the
+    reference per-group behavior)."""
     nodes0, quotas0, gangs0 = snap.nodes, snap.quotas, snap.gangs
     devices0 = snap.devices
     n_nodes = nodes0.num_nodes
@@ -357,6 +374,20 @@ def schedule_batch(snap: ClusterSnapshot, pods: PodBatch,
     # concatenations zero-size — one code path for both modes
     pc = p if topo_prefix is None else max(min(int(topo_prefix), p), 0)
 
+    _s_cls, _a_cls, _f_cls = dom_classes if dom_classes is not None \
+        else (None, None, None)
+
+    def _norm_classes(cls, n_g):
+        """Singleton classes (the default) reduce the batched per-class
+        matmul to the per-group matvec exactly."""
+        if cls is None:
+            return tuple((g,) for g in range(n_g))
+        got = sorted(g for c in cls for g in c)
+        if got != list(range(n_g)) or not all(len(c) for c in cls):
+            raise ValueError(f"dom_classes must partition range({n_g}) "
+                             f"into non-empty classes; got {cls}")
+        return tuple(tuple(c) for c in cls)
+
     use_spread = pods.has_spread
     if use_spread:
         spread_domain_x, spread_counts_flat, n_sg, n_dom = \
@@ -370,6 +401,7 @@ def schedule_batch(snap: ClusterSnapshot, pods: PodBatch,
         # SOFT groups (ScheduleAnyway) carry skew = inf from the
         # builder; they never filter — keyless nodes included
         spread_soft = ~jnp.isfinite(pods.spread_max_skew)      # [Sg]
+        spread_classes = _norm_classes(_s_cls, n_sg)
     # inter-pod anti-affinity: a domain admits a gated pod only at count
     # 0; nodes LACKING the topology key pass (no topology pair can
     # exist there — upstream admits them).
@@ -384,6 +416,7 @@ def schedule_batch(snap: ClusterSnapshot, pods: PodBatch,
                              pods.anti_carrier)
         anti_member_f = pods.anti_member.astype(jnp.float32)  # [P, Ag]
         anti_carrier_f = pods.anti_carrier.astype(jnp.float32)
+        anti_classes = _norm_classes(_a_cls, n_ag)
     # inter-pod affinity: a domain admits a gated pod only when it holds
     # a matching pod — except the bootstrap: when nothing matches
     # anywhere, any self-matching member may OPEN a domain, capped to
@@ -399,6 +432,7 @@ def schedule_batch(snap: ClusterSnapshot, pods: PodBatch,
         aff_domain_x, aff_counts_flat, n_fg, n_fd = \
             domain_machinery(pods.aff_domain, pods.aff_count0,
                              pods.aff_member)
+        aff_classes = _norm_classes(_f_cls, n_fg)
 
     def round_body(carry, _):
         requested, quota_used, numa_used, gpu_free, aux_free, once_taken, \
@@ -630,82 +664,102 @@ def schedule_batch(snap: ClusterSnapshot, pods: PodBatch,
                 # between rounds, releasing more; SOFT groups never
                 # gate). Current counts come from the CARRIED
                 # assignment, so allowance consumed in earlier inner
-                # steps (kptr fall-throughs) is charged too. The
-                # per-group loop (anti pattern) lets a pod charge every
-                # group it MATCHES while being gated by every group it
-                # CARRIES — multi-constraint pods.
+                # steps (kptr fall-throughs) is charged too. Groups
+                # iterate per domain CLASS (identical domain rows share
+                # one same-domain mask; the per-group matvecs batch into
+                # one matmul), and the per-group columns let a pod
+                # charge every group it MATCHES while being gated by
+                # every group it CARRIES — multi-constraint pods.
                 counts_s_now = spread_counts_flat(placed).reshape(
                     n_sg, n_dom)
-                for g in range(n_sg):
-                    dom_g = spread_domain_x[g, choice_pc]        # [pc]
-                    has_dom = dom_g >= 0
+                for cls in spread_classes:
+                    ci_ = np.asarray(cls, dtype=np.int32)
+                    dom_g = spread_domain_x[ci_[0], choice_pc]   # [pc]
+                    has_dom = (dom_g >= 0)[:, None]
                     same_d = dom_g[:, None] == dom_g[None, :]
                     e_mask = (same_d & earlier_pc).astype(jnp.float32)
                     dom_c = jnp.maximum(dom_g, 0)
-                    contrib = (trying_pc & pods.spread_member[:pc, g]
-                               & has_dom).astype(jnp.float32)
-                    gated = (trying_pc & pods.spread_carrier[:pc, g]
-                             & has_dom & ~spread_soft[g])
-                    occ = counts_s_now[g, dom_c] + e_mask @ contrib
-                    limit_g = pods.spread_max_skew[g] + min_c[g]
-                    accept_pc &= ~gated | (occ + 1.0 <= limit_g + EPS)
+                    contrib = (trying_pc[:, None]
+                               & pods.spread_member[:pc, ci_]
+                               & has_dom).astype(jnp.float32)  # [pc, Gc]
+                    gated = (trying_pc[:, None]
+                             & pods.spread_carrier[:pc, ci_]
+                             & has_dom & ~spread_soft[ci_][None, :])
+                    occ = counts_s_now[ci_][:, dom_c].T \
+                        + e_mask @ contrib                     # [pc, Gc]
+                    limit_c = (pods.spread_max_skew[ci_]
+                               + min_c[ci_])[None, :]
+                    accept_pc &= jnp.all(
+                        ~gated | (occ + 1.0 <= limit_c + EPS), axis=1)
             if use_anti:
-                # anti-affinity within the step: per group, every trying
-                # MEMBER (selector-matching pod, gated or not) charges
-                # its chosen domain; gated pods are rejected when any
+                # anti-affinity within the step: every trying MEMBER
+                # (selector-matching pod, gated or not) charges its
+                # chosen domain; gated pods are rejected when any
                 # earlier-ranked charge (or an initial count) occupies
-                # it. The per-group loop lets a pod contribute to
-                # several groups' accounting while being gated by only
-                # its own.
+                # it. Same class batching as spread; the per-group
+                # columns let a pod contribute to several groups'
+                # accounting while being gated by only its own.
                 counts_an_now = anti_counts_flat(placed).reshape(
                     n_ag, n_ad)
                 carr_now = anti_carrier_flat(placed).reshape(n_ag, n_ad)
-                for g in range(n_ag):
-                    dom_g = anti_domain_x[g, choice_pc]          # [pc]
-                    has_dom = dom_g >= 0
+                for cls in anti_classes:
+                    ci_ = np.asarray(cls, dtype=np.int32)
+                    dom_g = anti_domain_x[ci_[0], choice_pc]     # [pc]
+                    has_dom = (dom_g >= 0)[:, None]
                     same_d = dom_g[:, None] == dom_g[None, :]
                     e_mask = (same_d & earlier_pc).astype(jnp.float32)
                     dom_c = jnp.maximum(dom_g, 0)
+                    member_c = pods.anti_member[:pc, ci_]
+                    carrier_c = pods.anti_carrier[:pc, ci_]
                     # occupancy of the pod's chosen domain BEFORE it:
                     # carried counts + earlier-ranked in-step charges
                     # (a) matching pods charge; carriers are gated
-                    contrib_a = ((trying_pc & pods.anti_member[:pc, g]
-                                  & has_dom).astype(jnp.float32))
-                    gated_a = trying_pc & pods.anti_carrier[:pc, g] \
-                        & has_dom
-                    occ_a = counts_an_now[g, dom_c] + e_mask @ contrib_a
-                    accept_pc &= (occ_a < 0.5) | ~gated_a
+                    contrib_a = (trying_pc[:, None] & member_c
+                                 & has_dom).astype(jnp.float32)
+                    gated_a = trying_pc[:, None] & carrier_c & has_dom
+                    occ_a = counts_an_now[ci_][:, dom_c].T \
+                        + e_mask @ contrib_a
+                    accept_pc &= jnp.all((occ_a < 0.5) | ~gated_a,
+                                         axis=1)
                     # (b) carriers charge; matching pods are gated
-                    contrib_b = ((trying_pc & pods.anti_carrier[:pc, g]
-                                  & has_dom).astype(jnp.float32))
-                    gated_b = trying_pc & pods.anti_member[:pc, g] \
-                        & has_dom
-                    occ_b_g = carr_now[g, dom_c] + e_mask @ contrib_b
-                    accept_pc &= (occ_b_g < 0.5) | ~gated_b
+                    contrib_b = (trying_pc[:, None] & carrier_c
+                                 & has_dom).astype(jnp.float32)
+                    gated_b = trying_pc[:, None] & member_c & has_dom
+                    occ_b_g = carr_now[ci_][:, dom_c].T \
+                        + e_mask @ contrib_b
+                    accept_pc &= jnp.all((occ_b_g < 0.5) | ~gated_b,
+                                         axis=1)
             if use_aff:
                 # bootstrap cap: attempts into an EMPTY domain of an
                 # empty group are limited to one per group per step —
                 # per carried group, so a pod opening several groups is
-                # capped in each (multi-term pods)
+                # capped in each (multi-term pods). The opener-ordering
+                # mask is the plain earlier matrix (no same-domain
+                # term), so all groups of a class batch into one matmul.
                 counts_af_now = aff_counts_flat(placed).reshape(n_fg,
                                                                 n_fd)
                 total_now = jnp.sum(counts_af_now, axis=1)  # [Fg]
                 e_full = earlier_pc.astype(jnp.float32)
-                for g in range(n_fg):
-                    dom_g = aff_domain_x[g, choice_pc]          # [pc]
-                    cc_now_g = counts_af_now[g, jnp.maximum(dom_g, 0)]
+                for cls in aff_classes:
+                    ci_ = np.asarray(cls, dtype=np.int32)
+                    dom_g = aff_domain_x[ci_[0], choice_pc]      # [pc]
+                    cc_now = counts_af_now[ci_][
+                        :, jnp.maximum(dom_g, 0)].T            # [pc, Gc]
                     # a carried pod trying an EMPTY domain of g is an
                     # opener attempt; it succeeds only when the whole
                     # group is still empty AND no earlier-ranked opener
                     # exists — once g is populated, empty-domain tries
                     # are rejected so the pod falls through (kptr) to
                     # the opened domain
-                    boot_try_g = (trying_pc & pods.aff_carrier[:pc, g]
-                                  & (dom_g >= 0) & (cc_now_g < 0.5))
-                    openers_before = e_full @ boot_try_g.astype(
-                        jnp.float32)                         # [pc]
-                    accept_pc &= ~boot_try_g | (
-                        total_now[g] + openers_before < 0.5)
+                    boot_try = (trying_pc[:, None]
+                                & pods.aff_carrier[:pc, ci_]
+                                & (dom_g >= 0)[:, None]
+                                & (cc_now < 0.5))              # [pc, Gc]
+                    openers_before = e_full @ boot_try.astype(
+                        jnp.float32)                           # [pc, Gc]
+                    accept_pc &= jnp.all(
+                        ~boot_try | (total_now[ci_][None, :]
+                                     + openers_before < 0.5), axis=1)
             if use_spread or use_anti or use_aff:
                 accept = jnp.concatenate([accept_pc, accept[pc:]], axis=0)
 

@@ -505,6 +505,23 @@ def full_gate_pods(num_pods: int, num_nodes: int, seed: int = 1,
         has_taints=True, has_spread=True, has_anti=True, has_aff=True)
 
 
+def dom_classes(pods: PodBatch) -> tuple:
+    """Static domain-class partition for core.schedule_batch: groups
+    whose domain-matrix rows are byte-identical (the upstream
+    topologyKey determines the row, so zone-keyed groups share one row
+    shape and hostname-keyed groups another) share an in-step
+    same-domain mask. Derived from the ACTUAL rows, so the contract
+    (identical rows within a class) holds by construction."""
+    def classes(dom):
+        dom = np.asarray(dom)
+        seen = {}
+        for g in range(dom.shape[0]):
+            seen.setdefault(dom[g].tobytes(), []).append(g)
+        return tuple(tuple(v) for v in seen.values())
+    return (classes(pods.spread_domain), classes(pods.anti_domain),
+            classes(pods.aff_domain))
+
+
 def topo_constrained_mask(pods: PodBatch) -> np.ndarray:
     """bool[P]: pods carrying or matching ANY spread/anti/aff term —
     the rows core.schedule_batch's `topo_prefix` contract requires at

@@ -117,6 +117,51 @@ def test_prefix_equivalence_across_carried_chunks():
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
 
+def test_dom_class_batching_is_bit_identical():
+    """Groups sharing a domain row batch into one per-class matmul;
+    the sums are 0/1 floats so the result must be BIT-identical to the
+    per-group loop (dom_classes=None), with and without the packing
+    prefix."""
+    pods, prefix, _ = _packed_workload(seed=11)
+    classes = synthetic.dom_classes(pods)
+    # the bench workload genuinely exercises multi-group classes
+    assert max(len(c) for fam in classes for c in fam) > 1
+    snap = synthetic.full_gate_cluster(N, seed=6, num_quotas=8,
+                                       num_gangs=8)
+    cfg = LoadAwareConfig.make()
+    batch = synthetic.slice_batch(pods, 0, CHUNK)
+    kw = dict(num_rounds=2, k_choices=8, score_dims=(0, 1),
+              tie_break=True, quota_depth=2, fit_dims=(0, 1, 2, 3),
+              enable_numa=True, enable_devices=True)
+    per_group = core.schedule_batch(snap, batch, cfg, **kw)
+    batched = core.schedule_batch(snap, batch, cfg, dom_classes=classes,
+                                  **kw)
+    both = core.schedule_batch(snap, batch, cfg, dom_classes=classes,
+                               topo_prefix=prefix, **kw)
+    for got in (batched, both):
+        np.testing.assert_array_equal(np.asarray(per_group.assignment),
+                                      np.asarray(got.assignment))
+        np.testing.assert_array_equal(np.asarray(per_group.chosen_score),
+                                      np.asarray(got.chosen_score))
+        for a, b in zip(jax.tree_util.tree_leaves(per_group.snapshot),
+                        jax.tree_util.tree_leaves(got.snapshot)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert int((per_group.assignment >= 0).sum()) > 0
+
+
+def test_dom_classes_must_partition_the_groups():
+    pods, _, _ = _packed_workload()
+    snap = synthetic.full_gate_cluster(N, seed=0, num_quotas=8,
+                                       num_gangs=8)
+    batch = synthetic.slice_batch(pods, 0, CHUNK)
+    bad = (((0, 1),), ((0,),), ((0,),))  # drops groups; must be rejected
+    import pytest
+    with pytest.raises(ValueError, match="partition"):
+        core.schedule_batch(snap, batch, LoadAwareConfig.make(),
+                            dom_classes=bad, enable_numa=True,
+                            enable_devices=True)
+
+
 def test_full_width_default_untouched_by_unpacked_order():
     """topo_prefix=None on an UNPACKED batch (constrained pods anywhere)
     stays the exact reference behavior — the new argument must not
