@@ -152,20 +152,28 @@ def run_northstar(full_gate: bool = False, num_pods: int = None,
     if full_gate:
         pods = synthetic.full_gate_pods(num_pods, num_nodes, seed=1,
                                         num_quotas=32)
-        # constrained-prefix packing: ~17% of the workload carries a
-        # spread/anti/aff term; packing them to a static chunk prefix
-        # shrinks the in-step same-domain [P, P] machinery ~16x
-        # (core.schedule_batch topo_prefix contract)
-        pods, topo_prefix, topo_mask = synthetic.pack_topo_prefix(
-            pods, chunk)
+        # gate-class prefix packing: ~17% of the workload carries a
+        # spread/anti/aff term, ~11% is CPU-bind, ~10% requests
+        # devices; packing each class into a (nested) static chunk
+        # prefix shrinks the per-inner-step [P, P] machinery of the
+        # topology, topology-manager and GPU gates quadratically
+        # (core.schedule_batch topo/numa/gpu prefix contracts)
+        pods, prefixes, masks = synthetic.pack_gate_prefixes(pods, chunk)
+        topo_prefix, topo_mask = prefixes["topo"], masks["topo"]
         make_snap = functools.partial(synthetic.full_gate_cluster,
                                       num_nodes, num_quotas=32)
         metric = metric or "score_bind_100k_pods_10k_nodes_full_gate"
         step_kw = dict(enable_numa=True, enable_devices=True,
                        topo_prefix=topo_prefix,
-                       dom_classes=synthetic.dom_classes(pods))
+                       dom_classes=synthetic.dom_classes(pods),
+                       numa_prefix=prefixes["numa"],
+                       gpu_prefix=prefixes["gpu"])
+        # the numa_prefix contract needs a policy-free snapshot; checked
+        # against the real cluster below (see after make_snap)
+        tail_kw_override = dict(numa_prefix=None, gpu_prefix=None)
     else:
         topo_prefix, topo_mask = None, None
+        tail_kw_override = {}
         pods = synthetic.synthetic_pods(num_pods, seed=1, num_quotas=32)
         make_snap = functools.partial(synthetic.synthetic_cluster,
                                       num_nodes, num_quotas=32)
@@ -192,7 +200,19 @@ def run_northstar(full_gate: bool = False, num_pods: int = None,
         put_snap = jax.device_put
         put_repl = jax.device_put
 
-    snap0 = put_snap(make_snap(seed=0))
+    def checked_snap(seed):
+        """Build a snapshot and enforce the numa_prefix contract on THE
+        snapshot being scheduled (every seed, not just warmup): a
+        policy node would engage pods beyond the prefix whose gates
+        were sliced away."""
+        snap_host = make_snap(seed=seed)
+        if full_gate and step_kw.get("numa_prefix") is not None \
+                and np.asarray(snap_host.nodes.numa_policy).any():
+            raise ValueError("numa_prefix needs a policy-free snapshot "
+                             "(core.schedule_batch contract)")
+        return snap_host
+
+    snap0 = put_snap(checked_snap(0))
     stacked = put_repl(stacked)
     pods_dev = put_repl(pods)
     cfg = put_repl(cfg)
@@ -216,12 +236,15 @@ def run_northstar(full_gate: bool = False, num_pods: int = None,
                              score_dims=(0, 1), approx_topk=approx,
                              tie_break=True, quota_depth=2,
                              fit_dims=(0, 1, 2, 3), **step_kw)
+    # the tail's retry batches are gathered device-side, so only the
+    # topo contract (budgeted selection below) can be re-established
+    # there — the numa/gpu prefixes apply to the host-packed sweep only
     tail_step = functools.partial(core.schedule_batch,
                                   num_rounds=tail_rounds,
                                   k_choices=tail_k, score_dims=(0, 1),
                                   approx_topk=approx, tie_break=True,
                                   quota_depth=2, fit_dims=(0, 1, 2, 3),
-                                  **step_kw)
+                                  **dict(step_kw, **tail_kw_override))
     if topo_mask is not None:
         topo_mask = put_repl(jnp.asarray(topo_mask))
 
@@ -378,7 +401,7 @@ def run_northstar(full_gate: bool = False, num_pods: int = None,
     del out
 
     # timed steady-state pass on a fresh snapshot
-    snap1 = put_snap(make_snap(seed=7))
+    snap1 = put_snap(checked_snap(7))
     counts1 = put_repl(tuple(getattr(pods, f) for f in core.COUNT_FIELDS))
     t0 = time.perf_counter()
     (snap, counts, assign, left_after_sweep, left_final, never_retried,

@@ -108,7 +108,9 @@ class ScheduleResult:
                                              "fit_dims",
                                              "enable_amplification",
                                              "topo_prefix",
-                                             "dom_classes"))
+                                             "dom_classes",
+                                             "numa_prefix",
+                                             "gpu_prefix"))
 def schedule_batch(snap: ClusterSnapshot, pods: PodBatch,
                    cfg: loadaware.LoadAwareConfig,
                    num_rounds: int = 4, k_choices: int = 8,
@@ -123,7 +125,9 @@ def schedule_batch(snap: ClusterSnapshot, pods: PodBatch,
                    fit_dims: tuple = None,
                    enable_amplification: bool = False,
                    topo_prefix: int = None,
-                   dom_classes: tuple = None) -> ScheduleResult:
+                   dom_classes: tuple = None,
+                   numa_prefix: int = None,
+                   gpu_prefix: int = None) -> ScheduleResult:
     """Schedule a pod batch against the snapshot. Pure function; the caller
     publishes `result.snapshot` as the next version (store.update).
 
@@ -157,7 +161,19 @@ def schedule_batch(snap: ClusterSnapshot, pods: PodBatch,
     classes host-side from the actual domain rows
     (synthetic.dom_classes); a class containing groups with UNEQUAL
     rows silently mis-gates. None = every group its own class (the
-    reference per-group behavior)."""
+    reference per-group behavior).
+
+    `numa_prefix` / `gpu_prefix` (static): further packing contracts in
+    the same spirit as topo_prefix (synthetic.pack_gate_prefixes
+    establishes all three at once). numa_prefix: every CPU-bind
+    (numa_single) pod sits below it AND no node in the snapshot carries
+    a topology-manager policy (numa_policy == NONE everywhere — with a
+    policy node, ANY pod choosing it engages the manager and the
+    prefix is invalid; such callers must leave numa_prefix=None).
+    gpu_prefix: every device-requesting pod (deviceshare.
+    has_device_request) sits below it. The per-inner-step topology-
+    manager machinery and zone prefix gates then run on numa_prefix
+    rows, and the GPU instance gates on gpu_prefix rows."""
     nodes0, quotas0, gangs0 = snap.nodes, snap.quotas, snap.gangs
     devices0 = snap.devices
     n_nodes = nodes0.num_nodes
@@ -373,6 +389,19 @@ def schedule_batch(snap: ClusterSnapshot, pods: PodBatch,
     # pc == p (the default) keeps every slice full-width and the tail
     # concatenations zero-size — one code path for both modes
     pc = p if topo_prefix is None else max(min(int(topo_prefix), p), 0)
+    pn = p if numa_prefix is None else max(min(int(numa_prefix), p), 0)
+    pg = p if gpu_prefix is None else max(min(int(gpu_prefix), p), 0)
+
+    def _fit_rows(x, rows, fill):
+        """Slice or pad the leading axis to `rows` (prefix interop:
+        e.g. the numa block consumes per-instance GPU rows computed at
+        the gpu prefix width)."""
+        if x.shape[0] == rows:
+            return x
+        if x.shape[0] > rows:
+            return x[:rows]
+        pad = jnp.full((rows - x.shape[0],) + x.shape[1:], fill, x.dtype)
+        return jnp.concatenate([x, pad], axis=0)
 
     _s_cls, _a_cls, _f_cls = dom_classes if dom_classes is not None \
         else (None, None, None)
@@ -777,78 +806,102 @@ def schedule_batch(snap: ClusterSnapshot, pods: PodBatch,
             # later gate (device, AllocateOnce) never leaves a stale zone/
             # instance charge behind.
             if use_gpu:
+                # per-instance request at the chosen node, computed on
+                # the device-prefix rows; the view slices ONLY the
+                # fields per_instance_at reads (requests, gpu_ratio)
+                pods_pg = pods.replace(requests=pods.requests[:pg],
+                                       gpu_ratio=pods.gpu_ratio[:pg])
                 g_count, g_per = deviceshare.per_instance_at(
-                    devices_x, pods, choice_eff)
+                    devices_x, pods_pg, choice_eff[:pg])  # [pg], [pg, 3]
             if enable_numa:
                 # --- topology manager (frameworkext/topologymanager) ---
                 # Per-pod effective policy: a CPU-bind pod requires single-
                 # numa-node everywhere (incl. on a reservation slot, whose
                 # row holds the reserved zone); otherwise the chosen node's
-                # policy applies (slot rows carry policy none).
-                nc_z = jnp.clip(choice_eff, 0, n_numa_rows - 1)
+                # policy applies (slot rows carry policy none). Under the
+                # numa_prefix contract (no policy nodes; CPU-bind pods
+                # packed below pn) only prefix rows can engage, so the
+                # whole block runs on [pn] rows.
+                choice_pn = choice_eff[:pn]
+                nc_z = jnp.clip(choice_pn, 0, n_numa_rows - 1)
                 eff_policy = jnp.where(
-                    pods.numa_single,
+                    pods.numa_single[:pn],
                     topologymanager.POLICY_SINGLE_NUMA_NODE,
                     numa_policy_x[nc_z])
-                eff_policy = jnp.where(trying, eff_policy, 0)
+                eff_policy = jnp.where(trying[:pn], eff_policy, 0)
                 engaged = eff_policy > topologymanager.POLICY_NONE
                 free_z = jnp.maximum(
                     numa_cap_x[nc_z] - numa_used[nc_z], 0.0)
-                validz = numa_valid_x[nc_z]                  # [P, Z]
-                req2_eff = req2_all * engaged[:, None]
+                validz = numa_valid_x[nc_z]                  # [pn, Z]
+                req2_eff = req2_all[:pn] * engaged[:, None]
                 provider_hints = [topologymanager.capacity_hints(
                     free_z, req2_eff, validz)]
                 if use_gpu:
+                    # gpu rows fitted to the numa width: rows in
+                    # [pg, pn) carry no GPU request by contract, and
+                    # zero-padding reproduces their per_instance_at
+                    # output exactly
                     zcounts = deviceshare.gpu_zone_counts(
-                        gpu_free, devices_x, choice_eff, g_per, n_zones)
+                        gpu_free, devices_x, choice_pn,
+                        _fit_rows(g_per, pn, 0.0), n_zones)
                     provider_hints.append(topologymanager.count_hints(
-                        zcounts, g_count * engaged))
+                        zcounts, _fit_rows(g_count, pn, 0) * engaged))
                 fit_m, pref_m = topologymanager.merge_hints(provider_hints)
                 affinity, admit, _ = topologymanager.resolve(
                     fit_m, pref_m, eff_policy, free_z[..., 0], validz,
                     numa_strategy)
-                accept &= admit
                 numa_take, filled = topologymanager.greedy_take(
                     free_z, req2_eff, affinity, numa_strategy)
-                accept &= ~engaged | filled
+                acc_pn = accept[:pn] & admit & (~engaged | filled)
                 # per-zone capacity prefix gates in priority order (the
                 # same sequential-exactness trick as node capacity, one
-                # [N+V, 2] segment space per zone)
+                # [N+V, 2] segment space per zone; each zone observes
+                # the previous zone's gate, like the full-width loop)
                 for zz in range(n_zones):
-                    znow = accept & engaged
-                    zseg = jnp.where(znow, choice_eff, n_numa_rows)
-                    accept &= segment_prefix_ok(
-                        zseg, earlier, numa_take[:, zz, :] * znow[:, None],
+                    znow = acc_pn & engaged
+                    zseg = jnp.where(znow, choice_pn, n_numa_rows)
+                    acc_pn &= segment_prefix_ok(
+                        zseg, earlier[:pn, :pn],
+                        numa_take[:, zz, :] * znow[:, None],
                         numa_used[:, zz, :], numa_cap_x[:, zz, :],
                         n_numa_rows)
+                accept = jnp.concatenate([acc_pn, accept[pn:]], axis=0)
 
             if use_gpu:
                 # --- GPU instance gates (deviceshare allocateDevices) ---
                 # choice_eff indexes the EXTENDED instance pool: node rows
                 # are the open per-instance free, slot rows the remaining
-                # reserved holds — consumers take reserved minors here
+                # reserved holds — consumers take reserved minors here.
+                # Under the gpu_prefix contract every device-requesting
+                # pod sits below pg, so the whole block runs on [pg]
+                # rows (non-device rows beyond are vacuously accepted).
+                choice_pg = choice_eff[:pg]
                 shared = g_count == 1
                 multi = g_count > 1
                 # with NUMA modeling off, the zone constraint is dropped
-                # (not tightened against a sentinel mask)
+                # (not tightened against a sentinel mask); rows padded
+                # past the numa width carry no policy (all-open mask)
                 if enable_numa:
-                    zone_mask_dev, dev_engaged = affinity, engaged
+                    zone_mask_dev = _fit_rows(affinity, pg, True)
+                    dev_engaged = _fit_rows(engaged, pg, False)
                 else:
-                    zone_mask_dev = jnp.ones((p, 1), bool)
-                    dev_engaged = jnp.zeros((p,), bool)
+                    zone_mask_dev = jnp.ones((pg, 1), bool)
+                    dev_engaged = jnp.zeros((pg,), bool)
                 inst, inst_ok = deviceshare.choose_gpu_instance(
-                    gpu_free, devices_x, choice_eff, g_per, shared,
+                    gpu_free, devices_x, choice_pg, g_per, shared,
                     zone_mask_dev, dev_engaged, device_strategy)
-                accept &= ~shared | inst_ok
-                gseg = jnp.where(accept & shared,
-                                 choice_eff * n_inst + inst,
+                acc_pg = accept[:pg]
+                acc_pg &= ~shared | inst_ok
+                gseg = jnp.where(acc_pg & shared,
+                                 choice_pg * n_inst + inst,
                                  n_gpu_rows * n_inst)
-                greq = g_per * (accept & shared)[:, None]
+                greq = g_per * (acc_pg & shared)[:, None]
                 gpu_free_flat = gpu_free.reshape(-1, NUM_DEV_DIMS)
-                accept &= segment_prefix_ok(
-                    gseg, earlier, greq, jnp.zeros_like(gpu_free_flat),
+                acc_pg &= segment_prefix_ok(
+                    gseg, earlier[:pg, :pg], greq,
+                    jnp.zeros_like(gpu_free_flat),
                     gpu_free_flat, n_gpu_rows * n_inst)
-                took_shared = accept & shared
+                took_shared = acc_pg & shared
                 # multi-GPU (whole instances): one winner per node per inner
                 # step keeps lowest-index instance identity unambiguous;
                 # contenders fall through to the next step/round. Instances
@@ -857,20 +910,21 @@ def schedule_batch(snap: ClusterSnapshot, pods: PodBatch,
                 # recovered at chunk size 1).
                 shared_taken_now = jnp.zeros(
                     (n_gpu_rows * n_inst + 1,), bool).at[
-                        jnp.where(took_shared, choice_eff * n_inst + inst,
+                        jnp.where(took_shared, choice_pg * n_inst + inst,
                                   n_gpu_rows * n_inst)].set(True)[:-1]
-                nc = jnp.clip(choice_eff, 0, n_gpu_rows - 1)
+                nc = jnp.clip(choice_pg, 0, n_gpu_rows - 1)
                 take, enough = deviceshare.full_fit_instances(
-                    gpu_free, devices_x, choice_eff, g_per, g_count,
+                    gpu_free, devices_x, choice_pg, g_per, g_count,
                     zone_mask_dev, dev_engaged,
                     exclude=shared_taken_now.reshape(n_gpu_rows,
                                                      n_inst)[nc])
-                same_node = choice_eff[:, None] == choice_eff[None, :]
-                multi_cand = multi & accept
-                first_multi = ~jnp.any(earlier & same_node
+                same_node = choice_pg[:, None] == choice_pg[None, :]
+                multi_cand = multi & acc_pg
+                first_multi = ~jnp.any(earlier[:pg, :pg] & same_node
                                        & multi_cand[None, :], axis=-1)
-                accept = jnp.where(multi, accept & first_multi & enough,
-                                   accept)
+                acc_pg = jnp.where(multi, acc_pg & first_multi & enough,
+                                   acc_pg)
+                accept = jnp.concatenate([acc_pg, accept[pg:]], axis=0)
 
             if use_aux:
                 # --- aux (rdma/fpga) VF gates (default device handler) ---
@@ -907,35 +961,42 @@ def schedule_batch(snap: ClusterSnapshot, pods: PodBatch,
                         True, mode="drop")
 
             # scatter-commit (assume; scheduler_adapter assume/forget) —
-            # accept is final from here on
+            # accept is final from here on; the NUMA/GPU commits read
+            # and write only their prefix rows (engaged and device pods
+            # live there by contract)
             if enable_numa:
-                took_z = accept & engaged
+                took_z = accept[:pn] & engaged
                 numa_used = numa_used.at[
-                    jnp.where(took_z, choice_eff, n_numa_rows)].add(
+                    jnp.where(took_z, choice_pn, n_numa_rows)].add(
                         numa_take * took_z[:, None, None], mode="drop")
-                out_take = jnp.where(took_z[:, None, None], numa_take,
-                                     out_take)
+                out_take = jnp.concatenate(
+                    [jnp.where(took_z[:, None, None], numa_take,
+                               out_take[:pn]), out_take[pn:]], axis=0)
                 # reported zone: the single zone for CPU-bind pods (feeds
                 # the resource-status annotation)
                 zone1 = jnp.argmax(affinity, axis=-1).astype(jnp.int32)
-                out_zone = jnp.where(took_z & pods.numa_single, zone1,
-                                     out_zone)
+                out_zone = jnp.concatenate(
+                    [jnp.where(took_z & pods.numa_single[:pn], zone1,
+                               out_zone[:pn]), out_zone[pn:]], axis=0)
             if use_gpu:
-                took_shared = accept & shared
-                gseg = jnp.where(took_shared, choice_eff * n_inst + inst,
+                took_shared = accept[:pg] & shared
+                gseg = jnp.where(took_shared, choice_pg * n_inst + inst,
                                  n_gpu_rows * n_inst)
                 gpu_free = gpu_free.reshape(-1, NUM_DEV_DIMS).at[gseg].add(
                     -g_per * took_shared[:, None],
                     mode="drop").reshape(gpu_free.shape)
-                took_multi = accept & multi
+                took_multi = accept[:pg] & multi
                 g_upd = (take[:, :, None] * g_per[:, None, :]
                          * took_multi[:, None, None])
-                g_tgt = jnp.where(took_multi, choice_eff, n_gpu_rows)
+                g_tgt = jnp.where(took_multi, choice_pg, n_gpu_rows)
                 gpu_free = gpu_free.at[g_tgt].add(-g_upd, mode="drop")
                 inst_onehot = (jnp.arange(n_inst, dtype=jnp.int32)[None, :]
                                == inst[:, None])
-                out_gpu_take |= (inst_onehot & took_shared[:, None]) | \
-                    (take & took_multi[:, None])
+                out_gpu_take = jnp.concatenate(
+                    [out_gpu_take[:pg]
+                     | (inst_onehot & took_shared[:, None])
+                     | (take & took_multi[:, None]),
+                     out_gpu_take[pg:]], axis=0)
             if use_aux:
                 aux_free_flat = aux_free.reshape(-1, 1)
                 for t in range(NUM_AUX_TYPES):

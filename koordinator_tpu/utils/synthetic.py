@@ -538,10 +538,10 @@ def topo_constrained_mask(pods: PodBatch) -> np.ndarray:
 
 def pack_topo_prefix(pods: PodBatch, chunk: int,
                      align: int = 128) -> tuple:
-    """Reorder pods WITHIN each chunk so every topology-constrained pod
-    (spread/anti/aff member or carrier) sits in a chunk-prefix, and
-    return `(packed_pods, topo_prefix, constrained_mask)` satisfying
-    core.schedule_batch's packing contract.
+    """Topology-class view of pack_gate_prefixes (one packing
+    mechanism, one contract implementation): returns
+    `(packed_pods, topo_prefix, constrained_mask)` satisfying
+    core.schedule_batch's topo_prefix packing contract.
 
     On constraint-sparse workloads (the upstream norm: most pods carry
     no inter-pod term) this shrinks the scheduler's in-step same-domain
@@ -549,34 +549,66 @@ def pack_topo_prefix(pods: PodBatch, chunk: int,
     price of a stable in-chunk reorder. Queue semantics are unaffected:
     schedule_batch ranks by (priority desc, index asc), so the reorder
     only permutes tie-breaks among equal-priority pods, exactly like
-    any other arrival order of the same queue. `topo_prefix` is the max
-    per-chunk constrained count rounded up to `align` rows (MXU lane
-    granularity), clamped to the chunk size; the returned mask is in
+    any other arrival order of the same queue. The returned mask is in
     PACKED order (the bench tail uses it to keep retry batches inside
     the contract)."""
+    packed, prefixes, masks = pack_gate_prefixes(pods, chunk,
+                                                 align=align)
+    return packed, prefixes["topo"], masks["topo"]
+
+
+def pack_gate_prefixes(pods: PodBatch, chunk: int,
+                       align: int = 128) -> tuple:
+    """Pack THREE gate classes into nested chunk prefixes and return
+    `(packed_pods, prefixes, masks)` with `prefixes`/`masks` dicts
+    keyed "topo" / "numa" / "gpu" satisfying the corresponding
+    schedule_batch packing contracts (topo_prefix / numa_prefix /
+    gpu_prefix).
+
+    Pods sort within each chunk by (topo, numa, gpu) descending
+    membership (stable), giving segment order [T..][N..][G..][rest]:
+    every topo pod precedes every non-topo pod, every numa pod every
+    (non-topo, non-numa) pod, and so on — so the three prefixes nest
+    (topo <= numa <= gpu) and each class is fully covered by its own
+    prefix. Classes: topo = any spread/anti/aff term (the
+    topo_constrained_mask), numa = CPU-bind (numa_single), gpu = any
+    device request (deviceshare.has_device_request). The numa_prefix
+    contract ALSO requires a policy-free snapshot — that part is the
+    caller's to assert (bench does), since the packer never sees
+    nodes."""
+    from koordinator_tpu.scheduler.plugins import deviceshare
+
     p = pods.valid.shape[0]
     if p % chunk:
         raise ValueError(f"{p} pods not divisible by chunk {chunk}")
-    constrained = topo_constrained_mask(pods)
+    topo = topo_constrained_mask(pods)
+    numa = np.asarray(pods.numa_single, bool)
+    gpu = np.asarray(deviceshare.has_device_request(pods), bool)
     perm = np.empty((p,), np.int64)
-    worst = 0
+    worst = {"topo": 0, "numa": 0, "gpu": 0}
     for s in range(0, p, chunk):
-        cons = constrained[s:s + chunk]
-        order = np.argsort(~cons, kind="stable")
-        perm[s:s + chunk] = s + order
-        worst = max(worst, int(cons.sum()))
-    prefix = min(-(-worst // align) * align, chunk)
+        t = topo[s:s + chunk]
+        n = t | numa[s:s + chunk]
+        g = n | gpu[s:s + chunk]
+        # lexsort: last key is primary; stable within equal keys
+        perm[s:s + chunk] = s + np.lexsort((~g, ~n, ~t))
+        worst["topo"] = max(worst["topo"], int(t.sum()))
+        worst["numa"] = max(worst["numa"], int(n.sum()))
+        worst["gpu"] = max(worst["gpu"], int(g.sum()))
+    prefixes = {k: min(-(-v // align) * align, chunk)
+                for k, v in worst.items()}
     packed = pods.replace(**{f: np.asarray(getattr(pods, f))[perm]
                              for f in PER_POD_FIELDS})
-    packed_mask = constrained[perm]
-    # the contract the scheduler relies on (cheap host-side check; a
-    # real raise, not an assert — the scheduler silently miscomputes on
-    # violation, so -O must not strip this)
-    for s in range(0, p, chunk):
-        if packed_mask[s + prefix:s + chunk].any():
-            raise ValueError(
-                "pack_topo_prefix: constrained pod escaped the prefix")
-    return packed, prefix, packed_mask
+    masks = {"topo": topo[perm], "numa": numa[perm], "gpu": gpu[perm]}
+    # the contracts the scheduler relies on (real raises: silent
+    # miscomputation on violation, so -O must not strip these)
+    for key in ("topo", "numa", "gpu"):
+        m, pref = masks[key], prefixes[key]
+        for s in range(0, p, chunk):
+            if m[s + pref:s + chunk].any():
+                raise ValueError(
+                    f"pack_gate_prefixes: {key} pod escaped its prefix")
+    return packed, prefixes, masks
 
 
 def stack_pod_chunks(pods: PodBatch, chunk: int) -> dict:

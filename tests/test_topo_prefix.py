@@ -162,6 +162,60 @@ def test_dom_classes_must_partition_the_groups():
                             enable_devices=True)
 
 
+def test_gate_prefixes_nest_and_cover_their_classes():
+    pods = synthetic.full_gate_pods(P, N, seed=13, num_quotas=8,
+                                    num_gangs=8)
+    packed, prefixes, masks = synthetic.pack_gate_prefixes(pods, CHUNK)
+    assert prefixes["topo"] <= prefixes["numa"] <= prefixes["gpu"]
+    for key in ("topo", "numa", "gpu"):
+        assert prefixes[key] % 128 == 0 or prefixes[key] == CHUNK
+        m = masks[key]
+        for s in range(0, P, CHUNK):
+            assert not m[s + prefixes[key]:s + CHUNK].any()
+    np.testing.assert_array_equal(
+        masks["topo"], synthetic.topo_constrained_mask(packed))
+    np.testing.assert_array_equal(masks["numa"],
+                                  np.asarray(packed.numa_single))
+    from koordinator_tpu.scheduler.plugins import deviceshare
+    np.testing.assert_array_equal(
+        masks["gpu"], np.asarray(deviceshare.has_device_request(packed)))
+
+
+def test_numa_gpu_prefixes_are_bit_identical_to_full_width():
+    """The three packing contracts together: same packed chunk with and
+    without numa/gpu prefixes (plus topo + classes) must agree bit for
+    bit — including zone takes, GPU instance identity, and the
+    post-commit snapshot."""
+    pods = synthetic.full_gate_pods(P, N, seed=17, num_quotas=8,
+                                    num_gangs=8)
+    packed, prefixes, _ = synthetic.pack_gate_prefixes(pods, CHUNK)
+    classes = synthetic.dom_classes(packed)
+    snap = synthetic.full_gate_cluster(N, seed=8, num_quotas=8,
+                                       num_gangs=8)
+    assert not np.asarray(snap.nodes.numa_policy).any()  # contract
+    cfg = LoadAwareConfig.make()
+    batch = synthetic.slice_batch(packed, 0, CHUNK)
+    kw = dict(num_rounds=2, k_choices=8, score_dims=(0, 1),
+              tie_break=True, quota_depth=2, fit_dims=(0, 1, 2, 3),
+              enable_numa=True, enable_devices=True,
+              topo_prefix=prefixes["topo"], dom_classes=classes)
+    full = core.schedule_batch(snap, batch, cfg, **kw)
+    pref = core.schedule_batch(snap, batch, cfg,
+                               numa_prefix=prefixes["numa"],
+                               gpu_prefix=prefixes["gpu"], **kw)
+    for field in ("assignment", "chosen_score", "numa_zone", "numa_take",
+                  "gpu_take", "aux_inst", "res_slot"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(full, field)),
+            np.asarray(getattr(pref, field)), err_msg=field)
+    for a, b in zip(jax.tree_util.tree_leaves(full.snapshot),
+                    jax.tree_util.tree_leaves(pref.snapshot)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    # the workload must actually exercise the gates being sliced
+    assert int((np.asarray(full.numa_zone) >= 0).sum()) > 0
+    assert bool(np.asarray(full.gpu_take).any())
+
+
 def test_full_width_default_untouched_by_unpacked_order():
     """topo_prefix=None on an UNPACKED batch (constrained pods anywhere)
     stays the exact reference behavior — the new argument must not
