@@ -31,6 +31,10 @@ from koordinator_tpu.snapshot.schema import (
 
 R = NUM_RESOURCES
 CPU, MEM = int(ResourceKind.CPU), int(ResourceKind.MEMORY)
+
+# live reservation slot hold (synthetic_cluster num_reservations > 0);
+# module-level so full_gate_pods can sample owners that actually FIT
+RESV_SLOT_CPU, RESV_SLOT_MEM = 4000.0, 8192.0
 BCPU, BMEM = int(ResourceKind.BATCH_CPU), int(ResourceKind.BATCH_MEMORY)
 
 
@@ -69,6 +73,7 @@ def synthetic_cluster(num_nodes: int, seed: int = 0,
                       gpu_node_frac: float = 0.0,
                       gpus_per_node: int = 8,
                       gpu_memory_mib: float = 81920.0,
+                      num_reservations: int = 0,
                       now_version: int = 0) -> ClusterSnapshot:
     """A realistic colocation cluster: heterogeneous nodes, fresh
     NodeMetrics, batch-tier overcommit resources, a two-level quota tree,
@@ -162,16 +167,41 @@ def synthetic_cluster(num_nodes: int, seed: int = 0,
         valid=np.arange(g) < num_gangs,
     )
     n_inst = gpus_per_node if gpu_node_frac > 0 else 0
+    # Reservation slots: 0 by default — the slim workloads never
+    # consume reservations, and a ZERO-length slot axis compiles the
+    # virtual-node columns and the AllocateOnce [P, P] ordering
+    # machinery OUT of their programs (the previous fixed 8 invalid
+    # slots cost a full-width inner-step op for nothing). The FULL-gate
+    # cluster requests LIVE slots instead (num_reservations > 0):
+    # valid, node-hosted, owner-restricted holds whose capacity is
+    # charged on the hosting node (restore semantics — consumers draw
+    # from the slot, not the node's open pool), so the flagship
+    # exercises the reservation gate semantically, not as dead weight.
+    v = int(num_reservations)
+    if v > n:
+        raise ValueError(f"num_reservations={v} needs at least that many "
+                         f"nodes; got {n}")
+    r_nodes = np.full((v,), -1, np.int32)
+    r_free = np.zeros((v, R), f32)
+    if v:
+        rrng = np.random.default_rng(seed + 41)
+        r_nodes = rrng.choice(n, v, replace=False).astype(np.int32)
+        r_free[:, CPU] = RESV_SLOT_CPU
+        r_free[:, MEM] = RESV_SLOT_MEM
+        req = nodes.requested.copy()
+        req[r_nodes, CPU] += RESV_SLOT_CPU
+        req[r_nodes, MEM] += RESV_SLOT_MEM
+        nodes = nodes.replace(requested=req)
     reservations = ReservationState(
-        node=np.full((8,), -1, np.int32),
-        free=np.zeros((8, R), f32),
-        owner_group=np.full((8,), -1, np.int32),
-        allocate_once=np.ones((8,), bool),
-        valid=np.zeros((8,), bool),
-        gpu_free=np.zeros((8, n_inst, NUM_DEV_DIMS), f32),
-        gpu_valid=np.zeros((8, n_inst), bool),
-        numa_free=np.zeros((8, 4, 2), f32),
-        numa_valid=np.zeros((8, 4), bool),
+        node=r_nodes,
+        free=r_free,
+        owner_group=np.arange(v, dtype=np.int32),
+        allocate_once=(np.arange(v) % 2 == 0),
+        valid=np.ones((v,), bool),
+        gpu_free=np.zeros((v, n_inst, NUM_DEV_DIMS), f32),
+        gpu_valid=np.zeros((v, n_inst), bool),
+        numa_free=np.zeros((v, 4, 2), f32),
+        numa_valid=np.zeros((v, 4), bool),
     )
     if gpu_node_frac > 0:
         i = gpus_per_node
@@ -329,22 +359,32 @@ def with_two_numa_zones(snap: ClusterSnapshot) -> ClusterSnapshot:
             numa_valid=np.asarray(resv.numa_valid)[:, :z]))
 
 
+def full_gate_reservations(num_nodes: int) -> int:
+    """Live-slot count shared by full_gate_cluster and full_gate_pods
+    (owner ids must line up with slot owner_groups)."""
+    return min(64, num_nodes // 2)
+
+
 def full_gate_cluster(num_nodes: int, seed: int = 0,
                       num_quotas: int = 32, max_quotas: int = 64,
                       num_gangs: int = 64, max_gangs: int = 64,
                       gpu_node_frac: float = 0.25,
-                      gpus_per_node: int = 8) -> ClusterSnapshot:
+                      gpus_per_node: int = 8,
+                      num_reservations: int = None) -> ClusterSnapshot:
     """The FULL-gate flagship cluster: everything the slim bench cluster
     has, plus two populated NUMA zones per node, GPU nodes with
     per-instance pools, and a 3-class taint landscape (none/dedicated/
     gpu-exclusive). The reference's hot loop runs every registered
     plugin for every pod (framework_extender.go:204-259); this workload
     makes the batched program compile every gate in."""
+    if num_reservations is None:
+        num_reservations = full_gate_reservations(num_nodes)
     snap = synthetic_cluster(num_nodes, seed=seed, num_quotas=num_quotas,
                              max_quotas=max_quotas, num_gangs=num_gangs,
                              max_gangs=max_gangs,
                              gpu_node_frac=gpu_node_frac,
-                             gpus_per_node=gpus_per_node)
+                             gpus_per_node=gpus_per_node,
+                             num_reservations=num_reservations)
     snap = with_two_numa_zones(snap)
     rng = np.random.default_rng(seed + 17)
     # taint classes: 0 = untainted, 1 = dedicated, 2 = gpu-exclusive
@@ -361,8 +401,8 @@ def full_gate_pods(num_pods: int, num_nodes: int, seed: int = 1,
                    n_spread_groups: int = 8, spread_frac: float = 0.15,
                    max_skew: float = 64.0,
                    n_anti_groups: int = 16, anti_members: int = 64,
-                   n_aff_groups: int = 8, aff_members: int = 48
-                   ) -> PodBatch:
+                   n_aff_groups: int = 8, aff_members: int = 48,
+                   num_reservations: int = None) -> PodBatch:
     """The FULL-gate flagship workload: quota + gang pods plus NUMA-bound
     prod pods, GPU pods, three toleration classes, PodTopologySpread
     groups over zone domains, required anti-affinity over hostname
@@ -487,8 +527,35 @@ def full_gate_pods(num_pods: int, num_nodes: int, seed: int = 1,
         aff_carrier[dual, g - 1] = True
     aff_count0 = np.zeros((n_aff_groups, num_zones), f32)
 
+    # reservation owners: two pods compete for each live slot of the
+    # full-gate cluster (num_reservations defaults to the shared
+    # full_gate_reservations formula so owner ids line up with slot
+    # owner_groups) — the AllocateOnce single-winner ordering and the
+    # slot virtual-node columns run against real consumers, not dead
+    # weight. Owners are sampled from pods that can actually FIT the
+    # slot hold: requests within (RESV_SLOT_CPU, RESV_SLOT_MEM) on the
+    # prod dims and zero elsewhere (excludes batch-tier, device and
+    # CPU-bind pods — the slots carry no zone/instance holds).
+    from koordinator_tpu.scheduler.plugins import deviceshare
+    v = full_gate_reservations(num_nodes) if num_reservations is None \
+        else int(num_reservations)
+    resv_owner = np.full((p,), -1, np.int32)
+    if v:
+        reqs = np.asarray(pods.requests)
+        slot_free = np.zeros((reqs.shape[1],), np.float32)
+        slot_free[CPU], slot_free[MEM] = RESV_SLOT_CPU, RESV_SLOT_MEM
+        fits_slot = (reqs <= slot_free[None, :]).all(axis=1)
+        plain = np.flatnonzero(
+            fits_slot & ~np.asarray(deviceshare.has_device_request(pods))
+            & ~numa_single)
+        owners = rng.choice(plain, min(2 * v, plain.size),
+                            replace=False)
+        resv_owner[owners] = (np.arange(owners.size) % v).astype(
+            np.int32)
+
     return pods.replace(
         numa_single=numa_single,
+        reservation_owner=resv_owner,
         toleration_id=toleration_id, tol_forbid=tol_forbid,
         tol_prefer=tol_prefer,
         spread_id=spread_id, spread_carrier=spread_carrier,
