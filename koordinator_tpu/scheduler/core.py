@@ -68,6 +68,12 @@ from koordinator_tpu.snapshot.schema import (
 )
 
 
+# ScheduleResult fields indexed by pod row — a caller that reorders the
+# batch (prefix packing) must inverse-permute exactly these
+PER_POD_RESULT_FIELDS = ("assignment", "chosen_score", "numa_zone",
+                         "numa_take", "gpu_take", "aux_inst", "res_slot")
+
+
 @flax.struct.dataclass
 class ScheduleResult:
     assignment: jnp.ndarray      # i32[P] node index, -1 = unschedulable

@@ -666,7 +666,13 @@ def pack_gate_prefixes(pods: PodBatch, chunk: int,
                 for k, v in worst.items()}
     packed = pods.replace(**{f: np.asarray(getattr(pods, f))[perm]
                              for f in PER_POD_FIELDS})
-    masks = {"topo": topo[perm], "numa": numa[perm], "gpu": gpu[perm]}
+    masks = {"topo": topo[perm], "numa": numa[perm], "gpu": gpu[perm],
+             # the applied permutation: packed[i] == pods[perm[i]], so
+             # original_row = perm[packed_row]; callers mapping per-pod
+             # RESULTS back to the caller's order index with the
+             # INVERSE permutation (inv[perm] = arange; the service
+             # path does exactly this)
+             "perm": perm}
     # the contracts the scheduler relies on (real raises: silent
     # miscomputation on violation, so -O must not strip these)
     for key in ("topo", "numa", "gpu"):

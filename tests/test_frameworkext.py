@@ -266,3 +266,78 @@ def test_debug_filter_table_covers_topology_gates():
     t3 = debug_filter_table(snap, b.build_pod_batch(pods3, ctx),
                             LoadAwareConfig.make(), pod_names=["r"])
     assert "InterPodAffinity:-" in t3 and "fit:0/3" in t3
+
+
+# --- auto-pack (batching-layer specializations on the service path) ---------
+
+
+def test_service_auto_pack_returns_results_in_caller_order():
+    """The service derives dom_classes + prefix packing per batch and
+    must hand every per-pod result array back in the CALLER's pod
+    order: pods with distinguishable outcomes (impossible requests,
+    reservation owners, NUMA binds) keep those outcomes at their
+    original rows."""
+    n, p = 256, 1024
+    service = SchedulerService(num_rounds=2, k_choices=4)
+    snap = synthetic.full_gate_cluster(n, num_quotas=8, num_gangs=8)
+    service.publish(snap)
+    pods = synthetic.full_gate_pods(p, n, seed=33, num_quotas=8,
+                                    num_gangs=8)
+    # pin sentinel rows at known ORIGINAL indices (unpacked order):
+    # scattered impossible pods that packing will reorder
+    reqs = np.asarray(pods.requests).copy()
+    impossible = np.array([5, 300, 777, 1000])
+    reqs[impossible] = 1e9
+    pods = pods.replace(requests=reqs)
+    res = service.schedule(pods)
+    a = np.asarray(res.assignment)
+    assert (a[impossible] == -1).all(), \
+        "impossible pods must be unschedulable at their ORIGINAL rows"
+    placed = int((a >= 0).sum())
+    assert placed > 0
+    # reservation consumption reported at the owners' original rows
+    slot = np.asarray(res.res_slot)
+    owner = np.asarray(pods.reservation_owner)
+    assert (slot[owner < 0] < 0).all(), \
+        "non-owner rows must never report a consumed slot"
+    if (slot >= 0).any():
+        rows = np.flatnonzero(slot >= 0)
+        assert (owner[rows] == slot[rows]).all(), \
+            "consumed slot ids must match the owner ids at those rows"
+    # NUMA zone reports land on CPU-bind rows only
+    zone = np.asarray(res.numa_zone)
+    assert (zone[~np.asarray(pods.numa_single)] < 0).all()
+
+
+def test_service_auto_pack_matches_unpacked_on_uncontended_cluster():
+    """With ample capacity both configurations place every valid pod;
+    auto_pack must not change that (only tie-breaks may differ)."""
+    n, p = 512, 1024
+    pods = synthetic.full_gate_pods(p, n, seed=41, num_quotas=8,
+                                    num_gangs=8)
+    results = {}
+    for auto in (True, False):
+        service = SchedulerService(num_rounds=2, k_choices=8,
+                                   auto_pack=auto)
+        service.publish(synthetic.full_gate_cluster(
+            n, num_quotas=8, num_gangs=8))
+        res = service.schedule(pods)
+        results[auto] = np.asarray(res.assignment)
+    placed_on = int((results[True] >= 0).sum())
+    placed_off = int((results[False] >= 0).sum())
+    # tight contention-free bound: the two programs may break ties
+    # differently but must place essentially the same pod set
+    assert abs(placed_on - placed_off) <= p // 100, (placed_on,
+                                                    placed_off)
+    assert placed_on > p // 2
+
+
+def test_service_auto_pack_skips_small_batches():
+    service = SchedulerService(num_rounds=1, k_choices=4)
+    snap = synthetic.full_gate_cluster(64, num_quotas=4, num_gangs=4)
+    pods = synthetic.full_gate_pods(256, 64, seed=3, num_quotas=4,
+                                    num_gangs=4)
+    packed, kwargs, inv = service._prepare_batch(snap, pods)
+    assert inv is None  # below AUTO_PACK_MIN_BATCH: no reorder
+    assert "dom_classes" in kwargs  # classes are free — always derived
+    assert packed is pods
