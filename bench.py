@@ -245,6 +245,24 @@ def run_northstar(full_gate: bool = False, num_pods: int = None,
                                   approx_topk=approx, tie_break=True,
                                   quota_depth=2, fit_dims=(0, 1, 2, 3),
                                   **dict(step_kw, **tail_kw_override))
+    # tail retry width, decoupled from the sweep chunk: stragglers
+    # don't need a sweep-wide retry program (the [P, P] prefix
+    # machinery scales quadratically with this width); smaller widths
+    # trade more adaptive passes (one readback each) for much cheaper
+    # passes. FULL-GATE defaults to 512 — the heavy gate set makes a
+    # 2000-wide pass ~16x the cost of a 512-wide one (20k x 2k CPU:
+    # 9.1 s -> 5.8 s) — while the slim canonical keeps the sweep-chunk
+    # width (the recorded protocol; a non-default width is stamped
+    # into the emitted line as a knob either way).
+    default_tail = min(chunk, 512) if full_gate else chunk
+    tail_chunk = max(min(int(os.environ.get("BENCH_TAIL_CHUNK",
+                                            default_tail)),
+                         num_pods), 1)
+    # the narrower full-gate tail needs more adaptive passes to cover
+    # the same straggler pool (3160 at the 100k capture > 6 x 512);
+    # an explicit BENCH_MAX_TAIL_PASSES still wins
+    max_tail = MAX_TAIL_PASSES if os.environ.get("BENCH_MAX_TAIL_PASSES") \
+        else (max(MAX_TAIL_PASSES, 10) if full_gate else MAX_TAIL_PASSES)
     if topo_mask is not None:
         topo_mask = put_repl(jnp.asarray(topo_mask))
 
@@ -321,10 +339,10 @@ def run_northstar(full_gate: bool = False, num_pods: int = None,
                                     jnp.where(bad & ~topo_mask, 3,
                                               jnp.where(bad, 4, 5)))))
         order = jnp.argsort(key, stable=True)
-        idx = order[:chunk]
+        idx = order[:tail_chunk]
         attempt = bad[idx]
         if topo_prefix is not None:
-            in_prefix = jnp.arange(chunk) < topo_prefix
+            in_prefix = jnp.arange(tail_chunk) < topo_prefix
             attempt &= ~topo_mask[idx] | in_prefix
         retry = with_counts(
             pods_dev.replace(
@@ -363,7 +381,7 @@ def run_northstar(full_gate: bool = False, num_pods: int = None,
         passes = 0
         # the mandatory passes honor the MAX cap too (BENCH_MAX_TAIL_PASSES
         # below MIN is a legitimate quick-run knob)
-        for _ in range(min(MIN_TAIL_PASSES, MAX_TAIL_PASSES)):
+        for _ in range(min(MIN_TAIL_PASSES, max_tail)):
             snap, counts, assign, tried = tail_pass(
                 snap, counts, assign, tried, pods_dev, cfg)
             passes += 1
@@ -382,7 +400,7 @@ def run_northstar(full_gate: bool = False, num_pods: int = None,
         # (never-retried) windows remain — a pass that placed nothing
         # must not strand disjoint windows that were never tried. Only
         # the MAX cap can leave never_retried > 0.
-        while (passes < MAX_TAIL_PASSES and left > 0
+        while (passes < max_tail and left > 0
                and (improved or never_retried > 0)):
             snap, counts, assign, tried = tail_pass(
                 snap, counts, assign, tried, pods_dev, cfg)
@@ -414,7 +432,7 @@ def run_northstar(full_gate: bool = False, num_pods: int = None,
         # adaptive loop gives up — surface any that never did
         print(f"bench: WARNING: {never_retried} stragglers were never "
               f"retried after {passes} adaptive tail passes "
-              f"(chunk={chunk}); raise BENCH_MAX_TAIL_PASSES",
+              f"(tail_chunk={tail_chunk}); raise BENCH_MAX_TAIL_PASSES",
               file=sys.stderr)
     # non-default shape knobs are stamped into the line: a sweep run
     # must never be mistaken for the canonical protocol (the module
@@ -423,7 +441,11 @@ def run_northstar(full_gate: bool = False, num_pods: int = None,
     knob_tags = {}
     for name, val, default in (("rounds", rounds, 2), ("k", kch, 8),
                                ("tail_rounds", tail_rounds, 4),
-                               ("tail_k", tail_k, 32)):
+                               ("tail_k", tail_k, 32),
+                               ("tail_chunk", tail_chunk, default_tail),
+                               # 2000 is the PROTOCOL chunk (BASELINE);
+                               # smoke/sweep shapes stamp their width
+                               ("chunk", chunk, 2000)):
         if val != default:
             knob_tags[name] = val
     result = {

@@ -35,8 +35,15 @@ CHUNK = 2_000
 def time_sweep(tag, pods, step_kw, slim=False, pack=False):
     cfg = LoadAwareConfig.make()
     if pack:
-        pods, prefix, _ = synthetic.pack_topo_prefix(pods, CHUNK)
-        step_kw = dict(step_kw, topo_prefix=prefix)
+        # mirror the bench full-gate configuration: all three nested
+        # prefixes + domain classes
+        pods, prefixes, _ = synthetic.pack_gate_prefixes(pods, CHUNK)
+        step_kw = dict(step_kw, topo_prefix=prefixes["topo"],
+                       dom_classes=synthetic.dom_classes(pods))
+        if step_kw.get("enable_numa", True):
+            step_kw["numa_prefix"] = prefixes["numa"]
+        if step_kw.get("enable_devices", True):
+            step_kw["gpu_prefix"] = prefixes["gpu"]
     stacked = synthetic.stack_pod_chunks(pods, CHUNK)
     snap = jax.device_put(synthetic.full_gate_cluster(N, num_quotas=32,
                                                       seed=0))

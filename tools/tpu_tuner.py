@@ -101,6 +101,11 @@ def main():
     run_exp("fg_chunk500", fg, {"BENCH_FULL_CHUNK": "500"}, 2400)
     run_exp("fg_rounds1", fg, {"BENCH_ROUNDS": "1", "BENCH_K": "16"},
             2400)
+    run_exp("fg_tailwide2000", fg, {"BENCH_TAIL_CHUNK": "2000"}, 2400)
+    slim = [py, "-c", ("import bench; bench.ensure_platform(); "
+                       "bench.run_northstar(full_gate=False)")]
+    run_exp("slim_chunk1000", slim, {"BENCH_CHUNK": "1000"}, 1500)
+    run_exp("slim_tailchunk512", slim, {"BENCH_TAIL_CHUNK": "512"}, 1500)
     log("tuner battery complete")
     return 0
 
