@@ -478,11 +478,15 @@ def surface_stamped_capture() -> bool:
 
     Best-effort by construction: NOTHING here may crash the degraded
     bench run (that would destroy the round's only remaining evidence),
-    and an artifact older than BENCH_STAMP_MAX_AGE (default 12 h, one
-    round) is rejected — a leftover from a previous round must not be
-    presented as this round's capture.  The artifact is also gitignored
-    for the same reason."""
-    max_age = float(os.environ.get("BENCH_STAMP_MAX_AGE", "43200"))
+    and an artifact older than BENCH_STAMP_MAX_AGE (default 16 h) is
+    rejected — a leftover from a previous round must not be presented
+    as this round's capture.  16 h, not 12: a capture frozen minutes
+    into a 12 h round is ~12 h old when the driver runs the round-end
+    bench, and a bound at exactly one round length would reject the
+    round's OWN evidence; inter-round judge/advisor time keeps a
+    previous round's artifact well past 16 h.  The artifact is also
+    gitignored for the same reason."""
+    max_age = float(os.environ.get("BENCH_STAMP_MAX_AGE", "57600"))
     try:
         with open(CAPTURE_ARTIFACT) as f:
             art = json.load(f)
