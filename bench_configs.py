@@ -19,6 +19,7 @@ round over round.
 
 import dataclasses
 import functools
+import os
 import json
 import time
 
@@ -27,12 +28,20 @@ import jax.numpy as jnp
 import numpy as np
 
 
+def _use_approx() -> bool:
+    """Shared with bench.py: candidate selection is EXACT by default
+    since round 5 (hardware-measured faster); BENCH_APPROX=1 opts into
+    approx_max_k, and every emitted line records the mode."""
+    return os.environ.get("BENCH_APPROX", "0") not in ("0", "false", "")
+
+
 def _emit(name, elapsed, **extra):
     from koordinator_tpu.utils.hostinfo import host_fields
     out = {"metric": name, "value": round(elapsed, 4), "unit": "s"}
     out.update(extra)
     out.update(host_fields())
     out.setdefault("platform", jax.devices()[0].platform)
+    out.setdefault("approx_topk", _use_approx())
     print(json.dumps(out))
 
 
@@ -45,7 +54,7 @@ def _run_scheduler_config(name, snap, pods, cfg, chunk, **kw):
     num_pods = pods.valid.shape[0]
     stacked = synthetic.stack_pod_chunks(pods, chunk)
     step = functools.partial(core.schedule_batch, num_rounds=2, k_choices=8,
-                             score_dims=(0, 1), approx_topk=True,
+                             score_dims=(0, 1), approx_topk=_use_approx(),
                              tie_break=True, quota_depth=2,
                              fit_dims=(0, 1, 2, 3), **kw)
 

@@ -8,7 +8,7 @@ time: its own watcher loop (`python tools/tpu_capture.py`, the main()
 below; `--once` for a single probe+capture attempt) probes the tunnel
 every few minutes for the whole round and, on the first healthy probe,
 runs the FULL bench suite (BASELINE configs 1-5, the full-gate flagship, the canonical
-north-star, plus a BENCH_APPROX=0 exact-top-k comparison line) and freezes
+north-star, plus a BENCH_APPROX=1 approx-top-k comparison line) and freezes
 every emitted JSON line into a timestamped artifact:
 
     /root/repo/bench_tpu_capture.json
@@ -96,10 +96,14 @@ def _json_lines(text: str):
 
 
 def capture() -> bool:
-    """Run the full bench suite + the BENCH_APPROX=0 comparison; write the
+    """Run the full bench suite + the BENCH_APPROX=1 comparison; write the
     artifact.  Returns True when a TPU-platform canonical line landed."""
     env = dict(os.environ)
     env.setdefault("JAX_PLATFORMS", "axon")
+    # pin the main run to the bench DEFAULT selection mode: an
+    # inherited BENCH_APPROX would silently collapse the exact-vs-
+    # approx comparison into two identical runs
+    env.pop("BENCH_APPROX", None)
     # the watcher just probed; don't spend 3x180s re-probing in-bench
     env["BENCH_PROBE_ATTEMPTS"] = "2"
     env["BENCH_PROBE_TIMEOUT"] = "180"
@@ -122,18 +126,19 @@ def capture() -> bool:
         return False
     platforms = {l.get("platform") for l in lines}
 
+    # the default canonical is EXACT top-k since round 5; the
+    # comparison line runs the approx_max_k mode (bench stamps
+    # approx_topk into every line either way)
     env_approx = dict(env)
-    env_approx["BENCH_APPROX"] = "0"
+    env_approx["BENCH_APPROX"] = "1"
     env_approx["BENCH_EXTRAS"] = "0"
-    log("capture: running BENCH_APPROX=0 canonical comparison")
+    log("capture: running BENCH_APPROX=1 canonical comparison")
     rc2, out2 = _run_to_files([sys.executable, "bench.py"], env_approx,
-                              APPROX_TIMEOUT, "approx0")
+                              APPROX_TIMEOUT, "approx1")
     approx_lines = [l for l in _json_lines(out2)
                     if l.get("platform") != "cpu"
                     and not l.get("stamped_capture")]
-    log(f"capture: approx0 rc={rc2} live non-cpu lines={len(approx_lines)}")
-    for l in approx_lines:
-        l["approx_topk"] = False
+    log(f"capture: approx1 rc={rc2} live non-cpu lines={len(approx_lines)}")
 
     artifact = {
         "captured_at": datetime.datetime.now(

@@ -88,8 +88,8 @@ def main():
     bench_one = [py, "-c",
                  "import bench; bench.main(bench.ensure_platform())"]
     run_exp("canonical", bench_one, {"BENCH_EXTRAS": "0"}, 1500)
-    run_exp("canonical_exact", bench_one,
-            {"BENCH_EXTRAS": "0", "BENCH_APPROX": "0"}, 1500)
+    run_exp("canonical_approx", bench_one,
+            {"BENCH_EXTRAS": "0", "BENCH_APPROX": "1"}, 1500)
     run_exp("approx_bound",
             [py, "-m", "pytest", "tests/test_approx_topk.py", "-q"],
             {"KOORD_TEST_PLATFORM": "axon"}, 1500)
