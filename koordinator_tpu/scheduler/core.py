@@ -151,9 +151,10 @@ def schedule_batch(snap: ClusterSnapshot, pods: PodBatch,
     quadratically (~16x at the default bench shapes) with bit-identical
     results. The caller MUST enforce the contract host-side
     (synthetic.pack_topo_prefix validates; the bench tail masks overflow
-    pods to a later pass): a member outside the prefix would silently skip
-    in-step charging while still charging at round level. None = full
-    width (every row gated; no contract).
+    pods to a later pass): a member outside the prefix silently drops out
+    of ALL in-batch topology accounting — the in-step gates and the
+    round-level counts alike. None = full width (every row gated; no
+    contract).
 
     `dom_classes` (static): DOMAIN-CLASS CONTRACT — groups sharing an
     upstream topologyKey have IDENTICAL rows in their domain matrix, so
@@ -385,9 +386,12 @@ def schedule_batch(snap: ClusterSnapshot, pods: PodBatch,
             # one charging implementation for in-batch and cross-batch
             # counts (charge_domain_counts); dom_x here is the
             # slot-extended map, so extended placements land on their
-            # node's domain
-            return charge_domain_counts(count0, dom_x, member,
-                                        placed_now).reshape(-1)
+            # node's domain. Rows are sliced to the packing prefix —
+            # members beyond it cannot exist under the contract (and
+            # contribute nothing at full width), so the scatter shrinks
+            # with the prefix, bit-identically.
+            return charge_domain_counts(count0, dom_x, member[:pc],
+                                        placed_now[:pc]).reshape(-1)
 
         return dom_x, counts_flat, n_g, n_d
 

@@ -341,3 +341,58 @@ def test_service_auto_pack_skips_small_batches():
     assert inv is None  # below AUTO_PACK_MIN_BATCH: no reorder
     assert "dom_classes" in kwargs  # classes are free — always derived
     assert packed is pods
+
+
+def test_builder_same_key_groups_form_one_domain_class():
+    """The real informer/builder flow: two spread constraints sharing
+    topologyKey "zone" (distinct selectors) produce byte-identical
+    domain rows, so dom_classes batches them into one class — the
+    static structure the service's auto-pack derivation hands to
+    schedule_batch — and the scheduled placements respect both groups'
+    skew bounds."""
+    from koordinator_tpu.api import types as api
+    from koordinator_tpu.api.extension import ResourceKind as RK
+    from koordinator_tpu.snapshot import (
+        ClusterInformerHub,
+        SnapshotStore,
+        SnapshotSyncer,
+    )
+
+    now = 1e9
+    zones = ["z0", "z0", "z1", "z1"]
+    hub, store = ClusterInformerHub(), SnapshotStore()
+    syncer = SnapshotSyncer(hub, store, max_nodes=4)
+    service = SchedulerService(store=store, num_rounds=2, k_choices=4)
+    syncer.attach_scheduler(service)
+    for i, z in enumerate(zones):
+        hub.upsert_node(api.Node(
+            meta=api.ObjectMeta(name=f"n{i}", labels={"zone": z}),
+            allocatable={RK.CPU: 32000.0, RK.MEMORY: 65536.0}))
+        hub.set_node_metric(api.NodeMetric(node_name=f"n{i}",
+                                           update_time=now,
+                                           node_usage={}))
+    assert syncer.sync(now=now) == "full"
+    pods = []
+    for app in ("a", "b"):
+        c = api.TopologySpreadConstraint(
+            max_skew=1, topology_key="zone",
+            label_selector={"app": app})
+        for j in range(4):
+            pods.append(api.Pod(
+                meta=api.ObjectMeta(name=f"{app}{j}", uid=f"{app}{j}",
+                                    namespace="d",
+                                    labels={"app": app}),
+                priority=9000 - j, requests={RK.CPU: 1000.0},
+                spread_constraints=[c]))
+    batch = syncer.build_pod_batch(pods)
+    assert batch.has_spread
+    classes = synthetic.dom_classes(batch)
+    # both zone-keyed groups share one class (identical domain rows)
+    assert any(len(c) == 2 for c in classes[0]), classes[0]
+    res = service.schedule(batch, typed_pods=pods)
+    a = np.asarray(res.assignment)
+    assert (a >= 0).all()
+    # each app independently balanced across the two zones
+    for app_rows in (range(0, 4), range(4, 8)):
+        zs = [zones[a[j]] for j in app_rows]
+        assert abs(zs.count("z0") - zs.count("z1")) <= 1, zs
