@@ -1,0 +1,81 @@
+"""Randomized soak for the round-5 batching layer.
+
+For many workload seeds, schedule the SAME packed full-gate batch with
+and without the batching specializations (all three nested prefixes +
+domain classes) and require BIT-identical results — assignment, scores,
+zone takes, GPU instance identity, aux, slots, gang rollback, and every
+leaf of the post-commit snapshot. Bit-identity transfers every
+invariant the full-width program already guarantees (tests/
+test_invariants.py) to the packed program, seed by seed.
+
+Shapes stay constant so both programs compile once; each seed is then
+two cached executions. Usage:
+    JAX_PLATFORMS=cpu python tools/soak_pack.py [n_seeds] [start]
+"""
+
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import jax
+
+if os.environ.get("JAX_PLATFORMS"):
+    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+import numpy as np
+
+from koordinator_tpu.scheduler import core
+from koordinator_tpu.scheduler.plugins.loadaware import LoadAwareConfig
+from koordinator_tpu.utils import synthetic
+
+P, N, CHUNK = 2_048, 256, 512
+N_SEEDS = int(sys.argv[1]) if len(sys.argv) > 1 else 200
+START = int(sys.argv[2]) if len(sys.argv) > 2 else 0
+
+
+def main():
+    cfg = LoadAwareConfig.make()
+    kw = dict(num_rounds=2, k_choices=8, score_dims=(0, 1),
+              tie_break=True, quota_depth=2, fit_dims=(0, 1, 2, 3),
+              enable_numa=True, enable_devices=True)
+    fields = core.PER_POD_RESULT_FIELDS + ("gang_failed",)
+    bad = 0
+    for i in range(START, START + N_SEEDS):
+        pods = synthetic.full_gate_pods(P, N, seed=i, num_quotas=8,
+                                        num_gangs=8)
+        packed, prefixes, _ = synthetic.pack_gate_prefixes(pods, CHUNK)
+        classes = synthetic.dom_classes(packed)
+        snap = synthetic.full_gate_cluster(N, seed=i + 7, num_quotas=8,
+                                           num_gangs=8)
+        batch = synthetic.slice_batch(packed, (i % (P // CHUNK)) * CHUNK,
+                                      CHUNK)
+        full = core.schedule_batch(snap, batch, cfg, **kw)
+        spec = core.schedule_batch(snap, batch, cfg,
+                                   topo_prefix=prefixes["topo"],
+                                   numa_prefix=prefixes["numa"],
+                                   gpu_prefix=prefixes["gpu"],
+                                   dom_classes=classes, **kw)
+        ok = True
+        for f in fields:
+            if not np.array_equal(np.asarray(getattr(full, f)),
+                                  np.asarray(getattr(spec, f))):
+                print(f"seed {i}: MISMATCH in {f}", flush=True)
+                ok = False
+        for a, b in zip(jax.tree_util.tree_leaves(full.snapshot),
+                        jax.tree_util.tree_leaves(spec.snapshot)):
+            if not np.array_equal(np.asarray(a), np.asarray(b)):
+                print(f"seed {i}: SNAPSHOT leaf mismatch", flush=True)
+                ok = False
+                break
+        bad += not ok
+        if (i - START + 1) % 25 == 0:
+            print(f"{i - START + 1}/{N_SEEDS} seeds, {bad} mismatches",
+                  flush=True)
+    print(f"SOAK DONE: {N_SEEDS} seeds, {bad} mismatches", flush=True)
+    return 1 if bad else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
