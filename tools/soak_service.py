@@ -1,0 +1,76 @@
+"""Randomized soak for the service auto-pack path.
+
+For many seeds, schedule a full-gate batch through SchedulerService
+(auto_pack on) and assert the per-row outcome invariants that would
+break if the inverse permutation ever mapped results to the wrong
+rows: sentinel-impossible pods unschedulable at THEIR rows, consumed
+reservation slots only at owner rows with matching ids, NUMA zone
+reports only on CPU-bind rows, GPU instance takes only on
+device-requesting rows.
+
+Usage: JAX_PLATFORMS=cpu python tools/soak_service.py [n_seeds]
+"""
+
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import jax
+
+if os.environ.get("JAX_PLATFORMS"):
+    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+import numpy as np
+
+from koordinator_tpu.scheduler.frameworkext import SchedulerService
+from koordinator_tpu.utils import synthetic
+
+P, N = 1_024, 256
+N_SEEDS = int(sys.argv[1]) if len(sys.argv) > 1 else 100
+
+
+def main():
+    bad = 0
+    for i in range(N_SEEDS):
+        rng = np.random.default_rng(i)
+        service = SchedulerService(num_rounds=2, k_choices=4)
+        service.publish(synthetic.full_gate_cluster(
+            N, seed=i, num_quotas=8, num_gangs=8))
+        pods = synthetic.full_gate_pods(P, N, seed=i + 500,
+                                        num_quotas=8, num_gangs=8)
+        reqs = np.asarray(pods.requests).copy()
+        impossible = rng.choice(P, 16, replace=False)
+        reqs[impossible] = 1e9
+        pods = pods.replace(requests=reqs)
+        res = service.schedule(pods)
+        a = np.asarray(res.assignment)
+        slot = np.asarray(res.res_slot)
+        zone = np.asarray(res.numa_zone)
+        gpu_take = np.asarray(res.gpu_take)
+        owner = np.asarray(pods.reservation_owner)
+        numa = np.asarray(pods.numa_single)
+        from koordinator_tpu.scheduler.plugins import deviceshare
+        gpu = np.asarray(deviceshare.has_device_request(pods))
+        ok = ((a[impossible] == -1).all()
+              and (slot[owner < 0] < 0).all()
+              and (owner[slot >= 0] == slot[slot >= 0]).all()
+              and (zone[~numa] < 0).all()
+              and not gpu_take[~gpu].any()
+              # capacity varies by seed; the floor only guards
+              # against a degenerate all-unschedulable run
+              and int((a >= 0).sum()) > P // 8)
+        if not ok:
+            print(f"seed {i}: ROW-CONSISTENCY VIOLATION", flush=True)
+            bad += 1
+        if (i + 1) % 20 == 0:
+            print(f"{i + 1}/{N_SEEDS} seeds, {bad} violations",
+                  flush=True)
+    print(f"SERVICE SOAK DONE: {N_SEEDS} seeds, {bad} violations",
+          flush=True)
+    return 1 if bad else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
