@@ -105,7 +105,7 @@ def main():
     slim = [py, "-c", ("import bench; bench.ensure_platform(); "
                        "bench.run_northstar(full_gate=False)")]
     run_exp("slim_chunk1000", slim, {"BENCH_CHUNK": "1000"}, 1500)
-    run_exp("slim_tailchunk512", slim, {"BENCH_TAIL_CHUNK": "512"}, 1500)
+    run_exp("slim_tailwide2000", slim, {"BENCH_TAIL_CHUNK": "2000"}, 1500)
     log("tuner battery complete")
     return 0
 
