@@ -3,11 +3,14 @@
 Probes the tunnel every few minutes; on the first healthy window it
 runs the round-5 hardware experiments back-to-back and exits:
 
-  1. canonical bench (batched-readback protocol) + exact-top-k variant
+  1. canonical bench (batched readbacks, exact top-k, 512 tails) and
+     the approx_max_k contrast (BENCH_APPROX=1)
   2. approx_max_k quality bound where it binds (KOORD_TEST_PLATFORM)
   3. packed full-gate bisection (tools/profile_fullgate.py)
   4. full-gate chunk sweep (BENCH_FULL_CHUNK 1000 / 500)
   5. full-gate rounds sweep (BENCH_ROUNDS=1 BENCH_K=16)
+  6. wide-tail contrasts for both paths (BENCH_TAIL_CHUNK=2000)
+  7. slim chunk sweep (BENCH_CHUNK=1000)
 
 Coordination with tools/tpu_capture.py: the capture artifact is the
 round's EVIDENCE and takes priority — while it is stale the tuner
