@@ -493,8 +493,15 @@ def surface_stamped_capture() -> bool:
     bench, and a bound at exactly one round length would reject the
     round's OWN evidence; inter-round judge/advisor time keeps a
     previous round's artifact well past 16 h.  The artifact is also
-    gitignored for the same reason."""
+    gitignored for the same reason.
+
+    Surfaced lines additionally carry `stale_capture`: true once the
+    stamp is older than BENCH_STAMP_STALE_AFTER (default 1 h), so a
+    reader of the evidence trail can tell a fresh mid-round capture
+    from one that predates most of the round (BENCH_r05 surfaced a
+    stamped_age_seconds of 36196 with nothing marking it stale)."""
     max_age = float(os.environ.get("BENCH_STAMP_MAX_AGE", "57600"))
+    stale_after = float(os.environ.get("BENCH_STAMP_STALE_AFTER", "3600"))
     try:
         with open(CAPTURE_ARTIFACT) as f:
             art = json.load(f)
@@ -519,6 +526,7 @@ def surface_stamped_capture() -> bool:
             out["stamped_capture"] = True
             out["captured_at"] = captured_at
             out["stamped_age_seconds"] = round(age)
+            out["stale_capture"] = age > stale_after
             print(json.dumps(out))
         return True
     except FileNotFoundError:

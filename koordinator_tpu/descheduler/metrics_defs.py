@@ -1,19 +1,26 @@
 """descheduler metric series — parity with pkg/descheduler/metrics/
-metrics.go (PodsEvicted and the migration-job counters)."""
+metrics.go (PodsEvicted and the migration-job counters).
+
+Family names come from the shared name registry
+(koordinator_tpu/metrics/registry.py) and are re-exported here."""
 
 from __future__ import annotations
 
 from koordinator_tpu.metrics import Registry, global_registry
+from koordinator_tpu.metrics.registry import (  # noqa: F401  (re-export)
+    DESCHEDULER_MIGRATION_JOBS,
+    DESCHEDULER_PODS_EVICTED,
+)
 
 
 class DeschedulerMetrics:
     def __init__(self, registry: Registry = None):
         r = registry if registry is not None else global_registry()
         self.pods_evicted = r.counter(
-            "descheduler_pods_evicted",
+            DESCHEDULER_PODS_EVICTED,
             "Evicted pods by result/strategy/node ('error' = eviction "
             "failed)", labels=("result", "strategy", "node"))
         self.migration_jobs = r.counter(
-            "descheduler_migration_jobs",
+            DESCHEDULER_MIGRATION_JOBS,
             "PodMigrationJob transitions by phase",
             labels=("phase",))

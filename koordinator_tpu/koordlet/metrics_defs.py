@@ -6,73 +6,94 @@ common.go).
 Label vocabularies follow the reference (NodeKey/PodUID/... in
 common.go); the node label is bound once via `for_node` so call sites
 pass only the varying labels.
+
+Family names come from the shared name registry
+(koordinator_tpu/metrics/registry.py) and are re-exported here; the
+koordlint metric-registry pass rejects bare literals so the catalogs
+cannot drift.
 """
 
 from __future__ import annotations
 
 from koordinator_tpu.metrics import Registry, global_registry
+from koordinator_tpu.metrics.registry import (  # noqa: F401  (re-export)
+    KOORDLET_BE_SUPPRESS_CPU_CORES,
+    KOORDLET_BE_SUPPRESS_LS_USED_CPU_CORES,
+    KOORDLET_CONTAINER_CORE_SCHED_COOKIE,
+    KOORDLET_CONTAINER_CPI,
+    KOORDLET_CONTAINER_PSI,
+    KOORDLET_CONTAINER_SCALED_CFS_BURST_US,
+    KOORDLET_CONTAINER_SCALED_CFS_QUOTA_US,
+    KOORDLET_CORE_SCHED_COOKIE_MANAGE_STATUS,
+    KOORDLET_NODE_PREDICTED_RESOURCE_RECLAIMABLE,
+    KOORDLET_NODE_RESOURCE_ALLOCATABLE,
+    KOORDLET_NODE_USED_CPU_CORES,
+    KOORDLET_POD_EVICTION,
+    KOORDLET_POD_PSI,
+    KOORDLET_START_TIME,
+)
 
 
 class KoordletMetrics:
     def __init__(self, registry: Registry = None):
         r = registry if registry is not None else global_registry()
         self.start_time = r.gauge(
-            "koordlet_start_time",
+            KOORDLET_START_TIME,
             "Unix time the agent started (common.go StartTime)",
             labels=("node",))
         # --- performance collector (cpi.go, psi.go) ---
         self.container_cpi = r.gauge(
-            "koordlet_container_cpi",
+            KOORDLET_CONTAINER_CPI,
             "Container cycles-per-instruction collected by the perf group "
             "reader", labels=("node", "pod_uid", "container_id", "field"))
         self.container_psi = r.gauge(
-            "koordlet_container_psi",
+            KOORDLET_CONTAINER_PSI,
             "Container pressure-stall information",
             labels=("node", "pod_uid", "container_id", "resource",
                     "precision", "degree"))
         self.pod_psi = r.gauge(
-            "koordlet_pod_psi", "Pod pressure-stall information",
+            KOORDLET_POD_PSI, "Pod pressure-stall information",
             labels=("node", "pod_uid", "resource", "precision", "degree"))
         # --- qos strategies (cpu_suppress.go, cpu_burst.go) ---
         self.be_suppress_cpu_cores = r.gauge(
-            "koordlet_be_suppress_cpu_cores",
+            KOORDLET_BE_SUPPRESS_CPU_CORES,
             "Cores granted to the BE tier by the suppress policy",
             labels=("node", "type"))  # type: cfsQuota | cpuset
         self.be_suppress_ls_used_cpu_cores = r.gauge(
-            "koordlet_be_suppress_ls_used_cpu_cores",
+            KOORDLET_BE_SUPPRESS_LS_USED_CPU_CORES,
             "Cores the LS tier currently uses as seen by the suppress "
             "policy", labels=("node",))
         self.container_scaled_cfs_quota_us = r.gauge(
-            "koordlet_container_scaled_cfs_quota_us",
+            KOORDLET_CONTAINER_SCALED_CFS_QUOTA_US,
             "cfs quota written by the burst strategy",
             labels=("node", "pod_uid", "container_id"))
         self.container_scaled_cfs_burst_us = r.gauge(
-            "koordlet_container_scaled_cfs_burst_us",
+            KOORDLET_CONTAINER_SCALED_CFS_BURST_US,
             "cfs burst written by the burst strategy",
             labels=("node", "pod_uid", "container_id"))
         self.pod_eviction = r.counter(
-            "koordlet_pod_eviction",
+            KOORDLET_POD_EVICTION,
             "Evictions requested by QoS strategies by reason",
             labels=("node", "reason"))
         # --- core scheduling (core_sched.go) ---
         self.container_core_sched_cookie = r.gauge(
-            "koordlet_container_core_sched_cookie",
+            KOORDLET_CONTAINER_CORE_SCHED_COOKIE,
             "Core-scheduling cookie assigned to the container",
             labels=("node", "pod_uid", "container_id", "group"))
         self.core_sched_cookie_manage_status = r.counter(
-            "koordlet_core_sched_cookie_manage_status",
+            KOORDLET_CORE_SCHED_COOKIE_MANAGE_STATUS,
             "Cookie assign/clear operations by status",
             labels=("node", "group", "status"))
         # --- prediction / node summary (prediction.go, resource_summary.go)
         self.node_predicted_resource_reclaimable = r.gauge(
-            "koordlet_node_predicted_resource_reclaimable",
+            KOORDLET_NODE_PREDICTED_RESOURCE_RECLAIMABLE,
             "Reclaimable resource predicted by the peak predictor",
             labels=("node", "predictor", "resource", "unit"))
         self.node_resource_allocatable = r.gauge(
-            "koordlet_node_resource_allocatable",
+            KOORDLET_NODE_RESOURCE_ALLOCATABLE,
             "Node allocatable as reported",
             labels=("node", "resource", "unit"))
         self.node_used_cpu_cores = r.gauge(
-            "koordlet_node_used_cpu_cores",
+            KOORDLET_NODE_USED_CPU_CORES,
             "Node CPU usage in cores (resource_summary.go)",
             labels=("node",))

@@ -1,0 +1,66 @@
+"""The shared metric-name registry: every Prometheus family name any
+component catalog registers, as one constant each.
+
+Single source of truth for the cross-file consistency pass
+(`tools/lint` metric-registry analyzer): the per-component
+`metrics_defs.py` catalogs import these constants instead of spelling
+names inline, so two components can't silently claim the same family in
+the process-global registry and a renamed series can't drift from its
+dashboards. The analyzer enforces all three directions — duplicate
+resolved names (MN001), bare literals in a catalog (MN002), and
+constants no catalog registers (MN003).
+
+Grouped per component, mirroring the reference's
+pkg/<component>/metrics/ layout.
+"""
+
+from __future__ import annotations
+
+# --- scheduler (pkg/scheduler/metrics/metrics.go + TPU kernel series) ---
+SCHEDULER_SCHEDULING_TIMEOUT = "scheduler_scheduling_timeout"
+SCHEDULER_SCHEDULE_CYCLE_SECONDS = "scheduler_schedule_cycle_seconds"
+SCHEDULER_SCHEDULE_BATCH_KERNEL_SECONDS = \
+    "scheduler_schedule_batch_kernel_seconds"
+SCHEDULER_PODS_SCHEDULED = "scheduler_pods_scheduled"
+SCHEDULER_SNAPSHOT_VERSION = "scheduler_snapshot_version"
+
+# --- koordlet (pkg/koordlet/metrics/: cpi.go, psi.go, cpu_suppress.go,
+#     cpu_burst.go, core_sched.go, prediction.go, resource_summary.go,
+#     common.go) ---
+KOORDLET_START_TIME = "koordlet_start_time"
+KOORDLET_CONTAINER_CPI = "koordlet_container_cpi"
+KOORDLET_CONTAINER_PSI = "koordlet_container_psi"
+KOORDLET_POD_PSI = "koordlet_pod_psi"
+KOORDLET_BE_SUPPRESS_CPU_CORES = "koordlet_be_suppress_cpu_cores"
+KOORDLET_BE_SUPPRESS_LS_USED_CPU_CORES = \
+    "koordlet_be_suppress_ls_used_cpu_cores"
+KOORDLET_CONTAINER_SCALED_CFS_QUOTA_US = \
+    "koordlet_container_scaled_cfs_quota_us"
+KOORDLET_CONTAINER_SCALED_CFS_BURST_US = \
+    "koordlet_container_scaled_cfs_burst_us"
+KOORDLET_POD_EVICTION = "koordlet_pod_eviction"
+KOORDLET_CONTAINER_CORE_SCHED_COOKIE = \
+    "koordlet_container_core_sched_cookie"
+KOORDLET_CORE_SCHED_COOKIE_MANAGE_STATUS = \
+    "koordlet_core_sched_cookie_manage_status"
+KOORDLET_NODE_PREDICTED_RESOURCE_RECLAIMABLE = \
+    "koordlet_node_predicted_resource_reclaimable"
+KOORDLET_NODE_RESOURCE_ALLOCATABLE = "koordlet_node_resource_allocatable"
+KOORDLET_NODE_USED_CPU_CORES = "koordlet_node_used_cpu_cores"
+
+# --- descheduler (pkg/descheduler/metrics/metrics.go) ---
+DESCHEDULER_PODS_EVICTED = "descheduler_pods_evicted"
+DESCHEDULER_MIGRATION_JOBS = "descheduler_migration_jobs"
+
+# --- slo-controller (pkg/slo-controller/metrics/) ---
+SLO_NODEMETRIC_RECONCILE_COUNT = "slo_controller_nodemetric_reconcile_count"
+SLO_NODEMETRIC_SPEC_PARSE_COUNT = \
+    "slo_controller_nodemetric_spec_parse_count"
+SLO_NODESLO_RECONCILE_COUNT = "slo_controller_nodeslo_reconcile_count"
+SLO_NODESLO_SPEC_PARSE_COUNT = "slo_controller_nodeslo_spec_parse_count"
+SLO_NODE_RESOURCE_RECONCILE_COUNT = \
+    "slo_controller_node_resource_reconcile_count"
+SLO_NODE_RESOURCE_RUN_PLUGIN_STATUS = \
+    "slo_controller_node_resource_run_plugin_status"
+SLO_NODE_EXTENDED_RESOURCE_ALLOCATABLE = \
+    "slo_controller_node_extended_resource_allocatable_internal"

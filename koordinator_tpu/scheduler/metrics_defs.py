@@ -3,11 +3,23 @@ the TPU per-batch kernel timing series from SURVEY.md §5).
 
 Instantiated against a Registry so tests can assert on a private one; the
 default wiring (SchedulerService) uses the process-global registry.
+
+Family names come from the shared name registry
+(koordinator_tpu/metrics/registry.py) and are re-exported here; the
+koordlint metric-registry pass rejects bare literals so the catalogs
+cannot drift.
 """
 
 from __future__ import annotations
 
 from koordinator_tpu.metrics import Registry, global_registry
+from koordinator_tpu.metrics.registry import (  # noqa: F401  (re-export)
+    SCHEDULER_PODS_SCHEDULED,
+    SCHEDULER_SCHEDULE_BATCH_KERNEL_SECONDS,
+    SCHEDULER_SCHEDULE_CYCLE_SECONDS,
+    SCHEDULER_SCHEDULING_TIMEOUT,
+    SCHEDULER_SNAPSHOT_VERSION,
+)
 
 # device-time scale: schedule_batch is ~0.5ms-1s depending on chunk size
 KERNEL_BUCKETS = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
@@ -18,23 +30,23 @@ class SchedulerMetrics:
     def __init__(self, registry: Registry = None):
         r = registry if registry is not None else global_registry()
         self.scheduling_timeout = r.counter(
-            "scheduler_scheduling_timeout",
+            SCHEDULER_SCHEDULING_TIMEOUT,
             "Scheduling cycles that exceeded the watchdog budget "
             "(scheduler_monitor.go)", labels=("profile",))
         self.cycle_seconds = r.histogram(
-            "scheduler_schedule_cycle_seconds",
+            SCHEDULER_SCHEDULE_CYCLE_SECONDS,
             "End-to-end batch scheduling cycle latency (snapshot read to "
             "post-commit publish)")
         self.kernel_seconds = r.histogram(
-            "scheduler_schedule_batch_kernel_seconds",
+            SCHEDULER_SCHEDULE_BATCH_KERNEL_SECONDS,
             "Device time of the schedule_batch program per batch "
             "(jax-profiler-annotated region, blocked on the assignment "
             "readback)", buckets=KERNEL_BUCKETS)
         self.pods_scheduled = r.counter(
-            "scheduler_pods_scheduled",
+            SCHEDULER_PODS_SCHEDULED,
             "Pods through the batched commit by result",
             labels=("result",))  # placed | unschedulable
         self.snapshot_version = r.gauge(
-            "scheduler_snapshot_version",
+            SCHEDULER_SNAPSHOT_VERSION,
             "Version of the device-resident cluster snapshot last "
             "published")

@@ -1,37 +1,49 @@
 """slo-controller metric series — parity with pkg/slo-controller/metrics/
-(common.go, metrics.go, node_resource.go)."""
+(common.go, metrics.go, node_resource.go).
+
+Family names come from the shared name registry
+(koordinator_tpu/metrics/registry.py) and are re-exported here."""
 
 from __future__ import annotations
 
 from koordinator_tpu.metrics import Registry, global_registry
+from koordinator_tpu.metrics.registry import (  # noqa: F401  (re-export)
+    SLO_NODE_EXTENDED_RESOURCE_ALLOCATABLE,
+    SLO_NODE_RESOURCE_RECONCILE_COUNT,
+    SLO_NODE_RESOURCE_RUN_PLUGIN_STATUS,
+    SLO_NODEMETRIC_RECONCILE_COUNT,
+    SLO_NODEMETRIC_SPEC_PARSE_COUNT,
+    SLO_NODESLO_RECONCILE_COUNT,
+    SLO_NODESLO_SPEC_PARSE_COUNT,
+)
 
 
 class SloControllerMetrics:
     def __init__(self, registry: Registry = None):
         r = registry if registry is not None else global_registry()
         self.nodemetric_reconcile_count = r.counter(
-            "slo_controller_nodemetric_reconcile_count",
+            SLO_NODEMETRIC_RECONCILE_COUNT,
             "NodeMetric reconciliations by status",
             labels=("status",))
         self.nodemetric_spec_parse_count = r.counter(
-            "slo_controller_nodemetric_spec_parse_count",
+            SLO_NODEMETRIC_SPEC_PARSE_COUNT,
             "NodeMetric collect-policy config parses by status",
             labels=("status",))
         self.nodeslo_reconcile_count = r.counter(
-            "slo_controller_nodeslo_reconcile_count",
+            SLO_NODESLO_RECONCILE_COUNT,
             "NodeSLO reconciliations by status", labels=("status",))
         self.nodeslo_spec_parse_count = r.counter(
-            "slo_controller_nodeslo_spec_parse_count",
+            SLO_NODESLO_SPEC_PARSE_COUNT,
             "NodeSLO strategy config parses by status", labels=("status",))
         self.node_resource_reconcile_count = r.counter(
-            "slo_controller_node_resource_reconcile_count",
+            SLO_NODE_RESOURCE_RECONCILE_COUNT,
             "Node batch/mid resource reconciliations by status",
             labels=("status",))
         self.node_resource_run_plugin_status = r.counter(
-            "slo_controller_node_resource_run_plugin_status",
+            SLO_NODE_RESOURCE_RUN_PLUGIN_STATUS,
             "Resource-calculate plugin runs by plugin and status",
             labels=("plugin", "status"))
         self.node_extended_resource_allocatable = r.gauge(
-            "slo_controller_node_extended_resource_allocatable_internal",
+            SLO_NODE_EXTENDED_RESOURCE_ALLOCATABLE,
             "Extended (batch/mid) allocatable the controller computed",
             labels=("node", "resource", "unit"))

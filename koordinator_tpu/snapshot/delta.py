@@ -188,9 +188,12 @@ def forget_pods(snap: ClusterSnapshot, pods, result,
     # node requested: only non-consumers charged it (consumers drew from
     # the reservation). CPU-bind pods on amplified nodes were charged
     # request x ratio (core.py amplified-CPU commit) — return the same.
+    # result.amplified is static metadata (pytree_node=False), so plain
+    # truthiness is trace-safe; a bool() coercion here would read as a
+    # host sync to koordlint (and be one if the field ever went traced)
     amp = enable_amplification
     if amp is None:
-        amp = bool(getattr(result, "amplified", False))
+        amp = getattr(result, "amplified", False)
     req_node = req
     if amp:
         f_amp = jnp.where(

@@ -1,0 +1,24 @@
+"""Negative donation-aliasing fixtures: the rebind idiom, in and out of
+loops."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def step(state, inc):
+    return state + inc
+
+
+def drive(state, inc):
+    state = step(state, inc)       # rebinds: nothing stale
+    total = jnp.sum(state)
+    return state, total
+
+
+def loop(state, inc):
+    for _ in range(3):
+        state = step(state, inc)   # rebind every iteration
+    return state

@@ -1,0 +1,25 @@
+"""Positive donation-aliasing fixtures: reads of a donated buffer after
+the call, straight-line and via loop wrap-around."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def step(state, inc):
+    return state + inc
+
+
+def drive(state, inc):
+    out = step(state, inc)
+    norm = jnp.sum(state)          # DA001: state was donated above
+    return out, norm
+
+
+def loop(state, inc):
+    out = None
+    for _ in range(3):
+        out = step(state, inc)     # DA001: next iteration re-donates
+    return out                     # the buffer iteration 1 consumed

@@ -1,0 +1,28 @@
+"""Negative host-sync fixtures: static operands, shape reads, and
+device-side conversions must all pass, as must host syncs in functions
+no jit entry reaches."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@functools.partial(jax.jit, static_argnames=("k", "dims"))
+def entry(x, k, dims):
+    n = x.shape[0]                       # shapes are static under trace
+    idx = np.asarray(dims, dtype=np.int32)   # static operand: fine
+    scale = int(k)                       # static coercion: fine
+    y = helper(x) * scale
+    return y + n + idx.sum()
+
+
+def helper(x):
+    return jnp.asarray(x)                # device-side conversion: fine
+
+
+def host_only(x):
+    # full of syncs, but no jit entry reaches it
+    jax.block_until_ready(x)
+    return float(np.asarray(x).item())

@@ -1,0 +1,34 @@
+"""Positive host-sync fixtures: every sink class, including one two
+calls deep in the traced call graph."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def coerce_direct(x):
+    return float(x) + 1.0          # HS005
+
+
+@jax.jit
+def syncy(x):
+    jax.block_until_ready(x)       # HS002
+    y = x.block_until_ready()      # HS002 (method form)
+    return jax.device_get(y)       # HS003
+
+
+@functools.partial(jax.jit, static_argnames=("k",))
+def entry(x, k):
+    return helper(x) * k
+
+
+def helper(x):
+    host = np.asarray(x)           # HS004 (reached from entry)
+    return deep(host)
+
+
+def deep(x):
+    return x.item()                # HS001 (two levels deep)

@@ -1,0 +1,34 @@
+"""Negative lock-discipline fixtures: one global order, blocking only
+outside the critical sections."""
+
+import threading
+import time
+
+_REGISTRY_LOCK = threading.Lock()
+
+
+class Store:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+
+    def ab(self):
+        with self._a:
+            with self._b:
+                return 1
+
+    def also_ab(self):
+        # same order everywhere: no cycle
+        with self._a:
+            with self._b:
+                return 2
+
+    def snapshot_then_block(self):
+        with self._a:
+            state = 41
+        time.sleep(0.01)           # after release: fine
+        return state + 1
+
+    def registry(self):
+        with _REGISTRY_LOCK:
+            return 3

@@ -1,0 +1,40 @@
+"""Positive lock-discipline fixtures: an order cycle, blocking under a
+lock (direct and via a helper), and a manual acquire."""
+
+import threading
+import time
+
+
+class Store:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+
+    def ab(self):
+        with self._a:
+            with self._b:
+                return 1
+
+    def ba(self):
+        with self._b:
+            with self._a:          # LK001: a->b and b->a form a cycle
+                return 2
+
+    def slow(self):
+        with self._a:
+            time.sleep(1.0)        # LK002: blocking under the lock
+
+    def indirect(self):
+        with self._b:
+            return self._nap()     # LK002: helper blocks
+
+    def _nap(self):
+        time.sleep(0.1)
+        return 3
+
+    def manual(self):
+        self._a.acquire()          # LK003: escapes the with analysis
+        try:
+            return 4
+        finally:
+            self._a.release()

@@ -1,0 +1,6 @@
+from fixtures.metrics.registry import ALPHA_NAME  # noqa: F401
+
+
+class MetricsA:
+    def __init__(self, r):
+        self.alpha = r.counter(ALPHA_NAME, "fine")

@@ -1,0 +1,6 @@
+from fixtures.metrics.registry import BETA_NAME  # noqa: F401
+
+
+class MetricsB:
+    def __init__(self, r):
+        self.beta = r.histogram(BETA_NAME, "fine")

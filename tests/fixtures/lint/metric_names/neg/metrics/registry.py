@@ -1,0 +1,5 @@
+"""Negative metric-registry fixture: every constant registered exactly
+once."""
+
+ALPHA_NAME = "comp_alpha_total"
+BETA_NAME = "comp_beta_total"

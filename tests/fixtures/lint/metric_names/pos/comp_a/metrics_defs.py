@@ -1,0 +1,8 @@
+from fixtures.metrics.registry import GOOD_NAME  # noqa: F401
+
+
+class MetricsA:
+    def __init__(self, r):
+        self.good = r.counter(GOOD_NAME, "fine")
+        self.bare = r.gauge("comp_bare_total", "MN002: bare literal")
+        self.mystery = r.counter(UNKNOWN_NAME, "MN004")  # noqa: F821

@@ -1,0 +1,7 @@
+from fixtures.metrics.registry import GOOD_NAME  # noqa: F401
+
+
+class MetricsB:
+    def __init__(self, r):
+        # MN001: comp_a already registered this family
+        self.clash = r.counter(GOOD_NAME, "duplicate")

@@ -1,0 +1,5 @@
+"""Positive metric-registry fixture: shared name registry with one dead
+constant."""
+
+GOOD_NAME = "comp_good_total"
+DEAD_NAME = "comp_dead_total"      # MN003: no catalog registers it

@@ -1,0 +1,35 @@
+"""Negative recompilation-hazard fixtures: scalars declared static,
+branching only on static parameters."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+@functools.partial(jax.jit, static_argnames=("mode", "n"))
+def ok(x, mode: str = "a", n: int = 4):
+    if mode == "a":                # static branch: specialization is
+        return x * n               # explicit in the signature
+    return jnp.where(x > 0, x, -x)
+
+
+@jax.jit
+def arrays_only(x, mask):
+    return jnp.where(mask, x, 0.0)
+
+
+@jax.jit
+def optional_guard(x, mask=None):
+    # `param is None` is a concrete Python bool under trace — the
+    # standard optional-argument idiom must not flag
+    if mask is None:
+        mask = jnp.ones_like(x)
+    return jnp.where(mask, x, 0.0)
+
+
+@jax.jit
+def pytree_tuple(xs: tuple):
+    # a tuple-annotated param is an ordinary traced pytree, not a
+    # static-argnames candidate
+    return xs[0] + xs[1]

@@ -1,0 +1,20 @@
+"""Positive recompilation-hazard fixtures."""
+
+import functools
+
+import jax
+
+
+@jax.jit
+def scalar_params(x, mode: str, n: int = 4):
+    # RC001 twice: mode (str) and n (int default) are not static
+    return x * n
+
+
+@functools.partial(jax.jit, static_argnames=("k",))
+def branchy(x, flag, k):
+    if flag:                       # RC002: truth value of a tracer
+        return x * k
+    if x.shape[0] > 2:             # RC003: per-shape specialization
+        return x + 1
+    return x

@@ -1,0 +1,22 @@
+#!/usr/bin/env bash
+# Minimal CI gate: koordlint first (fast, stdlib-only — fails in
+# seconds on a hygiene regression), then the tier-1 pytest battery from
+# ROADMAP.md on the CPU backend. Exit code is the first failing stage's.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+echo "=== koordlint (python -m tools.lint) ==="
+python -m tools.lint
+
+echo "=== tier-1 tests (JAX_PLATFORMS=cpu) ==="
+set -o pipefail
+rm -f /tmp/_t1.log
+# `|| rc=$?` keeps set -e from aborting before the DOTS_PASSED
+# diagnostic — the pass count matters MOST on the failure path
+rc=0
+timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
+    -m 'not slow' --continue-on-collection-errors -p no:cacheprovider \
+    -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log || rc=$?
+echo "DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log \
+    | tr -cd . | wc -c)"
+exit "$rc"

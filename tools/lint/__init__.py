@@ -1,0 +1,26 @@
+"""koordlint — AST-based hot-path purity & concurrency lint suite.
+
+A self-contained, stdlib-only (`ast`, no jax/numpy imports) analyzer
+framework guarding the invariants the jitted score+bind core and the
+informer-side concurrency depend on (docs/DESIGN.md "Hot-path hygiene
+rules"):
+
+- per-file and cross-file passes over a parsed-module Project model
+- a plugin registry (`tools.lint.framework.register`) the six built-in
+  analyzers self-register into on import
+- a baseline-suppression file (tools/lint/baseline.json) holding stable
+  finding fingerprints, so pre-existing debt can be frozen while new
+  findings fail CI
+- `python -m tools.lint` exits non-zero on any unsuppressed finding
+
+Run `python -m tools.lint --list` for the analyzer catalog.
+"""
+
+from tools.lint.framework import (  # noqa: F401
+    Analyzer,
+    Finding,
+    Project,
+    all_analyzers,
+    register,
+)
+from tools.lint.runner import run_lint  # noqa: F401

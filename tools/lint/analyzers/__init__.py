@@ -1,0 +1,11 @@
+"""Built-in koordlint analyzers; importing this package registers every
+analyzer into the framework registry."""
+
+from tools.lint.analyzers import (  # noqa: F401
+    donation,
+    host_sync,
+    lock_discipline,
+    metric_names,
+    proto_drift,
+    recompile,
+)

@@ -1,0 +1,253 @@
+"""donation-aliasing: a buffer passed through `donate_argnums`/
+`donate_argnames` is invalidated by the call — XLA may reuse its memory
+for the outputs. Reading the donor variable afterwards returns garbage
+(or raises on deleted-buffer access) only at runtime; this pass catches
+it statically.
+
+Code:
+  DA001  donated variable read after the donating call before rebinding
+
+The check is scoped to the enclosing function of each call site and is
+loop-aware: for a call inside a loop both continuation paths are
+checked — the wrap-around to the next iteration (which reaches the
+loop-top statements with the buffer already donated) and the loop exit
+(which reaches the post-loop statements with the LAST iteration's
+buffer donated). The `snap = sweep(snap, ...)` rebind idiom passes; a
+stale `jax.block_until_ready(snap)` at loop top or a `return snap`
+after the loop does not. The assignment form `g = jax.jit(f,
+donate_argnums=...)` attributes donation to calls through `g`; direct
+`f(...)` calls stay plain.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from tools.lint.astutil import dotted_name, positional_params
+from tools.lint.callgraph import project_index, FunctionInfo, JitEntry, ProjectIndex
+from tools.lint.framework import Analyzer, Finding, Project, register
+
+
+@register
+class DonationAnalyzer(Analyzer):
+    name = "donation-aliasing"
+    description = ("reads of a donated buffer after the jitted call "
+                   "that consumed it")
+
+    def run(self, project: Project) -> Iterable[Finding]:
+        index = project_index(project)
+        # decorator form: the raw def IS the jitted callable
+        donating: Dict[int, JitEntry] = {}
+        # assignment form (g = jax.jit(f, ...)): donation applies to
+        # calls through the ALIAS, never to direct f(...) calls
+        aliased: Dict[Tuple[str, str], JitEntry] = {}
+        for entry in index.jit_entries():
+            if not (entry.donate_argnums or entry.donate_argnames):
+                continue
+            if entry.alias_name is not None:
+                aliased[(entry.alias_module_relpath,
+                         entry.alias_name)] = entry
+            else:
+                donating[id(entry.fn.node)] = entry
+        if not donating and not aliased:
+            return []
+        findings: List[Finding] = []
+        for mi in index.modules.values():
+            for info in mi.functions:
+                findings.extend(self._scan_function(
+                    index, mi, info, donating, aliased))
+        return sorted(findings, key=lambda f: (f.path, f.line, f.code))
+
+    def _scan_function(self, index: ProjectIndex, mi, info: FunctionInfo,
+                       donating: Dict[int, JitEntry],
+                       aliased: Dict[Tuple[str, str], JitEntry]
+                       ) -> Iterable[Finding]:
+        chain = info.scope_chain + (info.node,)
+        for stmt, call, loop in _calls_with_context(info.node):
+            entry = None
+            if isinstance(call.func, ast.Name):
+                entry = aliased.get((info.module.relpath, call.func.id))
+            if entry is None:
+                callee = index.resolve_call(mi, chain, call)
+                if callee is None or id(callee.node) not in donating:
+                    continue
+                entry = donating[id(callee.node)]
+            donated = _donated_names(entry, call)
+            if not donated:
+                continue
+            paths = _paths_after(info.node, stmt, loop)
+            for name in sorted(donated):
+                hit = None
+                for path in paths:
+                    hit = _first_use_before_rebind(path, stmt, name)
+                    if hit is not None:
+                        break
+                if hit is not None:
+                    yield Finding(
+                        analyzer="donation-aliasing", code="DA001",
+                        path=info.module.relpath, line=hit.lineno,
+                        message=f"`{name}` was donated to "
+                                f"`{entry.fn.qualname}` on line "
+                                f"{call.lineno} and is read here before "
+                                f"rebinding: the buffer may already be "
+                                f"reused for the outputs — rebind the "
+                                f"name from the call's result or drop "
+                                f"it from donate_argnums",
+                        key=f"{info.qualname}:{entry.fn.qualname}:{name}")
+
+
+def _donated_names(entry: JitEntry, call: ast.Call) -> Set[str]:
+    """Plain-Name arguments sitting in donated positions/keywords."""
+    pos = positional_params(entry.fn.node)
+    donated_params = set(entry.donate_argnames)
+    donated_params.update(pos[i] for i in entry.donate_argnums
+                          if 0 <= i < len(pos))
+    names: Set[str] = set()
+    for i, arg in enumerate(call.args):
+        if i < len(pos) and pos[i] in donated_params \
+                and isinstance(arg, ast.Name):
+            names.add(arg.id)
+    for kw in call.keywords:
+        if kw.arg in donated_params and isinstance(kw.value, ast.Name):
+            names.add(kw.value.id)
+    return names
+
+
+def _calls_with_context(fn) -> Iterable[Tuple[ast.stmt, ast.Call,
+                                              Optional[ast.stmt]]]:
+    """(enclosing statement, call, innermost enclosing loop) for every
+    call in `fn`, excluding nested function bodies; compound statements
+    attribute body calls to the innermost simple statement."""
+    seen: Set[int] = set()
+    for stmt, call, loop in _walk_dedup(fn.body, None):
+        if id(call) not in seen:
+            seen.add(id(call))
+            yield stmt, call, loop
+
+
+def _walk_dedup(body: List[ast.stmt], loop: Optional[ast.stmt]):
+    for stmt in body:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            continue
+        inner_loop = stmt if isinstance(stmt, (ast.For, ast.While)) \
+            else loop
+        subs = list(_sub_bodies(stmt))
+        if subs:
+            for sub in subs:
+                yield from _walk_dedup(sub, inner_loop)
+            # calls in the statement header (test/iter) still belong here
+            for node in _header_nodes(stmt):
+                for c in ast.walk(node):
+                    if isinstance(c, ast.Call):
+                        yield stmt, c, inner_loop
+        else:
+            for node in ast.walk(stmt):
+                if isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef, ast.Lambda)):
+                    continue
+                if isinstance(node, ast.Call):
+                    yield stmt, node, inner_loop
+
+
+def _sub_bodies(stmt: ast.stmt) -> Iterable[List[ast.stmt]]:
+    for attr in ("body", "orelse", "finalbody"):
+        sub = getattr(stmt, attr, None)
+        if isinstance(sub, list) and sub \
+                and isinstance(sub[0], ast.stmt):
+            yield sub
+    for h in getattr(stmt, "handlers", []) or []:
+        yield h.body
+
+
+def _header_nodes(stmt: ast.stmt) -> Iterable[ast.AST]:
+    for attr in ("test", "iter", "items", "value"):
+        node = getattr(stmt, attr, None)
+        if node is None:
+            continue
+        if isinstance(node, list):
+            yield from node
+        else:
+            yield node
+
+
+def _flatten(body: List[ast.stmt], out: List[ast.stmt]) -> None:
+    for stmt in body:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            continue
+        out.append(stmt)
+        for sub in _sub_bodies(stmt):
+            _flatten(sub, out)
+
+
+def _paths_after(fn, call_stmt: ast.stmt,
+                 loop: Optional[ast.stmt]) -> List[List[ast.stmt]]:
+    """Execution paths (flattened statement lists) a donated buffer can
+    flow along after `call_stmt`. Outside a loop there is one: the rest
+    of the function. Inside a loop there are two, each checked
+    independently because a rebind on one does not save the other:
+    (A) next iteration — wrap once around the loop body back to the
+    call; (B) loop exit — everything after the loop."""
+    linear: List[ast.stmt] = []
+    _flatten(fn.body, linear)
+    try:
+        at = linear.index(call_stmt)
+    except ValueError:
+        return []
+    if loop is None:
+        return [linear[at + 1:]]
+    loop_linear: List[ast.stmt] = []
+    _flatten(loop.body, loop_linear)
+    if call_stmt not in loop_linear:
+        return [linear[at + 1:]]
+    i = loop_linear.index(call_stmt)
+    wrap = loop_linear[i + 1:] + loop_linear[:i + 1]
+    in_loop = {id(s) for s in loop_linear}
+    post_loop = [s for s in linear[at + 1:] if id(s) not in in_loop]
+    return [wrap, post_loop]
+
+
+def _first_use_before_rebind(order: List[ast.stmt],
+                             call_stmt: ast.stmt,
+                             name: str) -> Optional[ast.AST]:
+    """First statement in `order` that loads `name`; None if a store
+    (rebind) comes first. The donating statement itself counts only as
+    its stores (its loads fed the call) — the `x, y = f(x, y)` rebind
+    idiom leaves nothing stale, in or out of a loop."""
+    if _stores_name(call_stmt, name):
+        return None
+    for stmt in order:
+        # the call statement can reappear via wrap-around: its argument
+        # loads then belong to the NEXT iteration, re-donating a buffer
+        # the previous iteration already consumed
+        load = _loads_name(stmt, name)
+        stores = _stores_name(stmt, name)
+        if load is not None and not stores:
+            return load
+        if load is not None and stores:
+            # `x = f(x)`-style single statement: the load feeds the
+            # rebinding expression — treat as rebind-after-read hazard
+            # only when the load is outside the defining statement's
+            # value; keep it simple and treat store+load as a rebind
+            return None
+        if stores:
+            return None
+    return None
+
+
+def _loads_name(stmt: ast.stmt, name: str) -> Optional[ast.AST]:
+    for node in ast.walk(stmt):
+        if isinstance(node, ast.Name) and node.id == name \
+                and isinstance(node.ctx, ast.Load):
+            return node
+    return None
+
+
+def _stores_name(stmt: ast.stmt, name: str) -> bool:
+    for node in ast.walk(stmt):
+        if isinstance(node, ast.Name) and node.id == name \
+                and isinstance(node.ctx, (ast.Store, ast.Del)):
+            return True
+    return False

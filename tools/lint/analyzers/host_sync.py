@@ -1,0 +1,123 @@
+"""host-sync-in-jit: flag device->host synchronization reachable inside
+a traced region.
+
+A `.item()`, `float()`/`int()`/`bool()` coercion, `np.asarray`/`np.array`
+of a traced value, `jax.device_get`, or `block_until_ready` inside a
+jitted program either raises at trace time (scalar coercions on tracers)
+or — worse for the <2s/100k-pod budget — silently forces a device
+round-trip per call when the enclosing code later runs un-jitted in a
+fallback path. The walk starts at every jax.jit /
+functools.partial(jax.jit, ...) entry point and follows project-resolvable
+calls, including callables handed to jax.lax control flow.
+
+Codes:
+  HS001  .item() on a traced value
+  HS002  block_until_ready inside the traced region
+  HS003  jax.device_get inside the traced region
+  HS004  np.asarray/np.array of a traced value
+  HS005  float()/int()/bool() coercion of a traced value
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, FrozenSet, Iterable, List, Set, Tuple
+
+from tools.lint.astutil import call_target, dotted_name, param_names
+from tools.lint.callgraph import project_index, FunctionInfo, ProjectIndex, TaintEngine
+from tools.lint.framework import Analyzer, Finding, Project, register
+
+NUMPY_SINKS = {"numpy.asarray", "numpy.array"}
+COERCIONS = {"float", "int", "bool"}
+
+
+@register
+class HostSyncAnalyzer(Analyzer):
+    name = "host-sync-in-jit"
+    description = ("host synchronization (.item, scalar coercions, "
+                   "np.asarray, device_get, block_until_ready) reachable "
+                   "from a jax.jit entry point")
+
+    def run(self, project: Project) -> Iterable[Finding]:
+        index = project_index(project)
+        findings: Dict[Tuple[str, int, str], Finding] = {}
+        # worklist of (function, traced param set); merge per function
+        seen: Dict[int, Tuple[FunctionInfo, Set[str]]] = {}
+        work: List[Tuple[FunctionInfo, FrozenSet[str]]] = []
+        for entry in index.jit_entries():
+            work.append((entry.fn, entry.traced_params))
+        while work:
+            info, traced = work.pop()
+            prev = seen.get(id(info.node))
+            if prev is not None and traced <= prev[1]:
+                continue
+            merged = set(traced) | (prev[1] if prev else set())
+            seen[id(info.node)] = (info, merged)
+            mi = index.index_of(info.module)
+            engine = TaintEngine(index, mi)
+
+            def check(call: ast.Call, env, eng,
+                      info=info, mi=mi) -> None:
+                f = self._check_call(call, env, eng, mi, info)
+                if f is not None:
+                    findings.setdefault((f.path, f.line, f.code), f)
+
+            scan = engine.scan(info, frozenset(merged), sink_check=check)
+            for callee, callee_traced in scan.calls:
+                work.append((callee, callee_traced))
+        return sorted(findings.values(),
+                      key=lambda f: (f.path, f.line, f.code))
+
+    @staticmethod
+    def _check_call(call: ast.Call, env, engine, mi,
+                    info: FunctionInfo):
+        rel = info.module.relpath
+        qual = info.qualname
+
+        def finding(code: str, message: str, key_sink: str) -> Finding:
+            return Finding(analyzer="host-sync-in-jit", code=code,
+                           path=rel, line=call.lineno, message=message,
+                           key=f"{qual}:{key_sink}")
+
+        # attribute sinks: x.item(), x.block_until_ready()
+        if isinstance(call.func, ast.Attribute):
+            attr = call.func.attr
+            if attr == "item" and not call.args \
+                    and engine.expr_taint(call.func.value, env):
+                return finding(
+                    "HS001",
+                    f"`.item()` on a traced value inside jitted "
+                    f"`{qual}` forces a device->host sync (or a trace "
+                    f"error); keep the value on device or hoist the "
+                    f"readback out of the jitted region", "item")
+            if attr == "block_until_ready":
+                return finding(
+                    "HS002",
+                    f"`block_until_ready` inside jitted `{qual}`: the "
+                    f"traced region has no host to block; move the "
+                    f"barrier to the caller", "block_until_ready")
+        dotted = call_target(call)
+        resolved = mi.resolve_dotted(dotted) if dotted else ""
+        if resolved in ("jax.device_get", "jax.block_until_ready"):
+            code = "HS003" if resolved.endswith("device_get") else "HS002"
+            return finding(
+                code,
+                f"`{resolved}` inside jitted `{qual}` is a host sync; "
+                f"return the value and fetch it at the call site",
+                resolved.rsplit(".", 1)[1])
+        if resolved in NUMPY_SINKS and call.args \
+                and engine.expr_taint(call.args[0], env):
+            return finding(
+                "HS004",
+                f"`{dotted}` of a traced value inside jitted `{qual}` "
+                f"materializes on host mid-trace; use jnp.asarray or "
+                f"keep the operand static", "np-asarray")
+        if resolved in COERCIONS and len(call.args) == 1 \
+                and engine.expr_taint(call.args[0], env):
+            return finding(
+                "HS005",
+                f"`{resolved}()` coercion of a traced value inside "
+                f"jitted `{qual}` raises TracerConversionError at trace "
+                f"time; mark the argument static or use jnp ops",
+                f"coerce-{resolved}")
+        return None

@@ -1,0 +1,379 @@
+"""lock-discipline: a static lock-order graph over threading.Lock/RLock
+acquisitions, complementing the dynamic `tests/test_ingest_race.py`.
+
+Lock identities are `module.Class.attr` for `self.X = threading.Lock()`
+assignments and `module.NAME` for module-level locks. Acquisitions are
+`with self.X:` / `with NAME:` blocks; ordering edges come from
+syntactically nested `with` blocks and from same-module calls made while
+holding a lock (closed transitively over method/function summaries).
+
+Codes:
+  LK001  lock-order cycle (potential deadlock between threads taking
+         the locks in opposite orders)
+  LK002  lock held across a blocking call (time.sleep, RPC/HTTP,
+         subprocess, block_until_ready): every other thread needing the
+         lock stalls for the full blocking latency — the informer-side
+         counterpart of a host-sync stall
+  LK003  manual .acquire() on a known lock — invisible to the
+         with-based order analysis and leak-prone on exceptions; use a
+         `with` block
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from tools.lint.astutil import call_target, collect_imports, dotted_name
+from tools.lint.framework import Analyzer, Finding, Module, Project, register
+
+LOCK_CTORS = {"threading.Lock", "threading.RLock", "threading.Condition"}
+
+BLOCKING_DOTTED = {
+    "time.sleep",
+    "jax.block_until_ready",
+    "jax.device_get",
+    "subprocess.run", "subprocess.call", "subprocess.check_call",
+    "subprocess.check_output", "subprocess.Popen",
+    "urllib.request.urlopen",
+    "socket.create_connection",
+    "requests.get", "requests.post", "requests.put", "requests.delete",
+    "requests.request",
+}
+BLOCKING_ATTRS = {"block_until_ready", "urlopen"}
+
+
+@dataclass
+class _Unit:
+    """One function/method body, with its class context (if any)."""
+
+    module: Module
+    cls: Optional[str]
+    name: str
+    node: ast.AST
+    # direct facts
+    acquires: Set[str] = field(default_factory=set)
+    blocking: Set[Tuple[str, int]] = field(default_factory=set)
+    # same-scope calls: method names (self.x()) or module-level names
+    calls: Set[str] = field(default_factory=set)
+    # (held lock, acquired lock, line) from nested withs
+    edges: Set[Tuple[str, str, int]] = field(default_factory=set)
+    # (held lock, callee, line) — resolved against summaries later
+    held_calls: Set[Tuple[str, str, int]] = field(default_factory=set)
+    # (held lock, blocking target, line)
+    held_blocking: Set[Tuple[str, str, int]] = field(default_factory=set)
+    manual_acquires: Set[Tuple[str, int]] = field(default_factory=set)
+
+    @property
+    def qual(self) -> str:
+        return f"{self.cls}.{self.name}" if self.cls else self.name
+
+
+@register
+class LockDisciplineAnalyzer(Analyzer):
+    name = "lock-discipline"
+    description = ("lock-order cycles and locks held across blocking "
+                   "calls over threading.Lock/RLock with-blocks")
+
+    def run(self, project: Project) -> Iterable[Finding]:
+        units: List[_Unit] = []
+        for module in project.modules:
+            units.extend(self._scan_module(module))
+        # transitive closure: what a callee may acquire / block on
+        summaries = _close_summaries(units)
+
+        findings: List[Finding] = []
+        edges: Dict[Tuple[str, str], Tuple[str, int]] = {}
+        for u in units:
+            for held, acquired, line in u.edges:
+                if held != acquired:
+                    edges.setdefault((held, acquired),
+                                     (u.module.relpath, line))
+            for held, callee, line in u.held_calls:
+                cs = summaries.get((u.module.relpath, u.cls, callee)) \
+                    or summaries.get((u.module.relpath, None, callee))
+                if cs is None:
+                    continue
+                for acq in cs[0]:
+                    if acq != held:
+                        edges.setdefault((held, acq),
+                                         (u.module.relpath, line))
+                for target in cs[1]:
+                    findings.append(Finding(
+                        analyzer="lock-discipline", code="LK002",
+                        path=u.module.relpath, line=line,
+                        message=f"`{u.qual}` holds `{_short(held)}` "
+                                f"across a call to `{callee}` which may "
+                                f"block on `{target}`; release the lock "
+                                f"first or move the blocking work out",
+                        key=f"{u.qual}:{_short(held)}:{callee}"))
+            for held, target, line in u.held_blocking:
+                findings.append(Finding(
+                    analyzer="lock-discipline", code="LK002",
+                    path=u.module.relpath, line=line,
+                    message=f"`{u.qual}` holds `{_short(held)}` across "
+                            f"blocking `{target}`: every thread needing "
+                            f"the lock stalls for the full latency; "
+                            f"snapshot state under the lock, then block "
+                            f"outside it",
+                    key=f"{u.qual}:{_short(held)}:{target}"))
+            for lock, line in u.manual_acquires:
+                findings.append(Finding(
+                    analyzer="lock-discipline", code="LK003",
+                    path=u.module.relpath, line=line,
+                    message=f"manual `.acquire()` on `{_short(lock)}` "
+                            f"in `{u.qual}` escapes the static order "
+                            f"analysis and leaks on exceptions; use a "
+                            f"`with` block",
+                    key=f"{u.qual}:{_short(lock)}:acquire"))
+
+        findings.extend(_cycles(edges))
+        return sorted(findings, key=lambda f: (f.path, f.line, f.code))
+
+    def _scan_module(self, module: Module) -> List[_Unit]:
+        package = module.dotted.rsplit(".", 1)[0] \
+            if "." in module.dotted else ""
+        imports = collect_imports(module.tree, package)
+
+        def is_lock_ctor(value: ast.AST) -> bool:
+            if not isinstance(value, ast.Call):
+                return False
+            tgt = call_target(value)
+            return tgt is not None \
+                and imports.resolve(tgt) in LOCK_CTORS
+
+        # pass 1: lock identities
+        class_locks: Dict[str, Set[str]] = {}
+        module_locks: Set[str] = set()
+        for node in module.tree.body:
+            if isinstance(node, ast.Assign) and is_lock_ctor(node.value):
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        module_locks.add(t.id)
+            if isinstance(node, ast.ClassDef):
+                locks: Set[str] = set()
+                for sub in ast.walk(node):
+                    if isinstance(sub, ast.Assign) \
+                            and is_lock_ctor(sub.value):
+                        for t in sub.targets:
+                            if isinstance(t, ast.Attribute) \
+                                    and isinstance(t.value, ast.Name) \
+                                    and t.value.id == "self":
+                                locks.add(t.attr)
+                if locks:
+                    class_locks[node.name] = locks
+
+        # pass 2: per-function facts
+        units: List[_Unit] = []
+        for node in module.tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                units.append(self._scan_unit(
+                    module, imports, None, node, module_locks, set()))
+            elif isinstance(node, ast.ClassDef):
+                locks = class_locks.get(node.name, set())
+                for sub in node.body:
+                    if isinstance(sub, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef)):
+                        units.append(self._scan_unit(
+                            module, imports, node.name, sub,
+                            module_locks, locks))
+        return units
+
+    def _scan_unit(self, module: Module, imports, cls: Optional[str],
+                   fn, module_locks: Set[str],
+                   self_locks: Set[str]) -> _Unit:
+        unit = _Unit(module=module, cls=cls, name=fn.name, node=fn)
+        prefix = module.dotted
+
+        def lock_id(expr: ast.AST) -> Optional[str]:
+            if isinstance(expr, ast.Attribute) \
+                    and isinstance(expr.value, ast.Name) \
+                    and expr.value.id == "self" \
+                    and expr.attr in self_locks:
+                return f"{prefix}.{cls}.{expr.attr}"
+            if isinstance(expr, ast.Name) and expr.id in module_locks:
+                return f"{prefix}.{expr.id}"
+            return None
+
+        def walk(body: List[ast.stmt], held: Tuple[str, ...]) -> None:
+            for stmt in body:
+                if isinstance(stmt, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef,
+                                     ast.ClassDef)):
+                    continue
+                if isinstance(stmt, ast.With):
+                    now = list(held)
+                    for item in stmt.items:
+                        lid = lock_id(item.context_expr)
+                        if lid is not None:
+                            unit.acquires.add(lid)
+                            for h in now:
+                                unit.edges.add((h, lid, stmt.lineno))
+                            now.append(lid)
+                    walk(stmt.body, tuple(now))
+                    continue
+                subs = list(_bodies(stmt))
+                if subs:
+                    # compound statement: scan only its header
+                    # expressions here — body calls get the right held
+                    # set through the recursion
+                    for header in _header_exprs(stmt):
+                        self._scan_expr_calls(header, held, unit,
+                                              imports, lock_id)
+                    for sub in subs:
+                        walk(sub, held)
+                else:
+                    self._scan_expr_calls(stmt, held, unit, imports,
+                                          lock_id)
+
+        walk(fn.body, ())
+        return unit
+
+    def _scan_expr_calls(self, root: ast.AST, held: Tuple[str, ...],
+                         unit: _Unit, imports, lock_id) -> None:
+        for node in ast.walk(root):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+                continue
+            if not isinstance(node, ast.Call):
+                continue
+            # manual acquire (held or not)
+            if isinstance(node.func, ast.Attribute) \
+                    and node.func.attr in ("acquire",):
+                lid = lock_id(node.func.value)
+                if lid is not None:
+                    unit.manual_acquires.add((lid, node.lineno))
+                    continue
+            target = self._blocking_target(node, imports)
+            if target is not None:
+                unit.blocking.add((target, node.lineno))
+                # EVERY held lock stalls its waiters, not just the
+                # innermost one
+                for h in held:
+                    unit.held_blocking.add((h, target, node.lineno))
+                continue
+            callee = self._local_callee(node)
+            if callee is not None:
+                unit.calls.add(callee)
+                for h in held:
+                    unit.held_calls.add((h, callee, node.lineno))
+
+    @staticmethod
+    def _blocking_target(call: ast.Call, imports) -> Optional[str]:
+        dotted = call_target(call)
+        if dotted is not None:
+            resolved = imports.resolve(dotted)
+            if resolved in BLOCKING_DOTTED:
+                return resolved
+        if isinstance(call.func, ast.Attribute) \
+                and call.func.attr in BLOCKING_ATTRS:
+            return call.func.attr
+        return None
+
+    @staticmethod
+    def _local_callee(call: ast.Call) -> Optional[str]:
+        """'name' for self.name(...) or bare name(...) calls."""
+        f = call.func
+        if isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name) \
+                and f.value.id == "self":
+            return f.attr
+        if isinstance(f, ast.Name):
+            return f.id
+        return None
+
+
+def _bodies(stmt: ast.stmt) -> Iterable[List[ast.stmt]]:
+    for attr in ("body", "orelse", "finalbody"):
+        sub = getattr(stmt, attr, None)
+        if isinstance(sub, list) and sub and isinstance(sub[0], ast.stmt):
+            yield sub
+    for h in getattr(stmt, "handlers", []) or []:
+        yield h.body
+
+
+def _close_summaries(units: List[_Unit]
+                     ) -> Dict[Tuple[str, Optional[str], str],
+                               Tuple[Set[str], Set[str]]]:
+    """(acquired locks, blocking targets) per unit, closed over
+    same-module self./local calls (fixpoint)."""
+    summaries = {
+        (u.module.relpath, u.cls, u.name):
+            (set(u.acquires), {t for t, _ in u.blocking})
+        for u in units}
+    changed = True
+    while changed:
+        changed = False
+        for u in units:
+            key = (u.module.relpath, u.cls, u.name)
+            acq, blk = summaries[key]
+            for callee in u.calls:
+                cs = summaries.get((u.module.relpath, u.cls, callee)) \
+                    or summaries.get((u.module.relpath, None, callee))
+                if cs is None:
+                    continue
+                if not cs[0] <= acq:
+                    acq |= cs[0]
+                    changed = True
+                if not cs[1] <= blk:
+                    blk |= cs[1]
+                    changed = True
+            summaries[key] = (acq, blk)
+    return summaries
+
+
+def _header_exprs(stmt: ast.stmt) -> Iterable[ast.AST]:
+    """Expressions evaluated by a compound statement itself (its test /
+    iterable), as opposed to its nested bodies."""
+    for attr in ("test", "iter"):
+        node = getattr(stmt, attr, None)
+        if node is not None:
+            yield node
+
+
+def _short(lock: str) -> str:
+    return ".".join(lock.split(".")[-2:])
+
+
+def _cycles(edges: Dict[Tuple[str, str], Tuple[str, int]]
+            ) -> List[Finding]:
+    graph: Dict[str, Set[str]] = {}
+    for a, b in edges:
+        graph.setdefault(a, set()).add(b)
+        graph.setdefault(b, set())
+    findings: List[Finding] = []
+    reported: Set[Tuple[str, ...]] = set()
+
+    def dfs(start: str, node: str, path: List[str],
+            on_path: Set[str]) -> None:
+        for nxt in sorted(graph.get(node, ())):
+            if nxt == start and len(path) >= 2:
+                cyc = _canonical(tuple(path))
+                if cyc in reported:
+                    continue
+                reported.add(cyc)
+                a, b = path[0], path[1]
+                rel, line = edges[(a, b)]
+                pretty = " -> ".join(_short(x) for x in path + [path[0]])
+                findings.append(Finding(
+                    analyzer="lock-discipline", code="LK001",
+                    path=rel, line=line,
+                    message=f"lock-order cycle: {pretty}; two threads "
+                            f"taking these locks in opposite order "
+                            f"deadlock — pick one global order (the "
+                            f"informers document commit -> view) and "
+                            f"stick to it",
+                    key="cycle:" + "->".join(_short(x) for x in cyc)))
+            elif nxt not in on_path:
+                on_path.add(nxt)
+                dfs(start, nxt, path + [nxt], on_path)
+                on_path.discard(nxt)
+
+    for start in sorted(graph):
+        dfs(start, start, [start], {start})
+    return findings
+
+
+def _canonical(cycle: Tuple[str, ...]) -> Tuple[str, ...]:
+    i = cycle.index(min(cycle))
+    return cycle[i:] + cycle[:i]

@@ -1,0 +1,125 @@
+"""metric-registry consistency: a cross-file pass over the per-component
+`metrics_defs.py` catalogs and the shared name registry
+(`koordinator_tpu/metrics/registry.py`).
+
+Registrations are `r.counter/gauge/histogram(<name>, ...)` calls inside
+any `metrics_defs.py`; the registry is any `registry.py` sitting in a
+`metrics/` directory, holding `UPPER_NAME = "metric_name"` constants.
+
+Codes:
+  MN001  duplicate metric name across the catalogs — two components
+         would fight over one family in the shared process registry
+  MN002  bare string-literal metric name in a catalog while a shared
+         registry module exists — names drift apart silently; import
+         the constant
+  MN003  registry constant never registered by any catalog (dead name,
+         or a catalog forgot its series)
+  MN004  metric name expression the pass cannot resolve (not a literal
+         and not a registry constant)
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Tuple
+
+from tools.lint.astutil import str_const
+from tools.lint.framework import Analyzer, Finding, Module, Project, register
+
+REGISTRATION_METHODS = {"counter", "gauge", "histogram"}
+
+
+def _is_catalog(module: Module) -> bool:
+    return module.relpath.endswith("metrics_defs.py")
+
+
+def _is_registry(module: Module) -> bool:
+    return module.relpath.endswith("metrics/registry.py")
+
+
+@register
+class MetricNamesAnalyzer(Analyzer):
+    name = "metric-registry"
+    description = ("duplicate/unregistered/unresolvable metric names "
+                   "across the metrics_defs catalogs and the shared "
+                   "name registry")
+
+    def run(self, project: Project) -> Iterable[Finding]:
+        catalogs = [m for m in project.modules if _is_catalog(m)]
+        registries = [m for m in project.modules if _is_registry(m)]
+        constants: Dict[str, Tuple[str, Module, int]] = {}
+        for reg in registries:
+            for node in reg.tree.body:
+                if not isinstance(node, ast.Assign):
+                    continue
+                value = str_const(node.value)
+                if value is None:
+                    continue
+                for t in node.targets:
+                    if isinstance(t, ast.Name) and t.id.isupper():
+                        constants[t.id] = (value, reg, node.lineno)
+
+        findings: List[Finding] = []
+        # name -> first registration (path, line)
+        seen: Dict[str, Tuple[str, int]] = {}
+        registered_constants: set = set()
+        for cat in catalogs:
+            for call in ast.walk(cat.tree):
+                if not (isinstance(call, ast.Call)
+                        and isinstance(call.func, ast.Attribute)
+                        and call.func.attr in REGISTRATION_METHODS
+                        and call.args):
+                    continue
+                name_node = call.args[0]
+                literal = str_const(name_node)
+                const_name = name_node.id \
+                    if isinstance(name_node, ast.Name) else None
+                if literal is not None:
+                    resolved = literal
+                    if registries:
+                        findings.append(Finding(
+                            analyzer="metric-registry", code="MN002",
+                            path=cat.relpath, line=name_node.lineno,
+                            message=f"metric name {literal!r} is a bare "
+                                    f"string literal; import the "
+                                    f"constant from the shared metrics "
+                                    f"registry so the catalogs cannot "
+                                    f"drift",
+                            key=f"bare:{literal}"))
+                elif const_name is not None \
+                        and const_name in constants:
+                    resolved = constants[const_name][0]
+                    registered_constants.add(const_name)
+                else:
+                    findings.append(Finding(
+                        analyzer="metric-registry", code="MN004",
+                        path=cat.relpath, line=name_node.lineno,
+                        message="metric name is neither a string "
+                                "literal nor a shared-registry "
+                                "constant; the cross-file consistency "
+                                "pass cannot verify it",
+                        key=f"unresolved:{ast.unparse(name_node)}"))
+                    continue
+                prev = seen.get(resolved)
+                if prev is not None:
+                    findings.append(Finding(
+                        analyzer="metric-registry", code="MN001",
+                        path=cat.relpath, line=call.lineno,
+                        message=f"metric name {resolved!r} already "
+                                f"registered at {prev[0]}:{prev[1]}; "
+                                f"two catalogs sharing one family "
+                                f"collide in the process registry",
+                        key=f"dup:{resolved}"))
+                else:
+                    seen[resolved] = (cat.relpath, call.lineno)
+        for const_name, (value, reg, line) in sorted(constants.items()):
+            if const_name not in registered_constants:
+                findings.append(Finding(
+                    analyzer="metric-registry", code="MN003",
+                    path=reg.relpath, line=line,
+                    message=f"registry constant {const_name} "
+                            f"({value!r}) is never registered by any "
+                            f"metrics_defs catalog — dead name or "
+                            f"missing series",
+                    key=f"unregistered:{const_name}"))
+        return sorted(findings, key=lambda f: (f.path, f.line, f.code))

@@ -1,0 +1,129 @@
+"""proto-drift: every checked-in `*_pb2.py` must carry a content stamp
+matching its `.proto` source, so a proto edit without regeneration fails
+CI instead of shipping a silently stale wire format.
+
+Stamp line (anywhere in the pb2 file, written by
+`python -m tools.lint --stamp-protos`):
+
+    # koordlint: proto-sha256=<sha256 hex of the .proto file bytes>
+
+Codes:
+  PD001  pb2 file has no stamp
+  PD002  stamp does not match the current .proto content (drift)
+  PD003  pb2 file with no sibling .proto source (orphan generated code)
+"""
+
+from __future__ import annotations
+
+import hashlib
+import posixpath
+import re
+from typing import Iterable, List
+
+from tools.lint.framework import Analyzer, Finding, Project, register
+
+STAMP_RE = re.compile(
+    r"^#\s*koordlint:\s*proto-sha256=([0-9a-f]{64})\s*$", re.MULTILINE)
+
+
+def proto_digest(data: bytes) -> str:
+    return hashlib.sha256(data).hexdigest()
+
+
+def stamp_line(digest: str) -> str:
+    return f"# koordlint: proto-sha256={digest}"
+
+
+@register
+class ProtoDriftAnalyzer(Analyzer):
+    name = "proto-drift"
+    description = ("checked-in *_pb2.py content stamps must match "
+                   "their .proto sources")
+
+    def run(self, project: Project) -> Iterable[Finding]:
+        findings: List[Finding] = []
+        protos = {p: proto_digest(project.read_bytes(p))
+                  for p in project.proto_files}
+        pb2_seen = set()
+        for proto_rel, digest in sorted(protos.items()):
+            pb2_rel = posixpath.join(
+                posixpath.dirname(proto_rel),
+                posixpath.basename(proto_rel)[:-len(".proto")] + "_pb2.py")
+            mod = project.by_relpath.get(pb2_rel)
+            if mod is None:
+                # a proto without generated code is fine (e.g. docs-only
+                # schema); drift needs both sides
+                continue
+            pb2_seen.add(pb2_rel)
+            m = STAMP_RE.search(mod.source)
+            if m is None:
+                findings.append(Finding(
+                    analyzer="proto-drift", code="PD001",
+                    path=pb2_rel, line=1,
+                    message=f"generated module carries no koordlint "
+                            f"proto stamp for {proto_rel}; run "
+                            f"`python -m tools.lint --stamp-protos` "
+                            f"after regenerating",
+                    key="missing-stamp"))
+            elif m.group(1) != digest:
+                findings.append(Finding(
+                    analyzer="proto-drift", code="PD002",
+                    path=pb2_rel, line=_line_of(mod.source, m.start()),
+                    message=f"stamp {m.group(1)[:12]}… does not match "
+                            f"{proto_rel} (now {digest[:12]}…): the "
+                            f".proto changed without regenerating the "
+                            f"pb2; regenerate, then re-stamp",
+                    key="stale-stamp"))
+        for mod in project.modules:
+            if not mod.relpath.endswith("_pb2.py") \
+                    or mod.relpath in pb2_seen:
+                continue
+            proto_rel = mod.relpath[:-len("_pb2.py")] + ".proto"
+            findings.append(Finding(
+                analyzer="proto-drift", code="PD003",
+                path=mod.relpath, line=1,
+                message=f"generated module has no sibling {proto_rel}: "
+                        f"orphan generated code cannot be checked for "
+                        f"drift; check in the source proto",
+                key="orphan-pb2"))
+        return sorted(findings, key=lambda f: (f.path, f.line, f.code))
+
+
+def _line_of(source: str, offset: int) -> int:
+    return source.count("\n", 0, offset) + 1
+
+
+def stamp_project(project: Project) -> List[str]:
+    """Insert/refresh stamps in every pb2 with a sibling proto; returns
+    the relpaths rewritten (the --stamp-protos helper)."""
+    rewritten: List[str] = []
+    for proto_rel in project.proto_files:
+        pb2_rel = posixpath.join(
+            posixpath.dirname(proto_rel),
+            posixpath.basename(proto_rel)[:-len(".proto")] + "_pb2.py")
+        mod = project.by_relpath.get(pb2_rel)
+        if mod is None:
+            continue
+        digest = proto_digest(project.read_bytes(proto_rel))
+        line = stamp_line(digest)
+        if STAMP_RE.search(mod.source):
+            new_source = STAMP_RE.sub(line, mod.source, count=1)
+        else:
+            lines = mod.source.splitlines(keepends=True)
+            # after the leading comment block, before the first code line
+            at = 0
+            for i, text in enumerate(lines):
+                stripped = text.strip()
+                if stripped and not stripped.startswith("#"):
+                    at = i
+                    break
+            lines.insert(at, line + "\n")
+            new_source = "".join(lines)
+        if new_source != mod.source:
+            import os
+            with open(os.path.join(project.root,
+                                   pb2_rel.replace("/", os.sep)),
+                      "w", encoding="utf-8") as f:
+                f.write(new_source)
+            rewritten.append(pb2_rel)
+    return rewritten

@@ -1,0 +1,179 @@
+"""recompilation-hazard: jitted callables whose signatures or bodies
+invite silent retracing.
+
+Codes:
+  RC001  parameter annotated/defaulted as a Python scalar, str, or dict
+         but not named in static_argnames — every distinct value (str)
+         or weak-type promotion (scalar) risks a retrace, and dicts
+         aren't hashable as static either way
+  RC002  `if`/`while` branching directly on a non-static parameter —
+         a tracer has no truth value; this raises at trace time or, if
+         the value is concrete, bakes the branch into the compiled
+         program per value
+  RC003  `if`/`while` branching on `<param>.shape` — per-shape
+         specialization; intentional specialization should flow through
+         a named local or a static argument so the dependence is
+         explicit (the scheduler's `n_inst = ...shape[1]` idiom)
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Optional, Set, Tuple
+
+from tools.lint.astutil import param_names
+from tools.lint.callgraph import project_index, ProjectIndex
+from tools.lint.framework import Analyzer, Finding, Project, register
+
+# NOT `tuple`: a tuple-annotated parameter is an ordinary traced pytree
+# (static_argnames on one would raise on unhashable arrays)
+SCALAR_ANNOTATIONS = {"int", "bool", "str", "float", "dict"}
+SCALAR_DEFAULTS = (int, bool, str, float)
+
+
+def _scalar_annotation(node: Optional[ast.AST]) -> Optional[str]:
+    """'int' for scalar-ish annotations, unwrapping Optional[...]/
+    Union[...]; None when the annotation doesn't imply a Python value."""
+    if node is None:
+        return None
+    if isinstance(node, ast.Name) and node.id in SCALAR_ANNOTATIONS:
+        return node.id
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        try:
+            return _scalar_annotation(
+                ast.parse(node.value, mode="eval").body)
+        except SyntaxError:
+            return None
+    if isinstance(node, ast.Subscript):
+        base = node.value
+        if isinstance(base, ast.Name) and base.id in ("Optional", "Union"):
+            inner = node.slice
+            elts = inner.elts if isinstance(inner, ast.Tuple) else [inner]
+            for e in elts:
+                s = _scalar_annotation(e)
+                if s is not None:
+                    return s
+    return None
+
+
+@register
+class RecompileAnalyzer(Analyzer):
+    name = "recompilation-hazard"
+    description = ("jitted params taking Python scalars/strings/dicts "
+                   "without static_argnames; Python branching on traced "
+                   "values or parameter shapes")
+
+    def run(self, project: Project) -> Iterable[Finding]:
+        index = project_index(project)
+        findings: List[Finding] = []
+        for entry in index.jit_entries():
+            fn = entry.fn.node
+            rel = entry.fn.module.relpath
+            qual = entry.fn.qualname
+            statics = set(entry.static_argnames)
+            findings.extend(self._check_signature(fn, rel, qual, statics))
+            findings.extend(self._check_branches(
+                fn, rel, qual, entry.traced_params))
+        return sorted(findings, key=lambda f: (f.path, f.line, f.code))
+
+    @staticmethod
+    def _check_signature(fn, rel: str, qual: str,
+                         statics: Set[str]) -> Iterable[Finding]:
+        args = fn.args
+        pos = args.posonlyargs + args.args
+        defaults: List[Optional[ast.AST]] = \
+            [None] * (len(pos) - len(args.defaults)) + list(args.defaults)
+        params = list(zip(pos, defaults)) + \
+            list(zip(args.kwonlyargs, args.kw_defaults))
+        for param, default in params:
+            if param.arg in statics:
+                continue
+            why = _scalar_annotation(param.annotation)
+            if why is None and isinstance(default, ast.Constant) \
+                    and isinstance(default.value, SCALAR_DEFAULTS):
+                why = type(default.value).__name__
+            if why is None and isinstance(default, ast.Dict):
+                why = "dict"
+            if why is None:
+                continue
+            yield Finding(
+                analyzer="recompilation-hazard", code="RC001",
+                path=rel, line=param.lineno,
+                message=f"jitted `{qual}` takes `{param.arg}` as a "
+                        f"Python {why} but does not list it in "
+                        f"static_argnames: each distinct value risks a "
+                        f"silent retrace (strs/dicts always do)",
+                key=f"{qual}:{param.arg}")
+
+    @staticmethod
+    def _check_branches(fn, rel: str, qual: str,
+                        traced: frozenset) -> Iterable[Finding]:
+        for node in ast.walk(fn):
+            if not isinstance(node, (ast.If, ast.While)):
+                continue
+            test = node.test
+            direct, shaped = _scan_test(test, traced)
+            for name in sorted(shaped):
+                yield Finding(
+                    analyzer="recompilation-hazard", code="RC003",
+                    path=rel, line=test.lineno,
+                    message=f"jitted `{qual}` branches on "
+                            f"`{name}.shape`: the program specializes "
+                            f"per shape; bind the flag to a named local "
+                            f"or a static argument to make the "
+                            f"specialization explicit",
+                    key=f"{qual}:shape:{name}")
+            for name in sorted(direct):
+                yield Finding(
+                    analyzer="recompilation-hazard", code="RC002",
+                    path=rel, line=test.lineno,
+                    message=f"jitted `{qual}` branches on traced "
+                            f"parameter `{name}`: tracers have no truth "
+                            f"value — use jnp.where/lax.cond, or mark "
+                            f"the parameter static",
+                    key=f"{qual}:branch:{name}")
+
+
+def _scan_test(test: ast.AST,
+               traced: frozenset) -> Tuple[Set[str], Set[str]]:
+    """Names branched on directly vs via `.shape`, limited to traced
+    parameters; `.shape`/`.dtype`/len() sub-expressions don't count as
+    direct branching."""
+    direct: Set[str] = set()
+    shaped: Set[str] = set()
+
+    def walk(node: ast.AST, under_static: bool) -> None:
+        if isinstance(node, ast.Compare) \
+                and all(isinstance(op, (ast.Is, ast.IsNot))
+                        for op in node.ops) \
+                and all(isinstance(c, ast.Constant) and c.value is None
+                        for c in node.comparators):
+            # `param is (not) None` is a concrete Python bool under
+            # trace — the standard optional-argument guard
+            for child in ast.iter_child_nodes(node):
+                walk(child, True)
+            return
+        if isinstance(node, ast.Attribute):
+            if node.attr in ("shape", "ndim", "size", "dtype"):
+                if isinstance(node.value, ast.Name) \
+                        and node.value.id in traced:
+                    shaped.add(node.value.id)
+                walk(node.value, True)
+                return
+            walk(node.value, under_static)
+            return
+        if isinstance(node, ast.Call):
+            fname = node.func.id if isinstance(node.func, ast.Name) else ""
+            inner_static = under_static or fname == "len"
+            for child in ast.iter_child_nodes(node):
+                walk(child, inner_static)
+            return
+        if isinstance(node, ast.Name) and not under_static \
+                and node.id in traced:
+            direct.add(node.id)
+            return
+        for child in ast.iter_child_nodes(node):
+            walk(child, under_static)
+
+    walk(test, False)
+    return direct, shaped

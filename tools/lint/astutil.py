@@ -1,0 +1,153 @@
+"""Shared AST helpers: dotted-name resolution, per-module import tables,
+and function scope indexing. Pure stdlib `ast`."""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, Iterator, List, Optional, Tuple, Union
+
+FuncDef = Union[ast.FunctionDef, ast.AsyncFunctionDef]
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """ "a.b.c" for Name/Attribute chains, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def call_target(call: ast.Call) -> Optional[str]:
+    return dotted_name(call.func)
+
+
+def str_const(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def str_tuple(node: ast.AST) -> Optional[Tuple[str, ...]]:
+    """Literal str or tuple/list of str -> tuple of str (the accepted
+    forms of static_argnames)."""
+    s = str_const(node)
+    if s is not None:
+        return (s,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for elt in node.elts:
+            s = str_const(elt)
+            if s is None:
+                return None
+            out.append(s)
+        return tuple(out)
+    return None
+
+
+def int_tuple(node: ast.AST) -> Optional[Tuple[int, ...]]:
+    """Literal int or tuple/list of int -> tuple (donate_argnums forms)."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, int) \
+            and not isinstance(node.value, bool):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for elt in node.elts:
+            if not (isinstance(elt, ast.Constant)
+                    and isinstance(elt.value, int)
+                    and not isinstance(elt.value, bool)):
+                return None
+            out.append(elt.value)
+        return tuple(out)
+    return None
+
+
+@dataclass
+class Imports:
+    """Local-alias -> fully dotted target for one module.
+
+    `modules`:  alias -> dotted module  (import x.y as z; from p import mod)
+    `symbols`:  alias -> (dotted module, symbol)  (from p.mod import f as g)
+
+    `from p import name` is ambiguous (module or symbol); it lands in
+    both tables and resolution tries modules first against the project.
+    """
+
+    modules: Dict[str, str] = field(default_factory=dict)
+    symbols: Dict[str, Tuple[str, str]] = field(default_factory=dict)
+
+    def resolve(self, dotted: str) -> str:
+        """Expand the leading alias of "a.b.c" to its full target."""
+        head, _, rest = dotted.partition(".")
+        if head in self.modules:
+            base = self.modules[head]
+            return f"{base}.{rest}" if rest else base
+        if head in self.symbols:
+            mod, sym = self.symbols[head]
+            base = f"{mod}.{sym}"
+            return f"{base}.{rest}" if rest else base
+        return dotted
+
+
+def collect_imports(tree: ast.Module, package: str = "") -> Imports:
+    """`package` is the importing module's package (for relative
+    imports), e.g. "koordinator_tpu.snapshot"."""
+    imp = Imports()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                alias = a.asname or a.name.split(".")[0]
+                target = a.name if a.asname else a.name.split(".")[0]
+                imp.modules[alias] = target
+        elif isinstance(node, ast.ImportFrom):
+            base = node.module or ""
+            if node.level:
+                pkg_parts = package.split(".") if package else []
+                keep = pkg_parts[:len(pkg_parts) - (node.level - 1)]
+                base = ".".join(keep + ([node.module] if node.module else []))
+            for a in node.names:
+                if a.name == "*":
+                    continue
+                alias = a.asname or a.name
+                imp.modules.setdefault(alias, f"{base}.{a.name}"
+                                       if base else a.name)
+                imp.symbols[alias] = (base, a.name)
+    return imp
+
+
+def iter_functions(tree: ast.Module) -> Iterator[Tuple[FuncDef, List[ast.AST]]]:
+    """Every function/method def with its enclosing-scope chain
+    (module, classes, outer functions), depth-first."""
+
+    def walk(node: ast.AST, chain: List[ast.AST]):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield child, chain
+                yield from walk(child, chain + [child])
+            elif isinstance(child, ast.ClassDef):
+                yield from walk(child, chain + [child])
+            elif isinstance(child, (ast.If, ast.Try, ast.With, ast.For,
+                                    ast.While, ast.Module)):
+                yield from walk(child, chain)
+
+    yield from walk(tree, [tree])
+
+
+def param_names(fn: FuncDef) -> List[str]:
+    a = fn.args
+    names = [p.arg for p in a.posonlyargs + a.args]
+    if a.vararg:
+        names.append(a.vararg.arg)
+    names += [p.arg for p in a.kwonlyargs]
+    if a.kwarg:
+        names.append(a.kwarg.arg)
+    return names
+
+
+def positional_params(fn: FuncDef) -> List[str]:
+    a = fn.args
+    return [p.arg for p in a.posonlyargs + a.args]

@@ -1,0 +1,550 @@
+"""jit entry-point discovery, intra-project call resolution, and the
+light taint engine shared by the host-sync and recompilation analyzers.
+
+Taint model ("traced"): values that are jax tracers inside a jitted
+region. Sources are the entry's non-static parameters and any call into
+the jax/jax.numpy namespace; `.shape`/`.dtype`/`.ndim`/`.size` reads and
+`len()` are static regardless of receiver (jax shapes are Python values
+under trace), which is what keeps the scheduler's intentional
+shape-specialization idioms (`n_inst = devices0.gpu_free.shape[1]`)
+clean without suppressions. Function calls resolvable inside the project
+propagate taint through per-function return summaries (element-wise for
+tuple returns), so `n_g, n_d = count0.shape`-style statics survive an
+unpack through a helper.
+"""
+
+from __future__ import annotations
+
+import ast
+import weakref
+from dataclasses import dataclass, field
+from typing import Dict, FrozenSet, Iterable, List, Optional, Set, Tuple, Union
+
+from tools.lint.astutil import (
+    FuncDef,
+    Imports,
+    call_target,
+    collect_imports,
+    dotted_name,
+    int_tuple,
+    iter_functions,
+    param_names,
+    positional_params,
+    str_tuple,
+)
+from tools.lint.framework import Module, Project
+
+# attribute reads that are static under trace even on a traced receiver
+STATIC_ATTRS = frozenset({"shape", "ndim", "dtype", "size", "sharding"})
+# jax namespaces whose call results are traced values
+TRACED_NAMESPACES = ("jax.numpy.", "jax.lax.", "jax.nn.", "jax.random.",
+                     "jax.scipy.")
+# jax control-flow combinators whose callable arguments run under trace
+JAX_HOF = frozenset({
+    "jax.lax.scan", "jax.lax.while_loop", "jax.lax.cond",
+    "jax.lax.fori_loop", "jax.lax.switch", "jax.lax.map",
+    "jax.checkpoint", "jax.remat", "jax.vmap",
+})
+
+
+@dataclass
+class FunctionInfo:
+    module: Module
+    node: FuncDef
+    qualname: str                 # enclosing-scope-qualified
+    scope_chain: Tuple[ast.AST, ...]   # module/class/function enclosures
+
+
+@dataclass
+class JitEntry:
+    """One jax.jit (or functools.partial(jax.jit, ...)) entry point.
+
+    `alias_name` is set for the assignment form `g = jax.jit(f, ...)`:
+    the jitted callable is bound to `g`, NOT to `f` — donation applies
+    to calls through the alias, while direct `f(...)` calls stay plain.
+    """
+
+    fn: FunctionInfo
+    static_argnames: Tuple[str, ...] = ()
+    donate_argnums: Tuple[int, ...] = ()
+    donate_argnames: Tuple[str, ...] = ()
+    decorator_line: int = 0
+    alias_name: Optional[str] = None
+    alias_module_relpath: Optional[str] = None
+
+    @property
+    def traced_params(self) -> FrozenSet[str]:
+        donated = set(self.donate_argnames)
+        pos = positional_params(self.fn.node)
+        donated.update(pos[i] for i in self.donate_argnums
+                       if 0 <= i < len(pos))
+        # donated params are still traced; donation affects buffer reuse,
+        # not tracedness
+        return frozenset(p for p in param_names(self.fn.node)
+                         if p not in self.static_argnames)
+
+
+class ModuleIndex:
+    """Per-module lookup tables: imports, every function def with scope,
+    top-level functions by name, nested functions by parent."""
+
+    def __init__(self, module: Module):
+        self.module = module
+        package = module.dotted.rsplit(".", 1)[0] \
+            if "." in module.dotted else ""
+        self.imports: Imports = collect_imports(module.tree, package)
+        self.functions: List[FunctionInfo] = []
+        self.top_level: Dict[str, FunctionInfo] = {}
+        self.nested: Dict[ast.AST, Dict[str, FunctionInfo]] = {}
+        for fn, chain in iter_functions(module.tree):
+            qual = ".".join(
+                [c.name for c in chain
+                 if isinstance(c, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                   ast.ClassDef))] + [fn.name])
+            info = FunctionInfo(module, fn, qual, tuple(chain))
+            self.functions.append(info)
+            parent = chain[-1]
+            if isinstance(parent, ast.Module):
+                self.top_level[fn.name] = info
+            self.nested.setdefault(parent, {})[fn.name] = info
+
+    def resolve_dotted(self, dotted: str) -> str:
+        return self.imports.resolve(dotted)
+
+
+class ProjectIndex:
+    """Project-wide: module indexes plus jit entry discovery. Build via
+    `project_index()` so the analyzers share one index per Project."""
+
+    def __init__(self, project: Project):
+        self.project = project
+        self.modules: Dict[str, ModuleIndex] = {
+            m.relpath: ModuleIndex(m) for m in project.modules}
+        self._partial_cache: Dict[int, Dict[str, str]] = {}
+        self._entries: Optional[List[JitEntry]] = None
+
+    def index_of(self, module: Module) -> ModuleIndex:
+        return self.modules[module.relpath]
+
+    # --- jit entries -----------------------------------------------------
+
+    def jit_entries(self) -> List[JitEntry]:
+        if self._entries is None:
+            self._entries = self._discover_entries()
+        return self._entries
+
+    def _discover_entries(self) -> List[JitEntry]:
+        entries: List[JitEntry] = []
+        for mi in self.modules.values():
+            for info in mi.functions:
+                for dec in info.node.decorator_list:
+                    e = self._entry_from_decorator(mi, info, dec)
+                    if e is not None:
+                        entries.append(e)
+            # assignment form: g = jax.jit(f, static_argnames=...)
+            for node in ast.walk(mi.module.tree):
+                if not (isinstance(node, ast.Assign)
+                        and isinstance(node.value, ast.Call)):
+                    continue
+                call = node.value
+                if mi.resolve_dotted(call_target(call) or "") != "jax.jit":
+                    continue
+                if not (call.args and isinstance(call.args[0], ast.Name)):
+                    continue
+                target = mi.top_level.get(call.args[0].id)
+                if target is None:
+                    continue
+                entry = self._entry_from_call(
+                    mi, target, call, call.lineno)
+                if len(node.targets) == 1 \
+                        and isinstance(node.targets[0], ast.Name):
+                    entry.alias_name = node.targets[0].id
+                    entry.alias_module_relpath = mi.module.relpath
+                entries.append(entry)
+        return entries
+
+    def _entry_from_decorator(self, mi: ModuleIndex, info: FunctionInfo,
+                              dec: ast.AST) -> Optional[JitEntry]:
+        if dotted_name(dec) is not None \
+                and mi.resolve_dotted(dotted_name(dec)) == "jax.jit":
+            return JitEntry(fn=info, decorator_line=dec.lineno)
+        if not isinstance(dec, ast.Call):
+            return None
+        target = mi.resolve_dotted(call_target(dec) or "")
+        if target == "jax.jit":
+            return self._entry_from_call(mi, info, dec, dec.lineno)
+        if target == "functools.partial" and dec.args:
+            inner = mi.resolve_dotted(dotted_name(dec.args[0]) or "")
+            if inner == "jax.jit":
+                return self._entry_from_call(mi, info, dec, dec.lineno)
+        return None
+
+    @staticmethod
+    def _entry_from_call(mi: ModuleIndex, info: FunctionInfo,
+                         call: ast.Call, line: int) -> JitEntry:
+        statics: Tuple[str, ...] = ()
+        dnums: Tuple[int, ...] = ()
+        dnames: Tuple[str, ...] = ()
+        for kw in call.keywords:
+            if kw.arg == "static_argnames":
+                statics = str_tuple(kw.value) or ()
+            elif kw.arg == "donate_argnums":
+                dnums = int_tuple(kw.value) or ()
+            elif kw.arg == "donate_argnames":
+                dnames = str_tuple(kw.value) or ()
+            elif kw.arg == "static_argnums":
+                nums = int_tuple(kw.value) or ()
+                pos = positional_params(info.node)
+                statics = statics + tuple(
+                    pos[i] for i in nums if 0 <= i < len(pos))
+        return JitEntry(fn=info, static_argnames=statics,
+                        donate_argnums=dnums, donate_argnames=dnames,
+                        decorator_line=line)
+
+    # --- call resolution -------------------------------------------------
+
+    def resolve_call(self, mi: ModuleIndex, scope_chain: Tuple[ast.AST, ...],
+                     call: ast.Call) -> Optional[FunctionInfo]:
+        """Resolve a call to a FunctionInfo inside the project: local
+        nested defs (inner scopes first), module top-level defs, `from m
+        import f` symbols, and `mod.f` attribute calls on imported
+        project modules. functools.partial aliases bound in an enclosing
+        scope resolve to the partial's target."""
+        dotted = call_target(call)
+        if dotted is None:
+            return None
+        head, _, rest = dotted.partition(".")
+        # scope-local defs and partial aliases, innermost first
+        for scope in reversed(scope_chain):
+            local = self.nested_defs(mi, scope).get(head)
+            if local is not None and not rest:
+                return local
+            alias = self.partial_aliases(mi, scope).get(head)
+            if alias is not None and not rest:
+                return self._resolve_dotted_fn(mi, scope_chain, alias)
+        return self._resolve_dotted_fn(mi, scope_chain, dotted)
+
+    def _resolve_dotted_fn(self, mi: ModuleIndex,
+                           scope_chain: Tuple[ast.AST, ...],
+                           dotted: str) -> Optional[FunctionInfo]:
+        head, _, rest = dotted.partition(".")
+        if not rest:
+            if head in mi.top_level:
+                return mi.top_level[head]
+            sym = mi.imports.symbols.get(head)
+            if sym is not None:
+                src = self.project.by_dotted.get(sym[0])
+                if src is not None:
+                    return self.index_of(src).top_level.get(sym[1])
+            return None
+        full = mi.resolve_dotted(dotted)
+        mod_name, _, fn_name = full.rpartition(".")
+        src = self.project.by_dotted.get(mod_name)
+        if src is not None and "." not in fn_name:
+            return self.index_of(src).top_level.get(fn_name)
+        return None
+
+    def nested_defs(self, mi: ModuleIndex,
+                    scope: ast.AST) -> Dict[str, FunctionInfo]:
+        if isinstance(scope, ast.Module):
+            return mi.top_level
+        return mi.nested.get(scope, {})
+
+    def partial_aliases(self, mi: ModuleIndex,
+                        scope: ast.AST) -> Dict[str, str]:
+        """name -> dotted target for `name = functools.partial(tgt, ...)`
+        assignments directly inside `scope`."""
+        cached = self._partial_cache.get(id(scope))
+        if cached is not None:
+            return cached
+        out: Dict[str, str] = {}
+        body = getattr(scope, "body", [])
+        for stmt in body:
+            if not (isinstance(stmt, ast.Assign)
+                    and isinstance(stmt.value, ast.Call)
+                    and len(stmt.targets) == 1
+                    and isinstance(stmt.targets[0], ast.Name)):
+                continue
+            if mi.resolve_dotted(call_target(stmt.value) or "") \
+                    != "functools.partial" or not stmt.value.args:
+                continue
+            tgt = dotted_name(stmt.value.args[0])
+            if tgt:
+                out[stmt.targets[0].id] = tgt
+        self._partial_cache[id(scope)] = out
+        return out
+
+
+_INDEX_CACHE: "weakref.WeakKeyDictionary[Project, ProjectIndex]" = \
+    weakref.WeakKeyDictionary()
+
+
+def project_index(project: Project) -> ProjectIndex:
+    """One shared ProjectIndex per Project: the module indexing pass is
+    the analyzers' common fixed cost, so building it per-analyzer would
+    triple the CI fast path for nothing."""
+    idx = _INDEX_CACHE.get(project)
+    if idx is None:
+        idx = ProjectIndex(project)
+        _INDEX_CACHE[project] = idx
+    return idx
+
+
+# ---------------------------------------------------------------------------
+# taint engine
+
+
+Taint = Union[bool, Tuple[bool, ...]]
+
+
+def _any(t: Taint) -> bool:
+    return any(t) if isinstance(t, tuple) else bool(t)
+
+
+@dataclass
+class FunctionScan:
+    """One function analyzed under a given traced-parameter set."""
+
+    sinks: List[Tuple[ast.AST, str, str]] = field(default_factory=list)
+    # (callee FunctionInfo, frozenset of traced callee params)
+    calls: List[Tuple[FunctionInfo, FrozenSet[str]]] = field(
+        default_factory=list)
+    return_taint: Taint = True
+
+
+class TaintEngine:
+    """Forward single-pass taint over a function body. `sink_check`
+    (optional) is called at every Call node with (call, env, engine) and
+    may record findings; used by the host-sync analyzer."""
+
+    def __init__(self, index: ProjectIndex, mi: ModuleIndex,
+                 max_depth: int = 8):
+        self.index = index
+        self.mi = mi
+        self.max_depth = max_depth
+        self._summary_cache: Dict[Tuple[int, FrozenSet[str]], Taint] = {}
+
+    # --- expression taint ------------------------------------------------
+
+    def expr_taint(self, node: ast.AST, env: Dict[str, bool],
+                   depth: int = 0) -> bool:
+        if isinstance(node, ast.Constant):
+            return False
+        if isinstance(node, ast.Name):
+            return env.get(node.id, False)
+        if isinstance(node, ast.Attribute):
+            if node.attr in STATIC_ATTRS:
+                return False
+            return self.expr_taint(node.value, env, depth)
+        if isinstance(node, ast.Call):
+            return _any(self.call_taint(node, env, depth))
+        if isinstance(node, ast.Subscript):
+            return self.expr_taint(node.value, env, depth)
+        if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+            return any(self.expr_taint(e, env, depth) for e in node.elts)
+        if isinstance(node, ast.Lambda):
+            return False
+        if isinstance(node, (ast.ListComp, ast.SetComp, ast.GeneratorExp,
+                             ast.DictComp)):
+            # comprehension taint: join everything mentioned
+            return any(env.get(n.id, False) for n in ast.walk(node)
+                       if isinstance(n, ast.Name))
+        out = False
+        for child in ast.iter_child_nodes(node):
+            out = out or self.expr_taint(child, env, depth)
+        return out
+
+    def call_taint(self, call: ast.Call, env: Dict[str, bool],
+                   depth: int = 0) -> Taint:
+        dotted = call_target(call)
+        resolved = self.mi.resolve_dotted(dotted) if dotted else ""
+        if resolved.startswith(TRACED_NAMESPACES) or resolved in JAX_HOF:
+            return True
+        if resolved in ("len", "range", "enumerate", "zip", "sorted",
+                        "isinstance", "functools.partial", "repr", "str"):
+            return False
+        callee = self.index.resolve_call(
+            self.mi, getattr(self, "_scope_chain", ()), call)
+        arg_taints = [self.expr_taint(a, env, depth) for a in call.args]
+        kw_taints = {kw.arg: self.expr_taint(kw.value, env, depth)
+                     for kw in call.keywords if kw.arg}
+        if callee is not None and depth < self.max_depth \
+                and callee.module.relpath in self.index.modules:
+            traced = self._bind_taint(callee, arg_taints, kw_taints)
+            return self.return_summary(callee, traced, depth + 1)
+        return any(arg_taints) or any(kw_taints.values())
+
+    @staticmethod
+    def _bind_taint(callee: FunctionInfo, arg_taints: List[bool],
+                    kw_taints: Dict[str, bool]) -> FrozenSet[str]:
+        pos = positional_params(callee.node)
+        traced: Set[str] = set()
+        for i, t in enumerate(arg_taints):
+            if t and i < len(pos):
+                traced.add(pos[i])
+        for name, t in kw_taints.items():
+            if t:
+                traced.add(name)
+        return frozenset(traced)
+
+    # --- function summaries ----------------------------------------------
+
+    def return_summary(self, info: FunctionInfo,
+                       traced_params: FrozenSet[str],
+                       depth: int) -> Taint:
+        key = (id(info.node), traced_params)
+        if key in self._summary_cache:
+            return self._summary_cache[key]
+        # optimistic placeholder breaks recursion cycles
+        self._summary_cache[key] = True
+        engine = TaintEngine(self.index, self.index.index_of(info.module),
+                             self.max_depth)
+        scan = engine.scan(info, traced_params, depth=depth)
+        self._summary_cache[key] = scan.return_taint
+        return scan.return_taint
+
+    # --- statement walk --------------------------------------------------
+
+    def scan(self, info: FunctionInfo, traced_params: FrozenSet[str],
+             sink_check=None, depth: int = 0) -> FunctionScan:
+        self._scope_chain = info.scope_chain + (info.node,)
+        env: Dict[str, bool] = {p: (p in traced_params)
+                                for p in param_names(info.node)}
+        scan = FunctionScan()
+        returns: List[Taint] = []
+        self._walk_body(info.node.body, env, scan, returns, sink_check,
+                        depth)
+        scan.return_taint = _join_returns(returns)
+        return scan
+
+    def _walk_body(self, body: List[ast.stmt], env: Dict[str, bool],
+                   scan: FunctionScan, returns: List[Taint],
+                   sink_check, depth: int) -> None:
+        for stmt in body:
+            self._walk_stmt(stmt, env, scan, returns, sink_check, depth)
+
+    def _walk_stmt(self, stmt: ast.stmt, env: Dict[str, bool],
+                   scan: FunctionScan, returns: List[Taint],
+                   sink_check, depth: int) -> None:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            return  # nested defs analyzed when resolved as callees
+        # record resolvable calls + run sink checks on every Call node in
+        # the statement (including inside expressions)
+        for node in ast.walk(stmt):
+            if isinstance(node, ast.Call):
+                self._visit_call(node, env, scan, sink_check, depth)
+        if isinstance(stmt, ast.Assign):
+            taint = self._rhs_taint(stmt.value, env, depth)
+            for target in stmt.targets:
+                self._bind(target, taint, env)
+        elif isinstance(stmt, ast.AugAssign):
+            t = self.expr_taint(stmt.value, env, depth) \
+                or self.expr_taint(stmt.target, env, depth)
+            self._bind(stmt.target, t, env)
+        elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+            self._bind(stmt.target,
+                       self._rhs_taint(stmt.value, env, depth), env)
+        elif isinstance(stmt, ast.Return):
+            if stmt.value is None:
+                returns.append(False)
+            else:
+                returns.append(self._rhs_taint(stmt.value, env, depth))
+        elif isinstance(stmt, ast.For):
+            self._bind(stmt.target,
+                       self.expr_taint(stmt.iter, env, depth), env)
+            self._walk_body(stmt.body, env, scan, returns, sink_check,
+                            depth)
+            self._walk_body(stmt.orelse, env, scan, returns, sink_check,
+                            depth)
+        elif isinstance(stmt, (ast.If, ast.While)):
+            self._walk_body(stmt.body, env, scan, returns, sink_check,
+                            depth)
+            self._walk_body(stmt.orelse, env, scan, returns, sink_check,
+                            depth)
+        elif isinstance(stmt, ast.With):
+            for item in stmt.items:
+                if item.optional_vars is not None:
+                    self._bind(item.optional_vars,
+                               self.expr_taint(item.context_expr, env,
+                                               depth), env)
+            self._walk_body(stmt.body, env, scan, returns, sink_check,
+                            depth)
+        elif isinstance(stmt, ast.Try):
+            self._walk_body(stmt.body, env, scan, returns, sink_check,
+                            depth)
+            for h in stmt.handlers:
+                self._walk_body(h.body, env, scan, returns, sink_check,
+                                depth)
+            self._walk_body(stmt.orelse, env, scan, returns, sink_check,
+                            depth)
+            self._walk_body(stmt.finalbody, env, scan, returns,
+                            sink_check, depth)
+
+    def _rhs_taint(self, value: ast.AST, env: Dict[str, bool],
+                   depth: int) -> Taint:
+        """Tuple RHS keeps element-wise taint for unpacking; a call RHS
+        uses the callee's (possibly tuple) return summary."""
+        if isinstance(value, (ast.Tuple, ast.List)):
+            return tuple(self.expr_taint(e, env, depth)
+                         for e in value.elts)
+        if isinstance(value, ast.Call):
+            return self.call_taint(value, env, depth)
+        return self.expr_taint(value, env, depth)
+
+    def _bind(self, target: ast.AST, taint: Taint,
+              env: Dict[str, bool]) -> None:
+        if isinstance(target, ast.Name):
+            env[target.id] = _any(taint)
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            elts = target.elts
+            if isinstance(taint, tuple) and len(taint) == len(elts):
+                for e, t in zip(elts, taint):
+                    self._bind(e, t, env)
+            else:
+                for e in elts:
+                    self._bind(e, _any(taint), env)
+        elif isinstance(target, ast.Starred):
+            self._bind(target.value, _any(taint), env)
+        # attribute/subscript stores don't introduce names
+
+    def _visit_call(self, call: ast.Call, env: Dict[str, bool],
+                    scan: FunctionScan, sink_check, depth: int) -> None:
+        if sink_check is not None:
+            sink_check(call, env, self)
+        dotted = call_target(call)
+        resolved = self.mi.resolve_dotted(dotted) if dotted else ""
+        if resolved in JAX_HOF:
+            # callables handed to jax control flow run fully traced
+            for arg in call.args:
+                name = dotted_name(arg)
+                if name is None or "." in name:
+                    continue
+                fn = None
+                for scope in reversed(getattr(self, "_scope_chain", ())):
+                    fn = self.index.nested_defs(self.mi, scope).get(name)
+                    if fn is not None:
+                        break
+                if fn is not None:
+                    scan.calls.append(
+                        (fn, frozenset(param_names(fn.node))))
+            return
+        callee = self.index.resolve_call(
+            self.mi, getattr(self, "_scope_chain", ()), call)
+        if callee is None:
+            return
+        arg_taints = [self.expr_taint(a, env, depth) for a in call.args]
+        kw_taints = {kw.arg: self.expr_taint(kw.value, env, depth)
+                     for kw in call.keywords if kw.arg}
+        scan.calls.append(
+            (callee, self._bind_taint(callee, arg_taints, kw_taints)))
+
+
+def _join_returns(returns: List[Taint]) -> Taint:
+    if not returns:
+        return False
+    widths = {len(t) for t in returns if isinstance(t, tuple)}
+    if len(widths) == 1 and all(isinstance(t, tuple) for t in returns):
+        w = widths.pop()
+        return tuple(any(t[i] for t in returns) for i in range(w))
+    return any(_any(t) for t in returns)

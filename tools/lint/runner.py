@@ -1,0 +1,104 @@
+"""koordlint runner: `python -m tools.lint` — exits non-zero on any
+finding not frozen in the baseline file."""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from typing import List, Optional, Sequence, Tuple
+
+from tools.lint.framework import (
+    Baseline,
+    Finding,
+    Project,
+    all_analyzers,
+)
+
+REPO_ROOT = os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
+DEFAULT_BASELINE = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "baseline.json")
+
+
+def run_lint(root: str = REPO_ROOT,
+             analyzers: Optional[Sequence[str]] = None,
+             baseline_path: Optional[str] = None,
+             ) -> Tuple[List[Finding], List[Finding]]:
+    """-> (new findings, baseline-suppressed findings). Parse errors
+    count as findings of the framework itself."""
+    registry = all_analyzers()
+    if analyzers is not None:
+        unknown = [a for a in analyzers if a not in registry]
+        if unknown:
+            raise KeyError(f"unknown analyzers: {unknown}; "
+                           f"known: {sorted(registry)}")
+        selected = {name: registry[name] for name in analyzers}
+    else:
+        selected = registry
+    project = Project(root)
+    findings: List[Finding] = list(project.parse_errors)
+    for name in sorted(selected):
+        findings.extend(selected[name].run(project))
+    findings.sort(key=lambda f: (f.path, f.line, f.code))
+    baseline = Baseline.load(baseline_path or DEFAULT_BASELINE)
+    return baseline.split(findings)
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m tools.lint",
+        description="koordlint: AST-based hot-path purity & concurrency "
+                    "lint for the koordinator_tpu tree")
+    parser.add_argument("--root", default=REPO_ROOT,
+                        help="tree to analyze (default: repo root)")
+    parser.add_argument("--baseline", default=DEFAULT_BASELINE,
+                        help="baseline suppression file")
+    parser.add_argument("--write-baseline", action="store_true",
+                        help="freeze current findings into the baseline "
+                             "and exit 0")
+    parser.add_argument("--analyzers",
+                        help="comma-separated subset to run")
+    parser.add_argument("--list", action="store_true",
+                        help="list analyzers and exit")
+    parser.add_argument("--stamp-protos", action="store_true",
+                        help="write/refresh proto content stamps into "
+                             "the *_pb2.py files, then exit")
+    parser.add_argument("-q", "--quiet", action="store_true",
+                        help="suppress the per-finding listing")
+    args = parser.parse_args(argv)
+
+    if args.list:
+        for name, an in sorted(all_analyzers().items()):
+            print(f"{name:24s} {an.description}")
+        return 0
+
+    if args.stamp_protos:
+        from tools.lint.analyzers.proto_drift import stamp_project
+        rewritten = stamp_project(Project(args.root))
+        for rel in rewritten:
+            print(f"stamped {rel}")
+        print(f"{len(rewritten)} pb2 file(s) updated")
+        return 0
+
+    selected = args.analyzers.split(",") if args.analyzers else None
+    new, suppressed = run_lint(args.root, selected, args.baseline)
+
+    if args.write_baseline:
+        Baseline(path=args.baseline).save(new + suppressed)
+        print(f"baseline: froze {len(new) + len(suppressed)} finding(s) "
+              f"into {args.baseline}")
+        return 0
+
+    if not args.quiet:
+        for f in new:
+            print(f.render())
+    tally = f"koordlint: {len(new)} finding(s)"
+    if suppressed:
+        tally += f", {len(suppressed)} suppressed by baseline"
+    print(tally)
+    return 1 if new else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
