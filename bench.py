@@ -18,15 +18,24 @@ chunks — LoadAware filter+score over each [CHUNK, N] matrix, quota
 admission, top-k commit with priority-ordered conflict resolution — carrying
 the snapshot AND the topology (group x domain) counts between chunks, so
 spread/anti/affinity placements in one chunk constrain the next (the
-cross-batch count rule in core.domain_machinery). Stragglers are retried
-device-side: tail passes pack the still-unplaced pod indices (argsort),
-re-schedule them with more rounds and fall-through choices, and scatter the
-results back into the assignment vector. The tail ADAPTS: at least
-MIN_TAIL_PASSES always run (both programs stay warm), then passes repeat
-while the straggler count improves, bounded by BENCH_MAX_TAIL_PASSES — no
-fixed retry-capacity cliff. The host never enters the scheduling loop; the
-only device->host transfers are the final assignment readback (the bind
-log) and one straggler-count scalar per tail pass.
+cross-batch count rule in core.domain_machinery). The full-gate paths
+additionally run the Filter->Score gate cascade (scheduler/cascade.py,
+BENCH_CASCADE overrides): a cheap stage-1 candidate mask prunes the pair
+space before the heavy per-pair gates run, bit-identically. Stragglers are
+retried device-side: tail passes pack the still-unplaced pod indices
+(argsort), re-schedule them with more rounds and fall-through choices, and
+scatter the results back into the assignment vector. The tail ADAPTS: at
+least MIN_TAIL_PASSES always run, then passes repeat while the straggler
+count improves or never-retried windows remain, bounded by
+BENCH_MAX_TAIL_PASSES — no fixed retry-capacity cliff. The adaptive loop
+itself is DEVICE-RESIDENT by default (core.tail_compaction_loop, a
+lax.while_loop over the compacted retry batches): sweep + tail are one
+program, and the only device->host transfers are the final assignment
+readback (the bind log) and ONE packed stats vector after the tail —
+regardless of straggler count. BENCH_TAIL_MODE=host keeps the previous
+host-driven orchestration (one straggler-count readback per adaptive
+decision) as the conformance oracle for A/B runs; every emitted line
+records `cascade` and `tail_mode` so runs are self-describing.
 """
 
 import functools
@@ -46,16 +55,39 @@ NUM_PODS = int(os.environ.get("BENCH_PODS", 100_000))
 CHUNK = int(os.environ.get("BENCH_CHUNK", 2_000))
 FULL_CHUNK = int(os.environ.get("BENCH_FULL_CHUNK", CHUNK))
 MIN_TAIL_PASSES = 2   # always run (keeps the tail program warm)
-MAX_TAIL_PASSES = int(os.environ.get("BENCH_MAX_TAIL_PASSES", 6))
+DEFAULT_MAX_TAIL_PASSES = 6
+# the narrower full-gate tail needs more adaptive passes to cover the
+# same straggler pool (3160 at the 100k capture > 6 x 512)
+FULL_GATE_MAX_TAIL_PASSES = 10
+
+
+def max_tail_passes(full_gate: bool) -> int:
+    """THE single parse of BENCH_MAX_TAIL_PASSES. It used to be read
+    TWICE with different semantics — once at import into a module
+    constant (so a value set after import was ignored by one reader)
+    and once as a raw truthiness check at run_northstar (so an empty
+    string crashed the import-time int() but flipped the run-time
+    branch). One call-time parse: an explicit value wins verbatim on
+    BOTH the slim and full-gate paths; unset or empty falls to the
+    per-path default. Pinned by tests/test_bench_tail.py."""
+    raw = (os.environ.get("BENCH_MAX_TAIL_PASSES") or "").strip()
+    if raw:
+        return max(int(raw), 0)
+    return FULL_GATE_MAX_TAIL_PASSES if full_gate else DEFAULT_MAX_TAIL_PASSES
+
+
 # Protocol note (round 4 -> 5): since round 4 the timed region includes the
 # ADAPTIVE tail's host readbacks (round 3 ran a fixed TAIL_PASSES count with
 # no mid-region sync), so cross-round comparisons against BENCH_r03 and
 # earlier are not strictly apples-to-apples; `tail_passes` is recorded in
-# every line so a reader can normalize.  Round 5 keeps the adaptive
-# semantics but batches the sweep + MIN-pass counts into ONE device->host
+# every line so a reader can normalize.  Round 5 kept the adaptive
+# semantics but batched the sweep + MIN-pass counts into ONE device->host
 # transfer (each blocking scalar readback costs a full tunnel round-trip,
-# ~100 ms; round 4 paid five of them).  The 2 s target itself is unchanged
-# (BASELINE.json).
+# ~100 ms; round 4 paid five of them).  Round 6 moves the whole adaptive
+# loop on device (core.tail_compaction_loop): the timed region now holds
+# exactly ONE straggler-stats readback however many passes run, and
+# `tail_mode` in every line says which protocol produced it.  The 2 s
+# target itself is unchanged (BASELINE.json).
 BASELINE_SECONDS = 2.0
 
 # mid-round TPU capture stamped by tools/tpu_capture.py; surfaced on the
@@ -236,11 +268,27 @@ def run_northstar(full_gate: bool = False, num_pods: int = None,
     kch = int(os.environ.get("BENCH_K", "8"))
     tail_rounds = int(os.environ.get("BENCH_TAIL_ROUNDS", "4"))
     tail_k = int(os.environ.get("BENCH_TAIL_K", "32"))
+    # the Filter->Score gate cascade: ON by default for the full-gate
+    # paths (where the heavy per-pair gates it narrows exist), off on
+    # the slim path so the canonical protocol stays byte-stable;
+    # BENCH_CASCADE overrides either way. cascade=False is the
+    # conformance oracle — placements are bit-identical (test_cascade).
+    cascade_env = os.environ.get("BENCH_CASCADE")
+    cascade_on = (full_gate if cascade_env is None
+                  else cascade_env not in ("0", "false", ""))
+    # tail orchestration: "device" = the lax.while_loop compaction loop
+    # (one straggler-stats readback total); "host" = the previous
+    # per-pass host-driven loop, kept as the conformance oracle
+    tail_mode = (os.environ.get("BENCH_TAIL_MODE") or "device").strip()
+    if tail_mode not in ("device", "host"):
+        raise SystemExit(f"BENCH_TAIL_MODE={tail_mode!r}: "
+                         "must be 'device' or 'host'")
     step = functools.partial(core.schedule_batch, num_rounds=rounds,
                              k_choices=kch,
                              score_dims=(0, 1), approx_topk=approx,
                              tie_break=True, quota_depth=2,
-                             fit_dims=(0, 1, 2, 3), **step_kw)
+                             fit_dims=(0, 1, 2, 3), cascade=cascade_on,
+                             **step_kw)
     # the tail's retry batches are gathered device-side, so only the
     # topo contract (budgeted selection below) can be re-established
     # there — the numa/gpu prefixes apply to the host-packed sweep only
@@ -249,6 +297,7 @@ def run_northstar(full_gate: bool = False, num_pods: int = None,
                                   k_choices=tail_k, score_dims=(0, 1),
                                   approx_topk=approx, tie_break=True,
                                   quota_depth=2, fit_dims=(0, 1, 2, 3),
+                                  cascade=cascade_on,
                                   **dict(step_kw, **tail_kw_override))
     # tail retry width, decoupled from the sweep chunk: stragglers
     # don't need a sweep-wide retry program (the [P, P] prefix
@@ -265,11 +314,7 @@ def run_northstar(full_gate: bool = False, num_pods: int = None,
     tail_chunk = max(min(int(os.environ.get("BENCH_TAIL_CHUNK",
                                             default_tail)),
                          num_pods), 1)
-    # the narrower full-gate tail needs more adaptive passes to cover
-    # the same straggler pool (3160 at the 100k capture > 6 x 512);
-    # an explicit BENCH_MAX_TAIL_PASSES still wins
-    max_tail = MAX_TAIL_PASSES if os.environ.get("BENCH_MAX_TAIL_PASSES") \
-        else (max(MAX_TAIL_PASSES, 10) if full_gate else MAX_TAIL_PASSES)
+    max_tail = max_tail_passes(full_gate)
     if topo_mask is not None:
         topo_mask = put_repl(jnp.asarray(topo_mask))
 
@@ -284,8 +329,7 @@ def run_northstar(full_gate: bool = False, num_pods: int = None,
     def with_counts(batch, counts):
         return batch.replace(**dict(zip(core.COUNT_FIELDS, counts)))
 
-    @functools.partial(jax.jit, donate_argnums=(0, 1))
-    def sweep(snap, counts, stacked, pods_dev, cfg):
+    def run_sweep(snap, counts, stacked, pods_dev, cfg):
         def body(carry, cols):
             snap, counts = carry
             # selector_match and the (group x domain) matrices are
@@ -298,72 +342,35 @@ def run_northstar(full_gate: bool = False, num_pods: int = None,
                                               stacked)
         return snap, counts, assign.reshape(-1)
 
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
+    def sweep(snap, counts, stacked, pods_dev, cfg):
+        return run_sweep(snap, counts, stacked, pods_dev, cfg)
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
+    def sweep_and_tail(snap, counts, stacked, pods_dev, cfg):
+        """tail_mode=device: sweep + the adaptive tail compaction loop
+        (core.tail_compaction_loop, a lax.while_loop over compacted
+        retry batches) are ONE program — stragglers are gathered,
+        retried, and scattered back entirely on device, and the host
+        reads back a single packed stats vector after the loop."""
+        snap, counts, assign = run_sweep(snap, counts, stacked,
+                                         pods_dev, cfg)
+        return core.tail_compaction_loop(
+            tail_step, snap, counts, assign, pods_dev, cfg,
+            tail_chunk=tail_chunk, min_passes=MIN_TAIL_PASSES,
+            max_passes=max_tail, charge_counts=full_gate,
+            topo_prefix=topo_prefix, topo_mask=topo_mask)
+
     @functools.partial(jax.jit, donate_argnums=(0, 1, 2, 3))
     def tail_pass(snap, counts, assign, tried, pods_dev, cfg):
-        """Retry up to CHUNK unplaced pods, packed device-side.
-
-        Selection prefers NEVER-RETRIED leftovers over already-retried
-        ones, so retry capacity is genuinely exhausted: without the
-        `tried` mask, a pass that placed nothing would re-select the
-        same window and silently starve the rest. The gathered retry
-        batch marks only true leftovers valid, so a pass with nothing
-        left is a no-op on the snapshot.
-
-        Full-gate (topo_prefix set): at most topo_prefix constrained
-        stragglers (untried first) sort to the FRONT of the window —
-        inside the scheduler's packing prefix — and the remaining slots
-        go to unconstrained stragglers. Constrained overflow is excluded
-        from the pass AND left unmarked in `tried`, so it stays in the
-        never-retried pool and the adaptive loop keeps running until it
-        drains; the in-prefix mask below is the safety net for the
-        degenerate few-stragglers case.
-        """
-        bad = pods_dev.valid & (assign < 0)
-        if topo_prefix is None:
-            key = jnp.where(bad & ~tried, 0, jnp.where(bad, 1, 2))
-        else:
-            # budgeted constrained selection: rank constrained
-            # stragglers untried-first and admit only the first
-            # topo_prefix of them to this pass — the REST of the window
-            # goes to unconstrained stragglers (untried first), so
-            # constrained overflow occupies no dead slots and can never
-            # starve unconstrained retries
-            cb = bad & topo_mask
-            ckey = jnp.where(cb & ~tried, 0, jnp.where(cb, 1, 2))
-            corder = jnp.argsort(ckey, stable=True)
-            rank_c = jnp.zeros((num_pods,), jnp.int32).at[corder].set(
-                jnp.arange(num_pods, dtype=jnp.int32))
-            adm = cb & (rank_c < topo_prefix)
-            # untried pods of EITHER class outrank every tried pod
-            # (admitted-constrained tried included), so no untried
-            # straggler can be starved by retry loops of failing pods;
-            # admitted-tried rows displaced beyond the prefix are
-            # caught by the in_prefix mask
-            key = jnp.where(
-                adm & ~tried, 0,
-                jnp.where(bad & ~topo_mask & ~tried, 1,
-                          jnp.where(adm, 2,
-                                    jnp.where(bad & ~topo_mask, 3,
-                                              jnp.where(bad, 4, 5)))))
-        order = jnp.argsort(key, stable=True)
-        idx = order[:tail_chunk]
-        attempt = bad[idx]
-        if topo_prefix is not None:
-            in_prefix = jnp.arange(tail_chunk) < topo_prefix
-            attempt &= ~topo_mask[idx] | in_prefix
-        retry = with_counts(
-            pods_dev.replace(
-                **{f: getattr(pods_dev, f)[idx]
-                   for f in synthetic.PER_POD_FIELDS if f != "valid"},
-                valid=attempt),
-            counts)
-        tried = tried.at[idx].set(tried[idx] | attempt)
-        res = tail_step(snap, retry, cfg)
-        counts = charge_all(counts, retry, res.assignment)
-        got = attempt & (res.assignment >= 0)
-        assign = assign.at[idx].set(
-            jnp.where(got, res.assignment, assign[idx]))
-        return res.snapshot, counts, assign, tried
+        """tail_mode=host: one retry pass (core.tail_pass — the same
+        gather/compact/retry/scatter program the device loop runs, so
+        host mode is the conformance oracle for it). Selection and
+        budgeted-constrained semantics live in core.tail_select."""
+        return core.tail_pass(
+            tail_step, snap, counts, assign, tried, pods_dev, cfg,
+            tail_chunk=tail_chunk, charge_counts=full_gate,
+            topo_prefix=topo_prefix, topo_mask=topo_mask)
 
     @jax.jit
     def pass_stats(assign, tried, pods_dev):
@@ -375,13 +382,24 @@ def run_northstar(full_gate: bool = False, num_pods: int = None,
         return jnp.stack([bad.sum(), (bad & ~tried).sum()])
 
     def full_pass(snap, counts):
-        # The sweep and the MIN mandatory tail passes are issued
-        # back-to-back with NO host readback between them: each blocking
-        # scalar transfer pays a full tunnel round-trip (~100 ms on the
-        # axon setup), and five of them inside the timed region more than
-        # doubled the round-4 canonical time. All the counts the adaptive
-        # decision needs are stacked device-side and read in ONE transfer
-        # after the mandatory passes.
+        if tail_mode == "device":
+            snap, counts, assign, stats = sweep_and_tail(
+                snap, counts, stacked, pods_dev, cfg)
+            # the run's ONE straggler-count readback, after the whole
+            # adaptive loop ([after_sweep, final, never_retried,
+            # passes] packed); the assignment transfer is the bind log
+            stats = np.asarray(stats)
+            return (snap, counts, np.asarray(assign), int(stats[0]),
+                    int(stats[1]), int(stats[2]), int(stats[3]))
+        # tail_mode=host — the previous protocol, kept as the
+        # conformance oracle. The sweep and the MIN mandatory tail
+        # passes are issued back-to-back with NO host readback between
+        # them: each blocking scalar transfer pays a full tunnel
+        # round-trip (~100 ms on the axon setup), and five of them
+        # inside the timed region more than doubled the round-4
+        # canonical time. All the counts the adaptive decision needs
+        # are stacked device-side and read in ONE transfer after the
+        # mandatory passes.
         snap, counts, assign = sweep(snap, counts, stacked, pods_dev, cfg)
         tried = jnp.zeros((num_pods,), bool)
         pair_hist = [pass_stats(assign, tried, pods_dev)]
@@ -412,7 +430,11 @@ def run_northstar(full_gate: bool = False, num_pods: int = None,
             snap, counts, assign, tried = tail_pass(
                 snap, counts, assign, tried, pods_dev, cfg)
             passes += 1
-            pair = np.asarray(pass_stats(assign, tried, pods_dev))
+            # the oracle's per-pass blocking readback IS the cost the
+            # device loop deletes (koordlint HS006 guards the bug
+            # class; this one marked instance is the measured baseline)
+            pair = np.asarray(  # koordlint: disable=HS006
+                pass_stats(assign, tried, pods_dev))
             new_left, never_retried = int(pair[0]), int(pair[1])
             improved = new_left < left
             left = new_left
@@ -468,12 +490,35 @@ def run_northstar(full_gate: bool = False, num_pods: int = None,
         "never_retried": never_retried,
         "tail_passes": passes,
         "approx_topk": approx,
+        # A/B protocol knobs, stamped on EVERY line (not only when
+        # non-default): a cascade-off or host-tail run must be
+        # self-describing without consulting the code's defaults
+        "cascade": cascade_on,
+        "tail_mode": tail_mode,
         "devices": len(jax.devices()),
         "platform": jax.devices()[0].platform,
         **host_fields(),
     }
     print(json.dumps(result))
     return result
+
+
+def _stamped_line(line: dict, captured_at: str, age: float,
+                  stale_after: float) -> dict:
+    """The ONE constructor for surfaced stamped lines: every line gets
+    the full provenance set — stamped_capture, captured_at,
+    stamped_age_seconds AND stale_capture — unconditionally. BENCH_r05's
+    tail surfaced 10 h-old stamped captures (stamped_age_seconds 36196)
+    with no stale marker on the metric lines; routing every emission
+    through this helper makes the invariant structural instead of a
+    per-call-site convention (tests/test_lint.py pins that every line
+    of a multi-line artifact carries it)."""
+    out = dict(line)
+    out["stamped_capture"] = True
+    out["captured_at"] = captured_at
+    out["stamped_age_seconds"] = round(age)
+    out["stale_capture"] = age > stale_after
+    return out
 
 
 def surface_stamped_capture() -> bool:
@@ -522,12 +567,8 @@ def surface_stamped_capture() -> bool:
               f"captured mid-round at {captured_at} (age {age:.0f}s, "
               "tools/tpu_capture.py)", file=sys.stderr)
         for line in lines:
-            out = dict(line)
-            out["stamped_capture"] = True
-            out["captured_at"] = captured_at
-            out["stamped_age_seconds"] = round(age)
-            out["stale_capture"] = age > stale_after
-            print(json.dumps(out))
+            print(json.dumps(_stamped_line(line, captured_at, age,
+                                           stale_after)))
         return True
     except FileNotFoundError:
         return False  # no mid-round capture happened — the normal case

@@ -1,2 +1,4 @@
 """Device kernels shared across components (top-k commit lives in
-scheduler/core; this package holds self-contained numerical ops)."""
+scheduler/core; this package holds self-contained numerical ops:
+waterfill — elastic-quota runtime, quota_demand — demand aggregation,
+feasibility — the gate cascade's cheap stage-1 fit/ceiling kernels)."""

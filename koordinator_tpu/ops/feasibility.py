@@ -1,0 +1,70 @@
+"""Cheap vectorized feasibility kernels — stage 1 of the Filter->Score
+gate cascade (scheduler/cascade.py).
+
+These are the batched analogues of the reference Filter stage's cheapest
+checks: batch-start resource fit (noderesources.Fit) and elastic-quota
+ceiling admission (elasticquota PreFilter). Both read only BATCH-START
+state, which within a commit batch is monotone — node `requested` and
+quota `used` only grow as pods are accepted — so a (pod, node) pair that
+fails here fails in every commit round, and pruning it up front cannot
+change placements (the soundness argument the cascade relies on; see
+cascade.stage1_mask).
+
+Self-contained numerical ops: no scheduler imports beyond the shared EPS
+tolerance, so plugin kernels and tools can reuse them without cycles.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+from koordinator_tpu.scheduler.batching import EPS
+from koordinator_tpu.snapshot.schema import MAX_QUOTA_DEPTH, PodBatch, QuotaState
+
+
+def _dims(x: jnp.ndarray, fit_dims: Optional[tuple]) -> jnp.ndarray:
+    """Restrict a [..., R] operand to the checked resource dims (the
+    same rule as core.schedule_batch's fit_dims)."""
+    return x if fit_dims is None else x[..., list(fit_dims)]
+
+
+def resource_fit(allocatable: jnp.ndarray, requested: jnp.ndarray,
+                 requests: jnp.ndarray,
+                 fit_dims: Optional[tuple] = None) -> jnp.ndarray:
+    """bool[P, N]: pod fits the node's batch-start headroom on every
+    checked dim. Identical math (and EPS tolerance) to the first commit
+    round's fit gate, so the mask is exactly that round's fit and an
+    upper bound of every later round's."""
+    return jnp.all(
+        _dims(requests, fit_dims)[:, None, :]
+        + _dims(requested, fit_dims)[None]
+        <= _dims(allocatable, fit_dims)[None] + EPS, axis=-1)
+
+
+def pod_ancestors(quotas: QuotaState, pods: PodBatch) -> jnp.ndarray:
+    """i32[P, D]: each pod's quota-tree ancestor chain per depth, -1 =
+    none (quota-less pods get an all--1 row)."""
+    quota_id = jnp.maximum(pods.quota_id, 0)
+    return jnp.where(pods.quota_id[:, None] >= 0,
+                     quotas.depth_ancestor[quota_id], -1)
+
+
+def quota_ceiling_ok(quotas: QuotaState, pods: PodBatch,
+                     quota_depth: int = MAX_QUOTA_DEPTH,
+                     fit_dims: Optional[tuple] = None) -> jnp.ndarray:
+    """bool[P]: batch-start elastic-quota admission — used + request <=
+    runtime at every tree level of the pod's chain. A False row kills
+    the pod's ENTIRE node row in the cascade mask: quota admission is
+    node-independent, and used only grows within the batch."""
+    pod_anc = pod_ancestors(quotas, pods)
+    ok = jnp.ones((pods.num_pods,), bool)
+    for d in range(quota_depth):
+        anc = pod_anc[:, d]
+        a = jnp.maximum(anc, 0)
+        level_ok = jnp.all(
+            _dims(quotas.used, fit_dims)[a] + _dims(pods.requests, fit_dims)
+            <= _dims(quotas.runtime, fit_dims)[a] + EPS, axis=-1)
+        ok &= (anc < 0) | level_ok
+    return ok

@@ -7,6 +7,7 @@ filters/scores a node shard and the top-k select rides ICI collectives.
 """
 
 from koordinator_tpu.parallel.mesh import (  # noqa: F401
+    candidate_mask_sharding,
     make_mesh,
     snapshot_sharding,
     shard_snapshot,

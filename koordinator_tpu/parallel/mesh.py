@@ -38,6 +38,17 @@ def make_mesh(devices: Optional[list] = None) -> Mesh:
     return Mesh(np.asarray(devices), (NODE_AXIS,))
 
 
+def candidate_mask_sharding(mesh: Mesh) -> NamedSharding:
+    """Sharding for the cascade's [P, N] stage-1 candidate mask
+    (scheduler/cascade.stage1_mask): pods replicate, node columns shard
+    — the mask follows the node-column layout of every other [.., N]
+    operand, so stage 1 is shard-local with zero collectives. Inside
+    `schedule_batch` GSPMD derives exactly this placement from the
+    snapshot's sharding; the export exists for callers that build or
+    inspect the mask OUTSIDE the jitted program (smoke tools, tests)."""
+    return NamedSharding(mesh, P(None, NODE_AXIS))
+
+
 def snapshot_sharding(mesh: Mesh) -> ClusterSnapshot:
     """A ClusterSnapshot-shaped pytree of NamedShardings: node columns
     sharded on dim 0, everything else replicated."""

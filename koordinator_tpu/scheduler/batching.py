@@ -21,16 +21,25 @@ MAX_NODE_SCORE = 100.0  # framework.MaxNodeScore — single source of truth;
                         # raise the slot multiplier when adding a fourth
 
 
+def stable_rank(key: jnp.ndarray) -> jnp.ndarray:
+    """i32[P]: each element's position in the stable ascending sort of
+    `key` (ties keep index order). One sort + one scatter; shared by the
+    priority ranking and the straggler-tail compaction (whose budgeted
+    selection admits the first K candidates of a ranking without
+    materializing the sorted array)."""
+    p = key.shape[0]
+    order = jnp.argsort(key, stable=True)
+    return jnp.zeros((p,), jnp.int32).at[order].set(
+        jnp.arange(p, dtype=jnp.int32))
+
+
 def rank_by_priority(pods: PodBatch) -> jnp.ndarray:
     """i32[P]: position in scheduling order — priority desc, index asc.
 
     The batched analogue of the scheduler queue order (Coscheduling Less +
     default PrioritySort); gang-group batching is handled by the caller.
     """
-    p = pods.priority.shape[0]
-    order = jnp.lexsort((jnp.arange(p), -pods.priority))
-    return jnp.zeros((p,), jnp.int32).at[order].set(
-        jnp.arange(p, dtype=jnp.int32))
+    return stable_rank(-pods.priority)
 
 
 def segment_prefix_ok(seg: jnp.ndarray, earlier: jnp.ndarray,

@@ -50,8 +50,10 @@ from koordinator_tpu.scheduler.batching import (
     EPS,
     rank_by_priority,
     segment_prefix_ok,
+    stable_rank,
 )
 from koordinator_tpu.scheduler import topologymanager
+from koordinator_tpu.scheduler.cascade import stage1_mask, static_gates
 from koordinator_tpu.scheduler.plugins import deviceshare, loadaware, numaaware
 from koordinator_tpu.scheduler.plugins.numaaware import CPU as CPU_KIND, MEM as MEM_KIND
 from koordinator_tpu.scheduler.plugins.reservation import (
@@ -64,6 +66,7 @@ from koordinator_tpu.snapshot.schema import (
     MAX_QUOTA_DEPTH,
     NUM_AUX_TYPES,
     NUM_DEV_DIMS,
+    PER_POD_FIELDS,
     PodBatch,
 )
 
@@ -116,7 +119,8 @@ class ScheduleResult:
                                              "topo_prefix",
                                              "dom_classes",
                                              "numa_prefix",
-                                             "gpu_prefix"))
+                                             "gpu_prefix",
+                                             "cascade"))
 def schedule_batch(snap: ClusterSnapshot, pods: PodBatch,
                    cfg: loadaware.LoadAwareConfig,
                    num_rounds: int = 4, k_choices: int = 8,
@@ -133,7 +137,8 @@ def schedule_batch(snap: ClusterSnapshot, pods: PodBatch,
                    topo_prefix: int = None,
                    dom_classes: tuple = None,
                    numa_prefix: int = None,
-                   gpu_prefix: int = None) -> ScheduleResult:
+                   gpu_prefix: int = None,
+                   cascade: bool = False) -> ScheduleResult:
     """Schedule a pod batch against the snapshot. Pure function; the caller
     publishes `result.snapshot` as the next version (store.update).
 
@@ -180,7 +185,18 @@ def schedule_batch(snap: ClusterSnapshot, pods: PodBatch,
     gpu_prefix: every device-requesting pod (deviceshare.
     has_device_request) sits below it. The per-inner-step topology-
     manager machinery and zone prefix gates then run on numa_prefix
-    rows, and the GPU instance gates on gpu_prefix rows."""
+    rows, and the GPU instance gates on gpu_prefix rows.
+
+    `cascade` (static): the Filter->Score gate cascade
+    (scheduler/cascade.py). Stage 1 folds a cheap candidate mask —
+    batch-start resource fit + quota ceilings on top of the static
+    gates — into the node columns; stage 2 narrows the HEAVY per-pair
+    batch gates (device prefilter/score [P, N, I], zone prefilter/score
+    [P, N, Z], policy combined-fit) to the numa_prefix / gpu_prefix
+    rows, padding pass-through rows back in. Both layers are placement-
+    preserving (monotone batch-start state; the prefix contracts), so
+    cascade=False — the default, and the conformance oracle — produces
+    bit-for-bit identical results (tests/test_cascade.py)."""
     nodes0, quotas0, gangs0 = snap.nodes, snap.quotas, snap.gangs
     devices0 = snap.devices
     n_nodes = nodes0.num_nodes
@@ -200,15 +216,18 @@ def schedule_batch(snap: ClusterSnapshot, pods: PodBatch,
         """Restrict a [..., R] operand to the checked resource dims."""
         return x if fd is None else x[..., fd]
 
+    # constrained-prefix width for the topology families (see docstring);
+    # pc == p (the default) keeps every slice full-width and the tail
+    # concatenations zero-size — one code path for both modes
+    pc = p if topo_prefix is None else max(min(int(topo_prefix), p), 0)
+    pn = p if numa_prefix is None else max(min(int(numa_prefix), p), 0)
+    pg = p if gpu_prefix is None else max(min(int(gpu_prefix), p), 0)
+
     rank = rank_by_priority(pods)
     # rank[p'] < rank[p], shared by every prefix gate in the commit
     earlier = rank[None, :] < rank[:, None]                      # [P, P]
 
-    # --- static (per-batch) gates -------------------------------------------
-    # nodeSelector gate: sel_match[sel_id, label_group[n]]
-    sel = jnp.maximum(pods.selector_id, 0)
-    sel_ok = (pods.selector_id[:, None] < 0) | \
-        pods.selector_match[sel][:, nodes0.label_group]          # [P, N]
+    # --- static (per-batch) gates — stage 1 of the gate cascade ------------
     # gang quorum (PreFilter, coscheduling core/core.go:220-274); a
     # match-policy-satisfied gang short-circuits the quorum check — its
     # members schedule individually (core.go:236 OnceSatisfied fast path)
@@ -222,31 +241,43 @@ def schedule_batch(snap: ClusterSnapshot, pods: PodBatch,
     pod_anc = jnp.where(pods.quota_id[:, None] >= 0,
                         quotas0.depth_ancestor[quota_id], -1)    # [P, D]
 
-    # LoadAware filter is round-invariant: it reads only NodeMetric-derived
-    # columns and thresholds, never assume state (load_aware.go:123-254
-    # touches no NodeInfo.requested), so compute it once for the batch.
-    la_ok = loadaware.filter_mask(nodes0, pods, cfg)
-    static_ok = la_ok & sel_ok & nodes0.schedulable[None, :]     # [P, N]
-    # TaintToleration (vanilla-framework plugin the reference's extender
-    # wraps): forbid on untolerated NoSchedule/NoExecute, penalize
-    # untolerated PreferNoSchedule. Matrices ride (toleration-set x
-    # taint-group) exactly like the selector gate; a [1, 1] matrix means
-    # the batch carries no toleration modeling (synthetic fast path) and
-    # the gates compile out.
-    use_taints = pods.has_taints
-    if use_taints:
-        tol_row = pods.tol_forbid[jnp.maximum(pods.toleration_id, 0)]
-        static_ok &= ~tol_row[:, nodes0.taint_group]             # [P, N]
-        prefer_cnt = pods.tol_prefer[
-            jnp.maximum(pods.toleration_id, 0)][:, nodes0.taint_group]
-        taint_penalty = prefer_cnt / jnp.maximum(
-            jnp.max(pods.tol_prefer), 1.0) * MAX_NODE_SCORE
-    else:
-        taint_penalty = None
-    # the slot columns see the gates BEFORE the device/NUMA prefilters:
-    # those prefilters reason about the node's open pools, but a consumer
-    # draws from the reservation's own hold (restore semantics)
+    # nodeSelector + round-invariant LoadAware filter + schedulable +
+    # taint forbids/penalty: one shared implementation for both cascade
+    # modes (cascade.static_gates — the cheap per-batch node gates)
+    static_ok, taint_penalty = static_gates(nodes0, pods, cfg)
+    # the slot columns see the gates BEFORE the stage-1 fit mask and the
+    # device/NUMA prefilters: those reason about the node's open pools,
+    # but a consumer draws from the reservation's own hold (restore
+    # semantics)
     static_base = static_ok
+    if cascade:
+        # stage-1 candidate mask: batch-start resource fit + quota
+        # ceilings fold in up front. Placement-preserving: node
+        # requested and quota used are monotone within the batch, so
+        # every pruned pair would be rejected by the exact round gates
+        # anyway (cascade.stage1_mask's contract).
+        static_ok = stage1_mask(snap, pods, static_ok,
+                                fit_dims=fit_dims, quota_depth=quota_depth)
+
+    def heavy_rows(rows):
+        """View of the columns the heavy per-pair batch gates read,
+        sliced to a class-prefix width (stage 2 of the cascade): pods
+        beyond the numa/gpu packing prefixes cannot engage those gates,
+        so their [*, N, Z] / [*, N, I] tensors shrink ~P/rows x."""
+        return pods.replace(requests=pods.requests[:rows],
+                            gpu_ratio=pods.gpu_ratio[:rows],
+                            numa_single=pods.numa_single[:rows])
+
+    def and_rows(mask, gate, rows):
+        """AND a [rows, N] gate into the first `rows` rows of `mask`;
+        rows beyond pass through (the sliced gate is vacuously True
+        there under the packing contract)."""
+        return jnp.concatenate([mask[:rows] & gate, mask[rows:]], axis=0)
+
+    # heavy-gate row widths: full width unless the cascade is on AND the
+    # corresponding packing contract is established (gpu_prefix /
+    # numa_prefix); under the contract the sliced gates are bit-identical
+    dev_pg = pg if (cascade and pg < p) else p
     if enable_devices:
         # batch-start device upper bound (exact instance gates run in the
         # inner commit); also rejects device pods on device-less nodes —
@@ -254,17 +285,37 @@ def schedule_batch(snap: ClusterSnapshot, pods: PodBatch,
         # node-allocatable columns (deviceshare
         # UnschedulableAndUnresolvable). Runs even with zero instance
         # capacity so such pods never silently place without a GPU.
-        static_ok &= deviceshare.prefilter(devices0, pods)
+        static_ok = and_rows(
+            static_ok, deviceshare.prefilter(devices0, heavy_rows(dev_pg)),
+            dev_pg)
     if use_gpu:
-        dev_scores = deviceshare.score_matrix(devices0, pods, device_strategy)
+        dev_scores = deviceshare.score_matrix(devices0, heavy_rows(dev_pg),
+                                              device_strategy)
+        if dev_pg < p:
+            # exact pad: rows beyond pg carry no device request, so
+            # their score rows are 0 by construction
+            dev_scores = jnp.concatenate(
+                [dev_scores,
+                 jnp.zeros((p - dev_pg, n_nodes), dev_scores.dtype)], axis=0)
     numa_used0 = nodes0.numa_cap - nodes0.numa_free              # [N, Z, 2]
     if enable_numa:
+        numa_pn = pn if (cascade and pn < p) else p
         # single-NUMA-node prefilter (upper bound; exact gate in the inner
         # commit) + zone-allocation score preference (nodenumaresource
-        # topology_hint.go + scoring.go)
-        static_ok &= numaaware.zone_prefilter(nodes0, pods)
-        numa_scores = numaaware.numa_score_matrix(nodes0, pods,
+        # topology_hint.go + scoring.go). Under the cascade these run on
+        # numa_prefix rows: CPU-bind pods all sit below pn, and the
+        # numa_prefix contract guarantees a policy-free snapshot, so
+        # rows beyond pass the gates and score 0.
+        pods_pn = heavy_rows(numa_pn)
+        static_ok = and_rows(
+            static_ok, numaaware.zone_prefilter(nodes0, pods_pn), numa_pn)
+        numa_scores = numaaware.numa_score_matrix(nodes0, pods_pn,
                                                   numa_strategy)
+        if numa_pn < p:
+            numa_scores = jnp.concatenate(
+                [numa_scores,
+                 jnp.zeros((p - numa_pn, n_nodes), numa_scores.dtype)],
+                axis=0)
         n_zones = nodes0.numa_cap.shape[1]
         # every pod's (cpu, mem) zone demand: on a node whose topology
         # policy engages the manager, ALL pods charge zone usage
@@ -277,8 +328,12 @@ def schedule_batch(snap: ClusterSnapshot, pods: PodBatch,
         # whose total valid-zone free cannot hold the pod is infeasible
         total_zfree = jnp.sum(
             nodes0.numa_free * nodes0.numa_valid[:, :, None], axis=1)
-        static_ok &= (numa_policy0 == topologymanager.POLICY_NONE)[None] | \
-            jnp.all(total_zfree[None] + EPS >= req2_all[:, None, :], axis=-1)
+        static_ok = and_rows(
+            static_ok,
+            (numa_policy0 == topologymanager.POLICY_NONE)[None]
+            | jnp.all(total_zfree[None] + EPS
+                      >= req2_all[:numa_pn, None, :], axis=-1),
+            numa_pn)
 
     # --- reservations as virtual nodes (transformer.go restore/nominate) ---
     # Each reservation slot is an extra owner-restricted column with the
@@ -394,13 +449,6 @@ def schedule_batch(snap: ClusterSnapshot, pods: PodBatch,
                                         placed_now[:pc]).reshape(-1)
 
         return dom_x, counts_flat, n_g, n_d
-
-    # constrained-prefix width for the topology families (see docstring);
-    # pc == p (the default) keeps every slice full-width and the tail
-    # concatenations zero-size — one code path for both modes
-    pc = p if topo_prefix is None else max(min(int(topo_prefix), p), 0)
-    pn = p if numa_prefix is None else max(min(int(numa_prefix), p), 0)
-    pg = p if gpu_prefix is None else max(min(int(gpu_prefix), p), 0)
 
     def _fit_rows(x, rows, fill):
         """Slice or pad the leading axis to `rows` (prefix interop:
@@ -1268,3 +1316,165 @@ def charge_domain_counts(count0: jnp.ndarray, dom_matrix: jnp.ndarray,
                     n_g * n_d).reshape(-1)
     return count0.reshape(-1).at[seg].add(
         1.0, mode="drop").reshape(n_g, n_d)
+
+
+# --- device-resident straggler tail -------------------------------------
+# After a chunked sweep some pods remain unplaced (conflict losers,
+# constraint-tight rows). The tail packs them into fixed-width retry
+# batches and re-schedules them with a heavier program (more rounds /
+# fall-through choices). `tail_pass` is ONE such pass; the host may
+# orchestrate passes itself (one straggler-count readback per adaptive
+# decision — the conformance oracle, bench tail_mode=host), or run
+# `tail_compaction_loop`, which drives the same pass inside a
+# lax.while_loop so the whole adaptive tail — gather, compact, retry,
+# repeat — stays on device and the host reads back ONE stats vector at
+# the end regardless of straggler count.
+
+
+def tail_select(pods: PodBatch, assign: jnp.ndarray, tried: jnp.ndarray,
+                tail_chunk: int, topo_prefix: int = None,
+                topo_mask: jnp.ndarray = None):
+    """Pick up to `tail_chunk` stragglers for one retry pass.
+
+    Returns (idx i32[tail_chunk], attempt bool[tail_chunk]): the batch
+    rows to gather and which of them are true leftovers this pass may
+    retry (the rest are padding — marked invalid by the caller).
+
+    Selection prefers NEVER-RETRIED leftovers over already-retried
+    ones, so retry capacity is genuinely exhausted: without the `tried`
+    mask, a pass that placed nothing would re-select the same window
+    and silently starve the rest.
+
+    Full-gate (`topo_prefix` set, `topo_mask` bool[P] in the batch's
+    packed order): at most topo_prefix constrained stragglers (untried
+    first) sort to the FRONT of the window — inside the scheduler's
+    packing prefix — and the remaining slots go to unconstrained
+    stragglers. Constrained overflow is excluded from the pass AND left
+    unmarked in `tried`, so it stays in the never-retried pool and an
+    adaptive caller keeps running until it drains; the in-prefix mask
+    below is the safety net for the degenerate few-stragglers case.
+    """
+    bad = pods.valid & (assign < 0)
+    if topo_prefix is None:
+        key = jnp.where(bad & ~tried, 0, jnp.where(bad, 1, 2))
+    else:
+        # budgeted constrained selection: rank constrained stragglers
+        # untried-first and admit only the first topo_prefix of them to
+        # this pass — the REST of the window goes to unconstrained
+        # stragglers (untried first), so constrained overflow occupies
+        # no dead slots and can never starve unconstrained retries
+        cb = bad & topo_mask
+        ckey = jnp.where(cb & ~tried, 0, jnp.where(cb, 1, 2))
+        adm = cb & (stable_rank(ckey) < topo_prefix)
+        # untried pods of EITHER class outrank every tried pod
+        # (admitted-constrained tried included), so no untried straggler
+        # can be starved by retry loops of failing pods; admitted-tried
+        # rows displaced beyond the prefix are caught by the in_prefix
+        # mask
+        key = jnp.where(
+            adm & ~tried, 0,
+            jnp.where(bad & ~topo_mask & ~tried, 1,
+                      jnp.where(adm, 2,
+                                jnp.where(bad & ~topo_mask, 3,
+                                          jnp.where(bad, 4, 5)))))
+    order = jnp.argsort(key, stable=True)
+    idx = order[:tail_chunk]
+    attempt = bad[idx]
+    if topo_prefix is not None:
+        in_prefix = jnp.arange(tail_chunk) < topo_prefix
+        attempt &= ~topo_mask[idx] | in_prefix
+    return idx, attempt
+
+
+def tail_pass(step_fn, snap: ClusterSnapshot, counts: tuple,
+              assign: jnp.ndarray, tried: jnp.ndarray, pods: PodBatch,
+              cfg, *, tail_chunk: int, charge_counts: bool = True,
+              topo_prefix: int = None, topo_mask: jnp.ndarray = None):
+    """One retry pass: gather the selected stragglers into a compact
+    [tail_chunk] batch, re-schedule via `step_fn(snap, retry, cfg)`,
+    and scatter placements back. Returns (snap, counts, assign, tried).
+
+    The gathered retry batch marks only true leftovers valid, so a pass
+    with nothing left is a no-op on the snapshot. `counts` is the
+    carried (group x domain) topology-count tuple (COUNT_FIELDS order);
+    `charge_counts=False` skips the cross-batch charge for workloads
+    without topology terms (the slim bench path).
+    """
+    idx, attempt = tail_select(pods, assign, tried, tail_chunk,
+                               topo_prefix, topo_mask)
+    retry = pods.replace(
+        **{f: getattr(pods, f)[idx]
+           for f in PER_POD_FIELDS if f != "valid"},
+        valid=attempt)
+    retry = retry.replace(**dict(zip(COUNT_FIELDS, counts)))
+    tried = tried.at[idx].set(tried[idx] | attempt)
+    res = step_fn(snap, retry, cfg)
+    if charge_counts:
+        counts = charge_all_counts(counts, retry, res.assignment)
+    got = attempt & (res.assignment >= 0)
+    assign = assign.at[idx].set(
+        jnp.where(got, res.assignment, assign[idx]))
+    return res.snapshot, counts, assign, tried
+
+
+def tail_compaction_loop(step_fn, snap: ClusterSnapshot, counts: tuple,
+                         assign: jnp.ndarray, pods: PodBatch, cfg, *,
+                         tail_chunk: int, min_passes: int, max_passes: int,
+                         charge_counts: bool = True,
+                         topo_prefix: int = None,
+                         topo_mask: jnp.ndarray = None):
+    """The device-resident adaptive tail: run `tail_pass` inside a
+    lax.while_loop until the stragglers drain or the retry budget is
+    spent, entirely on device.
+
+    Returns (snap, counts, assign, stats) with stats i32[4] =
+    [stragglers_after_sweep, stragglers_final, never_retried, passes] —
+    a host that wants the numbers pays exactly ONE readback, after the
+    loop, instead of one blocking straggler-count transfer per adaptive
+    decision (each cost a full tunnel round-trip, ~100 ms; the 10-pass
+    full-gate tail paid up to 10 of them).
+
+    Retry-budget semantics (mirrors the host-driven oracle pass for
+    pass, so placements are bit-identical — tests/test_cascade.py):
+    - `min(min_passes, max_passes)` passes always run, even with zero
+      stragglers (the warm-path contract callers rely on);
+    - further passes run while stragglers remain AND (the count
+      improved over the previous pass OR never-retried stragglers
+      remain — a pass that placed nothing must not strand disjoint
+      windows that were never tried), up to `max_passes`;
+    - only the max_passes cap can leave never_retried > 0 (the caller
+      should surface that loudly — bench does).
+    """
+    p = pods.valid.shape[0]
+    min_eff = min(int(min_passes), int(max_passes))
+
+    def left_count(assign):
+        return jnp.sum(pods.valid & (assign < 0)).astype(jnp.int32)
+
+    left0 = left_count(assign)
+
+    def cond(carry):
+        _, _, _, _, passes, left, improved, never_retried = carry
+        forced = passes < min_eff
+        adaptive = ((passes < max_passes) & (left > 0)
+                    & (improved | (never_retried > 0)))
+        return forced | adaptive
+
+    def body(carry):
+        snap, counts, assign, tried, passes, left, _, _ = carry
+        snap, counts, assign, tried = tail_pass(
+            step_fn, snap, counts, assign, tried, pods, cfg,
+            tail_chunk=tail_chunk, charge_counts=charge_counts,
+            topo_prefix=topo_prefix, topo_mask=topo_mask)
+        bad = pods.valid & (assign < 0)
+        new_left = jnp.sum(bad).astype(jnp.int32)
+        never_retried = jnp.sum(bad & ~tried).astype(jnp.int32)
+        return (snap, counts, assign, tried, passes + jnp.int32(1),
+                new_left, new_left < left, never_retried)
+
+    init = (snap, counts, assign, jnp.zeros((p,), bool), jnp.int32(0),
+            left0, jnp.asarray(False), left0)
+    (snap, counts, assign, _, passes, left, _, never_retried) = \
+        jax.lax.while_loop(cond, body, init)
+    stats = jnp.stack([left0, left, never_retried, passes])
+    return snap, counts, assign, stats

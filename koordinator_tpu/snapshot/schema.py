@@ -207,6 +207,21 @@ class PodBatch:
         return self.requests.shape[0]
 
 
+# The [P]-leading PodBatch columns — the fields a per-pod gather/reorder
+# (chunk slicing, prefix packing, straggler-tail compaction) must
+# permute together; batch-global matrices (selector_match, the
+# (group x domain) tables, count0 surfaces) stay put. THE one list:
+# synthetic.stack_pod_chunks/slice_batch, the bench sweep, and the
+# device-resident tail (scheduler/core.tail_pass) all consume it.
+PER_POD_FIELDS = ("requests", "estimated", "qos", "priority_class",
+                  "priority", "gang_id", "quota_id", "selector_id",
+                  "reservation_owner", "gpu_ratio", "numa_single",
+                  "daemonset", "toleration_id", "spread_id",
+                  "spread_carrier", "spread_member", "anti_id",
+                  "anti_member", "anti_carrier", "aff_id", "aff_carrier",
+                  "aff_member", "valid")
+
+
 @flax.struct.dataclass
 class QuotaState:
     """Hierarchical elastic-quota tree, flattened. Shapes: [Q, ...].

@@ -23,6 +23,7 @@ from koordinator_tpu.snapshot.schema import (
     NUM_AGG,
     NUM_AUX_TYPES,
     NUM_DEV_DIMS,
+    PER_POD_FIELDS as _PER_POD_FIELDS,
     PodBatch,
     QuotaState,
     ReservationState,
@@ -697,13 +698,9 @@ def stack_pod_chunks(pods: PodBatch, chunk: int) -> dict:
             for f in PER_POD_FIELDS}
 
 
-PER_POD_FIELDS = ("requests", "estimated", "qos", "priority_class",
-                  "priority", "gang_id", "quota_id", "selector_id",
-                  "reservation_owner", "gpu_ratio", "numa_single",
-                  "daemonset", "toleration_id", "spread_id",
-                  "spread_carrier", "spread_member", "anti_id",
-                  "anti_member", "anti_carrier", "aff_id", "aff_carrier",
-                  "aff_member", "valid")
+# re-exported from the schema (which owns the per-pod column list) so
+# existing callers keep importing it from here
+PER_POD_FIELDS = _PER_POD_FIELDS
 
 
 def slice_batch(batch: PodBatch, start: int, size: int) -> PodBatch:

@@ -1,0 +1,36 @@
+"""Negative fixture: device-resident tails and unrelated array walks."""
+
+import numpy as np
+
+
+def adaptive_tail(loop, snap, counts, assign):
+    """The fixed shape: the whole adaptive loop runs on device and the
+    host reads ONE stats vector after it."""
+    snap, counts, assign, stats = loop(snap, counts, assign)
+    final = np.asarray(stats)       # single readback AFTER the loop
+    return snap, counts, assign, final
+
+
+def mandatory_tail(step, snap, stats_fn, n):
+    hist = []
+    for _ in range(n):              # tail loop, but fully device-resident
+        snap = step(snap)
+        hist.append(stats_fn(snap))  # device values, no transfer
+    return snap, hist
+
+
+def column_sums(rows):
+    out = []
+    for r in rows:                  # ordinary data walk, not a tail loop
+        out.append(np.asarray(r).sum())
+    return out
+
+
+def format_details(rows):
+    # 'details', 'retailer', 'curtailed' contain the vocabulary only as
+    # mid-word substrings — segment-boundary anchoring must not match
+    out = []
+    for retailer in rows:
+        curtailed = np.asarray(retailer)
+        out.append(curtailed.sum())
+    return out

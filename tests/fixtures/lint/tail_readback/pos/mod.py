@@ -1,0 +1,32 @@
+"""Positive fixture: per-pass host readbacks inside retry/tail loops."""
+
+import jax
+import numpy as np
+
+
+def adaptive_tail(step, snap, stats):
+    """The bug class: one blocking transfer per adaptive decision."""
+    left = 10
+    passes = 0
+    while passes < 6 and left > 0:
+        snap, stats = step(snap)
+        pair = np.asarray(stats)               # HS006
+        left = int(pair[0])
+        jax.device_get(stats)                  # HS006
+        stats.block_until_ready()              # HS006
+        passes += 1
+    return snap
+
+
+def drain(step, snap, count, budget):
+    # loop header never names the pattern; the callee does
+    for _ in range(budget):
+        snap, count = retry_pass(step, snap)
+        left = count.item()                    # HS006
+        if left == 0:
+            break
+    return snap
+
+
+def retry_pass(step, snap):
+    return step(snap)

@@ -31,6 +31,10 @@ def test_bench_runs_sharded_on_8_device_mesh(capsys, monkeypatch):
     assert result["devices"] == 8
     assert result["placed"] == 4000
     assert result["value"] > 0
+    # the slim canonical line is self-describing: device-resident tail,
+    # cascade off (the byte-stable canonical protocol)
+    assert result["tail_mode"] == "device"
+    assert result["cascade"] is False
 
 
 def test_bench_full_gate_sharded(capsys, monkeypatch):
@@ -48,6 +52,9 @@ def test_bench_full_gate_sharded(capsys, monkeypatch):
     assert result["placed"] > 3000
     assert result["metric"].endswith("full_gate")
     assert result["never_retried"] == 0
+    # full-gate runs through the gate cascade + device tail by default
+    assert result["cascade"] is True
+    assert result["tail_mode"] == "device"
 
 
 def test_topology_delta_ingests_into_a_sharded_store():

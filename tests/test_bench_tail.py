@@ -1,0 +1,80 @@
+"""bench.py tail/cascade knob semantics (no device work — these pin the
+host-side parsing and protocol selection that the heavy mesh tests rely
+on).
+
+The BENCH_MAX_TAIL_PASSES consolidation: the variable used to be read
+TWICE with different semantics — once at import into a module constant
+(post-import env changes invisible to it; an empty string crashed the
+int() at import) and once as a raw truthiness check at run_northstar
+(empty string flipped the full-gate default branch while the constant
+kept the stale value). `bench.max_tail_passes` is now THE single
+call-time parse; these tests pin its contract.
+"""
+
+import importlib
+
+import pytest
+
+
+@pytest.fixture()
+def bench_mod(monkeypatch):
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    import bench
+    return importlib.reload(bench)
+
+
+def test_max_tail_passes_defaults(bench_mod, monkeypatch):
+    monkeypatch.delenv("BENCH_MAX_TAIL_PASSES", raising=False)
+    assert bench_mod.max_tail_passes(False) == 6
+    # the narrower full-gate tail needs more passes to cover the same
+    # straggler pool (3160 at the 100k capture > 6 x 512)
+    assert bench_mod.max_tail_passes(True) == 10
+
+
+def test_max_tail_passes_explicit_wins_both_paths(bench_mod, monkeypatch):
+    # read at CALL time, not import time: this env var lands after the
+    # module import and must still win on both paths
+    monkeypatch.setenv("BENCH_MAX_TAIL_PASSES", "3")
+    assert bench_mod.max_tail_passes(False) == 3
+    assert bench_mod.max_tail_passes(True) == 3
+    # 0 is the legitimate quick-run knob (skip the tail entirely)
+    monkeypatch.setenv("BENCH_MAX_TAIL_PASSES", "0")
+    assert bench_mod.max_tail_passes(False) == 0
+    assert bench_mod.max_tail_passes(True) == 0
+    # negative values clamp to 0 instead of producing a nonsense range
+    monkeypatch.setenv("BENCH_MAX_TAIL_PASSES", "-2")
+    assert bench_mod.max_tail_passes(True) == 0
+
+
+def test_max_tail_passes_empty_string_is_unset(bench_mod, monkeypatch):
+    # the old import-time `int(os.environ.get(...))` crashed on ""
+    # while the run-time truthiness check treated it as unset; the
+    # consolidated parse treats it as unset everywhere
+    monkeypatch.setenv("BENCH_MAX_TAIL_PASSES", "")
+    assert bench_mod.max_tail_passes(False) == 6
+    assert bench_mod.max_tail_passes(True) == 10
+
+
+def test_bench_has_single_max_tail_env_read(bench_mod):
+    """Regression pin for the consolidation itself: exactly one source
+    line reads the env var (the parse inside max_tail_passes)."""
+    import inspect
+    src = inspect.getsource(bench_mod)
+    reads = [l for l in src.splitlines()
+             if "BENCH_MAX_TAIL_PASSES" in l and "environ" in l]
+    assert len(reads) == 1, reads
+
+
+def test_stamped_line_always_carries_staleness(bench_mod):
+    """The one constructor for surfaced stamped lines sets the full
+    provenance set unconditionally (satellite: no stamped line without
+    a stale marker ever again)."""
+    out = bench_mod._stamped_line({"metric": "m", "value": 1.0},
+                                  "2026-01-01T00:00:00+00:00",
+                                  age=7200.0, stale_after=3600.0)
+    assert out["stamped_capture"] is True
+    assert out["stale_capture"] is True
+    assert out["stamped_age_seconds"] == 7200
+    fresh = bench_mod._stamped_line({"metric": "m"}, "t", age=10.0,
+                                    stale_after=3600.0)
+    assert fresh["stale_capture"] is False

@@ -37,6 +37,7 @@ _ANALYZER_NAMES = {
     "lock_discipline": "lock-discipline",
     "metric_names": "metric-registry",
     "proto_drift": "proto-drift",
+    "tail_readback": "tail-readback",
 }
 
 
@@ -60,6 +61,7 @@ def empty_baseline(tmp_path):
     ("lock_discipline", {"LK001", "LK002", "LK003"}),
     ("metric_names", {"MN001", "MN002", "MN003", "MN004"}),
     ("proto_drift", {"PD001", "PD002", "PD003"}),
+    ("tail_readback", {"HS006"}),
 ])
 def test_positive_fixture(fixture_dir, expected_codes, empty_baseline):
     findings = fixture_findings(fixture_dir, "pos", empty_baseline)
@@ -85,6 +87,69 @@ def test_host_sync_reports_deep_callee_site(empty_baseline):
     assert items and all("deep" in f.key for f in items), \
         "the .item() sink sits two calls below the entry and must be " \
         "attributed to the function that contains it"
+
+
+_TAIL_LOOP_SRC = (
+    "import numpy as np\n"
+    "\n"
+    "def adaptive(step, snap, stats, budget):\n"
+    "    left = 1\n"
+    "    passes = 0\n"
+    "    while passes < budget and left > 0:\n"
+    "        snap, stats = retry_pass(step, snap)\n"
+    "        left = int(np.asarray(stats)[0]){marker}\n"
+    "        passes += 1\n"
+    "    return snap\n"
+    "\n"
+    "def retry_pass(step, snap):\n"
+    "    return step(snap)\n")
+
+
+def test_tail_readback_inline_disable(tmp_path, empty_baseline):
+    """`# koordlint: disable=HS006` on the finding's line suppresses it
+    in place (the bench host-tail conformance oracle relies on this);
+    the analyzer name works as the token too, and the marker only
+    covers its OWN line."""
+    (tmp_path / "m.py").write_text(_TAIL_LOOP_SRC.format(marker=""))
+    new, _ = run_lint(str(tmp_path), analyzers=["tail-readback"],
+                      baseline_path=str(empty_baseline))
+    assert [f.code for f in new] == ["HS006"], [f.render() for f in new]
+
+    for token in ("HS006", "tail-readback",
+                  # trailing prose after the code must not defeat the
+                  # marker (tokens split on whitespace AND commas)
+                  "HS006 measured oracle"):
+        (tmp_path / "m.py").write_text(_TAIL_LOOP_SRC.format(
+            marker=f"  # koordlint: disable={token}"))
+        new, suppressed = run_lint(str(tmp_path),
+                                   analyzers=["tail-readback"],
+                                   baseline_path=str(empty_baseline))
+        assert new == [] and suppressed == [], \
+            (token, [f.render() for f in new])
+
+    # a marker on an UNRELATED line must not suppress the finding
+    (tmp_path / "m.py").write_text(
+        "# koordlint: disable=HS006\n" + _TAIL_LOOP_SRC.format(marker=""))
+    new, _ = run_lint(str(tmp_path), analyzers=["tail-readback"],
+                      baseline_path=str(empty_baseline))
+    assert [f.code for f in new] == ["HS006"]
+
+
+def test_tail_readback_ignores_plain_data_walks(tmp_path,
+                                                empty_baseline):
+    """np.asarray in a loop with no retry/tail vocabulary anywhere is
+    an ordinary data walk, not the flagged bug class."""
+    (tmp_path / "m.py").write_text(
+        "import numpy as np\n"
+        "\n"
+        "def column_sums(rows):\n"
+        "    out = []\n"
+        "    for r in rows:\n"
+        "        out.append(np.asarray(r).sum())\n"
+        "    return out\n")
+    new, _ = run_lint(str(tmp_path), analyzers=["tail-readback"],
+                      baseline_path=str(empty_baseline))
+    assert new == [], [f.render() for f in new]
 
 
 def test_donation_loop_wraparound(empty_baseline):
@@ -283,12 +348,13 @@ def test_bench_stale_capture_flag(tmp_path, monkeypatch, capsys):
     art = tmp_path / "cap.json"
     monkeypatch.setattr(bench, "CAPTURE_ARTIFACT", str(art))
 
-    def write_artifact(age_seconds):
+    def write_artifact(age_seconds, n_lines=1):
         at = (datetime.datetime.now(datetime.timezone.utc)
               - datetime.timedelta(seconds=age_seconds)).isoformat()
         art.write_text(json.dumps(
             {"captured_at": at,
-             "lines": [{"metric": "m", "value": 1.0}]}))
+             "lines": [{"metric": f"m{i}", "value": 1.0}
+                       for i in range(n_lines)]}))
 
     write_artifact(30)
     assert bench.surface_stamped_capture()
@@ -296,11 +362,18 @@ def test_bench_stale_capture_flag(tmp_path, monkeypatch, capsys):
     assert fresh["stamped_capture"] is True
     assert fresh["stale_capture"] is False
 
-    write_artifact(4 * 3600)   # older than the 1 h default
+    # EVERY stamped line of a multi-line artifact carries the full
+    # provenance set — the r05 tail surfaced 10 h-old captures whose
+    # metric lines had no stale marker
+    write_artifact(4 * 3600, n_lines=3)   # older than the 1 h default
     assert bench.surface_stamped_capture()
-    stale = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
-    assert stale["stale_capture"] is True
-    assert stale["stamped_age_seconds"] >= 3600
+    out_lines = [json.loads(l) for l in
+                 capsys.readouterr().out.strip().splitlines()]
+    assert len(out_lines) == 3
+    for stale in out_lines:
+        assert stale["stamped_capture"] is True
+        assert stale["stale_capture"] is True
+        assert stale["stamped_age_seconds"] >= 3600
 
     # threshold is configurable
     monkeypatch.setenv("BENCH_STAMP_STALE_AFTER", str(10 * 3600))

@@ -1,0 +1,137 @@
+"""Full-gate cascade + device-tail CI smoke (tools/ci.sh stage).
+
+Exercises the cascade path on every push at a CI-affordable shape
+(default 2k pods x 200 nodes, CPU) and asserts CORRECTNESS, not
+wall-clock:
+
+1. conformance — cascade on vs off produce IDENTICAL placements chunk
+   by chunk with carried topology counts (the `cascade=False` oracle at
+   CI scale, with every packing contract engaged);
+2. straggler accounting — the device-resident tail drains the pool
+   under its retry budget, its single packed stats readback agrees with
+   the assignment vector, nothing is left never-retried, and the placed
+   fraction clears a floor;
+3. cascade observability — stage 1 leaves every placed pod a surviving
+   candidate (the mask soundness invariant, checked against the actual
+   placements).
+
+Shapes are env-overridable (SMOKE_PODS / SMOKE_NODES / SMOKE_CHUNK) for
+local iteration; the defaults are the CI protocol.
+"""
+
+import functools
+import json
+import os
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+SMOKE_PODS = int(os.environ.get("SMOKE_PODS", 2_000))
+SMOKE_NODES = int(os.environ.get("SMOKE_NODES", 200))
+SMOKE_CHUNK = int(os.environ.get("SMOKE_CHUNK", 500))
+
+
+def main() -> int:
+    from koordinator_tpu.scheduler import cascade, core
+    from koordinator_tpu.scheduler.plugins.loadaware import LoadAwareConfig
+    from koordinator_tpu.utils import synthetic
+
+    t0 = time.perf_counter()
+    pods = synthetic.full_gate_pods(SMOKE_PODS, SMOKE_NODES, seed=1,
+                                    num_quotas=8, num_gangs=8)
+    packed, prefixes, masks = synthetic.pack_gate_prefixes(pods,
+                                                           SMOKE_CHUNK)
+    snap0 = synthetic.full_gate_cluster(SMOKE_NODES, seed=0,
+                                        num_quotas=8, num_gangs=8)
+    cfg = LoadAwareConfig.make()
+    kw = dict(num_rounds=2, k_choices=8, score_dims=(0, 1),
+              tie_break=True, quota_depth=2, fit_dims=(0, 1, 2, 3),
+              enable_numa=True, enable_devices=True,
+              topo_prefix=prefixes["topo"],
+              dom_classes=synthetic.dom_classes(packed),
+              numa_prefix=prefixes["numa"], gpu_prefix=prefixes["gpu"])
+
+    def sweep(cascade_on):
+        snap = snap0
+        counts = tuple(jnp.asarray(getattr(packed, f))
+                       for f in core.COUNT_FIELDS)
+        assign = []
+        for s in range(0, SMOKE_PODS, SMOKE_CHUNK):
+            batch = synthetic.slice_batch(packed, s, SMOKE_CHUNK).replace(
+                **dict(zip(core.COUNT_FIELDS, counts)))
+            res = core.schedule_batch(snap, batch, cfg,
+                                      cascade=cascade_on, **kw)
+            counts = core.charge_all_counts(counts, batch, res.assignment)
+            snap = res.snapshot
+            assign.append(res.assignment)
+        return snap, counts, jnp.concatenate(assign)
+
+    # 1. conformance: cascade on == cascade off, chunk by chunk
+    snap_off, _, assign_off = sweep(cascade_on=False)
+    snap_on, counts_on, assign_on = sweep(cascade_on=True)
+    np.testing.assert_array_equal(np.asarray(assign_off),
+                                  np.asarray(assign_on))
+    for a, b in zip(jax.tree_util.tree_leaves(snap_off),
+                    jax.tree_util.tree_leaves(snap_on)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    # 3. cascade observability: every node-placed pod survived stage 1
+    batch0 = synthetic.slice_batch(packed, 0, SMOKE_CHUNK)
+    static_ok, _ = cascade.static_gates(snap0.nodes, batch0, cfg)
+    mask = np.asarray(cascade.stage1_mask(snap0, batch0, static_ok,
+                                          fit_dims=(0, 1, 2, 3),
+                                          quota_depth=2))
+    a0 = np.asarray(assign_on)[:SMOKE_CHUNK]
+    survivors = np.asarray(cascade.candidate_counts(jnp.asarray(mask)))
+    placed_rows = np.flatnonzero(a0 >= 0)
+    assert placed_rows.size, "first chunk placed nothing"
+    assert (survivors[placed_rows] > 0).all(), \
+        "stage 1 pruned a pod the commit placed"
+
+    # 2. device-resident tail: drain under budget, one stats readback
+    tail_step = functools.partial(
+        core.schedule_batch, num_rounds=4, k_choices=32,
+        score_dims=(0, 1), tie_break=True, quota_depth=2,
+        fit_dims=(0, 1, 2, 3), enable_numa=True, enable_devices=True,
+        cascade=True, topo_prefix=kw["topo_prefix"],
+        dom_classes=kw["dom_classes"])
+    loop = jax.jit(functools.partial(
+        core.tail_compaction_loop, tail_step,
+        tail_chunk=min(SMOKE_CHUNK, 512), min_passes=2, max_passes=10,
+        topo_prefix=kw["topo_prefix"],
+        topo_mask=jnp.asarray(masks["topo"])))
+    snap_fin, _, assign_fin, stats = loop(
+        snap_on, counts_on, assign_on, packed, cfg)
+    stats = [int(x) for x in np.asarray(stats)]
+    after_sweep, final, never_retried, passes = stats
+    a_fin = np.asarray(assign_fin)
+    recount = int((np.asarray(packed.valid) & (a_fin < 0)).sum())
+    assert final == recount, \
+        f"stats readback {final} disagrees with the bind log {recount}"
+    assert after_sweep == int((np.asarray(packed.valid)
+                               & (np.asarray(assign_on) < 0)).sum())
+    assert never_retried == 0, \
+        f"{never_retried} stragglers never retried (passes={passes})"
+    assert passes <= 10
+    placed = int((a_fin >= 0).sum())
+    assert placed >= int(0.95 * SMOKE_PODS), \
+        f"only {placed}/{SMOKE_PODS} placed after the tail"
+
+    print(json.dumps({
+        "smoke": "cascade", "pods": SMOKE_PODS, "nodes": SMOKE_NODES,
+        "chunk": SMOKE_CHUNK, "placed": placed,
+        "stragglers_after_sweep": after_sweep, "stragglers_final": final,
+        "tail_passes": passes, "never_retried": never_retried,
+        "prefixes": prefixes,
+        "elapsed_s": round(time.perf_counter() - t0, 1)}))
+    print("cascade smoke OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -8,6 +8,13 @@ cd "$(dirname "$0")/.."
 echo "=== koordlint (python -m tools.lint) ==="
 python -m tools.lint
 
+echo "=== full-gate cascade smoke (2k pods x 200 nodes, CPU) ==="
+# correctness + straggler-count assertions, not wall-clock: cascade
+# on/off conformance, device-tail drain, single-stats-readback
+# consistency (tools/cascade_smoke.py) — the cascade path runs on
+# every push even when no test touches it
+JAX_PLATFORMS=cpu python tools/cascade_smoke.py
+
 echo "=== tier-1 tests (JAX_PLATFORMS=cpu) ==="
 set -o pipefail
 rm -f /tmp/_t1.log

@@ -8,4 +8,5 @@ from tools.lint.analyzers import (  # noqa: F401
     metric_names,
     proto_drift,
     recompile,
+    tail_readback,
 )
