@@ -62,6 +62,7 @@ from koordinator_tpu.descheduler.lownodeload import (
     LowNodeLoadArgs,
 )
 from koordinator_tpu.snapshot.builder import resource_vec
+from koordinator_tpu.snapshot.schema import shape_contract
 
 
 def _plan_prelude(usage, capacity, fresh, source_mask,
@@ -117,6 +118,15 @@ def _plan_prelude(usage, capacity, fresh, source_mask,
     return sel, active, order, budget0, high_abs
 
 
+@shape_contract(
+    usage="f32[N,R]", capacity="f32[N,R]", fresh="bool[N]",
+    source_mask="bool[N]", pod_node="i32[P]", pod_usage_r="f32[P,RD]",
+    pod_req="f32[P,R]", pod_eligible="bool[P]", low="f32[RD]",
+    high="f32[RD]", weights="f32[RD]", rdims_onehot="f32[RD,R]",
+    max_evictions="i32[]",
+    _returns=("bool[P]", "i32[P]"),
+    _pad="pod_usage_r is pre-restricted to the RD threshold dims via "
+         "rdims_onehot; ineligible pods are simply never taken")
 @functools.partial(jax.jit, static_argnames=("use_deviation", "node_fit",
                                              "fit_dims"))
 def plan_kernel(usage, capacity, fresh, source_mask,
@@ -170,6 +180,16 @@ def lax_cummax(x: jnp.ndarray) -> jnp.ndarray:
     return jax.lax.associative_scan(jnp.maximum, x)
 
 
+@shape_contract(
+    usage="f32[N,R]", capacity="f32[N,R]", fresh="bool[N]",
+    source_mask="bool[N]", pod_node="i32[P]", pod_usage_r="f32[P,RD]",
+    pod_req="f32[P,R]", pod_eligible="bool[P]", low="f32[RD]",
+    high="f32[RD]", weights="f32[RD]", rdims_onehot="f32[RD,R]",
+    pod_ns="i32[P]", ns_counts0="i32[NS]", per_node0="i32[N]",
+    max_evictions="i32[]", max_per_node="i32[]", max_per_ns="i32[]",
+    _returns=("bool[P]", "i32[P]"),
+    _pad="ns_counts0 is padded to a pow2 namespace table "
+         "(columnarize_ns); unlimited caps ride _BIG sentinels")
 @functools.partial(jax.jit, static_argnames=("use_deviation", "node_fit",
                                              "fit_dims"))
 def plan_kernel_capped(usage, capacity, fresh, source_mask,

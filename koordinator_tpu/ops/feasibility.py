@@ -21,7 +21,12 @@ from typing import Optional
 import jax.numpy as jnp
 
 from koordinator_tpu.scheduler.batching import EPS
-from koordinator_tpu.snapshot.schema import MAX_QUOTA_DEPTH, PodBatch, QuotaState
+from koordinator_tpu.snapshot.schema import (
+    MAX_QUOTA_DEPTH,
+    PodBatch,
+    QuotaState,
+    shape_contract,
+)
 
 
 def _dims(x: jnp.ndarray, fit_dims: Optional[tuple]) -> jnp.ndarray:
@@ -30,6 +35,11 @@ def _dims(x: jnp.ndarray, fit_dims: Optional[tuple]) -> jnp.ndarray:
     return x if fit_dims is None else x[..., list(fit_dims)]
 
 
+@shape_contract(
+    allocatable="f32[N,R]", requested="f32[N,R]", requests="f32[P,R]",
+    _returns="bool[P,N]",
+    _pad="padded node rows carry allocatable 0 so no pod fits them; "
+         "padded pod rows are masked later by pods.valid")
 def resource_fit(allocatable: jnp.ndarray, requested: jnp.ndarray,
                  requests: jnp.ndarray,
                  fit_dims: Optional[tuple] = None) -> jnp.ndarray:
@@ -43,6 +53,9 @@ def resource_fit(allocatable: jnp.ndarray, requested: jnp.ndarray,
         <= _dims(allocatable, fit_dims)[None] + EPS, axis=-1)
 
 
+@shape_contract(quotas="QuotaState", pods="PodBatch",
+                _returns="i32[P,QD]",
+                _pad="-1 rows past the leaf / for quota-less pods")
 def pod_ancestors(quotas: QuotaState, pods: PodBatch) -> jnp.ndarray:
     """i32[P, D]: each pod's quota-tree ancestor chain per depth, -1 =
     none (quota-less pods get an all--1 row)."""
@@ -51,6 +64,10 @@ def pod_ancestors(quotas: QuotaState, pods: PodBatch) -> jnp.ndarray:
                      quotas.depth_ancestor[quota_id], -1)
 
 
+@shape_contract(quotas="QuotaState", pods="PodBatch",
+                _returns="bool[P]",
+                _pad="invalid quota rows carry runtime +inf and never "
+                     "gate; quota-less pods pass every level")
 def quota_ceiling_ok(quotas: QuotaState, pods: PodBatch,
                      quota_depth: int = MAX_QUOTA_DEPTH,
                      fit_dims: Optional[tuple] = None) -> jnp.ndarray:

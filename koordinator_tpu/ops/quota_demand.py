@@ -11,9 +11,13 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from koordinator_tpu.snapshot.schema import PodBatch, QuotaState
+from koordinator_tpu.snapshot.schema import PodBatch, QuotaState, shape_contract
 
 
+@shape_contract(quotas="QuotaState", pods="PodBatch",
+                _returns="QuotaState",
+                _pad="invalid pod rows (valid False) and quota-less pods "
+                     "(quota_id -1) charge the drop row, not the tree")
 @jax.jit
 def add_pending_demand(quotas: QuotaState, pods: PodBatch) -> QuotaState:
     q = quotas.min.shape[0]

@@ -30,7 +30,11 @@ import functools
 import jax
 import jax.numpy as jnp
 
-from koordinator_tpu.snapshot.schema import MAX_QUOTA_DEPTH, QuotaState
+from koordinator_tpu.snapshot.schema import (
+    MAX_QUOTA_DEPTH,
+    QuotaState,
+    shape_contract,
+)
 
 
 def _seg_sum(values: jnp.ndarray, seg: jnp.ndarray, num: int) -> jnp.ndarray:
@@ -39,6 +43,8 @@ def _seg_sum(values: jnp.ndarray, seg: jnp.ndarray, num: int) -> jnp.ndarray:
     return out.at[jnp.where(seg >= 0, seg, num)].add(values)[:num]
 
 
+@shape_contract(quotas="QuotaState", _returns="f32[Q,R]",
+                _pad="invalid rows carry depth -1 and contribute nothing")
 def propagate_demand(quotas: QuotaState) -> jnp.ndarray:
     """f32[Q, R]: limitedRequest per quota, from DIRECT demand.
 
@@ -121,6 +127,10 @@ def _redistribute_level(level_mask: jnp.ndarray, parent: jnp.ndarray,
     return jnp.where(m, runtime, 0.0)
 
 
+@shape_contract(quotas="QuotaState", cluster_total="f32[R]",
+                _returns="f32[Q,R]",
+                _static={"max_iters": 8},
+                _pad="invalid quota rows return +inf (never gate)")
 @functools.partial(jax.jit, static_argnames=("max_iters",))
 def compute_runtime(quotas: QuotaState, cluster_total: jnp.ndarray,
                     max_iters: int = 64) -> jnp.ndarray:

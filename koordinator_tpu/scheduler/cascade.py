@@ -52,9 +52,16 @@ from koordinator_tpu.snapshot.schema import (
     MAX_QUOTA_DEPTH,
     NodeState,
     PodBatch,
+    shape_contract,
 )
 
 
+@shape_contract(
+    nodes="NodeState", pods="PodBatch", cfg="LoadAwareConfig",
+    _returns=("bool[P,N]", "?f32[P,N]"),
+    _pad="unschedulable (padded) node columns are False everywhere; "
+         "taint_penalty is None when the batch models no tolerations "
+         "(has_taints False — the gate compiles out)")
 def static_gates(nodes: NodeState, pods: PodBatch,
                  cfg: loadaware.LoadAwareConfig
                  ) -> Tuple[jnp.ndarray, Optional[jnp.ndarray]]:
@@ -89,6 +96,12 @@ def static_gates(nodes: NodeState, pods: PodBatch,
     return static_ok, taint_penalty
 
 
+@shape_contract(
+    snap="ClusterSnapshot", pods="PodBatch", static_ok="bool[P,N]",
+    _returns="bool[P,N]",
+    _pad="a SUPERSET of every commit round's node-column feasibility; "
+         "never applied to reservation slot columns (consumers draw "
+         "from the slot's own hold)")
 def stage1_mask(snap: ClusterSnapshot, pods: PodBatch,
                 static_ok: jnp.ndarray,
                 fit_dims: Optional[tuple] = None,
@@ -112,6 +125,7 @@ def stage1_mask(snap: ClusterSnapshot, pods: PodBatch,
     return mask
 
 
+@shape_contract(mask="bool[P,N]", _returns="i32[P]")
 def candidate_counts(mask: jnp.ndarray) -> jnp.ndarray:
     """i32[P]: surviving candidate nodes per pod — the cascade's
     observability hook (a zero row is a pod stage 1 already proved

@@ -68,6 +68,8 @@ from koordinator_tpu.snapshot.schema import (
     NUM_DEV_DIMS,
     PER_POD_FIELDS,
     PodBatch,
+    register_struct,
+    shape_contract,
 )
 
 
@@ -107,6 +109,26 @@ class ScheduleResult:
     #   un-assume path MUST mirror it so returned CPU equals charged CPU
 
 
+register_struct(ScheduleResult, {
+    "assignment": "i32[P]",
+    "chosen_score": "f32[P]",
+    "numa_zone": "i32[P]",
+    "numa_take": "f32[P,Z,2]",
+    "gpu_take": "bool[P,I]",
+    "aux_inst": "i32[P,AX]",
+    "res_slot": "i32[P]",
+    "gang_failed": "bool[G]",
+    "snapshot": "ClusterSnapshot",
+})
+
+
+@shape_contract(
+    snap="ClusterSnapshot", pods="PodBatch", cfg="LoadAwareConfig",
+    _returns="ScheduleResult",
+    _static={"num_rounds": 2, "k_choices": 2, "quota_depth": 2},
+    _pad="pods.valid masks padded pod rows (assignment -1); "
+         "nodes.schedulable masks padded node columns; every "
+         "[P]-leading result field is -1/0/False for unplaced rows")
 @functools.partial(jax.jit, static_argnames=("num_rounds", "k_choices",
                                              "score_dims", "approx_topk",
                                              "tie_break", "enable_numa",
@@ -1290,6 +1312,12 @@ def charge_all_counts(counts: tuple, batch, assignment) -> tuple:
         for c, (_, dom, mem) in zip(counts, _COUNT_RULE))
 
 
+@shape_contract(
+    count0="f32[SG,DM]", dom_matrix="i32[SG,N]", member="bool[P,SG]",
+    assignment="i32[P]", _returns="f32[SG,DM]",
+    _pad="unplaced rows (assignment -1), non-members, and keyless "
+         "nodes (domain -1) all charge the drop row; the SG symbol "
+         "stands for any of the three constraint families")
 def charge_domain_counts(count0: jnp.ndarray, dom_matrix: jnp.ndarray,
                          member: jnp.ndarray,
                          assignment: jnp.ndarray) -> jnp.ndarray:
@@ -1331,6 +1359,13 @@ def charge_domain_counts(count0: jnp.ndarray, dom_matrix: jnp.ndarray,
 # the end regardless of straggler count.
 
 
+@shape_contract(
+    pods="PodBatch", assign="i32[P]", tried="bool[P]",
+    _returns=("i32[TC]", "bool[TC]"),
+    _static={"tail_chunk": "TC"},
+    _pad="requires tail_chunk <= P (the window gathers batch rows); "
+         "rows of idx beyond the straggler pool are padding; attempt "
+         "marks the true leftovers this pass may retry")
 def tail_select(pods: PodBatch, assign: jnp.ndarray, tried: jnp.ndarray,
                 tail_chunk: int, topo_prefix: int = None,
                 topo_mask: jnp.ndarray = None):
@@ -1386,6 +1421,18 @@ def tail_select(pods: PodBatch, assign: jnp.ndarray, tried: jnp.ndarray,
     return idx, attempt
 
 
+@shape_contract(
+    snap="ClusterSnapshot",
+    counts=("f32[SG,DM]", "f32[AG,DM]", "f32[AG,DM]", "f32[FG,DM]"),
+    assign="i32[P]", tried="bool[P]", pods="PodBatch",
+    cfg="LoadAwareConfig",
+    _returns=("ClusterSnapshot",
+              ("f32[SG,DM]", "f32[AG,DM]", "f32[AG,DM]", "f32[FG,DM]"),
+              "i32[P]", "bool[P]"),
+    _static={"tail_chunk": "TC"},
+    _callable={"step_fn": "koordinator_tpu.scheduler.core.schedule_batch"},
+    _pad="counts ride COUNT_FIELDS order; a pass with nothing left "
+         "gathers an all-invalid retry batch and no-ops the snapshot")
 def tail_pass(step_fn, snap: ClusterSnapshot, counts: tuple,
               assign: jnp.ndarray, tried: jnp.ndarray, pods: PodBatch,
               cfg, *, tail_chunk: int, charge_counts: bool = True,
@@ -1417,6 +1464,17 @@ def tail_pass(step_fn, snap: ClusterSnapshot, counts: tuple,
     return res.snapshot, counts, assign, tried
 
 
+@shape_contract(
+    snap="ClusterSnapshot",
+    counts=("f32[SG,DM]", "f32[AG,DM]", "f32[AG,DM]", "f32[FG,DM]"),
+    assign="i32[P]", pods="PodBatch", cfg="LoadAwareConfig",
+    _returns=("ClusterSnapshot",
+              ("f32[SG,DM]", "f32[AG,DM]", "f32[AG,DM]", "f32[FG,DM]"),
+              "i32[P]", "i32[4]"),
+    _static={"tail_chunk": "TC", "min_passes": 1, "max_passes": 2},
+    _callable={"step_fn": "koordinator_tpu.scheduler.core.schedule_batch"},
+    _pad="stats = [after_sweep, final, never_retried, passes]; only "
+         "the max_passes cap can leave never_retried > 0")
 def tail_compaction_loop(step_fn, snap: ClusterSnapshot, counts: tuple,
                          assign: jnp.ndarray, pods: PodBatch, cfg, *,
                          tail_chunk: int, min_passes: int, max_passes: int,

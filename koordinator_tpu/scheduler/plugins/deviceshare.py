@@ -48,6 +48,7 @@ from koordinator_tpu.snapshot.schema import (
     DEV_RATIO,
     DeviceState,
     PodBatch,
+    shape_contract,
 )
 
 GPU_CORE = int(ResourceKind.GPU_CORE)
@@ -102,6 +103,11 @@ def _per_instance(total_mem, pods: PodBatch):
     return count, per_inst
 
 
+@shape_contract(devices="DeviceState", pods="PodBatch",
+                node_idx="i32[P]",
+                _returns=("i32[P]", "f32[P,DEV]"),
+                _pad="out-of-range node_idx (= no node) is clipped; "
+                     "pods without GPU requests get count 0 and zero rows")
 def per_instance_at(devices: DeviceState, pods: PodBatch,
                     node_idx: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """(count i32[P], per_inst f32[P, 3]) at each pod's chosen node
@@ -111,6 +117,10 @@ def per_instance_at(devices: DeviceState, pods: PodBatch,
     return _per_instance(devices.gpu_total[nc, DEV_MEM], pods)
 
 
+@shape_contract(devices="DeviceState", pods="PodBatch",
+                _returns="bool[P,N]",
+                _pad="non-device pods pass everywhere; invalid "
+                     "instances (gpu_valid False) never count")
 def prefilter(devices: DeviceState, pods: PodBatch) -> jnp.ndarray:
     """bool[P, N]: batch-start upper bound — the node has >= count instances
     each fitting the per-instance request, and every requested aux pool has
@@ -136,6 +146,9 @@ def prefilter(devices: DeviceState, pods: PodBatch) -> jnp.ndarray:
     return ok
 
 
+@shape_contract(devices="DeviceState", pods="PodBatch",
+                _returns="f32[P,N]",
+                _pad="0 for pods without GPU requests")
 def score_matrix(devices: DeviceState, pods: PodBatch,
                  strategy: str = "least") -> jnp.ndarray:
     """f32[P, N] in [0, 100]: least/most-allocated score of the node's GPU

@@ -24,7 +24,13 @@ import numpy as np
 
 from koordinator_tpu.api.extension import NUM_RESOURCES, PriorityClass, ResourceKind
 from koordinator_tpu.scheduler.batching import MAX_NODE_SCORE
-from koordinator_tpu.snapshot.schema import AGG_TYPES, NodeState, PodBatch
+from koordinator_tpu.snapshot.schema import (
+    AGG_TYPES,
+    NodeState,
+    PodBatch,
+    register_struct,
+    shape_contract,
+)
 
 
 @flax.struct.dataclass
@@ -74,6 +80,17 @@ class LoadAwareConfig:
         )
 
 
+register_struct(LoadAwareConfig, {
+    "resource_weights": "f32[R]",
+    "usage_thresholds": "f32[R]",
+    "prod_usage_thresholds": "f32[R]",
+    "agg_usage_thresholds": "f32[R]",
+    "filter_agg_idx": "i32[]",
+    "score_agg_idx": "i32[]",
+    "score_according_prod_usage": "bool[]",
+})
+
+
 def _usage_percent(used: jnp.ndarray, total: jnp.ndarray) -> jnp.ndarray:
     """math.Round(used/total*100), 0 where total == 0 (filterNodeUsage math).
 
@@ -86,6 +103,11 @@ def _usage_percent(used: jnp.ndarray, total: jnp.ndarray) -> jnp.ndarray:
     return pct
 
 
+@shape_contract(nodes="NodeState", pods="PodBatch", cfg="LoadAwareConfig",
+                _returns="bool[P,N]",
+                _pad="nodes without fresh metrics pass (metric_fresh "
+                     "False == padded rows pass; schedulable gates them "
+                     "downstream); DaemonSet pods pass everywhere")
 def filter_mask(nodes: NodeState, pods: PodBatch,
                 cfg: LoadAwareConfig) -> jnp.ndarray:
     """bool[P, N]: True = node passes the LoadAware filter for the pod.
@@ -133,6 +155,9 @@ def _guarded_sub(source: jnp.ndarray, correction: jnp.ndarray) -> jnp.ndarray:
     return source - jnp.where(source >= correction, correction, 0.0)
 
 
+@shape_contract(nodes="NodeState", pods="PodBatch", cfg="LoadAwareConfig",
+                _returns="f32[P,N]",
+                _pad="nodes without a fresh NodeMetric score 0")
 def score_matrix(nodes: NodeState, pods: PodBatch,
                  cfg: LoadAwareConfig,
                  score_dims: Optional[tuple] = None) -> jnp.ndarray:

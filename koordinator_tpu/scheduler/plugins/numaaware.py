@@ -32,12 +32,14 @@ import jax.numpy as jnp
 
 from koordinator_tpu.api.extension import ResourceKind
 from koordinator_tpu.scheduler.batching import EPS, MAX_NODE_SCORE
-from koordinator_tpu.snapshot.schema import NodeState, PodBatch
+from koordinator_tpu.snapshot.schema import NodeState, PodBatch, shape_contract
 
 CPU = int(ResourceKind.CPU)
 MEM = int(ResourceKind.MEMORY)
 
 
+@shape_contract(pods="PodBatch", _returns="f32[P,2]",
+                _pad="zero rows for unbound pods (their scatters no-op)")
 def pod_zone_requests(pods: PodBatch) -> jnp.ndarray:
     """f32[P, 2]: the (cpu milli, mem MiB) a NUMA-bound pod takes from its
     zone; zero rows for unbound pods so their scatters are no-ops."""
@@ -45,6 +47,10 @@ def pod_zone_requests(pods: PodBatch) -> jnp.ndarray:
     return req2 * pods.numa_single[:, None]
 
 
+@shape_contract(nodes="NodeState", pods="PodBatch",
+                _returns="bool[P,N]",
+                _pad="non-NUMA-bound pods pass everywhere; invalid "
+                     "zones (numa_valid False) never fit")
 def zone_prefilter(nodes: NodeState, pods: PodBatch) -> jnp.ndarray:
     """bool[P, N]: an upper-bound single-NUMA fit against the batch-start
     zone state (free only shrinks during commit, so this is a sound
@@ -58,6 +64,9 @@ def zone_prefilter(nodes: NodeState, pods: PodBatch) -> jnp.ndarray:
     return ok | ~pods.numa_single[:, None]
 
 
+@shape_contract(nodes="NodeState", pods="PodBatch",
+                _returns="f32[P,N]",
+                _pad="0 for unbound pods and nodes without topology")
 def numa_score_matrix(nodes: NodeState, pods: PodBatch,
                       strategy: str = "most") -> jnp.ndarray:
     """f32[P, N] in [0, 100]: allocation score of the zone the pod would

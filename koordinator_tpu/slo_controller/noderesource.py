@@ -35,6 +35,7 @@ from koordinator_tpu.api.extension import PriorityClass, QoSClass, ResourceKind
 from koordinator_tpu.api.types import Node, NodeMetric, Pod
 from koordinator_tpu.slo_controller.config import CalculatePolicy, ColocationStrategy
 from koordinator_tpu.slo_controller.metrics_defs import SloControllerMetrics
+from koordinator_tpu.snapshot.schema import shape_contract
 
 # Column order of the 2-dim resource axis used by this module.
 CPU, MEM = 0, 1
@@ -152,6 +153,14 @@ def build_inputs(nodes: Sequence[Node],
         names=[n.meta.name for n in nodes])
 
 
+@shape_contract(
+    capacity="f32[N,2]", node_reserved="f32[N,2]",
+    system_reserved="f32[N,2]", system_used="f32[N,2]",
+    hp_req="f32[N,2]", hp_used="f32[N,2]", hp_max="f32[N,2]",
+    cpu_by_max="bool[N]", mem_policy="i32[N]",
+    _returns="f32[N,2]",
+    _pad="columns are (cpu milli, mem MiB); clamped at 0, so padded "
+         "zero-capacity rows return 0")
 @jax.jit
 def _batch_allocatable(capacity, node_reserved, system_reserved, system_used,
                        hp_req, hp_used, hp_max, cpu_by_max, mem_policy):
@@ -170,6 +179,12 @@ def _batch_allocatable(capacity, node_reserved, system_reserved, system_used,
     return jnp.stack([cpu, mem], axis=-1)
 
 
+@shape_contract(
+    allocatable="f32[N,2]", prod_reclaimable="f32[N,2]",
+    threshold_ratio="f32[N,2]",
+    _returns="f32[N,2]",
+    _pad="clamped at 0; degrade/invalid sentinels (-1) are applied "
+         "host-side after the kernel")
 @jax.jit
 def _mid_allocatable(allocatable, prod_reclaimable, threshold_ratio):
     """Mid = min(ProdReclaimable, Allocatable × ratio), clamped at 0

@@ -24,7 +24,12 @@ import jax
 import jax.numpy as jnp
 
 from koordinator_tpu.api.extension import ResourceKind as _RK
-from koordinator_tpu.snapshot.schema import Array, ClusterSnapshot
+from koordinator_tpu.snapshot.schema import (
+    Array,
+    ClusterSnapshot,
+    register_struct,
+    shape_contract,
+)
 
 _CPU = int(_RK.CPU)
 
@@ -49,6 +54,23 @@ class NodeMetricDelta:
     prod_assigned_correction: Array  # f32[K, R]
 
 
+register_struct(NodeMetricDelta, {
+    "idx": "i32[K]",
+    "metric_fresh": "bool[K]",
+    "usage": "f32[K,R]",
+    "prod_usage": "f32[K,R]",
+    "agg_usage": "f32[K,AGG,R]",
+    "has_agg": "bool[K]",
+    "assigned_estimated": "f32[K,R]",
+    "assigned_correction": "f32[K,R]",
+    "prod_assigned_estimated": "f32[K,R]",
+    "prod_assigned_correction": "f32[K,R]",
+})
+
+
+@shape_contract(snap="ClusterSnapshot", delta="NodeMetricDelta",
+                _returns="ClusterSnapshot",
+                _pad="idx -1 rows are padding and scatter to the drop row")
 @jax.jit
 def apply_metric_delta(snap: ClusterSnapshot,
                        delta: NodeMetricDelta) -> ClusterSnapshot:
@@ -120,6 +142,33 @@ class NodeTopologyDelta:
     metric: NodeMetricDelta = None  # same idx; None only pre-init
 
 
+register_struct(NodeTopologyDelta, {
+    "idx": "i32[K]",
+    "allocatable": "f32[K,R]",
+    "requested": "f32[K,R]",
+    "schedulable": "bool[K]",
+    "label_group": "i32[K]",
+    "taint_group": "i32[K]",
+    "numa_cap": "f32[K,Z,2]",
+    "numa_free": "f32[K,Z,2]",
+    "numa_valid": "bool[K,Z]",
+    "numa_policy": "i32[K]",
+    "cpu_amplification": "f32[K]",
+    "gpu_total": "f32[K,DEV]",
+    "gpu_free": "f32[K,I,DEV]",
+    "gpu_valid": "bool[K,I]",
+    "gpu_numa": "i32[K,I]",
+    "gpu_pcie": "i32[K,I]",
+    "aux_free": "f32[K,AX,J]",
+    "aux_valid": "bool[K,AX,J]",
+    "metric": "NodeMetricDelta",
+})
+
+
+@shape_contract(snap="ClusterSnapshot", delta="NodeTopologyDelta",
+                _returns="ClusterSnapshot",
+                _pad="idx -1 rows are padding; a removed node is a "
+                     "zeroed row, not a remove flag")
 @jax.jit
 def apply_topology_delta(snap: ClusterSnapshot,
                          delta: NodeTopologyDelta) -> ClusterSnapshot:
@@ -160,6 +209,11 @@ def apply_topology_delta(snap: ClusterSnapshot,
     return apply_metric_delta(snap, delta.metric)
 
 
+@shape_contract(snap="ClusterSnapshot", pods="PodBatch",
+                result="ScheduleResult", mask="bool[P]",
+                _pad="un-masked rows and never-assigned rows (assignment "
+                     "-1) return nothing; charges scatter to drop rows",
+                _returns="ClusterSnapshot")
 @functools.partial(jax.jit, static_argnames=("enable_amplification",))
 def forget_pods(snap: ClusterSnapshot, pods, result,
                 mask: jnp.ndarray,

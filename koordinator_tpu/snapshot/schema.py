@@ -20,7 +20,7 @@ quota/gang/reservation caches (SURVEY.md 1, 2.1).
 
 from __future__ import annotations
 
-from typing import Any
+from typing import Any, Callable, Dict, Mapping, Optional, Tuple, Union
 
 import flax.struct
 import jax.numpy as jnp
@@ -426,3 +426,267 @@ def zeros_snapshot(num_nodes: int, num_quotas: int = 1, num_gangs: int = 1,
                            devices=zeros_devices(n, num_gpu_inst,
                                                  num_aux_inst),
                            version=jnp.zeros((), jnp.int32))
+
+
+# --- kernel shape contracts ------------------------------------------------
+#
+# Every jitted entry point (and the kernel helpers it composes) declares a
+# machine-checked contract over the named-dimension vocabulary below:
+# which dims each argument/output carries, its dtype, and the pad
+# semantics callers rely on. Two independent checkers consume the
+# registry:
+#   Tier A (static, stdlib-only): koordlint's `shape-contract` pass reads
+#     the decorator calls straight from the AST (tools/lint/shapes) and
+#     abstractly interprets kernel bodies against the declared dims.
+#   Tier B (device-free dynamic): tools/shapecheck.py imports this
+#     registry and drives jax.eval_shape over every contract with
+#     symbolic-sized ShapeDtypeStructs — no device, no compile.
+# The decorator itself is a pure registration: zero tracing or runtime
+# cost, and every spec is a literal string so the AST tier never has to
+# execute anything.
+#
+# Spec grammar (tools/lint/shapes/spec.py is the single parser):
+#   "f32[P,N]"   leaf array: dtype in {f32, i32, i8, u32, bool},
+#                dims = named symbols, fixed symbols, or int literals
+#   "f32[]"      scalar array
+#   "?f32[P,N]"  optional: the value may be None (e.g. compiled-out gates)
+#   "PodBatch"   a registered struct (register_struct below)
+#   "N"          a bare dim symbol marks a symbolic-int PROPERTY of a
+#                struct (documentation for the AST tier; never built)
+
+# the named-dimension vocabulary — THE shared meaning of every symbol;
+# tools/lint/shapes/spec.py carries the same table for the stdlib-only
+# tier and tests/test_shape_contract.py pins the two in sync
+DIM_VOCAB = {
+    "P": "pending pods in the batch",
+    "N": "node columns (padded capacity)",
+    "I": "GPU instances per node",
+    "Z": "NUMA zones per node",
+    "G": "gangs (PodGroups)",
+    "Q": "elastic-quota tree nodes",
+    "V": "reservation slots",
+    "R": "resource dims (NUM_RESOURCES; padded like any capacity)",
+    "S": "distinct pod node-selectors",
+    "L": "node label-equivalence groups",
+    "T": "distinct pod toleration sets",
+    "TG": "node taint-equivalence groups",
+    "SG": "pod-topology-spread groups",
+    "AG": "inter-pod anti-affinity groups",
+    "FG": "inter-pod affinity groups",
+    "DM": "topology domains per constraint group",
+    "J": "aux (RDMA/FPGA) VF instances per pool",
+    "K": "delta rows per ingest tick",
+    "TC": "tail retry-chunk width",
+    "RD": "descheduler threshold resource dims",
+    "NS": "descheduler namespace rows (padded)",
+}
+
+# dims pinned to module constants rather than free sizes
+FIXED_DIMS = {
+    "AGG": NUM_AGG,          # aggregation percentile rows
+    "DEV": NUM_DEV_DIMS,     # GPU instance resource dims (core/mem/ratio)
+    "AX": NUM_AUX_TYPES,     # aux device pools (rdma, fpga)
+    "QD": MAX_QUOTA_DEPTH,   # quota-tree depth
+}
+
+FieldSpec = Union[str, Tuple[str, ...]]
+
+
+class ShapeContract:
+    """One kernel's declared tensor contract (a plain record; the
+    checkers interpret it — nothing here touches jax)."""
+
+    __slots__ = ("name", "module", "fn", "args", "returns", "static",
+                 "callables", "pad")
+
+    def __init__(self, name: str, module: str, fn: Callable,
+                 args: Dict[str, FieldSpec], returns: FieldSpec,
+                 static: Dict[str, Any], callables: Dict[str, str],
+                 pad: str):
+        self.name = name
+        self.module = module
+        self.fn = fn
+        self.args = args
+        self.returns = returns
+        self.static = static
+        self.callables = callables
+        self.pad = pad
+
+    @property
+    def key(self) -> str:
+        return f"{self.module}.{self.name}"
+
+
+# key: "module.function" -> contract (import the defining modules to
+# populate; tools/shapecheck.py owns the canonical import list)
+SHAPE_CONTRACTS: Dict[str, ShapeContract] = {}
+# struct name -> {field: spec}; bare-symbol entries are symbolic-int
+# properties (num_nodes = "N"), never constructor fields
+STRUCT_SPECS: Dict[str, Dict[str, FieldSpec]] = {}
+# struct name -> class, for Tier B instance construction
+STRUCT_CLASSES: Dict[str, type] = {}
+
+
+def register_struct(cls: type, fields: Dict[str, FieldSpec]) -> type:
+    """Declare the per-field shape specs of a pytree struct. Static
+    (pytree_node=False) fields are omitted — they keep their defaults
+    when Tier B builds abstract instances."""
+    name = cls.__name__
+    prior = STRUCT_SPECS.get(name)
+    if prior is not None and prior != fields:
+        raise ValueError(f"struct {name!r} re-registered with a "
+                         f"different spec")
+    STRUCT_SPECS[name] = dict(fields)
+    STRUCT_CLASSES[name] = cls
+    return cls
+
+
+def shape_contract(_returns: FieldSpec = None,
+                   _static: Optional[Mapping[str, Any]] = None,
+                   _callable: Optional[Mapping[str, str]] = None,
+                   _pad: str = "",
+                   **arg_specs: FieldSpec) -> Callable:
+    """Decorator: register the function's kernel shape contract.
+
+    `arg_specs` maps TRACED argument names to specs; static arguments
+    the checker must supply go in `_static` (a value that names a dim
+    symbol, e.g. "TC", resolves to that dim's assigned size). `_callable`
+    maps higher-order arguments to the dotted path of another contracted
+    function Tier B substitutes. Apply ABOVE jax.jit so the registered
+    callable is the jitted wrapper (eval_shape traces it abstractly).
+    """
+
+    def deco(fn: Callable) -> Callable:
+        name = getattr(fn, "__name__", None)
+        module = getattr(fn, "__module__", None)
+        if not name or not module:
+            raise ValueError("shape_contract target has no name/module")
+        c = ShapeContract(name=name, module=module, fn=fn,
+                          args=dict(arg_specs), returns=_returns,
+                          static=dict(_static or {}),
+                          callables=dict(_callable or {}), pad=_pad)
+        if c.key in SHAPE_CONTRACTS:
+            raise ValueError(f"duplicate shape contract {c.key}")
+        SHAPE_CONTRACTS[c.key] = c
+        return fn
+
+    return deco
+
+
+register_struct(NodeState, {
+    "allocatable": "f32[N,R]",
+    "requested": "f32[N,R]",
+    "usage": "f32[N,R]",
+    "prod_usage": "f32[N,R]",
+    "agg_usage": "f32[N,AGG,R]",
+    "assigned_estimated": "f32[N,R]",
+    "assigned_correction": "f32[N,R]",
+    "prod_assigned_estimated": "f32[N,R]",
+    "prod_assigned_correction": "f32[N,R]",
+    "metric_fresh": "bool[N]",
+    "has_agg": "bool[N]",
+    "schedulable": "bool[N]",
+    "label_group": "i32[N]",
+    "taint_group": "i32[N]",
+    "numa_cap": "f32[N,Z,2]",
+    "numa_free": "f32[N,Z,2]",
+    "numa_valid": "bool[N,Z]",
+    "numa_policy": "i32[N]",
+    "cpu_amplification": "f32[N]",
+    "num_nodes": "N",
+})
+
+register_struct(PodBatch, {
+    "requests": "f32[P,R]",
+    "estimated": "f32[P,R]",
+    "qos": "i8[P]",
+    "priority_class": "i8[P]",
+    "priority": "i32[P]",
+    "gang_id": "i32[P]",
+    "quota_id": "i32[P]",
+    "selector_id": "i32[P]",
+    "selector_match": "bool[S,L]",
+    "reservation_owner": "i32[P]",
+    "gpu_ratio": "f32[P]",
+    "numa_single": "bool[P]",
+    "daemonset": "bool[P]",
+    "toleration_id": "i32[P]",
+    "tol_forbid": "bool[T,TG]",
+    "tol_prefer": "f32[T,TG]",
+    "spread_id": "i32[P]",
+    "spread_carrier": "bool[P,SG]",
+    "spread_member": "bool[P,SG]",
+    "spread_max_skew": "f32[SG]",
+    "spread_domain": "i32[SG,N]",
+    "spread_count0": "f32[SG,DM]",
+    "spread_dvalid": "bool[SG,DM]",
+    "anti_id": "i32[P]",
+    "anti_member": "bool[P,AG]",
+    "anti_carrier": "bool[P,AG]",
+    "anti_domain": "i32[AG,N]",
+    "anti_count0": "f32[AG,DM]",
+    "anti_carrier_count0": "f32[AG,DM]",
+    "aff_id": "i32[P]",
+    "aff_carrier": "bool[P,FG]",
+    "aff_member": "bool[P,FG]",
+    "aff_domain": "i32[FG,N]",
+    "aff_count0": "f32[FG,DM]",
+    "valid": "bool[P]",
+    "num_pods": "P",
+})
+
+register_struct(QuotaState, {
+    "min": "f32[Q,R]",
+    "max": "f32[Q,R]",
+    "shared_weight": "f32[Q,R]",
+    "parent": "i32[Q]",
+    "ancestors": "bool[Q,Q]",
+    "depth_ancestor": "i32[Q,QD]",
+    "used": "f32[Q,R]",
+    "demand": "f32[Q,R]",
+    "allow_lent": "bool[Q]",
+    "runtime": "f32[Q,R]",
+    "valid": "bool[Q]",
+})
+
+register_struct(GangState, {
+    "min_member": "i32[G]",
+    "member_count": "i32[G]",
+    "assumed": "i32[G]",
+    "strict": "bool[G]",
+    "satisfied": "bool[G]",
+    "valid": "bool[G]",
+})
+
+register_struct(DeviceState, {
+    "gpu_total": "f32[N,DEV]",
+    "gpu_free": "f32[N,I,DEV]",
+    "gpu_valid": "bool[N,I]",
+    "gpu_numa": "i32[N,I]",
+    "gpu_pcie": "i32[N,I]",
+    "aux_free": "f32[N,AX,J]",
+    "aux_valid": "bool[N,AX,J]",
+    "num_instances": "I",
+})
+
+register_struct(ReservationState, {
+    "node": "i32[V]",
+    "free": "f32[V,R]",
+    "owner_group": "i32[V]",
+    "allocate_once": "bool[V]",
+    "valid": "bool[V]",
+    "gpu_free": "f32[V,I,DEV]",
+    "gpu_valid": "bool[V,I]",
+    "numa_free": "f32[V,Z,2]",
+    "numa_valid": "bool[V,Z]",
+})
+
+register_struct(ClusterSnapshot, {
+    "nodes": "NodeState",
+    "quotas": "QuotaState",
+    "gangs": "GangState",
+    "reservations": "ReservationState",
+    "devices": "DeviceState",
+    "version": "i32[]",
+    "num_nodes": "N",
+})

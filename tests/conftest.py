@@ -46,3 +46,13 @@ jax.config.update("jax_enable_x64", False)
 # was not sufficient. In-process compiles are always safe; paying the
 # cold compile per run is the only configuration that cannot crash.
 jax.config.update("jax_compilation_cache_dir", None)
+
+
+def pytest_configure(config):
+    # the tier-1 battery (ROADMAP.md / tools/ci.sh) runs -m 'not slow';
+    # register the mark so --strict-markers stays an option and no
+    # UnknownMarkWarning fires
+    config.addinivalue_line(
+        "markers",
+        "slow: excluded from the tier-1 battery; the equivalent check "
+        "runs as a dedicated tools/ci.sh stage on every push")

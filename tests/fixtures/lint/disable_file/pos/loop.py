@@ -1,0 +1,28 @@
+"""Positive disable-file fixture: the file-level marker names a
+DIFFERENT code, and two markers for the RIGHT code hide inside string
+literals (this docstring and a constant), so the HS006 tail-readback
+finding must still fire. A doc line quoting the pragma verbatim:
+
+    # koordlint: disable-file=HS006
+
+must never silence anything — only real comment tokens count."""
+
+# koordlint: disable-file=HS001
+
+import numpy as np
+
+DOC = "koordlint: disable-file=HS006"  # inside a string: must not count
+
+
+def adaptive(step, snap, stats, budget):
+    left = 1
+    passes = 0
+    while passes < budget and left > 0:
+        snap, stats = retry_pass(step, snap)
+        left = int(np.asarray(stats)[0])
+        passes += 1
+    return snap
+
+
+def retry_pass(step, snap):
+    return step(snap)

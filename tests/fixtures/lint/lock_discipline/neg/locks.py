@@ -32,3 +32,38 @@ class Store:
     def registry(self):
         with _REGISTRY_LOCK:
             return 3
+
+
+class Queue:
+    def __init__(self):
+        self._cond = threading.Condition()
+        self._items = []
+
+    def get(self):
+        # waiting under ONLY the condition's own lock is the normal
+        # pattern: wait releases it while sleeping
+        with self._cond:
+            while not self._items:
+                self._cond.wait()
+            return self._items.pop()
+
+    def put(self, item):
+        with self._cond:
+            self._items.append(item)
+            self._cond.notify()
+
+
+class SharedLockQueue:
+    def __init__(self):
+        # the stdlib idiom: the condition WRAPS an existing lock, so
+        # wait() releases self._lk — holding it while waiting is the
+        # documented correct pattern, not LK004
+        self._lk = threading.Lock()
+        self._cond = threading.Condition(self._lk)
+        self._items = []
+
+    def get(self):
+        with self._lk:
+            while not self._items:
+                self._cond.wait()
+            return self._items.pop()

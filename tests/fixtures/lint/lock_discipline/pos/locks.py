@@ -38,3 +38,15 @@ class Store:
             return 4
         finally:
             self._a.release()
+
+
+class Feed:
+    def __init__(self):
+        self._state = threading.Lock()
+        self._cond = threading.Condition()
+
+    def drain(self):
+        with self._state:
+            with self._cond:
+                self._cond.wait()  # LK004: _state stays pinned until
+                return 5           # a notify arrives

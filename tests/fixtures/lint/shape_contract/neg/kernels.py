@@ -1,0 +1,49 @@
+"""Negative shape-contract fixtures: the same kernels written
+honestly — explicit broadcasts, matching cross-calls, contracted jit."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from koordinator_tpu.snapshot.schema import register_struct, shape_contract
+
+
+class Cols:
+    """Stand-in columnar struct (the fixture never runs)."""
+
+
+register_struct(Cols, {
+    "alloc": "f32[N,R]",
+    "req": "f32[P,R]",
+    "valid": "bool[P]",
+})
+
+
+@shape_contract(cols="Cols", _returns="bool[P,N]")
+def fit_mask(cols):
+    pair = cols.req[:, None, :] + cols.alloc[None]     # explicit [P,N,R]
+    return jnp.all(pair <= cols.alloc[None], axis=-1)
+
+
+@shape_contract(cols="Cols", _returns="f32[P,N]")
+def masked_fit(cols):
+    fit = jnp.zeros((cols.req.shape[0], cols.alloc.shape[0]),
+                    jnp.float32)
+    return fit * cols.valid[:, None]                   # declared growth
+
+
+@shape_contract(x="f32[N,R]", _returns="f32[N]")
+def row_sums(x):
+    return jnp.sum(x, axis=-1)
+
+
+@shape_contract(cols="Cols", _returns="f32[N]")
+def node_load(cols):
+    return row_sums(cols.alloc)                        # [N,R] as declared
+
+
+@shape_contract(x="f32[P,R]", _returns="f32[P]", _static={"lo": "R"})
+@functools.partial(jax.jit, static_argnames=("lo",))
+def contracted_jit(x, lo=1):
+    return jnp.sum(x, axis=-1) * lo

@@ -1,0 +1,57 @@
+"""Positive shape-contract fixtures: one violation per SH code."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from koordinator_tpu.snapshot.schema import register_struct, shape_contract
+
+
+class Cols:
+    """Stand-in columnar struct (the fixture never runs)."""
+
+
+register_struct(Cols, {
+    "alloc": "f32[N,R]",
+    "req": "f32[P,R]",
+    "valid": "bool[P]",
+})
+
+
+@shape_contract(cols="Cols", _returns="bool[P,N]")
+def mixed_dims(cols):
+    bad = cols.req + cols.alloc            # SH001: [P,R] + [N,R]
+    return jnp.all(bad[:, None, :] <= cols.alloc[None], axis=-1)
+
+
+@shape_contract(cols="Cols", _returns="f32[P,N]")
+def implicit_growth(cols):
+    fit = jnp.zeros((cols.req.shape[0], cols.alloc.shape[0]),
+                    jnp.float32)
+    return fit * cols.valid                # SH002: [P,N] * [P] implicit
+
+
+@shape_contract(x="f32[N,R]", _returns="f32[N]")
+def row_sums(x):
+    return jnp.sum(x, axis=-1)
+
+
+@shape_contract(cols="Cols", _returns="f32[N]")
+def drift(cols):
+    return row_sums(cols.req)              # SH003: [P,R] into f32[N,R]
+
+
+@shape_contract(cols="Cols", _returns="f32[N]")
+def wrong_return(cols):
+    return jnp.sum(cols.req, axis=-1)      # SH001: returns [P], not [N]
+
+
+@shape_contract(cols="Cols", bogus="f33[N]", _returns="f32[XY]")
+def bad_specs(cols, bogus):                # SH005 x2: dtype + dim symbol
+    return bogus
+
+
+@functools.partial(jax.jit, static_argnames=("flip",))
+def uncontracted(x, flip=False):           # SH004: jit with no contract
+    return -x if flip else x

@@ -295,3 +295,59 @@ def test_candidate_mask_sharding_spec():
     assert tuple(spec) == (None, "nodes")
     mask = jax.device_put(jnp.ones((16, 800), bool), s)
     assert mask.sharding.is_equivalent_to(s, 2)
+
+
+def test_tail_loop_zero_pending_batch():
+    """Boundary: a ZERO-pending batch through tail_compaction_loop.
+    The forced min_passes still run (the warm-path contract), but each
+    pass gathers an all-invalid retry batch and must be a no-op: stats
+    [0, 0, 0, min_passes], untouched assignment/counts, and a snapshot
+    identical up to the version counter."""
+    n_nodes, p = 4, 16
+    snap = synthetic.synthetic_cluster(n_nodes, seed=3)
+    pods = synthetic.synthetic_pods(p, seed=4)
+    pods = pods.replace(valid=jnp.zeros((p,), bool))   # nothing pending
+    cfg = LoadAwareConfig.make()
+    counts = tuple(jnp.asarray(getattr(pods, f))
+                   for f in core.COUNT_FIELDS)
+    assign = jnp.full((p,), -1, jnp.int32)
+    # the slimmest full program that still walks the loop's control
+    # edges (the boundary under test is the loop, not the gates)
+    step = functools.partial(core.schedule_batch, num_rounds=1,
+                             k_choices=1, quota_depth=1,
+                             enable_numa=False, enable_devices=False)
+    loop = jax.jit(functools.partial(
+        core.tail_compaction_loop, step, tail_chunk=8, min_passes=2,
+        max_passes=4, charge_counts=False))
+    snap2, counts2, assign2, stats = loop(snap, counts, assign, pods,
+                                          cfg)
+    assert [int(x) for x in np.asarray(stats)] == [0, 0, 0, 2]
+    np.testing.assert_array_equal(np.asarray(assign2), np.asarray(assign))
+    _assert_trees_equal(counts2, counts)
+    # the no-op passes must not move any capacity; only the version
+    # counter advances (one bump per schedule_batch call)
+    _assert_trees_equal(
+        snap2.replace(version=jnp.zeros_like(snap2.version)),
+        snap.replace(version=jnp.zeros_like(snap.version)))
+
+
+def test_prefix_larger_than_batch_identical():
+    """Boundary: a batch SMALLER than the declared packing prefixes.
+    stage1_mask and every stage-2 slice clamp the prefix to the batch
+    width (pc = pn = pg = P), so oversized prefixes must be bit-
+    identical to the unprefixed program — cascade off AND on."""
+    n_nodes, p = 8, 32
+    snap = synthetic.synthetic_cluster(n_nodes, seed=5)
+    pods = synthetic.synthetic_pods(p, seed=6)
+    cfg = LoadAwareConfig.make()
+    kw = dict(num_rounds=1, k_choices=2, quota_depth=1)
+    big = dict(topo_prefix=4 * p, numa_prefix=4 * p, gpu_prefix=4 * p)
+    base = core.schedule_batch(snap, pods, cfg, **kw)
+    clamped = core.schedule_batch(snap, pods, cfg, **kw, **big)
+    _assert_results_equal(base, clamped)
+    cas = core.schedule_batch(snap, pods, cfg, cascade=True, **kw)
+    cas_clamped = core.schedule_batch(snap, pods, cfg, cascade=True,
+                                      **kw, **big)
+    _assert_results_equal(cas, cas_clamped)
+    # the cascade conformance holds at this boundary too
+    _assert_results_equal(base, cas)

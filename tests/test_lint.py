@@ -37,6 +37,7 @@ _ANALYZER_NAMES = {
     "lock_discipline": "lock-discipline",
     "metric_names": "metric-registry",
     "proto_drift": "proto-drift",
+    "shape_contract": "shape-contract",
     "tail_readback": "tail-readback",
 }
 
@@ -58,9 +59,10 @@ def empty_baseline(tmp_path):
     ("host_sync", {"HS001", "HS002", "HS003", "HS004", "HS005"}),
     ("recompile", {"RC001", "RC002", "RC003"}),
     ("donation", {"DA001"}),
-    ("lock_discipline", {"LK001", "LK002", "LK003"}),
+    ("lock_discipline", {"LK001", "LK002", "LK003", "LK004"}),
     ("metric_names", {"MN001", "MN002", "MN003", "MN004"}),
     ("proto_drift", {"PD001", "PD002", "PD003"}),
+    ("shape_contract", {"SH001", "SH002", "SH003", "SH004", "SH005"}),
     ("tail_readback", {"HS006"}),
 ])
 def test_positive_fixture(fixture_dir, expected_codes, empty_baseline):
@@ -133,6 +135,32 @@ def test_tail_readback_inline_disable(tmp_path, empty_baseline):
     new, _ = run_lint(str(tmp_path), analyzers=["tail-readback"],
                       baseline_path=str(empty_baseline))
     assert [f.code for f in new] == ["HS006"]
+
+
+def test_disable_file_pragma_fixtures(empty_baseline):
+    """`# koordlint: disable-file=CODE` on a comment line silences that
+    code file-wide (neg tree); a marker naming a DIFFERENT code, or one
+    hiding inside a string literal, silences nothing (pos tree)."""
+    root = os.path.join(FIXTURES, "disable_file", "pos")
+    new, _ = run_lint(root, analyzers=["tail-readback"],
+                      baseline_path=str(empty_baseline))
+    assert [f.code for f in new] == ["HS006"], \
+        [f.render() for f in new]
+    root = os.path.join(FIXTURES, "disable_file", "neg")
+    new, suppressed = run_lint(root, analyzers=["tail-readback"],
+                               baseline_path=str(empty_baseline))
+    assert new == [] and suppressed == [], [f.render() for f in new]
+
+
+def test_disable_file_accepts_analyzer_name(tmp_path, empty_baseline):
+    """The analyzer name works as a file-level token too, from any
+    comment line in the file (not just line 1)."""
+    (tmp_path / "m.py").write_text(
+        _TAIL_LOOP_SRC.format(marker="")
+        + "\n# koordlint: disable-file=tail-readback\n")
+    new, _ = run_lint(str(tmp_path), analyzers=["tail-readback"],
+                      baseline_path=str(empty_baseline))
+    assert new == [], [f.render() for f in new]
 
 
 def test_tail_readback_ignores_plain_data_walks(tmp_path,

@@ -1,0 +1,256 @@
+"""koordshape test battery: the contract registry, the spec grammar,
+the static (AST) tier's repo-wide cleanliness, and the dynamic
+(eval_shape) tier's detectors — dtype promotion, weak-type leaks,
+output-shape drift, and the two-assignment dim-coupling trap.
+
+Per-SH-code pos/neg fixture coverage lives in test_lint.py's
+parametrized fixture battery (tests/fixtures/lint/shape_contract/);
+this file covers everything the fixtures can't: the grammar itself,
+the vocabulary pin between the two tiers, and Tier B's checkers
+against deliberately broken kernels that are NEVER registered (the
+global registry stays clean for the full-registry gate test).
+"""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from koordinator_tpu.snapshot import schema
+from tools import shapecheck
+from tools.lint.runner import REPO_ROOT, run_lint
+from tools.lint.shapes import spec as lint_spec
+from tools.lint.shapes.spec import (
+    DimProp,
+    LeafSpec,
+    SpecError,
+    StructRef,
+    broadcast_join,
+    parse_spec,
+)
+
+SIZES_A = shapecheck._sizes(shapecheck.ASSIGNMENT_A)
+SIZES_B = shapecheck._sizes(shapecheck.ASSIGNMENT_B)
+
+
+def _contract(fn, args, returns, static=None):
+    """An AD-HOC contract (never registered: the registry feeds the CI
+    gate and must not accumulate test debris)."""
+    return schema.ShapeContract(
+        name=fn.__name__, module="tests.adhoc", fn=fn, args=args,
+        returns=returns, static=static or {}, callables={}, pad="")
+
+
+# --- the two tiers share one vocabulary -----------------------------------
+
+def test_dim_vocab_pinned_between_tiers():
+    assert lint_spec.DIM_VOCAB == schema.DIM_VOCAB, \
+        "tools/lint/shapes/spec.py and snapshot/schema.py must carry " \
+        "the SAME dim vocabulary"
+    assert set(lint_spec.FIXED_DIM_SYMBOLS) == set(schema.FIXED_DIMS), \
+        "fixed-dim symbols drifted between the tiers"
+
+
+def test_vocab_disjoint_from_fixed():
+    assert not set(lint_spec.DIM_VOCAB) & set(schema.FIXED_DIMS)
+
+
+# --- spec grammar ---------------------------------------------------------
+
+def test_parse_leaf_scalar_optional_struct_prop():
+    leaf = parse_spec("f32[P,N]")
+    assert leaf == LeafSpec("f32", ("P", "N"))
+    assert parse_spec("bool[]") == LeafSpec("bool", ())
+    assert parse_spec("f32[N,Z,2]") == LeafSpec("f32", ("N", "Z", 2))
+    opt = parse_spec("?f32[P,N]")
+    assert opt.optional
+    assert parse_spec("PodBatch") == StructRef("PodBatch")
+    assert parse_spec("N") == DimProp("N")
+    assert parse_spec(("i32[P]", "bool[P]")) == \
+        (LeafSpec("i32", ("P",)), LeafSpec("bool", ("P",)))
+
+
+@pytest.mark.parametrize("bad", [
+    "f33[P]",            # unknown dtype
+    "f32[XY]",           # undeclared dim
+    "f32[P,]",           # empty dim
+    "lowercase",         # neither dim, struct, nor leaf
+    "f32[P][N]",         # malformed bracket
+    123,                 # not a string at all
+])
+def test_parse_rejects_malformed(bad):
+    with pytest.raises(SpecError):
+        parse_spec(bad)
+
+
+def test_broadcast_join_semantics():
+    j = broadcast_join(("P", "R"), ("N", "R"))
+    assert j.conflicts == [("P", "N")]
+    j = broadcast_join(("P", 1), (1, "N"))
+    assert j.dims == ("P", "N") and not j.conflicts
+    j = broadcast_join(("P", "N"), ("N",))
+    assert j.rank_growth and not j.conflicts
+    j = broadcast_join(("P", None), ("P", "N"))
+    assert j.dims == ("P", None) and not j.conflicts
+    assert broadcast_join(None, ("P",)).dims is None
+
+
+# --- static tier: per-code fixtures ---------------------------------------
+# (test_lint.py's parametrized battery also walks these trees; the
+# per-code assertions here keep the koordshape suite self-contained)
+
+_SH_FIXTURES = os.path.join(REPO_ROOT, "tests", "fixtures", "lint",
+                            "shape_contract")
+
+
+@pytest.mark.parametrize("code", ["SH001", "SH002", "SH003", "SH004",
+                                  "SH005"])
+def test_positive_fixture_per_code(code, tmp_path):
+    bl = tmp_path / "bl.json"
+    bl.write_text('{"suppressions": []}')
+    new, _ = run_lint(os.path.join(_SH_FIXTURES, "pos"),
+                      analyzers=["shape-contract"],
+                      baseline_path=str(bl))
+    assert code in {f.code for f in new}, \
+        [f.render() for f in new]
+
+
+def test_negative_fixture_clean(tmp_path):
+    bl = tmp_path / "bl.json"
+    bl.write_text('{"suppressions": []}')
+    new, _ = run_lint(os.path.join(_SH_FIXTURES, "neg"),
+                      analyzers=["shape-contract"],
+                      baseline_path=str(bl))
+    assert new == [], [f.render() for f in new]
+
+
+# --- static tier: the repo itself is contract-clean -----------------------
+
+def test_repo_shape_contract_clean_and_registry_total():
+    """The in-repo instance of the acceptance pin: every jitted entry
+    point in koordinator_tpu/ carries a contract (no SH004), the
+    abstract interpretation of every contract body is conflict-free,
+    AND the RUNTIME registry (what Tier B drives) names every
+    koordinator_tpu jit entry the AST tier sees — one repo scan serves
+    both assertions."""
+    import ast as _ast
+    import importlib
+
+    new, suppressed = run_lint(
+        REPO_ROOT, analyzers=["shape-contract"],
+        baseline_path=os.path.join(REPO_ROOT, "tools", "lint",
+                                   "baseline.json"))
+    assert new == [] and suppressed == [], \
+        [f.render() for f in new + suppressed]
+
+    from tools.lint.framework import Project
+    from tools.lint.callgraph import project_index
+
+    for mod in shapecheck.CONTRACT_MODULES:
+        importlib.import_module(mod)
+    keys = set(schema.SHAPE_CONTRACTS)
+    project = Project(REPO_ROOT)
+    for entry in project_index(project).jit_entries():
+        rel = entry.fn.module.relpath
+        if not rel.startswith("koordinator_tpu/"):
+            continue
+        if not isinstance(entry.fn.scope_chain[-1], _ast.Module):
+            continue
+        dotted = entry.fn.module.dotted + "." + entry.fn.node.name
+        assert dotted in keys, f"{dotted} jitted but not registered"
+
+
+# --- dynamic tier: the eval_shape detectors -------------------------------
+
+@pytest.mark.slow
+def test_eval_shape_full_registry_clean():
+    """Tier B end-to-end over the real registry, both assignments.
+    Marked slow: tools/ci.sh runs the SAME invocation as its own
+    shapecheck stage on every push, so tier-1 need not pay the ~8s
+    twice; the detector unit tests below stay in the fast battery."""
+    assert shapecheck.run_all() == 0
+
+
+def test_eval_shape_catches_dtype_promotion():
+    def promoting(x):
+        return x + 1.0            # f32 in, f32 out — fine
+
+    def flipped(x):
+        return (x > 0).astype(jnp.int32)   # declared bool, returns i32
+
+    ok = _contract(promoting, {"x": "f32[N]"}, "f32[N]")
+    assert shapecheck.run_contract(ok, SIZES_A, "ok") == []
+    bad = _contract(flipped, {"x": "f32[N]"}, "bool[N]")
+    errs = shapecheck.run_contract(bad, SIZES_A, "bad")
+    assert errs and "dtype drift" in errs[0]
+
+
+def test_eval_shape_catches_dim_coupling():
+    """A kernel that uses one dim where the contract declares another
+    only survives an assignment where the sizes collide — the second
+    assignment (P/N flipped, all-distinct) must catch it."""
+    def coupled(alloc, req):
+        # claims [P] but actually produces [N]
+        return jnp.sum(alloc, axis=-1)
+
+    c = _contract(coupled, {"alloc": "f32[N,R]", "req": "f32[P,R]"},
+                  "f32[P]")
+    errs_a = shapecheck.run_contract(c, SIZES_A, "A")
+    errs_b = shapecheck.run_contract(c, SIZES_B, "B")
+    assert errs_a or errs_b, "dim coupling escaped both assignments"
+    assert any("shape drift" in e for e in errs_a + errs_b)
+
+
+def test_eval_shape_catches_weak_type_leak():
+    def leaky(x):
+        del x
+        return jnp.asarray(1.0)   # weak f32 scalar
+
+    c = _contract(leaky, {"x": "f32[N]"}, "f32[]")
+    errs = shapecheck.run_contract(c, SIZES_A, "leaky")
+    assert errs and "weak-type" in errs[0]
+
+
+def test_eval_shape_catches_optional_and_none():
+    def gated(x):
+        return x * 2.0, None
+
+    ok = _contract(gated, {"x": "f32[P,N]"}, ("f32[P,N]", "?f32[P,N]"))
+    assert shapecheck.run_contract(ok, SIZES_A, "ok") == []
+    strict = _contract(gated, {"x": "f32[P,N]"},
+                       ("f32[P,N]", "f32[P,N]"))
+    errs = shapecheck.run_contract(strict, SIZES_A, "strict")
+    assert errs and "None" in errs[0]
+
+
+def test_eval_shape_static_dim_binding():
+    """A _static value naming a dim symbol resolves to that dim's
+    assigned size (the tail_chunk -> TC binding)."""
+    def windowed(x, width):
+        return x[:width]
+
+    c = _contract(windowed, {"x": "i32[P]"}, "i32[TC]",
+                  static={"width": "TC"})
+    assert shapecheck.run_contract(c, SIZES_A, "w") == []
+
+
+def test_build_value_structs_and_x64_guard():
+    snap = shapecheck.build_value(parse_spec("ClusterSnapshot"), SIZES_A)
+    assert isinstance(snap, schema.ClusterSnapshot)
+    assert snap.nodes.allocatable.shape == (SIZES_A["N"], SIZES_A["R"])
+    assert snap.quotas.depth_ancestor.shape == \
+        (SIZES_A["Q"], schema.MAX_QUOTA_DEPTH)
+    assert str(snap.nodes.metric_fresh.dtype) == "bool"
+    assert not jax.config.jax_enable_x64, \
+        "the contracts pin 32-bit layouts; tier-1 must run x64-off"
+
+
+@pytest.mark.slow
+def test_seeded_mutation_smoke():
+    """Gate liveness: the dtype flip in a temp copy of
+    ops/feasibility.py must make shapecheck FAIL. Marked slow (a
+    subprocess re-imports jax over the mutated tree, ~13s); tools/ci.sh
+    runs the same smoke as its own stage on every push, so the gate's
+    liveness is still proven per-push."""
+    assert shapecheck.self_test_mutation() == 0

@@ -8,6 +8,20 @@ cd "$(dirname "$0")/.."
 echo "=== koordlint (python -m tools.lint) ==="
 python -m tools.lint
 
+echo "=== koordlint self-lint (--root tools) ==="
+# the analyzers obey their own rules: the tools tree is linted as a
+# standalone root (same empty-baseline bar as the repo scan)
+python -m tools.lint --root tools
+
+echo "=== koordshape Tier B (device-free eval_shape gate) ==="
+JAX_PLATFORMS=cpu python tools/shapecheck.py
+
+echo "=== koordshape mutation smoke (gate liveness) ==="
+# flip one dtype in a TEMP COPY of ops/feasibility.py and assert the
+# gate fails on it — a shapecheck that can't catch the seeded mutation
+# is a green-but-dead gate
+JAX_PLATFORMS=cpu python tools/shapecheck.py --self-test-mutation
+
 echo "=== full-gate cascade smoke (2k pods x 200 nodes, CPU) ==="
 # correctness + straggler-count assertions, not wall-clock: cascade
 # on/off conformance, device-tail drain, single-stats-readback
@@ -21,7 +35,7 @@ rm -f /tmp/_t1.log
 # `|| rc=$?` keeps set -e from aborting before the DOTS_PASSED
 # diagnostic — the pass count matters MOST on the failure path
 rc=0
-timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
+timeout -k 10 1500 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
     -m 'not slow' --continue-on-collection-errors -p no:cacheprovider \
     -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log || rc=$?
 echo "DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log \

@@ -8,5 +8,6 @@ from tools.lint.analyzers import (  # noqa: F401
     metric_names,
     proto_drift,
     recompile,
+    shape_contract,
     tail_readback,
 )

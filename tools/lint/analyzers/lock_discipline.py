@@ -17,6 +17,13 @@ Codes:
   LK003  manual .acquire() on a known lock — invisible to the
          with-based order analysis and leak-prone on exceptions; use a
          `with` block
+  LK004  Condition.wait()/.wait_for() while holding ANOTHER lock:
+         wait releases only the condition's own lock — itself, or the
+         existing lock a `Condition(self._lk)` constructor wrapped —
+         so every other held lock is pinned until a notify arrives: a
+         stall at best, a deadlock when the notifier needs that lock
+         (same-function analysis; Condition with-blocks themselves
+         ride the LK001/LK002 machinery like any lock)
 """
 
 from __future__ import annotations
@@ -64,6 +71,8 @@ class _Unit:
     # (held lock, blocking target, line)
     held_blocking: Set[Tuple[str, str, int]] = field(default_factory=set)
     manual_acquires: Set[Tuple[str, int]] = field(default_factory=set)
+    # (held lock, condition waited on, line) — held != condition
+    held_waits: Set[Tuple[str, str, int]] = field(default_factory=set)
 
     @property
     def qual(self) -> str:
@@ -118,6 +127,18 @@ class LockDisciplineAnalyzer(Analyzer):
                             f"snapshot state under the lock, then block "
                             f"outside it",
                     key=f"{u.qual}:{_short(held)}:{target}"))
+            for held, cond, line in u.held_waits:
+                findings.append(Finding(
+                    analyzer="lock-discipline", code="LK004",
+                    path=u.module.relpath, line=line,
+                    message=f"`{u.qual}` calls `{_short(cond)}.wait()` "
+                            f"while holding `{_short(held)}`: wait "
+                            f"releases only the condition's own lock — "
+                            f"`{_short(held)}` stays pinned until a "
+                            f"notify, stalling (or deadlocking) every "
+                            f"thread that needs it; release it before "
+                            f"waiting",
+                    key=f"{u.qual}:{_short(held)}:{_short(cond)}:wait"))
             for lock, line in u.manual_acquires:
                 findings.append(Finding(
                     analyzer="lock-discipline", code="LK003",
@@ -136,53 +157,101 @@ class LockDisciplineAnalyzer(Analyzer):
             if "." in module.dotted else ""
         imports = collect_imports(module.tree, package)
 
-        def is_lock_ctor(value: ast.AST) -> bool:
+        def lock_ctor(value: ast.AST) -> Optional[str]:
             if not isinstance(value, ast.Call):
-                return False
+                return None
             tgt = call_target(value)
-            return tgt is not None \
-                and imports.resolve(tgt) in LOCK_CTORS
+            resolved = imports.resolve(tgt) if tgt is not None else None
+            return resolved if resolved in LOCK_CTORS else None
 
-        # pass 1: lock identities
+        def cond_wrapped_attr(value: ast.Call) -> Optional[str]:
+            """`threading.Condition(self.X)` / `Condition(NAME)` wraps
+            an EXISTING lock: wait() releases that lock, so LK004 must
+            not count it as pinned. Returns the wrapped attr/name."""
+            if not value.args:
+                return None
+            arg = value.args[0]
+            if isinstance(arg, ast.Attribute) \
+                    and isinstance(arg.value, ast.Name) \
+                    and arg.value.id == "self":
+                return arg.attr
+            if isinstance(arg, ast.Name):
+                return arg.id
+            return None
+
+        # pass 1: lock identities (conditions tracked separately — the
+        # LK004 wait analysis needs to know which locks can .wait(),
+        # and which existing lock a Condition wraps)
         class_locks: Dict[str, Set[str]] = {}
+        class_conds: Dict[str, Set[str]] = {}
+        class_wraps: Dict[str, Dict[str, str]] = {}
         module_locks: Set[str] = set()
+        module_conds: Set[str] = set()
+        module_wraps: Dict[str, str] = {}
         for node in module.tree.body:
-            if isinstance(node, ast.Assign) and is_lock_ctor(node.value):
-                for t in node.targets:
-                    if isinstance(t, ast.Name):
-                        module_locks.add(t.id)
+            if isinstance(node, ast.Assign):
+                ctor = lock_ctor(node.value)
+                if ctor is not None:
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            module_locks.add(t.id)
+                            if ctor == "threading.Condition":
+                                module_conds.add(t.id)
+                                wrapped = cond_wrapped_attr(node.value)
+                                if wrapped is not None:
+                                    module_wraps[t.id] = wrapped
             if isinstance(node, ast.ClassDef):
                 locks: Set[str] = set()
+                conds: Set[str] = set()
+                wraps: Dict[str, str] = {}
                 for sub in ast.walk(node):
-                    if isinstance(sub, ast.Assign) \
-                            and is_lock_ctor(sub.value):
+                    if isinstance(sub, ast.Assign):
+                        ctor = lock_ctor(sub.value)
+                        if ctor is None:
+                            continue
                         for t in sub.targets:
                             if isinstance(t, ast.Attribute) \
                                     and isinstance(t.value, ast.Name) \
                                     and t.value.id == "self":
                                 locks.add(t.attr)
+                                if ctor == "threading.Condition":
+                                    conds.add(t.attr)
+                                    wrapped = cond_wrapped_attr(
+                                        sub.value)
+                                    if wrapped is not None:
+                                        wraps[t.attr] = wrapped
                 if locks:
                     class_locks[node.name] = locks
+                    class_conds[node.name] = conds
+                    class_wraps[node.name] = wraps
 
         # pass 2: per-function facts
         units: List[_Unit] = []
         for node in module.tree.body:
             if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
                 units.append(self._scan_unit(
-                    module, imports, None, node, module_locks, set()))
+                    module, imports, None, node, module_locks, set(),
+                    module_conds, set(), module_wraps, {}))
             elif isinstance(node, ast.ClassDef):
                 locks = class_locks.get(node.name, set())
+                conds = class_conds.get(node.name, set())
+                wraps = class_wraps.get(node.name, {})
                 for sub in node.body:
                     if isinstance(sub, (ast.FunctionDef,
                                         ast.AsyncFunctionDef)):
                         units.append(self._scan_unit(
                             module, imports, node.name, sub,
-                            module_locks, locks))
+                            module_locks, locks, module_conds, conds,
+                            module_wraps, wraps))
         return units
 
     def _scan_unit(self, module: Module, imports, cls: Optional[str],
                    fn, module_locks: Set[str],
-                   self_locks: Set[str]) -> _Unit:
+                   self_locks: Set[str],
+                   module_conds: Set[str] = frozenset(),
+                   self_conds: Set[str] = frozenset(),
+                   module_wraps: Optional[Dict[str, str]] = None,
+                   self_wraps: Optional[Dict[str, str]] = None) -> _Unit:
         unit = _Unit(module=module, cls=cls, name=fn.name, node=fn)
         prefix = module.dotted
 
@@ -195,6 +264,27 @@ class LockDisciplineAnalyzer(Analyzer):
             if isinstance(expr, ast.Name) and expr.id in module_locks:
                 return f"{prefix}.{expr.id}"
             return None
+
+        mwraps = module_wraps or {}
+        swraps = self_wraps or {}
+
+        def cond_id(expr: ast.AST) -> Optional[str]:
+            """lock_id restricted to threading.Condition identities.
+            Returns (id, wrapped-lock id or None): Condition(existing)
+            releases the WRAPPED lock on wait, so LK004 exempts it."""
+            if isinstance(expr, ast.Attribute) \
+                    and isinstance(expr.value, ast.Name) \
+                    and expr.value.id == "self" \
+                    and expr.attr in self_conds:
+                wrapped = swraps.get(expr.attr)
+                return (f"{prefix}.{cls}.{expr.attr}",
+                        f"{prefix}.{cls}.{wrapped}" if wrapped else None)
+            if isinstance(expr, ast.Name) and expr.id in module_conds:
+                wrapped = mwraps.get(expr.id)
+                return (f"{prefix}.{expr.id}",
+                        f"{prefix}.{wrapped}" if wrapped else None)
+            return None
+
 
         def walk(body: List[ast.stmt], held: Tuple[str, ...]) -> None:
             for stmt in body:
@@ -220,18 +310,19 @@ class LockDisciplineAnalyzer(Analyzer):
                     # set through the recursion
                     for header in _header_exprs(stmt):
                         self._scan_expr_calls(header, held, unit,
-                                              imports, lock_id)
+                                              imports, lock_id, cond_id)
                     for sub in subs:
                         walk(sub, held)
                 else:
                     self._scan_expr_calls(stmt, held, unit, imports,
-                                          lock_id)
+                                          lock_id, cond_id)
 
         walk(fn.body, ())
         return unit
 
     def _scan_expr_calls(self, root: ast.AST, held: Tuple[str, ...],
-                         unit: _Unit, imports, lock_id) -> None:
+                         unit: _Unit, imports, lock_id,
+                         cond_id=lambda expr: None) -> None:
         for node in ast.walk(root):
             if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
                                  ast.Lambda)):
@@ -244,6 +335,19 @@ class LockDisciplineAnalyzer(Analyzer):
                 lid = lock_id(node.func.value)
                 if lid is not None:
                     unit.manual_acquires.add((lid, node.lineno))
+                    continue
+            # Condition wait under other held locks (LK004): wait
+            # releases only the condition's OWN lock — itself, or the
+            # existing lock a `Condition(self._lk)` ctor wrapped —
+            # never the rest of the stack
+            if isinstance(node.func, ast.Attribute) \
+                    and node.func.attr in ("wait", "wait_for"):
+                got = cond_id(node.func.value)
+                if got is not None:
+                    cid, wrapped = got
+                    for h in held:
+                        if h != cid and h != wrapped:
+                            unit.held_waits.add((h, cid, node.lineno))
                     continue
             target = self._blocking_target(node, imports)
             if target is not None:

@@ -4,9 +4,12 @@ finding not frozen in the baseline file."""
 from __future__ import annotations
 
 import argparse
+import io
 import os
 import re
 import sys
+import tokenize
+import weakref
 from typing import List, Optional, Sequence, Tuple
 
 from tools.lint.framework import (
@@ -30,11 +33,23 @@ DEFAULT_BASELINE = os.path.join(
 # host-tail conformance oracle in bench.py that the tail-readback
 # analyzer exists to police everywhere else).
 _INLINE_DISABLE_RE = re.compile(r"koordlint:\s*disable=([A-Za-z0-9_,\s-]+)")
+# `# koordlint: disable-file=CODE` on a COMMENT line anywhere in the
+# file suppresses that code (or analyzer) for the whole file — for
+# generated files and conformance oracles where per-line markers would
+# have to be repeated at every site. Still named, still reviewed: a
+# bare `disable-file=` with no code disables nothing.
+_FILE_DISABLE_RE = re.compile(r"koordlint:\s*disable-file=([A-Za-z0-9_,\s-]+)")
 
 
 def _inline_disabled(project: Project, finding: Finding) -> bool:
     mod = project.by_relpath.get(finding.path)
-    if mod is None or finding.line < 1:
+    if mod is None:
+        return False
+    if finding.code in _file_disable_tokens(project, finding.path) \
+            or finding.analyzer in _file_disable_tokens(project,
+                                                        finding.path):
+        return True
+    if finding.line < 1:
         return False
     lines = mod.source.splitlines()
     if finding.line > len(lines):
@@ -47,6 +62,40 @@ def _inline_disabled(project: Project, finding: Finding) -> bool:
     # than producing an unmatchable space-containing token
     tokens = {t for t in re.split(r"[,\s]+", m.group(1)) if t}
     return finding.code in tokens or finding.analyzer in tokens
+
+
+# per-Project cache of file-level disable tokens; weak keys so a
+# GC'd Project can never alias a recycled id
+_FILE_TOKEN_CACHE: "weakref.WeakKeyDictionary[Project, dict]" = \
+    weakref.WeakKeyDictionary()
+
+
+def _file_disable_tokens(project: Project, relpath: str) -> frozenset:
+    """Codes/analyzer names disabled file-wide by `disable-file=`
+    markers in COMMENTS. Real tokenization, not a line scan: a marker
+    quoted inside a (multi-line) string literal — docs describing the
+    pragma are the obvious case — must not silence anything."""
+    per_file = _FILE_TOKEN_CACHE.setdefault(project, {})
+    cached = per_file.get(relpath)
+    if cached is not None:
+        return cached
+    mod = project.by_relpath.get(relpath)
+    tokens: set = set()
+    if mod is not None:
+        try:
+            for tok in tokenize.generate_tokens(
+                    io.StringIO(mod.source).readline):
+                if tok.type != tokenize.COMMENT:
+                    continue
+                m = _FILE_DISABLE_RE.search(tok.string)
+                if m:
+                    tokens |= {t for t in re.split(r"[,\s]+",
+                                                   m.group(1)) if t}
+        except (tokenize.TokenError, IndentationError):
+            tokens = set()  # untokenizable: disable nothing
+    out = frozenset(tokens)
+    per_file[relpath] = out
+    return out
 
 
 def run_lint(root: str = REPO_ROOT,

@@ -1,0 +1,11 @@
+"""koordshape's symbolic-dimension model: the spec grammar, the AST
+contract extractor, and the abstract shape interpreter behind the
+`shape-contract` analyzer (tools/lint/analyzers/shape_contract.py).
+
+Stdlib-only by the same rule as the rest of koordlint: the static tier
+must fail CI on hosts where jax is broken or absent. The dynamic tier
+(tools/shapecheck.py) imports jax and the runtime registry instead —
+this package is the half both tiers share the GRAMMAR of, and
+tests/test_shape_contract.py pins the dim vocabulary here equal to
+koordinator_tpu.snapshot.schema.DIM_VOCAB.
+"""

@@ -1,0 +1,921 @@
+"""Abstract shape interpretation of contracted kernel bodies.
+
+A tiny forward dataflow over one function: parameters bind to the
+symbolic shapes their contract declares, a recognized subset of
+jnp/lax/array operations propagates shapes, and EVERYTHING else joins
+to "unknown", which silences all downstream checks — the interpreter
+never guesses, so a finding is always backed by declared dims flowing
+through recognized ops only.
+
+Defects surfaced (the analyzer assigns the SH codes):
+  - conflict: two distinct named dims forced equal by a broadcast,
+    concatenate, matmul contraction, or take_along_axis (SH001)
+  - rank_growth: implicit (no [None] / broadcast_to) rank promotion
+    between non-scalar operands (SH002)
+  - cross: an argument passed to another CONTRACTED function
+    disagreeing with the callee's declared spec, or a return value
+    disagreeing with the function's own declared returns (SH003 /
+    SH001 respectively)
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional, Tuple
+
+from tools.lint.astutil import dotted_name
+from tools.lint.shapes.contracts import AstContract
+from tools.lint.shapes.spec import (
+    DimProp,
+    LeafSpec,
+    Spec,
+    StructRef,
+    SymShape,
+    broadcast_join,
+    dims_compatible,
+)
+
+# --- the value lattice -----------------------------------------------------
+
+
+class Val:
+    """Top: statically unknown."""
+
+
+UNKNOWN = Val()
+
+
+@dataclass(frozen=True)
+class ArrVal(Val):
+    dims: SymShape            # entries: symbol | int | None
+
+
+@dataclass(frozen=True)
+class StructVal(Val):
+    name: str
+
+
+@dataclass(frozen=True)
+class IntVal(Val):
+    """A python int statically tied to a dim (or a literal)."""
+
+    dim: object               # symbol str or int literal
+
+
+@dataclass(frozen=True)
+class ScalarVal(Val):
+    """A scalar of unknown value (loop indices, int() casts, inf)."""
+
+
+@dataclass(frozen=True)
+class NoneVal(Val):
+    pass
+
+
+@dataclass(frozen=True)
+class TupleVal(Val):
+    items: tuple
+
+
+@dataclass(frozen=True)
+class ShapeTupleVal(Val):
+    """`x.shape` of a known array."""
+
+    dims: SymShape
+
+
+@dataclass(frozen=True)
+class AtVal(Val):
+    """`x.at` / `x.at[idx]`: the pending in-place update view."""
+
+    dims: SymShape
+
+
+_SCALARISH = (IntVal, ScalarVal)
+
+_ELEMENTWISE = {
+    "where", "maximum", "minimum", "logical_and", "logical_or",
+    "logical_not", "logical_xor", "floor", "ceil", "abs", "exp", "sqrt",
+    "log", "isfinite", "isnan", "mod", "power", "add", "subtract",
+    "multiply", "divide", "equal", "not_equal", "greater", "less",
+    "greater_equal", "less_equal", "sign", "square", "round", "clip",
+}
+_REDUCTIONS = {"sum", "any", "all", "max", "min", "mean", "prod",
+               "argmax", "argmin"}
+_SHAPE_PRESERVING_METHODS = {"astype", "copy", "clip", "round"}
+_SHAPE_PRESERVING_FUNCS = {"argsort", "sort", "cumsum", "cumprod",
+                           "flip", "negative", "asarray"}
+_AT_METHODS = {"add", "set", "mul", "max", "min", "subtract", "divide",
+               "multiply", "apply", "get"}
+_SCALAR_CONSTS = {
+    "jax.numpy.inf", "jax.numpy.nan", "jax.numpy.pi",
+    "numpy.inf", "numpy.nan", "numpy.pi", "math.inf", "math.nan",
+}
+_NEWAXIS = {"jax.numpy.newaxis", "numpy.newaxis"}
+_SCALAR_CASTS = {"int", "float", "bool", "len", "min", "max",
+                 "jax.numpy.int32", "jax.numpy.float32",
+                 "jax.numpy.int8", "jax.numpy.uint32",
+                 "jax.numpy.bool_"}
+
+
+@dataclass
+class Defect:
+    kind: str                  # "conflict" | "rank_growth" | "cross"
+    line: int
+    detail: str
+    key: str
+
+
+class ShapeInterp:
+    """One contracted function body, interpreted.
+
+    `resolve_const(dotted) -> Val|None` resolves module-level numeric
+    constants (EPS, POLICY_NONE) through imports to IntVal/ScalarVal.
+    `resolve_contract(call) -> (AstContract, param_names)|None` resolves
+    a Call to another contracted function for the cross checks.
+    `struct_field(struct, field) -> Spec|None` reads the struct tables.
+    """
+
+    def __init__(self, contract: AstContract,
+                 resolve_dotted: Callable[[str], str],
+                 resolve_const: Callable[[str], Optional[float]],
+                 resolve_contract: Callable[[ast.Call],
+                                            Optional[AstContract]],
+                 struct_field: Callable[[str, str], Optional[Spec]]):
+        self.contract = contract
+        self.resolve_dotted = resolve_dotted
+        self.resolve_const = resolve_const
+        self.resolve_contract = resolve_contract
+        self.struct_field = struct_field
+        self.defects: List[Defect] = []
+        self._keys_seen: Dict[str, int] = {}
+
+    # --- entry -----------------------------------------------------------
+
+    def run(self) -> List[Defect]:
+        env: Dict[str, Val] = {}
+        for name, spec in self.contract.args.items():
+            env[name] = self._spec_val(spec)
+        for name, dim in self.contract.static.items():
+            env[name] = IntVal(dim) if dim is not None else ScalarVal()
+        self._walk_body(self.contract.fn_node.body, env)
+        return self.defects
+
+    def _spec_val(self, spec: Spec) -> Val:
+        if isinstance(spec, LeafSpec):
+            return ArrVal(tuple(spec.dims))
+        if isinstance(spec, StructRef):
+            return StructVal(spec.name)
+        if isinstance(spec, DimProp):
+            return IntVal(spec.dim)
+        if isinstance(spec, tuple):
+            return TupleVal(tuple(self._spec_val(s) for s in spec))
+        return UNKNOWN
+
+    # --- reporting -------------------------------------------------------
+
+    def _report(self, kind: str, line: int, detail: str, key: str) -> None:
+        base = f"{self.contract.name}:{key}"
+        n = self._keys_seen.get(base, 0)
+        self._keys_seen[base] = n + 1
+        if n:
+            base = f"{base}#{n}"
+        self.defects.append(Defect(kind=kind, line=line, detail=detail,
+                                   key=base))
+
+    def _check_join(self, join, line: int, where: str) -> None:
+        for a, b in join.conflicts:
+            self._report(
+                "conflict", line,
+                f"dims `{a}` and `{b}` forced equal in {where} — "
+                f"distinct contract dims never broadcast together",
+                key=f"{a}<>{b}:{where}")
+        if join.rank_growth:
+            self._report(
+                "rank_growth", line,
+                f"implicit rank growth in {where}: add an explicit "
+                f"[None] / jnp.broadcast_to so the promoted axes are "
+                f"declared", key=f"rank:{where}")
+
+    # --- statements ------------------------------------------------------
+
+    def _walk_body(self, body: List[ast.stmt],
+                   env: Dict[str, Val]) -> None:
+        for stmt in body:
+            self._walk_stmt(stmt, env)
+
+    def _walk_stmt(self, stmt: ast.stmt, env: Dict[str, Val]) -> None:
+        if isinstance(stmt, (ast.Import, ast.ImportFrom, ast.ClassDef,
+                             ast.Global, ast.Nonlocal, ast.Pass)):
+            return
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # nested defs see a snapshot of the closure; their params
+            # are unknown, their bindings stay local
+            inner = dict(env)
+            for p in [a.arg for a in stmt.args.posonlyargs
+                      + stmt.args.args + stmt.args.kwonlyargs]:
+                inner[p] = UNKNOWN
+            self._walk_body(stmt.body, inner)
+            return
+        if isinstance(stmt, ast.Assign):
+            val = self._eval(stmt.value, env)
+            for t in stmt.targets:
+                self._bind(t, val, env)
+            return
+        if isinstance(stmt, ast.AnnAssign):
+            if stmt.value is not None:
+                self._bind(stmt.target, self._eval(stmt.value, env), env)
+            return
+        if isinstance(stmt, ast.AugAssign):
+            left = self._eval(stmt.target, env)
+            right = self._eval(stmt.value, env)
+            out = self._binop_val(left, right, stmt.lineno,
+                                  _op_name(stmt.op))
+            self._bind(stmt.target, out, env)
+            return
+        if isinstance(stmt, ast.Return):
+            val = self._eval(stmt.value, env) if stmt.value is not None \
+                else NoneVal()
+            self._check_return(val, stmt.lineno)
+            return
+        if isinstance(stmt, ast.Expr):
+            self._eval(stmt.value, env)
+            return
+        if isinstance(stmt, (ast.If, ast.While)):
+            self._eval(stmt.test, env)
+            self._walk_branches(env, stmt.body, stmt.orelse)
+            return
+        if isinstance(stmt, ast.For):
+            self._bind(stmt.target, self._iter_val(stmt.iter, env), env)
+            self._walk_branches(env, stmt.body, stmt.orelse)
+            return
+        if isinstance(stmt, ast.With):
+            for item in stmt.items:
+                self._eval(item.context_expr, env)
+                if item.optional_vars is not None:
+                    self._bind(item.optional_vars, UNKNOWN, env)
+            self._walk_body(stmt.body, env)
+            return
+        if isinstance(stmt, ast.Try):
+            self._walk_body(stmt.body, env)
+            for h in stmt.handlers:
+                self._walk_body(h.body, env)
+            self._walk_body(stmt.orelse, env)
+            self._walk_body(stmt.finalbody, env)
+            return
+        if isinstance(stmt, (ast.Raise, ast.Assert)):
+            for child in ast.iter_child_nodes(stmt):
+                if isinstance(child, ast.expr):
+                    self._eval(child, env)
+            return
+        # anything else: evaluate child expressions for their checks
+        for child in ast.iter_child_nodes(stmt):
+            if isinstance(child, ast.expr):
+                self._eval(child, env)
+
+    def _walk_branches(self, env: Dict[str, Val],
+                       *bodies: List[ast.stmt]) -> None:
+        """Walk alternative bodies on copies, then join: names whose
+        post-branch values disagree become unknown (loop bodies run
+        once — enough for the checks, sound for the join)."""
+        results = []
+        for body in bodies:
+            branch = dict(env)
+            self._walk_body(body, branch)
+            results.append(branch)
+        keys = set()
+        for r in results:
+            keys |= set(r)
+        for k in keys:
+            vals = [r.get(k, env.get(k)) for r in results]
+            base = vals[0]
+            if all(v == base for v in vals):
+                if base is not None:
+                    env[k] = base
+            else:
+                env[k] = UNKNOWN
+
+    def _iter_val(self, it: ast.expr, env: Dict[str, Val]) -> Val:
+        v = self._eval(it, env)
+        if isinstance(it, ast.Call):
+            dotted = dotted_name(it.func) or ""
+            if self.resolve_dotted(dotted) == "range":
+                return ScalarVal()
+        if isinstance(v, ArrVal) and len(v.dims) >= 1:
+            return ArrVal(v.dims[1:])     # iterating strips the lead axis
+        return UNKNOWN
+
+    def _bind(self, target: ast.AST, val: Val,
+              env: Dict[str, Val]) -> None:
+        if isinstance(target, ast.Name):
+            env[target.id] = val
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            elts = target.elts
+            items: Tuple = ()
+            if isinstance(val, TupleVal) and len(val.items) == len(elts):
+                items = val.items
+            elif isinstance(val, ShapeTupleVal) \
+                    and len(val.dims) == len(elts):
+                items = tuple(IntVal(d) if d is not None else ScalarVal()
+                              for d in val.dims)
+            if items:
+                for e, v in zip(elts, items):
+                    self._bind(e, v, env)
+            else:
+                for e in elts:
+                    self._bind(e, UNKNOWN, env)
+        elif isinstance(target, ast.Starred):
+            self._bind(target.value, UNKNOWN, env)
+        # attribute/subscript stores introduce no names
+
+    # --- returns / cross-contract checks ---------------------------------
+
+    def _check_return(self, val: Val, line: int) -> None:
+        spec = self.contract.returns
+        if spec is None:
+            return
+        self._check_against_spec(val, spec, line, "return",
+                                 kind="conflict")
+
+    def _check_against_spec(self, val: Val, spec: Spec, line: int,
+                            where: str, kind: str) -> None:
+        if isinstance(spec, tuple):
+            if isinstance(val, TupleVal) \
+                    and len(val.items) == len(spec):
+                for i, (v, s) in enumerate(zip(val.items, spec)):
+                    self._check_against_spec(v, s, line,
+                                             f"{where}[{i}]", kind)
+            return
+        if isinstance(spec, LeafSpec):
+            if isinstance(val, NoneVal):
+                if not spec.optional:
+                    self._report(kind, line,
+                                 f"{where}: None where the contract "
+                                 f"declares a required "
+                                 f"{spec.dtype}[{','.join(map(str, spec.dims))}]",
+                                 key=f"{where}:none")
+                return
+            if isinstance(val, ArrVal):
+                for a, b in dims_compatible(tuple(spec.dims), val.dims):
+                    self._report(
+                        kind, line,
+                        f"{where}: contract declares dim `{a}` but the "
+                        f"value carries `{b}`", key=f"{where}:{a}<>{b}")
+            return
+        if isinstance(spec, StructRef) and isinstance(val, StructVal):
+            if val.name != spec.name:
+                self._report(kind, line,
+                             f"{where}: contract declares struct "
+                             f"{spec.name!r} but the value is "
+                             f"{val.name!r}",
+                             key=f"{where}:{spec.name}<>{val.name}")
+
+    # --- expressions -----------------------------------------------------
+
+    def _eval(self, node: ast.expr, env: Dict[str, Val]) -> Val:
+        if node is None:
+            return UNKNOWN
+        if isinstance(node, ast.Constant):
+            if node.value is None:
+                return NoneVal()
+            if isinstance(node.value, bool):
+                return ScalarVal()
+            if isinstance(node.value, int):
+                return IntVal(node.value)
+            if isinstance(node.value, (float, complex)):
+                return ScalarVal()
+            return UNKNOWN
+        if isinstance(node, ast.Name):
+            return env.get(node.id, self._const_val(node.id))
+        if isinstance(node, ast.Attribute):
+            return self._eval_attribute(node, env)
+        if isinstance(node, ast.Subscript):
+            return self._eval_subscript(node, env)
+        if isinstance(node, ast.Call):
+            return self._eval_call(node, env)
+        if isinstance(node, ast.BinOp):
+            left = self._eval(node.left, env)
+            right = self._eval(node.right, env)
+            if isinstance(node.op, ast.MatMult):
+                return self._matmul_val(left, right, node.lineno)
+            return self._binop_val(left, right, node.lineno,
+                                   _op_name(node.op))
+        if isinstance(node, ast.UnaryOp):
+            return self._eval(node.operand, env)
+        if isinstance(node, ast.Compare):
+            out = self._eval(node.left, env)
+            for comp in node.comparators:
+                out = self._binop_val(out, self._eval(comp, env),
+                                      node.lineno, "compare")
+            return out
+        if isinstance(node, ast.BoolOp):
+            for v in node.values:
+                self._eval(v, env)
+            return UNKNOWN
+        if isinstance(node, ast.IfExp):
+            self._eval(node.test, env)
+            a = self._eval(node.body, env)
+            b = self._eval(node.orelse, env)
+            return a if a == b else UNKNOWN
+        if isinstance(node, (ast.Tuple, ast.List)):
+            return TupleVal(tuple(self._eval(e, env) for e in node.elts))
+        if isinstance(node, ast.Starred):
+            self._eval(node.value, env)
+            return UNKNOWN
+        if isinstance(node, (ast.Lambda, ast.ListComp, ast.SetComp,
+                             ast.GeneratorExp, ast.DictComp, ast.Dict)):
+            return UNKNOWN
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.expr):
+                self._eval(child, env)
+        return UNKNOWN
+
+    def _const_val(self, name: str) -> Val:
+        resolved = self.resolve_dotted(name)
+        if resolved in _NEWAXIS:
+            return NoneVal()
+        if resolved in _SCALAR_CONSTS:
+            return ScalarVal()
+        c = self.resolve_const(resolved)
+        return c if c is not None else UNKNOWN
+
+    def _eval_attribute(self, node: ast.Attribute,
+                        env: Dict[str, Val]) -> Val:
+        dotted = dotted_name(node)
+        if dotted is not None:
+            head = dotted.partition(".")[0]
+            if head not in env:
+                resolved = self.resolve_dotted(dotted)
+                if resolved in _SCALAR_CONSTS:
+                    return ScalarVal()
+                if resolved in _NEWAXIS:
+                    return NoneVal()
+                c = self.resolve_const(resolved)
+                if c is not None:
+                    return c
+        base = self._eval(node.value, env)
+        if isinstance(base, StructVal):
+            field = self.struct_field(base.name, node.attr)
+            if field is not None:
+                return self._spec_val(field)
+            return UNKNOWN
+        if isinstance(base, ArrVal):
+            if node.attr == "shape":
+                return ShapeTupleVal(base.dims)
+            if node.attr == "T":
+                return ArrVal(tuple(reversed(base.dims)))
+            if node.attr == "at":
+                return AtVal(base.dims)
+            if node.attr in ("dtype", "ndim", "size"):
+                return ScalarVal()
+        return UNKNOWN
+
+    # --- subscripts ------------------------------------------------------
+
+    def _eval_subscript(self, node: ast.Subscript,
+                        env: Dict[str, Val]) -> Val:
+        base = self._eval(node.value, env)
+        sl = node.slice
+        if isinstance(base, ShapeTupleVal):
+            idx = self._eval(sl, env)
+            if isinstance(idx, IntVal) and isinstance(idx.dim, int) \
+                    and 0 <= idx.dim < len(base.dims):
+                d = base.dims[idx.dim]
+                return IntVal(d) if d is not None else ScalarVal()
+            return ScalarVal()
+        if isinstance(base, AtVal):
+            self._eval(sl, env)
+            return AtVal(base.dims)
+        if isinstance(base, TupleVal):
+            idx = self._eval(sl, env)
+            if isinstance(idx, IntVal) and isinstance(idx.dim, int) \
+                    and 0 <= idx.dim < len(base.items):
+                return base.items[idx.dim]
+            return UNKNOWN
+        if not isinstance(base, ArrVal):
+            self._eval(sl, env)
+            return UNKNOWN
+        elements = list(sl.elts) if isinstance(sl, ast.Tuple) else [sl]
+        out: List = []
+        axis = 0
+        advanced = 0
+        for el in elements:
+            if isinstance(el, ast.Constant) and el.value is Ellipsis:
+                return UNKNOWN
+            if isinstance(el, ast.Slice):
+                if axis >= len(base.dims):
+                    return UNKNOWN
+                if el.lower is None and el.upper is None \
+                        and el.step is None:
+                    out.append(base.dims[axis])
+                else:
+                    for b in (el.lower, el.upper, el.step):
+                        if b is not None:
+                            self._eval(b, env)
+                    out.append(None)      # sliced extent: unknown
+                axis += 1
+                continue
+            v = self._eval(el, env)
+            if isinstance(v, NoneVal):
+                out.append(1)             # explicit broadcast axis
+                continue
+            if isinstance(v, (IntVal, ScalarVal)):
+                if axis >= len(base.dims):
+                    return UNKNOWN
+                axis += 1                 # scalar index drops the axis
+                continue
+            if isinstance(v, ArrVal):
+                if axis >= len(base.dims):
+                    return UNKNOWN
+                advanced += 1
+                if advanced > 1:
+                    return UNKNOWN        # multi-array indexing: punt
+                out.extend(v.dims)
+                axis += 1
+                continue
+            return UNKNOWN
+        out.extend(base.dims[axis:])
+        return ArrVal(tuple(out))
+
+    # --- operators -------------------------------------------------------
+
+    def _binop_val(self, left: Val, right: Val, line: int,
+                   where: str) -> Val:
+        if isinstance(left, ArrVal) and isinstance(right, ArrVal):
+            join = broadcast_join(left.dims, right.dims)
+            self._check_join(join, line, where)
+            return ArrVal(join.dims) if join.dims is not None else UNKNOWN
+        for a, b in ((left, right), (right, left)):
+            if isinstance(a, ArrVal) and isinstance(b, _SCALARISH):
+                return a
+        if isinstance(left, _SCALARISH) and isinstance(right, _SCALARISH):
+            if isinstance(left, IntVal) and isinstance(right, IntVal) \
+                    and left.dim == right.dim:
+                return left
+            return ScalarVal()
+        return UNKNOWN
+
+    def _matmul_val(self, left: Val, right: Val, line: int) -> Val:
+        if not (isinstance(left, ArrVal) and isinstance(right, ArrVal)):
+            return UNKNOWN
+        a, b = left.dims, right.dims
+        if len(a) < 1 or len(b) < 2:
+            return UNKNOWN
+        k1, k2 = a[-1], b[-2]
+        if k1 is not None and k2 is not None and k1 != k2 \
+                and not (k1 == 1 or k2 == 1) \
+                and type(k1) is type(k2):
+            self._report("conflict", line,
+                         f"matmul contracts dim `{k1}` against `{k2}`",
+                         key=f"{k1}<>{k2}:matmul")
+        if len(a) == 1:
+            return ArrVal(tuple(b[:-2]) + (b[-1],))
+        return ArrVal(tuple(a[:-1]) + (b[-1],))
+
+    # --- calls -----------------------------------------------------------
+
+    def _eval_call(self, node: ast.Call, env: Dict[str, Val]) -> Val:
+        argvals = [self._eval(a, env) for a in node.args]
+        kwvals = {kw.arg: self._eval(kw.value, env)
+                  for kw in node.keywords if kw.arg is not None}
+        for kw in node.keywords:
+            if kw.arg is None:
+                self._eval(kw.value, env)
+        # method-style calls on evaluated receivers
+        if isinstance(node.func, ast.Attribute):
+            recv = self._eval(node.func.value, env)
+            out = self._eval_method(node, recv, argvals)
+            if out is not None:
+                return out
+        dotted = dotted_name(node.func)
+        resolved = self.resolve_dotted(dotted) if dotted else ""
+
+        if resolved in _SCALAR_CASTS:
+            return ScalarVal()
+        if resolved == "range":
+            return UNKNOWN
+
+        if resolved.startswith("jax.numpy.") \
+                or resolved.startswith("jax.lax."):
+            return self._eval_jax_call(node, resolved.rpartition(".")[2],
+                                       argvals, kwvals, env)
+
+        # cross-contract call: check args, then trust the declared
+        # return ONLY when every declared arg was known (a sliced /
+        # rebuilt operand must not smuggle the callee's dims back in)
+        target = self.resolve_contract(node)
+        if target is not None:
+            return self._eval_contract_call(node, target, argvals,
+                                            kwvals)
+        return UNKNOWN
+
+    def _eval_method(self, node: ast.Call, recv: Val,
+                     argvals: List[Val]) -> Optional[Val]:
+        """Shape rules for attribute calls; None = not handled here
+        (fall through to function-call resolution)."""
+        attr = node.func.attr
+        if isinstance(recv, AtVal) and attr in _AT_METHODS:
+            return ArrVal(recv.dims)
+        if isinstance(recv, ArrVal):
+            if attr in _SHAPE_PRESERVING_METHODS:
+                return ArrVal(recv.dims)
+            if attr in _REDUCTIONS:
+                return self._reduce_dims(recv.dims, node, axis_offset=0)
+            if attr == "reshape":
+                return self._reshape_dims(node, argvals)
+            if attr == "flatten" or attr == "ravel":
+                return ArrVal((None,))
+        if isinstance(recv, StructVal) and attr == "replace":
+            return UNKNOWN
+        if isinstance(recv, (TupleVal, ShapeTupleVal)) \
+                and attr in ("index", "count"):
+            return ScalarVal()
+        return None
+
+    def _reduce_dims(self, dims: SymShape, node: ast.Call,
+                     axis_offset: int) -> Val:
+        axis_node = None
+        for kw in node.keywords:
+            if kw.arg == "keepdims":
+                return UNKNOWN
+            if kw.arg == "axis":
+                axis_node = kw.value
+        if axis_node is None and len(node.args) > axis_offset:
+            axis_node = node.args[axis_offset]
+        if axis_node is None:
+            return ArrVal(())
+        if isinstance(axis_node, ast.Constant) \
+                and axis_node.value is None:
+            return ArrVal(())
+        ax = _const_int(axis_node)
+        if ax is not None and -len(dims) <= ax < len(dims):
+            ax %= len(dims)
+            return ArrVal(dims[:ax] + dims[ax + 1:])
+        return UNKNOWN
+
+    def _reshape_dims(self, node: ast.Call, argvals: List[Val]) -> Val:
+        """reshape(-1) / reshape(a, b) / reshape(x.shape): known IntVal
+        args become dims, -1 becomes unknown, anything else punts."""
+        vals = argvals
+        if len(vals) == 1 and isinstance(vals[0], TupleVal):
+            vals = list(vals[0].items)
+        if len(vals) == 1 and isinstance(vals[0], ShapeTupleVal):
+            return ArrVal(vals[0].dims)
+        out: List = []
+        for v in vals:
+            if isinstance(v, IntVal):
+                out.append(None if v.dim == -1 else v.dim)
+            elif isinstance(v, ScalarVal):
+                out.append(None)
+            else:
+                return UNKNOWN
+        return ArrVal(tuple(out)) if out else UNKNOWN
+
+    def _eval_jax_call(self, node: ast.Call, fname: str,
+                       argvals: List[Val], kwvals: Dict[str, Val],
+                       env: Dict[str, Val]) -> Val:
+        if fname in _ELEMENTWISE:
+            arrs = [v for v in argvals + list(kwvals.values())
+                    if isinstance(v, ArrVal)]
+            if any(v is UNKNOWN for v in argvals) \
+                    or any(v is UNKNOWN for v in kwvals.values()):
+                return UNKNOWN
+            if not arrs:
+                return ScalarVal() if argvals else UNKNOWN
+            out = arrs[0]
+            for other in arrs[1:]:
+                join = broadcast_join(out.dims, other.dims)
+                self._check_join(join, node.lineno, fname)
+                if join.dims is None:
+                    return UNKNOWN
+                out = ArrVal(join.dims)
+            return out
+        if fname in _REDUCTIONS:
+            if argvals and isinstance(argvals[0], ArrVal):
+                return self._reduce_dims(argvals[0].dims, node,
+                                         axis_offset=1)
+            return UNKNOWN
+        if fname in _SHAPE_PRESERVING_FUNCS:
+            if argvals and isinstance(argvals[0], ArrVal):
+                return ArrVal(argvals[0].dims)
+            return UNKNOWN
+        if fname == "associative_scan":
+            if len(argvals) >= 2 and isinstance(argvals[1], ArrVal):
+                return ArrVal(argvals[1].dims)
+            return UNKNOWN
+        if fname in ("zeros", "ones", "empty"):
+            return self._from_shape_arg(node, argvals[:1])
+        if fname in ("full",):
+            return self._from_shape_arg(node, argvals[:1])
+        if fname in ("zeros_like", "ones_like", "full_like",
+                     "empty_like"):
+            if argvals and isinstance(argvals[0], ArrVal):
+                return ArrVal(argvals[0].dims)
+            return UNKNOWN
+        if fname == "arange":
+            if argvals and isinstance(argvals[0], IntVal) \
+                    and len(node.args) == 1:
+                return ArrVal((argvals[0].dim,))
+            return ArrVal((None,))
+        if fname == "broadcast_to":
+            return self._from_shape_arg(node, argvals[1:2])
+        if fname == "expand_dims":
+            return UNKNOWN
+        if fname == "reshape":
+            return self._reshape_dims(node, argvals[1:]) \
+                if argvals else UNKNOWN
+        if fname == "concatenate":
+            return self._concat_dims(node, argvals, kwvals)
+        if fname == "stack":
+            return self._stack_dims(node, argvals, kwvals)
+        if fname == "take":
+            return self._take_dims(node, argvals, kwvals)
+        if fname == "take_along_axis":
+            return self._take_along_dims(node, argvals, kwvals)
+        if fname in ("top_k", "approx_max_k", "approx_min_k"):
+            if argvals and isinstance(argvals[0], ArrVal) \
+                    and len(argvals[0].dims) >= 1:
+                d = ArrVal(argvals[0].dims[:-1] + (None,))
+                return TupleVal((d, d))
+            return UNKNOWN
+        if fname in ("int32", "float32", "int8", "uint32", "bool_",
+                     "asarray", "array"):
+            if argvals and isinstance(argvals[0], ArrVal):
+                return ArrVal(argvals[0].dims)
+            if argvals and isinstance(argvals[0], _SCALARISH):
+                return ScalarVal()
+            return UNKNOWN
+        return UNKNOWN
+
+    def _from_shape_arg(self, node: ast.Call,
+                        shape_vals: List[Val]) -> Val:
+        if not shape_vals:
+            return UNKNOWN
+        v = shape_vals[0]
+        if isinstance(v, TupleVal):
+            out: List = []
+            for item in v.items:
+                if isinstance(item, IntVal):
+                    out.append(item.dim if item.dim != -1 else None)
+                elif isinstance(item, ScalarVal):
+                    out.append(None)
+                else:
+                    return UNKNOWN
+            return ArrVal(tuple(out))
+        if isinstance(v, ShapeTupleVal):
+            return ArrVal(v.dims)
+        if isinstance(v, IntVal):
+            return ArrVal((v.dim,))
+        return UNKNOWN
+
+    def _concat_dims(self, node: ast.Call, argvals: List[Val],
+                     kwvals: Dict[str, Val]) -> Val:
+        if not argvals or not isinstance(argvals[0], TupleVal):
+            return UNKNOWN
+        parts = argvals[0].items
+        if not parts or not all(isinstance(p, ArrVal) for p in parts):
+            return UNKNOWN
+        ranks = {len(p.dims) for p in parts}
+        if len(ranks) != 1:
+            return UNKNOWN
+        rank = ranks.pop()
+        axis = self._axis_arg(node, default=0)
+        if axis is None or not (-rank <= axis < rank):
+            return UNKNOWN
+        axis %= rank
+        out: List = []
+        for i in range(rank):
+            if i == axis:
+                out.append(None)          # concatenated extent
+                continue
+            dims_i = [p.dims[i] for p in parts]
+            known = [d for d in dims_i if d is not None]
+            strs = {d for d in known if isinstance(d, str)}
+            if len(strs) > 1:
+                a, b = sorted(strs)[:2]
+                self._report(
+                    "conflict", node.lineno,
+                    f"concatenate requires equal non-axis dims but "
+                    f"axis {i} mixes `{a}` and `{b}`",
+                    key=f"{a}<>{b}:concat")
+            out.append(known[0] if len(set(known)) == 1 and known
+                       else None)
+        return ArrVal(tuple(out))
+
+    def _stack_dims(self, node: ast.Call, argvals: List[Val],
+                    kwvals: Dict[str, Val]) -> Val:
+        if not argvals or not isinstance(argvals[0], TupleVal):
+            return UNKNOWN
+        parts = argvals[0].items
+        if not parts or not all(isinstance(p, ArrVal) for p in parts):
+            return UNKNOWN
+        base = parts[0]
+        for other in parts[1:]:
+            join = broadcast_join(base.dims, other.dims)
+            self._check_join(join, node.lineno, "stack")
+            if join.dims is None:
+                return UNKNOWN
+            base = ArrVal(join.dims)
+        axis = self._axis_arg(node, default=0)
+        rank = len(base.dims) + 1
+        if axis is None or not (-rank <= axis < rank):
+            return UNKNOWN
+        axis %= rank
+        dims = list(base.dims)
+        dims.insert(axis, len(parts))
+        return ArrVal(tuple(dims))
+
+    def _take_dims(self, node: ast.Call, argvals: List[Val],
+                   kwvals: Dict[str, Val]) -> Val:
+        if len(argvals) < 2 or not isinstance(argvals[0], ArrVal):
+            return UNKNOWN
+        idx = argvals[1]
+        axis = self._axis_arg(node, default=None)
+        base = argvals[0].dims
+        if axis is None or not isinstance(idx, ArrVal) \
+                or not (-len(base) <= axis < len(base)):
+            return UNKNOWN
+        axis %= len(base)
+        return ArrVal(base[:axis] + idx.dims + base[axis + 1:])
+
+    def _take_along_dims(self, node: ast.Call, argvals: List[Val],
+                         kwvals: Dict[str, Val]) -> Val:
+        if len(argvals) < 2 or not isinstance(argvals[0], ArrVal) \
+                or not isinstance(argvals[1], ArrVal):
+            return UNKNOWN
+        x, idx = argvals[0].dims, argvals[1].dims
+        axis = self._axis_arg(node, default=None)
+        if axis is None or len(x) != len(idx) \
+                or not (-len(x) <= axis < len(x)):
+            return UNKNOWN
+        axis %= len(x)
+        out: List = []
+        for i, (a, b) in enumerate(zip(x, idx)):
+            if i == axis:
+                out.append(b)
+                continue
+            if a is not None and b is not None and a != b \
+                    and 1 not in (a, b) \
+                    and isinstance(a, str) and isinstance(b, str):
+                self._report(
+                    "conflict", node.lineno,
+                    f"take_along_axis requires equal non-axis dims "
+                    f"but axis {i} mixes `{a}` and `{b}`",
+                    key=f"{a}<>{b}:take_along_axis")
+            out.append(a if a is not None else b)
+        return ArrVal(tuple(out))
+
+    def _axis_arg(self, node: ast.Call, default) -> Optional[int]:
+        for kw in node.keywords:
+            if kw.arg == "axis":
+                got = _const_int(kw.value)
+                return got if got is not None else None
+        if len(node.args) >= 2:
+            # positional axis for the (x, axis) / (parts, axis) forms
+            got = _const_int(node.args[-1])
+            if got is not None:
+                return got
+        return default
+
+    def _eval_contract_call(self, node: ast.Call, target: AstContract,
+                            argvals: List[Val],
+                            kwvals: Dict[str, Val]) -> Val:
+        params = target.params
+        bound: Dict[str, Val] = {}
+        for i, v in enumerate(argvals):
+            if i < len(params):
+                bound[params[i]] = v
+        bound.update(kwvals)
+        all_known = True
+        for name, spec in target.args.items():
+            v = bound.get(name)
+            if v is None or v is UNKNOWN or isinstance(v, _SCALARISH):
+                all_known = False
+                continue
+            before = len(self.defects)
+            self._check_against_spec(
+                v, spec, node.lineno,
+                f"arg `{name}` of `{target.name}`", kind="cross")
+            if len(self.defects) > before:
+                all_known = False
+        if not all_known:
+            return UNKNOWN
+        if target.returns is None:
+            return UNKNOWN
+        return self._spec_val(target.returns)
+
+
+def _op_name(op: ast.operator) -> str:
+    return type(op).__name__.lower()
+
+
+def _const_int(node: ast.AST) -> Optional[int]:
+    """A literal int, including the UnaryOp form of negatives."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, int) \
+            and not isinstance(node.value, bool):
+        return node.value
+    if isinstance(node, ast.UnaryOp) and isinstance(node.op, ast.USub):
+        inner = _const_int(node.operand)
+        return -inner if inner is not None else None
+    return None

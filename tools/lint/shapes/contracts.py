@@ -1,0 +1,256 @@
+"""AST extraction of @shape_contract declarations and register_struct
+calls — the static tier's view of the runtime registry in
+koordinator_tpu/snapshot/schema.py, read without executing anything.
+
+Every spec in a contract is required to be a LITERAL (string / tuple of
+strings / dict of string literals); anything computed is a malformed
+declaration (SH005) because neither tier could trust it.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from tools.lint.astutil import dotted_name, param_names
+from tools.lint.framework import Project
+from tools.lint.callgraph import ModuleIndex, ProjectIndex, project_index
+from tools.lint.shapes.spec import (
+    DimProp,
+    Spec,
+    SpecError,
+    parse_spec,
+)
+
+_CONTRACT_TAIL = ".shape_contract"
+_STRUCT_TAIL = ".register_struct"
+
+
+@dataclass
+class AstContract:
+    """One @shape_contract declaration as the AST sees it."""
+
+    name: str
+    relpath: str
+    line: int
+    fn_node: ast.AST                       # the decorated FunctionDef
+    args: Dict[str, Spec] = field(default_factory=dict)
+    returns: Optional[Spec] = None
+    # static params bound to a dim symbol ("tail_chunk" -> "TC") or just
+    # known to exist (value None)
+    static: Dict[str, Optional[str]] = field(default_factory=dict)
+    callables: Tuple[str, ...] = ()
+
+    @property
+    def params(self) -> List[str]:
+        return param_names(self.fn_node)
+
+
+@dataclass
+class SpecProblem:
+    relpath: str
+    line: int
+    message: str
+    key: str
+
+
+@dataclass
+class ContractIndex:
+    """Project-wide contract/struct tables plus every malformed
+    declaration found on the way (the SH005 feed)."""
+
+    contracts: Dict[Tuple[str, str], AstContract] = field(
+        default_factory=dict)            # (relpath, fn name) -> contract
+    structs: Dict[str, Dict[str, Spec]] = field(default_factory=dict)
+    struct_sites: Dict[str, Tuple[str, int]] = field(default_factory=dict)
+    problems: List[SpecProblem] = field(default_factory=list)
+    # struct name re-registered with a different field table (SH003)
+    struct_drift: List[SpecProblem] = field(default_factory=list)
+
+    def contract_for(self, relpath: str,
+                     fn_name: str) -> Optional[AstContract]:
+        return self.contracts.get((relpath, fn_name))
+
+
+def _is_call_to(mi: ModuleIndex, call: ast.Call, tail: str) -> bool:
+    dotted = dotted_name(call.func)
+    if dotted is None:
+        return False
+    resolved = mi.resolve_dotted(dotted)
+    return resolved.endswith(tail) or resolved == tail.lstrip(".")
+
+
+def _literal_str(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def _literal_spec_value(node: ast.AST):
+    """String or (nested) tuple/list of strings -> the raw value
+    parse_spec accepts; None when the node is not a literal."""
+    s = _literal_str(node)
+    if s is not None:
+        return s
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for elt in node.elts:
+            v = _literal_spec_value(elt)
+            if v is None:
+                return None
+            out.append(v)
+        return tuple(out)
+    return None
+
+
+def extract_contracts(project: Project) -> ContractIndex:
+    """Walk every module for @shape_contract decorators and
+    register_struct calls."""
+    index = ContractIndex()
+    pidx: ProjectIndex = project_index(project)
+    for mi in pidx.modules.values():
+        rel = mi.module.relpath
+        for info in mi.functions:
+            for dec in info.node.decorator_list:
+                if isinstance(dec, ast.Call) \
+                        and _is_call_to(mi, dec, _CONTRACT_TAIL):
+                    c = _parse_contract(index, rel, info.node, dec)
+                    index.contracts[(rel, info.node.name)] = c
+        for node in ast.walk(mi.module.tree):
+            if isinstance(node, ast.Call) \
+                    and _is_call_to(mi, node, _STRUCT_TAIL):
+                _parse_struct(index, rel, node)
+    return index
+
+
+def _parse_contract(index: ContractIndex, rel: str, fn: ast.AST,
+                    dec: ast.Call) -> AstContract:
+    c = AstContract(name=fn.name, relpath=rel, line=dec.lineno,
+                    fn_node=fn)
+    params = set(c.params)
+    for kw in dec.keywords:
+        if kw.arg is None:
+            index.problems.append(SpecProblem(
+                rel, dec.lineno,
+                f"contract on `{fn.name}` uses **kwargs expansion; "
+                f"specs must be literal keywords",
+                key=f"{fn.name}:kwargs"))
+            continue
+        if kw.arg == "_returns":
+            raw = _literal_spec_value(kw.value)
+            if raw is None and not (isinstance(kw.value, ast.Constant)
+                                    and kw.value.value is None):
+                index.problems.append(SpecProblem(
+                    rel, kw.value.lineno,
+                    f"contract on `{fn.name}`: _returns must be a "
+                    f"literal spec string or tuple",
+                    key=f"{fn.name}:_returns"))
+                continue
+            c.returns = _try_parse(index, rel, kw.value.lineno, fn.name,
+                                   "_returns", raw)
+        elif kw.arg == "_static":
+            if not isinstance(kw.value, ast.Dict):
+                index.problems.append(SpecProblem(
+                    rel, kw.value.lineno,
+                    f"contract on `{fn.name}`: _static must be a "
+                    f"literal dict", key=f"{fn.name}:_static"))
+                continue
+            for k, v in zip(kw.value.keys, kw.value.values):
+                name = _literal_str(k) if k is not None else None
+                if name is None:
+                    continue
+                sval = _literal_str(v)
+                dim = None
+                if sval is not None:
+                    try:
+                        parsed = parse_spec(sval)
+                        if isinstance(parsed, DimProp):
+                            dim = parsed.dim
+                    except SpecError:
+                        index.problems.append(SpecProblem(
+                            rel, v.lineno,
+                            f"contract on `{fn.name}`: _static "
+                            f"[{name!r}] names no known dim symbol: "
+                            f"{sval!r}", key=f"{fn.name}:_static:{name}"))
+                c.static[name] = dim
+        elif kw.arg == "_callable":
+            if isinstance(kw.value, ast.Dict):
+                c.callables = tuple(
+                    _literal_str(k) for k in kw.value.keys
+                    if k is not None and _literal_str(k))
+        elif kw.arg == "_pad":
+            continue
+        else:
+            raw = _literal_spec_value(kw.value)
+            if raw is None:
+                index.problems.append(SpecProblem(
+                    rel, kw.value.lineno,
+                    f"contract on `{fn.name}`: spec for `{kw.arg}` is "
+                    f"not a literal string/tuple",
+                    key=f"{fn.name}:{kw.arg}:literal"))
+                continue
+            if kw.arg not in params:
+                index.problems.append(SpecProblem(
+                    rel, kw.value.lineno,
+                    f"contract on `{fn.name}` declares `{kw.arg}` "
+                    f"which is not a parameter of the function",
+                    key=f"{fn.name}:{kw.arg}:unknown-param"))
+                continue
+            parsed = _try_parse(index, rel, kw.value.lineno, fn.name,
+                                kw.arg, raw)
+            if parsed is not None:
+                c.args[kw.arg] = parsed
+    return c
+
+
+def _try_parse(index: ContractIndex, rel: str, line: int, fn_name: str,
+               arg: str, raw) -> Optional[Spec]:
+    if raw is None:
+        return None
+    try:
+        return parse_spec(raw)
+    except SpecError as exc:
+        index.problems.append(SpecProblem(
+            rel, line,
+            f"contract on `{fn_name}`: bad spec for `{arg}`: {exc}",
+            key=f"{fn_name}:{arg}:spec"))
+        return None
+
+
+def _parse_struct(index: ContractIndex, rel: str, call: ast.Call) -> None:
+    if len(call.args) < 2:
+        return
+    name_node, fields_node = call.args[0], call.args[1]
+    dotted = dotted_name(name_node)
+    name = dotted.rsplit(".", 1)[-1] if dotted else None
+    if name is None or not isinstance(fields_node, ast.Dict):
+        index.problems.append(SpecProblem(
+            rel, call.lineno,
+            "register_struct needs a class and a literal field dict",
+            key=f"register_struct:L{call.lineno}"))
+        return
+    fields: Dict[str, Spec] = {}
+    for k, v in zip(fields_node.keys, fields_node.values):
+        fname = _literal_str(k) if k is not None else None
+        raw = _literal_spec_value(v)
+        if fname is None or raw is None:
+            index.problems.append(SpecProblem(
+                rel, (v or call).lineno,
+                f"struct {name!r}: non-literal field spec",
+                key=f"{name}:field-literal"))
+            continue
+        parsed = _try_parse(index, rel, v.lineno, name, fname, raw)
+        if parsed is not None:
+            fields[fname] = parsed
+    prior = index.structs.get(name)
+    if prior is not None and prior != fields:
+        here, there = (rel, call.lineno), index.struct_sites[name]
+        index.struct_drift.append(SpecProblem(
+            rel, call.lineno,
+            f"struct {name!r} re-registered with a different field "
+            f"table (first at {there[0]}:{there[1]}) — one struct, one "
+            f"contract", key=f"{name}:re-register"))
+        return
+    index.structs[name] = fields
+    index.struct_sites[name] = (rel, call.lineno)

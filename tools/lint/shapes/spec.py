@@ -1,0 +1,218 @@
+"""The koordshape spec grammar and symbolic-shape algebra.
+
+A spec string is one of:
+  "f32[P,N]"    leaf array: dtype + named/fixed/int dims
+  "f32[]"       scalar array
+  "?f32[P,N]"   optional leaf (the value may be None)
+  "PodBatch"    reference to a registered struct (CapWord, has lowercase)
+  "N"           bare dim symbol: a symbolic-int PROPERTY of a struct
+
+Symbolic shapes are tuples whose entries are dim symbols (str), int
+literals, or None (statically unknown). The broadcast join implements
+numpy trailing alignment and reports two defect classes:
+  - distinct named symbols forced equal (the SH001 bug class)
+  - implicit rank growth between non-scalar operands (SH002)
+Unknown entries join silently — the interpreter never guesses.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass
+from typing import List, Optional, Tuple, Union
+
+# The named-dimension vocabulary. This is the linter's own copy (the
+# stdlib tier cannot import jax-importing schema.py);
+# tests/test_shape_contract.py pins it equal to schema.DIM_VOCAB.
+DIM_VOCAB = {
+    "P": "pending pods in the batch",
+    "N": "node columns (padded capacity)",
+    "I": "GPU instances per node",
+    "Z": "NUMA zones per node",
+    "G": "gangs (PodGroups)",
+    "Q": "elastic-quota tree nodes",
+    "V": "reservation slots",
+    "R": "resource dims (NUM_RESOURCES; padded like any capacity)",
+    "S": "distinct pod node-selectors",
+    "L": "node label-equivalence groups",
+    "T": "distinct pod toleration sets",
+    "TG": "node taint-equivalence groups",
+    "SG": "pod-topology-spread groups",
+    "AG": "inter-pod anti-affinity groups",
+    "FG": "inter-pod affinity groups",
+    "DM": "topology domains per constraint group",
+    "J": "aux (RDMA/FPGA) VF instances per pool",
+    "K": "delta rows per ingest tick",
+    "TC": "tail retry-chunk width",
+    "RD": "descheduler threshold resource dims",
+    "NS": "descheduler namespace rows (padded)",
+}
+
+# dims pinned to module constants (schema.FIXED_DIMS carries the values;
+# the static tier only needs the symbols)
+FIXED_DIM_SYMBOLS = ("AGG", "DEV", "AX", "QD")
+
+DTYPES = {
+    "f32": "float32",
+    "i32": "int32",
+    "i8": "int8",
+    "u32": "uint32",
+    "bool": "bool",
+}
+
+Dim = Union[str, int]           # a known dim: symbol or literal
+SymDim = Optional[Dim]          # None = statically unknown
+SymShape = Tuple[SymDim, ...]
+
+
+class SpecError(ValueError):
+    """A malformed contract spec (the SH005 bug class)."""
+
+
+@dataclass(frozen=True)
+class LeafSpec:
+    dtype: str                  # key of DTYPES
+    dims: Tuple[Dim, ...]
+    optional: bool = False
+
+
+@dataclass(frozen=True)
+class StructRef:
+    name: str
+
+
+@dataclass(frozen=True)
+class DimProp:
+    """A bare dim symbol: a symbolic-int struct property (num_nodes)."""
+
+    dim: str
+
+
+Spec = Union[LeafSpec, StructRef, DimProp, tuple]
+
+_LEAF_RE = re.compile(r"^(\?)?([a-z][a-z0-9]*)\[([^\[\]]*)\]$")
+_WORD_RE = re.compile(r"^[A-Za-z_][A-Za-z0-9_]*$")
+
+
+def known_dim(symbol: str) -> bool:
+    return symbol in DIM_VOCAB or symbol in FIXED_DIM_SYMBOLS
+
+
+def parse_spec(raw) -> Spec:
+    """Parse one spec value (a string, or a tuple/list of specs for
+    multi-output contracts). Raises SpecError on anything malformed."""
+    if isinstance(raw, (tuple, list)):
+        return tuple(parse_spec(r) for r in raw)
+    if not isinstance(raw, str):
+        raise SpecError(f"spec must be a string or tuple, got {raw!r}")
+    m = _LEAF_RE.match(raw)
+    if m:
+        optional, dtype, body = bool(m.group(1)), m.group(2), m.group(3)
+        if dtype not in DTYPES:
+            raise SpecError(f"unknown dtype {dtype!r} in {raw!r} "
+                            f"(expected one of {sorted(DTYPES)})")
+        dims: List[Dim] = []
+        body = body.strip()
+        for tok in (body.split(",") if body else []):
+            tok = tok.strip()
+            if not tok:
+                raise SpecError(f"empty dim in {raw!r}")
+            if tok.isdigit():
+                dims.append(int(tok))
+            elif known_dim(tok):
+                dims.append(tok)
+            else:
+                raise SpecError(f"undeclared dim symbol {tok!r} in "
+                                f"{raw!r} (vocabulary: "
+                                f"{sorted(DIM_VOCAB)} + "
+                                f"{sorted(FIXED_DIM_SYMBOLS)})")
+        return LeafSpec(dtype=dtype, dims=tuple(dims), optional=optional)
+    if not _WORD_RE.match(raw):
+        raise SpecError(f"malformed spec {raw!r}")
+    if known_dim(raw):
+        return DimProp(dim=raw)
+    if raw[0].isupper() and any(c.islower() for c in raw):
+        return StructRef(name=raw)
+    raise SpecError(f"undeclared dim symbol {raw!r} (a struct reference "
+                    f"needs CapWord form, a dim symbol must be in the "
+                    f"vocabulary)")
+
+
+def spec_shape(spec: Spec) -> Optional[SymShape]:
+    """The symbolic shape a leaf spec declares; None for non-leaves."""
+    if isinstance(spec, LeafSpec):
+        return tuple(spec.dims)
+    return None
+
+
+@dataclass
+class Join:
+    """Result of a broadcast join: the joined shape plus the defects the
+    join itself proves."""
+
+    dims: Optional[SymShape]
+    conflicts: List[Tuple[Dim, Dim]]        # distinct knowns forced equal
+    rank_growth: bool = False               # implicit non-scalar growth
+
+
+def broadcast_join(a: Optional[SymShape],
+                   b: Optional[SymShape]) -> Join:
+    """Numpy trailing-aligned broadcast of two symbolic shapes. Unknown
+    operands (None) poison the result silently; unknown ENTRIES join to
+    unknown entries without a conflict."""
+    if a is None or b is None:
+        return Join(dims=None, conflicts=[])
+    conflicts: List[Tuple[Dim, Dim]] = []
+    rank_growth = len(a) != len(b) and min(len(a), len(b)) >= 1
+    n = max(len(a), len(b))
+    out: List[SymDim] = []
+    for i in range(1, n + 1):
+        x = a[-i] if i <= len(a) else 1
+        y = b[-i] if i <= len(b) else 1
+        out.append(_join_dim(x, y, conflicts))
+    return Join(dims=tuple(reversed(out)), conflicts=conflicts,
+                rank_growth=rank_growth)
+
+
+def _join_dim(x: SymDim, y: SymDim,
+              conflicts: List[Tuple[Dim, Dim]]) -> SymDim:
+    if x is None or y is None:
+        return None
+    if x == y:
+        return x
+    if x == 1:
+        return y
+    if y == 1:
+        return x
+    if isinstance(x, str) and isinstance(y, str):
+        conflicts.append((x, y))
+        return None
+    if isinstance(x, int) and isinstance(y, int):
+        conflicts.append((x, y))
+        return None
+    # symbol vs int literal: statically undecidable (the symbol may be
+    # bound to exactly that size) — join to unknown, no conflict
+    return None
+
+
+def dims_compatible(declared: SymShape, got: SymShape
+                    ) -> List[Tuple[Dim, Dim]]:
+    """Positional (non-broadcast) comparison for contract boundaries:
+    argument passing and returns. Only KNOWN-vs-KNOWN disagreements
+    count; a rank mismatch between fully-known shapes is reported as a
+    pseudo-conflict on the rank."""
+    if len(declared) != len(got):
+        if all(d is not None for d in declared) \
+                and all(g is not None for g in got):
+            return [(f"rank {len(declared)}", f"rank {len(got)}")]
+        return []
+    out: List[Tuple[Dim, Dim]] = []
+    for d, g in zip(declared, got):
+        if d is None or g is None or d == g:
+            continue
+        if isinstance(d, str) and isinstance(g, str):
+            out.append((d, g))
+        elif isinstance(d, int) and isinstance(g, int):
+            out.append((d, g))
+        # symbol vs int: undecidable, skip
+    return out

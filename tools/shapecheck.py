@@ -1,0 +1,336 @@
+"""koordshape Tier B: the device-free jax.eval_shape CI gate.
+
+Imports the runtime contract registry
+(koordinator_tpu.snapshot.schema.SHAPE_CONTRACTS) and drives
+jax.eval_shape over EVERY registered contract with symbolic-sized
+ShapeDtypeStructs — abstract tracing only: no device, no XLA compile,
+seconds on CPU. Two distinct size assignments run so a kernel that
+accidentally couples two dims (uses N where the contract says P)
+produces an output-shape drift in at least one of them.
+
+Failure classes caught per contract:
+  - output-shape drift vs the declared dims (under both assignments)
+  - dtype promotion (declared f32 coming back f64/i32, bool masks
+    silently promoted by arithmetic)
+  - weak-type leaks (an output whose dtype still floats with context —
+    one python scalar away from a silent promotion + retrace)
+  - x64 upcasts (any 64-bit leaf anywhere in the output tree; the gate
+    also refuses to run with jax_enable_x64 on)
+
+`--self-test-mutation` proves the gate is live: it copies
+koordinator_tpu/ to a temp dir, flips the mask dtype of
+ops/feasibility.resource_fit (jnp.all -> jnp.sum: bool[P,N] becomes
+i32[P,N]), re-runs this script against the mutated copy, and fails
+unless the run FAILS. CI runs both stages (tools/ci.sh).
+"""
+
+from __future__ import annotations
+
+import argparse
+import functools
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+from typing import Dict, List, Optional
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+# appended (not prepended) so a mutated tree earlier on PYTHONPATH wins
+if REPO_ROOT not in sys.path:
+    sys.path.append(REPO_ROOT)
+
+from tools.lint.shapes.spec import (  # noqa: E402
+    DimProp,
+    LeafSpec,
+    Spec,
+    StructRef,
+    parse_spec,
+)
+
+# every module that registers contracts or structs; importing populates
+# the registry — keep in sync with new @shape_contract carriers
+CONTRACT_MODULES = (
+    "koordinator_tpu.snapshot.schema",
+    "koordinator_tpu.snapshot.delta",
+    "koordinator_tpu.ops.feasibility",
+    "koordinator_tpu.ops.waterfill",
+    "koordinator_tpu.ops.quota_demand",
+    "koordinator_tpu.scheduler.cascade",
+    "koordinator_tpu.scheduler.core",
+    "koordinator_tpu.scheduler.plugins.loadaware",
+    "koordinator_tpu.scheduler.plugins.deviceshare",
+    "koordinator_tpu.scheduler.plugins.numaaware",
+    "koordinator_tpu.descheduler.lownodeload_device",
+    "koordinator_tpu.slo_controller.noderesource",
+)
+
+# Two size assignments, each internally all-distinct, with the P/N
+# order FLIPPED between them so pod/node coupling cannot hide. R stays
+# NUM_RESOURCES in both (kernels index resource columns by ResourceKind
+# constants, so R is a fixed axis in practice). Constraints honored:
+# TC <= P (tail windows gather from the batch), Z small (the topology
+# manager builds a 2^Z mask table). Assignment B additionally avoids
+# every FIXED_DIMS value and NUM_RESOURCES, so coupling against a fixed
+# axis is caught there even where A's small values collide.
+ASSIGNMENT_A = {
+    "P": 21, "N": 5, "I": 2, "Z": 3, "G": 4, "Q": 6, "V": 7,
+    "S": 8, "L": 9, "T": 10, "TG": 12, "SG": 13, "AG": 14, "FG": 15,
+    "DM": 16, "J": 17, "K": 18, "TC": 19, "RD": 20, "NS": 22,
+}
+ASSIGNMENT_B = {
+    "P": 26, "N": 23, "I": 8, "Z": 4, "G": 7, "Q": 9, "V": 10,
+    "S": 13, "L": 14, "T": 15, "TG": 16, "SG": 17, "AG": 18, "FG": 19,
+    "DM": 21, "J": 24, "K": 25, "TC": 12, "RD": 27, "NS": 28,
+}
+
+_DTYPE_NAMES = {"f32": "float32", "i32": "int32", "i8": "int8",
+                "u32": "uint32", "bool": "bool"}
+_WIDE_DTYPES = ("float64", "int64", "uint64", "complex128")
+
+
+class ShapeCheckError(Exception):
+    pass
+
+
+def _sizes(assignment: Dict[str, int]):
+    from koordinator_tpu.api.extension import NUM_RESOURCES
+    from koordinator_tpu.snapshot.schema import FIXED_DIMS
+    out = dict(assignment)
+    out["R"] = NUM_RESOURCES
+    out.update(FIXED_DIMS)
+    return out
+
+
+def _resolve_dim(dim, sizes: Dict[str, int]) -> int:
+    if isinstance(dim, int):
+        return dim
+    if dim in sizes:
+        return sizes[dim]
+    raise ShapeCheckError(f"no size assigned to dim {dim!r}")
+
+
+def build_value(spec: Spec, sizes: Dict[str, int]):
+    """A spec -> an abstract input: ShapeDtypeStruct leaves, struct
+    instances for StructRefs (static fields keep their defaults)."""
+    import jax
+    import numpy as np
+    from koordinator_tpu.snapshot.schema import STRUCT_CLASSES, STRUCT_SPECS
+
+    if isinstance(spec, tuple):
+        return tuple(build_value(s, sizes) for s in spec)
+    if isinstance(spec, LeafSpec):
+        shape = tuple(_resolve_dim(d, sizes) for d in spec.dims)
+        return jax.ShapeDtypeStruct(shape,
+                                    np.dtype(_DTYPE_NAMES[spec.dtype]))
+    if isinstance(spec, StructRef):
+        cls = STRUCT_CLASSES.get(spec.name)
+        fields = STRUCT_SPECS.get(spec.name)
+        if cls is None or fields is None:
+            raise ShapeCheckError(f"unregistered struct {spec.name!r}")
+        kwargs = {}
+        for fname, raw in fields.items():
+            fspec = parse_spec(raw)
+            if isinstance(fspec, DimProp):
+                continue  # symbolic-int property, not a field
+            kwargs[fname] = build_value(fspec, sizes)
+        return cls(**kwargs)
+    raise ShapeCheckError(f"cannot build a value for spec {spec!r}")
+
+
+def check_output(spec: Spec, got, sizes: Dict[str, int],
+                 where: str, errors: List[str]) -> None:
+    from koordinator_tpu.snapshot.schema import STRUCT_CLASSES, STRUCT_SPECS
+
+    if spec is None:
+        return
+    if isinstance(spec, tuple):
+        if not isinstance(got, (tuple, list)) or len(got) != len(spec):
+            errors.append(f"{where}: expected a {len(spec)}-tuple, got "
+                          f"{type(got).__name__}")
+            return
+        for i, (s, g) in enumerate(zip(spec, got)):
+            check_output(s, g, sizes, f"{where}[{i}]", errors)
+        return
+    if isinstance(spec, LeafSpec):
+        if got is None:
+            if not spec.optional:
+                errors.append(f"{where}: None where the contract "
+                              f"requires a value")
+            return
+        shape = getattr(got, "shape", None)
+        dtype = getattr(got, "dtype", None)
+        if shape is None or dtype is None:
+            errors.append(f"{where}: expected an array, got {got!r}")
+            return
+        want_shape = tuple(_resolve_dim(d, sizes) for d in spec.dims)
+        if tuple(shape) != want_shape:
+            decl = ",".join(str(d) for d in spec.dims)
+            errors.append(
+                f"{where}: shape drift — declared [{decl}] = "
+                f"{want_shape} under this assignment, got "
+                f"{tuple(shape)} (dim coupling or a mis-broadcast)")
+        want_dtype = _DTYPE_NAMES[spec.dtype]
+        if str(dtype) != want_dtype:
+            errors.append(f"{where}: dtype drift — declared "
+                          f"{want_dtype}, got {dtype} (promotion?)")
+        if getattr(got, "weak_type", False):
+            errors.append(f"{where}: weak-type leak — the output dtype "
+                          f"still floats with context; anchor it with "
+                          f"an explicit dtype")
+        return
+    if isinstance(spec, StructRef):
+        cls = STRUCT_CLASSES.get(spec.name)
+        fields = STRUCT_SPECS.get(spec.name, {})
+        if cls is not None and not isinstance(got, cls):
+            errors.append(f"{where}: expected {spec.name}, got "
+                          f"{type(got).__name__}")
+            return
+        for fname, raw in fields.items():
+            fspec = parse_spec(raw)
+            if isinstance(fspec, DimProp):
+                continue
+            check_output(fspec, getattr(got, fname, None), sizes,
+                         f"{where}.{fname}", errors)
+        return
+    errors.append(f"{where}: unhandled spec {spec!r}")
+
+
+def _scan_wide_leaves(out, where: str, errors: List[str]) -> None:
+    import jax
+    for path, leaf in jax.tree_util.tree_flatten_with_path(out)[0]:
+        dtype = getattr(leaf, "dtype", None)
+        if dtype is not None and str(dtype) in _WIDE_DTYPES:
+            errors.append(f"{where}{jax.tree_util.keystr(path)}: "
+                          f"64-bit leaf ({dtype}) — x64 upcast")
+
+
+def run_contract(contract, sizes: Dict[str, int],
+                 label: str) -> List[str]:
+    import jax
+    from koordinator_tpu.snapshot.schema import SHAPE_CONTRACTS
+
+    errors: List[str] = []
+    kwargs = {}
+    static_kwargs = {}
+    for name, raw in contract.args.items():
+        kwargs[name] = build_value(parse_spec(raw), sizes)
+    for name, value in contract.static.items():
+        if isinstance(value, str) and value in sizes:
+            value = sizes[value]
+        static_kwargs[name] = value
+    for name, dotted in contract.callables.items():
+        target = SHAPE_CONTRACTS.get(dotted)
+        if target is None:
+            return [f"{label}: _callable {name!r} names unregistered "
+                    f"contract {dotted!r}"]
+        static_kwargs[name] = target.fn
+    fn = functools.partial(contract.fn, **static_kwargs) \
+        if static_kwargs else contract.fn
+    try:
+        out = jax.eval_shape(fn, **kwargs)
+    except Exception as exc:  # noqa: BLE001 — any trace failure fails CI
+        return [f"{label}: eval_shape raised "
+                f"{type(exc).__name__}: {exc}"]
+    spec = parse_spec(contract.returns) \
+        if contract.returns is not None else None
+    check_output(spec, out, sizes, label, errors)
+    _scan_wide_leaves(out, label, errors)
+    return errors
+
+
+def run_all(verbose: bool = False) -> int:
+    import importlib
+
+    import jax
+    if jax.config.jax_enable_x64:
+        print("shapecheck: refusing to run with jax_enable_x64 — the "
+              "contracts pin 32-bit layouts", file=sys.stderr)
+        return 2
+    for mod in CONTRACT_MODULES:
+        importlib.import_module(mod)
+    from koordinator_tpu.snapshot.schema import SHAPE_CONTRACTS
+
+    failures = 0
+    for key in sorted(SHAPE_CONTRACTS):
+        contract = SHAPE_CONTRACTS[key]
+        errs: List[str] = []
+        for tag, assignment in (("A", ASSIGNMENT_A),
+                                ("B", ASSIGNMENT_B)):
+            errs.extend(run_contract(contract, _sizes(assignment),
+                                     f"{key}[{tag}]"))
+        if errs:
+            failures += 1
+            for e in errs:
+                print(f"FAIL {e}")
+        elif verbose:
+            print(f"ok   {key}")
+    total = len(SHAPE_CONTRACTS)
+    print(f"shapecheck: {total - failures}/{total} contracts clean "
+          f"under 2 assignments")
+    return 1 if failures else 0
+
+
+# --- the seeded-mutation smoke (gate liveness proof) -----------------------
+
+_MUTATION_FILE = os.path.join("koordinator_tpu", "ops", "feasibility.py")
+_MUTATION_FROM = "return jnp.all("
+_MUTATION_TO = "return jnp.sum("
+
+
+def self_test_mutation() -> int:
+    """Flip resource_fit's mask dtype in a TEMP COPY of the package and
+    assert the gate fails on it. Leaves the working tree untouched."""
+    with tempfile.TemporaryDirectory(prefix="shapecheck-mut-") as td:
+        shutil.copytree(os.path.join(REPO_ROOT, "koordinator_tpu"),
+                        os.path.join(td, "koordinator_tpu"))
+        target = os.path.join(td, _MUTATION_FILE)
+        with open(target, encoding="utf-8") as f:
+            src = f.read()
+        if _MUTATION_FROM not in src:
+            print(f"mutation smoke: anchor {_MUTATION_FROM!r} missing "
+                  f"from {_MUTATION_FILE}", file=sys.stderr)
+            return 2
+        with open(target, "w", encoding="utf-8") as f:
+            f.write(src.replace(_MUTATION_FROM, _MUTATION_TO, 1))
+        env = dict(os.environ)
+        env["PYTHONPATH"] = os.pathsep.join(
+            [td, REPO_ROOT] + ([env["PYTHONPATH"]]
+                               if env.get("PYTHONPATH") else []))
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__)],
+            capture_output=True, text=True, env=env, timeout=1200)
+    if proc.returncode == 0:
+        print("mutation smoke: the gate PASSED a flipped dtype — "
+              "shapecheck is not protecting anything", file=sys.stderr)
+        print(proc.stdout, file=sys.stderr)
+        return 1
+    if "dtype drift" not in proc.stdout:
+        print("mutation smoke: the gate failed for the wrong reason:",
+              file=sys.stderr)
+        print(proc.stdout + proc.stderr, file=sys.stderr)
+        return 1
+    print("mutation smoke: flipped dtype in ops/feasibility.py "
+          "correctly failed shapecheck (gate is live)")
+    return 0
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python tools/shapecheck.py",
+        description="koordshape Tier B: device-free eval_shape gate "
+                    "over the kernel contract registry")
+    parser.add_argument("--self-test-mutation", action="store_true",
+                        help="prove the gate live: flip one dtype in a "
+                             "temp copy and assert the run fails")
+    parser.add_argument("-v", "--verbose", action="store_true")
+    args = parser.parse_args(argv)
+    if args.self_test_mutation:
+        return self_test_mutation()
+    return run_all(verbose=args.verbose)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
