@@ -36,6 +36,20 @@ regardless of straggler count. BENCH_TAIL_MODE=host keeps the previous
 host-driven orchestration (one straggler-count readback per adaptive
 decision) as the conformance oracle for A/B runs; every emitted line
 records `cascade` and `tail_mode` so runs are self-describing.
+
+Multichip flagship (promoted from the __graft_entry__ dryrun): with >1
+visible device the node axis of the snapshot is sharded over the mesh
+and the SAME chunked sweep + device tail runs under GSPMD — stage-1
+masks stay shard-local, the top-k select merges per-shard candidates
+over ICI, and the tail keeps its single packed stats readback.
+BENCH_DEVICES=n pins the device count (the virtual CPU mesh in CI, a
+slice on hardware); BENCH_MESH_PODS=m folds the devices into a 2D
+pods x nodes mesh (parallel/mesh.py). Node counts indivisible by the
+mesh are padded with provably-unschedulable zero-capacity rows
+(parallel.pad_nodes_to_mesh), and multi-device lines additionally stamp
+the mesh axis sizes. Placements are bit-identical to the single-device
+program (exact top-k path) — tools/mesh_flagship_smoke.py and the slow
+mesh conformance test pin it, placement-for-placement.
 """
 
 import functools
@@ -215,22 +229,65 @@ def run_northstar(full_gate: bool = False, num_pods: int = None,
         step_kw = dict(enable_numa=False)
     cfg = LoadAwareConfig.make()
 
-    # the queue as [C, CHUNK, ...] per-pod columns (scan operand)
-    stacked = synthetic.stack_pod_chunks(pods, chunk)
-
+    # --- device / mesh selection (the multichip flagship path) -----------
+    # BENCH_DEVICES=n runs on the first n visible devices (the virtual
+    # CPU mesh in CI, a real slice on hardware); unset = all visible.
+    # BENCH_MESH_PODS=m folds the devices into a 2D (pods x nodes) mesh.
     devices = jax.devices()
+    ndev_env = (os.environ.get("BENCH_DEVICES") or "").strip()
+    if ndev_env:
+        ndev = int(ndev_env)
+        if not 1 <= ndev <= len(devices):
+            raise SystemExit(f"BENCH_DEVICES={ndev} but "
+                             f"{len(devices)} devices are visible")
+        devices = devices[:ndev]
+    mesh_pods = int((os.environ.get("BENCH_MESH_PODS") or "1").strip())
+    mesh = None
     if len(devices) > 1:
         # multi-chip: node columns sharded over the mesh (ICI); the pod
-        # queue and quota/gang state replicate. GSPMD turns the top-k
-        # select into a shard-local reduce + cross-chip merge.
-        mesh = meshlib.make_mesh(devices)
+        # queue and quota/gang state replicate on the 1D node mesh and
+        # shard over the pods axis on the 2D one. GSPMD turns the top-k
+        # select into a shard-local reduce + cross-chip merge, and the
+        # cascade's stage-1 mask stays shard-local (zero collectives —
+        # tools/mesh_flagship_smoke.py pins that on the compiled HLO).
+        mesh = meshlib.make_mesh(devices, pods_axis=mesh_pods)
+        if mesh_pods > 1 and (num_pods % mesh_pods or chunk % mesh_pods):
+            raise SystemExit(f"BENCH_MESH_PODS={mesh_pods} must divide "
+                             f"both BENCH_PODS={num_pods} and the chunk "
+                             f"{chunk}")
+        # node counts indivisible by the mesh get zero-capacity pad rows
+        # (provably unschedulable; excluded from the overcommit checks)
+        n_pad = meshlib.padded_node_count(num_nodes, mesh)
         repl = jax.sharding.NamedSharding(
             mesh, jax.sharding.PartitionSpec())
-        put_snap = functools.partial(meshlib.shard_snapshot, mesh=mesh)
+        snap_shardings = meshlib.snapshot_sharding(mesh)
+
+        def put_snap(s):
+            return meshlib.shard_snapshot(
+                meshlib.pad_nodes_to_mesh(s, mesh), mesh)
+
         put_repl = functools.partial(jax.device_put, device=repl)
+        if mesh_pods > 1:
+            put_batch = functools.partial(meshlib.shard_batch, mesh=mesh)
+            put_stacked = functools.partial(
+                jax.device_put,
+                device=jax.sharding.NamedSharding(
+                    mesh, jax.sharding.PartitionSpec(
+                        None, meshlib.POD_AXIS)))
+        else:
+            put_batch = put_repl
+            put_stacked = put_repl
+        # the batch's node-indexed domain matrices follow the padded
+        # snapshot (pad columns are -1 = "node lacks the key")
+        pods = meshlib.pad_batch_nodes(pods, n_pad)
     else:
         put_snap = jax.device_put
         put_repl = jax.device_put
+        put_batch = jax.device_put
+        put_stacked = jax.device_put
+
+    # the queue as [C, CHUNK, ...] per-pod columns (scan operand)
+    stacked = synthetic.stack_pod_chunks(pods, chunk)
 
     def checked_snap(seed):
         """Build a snapshot and enforce the numa_prefix contract on THE
@@ -245,8 +302,8 @@ def run_northstar(full_gate: bool = False, num_pods: int = None,
         return snap_host
 
     snap0 = put_snap(checked_snap(0))
-    stacked = put_repl(stacked)
-    pods_dev = put_repl(pods)
+    stacked = put_stacked(stacked)
+    pods_dev = put_batch(pods)
     cfg = put_repl(cfg)
     counts0 = put_repl(tuple(getattr(pods, f) for f in core.COUNT_FIELDS))
 
@@ -342,11 +399,31 @@ def run_northstar(full_gate: bool = False, num_pods: int = None,
                                               stacked)
         return snap, counts, assign.reshape(-1)
 
-    @functools.partial(jax.jit, donate_argnums=(0, 1))
+    # on a mesh the jitted programs pin their output placements (the
+    # carried snapshot stays node-sharded across chunks/passes instead
+    # of wherever GSPMD's cost model lands it; donation then aliases
+    # shard-for-shard): (snap, counts, assign[, stats/tried]) outputs
+    if mesh is not None:
+        counts_sh = tuple(repl for _ in core.COUNT_FIELDS)
+        sweep_jit = functools.partial(
+            jax.jit, donate_argnums=(0, 1),
+            out_shardings=(snap_shardings, counts_sh, repl))
+        tail4_out = (snap_shardings, counts_sh, repl, repl)
+        sweep_tail_jit = functools.partial(
+            jax.jit, donate_argnums=(0, 1), out_shardings=tail4_out)
+        tail_pass_jit = functools.partial(
+            jax.jit, donate_argnums=(0, 1, 2, 3), out_shardings=tail4_out)
+    else:
+        sweep_jit = functools.partial(jax.jit, donate_argnums=(0, 1))
+        sweep_tail_jit = sweep_jit
+        tail_pass_jit = functools.partial(jax.jit,
+                                          donate_argnums=(0, 1, 2, 3))
+
+    @sweep_jit
     def sweep(snap, counts, stacked, pods_dev, cfg):
         return run_sweep(snap, counts, stacked, pods_dev, cfg)
 
-    @functools.partial(jax.jit, donate_argnums=(0, 1))
+    @sweep_tail_jit
     def sweep_and_tail(snap, counts, stacked, pods_dev, cfg):
         """tail_mode=device: sweep + the adaptive tail compaction loop
         (core.tail_compaction_loop, a lax.while_loop over compacted
@@ -361,7 +438,7 @@ def run_northstar(full_gate: bool = False, num_pods: int = None,
             max_passes=max_tail, charge_counts=full_gate,
             topo_prefix=topo_prefix, topo_mask=topo_mask)
 
-    @functools.partial(jax.jit, donate_argnums=(0, 1, 2, 3))
+    @tail_pass_jit
     def tail_pass(snap, counts, assign, tried, pods_dev, cfg):
         """tail_mode=host: one retry pass (core.tail_pass — the same
         gather/compact/retry/scatter program the device loop runs, so
@@ -495,11 +572,26 @@ def run_northstar(full_gate: bool = False, num_pods: int = None,
         # self-describing without consulting the code's defaults
         "cascade": cascade_on,
         "tail_mode": tail_mode,
-        "devices": len(jax.devices()),
-        "platform": jax.devices()[0].platform,
+        "devices": len(devices),
+        # the mesh stamp makes a 4-device line self-describing (1x4 vs
+        # 2x2); absent on single-device lines so trajectories stay
+        # byte-comparable with earlier rounds
+        **({"mesh": meshlib.mesh_axis_sizes(mesh)}
+           if mesh is not None else {}),
+        "platform": devices[0].platform,
         **host_fields(),
     }
     print(json.dumps(result))
+    # non-serialized conformance surfaces (tests + the CI mesh smoke
+    # compare sharded placements against the single-device oracle and
+    # check the overcommit invariant on the real rows): attached AFTER
+    # the line is emitted so the artifact stays line-parseable
+    result["arrays"] = {
+        "assignment": assign,
+        "requested": np.asarray(snap.nodes.requested),
+        "allocatable": np.asarray(snap.nodes.allocatable),
+        "num_nodes": num_nodes,
+    }
     return result
 
 

@@ -4,11 +4,26 @@ The reference scales Filter/Score with chunked goroutines over nodes
 (k8s Parallelizer, SURVEY.md 2.9); the TPU-native analogue is sharding the
 node dimension of every [N, ...] column across chips so each chip
 filters/scores a node shard and the top-k select rides ICI collectives.
+`mesh` owns the mesh shapes, spec-derived shardings and node-axis
+padding; `shardops` the explicit shard_map kernels (shard-local stage-1,
+per-shard top-k + ICI merge) for stages composed outside one jitted
+program.
 """
 
 from koordinator_tpu.parallel.mesh import (  # noqa: F401
+    NODE_AXIS,
+    POD_AXIS,
+    batch_sharding,
     candidate_mask_sharding,
     make_mesh,
-    snapshot_sharding,
+    mesh_axis_sizes,
+    node_shards,
+    pad_batch_nodes,
+    pad_nodes_to_mesh,
+    padded_node_count,
+    shard_batch,
     shard_snapshot,
+    snapshot_sharding,
+    struct_sharding,
 )
+from koordinator_tpu.parallel import shardops  # noqa: F401

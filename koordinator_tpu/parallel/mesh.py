@@ -3,20 +3,41 @@
 Design (scaling-book recipe): pick a mesh, annotate shardings, let XLA
 insert collectives.
 
-- 1D mesh over axis "nodes": every per-node column ([N, ...]) is sharded on
-  dim 0; pod batches, quota/gang state, and config are replicated. The
-  [P, N] score matrix is then computed shard-locally ([P, N/dev] per chip);
-  jax.lax.top_k over the sharded axis makes XLA emit an all-gather of the
-  per-shard top-k candidates over ICI (the global "selectHost" reduce);
-  scatter-commits to node columns land shard-locally.
+- 1D mesh over axis "nodes" (the default): every per-node column
+  ([N, ...]) is sharded on dim 0; pod batches, quota/gang state, and
+  config are replicated. The [P, N] score matrix is then computed
+  shard-locally ([P, N/dev] per chip); jax.lax.top_k over the sharded
+  axis makes XLA emit an all-gather of the per-shard top-k candidates
+  over ICI (the global "selectHost" reduce); scatter-commits to node
+  columns land shard-locally.
+- 2D mesh over ("pods", "nodes") (`make_mesh(devices, pods_axis=m)`):
+  the pod queue's [P, ...] columns additionally shard over the pods
+  axis, so the [P, N] intermediates tile over BOTH axes — the option
+  for meshes big enough that node-axis sharding alone leaves chips
+  idle. `batch_sharding`/`shard_batch` place a PodBatch accordingly.
 - The equivalent of sequence/context parallelism for this workload is
   exactly this node-axis sharding (SURVEY.md 5 "long-context"): the scaling
   axis is cluster size, and the collective pattern (shard-local reduce +
   cross-chip top-k merge) mirrors ring-attention's shard-local softmax +
   global combine.
 
-No shard_map is needed: `scheduler.core.schedule_batch` is pure jit, so
-annotating the snapshot's placement is enough (GSPMD propagates).
+Inside `scheduler.core.schedule_batch` (pure jit) annotating the
+operand placements is enough — GSPMD propagates the node sharding
+through every [.., N] intermediate, computes the cascade's stage-1 mask
+shard-locally (zero collectives; tools/mesh_flagship_smoke.py pins that
+structurally on the compiled HLO) and emits the ICI top-k merge for
+lax.top_k. For stages composed OUTSIDE one jitted program — where GSPMD
+propagation has nothing to propagate through — the explicit shard_map
+kernels live in `parallel.shardops` (shard-local stage-1, per-shard
+top-k + ICI merge with exact tie semantics).
+
+Sharding specs are DERIVED from the koordshape `register_struct`
+field-spec tables (snapshot/schema.py): a leaf whose declared spec
+carries the node symbol `N` shards that axis over "nodes", a [P]-leading
+pod column shards over "pods" when the mesh has that axis, everything
+else replicates. Adding a snapshot field therefore cannot silently get
+the wrong placement — the same table that feeds the shape checkers
+feeds the mesh layout.
 """
 
 from __future__ import annotations
@@ -27,15 +48,121 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from koordinator_tpu.snapshot.schema import ClusterSnapshot
+from koordinator_tpu.snapshot.schema import (
+    ClusterSnapshot,
+    PodBatch,
+    STRUCT_CLASSES,
+    STRUCT_SPECS,
+)
 
 NODE_AXIS = "nodes"
+POD_AXIS = "pods"
 
 
-def make_mesh(devices: Optional[list] = None) -> Mesh:
-    """1D mesh over all (or the given) devices on the node axis."""
+def make_mesh(devices: Optional[list] = None, pods_axis: int = 1) -> Mesh:
+    """Mesh over all (or the given) devices: 1D on the node axis by
+    default; `pods_axis > 1` folds the devices into a 2D
+    (pods, nodes) grid (pods_axis must divide the device count)."""
     devices = jax.devices() if devices is None else devices
-    return Mesh(np.asarray(devices), (NODE_AXIS,))
+    if pods_axis <= 1:
+        return Mesh(np.asarray(devices), (NODE_AXIS,))
+    if len(devices) % pods_axis:
+        raise ValueError(f"pods_axis={pods_axis} must divide the device "
+                         f"count {len(devices)}")
+    grid = np.asarray(devices).reshape(pods_axis,
+                                       len(devices) // pods_axis)
+    return Mesh(grid, (POD_AXIS, NODE_AXIS))
+
+
+def mesh_axis_sizes(mesh: Mesh) -> dict:
+    """{axis name: size} — the self-describing mesh stamp bench lines
+    carry (a 4-device line must say whether it was 1x4 or 2x2)."""
+    return {name: int(size)
+            for name, size in zip(mesh.axis_names, mesh.devices.shape)}
+
+
+def node_shards(mesh: Mesh) -> int:
+    return int(mesh.shape[NODE_AXIS])
+
+
+# --- spec-derived sharding trees ----------------------------------------
+
+def _leaf_dims(spec) -> Optional[tuple]:
+    """Dim-symbol tuple of a leaf spec string ("f32[N,R]" -> ("N", "R"));
+    None for struct references and bare-symbol properties."""
+    if not isinstance(spec, str) or "[" not in spec:
+        return None
+    body = spec[spec.index("[") + 1:spec.rindex("]")].strip()
+    return tuple(t.strip() for t in body.split(",")) if body else ()
+
+
+def _leaf_partition(dims: tuple, mesh: Mesh, shard_pods: bool) -> P:
+    """PartitionSpec for one leaf: any `N` axis shards over the node
+    axis; a LEADING `P` shards over the pods axis when asked for and
+    the mesh has one; everything else replicates."""
+    axes = []
+    for i, d in enumerate(dims):
+        if d == "N":
+            axes.append(NODE_AXIS)
+        elif (d == "P" and i == 0 and shard_pods
+              and POD_AXIS in mesh.axis_names):
+            axes.append(POD_AXIS)
+        else:
+            axes.append(None)
+    while axes and axes[-1] is None:  # P(None) is not P()
+        axes.pop()
+    return P(*axes)
+
+
+def struct_sharding(name: str, mesh: Mesh, shard_pods: bool = False):
+    """Build a struct-shaped pytree of NamedShardings from the
+    registered field-spec table (bare-symbol properties are skipped;
+    nested registered structs recurse). Works for ANY registered
+    struct whose defining module is imported — e.g.
+    struct_sharding("ScheduleResult", mesh) derives the out_shardings
+    of a sharded schedule step."""
+    fields = {}
+    for fname, spec in STRUCT_SPECS[name].items():
+        if isinstance(spec, str) and spec in STRUCT_SPECS:
+            fields[fname] = struct_sharding(spec, mesh, shard_pods)
+            continue
+        dims = _leaf_dims(spec)
+        if dims is None:
+            continue  # symbolic-int property (num_nodes), not a field
+        fields[fname] = NamedSharding(
+            mesh, _leaf_partition(dims, mesh, shard_pods))
+    return STRUCT_CLASSES[name](**fields)
+
+
+def snapshot_sharding(mesh: Mesh) -> ClusterSnapshot:
+    """A ClusterSnapshot-shaped pytree of NamedShardings, derived from
+    the koordshape field-spec tables: node columns ([N, ...] leaves in
+    nodes.*/devices.*) shard dim 0, everything else replicates."""
+    return struct_sharding("ClusterSnapshot", mesh)
+
+
+def batch_sharding(pods: PodBatch, mesh: Mesh) -> PodBatch:
+    """A PodBatch-shaped pytree of NamedShardings for the 2D mesh path:
+    per-pod [P, ...] columns shard over the pods axis (when the mesh
+    has one), the batch-global [*, N] domain matrices shard their node
+    axis, count surfaces and selector/toleration tables replicate.
+    Built by `replace` on `pods` so the static gate switches
+    (has_taints & co, pytree aux data) match the batch being placed."""
+    upd = {}
+    for fname, spec in STRUCT_SPECS["PodBatch"].items():
+        dims = _leaf_dims(spec)
+        if dims is None:
+            continue
+        part = _leaf_partition(dims, mesh, shard_pods=True)
+        # degenerate compile-out extents (the [1, 1] domain matrices of
+        # slim workloads) and any axis the mesh doesn't divide replicate
+        shape = getattr(pods, fname).shape
+        part = P(*(ax if ax is not None
+                   and shape[i] % mesh.shape[ax] == 0 and shape[i] > 1
+                   else None
+                   for i, ax in enumerate(part)))
+        upd[fname] = NamedSharding(mesh, part)
+    return pods.replace(**upd)
 
 
 def candidate_mask_sharding(mesh: Mesh) -> NamedSharding:
@@ -49,40 +176,113 @@ def candidate_mask_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P(None, NODE_AXIS))
 
 
-def snapshot_sharding(mesh: Mesh) -> ClusterSnapshot:
-    """A ClusterSnapshot-shaped pytree of NamedShardings: node columns
-    sharded on dim 0, everything else replicated."""
-    node_spec = NamedSharding(mesh, P(NODE_AXIS))
-    repl = NamedSharding(mesh, P())
-
-    def node_field(_):
-        return node_spec
-
-    # nodes.* / devices.* are all [N, ...] -> shard dim 0; other groups
-    # replicate
-    from koordinator_tpu.snapshot.schema import (
-        DeviceState, GangState, NodeState, QuotaState, ReservationState,
-    )
-    nodes = jax.tree_util.tree_map(node_field,
-                                   NodeState(*([0] * len(NodeState.__dataclass_fields__))))
-    quotas = jax.tree_util.tree_map(lambda _: repl,
-                                    QuotaState(*([0] * len(QuotaState.__dataclass_fields__))))
-    gangs = jax.tree_util.tree_map(lambda _: repl,
-                                   GangState(*([0] * len(GangState.__dataclass_fields__))))
-    res = jax.tree_util.tree_map(lambda _: repl,
-                                 ReservationState(*([0] * len(ReservationState.__dataclass_fields__))))
-    devs = jax.tree_util.tree_map(node_field,
-                                  DeviceState(*([0] * len(DeviceState.__dataclass_fields__))))
-    return ClusterSnapshot(nodes=nodes, quotas=quotas, gangs=gangs,
-                           reservations=res, devices=devs, version=repl)
-
-
 def shard_snapshot(snap: ClusterSnapshot, mesh: Mesh) -> ClusterSnapshot:
     """Place a host snapshot onto the mesh (node axis sharded over ICI).
 
-    The node count must be divisible by the mesh size (pad capacities
-    accordingly; SnapshotBuilder's max_nodes is the padded size).
+    The node count must be divisible by the mesh's node-axis size —
+    run the snapshot through `pad_nodes_to_mesh` first when it isn't
+    (SnapshotBuilder's max_nodes is the padded size on the typed path).
     """
     shardings = snapshot_sharding(mesh)
     return jax.tree_util.tree_map(
         lambda x, s: jax.device_put(x, s), snap, shardings)
+
+
+def shard_batch(pods: PodBatch, mesh: Mesh) -> PodBatch:
+    """Place a pod batch onto the mesh per `batch_sharding` (the 2D
+    mesh path; on a 1D node mesh it replicates per-pod columns and
+    shards only the [*, N] domain matrices)."""
+    return jax.tree_util.tree_map(
+        lambda x, s: jax.device_put(x, s), pods, batch_sharding(pods, mesh))
+
+
+# --- node-axis padding ---------------------------------------------------
+
+# pad values that are NOT plain zero: amplification is a ratio (pad rows
+# are never chosen, but 1.0 keeps the column semantically well-formed),
+# instance topology uses -1 = unknown
+_SNAP_PAD_FILLS = {"cpu_amplification": 1.0, "gpu_numa": -1, "gpu_pcie": -1}
+# a -1 domain entry means "node lacks the topology key": hard spread
+# groups reject such nodes and no anti/affinity pair can exist there
+_BATCH_PAD_FILLS = {"spread_domain": -1, "anti_domain": -1,
+                    "aff_domain": -1}
+
+
+def padded_node_count(num_nodes: int, mesh: Mesh) -> int:
+    """The node-axis size after padding to a multiple of the mesh's
+    node-axis extent."""
+    size = node_shards(mesh)
+    return -(-num_nodes // size) * size
+
+
+def _pad_leaf(x, dims: tuple, n_old: int, n_new: int, fill):
+    """Pad every axis whose declared symbol is N (and whose runtime
+    extent actually is the node count — degenerate [1, 1] compile-out
+    matrices stay put) from n_old to n_new with `fill`."""
+    for axis, d in enumerate(dims):
+        if d != "N" or x.shape[axis] != n_old:
+            continue
+        lib = np if isinstance(x, np.ndarray) else jax.numpy
+        shape = x.shape[:axis] + (n_new - n_old,) + x.shape[axis + 1:]
+        x = lib.concatenate(
+            [x, lib.full(shape, fill, dtype=x.dtype)], axis=axis)
+    return x
+
+
+def _pad_struct(obj, name: str, n_old: int, n_new: int, fills: dict):
+    upd = {}
+    for fname, spec in STRUCT_SPECS[name].items():
+        if isinstance(spec, str) and spec in STRUCT_SPECS:
+            upd[fname] = _pad_struct(getattr(obj, fname), spec,
+                                     n_old, n_new, fills)
+            continue
+        dims = _leaf_dims(spec)
+        if dims is None or "N" not in dims:
+            continue
+        upd[fname] = _pad_leaf(getattr(obj, fname), dims, n_old, n_new,
+                               fills.get(fname, 0))
+    return obj.replace(**upd)
+
+
+def pad_nodes_to_mesh(snap: ClusterSnapshot, mesh: Mesh) -> ClusterSnapshot:
+    """Pad the snapshot's node axis to a multiple of the mesh's
+    node-axis size with zero-capacity rows, so callers never hand-pad
+    before `shard_snapshot`. Derived from the same field-spec tables as
+    the shardings (every leaf with an `N` axis pads; numpy inputs stay
+    on host).
+
+    PAD-ROW CONTRACT: pad rows are PROVABLY unschedulable — schedulable
+    is False (the static gates zero their columns, so the cascade's
+    stage-1 mask kills them before any score is computed) and
+    allocatable is zero (the resource-fit gate rejects them
+    independently). They therefore can never be charged: `requested`
+    stays zero and the overcommit invariant is checked on the real rows
+    only (`core.overcommit_ok(snap, num_real_nodes)` — pad rows are
+    excluded by construction, not by tolerance).
+    """
+    n_old = snap.num_nodes
+    n_new = padded_node_count(n_old, mesh)
+    if n_new == n_old:
+        return snap
+    return _pad_struct(snap, "ClusterSnapshot", n_old, n_new,
+                       _SNAP_PAD_FILLS)
+
+
+def pad_batch_nodes(pods: PodBatch, num_nodes: int) -> PodBatch:
+    """Pad the batch's node-indexed matrices (the [*, N] topology
+    domain maps) to a padded snapshot's node count, filling -1 ("node
+    lacks the key") so pad columns can never open or charge a domain.
+    A no-op when nothing carries the real node count (the [1, 1]
+    compile-out matrices of slim workloads)."""
+    extents = set()
+    for fname in _BATCH_PAD_FILLS:
+        dims = _leaf_dims(STRUCT_SPECS["PodBatch"][fname])
+        extents.add(getattr(pods, fname).shape[dims.index("N")])
+    extents -= {1, num_nodes}
+    if not extents:
+        return pods
+    if len(extents) > 1 or max(extents) > num_nodes:
+        raise ValueError(f"inconsistent batch node extents {sorted(extents)} "
+                         f"vs padded node count {num_nodes}")
+    return _pad_struct(pods, "PodBatch", extents.pop(), num_nodes,
+                       _BATCH_PAD_FILLS)

@@ -35,7 +35,14 @@ constraint-sparse flagship workload those tensors shrink ~10x.
 
 The [P, N] mask follows the snapshot's node-column sharding on a mesh
 (parallel/mesh.candidate_mask_sharding): pods replicate, node columns
-shard, so stage 1 is embarrassingly parallel over chips.
+shard, so stage 1 is embarrassingly parallel over chips — the compiled
+stage-1 HLO over sharded inputs contains zero collectives
+(tools/mesh_flagship_smoke.py pins that structurally), and
+parallel.shardops.stage1_mask_sharded is the explicit shard_map form
+for callers composing the mask outside one jitted program. Pad rows
+appended by parallel.pad_nodes_to_mesh are killed here: schedulable is
+False and allocatable zero, so their columns are all-False in every
+stage-1 mask.
 """
 
 from __future__ import annotations

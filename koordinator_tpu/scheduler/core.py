@@ -1289,6 +1289,34 @@ def schedule_batch(snap: ClusterSnapshot, pods: PodBatch,
                           amplified=enable_amplification)
 
 
+def overcommit_arrays_ok(requested, allocatable, num_nodes: int = None,
+                         tol: float = 1.0) -> bool:
+    """Array form of `overcommit_ok` for callers holding the capacity
+    columns without the snapshot (the bench's non-serialized
+    conformance arrays)."""
+    req = np.asarray(requested)
+    alloc = np.asarray(allocatable)
+    if num_nodes is not None:
+        if req[num_nodes:].any():
+            return False  # a pad row was charged: provably a bug
+        req, alloc = req[:num_nodes], alloc[:num_nodes]
+    return bool((req <= alloc + tol).all())
+
+
+def overcommit_ok(snap: ClusterSnapshot, num_nodes: int = None,
+                  tol: float = 1.0) -> bool:
+    """The no-overcommit invariant, host-side: requested <= allocatable
+    + tol on the REAL node rows [0, num_nodes). THE one implementation
+    the dryrun, the mesh smoke, and the conformance tests assert —
+    `num_nodes` excludes the zero-capacity pad rows appended by
+    parallel.pad_nodes_to_mesh (provably unschedulable, so they can
+    never be charged; checking them would be vacuous, and a caller
+    accidentally including a charged pad row must fail loudly here,
+    not by tolerance). None checks every row (no padding)."""
+    return overcommit_arrays_ok(snap.nodes.requested,
+                                snap.nodes.allocatable, num_nodes, tol)
+
+
 # the (count field, domain field, member field) triples of the
 # cross-batch count rule — THE one place the pairing is encoded;
 # bench.py, the dryrun, and the mesh tests all consume it

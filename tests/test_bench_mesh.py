@@ -29,6 +29,8 @@ def test_bench_runs_sharded_on_8_device_mesh(capsys, monkeypatch):
     out = capsys.readouterr().out.strip().splitlines()[-1]
     result = json.loads(out)
     assert result["devices"] == 8
+    # multi-device lines stamp their mesh shape (1D node mesh here)
+    assert result["mesh"] == {"nodes": 8}
     assert result["placed"] == 4000
     assert result["value"] > 0
     # the slim canonical line is self-describing: device-resident tail,
@@ -48,6 +50,7 @@ def test_bench_full_gate_sharded(capsys, monkeypatch):
     importlib.reload(bench)
     result = bench.run_northstar(full_gate=True)
     assert result["devices"] == 8
+    assert result["mesh"] == {"nodes": 8}
     # tight topology constraints leave stragglers; the bulk must place
     assert result["placed"] > 3000
     assert result["metric"].endswith("full_gate")

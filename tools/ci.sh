@@ -29,6 +29,14 @@ echo "=== full-gate cascade smoke (2k pods x 200 nodes, CPU) ==="
 # every push even when no test touches it
 JAX_PLATFORMS=cpu python tools/cascade_smoke.py
 
+echo "=== sharded full-gate mesh smoke (2-device virtual CPU mesh) ==="
+# the multichip flagship path on a 2-device virtual mesh: bit-identical
+# placements vs the single-device oracle, pad rows provably dead, the
+# overcommit invariant on real rows, and structural HLO pins (stage-1
+# collective-free, schedule step carries the ICI top-k merge) — never
+# wall-clock (tools/mesh_flagship_smoke.py)
+python tools/mesh_flagship_smoke.py
+
 echo "=== tier-1 tests (JAX_PLATFORMS=cpu) ==="
 set -o pipefail
 rm -f /tmp/_t1.log

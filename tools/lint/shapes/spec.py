@@ -43,6 +43,7 @@ DIM_VOCAB = {
     "DM": "topology domains per constraint group",
     "J": "aux (RDMA/FPGA) VF instances per pool",
     "K": "delta rows per ingest tick",
+    "KC": "gathered per-shard top-k candidates (k x node shards)",
     "TC": "tail retry-chunk width",
     "RD": "descheduler threshold resource dims",
     "NS": "descheduler namespace rows (padded)",

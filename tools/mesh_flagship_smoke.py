@@ -1,0 +1,145 @@
+"""Sharded full-gate flagship smoke (CI stage; dryrun_multichip(2) scale).
+
+Runs the bench's multichip full-gate flagship (bench.run_northstar with
+BENCH_DEVICES) on a 2-device virtual CPU mesh and on one device from
+the SAME seeds, asserting correctness — never wall-clock:
+
+- placements are BIT-IDENTICAL to the single-device oracle (exact
+  top-k path), with an indivisible node count so the run goes through
+  `parallel.pad_nodes_to_mesh` on the hot path;
+- the overcommit invariant holds on the real rows and no pad row was
+  ever charged or assigned (core.overcommit_ok);
+- the cascade's stage-1 mask is shard-local: the shard_map kernel
+  (parallel.shardops.stage1_mask_sharded) matches the global mask, pad
+  columns are dead, and the compiled HLO of the jitted stage-1 over
+  sharded inputs contains NO cross-device collectives — while the full
+  schedule step's HLO DOES (the ICI top-k merge). Structural pins, so
+  a sharding regression fails here even when results happen to agree.
+
+Kept out of tier-1 (the slow-marked mesh conformance test covers the
+same ground at 4 devices); this stage gates every push via tools/ci.sh.
+"""
+
+import os
+import sys
+
+N_DEV = int(os.environ.get("SMOKE_DEVICES", "2"))
+
+# the virtual mesh must exist before the first backend use
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + f" --xla_force_host_platform_device_count={N_DEV}").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+# dryrun_multichip(2)-scale bench shapes, set before bench import (the
+# module constants are read at import): 35 nodes is NOT divisible by 2,
+# so the sharded run exercises the padding helper for real
+os.environ.setdefault("BENCH_NODES", "35")
+os.environ.setdefault("BENCH_PODS", "512")
+os.environ.setdefault("BENCH_FULL_CHUNK", "256")
+os.environ.setdefault("BENCH_MAX_TAIL_PASSES", "4")
+os.environ["BENCH_EXTRAS"] = "0"
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+import bench  # noqa: E402
+
+COLLECTIVES = ("all-gather", "all-reduce", "all-to-all",
+               "collective-permute", "reduce-scatter")
+
+
+def hlo_collectives(compiled) -> set:
+    """The cross-device collectives named in an optimized HLO module."""
+    text = compiled.as_text()
+    return {c for c in COLLECTIVES if c in text}
+
+
+def main() -> None:
+    from koordinator_tpu.parallel import (
+        make_mesh, pad_batch_nodes, pad_nodes_to_mesh, padded_node_count,
+        shard_snapshot, shardops)
+    from koordinator_tpu.scheduler import core
+    from koordinator_tpu.scheduler.cascade import stage1_mask, static_gates
+    from koordinator_tpu.scheduler.plugins.loadaware import LoadAwareConfig
+    from koordinator_tpu.utils import synthetic
+
+    mesh = make_mesh(jax.devices()[:N_DEV])
+
+    os.environ["BENCH_DEVICES"] = str(N_DEV)
+    multi = bench.run_northstar(full_gate=True)
+    os.environ["BENCH_DEVICES"] = "1"
+    single = bench.run_northstar(full_gate=True)
+
+    assert multi["devices"] == N_DEV and single["devices"] == 1
+    assert multi["mesh"] == {"nodes": N_DEV}, multi.get("mesh")
+    a_m = multi["arrays"]["assignment"]
+    a_s = single["arrays"]["assignment"]
+    num_nodes = multi["arrays"]["num_nodes"]
+    placed = int((a_m >= 0).sum())
+    assert placed > 0, "sharded flagship placed nothing"
+    assert np.array_equal(a_m, a_s), (
+        f"sharded placements diverged from the single-device oracle "
+        f"({int((a_m != a_s).sum())}/{a_m.size} rows differ)")
+    assert a_m.max() < num_nodes, "a pod landed on a pad row"
+    req = multi["arrays"]["requested"]
+    n_pad = padded_node_count(num_nodes, mesh)
+    assert n_pad > num_nodes, "smoke shape must exercise the pad helper"
+    assert req.shape[0] == n_pad, req.shape
+    # the one shared invariant implementation (pad rows excluded AND
+    # asserted uncharged), not a local re-derivation
+    assert core.overcommit_arrays_ok(req, multi["arrays"]["allocatable"],
+                                     num_nodes), \
+        "sharded flagship overcommitted a node (or charged a pad row)"
+    print(f"mesh smoke: {N_DEV}-device full-gate flagship conformant "
+          f"({placed}/{a_m.size} placed, pad rows dead) OK")
+
+    # --- structural sharding pins on a fresh sharded workload ------------
+    snap_h = synthetic.full_gate_cluster(num_nodes, num_quotas=4,
+                                         num_gangs=2, gpus_per_node=4)
+    snap_p = pad_nodes_to_mesh(snap_h, mesh)
+    snap = shard_snapshot(snap_p, mesh)
+    pods = pad_batch_nodes(
+        synthetic.full_gate_pods(256, num_nodes, num_quotas=4, num_gangs=2,
+                                 n_anti_groups=4, anti_members=4,
+                                 n_aff_groups=2, aff_members=4),
+        snap_p.num_nodes)
+    cfg = LoadAwareConfig.make()
+
+    static_ok, _ = static_gates(snap.nodes, pods, cfg)
+    mask_global = np.asarray(stage1_mask(snap, pods, static_ok))
+    mask_sharded = np.asarray(jax.jit(
+        lambda sn, pd, so: shardops.stage1_mask_sharded(mesh, sn, pd, so)
+    )(snap, pods, static_ok))
+    assert np.array_equal(mask_global, mask_sharded), \
+        "shard-local stage-1 mask diverged from the global mask"
+    assert not mask_global[:, num_nodes:].any(), \
+        "stage-1 admitted a zero-capacity pad column"
+
+    # stage 1 must compile COLLECTIVE-FREE over sharded inputs (it is
+    # elementwise over node columns), while the full schedule step must
+    # contain the ICI candidate merge — both read off the optimized HLO
+    from koordinator_tpu.parallel import struct_sharding
+    s1 = jax.jit(stage1_mask).lower(snap, pods, static_ok).compile()
+    got = hlo_collectives(s1)
+    assert not got, f"stage-1 HLO grew collectives: {sorted(got)}"
+    step = jax.jit(lambda s, p, c: core.schedule_batch(
+        s, p, c, num_rounds=2, k_choices=4, enable_numa=True,
+        enable_devices=True, cascade=True),
+        out_shardings=struct_sharding("ScheduleResult", mesh)
+    ).lower(snap, pods, cfg).compile()
+    got = hlo_collectives(step)
+    assert got, "sharded schedule step compiled with NO collectives " \
+        "(the snapshot is no longer actually sharded?)"
+    print(f"mesh smoke: stage-1 collective-free, schedule step merges "
+          f"over ICI ({sorted(got)}) OK")
+
+
+if __name__ == "__main__":
+    main()

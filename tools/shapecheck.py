@@ -60,6 +60,7 @@ CONTRACT_MODULES = (
     "koordinator_tpu.ops.quota_demand",
     "koordinator_tpu.scheduler.cascade",
     "koordinator_tpu.scheduler.core",
+    "koordinator_tpu.parallel.shardops",
     "koordinator_tpu.scheduler.plugins.loadaware",
     "koordinator_tpu.scheduler.plugins.deviceshare",
     "koordinator_tpu.scheduler.plugins.numaaware",
@@ -78,12 +79,12 @@ CONTRACT_MODULES = (
 ASSIGNMENT_A = {
     "P": 21, "N": 5, "I": 2, "Z": 3, "G": 4, "Q": 6, "V": 7,
     "S": 8, "L": 9, "T": 10, "TG": 12, "SG": 13, "AG": 14, "FG": 15,
-    "DM": 16, "J": 17, "K": 18, "TC": 19, "RD": 20, "NS": 22,
+    "DM": 16, "J": 17, "K": 18, "KC": 23, "TC": 19, "RD": 20, "NS": 22,
 }
 ASSIGNMENT_B = {
     "P": 26, "N": 23, "I": 8, "Z": 4, "G": 7, "Q": 9, "V": 10,
     "S": 13, "L": 14, "T": 15, "TG": 16, "SG": 17, "AG": 18, "FG": 19,
-    "DM": 21, "J": 24, "K": 25, "TC": 12, "RD": 27, "NS": 28,
+    "DM": 21, "J": 24, "K": 25, "KC": 30, "TC": 12, "RD": 27, "NS": 28,
 }
 
 _DTYPE_NAMES = {"f32": "float32", "i32": "int32", "i8": "int8",
