@@ -182,7 +182,7 @@ def ensure_platform(probe_timeout: float = None) -> bool:
 
 def run_northstar(full_gate: bool = False, num_pods: int = None,
                   num_nodes: int = None, chunk: int = None,
-                  metric: str = None) -> dict:
+                  metric: str = None, degraded: str = None) -> dict:
     from koordinator_tpu.parallel import mesh as meshlib
     from koordinator_tpu.scheduler import core
     from koordinator_tpu.scheduler.plugins.loadaware import LoadAwareConfig
@@ -572,6 +572,10 @@ def run_northstar(full_gate: bool = False, num_pods: int = None,
         # self-describing without consulting the code's defaults
         "cascade": cascade_on,
         "tail_mode": tail_mode,
+        # present ONLY on a run the bench ladder re-ran degraded
+        # (run_with_ladder): the classified failure class + the retried
+        # chunk, so a degraded number can never pass as the protocol
+        **({"degraded": degraded} if degraded else {}),
         "devices": len(devices),
         # the mesh stamp makes a 4-device line self-describing (1x4 vs
         # 2x2); absent on single-device lines so trajectories stay
@@ -593,6 +597,39 @@ def run_northstar(full_gate: bool = False, num_pods: int = None,
         "num_nodes": num_nodes,
     }
     return result
+
+
+def run_with_ladder(max_halvings: int = 2, **kw) -> dict:
+    """The bench's rung of the degradation ladder: a run whose failure
+    classifies as RESOURCE_EXHAUSTED retries with the chunk halved (up
+    to `max_halvings` times) and the retried line carries a `degraded`
+    stamp (failure class + the chunk that survived), so a degraded
+    number is self-describing and can never pass as the canonical
+    protocol. Any other failure class propagates — the caller's
+    evidence guards own those."""
+    from koordinator_tpu.scheduler.errorhandler import (
+        FailureClass,
+        classify_failure,
+    )
+
+    chunk = kw.pop("chunk", None)
+    degraded = None
+    for halvings in range(max_halvings + 1):
+        try:
+            return run_northstar(chunk=chunk, degraded=degraded, **kw)
+        except Exception as exc:
+            fc = classify_failure(exc)
+            cur = chunk if chunk is not None \
+                else (FULL_CHUNK if kw.get("full_gate", False) else CHUNK)
+            if fc is not FailureClass.RESOURCE_EXHAUSTED \
+                    or halvings == max_halvings or cur < 2:
+                # out of rungs (or not an OOM at all): the REAL
+                # exception propagates, never a synthetic stand-in
+                raise
+            chunk = cur // 2
+            degraded = f"{fc.value}:chunk={chunk}"
+            print(f"bench: {fc.value}; retrying with chunk {cur} -> "
+                  f"{chunk}", file=sys.stderr)
 
 
 def _stamped_line(line: dict, captured_at: str, age: float,
@@ -693,12 +730,16 @@ def main(platform_healthy: bool = True):
             # stamped surfacing: a failure here must not abort the run
             # before the canonical fallback line prints.
             try:
-                run_northstar(
+                run_with_ladder(
                     full_gate=True, num_pods=20_000, num_nodes=2_000,
                     chunk=2_000,
                     metric="score_bind_20k_pods_2k_nodes_full_gate_degraded")
             except Exception as exc:  # noqa: BLE001 — evidence guard
-                print(f"bench: degraded full-gate line failed ({exc!r}); "
+                from koordinator_tpu.scheduler.errorhandler import (
+                    classify_failure,
+                )
+                print(f"bench: degraded full-gate line failed "
+                      f"(class={classify_failure(exc).value}: {exc!r}); "
                       "continuing to the canonical line", file=sys.stderr)
     if extras:
         # BASELINE configs 1-5 + the full-gate flagship, driver-captured
@@ -709,9 +750,11 @@ def main(platform_healthy: bool = True):
         bench_configs.config_3_gangs()
         bench_configs.config_4_quota()
         bench_configs.config_5_descheduler()
-        run_northstar(full_gate=True)
-    # the canonical north-star line, LAST
-    run_northstar(full_gate=False)
+        run_with_ladder(full_gate=True)
+    # the canonical north-star line, LAST (ladder-wrapped: an OOM on a
+    # smaller-memory host retries with the chunk halved and the line
+    # stamps `degraded` instead of recording nothing for the round)
+    run_with_ladder(full_gate=False)
 
 
 if __name__ == "__main__":

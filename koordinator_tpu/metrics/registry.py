@@ -23,6 +23,13 @@ SCHEDULER_SCHEDULE_BATCH_KERNEL_SECONDS = \
     "scheduler_schedule_batch_kernel_seconds"
 SCHEDULER_PODS_SCHEDULED = "scheduler_pods_scheduled"
 SCHEDULER_SNAPSHOT_VERSION = "scheduler_snapshot_version"
+# resilience layer (scheduler/guards.py + the frameworkext ladder)
+SCHEDULER_FAILURES_CLASSIFIED = "scheduler_failures_classified"
+SCHEDULER_GUARD_TRIPS = "scheduler_guard_trips"
+SCHEDULER_QUARANTINED_INPUTS = "scheduler_quarantined_inputs"
+SCHEDULER_DEGRADED_CYCLES = "scheduler_degraded_cycles"
+SCHEDULER_DEGRADATION_LEVEL = "scheduler_degradation_level"
+SCHEDULER_DELTA_REJECTED = "scheduler_delta_rejected"
 
 # --- koordlet (pkg/koordlet/metrics/: cpi.go, psi.go, cpu_suppress.go,
 #     cpu_burst.go, core_sched.go, prediction.go, resource_summary.go,

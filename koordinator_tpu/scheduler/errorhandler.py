@@ -1,4 +1,5 @@
-"""Scheduling error-handler chain + reservation unschedulable writeback.
+"""Scheduling error-handler chain, typed runtime-failure classification,
+and the reservation unschedulable writeback.
 
 Capability parity with `pkg/scheduler/frameworkext/errorhandler_dispatcher.go`
 (pre filters -> default handler -> post filters, a filter returning True
@@ -10,11 +11,21 @@ In the batched TPU scheduler a "scheduling error" is an unplaced row of a
 batch (assignment -1): `dispatch_batch_errors` fans the unplaced pods out
 through the chain, so plugins observe exactly the per-pod error stream
 the reference's queue-centric scheduler produces.
+
+This module also owns the RUNTIME failure model of the resident service
+(docs/DESIGN.md "Failure model & degradation ladder"): `classify_failure`
+maps any exception a device-program call can raise into a `FailureClass`,
+and `Backoff` is the bounded-retry bookkeeping the SchedulerService (and
+any other retry site) uses between attempts. Every hot-path `except
+Exception` around a device-program call must route through the
+classifier — koordlint RB001 enforces it.
 """
 
 from __future__ import annotations
 
 import dataclasses
+import enum
+import random
 import time
 from typing import Callable, List, Optional
 
@@ -32,6 +43,138 @@ class SchedulingError(Exception):
 
     def __str__(self) -> str:
         return self.message
+
+
+# --- typed runtime-failure classification ----------------------------------
+
+
+class FailureClass(enum.Enum):
+    """Every way a device-program cycle can fail, as ONE closed set: the
+    degradation ladder, the retry policy, the chaos matrix, and the
+    failure metrics all key on it, so a new failure mode must be named
+    here before any component can react to it."""
+
+    GUARD_TRIP = "guard_trip"                  # health guards quarantined input
+    RESOURCE_EXHAUSTED = "resource_exhausted"  # XLA OOM / allocator failure
+    DEVICE_LOST = "device_lost"                # device unreachable/halted
+    XLA_INTERNAL = "xla_internal"              # compiler/runtime internal error
+    WATCHDOG_STALL = "watchdog_stall"          # cycle exceeded the monitor budget
+    UNKNOWN = "unknown"                        # anything unrecognized
+
+
+# classes where retrying the SAME program may succeed (a lost device can
+# reconnect, an internal error can be a transient runtime hiccup); OOM is
+# deliberately NOT here — the identical program OOMs identically, so the
+# only useful reaction is degrading (chunk halving), never a plain retry
+TRANSIENT_CLASSES = frozenset({FailureClass.DEVICE_LOST,
+                               FailureClass.XLA_INTERNAL,
+                               FailureClass.UNKNOWN})
+
+
+class GuardTripError(RuntimeError):
+    """Raised by callers that treat a non-zero guard health word as fatal
+    (strict mode); carries the packed word for the classifier/logs."""
+
+    def __init__(self, word: int, message: str = ""):
+        super().__init__(message or f"device health guard tripped: "
+                                    f"word=0x{word:x}")
+        self.word = int(word)
+
+
+class WatchdogStall(RuntimeError):
+    """A scheduling cycle exceeded the SchedulerMonitor budget."""
+
+
+# message fragments (upper-cased match) per class, in PRECEDENCE order:
+# OOM text often embeds "INTERNAL"-flavored detail, so it must win
+_MESSAGE_RULES = (
+    (FailureClass.RESOURCE_EXHAUSTED,
+     ("RESOURCE_EXHAUSTED", "OUT OF MEMORY", "ALLOCATION FAILURE", "OOM")),
+    (FailureClass.DEVICE_LOST,
+     ("DEVICE_LOST", "DEVICE LOST", "UNAVAILABLE", "DEVICE HALTED",
+      "FAILED TO CONNECT", "SOCKET CLOSED")),
+    (FailureClass.XLA_INTERNAL, ("INTERNAL", "DATA_LOSS", "ABORTED")),
+)
+
+
+def classify_failure(exc: BaseException) -> FailureClass:
+    """Map an exception from a device-program call to its FailureClass.
+
+    Typed exceptions win; otherwise the XLA status-code vocabulary in the
+    message decides (XlaRuntimeError carries the absl status name —
+    RESOURCE_EXHAUSTED, UNAVAILABLE, INTERNAL — as a message prefix).
+    Matching is by type NAME so the classifier stays importable where
+    jax is broken or absent (the koordlint analyzers run stdlib-only)."""
+    if isinstance(exc, GuardTripError):
+        return FailureClass.GUARD_TRIP
+    if isinstance(exc, (WatchdogStall, TimeoutError)):
+        return FailureClass.WATCHDOG_STALL
+    msg = str(exc).upper()
+    for cls, fragments in _MESSAGE_RULES:
+        if any(f in msg for f in fragments):
+            return cls
+    mro_names = {t.__name__ for t in type(exc).__mro__}
+    if {"XlaRuntimeError", "JaxRuntimeError"} & mro_names:
+        # an XLA runtime failure with an unrecognized status: internal
+        return FailureClass.XLA_INTERNAL
+    return FailureClass.UNKNOWN
+
+
+@dataclasses.dataclass(frozen=True)
+class RetryPolicy:
+    """Bounded exponential backoff with jitter for TRANSIENT failures."""
+
+    max_attempts: int = 3       # attempts at one ladder state before degrading
+    base_seconds: float = 0.05
+    multiplier: float = 2.0
+    max_seconds: float = 2.0
+    jitter_frac: float = 0.25   # +/- fraction of the computed delay
+
+
+class Backoff:
+    """Attempt/backoff bookkeeping for one retry site.
+
+    Clocked on `time.monotonic`, NEVER wall-clock: an NTP step or DST
+    jump under `time.time()` can move the clock backwards mid-retry and
+    produce a negative backoff window (an instant hot-loop retry storm —
+    the exact failure the backoff exists to prevent). Delays are a pure
+    function of the ATTEMPT COUNT (clock-free), and `remaining()` clamps
+    at zero, so no clock behavior can yield a negative window; pinned by
+    tests/test_degradation.py."""
+
+    def __init__(self, policy: Optional[RetryPolicy] = None,
+                 clock: Callable[[], float] = time.monotonic,
+                 seed: int = 0):
+        self.policy = policy or RetryPolicy()
+        self._clock = clock
+        self._rng = random.Random(seed)
+        self.attempts = 0
+        self._not_before: Optional[float] = None
+
+    def exhausted(self) -> bool:
+        return self.attempts >= self.policy.max_attempts
+
+    def next_delay(self) -> float:
+        """Record an attempt and return the delay before the next one
+        (>= 0 always; jittered so synchronized retries fan out)."""
+        p = self.policy
+        delay = min(p.base_seconds * (p.multiplier ** self.attempts),
+                    p.max_seconds)
+        delay *= 1.0 + p.jitter_frac * (self._rng.random() * 2.0 - 1.0)
+        delay = max(delay, 0.0)
+        self.attempts += 1
+        self._not_before = self._clock() + delay
+        return delay
+
+    def remaining(self) -> float:
+        """Seconds until the backoff window closes, clamped at zero."""
+        if self._not_before is None:
+            return 0.0
+        return max(self._not_before - self._clock(), 0.0)
+
+    def reset(self) -> None:
+        self.attempts = 0
+        self._not_before = None
 
 
 @dataclasses.dataclass
@@ -205,18 +348,28 @@ def reserve_pod_for(r: api.Reservation) -> api.Pod:
 def dispatch_batch_errors(dispatcher: ErrorHandlerDispatcher,
                           assignment: np.ndarray, valid: np.ndarray,
                           pods: List[api.Pod],
-                          message: str = "no node fits") -> int:
+                          message: str = "no node fits",
+                          infra_mask: Optional[np.ndarray] = None) -> int:
     """Fan a batch's unplaced rows through the chain; returns the count.
     `pods` is the typed pod list in batch order (rows past its length are
-    padding and never dispatched)."""
+    padding and never dispatched). Rows set in `infra_mask` (the guard
+    quarantine mask) dispatch as INFRASTRUCTURE errors
+    (unschedulable=False): the input row was corrupt, not the cluster
+    full, so preemption must not fire for them and requeue retries hard
+    against the next (healthy) snapshot."""
     n = 0
     for i, pod in enumerate(pods):
         if i >= assignment.shape[0] or not bool(valid[i]):
             continue
         if int(assignment[i]) >= 0:
             continue
-        dispatcher.error(QueuedPodInfo(pod=pod),
-                        SchedulingError(f"{message}: pod "
-                                        f"{pod.meta.namespaced_name}"))
+        if infra_mask is not None and bool(infra_mask[i]):
+            err = SchedulingError(
+                f"quarantined input row: pod {pod.meta.namespaced_name}",
+                unschedulable=False)
+        else:
+            err = SchedulingError(f"{message}: pod "
+                                  f"{pod.meta.namespaced_name}")
+        dispatcher.error(QueuedPodInfo(pod=pod), err)
         n += 1
     return n

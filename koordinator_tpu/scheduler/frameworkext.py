@@ -16,16 +16,25 @@ Capability parity with pkg/scheduler/frameworkext (SURVEY.md 2.1):
   sidecar boundary per BASELINE.json): holds the SnapshotStore, schedules
   pod batches chunk-by-chunk against the current snapshot, publishes the
   post-commit snapshot, and reports through the monitor/debug hooks.
+- Resilience layer (docs/DESIGN.md "Failure model & degradation
+  ladder"): device health guards fused into every batch program
+  (scheduler/guards.py), typed failure classification with bounded
+  monotonic backoff (errorhandler.classify_failure/Backoff), and the
+  DegradationLadder below — the explicit rungs between "all healthy"
+  and "crash", with automatic probing back up after clean cycles.
 """
 
 from __future__ import annotations
 
+import dataclasses
 import json
 import logging
 import threading
 import time
-from typing import Callable, Dict, List, Optional
+from typing import Callable, Dict, List, Optional, Tuple
 
+import jax
+import jax.numpy as jnp
 import numpy as np
 
 from koordinator_tpu.utils.httpserver import (
@@ -34,7 +43,14 @@ from koordinator_tpu.utils.httpserver import (
 )
 
 from koordinator_tpu.metrics import kernel_timer
-from koordinator_tpu.scheduler import core
+from koordinator_tpu.scheduler import core, guards
+from koordinator_tpu.scheduler.errorhandler import (
+    Backoff,
+    FailureClass,
+    RetryPolicy,
+    TRANSIENT_CLASSES,
+    classify_failure,
+)
 from koordinator_tpu.scheduler.metrics_defs import SchedulerMetrics
 from koordinator_tpu.scheduler.plugins.loadaware import LoadAwareConfig
 from koordinator_tpu.snapshot.schema import ClusterSnapshot, PodBatch
@@ -84,6 +100,148 @@ class SchedulerMonitor:
         with self._lock:
             return [t for t, s in self._inflight.items()
                     if now - s > self.timeout]
+
+
+class _CommittedCycleError(Exception):
+    """A failure AFTER a cycle's snapshot commit (post-commit hooks):
+    terminal by construction — retrying would schedule the same batch
+    against its own post-commit snapshot and double-charge every
+    placement. schedule() unwraps and re-raises the cause."""
+
+    def __init__(self, cause: BaseException):
+        super().__init__(str(cause))
+        self.cause = cause
+
+
+@dataclasses.dataclass(frozen=True)
+class LadderState:
+    """The configuration one scheduling cycle runs at."""
+
+    level: int = 0         # index into DegradationLadder.LEVELS
+    chunk_splits: int = 0  # batch scheduled as 2**splits sequential chunks
+
+    @property
+    def cascade_off(self) -> bool:
+        return self.level >= DegradationLadder.L_NO_CASCADE
+
+    @property
+    def chunked(self) -> bool:
+        return self.chunk_splits > 0
+
+    @property
+    def single_device(self) -> bool:
+        return self.level >= DegradationLadder.L_SINGLE_DEVICE
+
+    @property
+    def degraded(self) -> bool:
+        return self.level > 0 or self.chunk_splits > 0
+
+    def label(self) -> str:
+        name = DegradationLadder.LEVELS[self.level]
+        if self.chunk_splits > 0:
+            name += f"/2^{self.chunk_splits}"
+        return name
+
+
+class DegradationLadder:
+    """The explicit ladder between "all healthy" and "crash".
+
+    Rungs, in degradation order (each rung keeps the degradations of the
+    rungs above it):
+      normal        -> the caller's full configuration
+      no_cascade    -> cascade=False: the conformance-oracle program —
+                       structurally simpler (no stage-2 narrowing), the
+                       first thing to try when the full program misbehaves
+      chunked       -> the batch runs as 2**chunk_splits sequential
+                       sub-batches (counts and the snapshot carried
+                       chunk-to-chunk); each further OOM halves again
+      single_device -> inputs pinned to device 0 (a sharded store's mesh
+                       is abandoned until the fleet heals)
+
+    Transitions are keyed on FailureClass: RESOURCE_EXHAUSTED jumps
+    straight to chunking (retrying an identical OOM is useless),
+    DEVICE_LOST jumps to single-device, everything else steps one rung.
+    After `probe_after` consecutive clean cycles below normal, ONE cycle
+    probes the rung above; success commits the promotion, failure falls
+    straight back (and the streak restarts). Every transition is
+    recorded so the chaos matrix can assert the exact path taken.
+
+    Not thread-safe by itself: the service mutates it only while holding
+    its cycle machinery (transitions happen between program attempts).
+    """
+
+    LEVELS = ("normal", "no_cascade", "chunked", "single_device")
+    L_NORMAL, L_NO_CASCADE, L_CHUNKED, L_SINGLE_DEVICE = range(4)
+
+    def __init__(self, probe_after: int = 8, max_chunk_splits: int = 4):
+        self.probe_after = probe_after
+        self.max_chunk_splits = max_chunk_splits
+        self.level = self.L_NORMAL
+        self.chunk_splits = 0
+        self.clean_streak = 0
+        self.degraded_cycles = 0
+        self.transitions: List[Tuple[str, str]] = []  # (cause, new label)
+
+    def state(self) -> LadderState:
+        return LadderState(self.level, self.chunk_splits)
+
+    def _probe_target(self) -> LadderState:
+        if self.level == self.L_CHUNKED and self.chunk_splits > 1:
+            return LadderState(self.level, self.chunk_splits - 1)
+        if self.level == self.L_SINGLE_DEVICE:
+            return LadderState(self.L_CHUNKED, max(self.chunk_splits, 1))
+        if self.level == self.L_CHUNKED:
+            return LadderState(self.L_NO_CASCADE, 0)
+        return LadderState(max(self.level - 1, 0), 0)
+
+    def begin_cycle(self) -> Tuple[LadderState, bool]:
+        """-> (state to run at, whether this cycle is an up-probe)."""
+        if self.level > self.L_NORMAL \
+                and self.clean_streak >= self.probe_after:
+            return self._probe_target(), True
+        return self.state(), False
+
+    def on_success(self, probing: bool, state: LadderState) -> None:
+        if probing:
+            # commit the promotion; earn the next probe from scratch
+            self._transition("probe_up", state)
+            self.clean_streak = 0
+        else:
+            self.clean_streak += 1
+
+    def on_failure(self, fc: FailureClass, probing: bool) -> bool:
+        """Degrade for the failure class; returns False when there is no
+        lower rung left (the caller re-raises). A failed PROBE is not a
+        degradation — the pre-probe state simply stays."""
+        self.clean_streak = 0
+        if probing:
+            return True
+        if fc is FailureClass.RESOURCE_EXHAUSTED:
+            if self.level < self.L_CHUNKED:
+                nxt = LadderState(self.L_CHUNKED, 1)
+            elif self.chunk_splits < self.max_chunk_splits:
+                nxt = LadderState(self.level, self.chunk_splits + 1)
+            else:
+                return False
+        elif fc is FailureClass.DEVICE_LOST:
+            if self.level >= self.L_SINGLE_DEVICE:
+                return False
+            nxt = LadderState(self.L_SINGLE_DEVICE, self.chunk_splits)
+        else:
+            if self.level >= self.L_SINGLE_DEVICE:
+                return False
+            new_level = self.level + 1
+            nxt = LadderState(
+                new_level,
+                max(self.chunk_splits, 1)
+                if new_level >= self.L_CHUNKED else self.chunk_splits)
+        self._transition(fc.value, nxt)
+        return True
+
+    def _transition(self, cause: str, nxt: LadderState) -> None:
+        self.level = nxt.level
+        self.chunk_splits = nxt.chunk_splits
+        self.transitions.append((cause, nxt.label()))
 
 
 def debug_score_table(snap: ClusterSnapshot, pods: PodBatch,
@@ -344,6 +502,8 @@ class SchedulerService:
                  flags: Optional[DebugFlags] = None,
                  registry: Optional[ServiceRegistry] = None,
                  metrics: Optional[SchedulerMetrics] = None,
+                 ladder: Optional[DegradationLadder] = None,
+                 retry_policy: Optional[RetryPolicy] = None,
                  **schedule_kwargs):
         self.store = store or SnapshotStore()
         self.cfg = cfg if cfg is not None else LoadAwareConfig.make()
@@ -361,6 +521,24 @@ class SchedulerService:
         # traffic compiles a handful of program variants, not one per
         # constrained-count.
         self.auto_pack = bool(schedule_kwargs.pop("auto_pack", True))
+        # resilience layer (docs/DESIGN.md "Failure model & degradation
+        # ladder"): health guards fused into the batch program, typed
+        # failure classification with bounded backoff, and the explicit
+        # degradation ladder between "all healthy" and "crash"
+        self.guards_enabled = bool(schedule_kwargs.pop("guards", True))
+        self.max_cycle_attempts = int(
+            schedule_kwargs.pop("max_cycle_attempts", 8))
+        self.ladder = ladder or DegradationLadder()
+        self.retry_policy = retry_policy or RetryPolicy()
+        self._sleep: Callable[[float], None] = time.sleep
+        # chaos seam (koordinator_tpu.testing.faults): called with
+        # (LadderState, PodBatch) before every program attempt; a raised
+        # exception injects a device-program failure deterministically
+        self.fault_injection: Optional[Callable] = None
+        self._cycle_state = LadderState()
+        self.last_health_word = 0
+        self.last_quarantined_pods: Optional[np.ndarray] = None
+        self.last_ladder_state = LadderState()
         self.schedule_kwargs = schedule_kwargs
         self._explicit_amp = "enable_amplification" in schedule_kwargs
         self.batches = 0
@@ -421,9 +599,17 @@ class SchedulerService:
         """Apply an O(K) metric delta SERIALIZED with batch commits — a
         delta landing between a batch's snapshot read and its post-commit
         publish would be silently overwritten (the same hazard the commit
-        lock exists for; see the lock comment above)."""
+        lock exists for; see the lock comment above). An out-of-order /
+        duplicate delta no-ops in the store's version guard; the typed
+        reason lands on the scheduler_delta_rejected metric here."""
         with self._commit_lock:
             self.store.ingest(delta)
+            reason = self.store.take_delta_rejection()
+            if reason is not None:
+                self.metrics.delta_rejected.labels(reason.value).inc()
+                log.warning("delta rejected (%s): store at delta "
+                            "version %d", reason.value,
+                            self.store.applied_delta_version)
             self.last_committed_version = self.store.version
             return self.last_committed_version
 
@@ -431,13 +617,18 @@ class SchedulerService:
     # [P, P] savings cannot pay for the pack/unpack permutations there
     AUTO_PACK_MIN_BATCH = 512
 
-    def _prepare_batch(self, snap: ClusterSnapshot, pods: PodBatch):
+    def _prepare_batch(self, snap: ClusterSnapshot, pods: PodBatch,
+                       allow_prefix_pack: bool = True):
         """Derive the batching-layer specializations for this batch:
         `(maybe-packed pods, extra static kwargs, inverse permutation
         or None)`. Every contract the kwargs claim is established or
         verified here, host-side (the scheduler silently trusts them):
         domain classes come from actual row equality, prefixes from an
-        actual pack, and numa_prefix only on a policy-free snapshot."""
+        actual pack, and numa_prefix only on a policy-free snapshot.
+        `allow_prefix_pack=False` (the ladder's chunked rung) keeps the
+        dom_classes derivation but skips the prefix contracts — slicing
+        a prefix-packed batch into chunks would break the row-range
+        claims the prefixes make."""
         from koordinator_tpu.utils import synthetic as batching
 
         from koordinator_tpu.scheduler.plugins import deviceshare
@@ -456,7 +647,7 @@ class SchedulerService:
                 # and auto_pack=False opts out entirely.
                 kwargs["dom_classes"] = classes
         p = int(np.asarray(pods.valid).shape[0])
-        if p <= self.AUTO_PACK_MIN_BATCH:
+        if p <= self.AUTO_PACK_MIN_BATCH or not allow_prefix_pack:
             return pods, kwargs, None
 
         # cheap masks FIRST; the full batch copy + contract validation
@@ -495,13 +686,98 @@ class SchedulerService:
         inv[perm] = np.arange(perm.size)
         return packed, kwargs, inv
 
-    def schedule(self, pods: PodBatch,
-                 pod_names: Optional[List[str]] = None,
-                 typed_pods: Optional[List] = None) -> core.ScheduleResult:
-        """`typed_pods` (batch-ordered api.Pod list) opts unplaced rows
-        into the error-handler chain — the reservation filter needs the
-        typed pod to recognize reserve pods."""
-        token = self.monitor.start_cycle()
+    def _run_program(self, snap: ClusterSnapshot, pods: PodBatch,
+                     kwargs: dict):
+        """One guarded/unguarded device-program invocation ->
+        `(result, health u32[3] device array or None, node_bad,
+        pod_bad)`. With guards on, detection + quarantine + scheduling
+        are ONE fused program (scheduler/guards.py)."""
+        if self.fault_injection is not None:
+            # the chaos seam sits at the PROGRAM invocation, so chunked
+            # cycles inject per sub-batch — a width-dependent OOM stops
+            # firing once halving narrows below its threshold, exactly
+            # like a real allocator
+            self.fault_injection(self._cycle_state, pods)
+        if self.guards_enabled:
+            return guards.guarded_schedule_batch(snap, pods, self.cfg,
+                                                 **kwargs)
+        result = core.schedule_batch(snap, pods, self.cfg, **kwargs)
+        return result, None, None, None
+
+    def _run_chunked(self, snap: ClusterSnapshot, pods: PodBatch,
+                     kwargs: dict, splits: int):
+        """The ladder's chunked rung: 2**splits sequential sub-batches
+        against the evolving snapshot, topology counts carried
+        chunk-to-chunk exactly like the bench sweep (the cross-batch
+        count rule). `gang_failed` is SUPPRESSED here — per-chunk
+        quorum proofs don't compose across chunks, and a false
+        un-assume corrupts held capacity; the Permit wait-expiry
+        timeout stays the rollback backstop for degraded cycles. All
+        merging stays device-side; no per-chunk host sync."""
+        p = int(np.asarray(pods.valid).shape[0])
+        n_chunks = max(min(2 ** splits, p), 1)
+        from koordinator_tpu.utils import synthetic
+        sizes = [len(c) for c in np.array_split(np.arange(p), n_chunks)]
+        # the whole batch on device first (one upload, like the bench
+        # sweep): the count-charge helpers compose eagerly with .at
+        # scatters and clipped gathers — numpy operands would raise on
+        # the degenerate [1, 1] domain matrices instead of dropping
+        pods = jax.device_put(pods)
+        counts = tuple(getattr(pods, f) for f in core.COUNT_FIELDS)
+        parts, pod_bads, node_bad, health = [], [], None, None
+        start = 0
+        for size in sizes:
+            if size == 0:
+                continue
+            batch = synthetic.slice_batch(pods, start, size)
+            batch = batch.replace(**dict(zip(core.COUNT_FIELDS, counts)))
+            res_i, h_i, nb_i, pb_i = self._run_program(snap, batch, kwargs)
+            counts = core.charge_all_counts(counts, batch,
+                                            res_i.assignment)
+            snap = res_i.snapshot
+            parts.append(res_i)
+            if h_i is not None:
+                pod_bads.append(pb_i)
+                node_bad = nb_i if node_bad is None else node_bad | nb_i
+                # the WORD merges bitwise; counts do not (a node bad in
+                # several chunks is one bad node) — the node count is
+                # recomputed from the merged mask below, pod rows are
+                # disjoint so their counts sum
+                health = h_i if health is None else jnp.stack(
+                    [health[0] | h_i[0], health[1], health[2] + h_i[2]])
+            start += size
+        if health is not None:
+            health = jnp.stack([health[0],
+                                node_bad.sum().astype(jnp.uint32),
+                                health[2]])
+        merged = parts[0].replace(
+            snapshot=snap,
+            gang_failed=jnp.zeros_like(parts[0].gang_failed),
+            **{f: jnp.concatenate([getattr(r, f) for r in parts])
+               for f in core.PER_POD_RESULT_FIELDS})
+        pod_bad = jnp.concatenate(pod_bads) if pod_bads else None
+        return merged, health, node_bad, pod_bad
+
+    def _device_cycle(self, snap: ClusterSnapshot, pods: PodBatch,
+                      kwargs: dict, state: LadderState):
+        """Run one cycle's device program at the ladder state's
+        configuration."""
+        self._cycle_state = state
+        if state.single_device:
+            dev = jax.devices()[0]
+            snap = jax.device_put(snap, dev)
+            pods = jax.device_put(pods, dev)
+        if state.cascade_off:
+            kwargs = dict(kwargs, cascade=False)
+        if state.chunked:
+            return self._run_chunked(snap, pods, kwargs,
+                                     state.chunk_splits)
+        return self._run_program(snap, pods, kwargs)
+
+    def _locked_cycle(self, pods: PodBatch, typed_pods,
+                      state: LadderState):
+        """The serialized snapshot-read -> program -> commit section of
+        one cycle attempt."""
         with self._commit_lock:
             snap = self.store.current()
             # amplified-CPU auto-detection happens on the snapshot the
@@ -514,34 +790,140 @@ class SchedulerService:
             if not self._explicit_amp:
                 self.schedule_kwargs["enable_amplification"] = bool(
                     np.asarray(snap.nodes.cpu_amplification > 1.0).any())
-            sched_pods, pack_kwargs, inv = self._prepare_batch(snap, pods)
+            sched_pods, pack_kwargs, inv = self._prepare_batch(
+                snap, pods, allow_prefix_pack=not state.chunked)
             with kernel_timer(self.metrics.kernel_seconds,
                               "koord/schedule_batch"):
-                result = core.schedule_batch(
-                    snap, sched_pods, self.cfg,
-                    **{**self.schedule_kwargs, **pack_kwargs})
+                result, health_dev, _node_bad, pod_bad = \
+                    self._device_cycle(
+                        snap, sched_pods,
+                        {**self.schedule_kwargs, **pack_kwargs}, state)
                 if inv is not None:
                     # back to the CALLER's pod order before anything
                     # (hooks, error chain, debug tables) sees the result
                     result = result.replace(
                         **{f: getattr(result, f)[inv]
                            for f in core.PER_POD_RESULT_FIELDS})
+                    if pod_bad is not None:
+                        pod_bad = pod_bad[inv]
                 # single D2H transfer doubles as the completion barrier
                 # (and makes the kernel timer measure device time)
                 assignment = np.asarray(result.assignment)
+            # the guards' ONE packed readback ([word, bad nodes, bad
+            # pods]); the full masks stay on device unless the word is
+            # non-zero (cold path)
+            health = (np.asarray(health_dev)
+                      if health_dev is not None else None)
             self.store.update(lambda _old: result.snapshot)
-            # THIS call's commit version, captured under the lock — the
-            # shared last_committed_version attribute can already
-            # reflect a racing ingest by the time a caller reads it
-            version = self.store.version
-            self.last_committed_version = version
-            if self.on_assumed is not None and typed_pods is not None:
-                # under the commit lock: an attached syncer's rebuild
-                # (which serializes on the same lock) cannot swap the
-                # builder between this batch's snapshot and the hook's
-                # row-name resolution
-                self.on_assumed(assignment, typed_pods, result)
+            # THE COMMIT POINT: everything below ran against a snapshot
+            # version that is now published. A failure past here must
+            # NOT re-enter the retry loop — re-running the cycle would
+            # schedule the same batch against its own post-commit
+            # snapshot and double-charge every placement — so it is
+            # wrapped as terminal (_CommittedCycleError).
+            try:
+                # THIS call's commit version, captured under the lock —
+                # the shared last_committed_version attribute can
+                # already reflect a racing ingest by the time a caller
+                # reads it
+                version = self.store.version
+                self.last_committed_version = version
+                if self.on_assumed is not None and typed_pods is not None:
+                    # under the commit lock: an attached syncer's
+                    # rebuild (which serializes on the same lock)
+                    # cannot swap the builder between this batch's
+                    # snapshot and the hook's row-name resolution
+                    self.on_assumed(assignment, typed_pods, result)
+            except Exception as exc:
+                raise _CommittedCycleError(exc) from exc
+        return snap, result, assignment, health, pod_bad, version
+
+    def schedule(self, pods: PodBatch,
+                 pod_names: Optional[List[str]] = None,
+                 typed_pods: Optional[List] = None) -> core.ScheduleResult:
+        """`typed_pods` (batch-ordered api.Pod list) opts unplaced rows
+        into the error-handler chain — the reservation filter needs the
+        typed pod to recognize reserve pods.
+
+        Runtime failures are classified (errorhandler.classify_failure),
+        transients retried with bounded monotonic backoff, and
+        persistent failures walked down the degradation ladder; the
+        backoff sleeps happen OUTSIDE the commit lock so publishes and
+        ingests proceed while a retry waits."""
+        token = self.monitor.start_cycle()
+        backoff = Backoff(self.retry_policy, seed=self.batches)
+        attempts = 0
+        while True:
+            state, probing = self.ladder.begin_cycle()
+            try:
+                (snap, result, assignment, health, pod_bad,
+                 version) = self._locked_cycle(pods, typed_pods, state)
+                self.ladder.on_success(probing, state)
+                break
+            except _CommittedCycleError as exc:
+                # the snapshot already committed: never retry (see
+                # _CommittedCycleError), surface the hook's failure
+                self.monitor.complete_cycle(token)
+                raise exc.cause
+            except Exception as exc:
+                # every device-program failure routes through the
+                # FailureClass classifier (koordlint RB001)
+                fc = classify_failure(exc)
+                self.metrics.failures_classified.labels(fc.value).inc()
+                attempts += 1
+                log.warning(
+                    "scheduling cycle failed (class=%s, attempt %d, "
+                    "ladder=%s): %r", fc.value, attempts, state.label(),
+                    exc)
+                if attempts >= self.max_cycle_attempts:
+                    self.monitor.complete_cycle(token)
+                    raise
+                if probing:
+                    # a failed up-probe falls straight back; the
+                    # pre-probe state was never left
+                    self.ladder.on_failure(fc, probing=True)
+                    continue
+                if fc in TRANSIENT_CLASSES and not backoff.exhausted():
+                    self._sleep(backoff.next_delay())
+                    continue
+                if not self.ladder.on_failure(fc, probing=False):
+                    # no lower rung left: the failure is terminal
+                    self.monitor.complete_cycle(token)
+                    raise
+                backoff.reset()
+        self.last_ladder_state = state
+        if state.degraded or probing:
+            self.metrics.degraded_cycles.labels(state.label()).inc()
+        self.metrics.degradation_level.set(float(self.ladder.level))
+        word = int(health[0]) if health is not None else 0
+        self.last_health_word = word
+        pod_bad_np: Optional[np.ndarray] = None
+        if word:
+            defects = guards.decode_health_word(word)
+            for name in defects:
+                self.metrics.guard_trips.labels(name).inc()
+            n_bad_nodes, n_bad_pods = int(health[1]), int(health[2])
+            if n_bad_nodes:
+                self.metrics.quarantined_inputs.labels("node").inc(
+                    n_bad_nodes)
+            if n_bad_pods:
+                self.metrics.quarantined_inputs.labels("pod").inc(
+                    n_bad_pods)
+            if pod_bad is not None:
+                pod_bad_np = np.asarray(pod_bad)
+            log.warning(
+                "health guards tripped: word=0x%x (%s); %d node(s) / "
+                "%d pod(s) quarantined", word, ",".join(defects),
+                n_bad_nodes, n_bad_pods)
+        self.last_quarantined_pods = pod_bad_np
         self.last_elapsed = elapsed = self.monitor.complete_cycle(token)
+        if elapsed > self.monitor.timeout:
+            # the stall completed, but the NEXT cycle runs degraded:
+            # a watchdog trip is a classified failure like any other
+            self.metrics.failures_classified.labels(
+                FailureClass.WATCHDOG_STALL.value).inc()
+            self.ladder.on_failure(FailureClass.WATCHDOG_STALL,
+                                   probing=False)
         # per-CALL (version, elapsed) for the calling thread: the
         # threaded sidecar reads them after scheduling, and the shared
         # attributes race with concurrent ingests/schedules
@@ -556,8 +938,14 @@ class SchedulerService:
             self.batches += 1
             self.pods_placed += placed_n
         self.metrics.pods_scheduled.labels("placed").inc(placed_n)
+        unsched = (assignment < 0) & valid
+        if pod_bad_np is not None:
+            # quarantined rows are infrastructure errors, already
+            # counted per kind above — not "unschedulable" (cluster
+            # full) rows
+            unsched &= ~pod_bad_np
         self.metrics.pods_scheduled.labels("unschedulable").inc(
-            int(((assignment < 0) & valid).sum()))
+            int(unsched.sum()))
         self.metrics.snapshot_version.set(float(self.store.version))
         gang_failed = np.asarray(result.gang_failed)
         self.last_gang_failed = gang_failed
@@ -568,7 +956,7 @@ class SchedulerService:
                 dispatch_batch_errors,
             )
             dispatch_batch_errors(self.error_dispatcher, assignment, valid,
-                                  typed_pods)
+                                  typed_pods, infra_mask=pod_bad_np)
         if self.flags.score_top_n > 0:
             log.info("score table:\n%s", debug_score_table(
                 snap, pods, self.cfg, self.flags.score_top_n, pod_names))
@@ -599,4 +987,7 @@ class SchedulerService:
             "lastCycleSeconds": round(self.last_elapsed, 4),
             "cycleTimeouts": self.monitor.timeouts,
             "snapshotVersion": self.store.version,
+            "degradationLevel": DegradationLadder.LEVELS[self.ladder.level],
+            "ladderTransitions": len(self.ladder.transitions),
+            "lastHealthWord": self.last_health_word,
         }

@@ -14,7 +14,13 @@ from __future__ import annotations
 
 from koordinator_tpu.metrics import Registry, global_registry
 from koordinator_tpu.metrics.registry import (  # noqa: F401  (re-export)
+    SCHEDULER_DEGRADATION_LEVEL,
+    SCHEDULER_DEGRADED_CYCLES,
+    SCHEDULER_DELTA_REJECTED,
+    SCHEDULER_FAILURES_CLASSIFIED,
+    SCHEDULER_GUARD_TRIPS,
     SCHEDULER_PODS_SCHEDULED,
+    SCHEDULER_QUARANTINED_INPUTS,
     SCHEDULER_SCHEDULE_BATCH_KERNEL_SECONDS,
     SCHEDULER_SCHEDULE_CYCLE_SECONDS,
     SCHEDULER_SCHEDULING_TIMEOUT,
@@ -50,3 +56,30 @@ class SchedulerMetrics:
             SCHEDULER_SNAPSHOT_VERSION,
             "Version of the device-resident cluster snapshot last "
             "published")
+        # resilience layer (docs/DESIGN.md "Failure model & degradation
+        # ladder"): every runtime failure, guard trip, quarantined input
+        # row, and degraded cycle is countable per class
+        self.failures_classified = r.counter(
+            SCHEDULER_FAILURES_CLASSIFIED,
+            "Device-program failures by FailureClass "
+            "(errorhandler.classify_failure)", labels=("failure_class",))
+        self.guard_trips = r.counter(
+            SCHEDULER_GUARD_TRIPS,
+            "Device health-guard trips by defect class "
+            "(scheduler/guards.py packed-word bits)", labels=("defect",))
+        self.quarantined_inputs = r.counter(
+            SCHEDULER_QUARANTINED_INPUTS,
+            "Input rows quarantined by the health guards",
+            labels=("kind",))  # node | pod
+        self.degraded_cycles = r.counter(
+            SCHEDULER_DEGRADED_CYCLES,
+            "Scheduling cycles run below the normal ladder level "
+            "(probe cycles included)", labels=("level",))
+        self.degradation_level = r.gauge(
+            SCHEDULER_DEGRADATION_LEVEL,
+            "Current degradation-ladder level (0 = normal; "
+            "frameworkext.DegradationLadder.LEVELS order)")
+        self.delta_rejected = r.counter(
+            SCHEDULER_DELTA_REJECTED,
+            "Snapshot deltas rejected by the store's version guard "
+            "(out-of-order / duplicate replay)", labels=("reason",))

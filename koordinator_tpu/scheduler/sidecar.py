@@ -53,10 +53,14 @@ def _flat_template(cls):
     """Restore target for a FLAT flax struct: array dummies for pytree
     leaves only — STATIC (pytree_node=False) fields keep their defaults,
     because flax to_bytes/from_bytes carries leaves, not aux data (the
-    gate flags ride the proto instead)."""
+    gate flags ride the proto instead). `source_version` stays at its
+    None default: the wire carries it only as an OPTIONAL entry (see
+    _delta_to_bytes), and the decode path grafts a slot in when a frame
+    actually has one."""
     return cls(**{f.name: jnp.zeros((1,), jnp.float32)
                   for f in dataclasses.fields(cls)
-                  if f.metadata.get("pytree_node", True)})
+                  if f.metadata.get("pytree_node", True)
+                  and f.name != "source_version"})
 
 
 def _topology_template() -> NodeTopologyDelta:
@@ -64,10 +68,49 @@ def _topology_template() -> NodeTopologyDelta:
     needs the nested structure (leaf shapes are irrelevant)."""
     arrays = {f.name: jnp.zeros((1,), jnp.float32)
               for f in dataclasses.fields(NodeTopologyDelta)
-              if f.name != "metric"
+              if f.name not in ("metric", "source_version")
               and f.metadata.get("pytree_node", True)}
     return NodeTopologyDelta(**arrays,
                              metric=_flat_template(NodeMetricDelta))
+
+
+def _delta_to_bytes(delta) -> bytes:
+    """Encode a delta for the wire. An UNVERSIONED delta (source_version
+    None, nested metric included) omits the key entirely — byte-for-byte
+    the pre-version wire format, pinned by tests/test_sidecar_wire.py's
+    frozen frames. A stamped version rides as an optional scalar entry
+    (docs/SIDECAR_WIRE.md) so the store's replay guard works across the
+    sidecar; foreign decoders ignore keys they don't know."""
+    sd = flax.serialization.to_state_dict(delta)
+    for node in (sd, sd.get("metric")):
+        if isinstance(node, dict) \
+                and node.get("source_version", 0) is None:
+            node.pop("source_version")
+    # in_place=True like flax.to_bytes: the copying path runs the tree
+    # through jax tree-utils, which SORTS dict keys and silently
+    # reorders the wire map away from the frozen field-order frames
+    return flax.serialization.msgpack_serialize(sd, in_place=True)
+
+
+def _delta_from_bytes(template, body: bytes):
+    """Decode a delta frame: frames without a source_version entry (all
+    pre-version peers) restore as unversioned; frames carrying one get
+    a scalar slot grafted into the template so the stamp survives into
+    the store's replay guard."""
+    sd = flax.serialization.msgpack_restore(body)
+    for node, is_top in ((sd, True), (sd.get("metric"), False)):
+        if not isinstance(node, dict):
+            continue
+        if "source_version" in node:
+            slot = jnp.zeros((), jnp.int32)
+            if is_top:
+                template = template.replace(source_version=slot)
+            else:
+                template = template.replace(
+                    metric=template.metric.replace(source_version=slot))
+        else:
+            node["source_version"] = None
+    return flax.serialization.from_state_dict(template, sd)
 
 
 _GATE_FIELDS = ("has_taints", "has_spread", "has_anti", "has_aff")
@@ -124,8 +167,8 @@ class SchedulerSidecarServer:
             version=self.service.publish(snap))
 
     def _ingest(self, req: pb.IngestDeltaRequest) -> pb.IngestDeltaResponse:
-        delta = flax.serialization.from_bytes(_flat_template(NodeMetricDelta),
-                                              req.delta_msgpack)
+        delta = _delta_from_bytes(_flat_template(NodeMetricDelta),
+                                  req.delta_msgpack)
         # service.ingest, NOT store.ingest: the RPC server is threaded and
         # a delta racing a Schedule call must serialize with the commit
         return pb.IngestDeltaResponse(version=self.service.ingest(delta))
@@ -136,8 +179,8 @@ class SchedulerSidecarServer:
         patch — WITHOUT this, a sidecar deployment's topology churn
         falls back to the ~10 s full snapshot publish the delta plane
         exists to avoid (store.ingest dispatches on the delta type)."""
-        delta = flax.serialization.from_bytes(_topology_template(),
-                                              req.delta_msgpack)
+        delta = _delta_from_bytes(_topology_template(),
+                                  req.delta_msgpack)
         return pb.IngestTopologyResponse(
             version=self.service.ingest(delta))
 
@@ -183,7 +226,7 @@ class SchedulerSidecarClient:
         resp = self._rpc.call(
             "IngestDelta",
             pb.IngestDeltaRequest(
-                delta_msgpack=flax.serialization.to_bytes(delta)),
+                delta_msgpack=_delta_to_bytes(delta)),
             pb.IngestDeltaResponse)
         return resp.version
 
@@ -191,7 +234,7 @@ class SchedulerSidecarClient:
         resp = self._rpc.call(
             "IngestTopology",
             pb.IngestTopologyRequest(
-                delta_msgpack=flax.serialization.to_bytes(delta)),
+                delta_msgpack=_delta_to_bytes(delta)),
             pb.IngestTopologyResponse)
         return resp.version
 

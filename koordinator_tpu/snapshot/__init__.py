@@ -16,10 +16,12 @@ from koordinator_tpu.snapshot.schema import (  # noqa: F401
 )
 from koordinator_tpu.snapshot.builder import SnapshotBuilder  # noqa: F401
 from koordinator_tpu.snapshot.delta import (  # noqa: F401
+    DeltaRejectReason,
     NodeMetricDelta,
     NodeTopologyDelta,
     apply_metric_delta,
     apply_topology_delta,
+    delta_version,
     forget_pods,
 )
 from koordinator_tpu.snapshot.store import SnapshotStore  # noqa: F401

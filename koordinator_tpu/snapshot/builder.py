@@ -197,6 +197,10 @@ class SnapshotBuilder:
         self.max_spread_groups = max_spread_groups
         self.max_spread_domains = max_spread_domains
         self._taint_groups: Dict[tuple, int] = {}
+        # monotonically increasing delta sequence this builder stamps
+        # into every emitted delta (snapshot/delta.py source_version) —
+        # the store's replay guard keys on it
+        self._delta_version = 0
         self.metric_expiration_s = metric_expiration_s
         # estimator config must match the LoadAware plugin args so that
         # PodBatch.estimated and the assign-cache columns agree with the
@@ -677,8 +681,20 @@ class SnapshotBuilder:
         return (True, usage, prod_usage, agg, has_agg,
                 assigned_est, assigned_corr, prod_est, prod_corr)
 
+    def _next_delta_version(self, version: Optional[int]) -> np.ndarray:
+        """Stamp for an emitted delta: the explicit `version` wins (and
+        advances the high-water mark), else the builder's own sequence
+        increments. The store rejects replays against it."""
+        if version is None:
+            self._delta_version += 1
+            version = self._delta_version
+        else:
+            self._delta_version = max(self._delta_version, int(version))
+        return np.asarray(int(version), np.int32)
+
     def metric_delta(self, names: Sequence[str], now: Optional[float] = None,
-                     pad_to: Optional[int] = None) -> "NodeMetricDelta":
+                     pad_to: Optional[int] = None,
+                     version: Optional[int] = None) -> "NodeMetricDelta":
         """Per-node metric ingest: the changed nodes' metric-derived
         columns as a fixed-capacity delta the store applies DEVICE-SIDE
         (snapshot/delta.py) — no full column re-upload. `pad_to` fixes the
@@ -716,11 +732,13 @@ class SnapshotBuilder:
             idx=idx, metric_fresh=fresh, usage=usage, prod_usage=prod_usage,
             agg_usage=agg, has_agg=has_agg, assigned_estimated=est,
             assigned_correction=corr, prod_assigned_estimated=p_est,
-            prod_assigned_correction=p_corr)
+            prod_assigned_correction=p_corr,
+            source_version=self._next_delta_version(version))
 
     def topology_delta(self, names: Sequence[str],
                        now: Optional[float] = None,
-                       pad_to: Optional[int] = None) -> "NodeTopologyDelta":
+                       pad_to: Optional[int] = None,
+                       version: Optional[int] = None) -> "NodeTopologyDelta":
         """Node add/remove/update as an O(K) column delta (snapshot/
         delta.py NodeTopologyDelta): for each name, the node's complete
         identity + device + metric row exactly as a full rebuild would
@@ -871,7 +889,8 @@ class SnapshotBuilder:
                 prod_usage=prod_usage, agg_usage=agg, has_agg=has_agg,
                 assigned_estimated=est, assigned_correction=corr,
                 prod_assigned_estimated=p_est,
-                prod_assigned_correction=p_corr))
+                prod_assigned_correction=p_corr),
+            source_version=self._next_delta_version(version))
 
     def build_reservations(self, owner_groups: Dict[str, int],
                            nodes: "NodeState",

@@ -16,12 +16,14 @@ and re-upload. Fixed K means repeated ingests reuse one compiled program.
 
 from __future__ import annotations
 
+import enum
 import functools
 from typing import Optional
 
 import flax.struct
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from koordinator_tpu.api.extension import ResourceKind as _RK
 from koordinator_tpu.snapshot.schema import (
@@ -33,14 +35,43 @@ from koordinator_tpu.snapshot.schema import (
 
 _CPU = int(_RK.CPU)
 
-__all__ = ["NodeMetricDelta", "NodeTopologyDelta", "apply_metric_delta",
-           "apply_topology_delta", "forget_pods"]
+__all__ = ["NodeMetricDelta", "NodeTopologyDelta", "DeltaRejectReason",
+           "apply_metric_delta", "apply_topology_delta", "delta_version",
+           "forget_pods"]
+
+
+class DeltaRejectReason(enum.Enum):
+    """Why the store's version guard refused to apply a delta — the
+    typed reason surfaced to metrics (scheduler_delta_rejected) and to
+    `SnapshotStore.take_delta_rejection`."""
+
+    STALE_VERSION = "stale_version"          # version < last applied
+    DUPLICATE_VERSION = "duplicate_version"  # version == last applied
+
+
+def delta_version(delta) -> Optional[int]:
+    """Host-side read of a delta's source version; None = unversioned
+    (legacy producers and the sidecar wire format), which always
+    applies. Deltas are built host-side, so this never syncs a
+    device value."""
+    v = getattr(delta, "source_version", None)
+    if v is None:
+        return None
+    return int(np.asarray(v))
 
 
 @flax.struct.dataclass
 class NodeMetricDelta:
     """K node rows of metric-derived columns (builder.metric_delta output);
-    idx = -1 rows are padding and apply nowhere."""
+    idx = -1 rows are padding and apply nowhere.
+
+    `source_version` is the producer's monotonically increasing delta
+    sequence number (builder stamps it per emission; None = unversioned).
+    The STORE, not the apply kernel, enforces ordering: a delta whose
+    version is <= the last applied one is an out-of-order or duplicate
+    replay and no-ops idempotently with a typed reason
+    (DeltaRejectReason) — silently re-applying it would scatter stale
+    rows over fresher ones."""
 
     idx: Array                       # i32[K] node row, -1 = pad
     metric_fresh: Array              # bool[K]
@@ -52,6 +83,7 @@ class NodeMetricDelta:
     assigned_correction: Array       # f32[K, R]
     prod_assigned_estimated: Array   # f32[K, R]
     prod_assigned_correction: Array  # f32[K, R]
+    source_version: Array = None     # i32[] producer sequence, None = unversioned
 
 
 register_struct(NodeMetricDelta, {
@@ -65,6 +97,7 @@ register_struct(NodeMetricDelta, {
     "assigned_correction": "f32[K,R]",
     "prod_assigned_estimated": "f32[K,R]",
     "prod_assigned_correction": "f32[K,R]",
+    "source_version": "?i32[]",
 })
 
 
@@ -140,6 +173,8 @@ class NodeTopologyDelta:
     aux_free: Array           # f32[K, A, J]
     aux_valid: Array          # bool[K, A, J]
     metric: NodeMetricDelta = None  # same idx; None only pre-init
+    source_version: Array = None    # i32[] producer sequence (see
+                                    # NodeMetricDelta.source_version)
 
 
 register_struct(NodeTopologyDelta, {
@@ -162,6 +197,7 @@ register_struct(NodeTopologyDelta, {
     "aux_free": "f32[K,AX,J]",
     "aux_valid": "bool[K,AX,J]",
     "metric": "NodeMetricDelta",
+    "source_version": "?i32[]",
 })
 
 

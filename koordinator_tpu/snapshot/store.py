@@ -36,10 +36,28 @@ class SnapshotStore:
         self._lock = threading.Lock()
         self._current: Optional[ClusterSnapshot] = None
         self._version = 0
+        # delta replay guard: highest source_version applied since the
+        # last full publish (a publish opens a new delta epoch)
+        self._applied_delta_version = 0
+        self._last_delta_rejection = None
+        self.delta_rejections = 0
 
     @property
     def version(self) -> int:
         return self._version
+
+    @property
+    def applied_delta_version(self) -> int:
+        return self._applied_delta_version
+
+    def take_delta_rejection(self):
+        """Pop the last ingest's DeltaRejectReason (None if it applied)
+        — the typed-reason handoff SchedulerService.ingest surfaces to
+        the scheduler_delta_rejected metric."""
+        with self._lock:
+            reason = self._last_delta_rejection
+            self._last_delta_rejection = None
+            return reason
 
     def publish(self, snapshot: ClusterSnapshot) -> ClusterSnapshot:
         """Upload a host-built snapshot; returns the device-resident
@@ -53,6 +71,11 @@ class SnapshotStore:
         with self._lock:
             self._version += 1
             self._current = on_device
+            # a full publish is a new delta epoch: a restarted producer
+            # restarts its sequence at 1 and must not be rejected
+            # against a previous epoch's high-water mark
+            self._applied_delta_version = 0
+            self._last_delta_rejection = None
         return on_device
 
     def current(self) -> ClusterSnapshot:
@@ -77,16 +100,45 @@ class SnapshotStore:
         rebuild — the informer event-handler path of the reference, on
         columns. Topology deltas patch node identity (add/remove/update
         rows) within the padded capacity; metric deltas refresh the
-        NodeMetric-derived columns."""
+        NodeMetric-derived columns.
+
+        Versioned deltas (`source_version` set) are guarded against
+        out-of-order / duplicate replay: a version <= the last applied
+        one no-ops IDEMPOTENTLY — the snapshot and store version are
+        untouched — and the typed reason is held for
+        `take_delta_rejection`. Re-applying a stale delta would scatter
+        old rows over fresher ones (last-writer-wins per node row), the
+        exact mis-apply this guard exists for. Unversioned deltas
+        always apply (legacy producers, the sidecar wire)."""
         from koordinator_tpu.snapshot.delta import (
+            DeltaRejectReason,
             NodeTopologyDelta,
             apply_metric_delta,
             apply_topology_delta,
+            delta_version,
         )
 
+        ver = delta_version(delta)
         if isinstance(delta, NodeTopologyDelta):
-            return self.update(lambda s: apply_topology_delta(s, delta))
-        return self.update(lambda s: apply_metric_delta(s, delta))
+            apply = apply_topology_delta
+        else:
+            apply = apply_metric_delta
+        with self._lock:
+            if self._current is None:
+                raise RuntimeError("no snapshot published yet")
+            if ver is not None:
+                if ver <= self._applied_delta_version:
+                    self._last_delta_rejection = (
+                        DeltaRejectReason.DUPLICATE_VERSION
+                        if ver == self._applied_delta_version
+                        else DeltaRejectReason.STALE_VERSION)
+                    self.delta_rejections += 1
+                    return self._current
+                self._applied_delta_version = ver
+            self._last_delta_rejection = None
+            self._current = apply(self._current, delta)
+            self._version += 1
+            return self._current
 
     def forget(self, pods, result, mask) -> ClusterSnapshot:
         """Un-assume failed binds (scheduler_adapter.go Forget): returns

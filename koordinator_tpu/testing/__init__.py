@@ -1,0 +1,3 @@
+"""Deterministic test scaffolding shared by the chaos CI stage
+(tools/chaos_smoke.py), the soak harness (tools/soak_service.py
+--chaos), and the fault-injection test batteries."""

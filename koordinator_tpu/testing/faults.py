@@ -1,0 +1,192 @@
+"""Seeded, deterministic fault injectors for the resilience layer.
+
+Each injector produces exactly one fault instance from a seeded RNG, so
+a failing chaos run reproduces from its seed alone. Three families:
+
+- column corruptions: host-side edits of snapshot/batch columns (the
+  poison the device health guards in scheduler/guards.py must catch);
+- delta replay: stale/duplicate `source_version` stamps (the store's
+  version guard);
+- runtime failures: hooks for `SchedulerService.fault_injection` that
+  raise real `XlaRuntimeError`s (OOM above a width threshold, fail the
+  Nth program attempt) or trip the cycle watchdog — driving the typed
+  classifier and the degradation ladder.
+
+Consumed by tools/chaos_smoke.py (the CI matrix), tools/soak_service.py
+--chaos, and tests/test_degradation.py.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Tuple
+
+import numpy as np
+
+from koordinator_tpu.scheduler import guards
+
+# every fault class the chaos matrix exercises; tools/chaos_smoke.py
+# asserts detection + quarantine + service-up + clean-row conformance
+# for each one
+SNAPSHOT_FAULTS = ("nan_metric_column", "negative_allocatable",
+                   "overcommit_row", "numa_free_above_cap")
+BATCH_FAULTS = ("nan_pod_request", "negative_pod_request",
+                "bad_gang_id", "bad_domain_index")
+RUNTIME_FAULTS = ("xla_oom", "xla_transient", "device_lost",
+                  "watchdog_stall")
+DELTA_FAULTS = ("stale_delta",)
+ALL_FAULTS = SNAPSHOT_FAULTS + BATCH_FAULTS + RUNTIME_FAULTS + DELTA_FAULTS
+
+# fault class -> guard-word bit the detection assertion checks
+EXPECTED_BIT = {
+    "nan_metric_column": guards.NODE_METRIC_NONFINITE,
+    "negative_allocatable": guards.NODE_BAD_ALLOCATABLE,
+    "overcommit_row": guards.NODE_OVERCOMMIT,
+    "numa_free_above_cap": guards.NODE_NUMA_INVALID,
+    "nan_pod_request": guards.POD_NONFINITE,
+    "negative_pod_request": guards.POD_NEGATIVE,
+    "bad_gang_id": guards.POD_ID_RANGE,
+    "bad_domain_index": guards.POD_DOMAIN_RANGE,
+}
+
+
+def make_xla_error(message: str) -> Exception:
+    """A REAL XlaRuntimeError when the runtime exposes one (it is the
+    exception class device programs actually raise), else a stand-in
+    with the same type name so `classify_failure`'s mro-name fallback
+    still engages."""
+    try:
+        from jax.errors import JaxRuntimeError
+        return JaxRuntimeError(message)
+    except Exception:  # pragma: no cover - jaxlib layout drift
+        err_type = type("XlaRuntimeError", (RuntimeError,), {})
+        return err_type(message)
+
+
+class FaultInjector:
+    """One seeded source of faults; every choice draws from the seed."""
+
+    def __init__(self, seed: int = 0):
+        self.seed = seed
+        self.rng = np.random.default_rng(seed)
+
+    # --- column corruptions ------------------------------------------------
+
+    def corrupt_snapshot(self, snap, kind: str,
+                         n_rows: int = 1) -> Tuple[object, np.ndarray]:
+        """-> (corrupted snapshot, corrupted node row indices)."""
+        import jax.numpy as jnp
+
+        nodes = snap.nodes
+        n = int(np.asarray(nodes.schedulable).shape[0])
+        rows = np.sort(self.rng.choice(n, size=min(n_rows, n),
+                                       replace=False))
+        if kind == "nan_metric_column":
+            usage = np.asarray(nodes.usage).copy()
+            usage[rows, self.rng.integers(usage.shape[1])] = np.nan
+            nodes = nodes.replace(usage=jnp.asarray(usage))
+        elif kind == "negative_allocatable":
+            alloc = np.asarray(nodes.allocatable).copy()
+            alloc[rows, self.rng.integers(alloc.shape[1])] = -1.0
+            nodes = nodes.replace(allocatable=jnp.asarray(alloc))
+        elif kind == "overcommit_row":
+            req = np.asarray(nodes.requested).copy()
+            req[rows] = np.asarray(nodes.allocatable)[rows] \
+                + guards.OVERCOMMIT_TOL + 50.0
+            nodes = nodes.replace(requested=jnp.asarray(req))
+        elif kind == "numa_free_above_cap":
+            free = np.asarray(nodes.numa_free).copy()
+            valid = np.asarray(nodes.numa_valid)
+            # only a VALID zone counts as inconsistent; force one
+            free[rows, 0, 0] = np.asarray(nodes.numa_cap)[rows, 0, 0] \
+                + guards.OVERCOMMIT_TOL + 10.0
+            nv = valid.copy()
+            nv[rows, 0] = True
+            nodes = nodes.replace(numa_free=jnp.asarray(free),
+                                  numa_valid=jnp.asarray(nv))
+        else:
+            raise ValueError(f"unknown snapshot fault {kind!r}")
+        return snap.replace(nodes=nodes), rows
+
+    def corrupt_batch(self, pods, kind: str,
+                      n_rows: int = 1) -> Tuple[object, np.ndarray]:
+        """-> (corrupted batch, quarantine-expected pod row indices)."""
+        import jax.numpy as jnp
+
+        p = int(np.asarray(pods.valid).shape[0])
+        rows = np.sort(self.rng.choice(p, size=min(n_rows, p),
+                                       replace=False))
+        if kind == "nan_pod_request":
+            req = np.asarray(pods.requests).copy()
+            req[rows, self.rng.integers(req.shape[1])] = np.nan
+            return pods.replace(requests=jnp.asarray(req)), rows
+        if kind == "negative_pod_request":
+            req = np.asarray(pods.requests).copy()
+            req[rows, self.rng.integers(req.shape[1])] = -100.0
+            return pods.replace(requests=jnp.asarray(req)), rows
+        if kind == "bad_gang_id":
+            gid = np.asarray(pods.gang_id).copy()
+            gid[rows] = 1_000_000
+            return pods.replace(gang_id=jnp.asarray(gid)), rows
+        if kind == "bad_domain_index":
+            if not pods.has_spread:
+                raise ValueError("bad_domain_index needs a spread-"
+                                 "modeling batch")
+            dom = np.asarray(pods.spread_domain).copy()
+            g = int(self.rng.integers(dom.shape[0]))
+            dom[g, self.rng.integers(dom.shape[1])] = \
+                np.asarray(pods.spread_count0).shape[1] + 3
+            carriers = np.where(np.asarray(pods.spread_carrier)[:, g])[0]
+            return pods.replace(spread_domain=jnp.asarray(dom)), carriers
+        raise ValueError(f"unknown batch fault {kind!r}")
+
+    # --- delta replay ------------------------------------------------------
+
+    def stale_delta(self, delta, applied_version: Optional[int] = None):
+        """Re-stamp a delta so it replays at/below the applied version
+        (<= the high-water mark -> the store must no-op it)."""
+        cur = applied_version
+        if cur is None:
+            cur = int(np.asarray(delta.source_version))
+        stale = int(self.rng.integers(0, max(cur, 1)))
+        return delta.replace(source_version=np.asarray(stale, np.int32))
+
+    # --- runtime failures (SchedulerService.fault_injection hooks) ---------
+
+    def oom_above(self, width: int) -> Callable:
+        """OOM whenever the program's batch is wider than `width` — the
+        allocator model chunk-halving degrades past."""
+
+        def hook(_state, batch):
+            if int(np.asarray(batch.valid).shape[0]) > width:
+                raise make_xla_error(
+                    "RESOURCE_EXHAUSTED: Out of memory while trying to "
+                    "allocate 9182736455 bytes.")
+
+        return hook
+
+    def fail_nth_calls(self, fail_attempts, message: str) -> Callable:
+        """Raise on the given (1-based) program attempts, succeed on the
+        rest — the transient-failure model bounded retry must absorb."""
+        fail = set(int(i) for i in fail_attempts)
+        counter = {"n": 0}
+
+        def hook(_state, _batch):
+            counter["n"] += 1
+            if counter["n"] in fail:
+                raise make_xla_error(message)
+
+        return hook
+
+    def device_lost(self, fail_attempts) -> Callable:
+        return self.fail_nth_calls(
+            fail_attempts, "UNAVAILABLE: device lost; socket closed")
+
+    def xla_transient(self, fail_attempts) -> Callable:
+        return self.fail_nth_calls(
+            fail_attempts, "INTERNAL: ran out of program cache slots")
+
+    @staticmethod
+    def stall_watchdog(service) -> None:
+        """Force every cycle over the watchdog budget: the stall is
+        classified and the NEXT cycle runs one rung down."""
+        service.monitor.timeout = 0.0

@@ -1,0 +1,41 @@
+"""RB001 negatives: classified handlers, narrow handlers, and broad
+handlers around host-only work."""
+
+import json
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def kernel(x):
+    return jnp.sum(x * 2.0)
+
+
+def classify_failure(exc):
+    return type(exc).__name__
+
+
+def classified(x):
+    try:
+        return kernel(x)
+    except Exception as exc:
+        # routed through the typed model: not flagged
+        return classify_failure(exc)
+
+
+def narrow(x):
+    try:
+        return kernel(x)
+    except ValueError:
+        # a narrow handler is a deliberate, typed choice already
+        return None
+
+
+def host_only(path):
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except Exception:
+        # no device-program call in the try body: out of scope
+        return None

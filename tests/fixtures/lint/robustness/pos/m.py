@@ -1,0 +1,43 @@
+"""RB001 positives: broad handlers around device-program calls with no
+FailureClass classification."""
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def kernel(x):
+    return jnp.sum(x * 2.0)
+
+
+def sweep(x):
+    # transitively device-reaching: sweep -> kernel (a jit entry)
+    return kernel(x) + 1.0
+
+
+def direct(x):
+    try:
+        return kernel(x)
+    except Exception:  # RB001: untyped swallow of a device failure
+        return None
+
+
+def transitive(x):
+    try:
+        return sweep(x)
+    except:  # noqa: E722  RB001: bare except, one call from the kernel
+        return None
+
+
+def via_alias(x):
+    try:
+        return fast(x)
+    except BaseException:  # RB001: alias form g = jax.jit(f)
+        return None
+
+
+def _impl(x):
+    return x + 1
+
+
+fast = jax.jit(_impl)

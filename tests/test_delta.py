@@ -130,6 +130,108 @@ def test_forget_restores_reservation_consumer():
                                np.asarray(snap.nodes.requested))
 
 
+def test_stale_and_duplicate_deltas_noop_idempotently():
+    """The replay guard (ISSUE 13 satellite): a delta whose version is
+    <= the applied one must NOT scatter — before this guard, replaying
+    v1 after v2 silently overwrote n0's fresher usage with the stale
+    row."""
+    from koordinator_tpu.snapshot.delta import DeltaRejectReason
+
+    b = make_builder()
+    snap, _ = b.build(now=NOW)
+    store = SnapshotStore()
+    store.publish(snap)
+    b.set_node_metric(NodeMetric(node_name="n0", update_time=NOW + 1,
+                                 node_usage={RK.CPU: 1_000.0}))
+    d1 = b.metric_delta(["n0"], now=NOW + 1, pad_to=2)
+    b.set_node_metric(NodeMetric(node_name="n0", update_time=NOW + 2,
+                                 node_usage={RK.CPU: 2_000.0}))
+    d2 = b.metric_delta(["n0"], now=NOW + 2, pad_to=2)
+    assert int(np.asarray(d2.source_version)) \
+        > int(np.asarray(d1.source_version))
+
+    store.ingest(d2)
+    assert store.take_delta_rejection() is None
+    v_after = store.version
+    fresh_usage = np.asarray(store.current().nodes.usage).copy()
+
+    # out-of-order replay of d1: idempotent no-op with a typed reason
+    out = store.ingest(d1)
+    assert store.take_delta_rejection() is DeltaRejectReason.STALE_VERSION
+    assert store.version == v_after
+    np.testing.assert_array_equal(np.asarray(out.nodes.usage),
+                                  fresh_usage)
+    # exact duplicate of d2: same, but named a duplicate
+    store.ingest(d2)
+    assert store.take_delta_rejection() \
+        is DeltaRejectReason.DUPLICATE_VERSION
+    assert store.version == v_after and store.delta_rejections == 2
+    np.testing.assert_array_equal(
+        np.asarray(store.current().nodes.usage)[0, int(RK.CPU)], 2_000.0)
+
+
+def test_publish_opens_a_new_delta_epoch():
+    """A restarted producer restarts its sequence at 1; the full publish
+    resets the high-water mark so the fresh sequence is not rejected
+    against the previous epoch."""
+    b = make_builder()
+    snap, _ = b.build(now=NOW)
+    store = SnapshotStore()
+    store.publish(snap)
+    for _ in range(3):
+        b.set_node_metric(NodeMetric(node_name="n0",
+                                     update_time=NOW + 1,
+                                     node_usage={RK.CPU: 100.0}))
+        store.ingest(b.metric_delta(["n0"], now=NOW + 1, pad_to=2))
+    assert store.applied_delta_version == 3
+    store.publish(snap)  # rebuild: new epoch
+    assert store.applied_delta_version == 0
+    b2 = make_builder()  # restarted producer: sequence restarts at 1
+    b2.set_node_metric(NodeMetric(node_name="n0", update_time=NOW + 5,
+                                  node_usage={RK.CPU: 4_242.0}))
+    store.ingest(b2.metric_delta(["n0"], now=NOW + 6, pad_to=2))
+    assert store.take_delta_rejection() is None
+    np.testing.assert_allclose(
+        np.asarray(store.current().nodes.usage)[0, int(RK.CPU)], 4_242.0)
+
+
+def test_service_ingest_surfaces_rejection_metric():
+    from koordinator_tpu.metrics import Registry
+    from koordinator_tpu.scheduler.frameworkext import SchedulerService
+    from koordinator_tpu.scheduler.metrics_defs import SchedulerMetrics
+
+    reg = Registry()
+    svc = SchedulerService(metrics=SchedulerMetrics(reg))
+    b = make_builder()
+    snap, _ = b.build(now=NOW)
+    svc.publish(snap)
+    d1 = b.metric_delta(["n0"], now=NOW + 1, pad_to=2)
+    d2 = b.metric_delta(["n1"], now=NOW + 2, pad_to=2)
+    svc.ingest(d2)
+    v = svc.last_committed_version
+    assert svc.ingest(d1) == v  # stale: version unchanged
+    exposed = reg.expose()
+    assert 'scheduler_delta_rejected{reason="stale_version"} 1' in exposed
+
+
+def test_unversioned_delta_always_applies():
+    """The sidecar wire format carries no source_version yet; a delta
+    with source_version=None must keep the pre-guard semantics."""
+    b = make_builder()
+    snap, _ = b.build(now=NOW)
+    store = SnapshotStore()
+    store.publish(snap)
+    b.set_node_metric(NodeMetric(node_name="n0", update_time=NOW + 1,
+                                 node_usage={RK.CPU: 777.0}))
+    delta = b.metric_delta(["n0"], now=NOW + 2, pad_to=2)
+    delta = delta.replace(source_version=None)
+    for _ in range(2):  # replays apply too — no guard without a version
+        store.ingest(delta)
+        assert store.take_delta_rejection() is None
+    np.testing.assert_allclose(
+        np.asarray(store.current().nodes.usage)[0, int(RK.CPU)], 777.0)
+
+
 def test_ingest_10k_nodes_fits_cycle_budget():
     n = 10_000
     b = SnapshotBuilder(max_nodes=n)
@@ -149,8 +251,11 @@ def test_ingest_10k_nodes_fits_cycle_budget():
     delta = b.metric_delta(names, now=NOW + 2, pad_to=256)
     store.ingest(delta)  # warm-up compiles the scatter program
     t0 = time.perf_counter()
-    for _ in range(5):
-        out = store.ingest(delta)
+    for tick in range(5):
+        # fresh versions per tick: the replay guard would otherwise
+        # no-op every repeat and the loop would time nothing
+        out = store.ingest(delta.replace(
+            source_version=np.asarray(delta.source_version) + 1 + tick))
     np.asarray(out.nodes.usage)  # force materialization
     per_tick = (time.perf_counter() - t0) / 5
     # SURVEY §7: the whole scheduling cycle has a 2 s budget; ingest must

@@ -1,0 +1,188 @@
+"""Device health guards (scheduler/guards.py): packed-word layout, one
+mask row per defect class, quarantine semantics, and the fused
+guarded_schedule_batch's bit-identity on healthy inputs.
+
+The full chaos matrix (detection + quarantine + service-up + clean-row
+oracle conformance per fault class) runs as the dedicated
+tools/chaos_smoke.py CI stage; tests here pin the kernel-level
+contracts the stage builds on.
+"""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from koordinator_tpu.scheduler import core, guards
+from koordinator_tpu.scheduler.plugins import loadaware
+from koordinator_tpu.testing import faults
+from koordinator_tpu.utils import synthetic
+
+N, P = 32, 64
+CFG = loadaware.LoadAwareConfig.make()
+
+
+def make_inputs(seed=0):
+    snap = synthetic.full_gate_cluster(N, seed=seed, num_quotas=4,
+                                       num_gangs=4)
+    pods = synthetic.full_gate_pods(P, N, seed=seed + 7, num_quotas=4,
+                                    num_gangs=4)
+    return snap, pods
+
+
+# --- packed-word layout ----------------------------------------------------
+
+def test_word_layout_is_stable():
+    """The bit positions are wire format for dashboards and the chaos
+    matrix: moving one silently re-labels every alert."""
+    assert guards.NODE_METRIC_NONFINITE == 1 << 0
+    assert guards.NODE_BAD_ALLOCATABLE == 1 << 1
+    assert guards.NODE_BAD_REQUESTED == 1 << 2
+    assert guards.NODE_OVERCOMMIT == 1 << 3
+    assert guards.NODE_NUMA_INVALID == 1 << 4
+    assert guards.POD_NONFINITE == 1 << 8
+    assert guards.POD_NEGATIVE == 1 << 9
+    assert guards.POD_ID_RANGE == 1 << 10
+    assert guards.POD_DOMAIN_RANGE == 1 << 11
+    # every bit named exactly once; decode round-trips
+    assert len(guards.DEFECT_NAMES) == 9
+    word = guards.NODE_OVERCOMMIT | guards.POD_ID_RANGE
+    assert guards.decode_health_word(word) == ("node_overcommit",
+                                               "pod_id_range")
+    assert guards.decode_health_word(0) == ()
+
+
+def test_healthy_inputs_scan_clean():
+    snap, pods = make_inputs()
+    w, node_bad = guards.snapshot_health(snap)
+    assert int(np.asarray(w)) == guards.HEALTH_OK
+    assert not np.asarray(node_bad).any()
+    w, pod_bad = guards.batch_health(snap, pods)
+    assert int(np.asarray(w)) == guards.HEALTH_OK
+    assert not np.asarray(pod_bad).any()
+
+
+# --- one defect class at a time -------------------------------------------
+
+@pytest.mark.parametrize("kind", faults.SNAPSHOT_FAULTS)
+def test_snapshot_defect_sets_its_bit_and_rows(kind):
+    snap, _ = make_inputs(2)
+    inj = faults.FaultInjector(11)
+    bad_snap, rows = inj.corrupt_snapshot(snap, kind, n_rows=3)
+    w, mask = guards.snapshot_health(bad_snap)
+    w, mask = int(np.asarray(w)), np.asarray(mask)
+    assert w & faults.EXPECTED_BIT[kind], guards.decode_health_word(w)
+    assert set(np.where(mask)[0]) == set(rows.tolist())
+
+
+@pytest.mark.parametrize("kind", faults.BATCH_FAULTS)
+def test_batch_defect_sets_its_bit_and_rows(kind):
+    snap, pods = make_inputs(3)
+    inj = faults.FaultInjector(13)
+    bad_pods, rows = inj.corrupt_batch(pods, kind, n_rows=3)
+    w, mask = guards.batch_health(snap, bad_pods)
+    w, mask = int(np.asarray(w)), np.asarray(mask)
+    assert w & faults.EXPECTED_BIT[kind], guards.decode_health_word(w)
+    assert set(rows.tolist()) <= set(np.where(mask)[0].tolist())
+
+
+def test_id_range_allows_the_none_sentinel():
+    """-1 is 'no gang / no quota / match-all selector' everywhere; the
+    guard must not quarantine the whole unconstrained workload."""
+    snap, pods = make_inputs(4)
+    neg1 = jnp.full_like(pods.gang_id, -1)
+    pods = pods.replace(gang_id=neg1, quota_id=neg1, selector_id=neg1)
+    w, mask = guards.batch_health(snap, pods)
+    assert not (int(np.asarray(w)) & guards.POD_ID_RANGE)
+    assert not np.asarray(mask).any()
+
+
+# --- quarantine semantics --------------------------------------------------
+
+def test_apply_quarantine_is_bitwise_identity_on_false_masks():
+    snap, pods = make_inputs(5)
+    q_snap, q_pods = guards.apply_quarantine(
+        snap, pods, jnp.zeros((N,), bool), jnp.zeros((P,), bool))
+    for field in ("allocatable", "requested", "usage", "numa_free"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(q_snap.nodes, field)),
+            np.asarray(getattr(snap.nodes, field)), err_msg=field)
+    np.testing.assert_array_equal(np.asarray(q_pods.requests),
+                                  np.asarray(pods.requests))
+    np.testing.assert_array_equal(np.asarray(q_pods.valid),
+                                  np.asarray(pods.valid))
+
+
+def test_apply_quarantine_scrubs_and_pins_out_bad_rows():
+    snap, pods = make_inputs(6)
+    inj = faults.FaultInjector(17)
+    bad_snap, rows = inj.corrupt_snapshot(snap, "nan_metric_column")
+    node_bad = np.zeros((N,), bool)
+    node_bad[rows] = True
+    q_snap, _ = guards.apply_quarantine(
+        bad_snap, pods, jnp.asarray(node_bad), jnp.zeros((P,), bool))
+    sched = np.asarray(q_snap.nodes.schedulable)
+    assert not sched[rows].any()
+    assert np.isfinite(np.asarray(q_snap.nodes.usage)).all()
+    # healthy rows untouched, bitwise
+    keep = ~node_bad
+    np.testing.assert_array_equal(
+        np.asarray(q_snap.nodes.usage)[keep],
+        np.asarray(bad_snap.nodes.usage)[keep])
+
+
+def test_quarantine_scrubs_bad_domain_group_to_minus_one():
+    snap, pods = make_inputs(7)
+    inj = faults.FaultInjector(19)
+    bad_pods, carriers = inj.corrupt_batch(pods, "bad_domain_index")
+    w, mask = guards.batch_health(snap, bad_pods)
+    _, q_pods = guards.apply_quarantine(snap, bad_pods,
+                                        jnp.zeros((N,), bool), mask)
+    dom = np.asarray(q_pods.spread_domain)
+    d = np.asarray(q_pods.spread_count0).shape[1]
+    assert ((dom >= -1) & (dom < d)).all(), "scrub left an OOB entry"
+    assert not np.asarray(q_pods.valid)[carriers].any()
+
+
+# --- the fused program -----------------------------------------------------
+
+def test_guarded_schedule_batch_bit_identical_when_healthy():
+    snap, pods = make_inputs(8)
+    res0 = core.schedule_batch(snap, pods, CFG, num_rounds=2, k_choices=4)
+    res1, health, node_bad, pod_bad = guards.guarded_schedule_batch(
+        snap, pods, CFG, num_rounds=2, k_choices=4)
+    h = np.asarray(health)
+    assert h.dtype == np.uint32 and h.shape == (3,)
+    assert int(h[0]) == 0 and int(h[1]) == 0 and int(h[2]) == 0
+    for field in core.PER_POD_RESULT_FIELDS:
+        np.testing.assert_array_equal(
+            np.asarray(getattr(res0, field)),
+            np.asarray(getattr(res1, field)), err_msg=field)
+
+
+def test_guarded_schedule_matches_masked_oracle_under_faults():
+    """The acceptance pin at kernel level: placements of the guarded
+    program on corrupted inputs equal the plain program on CLEAN inputs
+    with the corrupted rows masked manually — corruption never leaks
+    into clean rows."""
+    snap, pods = make_inputs(9)
+    inj = faults.FaultInjector(23)
+    bad_snap, n_rows = inj.corrupt_snapshot(snap, "nan_metric_column",
+                                            n_rows=2)
+    bad_pods, p_rows = inj.corrupt_batch(pods, "nan_pod_request",
+                                         n_rows=3)
+    res, health, _nb, _pb = guards.guarded_schedule_batch(
+        bad_snap, bad_pods, CFG, num_rounds=2, k_choices=4)
+    sched = np.asarray(snap.nodes.schedulable).copy()
+    sched[n_rows] = False
+    valid = np.asarray(pods.valid).copy()
+    valid[p_rows] = False
+    oracle = core.schedule_batch(
+        snap.replace(nodes=snap.nodes.replace(
+            schedulable=jnp.asarray(sched))),
+        pods.replace(valid=jnp.asarray(valid)),
+        CFG, num_rounds=2, k_choices=4)
+    np.testing.assert_array_equal(np.asarray(res.assignment),
+                                  np.asarray(oracle.assignment))
+    word = int(np.asarray(health)[0])
+    assert word & guards.NODE_METRIC_NONFINITE
+    assert word & guards.POD_NONFINITE

@@ -37,6 +37,7 @@ _ANALYZER_NAMES = {
     "lock_discipline": "lock-discipline",
     "metric_names": "metric-registry",
     "proto_drift": "proto-drift",
+    "robustness": "robustness",
     "shape_contract": "shape-contract",
     "tail_readback": "tail-readback",
 }
@@ -62,6 +63,7 @@ def empty_baseline(tmp_path):
     ("lock_discipline", {"LK001", "LK002", "LK003", "LK004"}),
     ("metric_names", {"MN001", "MN002", "MN003", "MN004"}),
     ("proto_drift", {"PD001", "PD002", "PD003"}),
+    ("robustness", {"RB001"}),
     ("shape_contract", {"SH001", "SH002", "SH003", "SH004", "SH005"}),
     ("tail_readback", {"HS006"}),
 ])

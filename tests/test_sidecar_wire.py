@@ -175,7 +175,10 @@ def unframe_request(buf: bytes):
 
 def build_request_frames() -> dict:
     from koordinator_tpu.scheduler import sidecar_pb2 as pb
-    from koordinator_tpu.scheduler.sidecar import _pack_gate_flags
+    from koordinator_tpu.scheduler.sidecar import (
+        _delta_to_bytes,
+        _pack_gate_flags,
+    )
 
     pods = canonical_pods()
     return {
@@ -187,12 +190,12 @@ def build_request_frames() -> dict:
         "ingest_request.bin": frame(
             "IngestDelta",
             pb.IngestDeltaRequest(
-                delta_msgpack=flax.serialization.to_bytes(
+                delta_msgpack=_delta_to_bytes(
                     canonical_delta())).SerializeToString()),
         "ingest_topology_request.bin": frame(
             "IngestTopology",
             pb.IngestTopologyRequest(
-                delta_msgpack=flax.serialization.to_bytes(
+                delta_msgpack=_delta_to_bytes(
                     canonical_topology_delta())).SerializeToString()),
         "schedule_request.bin": frame(
             "Schedule",
@@ -229,26 +232,34 @@ def test_frozen_publish_request_decodes():
 
 def test_frozen_ingest_request_decodes():
     from koordinator_tpu.scheduler import sidecar_pb2 as pb
-    from koordinator_tpu.scheduler.sidecar import _flat_template
+    from koordinator_tpu.scheduler.sidecar import (
+        _delta_from_bytes,
+        _flat_template,
+    )
 
     method, body = unframe_request(_read("ingest_request.bin"))
     assert method == "IngestDelta"
     req = pb.IngestDeltaRequest.FromString(body)
-    delta = flax.serialization.from_bytes(_flat_template(NodeMetricDelta),
-                                          req.delta_msgpack)
+    delta = _delta_from_bytes(_flat_template(NodeMetricDelta),
+                              req.delta_msgpack)
     assert np.asarray(delta.idx).tolist() == [0]
     assert np.asarray(delta.usage)[0, 0] == 3000.0
+    # a pre-version frame restores as UNVERSIONED (always applies)
+    assert delta.source_version is None
 
 
 def test_frozen_topology_request_decodes():
     from koordinator_tpu.scheduler import sidecar_pb2 as pb
-    from koordinator_tpu.scheduler.sidecar import _topology_template
+    from koordinator_tpu.scheduler.sidecar import (
+        _delta_from_bytes,
+        _topology_template,
+    )
 
     method, body = unframe_request(_read("ingest_topology_request.bin"))
     assert method == "IngestTopology"
     req = pb.IngestTopologyRequest.FromString(body)
-    delta = flax.serialization.from_bytes(_topology_template(),
-                                          req.delta_msgpack)
+    delta = _delta_from_bytes(_topology_template(),
+                              req.delta_msgpack)
     assert np.asarray(delta.idx).tolist() == [1]
     assert np.asarray(delta.allocatable)[0, 0] == 48000.0
     assert bool(np.asarray(delta.schedulable)[0])
@@ -293,6 +304,28 @@ def test_encoding_is_wire_stable():
             f"intentional, regenerate with "
             f"`python tests/test_sidecar_wire.py --regen` and document "
             f"it in docs/SIDECAR_WIRE.md")
+
+
+def test_source_version_is_an_optional_wire_extension():
+    """The delta replay guard's `source_version` rides the wire only
+    when stamped: an UNVERSIONED delta encodes byte-identically to the
+    pre-version format (pinned above against the frozen frames), and a
+    stamped one round-trips the version into the decode — so a sidecar
+    deployment gets replay protection without breaking older peers."""
+    from koordinator_tpu.scheduler.sidecar import (
+        _delta_from_bytes,
+        _delta_to_bytes,
+        _flat_template,
+    )
+
+    plain = _delta_to_bytes(canonical_delta())
+    assert b"source_version" not in plain
+    stamped_delta = canonical_delta().replace(
+        source_version=np.asarray(7, np.int32))
+    stamped = _delta_to_bytes(stamped_delta)
+    assert b"source_version" in stamped
+    back = _delta_from_bytes(_flat_template(NodeMetricDelta), stamped)
+    assert int(np.asarray(back.source_version)) == 7
 
 
 # --- 3. serve: the frozen frames drive a live server ------------------------

@@ -37,6 +37,14 @@ echo "=== sharded full-gate mesh smoke (2-device virtual CPU mesh) ==="
 # wall-clock (tools/mesh_flagship_smoke.py)
 python tools/mesh_flagship_smoke.py
 
+echo "=== chaos smoke (fault-injection matrix, CPU) ==="
+# every fault class in koordinator_tpu/testing/faults.py: detected
+# (guard word bit / FailureClass / typed delta reason), quarantined,
+# service completes the cycle, and clean-row placements bit-identical
+# to the no-fault oracle (tools/chaos_smoke.py) — correctness only,
+# never wall-clock
+JAX_PLATFORMS=cpu python tools/chaos_smoke.py
+
 echo "=== tier-1 tests (JAX_PLATFORMS=cpu) ==="
 set -o pipefail
 rm -f /tmp/_t1.log

@@ -8,6 +8,7 @@ from tools.lint.analyzers import (  # noqa: F401
     metric_names,
     proto_drift,
     recompile,
+    robustness,
     shape_contract,
     tail_readback,
 )

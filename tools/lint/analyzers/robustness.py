@@ -1,0 +1,173 @@
+"""robustness: broad exception handlers around device-program calls
+must route through the typed FailureClass classifier.
+
+The bug class: `except Exception:` (or a bare `except:`) wrapped around
+a jitted-kernel call swallows OOM, device-lost, and XLA-internal
+failures indistinguishably — the service can neither retry transients,
+degrade on OOM, nor alert on corruption, and the failure model
+(errorhandler.FailureClass, docs/DESIGN.md "Failure model & degradation
+ladder") silently loses coverage. A broad handler IS legitimate at
+evidence-guard boundaries — but only after the exception has been
+classified: referencing `classify_failure` (or `FailureClass`) in the
+handler body is the visible, reviewed statement that the failure enters
+the typed model.
+
+Scope: every module except tests/ (test code legitimately catches
+broadly). "Device-program call" = a call resolving — directly or
+through project functions — to a `jax.jit` entry point (the same entry
+discovery the host-sync analyzer uses), or through a jitted alias
+(`g = jax.jit(f)`).
+
+Code:
+  RB001  bare/`except Exception` around a device-program call without
+         FailureClass classification
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, Set, Tuple
+
+from tools.lint.astutil import call_target, dotted_name
+from tools.lint.callgraph import ProjectIndex, project_index
+from tools.lint.framework import Analyzer, Finding, Module, Project, register
+
+# names whose presence in a handler body marks the failure as routed
+# through the typed model
+CLASSIFIER_NAMES = frozenset({"classify_failure", "FailureClass"})
+BROAD_TYPES = frozenset({"Exception", "BaseException"})
+
+
+def _device_reaching(index: ProjectIndex
+                     ) -> Tuple[Set[int], Set[Tuple[str, str]]]:
+    """-> (ids of FunctionInfo.nodes that reach a jit entry, per-module
+    jit alias names). Fixed point over project-resolvable call edges."""
+    reaching: Set[int] = set()
+    aliases: Set[Tuple[str, str]] = set()
+    for entry in index.jit_entries():
+        reaching.add(id(entry.fn.node))
+        if entry.alias_name:
+            aliases.add((entry.alias_module_relpath, entry.alias_name))
+    changed = True
+    while changed:
+        changed = False
+        for mi in index.modules.values():
+            for info in mi.functions:
+                if id(info.node) in reaching:
+                    continue
+                chain = info.scope_chain + (info.node,)
+                for call in _calls_under(info.node):
+                    if _is_device_call(index, mi, chain, call, reaching,
+                                       aliases):
+                        reaching.add(id(info.node))
+                        changed = True
+                        break
+    return reaching, aliases
+
+
+def _calls_under(node: ast.AST) -> Iterable[ast.Call]:
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Call):
+            yield sub
+
+
+def _is_device_call(index: ProjectIndex, mi, chain, call: ast.Call,
+                    reaching: Set[int],
+                    aliases: Set[Tuple[str, str]]) -> bool:
+    dotted = call_target(call)
+    if dotted is not None and "." not in dotted \
+            and (mi.module.relpath, dotted) in aliases:
+        return True
+    callee = index.resolve_call(mi, chain, call)
+    return callee is not None and id(callee.node) in reaching
+
+
+def _is_broad_handler(handler: ast.ExceptHandler) -> bool:
+    if handler.type is None:
+        return True
+    types = handler.type.elts if isinstance(handler.type, ast.Tuple) \
+        else [handler.type]
+    for t in types:
+        name = dotted_name(t)
+        if name is not None and name.split(".")[-1] in BROAD_TYPES:
+            return True
+    return False
+
+
+def _handler_classifies(handler: ast.ExceptHandler) -> bool:
+    for sub in ast.walk(handler):
+        if isinstance(sub, ast.Name) and sub.id in CLASSIFIER_NAMES:
+            return True
+        if isinstance(sub, ast.Attribute) and sub.attr in CLASSIFIER_NAMES:
+            return True
+    return False
+
+
+@register
+class RobustnessAnalyzer(Analyzer):
+    name = "robustness"
+    description = ("bare `except Exception`/`except:` around "
+                   "device-program calls must route through the "
+                   "FailureClass classifier "
+                   "(errorhandler.classify_failure)")
+
+    def run(self, project: Project) -> Iterable[Finding]:
+        index = project_index(project)
+        reaching, aliases = _device_reaching(index)
+        findings = []
+        for mod in project.modules:
+            if mod.relpath.startswith("tests/"):
+                continue
+            mi = index.index_of(mod)
+            self._walk(mod.tree, mod, mi, index, reaching, aliases,
+                       (mod.tree,), findings)
+        return sorted(findings, key=lambda f: (f.path, f.line, f.code))
+
+    def _walk(self, node: ast.AST, mod: Module, mi, index, reaching,
+              aliases, chain, findings) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.ClassDef)):
+                self._walk(child, mod, mi, index, reaching, aliases,
+                           chain + (child,), findings)
+                continue
+            if isinstance(child, ast.Try):
+                self._check_try(child, mod, mi, index, reaching, aliases,
+                                chain, findings)
+            self._walk(child, mod, mi, index, reaching, aliases, chain,
+                       findings)
+
+    def _check_try(self, node: ast.Try, mod: Module, mi, index, reaching,
+                   aliases, chain, findings) -> None:
+        device_call = None
+        for stmt in node.body:
+            for call in _calls_under(stmt):
+                if _is_device_call(index, mi, chain, call, reaching,
+                                   aliases):
+                    device_call = call_target(call) or "<call>"
+                    break
+            if device_call:
+                break
+        if device_call is None:
+            return
+        qual = ".".join(c.name for c in chain
+                        if isinstance(c, (ast.FunctionDef,
+                                          ast.AsyncFunctionDef,
+                                          ast.ClassDef))) or "<module>"
+        for handler in node.handlers:
+            if not _is_broad_handler(handler) \
+                    or _handler_classifies(handler):
+                continue
+            caught = "except:" if handler.type is None else \
+                f"except {ast.unparse(handler.type)}"
+            findings.append(Finding(
+                analyzer=self.name, code="RB001", path=mod.relpath,
+                line=handler.lineno,
+                message=(f"`{caught}` in `{qual}` swallows device-"
+                         f"program failures from `{device_call}` "
+                         f"untyped; classify them "
+                         f"(errorhandler.classify_failure / "
+                         f"FailureClass) so OOM, device-lost, and "
+                         f"internal errors stay distinguishable to "
+                         f"the retry/degradation ladder"),
+                key=f"{qual}:{device_call}"))

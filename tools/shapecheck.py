@@ -60,6 +60,7 @@ CONTRACT_MODULES = (
     "koordinator_tpu.ops.quota_demand",
     "koordinator_tpu.scheduler.cascade",
     "koordinator_tpu.scheduler.core",
+    "koordinator_tpu.scheduler.guards",
     "koordinator_tpu.parallel.shardops",
     "koordinator_tpu.scheduler.plugins.loadaware",
     "koordinator_tpu.scheduler.plugins.deviceshare",

@@ -8,7 +8,14 @@ reservation slots only at owner rows with matching ids, NUMA zone
 reports only on CPU-bind rows, GPU instance takes only on
 device-requesting rows.
 
-Usage: JAX_PLATFORMS=cpu python tools/soak_service.py [n_seeds]
+`--chaos` additionally injects ONE random fault per seed (column
+corruption, runtime failure, or watchdog stall — the
+koordinator_tpu.testing.faults catalog) and asserts the service
+completes the cycle with the quarantined/faulted rows contained: the
+per-row invariants must hold on the CLEAN rows regardless of the
+fault.
+
+Usage: JAX_PLATFORMS=cpu python tools/soak_service.py [n_seeds] [--chaos]
 """
 
 import os
@@ -28,7 +35,34 @@ from koordinator_tpu.scheduler.frameworkext import SchedulerService
 from koordinator_tpu.utils import synthetic
 
 P, N = 1_024, 256
-N_SEEDS = int(sys.argv[1]) if len(sys.argv) > 1 else 100
+CHAOS = "--chaos" in sys.argv[1:]
+_counts = [a for a in sys.argv[1:] if not a.startswith("-")]
+N_SEEDS = int(_counts[0]) if _counts else 100
+
+# per-seed chaos menu: one of these fires each seed (seeded choice)
+CHAOS_MENU = ("nan_metric_column", "negative_allocatable",
+              "nan_pod_request", "bad_gang_id", "xla_oom",
+              "xla_transient", "watchdog_stall", "none")
+
+
+def apply_chaos(service, snap, pods, seed):
+    """Inject one seeded fault; -> (snap, pods, quarantined pod rows)."""
+    from koordinator_tpu.testing import faults
+
+    inj = faults.FaultInjector(seed)
+    fault = CHAOS_MENU[int(inj.rng.integers(len(CHAOS_MENU)))]
+    quarantined = np.zeros((0,), np.int64)
+    if fault in faults.SNAPSHOT_FAULTS:
+        snap, _rows = inj.corrupt_snapshot(snap, fault, n_rows=2)
+    elif fault in faults.BATCH_FAULTS:
+        pods, quarantined = inj.corrupt_batch(pods, fault, n_rows=4)
+    elif fault == "xla_oom":
+        service.fault_injection = inj.oom_above(P // 2)
+    elif fault == "xla_transient":
+        service.fault_injection = inj.xla_transient(fail_attempts={1})
+    elif fault == "watchdog_stall":
+        inj.stall_watchdog(service)
+    return snap, pods, quarantined
 
 
 def main():
@@ -36,14 +70,19 @@ def main():
     for i in range(N_SEEDS):
         rng = np.random.default_rng(i)
         service = SchedulerService(num_rounds=2, k_choices=4)
-        service.publish(synthetic.full_gate_cluster(
-            N, seed=i, num_quotas=8, num_gangs=8))
+        service._sleep = lambda _s: None
+        snap = synthetic.full_gate_cluster(
+            N, seed=i, num_quotas=8, num_gangs=8)
         pods = synthetic.full_gate_pods(P, N, seed=i + 500,
                                         num_quotas=8, num_gangs=8)
         reqs = np.asarray(pods.requests).copy()
         impossible = rng.choice(P, 16, replace=False)
         reqs[impossible] = 1e9
         pods = pods.replace(requests=reqs)
+        quarantined = np.zeros((0,), np.int64)
+        if CHAOS:
+            snap, pods, quarantined = apply_chaos(service, snap, pods, i)
+        service.publish(snap)
         res = service.schedule(pods)
         a = np.asarray(res.assignment)
         slot = np.asarray(res.res_slot)
@@ -54,6 +93,7 @@ def main():
         from koordinator_tpu.scheduler.plugins import deviceshare
         gpu = np.asarray(deviceshare.has_device_request(pods))
         ok = ((a[impossible] == -1).all()
+              and (a[quarantined] == -1).all()
               and (slot[owner < 0] < 0).all()
               and (owner[slot >= 0] == slot[slot >= 0]).all()
               and (zone[~numa] < 0).all()
