@@ -182,7 +182,8 @@ def ensure_platform(probe_timeout: float = None) -> bool:
 
 def run_northstar(full_gate: bool = False, num_pods: int = None,
                   num_nodes: int = None, chunk: int = None,
-                  metric: str = None, degraded: str = None) -> dict:
+                  metric: str = None, degraded: str = None,
+                  num_devices: int = None, recovered: str = None) -> dict:
     from koordinator_tpu.parallel import mesh as meshlib
     from koordinator_tpu.scheduler import core
     from koordinator_tpu.scheduler.plugins.loadaware import LoadAwareConfig
@@ -235,7 +236,15 @@ def run_northstar(full_gate: bool = False, num_pods: int = None,
     # BENCH_MESH_PODS=m folds the devices into a 2D (pods x nodes) mesh.
     devices = jax.devices()
     ndev_env = (os.environ.get("BENCH_DEVICES") or "").strip()
-    if ndev_env:
+    if num_devices is not None:
+        # an explicit count wins over the env: run_with_ladder's
+        # device-lost rung retries on a SHRUNK device set
+        ndev = int(num_devices)
+        if not 1 <= ndev <= len(devices):
+            raise SystemExit(f"num_devices={ndev} but "
+                             f"{len(devices)} devices are visible")
+        devices = devices[:ndev]
+    elif ndev_env:
         ndev = int(ndev_env)
         if not 1 <= ndev <= len(devices):
             raise SystemExit(f"BENCH_DEVICES={ndev} but "
@@ -576,6 +585,11 @@ def run_northstar(full_gate: bool = False, num_pods: int = None,
         # (run_with_ladder): the classified failure class + the retried
         # chunk, so a degraded number can never pass as the protocol
         **({"degraded": degraded} if degraded else {}),
+        # present ONLY after the ladder recovered a DEVICE_LOST run on
+        # a shrunk device set (the bench mirror of the service's
+        # mesh-shrink rung); `devices`/`mesh` below then carry the
+        # SHRUNK size, so the line is self-describing
+        **({"recovered": recovered} if recovered else {}),
         "devices": len(devices),
         # the mesh stamp makes a 4-device line self-describing (1x4 vs
         # 2x2); absent on single-device lines so trajectories stay
@@ -599,37 +613,56 @@ def run_northstar(full_gate: bool = False, num_pods: int = None,
     return result
 
 
-def run_with_ladder(max_halvings: int = 2, **kw) -> dict:
+def run_with_ladder(max_halvings: int = 2, _run=None, **kw) -> dict:
     """The bench's rung of the degradation ladder: a run whose failure
     classifies as RESOURCE_EXHAUSTED retries with the chunk halved (up
     to `max_halvings` times) and the retried line carries a `degraded`
-    stamp (failure class + the chunk that survived), so a degraded
-    number is self-describing and can never pass as the canonical
-    protocol. Any other failure class propagates — the caller's
-    evidence guards own those."""
+    stamp (failure class + the chunk that survived); one that
+    classifies as DEVICE_LOST retries on a device set shrunk by one —
+    the bench mirror of the service's mesh-shrink rung — and the
+    retried line carries a `recovered` stamp plus the shrunk
+    `devices`/`mesh` size. Either way a non-protocol number is
+    self-describing and can never pass as the canonical protocol. Any
+    other failure class propagates — the caller's evidence guards own
+    those. `_run` is the injectable run function (tests)."""
     from koordinator_tpu.scheduler.errorhandler import (
         FailureClass,
         classify_failure,
     )
 
+    run = _run if _run is not None else run_northstar
     chunk = kw.pop("chunk", None)
+    num_devices = kw.pop("num_devices", None)
     degraded = None
-    for halvings in range(max_halvings + 1):
+    recovered = None
+    for retries in range(max_halvings + 1):
         try:
-            return run_northstar(chunk=chunk, degraded=degraded, **kw)
+            return run(chunk=chunk, degraded=degraded,
+                       num_devices=num_devices, recovered=recovered,
+                       **kw)
         except Exception as exc:
             fc = classify_failure(exc)
             cur = chunk if chunk is not None \
                 else (FULL_CHUNK if kw.get("full_gate", False) else CHUNK)
-            if fc is not FailureClass.RESOURCE_EXHAUSTED \
-                    or halvings == max_halvings or cur < 2:
-                # out of rungs (or not an OOM at all): the REAL
+            cur_dev = num_devices if num_devices is not None \
+                else int((os.environ.get("BENCH_DEVICES") or "").strip()
+                         or len(jax.devices()))
+            if retries == max_halvings:
+                raise
+            if fc is FailureClass.RESOURCE_EXHAUSTED and cur >= 2:
+                chunk = cur // 2
+                degraded = f"{fc.value}:chunk={chunk}"
+                print(f"bench: {fc.value}; retrying with chunk {cur} "
+                      f"-> {chunk}", file=sys.stderr)
+            elif fc is FailureClass.DEVICE_LOST and cur_dev >= 2:
+                num_devices = cur_dev - 1
+                recovered = f"{fc.value}:devices={num_devices}"
+                print(f"bench: {fc.value}; retrying on {cur_dev} -> "
+                      f"{num_devices} device(s)", file=sys.stderr)
+            else:
+                # out of rungs (or an unabsorbable class): the REAL
                 # exception propagates, never a synthetic stand-in
                 raise
-            chunk = cur // 2
-            degraded = f"{fc.value}:chunk={chunk}"
-            print(f"bench: {fc.value}; retrying with chunk {cur} -> "
-                  f"{chunk}", file=sys.stderr)
 
 
 def _stamped_line(line: dict, captured_at: str, age: float,

@@ -30,6 +30,15 @@ SCHEDULER_QUARANTINED_INPUTS = "scheduler_quarantined_inputs"
 SCHEDULER_DEGRADED_CYCLES = "scheduler_degraded_cycles"
 SCHEDULER_DEGRADATION_LEVEL = "scheduler_degradation_level"
 SCHEDULER_DELTA_REJECTED = "scheduler_delta_rejected"
+# crash recovery (scheduler/journal.py + SnapshotStore checkpoints +
+# the mesh-shrink ladder rung)
+SCHEDULER_JOURNAL_APPENDS = "scheduler_journal_appends"
+SCHEDULER_JOURNAL_BYTES = "scheduler_journal_bytes"
+SCHEDULER_RECOVERY_REPLAYED_RECORDS = \
+    "scheduler_recovery_replayed_records"
+SCHEDULER_RECOVERY_SECONDS = "scheduler_recovery_seconds"
+SCHEDULER_MESH_SHRINK_EVENTS = "scheduler_mesh_shrink_events"
+SCHEDULER_MESH_SIZE = "scheduler_mesh_size"
 
 # --- koordlet (pkg/koordlet/metrics/: cpi.go, psi.go, cpu_suppress.go,
 #     cpu_burst.go, core_sched.go, prediction.go, resource_summary.go,

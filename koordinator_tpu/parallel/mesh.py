@@ -268,6 +268,48 @@ def pad_nodes_to_mesh(snap: ClusterSnapshot, mesh: Mesh) -> ClusterSnapshot:
                        _SNAP_PAD_FILLS)
 
 
+def unpad_nodes(snap: ClusterSnapshot, num_real: int) -> ClusterSnapshot:
+    """Slice a `pad_nodes_to_mesh`-padded snapshot back to its real
+    node count — the inverse walk over the same field-spec tables.
+
+    The mesh-shrink ladder rung (frameworkext.DegradationLadder) pads
+    and re-shards per cycle over whatever devices survive; committing
+    the PADDED post-cycle snapshot to the store would make the stored
+    shapes a function of the surviving-device count (a recompile per
+    shrink event, and a shape mismatch the moment the full mesh
+    returns). Unpadding is sound because pad rows are provably inert:
+    schedulable=False + zero allocatable means they are never chosen
+    and never charged (`core.overcommit_ok` pins that), so slicing
+    them off loses nothing."""
+    n_now = snap.num_nodes
+    if n_now == num_real:
+        return snap
+    if n_now < num_real:
+        raise ValueError(f"cannot unpad {n_now} nodes to {num_real}")
+
+    def slice_leaf(x, dims):
+        for axis, d in enumerate(dims):
+            if d == "N" and x.shape[axis] == n_now:
+                index = [slice(None)] * x.ndim
+                index[axis] = slice(0, num_real)
+                x = x[tuple(index)]
+        return x
+
+    def walk(obj, name):
+        upd = {}
+        for fname, spec in STRUCT_SPECS[name].items():
+            if isinstance(spec, str) and spec in STRUCT_SPECS:
+                upd[fname] = walk(getattr(obj, fname), spec)
+                continue
+            dims = _leaf_dims(spec)
+            if dims is None or "N" not in dims:
+                continue
+            upd[fname] = slice_leaf(getattr(obj, fname), dims)
+        return obj.replace(**upd)
+
+    return walk(snap, "ClusterSnapshot")
+
+
 def pad_batch_nodes(pods: PodBatch, num_nodes: int) -> PodBatch:
     """Pad the batch's node-indexed matrices (the [*, N] topology
     domain maps) to a padded snapshot's node count, filling -1 ("node

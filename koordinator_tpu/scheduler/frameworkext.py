@@ -51,6 +51,7 @@ from koordinator_tpu.scheduler.errorhandler import (
     TRANSIENT_CLASSES,
     classify_failure,
 )
+from koordinator_tpu.scheduler.journal import JournalConflict
 from koordinator_tpu.scheduler.metrics_defs import SchedulerMetrics
 from koordinator_tpu.scheduler.plugins.loadaware import LoadAwareConfig
 from koordinator_tpu.snapshot.schema import ClusterSnapshot, PodBatch
@@ -129,6 +130,10 @@ class LadderState:
         return self.chunk_splits > 0
 
     @property
+    def mesh_shrink(self) -> bool:
+        return self.level == DegradationLadder.L_MESH_SHRINK
+
+    @property
     def single_device(self) -> bool:
         return self.level >= DegradationLadder.L_SINGLE_DEVICE
 
@@ -155,13 +160,22 @@ class DegradationLadder:
       chunked       -> the batch runs as 2**chunk_splits sequential
                        sub-batches (counts and the snapshot carried
                        chunk-to-chunk); each further OOM halves again
-      single_device -> inputs pinned to device 0 (a sharded store's mesh
-                       is abandoned until the fleet heals)
+      mesh_shrink   -> the cycle runs on a mesh rebuilt over the
+                       SURVIVING devices (parallel/mesh.py pad helpers
+                       re-shard the snapshot per cycle); placements
+                       stay bit-identical to the full-mesh program —
+                       losing 1 of 8 chips costs capacity, not a whole
+                       mesh. Reached only by DEVICE_LOST with >= 2
+                       survivors; a probe-up restores the full mesh.
+      single_device -> inputs pinned to device 0 (the mesh is
+                       abandoned until the fleet heals)
 
     Transitions are keyed on FailureClass: RESOURCE_EXHAUSTED jumps
     straight to chunking (retrying an identical OOM is useless),
-    DEVICE_LOST jumps to single-device, everything else steps one rung.
-    After `probe_after` consecutive clean cycles below normal, ONE cycle
+    DEVICE_LOST goes to mesh-shrink when >= 2 devices survive (else
+    single-device), everything else steps one rung — skipping
+    mesh_shrink, which is meaningless without a lost device. After
+    `probe_after` consecutive clean cycles below normal, ONE cycle
     probes the rung above; success commits the promotion, failure falls
     straight back (and the streak restarts). Every transition is
     recorded so the chaos matrix can assert the exact path taken.
@@ -170,8 +184,10 @@ class DegradationLadder:
     its cycle machinery (transitions happen between program attempts).
     """
 
-    LEVELS = ("normal", "no_cascade", "chunked", "single_device")
-    L_NORMAL, L_NO_CASCADE, L_CHUNKED, L_SINGLE_DEVICE = range(4)
+    LEVELS = ("normal", "no_cascade", "chunked", "mesh_shrink",
+              "single_device")
+    (L_NORMAL, L_NO_CASCADE, L_CHUNKED, L_MESH_SHRINK,
+     L_SINGLE_DEVICE) = range(5)
 
     def __init__(self, probe_after: int = 8, max_chunk_splits: int = 4):
         self.probe_after = probe_after
@@ -189,7 +205,14 @@ class DegradationLadder:
         if self.level == self.L_CHUNKED and self.chunk_splits > 1:
             return LadderState(self.level, self.chunk_splits - 1)
         if self.level == self.L_SINGLE_DEVICE:
-            return LadderState(self.L_CHUNKED, max(self.chunk_splits, 1))
+            return LadderState(self.L_MESH_SHRINK, self.chunk_splits)
+        if self.level == self.L_MESH_SHRINK:
+            # the probe that restores the FULL mesh: back to the
+            # chunked rung when chunking was in force, else straight
+            # past it (a chunk-free mesh_shrink never chunked)
+            if self.chunk_splits > 0:
+                return LadderState(self.L_CHUNKED, self.chunk_splits)
+            return LadderState(self.L_NO_CASCADE, 0)
         if self.level == self.L_CHUNKED:
             return LadderState(self.L_NO_CASCADE, 0)
         return LadderState(max(self.level - 1, 0), 0)
@@ -209,10 +232,15 @@ class DegradationLadder:
         else:
             self.clean_streak += 1
 
-    def on_failure(self, fc: FailureClass, probing: bool) -> bool:
+    def on_failure(self, fc: FailureClass, probing: bool,
+                   survivors: Optional[int] = None) -> bool:
         """Degrade for the failure class; returns False when there is no
         lower rung left (the caller re-raises). A failed PROBE is not a
-        degradation — the pre-probe state simply stays."""
+        degradation — the pre-probe state simply stays. `survivors` is
+        the surviving-device count the service observed for a
+        DEVICE_LOST failure: >= 2 earns the mesh-shrink rung instead of
+        abandoning the mesh outright (None — a caller without device
+        visibility — degrades conservatively to single-device)."""
         self.clean_streak = 0
         if probing:
             return True
@@ -224,13 +252,21 @@ class DegradationLadder:
             else:
                 return False
         elif fc is FailureClass.DEVICE_LOST:
-            if self.level >= self.L_SINGLE_DEVICE:
+            if survivors is not None and survivors >= 2 \
+                    and self.level < self.L_MESH_SHRINK:
+                nxt = LadderState(self.L_MESH_SHRINK, self.chunk_splits)
+            elif self.level >= self.L_SINGLE_DEVICE:
                 return False
-            nxt = LadderState(self.L_SINGLE_DEVICE, self.chunk_splits)
+            else:
+                nxt = LadderState(self.L_SINGLE_DEVICE, self.chunk_splits)
         else:
             if self.level >= self.L_SINGLE_DEVICE:
                 return False
             new_level = self.level + 1
+            if new_level == self.L_MESH_SHRINK:
+                # mesh_shrink is the DEVICE_LOST rung; a generic
+                # failure that already exhausted chunking goes past it
+                new_level = self.L_SINGLE_DEVICE
             nxt = LadderState(
                 new_level,
                 max(self.chunk_splits, 1)
@@ -504,6 +540,7 @@ class SchedulerService:
                  metrics: Optional[SchedulerMetrics] = None,
                  ladder: Optional[DegradationLadder] = None,
                  retry_policy: Optional[RetryPolicy] = None,
+                 journal=None,
                  **schedule_kwargs):
         self.store = store or SnapshotStore()
         self.cfg = cfg if cfg is not None else LoadAwareConfig.make()
@@ -535,6 +572,31 @@ class SchedulerService:
         # (LadderState, PodBatch) before every program attempt; a raised
         # exception injects a device-program failure deterministically
         self.fault_injection: Optional[Callable] = None
+        # crash-recoverable scheduling (docs/DESIGN.md "Crash recovery
+        # & mesh elasticity"): an optional CommitJournal makes every
+        # chunk commit durable with append-before-publish ordering —
+        # committed chunks of an interrupted batch replay bit-identical
+        # on resume (in-process retry OR restart via recover()), and
+        # uncommitted chunks are simply scheduled. Epochs are assigned
+        # per batch under the commit lock, resuming where the journal
+        # left off.
+        self.journal = journal
+        self.epoch = journal.next_epoch() if journal is not None else 0
+        # epochs whose records THIS process appended: a base-version
+        # mismatch on one of these is a raced ingest between retry
+        # attempts (safe to abandon — nothing published), never a
+        # restart mis-rehydration
+        self._own_epochs: set = set()
+        self._forced_chunks: Optional[int] = None
+        self._cycle_digest = 0
+        self._cycle_base_version = 0
+        self._cycle_replayed = 0
+        self.last_recovery: Optional[dict] = None
+        # device-loss visibility seam: a health prober returning the
+        # SURVIVING jax devices; None = trust the runtime's view. The
+        # mesh-shrink rung rebuilds its mesh over exactly this list.
+        self.device_health: Optional[Callable[[], list]] = None
+        self._last_mesh_size = len(jax.devices())
         self._cycle_state = LadderState()
         self.last_health_word = 0
         self.last_quarantined_pods: Optional[np.ndarray] = None
@@ -587,13 +649,27 @@ class SchedulerService:
         Lock order is commit -> view, everywhere."""
         return self._commit_lock
 
+    def surviving_devices(self) -> list:
+        """The devices the service believes are healthy right now: the
+        `device_health` prober's answer when one is attached, else
+        whatever the runtime reports. The mesh-shrink rung builds its
+        mesh over exactly this list, and DEVICE_LOST ladder decisions
+        key on its length."""
+        if self.device_health is not None:
+            return list(self.device_health())
+        return list(jax.devices())
+
     def publish(self, snapshot: ClusterSnapshot) -> int:
         """Returns the published version, read under the commit lock so a
         concurrent mutator cannot be misattributed."""
         with self._commit_lock:
             self.store.publish(snapshot)
             self.last_committed_version = self.store.version
-            return self.last_committed_version
+            version = self.last_committed_version
+        # checkpoint OUTSIDE the lock: a fsync must never stall a
+        # concurrent schedule/ingest waiting on the commit lock
+        self.store.maybe_checkpoint()
+        return version
 
     def ingest(self, delta) -> int:
         """Apply an O(K) metric delta SERIALIZED with batch commits — a
@@ -611,7 +687,9 @@ class SchedulerService:
                             "version %d", reason.value,
                             self.store.applied_delta_version)
             self.last_committed_version = self.store.version
-            return self.last_committed_version
+            version = self.last_committed_version
+        self.store.maybe_checkpoint()
+        return version
 
     # batches at or below this size schedule as-is: the quadratic
     # [P, P] savings cannot pay for the pack/unpack permutations there
@@ -686,6 +764,59 @@ class SchedulerService:
         inv[perm] = np.arange(perm.size)
         return packed, kwargs, inv
 
+    def _begin_journal_cycle(self, pods: PodBatch) -> None:
+        """Journal bookkeeping for one cycle attempt, under the commit
+        lock: capture the base version/digest the records will carry,
+        and detect a RESUME — committed records already journaled for
+        this epoch pin the chunk layout and must match the resubmitted
+        batch (digest) and the rehydrated snapshot (base version);
+        either mismatch is a terminal JournalConflict, because replay
+        against different inputs would silently diverge from the
+        journaled placements."""
+        from koordinator_tpu.scheduler import journal as journal_mod
+
+        self._cycle_base_version = self.store.version
+        self._cycle_replayed = 0
+        self._cycle_digest = journal_mod.batch_digest(pods)
+        self._forced_chunks = None
+        committed = self.journal.records_for(self.epoch)
+        if not committed:
+            return
+        rec = next(iter(committed.values()))
+        if rec.batch_digest != self._cycle_digest:
+            raise journal_mod.JournalConflict(
+                f"epoch {self.epoch} resume: the resubmitted batch's "
+                f"digest {self._cycle_digest:#x} differs from the "
+                f"journaled {rec.batch_digest:#x} — refusing to "
+                f"complete another batch's committed chunks (if the "
+                f"interrupted batch is gone for good, call "
+                f"abandon_interrupted_epoch() to close its epoch)")
+        if rec.base_version != self.store.version:
+            if self.epoch in self._own_epochs:
+                # a delta/publish landed between THIS process's retry
+                # attempts (the backoff sleeps outside the commit lock
+                # by design): the journaled chunks pinned placements
+                # against a snapshot that no longer exists, but nothing
+                # of this epoch was ever published (publish seals an
+                # epoch) — so abandon them durably and re-run the whole
+                # batch against the fresher snapshot, exactly what the
+                # pre-journal retry did
+                log.warning(
+                    "epoch %d: store moved %d -> %d under an in-flight "
+                    "retry; abandoning %d journaled chunk(s) and "
+                    "re-running the batch fresh", self.epoch,
+                    rec.base_version, self.store.version, len(committed))
+                self.journal.abandon(self.epoch)
+                self.epoch = self.journal.next_epoch()
+                return
+            raise journal_mod.JournalConflict(
+                f"epoch {self.epoch} resume: store at version "
+                f"{self.store.version} but the journaled chunks ran "
+                f"against version {rec.base_version} — rehydrate the "
+                f"store (checkpoint restore + delta/epoch replay) "
+                f"before resuming")
+        self._forced_chunks = rec.n_chunks
+
     def _run_program(self, snap: ClusterSnapshot, pods: PodBatch,
                      kwargs: dict):
         """One guarded/unguarded device-program invocation ->
@@ -704,18 +835,44 @@ class SchedulerService:
         result = core.schedule_batch(snap, pods, self.cfg, **kwargs)
         return result, None, None, None
 
+    def _journal_commit(self, chunk: int, n_chunks: int,
+                        assignment: np.ndarray) -> None:
+        """Durably commit one chunk's assignment (append-before-publish
+        — the store has NOT published when this runs). An identical
+        already-journaled record is the replay path: counted, asserted
+        bit-identical inside the journal, and never re-appended — a
+        committed pod is never re-placed. A divergent record raises
+        JournalConflict (terminal)."""
+        from koordinator_tpu.scheduler import journal as journal_mod
+
+        rec = journal_mod.JournalRecord(
+            epoch=self.epoch, chunk=chunk, n_chunks=n_chunks,
+            base_version=self._cycle_base_version,
+            delta_watermark=self.store.applied_delta_version,
+            batch_digest=self._cycle_digest,
+            assignment=np.asarray(assignment, np.int32))
+        wrote = self.journal.append(rec)
+        if wrote:
+            self._own_epochs.add(self.epoch)
+            self.metrics.journal_appends.inc()
+            self.metrics.journal_bytes.inc(wrote)
+        else:
+            self._cycle_replayed += 1
+
     def _run_chunked(self, snap: ClusterSnapshot, pods: PodBatch,
-                     kwargs: dict, splits: int):
-        """The ladder's chunked rung: 2**splits sequential sub-batches
+                     kwargs: dict, n_chunks: int):
+        """The ladder's chunked rung: `n_chunks` sequential sub-batches
         against the evolving snapshot, topology counts carried
         chunk-to-chunk exactly like the bench sweep (the cross-batch
         count rule). `gang_failed` is SUPPRESSED here — per-chunk
         quorum proofs don't compose across chunks, and a false
         un-assume corrupts held capacity; the Permit wait-expiry
         timeout stays the rollback backstop for degraded cycles. All
-        merging stays device-side; no per-chunk host sync."""
+        merging stays device-side with no per-chunk host sync — except
+        under a commit journal, which by design trades one assignment
+        readback per chunk for chunk-granular crash durability."""
         p = int(np.asarray(pods.valid).shape[0])
-        n_chunks = max(min(2 ** splits, p), 1)
+        n_chunks = max(min(n_chunks, p), 1)
         from koordinator_tpu.utils import synthetic
         sizes = [len(c) for c in np.array_split(np.arange(p), n_chunks)]
         # the whole batch on device first (one upload, like the bench
@@ -726,12 +883,18 @@ class SchedulerService:
         counts = tuple(getattr(pods, f) for f in core.COUNT_FIELDS)
         parts, pod_bads, node_bad, health = [], [], None, None
         start = 0
+        chunk_idx = -1
         for size in sizes:
             if size == 0:
                 continue
+            chunk_idx += 1
             batch = synthetic.slice_batch(pods, start, size)
             batch = batch.replace(**dict(zip(core.COUNT_FIELDS, counts)))
             res_i, h_i, nb_i, pb_i = self._run_program(snap, batch, kwargs)
+            if self.journal is not None:
+                # the journaled readback is the chunk's COMMIT point
+                self._journal_commit(chunk_idx, n_chunks,
+                                     np.asarray(res_i.assignment))
             counts = core.charge_all_counts(counts, batch,
                                             res_i.assignment)
             snap = res_i.snapshot
@@ -761,18 +924,62 @@ class SchedulerService:
     def _device_cycle(self, snap: ClusterSnapshot, pods: PodBatch,
                       kwargs: dict, state: LadderState):
         """Run one cycle's device program at the ladder state's
-        configuration."""
+        configuration. A journaled resume (`_forced_chunks`) pins the
+        chunk layout to the journaled epoch's regardless of the current
+        ladder state — replay must slice the batch exactly as the
+        interrupted run did."""
         self._cycle_state = state
+        n_real = None
         if state.single_device:
             dev = jax.devices()[0]
             snap = jax.device_put(snap, dev)
             pods = jax.device_put(pods, dev)
+            self._last_mesh_size = 1
+        elif state.mesh_shrink:
+            # rebuild the mesh over the survivors: pad the node axis to
+            # the shrunk mesh, re-shard, run — then unpad the committed
+            # snapshot so stored shapes never depend on the surviving-
+            # device count. Placements are bit-identical through the
+            # padding/sharding path (the PR 4 mesh conformance pins).
+            from koordinator_tpu.parallel import mesh as meshlib
+
+            devs = self.surviving_devices()
+            mesh = meshlib.make_mesh(devs)
+            n_real = int(snap.num_nodes)
+            snap = meshlib.shard_snapshot(
+                meshlib.pad_nodes_to_mesh(snap, mesh), mesh)
+            pods = meshlib.pad_batch_nodes(
+                pods, meshlib.padded_node_count(n_real, mesh))
+            self._last_mesh_size = len(devs)
+        else:
+            self._last_mesh_size = len(self.surviving_devices())
         if state.cascade_off:
             kwargs = dict(kwargs, cascade=False)
-        if state.chunked:
-            return self._run_chunked(snap, pods, kwargs,
-                                     state.chunk_splits)
-        return self._run_program(snap, pods, kwargs)
+        if self._forced_chunks is not None:
+            # the journaled layout wins over the ladder in BOTH
+            # directions: a 1-chunk epoch replays as the single
+            # program even on a chunked-rung service (running it
+            # chunked would journal conflicting n_chunks records)
+            if self._forced_chunks > 1:
+                out = self._run_chunked(snap, pods, kwargs,
+                                        self._forced_chunks)
+            else:
+                out = self._run_program(snap, pods, kwargs)
+        elif state.chunked:
+            out = self._run_chunked(snap, pods, kwargs,
+                                    2 ** state.chunk_splits)
+        else:
+            out = self._run_program(snap, pods, kwargs)
+        if n_real is not None:
+            from koordinator_tpu.parallel import mesh as meshlib
+
+            result, health, node_bad, pod_bad = out
+            result = result.replace(
+                snapshot=meshlib.unpad_nodes(result.snapshot, n_real))
+            if node_bad is not None:
+                node_bad = node_bad[:n_real]
+            out = (result, health, node_bad, pod_bad)
+        return out
 
     def _locked_cycle(self, pods: PodBatch, typed_pods,
                       state: LadderState):
@@ -780,6 +987,8 @@ class SchedulerService:
         one cycle attempt."""
         with self._commit_lock:
             snap = self.store.current()
+            if self.journal is not None:
+                self._begin_journal_cycle(pods)
             # amplified-CPU auto-detection happens on the snapshot the
             # batch actually runs against (an explicit
             # enable_amplification kwarg from the constructor wins).
@@ -790,8 +999,14 @@ class SchedulerService:
             if not self._explicit_amp:
                 self.schedule_kwargs["enable_amplification"] = bool(
                     np.asarray(snap.nodes.cpu_amplification > 1.0).any())
+            # a journaled resume (forced chunk layout) also forbids
+            # prefix packing: slicing a packed batch breaks the
+            # row-range contracts, exactly like the chunked rung
             sched_pods, pack_kwargs, inv = self._prepare_batch(
-                snap, pods, allow_prefix_pack=not state.chunked)
+                snap, pods,
+                allow_prefix_pack=not state.chunked
+                and (self._forced_chunks is None
+                     or self._forced_chunks <= 1))
             with kernel_timer(self.metrics.kernel_seconds,
                               "koord/schedule_batch"):
                 result, health_dev, _node_bad, pod_bad = \
@@ -814,7 +1029,26 @@ class SchedulerService:
             # non-zero (cold path)
             health = (np.asarray(health_dev)
                       if health_dev is not None else None)
+            # what _device_cycle ACTUALLY ran: the journaled layout
+            # overrides the ladder in both directions
+            chunked_run = (self._forced_chunks > 1
+                           if self._forced_chunks is not None
+                           else state.chunked)
+            if self.journal is not None and not chunked_run:
+                # append-before-publish: the single-program cycle's one
+                # record lands BEFORE the store publish below, so a
+                # crash between them replays rather than loses the batch
+                self._journal_commit(0, 1, assignment)
             self.store.update(lambda _old: result.snapshot)
+            if self.journal is not None:
+                # the batch committed: the epoch is sealed (its chunk
+                # set is complete in the journal) and the next schedule
+                # opens a new one; the own-epoch marker only matters
+                # for the CURRENT epoch's retries, so drop the sealed
+                # one (a resident service must not accrete the set)
+                self._own_epochs.discard(self.epoch)
+                self.epoch += 1
+                self._forced_chunks = None
             # THE COMMIT POINT: everything below ran against a snapshot
             # version that is now published. A failure past here must
             # NOT re-enter the retry loop — re-running the cycle would
@@ -865,6 +1099,13 @@ class SchedulerService:
                 # _CommittedCycleError), surface the hook's failure
                 self.monitor.complete_cycle(token)
                 raise exc.cause
+            except JournalConflict:
+                # the journal disagrees with this cycle's inputs:
+                # terminal by construction — a retry re-derives the
+                # same divergence, and degrading cannot fix a wrong
+                # batch or a stale snapshot
+                self.monitor.complete_cycle(token)
+                raise
             except Exception as exc:
                 # every device-program failure routes through the
                 # FailureClass classifier (koordlint RB001)
@@ -886,15 +1127,29 @@ class SchedulerService:
                 if fc in TRANSIENT_CLASSES and not backoff.exhausted():
                     self._sleep(backoff.next_delay())
                     continue
-                if not self.ladder.on_failure(fc, probing=False):
+                survivors = None
+                if fc is FailureClass.DEVICE_LOST:
+                    # the ladder's DEVICE_LOST decision keys on how
+                    # many devices actually survive: >= 2 earns the
+                    # mesh-shrink rung, fewer abandons the mesh
+                    survivors = len(self.surviving_devices())
+                pre_level = self.ladder.level
+                if not self.ladder.on_failure(fc, probing=False,
+                                              survivors=survivors):
                     # no lower rung left: the failure is terminal
                     self.monitor.complete_cycle(token)
                     raise
+                if self.ladder.level == DegradationLadder.L_MESH_SHRINK \
+                        and pre_level != DegradationLadder.L_MESH_SHRINK:
+                    self.metrics.mesh_shrink_events.inc()
                 backoff.reset()
         self.last_ladder_state = state
         if state.degraded or probing:
             self.metrics.degraded_cycles.labels(state.label()).inc()
         self.metrics.degradation_level.set(float(self.ladder.level))
+        self.metrics.mesh_size.set(float(self._last_mesh_size))
+        if self.journal is not None and self._cycle_replayed:
+            self.metrics.recovery_replayed.inc(self._cycle_replayed)
         word = int(health[0]) if health is not None else 0
         self.last_health_word = word
         pod_bad_np: Optional[np.ndarray] = None
@@ -963,7 +1218,95 @@ class SchedulerService:
         if self.flags.filter_dump:
             log.info("filter table:\n%s", debug_filter_table(
                 snap, pods, self.cfg, pod_names))
+        # the post-commit checkpoint, outside the commit lock: a fsync
+        # must never stall the next cycle's snapshot read
+        if self.store.maybe_checkpoint() and self.journal is not None:
+            # epochs below the fresh checkpoint can never replay:
+            # prune them so a resident service's journal stays bounded
+            # (serialized with appends via the commit lock)
+            with self._commit_lock:
+                self.journal.prune(self.store.last_checkpoint_version)
         return result
+
+    def abandon_interrupted_epoch(self) -> bool:
+        """Durably close the current epoch's journaled chunks with a
+        tombstone and move to a fresh epoch — the unwedge path when an
+        interrupted batch will NEVER be resubmitted (without this,
+        every future schedule() of a different batch would refuse with
+        a digest JournalConflict). Safe because an incomplete epoch
+        has published nothing: dropping its chunks loses no
+        externally-visible placement. Returns False when there is
+        nothing to abandon."""
+        if self.journal is None:
+            return False
+        with self._commit_lock:
+            if not self.journal.records_for(self.epoch):
+                return False
+            self.journal.abandon(self.epoch)
+            self.epoch = self.journal.next_epoch()
+            return True
+
+    def recover(self, batches,
+                typed_pods_by_epoch: Optional[Dict[int, List]] = None
+                ) -> dict:
+        """Restart recovery: rehydrate the store, then bring the world
+        back to exactly where the crash interrupted it — never
+        re-placing a committed pod, never dropping an uncommitted one.
+
+        1. If the store has no snapshot yet, restore the last
+           checkpoint (version + delta high-water mark come with it).
+           A caller whose producer logs deltas re-ingests them next:
+           already-applied ones no-op in the store's version guard.
+        2. Every journaled epoch whose base version is AT OR PAST the
+           rehydrated store version re-runs through the normal
+           schedule() path: committed chunks replay (the journal
+           asserts them bit-identical and they are never re-appended),
+           missing chunks of an interrupted tail epoch schedule fresh,
+           and each epoch's publish re-derives the store state the
+           crash destroyed.
+
+        `batches` maps epoch -> the resubmitted PodBatch (or is a
+        callable epoch -> PodBatch); the journal's batch digest pins
+        that the resubmission is the same batch. Returns a report dict
+        (also kept on `last_recovery`) with the per-epoch results."""
+        if self.journal is None:
+            raise RuntimeError("recover() needs a commit journal")
+        t0 = time.monotonic()
+        restored = False
+        try:
+            self.store.current()
+        except RuntimeError:
+            restored = self.store.restore()
+            if not restored:
+                raise RuntimeError(
+                    "recover(): no snapshot and no readable checkpoint "
+                    "— publish the initial snapshot, then call "
+                    "recover() again to replay the journal")
+        epochs = [e for e in self.journal.epochs()
+                  if self.journal.base_version_of(e) >= self.store.version]
+        results = {}
+        replayed = 0
+        for e in epochs:
+            pods = batches(e) if callable(batches) else batches[e]
+            typed = (typed_pods_by_epoch or {}).get(e)
+            self.epoch = e
+            results[e] = self.schedule(pods, typed_pods=typed)
+            replayed += self._cycle_replayed
+        self.epoch = self.journal.next_epoch()
+        seconds = time.monotonic() - t0
+        self.metrics.recovery_seconds.observe(seconds)
+        self.last_recovery = {
+            "restored_checkpoint": restored,
+            "epochs_replayed": epochs,
+            "records_replayed": replayed,
+            "journal_tail": self.journal.tail_reason.value,
+            "seconds": seconds,
+            "results": results,
+        }
+        log.info("recovery complete: %d epoch(s), %d journaled "
+                 "chunk(s) replayed, %.3fs (tail: %s)", len(epochs),
+                 replayed, seconds, self.journal.tail_reason.value)
+        return self.last_recovery
 
     def last_schedule_info(self) -> tuple:
         """(commit version, elapsed seconds) of THE CALLING THREAD's
@@ -990,4 +1333,7 @@ class SchedulerService:
             "degradationLevel": DegradationLadder.LEVELS[self.ladder.level],
             "ladderTransitions": len(self.ladder.transitions),
             "lastHealthWord": self.last_health_word,
+            "meshSize": self._last_mesh_size,
+            "epoch": self.epoch,
+            "journaled": self.journal is not None,
         }

@@ -1,0 +1,405 @@
+"""Chunk-granular commit journal: the crash-durability seam of the
+scheduling service (docs/DESIGN.md "Crash recovery & mesh elasticity").
+
+Koordinator survives scheduler restarts because every decision lives in
+the API server; our device-resident mirror loses the in-flight batch on
+a process crash. The journal closes that gap with the classic
+write-ahead discipline, chunk-granular so recovery never re-opens more
+work than the crash actually interrupted:
+
+- after each chunk's device program completes — and BEFORE its result
+  can be published anywhere — the service appends one checksummed
+  record: (epoch, chunk, n_chunks, store base version, delta watermark,
+  batch digest, the chunk's assignment row block). Append-before-
+  publish means the journal is always a SUPERSET of what any external
+  observer saw, so replay can only re-derive, never invent.
+- replay is idempotent keyed by (epoch, chunk): a record that already
+  exists with identical payload is a no-op; one that exists with a
+  DIFFERENT payload is a conflict and fails loudly (recovery diverged
+  from the original run — continuing would corrupt placements).
+- the tail is torn-write tolerant: a SIGKILL mid-append leaves a
+  truncated record, which load discards with a typed reason
+  (`JournalTail`) and the next append truncates away. A checksum
+  mismatch anywhere BEFORE the tail is real corruption and raises.
+
+The file format is pure struct + raw int32 bytes — no pickle — so a
+journal written by one process version replays in any other.
+
+File I/O here runs under `SchedulerService.commit_lock` by design
+(append-before-publish must be inside the commit critical section);
+this module is the ONE sanctioned seam for that — koordlint LK005
+flags commit-lock file I/O everywhere else. Appends are bounded:
+one header + one int32 row block per chunk, one flush+fsync.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import os
+import struct
+import zlib
+from typing import Callable, Dict, List, Optional, Set
+
+import numpy as np
+
+from koordinator_tpu.snapshot.schema import STRUCT_SPECS
+
+# record framing: MAGIC, payload length, crc32(payload)
+_MAGIC = 0x4B4A4C31  # "KJL1"
+_HEADER = struct.Struct("<III")
+# payload head: epoch, chunk, n_chunks, chunk_size, base_version,
+# delta_watermark, batch_digest — assignment int32 bytes follow
+_PAYLOAD_HEAD = struct.Struct("<IIIIQQI")
+
+# the named crash points the kill-injected soak drives
+# (testing/faults.sigkill_at + tools/crash_smoke.py); the journal owns
+# the three append-seam points, SnapshotStore.checkpoint owns the
+# fourth ("mid_checkpoint")
+POINT_PRE_APPEND = "post_dispatch_pre_append"
+POINT_MID_APPEND = "mid_append_torn"
+POINT_POST_APPEND = "post_append_pre_publish"
+
+
+class JournalTail(enum.Enum):
+    """What the load pass found at the end of the file. A torn tail is
+    the EXPECTED shape of a crash mid-append — discarded, never fatal."""
+
+    CLEAN = "clean"
+    TORN_HEADER = "torn_header"    # fewer bytes than one record header
+    TORN_PAYLOAD = "torn_payload"  # header promises more bytes than exist
+
+
+class JournalCorruption(RuntimeError):
+    """A record BEFORE the tail failed its checksum or framing — not a
+    torn write (those only truncate the tail) but real corruption; the
+    journal cannot be trusted and recovery must fail loudly."""
+
+    def __init__(self, path: str, offset: int, why: str):
+        super().__init__(f"journal {path!r} corrupt at byte {offset}: {why}")
+        self.offset = offset
+
+
+class JournalConflict(RuntimeError):
+    """A (epoch, chunk) commit disagrees with the already-journaled
+    record — replay diverged from the original run (different snapshot
+    rehydration, different batch). Terminal by construction: retrying
+    re-derives the same divergence."""
+
+
+@dataclasses.dataclass(frozen=True)
+class JournalRecord:
+    """One committed chunk. `base_version` is the store version the
+    whole batch read its snapshot at (shared by every chunk of an
+    epoch); `delta_watermark` the store's applied_delta_version at
+    append time; `batch_digest` pins the resubmitted batch on resume."""
+
+    epoch: int
+    chunk: int
+    n_chunks: int
+    base_version: int
+    delta_watermark: int
+    batch_digest: int
+    assignment: np.ndarray  # i32[chunk_size]
+
+    def same_payload(self, other: "JournalRecord") -> bool:
+        return (self.n_chunks == other.n_chunks
+                and self.base_version == other.base_version
+                and self.batch_digest == other.batch_digest
+                and np.array_equal(self.assignment, other.assignment))
+
+    def encode(self) -> bytes:
+        a = np.ascontiguousarray(self.assignment, np.int32)
+        return _PAYLOAD_HEAD.pack(
+            self.epoch, self.chunk, self.n_chunks, a.size,
+            self.base_version, self.delta_watermark,
+            self.batch_digest) + a.tobytes()
+
+    @classmethod
+    def decode(cls, payload: bytes) -> "JournalRecord":
+        (epoch, chunk, n_chunks, size, base, watermark,
+         digest) = _PAYLOAD_HEAD.unpack_from(payload)
+        body = payload[_PAYLOAD_HEAD.size:]
+        if len(body) != 4 * size:
+            raise ValueError(f"payload claims {size} assignment rows, "
+                             f"carries {len(body)} bytes")
+        return cls(epoch=epoch, chunk=chunk, n_chunks=n_chunks,
+                   base_version=base, delta_watermark=watermark,
+                   batch_digest=digest,
+                   assignment=np.frombuffer(body, np.int32).copy())
+
+
+def batch_digest(pods) -> int:
+    """Content digest of the batch a journaled epoch scheduled — the
+    resume guard: a resubmitted batch whose rows differ must not be
+    silently completed against another batch's committed chunks.
+    Covers EVERY registered array column of the PodBatch (requests,
+    gang/quota/selector/toleration ids, domain matrices, counts, ...),
+    per the koordshape field-spec table, so no schedulable input can
+    differ without changing the digest."""
+    d = 0
+    for fname in sorted(STRUCT_SPECS["PodBatch"]):
+        spec = STRUCT_SPECS["PodBatch"][fname]
+        if not (isinstance(spec, str) and "[" in spec):
+            continue  # symbolic-int property (num_pods), not a column
+        a = np.ascontiguousarray(np.asarray(getattr(pods, fname)))
+        d = zlib.crc32(fname.encode() + repr(a.shape).encode(), d)
+        d = zlib.crc32(a.tobytes(), d)
+    return d & 0xFFFFFFFF
+
+
+class CommitJournal:
+    """Append-only, checksummed, torn-tail-tolerant chunk commit log.
+
+    `crash_hook` (testing seam) is called with the named crash point
+    at the three append stages; `faults.sigkill_at` turns one of them
+    into a real SIGKILL for the kill-injected soak.
+    """
+
+    def __init__(self, path: str,
+                 crash_hook: Optional[Callable[[str], None]] = None):
+        self.path = str(path)
+        self.crash_hook = crash_hook
+        # epoch -> {chunk -> record}
+        self.records: Dict[int, Dict[int, JournalRecord]] = {}
+        # epochs closed by a durable tombstone (abandon()): their
+        # records never replay, and next_epoch moves past them
+        self.abandoned: Set[int] = set()
+        self.tail_reason = JournalTail.CLEAN
+        self.appended_records = 0  # this process's appends
+        self.appended_bytes = 0
+        self._good_end = 0
+        self._load()
+
+    # --- load / scan -------------------------------------------------------
+
+    def _load(self) -> None:
+        if not os.path.exists(self.path):
+            return
+        with open(self.path, "rb") as f:
+            data = f.read()
+        off = 0
+        while off < len(data):
+            if len(data) - off < _HEADER.size:
+                self.tail_reason = JournalTail.TORN_HEADER
+                break
+            magic, length, crc = _HEADER.unpack_from(data, off)
+            if magic != _MAGIC:
+                raise JournalCorruption(self.path, off, "bad record magic")
+            start = off + _HEADER.size
+            if len(data) - start < length:
+                self.tail_reason = JournalTail.TORN_PAYLOAD
+                break
+            payload = data[start:start + length]
+            if zlib.crc32(payload) & 0xFFFFFFFF != crc:
+                # a full-length record with a bad checksum is NOT a torn
+                # tail (truncation only shortens): fail loudly
+                raise JournalCorruption(self.path, off,
+                                        "payload checksum mismatch")
+            try:
+                rec = JournalRecord.decode(payload)
+            except ValueError as exc:
+                raise JournalCorruption(self.path, off, str(exc)) from exc
+            self._index(rec, loading=True)
+            off = start + length
+            self._good_end = off
+
+    def _index(self, rec: JournalRecord, loading: bool) -> bool:
+        """-> True if the record is new; False for an identical
+        duplicate (idempotent no-op); raises on a conflicting one.
+        An n_chunks of 0 is the epoch TOMBSTONE (abandon)."""
+        if rec.n_chunks == 0:
+            self.abandoned.add(rec.epoch)
+            # keep any pre-tombstone chunk rows indexed (so next_epoch
+            # still sees the epoch) but never replay them (records_for)
+            self.records.setdefault(rec.epoch, {})
+            return True
+        self._check_conflict(rec, loading)
+        by_chunk = self.records.setdefault(rec.epoch, {})
+        prior = by_chunk.get(rec.chunk)
+        if prior is not None:
+            return False  # identical duplicate (_check_conflict ruled
+            #               out a divergent one)
+        by_chunk[rec.chunk] = rec
+        return True
+
+    def _check_conflict(self, rec: JournalRecord, loading: bool) -> bool:
+        """Validate a non-tombstone record against the index WITHOUT
+        touching it (runs BEFORE any durable write on the append path,
+        so a divergent record never half-lands on disk). -> True when
+        the record already exists identically."""
+        if rec.epoch in self.abandoned:
+            raise JournalConflict(
+                f"epoch {rec.epoch} was abandoned; appending to it "
+                f"would resurrect placements the tombstone closed")
+        by_chunk = self.records.get(rec.epoch, {})
+        prior = by_chunk.get(rec.chunk)
+        if prior is not None:
+            if prior.same_payload(rec):
+                return True
+            raise JournalConflict(
+                f"(epoch {rec.epoch}, chunk {rec.chunk}) re-committed "
+                f"with a different payload"
+                + (" while loading" if loading else
+                   " — recovery diverged from the journaled run"))
+        if by_chunk and rec.n_chunks != \
+                next(iter(by_chunk.values())).n_chunks:
+            raise JournalConflict(
+                f"epoch {rec.epoch} records disagree on n_chunks")
+        return False
+
+    # --- append ------------------------------------------------------------
+
+    def _hook(self, point: str) -> None:
+        if self.crash_hook is not None:
+            self.crash_hook(point)
+
+    def append(self, rec: JournalRecord) -> int:
+        """Durably commit one chunk. Returns the bytes written, or 0
+        when the record already exists identically (idempotent replay);
+        raises JournalConflict on a divergent duplicate. ALL conflict
+        checks (divergent payload, n_chunks drift, abandoned epoch) run
+        BEFORE touching the file, so a conflicting record never lands
+        durably only to make the journal unloadable."""
+        self._hook(POINT_PRE_APPEND)
+        if rec.n_chunks != 0 and self._check_conflict(rec, loading=False):
+            self._hook(POINT_POST_APPEND)
+            return 0  # identical duplicate: idempotent no-op
+        payload = rec.encode()
+        buf = _HEADER.pack(_MAGIC, len(payload),
+                           zlib.crc32(payload) & 0xFFFFFFFF) + payload
+        with open(self.path, "r+b" if os.path.exists(self.path)
+                  else "w+b") as f:
+            # a torn tail from a previous crash is discarded here — the
+            # new record starts at the last good byte
+            f.truncate(self._good_end)
+            f.seek(self._good_end)
+            half = len(buf) // 2
+            f.write(buf[:half])
+            f.flush()
+            self._hook(POINT_MID_APPEND)  # SIGKILL here = torn write
+            f.write(buf[half:])
+            f.flush()
+            os.fsync(f.fileno())
+        self.tail_reason = JournalTail.CLEAN
+        self._good_end += len(buf)
+        self._index(rec, loading=False)
+        self.appended_records += 1
+        self.appended_bytes += len(buf)
+        self._hook(POINT_POST_APPEND)
+        return len(buf)
+
+    def abandon(self, epoch: int) -> int:
+        """Durably close an epoch with a tombstone: its journaled
+        chunks never replay again and `next_epoch` moves past it.
+        SAFE only because an incomplete epoch has by construction
+        published NOTHING (the store publish is what seals an epoch),
+        so dropping its chunks loses no externally-visible placement —
+        the unwedge path for an interrupted batch that will never be
+        resubmitted (SchedulerService.abandon_interrupted_epoch) and
+        for a retry whose base snapshot moved under it. Idempotent."""
+        if epoch in self.abandoned:
+            return 0
+        return self.append(JournalRecord(
+            epoch=epoch, chunk=0, n_chunks=0, base_version=0,
+            delta_watermark=0, batch_digest=0,
+            assignment=np.zeros((0,), np.int32)))
+
+    def prune(self, min_base_version: int) -> int:
+        """Checkpoint-anchored truncation: drop epochs that can never
+        replay again — complete (or abandoned) epochs whose base
+        version is BELOW the last durable checkpoint's store version
+        (recovery only replays `base_version >= store.version`, and a
+        restored store is never older than its checkpoint). The most
+        recent epoch is always kept so `next_epoch` stays monotonic
+        across restarts. Without this a resident service accretes
+        every assignment ever committed, in RAM and on disk, and
+        reload cost grows with lifetime throughput. Atomic (tmp +
+        os.replace); returns the number of epochs dropped. Call it
+        serialized with appends (the service prunes under its commit
+        lock, right after a successful checkpoint)."""
+        if not self.records:
+            return 0
+        last = max(self.records)
+        dead = [
+            e for e in self.records
+            if e != last and self.epoch_complete(e)
+            and (e in self.abandoned
+                 or self.base_version_of(e) < min_base_version)]
+        if not dead:
+            return 0
+        keep: List[JournalRecord] = []
+        for e in sorted(self.records):
+            if e in dead:
+                continue
+            if e in self.abandoned:
+                # the tombstone alone: the epoch's chunk rows are
+                # masked forever, and a record written AFTER its
+                # tombstone would refuse to load
+                keep.append(JournalRecord(
+                    epoch=e, chunk=0, n_chunks=0, base_version=0,
+                    delta_watermark=0, batch_digest=0,
+                    assignment=np.zeros((0,), np.int32)))
+                self.records[e] = {}
+                continue
+            keep.extend(self.records[e][c]
+                        for c in sorted(self.records[e]))
+        tmp = self.path + ".tmp"
+        with open(tmp, "wb") as f:
+            for r in keep:
+                payload = r.encode()
+                f.write(_HEADER.pack(_MAGIC, len(payload),
+                                     zlib.crc32(payload) & 0xFFFFFFFF))
+                f.write(payload)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self.path)
+        for e in dead:
+            self.records.pop(e, None)
+            self.abandoned.discard(e)
+        self._good_end = os.path.getsize(self.path)
+        self.tail_reason = JournalTail.CLEAN
+        return len(dead)
+
+    # --- queries -----------------------------------------------------------
+
+    def records_for(self, epoch: int) -> Dict[int, JournalRecord]:
+        if epoch in self.abandoned:
+            return {}
+        return dict(self.records.get(epoch, {}))
+
+    def epochs(self) -> List[int]:
+        return sorted(e for e in self.records if e not in self.abandoned)
+
+    def n_chunks_of(self, epoch: int) -> Optional[int]:
+        by_chunk = self.records_for(epoch)
+        if not by_chunk:
+            return None
+        return next(iter(by_chunk.values())).n_chunks
+
+    def base_version_of(self, epoch: int) -> Optional[int]:
+        by_chunk = self.records_for(epoch)
+        if not by_chunk:
+            return None
+        return next(iter(by_chunk.values())).base_version
+
+    def epoch_complete(self, epoch: int) -> bool:
+        """A tombstoned epoch counts as CLOSED (complete for epoch
+        accounting, empty for replay)."""
+        if epoch in self.abandoned:
+            return True
+        by_chunk = self.records.get(epoch)
+        if not by_chunk:
+            return False
+        n = next(iter(by_chunk.values())).n_chunks
+        return set(by_chunk) == set(range(n))
+
+    def next_epoch(self) -> int:
+        """The epoch the service should run next: a fresh journal
+        starts at 1; a journal whose last epoch is incomplete RESUMES
+        that epoch (its committed chunks replay idempotently); a
+        tombstoned last epoch is closed and skipped."""
+        if not self.records:
+            return 1
+        last = max(self.records)
+        return last + 1 if self.epoch_complete(last) else last

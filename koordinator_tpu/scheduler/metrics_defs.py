@@ -19,8 +19,14 @@ from koordinator_tpu.metrics.registry import (  # noqa: F401  (re-export)
     SCHEDULER_DELTA_REJECTED,
     SCHEDULER_FAILURES_CLASSIFIED,
     SCHEDULER_GUARD_TRIPS,
+    SCHEDULER_JOURNAL_APPENDS,
+    SCHEDULER_JOURNAL_BYTES,
+    SCHEDULER_MESH_SHRINK_EVENTS,
+    SCHEDULER_MESH_SIZE,
     SCHEDULER_PODS_SCHEDULED,
     SCHEDULER_QUARANTINED_INPUTS,
+    SCHEDULER_RECOVERY_REPLAYED_RECORDS,
+    SCHEDULER_RECOVERY_SECONDS,
     SCHEDULER_SCHEDULE_BATCH_KERNEL_SECONDS,
     SCHEDULER_SCHEDULE_CYCLE_SECONDS,
     SCHEDULER_SCHEDULING_TIMEOUT,
@@ -83,3 +89,31 @@ class SchedulerMetrics:
             SCHEDULER_DELTA_REJECTED,
             "Snapshot deltas rejected by the store's version guard "
             "(out-of-order / duplicate replay)", labels=("reason",))
+        # crash recovery (docs/DESIGN.md "Crash recovery & mesh
+        # elasticity"): the commit journal's write volume, what replay
+        # had to re-derive after a crash, and the mesh's elasticity
+        self.journal_appends = r.counter(
+            SCHEDULER_JOURNAL_APPENDS,
+            "Chunk commit records durably appended to the commit "
+            "journal (scheduler/journal.py)")
+        self.journal_bytes = r.counter(
+            SCHEDULER_JOURNAL_BYTES,
+            "Bytes durably appended to the commit journal")
+        self.recovery_replayed = r.counter(
+            SCHEDULER_RECOVERY_REPLAYED_RECORDS,
+            "Journaled chunk records replayed (asserted bit-identical, "
+            "never re-appended) while resuming an interrupted batch — "
+            "in-process retry or restart recovery")
+        self.recovery_seconds = r.histogram(
+            SCHEDULER_RECOVERY_SECONDS,
+            "Wall-clock of SchedulerService.recover(): checkpoint "
+            "restore + journal replay until the store is re-derived")
+        self.mesh_shrink_events = r.counter(
+            SCHEDULER_MESH_SHRINK_EVENTS,
+            "Ladder transitions INTO the mesh-shrink rung (device lost "
+            "with >= 2 survivors; the mesh rebuilds over the survivors)")
+        self.mesh_size = r.gauge(
+            SCHEDULER_MESH_SIZE,
+            "Devices in the mesh the last scheduling cycle considered "
+            "usable (survivors on the mesh-shrink rung, 1 on "
+            "single_device, the full fleet otherwise)")

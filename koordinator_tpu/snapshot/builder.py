@@ -681,6 +681,16 @@ class SnapshotBuilder:
         return (True, usage, prod_usage, agg, has_agg,
                 assigned_est, assigned_corr, prod_est, prod_corr)
 
+    def resume_delta_version(self, version: int) -> None:
+        """Fast-forward the builder's delta sequence to at least a
+        restored store's `applied_delta_version` watermark
+        (SnapshotStore.restore), so a producer restarted from a
+        checkpoint stamps its NEXT delta above everything the
+        checkpoint already contains — without this, the restarted
+        sequence restarts at 1 and the store's replay guard (rightly)
+        rejects every fresh delta as stale."""
+        self._delta_version = max(self._delta_version, int(version))
+
     def _next_delta_version(self, version: Optional[int]) -> np.ndarray:
         """Stamp for an emitted delta: the explicit `version` wins (and
         advances the high-water mark), else the builder's own sequence

@@ -8,17 +8,70 @@ hide HBM transfer latency behind compute (SURVEY.md 2.9) — and between
 rebuilds the store stays fresh with O(K) device-side deltas: `ingest`
 scatters per-node metric updates, `forget` un-assumes failed binds
 (snapshot/delta.py; scheduler_adapter.go assume/forget).
+
+Restart recovery (docs/DESIGN.md "Crash recovery & mesh elasticity"):
+`checkpoint`/`restore` persist the full snapshot with its version and
+delta high-water mark, atomically (tmp + os.replace) and checksummed,
+so a crashed service rehydrates the device snapshot, replays the
+producer's versioned deltas through the existing idempotent guard, and
+hands the interrupted batch to the commit journal
+(scheduler/journal.py).
 """
 
 from __future__ import annotations
 
+import io
+import os
+import struct
 import threading
-from typing import Any, Callable, Optional
+import zlib
+from typing import Any, Callable, Dict, Iterator, Optional, Tuple
 
 import jax
 import numpy as np
 
-from koordinator_tpu.snapshot.schema import ClusterSnapshot
+from koordinator_tpu.snapshot.schema import (
+    STRUCT_CLASSES,
+    STRUCT_SPECS,
+    ClusterSnapshot,
+)
+
+# checkpoint framing: MAGIC, store version, applied delta watermark,
+# npz byte length, then crc32 over ALL of the preceding header fields
+# plus the npz bytes — the version/watermark are load-bearing for
+# recovery (they gate journal-epoch replay and delta dedup), so header
+# corruption must be caught exactly like blob corruption
+_CK_MAGIC = 0x4B434B31  # "KCK1"
+_CK_PREFIX = struct.Struct("<IQQQ")
+_CK_CRC = struct.Struct("<I")
+_CK_HEADER_SIZE = _CK_PREFIX.size + _CK_CRC.size
+
+
+def _struct_leaves(name: str, obj,
+                   prefix: str = "") -> Iterator[Tuple[str, np.ndarray]]:
+    """(dotted key, host array) per registered leaf — the koordshape
+    field-spec tables drive serialization exactly like they drive the
+    mesh shardings, so a new snapshot field cannot silently be dropped
+    from checkpoints."""
+    for fname, spec in STRUCT_SPECS[name].items():
+        if isinstance(spec, str) and spec in STRUCT_SPECS:
+            yield from _struct_leaves(spec, getattr(obj, fname),
+                                      prefix + fname + ".")
+        elif isinstance(spec, str) and "[" in spec:
+            yield prefix + fname, np.asarray(getattr(obj, fname))
+        # bare-symbol entries (num_nodes) are properties, not fields
+
+
+def _build_struct(name: str, arrays: Dict[str, np.ndarray],
+                  prefix: str = ""):
+    fields = {}
+    for fname, spec in STRUCT_SPECS[name].items():
+        if isinstance(spec, str) and spec in STRUCT_SPECS:
+            fields[fname] = _build_struct(spec, arrays,
+                                          prefix + fname + ".")
+        elif isinstance(spec, str) and "[" in spec:
+            fields[fname] = arrays[prefix + fname]
+    return STRUCT_CLASSES[name](**fields)
 
 
 class SnapshotStore:
@@ -31,7 +84,10 @@ class SnapshotStore:
     - Optional `sharding` places the node axis across a mesh (parallel/mesh.py).
     """
 
-    def __init__(self, sharding: Optional[Any] = None):
+    def __init__(self, sharding: Optional[Any] = None,
+                 checkpoint_path: Optional[str] = None,
+                 checkpoint_every: int = 1,
+                 crash_hook: Optional[Callable[[str], None]] = None):
         self._sharding = sharding
         self._lock = threading.Lock()
         self._current: Optional[ClusterSnapshot] = None
@@ -41,6 +97,23 @@ class SnapshotStore:
         self._applied_delta_version = 0
         self._last_delta_rejection = None
         self.delta_rejections = 0
+        # restart recovery (docs/DESIGN.md "Crash recovery & mesh
+        # elasticity"): periodic checkpoints of the full snapshot +
+        # version + delta watermark; `maybe_checkpoint` is called by
+        # owners OUTSIDE their commit locks (disk must never stall a
+        # scheduler), at most every `checkpoint_every` versions.
+        # `crash_hook` is the kill-injection seam (faults.sigkill_at).
+        self.checkpoint_path = checkpoint_path
+        self.checkpoint_every = max(int(checkpoint_every), 1)
+        self.crash_hook = crash_hook
+        self._last_checkpoint_version = 0
+        self.checkpoints_written = 0
+        # serializes whole checkpoint writes (capture -> tmp ->
+        # os.replace): without it, racing maybe_checkpoint() callers
+        # (publish / ingest / post-schedule all call it, from different
+        # threads) would interleave writes into the shared .tmp file or
+        # replace a newer checkpoint with an older capture
+        self._ck_lock = threading.Lock()
 
     @property
     def version(self) -> int:
@@ -49,6 +122,13 @@ class SnapshotStore:
     @property
     def applied_delta_version(self) -> int:
         return self._applied_delta_version
+
+    @property
+    def last_checkpoint_version(self) -> int:
+        """Store version of the last durable checkpoint (0 = none) —
+        the anchor below which journal epochs can never replay
+        (CommitJournal.prune)."""
+        return self._last_checkpoint_version
 
     def take_delta_rejection(self):
         """Pop the last ingest's DeltaRejectReason (None if it applied)
@@ -139,6 +219,116 @@ class SnapshotStore:
             self._current = apply(self._current, delta)
             self._version += 1
             return self._current
+
+    # --- restart recovery: periodic checkpoints --------------------------
+
+    def maybe_checkpoint(self) -> bool:
+        """Checkpoint when configured and `checkpoint_every` versions
+        have landed since the last one. Called by owners OUTSIDE their
+        commit locks (SchedulerService calls it after publish/ingest/
+        schedule release the lock) so a fsync can never stall a
+        scheduling cycle waiting on the lock."""
+        if self.checkpoint_path is None:
+            return False
+        with self._lock:
+            due = (self._current is not None
+                   and self._version - self._last_checkpoint_version
+                   >= self.checkpoint_every)
+        if not due:
+            return False
+        self.checkpoint()
+        return True
+
+    def checkpoint(self, path: Optional[str] = None) -> str:
+        """Write the current snapshot + version + delta watermark,
+        checksummed and ATOMIC (tmp file + os.replace): a crash
+        mid-write leaves the previous checkpoint intact, never a torn
+        one — `restore` therefore only ever sees a complete file, and
+        the crc is the belt to that suspender."""
+        path = path or self.checkpoint_path
+        if path is None:
+            raise ValueError("no checkpoint path configured")
+        # one writer at a time, capture THROUGH replace: concurrent
+        # maybe_checkpoint() callers otherwise interleave in the shared
+        # tmp file, or an older capture can os.replace a newer one
+        with self._ck_lock:
+            with self._lock:
+                snap = self._current
+                version = self._version
+                delta_v = self._applied_delta_version
+            if snap is None:
+                raise RuntimeError("no snapshot published yet")
+            # serialize outside the SNAPSHOT lock: the D2H gather and
+            # npz encode are the expensive part, and readers/writers
+            # must not wait on them (only other checkpointers do)
+            buf = io.BytesIO()
+            np.savez(buf, **dict(_struct_leaves("ClusterSnapshot", snap)))
+            blob = buf.getvalue()
+            prefix = _CK_PREFIX.pack(_CK_MAGIC, version, delta_v,
+                                     len(blob))
+            crc = zlib.crc32(blob, zlib.crc32(prefix)) & 0xFFFFFFFF
+            header = prefix + _CK_CRC.pack(crc)
+            tmp = path + ".tmp"
+            with open(tmp, "wb") as f:
+                f.write(header)
+                f.write(blob[:len(blob) // 2])
+                f.flush()
+                if self.crash_hook is not None:
+                    self.crash_hook("mid_checkpoint")  # SIGKILL = torn
+                f.write(blob[len(blob) // 2:])
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, path)
+            with self._lock:
+                self._last_checkpoint_version = version
+            self.checkpoints_written += 1
+        return path
+
+    def restore(self, path: Optional[str] = None) -> bool:
+        """Rehydrate the device snapshot from the last checkpoint:
+        version and the delta high-water mark come back with it, so a
+        producer replaying its delta log after the restart has every
+        already-applied delta no-op idempotently in the version guard
+        while later ones apply normally. Returns False (no state
+        touched) when there is no readable checkpoint — missing,
+        corrupt, or written for a different snapshot schema (field-set
+        drift across a deploy) — and the caller falls back to a fresh
+        publish."""
+        path = path or self.checkpoint_path
+        if path is None or not os.path.exists(path):
+            return False
+        try:
+            with open(path, "rb") as f:
+                header = f.read(_CK_HEADER_SIZE)
+                prefix = header[:_CK_PREFIX.size]
+                magic, version, delta_v, blob_len = \
+                    _CK_PREFIX.unpack(prefix)
+                (crc,) = _CK_CRC.unpack(header[_CK_PREFIX.size:])
+                if magic != _CK_MAGIC:
+                    return False
+                blob = f.read(blob_len)
+            if len(blob) != blob_len or \
+                    zlib.crc32(blob, zlib.crc32(prefix)) & 0xFFFFFFFF \
+                    != crc:
+                return False
+            arrays = dict(np.load(io.BytesIO(blob)))
+            # a crc-valid checkpoint from a build with a DIFFERENT
+            # registered field set (schema drift) raises KeyError here:
+            # unreadable for this build, same typed outcome as corrupt
+            snap = _build_struct("ClusterSnapshot", arrays)
+        except (OSError, ValueError, KeyError, struct.error):
+            return False
+        if self._sharding is not None:
+            on_device = jax.device_put(snap, self._sharding)
+        else:
+            on_device = jax.device_put(snap)
+        with self._lock:
+            self._current = on_device
+            self._version = int(version)
+            self._applied_delta_version = int(delta_v)
+            self._last_checkpoint_version = int(version)
+            self._last_delta_rejection = None
+        return True
 
     def forget(self, pods, result, mask) -> ClusterSnapshot:
         """Un-assume failed binds (scheduler_adapter.go Forget): returns

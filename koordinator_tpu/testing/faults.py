@@ -18,6 +18,8 @@ Consumed by tools/chaos_smoke.py (the CI matrix), tools/soak_service.py
 
 from __future__ import annotations
 
+import os
+import signal
 from typing import Callable, Optional, Tuple
 
 import numpy as np
@@ -32,9 +34,18 @@ SNAPSHOT_FAULTS = ("nan_metric_column", "negative_allocatable",
 BATCH_FAULTS = ("nan_pod_request", "negative_pod_request",
                 "bad_gang_id", "bad_domain_index")
 RUNTIME_FAULTS = ("xla_oom", "xla_transient", "device_lost",
-                  "watchdog_stall")
+                  "watchdog_stall", "device_lost_mid_chunk")
 DELTA_FAULTS = ("stale_delta",)
 ALL_FAULTS = SNAPSHOT_FAULTS + BATCH_FAULTS + RUNTIME_FAULTS + DELTA_FAULTS
+
+# the named crash points of the kill-injected soak (ISSUE 14): the
+# first three are the commit journal's append seam
+# (scheduler/journal.py POINT_*), the fourth is the store's checkpoint
+# writer. tools/crash_smoke.py SIGKILLs the service at each one and
+# asserts the restarted service converges bit-identical to the
+# no-crash oracle.
+CRASH_POINTS = ("post_dispatch_pre_append", "mid_append_torn",
+                "post_append_pre_publish", "mid_checkpoint")
 
 # fault class -> guard-word bit the detection assertion checks
 EXPECTED_BIT = {
@@ -190,3 +201,46 @@ class FaultInjector:
         """Force every cycle over the watchdog budget: the stall is
         classified and the NEXT cycle runs one rung down."""
         service.monitor.timeout = 0.0
+
+    def lost_device_until_shrunk(self, after_calls: int) -> Callable:
+        """A device that dies after `after_calls` program invocations
+        and STAYS dead until the service stops scheduling onto it —
+        i.e. every attempt keeps failing until the ladder reaches the
+        mesh-shrink (or single-device) rung, exactly like a real bricked
+        chip. The in-place transient retries must exhaust before the
+        rung change, so this drives the full detect -> retry ->
+        shrink -> resume path."""
+        counter = {"n": 0}
+
+        def hook(state, _batch):
+            counter["n"] += 1
+            if counter["n"] > after_calls and not state.mesh_shrink \
+                    and not state.single_device:
+                raise make_xla_error(
+                    "UNAVAILABLE: device lost; socket closed")
+
+        return hook
+
+
+# --- kill-injected crash points (tools/crash_smoke.py) ---------------------
+
+
+def sigkill_at(point: str, hit: int = 1) -> Callable[[str], None]:
+    """Crash hook for the CommitJournal / SnapshotStore checkpoint
+    seams: SIGKILL this process the `hit`-th time the named crash point
+    is reached. A real SIGKILL — no atexit, no buffer flush, no
+    finally blocks — so the on-disk state is exactly what a power cut
+    would leave."""
+    if point not in CRASH_POINTS:
+        raise ValueError(f"unknown crash point {point!r} "
+                         f"(known: {CRASH_POINTS})")
+    count = {"n": 0}
+
+    def hook(name: str) -> None:
+        if name != point:
+            return
+        count["n"] += 1
+        if count["n"] == hit:
+            os.kill(os.getpid(), signal.SIGKILL)
+
+    return hook

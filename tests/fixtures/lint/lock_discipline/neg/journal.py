@@ -1,0 +1,20 @@
+"""Negative LK005 fixture: a module named journal.py IS the sanctioned
+bounded append seam — commit-lock file I/O here is exempt (the real
+one is koordinator_tpu/scheduler/journal.py, whose append-before-
+publish ordering REQUIRES writing inside the commit critical section)."""
+
+import os
+import threading
+
+
+class CommitJournal:
+    def __init__(self, path):
+        self.path = path
+        self._commit_lock = threading.Lock()
+
+    def append(self, payload):
+        with self._commit_lock:
+            with open(self.path, "ab") as f:   # exempt: the seam
+                f.write(payload)
+                f.flush()
+                os.fsync(f.fileno())

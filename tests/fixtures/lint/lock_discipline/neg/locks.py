@@ -53,6 +53,28 @@ class Queue:
             self._cond.notify()
 
 
+class CheckpointWriter:
+    def __init__(self):
+        self._commit_lock = threading.Lock()
+        self._state = b""
+
+    def commit(self, payload):
+        # the negative shape LK005 demands: snapshot under the lock,
+        # write OUTSIDE it
+        with self._commit_lock:
+            self._state = payload
+        with open("/tmp/ck.bin", "wb") as f:
+            f.write(self._state)
+
+    def non_commit_io(self, payload):
+        # file I/O under a NON-commit lock is out of LK005's scope
+        # (LK002 owns genuinely blocking calls; plain writes are fine
+        # under ordinary state locks)
+        with _REGISTRY_LOCK:
+            with open("/tmp/reg.bin", "wb") as f:
+                f.write(payload)
+
+
 class SharedLockQueue:
     def __init__(self):
         # the stdlib idiom: the condition WRAPS an existing lock, so

@@ -40,6 +40,29 @@ class Store:
             self._a.release()
 
 
+class Service:
+    """LK005: file I/O under a commit lock outside the journal seam."""
+
+    def __init__(self):
+        self._commit_lock = threading.Lock()
+
+    def commit_direct(self, payload):
+        with self._commit_lock:
+            with open("/tmp/x.bin", "ab") as f:   # LK005: direct
+                f.write(payload)
+
+    def commit_indirect(self, payload):
+        with self._commit_lock:
+            return self._persist(payload)         # LK005: via helper
+
+    def _persist(self, payload):
+        import os
+        with open("/tmp/x.bin", "ab") as f:
+            f.write(payload)
+        os.fsync(f.fileno())
+        return True
+
+
 class Feed:
     def __init__(self):
         self._state = threading.Lock()

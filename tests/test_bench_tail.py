@@ -78,3 +78,58 @@ def test_stamped_line_always_carries_staleness(bench_mod):
     fresh = bench_mod._stamped_line({"metric": "m"}, "t", age=10.0,
                                     stale_after=3600.0)
     assert fresh["stale_capture"] is False
+
+
+# --- run_with_ladder: the bench's device-lost recovery rung (ISSUE 14) -----
+
+def test_ladder_device_lost_retries_on_a_shrunk_device_set(bench_mod,
+                                                           monkeypatch):
+    """A DEVICE_LOST-classified failure retries with the device count
+    shrunk by one, and the retried line carries the `recovered` stamp
+    — the bench mirror of the service's mesh-shrink rung."""
+    monkeypatch.delenv("BENCH_DEVICES", raising=False)
+    calls = []
+
+    def fake_run(chunk=None, degraded=None, num_devices=None,
+                 recovered=None, **kw):
+        calls.append((chunk, degraded, num_devices, recovered))
+        if len(calls) < 3:
+            raise RuntimeError("UNAVAILABLE: device lost; socket closed")
+        return {"num_devices": num_devices, "recovered": recovered,
+                "degraded": degraded}
+
+    monkeypatch.setattr(bench_mod.jax, "devices", lambda: [0, 1, 2, 3])
+    line = bench_mod.run_with_ladder(max_halvings=2, _run=fake_run)
+    # 4 -> 3 -> 2 devices, each retry stamped as recovered
+    assert [c[2] for c in calls] == [None, 3, 2]
+    assert line["recovered"] == "device_lost:devices=2"
+    assert line["num_devices"] == 2
+    assert line["degraded"] is None
+
+
+def test_ladder_oom_still_halves_the_chunk(bench_mod, monkeypatch):
+    calls = []
+
+    def fake_run(chunk=None, degraded=None, num_devices=None,
+                 recovered=None, **kw):
+        calls.append(chunk)
+        if len(calls) < 2:
+            raise RuntimeError("RESOURCE_EXHAUSTED: OOM")
+        return {"chunk": chunk, "degraded": degraded,
+                "recovered": recovered}
+
+    line = bench_mod.run_with_ladder(max_halvings=2, chunk=8,
+                                     _run=fake_run)
+    assert calls == [8, 4]
+    assert line["degraded"] == "resource_exhausted:chunk=4"
+    assert line["recovered"] is None
+
+
+def test_ladder_out_of_device_rungs_propagates(bench_mod, monkeypatch):
+    def fake_run(chunk=None, degraded=None, num_devices=None,
+                 recovered=None, **kw):
+        raise RuntimeError("UNAVAILABLE: device lost; socket closed")
+
+    monkeypatch.setattr(bench_mod.jax, "devices", lambda: [0])
+    with pytest.raises(RuntimeError, match="device lost"):
+        bench_mod.run_with_ladder(max_halvings=3, _run=fake_run)

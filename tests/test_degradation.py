@@ -138,10 +138,66 @@ def test_ladder_oom_jumps_to_chunking_and_halves():
 
 
 def test_ladder_device_lost_jumps_to_single_device():
+    """Without survivor visibility (or with < 2 survivors) the mesh is
+    abandoned — the conservative pre-ISSUE-14 behavior stays."""
     lad = DegradationLadder()
     assert lad.on_failure(FailureClass.DEVICE_LOST, probing=False)
     assert lad.level == DegradationLadder.L_SINGLE_DEVICE
     assert not lad.on_failure(FailureClass.DEVICE_LOST, probing=False)
+    lad2 = DegradationLadder()
+    assert lad2.on_failure(FailureClass.DEVICE_LOST, probing=False,
+                           survivors=1)
+    assert lad2.level == DegradationLadder.L_SINGLE_DEVICE
+
+
+def test_ladder_device_lost_with_survivors_shrinks_the_mesh():
+    """>= 2 survivors earn the mesh-shrink rung; a SECOND device loss
+    there falls to single_device (monotone)."""
+    lad = DegradationLadder()
+    assert lad.on_failure(FailureClass.DEVICE_LOST, probing=False,
+                          survivors=7)
+    assert lad.level == DegradationLadder.L_MESH_SHRINK
+    assert lad.state().mesh_shrink and not lad.state().single_device
+    assert lad.on_failure(FailureClass.DEVICE_LOST, probing=False,
+                          survivors=6)
+    assert lad.level == DegradationLadder.L_SINGLE_DEVICE
+    # chunking in force is KEPT across the shrink
+    lad2 = DegradationLadder()
+    lad2.on_failure(FailureClass.RESOURCE_EXHAUSTED, probing=False)
+    lad2.on_failure(FailureClass.DEVICE_LOST, probing=False, survivors=4)
+    assert lad2.state().label() == "mesh_shrink/2^1"
+
+
+def test_ladder_generic_failures_skip_the_mesh_shrink_rung():
+    """mesh_shrink is the DEVICE_LOST rung: a generic failure past
+    chunking goes straight to single_device (shrinking a mesh with no
+    lost device is meaningless)."""
+    lad = DegradationLadder()
+    lad.level = DegradationLadder.L_CHUNKED
+    lad.chunk_splits = 1
+    assert lad.on_failure(FailureClass.XLA_INTERNAL, probing=False)
+    assert lad.level == DegradationLadder.L_SINGLE_DEVICE
+
+
+def test_ladder_probe_from_mesh_shrink_restores_the_full_mesh():
+    lad = DegradationLadder(probe_after=1)
+    lad.on_failure(FailureClass.DEVICE_LOST, probing=False, survivors=3)
+    lad.on_success(False, lad.state())
+    state, probing = lad.begin_cycle()
+    # chunk-free mesh_shrink probes past the chunked rung entirely
+    assert probing and state.level == DegradationLadder.L_NO_CASCADE
+    lad2 = DegradationLadder(probe_after=1)
+    lad2.on_failure(FailureClass.RESOURCE_EXHAUSTED, probing=False)
+    lad2.on_failure(FailureClass.DEVICE_LOST, probing=False, survivors=3)
+    lad2.on_success(False, lad2.state())
+    state2, probing2 = lad2.begin_cycle()
+    assert probing2 and state2.label() == "chunked/2^1"
+    # single_device probes to mesh_shrink first (gentler re-entry)
+    lad3 = DegradationLadder(probe_after=1)
+    lad3.on_failure(FailureClass.DEVICE_LOST, probing=False)
+    lad3.on_success(False, lad3.state())
+    state3, probing3 = lad3.begin_cycle()
+    assert probing3 and state3.level == DegradationLadder.L_MESH_SHRINK
 
 
 def test_ladder_generic_failures_step_one_rung():
@@ -258,6 +314,51 @@ def test_service_transient_retries_in_place():
     np.testing.assert_array_equal(
         np.asarray(res.assignment),
         np.asarray(oracle.schedule(pods).assignment))
+
+
+def test_service_device_lost_resumes_on_the_shrunk_mesh():
+    """ISSUE 14: a device that dies and STAYS dead (until excluded)
+    must land the service on the mesh-shrink rung — scheduling over
+    the survivors, bit-identical to the healthy program — and probe-up
+    must restore the full mesh."""
+    import jax
+
+    if jax.device_count() < 3:
+        pytest.skip("needs >= 3 devices (conftest forces 8 on CPU)")
+    snap, pods = slim_inputs(11)
+    inj = faults.FaultInjector(3)
+    svc = make_service()
+    svc.ladder.probe_after = 1
+    svc.fault_injection = inj.lost_device_until_shrunk(after_calls=0)
+    survivors = jax.devices()[:-1]
+    svc.device_health = lambda: survivors
+    svc.publish(snap)
+    res = svc.schedule(pods)
+    assert svc.ladder.level == DegradationLadder.L_MESH_SHRINK
+    assert svc.metrics.mesh_shrink_events.value() == 1
+    assert svc.metrics.mesh_size.value() == len(survivors)
+    assert svc.summary()["meshSize"] == len(survivors)
+    # placements on the shrunk mesh == the no-fault oracle at the same
+    # rung == (by the PR 4 mesh conformance) the plain program
+    oracle = make_service()
+    oracle.ladder.level = DegradationLadder.L_MESH_SHRINK
+    oracle.publish(snap)
+    np.testing.assert_array_equal(
+        np.asarray(res.assignment),
+        np.asarray(oracle.schedule(pods).assignment))
+    # the committed snapshot keeps REAL shapes (unpadded): the store
+    # must not grow pad rows from the shrunk-mesh cycle
+    assert int(np.asarray(
+        svc.store.current().nodes.schedulable).shape[0]) == N
+    # device heals -> probe-up restores the full mesh
+    svc.fault_injection = None
+    svc.device_health = None
+    for _ in range(6):
+        svc.schedule(pods)
+        if svc.ladder.level < DegradationLadder.L_MESH_SHRINK:
+            break
+    assert svc.ladder.level < DegradationLadder.L_MESH_SHRINK
+    assert svc.metrics.mesh_size.value() == jax.device_count()
 
 
 def test_service_watchdog_stall_degrades_next_cycle():

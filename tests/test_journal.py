@@ -1,0 +1,550 @@
+"""The crash-recovery seam (ISSUE 14): commit-journal record/replay
+semantics, store checkpoint/restore, and the service-level
+interrupt -> restart -> bit-identical-resume path.
+
+The SIGKILL realism (a real uncatchable kill at every named crash
+point, in a child process) lives in tools/crash_smoke.py as a CI
+stage; the slow-marked test at the bottom runs that same matrix so
+`pytest -m slow` covers it without double-paying in the fast battery.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from koordinator_tpu.api.extension import ResourceKind as RK
+from koordinator_tpu.api.types import Node, NodeMetric, ObjectMeta
+from koordinator_tpu.metrics import Registry
+from koordinator_tpu.scheduler.frameworkext import (
+    DegradationLadder,
+    SchedulerService,
+)
+from koordinator_tpu.scheduler.journal import (
+    CommitJournal,
+    JournalConflict,
+    JournalCorruption,
+    JournalRecord,
+    JournalTail,
+    batch_digest,
+)
+from koordinator_tpu.scheduler.metrics_defs import SchedulerMetrics
+from koordinator_tpu.snapshot import SnapshotBuilder
+from koordinator_tpu.snapshot.store import SnapshotStore
+from koordinator_tpu.utils import synthetic
+
+N, P = 32, 64
+
+
+def rec(epoch=1, chunk=0, n_chunks=2, base=1, watermark=0, digest=7,
+        assignment=(0, 1, 2, 3)):
+    return JournalRecord(epoch=epoch, chunk=chunk, n_chunks=n_chunks,
+                        base_version=base, delta_watermark=watermark,
+                        batch_digest=digest,
+                        assignment=np.asarray(assignment, np.int32))
+
+
+# --- journal record/replay semantics ---------------------------------------
+
+def test_roundtrip_and_resume_bookkeeping(tmp_path):
+    path = str(tmp_path / "j.bin")
+    j = CommitJournal(path)
+    assert j.next_epoch() == 1  # fresh journal
+    j.append(rec(chunk=0))
+    j.append(rec(chunk=1, assignment=(4, -1, 6, 7)))
+    # incomplete? no: n_chunks=2 and chunks {0, 1} present -> complete
+    assert j.epoch_complete(1)
+    assert j.next_epoch() == 2
+    j.append(rec(epoch=2, chunk=0, n_chunks=3))
+    assert not j.epoch_complete(2)
+    assert j.next_epoch() == 2  # interrupted epoch RESUMES
+
+    j2 = CommitJournal(path)  # reload from disk
+    assert j2.tail_reason is JournalTail.CLEAN
+    assert j2.epochs() == [1, 2]
+    got = j2.records_for(1)
+    assert sorted(got) == [0, 1]
+    np.testing.assert_array_equal(got[1].assignment, [4, -1, 6, 7])
+    assert got[0].base_version == 1 and got[0].batch_digest == 7
+    assert j2.n_chunks_of(2) == 3 and j2.base_version_of(1) == 1
+
+
+def test_duplicate_identical_record_is_a_noop(tmp_path):
+    j = CommitJournal(str(tmp_path / "j.bin"))
+    wrote = j.append(rec())
+    assert wrote > 0
+    size = os.path.getsize(j.path)
+    assert j.append(rec()) == 0  # idempotent replay
+    assert os.path.getsize(j.path) == size
+    assert j.appended_records == 1
+
+
+def test_conflicting_duplicate_fails_loudly(tmp_path):
+    j = CommitJournal(str(tmp_path / "j.bin"))
+    j.append(rec())
+    with pytest.raises(JournalConflict):
+        j.append(rec(assignment=(9, 9, 9, 9)))
+
+
+def test_torn_tail_discarded_with_typed_reason(tmp_path):
+    path = str(tmp_path / "j.bin")
+    j = CommitJournal(path)
+    j.append(rec(chunk=0))
+    j.append(rec(chunk=1))
+    # SIGKILL mid-append leaves a truncated record: simulate by
+    # shearing bytes off the tail
+    with open(path, "r+b") as f:
+        f.truncate(os.path.getsize(path) - 5)
+    j2 = CommitJournal(path)
+    assert j2.tail_reason is JournalTail.TORN_PAYLOAD
+    assert sorted(j2.records_for(1)) == [0]  # the torn record is GONE
+    # shear into the header of the next record
+    j2.append(rec(chunk=1))
+    with open(path, "r+b") as f:
+        f.truncate(os.path.getsize(path) - j2.appended_bytes + 4)
+    j3 = CommitJournal(path)
+    assert j3.tail_reason is JournalTail.TORN_HEADER
+    # appending after a torn tail truncates it away and lands cleanly
+    j3.append(rec(chunk=1))
+    j4 = CommitJournal(path)
+    assert j4.tail_reason is JournalTail.CLEAN
+    assert sorted(j4.records_for(1)) == [0, 1]
+
+
+def test_checksum_mismatch_fails_loudly(tmp_path):
+    path = str(tmp_path / "j.bin")
+    j = CommitJournal(path)
+    j.append(rec(chunk=0))
+    j.append(rec(chunk=1))
+    # flip one payload byte of the FIRST record: not a torn tail, so
+    # the load must refuse the journal rather than replay garbage
+    with open(path, "r+b") as f:
+        f.seek(14)
+        byte = f.read(1)
+        f.seek(14)
+        f.write(bytes([byte[0] ^ 0xFF]))
+    with pytest.raises(JournalCorruption):
+        CommitJournal(path)
+
+
+def test_batch_digest_pins_the_resubmitted_batch():
+    pods = synthetic.synthetic_pods(P, seed=3)
+    other = synthetic.synthetic_pods(P, seed=4)
+    assert batch_digest(pods) == batch_digest(pods)
+    assert batch_digest(pods) != batch_digest(other)
+    # the digest covers EVERY batch column, not just requests/valid:
+    # same requests + different gang ids is a DIFFERENT batch
+    gid = np.asarray(pods.gang_id).copy()
+    gid[0] += 1
+    assert batch_digest(pods.replace(gang_id=gid)) != batch_digest(pods)
+
+
+def test_divergent_n_chunks_refused_before_any_write(tmp_path):
+    """The conflict check runs BEFORE the durable write: a divergent
+    record must never land on disk and make the journal unloadable."""
+    path = str(tmp_path / "j.bin")
+    j = CommitJournal(path)
+    j.append(rec(chunk=0, n_chunks=2))
+    size = os.path.getsize(path)
+    with pytest.raises(JournalConflict, match="n_chunks"):
+        j.append(rec(chunk=1, n_chunks=3))
+    assert os.path.getsize(path) == size  # nothing half-written
+    CommitJournal(path)  # and the file still loads
+
+
+def test_abandon_tombstone_closes_an_epoch(tmp_path):
+    path = str(tmp_path / "j.bin")
+    j = CommitJournal(path)
+    j.append(rec(epoch=1, chunk=0, n_chunks=4))
+    assert j.next_epoch() == 1  # incomplete: would resume
+    assert j.abandon(1) > 0
+    assert j.abandon(1) == 0  # idempotent
+    assert j.records_for(1) == {} and j.epochs() == []
+    assert j.next_epoch() == 2
+    with pytest.raises(JournalConflict, match="abandoned"):
+        j.append(rec(epoch=1, chunk=1, n_chunks=4))
+    # the tombstone is DURABLE: a reload stays closed
+    j2 = CommitJournal(path)
+    assert j2.next_epoch() == 2 and j2.records_for(1) == {}
+
+
+# --- store checkpoint / restore --------------------------------------------
+
+def build_store_inputs():
+    b = SnapshotBuilder(max_nodes=8)
+    for i in range(8):
+        b.add_node(Node(meta=ObjectMeta(name=f"n{i}"),
+                        allocatable={RK.CPU: 8_000.0,
+                                     RK.MEMORY: 16_384.0}))
+        b.set_node_metric(NodeMetric(node_name=f"n{i}", update_time=100.0,
+                                     node_usage={RK.CPU: 500.0}))
+    return b
+
+
+def test_checkpoint_restore_roundtrip(tmp_path):
+    ck = str(tmp_path / "store.ck")
+    b = build_store_inputs()
+    snap, _ = b.build(now=105.0)
+    store = SnapshotStore(checkpoint_path=ck, checkpoint_every=1)
+    store.publish(snap)
+    store.ingest(b.metric_delta(["n1"], now=106.0, pad_to=2))
+    assert store.maybe_checkpoint()
+    want_usage = np.asarray(store.current().nodes.usage)
+
+    fresh = SnapshotStore(checkpoint_path=ck)
+    assert fresh.restore()
+    assert fresh.version == store.version
+    assert fresh.applied_delta_version == store.applied_delta_version
+    np.testing.assert_array_equal(
+        np.asarray(fresh.current().nodes.usage), want_usage)
+    np.testing.assert_array_equal(
+        np.asarray(fresh.current().nodes.allocatable),
+        np.asarray(store.current().nodes.allocatable))
+
+
+def test_restore_refuses_corrupt_or_missing_checkpoint(tmp_path):
+    ck = str(tmp_path / "store.ck")
+    store = SnapshotStore(checkpoint_path=ck)
+    assert not store.restore()  # missing -> False, nothing touched
+    b = build_store_inputs()
+    snap, _ = b.build(now=105.0)
+    store.publish(snap)
+    store.checkpoint()
+    with open(ck, "r+b") as f:
+        f.seek(64)
+        f.write(b"\xde\xad")
+    assert not SnapshotStore(checkpoint_path=ck).restore()
+
+
+def test_delta_replay_rides_the_restored_watermark(tmp_path):
+    """The restart story for deltas: a producer replaying its log has
+    already-applied deltas no-op in the version guard, later ones
+    apply; resume_delta_version keeps a RESTARTED producer's fresh
+    deltas above the watermark."""
+    ck = str(tmp_path / "store.ck")
+    b = build_store_inputs()
+    snap, _ = b.build(now=105.0)
+    store = SnapshotStore(checkpoint_path=ck)
+    store.publish(snap)
+    d1 = b.metric_delta(["n1"], now=106.0, pad_to=2)
+    d2 = b.metric_delta(["n2"], now=107.0, pad_to=2)
+    store.ingest(d1)
+    store.ingest(d2)
+    store.checkpoint()
+
+    fresh = SnapshotStore(checkpoint_path=ck)
+    assert fresh.restore()
+    v = fresh.version
+    fresh.ingest(d1)  # replayed log: both must no-op idempotently
+    fresh.ingest(d2)
+    assert fresh.version == v and fresh.delta_rejections == 2
+    # a RESTARTED producer fast-forwards past the watermark, so its
+    # next delta is accepted instead of rejected as a replay
+    b2 = build_store_inputs()
+    b2.set_node_metric(NodeMetric(node_name="n3", update_time=108.0,
+                                  node_usage={RK.CPU: 900.0}))
+    b2.resume_delta_version(fresh.applied_delta_version)
+    d3 = b2.metric_delta(["n3"], now=108.0, pad_to=2)
+    fresh.ingest(d3)
+    assert fresh.version == v + 1
+    assert fresh.applied_delta_version == 3
+
+
+# --- service integration: interrupt -> restart -> bit-identical resume -----
+
+def make_service(**kw):
+    svc = SchedulerService(metrics=SchedulerMetrics(Registry()),
+                           num_rounds=2, k_choices=4, guards=False, **kw)
+    svc._sleep = lambda _s: None
+    return svc
+
+
+def slim_inputs(seed=0):
+    snap = synthetic.synthetic_cluster(N, seed=seed, num_quotas=4,
+                                       num_gangs=4)
+    pods = synthetic.synthetic_pods(P, seed=seed + 3, num_quotas=4,
+                                    num_gangs=4)
+    return snap, pods
+
+
+class Boom(Exception):
+    """An in-process stand-in for the crash (the REAL SIGKILL path is
+    tools/crash_smoke.py)."""
+
+
+def test_interrupted_chunked_batch_resumes_bit_identical(tmp_path):
+    snap, pods = slim_inputs(1)
+    # oracle: the uninterrupted chunked run
+    oracle = make_service()
+    oracle.ladder.level = DegradationLadder.L_CHUNKED
+    oracle.ladder.chunk_splits = 1
+    oracle.publish(snap)
+    want = np.asarray(oracle.schedule(pods).assignment)
+    want_req = np.asarray(oracle.store.current().nodes.requested)
+
+    path = str(tmp_path / "j.bin")
+    hits = {"n": 0}
+
+    def crash_before_second_append(point):
+        if point == "post_dispatch_pre_append":
+            hits["n"] += 1
+            if hits["n"] == 2:
+                raise Boom()
+
+    svc = make_service(journal=CommitJournal(
+        path, crash_hook=crash_before_second_append))
+    svc.max_cycle_attempts = 1
+    svc.ladder.level = DegradationLadder.L_CHUNKED
+    svc.ladder.chunk_splits = 1
+    svc.publish(snap)
+    with pytest.raises(Boom):
+        svc.schedule(pods)
+    assert sorted(svc.journal.records_for(1)) == [0]
+
+    # "restart": a fresh service over the same journal; the store is
+    # re-published by the edge (no checkpoint in this test)
+    svc2 = make_service(journal=CommitJournal(path))
+    assert svc2.epoch == 1  # the interrupted epoch resumes
+    svc2.publish(snap)
+    res = svc2.schedule(pods)
+    got = np.asarray(res.assignment)
+    np.testing.assert_array_equal(got, want)
+    np.testing.assert_allclose(
+        np.asarray(svc2.store.current().nodes.requested), want_req)
+    # chunk 0 was REPLAYED (asserted identical, not re-appended),
+    # chunk 1 scheduled fresh: exactly one record per (epoch, chunk)
+    assert svc2.metrics.recovery_replayed.value() == 1
+    assert sorted(svc2.journal.records_for(1)) == [0, 1]
+    assert svc2.journal.appended_records == 1
+    assert svc2.epoch == 2
+
+
+def test_resume_refuses_a_different_batch(tmp_path):
+    snap, pods = slim_inputs(2)
+    path = str(tmp_path / "j.bin")
+    hits = {"n": 0}
+
+    def crash_second(point):
+        if point == "post_dispatch_pre_append":
+            hits["n"] += 1
+            if hits["n"] == 2:
+                raise Boom()
+
+    svc = make_service(journal=CommitJournal(path,
+                                             crash_hook=crash_second))
+    svc.max_cycle_attempts = 1
+    svc.ladder.level = DegradationLadder.L_CHUNKED
+    svc.ladder.chunk_splits = 1
+    svc.publish(snap)
+    with pytest.raises(Boom):
+        svc.schedule(pods)
+
+    svc2 = make_service(journal=CommitJournal(path))
+    svc2.publish(snap)
+    _, other = slim_inputs(9)
+    with pytest.raises(JournalConflict, match="digest"):
+        svc2.schedule(other)
+
+
+def test_abandon_interrupted_epoch_unwedges_the_service(tmp_path):
+    """A terminally-failed batch must not wedge the service forever:
+    abandon_interrupted_epoch() closes the poisoned epoch durably and
+    a DIFFERENT batch then schedules normally."""
+    snap, pods = slim_inputs(5)
+    path = str(tmp_path / "j.bin")
+    hits = {"n": 0}
+
+    def crash_second(point):
+        if point == "post_dispatch_pre_append":
+            hits["n"] += 1
+            if hits["n"] == 2:
+                raise Boom()
+
+    svc = make_service(journal=CommitJournal(path,
+                                             crash_hook=crash_second))
+    svc.max_cycle_attempts = 1
+    svc.ladder.level = DegradationLadder.L_CHUNKED
+    svc.ladder.chunk_splits = 1
+    svc.publish(snap)
+    with pytest.raises(Boom):
+        svc.schedule(pods)
+    svc.journal.crash_hook = None
+    _, other = slim_inputs(9)
+    with pytest.raises(JournalConflict):
+        svc.schedule(other)
+    assert svc.abandon_interrupted_epoch()
+    assert not svc.abandon_interrupted_epoch()  # nothing left
+    res = np.asarray(svc.schedule(other).assignment)  # unwedged
+    assert svc.journal.epoch_complete(2)
+    oracle = make_service()
+    oracle.ladder.level = DegradationLadder.L_CHUNKED
+    oracle.ladder.chunk_splits = 1
+    oracle.publish(snap)
+    np.testing.assert_array_equal(
+        res, np.asarray(oracle.schedule(other).assignment))
+
+
+def test_raced_ingest_between_retries_abandons_and_reruns(tmp_path):
+    """A delta landing between retry attempts (the backoff sleeps
+    outside the commit lock BY DESIGN) moves the store version under
+    the journaled chunks. That must stay a recoverable transient —
+    the in-process epoch is abandoned and the batch re-runs whole
+    against the fresher snapshot — never a terminal JournalConflict."""
+    from koordinator_tpu.api.extension import NUM_RESOURCES
+    from koordinator_tpu.snapshot.delta import NodeMetricDelta
+    from koordinator_tpu.snapshot.schema import NUM_AGG
+    from koordinator_tpu.testing import faults
+
+    snap, pods = slim_inputs(6)
+    r = NUM_RESOURCES
+    noop_delta = NodeMetricDelta(
+        idx=np.full((1,), -1, np.int32),
+        metric_fresh=np.zeros((1,), bool),
+        usage=np.zeros((1, r), np.float32),
+        prod_usage=np.zeros((1, r), np.float32),
+        agg_usage=np.zeros((1, NUM_AGG, r), np.float32),
+        has_agg=np.zeros((1,), bool),
+        assigned_estimated=np.zeros((1, r), np.float32),
+        assigned_correction=np.zeros((1, r), np.float32),
+        prod_assigned_estimated=np.zeros((1, r), np.float32),
+        prod_assigned_correction=np.zeros((1, r), np.float32))
+
+    svc = make_service(journal=CommitJournal(str(tmp_path / "j.bin")))
+    svc.ladder.level = DegradationLadder.L_CHUNKED
+    svc.ladder.chunk_splits = 1
+    # chunk 0 commits, then the SECOND program call fails transiently;
+    # the backoff sleep is where the racing ingest lands
+    inj = faults.FaultInjector(1)
+    svc.fault_injection = inj.xla_transient(fail_attempts={2})
+    svc._sleep = lambda _s: svc.ingest(noop_delta)
+    svc.publish(snap)
+    res = svc.schedule(pods)  # must complete, not raise
+    assert svc.journal.abandoned == {1}
+    assert svc.journal.epoch_complete(2) and svc.epoch == 3
+    oracle = make_service()
+    oracle.ladder.level = DegradationLadder.L_CHUNKED
+    oracle.ladder.chunk_splits = 1
+    oracle.publish(snap)
+    oracle.ingest(noop_delta)
+    np.testing.assert_array_equal(
+        np.asarray(res.assignment),
+        np.asarray(oracle.schedule(pods).assignment))
+
+
+def test_journal_metrics_and_single_program_epochs(tmp_path):
+    """A non-chunked cycle is a 1-chunk epoch: one record, appended
+    BEFORE the publish, and the journal metrics count it."""
+    snap, pods = slim_inputs(3)
+    svc = make_service(journal=CommitJournal(str(tmp_path / "j.bin")))
+    svc.publish(snap)
+    svc.schedule(pods)
+    svc.schedule(pods)
+    assert svc.journal.epochs() == [1, 2]
+    assert svc.journal.n_chunks_of(1) == 1
+    assert svc.metrics.journal_appends.value() == 2
+    assert svc.metrics.journal_bytes.value() == svc.journal.appended_bytes
+    assert svc.summary()["journaled"] and svc.summary()["epoch"] == 3
+    # journaling must not perturb placements: a journal-free service
+    # schedules bit-identically
+    bare = make_service()
+    bare.publish(snap)
+    np.testing.assert_array_equal(
+        np.asarray(bare.schedule(pods).assignment),
+        np.asarray(svc.journal.records_for(1)[0].assignment))
+
+
+def test_single_program_epoch_replays_on_a_chunked_service(tmp_path):
+    """The journaled layout pins replay in BOTH directions: an epoch
+    journaled as n_chunks=1 (crash between its append and publish)
+    must replay as the single program even when the restarted service
+    sits on the chunked rung — running it chunked would journal
+    conflicting n_chunks records."""
+    snap, pods = slim_inputs(7)
+    path = str(tmp_path / "j.bin")
+
+    def crash_post_append(point):
+        if point == "post_append_pre_publish":
+            raise Boom()
+
+    svc = make_service(journal=CommitJournal(
+        path, crash_hook=crash_post_append))
+    svc.max_cycle_attempts = 1
+    svc.publish(snap)
+    with pytest.raises(Boom):
+        svc.schedule(pods)
+    assert svc.journal.n_chunks_of(1) == 1
+
+    # the restarted service sits on the CHUNKED rung; recover() must
+    # still replay the epoch as the single program it was journaled as
+    svc2 = make_service(journal=CommitJournal(path))
+    svc2.ladder.level = DegradationLadder.L_CHUNKED
+    svc2.ladder.chunk_splits = 2
+    svc2.publish(snap)
+    report = svc2.recover({1: pods})  # no JournalConflict
+    assert report["records_replayed"] == 1
+    assert svc2.metrics.recovery_replayed.value() == 1
+    assert svc2.journal.n_chunks_of(1) == 1  # layout unchanged
+    oracle = make_service()
+    oracle.publish(snap)
+    np.testing.assert_array_equal(
+        np.asarray(report["results"][1].assignment),
+        np.asarray(oracle.schedule(pods).assignment))
+
+
+def test_prune_drops_dead_epochs_and_keeps_the_last(tmp_path):
+    path = str(tmp_path / "j.bin")
+    j = CommitJournal(path)
+    for e, base in ((1, 1), (2, 2), (3, 3)):
+        j.append(rec(epoch=e, chunk=0, n_chunks=2, base=base))
+        j.append(rec(epoch=e, chunk=1, n_chunks=2, base=base))
+    j.append(rec(epoch=4, chunk=0, n_chunks=1, base=4))
+    j.abandon(2)
+    size = os.path.getsize(path)
+    # checkpoint at store version 3: epochs 1 (complete, base 1 < 3)
+    # and 2 (abandoned) are dead; 3 could still replay; 4 is last
+    assert j.prune(3) == 2
+    assert os.path.getsize(path) < size
+    assert j.epochs() == [3, 4] and j.next_epoch() == 5
+    j2 = CommitJournal(path)  # the pruned file reloads cleanly
+    assert j2.tail_reason is JournalTail.CLEAN
+    assert j2.epochs() == [3, 4] and j2.next_epoch() == 5
+    assert sorted(j2.records_for(3)) == [0, 1]
+    assert j.prune(3) == 0  # idempotent: nothing dead left
+
+
+def test_prune_keeps_the_last_epochs_tombstone(tmp_path):
+    path = str(tmp_path / "j.bin")
+    j = CommitJournal(path)
+    j.append(rec(epoch=1, chunk=0, n_chunks=2, base=1))
+    j.append(rec(epoch=1, chunk=1, n_chunks=2, base=1))
+    j.append(rec(epoch=2, chunk=0, n_chunks=4, base=2))
+    j.abandon(2)
+    assert j.prune(10) == 1  # epoch 1 dead; 2 kept (last), as tombstone
+    j2 = CommitJournal(path)
+    assert j2.abandoned == {2} and j2.next_epoch() == 3
+    assert j2.records_for(2) == {}
+
+
+def test_service_prunes_after_checkpoint(tmp_path):
+    snap, pods = slim_inputs(8)
+    store = SnapshotStore(checkpoint_path=str(tmp_path / "store.ck"),
+                          checkpoint_every=1)
+    svc = make_service(journal=CommitJournal(str(tmp_path / "j.bin")),
+                       store=store)
+    svc.publish(snap)
+    for _ in range(4):
+        svc.schedule(pods)
+    # every completed epoch below the checkpoint watermark is pruned;
+    # only the most recent survives for monotonic numbering
+    assert svc.journal.epochs() == [4]
+    assert svc.epoch == 5
+
+
+@pytest.mark.slow
+def test_crash_smoke_matrix():
+    """The same kill-injected matrix tools/crash_smoke.py runs as a CI
+    stage (SIGKILL at every named crash point; restart recovery
+    bit-identical to the no-crash oracle)."""
+    import tools.crash_smoke as crash
+
+    assert crash.main([]) == 0

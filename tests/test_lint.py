@@ -60,7 +60,7 @@ def empty_baseline(tmp_path):
     ("host_sync", {"HS001", "HS002", "HS003", "HS004", "HS005"}),
     ("recompile", {"RC001", "RC002", "RC003"}),
     ("donation", {"DA001"}),
-    ("lock_discipline", {"LK001", "LK002", "LK003", "LK004"}),
+    ("lock_discipline", {"LK001", "LK002", "LK003", "LK004", "LK005"}),
     ("metric_names", {"MN001", "MN002", "MN003", "MN004"}),
     ("proto_drift", {"PD001", "PD002", "PD003"}),
     ("robustness", {"RB001"}),

@@ -30,6 +30,16 @@ import time
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
+# a small virtual device fleet (CPU), set before the backend
+# initializes: the device_lost cases must exercise the mesh-shrink
+# rung (>= 2 survivors), not only the single-device abandon path.
+# Programs still run on device 0 unless a rung shards them, so every
+# other fault class is unaffected.
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=4").strip()
+
 import jax
 
 if os.environ.get("JAX_PLATFORMS"):
@@ -191,7 +201,8 @@ def run_runtime_fault(kind):
     elif kind == "device_lost":
         # one lost-device hiccup is absorbed by the transient retry at
         # the SAME rung; only an exhausted retry budget (RetryPolicy
-        # max_attempts=3) abandons the mesh for single-device
+        # max_attempts=3) moves the ladder — to the mesh-shrink rung
+        # when >= 2 devices survive, to single-device otherwise
         svc.fault_injection = inj.device_lost(fail_attempts={1, 2, 3, 4})
     else:
         inj.stall_watchdog(svc)
@@ -217,8 +228,14 @@ def run_runtime_fault(kind):
               f"{kind}: expected the chunked rung, "
               f"got {svc.ladder.state().label()}")
     if kind == "device_lost":
-        check(svc.ladder.level == DegradationLadder.L_SINGLE_DEVICE,
-              f"{kind}: expected single_device, "
+        # with >= 2 survivors the mesh SHRINKS instead of being
+        # abandoned (ISSUE 14); single-device only on a 1-device host
+        expected_level = (DegradationLadder.L_MESH_SHRINK
+                          if jax.device_count() >= 2
+                          else DegradationLadder.L_SINGLE_DEVICE)
+        check(svc.ladder.level == expected_level,
+              f"{kind}: expected "
+              f"{DegradationLadder.LEVELS[expected_level]}, "
               f"got {svc.ladder.state().label()}")
     if kind == "watchdog_stall":
         check(svc.monitor.timeouts >= 1, "stall never tripped the monitor")
@@ -226,6 +243,85 @@ def run_runtime_fault(kind):
     return {"fault": kind, "class": expected.value,
             "ladder": svc.ladder.state().label(),
             "transitions": svc.ladder.transitions}
+
+
+def run_device_lost_mid_chunk(kind):
+    """ISSUE 14 satellite: a device dies MID-chunked-batch (chunks 0-1
+    already committed to the journal) and stays dead. The service must
+    resume on the SHRUNK mesh from the last committed chunk — zero
+    duplicated and zero lost placements, bit-identical to the no-fault
+    chunked oracle — instead of restarting (or abandoning) the batch;
+    probe-up then restores the full mesh."""
+    import shutil
+    import tempfile
+
+    from koordinator_tpu.scheduler.journal import CommitJournal
+
+    if jax.device_count() < 3:
+        # needs >= 2 survivors after losing one device; the module
+        # header forces 4 virtual devices, so this only trips when a
+        # caller overrode XLA_FLAGS
+        return {"fault": kind, "skipped": f"{jax.device_count()} devices"}
+    inj = faults.FaultInjector(SEED)
+    snap, pods = make_inputs(11)
+    workdir = tempfile.mkdtemp(prefix="chaos_mid_chunk_")
+    try:
+        svc = make_service(
+            journal=CommitJournal(os.path.join(workdir, "journal.bin")))
+        svc.ladder.level = DegradationLadder.L_CHUNKED
+        svc.ladder.chunk_splits = 2  # 4 journaled chunks
+        svc.ladder.probe_after = 2
+        # the device dies after 2 chunk programs and STAYS dead until
+        # the mesh stops including it (faults.lost_device_until_shrunk)
+        svc.fault_injection = inj.lost_device_until_shrunk(after_calls=2)
+        survivors = jax.devices()[:-1]
+        svc.device_health = lambda: survivors
+        svc.publish(snap)
+        res = svc.schedule(pods)
+        # 1. detected + degraded to the NEW rung, not single_device
+        check(svc.ladder.level == DegradationLadder.L_MESH_SHRINK,
+              f"{kind}: expected mesh_shrink, "
+              f"got {svc.ladder.state().label()}")
+        check(svc.metrics.mesh_shrink_events.value() == 1,
+              f"{kind}: mesh-shrink event not counted")
+        check(svc.metrics.mesh_size.value() == len(survivors),
+              f"{kind}: mesh-size gauge {svc.metrics.mesh_size.value()} "
+              f"!= {len(survivors)} survivors")
+        # 2. resumed, not restarted: the pre-crash chunks were REPLAYED
+        # from the journal (asserted bit-identical inside it, never
+        # re-appended) and every chunk appears exactly once
+        check(svc.metrics.recovery_replayed.value() == 2,
+              f"{kind}: expected 2 replayed chunks, got "
+              f"{svc.metrics.recovery_replayed.value()}")
+        records = svc.journal.records_for(1)
+        check(sorted(records) == [0, 1, 2, 3],
+              f"{kind}: journal chunk set {sorted(records)} is not "
+              f"exactly one record per chunk")
+        # 4. no duplicate, no lost placements: bit-identical to the
+        # no-fault chunked oracle on the full mesh
+        oracle = oracle_assignment(
+            snap, pods, ladder_state=LadderState(
+                DegradationLadder.L_CHUNKED, 2))
+        check(np.array_equal(np.asarray(res.assignment), oracle),
+              f"{kind}: resumed placements drifted from the chunked "
+              f"no-fault oracle")
+        # 3. service up, and probe-up restores the FULL mesh
+        svc.fault_injection = None
+        svc.device_health = None
+        for _ in range(8):
+            svc.schedule(pods)
+            if svc.ladder.level < DegradationLadder.L_MESH_SHRINK:
+                break
+        check(svc.ladder.level < DegradationLadder.L_MESH_SHRINK,
+              f"{kind}: probe-up never left mesh_shrink "
+              f"({svc.ladder.transitions})")
+        check(svc.metrics.mesh_size.value() == jax.device_count(),
+              f"{kind}: full mesh not restored after probe-up")
+        return {"fault": kind, "ladder": svc.ladder.state().label(),
+                "replayed": 2, "survivors": len(survivors),
+                "transitions": svc.ladder.transitions}
+    finally:
+        shutil.rmtree(workdir, ignore_errors=True)
 
 
 def run_delta_fault(kind):
@@ -331,7 +427,9 @@ def main(argv):
     matrix = selected or list(faults.ALL_FAULTS)
     failures = []
     for fault in matrix:
-        if fault in faults.SNAPSHOT_FAULTS:
+        if fault == "device_lost_mid_chunk":
+            runner = run_device_lost_mid_chunk
+        elif fault in faults.SNAPSHOT_FAULTS:
             runner = run_snapshot_fault
         elif fault in faults.BATCH_FAULTS:
             runner = run_batch_fault

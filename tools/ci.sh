@@ -45,6 +45,15 @@ echo "=== chaos smoke (fault-injection matrix, CPU) ==="
 # never wall-clock
 JAX_PLATFORMS=cpu python tools/chaos_smoke.py
 
+echo "=== crash smoke (kill-injected recovery matrix, CPU) ==="
+# every named crash point in koordinator_tpu/testing/faults.py
+# CRASH_POINTS: a child service is SIGKILLed at the point mid-batch,
+# the restarted service recovers via checkpoint restore + commit-
+# journal replay, and final placements must be BIT-IDENTICAL to the
+# no-crash oracle — exactly one journal record per (epoch, chunk),
+# torn tails surfaced with a typed reason (tools/crash_smoke.py)
+JAX_PLATFORMS=cpu python tools/crash_smoke.py
+
 echo "=== tier-1 tests (JAX_PLATFORMS=cpu) ==="
 set -o pipefail
 rm -f /tmp/_t1.log

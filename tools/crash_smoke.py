@@ -1,0 +1,224 @@
+"""Crash smoke: the kill-injected recovery matrix for the commit
+journal + checkpoint + restart-replay path (ISSUE 14 tentpole).
+
+For every named crash point in `koordinator_tpu.testing.faults.
+CRASH_POINTS` (at chosen hit counts, so crashes land both before any
+chunk committed and mid-batch), a CHILD process runs a journaled,
+checkpointed, chunked scheduling cycle and is SIGKILLed at the crash
+point — a real uncatchable kill, so the on-disk journal/checkpoint
+state is exactly what a power cut would leave. The parent then
+"restarts the service": a fresh SchedulerService over the same journal
+and checkpoint files runs `recover()` with the resubmitted batch, and
+the smoke asserts:
+
+  1. KILLED      — the child really died by SIGKILL at the armed point
+                   (a child that completes means the point never fired);
+  2. CONVERGED   — the recovered run's final placements are
+                   BIT-IDENTICAL to an uninterrupted no-crash oracle,
+                   and the post-recovery store (requested columns)
+                   matches the oracle's store;
+  3. EXACT       — per (epoch, chunk): every chunk appears in the
+                   journal exactly once after recovery (no duplicated
+                   and no lost placements — replay re-derives, never
+                   re-appends), and the torn-write case surfaces its
+                   typed tail reason instead of crashing the load.
+
+Runs on CPU in CI (tools/ci.sh); correctness-only, never wall-clock.
+Usage: JAX_PLATFORMS=cpu python tools/crash_smoke.py [point[:hit] ...]
+Child mode (internal): ... --child <point:hit> <workdir> <seed>
+"""
+
+import os
+import subprocess
+import sys
+import tempfile
+import shutil
+import signal
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import jax
+
+if os.environ.get("JAX_PLATFORMS"):
+    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+import numpy as np
+
+from koordinator_tpu.metrics import Registry
+from koordinator_tpu.scheduler.frameworkext import (
+    DegradationLadder,
+    SchedulerService,
+)
+from koordinator_tpu.scheduler.journal import (
+    CommitJournal,
+    JournalConflict,
+    JournalCorruption,
+    JournalTail,
+)
+from koordinator_tpu.scheduler.metrics_defs import SchedulerMetrics
+from koordinator_tpu.snapshot.store import SnapshotStore
+from koordinator_tpu.testing import faults
+from koordinator_tpu.utils import synthetic
+
+N_NODES, N_PODS = 32, 64
+CHUNK_SPLITS = 2  # the batch runs as 4 journaled chunks
+
+# (crash point, hit count): hits are chosen so the matrix covers
+# "nothing committed yet" (pre-append hit 1), "mid-batch" (hits 2-3 =
+# between chunks), the torn write, the post-append/pre-publish window,
+# and a kill DURING the post-batch checkpoint (hit 2: hit 1 is the
+# checkpoint the initial publish writes)
+DEFAULT_CASES = (
+    ("post_dispatch_pre_append", 1),
+    ("post_dispatch_pre_append", 3),
+    ("mid_append_torn", 2),
+    ("post_append_pre_publish", 2),
+    ("mid_checkpoint", 2),
+)
+
+
+def make_inputs(seed: int):
+    snap = synthetic.synthetic_cluster(N_NODES, seed=seed, num_quotas=4,
+                                       num_gangs=4)
+    pods = synthetic.synthetic_pods(N_PODS, seed=seed + 7, num_quotas=4,
+                                    num_gangs=4)
+    return snap, pods
+
+
+def make_service(workdir: str, crash_hook=None) -> SchedulerService:
+    journal = CommitJournal(os.path.join(workdir, "journal.bin"),
+                            crash_hook=crash_hook)
+    store = SnapshotStore(checkpoint_path=os.path.join(workdir, "store.ck"),
+                          checkpoint_every=1, crash_hook=crash_hook)
+    svc = SchedulerService(metrics=SchedulerMetrics(Registry()),
+                           num_rounds=2, k_choices=4, guards=False,
+                           journal=journal, store=store)
+    svc._sleep = lambda _s: None
+    svc.ladder.level = DegradationLadder.L_CHUNKED
+    svc.ladder.chunk_splits = CHUNK_SPLITS
+    return svc
+
+
+def child(point: str, hit: int, workdir: str, seed: int) -> int:
+    """One journaled chunked batch, armed to SIGKILL at the crash
+    point. Returning at all means the point never fired -> exit 3 so
+    the parent can tell 'crashed as planned' from 'never crashed'."""
+    snap, pods = make_inputs(seed)
+    svc = make_service(workdir, crash_hook=faults.sigkill_at(point, hit))
+    svc.publish(snap)
+    svc.schedule(pods)
+    return 3
+
+
+def oracle_run(seed: int):
+    """The uninterrupted no-crash oracle: same batch, same chunking, no
+    journal — final placements + the post-commit requested columns."""
+    snap, pods = make_inputs(seed)
+    svc = SchedulerService(metrics=SchedulerMetrics(Registry()),
+                           num_rounds=2, k_choices=4, guards=False)
+    svc._sleep = lambda _s: None
+    svc.ladder.level = DegradationLadder.L_CHUNKED
+    svc.ladder.chunk_splits = CHUNK_SPLITS
+    svc.publish(snap)
+    res = svc.schedule(pods)
+    return (np.asarray(res.assignment),
+            np.asarray(svc.store.current().nodes.requested))
+
+
+def check(cond, what):
+    if not cond:
+        raise AssertionError(what)
+
+
+def run_case(point: str, hit: int, seed: int = 0) -> dict:
+    """Spawn the child, let it die at the crash point, recover in this
+    process, and assert convergence. Raises AssertionError on any
+    violated invariant; returns a verdict dict otherwise."""
+    workdir = tempfile.mkdtemp(prefix=f"crash_{point}_")
+    try:
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--child",
+             f"{point}:{hit}", workdir, str(seed)],
+            env=env, cwd=REPO, capture_output=True, text=True,
+            timeout=900)
+        check(proc.returncode == -signal.SIGKILL,
+              f"{point}:{hit}: child exited {proc.returncode}, expected "
+              f"SIGKILL ({-signal.SIGKILL});\nstderr tail: "
+              f"{proc.stderr[-2000:]}")
+
+        snap, pods = make_inputs(seed)
+        svc = make_service(workdir)
+        committed_before = sorted(svc.journal.records_for(1))
+        tail = svc.journal.tail_reason
+        if point == "mid_append_torn":
+            check(tail is not JournalTail.CLEAN,
+                  f"{point}:{hit}: mid-append kill left a clean tail")
+        try:
+            report = svc.recover({1: pods})
+        except (JournalConflict, JournalCorruption):
+            # journal-level failures are exactly what this gate exists
+            # to catch — never mask them behind the fresh-publish
+            # fallback below
+            raise
+        except RuntimeError:
+            # no checkpoint survived (killed during the very first
+            # one): the control-plane edge re-publishes, then replay
+            svc.publish(snap)
+            report = svc.recover({1: pods})
+        result = report["results"].get(1)
+        if result is None:
+            # every journaled epoch predated the surviving checkpoint:
+            # the batch itself is simply scheduled as the next epoch
+            result = svc.schedule(pods)
+        assign = np.asarray(result.assignment)
+
+        oracle_assign, oracle_req = oracle_run(seed)
+        check(np.array_equal(assign, oracle_assign),
+              f"{point}:{hit}: recovered placements diverged from the "
+              f"no-crash oracle")
+        np.testing.assert_allclose(
+            np.asarray(svc.store.current().nodes.requested), oracle_req,
+            err_msg=f"{point}:{hit}: post-recovery store drifted")
+        records = svc.journal.records_for(1)
+        check(sorted(records) == list(range(2 ** CHUNK_SPLITS)),
+              f"{point}:{hit}: journal chunk set {sorted(records)} is "
+              f"not exactly one record per chunk")
+        return {"point": point, "hit": hit,
+                "committed_before_crash": committed_before,
+                "tail": tail.value,
+                "records_replayed": report["records_replayed"],
+                "restored_checkpoint": report["restored_checkpoint"]}
+    finally:
+        shutil.rmtree(workdir, ignore_errors=True)
+
+
+def main(argv) -> int:
+    if argv[:1] == ["--child"]:
+        point, _, hit = argv[1].partition(":")
+        return child(point, int(hit or "1"), argv[2],
+                     int(argv[3]) if len(argv) > 3 else 0)
+    selected = [a for a in argv if not a.startswith("-")]
+    if selected:
+        cases = []
+        for spec in selected:
+            point, _, hit = spec.partition(":")
+            cases.append((point, int(hit or "1")))
+    else:
+        cases = list(DEFAULT_CASES)
+    failures = []
+    for point, hit in cases:
+        try:
+            verdict = run_case(point, hit)
+            print(f"CRASH OK   {point}:{hit}: {verdict}", flush=True)
+        except AssertionError as exc:
+            failures.append((point, hit, str(exc)))
+            print(f"CRASH FAIL {point}:{hit}: {exc}", flush=True)
+    print(f"CRASH SMOKE: {len(cases) - len(failures)}/{len(cases)} "
+          f"crash points converge bit-identical", flush=True)
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

@@ -24,6 +24,17 @@ Codes:
          stall at best, a deadlock when the notifier needs that lock
          (same-function analysis; Condition with-blocks themselves
          ride the LK001/LK002 machinery like any lock)
+  LK005  file I/O (open / os.replace / fsync / pathlib writes) while
+         holding a COMMIT lock (any lock whose name contains "commit",
+         e.g. SchedulerService._commit_lock) outside the commit
+         journal's bounded append seam: disk latency under the commit
+         lock stalls every publish/ingest/schedule for the full fsync.
+         The journal module (scheduler/journal.py) is the ONE
+         sanctioned seam — append-before-publish must be inside the
+         commit critical section, and its writes are bounded to one
+         header + one int32 row block — so units defined in a
+         `journal.py` are exempt; everything else must move its I/O
+         outside the lock or into the journal.
 """
 
 from __future__ import annotations
@@ -50,6 +61,23 @@ BLOCKING_DOTTED = {
 }
 BLOCKING_ATTRS = {"block_until_ready", "urlopen"}
 
+# LK005: file-I/O entry points that must not run under a commit lock
+# outside the journal seam
+FILE_IO_DOTTED = {
+    "os.replace", "os.rename", "os.fsync", "os.remove", "os.unlink",
+    "os.truncate", "os.makedirs", "os.mkdir",
+    "shutil.move", "shutil.copy", "shutil.copyfile", "shutil.copytree",
+    "shutil.rmtree",
+}
+FILE_IO_ATTRS = {"write_bytes", "write_text", "read_bytes", "read_text"}
+# the sanctioned bounded append seam: units defined in a journal
+# module may do file I/O under the commit lock (scheduler/journal.py)
+FILE_IO_SEAM_BASENAMES = {"journal.py"}
+
+
+def _is_commit_lock(lock: str) -> bool:
+    return "commit" in lock.rsplit(".", 1)[-1].lower()
+
 
 @dataclass
 class _Unit:
@@ -73,6 +101,10 @@ class _Unit:
     manual_acquires: Set[Tuple[str, int]] = field(default_factory=set)
     # (held lock, condition waited on, line) — held != condition
     held_waits: Set[Tuple[str, str, int]] = field(default_factory=set)
+    # LK005 facts: direct file-I/O targets, and (held lock, target,
+    # line) while a lock was held — empty for seam-exempt modules
+    file_io: Set[Tuple[str, int]] = field(default_factory=set)
+    held_file_io: Set[Tuple[str, str, int]] = field(default_factory=set)
 
     @property
     def qual(self) -> str:
@@ -117,6 +149,13 @@ class LockDisciplineAnalyzer(Analyzer):
                                 f"block on `{target}`; release the lock "
                                 f"first or move the blocking work out",
                         key=f"{u.qual}:{_short(held)}:{callee}"))
+                if _is_commit_lock(held):
+                    for target in cs[2]:
+                        findings.append(_lk005(u, held, target, line,
+                                               via=callee))
+            for held, target, line in u.held_file_io:
+                if _is_commit_lock(held):
+                    findings.append(_lk005(u, held, target, line))
             for held, target, line in u.held_blocking:
                 findings.append(Finding(
                     analyzer="lock-discipline", code="LK002",
@@ -156,6 +195,10 @@ class LockDisciplineAnalyzer(Analyzer):
         package = module.dotted.rsplit(".", 1)[0] \
             if "." in module.dotted else ""
         imports = collect_imports(module.tree, package)
+        # the commit journal IS the sanctioned commit-lock file-I/O
+        # seam: its units contribute no LK005 facts
+        basename = module.relpath.replace("\\", "/").rsplit("/", 1)[-1]
+        self._file_io_exempt = basename in FILE_IO_SEAM_BASENAMES
 
         def lock_ctor(value: ast.AST) -> Optional[str]:
             if not isinstance(value, ast.Call):
@@ -301,6 +344,14 @@ class LockDisciplineAnalyzer(Analyzer):
                             for h in now:
                                 unit.edges.add((h, lid, stmt.lineno))
                             now.append(lid)
+                        else:
+                            # non-lock context managers (`with
+                            # open(...)`) are calls made while the
+                            # locks acquired SO FAR are held
+                            self._scan_expr_calls(item.context_expr,
+                                                  tuple(now), unit,
+                                                  imports, lock_id,
+                                                  cond_id)
                     walk(stmt.body, tuple(now))
                     continue
                 subs = list(_bodies(stmt))
@@ -357,6 +408,13 @@ class LockDisciplineAnalyzer(Analyzer):
                 for h in held:
                     unit.held_blocking.add((h, target, node.lineno))
                 continue
+            io_target = self._file_io_target(node, imports)
+            if io_target is not None and not getattr(
+                    self, "_file_io_exempt", False):
+                unit.file_io.add((io_target, node.lineno))
+                for h in held:
+                    unit.held_file_io.add((h, io_target, node.lineno))
+                continue
             callee = self._local_callee(node)
             if callee is not None:
                 unit.calls.add(callee)
@@ -372,6 +430,22 @@ class LockDisciplineAnalyzer(Analyzer):
                 return resolved
         if isinstance(call.func, ast.Attribute) \
                 and call.func.attr in BLOCKING_ATTRS:
+            return call.func.attr
+        return None
+
+    @staticmethod
+    def _file_io_target(call: ast.Call, imports) -> Optional[str]:
+        """LK005: builtin open(), the os/shutil file ops, and pathlib
+        read/write methods."""
+        if isinstance(call.func, ast.Name) and call.func.id == "open":
+            return "open"
+        dotted = call_target(call)
+        if dotted is not None:
+            resolved = imports.resolve(dotted)
+            if resolved in FILE_IO_DOTTED:
+                return resolved
+        if isinstance(call.func, ast.Attribute) \
+                and call.func.attr in FILE_IO_ATTRS:
             return call.func.attr
         return None
 
@@ -396,21 +470,39 @@ def _bodies(stmt: ast.stmt) -> Iterable[List[ast.stmt]]:
         yield h.body
 
 
+def _lk005(u: _Unit, held: str, target: str, line: int,
+           via: Optional[str] = None) -> Finding:
+    how = f"a call to `{via}` which reaches " if via else ""
+    return Finding(
+        analyzer="lock-discipline", code="LK005",
+        path=u.module.relpath, line=line,
+        message=f"`{u.qual}` holds commit lock `{_short(held)}` across "
+                f"{how}file I/O `{target}`: disk latency under the "
+                f"commit lock stalls every publish/ingest/schedule for "
+                f"the full write+fsync; only the commit journal's "
+                f"bounded append seam (scheduler/journal.py) may write "
+                f"while committing — move the I/O outside the lock or "
+                f"into the journal",
+        key=f"{u.qual}:{_short(held)}:io:{target}"
+            + (f":{via}" if via else ""))
+
+
 def _close_summaries(units: List[_Unit]
                      ) -> Dict[Tuple[str, Optional[str], str],
-                               Tuple[Set[str], Set[str]]]:
-    """(acquired locks, blocking targets) per unit, closed over
-    same-module self./local calls (fixpoint)."""
+                               Tuple[Set[str], Set[str], Set[str]]]:
+    """(acquired locks, blocking targets, file-I/O targets) per unit,
+    closed over same-module self./local calls (fixpoint)."""
     summaries = {
         (u.module.relpath, u.cls, u.name):
-            (set(u.acquires), {t for t, _ in u.blocking})
+            (set(u.acquires), {t for t, _ in u.blocking},
+             {t for t, _ in u.file_io})
         for u in units}
     changed = True
     while changed:
         changed = False
         for u in units:
             key = (u.module.relpath, u.cls, u.name)
-            acq, blk = summaries[key]
+            acq, blk, fio = summaries[key]
             for callee in u.calls:
                 cs = summaries.get((u.module.relpath, u.cls, callee)) \
                     or summaries.get((u.module.relpath, None, callee))
@@ -422,7 +514,10 @@ def _close_summaries(units: List[_Unit]
                 if not cs[1] <= blk:
                     blk |= cs[1]
                     changed = True
-            summaries[key] = (acq, blk)
+                if not cs[2] <= fio:
+                    fio |= cs[2]
+                    changed = True
+            summaries[key] = (acq, blk, fio)
     return summaries
 
 

@@ -15,7 +15,16 @@ completes the cycle with the quarantined/faulted rows contained: the
 per-row invariants must hold on the CLEAN rows regardless of the
 fault.
 
-Usage: JAX_PLATFORMS=cpu python tools/soak_service.py [n_seeds] [--chaos]
+`--kill` is the crash soak (ISSUE 14): each cycle SIGKILLs a
+journaled, checkpointed child service at a SEEDED crash point
+(faults.CRASH_POINTS x hit count, drawn per seed), then recovers in
+this process and asserts the recovered placements are bit-identical to
+the no-crash oracle with exactly one journal record per (epoch, chunk)
+— the tools/crash_smoke.py machinery, randomized. Each cycle pays a
+subprocess jax start, so the default seed count is small.
+
+Usage: JAX_PLATFORMS=cpu python tools/soak_service.py [n_seeds]
+           [--chaos | --kill]
 """
 
 import os
@@ -36,8 +45,9 @@ from koordinator_tpu.utils import synthetic
 
 P, N = 1_024, 256
 CHAOS = "--chaos" in sys.argv[1:]
+KILL = "--kill" in sys.argv[1:]
 _counts = [a for a in sys.argv[1:] if not a.startswith("-")]
-N_SEEDS = int(_counts[0]) if _counts else 100
+N_SEEDS = int(_counts[0]) if _counts else (5 if KILL else 100)
 
 # per-seed chaos menu: one of these fires each seed (seeded choice)
 CHAOS_MENU = ("nan_metric_column", "negative_allocatable",
@@ -63,6 +73,35 @@ def apply_chaos(service, snap, pods, seed):
     elif fault == "watchdog_stall":
         inj.stall_watchdog(service)
     return snap, pods, quarantined
+
+
+def main_kill():
+    """The crash soak: one SIGKILLed child + recovery per seed, crash
+    point and hit drawn from the seed so a failure reproduces from its
+    seed alone."""
+    from koordinator_tpu.testing import faults
+    import tools.crash_smoke as crash
+
+    bad = 0
+    for i in range(N_SEEDS):
+        rng = np.random.default_rng(i)
+        point = faults.CRASH_POINTS[int(rng.integers(
+            len(faults.CRASH_POINTS)))]
+        # hits 1..4: before/while/after each of the 4 chunk commits
+        hit = int(rng.integers(1, 5))
+        if point == "mid_checkpoint":
+            # checkpoint 1 is the initial publish; 2 the post-batch one
+            hit = int(rng.integers(1, 3))
+        try:
+            verdict = crash.run_case(point, hit, seed=i)
+            print(f"KILL OK   seed {i}: {verdict}", flush=True)
+        except AssertionError as exc:
+            bad += 1
+            print(f"KILL FAIL seed {i} ({point}:{hit}): {exc}",
+                  flush=True)
+    print(f"KILL SOAK DONE: {N_SEEDS} seeds, {bad} violations",
+          flush=True)
+    return 1 if bad else 0
 
 
 def main():
@@ -113,4 +152,4 @@ def main():
 
 
 if __name__ == "__main__":
-    sys.exit(main())
+    sys.exit(main_kill() if KILL else main())
