@@ -85,6 +85,12 @@ def _plan_prelude(usage, capacity, fresh, source_mask,
     high_abs = sel(capacity) * high[None, :] / 100.0      # [N, Rd]
     source = source_mask & high_mask                      # [N]
 
+    # a -1 pod_node (pad rows, orphan pods) must not wrap to the last
+    # node: clamp every gather through `pn` and gate on `on_node` so
+    # such rows are never active and never charge a node
+    on_node = pod_node >= 0                               # [P]
+    pn = jnp.maximum(pod_node, 0)                         # [P]
+
     # budget: spare headroom under the HIGH threshold of destinations
     budget0 = jnp.where(low_mask[:, None],
                         high_abs - sel(usage), 0.0).sum(0)  # [Rd]
@@ -95,7 +101,8 @@ def _plan_prelude(usage, capacity, fresh, source_mask,
     # exact, because an unrequested dim compares 0 <= capacity + 0.5,
     # always true (the scheduler bench's fit_dims argument, same idea).
     if node_fit:
-        node_req = jnp.zeros_like(capacity).at[pod_node].add(pod_req)
+        node_req = jnp.zeros_like(capacity).at[pn].add(
+            pod_req * on_node[:, None])
         dest_free = capacity - node_req                   # [N, R]
         fd = list(fit_dims) if fit_dims is not None else slice(None)
         fits_pn = (pod_req[:, None, fd] <= dest_free[None][:, :, fd]
@@ -103,7 +110,7 @@ def _plan_prelude(usage, capacity, fresh, source_mask,
         fits = (fits_pn & low_mask[None, :]).any(-1)      # [P]
         pod_eligible = pod_eligible & fits
 
-    active = pod_eligible & source[pod_node]              # [P]
+    active = pod_eligible & on_node & source[pn]          # [P]
 
     # --- global eviction order: source nodes by weighted usage%% desc,
     # pods within a node by weighted usage desc (stable = list order) --
@@ -114,17 +121,21 @@ def _plan_prelude(usage, capacity, fresh, source_mask,
         jnp.arange(n, dtype=jnp.int32))
     pod_w = (pod_usage_r * weights[None, :]).sum(1)       # [P]
     ord1 = jnp.argsort(-pod_w, stable=True)
-    order = ord1[jnp.argsort(src_rank[pod_node[ord1]], stable=True)]
+    pod_rank = jnp.where(on_node, src_rank[pn], n)        # nodeless last
+    order = ord1[jnp.argsort(pod_rank[ord1], stable=True)]
     return sel, active, order, budget0, high_abs
 
 
 @shape_contract(
-    usage="f32[N,R]", capacity="f32[N,R]", fresh="bool[N]",
-    source_mask="bool[N]", pod_node="i32[P]", pod_usage_r="f32[P,RD]",
-    pod_req="f32[P,R]", pod_eligible="bool[P]", low="f32[RD]",
+    usage="f32[N~pad:zero,R]", capacity="f32[N~pad:zero,R]",
+    fresh="bool[N~pad:false]",
+    source_mask="bool[N~pad:false]", pod_node="i32[P~pad:-1]",
+    pod_usage_r="f32[P~pad:zero,RD]",
+    pod_req="f32[P~pad:zero,R]", pod_eligible="bool[P~pad:false]",
+    low="f32[RD]",
     high="f32[RD]", weights="f32[RD]", rdims_onehot="f32[RD,R]",
     max_evictions="i32[]",
-    _returns=("bool[P]", "i32[P]"),
+    _returns=("bool[P~pad:false]", "i32[P~pad:any]"),
     _pad="pod_usage_r is pre-restricted to the RD threshold dims via "
          "rdims_onehot; ineligible pods are simply never taken")
 @functools.partial(jax.jit, static_argnames=("use_deviation", "node_fit",
@@ -181,13 +192,17 @@ def lax_cummax(x: jnp.ndarray) -> jnp.ndarray:
 
 
 @shape_contract(
-    usage="f32[N,R]", capacity="f32[N,R]", fresh="bool[N]",
-    source_mask="bool[N]", pod_node="i32[P]", pod_usage_r="f32[P,RD]",
-    pod_req="f32[P,R]", pod_eligible="bool[P]", low="f32[RD]",
+    usage="f32[N~pad:zero,R]", capacity="f32[N~pad:zero,R]",
+    fresh="bool[N~pad:false]",
+    source_mask="bool[N~pad:false]", pod_node="i32[P~pad:-1]",
+    pod_usage_r="f32[P~pad:zero,RD]",
+    pod_req="f32[P~pad:zero,R]", pod_eligible="bool[P~pad:false]",
+    low="f32[RD]",
     high="f32[RD]", weights="f32[RD]", rdims_onehot="f32[RD,R]",
-    pod_ns="i32[P]", ns_counts0="i32[NS]", per_node0="i32[N]",
+    pod_ns="i32[P~pad:zero]", ns_counts0="i32[NS~pad:zero]",
+    per_node0="i32[N~pad:zero]",
     max_evictions="i32[]", max_per_node="i32[]", max_per_ns="i32[]",
-    _returns=("bool[P]", "i32[P]"),
+    _returns=("bool[P~pad:false]", "i32[P~pad:any]"),
     _pad="ns_counts0 is padded to a pow2 namespace table "
          "(columnarize_ns); unlimited caps ride _BIG sentinels")
 @functools.partial(jax.jit, static_argnames=("use_deviation", "node_fit",

@@ -641,7 +641,9 @@ class BlkIOReconcile:
         return block.name
 
     def _reset_stale(self, desired: Dict[tuple, int]) -> None:
-        for (file, dev) in set(self._applied) - set(desired):
+        # sorted: reset writes (and their audit records) must land in
+        # the same order every process, not hash-seed order
+        for (file, dev) in sorted(set(self._applied) - set(desired)):
             reset = 100 if file == "blkio.cost.weight" else 0
             self.executor.update(CgroupUpdate(BE_ROOT, file,
                                               f"{dev} {reset}"))

@@ -36,8 +36,9 @@ def _dims(x: jnp.ndarray, fit_dims: Optional[tuple]) -> jnp.ndarray:
 
 
 @shape_contract(
-    allocatable="f32[N,R]", requested="f32[N,R]", requests="f32[P,R]",
-    _returns="bool[P,N]",
+    allocatable="f32[N~pad:unschedulable,R]",
+    requested="f32[N~pad:unschedulable,R]", requests="f32[P~pad:zero,R]",
+    _returns="bool[P~pad:invalid,N~pad:false]",
     _pad="padded node rows carry allocatable 0 so no pod fits them; "
          "padded pod rows are masked later by pods.valid")
 def resource_fit(allocatable: jnp.ndarray, requested: jnp.ndarray,
@@ -54,7 +55,7 @@ def resource_fit(allocatable: jnp.ndarray, requested: jnp.ndarray,
 
 
 @shape_contract(quotas="QuotaState", pods="PodBatch",
-                _returns="i32[P,QD]",
+                _returns="i32[P~pad:-1,QD]",
                 _pad="-1 rows past the leaf / for quota-less pods")
 def pod_ancestors(quotas: QuotaState, pods: PodBatch) -> jnp.ndarray:
     """i32[P, D]: each pod's quota-tree ancestor chain per depth, -1 =
@@ -65,7 +66,7 @@ def pod_ancestors(quotas: QuotaState, pods: PodBatch) -> jnp.ndarray:
 
 
 @shape_contract(quotas="QuotaState", pods="PodBatch",
-                _returns="bool[P]",
+                _returns="bool[P~pad:one]",
                 _pad="invalid quota rows carry runtime +inf and never "
                      "gate; quota-less pods pass every level")
 def quota_ceiling_ok(quotas: QuotaState, pods: PodBatch,

@@ -43,7 +43,7 @@ def _seg_sum(values: jnp.ndarray, seg: jnp.ndarray, num: int) -> jnp.ndarray:
     return out.at[jnp.where(seg >= 0, seg, num)].add(values)[:num]
 
 
-@shape_contract(quotas="QuotaState", _returns="f32[Q,R]",
+@shape_contract(quotas="QuotaState", _returns="f32[Q~pad:zero,R]",
                 _pad="invalid rows carry depth -1 and contribute nothing")
 def propagate_demand(quotas: QuotaState) -> jnp.ndarray:
     """f32[Q, R]: limitedRequest per quota, from DIRECT demand.
@@ -128,7 +128,7 @@ def _redistribute_level(level_mask: jnp.ndarray, parent: jnp.ndarray,
 
 
 @shape_contract(quotas="QuotaState", cluster_total="f32[R]",
-                _returns="f32[Q,R]",
+                _returns="f32[Q~pad:inf,R]",
                 _static={"max_iters": 8},
                 _pad="invalid quota rows return +inf (never gate)")
 @functools.partial(jax.jit, static_argnames=("max_iters",))

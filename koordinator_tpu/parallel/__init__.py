@@ -25,5 +25,6 @@ from koordinator_tpu.parallel.mesh import (  # noqa: F401
     shard_snapshot,
     snapshot_sharding,
     struct_sharding,
+    unpad_nodes,
 )
 from koordinator_tpu.parallel import shardops  # noqa: F401

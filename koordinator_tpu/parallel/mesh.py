@@ -50,6 +50,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from koordinator_tpu.snapshot.schema import (
     ClusterSnapshot,
+    PAD_FILL_VALUES,
     PodBatch,
     STRUCT_CLASSES,
     STRUCT_SPECS,
@@ -88,12 +89,28 @@ def node_shards(mesh: Mesh) -> int:
 # --- spec-derived sharding trees ----------------------------------------
 
 def _leaf_dims(spec) -> Optional[tuple]:
-    """Dim-symbol tuple of a leaf spec string ("f32[N,R]" -> ("N", "R"));
-    None for struct references and bare-symbol properties."""
+    """Dim-symbol tuple of a leaf spec string, `~pad:` predicates
+    stripped ("f32[N~pad:zero,R]" -> ("N", "R")); None for struct
+    references and bare-symbol properties."""
     if not isinstance(spec, str) or "[" not in spec:
         return None
     body = spec[spec.index("[") + 1:spec.rindex("]")].strip()
-    return tuple(t.strip() for t in body.split(",")) if body else ()
+    if not body:
+        return ()
+    return tuple(t.split("~")[0].strip() for t in body.split(","))
+
+
+def _node_fill(spec: str):
+    """The concrete pad fill for a leaf's node axis, read off the `N`
+    dim's declared ~pad: predicate (PAD_FILL_VALUES); predicates with
+    no canonical fill (invalid/any) and undeclared dims fill 0."""
+    body = spec[spec.index("[") + 1:spec.rindex("]")]
+    for tok in body.split(","):
+        dim, _, anno = tok.strip().partition("~")
+        if dim.strip() == "N" and anno.strip().startswith("pad:"):
+            fill = PAD_FILL_VALUES.get(anno.strip()[len("pad:"):])
+            return 0 if fill is None else fill
+    return 0
 
 
 def _leaf_partition(dims: tuple, mesh: Mesh, shard_pods: bool) -> P:
@@ -197,15 +214,13 @@ def shard_batch(pods: PodBatch, mesh: Mesh) -> PodBatch:
 
 
 # --- node-axis padding ---------------------------------------------------
-
-# pad values that are NOT plain zero: amplification is a ratio (pad rows
-# are never chosen, but 1.0 keeps the column semantically well-formed),
-# instance topology uses -1 = unknown
-_SNAP_PAD_FILLS = {"cpu_amplification": 1.0, "gpu_numa": -1, "gpu_pcie": -1}
-# a -1 domain entry means "node lacks the topology key": hard spread
-# groups reject such nodes and no anti/affinity pair can exist there
-_BATCH_PAD_FILLS = {"spread_domain": -1, "anti_domain": -1,
-                    "aff_domain": -1}
+#
+# Pad fills are DERIVED from the ~pad: predicates the field-spec tables
+# declare (_node_fill above): cpu_amplification pads 1.0 (pad:one — a
+# ratio column stays semantically well-formed), instance/domain topology
+# pads -1 (pad:-1 — "unknown" / "node lacks the topology key"), and
+# everything else pads 0. tools/padcheck.py asserts the fills; the
+# pad-soundness lint pass asserts consumers respect them.
 
 
 def padded_node_count(num_nodes: int, mesh: Mesh) -> int:
@@ -229,18 +244,18 @@ def _pad_leaf(x, dims: tuple, n_old: int, n_new: int, fill):
     return x
 
 
-def _pad_struct(obj, name: str, n_old: int, n_new: int, fills: dict):
+def _pad_struct(obj, name: str, n_old: int, n_new: int):
     upd = {}
     for fname, spec in STRUCT_SPECS[name].items():
         if isinstance(spec, str) and spec in STRUCT_SPECS:
             upd[fname] = _pad_struct(getattr(obj, fname), spec,
-                                     n_old, n_new, fills)
+                                     n_old, n_new)
             continue
         dims = _leaf_dims(spec)
         if dims is None or "N" not in dims:
             continue
         upd[fname] = _pad_leaf(getattr(obj, fname), dims, n_old, n_new,
-                               fills.get(fname, 0))
+                               _node_fill(spec))
     return obj.replace(**upd)
 
 
@@ -264,8 +279,7 @@ def pad_nodes_to_mesh(snap: ClusterSnapshot, mesh: Mesh) -> ClusterSnapshot:
     n_new = padded_node_count(n_old, mesh)
     if n_new == n_old:
         return snap
-    return _pad_struct(snap, "ClusterSnapshot", n_old, n_new,
-                       _SNAP_PAD_FILLS)
+    return _pad_struct(snap, "ClusterSnapshot", n_old, n_new)
 
 
 def unpad_nodes(snap: ClusterSnapshot, num_real: int) -> ClusterSnapshot:
@@ -317,8 +331,10 @@ def pad_batch_nodes(pods: PodBatch, num_nodes: int) -> PodBatch:
     A no-op when nothing carries the real node count (the [1, 1]
     compile-out matrices of slim workloads)."""
     extents = set()
-    for fname in _BATCH_PAD_FILLS:
-        dims = _leaf_dims(STRUCT_SPECS["PodBatch"][fname])
+    for fname, spec in STRUCT_SPECS["PodBatch"].items():
+        dims = _leaf_dims(spec)
+        if dims is None or "N" not in dims:
+            continue
         extents.add(getattr(pods, fname).shape[dims.index("N")])
     extents -= {1, num_nodes}
     if not extents:
@@ -326,5 +342,4 @@ def pad_batch_nodes(pods: PodBatch, num_nodes: int) -> PodBatch:
     if len(extents) > 1 or max(extents) > num_nodes:
         raise ValueError(f"inconsistent batch node extents {sorted(extents)} "
                          f"vs padded node count {num_nodes}")
-    return _pad_struct(pods, "PodBatch", extents.pop(), num_nodes,
-                       _BATCH_PAD_FILLS)
+    return _pad_struct(pods, "PodBatch", extents.pop(), num_nodes)

@@ -75,8 +75,8 @@ def stage1_mask_sharded(mesh: Mesh, snap: ClusterSnapshot, pods: PodBatch,
 
 
 @shape_contract(
-    vals="f32[P,KC]", idxs="i32[P,KC]",
-    _returns=("f32[P,KC]", "i32[P,KC]"),
+    vals="f32[P~pad:any,KC]", idxs="i32[P~pad:any,KC]",
+    _returns=("f32[P~pad:any,KC]", "i32[P~pad:any,KC]"),
     _pad="KC = gathered per-shard candidates (k x node shards); rows "
          "sort by (value desc, global index asc) — exactly lax.top_k's "
          "tie order, so [:, :k] of the output equals the global top-k")

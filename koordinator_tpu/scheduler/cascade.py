@@ -65,7 +65,8 @@ from koordinator_tpu.snapshot.schema import (
 
 @shape_contract(
     nodes="NodeState", pods="PodBatch", cfg="LoadAwareConfig",
-    _returns=("bool[P,N]", "?f32[P,N]"),
+    _returns=("bool[P~pad:invalid,N~pad:false]",
+              "?f32[P~pad:any,N~pad:any]"),
     _pad="unschedulable (padded) node columns are False everywhere; "
          "taint_penalty is None when the batch models no tolerations "
          "(has_taints False — the gate compiles out)")
@@ -104,8 +105,9 @@ def static_gates(nodes: NodeState, pods: PodBatch,
 
 
 @shape_contract(
-    snap="ClusterSnapshot", pods="PodBatch", static_ok="bool[P,N]",
-    _returns="bool[P,N]",
+    snap="ClusterSnapshot", pods="PodBatch",
+    static_ok="bool[P~pad:invalid,N~pad:false]",
+    _returns="bool[P~pad:invalid,N~pad:false]",
     _pad="a SUPERSET of every commit round's node-column feasibility; "
          "never applied to reservation slot columns (consumers draw "
          "from the slot's own hold)")
@@ -132,7 +134,10 @@ def stage1_mask(snap: ClusterSnapshot, pods: PodBatch,
     return mask
 
 
-@shape_contract(mask="bool[P,N]", _returns="i32[P]")
+@shape_contract(mask="bool[P~pad:invalid,N~pad:false]",
+                _returns="i32[P~pad:any]",
+                _pad="pad pod rows count their surviving pad-invariant "
+                     "columns — observability only, masked by valid")
 def candidate_counts(mask: jnp.ndarray) -> jnp.ndarray:
     """i32[P]: surviving candidate nodes per pod — the cascade's
     observability hook (a zero row is a pod stage 1 already proved

@@ -110,14 +110,14 @@ class ScheduleResult:
 
 
 register_struct(ScheduleResult, {
-    "assignment": "i32[P]",
-    "chosen_score": "f32[P]",
-    "numa_zone": "i32[P]",
-    "numa_take": "f32[P,Z,2]",
-    "gpu_take": "bool[P,I]",
-    "aux_inst": "i32[P,AX]",
-    "res_slot": "i32[P]",
-    "gang_failed": "bool[G]",
+    "assignment": "i32[P~pad:-1]",
+    "chosen_score": "f32[P~pad:-1]",  # pad rows are never placed
+    "numa_zone": "i32[P~pad:-1]",
+    "numa_take": "f32[P~pad:zero,Z~pad:zero,2]",
+    "gpu_take": "bool[P~pad:false,I~pad:false]",
+    "aux_inst": "i32[P~pad:-1,AX]",
+    "res_slot": "i32[P~pad:-1]",
+    "gang_failed": "bool[G~pad:false]",
     "snapshot": "ClusterSnapshot",
 })
 
@@ -1341,8 +1341,9 @@ def charge_all_counts(counts: tuple, batch, assignment) -> tuple:
 
 
 @shape_contract(
-    count0="f32[SG,DM]", dom_matrix="i32[SG,N]", member="bool[P,SG]",
-    assignment="i32[P]", _returns="f32[SG,DM]",
+    count0="f32[SG,DM~pad:zero]", dom_matrix="i32[SG,N~pad:-1]",
+    member="bool[P~pad:false,SG]",
+    assignment="i32[P~pad:-1]", _returns="f32[SG,DM~pad:zero]",
     _pad="unplaced rows (assignment -1), non-members, and keyless "
          "nodes (domain -1) all charge the drop row; the SG symbol "
          "stands for any of the three constraint families")
@@ -1388,7 +1389,7 @@ def charge_domain_counts(count0: jnp.ndarray, dom_matrix: jnp.ndarray,
 
 
 @shape_contract(
-    pods="PodBatch", assign="i32[P]", tried="bool[P]",
+    pods="PodBatch", assign="i32[P~pad:-1]", tried="bool[P~pad:false]",
     _returns=("i32[TC]", "bool[TC]"),
     _static={"tail_chunk": "TC"},
     _pad="requires tail_chunk <= P (the window gathers batch rows); "
@@ -1451,12 +1452,14 @@ def tail_select(pods: PodBatch, assign: jnp.ndarray, tried: jnp.ndarray,
 
 @shape_contract(
     snap="ClusterSnapshot",
-    counts=("f32[SG,DM]", "f32[AG,DM]", "f32[AG,DM]", "f32[FG,DM]"),
-    assign="i32[P]", tried="bool[P]", pods="PodBatch",
+    counts=("f32[SG,DM~pad:zero]", "f32[AG,DM~pad:zero]",
+            "f32[AG,DM~pad:zero]", "f32[FG,DM~pad:zero]"),
+    assign="i32[P~pad:-1]", tried="bool[P~pad:false]", pods="PodBatch",
     cfg="LoadAwareConfig",
     _returns=("ClusterSnapshot",
-              ("f32[SG,DM]", "f32[AG,DM]", "f32[AG,DM]", "f32[FG,DM]"),
-              "i32[P]", "bool[P]"),
+              ("f32[SG,DM~pad:zero]", "f32[AG,DM~pad:zero]",
+               "f32[AG,DM~pad:zero]", "f32[FG,DM~pad:zero]"),
+              "i32[P~pad:-1]", "bool[P~pad:false]"),
     _static={"tail_chunk": "TC"},
     _callable={"step_fn": "koordinator_tpu.scheduler.core.schedule_batch"},
     _pad="counts ride COUNT_FIELDS order; a pass with nothing left "
@@ -1494,11 +1497,13 @@ def tail_pass(step_fn, snap: ClusterSnapshot, counts: tuple,
 
 @shape_contract(
     snap="ClusterSnapshot",
-    counts=("f32[SG,DM]", "f32[AG,DM]", "f32[AG,DM]", "f32[FG,DM]"),
-    assign="i32[P]", pods="PodBatch", cfg="LoadAwareConfig",
+    counts=("f32[SG,DM~pad:zero]", "f32[AG,DM~pad:zero]",
+            "f32[AG,DM~pad:zero]", "f32[FG,DM~pad:zero]"),
+    assign="i32[P~pad:-1]", pods="PodBatch", cfg="LoadAwareConfig",
     _returns=("ClusterSnapshot",
-              ("f32[SG,DM]", "f32[AG,DM]", "f32[AG,DM]", "f32[FG,DM]"),
-              "i32[P]", "i32[4]"),
+              ("f32[SG,DM~pad:zero]", "f32[AG,DM~pad:zero]",
+               "f32[AG,DM~pad:zero]", "f32[FG,DM~pad:zero]"),
+              "i32[P~pad:-1]", "i32[4]"),
     _static={"tail_chunk": "TC", "min_passes": 1, "max_passes": 2},
     _callable={"step_fn": "koordinator_tpu.scheduler.core.schedule_batch"},
     _pad="stats = [after_sweep, final, never_retried, passes]; only "

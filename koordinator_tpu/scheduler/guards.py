@@ -272,7 +272,7 @@ def _quarantine(snap: ClusterSnapshot, pods: PodBatch,
 
 
 @shape_contract(snap="ClusterSnapshot",
-                _returns=("u32[]", "bool[N]"),
+                _returns=("u32[]", "bool[N~pad:false]"),
                 _pad="pad node rows are zero-capacity and scan healthy; "
                      "the word ORs defect-class bits over ALL rows")
 @jax.jit
@@ -282,7 +282,7 @@ def snapshot_health(snap: ClusterSnapshot):
 
 
 @shape_contract(snap="ClusterSnapshot", pods="PodBatch",
-                _returns=("u32[]", "bool[P]"),
+                _returns=("u32[]", "bool[P~pad:false]"),
                 _pad="defects are detected on every row including "
                      "invalid pads (they still poison batch-global "
                      "matmuls); callers drain only valid rows")
@@ -293,7 +293,7 @@ def batch_health(snap: ClusterSnapshot, pods: PodBatch):
 
 
 @shape_contract(snap="ClusterSnapshot", pods="PodBatch",
-                node_bad="bool[N]", pod_bad="bool[P]",
+                node_bad="bool[N~pad:false]", pod_bad="bool[P~pad:false]",
                 _returns=("ClusterSnapshot", "PodBatch"),
                 _pad="all-false masks are a bit-identical pass-through")
 @jax.jit
@@ -306,7 +306,8 @@ def apply_quarantine(snap: ClusterSnapshot, pods: PodBatch,
 
 @shape_contract(
     snap="ClusterSnapshot", pods="PodBatch", cfg="LoadAwareConfig",
-    _returns=("ScheduleResult", "u32[3]", "bool[N]", "bool[P]"),
+    _returns=("ScheduleResult", "u32[3]", "bool[N~pad:false]",
+              "bool[P~pad:false]"),
     _static={"num_rounds": 2, "k_choices": 2, "quota_depth": 2},
     _pad="quarantined rows behave exactly like schedulable=False nodes "
          "/ valid=False pods; health is [word, bad_nodes, bad_pods] "

@@ -104,8 +104,8 @@ def _per_instance(total_mem, pods: PodBatch):
 
 
 @shape_contract(devices="DeviceState", pods="PodBatch",
-                node_idx="i32[P]",
-                _returns=("i32[P]", "f32[P,DEV]"),
+                node_idx="i32[P~pad:-1]",
+                _returns=("i32[P~pad:zero]", "f32[P~pad:zero,DEV]"),
                 _pad="out-of-range node_idx (= no node) is clipped; "
                      "pods without GPU requests get count 0 and zero rows")
 def per_instance_at(devices: DeviceState, pods: PodBatch,
@@ -118,7 +118,7 @@ def per_instance_at(devices: DeviceState, pods: PodBatch,
 
 
 @shape_contract(devices="DeviceState", pods="PodBatch",
-                _returns="bool[P,N]",
+                _returns="bool[P~pad:one,N~pad:any]",
                 _pad="non-device pods pass everywhere; invalid "
                      "instances (gpu_valid False) never count")
 def prefilter(devices: DeviceState, pods: PodBatch) -> jnp.ndarray:
@@ -147,7 +147,7 @@ def prefilter(devices: DeviceState, pods: PodBatch) -> jnp.ndarray:
 
 
 @shape_contract(devices="DeviceState", pods="PodBatch",
-                _returns="f32[P,N]",
+                _returns="f32[P~pad:zero,N~pad:any]",
                 _pad="0 for pods without GPU requests")
 def score_matrix(devices: DeviceState, pods: PodBatch,
                  strategy: str = "least") -> jnp.ndarray:

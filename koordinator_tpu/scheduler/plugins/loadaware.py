@@ -104,7 +104,7 @@ def _usage_percent(used: jnp.ndarray, total: jnp.ndarray) -> jnp.ndarray:
 
 
 @shape_contract(nodes="NodeState", pods="PodBatch", cfg="LoadAwareConfig",
-                _returns="bool[P,N]",
+                _returns="bool[P~pad:any,N~pad:one]",
                 _pad="nodes without fresh metrics pass (metric_fresh "
                      "False == padded rows pass; schedulable gates them "
                      "downstream); DaemonSet pods pass everywhere")
@@ -156,7 +156,7 @@ def _guarded_sub(source: jnp.ndarray, correction: jnp.ndarray) -> jnp.ndarray:
 
 
 @shape_contract(nodes="NodeState", pods="PodBatch", cfg="LoadAwareConfig",
-                _returns="f32[P,N]",
+                _returns="f32[P~pad:any,N~pad:zero]",
                 _pad="nodes without a fresh NodeMetric score 0")
 def score_matrix(nodes: NodeState, pods: PodBatch,
                  cfg: LoadAwareConfig,

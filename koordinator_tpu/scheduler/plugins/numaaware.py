@@ -38,7 +38,7 @@ CPU = int(ResourceKind.CPU)
 MEM = int(ResourceKind.MEMORY)
 
 
-@shape_contract(pods="PodBatch", _returns="f32[P,2]",
+@shape_contract(pods="PodBatch", _returns="f32[P~pad:zero,2]",
                 _pad="zero rows for unbound pods (their scatters no-op)")
 def pod_zone_requests(pods: PodBatch) -> jnp.ndarray:
     """f32[P, 2]: the (cpu milli, mem MiB) a NUMA-bound pod takes from its
@@ -48,7 +48,7 @@ def pod_zone_requests(pods: PodBatch) -> jnp.ndarray:
 
 
 @shape_contract(nodes="NodeState", pods="PodBatch",
-                _returns="bool[P,N]",
+                _returns="bool[P~pad:one,N~pad:any]",
                 _pad="non-NUMA-bound pods pass everywhere; invalid "
                      "zones (numa_valid False) never fit")
 def zone_prefilter(nodes: NodeState, pods: PodBatch) -> jnp.ndarray:
@@ -65,7 +65,7 @@ def zone_prefilter(nodes: NodeState, pods: PodBatch) -> jnp.ndarray:
 
 
 @shape_contract(nodes="NodeState", pods="PodBatch",
-                _returns="f32[P,N]",
+                _returns="f32[P~pad:zero,N~pad:any]",
                 _pad="0 for unbound pods and nodes without topology")
 def numa_score_matrix(nodes: NodeState, pods: PodBatch,
                       strategy: str = "most") -> jnp.ndarray:

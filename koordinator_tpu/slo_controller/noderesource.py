@@ -154,11 +154,12 @@ def build_inputs(nodes: Sequence[Node],
 
 
 @shape_contract(
-    capacity="f32[N,2]", node_reserved="f32[N,2]",
-    system_reserved="f32[N,2]", system_used="f32[N,2]",
-    hp_req="f32[N,2]", hp_used="f32[N,2]", hp_max="f32[N,2]",
-    cpu_by_max="bool[N]", mem_policy="i32[N]",
-    _returns="f32[N,2]",
+    capacity="f32[N~pad:zero,2]", node_reserved="f32[N~pad:zero,2]",
+    system_reserved="f32[N~pad:zero,2]", system_used="f32[N~pad:zero,2]",
+    hp_req="f32[N~pad:zero,2]", hp_used="f32[N~pad:zero,2]",
+    hp_max="f32[N~pad:zero,2]",
+    cpu_by_max="bool[N~pad:false]", mem_policy="i32[N~pad:zero]",
+    _returns="f32[N~pad:zero,2]",
     _pad="columns are (cpu milli, mem MiB); clamped at 0, so padded "
          "zero-capacity rows return 0")
 @jax.jit
@@ -180,9 +181,9 @@ def _batch_allocatable(capacity, node_reserved, system_reserved, system_used,
 
 
 @shape_contract(
-    allocatable="f32[N,2]", prod_reclaimable="f32[N,2]",
-    threshold_ratio="f32[N,2]",
-    _returns="f32[N,2]",
+    allocatable="f32[N~pad:zero,2]", prod_reclaimable="f32[N~pad:zero,2]",
+    threshold_ratio="f32[N~pad:zero,2]",
+    _returns="f32[N~pad:zero,2]",
     _pad="clamped at 0; degrade/invalid sentinels (-1) are applied "
          "host-side after the kernel")
 @jax.jit

@@ -87,16 +87,16 @@ class NodeMetricDelta:
 
 
 register_struct(NodeMetricDelta, {
-    "idx": "i32[K]",
-    "metric_fresh": "bool[K]",
-    "usage": "f32[K,R]",
-    "prod_usage": "f32[K,R]",
-    "agg_usage": "f32[K,AGG,R]",
-    "has_agg": "bool[K]",
-    "assigned_estimated": "f32[K,R]",
-    "assigned_correction": "f32[K,R]",
-    "prod_assigned_estimated": "f32[K,R]",
-    "prod_assigned_correction": "f32[K,R]",
+    "idx": "i32[K~pad:-1]",
+    "metric_fresh": "bool[K~pad:false]",
+    "usage": "f32[K~pad:zero,R]",
+    "prod_usage": "f32[K~pad:zero,R]",
+    "agg_usage": "f32[K~pad:zero,AGG,R]",
+    "has_agg": "bool[K~pad:false]",
+    "assigned_estimated": "f32[K~pad:zero,R]",
+    "assigned_correction": "f32[K~pad:zero,R]",
+    "prod_assigned_estimated": "f32[K~pad:zero,R]",
+    "prod_assigned_correction": "f32[K~pad:zero,R]",
     "source_version": "?i32[]",
 })
 
@@ -178,24 +178,24 @@ class NodeTopologyDelta:
 
 
 register_struct(NodeTopologyDelta, {
-    "idx": "i32[K]",
-    "allocatable": "f32[K,R]",
-    "requested": "f32[K,R]",
-    "schedulable": "bool[K]",
-    "label_group": "i32[K]",
-    "taint_group": "i32[K]",
-    "numa_cap": "f32[K,Z,2]",
-    "numa_free": "f32[K,Z,2]",
-    "numa_valid": "bool[K,Z]",
-    "numa_policy": "i32[K]",
-    "cpu_amplification": "f32[K]",
-    "gpu_total": "f32[K,DEV]",
-    "gpu_free": "f32[K,I,DEV]",
-    "gpu_valid": "bool[K,I]",
-    "gpu_numa": "i32[K,I]",
-    "gpu_pcie": "i32[K,I]",
-    "aux_free": "f32[K,AX,J]",
-    "aux_valid": "bool[K,AX,J]",
+    "idx": "i32[K~pad:-1]",
+    "allocatable": "f32[K~pad:zero,R]",
+    "requested": "f32[K~pad:zero,R]",
+    "schedulable": "bool[K~pad:false]",
+    "label_group": "i32[K~pad:zero]",
+    "taint_group": "i32[K~pad:zero]",
+    "numa_cap": "f32[K~pad:zero,Z~pad:zero,2]",
+    "numa_free": "f32[K~pad:zero,Z~pad:zero,2]",
+    "numa_valid": "bool[K~pad:false,Z~pad:false]",
+    "numa_policy": "i32[K~pad:zero]",
+    "cpu_amplification": "f32[K~pad:one]",
+    "gpu_total": "f32[K~pad:zero,DEV]",
+    "gpu_free": "f32[K~pad:zero,I~pad:zero,DEV]",
+    "gpu_valid": "bool[K~pad:false,I~pad:false]",
+    "gpu_numa": "i32[K~pad:-1,I~pad:-1]",
+    "gpu_pcie": "i32[K~pad:-1,I~pad:-1]",
+    "aux_free": "f32[K~pad:zero,AX,J~pad:zero]",
+    "aux_valid": "bool[K~pad:false,AX,J~pad:false]",
     "metric": "NodeMetricDelta",
     "source_version": "?i32[]",
 })
@@ -246,7 +246,7 @@ def apply_topology_delta(snap: ClusterSnapshot,
 
 
 @shape_contract(snap="ClusterSnapshot", pods="PodBatch",
-                result="ScheduleResult", mask="bool[P]",
+                result="ScheduleResult", mask="bool[P~pad:false]",
                 _pad="un-masked rows and never-assigned rows (assignment "
                      "-1) return nothing; charges scatter to drop rows",
                 _returns="ClusterSnapshot")

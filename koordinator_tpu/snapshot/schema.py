@@ -453,6 +453,15 @@ def zeros_snapshot(num_nodes: int, num_quotas: int = 1, num_gangs: int = 1,
 #   "PodBatch"   a registered struct (register_struct below)
 #   "N"          a bare dim symbol marks a symbolic-int PROPERTY of a
 #                struct (documentation for the AST tier; never built)
+#   "f32[N~pad:zero,R]"  a PADDED dim declares its pad predicate (the
+#                koordpad tier): what the pad region along that dim
+#                contains. Three checkers consume the predicates:
+#                koordlint's pad-soundness pass (PS001-PS005, static
+#                mask-provenance dataflow), tools/padcheck.py (concrete
+#                differential runs under two paddings), and
+#                parallel/mesh.py's pad fills. PAD_VOCAB below names
+#                the predicates; PADDED_DIMS names the dims that must
+#                carry one.
 
 # the named-dimension vocabulary — THE shared meaning of every symbol;
 # tools/lint/shapes/spec.py carries the same table for the stdlib-only
@@ -488,6 +497,51 @@ FIXED_DIMS = {
     "DEV": NUM_DEV_DIMS,     # GPU instance resource dims (core/mem/ratio)
     "AX": NUM_AUX_TYPES,     # aux device pools (rdma, fpga)
     "QD": MAX_QUOTA_DEPTH,   # quota-tree depth
+}
+
+# the pad-predicate vocabulary (the koordpad tier) — what a `~pad:` token
+# on a padded dim promises about the pad region along that dim;
+# tools/lint/shapes/spec.py carries the same table for the stdlib-only
+# tier and tests/test_pad_soundness.py pins the two in sync
+PAD_VOCAB = {
+    "zero": "pad entries are 0 (False for bool)",
+    "one": "pad entries are 1 (True for bool)",
+    "false": "pad entries are False (bool columns only)",
+    "-1": "pad entries carry the -1 'none' sentinel",
+    "inf": "pad entries are +inf (never gate; f32 only)",
+    "unschedulable": "zero-filled node rows additionally killed by the "
+                     "schedulable=False guard (pad_nodes_to_mesh rows)",
+    "invalid": "content unspecified; masked by the carrying struct's "
+               "validity column (valid/gpu_valid/numa_valid/...)",
+    "any": "content unspecified; every consumer must guard it "
+           "explicitly (no inertness is asserted)",
+}
+
+# dims that take padded capacity and therefore MUST declare a pad
+# predicate wherever they appear in a struct field or contract spec
+# (the PS004 totality check). Deliberately NOT here:
+#   R          fixed resource axis — NUM_RESOURCES is exact, never padded
+#   S/L/T/TG/  exact equivalence-class tables sized by distinct values,
+#   SG/AG/FG     not bucketed capacities
+#   TC         static tail retry-chunk width (a tuning constant; varying
+#                it changes tail-loop iteration stats, not padding)
+#   KC/RD      derived widths (k x shards, threshold rows) — exact
+PADDED_DIMS = frozenset({
+    "P", "N", "Q", "G", "V", "Z", "I", "J", "DM", "K", "NS",
+})
+
+# pad predicate -> the concrete fill value tools/padcheck.py and the
+# mesh padder materialize for it (None: no single canonical fill — the
+# predicate is a masking promise, not a value)
+PAD_FILL_VALUES = {
+    "zero": 0,
+    "one": 1,
+    "false": 0,
+    "-1": -1,
+    "inf": float("inf"),
+    "unschedulable": 0,
+    "invalid": None,
+    "any": None,
 }
 
 FieldSpec = Union[str, Tuple[str, ...]]
@@ -575,111 +629,111 @@ def shape_contract(_returns: FieldSpec = None,
 
 
 register_struct(NodeState, {
-    "allocatable": "f32[N,R]",
-    "requested": "f32[N,R]",
-    "usage": "f32[N,R]",
-    "prod_usage": "f32[N,R]",
-    "agg_usage": "f32[N,AGG,R]",
-    "assigned_estimated": "f32[N,R]",
-    "assigned_correction": "f32[N,R]",
-    "prod_assigned_estimated": "f32[N,R]",
-    "prod_assigned_correction": "f32[N,R]",
-    "metric_fresh": "bool[N]",
-    "has_agg": "bool[N]",
-    "schedulable": "bool[N]",
-    "label_group": "i32[N]",
-    "taint_group": "i32[N]",
-    "numa_cap": "f32[N,Z,2]",
-    "numa_free": "f32[N,Z,2]",
-    "numa_valid": "bool[N,Z]",
-    "numa_policy": "i32[N]",
-    "cpu_amplification": "f32[N]",
+    "allocatable": "f32[N~pad:unschedulable,R]",
+    "requested": "f32[N~pad:unschedulable,R]",
+    "usage": "f32[N~pad:unschedulable,R]",
+    "prod_usage": "f32[N~pad:unschedulable,R]",
+    "agg_usage": "f32[N~pad:unschedulable,AGG,R]",
+    "assigned_estimated": "f32[N~pad:unschedulable,R]",
+    "assigned_correction": "f32[N~pad:unschedulable,R]",
+    "prod_assigned_estimated": "f32[N~pad:unschedulable,R]",
+    "prod_assigned_correction": "f32[N~pad:unschedulable,R]",
+    "metric_fresh": "bool[N~pad:false]",
+    "has_agg": "bool[N~pad:false]",
+    "schedulable": "bool[N~pad:false]",
+    "label_group": "i32[N~pad:zero]",
+    "taint_group": "i32[N~pad:zero]",
+    "numa_cap": "f32[N~pad:unschedulable,Z~pad:zero,2]",
+    "numa_free": "f32[N~pad:unschedulable,Z~pad:zero,2]",
+    "numa_valid": "bool[N~pad:false,Z~pad:false]",
+    "numa_policy": "i32[N~pad:zero]",
+    "cpu_amplification": "f32[N~pad:one]",
     "num_nodes": "N",
 })
 
 register_struct(PodBatch, {
-    "requests": "f32[P,R]",
-    "estimated": "f32[P,R]",
-    "qos": "i8[P]",
-    "priority_class": "i8[P]",
-    "priority": "i32[P]",
-    "gang_id": "i32[P]",
-    "quota_id": "i32[P]",
-    "selector_id": "i32[P]",
+    "requests": "f32[P~pad:zero,R]",
+    "estimated": "f32[P~pad:zero,R]",
+    "qos": "i8[P~pad:zero]",
+    "priority_class": "i8[P~pad:zero]",
+    "priority": "i32[P~pad:zero]",
+    "gang_id": "i32[P~pad:-1]",
+    "quota_id": "i32[P~pad:-1]",
+    "selector_id": "i32[P~pad:-1]",
     "selector_match": "bool[S,L]",
-    "reservation_owner": "i32[P]",
-    "gpu_ratio": "f32[P]",
-    "numa_single": "bool[P]",
-    "daemonset": "bool[P]",
-    "toleration_id": "i32[P]",
+    "reservation_owner": "i32[P~pad:-1]",
+    "gpu_ratio": "f32[P~pad:zero]",
+    "numa_single": "bool[P~pad:false]",
+    "daemonset": "bool[P~pad:false]",
+    "toleration_id": "i32[P~pad:zero]",
     "tol_forbid": "bool[T,TG]",
     "tol_prefer": "f32[T,TG]",
-    "spread_id": "i32[P]",
-    "spread_carrier": "bool[P,SG]",
-    "spread_member": "bool[P,SG]",
+    "spread_id": "i32[P~pad:-1]",
+    "spread_carrier": "bool[P~pad:false,SG]",
+    "spread_member": "bool[P~pad:false,SG]",
     "spread_max_skew": "f32[SG]",
-    "spread_domain": "i32[SG,N]",
-    "spread_count0": "f32[SG,DM]",
-    "spread_dvalid": "bool[SG,DM]",
-    "anti_id": "i32[P]",
-    "anti_member": "bool[P,AG]",
-    "anti_carrier": "bool[P,AG]",
-    "anti_domain": "i32[AG,N]",
-    "anti_count0": "f32[AG,DM]",
-    "anti_carrier_count0": "f32[AG,DM]",
-    "aff_id": "i32[P]",
-    "aff_carrier": "bool[P,FG]",
-    "aff_member": "bool[P,FG]",
-    "aff_domain": "i32[FG,N]",
-    "aff_count0": "f32[FG,DM]",
-    "valid": "bool[P]",
+    "spread_domain": "i32[SG,N~pad:-1]",
+    "spread_count0": "f32[SG,DM~pad:zero]",
+    "spread_dvalid": "bool[SG,DM~pad:false]",
+    "anti_id": "i32[P~pad:-1]",
+    "anti_member": "bool[P~pad:false,AG]",
+    "anti_carrier": "bool[P~pad:false,AG]",
+    "anti_domain": "i32[AG,N~pad:-1]",
+    "anti_count0": "f32[AG,DM~pad:zero]",
+    "anti_carrier_count0": "f32[AG,DM~pad:zero]",
+    "aff_id": "i32[P~pad:-1]",
+    "aff_carrier": "bool[P~pad:false,FG]",
+    "aff_member": "bool[P~pad:false,FG]",
+    "aff_domain": "i32[FG,N~pad:-1]",
+    "aff_count0": "f32[FG,DM~pad:zero]",
+    "valid": "bool[P~pad:false]",
     "num_pods": "P",
 })
 
 register_struct(QuotaState, {
-    "min": "f32[Q,R]",
-    "max": "f32[Q,R]",
-    "shared_weight": "f32[Q,R]",
-    "parent": "i32[Q]",
-    "ancestors": "bool[Q,Q]",
-    "depth_ancestor": "i32[Q,QD]",
-    "used": "f32[Q,R]",
-    "demand": "f32[Q,R]",
-    "allow_lent": "bool[Q]",
-    "runtime": "f32[Q,R]",
-    "valid": "bool[Q]",
+    "min": "f32[Q~pad:zero,R]",
+    "max": "f32[Q~pad:inf,R]",
+    "shared_weight": "f32[Q~pad:zero,R]",
+    "parent": "i32[Q~pad:-1]",
+    "ancestors": "bool[Q~pad:false,Q~pad:false]",
+    "depth_ancestor": "i32[Q~pad:-1,QD]",
+    "used": "f32[Q~pad:zero,R]",
+    "demand": "f32[Q~pad:zero,R]",
+    "allow_lent": "bool[Q~pad:one]",
+    "runtime": "f32[Q~pad:inf,R]",
+    "valid": "bool[Q~pad:false]",
 })
 
 register_struct(GangState, {
-    "min_member": "i32[G]",
-    "member_count": "i32[G]",
-    "assumed": "i32[G]",
-    "strict": "bool[G]",
-    "satisfied": "bool[G]",
-    "valid": "bool[G]",
+    "min_member": "i32[G~pad:one]",
+    "member_count": "i32[G~pad:zero]",
+    "assumed": "i32[G~pad:zero]",
+    "strict": "bool[G~pad:one]",
+    "satisfied": "bool[G~pad:false]",
+    "valid": "bool[G~pad:false]",
 })
 
 register_struct(DeviceState, {
-    "gpu_total": "f32[N,DEV]",
-    "gpu_free": "f32[N,I,DEV]",
-    "gpu_valid": "bool[N,I]",
-    "gpu_numa": "i32[N,I]",
-    "gpu_pcie": "i32[N,I]",
-    "aux_free": "f32[N,AX,J]",
-    "aux_valid": "bool[N,AX,J]",
+    "gpu_total": "f32[N~pad:zero,DEV]",
+    "gpu_free": "f32[N~pad:zero,I~pad:zero,DEV]",
+    "gpu_valid": "bool[N~pad:false,I~pad:false]",
+    "gpu_numa": "i32[N~pad:-1,I~pad:-1]",
+    "gpu_pcie": "i32[N~pad:-1,I~pad:-1]",
+    "aux_free": "f32[N~pad:zero,AX,J~pad:zero]",
+    "aux_valid": "bool[N~pad:false,AX,J~pad:false]",
     "num_instances": "I",
 })
 
 register_struct(ReservationState, {
-    "node": "i32[V]",
-    "free": "f32[V,R]",
-    "owner_group": "i32[V]",
-    "allocate_once": "bool[V]",
-    "valid": "bool[V]",
-    "gpu_free": "f32[V,I,DEV]",
-    "gpu_valid": "bool[V,I]",
-    "numa_free": "f32[V,Z,2]",
-    "numa_valid": "bool[V,Z]",
+    "node": "i32[V~pad:-1]",
+    "free": "f32[V~pad:zero,R]",
+    "owner_group": "i32[V~pad:-1]",
+    "allocate_once": "bool[V~pad:one]",
+    "valid": "bool[V~pad:false]",
+    "gpu_free": "f32[V~pad:zero,I~pad:zero,DEV]",
+    "gpu_valid": "bool[V~pad:false,I~pad:false]",
+    "numa_free": "f32[V~pad:zero,Z~pad:zero,2]",
+    "numa_valid": "bool[V~pad:false,Z~pad:false]",
 })
 
 register_struct(ClusterSnapshot, {

@@ -1,0 +1,37 @@
+"""Negative determinism fixtures: set consumption that is order-free
+or explicitly sorted."""
+
+import hashlib
+
+import numpy as np
+
+ACTIVE_KINDS = {"cpu", "memory", "gpu"}
+
+
+def columnarize(nodes):
+    names = {n.name for n in nodes}
+    rows = sorted(names)                  # sorted(): deterministic
+    return {name: i for i, name in enumerate(rows)}
+
+
+def kind_columns():
+    return np.asarray(sorted(ACTIVE_KINDS))
+
+
+def digest(pods):
+    seen = {p.uid for p in pods}
+    h = hashlib.sha256()
+    for uid in sorted(seen):
+        h.update(uid.encode())
+    return h.hexdigest()
+
+
+def membership(kind, extra):
+    allowed = ACTIVE_KINDS | set(extra)
+    total = len(allowed)                  # order-free consumption
+    return kind in allowed and total > 0
+
+
+def extremes(weights):
+    pool = set(weights)
+    return min(pool), max(pool)

@@ -1,0 +1,34 @@
+"""Positive determinism fixtures: set order materialized into ordered
+artifacts (the analyzer only scans koordinator_tpu/ paths, hence the
+fixture package dir)."""
+
+import hashlib
+
+import numpy as np
+
+ACTIVE_KINDS = {"cpu", "memory", "gpu"}
+
+
+def columnarize(nodes):
+    names = {n.name for n in nodes}
+    rows = list(names)                    # ND001: list() of a set
+    return {name: i for i, name in enumerate(rows)}
+
+
+def kind_columns():
+    return np.asarray([k for k in ACTIVE_KINDS])  # ND001: listcomp
+
+
+def digest(pods):
+    seen = set()
+    for p in pods:
+        seen.add(p.uid)
+    h = hashlib.sha256()
+    for uid in seen:                      # ND001: digest over set order
+        h.update(uid.encode())
+    return h.hexdigest()
+
+
+def label_key(labels):
+    tags = set(labels) | {"default"}
+    return ",".join(tags)                 # ND001: join over set order

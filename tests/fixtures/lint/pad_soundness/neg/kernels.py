@@ -1,0 +1,43 @@
+"""Negative pad-soundness fixtures: pad-correct kernels that exercise
+the same shapes as pos/ without violating any PS rule."""
+
+import jax.numpy as jnp
+
+from koordinator_tpu.snapshot.schema import register_struct, shape_contract
+
+
+class Cols:
+    """Stand-in columnar struct (the fixture never runs)."""
+
+
+register_struct(Cols, {
+    "usage": "f32[N~pad:zero]",
+    "mask": "bool[N~pad:false]",
+})
+
+
+@shape_contract(x="f32[P~pad:zero,R]", _returns="f32[R]")
+def sum_over_zeros(x):
+    return jnp.sum(x, axis=0)             # zero-pads are sum-neutral
+
+
+@shape_contract(idx="i32[P~pad:-1]", table="f32[Q~pad:zero]",
+                _returns="f32[P~pad:any]")
+def clamped_gather(idx, table):
+    safe = jnp.maximum(idx, 0)            # clamp kills the -1 fill
+    return table[safe]
+
+
+@shape_contract(m="bool[N~pad:false]", _returns="f32[]")
+def masked_total(m):
+    return jnp.sum(m.astype(jnp.float32))
+
+
+@shape_contract(m="bool[N~pad:false]", _returns="f32[]")
+def straight_cross(m):
+    return masked_total(m & m)            # pads stay False across the call
+
+
+@shape_contract(cols="Cols", _returns="f32[N~pad:zero]")
+def masked_usage(cols):
+    return cols.usage * cols.mask         # & with false pads annihilates

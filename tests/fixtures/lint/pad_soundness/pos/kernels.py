@@ -1,0 +1,46 @@
+"""Positive pad-soundness fixtures: one violation per PS code."""
+
+import jax.numpy as jnp
+
+from koordinator_tpu.snapshot.schema import register_struct, shape_contract
+
+
+class Cols:
+    """Stand-in columnar struct (the fixture never runs)."""
+
+
+register_struct(Cols, {
+    "usage": "f32[N]",                    # PS004: padded dim, no ~pad:
+    "mask": "bool[N~pad:false]",
+})
+
+
+@shape_contract(x="f32[P~pad:one,R]", _returns="f32[R]")
+def sum_over_ones(x):
+    return jnp.sum(x, axis=0)             # PS001: one-pads inflate sums
+
+
+@shape_contract(idx="i32[P~pad:-1]", table="f32[Q~pad:zero]",
+                _returns="f32[P~pad:any]")
+def raw_sentinel_gather(idx, table):
+    return table[idx]                     # PS002: -1 wraps to the last row
+
+
+@shape_contract(m="bool[N~pad:false]", _returns="f32[]")
+def masked_total(m):
+    return jnp.sum(m.astype(jnp.float32))
+
+
+@shape_contract(m="bool[N~pad:false]", _returns="f32[]")
+def inverted_cross(m):
+    return masked_total(~m)               # PS003: ~m pads are True
+
+
+@shape_contract(w="f32[2~pad:zero]", _returns="i32[Q~pad:inf]")
+def malformed_pads(w):                    # PS005: literal-dim pad + int inf
+    return jnp.zeros((8,), jnp.int32)
+
+
+@shape_contract(s="f32[S~pad:zero]", _returns="f32[S]")
+def exempt_dim_pad(s):                    # PS005: S is sized exactly
+    return s

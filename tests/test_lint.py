@@ -31,6 +31,7 @@ def fixture_findings(analyzer: str, tree: str, empty_baseline):
 
 
 _ANALYZER_NAMES = {
+    "determinism": "determinism",
     "host_sync": "host-sync-in-jit",
     "recompile": "recompilation-hazard",
     "donation": "donation-aliasing",
@@ -40,6 +41,7 @@ _ANALYZER_NAMES = {
     "robustness": "robustness",
     "shape_contract": "shape-contract",
     "tail_readback": "tail-readback",
+    "pad_soundness": "pad-soundness",
 }
 
 
@@ -66,6 +68,8 @@ def empty_baseline(tmp_path):
     ("robustness", {"RB001"}),
     ("shape_contract", {"SH001", "SH002", "SH003", "SH004", "SH005"}),
     ("tail_readback", {"HS006"}),
+    ("pad_soundness", {"PS001", "PS002", "PS003", "PS004", "PS005"}),
+    ("determinism", {"ND001"}),
 ])
 def test_positive_fixture(fixture_dir, expected_codes, empty_baseline):
     findings = fixture_findings(fixture_dir, "pos", empty_baseline)

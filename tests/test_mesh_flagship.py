@@ -63,7 +63,8 @@ def test_snapshot_sharding_derived_from_specs():
             if "[" not in spec:
                 continue  # symbolic-int property
             dims = spec[spec.index("[") + 1:spec.rindex("]")].split(",")
-            want = NODE_AXIS if dims and dims[0].strip() == "N" else None
+            lead = dims[0].split("~")[0].strip() if dims else ""
+            want = NODE_AXIS if lead == "N" else None
             got = getattr(sub, fname).spec
             assert (got[0] if len(got) else None) == want, \
                 (group, fname, got)

@@ -1,0 +1,186 @@
+"""Pad x quarantine x mesh-shrink interplay (ISSUE 16, koordpad): the
+PAD-ROW CONTRACT (mesh.pad_nodes_to_mesh docstring) must survive the
+guard path and the degradation ladder's pad -> unpad -> repad cycle at
+mesh-indivisible node counts.
+
+Three ways a pad row could leak that the kernel tests alone don't pin:
+the health scan could flag it (spurious quarantine churn every cycle),
+the quarantine scrub could rewrite its declared fill (breaking the
+fills tools/padcheck.py asserts), or a shrink-repad round trip could
+smear real-row state into the pad band. Each gets a bitwise pin here.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from koordinator_tpu.parallel import (
+    make_mesh,
+    pad_nodes_to_mesh,
+    padded_node_count,
+    unpad_nodes,
+)
+from koordinator_tpu.scheduler import core, guards
+from koordinator_tpu.scheduler.plugins.loadaware import LoadAwareConfig
+from koordinator_tpu.utils import synthetic
+
+N_REAL = 13  # indivisible by every mesh size we pad to
+CFG = LoadAwareConfig.make()
+SLIM = dict(num_rounds=2, k_choices=4, enable_numa=False,
+            enable_devices=False)
+
+
+def make_padded(seed=0, num_pods=6):
+    mesh = make_mesh(jax.devices())  # 8-way node axis: 13 -> 16
+    snap = synthetic.synthetic_cluster(N_REAL, seed=seed)
+    pods = synthetic.synthetic_pods(num_pods, seed=seed + 7, prod_frac=1.0)
+    padded = pad_nodes_to_mesh(snap, mesh)
+    assert padded.num_nodes == padded_node_count(N_REAL, mesh) == 16
+    return mesh, snap, pods, padded
+
+
+def assert_trees_equal(a, b, msg=""):
+    la, lb = jax.tree_util.tree_leaves(a), jax.tree_util.tree_leaves(b)
+    assert len(la) == len(lb), msg
+    for x, y in zip(la, lb):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y),
+                                      err_msg=msg)
+
+
+def assert_pad_rows_inert(snap, n_real):
+    """The three load-bearing pad fills: never chosen, never charged."""
+    assert not np.asarray(snap.nodes.schedulable)[n_real:].any()
+    assert not np.asarray(snap.nodes.allocatable)[n_real:].any()
+    assert not np.asarray(snap.nodes.requested)[n_real:].any()
+
+
+# --- the health scan on padded snapshots ------------------------------------
+
+def test_pad_rows_scan_healthy():
+    """Pad rows must never trip the guard word: a spurious bit would
+    quarantine (and re-count) the pad band on every ladder cycle."""
+    _, _, pods, padded = make_padded()
+    word, node_bad = guards.snapshot_health(padded)
+    assert int(np.asarray(word)) == guards.HEALTH_OK, \
+        guards.decode_health_word(int(np.asarray(word)))
+    assert not np.asarray(node_bad).any()
+    word, pod_bad = guards.batch_health(padded, pods)
+    assert int(np.asarray(word)) == guards.HEALTH_OK
+    assert not np.asarray(pod_bad).any()
+
+
+# --- quarantine vs the pad band ---------------------------------------------
+
+def test_quarantine_passthrough_includes_pad_band():
+    """All-false masks are a bit-identical pass-through on the PADDED
+    snapshot too — declared pad fills included."""
+    _, _, pods, padded = make_padded(1)
+    n_pad = padded.num_nodes
+    q_snap, q_pods = guards.apply_quarantine(
+        padded, pods, jnp.zeros((n_pad,), bool),
+        jnp.zeros((pods.num_pods,), bool))
+    assert_trees_equal(q_snap, padded, "quarantine pass-through (snap)")
+    assert_trees_equal(q_pods, pods, "quarantine pass-through (pods)")
+
+
+def test_quarantining_pad_rows_is_a_noop():
+    """Every scrubbed field's declared pad fill is a fixed point of the
+    scrub (zero stays zero, schedulable stays False, cpu_amplification
+    is never scrubbed), so flagging the pad band changes nothing —
+    quarantine can't corrupt the fills padcheck asserts."""
+    _, _, pods, padded = make_padded(2)
+    n_pad = padded.num_nodes
+    pad_only = np.zeros((n_pad,), bool)
+    pad_only[N_REAL:] = True
+    q_snap, q_pods = guards.apply_quarantine(
+        padded, pods, jnp.asarray(pad_only),
+        jnp.zeros((pods.num_pods,), bool))
+    assert_trees_equal(q_snap, padded, "pad-only quarantine (snap)")
+    assert_trees_equal(q_pods, pods, "pad-only quarantine (pods)")
+
+
+def test_quarantined_real_row_leaves_pads_inert_and_uncharged():
+    """Quarantine a real node on the padded snapshot, schedule, and pin
+    the full contract: the quarantined node and every pad row stay
+    unassigned and uncharged, and overcommit holds on the real rows."""
+    _, snap, pods, padded = make_padded(3)
+    n_pad = padded.num_nodes
+    node_bad = np.zeros((n_pad,), bool)
+    node_bad[2] = True
+    q_snap, q_pods = guards.apply_quarantine(
+        padded, pods, jnp.asarray(node_bad),
+        jnp.zeros((pods.num_pods,), bool))
+    assert_pad_rows_inert(q_snap, N_REAL)
+
+    res = core.schedule_batch(q_snap, q_pods, CFG, **SLIM)
+    a = np.asarray(res.assignment)
+    assert (a >= 0).any()            # the cluster still schedules
+    assert not (a == 2).any()        # never the quarantined node
+    assert a.max() < N_REAL          # never a pad row
+    assert core.overcommit_ok(res.snapshot, N_REAL)
+    assert not np.asarray(res.snapshot.nodes.requested)[N_REAL:].any()
+
+
+# --- the shrink ladder's pad -> unpad -> repad cycle ------------------------
+
+def test_unpad_roundtrip_is_bitwise_identity():
+    _, snap, _, padded = make_padded(4)
+    assert_trees_equal(unpad_nodes(padded, N_REAL), snap,
+                       "unpad(pad(snap)) round trip")
+    with pytest.raises(ValueError):
+        unpad_nodes(snap, N_REAL + 1)  # cannot unpad upward
+
+
+def test_mesh_shrink_repad_matches_oracle_and_stays_uncharged():
+    """The DegradationLadder flow at an indivisible count: pad to the
+    full mesh, unpad (commit shapes), repad to a 2-device survivor mesh
+    (13 -> 14), schedule — placement matches the unpadded oracle and
+    the new, smaller pad band is still inert."""
+    _, snap, pods, padded = make_padded(5)
+    mesh2 = make_mesh(jax.devices()[:2])
+    committed = unpad_nodes(padded, N_REAL)
+    repadded = pad_nodes_to_mesh(committed, mesh2)
+    assert repadded.num_nodes == padded_node_count(N_REAL, mesh2) == 14
+    assert_pad_rows_inert(repadded, N_REAL)
+
+    res1 = core.schedule_batch(snap, pods, CFG, **SLIM)
+    with mesh2:
+        res2 = core.schedule_batch(repadded, pods, CFG, **SLIM)
+    assert np.array_equal(np.asarray(res2.assignment),
+                          np.asarray(res1.assignment))
+    assert core.overcommit_ok(res2.snapshot, N_REAL)
+    assert not np.asarray(res2.snapshot.nodes.requested)[N_REAL:].any()
+
+
+def test_guarded_schedule_on_repadded_snapshot_quarantines_real_only():
+    """End to end through the fused guard kernel on a shrink-repadded
+    snapshot with one genuinely sick real node: the guard flags exactly
+    that node (never the pad band), and the committed result keeps the
+    pad rows uncharged."""
+    _, snap, pods, _ = make_padded(6)
+    mesh2 = make_mesh(jax.devices()[:2])
+    repadded = pad_nodes_to_mesh(snap, mesh2)
+    usage = np.asarray(repadded.nodes.usage).copy()
+    usage[1, 0] = np.nan  # a real node goes sick mid-cycle
+    sick = repadded.replace(nodes=repadded.nodes.replace(usage=usage))
+
+    with mesh2:
+        res, health, node_bad, pod_bad = guards.guarded_schedule_batch(
+            sick, pods, CFG, **SLIM)
+    word = int(np.asarray(health)[0])
+    assert word & guards.NODE_METRIC_NONFINITE, \
+        guards.decode_health_word(word)
+    node_bad = np.asarray(node_bad)
+    assert node_bad[1]
+    assert not node_bad[N_REAL:].any()  # the pad band never quarantines
+    assert not np.asarray(pod_bad).any()
+
+    a = np.asarray(res.assignment)
+    assert (a >= 0).any()
+    assert not (a == 1).any()
+    assert a.max() < N_REAL
+    assert core.overcommit_ok(res.snapshot, N_REAL)
+    assert not np.asarray(res.snapshot.nodes.requested)[N_REAL:].any()
+    # committing back through unpad drops the (still pristine) pad band
+    assert unpad_nodes(res.snapshot, N_REAL).num_nodes == N_REAL

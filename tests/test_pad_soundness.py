@@ -1,0 +1,285 @@
+"""koordpad pins (ISSUE 16): the pad-predicate grammar, the two-copy
+vocabulary lock between the linter and the runtime schema, the pad-fill
+algebra Tier A reasons with, the Tier B differential harness, the
+machine-readable lint output formats, and the repo-clean gates.
+
+The slow-marked tests at the bottom are the full Tier B gate and the
+dual-tier seeded-mutation smoke — the same ground tools/ci.sh runs as a
+dedicated stage.
+"""
+
+import json
+import math
+import os
+
+import numpy as np
+import pytest
+
+from koordinator_tpu.snapshot import schema
+from tools import padcheck
+from tools.lint import runner
+from tools.lint.framework import DEFAULT_EXCLUDES, Finding, cached_project
+from tools.lint.shapes import pads
+from tools.lint.shapes import spec as lspec
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# --- the two-copy vocabulary lock -------------------------------------------
+
+def test_pad_vocab_pinned_between_linter_and_schema():
+    """The linter ships its own copy of the pad vocabulary (it must not
+    import the runtime tree it analyzes); dict equality makes drift a
+    test failure instead of a silent analysis gap."""
+    assert dict(lspec.PAD_VOCAB) == dict(schema.PAD_VOCAB)
+    assert set(lspec.PADDED_DIMS) == set(schema.PADDED_DIMS)
+    assert set(lspec.PAD_FILLS) == set(lspec.PAD_VOCAB)
+    assert set(schema.PAD_FILL_VALUES) == set(schema.PAD_VOCAB)
+
+
+def test_pad_fills_agree_with_runtime_fill_values():
+    """Tier A's canonical fill and Tier B's concrete fill must describe
+    the same value for every predicate (or both abstain)."""
+    for pred in lspec.PAD_VOCAB:
+        canon = lspec.PAD_FILLS[pred]
+        concrete = schema.PAD_FILL_VALUES[pred]
+        if canon is None:
+            assert concrete is None, pred
+        else:
+            assert concrete is not None, pred
+            assert pads.FILL_VALUES[canon] == float(concrete), pred
+
+
+# --- the spec grammar -------------------------------------------------------
+
+def test_parse_spec_pad_grammar():
+    leaf = lspec.parse_spec("f32[P~pad:zero,R]")
+    assert leaf.dims == ("P", "R")
+    assert leaf.pads == ("zero", None)
+    assert leaf.pad_for(0) == "zero" and leaf.pad_for(1) is None
+    # pad-free specs keep the pre-koordpad () sentinel (LeafSpec
+    # literals in older tests stay equal)
+    bare = lspec.parse_spec("f32[P,R]")
+    assert bare.pads == ()
+    assert bare.pad_for(0) is None
+    assert lspec.parse_spec("bool[N~pad:false]").pads == ("false",)
+    assert lspec.parse_spec("i32[P~pad:-1]").pads == ("-1",)
+
+
+@pytest.mark.parametrize("raw", [
+    "f32[P~pad:seven]",       # predicate outside the vocabulary
+    "f32[P~fill:zero]",       # wrong annotation keyword
+    "f32[P~pad:]",            # empty predicate
+    "q7[P~pad:zero]",         # unknown dtype
+    "f32[WAT~pad:zero]",      # undeclared dim symbol
+])
+def test_parse_spec_rejects_malformed_pads(raw):
+    with pytest.raises(lspec.SpecError):
+        lspec.parse_spec(raw)
+
+
+# --- the pad-fill algebra (Tier A's reasoning core) -------------------------
+
+def test_canonical_and_fill_of_value():
+    assert pads.canonical("false") == "zero"
+    assert pads.canonical("unschedulable") == "zero"
+    assert pads.canonical("invalid") is None
+    assert pads.canonical(None) is None
+    assert pads.fill_of_value(0.0) == "zero"
+    assert pads.fill_of_value(-1) == "-1"
+    assert pads.fill_of_value(math.inf) == "inf"
+    assert pads.fill_of_value(2.0) is None       # outside the space
+    assert pads.fill_of_value(math.nan) is None
+    assert pads.fill_of_value("x") is None
+
+
+def test_combine_annihilators_beat_unknown_operands():
+    """x * 0 -> 0 and mask & False -> False even when the other side is
+    statically unknown — the rules that let zero-masking prove
+    inertness through arbitrary score pipelines."""
+    assert pads.combine("mult", None, ("lit", 0.0)) == "zero"
+    assert pads.combine("bitand", ("fill", 0.0), None) == "zero"
+    assert pads.combine("bitor", None, ("fill", 1.0)) == "one"
+    assert pads.combine("maximum", ("lit", math.inf), None) == "inf"
+    # no annihilator: unknown stays unknown (never-guess)
+    assert pads.combine("add", None, ("lit", 0.0)) is None
+    # both known: computed, but only canonical values survive
+    assert pads.combine("sub", ("fill", 1.0), ("lit", 1.0)) == "zero"
+    assert pads.combine("add", ("fill", 1.0), ("lit", 1.0)) is None
+    assert pads.combine("div", ("fill", 1.0), ("lit", 0.0)) is None
+
+
+def test_where_fill_branch_selection():
+    t, f = ("lit", 1.0), ("lit", 0.0)
+    assert pads.where_fill(("fill", 1.0), t, f) == "one"   # cond true
+    assert pads.where_fill(("fill", 0.0), t, f) == "zero"  # cond false
+    assert pads.where_fill(None, t, t) == "one"            # agree
+    assert pads.where_fill(None, t, f) is None             # disagree
+    assert pads.where_fill(None, t, None) is None
+
+
+def test_reduction_neutrality_table():
+    assert pads.reduction_neutral("sum", "zero") is True
+    assert pads.reduction_neutral("sum", "one") is False
+    assert pads.reduction_neutral("max", "-1") is True     # scores >= 0
+    assert pads.reduction_neutral("min", "inf") is True
+    assert pads.reduction_neutral("mean", "zero") is False # shifts mean
+    assert pads.reduction_neutral("sum", None) is None     # silent
+    assert pads.reduction_neutral("cumsum", "zero") is None
+
+
+def test_reduce_surviving_and_cast_fill():
+    assert pads.reduce_surviving("max", "-1") == "-1"
+    assert pads.reduce_surviving("sum", "zero") == "zero"
+    assert pads.reduce_surviving("sum", "one") is None     # extent symbolic
+    assert pads.reduce_surviving("all", "one") == "one"
+    assert pads.reduce_surviving("any", "zero") == "zero"
+    assert pads.reduce_surviving("argmax", "inf") == "zero"
+    assert pads.cast_fill("bool_", "-1") == "one"          # truthiness
+    assert pads.cast_fill("int32", "inf") is None          # UB cast
+    assert pads.cast_fill("uint32", "-1") is None          # wraps
+    assert pads.cast_fill("int32", "-1") == "-1"
+
+
+# --- repo-clean gates (doubles as PS004 totality over the registry) ---------
+
+def test_repo_is_pad_sound_with_empty_baseline():
+    new, suppressed = runner.run_lint(REPO_ROOT,
+                                      analyzers=["pad-soundness"])
+    assert new == [], [f.render() for f in new]
+    assert suppressed == []
+
+
+def test_repo_is_determinism_clean():
+    new, _ = runner.run_lint(REPO_ROOT, analyzers=["determinism"])
+    assert new == [], [f.render() for f in new]
+
+
+# --- the Tier B harness -----------------------------------------------------
+
+def _pair_for(raw, key="t"):
+    real = padcheck._sizes(padded=False)
+    padded = padcheck._sizes(padded=True)
+    rng = padcheck._rng(key, padcheck.BASE_SEED)
+    grng = padcheck._rng(key + "/garbage", padcheck.BASE_SEED)
+    leaf = lspec.parse_spec(raw)
+    a0, ax = padcheck.build_pair(leaf, real, padded, rng, grng,
+                                 index_cap=min(real.values()))
+    return leaf, real, a0, ax
+
+
+def test_build_pair_real_regions_identical_and_bands_filled():
+    leaf, real, a0, ax = _pair_for("f32[P~pad:one,R]")
+    p = real["P"]
+    assert ax.shape[0] > p                     # P actually pads
+    np.testing.assert_array_equal(ax[:p], a0)  # draw-for-draw identical
+    assert (ax[p:] == 1.0).all()               # declared fill
+
+
+def test_build_pair_garbage_band_for_any():
+    leaf, real, a0, ax = _pair_for("f32[P~pad:any]")
+    p = real["P"]
+    np.testing.assert_array_equal(ax[:p], a0)
+    # `any` bands are seeded garbage from the same draw range — NOT a
+    # fixed fill, so a kernel relying on their content fails loudly
+    assert (ax[p:] >= 0.5).all() and (ax[p:] <= 2.0).all()
+
+
+def test_compare_leaf_detects_real_region_leak():
+    leaf, real, a0, ax = _pair_for("f32[P~pad:zero,R]")
+    bad = ax.copy()
+    bad[0, 0] += 1.0                           # pad perturbed a real cell
+    errors = []
+    padcheck._compare_leaf(leaf, a0, bad, real, "t", errors)
+    assert len(errors) == 1 and "pad leak" in errors[0]
+
+
+def test_compare_leaf_detects_pad_band_drift():
+    leaf, real, a0, ax = _pair_for("f32[P~pad:zero,R]")
+    bad = ax.copy()
+    bad[real["P"]:, :] = 7.0                   # fill no longer held
+    errors = []
+    padcheck._compare_leaf(leaf, a0, bad, real, "t", errors)
+    assert len(errors) == 1 and "pad-band drift" in errors[0]
+    # clean pair: no errors at all
+    errors = []
+    padcheck._compare_leaf(leaf, a0, ax, real, "t", errors)
+    assert errors == []
+
+
+def test_statics_may_not_name_padded_dims():
+    """A static arg bakes its dim into the compiled program, so a
+    static that names a padded dim can't follow the pad — run_contract
+    refuses rather than silently checking the wrong shape."""
+    from koordinator_tpu.snapshot.schema import SHAPE_CONTRACTS
+    import importlib
+    for mod in padcheck.CONTRACT_MODULES:
+        importlib.import_module(mod)
+    for key, contract in SHAPE_CONTRACTS.items():
+        for name, value in contract.static.items():
+            if isinstance(value, str):
+                assert value not in lspec.PADDED_DIMS, (key, name)
+
+
+# --- machine-readable output formats ----------------------------------------
+
+_F = Finding(analyzer="pad-soundness", code="PS001",
+             path="koordinator_tpu/ops/x.py", line=12,
+             message="non-neutral sum over ~pad:one axis, 100%\nsure",
+             key="sum:x")
+
+
+def test_github_annotation_escaping():
+    assert runner._github_line(_F) == (
+        "::error file=koordinator_tpu/ops/x.py,line=12,"
+        "title=PS001 [pad-soundness]"
+        "::non-neutral sum over ~pad:one axis, 100%25%0Asure")
+    # property values additionally escape , and : (free text doesn't)
+    assert runner._github_escape("a,b:c\n", properties=True) == \
+        "a%2Cb%3Ac%0A"
+    assert runner._github_escape("a,b:c\r\n%") == "a,b:c%0D%0A%25"
+
+
+def test_sarif_document_shape_and_suppressions():
+    doc = runner._sarif_doc([_F], [_F])
+    assert doc["version"] == "2.1.0"
+    run = doc["runs"][0]
+    assert run["tool"]["driver"]["name"] == "koordlint"
+    assert [r["id"] for r in run["tool"]["driver"]["rules"]] == ["PS001"]
+    new, suppressed = run["results"]
+    assert "suppressions" not in new
+    assert suppressed["suppressions"][0]["justification"] == "baseline"
+    for r in (new, suppressed):
+        assert r["partialFingerprints"]["koordlint/v1"] == _F.fingerprint
+        assert r["locations"][0]["physicalLocation"][
+            "region"]["startLine"] == 12
+    json.dumps(doc)                            # serializable end to end
+
+
+# --- the per-process Project cache ------------------------------------------
+
+def test_cached_project_reuses_then_invalidates(tmp_path):
+    (tmp_path / "a.py").write_text("x = 1\n")
+    p1 = cached_project(str(tmp_path), excludes=DEFAULT_EXCLUDES)
+    p2 = cached_project(str(tmp_path), excludes=DEFAULT_EXCLUDES)
+    assert p1 is p2                            # unchanged tree: one parse
+    (tmp_path / "a.py").write_text("x = 2\n")
+    os.utime(tmp_path / "a.py", ns=(1, 1))    # force a visible stat delta
+    p3 = cached_project(str(tmp_path), excludes=DEFAULT_EXCLUDES)
+    assert p3 is not p1
+    assert p3.modules[0].source == "x = 2\n"
+
+
+# --- the full Tier B gate + the dual-tier mutation smoke (slow) -------------
+
+@pytest.mark.slow
+def test_padcheck_full_gate_green():
+    assert padcheck.run_all() == 0
+
+
+@pytest.mark.slow
+def test_dual_tier_mutation_smoke():
+    """Both koordpad tiers prove themselves live: a planted pad leak is
+    caught by the differential gate, a planted clamp-drop by the static
+    pass."""
+    assert padcheck.self_test_mutation() == 0

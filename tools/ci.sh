@@ -5,13 +5,18 @@
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
+# under GitHub Actions, findings come out as ::error workflow commands
+# so the runner turns them into inline PR annotations at the flagged
+# line; on a desk the human text format stays
+LINT_FORMAT=${GITHUB_ACTIONS:+--format github}
+
 echo "=== koordlint (python -m tools.lint) ==="
-python -m tools.lint
+python -m tools.lint ${LINT_FORMAT}
 
 echo "=== koordlint self-lint (--root tools) ==="
 # the analyzers obey their own rules: the tools tree is linted as a
 # standalone root (same empty-baseline bar as the repo scan)
-python -m tools.lint --root tools
+python -m tools.lint --root tools ${LINT_FORMAT}
 
 echo "=== koordshape Tier B (device-free eval_shape gate) ==="
 JAX_PLATFORMS=cpu python tools/shapecheck.py
@@ -21,6 +26,19 @@ echo "=== koordshape mutation smoke (gate liveness) ==="
 # gate fails on it — a shapecheck that can't catch the seeded mutation
 # is a green-but-dead gate
 JAX_PLATFORMS=cpu python tools/shapecheck.py --self-test-mutation
+
+echo "=== koordpad Tier B (differential pad-inertness gate) ==="
+# every contract runs concretely twice (zero-pad vs declared-fill pads):
+# real regions must be bit-identical, output pad bands must hold their
+# declared fills (tools/padcheck.py)
+JAX_PLATFORMS=cpu python tools/padcheck.py
+
+echo "=== koordpad dual-tier mutation smoke (gate liveness) ==="
+# one seeded pad leak per tier in a TEMP COPY: a dropped schedulable
+# conjunction only the differential run can see (padcheck must FAIL),
+# and a dropped -1-index clamp only the static pass can see (the
+# pad-soundness lint must flag PS002)
+JAX_PLATFORMS=cpu python tools/padcheck.py --self-test-mutation
 
 echo "=== full-gate cascade smoke (2k pods x 200 nodes, CPU) ==="
 # correctness + straggler-count assertions, not wall-clock: cascade

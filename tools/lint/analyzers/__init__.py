@@ -2,10 +2,12 @@
 analyzer into the framework registry."""
 
 from tools.lint.analyzers import (  # noqa: F401
+    determinism,
     donation,
     host_sync,
     lock_discipline,
     metric_names,
+    pad_soundness,
     proto_drift,
     recompile,
     robustness,

@@ -1,0 +1,253 @@
+"""determinism: unordered-collection iteration must not materialize
+into ordered artifacts.
+
+Set iteration order depends on PYTHONHASHSEED for str/bytes elements
+and on insertion/collision history for everything else — two processes
+holding the SAME logical set can walk it in different orders. In this
+tree that is not a style nit: the host side columnarizes cluster state
+into dense arrays, journals commits, and digests snapshots for the
+crash-replay path. A node table built by iterating a set lays out
+DIFFERENT row indices per process, so replicas disagree on every array
+that indexes by row, replay produces a different schedule than the
+original run, and snapshot digests stop matching across restarts.
+
+ND001 fires when a set-valued expression (literal, comprehension,
+set()/frozenset() call, set-algebra of those, or a local/module name
+bound only to such) reaches an ORDER-SENSITIVE sink:
+
+  - list()/tuple()/enumerate() materialization
+  - a list comprehension over it
+  - np/jnp array construction (array/asarray/fromiter/stack/
+    concatenate) or str.join
+  - a `for` loop whose body appends/extends/writes/update()s —
+    accumulation into an ordered artifact or a hash digest
+
+`sorted(s)` is the fix, and needs no special pragma: sorted() returns
+a list, so its result is simply not set-typed and no sink fires.
+Order-insensitive consumption (membership, len, min/max, any/all,
+set algebra) is untouched.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set
+
+from tools.lint.framework import Analyzer, Finding, Project, register
+
+_SET_CTORS = {"set", "frozenset"}
+_SET_ANNOTATIONS = {"set", "frozenset", "Set", "FrozenSet", "AbstractSet",
+                    "MutableSet"}
+# binary set algebra keeps set-ness when either side is a set
+_SET_BINOPS = (ast.BitOr, ast.BitAnd, ast.Sub, ast.BitXor)
+# set methods returning sets
+_SET_RETURNING_METHODS = {"union", "intersection", "difference",
+                          "symmetric_difference", "copy"}
+
+_MATERIALIZERS = {"list", "tuple", "enumerate"}
+_ARRAY_CTORS = {"array", "asarray", "fromiter", "stack", "concatenate"}
+# loop-body calls that accumulate into an ordered artifact / digest
+_ACCUMULATORS = {"append", "extend", "write", "update", "writerow"}
+
+
+def _ann_is_set(ann: Optional[ast.expr]) -> bool:
+    if ann is None:
+        return False
+    node = ann
+    if isinstance(node, ast.Subscript):      # Set[str], frozenset[int]
+        node = node.value
+    name = node.attr if isinstance(node, ast.Attribute) else \
+        node.id if isinstance(node, ast.Name) else None
+    return name in _SET_ANNOTATIONS
+
+
+class _SetTyper:
+    """Flow-insensitive local inference: a name is set-typed iff EVERY
+    binding of it in the scope is a set-valued expression (a single
+    non-set rebinding clears it — never guess)."""
+
+    def __init__(self, outer: Optional["_SetTyper"] = None):
+        self.outer = outer
+        self.is_set: Dict[str, bool] = {}
+
+    def bind(self, name: str, value_is_set: bool) -> None:
+        prev = self.is_set.get(name)
+        self.is_set[name] = value_is_set if prev is None \
+            else (prev and value_is_set)
+
+    def query(self, name: str) -> bool:
+        if name in self.is_set:
+            return self.is_set[name]
+        return self.outer.query(name) if self.outer else False
+
+    def expr_is_set(self, node: ast.expr) -> bool:
+        if isinstance(node, (ast.Set, ast.SetComp)):
+            return True
+        if isinstance(node, ast.Name):
+            return self.query(node.id)
+        if isinstance(node, ast.Call):
+            fn = node.func
+            if isinstance(fn, ast.Name) and fn.id in _SET_CTORS:
+                return True
+            if isinstance(fn, ast.Attribute) \
+                    and fn.attr in _SET_RETURNING_METHODS:
+                return self.expr_is_set(fn.value)
+            return False
+        if isinstance(node, ast.BinOp) \
+                and isinstance(node.op, _SET_BINOPS):
+            return self.expr_is_set(node.left) \
+                or self.expr_is_set(node.right)
+        if isinstance(node, ast.IfExp):
+            return self.expr_is_set(node.body) \
+                and self.expr_is_set(node.orelse)
+        return False
+
+
+def _scope_nodes(scope_body: List[ast.stmt]) -> Iterable[ast.AST]:
+    """Source-order walk of a scope's own statements, descending into
+    control flow but NOT into nested def/class/lambda scopes."""
+    queue: List[ast.AST] = list(scope_body)
+    while queue:
+        node = queue.pop(0)
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef, ast.Lambda)):
+            continue
+        yield node
+        queue.extend(ast.iter_child_nodes(node))
+
+
+def _collect_bindings(scope_body: List[ast.stmt],
+                      typer: _SetTyper) -> None:
+    """One pass over a scope's own statements recording name bindings
+    (a name bound only to set expressions is set-typed)."""
+    for node in _scope_nodes(scope_body):
+        if isinstance(node, ast.Assign):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name):
+                    typer.bind(tgt.id, typer.expr_is_set(node.value))
+        elif isinstance(node, ast.AnnAssign) \
+                and isinstance(node.target, ast.Name):
+            is_set = _ann_is_set(node.annotation) or (
+                node.value is not None
+                and typer.expr_is_set(node.value))
+            typer.bind(node.target.id, is_set)
+        elif isinstance(node, ast.AugAssign) \
+                and isinstance(node.target, ast.Name):
+            if not isinstance(node.op, _SET_BINOPS):
+                typer.bind(node.target.id, False)
+        elif isinstance(node, (ast.For, ast.AsyncFor)):
+            if isinstance(node.target, ast.Name):
+                typer.bind(node.target.id, False)
+
+
+def _loop_accumulates(body: List[ast.stmt]) -> Optional[str]:
+    """The accumulator method name when a loop body feeds an ordered
+    artifact (list.append, digest.update, file.write, ...)."""
+    for stmt in body:
+        for node in ast.walk(stmt):
+            if isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr in _ACCUMULATORS:
+                return node.func.attr
+    return None
+
+
+def _describe(node: ast.expr) -> str:
+    if isinstance(node, ast.Name):
+        return f"`{node.id}`"
+    if isinstance(node, (ast.Set, ast.SetComp)):
+        return "a set literal"
+    if isinstance(node, ast.Call) and isinstance(node.func, ast.Name):
+        return f"a {node.func.id}() value"
+    return "a set-valued expression"
+
+
+@register
+class DeterminismAnalyzer(Analyzer):
+    name = "determinism"
+    description = ("set iteration materialized into ordered artifacts "
+                   "(arrays, lists, digests) — hash-seed-dependent "
+                   "order breaks replay and cross-process agreement; "
+                   "iterate sorted(...) instead")
+
+    def run(self, project: Project) -> Iterable[Finding]:
+        findings: List[Finding] = []
+        for mod in project.modules:
+            if not mod.relpath.startswith("koordinator_tpu/"):
+                continue
+            module_typer = _SetTyper()
+            _collect_bindings(mod.tree.body, module_typer)
+            self._scan_scope(mod.tree.body, module_typer, mod.relpath,
+                             findings)
+        return sorted(findings, key=lambda f: (f.path, f.line, f.code))
+
+    def _scan_scope(self, body: List[ast.stmt], typer: _SetTyper,
+                    relpath: str, findings: List[Finding]) -> None:
+        for stmt in body:
+            self._scan_node(stmt, typer, relpath, findings)
+
+    def _scan_node(self, node: ast.AST, typer: _SetTyper, relpath: str,
+                   findings: List[Finding]) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            inner = _SetTyper(outer=typer)
+            for arg in (node.args.posonlyargs + node.args.args
+                        + node.args.kwonlyargs):
+                inner.bind(arg.arg, _ann_is_set(arg.annotation))
+            _collect_bindings(node.body, inner)
+            self._scan_scope(node.body, inner, relpath, findings)
+            return
+        if isinstance(node, ast.ClassDef):
+            inner = _SetTyper(outer=typer)
+            _collect_bindings(node.body, inner)
+            self._scan_scope(node.body, inner, relpath, findings)
+            return
+
+        if isinstance(node, ast.Call):
+            self._check_call(node, typer, relpath, findings)
+        elif isinstance(node, ast.ListComp):
+            for gen in node.generators:
+                if typer.expr_is_set(gen.iter):
+                    self._emit(findings, relpath, node.lineno, gen.iter,
+                               "a list comprehension")
+        elif isinstance(node, (ast.For, ast.AsyncFor)) \
+                and typer.expr_is_set(node.iter):
+            acc = _loop_accumulates(node.body)
+            if acc is not None:
+                self._emit(findings, relpath, node.lineno, node.iter,
+                           f"a loop accumulating via .{acc}()")
+
+        for child in ast.iter_child_nodes(node):
+            self._scan_node(child, typer, relpath, findings)
+
+    def _check_call(self, node: ast.Call, typer: _SetTyper,
+                    relpath: str, findings: List[Finding]) -> None:
+        fn = node.func
+        args = [a for a in node.args
+                if not isinstance(a, ast.Starred)]
+        if isinstance(fn, ast.Name) and fn.id in _MATERIALIZERS \
+                and args and typer.expr_is_set(args[0]):
+            self._emit(findings, relpath, node.lineno, args[0],
+                       f"{fn.id}()")
+        elif isinstance(fn, ast.Attribute):
+            if fn.attr in _ARRAY_CTORS \
+                    and args and typer.expr_is_set(args[0]):
+                self._emit(findings, relpath, node.lineno, args[0],
+                           f".{fn.attr}() array construction")
+            elif fn.attr == "join" and args \
+                    and typer.expr_is_set(args[0]):
+                self._emit(findings, relpath, node.lineno, args[0],
+                           "str.join()")
+
+    def _emit(self, findings: List[Finding], relpath: str, line: int,
+              src: ast.expr, sink: str) -> None:
+        what = _describe(src)
+        name = src.id if isinstance(src, ast.Name) else "<expr>"
+        findings.append(Finding(
+            analyzer=self.name, code="ND001", path=relpath, line=line,
+            message=f"{what} is materialized through {sink} — set "
+                    f"order is hash-seed/insertion dependent, so the "
+                    f"produced ordering differs across processes "
+                    f"(breaks columnar layout, replay, and digests); "
+                    f"iterate sorted({name if name != '<expr>' else '...'}) "
+                    f"instead",
+            key=f"{sink}:{name}"))

@@ -1,0 +1,205 @@
+"""pad-soundness: the koordpad static tier — mask-provenance dataflow
+over contracted kernels, stdlib-only.
+
+Every padded capacity axis (spec.PADDED_DIMS) declares what its pad
+region contains via `~pad:<predicate>` in the koordshape grammar. This
+pass re-runs the symbolic shape interpreter (tools/lint/shapes/
+abstract.py) with per-axis pad-fill tracking (tools/lint/shapes/
+pads.py): parameter fills come from the declarations, flow through
+recognized jnp ops (annihilators like `& False` / `* 0` survive
+broadcasting; equal known fills combine exactly; everything else joins
+to unknown and stays silent — never-guess), and three dataflow checks
+plus two registry checks fire on proven violations only. The dynamic
+twin — tools/padcheck.py — runs every contract concretely under two
+paddings and asserts bit-identical real rows; this pass is the half
+that needs no jax at all.
+
+Codes:
+  PS001  non-neutral reduction: sum/any/max/argmax/top_k/... over a
+         padded axis whose pad fill would perturb the real rows'
+         result (e.g. mean over zero-padded rows, sum over -1
+         sentinels) — mask the pads first
+  PS002  sentinel gather: indexing (take / take_along_axis / advanced
+         indexing / .at updates) by an array whose padded axis carries
+         the -1 'none' sentinel without clamping — jax wraps negative
+         indices, so pad rows silently hit the last real row
+  PS003  pad-contract drift: an argument passed to another contracted
+         kernel, or a return value, whose derived pad fill contradicts
+         the declared predicate (known-vs-known only)
+  PS004  pad totality: a PADDED_DIMS axis in a registered struct field
+         or contract spec with no ~pad: predicate — declare what the
+         pad region holds so both koordpad tiers can police it
+  PS005  malformed pad: a predicate on a non-padded/exempt dim or an
+         int-literal dim, or a fill the declared dtype cannot carry
+         (inf on i32/bool, false on non-bool, -1 on u32/bool)
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, Iterator, List, Optional, Tuple
+
+from tools.lint.callgraph import ModuleIndex, ProjectIndex, project_index
+from tools.lint.framework import Analyzer, Finding, Project, register
+from tools.lint.analyzers.shape_contract import _ConstTable
+from tools.lint.shapes.abstract import ShapeInterp
+from tools.lint.shapes.contracts import (
+    AstContract,
+    ContractIndex,
+    extract_contracts,
+)
+from tools.lint.shapes.spec import LeafSpec, PADDED_DIMS, Spec
+
+_DEFECT_CODE = {"pad_reduce": "PS001", "pad_gather": "PS002",
+                "pad_cross": "PS003"}
+
+# predicates whose fill only some dtypes can carry; everything absent
+# here (zero/one/unschedulable/invalid/any) is dtype-agnostic
+_FILL_DTYPES = {
+    "inf": {"f32"},
+    "false": {"bool"},
+    "-1": {"f32", "i32", "i8"},
+}
+
+
+def _leaves(spec: Optional[Spec]) -> Iterator[Tuple[int, LeafSpec]]:
+    """Every LeafSpec in a spec tree, with a stable position index."""
+    def walk(s, pos):
+        if isinstance(s, LeafSpec):
+            yield pos[0], s
+            pos[0] += 1
+        elif isinstance(s, tuple):
+            for item in s:
+                yield from walk(item, pos)
+    if spec is not None:
+        yield from walk(spec, [0])
+
+
+@register
+class PadSoundnessAnalyzer(Analyzer):
+    name = "pad-soundness"
+    description = ("koordpad static tier: pad/mask provenance dataflow "
+                   "over contracted kernels, pad-predicate totality "
+                   "and well-formedness (PS001-PS005)")
+
+    def run(self, project: Project) -> Iterable[Finding]:
+        pidx = project_index(project)
+        cindex = extract_contracts(project)
+        consts = _ConstTable(project, pidx)
+        findings: List[Finding] = []
+
+        findings.extend(self._registry_checks(cindex))
+
+        for (rel, _), contract in sorted(cindex.contracts.items()):
+            mi = pidx.modules.get(rel)
+            if mi is None:
+                continue
+            findings.extend(self._interpret(pidx, mi, cindex, consts,
+                                            contract))
+        return sorted(findings, key=lambda f: (f.path, f.line, f.code))
+
+    # --- PS004 / PS005: the declarations themselves ----------------------
+
+    def _registry_checks(self, cindex: ContractIndex
+                         ) -> Iterator[Finding]:
+        for sname in sorted(cindex.structs):
+            rel, line = cindex.struct_sites[sname]
+            for fname, spec in sorted(cindex.structs[sname].items()):
+                for i, leaf in _leaves(spec):
+                    yield from self._leaf_checks(
+                        f"{sname}.{fname}", i, leaf, rel, line)
+        for (rel, _), c in sorted(cindex.contracts.items()):
+            for aname, spec in sorted(c.args.items()):
+                for i, leaf in _leaves(spec):
+                    yield from self._leaf_checks(
+                        f"{c.name}({aname})", i, leaf, rel, c.line)
+            for i, leaf in _leaves(c.returns):
+                yield from self._leaf_checks(
+                    f"{c.name} returns", i, leaf, rel, c.line)
+
+    def _leaf_checks(self, owner: str, leaf_idx: int, leaf: LeafSpec,
+                     rel: str, line: int) -> Iterator[Finding]:
+        for ax, dim in enumerate(leaf.dims):
+            pred = leaf.pad_for(ax)
+            keybase = f"{owner}:{leaf_idx}:{ax}"
+            if pred is None:
+                if isinstance(dim, str) and dim in PADDED_DIMS:
+                    yield Finding(
+                        analyzer=self.name, code="PS004", path=rel,
+                        line=line,
+                        message=f"{owner}: padded dim `{dim}` carries "
+                                f"no ~pad: predicate — declare what "
+                                f"its pad region holds (PAD_VOCAB) so "
+                                f"both koordpad tiers can police it",
+                        key=f"{keybase}:missing-pad")
+                continue
+            if isinstance(dim, int):
+                yield Finding(
+                    analyzer=self.name, code="PS005", path=rel,
+                    line=line,
+                    message=f"{owner}: pad predicate `{pred}` on the "
+                            f"int-literal dim {dim} — literal extents "
+                            f"are exact, never padded",
+                    key=f"{keybase}:literal-pad")
+            elif dim not in PADDED_DIMS:
+                yield Finding(
+                    analyzer=self.name, code="PS005", path=rel,
+                    line=line,
+                    message=f"{owner}: pad predicate `{pred}` on "
+                            f"`{dim}`, which is not a padded capacity "
+                            f"(spec.PADDED_DIMS) — exempt dims are "
+                            f"sized exactly",
+                    key=f"{keybase}:exempt-pad")
+            allowed = _FILL_DTYPES.get(pred)
+            if allowed is not None and leaf.dtype not in allowed:
+                yield Finding(
+                    analyzer=self.name, code="PS005", path=rel,
+                    line=line,
+                    message=f"{owner}: pad predicate `{pred}` is "
+                            f"unrepresentable in dtype "
+                            f"`{leaf.dtype}` (allowed: "
+                            f"{sorted(allowed)})",
+                    key=f"{keybase}:dtype-pad")
+
+    # --- PS001-PS003: the dataflow per contract --------------------------
+
+    def _interpret(self, pidx: ProjectIndex, mi: ModuleIndex,
+                   cindex: ContractIndex, consts: _ConstTable,
+                   contract: AstContract) -> Iterable[Finding]:
+        info = None
+        for fi in mi.functions:
+            if fi.node is contract.fn_node:
+                info = fi
+                break
+        if info is None:
+            return []
+        scope = info.scope_chain + (info.node,)
+
+        def resolve_contract(call: ast.Call) -> Optional[AstContract]:
+            target = pidx.resolve_call(mi, scope, call)
+            if target is None:
+                return None
+            c = cindex.contract_for(target.module.relpath,
+                                    target.node.name)
+            if c is contract:
+                return None
+            return c
+
+        interp = ShapeInterp(
+            contract,
+            resolve_dotted=mi.resolve_dotted,
+            resolve_const=consts.resolve,
+            resolve_contract=resolve_contract,
+            struct_field=lambda s, f: cindex.structs.get(s, {}).get(f),
+            track_pads=True,
+        )
+        out: List[Finding] = []
+        for d in interp.run():
+            code = _DEFECT_CODE.get(d.kind)
+            if code is None:
+                continue      # shape defects belong to shape-contract
+            out.append(Finding(
+                analyzer=self.name, code=code,
+                path=contract.relpath, line=d.line,
+                message=f"`{contract.name}`: {d.detail}", key=d.key))
+        return out

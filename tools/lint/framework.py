@@ -149,6 +149,59 @@ def _excluded(rel: str, norm_excludes: Sequence[str]) -> bool:
     return False
 
 
+def _tree_signature(root: str,
+                    norm_excludes: Sequence[str]) -> Tuple[tuple, ...]:
+    """(relpath, mtime_ns, size) for every analyzable file under root —
+    a stat-only walk, no reads, no parses."""
+    sig: List[tuple] = []
+    for dirpath, dirnames, filenames in os.walk(root):
+        rel_dir = os.path.relpath(dirpath, root)
+        rel_dir = "" if rel_dir == "." else rel_dir
+        dirnames[:] = sorted(
+            d for d in dirnames
+            if not _excluded(os.path.join(rel_dir, d), norm_excludes))
+        for fn in sorted(filenames):
+            if not fn.endswith((".py", ".proto")):
+                continue
+            rel = os.path.join(rel_dir, fn) if rel_dir else fn
+            if _excluded(rel, norm_excludes):
+                continue
+            try:
+                st = os.stat(os.path.join(dirpath, fn))
+            except OSError:
+                sig.append((rel, -1, -1))
+                continue
+            sig.append((rel, st.st_mtime_ns, st.st_size))
+    return tuple(sig)
+
+
+# (abs root, excludes) -> (tree signature, parsed Project). One entry
+# per root a process analyzes; a Project is a few MB of ASTs, so this
+# is bounded by the handful of roots tests exercise.
+_PROJECT_CACHE: Dict[Tuple[str, Tuple[str, ...]],
+                     Tuple[Tuple[tuple, ...], "Project"]] = {}
+
+
+def cached_project(root: str,
+                   excludes: Sequence[str] = DEFAULT_EXCLUDES
+                   ) -> "Project":
+    """A Project for `root`, reusing this process's parsed tree when no
+    analyzable file was added, removed, resized, or touched since the
+    last call (per-file mtime_ns + size). Editing a file between runs —
+    as the fingerprint-drift tests do — always yields a fresh parse;
+    repeat runs over an unchanged tree skip the os.walk + ast.parse
+    cost entirely."""
+    key = (os.path.abspath(root), tuple(excludes))
+    norm_excludes = tuple(e.replace("/", os.sep) for e in excludes)
+    sig = _tree_signature(key[0], norm_excludes)
+    hit = _PROJECT_CACHE.get(key)
+    if hit is not None and hit[0] == sig:
+        return hit[1]
+    project = Project(root, excludes)
+    _PROJECT_CACHE[key] = (sig, project)
+    return project
+
+
 class Analyzer:
     """Base class for lint passes. Subclasses set `name`/`description`
     and implement `run(project)` yielding Findings; `register` adds them

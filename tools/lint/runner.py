@@ -12,11 +12,14 @@ import tokenize
 import weakref
 from typing import List, Optional, Sequence, Tuple
 
+import json
+
 from tools.lint.framework import (
     Baseline,
     Finding,
     Project,
     all_analyzers,
+    cached_project,
 )
 
 REPO_ROOT = os.path.dirname(os.path.dirname(
@@ -115,7 +118,9 @@ def run_lint(root: str = REPO_ROOT,
         selected = {name: registry[name] for name in analyzers}
     else:
         selected = registry
-    project = Project(root)
+    # per-process cache: repeat runs (tests invoke run_lint dozens of
+    # times) skip the walk+parse when no file's stat signature moved
+    project = cached_project(root)
     findings: List[Finding] = list(project.parse_errors)
     for name in sorted(selected):
         findings.extend(selected[name].run(project))
@@ -123,6 +128,74 @@ def run_lint(root: str = REPO_ROOT,
     findings.sort(key=lambda f: (f.path, f.line, f.code))
     baseline = Baseline.load(baseline_path or DEFAULT_BASELINE)
     return baseline.split(findings)
+
+
+def _github_escape(s: str, properties: bool = False) -> str:
+    """Workflow-command escaping: %/\\r/\\n always; , and : inside
+    property values (file=..., title=...)."""
+    s = s.replace("%", "%25").replace("\r", "%0D").replace("\n", "%0A")
+    if properties:
+        s = s.replace(",", "%2C").replace(":", "%3A")
+    return s
+
+
+def _github_line(f: Finding) -> str:
+    """One `::error` workflow command per finding — the Actions runner
+    turns these into inline PR annotations at the flagged line."""
+    return (f"::error file={_github_escape(f.path, properties=True)},"
+            f"line={f.line},"
+            f"title={_github_escape(f.code + ' [' + f.analyzer + ']', properties=True)}"
+            f"::{_github_escape(f.message)}")
+
+
+def _sarif_doc(new: Sequence[Finding],
+               suppressed: Sequence[Finding]) -> dict:
+    """SARIF 2.1.0 for code-scanning upload. Baseline-suppressed
+    findings ride along with a suppression record so dashboards show
+    frozen debt without failing the gate."""
+    registry = all_analyzers()
+    rules: dict = {}
+    results = []
+    for f, is_suppressed in [(f, False) for f in new] + \
+                            [(f, True) for f in suppressed]:
+        an = registry.get(f.analyzer)
+        rules.setdefault(f.code, {
+            "id": f.code,
+            "name": f.analyzer,
+            "shortDescription": {
+                "text": an.description if an else f.analyzer},
+        })
+        result = {
+            "ruleId": f.code,
+            "level": "error",
+            "message": {"text": f.message},
+            "locations": [{
+                "physicalLocation": {
+                    "artifactLocation": {"uri": f.path},
+                    "region": {"startLine": max(f.line, 1)},
+                },
+            }],
+            "partialFingerprints": {"koordlint/v1": f.fingerprint},
+        }
+        if is_suppressed:
+            result["suppressions"] = [{"kind": "external",
+                                       "justification": "baseline"}]
+        results.append(result)
+    return {
+        "$schema": "https://raw.githubusercontent.com/oasis-tcs/"
+                   "sarif-spec/master/Schemata/sarif-schema-2.1.0.json",
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {"driver": {
+                "name": "koordlint",
+                "informationUri":
+                    "https://github.com/koordinator-sh/koordinator",
+                "rules": sorted(rules.values(),
+                                key=lambda r: r["id"]),
+            }},
+            "results": results,
+        }],
+    }
 
 
 def main(argv: Optional[Sequence[str]] = None) -> int:
@@ -144,6 +217,11 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     parser.add_argument("--stamp-protos", action="store_true",
                         help="write/refresh proto content stamps into "
                              "the *_pb2.py files, then exit")
+    parser.add_argument("--format", default="text",
+                        choices=("text", "sarif", "github"),
+                        help="finding output: human text (default), a "
+                             "SARIF 2.1.0 document on stdout, or "
+                             "GitHub Actions ::error annotations")
     parser.add_argument("-q", "--quiet", action="store_true",
                         help="suppress the per-finding listing")
     args = parser.parse_args(argv)
@@ -170,9 +248,13 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
               f"into {args.baseline}")
         return 0
 
+    if args.format == "sarif":
+        print(json.dumps(_sarif_doc(new, suppressed), indent=2))
+        return 1 if new else 0
     if not args.quiet:
         for f in new:
-            print(f.render())
+            print(_github_line(f) if args.format == "github"
+                  else f.render())
     tally = f"koordlint: {len(new)} finding(s)"
     if suppressed:
         tally += f", {len(suppressed)} suppressed by baseline"

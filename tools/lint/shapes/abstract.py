@@ -16,6 +16,19 @@ Defects surfaced (the analyzer assigns the SH codes):
     disagreeing with the callee's declared spec, or a return value
     disagreeing with the function's own declared returns (SH003 /
     SH001 respectively)
+
+With `track_pads=True` (the pad-soundness analyzer; the shape analyzer
+leaves it off and is bit-identical to before), every ArrVal also
+carries per-axis CANONICAL PAD FILLS and the interpreter applies the
+algebra in tools/lint/shapes/pads.py, surfacing three more kinds:
+  - pad_reduce: a reduction over a padded axis whose declared/derived
+    fill is not neutral for that reduction (PS001)
+  - pad_gather: indexing by an array whose padded axis carries the -1
+    sentinel without clamping — negative indices wrap in jax, so pad
+    rows silently read (or scatter into!) the last real row (PS002)
+  - pad_cross: a kernel-boundary pad disagreement — an argument or
+    return whose derived fill contradicts the declared predicate
+    (PS003); only known-vs-known disagreements count
 """
 
 from __future__ import annotations
@@ -25,6 +38,7 @@ from dataclasses import dataclass
 from typing import Callable, Dict, List, Optional, Tuple
 
 from tools.lint.astutil import dotted_name
+from tools.lint.shapes import pads as padalg
 from tools.lint.shapes.contracts import AstContract
 from tools.lint.shapes.spec import (
     DimProp,
@@ -49,6 +63,20 @@ UNKNOWN = Val()
 @dataclass(frozen=True)
 class ArrVal(Val):
     dims: SymShape            # entries: symbol | int | None
+    # canonical pad fill per axis (pads.FILL_VALUES key or None),
+    # parallel to dims; () when nothing is known, so pad-free values
+    # stay equal to plain ArrVal literals. Only populated under
+    # track_pads.
+    pads: Tuple[Optional[str], ...] = ()
+
+
+def _pad_at(v: ArrVal, i: int) -> Optional[str]:
+    return v.pads[i] if i < len(v.pads) else None
+
+
+def _norm_pads(pads) -> Tuple[Optional[str], ...]:
+    t = tuple(pads)
+    return t if any(p is not None for p in t) else ()
 
 
 @dataclass(frozen=True)
@@ -66,6 +94,15 @@ class IntVal(Val):
 @dataclass(frozen=True)
 class ScalarVal(Val):
     """A scalar of unknown value (loop indices, int() casts, inf)."""
+
+
+@dataclass(frozen=True)
+class FloatVal(ScalarVal):
+    """A float literal of statically known value — pad-fill algebra
+    needs 0.0 / -1.0 / inf branches of jnp.where etc. Scalar in every
+    shape rule (isinstance ScalarVal)."""
+
+    value: float = 0.0
 
 
 @dataclass(frozen=True)
@@ -142,14 +179,125 @@ class ShapeInterp:
                  resolve_const: Callable[[str], Optional[float]],
                  resolve_contract: Callable[[ast.Call],
                                             Optional[AstContract]],
-                 struct_field: Callable[[str, str], Optional[Spec]]):
+                 struct_field: Callable[[str, str], Optional[Spec]],
+                 track_pads: bool = False):
         self.contract = contract
         self.resolve_dotted = resolve_dotted
         self.resolve_const = resolve_const
         self.resolve_contract = resolve_contract
         self.struct_field = struct_field
+        self.track_pads = track_pads
         self.defects: List[Defect] = []
         self._keys_seen: Dict[str, int] = {}
+
+    # --- pad bookkeeping -------------------------------------------------
+
+    def _arr(self, dims, pads=()) -> ArrVal:
+        if not self.track_pads or not pads:
+            return ArrVal(tuple(dims))
+        return ArrVal(tuple(dims), _norm_pads(pads))
+
+    def _contrib(self, v: Val, out_rank: int,
+                 axis: int) -> padalg.Contrib:
+        """Operand v's pad contribution at output axis `axis` in the
+        trailing-aligned out_rank frame (pads.py Contrib)."""
+        if isinstance(v, IntVal) and isinstance(v.dim, int):
+            return ("lit", float(v.dim))
+        if isinstance(v, FloatVal):
+            return ("lit", v.value)
+        if isinstance(v, ArrVal):
+            j = axis - (out_rank - len(v.dims))
+            if j < 0 or v.dims[j] == 1:
+                return None           # broadcast: real values repeat
+            f = _pad_at(v, j)
+            return ("fill", padalg.FILL_VALUES[f]) if f else None
+        return None
+
+    def _ew_pads(self, op: str, operands: List[Val],
+                 out_rank: int) -> tuple:
+        """Per-axis result fills of an elementwise op over `operands`
+        (in call order — sub/div/where are order-sensitive)."""
+        if not self.track_pads or out_rank == 0:
+            return ()
+        out: List[Optional[str]] = []
+        for ax in range(out_rank):
+            cs = [self._contrib(v, out_rank, ax) for v in operands]
+            if op == "where" and len(cs) == 3:
+                out.append(padalg.where_fill(cs[0], cs[1], cs[2]))
+            elif len(cs) == 1:
+                out.append(padalg.unary(op, cs[0]))
+            else:
+                cur = cs[0]
+                for nxt in cs[1:]:
+                    f = padalg.combine(op, cur, nxt)
+                    cur = ("fill", padalg.FILL_VALUES[f]) if f else None
+                out.append(padalg.fill_of_value(cur[1])
+                           if cur else None)
+        return tuple(out)
+
+    def _clip_pads(self, x: ArrVal, bounds: List[Val]) -> tuple:
+        """clip(x, lo, hi) == minimum(maximum(x, lo), hi); a None
+        bound is absent."""
+        if not self.track_pads:
+            return ()
+        rank = len(x.dims)
+        out: List[Optional[str]] = []
+        for ax in range(rank):
+            cur = self._contrib(x, rank, ax)
+            for b, op in zip(bounds[:2], ("maximum", "minimum")):
+                if b is None or isinstance(b, NoneVal):
+                    continue
+                f = padalg.combine(op, cur,
+                                   self._contrib(b, rank, ax))
+                cur = ("fill", padalg.FILL_VALUES[f]) if f else None
+            out.append(padalg.fill_of_value(cur[1]) if cur else None)
+        return tuple(out)
+
+    def _check_reduce(self, arr: ArrVal, ax: int, fname: str,
+                      line: int) -> None:
+        """PS001: a reduction over a padded axis with a known
+        non-neutral fill."""
+        if not self.track_pads or not (0 <= ax < len(arr.dims)):
+            return
+        fill = _pad_at(arr, ax)
+        dim = arr.dims[ax]
+        if fill is None or not isinstance(dim, str):
+            return
+        neutral = padalg.reduction_neutral(fname, fill)
+        if neutral is None or neutral:
+            return
+        self._report(
+            "pad_reduce", line,
+            f"`{fname}` reduces over padded axis `{dim}` whose pad "
+            f"rows carry fill `{fill}` — not neutral for {fname}; "
+            f"mask the pads first (jnp.where / multiply by the "
+            f"validity mask) or pad with a neutral fill",
+            key=f"reduce:{fname}:{dim}:{fill}")
+
+    def _check_gather(self, idx: Val, line: int, where: str) -> None:
+        """PS002: indexing by an array whose padded axis carries the
+        -1 sentinel — jax wraps negative indices, so pad rows read
+        (or scatter into) the last real row; clamp with
+        jnp.maximum(idx, 0) under the validity mask."""
+        if not self.track_pads:
+            return
+        if isinstance(idx, TupleVal):
+            for item in idx.items:
+                self._check_gather(item, line, where)
+            return
+        if not isinstance(idx, ArrVal):
+            return
+        for ax, f in enumerate(idx.pads):
+            dim = idx.dims[ax]
+            if f == "-1" and isinstance(dim, str):
+                self._report(
+                    "pad_gather", line,
+                    f"{where} indexed by an array whose padded axis "
+                    f"`{dim}` carries the -1 'none' sentinel — "
+                    f"negative indices wrap in jax, so pad rows "
+                    f"silently hit the last real row; clamp first "
+                    f"(jnp.maximum(idx, 0)) and mask the result",
+                    key=f"gather:{where}:{dim}")
 
     # --- entry -----------------------------------------------------------
 
@@ -164,7 +312,9 @@ class ShapeInterp:
 
     def _spec_val(self, spec: Spec) -> Val:
         if isinstance(spec, LeafSpec):
-            return ArrVal(tuple(spec.dims))
+            return self._arr(
+                spec.dims,
+                tuple(padalg.canonical(p) for p in spec.pads))
         if isinstance(spec, StructRef):
             return StructVal(spec.name)
         if isinstance(spec, DimProp):
@@ -303,7 +453,8 @@ class ShapeInterp:
             if self.resolve_dotted(dotted) == "range":
                 return ScalarVal()
         if isinstance(v, ArrVal) and len(v.dims) >= 1:
-            return ArrVal(v.dims[1:])     # iterating strips the lead axis
+            # iterating strips the lead axis
+            return self._arr(v.dims[1:], v.pads[1:] if v.pads else ())
         return UNKNOWN
 
     def _bind(self, target: ast.AST, val: Val,
@@ -362,6 +513,20 @@ class ShapeInterp:
                         kind, line,
                         f"{where}: contract declares dim `{a}` but the "
                         f"value carries `{b}`", key=f"{where}:{a}<>{b}")
+                if self.track_pads \
+                        and len(val.dims) == len(spec.dims):
+                    for ax, pred in enumerate(spec.pads):
+                        want = padalg.canonical(pred)
+                        got = _pad_at(val, ax)
+                        if want is not None and got is not None \
+                                and want != got:
+                            self._report(
+                                "pad_cross", line,
+                                f"{where}: axis `{spec.dims[ax]}` "
+                                f"declares pad predicate `{pred}` "
+                                f"(fill `{want}`) but the value's pad "
+                                f"rows carry `{got}`",
+                                key=f"{where}:pad:{spec.dims[ax]}")
             return
         if isinstance(spec, StructRef) and isinstance(val, StructVal):
             if val.name != spec.name:
@@ -380,10 +545,12 @@ class ShapeInterp:
             if node.value is None:
                 return NoneVal()
             if isinstance(node.value, bool):
-                return ScalarVal()
+                return FloatVal(1.0 if node.value else 0.0)
             if isinstance(node.value, int):
                 return IntVal(node.value)
-            if isinstance(node.value, (float, complex)):
+            if isinstance(node.value, float):
+                return FloatVal(node.value)
+            if isinstance(node.value, complex):
                 return ScalarVal()
             return UNKNOWN
         if isinstance(node, ast.Name):
@@ -402,12 +569,26 @@ class ShapeInterp:
             return self._binop_val(left, right, node.lineno,
                                    _op_name(node.op))
         if isinstance(node, ast.UnaryOp):
-            return self._eval(node.operand, env)
+            v = self._eval(node.operand, env)
+            opname = type(node.op).__name__.lower()   # usub/uadd/...
+            if opname == "usub":
+                if isinstance(v, IntVal) and isinstance(v.dim, int):
+                    return IntVal(-v.dim)
+                if isinstance(v, FloatVal):
+                    return FloatVal(-v.value)
+            if isinstance(v, ArrVal) and v.pads \
+                    and opname in ("usub", "invert", "not"):
+                rank = len(v.dims)
+                return self._arr(v.dims, tuple(
+                    padalg.unary(opname, self._contrib(v, rank, ax))
+                    for ax in range(rank)))
+            return v
         if isinstance(node, ast.Compare):
             out = self._eval(node.left, env)
-            for comp in node.comparators:
-                out = self._binop_val(out, self._eval(comp, env),
-                                      node.lineno, "compare")
+            for cmp_op, comp in zip(node.ops, node.comparators):
+                out = self._binop_val(
+                    out, self._eval(comp, env), node.lineno, "compare",
+                    opdetail=type(cmp_op).__name__.lower())
             return out
         if isinstance(node, ast.BoolOp):
             for v in node.values:
@@ -464,7 +645,9 @@ class ShapeInterp:
             if node.attr == "shape":
                 return ShapeTupleVal(base.dims)
             if node.attr == "T":
-                return ArrVal(tuple(reversed(base.dims)))
+                return self._arr(tuple(reversed(base.dims)),
+                                 tuple(reversed(base.pads))
+                                 if base.pads else ())
             if node.attr == "at":
                 return AtVal(base.dims)
             if node.attr in ("dtype", "ndim", "size"):
@@ -480,17 +663,18 @@ class ShapeInterp:
         if isinstance(base, ShapeTupleVal):
             idx = self._eval(sl, env)
             if isinstance(idx, IntVal) and isinstance(idx.dim, int) \
-                    and 0 <= idx.dim < len(base.dims):
+                    and -len(base.dims) <= idx.dim < len(base.dims):
                 d = base.dims[idx.dim]
                 return IntVal(d) if d is not None else ScalarVal()
             return ScalarVal()
         if isinstance(base, AtVal):
-            self._eval(sl, env)
+            self._check_gather(self._eval(sl, env), node.lineno,
+                               "`.at[...]` update")
             return AtVal(base.dims)
         if isinstance(base, TupleVal):
             idx = self._eval(sl, env)
             if isinstance(idx, IntVal) and isinstance(idx.dim, int) \
-                    and 0 <= idx.dim < len(base.items):
+                    and -len(base.items) <= idx.dim < len(base.items):
                 return base.items[idx.dim]
             return UNKNOWN
         if not isinstance(base, ArrVal):
@@ -498,6 +682,7 @@ class ShapeInterp:
             return UNKNOWN
         elements = list(sl.elts) if isinstance(sl, ast.Tuple) else [sl]
         out: List = []
+        out_pads: List = []
         axis = 0
         advanced = 0
         for el in elements:
@@ -509,16 +694,19 @@ class ShapeInterp:
                 if el.lower is None and el.upper is None \
                         and el.step is None:
                     out.append(base.dims[axis])
+                    out_pads.append(_pad_at(base, axis))
                 else:
                     for b in (el.lower, el.upper, el.step):
                         if b is not None:
                             self._eval(b, env)
                     out.append(None)      # sliced extent: unknown
+                    out_pads.append(None)
                 axis += 1
                 continue
             v = self._eval(el, env)
             if isinstance(v, NoneVal):
                 out.append(1)             # explicit broadcast axis
+                out_pads.append(None)
                 continue
             if isinstance(v, (IntVal, ScalarVal)):
                 if axis >= len(base.dims):
@@ -531,24 +719,36 @@ class ShapeInterp:
                 advanced += 1
                 if advanced > 1:
                     return UNKNOWN        # multi-array indexing: punt
+                self._check_gather(v, node.lineno, "advanced indexing")
                 out.extend(v.dims)
+                # gathered content: real rows land in pad positions
+                out_pads.extend([None] * len(v.dims))
                 axis += 1
                 continue
             return UNKNOWN
         out.extend(base.dims[axis:])
-        return ArrVal(tuple(out))
+        out_pads.extend(_pad_at(base, i)
+                        for i in range(axis, len(base.dims)))
+        return self._arr(out, out_pads)
 
     # --- operators -------------------------------------------------------
 
     def _binop_val(self, left: Val, right: Val, line: int,
-                   where: str) -> Val:
+                   where: str, opdetail: Optional[str] = None) -> Val:
+        op = opdetail or where
         if isinstance(left, ArrVal) and isinstance(right, ArrVal):
             join = broadcast_join(left.dims, right.dims)
             self._check_join(join, line, where)
-            return ArrVal(join.dims) if join.dims is not None else UNKNOWN
+            if join.dims is None:
+                return UNKNOWN
+            return self._arr(join.dims,
+                             self._ew_pads(op, [left, right],
+                                           len(join.dims)))
         for a, b in ((left, right), (right, left)):
             if isinstance(a, ArrVal) and isinstance(b, _SCALARISH):
-                return a
+                return self._arr(a.dims,
+                                 self._ew_pads(op, [left, right],
+                                               len(a.dims)))
         if isinstance(left, _SCALARISH) and isinstance(right, _SCALARISH):
             if isinstance(left, IntVal) and isinstance(right, IntVal) \
                     and left.dim == right.dim:
@@ -618,10 +818,16 @@ class ShapeInterp:
         if isinstance(recv, AtVal) and attr in _AT_METHODS:
             return ArrVal(recv.dims)
         if isinstance(recv, ArrVal):
+            if attr == "clip":
+                return self._arr(recv.dims,
+                                 self._clip_pads(recv, argvals))
             if attr in _SHAPE_PRESERVING_METHODS:
-                return ArrVal(recv.dims)
+                # astype/copy/round keep fills (canonical fills are
+                # integral or inf — round is identity on them)
+                return self._arr(recv.dims, recv.pads)
             if attr in _REDUCTIONS:
-                return self._reduce_dims(recv.dims, node, axis_offset=0)
+                return self._reduce_dims(recv, node, axis_offset=0,
+                                         fname=attr)
             if attr == "reshape":
                 return self._reshape_dims(node, argvals)
             if attr == "flatten" or attr == "ravel":
@@ -633,8 +839,9 @@ class ShapeInterp:
             return ScalarVal()
         return None
 
-    def _reduce_dims(self, dims: SymShape, node: ast.Call,
-                     axis_offset: int) -> Val:
+    def _reduce_dims(self, arr: ArrVal, node: ast.Call,
+                     axis_offset: int, fname: str) -> Val:
+        dims = arr.dims
         axis_node = None
         for kw in node.keywords:
             if kw.arg == "keepdims":
@@ -643,15 +850,23 @@ class ShapeInterp:
                 axis_node = kw.value
         if axis_node is None and len(node.args) > axis_offset:
             axis_node = node.args[axis_offset]
-        if axis_node is None:
-            return ArrVal(())
-        if isinstance(axis_node, ast.Constant) \
-                and axis_node.value is None:
+        full_reduce = axis_node is None or (
+            isinstance(axis_node, ast.Constant)
+            and axis_node.value is None)
+        if full_reduce:
+            for i in range(len(dims)):
+                self._check_reduce(arr, i, fname, node.lineno)
             return ArrVal(())
         ax = _const_int(axis_node)
         if ax is not None and -len(dims) <= ax < len(dims):
             ax %= len(dims)
-            return ArrVal(dims[:ax] + dims[ax + 1:])
+            self._check_reduce(arr, ax, fname, node.lineno)
+            pads = ()
+            if arr.pads:
+                kept = arr.pads[:ax] + arr.pads[ax + 1:]
+                pads = tuple(padalg.reduce_surviving(fname, f)
+                             for f in kept)
+            return self._arr(dims[:ax] + dims[ax + 1:], pads)
         return UNKNOWN
 
     def _reshape_dims(self, node: ast.Call, argvals: List[Val]) -> Val:
@@ -683,6 +898,12 @@ class ShapeInterp:
                 return UNKNOWN
             if not arrs:
                 return ScalarVal() if argvals else UNKNOWN
+            if fname == "clip" and isinstance(argvals[0], ArrVal) \
+                    and not any(isinstance(v, ArrVal)
+                                for v in argvals[1:]):
+                return self._arr(argvals[0].dims,
+                                 self._clip_pads(argvals[0],
+                                                 argvals[1:]))
             out = arrs[0]
             for other in arrs[1:]:
                 join = broadcast_join(out.dims, other.dims)
@@ -690,28 +911,48 @@ class ShapeInterp:
                 if join.dims is None:
                     return UNKNOWN
                 out = ArrVal(join.dims)
-            return out
+            return self._arr(out.dims,
+                             self._ew_pads(fname, argvals,
+                                           len(out.dims)))
         if fname in _REDUCTIONS:
             if argvals and isinstance(argvals[0], ArrVal):
-                return self._reduce_dims(argvals[0].dims, node,
-                                         axis_offset=1)
+                return self._reduce_dims(argvals[0], node,
+                                         axis_offset=1, fname=fname)
             return UNKNOWN
         if fname in _SHAPE_PRESERVING_FUNCS:
             if argvals and isinstance(argvals[0], ArrVal):
-                return ArrVal(argvals[0].dims)
+                src = argvals[0]
+                if fname == "asarray":
+                    return src
+                if fname == "negative":
+                    return self._arr(src.dims, tuple(
+                        padalg.unary("usub",
+                                     self._contrib(src, len(src.dims),
+                                                   ax))
+                        for ax in range(len(src.dims)))
+                        if src.pads else ())
+                # sort/cumsum/flip move pad rows out of the trailing
+                # region — fills no longer hold
+                return ArrVal(src.dims)
             return UNKNOWN
         if fname == "associative_scan":
             if len(argvals) >= 2 and isinstance(argvals[1], ArrVal):
                 return ArrVal(argvals[1].dims)
             return UNKNOWN
-        if fname in ("zeros", "ones", "empty"):
-            return self._from_shape_arg(node, argvals[:1])
-        if fname in ("full",):
-            return self._from_shape_arg(node, argvals[:1])
+        if fname in ("zeros", "ones", "empty", "full"):
+            out = self._from_shape_arg(node, argvals[:1])
+            fill = self._uniform_fill(fname, argvals)
+            if isinstance(out, ArrVal) and fill is not None:
+                return self._arr(out.dims, (fill,) * len(out.dims))
+            return out
         if fname in ("zeros_like", "ones_like", "full_like",
                      "empty_like"):
             if argvals and isinstance(argvals[0], ArrVal):
-                return ArrVal(argvals[0].dims)
+                fill = self._uniform_fill(fname[:-5], argvals)
+                dims = argvals[0].dims
+                if fill is not None:
+                    return self._arr(dims, (fill,) * len(dims))
+                return ArrVal(dims)
             return UNKNOWN
         if fname == "arange":
             if argvals and isinstance(argvals[0], IntVal) \
@@ -719,7 +960,19 @@ class ShapeInterp:
                 return ArrVal((argvals[0].dim,))
             return ArrVal((None,))
         if fname == "broadcast_to":
-            return self._from_shape_arg(node, argvals[1:2])
+            out = self._from_shape_arg(node, argvals[1:2])
+            if isinstance(out, ArrVal) and self.track_pads \
+                    and argvals and isinstance(argvals[0], ArrVal) \
+                    and argvals[0].pads:
+                src, rank = argvals[0], len(out.dims)
+                pads = []
+                for ax in range(rank):
+                    j = ax - (rank - len(src.dims))
+                    pads.append(_pad_at(src, j)
+                                if j >= 0 and src.dims[j] != 1
+                                else None)
+                return self._arr(out.dims, pads)
+            return out
         if fname == "expand_dims":
             return UNKNOWN
         if fname == "reshape":
@@ -736,17 +989,46 @@ class ShapeInterp:
         if fname in ("top_k", "approx_max_k", "approx_min_k"):
             if argvals and isinstance(argvals[0], ArrVal) \
                     and len(argvals[0].dims) >= 1:
-                d = ArrVal(argvals[0].dims[:-1] + (None,))
-                return TupleVal((d, d))
+                arr = argvals[0]
+                # the selection scans the last axis like a reduction
+                self._check_reduce(
+                    arr, len(arr.dims) - 1,
+                    "min" if fname == "approx_min_k" else "top_k",
+                    node.lineno)
+                lead = arr.pads[:-1] if arr.pads else ()
+                vals = self._arr(arr.dims[:-1] + (None,),
+                                 lead + (None,) if lead else ())
+                idxs = ArrVal(arr.dims[:-1] + (None,))
+                return TupleVal((vals, idxs))
             return UNKNOWN
         if fname in ("int32", "float32", "int8", "uint32", "bool_",
                      "asarray", "array"):
             if argvals and isinstance(argvals[0], ArrVal):
-                return ArrVal(argvals[0].dims)
+                src = argvals[0]
+                return self._arr(src.dims, tuple(
+                    padalg.cast_fill(fname, f) for f in src.pads))
             if argvals and isinstance(argvals[0], _SCALARISH):
                 return ScalarVal()
             return UNKNOWN
         return UNKNOWN
+
+    def _uniform_fill(self, ctor: str,
+                      argvals: List[Val]) -> Optional[str]:
+        """The fill every position (so every pad slice) of a
+        constructor's result carries; None for empty/unknown."""
+        if not self.track_pads:
+            return None
+        if ctor == "zeros":
+            return "zero"
+        if ctor == "ones":
+            return "one"
+        if ctor == "full" and len(argvals) >= 2:
+            v = argvals[1]
+            if isinstance(v, IntVal) and isinstance(v.dim, int):
+                return padalg.fill_of_value(v.dim)
+            if isinstance(v, FloatVal):
+                return padalg.fill_of_value(v.value)
+        return None
 
     def _from_shape_arg(self, node: ast.Call,
                         shape_vals: List[Val]) -> Val:
@@ -785,9 +1067,13 @@ class ShapeInterp:
             return UNKNOWN
         axis %= rank
         out: List = []
+        out_pads: List = []
         for i in range(rank):
             if i == axis:
+                # real+pad|real+pad: the pad region is no longer a
+                # trailing block of the concatenated axis
                 out.append(None)          # concatenated extent
+                out_pads.append(None)
                 continue
             dims_i = [p.dims[i] for p in parts]
             known = [d for d in dims_i if d is not None]
@@ -801,7 +1087,10 @@ class ShapeInterp:
                     key=f"{a}<>{b}:concat")
             out.append(known[0] if len(set(known)) == 1 and known
                        else None)
-        return ArrVal(tuple(out))
+            fills = {_pad_at(p, i) for p in parts}
+            out_pads.append(fills.pop()
+                            if len(fills) == 1 else None)
+        return self._arr(out, out_pads)
 
     def _stack_dims(self, node: ast.Call, argvals: List[Val],
                     kwvals: Dict[str, Val]) -> Val:
@@ -824,7 +1113,14 @@ class ShapeInterp:
         axis %= rank
         dims = list(base.dims)
         dims.insert(axis, len(parts))
-        return ArrVal(tuple(dims))
+        pads: List = []
+        for i in range(len(base.dims)):
+            fills = {_pad_at(p, i + len(p.dims) - len(base.dims))
+                     if len(p.dims) == len(base.dims) else None
+                     for p in parts}
+            pads.append(fills.pop() if len(fills) == 1 else None)
+        pads.insert(axis, None)
+        return self._arr(dims, pads)
 
     def _take_dims(self, node: ast.Call, argvals: List[Val],
                    kwvals: Dict[str, Val]) -> Val:
@@ -832,12 +1128,19 @@ class ShapeInterp:
             return UNKNOWN
         idx = argvals[1]
         axis = self._axis_arg(node, default=None)
-        base = argvals[0].dims
+        arr = argvals[0]
+        base = arr.dims
         if axis is None or not isinstance(idx, ArrVal) \
                 or not (-len(base) <= axis < len(base)):
             return UNKNOWN
         axis %= len(base)
-        return ArrVal(base[:axis] + idx.dims + base[axis + 1:])
+        self._check_gather(idx, node.lineno, "jnp.take")
+        pads = ()
+        if arr.pads:
+            pads = (arr.pads[:axis] + (None,) * len(idx.dims)
+                    + arr.pads[axis + 1:])
+        return self._arr(base[:axis] + idx.dims + base[axis + 1:],
+                         pads)
 
     def _take_along_dims(self, node: ast.Call, argvals: List[Val],
                          kwvals: Dict[str, Val]) -> Val:
@@ -850,10 +1153,14 @@ class ShapeInterp:
                 or not (-len(x) <= axis < len(x)):
             return UNKNOWN
         axis %= len(x)
+        self._check_gather(argvals[1], node.lineno,
+                           "jnp.take_along_axis")
         out: List = []
+        out_pads: List = []
         for i, (a, b) in enumerate(zip(x, idx)):
             if i == axis:
                 out.append(b)
+                out_pads.append(None)   # gathered content
                 continue
             if a is not None and b is not None and a != b \
                     and 1 not in (a, b) \
@@ -864,7 +1171,10 @@ class ShapeInterp:
                     f"but axis {i} mixes `{a}` and `{b}`",
                     key=f"{a}<>{b}:take_along_axis")
             out.append(a if a is not None else b)
-        return ArrVal(tuple(out))
+            # a non-axis pad slice of x is uniform fill, so the
+            # gathered rows in it are too
+            out_pads.append(_pad_at(argvals[0], i))
+        return self._arr(out, out_pads)
 
     def _axis_arg(self, node: ast.Call, default) -> Optional[int]:
         for kw in node.keywords:

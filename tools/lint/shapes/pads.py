@@ -1,0 +1,243 @@
+"""Pad-fill algebra for the koordpad static tier (pad-soundness).
+
+The abstract interpreter tracks, per array axis, what the PAD REGION
+along that axis contains, as a CANONICAL FILL:
+
+    "zero" | "one" | "-1" | "inf" | None (statically unknown)
+
+Predicates from the spec grammar map into this space via
+spec.PAD_FILLS ("false"/"unschedulable" -> "zero"; "invalid"/"any" ->
+None). The rules in this module answer: given an operation and what is
+known about each operand's pad slices, what do the RESULT's pad slices
+contain?
+
+Soundness direction: a rule may only claim a fill when the claim holds
+for every runtime content of the unknown operands; when in doubt the
+answer is None, which silences every downstream check — never-guess.
+Two deliberate assumptions lean on tree-wide invariants and can, at
+worst, SILENCE a finding that Tier B (tools/padcheck.py) still
+exercises concretely:
+  - `~` / `&` / `|` on arrays are treated with bool-mask semantics
+    (the tree uses them exclusively on masks; int bitwise `|`/`~` over
+    an array with declared 0/1 pads would evaluate differently).
+  - multiply-by-zero annihilates (x * 0 -> 0); a runtime +-inf/nan in
+    the other operand would make it nan instead. Score surfaces are
+    finite by construction; quota runtime's +inf columns never meet a
+    zero mask multiplicatively.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Tuple
+
+from tools.lint.shapes.spec import NEUTRAL_PADS, PAD_FILLS
+
+Fill = Optional[str]
+
+# canonical fill -> the numeric value of every pad entry
+FILL_VALUES = {"zero": 0.0, "one": 1.0, "-1": -1.0, "inf": math.inf}
+
+# An operand's CONTRIBUTION on one output axis:
+#   ("fill", v)  a non-broadcast array whose pad slice is uniformly v
+#   ("lit", v)   a scalar literal v (uniform over every position)
+#   None         statically unknown content (broadcast operands too:
+#                their single row holds REAL values, not fill)
+Contrib = Optional[Tuple[str, float]]
+
+
+def canonical(pred: Optional[str]) -> Fill:
+    """Spec pad predicate -> canonical fill (None for invalid/any)."""
+    if pred is None:
+        return None
+    return PAD_FILLS.get(pred)
+
+
+def fill_of_value(v) -> Fill:
+    """Map a computed pad value back into the canonical space; any
+    value outside it is unrepresentable -> None (unknown)."""
+    try:
+        f = float(v)
+    except (TypeError, ValueError):
+        return None
+    if math.isnan(f):
+        return None
+    if f == 0.0:
+        return "zero"
+    if f == 1.0:
+        return "one"
+    if f == -1.0:
+        return "-1"
+    if f == math.inf:
+        return "inf"
+    return None
+
+
+def _truthy(v) -> float:
+    return 1.0 if v else 0.0
+
+
+def _safe_div(a: float, b: float) -> Optional[float]:
+    if b == 0.0:
+        return None
+    return a / b
+
+
+_BINOPS = {
+    # ast.BinOp names (_op_name) and jnp function names, one table
+    "add": lambda a, b: a + b,
+    "sub": lambda a, b: a - b,
+    "subtract": lambda a, b: a - b,
+    "mult": lambda a, b: a * b,
+    "multiply": lambda a, b: a * b,
+    "div": _safe_div,
+    "divide": _safe_div,
+    "truediv": _safe_div,
+    "floordiv": lambda a, b: float(math.floor(a / b)) if b else None,
+    "pow": lambda a, b: float(a ** b),
+    "power": lambda a, b: float(a ** b),
+    "maximum": max,
+    "minimum": min,
+    # bool-mask semantics (see module docstring)
+    "bitand": lambda a, b: _truthy(a and b),
+    "logical_and": lambda a, b: _truthy(a and b),
+    "bitor": lambda a, b: _truthy(a or b),
+    "logical_or": lambda a, b: _truthy(a or b),
+    "bitxor": lambda a, b: _truthy(bool(a) != bool(b)),
+    "logical_xor": lambda a, b: _truthy(bool(a) != bool(b)),
+    # ast.Compare op class names, lowercased, plus jnp spellings
+    "lt": lambda a, b: _truthy(a < b),
+    "lte": lambda a, b: _truthy(a <= b),
+    "gt": lambda a, b: _truthy(a > b),
+    "gte": lambda a, b: _truthy(a >= b),
+    "eq": lambda a, b: _truthy(a == b),
+    "noteq": lambda a, b: _truthy(a != b),
+    "less": lambda a, b: _truthy(a < b),
+    "less_equal": lambda a, b: _truthy(a <= b),
+    "greater": lambda a, b: _truthy(a > b),
+    "greater_equal": lambda a, b: _truthy(a >= b),
+    "equal": lambda a, b: _truthy(a == b),
+    "not_equal": lambda a, b: _truthy(a != b),
+}
+
+# ops where ONE known operand value forces the result regardless of the
+# other operand's (unknown) content
+_ANNIHILATORS = {
+    "mult": 0.0,
+    "multiply": 0.0,
+    "bitand": 0.0,
+    "logical_and": 0.0,
+    "bitor": 1.0,
+    "logical_or": 1.0,
+    "maximum": math.inf,
+}
+
+_UNARY = {
+    "usub": lambda v: -v,
+    "negative": lambda v: -v,
+    "abs": abs,
+    "square": lambda v: v * v,
+    "sign": lambda v: float((v > 0) - (v < 0)),
+    "floor": lambda v: float(math.floor(v)),
+    "ceil": lambda v: float(math.ceil(v)),
+    "round": lambda v: float(round(v)),
+    "sqrt": math.sqrt,
+    "exp": math.exp,
+    "log": math.log,
+    "isnan": lambda v: 0.0,          # canonical fills are never nan
+    "isfinite": lambda v: _truthy(not math.isinf(v)),
+    # bool-mask semantics for `~` (see module docstring)
+    "invert": lambda v: _truthy(not v),
+    "not": lambda v: _truthy(not v),
+    "logical_not": lambda v: _truthy(not v),
+}
+
+
+def combine(op: str, a: Contrib, b: Contrib) -> Fill:
+    """The result fill on one axis of a binary (or pairwise-folded
+    n-ary) op over two operand contributions."""
+    ann = _ANNIHILATORS.get(op)
+    if ann is not None:
+        for c in (a, b):
+            if c is not None and c[1] == ann:
+                return fill_of_value(ann)
+    fn = _BINOPS.get(op)
+    if fn is None or a is None or b is None:
+        return None
+    try:
+        r = fn(a[1], b[1])
+    except (ArithmeticError, OverflowError, ValueError):
+        return None
+    return fill_of_value(r) if r is not None else None
+
+
+def unary(op: str, c: Contrib) -> Fill:
+    fn = _UNARY.get(op)
+    if fn is None or c is None:
+        return None
+    try:
+        r = fn(c[1])
+    except (ArithmeticError, OverflowError, ValueError):
+        return None
+    return fill_of_value(r)
+
+
+def where_fill(c: Contrib, a: Contrib, b: Contrib) -> Fill:
+    """jnp.where(c, a, b) on one axis: a known condition fill selects
+    the matching branch's contribution; an unknown condition still
+    yields a fill when BOTH branches agree on a known one."""
+    if c is not None:
+        pick = a if c[1] else b
+        return fill_of_value(pick[1]) if pick is not None else None
+    if a is not None and b is not None and a[1] == b[1]:
+        return fill_of_value(a[1])
+    return None
+
+
+def reduction_neutral(op: str, fill: Fill) -> Optional[bool]:
+    """Whether `fill` pads cannot perturb the real rows of a reduction
+    over the padded axis; None when op is not a known reduction family
+    or the fill is unknown (silent either way)."""
+    fam = NEUTRAL_PADS.get(op)
+    if fam is None or fill is None:
+        return None
+    return fill in fam
+
+
+def reduce_surviving(op: str, fill: Fill) -> Fill:
+    """After reducing away some OTHER axis, what a surviving padded
+    axis's pad slices contain: the slice was uniformly `fill`, so the
+    reduction of identical values is often exactly computable (the
+    reduced extent itself is symbolic, so sums of nonzero fills are
+    not)."""
+    if fill is None:
+        return None
+    if op in ("max", "min", "mean", "nanmax", "nanmin", "nanmean",
+              "median"):
+        return fill
+    if op in ("sum", "nansum"):
+        return fill if fill in ("zero", "inf") else None
+    if op in ("prod", "nanprod"):
+        return fill if fill in ("zero", "one", "inf") else None
+    if op in ("any", "all"):
+        return "one" if FILL_VALUES[fill] else "zero"
+    if op in ("argmax", "argmin"):
+        return "zero"                 # ties resolve to index 0
+    if op == "count_nonzero":
+        return "zero" if fill == "zero" else None
+    if op in ("std", "var"):
+        return None if fill == "inf" else "zero"
+    return None
+
+
+def cast_fill(cast: str, fill: Fill) -> Fill:
+    """Dtype-cast constructors (jnp.int32(x), x.astype, bool_)."""
+    if fill is None:
+        return None
+    if cast == "bool_":
+        return "one" if FILL_VALUES[fill] else "zero"
+    if cast in ("int32", "int8"):
+        return None if fill == "inf" else fill
+    if cast == "uint32":
+        return fill if fill in ("zero", "one") else None
+    return fill

@@ -7,6 +7,15 @@ A spec string is one of:
   "PodBatch"    reference to a registered struct (CapWord, has lowercase)
   "N"           bare dim symbol: a symbolic-int PROPERTY of a struct
 
+A dim symbol in a leaf may carry a PAD PREDICATE (the koordpad tier):
+  "f32[N~pad:zero,R]"      pad rows along N are zero-filled
+  "i32[P~pad:-1]"          pad rows carry the -1 sentinel
+  "bool[P~pad:invalid]"    pad content unspecified, masked by the
+                           struct's validity column
+Every dim in PADDED_DIMS is a padded capacity and MUST declare its
+predicate in registered structs and contracts (pad_soundness PS004);
+dims outside PADDED_DIMS must not carry one (PS005).
+
 Symbolic shapes are tuples whose entries are dim symbols (str), int
 literals, or None (statically unknown). The broadcast join implements
 numpy trailing alignment and reports two defect classes:
@@ -53,6 +62,79 @@ DIM_VOCAB = {
 # the static tier only needs the symbols)
 FIXED_DIM_SYMBOLS = ("AGG", "DEV", "AX", "QD")
 
+# The pad-predicate vocabulary (the koordpad tier). This is the
+# linter's own copy; tests/test_pad_soundness.py pins it equal to
+# schema.PAD_VOCAB. Each predicate names what the PAD REGION along the
+# annotated dim contains — the machine-readable form of the prose
+# `_pad=` notes.
+PAD_VOCAB = {
+    "zero": "pad entries are 0 (False for bool)",
+    "one": "pad entries are 1 (True for bool)",
+    "false": "pad entries are False (bool columns only)",
+    "-1": "pad entries carry the -1 'none' sentinel",
+    "inf": "pad entries are +inf (never gate; f32 only)",
+    "unschedulable": "zero-filled node rows additionally killed by the "
+                     "schedulable=False guard (pad_nodes_to_mesh rows)",
+    "invalid": "content unspecified; masked by the carrying struct's "
+               "validity column (valid/gpu_valid/numa_valid/...)",
+    "any": "content unspecified; every consumer must guard it "
+           "explicitly (no inertness is asserted)",
+}
+
+# Dims that are PADDED CAPACITIES — their extent may exceed the real
+# element count, with a declared-fill pad region at the end. Every
+# occurrence of one of these in a registered struct / contract leaf
+# must carry a ~pad: predicate (PS004). Deliberately exempt:
+#   R   fixed NUM_RESOURCES in practice (kernels index it by
+#       ResourceKind constants; zero columns are uniformly inert)
+#   S/L/T/TG/SG/AG/FG  equivalence-class tables sized exactly
+#   TC  a static retry-window width (runtime-masked by `attempt`,
+#       never a trailing pad region)
+#   KC/RD  derived widths (k x shards / threshold dims), sized exactly
+PADDED_DIMS = frozenset(
+    {"P", "N", "Q", "G", "V", "Z", "I", "J", "DM", "K", "NS"})
+
+# predicate -> canonical FILL the static tier can reason about; None =
+# content statically unknown (invalid/any — Tier B's differential run
+# still exercises them, but never-guess keeps Tier A silent)
+PAD_FILLS = {
+    "zero": "zero",
+    "one": "one",
+    "false": "zero",
+    "-1": "-1",
+    "inf": "inf",
+    "unschedulable": "zero",
+    "invalid": None,
+    "any": None,
+}
+
+# reduction family -> canonical fills NEUTRAL for it (a pad region
+# carrying a neutral fill cannot perturb the reduction's real rows).
+# zero/-1 are neutral for max/argmax/top_k because every score surface
+# in the tree is >= 0 and lax tie-breaking is stable toward the lowest
+# index with pads appended AFTER real rows.
+NEUTRAL_PADS = {
+    "sum": {"zero"},
+    "any": {"zero"},
+    "count_nonzero": {"zero"},
+    "nansum": {"zero"},
+    "max": {"zero", "-1"},
+    "argmax": {"zero", "-1"},
+    "nanmax": {"zero", "-1"},
+    "top_k": {"zero", "-1"},
+    "min": {"inf"},
+    "argmin": {"inf"},
+    "nanmin": {"inf"},
+    "all": {"one"},
+    "prod": {"one"},
+    "nanprod": {"one"},
+    "mean": set(),
+    "nanmean": set(),
+    "std": set(),
+    "var": set(),
+    "median": set(),
+}
+
 DTYPES = {
     "f32": "float32",
     "i32": "int32",
@@ -75,6 +157,13 @@ class LeafSpec:
     dtype: str                  # key of DTYPES
     dims: Tuple[Dim, ...]
     optional: bool = False
+    # pad predicate per dim (PAD_VOCAB key or None), parallel to
+    # `dims`; () when NO dim carries one, so pad-free specs stay equal
+    # to pre-koordpad LeafSpec literals
+    pads: Tuple[Optional[str], ...] = ()
+
+    def pad_for(self, axis: int) -> Optional[str]:
+        return self.pads[axis] if axis < len(self.pads) else None
 
 
 @dataclass(frozen=True)
@@ -113,11 +202,25 @@ def parse_spec(raw) -> Spec:
             raise SpecError(f"unknown dtype {dtype!r} in {raw!r} "
                             f"(expected one of {sorted(DTYPES)})")
         dims: List[Dim] = []
+        pads: List[Optional[str]] = []
         body = body.strip()
         for tok in (body.split(",") if body else []):
             tok = tok.strip()
             if not tok:
                 raise SpecError(f"empty dim in {raw!r}")
+            pad = None
+            if "~" in tok:
+                tok, _, anno = tok.partition("~")
+                tok = tok.strip()
+                anno = anno.strip()
+                if not anno.startswith("pad:"):
+                    raise SpecError(f"malformed dim annotation {anno!r} "
+                                    f"in {raw!r} (expected pad:<pred>)")
+                pad = anno[len("pad:"):].strip()
+                if pad not in PAD_VOCAB:
+                    raise SpecError(f"unknown pad predicate {pad!r} in "
+                                    f"{raw!r} (vocabulary: "
+                                    f"{sorted(PAD_VOCAB)})")
             if tok.isdigit():
                 dims.append(int(tok))
             elif known_dim(tok):
@@ -127,7 +230,11 @@ def parse_spec(raw) -> Spec:
                                 f"{raw!r} (vocabulary: "
                                 f"{sorted(DIM_VOCAB)} + "
                                 f"{sorted(FIXED_DIM_SYMBOLS)})")
-        return LeafSpec(dtype=dtype, dims=tuple(dims), optional=optional)
+            pads.append(pad)
+        if all(p is None for p in pads):
+            pads = []
+        return LeafSpec(dtype=dtype, dims=tuple(dims), optional=optional,
+                        pads=tuple(pads))
     if not _WORD_RE.match(raw):
         raise SpecError(f"malformed spec {raw!r}")
     if known_dim(raw):

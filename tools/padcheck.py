@@ -1,0 +1,380 @@
+"""koordpad Tier B: the differential pad-inertness gate.
+
+Where tools/shapecheck.py proves every contracted kernel's SHAPES
+abstractly (jax.eval_shape, no values), this gate runs every kernel
+CONCRETELY on CPU, twice, over the same seeded real problem:
+
+  run 0   arrays sized to the real extents exactly (zero extra pad)
+  run X   every padded dim (schema.PADDED_DIMS) grown by +2/+3, pad
+          regions materialized from the declared `~pad:` predicates
+          (schema.PAD_FILL_VALUES); `invalid`/`any` regions get seeded
+          well-typed garbage, because consumers promise not to read
+          them
+
+and then asserts, leaf by declared leaf of the output spec:
+
+  - REAL-REGION INERTNESS: the padded run's outputs, sliced back to
+    the real extents, are BIT-identical to run 0's. Any difference
+    means pad rows leaked into real results — a non-neutral reduction,
+    an unclamped sentinel gather, a mask conjunction dropped.
+  - PAD-BAND DISCIPLINE: the padded run's own pad bands hold exactly
+    the declared fill (skipped for `invalid`/`any`, which promise
+    nothing). Producers must leave pads the way the contract says, or
+    downstream annihilator reasoning (the pad-soundness lint) and the
+    mesh repadder are built on sand.
+
+The static twin is the `pad-soundness` koordlint pass: dataflow over
+the same declarations, no jax. `--self-test-mutation` proves BOTH
+tiers live by planting one defect each (tools/seedmut.py): dropping
+the `& nodes.schedulable` conjunction in cascade.static_gates must
+fail THIS gate, and dropping the index clamp in
+feasibility.pod_ancestors must fail the lint pass (that one is
+concretely masked afterwards, so only dataflow can see the hazard).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import zlib
+from typing import Dict, List, Optional, Tuple
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+# appended (not prepended) so a mutated tree earlier on PYTHONPATH wins
+if REPO_ROOT not in sys.path:
+    sys.path.append(REPO_ROOT)
+
+from tools.lint.shapes.spec import (  # noqa: E402
+    DimProp,
+    LeafSpec,
+    PADDED_DIMS,
+    Spec,
+    StructRef,
+    parse_spec,
+)
+from tools.shapecheck import (  # noqa: E402
+    CONTRACT_MODULES,
+    _DTYPE_NAMES,
+    _resolve_dim,
+)
+
+# The real problem: every symbol all-distinct so cross-dim coupling
+# cannot alias, every padded extent >= 4 so sliced comparisons see a
+# real interior (Z stays 3: the topology manager builds a 2^Z table).
+# TC <= P as in shapecheck. R/AGG/DEV/AX/QD come from the runtime.
+REAL_SIZES = {
+    "P": 11, "N": 5, "I": 6, "Z": 3, "G": 7, "Q": 8, "V": 9,
+    "S": 10, "L": 12, "T": 13, "TG": 14, "SG": 15, "AG": 16, "FG": 17,
+    "DM": 18, "J": 19, "K": 20, "KC": 21, "TC": 4, "RD": 22, "NS": 23,
+}
+
+# extra pad per padded dim in run X — deterministic, mixed +2/+3 so
+# two padded dims never grow by amounts that re-alias their extents
+PAD_EXTRA = {d: 2 + (i % 2)
+             for i, d in enumerate(sorted(PADDED_DIMS))}
+
+BASE_SEED = 0xC0FFEE
+
+
+class PadCheckError(Exception):
+    pass
+
+
+def _sizes(padded: bool) -> Dict[str, int]:
+    from koordinator_tpu.api.extension import NUM_RESOURCES
+    from koordinator_tpu.snapshot.schema import FIXED_DIMS
+    out = dict(REAL_SIZES)
+    if padded:
+        for d, extra in PAD_EXTRA.items():
+            out[d] = out[d] + extra
+    out["R"] = NUM_RESOURCES
+    out.update(FIXED_DIMS)
+    return out
+
+
+def _rng(key: str, seed: int):
+    import numpy as np
+    return np.random.default_rng(
+        (seed & 0xFFFFFFFF) << 32 | zlib.crc32(key.encode("utf-8")))
+
+
+def _gen(dtype: str, shape: Tuple[int, ...], rng, index_cap: int):
+    """Seeded real-region content. Integer leaves are index-like
+    throughout the tree, so they draw from [-1, index_cap) — valid
+    into every axis, including the -1 'none' sentinel (u32 cannot
+    carry it and starts at 0)."""
+    import numpy as np
+    if dtype == "bool":
+        return rng.random(shape) < 0.7
+    if dtype == "f32":
+        return rng.uniform(0.5, 2.0, shape).astype(np.float32)
+    lo = 0 if dtype == "u32" else -1
+    return rng.integers(lo, index_cap,
+                        size=shape).astype(np.dtype(_DTYPE_NAMES[dtype]))
+
+
+def _build_leaf(leaf: LeafSpec, real: Dict[str, int],
+                padded: Dict[str, int], rng, grng, index_cap: int):
+    """-> (array_real, array_padded): identical seeded real regions;
+    the padded twin's pad bands hold the declared fills (or seeded
+    garbage for `invalid`/`any`)."""
+    import numpy as np
+    from koordinator_tpu.snapshot.schema import PAD_FILL_VALUES
+    real_shape = tuple(_resolve_dim(d, real) for d in leaf.dims)
+    pad_shape = tuple(_resolve_dim(d, padded) for d in leaf.dims)
+    base = _gen(leaf.dtype, real_shape, rng, index_cap)
+    if pad_shape == real_shape:
+        return base, base
+    arr = np.zeros(pad_shape, dtype=base.dtype)
+    for ax in range(len(leaf.dims)):
+        if pad_shape[ax] == real_shape[ax]:
+            continue
+        sl = [slice(None)] * len(leaf.dims)
+        sl[ax] = slice(real_shape[ax], None)
+        fill = PAD_FILL_VALUES.get(leaf.pad_for(ax) or "")
+        if fill is None:
+            band_shape = tuple(pad_shape[i] if i != ax
+                               else pad_shape[ax] - real_shape[ax]
+                               for i in range(len(pad_shape)))
+            arr[tuple(sl)] = _gen(leaf.dtype, band_shape, grng,
+                                  index_cap)
+        else:
+            arr[tuple(sl)] = np.asarray(fill).astype(base.dtype)
+    arr[tuple(slice(0, s) for s in real_shape)] = base
+    return base, arr
+
+
+def build_pair(spec: Spec, real: Dict[str, int], padded: Dict[str, int],
+               rng, grng, index_cap: int):
+    """A spec -> (value_real, value_padded), recursing through tuples
+    and registered structs with ONE rng stream so the real regions are
+    draw-for-draw identical."""
+    from koordinator_tpu.snapshot.schema import STRUCT_CLASSES, STRUCT_SPECS
+    if isinstance(spec, tuple):
+        pairs = [build_pair(s, real, padded, rng, grng, index_cap)
+                 for s in spec]
+        return tuple(p[0] for p in pairs), tuple(p[1] for p in pairs)
+    if isinstance(spec, LeafSpec):
+        return _build_leaf(spec, real, padded, rng, grng, index_cap)
+    if isinstance(spec, StructRef):
+        cls = STRUCT_CLASSES.get(spec.name)
+        fields = STRUCT_SPECS.get(spec.name)
+        if cls is None or fields is None:
+            raise PadCheckError(f"unregistered struct {spec.name!r}")
+        kw0, kwx = {}, {}
+        for fname, raw in fields.items():
+            fspec = parse_spec(raw)
+            if isinstance(fspec, DimProp):
+                continue
+            kw0[fname], kwx[fname] = build_pair(fspec, real, padded,
+                                                rng, grng, index_cap)
+        return cls(**kw0), cls(**kwx)
+    raise PadCheckError(f"cannot build a value for spec {spec!r}")
+
+
+def _compare_leaf(leaf: LeafSpec, o0, ox, real: Dict[str, int],
+                  where: str, errors: List[str]) -> None:
+    import numpy as np
+    from koordinator_tpu.snapshot.schema import PAD_FILL_VALUES
+    if o0 is None or ox is None:
+        if leaf.optional and o0 is None and ox is None:
+            return
+        errors.append(f"{where}: output present in one run only "
+                      f"(pad0={o0 is not None}, padX={ox is not None})")
+        return
+    a = np.asarray(o0)
+    b = np.asarray(ox)
+    real_shape = tuple(_resolve_dim(d, real) for d in leaf.dims)
+    # shape drift is shapecheck's job; slicing to the real extents is
+    # well-defined regardless
+    sliced = b[tuple(slice(0, s) for s in real_shape)]
+    if a.tobytes() != sliced.tobytes():
+        with np.errstate(invalid="ignore"):
+            ndrift = int(np.sum(a != sliced))
+        errors.append(
+            f"{where}: pad leak — real-region drift between the "
+            f"zero-pad and padded runs ({ndrift} element(s) differ); "
+            f"pad rows perturbed real results")
+    for ax, dim in enumerate(leaf.dims):
+        if b.shape[ax] == real_shape[ax]:
+            continue
+        pred = leaf.pad_for(ax)
+        fill = PAD_FILL_VALUES.get(pred or "")
+        if fill is None:
+            continue  # invalid/any (or undeclared): contents free
+        sl = [slice(0, real_shape[i]) for i in range(len(leaf.dims))]
+        sl[ax] = slice(real_shape[ax], None)
+        band = b[tuple(sl)]
+        want = np.asarray(fill).astype(b.dtype)
+        if not np.all(band == want):
+            errors.append(
+                f"{where}: pad-band drift on axis `{dim}` — declared "
+                f"~pad:{pred} (fill {fill}), produced values "
+                f"{sorted(set(np.asarray(band).ravel().tolist()))[:6]}")
+
+
+def compare_outputs(spec: Optional[Spec], o0, ox, real: Dict[str, int],
+                    where: str, errors: List[str]) -> None:
+    from koordinator_tpu.snapshot.schema import STRUCT_SPECS
+    if spec is None:
+        return
+    if isinstance(spec, tuple):
+        if not isinstance(o0, (tuple, list)) or len(o0) != len(spec) \
+                or not isinstance(ox, (tuple, list)) \
+                or len(ox) != len(spec):
+            errors.append(f"{where}: tuple arity drift vs the declared "
+                          f"{len(spec)}-tuple")
+            return
+        for i, s in enumerate(spec):
+            compare_outputs(s, o0[i], ox[i], real, f"{where}[{i}]",
+                            errors)
+        return
+    if isinstance(spec, LeafSpec):
+        _compare_leaf(spec, o0, ox, real, where, errors)
+        return
+    if isinstance(spec, StructRef):
+        for fname, raw in STRUCT_SPECS.get(spec.name, {}).items():
+            fspec = parse_spec(raw)
+            if isinstance(fspec, DimProp):
+                continue
+            compare_outputs(fspec, getattr(o0, fname, None),
+                            getattr(ox, fname, None), real,
+                            f"{where}.{fname}", errors)
+        return
+    errors.append(f"{where}: unhandled spec {spec!r}")
+
+
+def run_contract(key: str, contract, seed: int) -> List[str]:
+    import functools
+
+    import jax
+    from koordinator_tpu.snapshot.schema import SHAPE_CONTRACTS
+    real = _sizes(padded=False)
+    padded = _sizes(padded=True)
+    index_cap = min(real.values())
+    rng = _rng(key, seed)
+    grng = _rng(key + "/garbage", seed)
+    kw0, kwx = {}, {}
+    for name, raw in contract.args.items():
+        kw0[name], kwx[name] = build_pair(parse_spec(raw), real, padded,
+                                          rng, grng, index_cap)
+    static_kwargs = {}
+    for name, value in contract.static.items():
+        if isinstance(value, str) and value in real:
+            if value in PADDED_DIMS:
+                return [f"{key}: static {name!r} names padded dim "
+                        f"{value!r} — a static cannot track padding"]
+            value = real[value]
+        static_kwargs[name] = value
+    for name, dotted in contract.callables.items():
+        target = SHAPE_CONTRACTS.get(dotted)
+        if target is None:
+            return [f"{key}: _callable {name!r} names unregistered "
+                    f"contract {dotted!r}"]
+        static_kwargs[name] = target.fn
+    fn = functools.partial(contract.fn, **static_kwargs) \
+        if static_kwargs else contract.fn
+    # kernels use .at[] / while_loop carries: feed device arrays, not np
+    import jax.numpy as jnp
+    kw0 = jax.tree_util.tree_map(jnp.asarray, kw0)
+    kwx = jax.tree_util.tree_map(jnp.asarray, kwx)
+    try:
+        out0 = jax.device_get(fn(**kw0))
+        outx = jax.device_get(fn(**kwx))
+    except Exception as exc:  # noqa: BLE001 — any concrete failure fails CI
+        return [f"{key}: concrete run raised "
+                f"{type(exc).__name__}: {exc}"]
+    errors: List[str] = []
+    spec = parse_spec(contract.returns) \
+        if contract.returns is not None else None
+    compare_outputs(spec, out0, outx, real, key, errors)
+    return errors
+
+
+def run_all(seed: int = BASE_SEED, verbose: bool = False,
+            only: Optional[str] = None) -> int:
+    import importlib
+
+    import jax
+    if jax.config.jax_enable_x64:
+        print("padcheck: refusing to run with jax_enable_x64 — the "
+              "contracts pin 32-bit layouts", file=sys.stderr)
+        return 2
+    for mod in CONTRACT_MODULES:
+        importlib.import_module(mod)
+    from koordinator_tpu.snapshot.schema import SHAPE_CONTRACTS
+
+    failures = 0
+    total = 0
+    for key in sorted(SHAPE_CONTRACTS):
+        if only is not None and only not in key:
+            continue
+        total += 1
+        errs = run_contract(key, SHAPE_CONTRACTS[key], seed)
+        if errs:
+            failures += 1
+            for e in errs:
+                print(f"FAIL {e}")
+        elif verbose:
+            print(f"ok   {key}")
+    print(f"padcheck: {total - failures}/{total} contracts pad-inert "
+          f"under zero-pad vs padded runs (seed={seed:#x})")
+    return 1 if failures else 0
+
+
+# --- the seeded-mutation smoke: both koordpad tiers must be live -----------
+
+def self_test_mutation() -> int:
+    from tools.seedmut import Mutation, check_gate_catches
+    rc = check_gate_catches(
+        Mutation(
+            relpath=os.path.join("koordinator_tpu", "scheduler",
+                                 "cascade.py"),
+            anchor="static_ok = la_ok & sel_ok "
+                   "& nodes.schedulable[None, :]",
+            replacement="static_ok = la_ok & sel_ok",
+            note="static_gates no longer kills pad node columns "
+                 "(schedulable conjunction dropped)"),
+        [sys.executable, os.path.abspath(__file__)],
+        marker="FAIL", label="padcheck")
+    rc |= check_gate_catches(
+        Mutation(
+            relpath=os.path.join("koordinator_tpu", "ops",
+                                 "feasibility.py"),
+            anchor="quota_id = jnp.maximum(pods.quota_id, 0)",
+            replacement="quota_id = pods.quota_id",
+            note="pod_ancestors gathers through the raw -1 sentinel "
+                 "(clamp dropped; concretely masked, so only the "
+                 "static tier can see it)"),
+        [sys.executable, "-m", "tools.lint", "--root", "{tree}",
+         "--analyzers", "pad-soundness"],
+        marker="PS002", label="pad-soundness")
+    return rc
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python tools/padcheck.py",
+        description="koordpad Tier B: differential pad-inertness gate "
+                    "over the kernel contract registry")
+    parser.add_argument("--seed", type=lambda s: int(s, 0),
+                        default=BASE_SEED,
+                        help="base seed for the real problem draw")
+    parser.add_argument("--only", help="substring filter on contract "
+                                       "keys")
+    parser.add_argument("--self-test-mutation", action="store_true",
+                        help="prove both koordpad tiers live: plant "
+                             "one defect per tier in a temp copy and "
+                             "assert each gate fails")
+    parser.add_argument("-v", "--verbose", action="store_true")
+    args = parser.parse_args(argv)
+    if args.self_test_mutation:
+        return self_test_mutation()
+    return run_all(seed=args.seed, verbose=args.verbose, only=args.only)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

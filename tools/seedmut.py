@@ -1,0 +1,93 @@
+"""Seeded-mutation harness shared by the contract gates.
+
+Every koordshape/koordpad gate ships a `--self-test-mutation` mode that
+proves the gate is LIVE: plant one known defect in a temp copy of the
+package, re-run the gate against the mutated tree, and fail unless the
+gate fails for the expected reason. This module is the one
+implementation of that plant-and-rerun loop; the gates
+(tools/shapecheck.py, tools/padcheck.py) supply only their anchors and
+failure markers.
+
+Two kinds of gate are supported by the same entry point:
+  - import gates (shapecheck, padcheck): the temp tree is PREPENDED to
+    PYTHONPATH so the mutated `koordinator_tpu` shadows the real one
+    for the child process;
+  - file gates (koordlint): any "{tree}" placeholder in the argv is
+    substituted with the temp tree path, for tools that read source
+    from a --root rather than importing it.
+
+The working tree is never touched; the temp copy is deleted on exit.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+from dataclasses import dataclass
+from typing import Optional, Sequence
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+PACKAGE = "koordinator_tpu"
+
+
+@dataclass(frozen=True)
+class Mutation:
+    """One planted defect: replace the first occurrence of `anchor`
+    with `replacement` in `relpath` (relative to the repo root)."""
+
+    relpath: str
+    anchor: str
+    replacement: str
+    note: str  # one-line description for the smoke report
+
+
+def check_gate_catches(mutation: Mutation, argv: Sequence[str], *,
+                       marker: Optional[str] = None,
+                       label: str = "gate",
+                       repo_root: str = REPO_ROOT,
+                       timeout: int = 1200) -> int:
+    """Plant `mutation` in a temp copy of the package, run `argv`
+    against it, and return 0 iff the gate FAILED (non-zero exit) with
+    `marker` somewhere in its output — i.e. the gate caught the defect
+    for the right reason. Returns 2 when the anchor has drifted out of
+    the tree (the smoke itself is stale), 1 when the gate let the
+    defect through or failed for an unrelated reason."""
+    with tempfile.TemporaryDirectory(prefix="seedmut-") as td:
+        shutil.copytree(os.path.join(repo_root, PACKAGE),
+                        os.path.join(td, PACKAGE))
+        target = os.path.join(td, mutation.relpath)
+        with open(target, encoding="utf-8") as f:
+            src = f.read()
+        if mutation.anchor not in src:
+            print(f"mutation smoke [{label}]: anchor "
+                  f"{mutation.anchor!r} missing from {mutation.relpath}"
+                  f" — refresh the smoke's anchor", file=sys.stderr)
+            return 2
+        with open(target, "w", encoding="utf-8") as f:
+            f.write(src.replace(mutation.anchor,
+                                mutation.replacement, 1))
+        env = dict(os.environ)
+        env["PYTHONPATH"] = os.pathsep.join(
+            [td, repo_root] + ([env["PYTHONPATH"]]
+                               if env.get("PYTHONPATH") else []))
+        cmd = [a.replace("{tree}", td) for a in argv]
+        proc = subprocess.run(cmd, capture_output=True, text=True,
+                              env=env, cwd=repo_root, timeout=timeout)
+    if proc.returncode == 0:
+        print(f"mutation smoke [{label}]: the gate PASSED a mutated "
+              f"tree ({mutation.note}) — it is not protecting "
+              f"anything", file=sys.stderr)
+        print(proc.stdout, file=sys.stderr)
+        return 1
+    if marker is not None and marker not in proc.stdout + proc.stderr:
+        print(f"mutation smoke [{label}]: the gate failed without the "
+              f"expected marker {marker!r}:", file=sys.stderr)
+        print(proc.stdout + proc.stderr, file=sys.stderr)
+        return 1
+    print(f"mutation smoke [{label}]: {mutation.note} — correctly "
+          f"caught (gate is live)")
+    return 0

@@ -29,10 +29,7 @@ from __future__ import annotations
 import argparse
 import functools
 import os
-import shutil
-import subprocess
 import sys
-import tempfile
 from typing import Dict, List, Optional
 
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
@@ -277,46 +274,20 @@ def run_all(verbose: bool = False) -> int:
 
 # --- the seeded-mutation smoke (gate liveness proof) -----------------------
 
-_MUTATION_FILE = os.path.join("koordinator_tpu", "ops", "feasibility.py")
-_MUTATION_FROM = "return jnp.all("
-_MUTATION_TO = "return jnp.sum("
-
-
 def self_test_mutation() -> int:
     """Flip resource_fit's mask dtype in a TEMP COPY of the package and
     assert the gate fails on it. Leaves the working tree untouched."""
-    with tempfile.TemporaryDirectory(prefix="shapecheck-mut-") as td:
-        shutil.copytree(os.path.join(REPO_ROOT, "koordinator_tpu"),
-                        os.path.join(td, "koordinator_tpu"))
-        target = os.path.join(td, _MUTATION_FILE)
-        with open(target, encoding="utf-8") as f:
-            src = f.read()
-        if _MUTATION_FROM not in src:
-            print(f"mutation smoke: anchor {_MUTATION_FROM!r} missing "
-                  f"from {_MUTATION_FILE}", file=sys.stderr)
-            return 2
-        with open(target, "w", encoding="utf-8") as f:
-            f.write(src.replace(_MUTATION_FROM, _MUTATION_TO, 1))
-        env = dict(os.environ)
-        env["PYTHONPATH"] = os.pathsep.join(
-            [td, REPO_ROOT] + ([env["PYTHONPATH"]]
-                               if env.get("PYTHONPATH") else []))
-        proc = subprocess.run(
-            [sys.executable, os.path.abspath(__file__)],
-            capture_output=True, text=True, env=env, timeout=1200)
-    if proc.returncode == 0:
-        print("mutation smoke: the gate PASSED a flipped dtype — "
-              "shapecheck is not protecting anything", file=sys.stderr)
-        print(proc.stdout, file=sys.stderr)
-        return 1
-    if "dtype drift" not in proc.stdout:
-        print("mutation smoke: the gate failed for the wrong reason:",
-              file=sys.stderr)
-        print(proc.stdout + proc.stderr, file=sys.stderr)
-        return 1
-    print("mutation smoke: flipped dtype in ops/feasibility.py "
-          "correctly failed shapecheck (gate is live)")
-    return 0
+    from tools.seedmut import Mutation, check_gate_catches
+    return check_gate_catches(
+        Mutation(
+            relpath=os.path.join("koordinator_tpu", "ops",
+                                 "feasibility.py"),
+            anchor="return jnp.all(",
+            replacement="return jnp.sum(",
+            note="resource_fit mask flipped jnp.all -> jnp.sum "
+                 "(bool[P,N] becomes i32[P,N])"),
+        [sys.executable, os.path.abspath(__file__)],
+        marker="dtype drift", label="shapecheck")
 
 
 def main(argv: Optional[List[str]] = None) -> int:
