@@ -1,0 +1,29 @@
+"""Contract-keyed AOT compile cache (docs/DESIGN.md "Compile cache &
+columnar packing").
+
+The koordshape contract registry already names every entry point's
+shapes, dtypes and pad semantics, so the scheduler's program set is
+enumerable ahead of time: `precompile` walks STRUCT_SPECS + the
+contract table, materializes ShapeDtypeStruct pytrees for a configured
+working set (including shrunk-mesh variants and the cascade/tail
+program forms), and pre-lowers them through `CompileCache` — a manifest
+layer over JAX's persistent compilation cache keyed by (contract hash,
+mesh axes, jax version, backend). `counters` exposes the JAX
+compilation-cache telemetry the warm-start pins assert on.
+
+STRICTLY OPT-IN: nothing here activates by default. XLA:CPU AOT
+artifacts deserialized on a different machine can segfault (the CI
+hosts live-migrate — see tests/conftest.py), so a cache directory is
+only ever safe same-host, and every consumer (service ctor handle,
+BENCH_COMPILE_CACHE, the warm-cache smoke) passes one explicitly.
+"""
+
+from koordinator_tpu.compilecache.cache import CompileCache  # noqa: F401
+from koordinator_tpu.compilecache.counters import (  # noqa: F401
+    CompileWatcher,
+)
+from koordinator_tpu.compilecache.keys import (  # noqa: F401
+    abstract_digest,
+    cache_key,
+    contract_fingerprint,
+)

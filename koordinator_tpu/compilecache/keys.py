@@ -1,0 +1,164 @@
+"""Cache-key derivation for the AOT compile cache.
+
+A cached executable is only reusable while everything that shaped its
+HLO is unchanged. The key therefore folds together:
+
+  - the CONTRACT FINGERPRINT: a digest of the whole koordshape registry
+    (every contract's arg/return/static/callable/pad specs plus every
+    registered struct's field specs). Editing any spec string — a dim
+    symbol, a pad predicate, a field dtype — changes the fingerprint
+    and hence every key, so a contract change can never serve a stale
+    program. This is deliberately coarser than per-entry invalidation
+    of the underlying XLA artifacts (JAX's persistent cache keys those
+    on the HLO itself); the manifest layer uses the fingerprint to
+    decide which of ITS entries are still trustworthy.
+  - the ABSTRACT SIGNATURE of the inputs: every leaf's path, shape,
+    dtype and (when committed) sharding spec.
+  - the STATIC ARGUMENTS, canonically serialized.
+  - the MESH AXES the program was lowered for (None on single device).
+  - the jax version and backend: an executable is never portable
+    across either.
+
+Pure derivation, no I/O; `cache.CompileCache` owns persistence.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import importlib
+from typing import Any, Dict, Mapping, Optional
+
+# every module that registers contracts or structs: the fingerprint
+# must digest the FULLY populated registry, not whatever the caller
+# happened to import first (two processes warming different subsets
+# would otherwise derive different fingerprints for the same code).
+# Mirrors tools/shapecheck.py CONTRACT_MODULES; tests pin the two in
+# sync.
+CONTRACT_MODULES = (
+    "koordinator_tpu.snapshot.schema",
+    "koordinator_tpu.snapshot.delta",
+    "koordinator_tpu.ops.feasibility",
+    "koordinator_tpu.ops.waterfill",
+    "koordinator_tpu.ops.quota_demand",
+    "koordinator_tpu.scheduler.cascade",
+    "koordinator_tpu.scheduler.core",
+    "koordinator_tpu.scheduler.guards",
+    "koordinator_tpu.compilecache.precompile",
+    "koordinator_tpu.parallel.shardops",
+    "koordinator_tpu.scheduler.plugins.loadaware",
+    "koordinator_tpu.scheduler.plugins.deviceshare",
+    "koordinator_tpu.scheduler.plugins.numaaware",
+    "koordinator_tpu.descheduler.lownodeload_device",
+    "koordinator_tpu.slo_controller.noderesource",
+)
+
+
+def _canon(value: Any) -> str:
+    """Deterministic serialization for static argument values and spec
+    tables (sorted mappings/sets so dict order can't leak into keys)."""
+    if isinstance(value, Mapping):
+        items = ", ".join(f"{_canon(k)}: {_canon(value[k])}"
+                          for k in sorted(value, key=repr))
+        return "{" + items + "}"
+    if isinstance(value, (set, frozenset)):
+        return "{" + ", ".join(sorted(_canon(v) for v in value)) + "}"
+    if isinstance(value, (list, tuple)):
+        body = ", ".join(_canon(v) for v in value)
+        return ("[" if isinstance(value, list) else "(") + body + \
+            ("]" if isinstance(value, list) else ")")
+    if callable(value):
+        # a callable static (step_fn) keys on its dotted name, not its
+        # repr (which carries the object address and would bust the
+        # cache every process)
+        mod = getattr(value, "__module__", "?")
+        name = getattr(value, "__qualname__",
+                       getattr(value, "__name__", repr(value)))
+        return f"<callable {mod}.{name}>"
+    return repr(value)
+
+
+def contract_fingerprint(contracts: Optional[Mapping] = None,
+                         structs: Optional[Mapping] = None) -> str:
+    """sha256 over the canonical serialization of the contract registry
+    (SHAPE_CONTRACTS) + the struct field specs (STRUCT_SPECS).
+
+    `contracts`/`structs` default to the live registry; tests pass
+    doctored copies to pin that mutating a spec string or a field dtype
+    changes the fingerprint (and hence every cache key).
+    """
+    if contracts is None or structs is None:
+        for mod in CONTRACT_MODULES:
+            importlib.import_module(mod)
+        from koordinator_tpu.snapshot import schema
+        if contracts is None:
+            contracts = schema.SHAPE_CONTRACTS
+        if structs is None:
+            structs = schema.STRUCT_SPECS
+    parts = []
+    for key in sorted(contracts):
+        c = contracts[key]
+        parts.append(f"contract {key}")
+        for a in sorted(c.args):
+            parts.append(f"  arg {a} = {_canon(c.args[a])}")
+        parts.append(f"  returns {_canon(c.returns)}")
+        for s in sorted(c.static):
+            parts.append(f"  static {s} = {_canon(c.static[s])}")
+        for s in sorted(c.callables):
+            parts.append(f"  callable {s} = {_canon(c.callables[s])}")
+        parts.append(f"  pad {c.pad!r}")
+    for name in sorted(structs):
+        parts.append(f"struct {name}")
+        for fname in sorted(structs[name]):
+            parts.append(f"  field {fname} = "
+                         f"{_canon(structs[name][fname])}")
+    blob = "\n".join(parts).encode()
+    return hashlib.sha256(blob).hexdigest()
+
+
+def abstract_digest(tree: Any) -> str:
+    """Stable digest of an abstract input pytree: every leaf's tree
+    path, shape, dtype, and sharding spec (committed arrays and
+    sharding-annotated ShapeDtypeStructs carry one; host values
+    don't)."""
+    import jax
+
+    parts = []
+    leaves, treedef = jax.tree_util.tree_flatten_with_path(tree)
+    parts.append(f"treedef {treedef}")
+    for path, leaf in leaves:
+        shape = tuple(getattr(leaf, "shape", ()))
+        dtype = getattr(leaf, "dtype", None)
+        sharding = getattr(leaf, "sharding", None)
+        spec = getattr(sharding, "spec", None)
+        parts.append(f"{jax.tree_util.keystr(path)}: shape={shape} "
+                     f"dtype={dtype} spec={spec}")
+    return hashlib.sha256("\n".join(parts).encode()).hexdigest()
+
+
+def cache_key(program: str, inputs_digest: str,
+              statics: Optional[Dict[str, Any]] = None,
+              mesh_axes: Optional[Dict[str, int]] = None,
+              backend: Optional[str] = None,
+              jax_version: Optional[str] = None,
+              fingerprint: Optional[str] = None) -> str:
+    """The manifest key for one (program, working-set point): sha256
+    over program name, input signature, canonical statics, mesh axes,
+    backend, jax version, and the contract fingerprint."""
+    import jax
+
+    if backend is None:
+        backend = jax.default_backend()
+    if jax_version is None:
+        jax_version = jax.__version__
+    if fingerprint is None:
+        fingerprint = contract_fingerprint()
+    blob = "\n".join([
+        f"program {program}",
+        f"inputs {inputs_digest}",
+        f"statics {_canon(dict(statics or {}))}",
+        f"mesh {_canon(dict(mesh_axes) if mesh_axes else None)}",
+        f"backend {backend}",
+        f"jax {jax_version}",
+        f"contracts {fingerprint}",
+    ]).encode()
+    return hashlib.sha256(blob).hexdigest()

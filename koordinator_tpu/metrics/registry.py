@@ -39,6 +39,15 @@ SCHEDULER_RECOVERY_REPLAYED_RECORDS = \
 SCHEDULER_RECOVERY_SECONDS = "scheduler_recovery_seconds"
 SCHEDULER_MESH_SHRINK_EVENTS = "scheduler_mesh_shrink_events"
 SCHEDULER_MESH_SIZE = "scheduler_mesh_size"
+# warm-start layer (koordinator_tpu/compilecache/): the AOT compile
+# cache's hit/miss ledger, the warmer's per-program cost, and the
+# replay-vs-compile split of recovery time
+SCHEDULER_COMPILE_CACHE_HITS = "scheduler_compile_cache_hits"
+SCHEDULER_COMPILE_CACHE_MISSES = "scheduler_compile_cache_misses"
+SCHEDULER_PRECOMPILE_SECONDS = "scheduler_precompile_seconds"
+SCHEDULER_RECOVERY_REPLAY_SECONDS = "scheduler_recovery_replay_seconds"
+SCHEDULER_RECOVERY_COMPILE_SECONDS = \
+    "scheduler_recovery_compile_seconds"
 
 # --- koordlet (pkg/koordlet/metrics/: cpi.go, psi.go, cpu_suppress.go,
 #     cpu_burst.go, core_sched.go, prediction.go, resource_summary.go,

@@ -541,6 +541,7 @@ class SchedulerService:
                  ladder: Optional[DegradationLadder] = None,
                  retry_policy: Optional[RetryPolicy] = None,
                  journal=None,
+                 compile_cache=None,
                  **schedule_kwargs):
         self.store = store or SnapshotStore()
         self.cfg = cfg if cfg is not None else LoadAwareConfig.make()
@@ -581,6 +582,17 @@ class SchedulerService:
         # per batch under the commit lock, resuming where the journal
         # left off.
         self.journal = journal
+        # warm-start layer (docs/DESIGN.md "Compile cache & columnar
+        # packing"): an optional, STRICTLY OPT-IN compilecache handle.
+        # With one attached, every cycle's device program is ensured
+        # through the cache before dispatch — recover() replays and
+        # mesh-shrink/chunked rung transitions then reuse AOT-compiled
+        # executables (a dict lookup once warm) instead of cold-jitting
+        # at the worst possible moment. None (the default) changes
+        # nothing: no process-global cache config is ever touched.
+        self.compile_cache = compile_cache
+        if compile_cache is not None:
+            compile_cache.activate()
         self.epoch = journal.next_epoch() if journal is not None else 0
         # epochs whose records THIS process appended: a base-version
         # mismatch on one of these is a raced ingest between retry
@@ -817,6 +829,26 @@ class SchedulerService:
                 f"before resuming")
         self._forced_chunks = rec.n_chunks
 
+    def _ensure_cached(self, snap: ClusterSnapshot, pods: PodBatch,
+                       kwargs: dict) -> None:
+        """Request the cycle program from the compile cache before
+        dispatch (no-op without a cache handle). The abstract signature
+        is derived from the CONCRETE inputs — padded/sharded mesh-
+        shrink forms and chunked sub-batch widths key distinct entries,
+        exactly the transitions that used to cold-jit. Best-effort: a
+        cache failure must never fail a scheduling cycle."""
+        if self.compile_cache is None:
+            return
+        from koordinator_tpu.compilecache import precompile
+
+        try:
+            precompile.ensure_cycle_program(
+                self.compile_cache, snap, pods, self.cfg, kwargs,
+                guarded=self.guards_enabled, metrics=self.metrics)
+        except Exception:  # noqa: BLE001 — warmth is advisory
+            log.warning("compile-cache ensure failed; cycle will "
+                        "cold-jit", exc_info=True)
+
     def _run_program(self, snap: ClusterSnapshot, pods: PodBatch,
                      kwargs: dict):
         """One guarded/unguarded device-program invocation ->
@@ -884,12 +916,19 @@ class SchedulerService:
         parts, pod_bads, node_bad, health = [], [], None, None
         start = 0
         chunk_idx = -1
+        ensured_widths = set()
         for size in sizes:
             if size == 0:
                 continue
             chunk_idx += 1
             batch = synthetic.slice_batch(pods, start, size)
             batch = batch.replace(**dict(zip(core.COUNT_FIELDS, counts)))
+            if size not in ensured_widths:
+                # one ensure per DISTINCT sub-batch width: array_split
+                # yields at most two widths per layout, and every later
+                # chunk of the same width is the same program
+                ensured_widths.add(size)
+                self._ensure_cached(snap, batch, kwargs)
             res_i, h_i, nb_i, pb_i = self._run_program(snap, batch, kwargs)
             if self.journal is not None:
                 # the journaled readback is the chunk's COMMIT point
@@ -964,11 +1003,17 @@ class SchedulerService:
                 out = self._run_chunked(snap, pods, kwargs,
                                         self._forced_chunks)
             else:
+                self._ensure_cached(snap, pods, kwargs)
                 out = self._run_program(snap, pods, kwargs)
         elif state.chunked:
             out = self._run_chunked(snap, pods, kwargs,
                                     2 ** state.chunk_splits)
         else:
+            # the normal AND mesh-shrink paths ensure here: on the
+            # shrink rung `snap`/`pods` already carry the padded,
+            # resharded survivor-mesh forms, so the cache key is
+            # exactly the program about to dispatch
+            self._ensure_cached(snap, pods, kwargs)
             out = self._run_program(snap, pods, kwargs)
         if n_real is not None:
             from koordinator_tpu.parallel import mesh as meshlib
@@ -1271,36 +1316,59 @@ class SchedulerService:
         (also kept on `last_recovery`) with the per-epoch results."""
         if self.journal is None:
             raise RuntimeError("recover() needs a commit journal")
+        from koordinator_tpu.compilecache import counters as compile_counters
+
         t0 = time.monotonic()
         restored = False
-        try:
-            self.store.current()
-        except RuntimeError:
-            restored = self.store.restore()
-            if not restored:
-                raise RuntimeError(
-                    "recover(): no snapshot and no readable checkpoint "
-                    "— publish the initial snapshot, then call "
-                    "recover() again to replay the journal")
-        epochs = [e for e in self.journal.epochs()
-                  if self.journal.base_version_of(e) >= self.store.version]
-        results = {}
-        replayed = 0
-        for e in epochs:
-            pods = batches(e) if callable(batches) else batches[e]
-            typed = (typed_pods_by_epoch or {}).get(e)
-            self.epoch = e
-            results[e] = self.schedule(pods, typed_pods=typed)
-            replayed += self._cycle_replayed
-        self.epoch = self.journal.next_epoch()
+        # the whole recovery runs under a compile watcher so the
+        # recorded time splits into what replay actually spent vs what
+        # XLA compilation cost on top — the component a warmed compile
+        # cache deletes (PR 5/6 recoveries were compile-dominated)
+        with compile_counters.watch() as compile_watch:
+            try:
+                self.store.current()
+            except RuntimeError:
+                restored = self.store.restore()
+                if not restored:
+                    raise RuntimeError(
+                        "recover(): no snapshot and no readable checkpoint "
+                        "— publish the initial snapshot, then call "
+                        "recover() again to replay the journal")
+            epochs = [e for e in self.journal.epochs()
+                      if self.journal.base_version_of(e)
+                      >= self.store.version]
+            results = {}
+            replayed = 0
+            for e in epochs:
+                pods = batches(e) if callable(batches) else batches[e]
+                typed = (typed_pods_by_epoch or {}).get(e)
+                self.epoch = e
+                results[e] = self.schedule(pods, typed_pods=typed)
+                replayed += self._cycle_replayed
+            self.epoch = self.journal.next_epoch()
         seconds = time.monotonic() - t0
+        compile_seconds = min(compile_watch.compile_seconds, seconds)
+        replay_seconds = seconds - compile_seconds
         self.metrics.recovery_seconds.observe(seconds)
+        self.metrics.recovery_compile_seconds.observe(compile_seconds)
+        self.metrics.recovery_replay_seconds.observe(replay_seconds)
         self.last_recovery = {
             "restored_checkpoint": restored,
             "epochs_replayed": epochs,
             "records_replayed": replayed,
             "journal_tail": self.journal.tail_reason.value,
             "seconds": seconds,
+            "compile_seconds": compile_seconds,
+            "replay_seconds": replay_seconds,
+            # real XLA compilations during recovery: with a persistent
+            # cache active the cache-miss count is exact (retrievals
+            # don't fire it); without one only the compile-or-retrieve
+            # invocation count exists, and every one is a compile
+            "compiled_programs": (
+                compile_watch.cache_misses
+                if self.compile_cache is not None
+                and self.compile_cache.active
+                else compile_watch.backend_compiles),
             "results": results,
         }
         log.info("recovery complete: %d epoch(s), %d journaled "

@@ -14,6 +14,8 @@ from __future__ import annotations
 
 from koordinator_tpu.metrics import Registry, global_registry
 from koordinator_tpu.metrics.registry import (  # noqa: F401  (re-export)
+    SCHEDULER_COMPILE_CACHE_HITS,
+    SCHEDULER_COMPILE_CACHE_MISSES,
     SCHEDULER_DEGRADATION_LEVEL,
     SCHEDULER_DEGRADED_CYCLES,
     SCHEDULER_DELTA_REJECTED,
@@ -24,8 +26,11 @@ from koordinator_tpu.metrics.registry import (  # noqa: F401  (re-export)
     SCHEDULER_MESH_SHRINK_EVENTS,
     SCHEDULER_MESH_SIZE,
     SCHEDULER_PODS_SCHEDULED,
+    SCHEDULER_PRECOMPILE_SECONDS,
     SCHEDULER_QUARANTINED_INPUTS,
+    SCHEDULER_RECOVERY_COMPILE_SECONDS,
     SCHEDULER_RECOVERY_REPLAYED_RECORDS,
+    SCHEDULER_RECOVERY_REPLAY_SECONDS,
     SCHEDULER_RECOVERY_SECONDS,
     SCHEDULER_SCHEDULE_BATCH_KERNEL_SECONDS,
     SCHEDULER_SCHEDULE_CYCLE_SECONDS,
@@ -117,3 +122,31 @@ class SchedulerMetrics:
             "Devices in the mesh the last scheduling cycle considered "
             "usable (survivors on the mesh-shrink rung, 1 on "
             "single_device, the full fleet otherwise)")
+        # warm-start layer (docs/DESIGN.md "Compile cache & columnar
+        # packing"): program requests the AOT compile cache answered
+        # without an XLA compile vs those that had to compile, the
+        # warmer's per-program cost, and recovery time split into what
+        # replay actually spent vs what compilation cost on top
+        self.compile_cache_hits = r.counter(
+            SCHEDULER_COMPILE_CACHE_HITS,
+            "Cycle-program requests the compile cache served without "
+            "an XLA compilation (in-memory memo or persistent-cache "
+            "absorbed lowering)")
+        self.compile_cache_misses = r.counter(
+            SCHEDULER_COMPILE_CACHE_MISSES,
+            "Cycle-program requests that cost a real XLA compilation "
+            "(new working-set point, contract change, or cold cache)")
+        self.precompile_seconds = r.histogram(
+            SCHEDULER_PRECOMPILE_SECONDS,
+            "Per-program wall time of the AOT warmer "
+            "(compilecache.precompile.warm: lower + compile + persist)")
+        self.recovery_replay_seconds = r.histogram(
+            SCHEDULER_RECOVERY_REPLAY_SECONDS,
+            "Recovery wall time minus compilation: checkpoint restore "
+            "+ journal replay proper (the floor a warm cache drives "
+            "recovery toward)")
+        self.recovery_compile_seconds = r.histogram(
+            SCHEDULER_RECOVERY_COMPILE_SECONDS,
+            "XLA compile-or-retrieve time inside "
+            "SchedulerService.recover() (near zero with a warmed "
+            "compile cache)")

@@ -1,0 +1,219 @@
+"""bf16 columnar packing for the device-resident snapshot.
+
+The snapshot's float columns split into two families:
+
+  EXACT surfaces — the fit/commit gates compare them with exact
+    semantics mirrored from the Go int64 math: NodeState
+    allocatable/requested, numa_cap/numa_free, PodBatch requests, the
+    quota min/max/used/demand/runtime tree, reservation/device free
+    capacity. These stay f32: halving their mantissa would move
+    feasibility boundaries.
+  SCORE/METRIC surfaces — estimator outputs and usage telemetry the
+    scoring paths consume (NodeMetric usage columns, the aggregated
+    percentiles, the assigned-pod estimator accumulators, the per-pod
+    estimated usage). The estimator itself is a heuristic with >>1%
+    model error; carrying these at bf16 (8-bit exponent, 8-bit
+    significand) costs well under that while halving the bytes those
+    columns occupy on device and on the host->device path.
+
+`pack_*` downcasts exactly the PACKABLE columns to bf16; `unpack_*`
+upcasts them back to f32 so every kernel still sees its contracted
+dtype (the values are then bf16-rounded f32). Integer/bool columns
+(ids, validity, groups) are never touched — placements ride integer
+contract surfaces, and the tests pin them bit-identical against the
+f32 oracle.
+
+Pad soundness: a packable column's declared `~pad:` fills must survive
+the round-trip bit-exactly, or the koordpad annihilator reasoning
+(masked reductions meeting exact 0/1/-1/inf fills) breaks under
+packing. `validate_packable()` proves that against STRUCT_SPECS at
+first use, and `tools/padcheck.py --packed` re-runs the whole Tier-B
+differential gate with packed inputs.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Dict, Tuple
+
+import numpy as np
+
+from koordinator_tpu.snapshot import schema
+
+# struct name -> the columns packing may downcast. Membership is a
+# CONTRACT: every entry must be an f32 field whose pad fills are
+# bf16-exact (validate_packable), and must feed scoring — never an
+# exact fit/commit gate.
+PACKABLE: Dict[str, Tuple[str, ...]] = {
+    "NodeState": (
+        "usage",
+        "prod_usage",
+        "agg_usage",
+        "assigned_estimated",
+        "assigned_correction",
+        "prod_assigned_estimated",
+        "prod_assigned_correction",
+    ),
+    "PodBatch": (
+        "estimated",
+    ),
+}
+
+# bf16 rounding is 2^-8 relative per element; scoring sums a handful
+# of rounded terms, so the documented equivalence tolerance for packed
+# float outputs is a few ulps on top (docs/DESIGN.md "bf16 tolerance
+# policy"). Integer/bool outputs get NO tolerance: bit-identical.
+PACK_RTOL = 0.02
+PACK_ATOL = 0.02
+
+_PAD_TOKEN = re.compile(r"~pad:([a-z0-9-]+)")
+
+_validated = False
+
+
+def _bf16():
+    import jax.numpy as jnp
+    return jnp.bfloat16
+
+
+def validate_packable() -> None:
+    """Prove the PACKABLE table against the registered struct specs:
+    every column exists, is f32 (optionally-absent allowed), and every
+    pad predicate it declares has a bf16-exact fill. Raises ValueError
+    on any violation — packing an unproven column is a contract bug,
+    not a runtime condition."""
+    global _validated
+    if _validated:
+        return
+    bf16 = np.dtype(_bf16().dtype) if hasattr(_bf16(), "dtype") \
+        else _bf16()
+    errors = []
+    for struct, fields in PACKABLE.items():
+        specs = schema.STRUCT_SPECS.get(struct)
+        if specs is None:
+            errors.append(f"{struct}: struct not registered")
+            continue
+        for field in fields:
+            raw = specs.get(field)
+            if raw is None:
+                errors.append(f"{struct}.{field}: no spec")
+                continue
+            if not isinstance(raw, str):
+                errors.append(f"{struct}.{field}: tuple spec "
+                              f"not packable")
+                continue
+            if not raw.lstrip("?").startswith("f32["):
+                errors.append(f"{struct}.{field}: dtype is not f32 "
+                              f"({raw!r})")
+                continue
+            for pred in _PAD_TOKEN.findall(raw):
+                fill = schema.PAD_FILL_VALUES.get(pred)
+                if fill is None:
+                    continue  # invalid/any: no fill promised
+                rt = np.asarray(fill, np.float32).astype(bf16) \
+                    .astype(np.float32)
+                if not (rt == np.float32(fill) or
+                        (np.isinf(rt) and np.isinf(np.float32(fill)))):
+                    errors.append(
+                        f"{struct}.{field}: pad fill {fill!r} "
+                        f"(~pad:{pred}) is not bf16-exact")
+    if errors:
+        raise ValueError("packing contract violated:\n  " +
+                         "\n  ".join(errors))
+    _validated = True
+
+
+def _convert(value: Any, struct: str, dtype) -> Any:
+    """One struct instance with its PACKABLE columns cast to dtype
+    (None optionals pass through)."""
+    import jax.numpy as jnp
+    validate_packable()
+    updates = {}
+    for field in PACKABLE[struct]:
+        col = getattr(value, field)
+        if col is None:
+            continue
+        updates[field] = jnp.asarray(col).astype(dtype)
+    return value.replace(**updates) if updates else value
+
+
+def pack_nodes(nodes) -> Any:
+    return _convert(nodes, "NodeState", _bf16())
+
+
+def unpack_nodes(nodes) -> Any:
+    import jax.numpy as jnp
+    return _convert(nodes, "NodeState", jnp.float32)
+
+
+def pack_pods(pods) -> Any:
+    return _convert(pods, "PodBatch", _bf16())
+
+
+def unpack_pods(pods) -> Any:
+    import jax.numpy as jnp
+    return _convert(pods, "PodBatch", jnp.float32)
+
+
+def pack_snapshot(snap):
+    """ClusterSnapshot with its NodeState score/metric columns stored
+    bf16. Quota/reservation/device capacity surfaces are exact-gate
+    inputs and stay f32."""
+    return snap.replace(nodes=pack_nodes(snap.nodes))
+
+
+def unpack_snapshot(snap):
+    return snap.replace(nodes=unpack_nodes(snap.nodes))
+
+
+def roundtrip_snapshot(snap):
+    """The values a packed snapshot presents to the kernels: f32
+    columns carrying bf16-rounded content. Tests and padcheck --packed
+    run the scheduler on this against the unpacked oracle."""
+    return unpack_snapshot(pack_snapshot(snap))
+
+
+def roundtrip_pods(pods):
+    return unpack_pods(pack_pods(pods))
+
+
+def roundtrip_tree(tree):
+    """Apply the pack/unpack round-trip to every NodeState/PodBatch
+    instance inside an arbitrary pytree (ClusterSnapshot included),
+    leaving everything else untouched."""
+    import jax
+
+    classes = tuple(schema.STRUCT_CLASSES[name] for name in PACKABLE
+                    if name in schema.STRUCT_CLASSES)
+
+    def visit(value):
+        if isinstance(value, schema.STRUCT_CLASSES.get("NodeState", ())):
+            return unpack_nodes(pack_nodes(value))
+        if isinstance(value, schema.STRUCT_CLASSES.get("PodBatch", ())):
+            return unpack_pods(pack_pods(value))
+        return value
+
+    return jax.tree_util.tree_map(
+        visit, tree, is_leaf=lambda v: isinstance(v, classes))
+
+
+def packed_savings(snap, pods=None) -> dict:
+    """Bytes the packed layout saves: each packable f32 column drops
+    half its payload. Reported by the bench stamp so the win is
+    visible next to the timing it buys."""
+    saved = 0
+    total = 0
+    import jax
+
+    for leaf in jax.tree_util.tree_leaves((snap,) if pods is None
+                                          else (snap, pods)):
+        total += getattr(leaf, "nbytes", 0)
+    for struct, owner in (("NodeState", getattr(snap, "nodes", snap)),
+                          ("PodBatch", pods)):
+        if owner is None:
+            continue
+        for field in PACKABLE[struct]:
+            col = getattr(owner, field, None)
+            if col is not None and np.dtype(col.dtype) == np.float32:
+                saved += col.nbytes // 2
+    return {"bytes_total": int(total), "bytes_saved": int(saved)}

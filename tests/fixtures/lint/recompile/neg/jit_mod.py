@@ -33,3 +33,17 @@ def pytree_tuple(xs: tuple):
     # a tuple-annotated param is an ordinary traced pytree, not a
     # static-argnames candidate
     return xs[0] + xs[1]
+
+
+@functools.partial(jax.jit, static_argnames=("k", "names"))
+def hashable_statics(x, k: int = 4, names: tuple = ()):
+    # int/tuple statics are hashable — no RC004
+    return x * k
+
+
+def run(x):
+    # a literal into a STATIC param is exactly what static_argnames is
+    # for, and a wrapped scalar into a traced param carries its dtype
+    a = hashable_statics(x, 8, names=("cpu",))
+    b = arrays_only(x, jnp.asarray(0.5))
+    return a + b

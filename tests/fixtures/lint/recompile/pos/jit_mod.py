@@ -1,6 +1,7 @@
 """Positive recompilation-hazard fixtures."""
 
 import functools
+import time
 
 import jax
 
@@ -18,3 +19,18 @@ def branchy(x, flag, k):
     if x.shape[0] > 2:             # RC003: per-shape specialization
         return x + 1
     return x
+
+
+@functools.partial(jax.jit, static_argnames=("opts", "seed"))
+def keyed(x, opts: list, seed=()):
+    # RC004 (signature): `opts` is static but annotated `list` —
+    # jit's cache key raises on unhashable statics
+    return x[0] * len(opts) + seed[0] if seed else x[0]
+
+
+def run(x):
+    # RC004 (call site): a static fed from time.* re-keys per call
+    a = keyed(x, ("p",), seed=(time.monotonic(),))
+    # RC005: bare float literal into traced `flag` — weak-typed scalar
+    b = branchy(x, 0.5, k=3)
+    return a + b

@@ -60,7 +60,7 @@ def empty_baseline(tmp_path):
 
 @pytest.mark.parametrize("fixture_dir,expected_codes", [
     ("host_sync", {"HS001", "HS002", "HS003", "HS004", "HS005"}),
-    ("recompile", {"RC001", "RC002", "RC003"}),
+    ("recompile", {"RC001", "RC002", "RC003", "RC004", "RC005"}),
     ("donation", {"DA001"}),
     ("lock_discipline", {"LK001", "LK002", "LK003", "LK004", "LK005"}),
     ("metric_names", {"MN001", "MN002", "MN003", "MN004"}),

@@ -1,0 +1,162 @@
+"""bf16 columnar packing (ISSUE 17): the PACKABLE table is proven
+against the registry (pad fills bf16-exact, f32-only, score/metric
+surfaces only), the round-trip touches exactly the packed columns, and
+the equivalence pins — placements on integer surfaces BIT-IDENTICAL to
+the f32 oracle at pinned seeds, float outputs inside the documented
+PACK_RTOL/PACK_ATOL envelope.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from koordinator_tpu.metrics import Registry
+from koordinator_tpu.scheduler.frameworkext import SchedulerService
+from koordinator_tpu.scheduler.metrics_defs import SchedulerMetrics
+from koordinator_tpu.snapshot import packing, schema
+from koordinator_tpu.utils import synthetic
+
+N, P = 16, 32
+
+
+def inputs(seed=0):
+    snap = synthetic.synthetic_cluster(N, seed=seed, num_quotas=4,
+                                       num_gangs=4)
+    pods = synthetic.synthetic_pods(P, seed=seed + 3, num_quotas=4,
+                                    num_gangs=4)
+    return snap, pods
+
+
+def make_service():
+    svc = SchedulerService(metrics=SchedulerMetrics(Registry()),
+                           num_rounds=2, k_choices=4, guards=False)
+    svc._sleep = lambda _s: None
+    return svc
+
+
+# --- the packing contract --------------------------------------------------
+
+def test_packable_table_validates_against_live_registry():
+    packing.validate_packable()  # raises on any violation
+
+
+def test_every_packable_column_is_a_score_surface():
+    """Membership pin: the exact fit/commit surfaces must never appear
+    in PACKABLE — halving their mantissa moves feasibility
+    boundaries."""
+    exact = {("NodeState", f) for f in
+             ("allocatable", "requested", "numa_cap", "numa_free")} | \
+            {("PodBatch", "requests")}
+    packed = {(s, f) for s, fields in packing.PACKABLE.items()
+              for f in fields}
+    assert not (packed & exact), packed & exact
+
+
+def test_unknown_column_fails_validation(monkeypatch):
+    monkeypatch.setattr(packing, "_validated", False)
+    monkeypatch.setitem(packing.PACKABLE, "NodeState",
+                        packing.PACKABLE["NodeState"] + ("no_such",))
+    with pytest.raises(ValueError, match="no_such"):
+        packing.validate_packable()
+
+
+def test_non_f32_column_fails_validation(monkeypatch):
+    monkeypatch.setattr(packing, "_validated", False)
+    monkeypatch.setitem(packing.PACKABLE, "NodeState",
+                        ("label_group",))  # i32: ids must never pack
+    with pytest.raises(ValueError, match="not f32"):
+        packing.validate_packable()
+
+
+def test_declared_pad_fills_are_bf16_exact():
+    """Every concrete pad fill the registry can promise (0/1/-1/inf)
+    must survive the bf16 round-trip bit-exactly, or masked reductions
+    meeting pad rows break under packing."""
+    for pred, fill in schema.PAD_FILL_VALUES.items():
+        if fill is None:
+            continue
+        rt = np.asarray(fill, np.float32).astype(jnp.bfloat16) \
+            .astype(np.float32)
+        if np.isinf(np.float32(fill)):
+            assert np.isinf(rt) and rt > 0, pred
+        else:
+            assert rt == np.float32(fill), pred
+
+
+# --- round-trip mechanics --------------------------------------------------
+
+def test_pack_touches_exactly_the_packable_columns():
+    snap, pods = inputs(0)
+    packed = packing.pack_snapshot(snap)
+    for field in packing.PACKABLE["NodeState"]:
+        col = getattr(packed.nodes, field)
+        if col is not None:
+            assert col.dtype == jnp.bfloat16, field
+    # exact surfaces ride through UNTOUCHED (same arrays, not copies)
+    assert packed.nodes.allocatable is snap.nodes.allocatable
+    assert packed.nodes.requested is snap.nodes.requested
+    assert packed.nodes.label_group is snap.nodes.label_group
+    assert packed.quotas is snap.quotas
+
+    ppods = packing.pack_pods(pods)
+    assert ppods.estimated.dtype == jnp.bfloat16
+    assert ppods.requests is pods.requests
+
+    back = packing.unpack_snapshot(packed)
+    for field in packing.PACKABLE["NodeState"]:
+        col = getattr(back.nodes, field)
+        if col is not None:
+            assert col.dtype == jnp.float32, field
+            np.testing.assert_allclose(
+                np.asarray(col),
+                np.asarray(getattr(snap.nodes, field)),
+                rtol=packing.PACK_RTOL, atol=packing.PACK_ATOL)
+
+
+def test_roundtrip_tree_finds_structs_inside_pytrees():
+    snap, pods = inputs(1)
+    tree = {"snap": snap, "pods": pods, "other": jnp.ones(3)}
+    rt = packing.roundtrip_tree(tree)
+    want = np.asarray(packing.roundtrip_pods(pods).estimated)
+    np.testing.assert_array_equal(np.asarray(rt["pods"].estimated), want)
+    np.testing.assert_array_equal(np.asarray(rt["other"]), np.ones(3))
+    np.testing.assert_array_equal(
+        np.asarray(rt["snap"].nodes.usage),
+        np.asarray(packing.roundtrip_snapshot(snap).nodes.usage))
+
+
+def test_packed_savings_counts_half_the_packable_bytes():
+    snap, pods = inputs(2)
+    stats = packing.packed_savings(snap, pods)
+    want = sum(getattr(snap.nodes, f).nbytes // 2
+               for f in packing.PACKABLE["NodeState"]
+               if getattr(snap.nodes, f) is not None) + \
+        pods.estimated.nbytes // 2
+    assert stats["bytes_saved"] == want > 0
+    assert stats["bytes_total"] > stats["bytes_saved"]
+
+
+# --- equivalence pins ------------------------------------------------------
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_placements_bit_identical_to_f32_oracle(seed):
+    """The acceptance pin: scheduling a bf16-round-tripped snapshot
+    and batch places every pod on exactly the node the f32 oracle
+    picks — integer surfaces carry no tolerance — and the committed
+    float state stays inside the documented envelope."""
+    snap, pods = inputs(seed)
+    oracle = make_service()
+    oracle.publish(snap)
+    want = oracle.schedule(pods)
+
+    svc = make_service()
+    svc.publish(packing.roundtrip_snapshot(snap))
+    got = svc.schedule(packing.roundtrip_pods(pods))
+
+    np.testing.assert_array_equal(np.asarray(got.assignment),
+                                  np.asarray(want.assignment))
+    np.testing.assert_allclose(
+        np.asarray(svc.store.current().nodes.requested),
+        np.asarray(oracle.store.current().nodes.requested),
+        rtol=packing.PACK_RTOL, atol=packing.PACK_ATOL)

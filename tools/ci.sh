@@ -72,6 +72,15 @@ echo "=== crash smoke (kill-injected recovery matrix, CPU) ==="
 # torn tails surfaced with a typed reason (tools/crash_smoke.py)
 JAX_PLATFORMS=cpu python tools/crash_smoke.py
 
+echo "=== warm-cache smoke (compile-cache warm-start gate, CPU) ==="
+# the flagship cycle runs in three REAL child processes against ONE
+# compile-cache dir: cold (compiles, populates manifest), warm (ZERO
+# XLA compilations, placements bit-identical), restart recovery
+# (compiled_programs == 0, replay bit-identical) — the cross-process
+# warm-start contract (tools/warm_cache_smoke.py); same-host only by
+# construction, the dir lives and dies inside the stage
+JAX_PLATFORMS=cpu python tools/warm_cache_smoke.py
+
 echo "=== tier-1 tests (JAX_PLATFORMS=cpu) ==="
 set -o pipefail
 rm -f /tmp/_t1.log

@@ -14,6 +14,18 @@ Codes:
          specialization; intentional specialization should flow through
          a named local or a static argument so the dependence is
          explicit (the scheduler's `n_inst = ...shape[1]` idiom)
+  RC004  a cache-busting static: a static_argnames parameter whose
+         annotation/default is unhashable (list/set/dict — jit's cache
+         key raises on it), or a call site feeding a static from a
+         non-deterministic source (time.*/random.*/uuid.*/os.urandom)
+         — every call mints a fresh cache key, so the "cached" program
+         recompiles per call and a persistent compile cache can never
+         hit
+  RC005  a bare Python numeric literal passed as a TRACED argument at
+         a jit-entry call site: the scalar enters the trace weak-typed,
+         so the executable cache keys it differently from an array of
+         the same value — alternating callers silently double-compile.
+         Wrap it (jnp.asarray(v, dtype)) or mark the parameter static.
 """
 
 from __future__ import annotations
@@ -21,7 +33,7 @@ from __future__ import annotations
 import ast
 from typing import Iterable, List, Optional, Set, Tuple
 
-from tools.lint.astutil import param_names
+from tools.lint.astutil import call_target, positional_params
 from tools.lint.callgraph import project_index, ProjectIndex
 from tools.lint.framework import Analyzer, Finding, Project, register
 
@@ -29,6 +41,15 @@ from tools.lint.framework import Analyzer, Finding, Project, register
 # (static_argnames on one would raise on unhashable arrays)
 SCALAR_ANNOTATIONS = {"int", "bool", "str", "float", "dict"}
 SCALAR_DEFAULTS = (int, bool, str, float)
+
+# static_argnames values must be hashable; these annotations/literal
+# defaults never are
+UNHASHABLE_ANNOTATIONS = {"list", "set", "dict", "List", "Set", "Dict"}
+
+# sources whose every call yields a fresh value: a static derived from
+# one re-keys (and recompiles) the program per call
+NONDET_MODULE_HEADS = {"time", "random", "uuid", "secrets"}
+NONDET_CALLS = {"os.urandom", "os.getpid", "os.getrandom", "id"}
 
 
 def _scalar_annotation(node: Optional[ast.AST]) -> Optional[str]:
@@ -66,6 +87,10 @@ class RecompileAnalyzer(Analyzer):
     def run(self, project: Project) -> Iterable[Finding]:
         index = project_index(project)
         findings: List[Finding] = []
+        # decorator-form entries are callable by their own name; the
+        # assignment form (g = jax.jit(f)) jits only calls through the
+        # alias, so direct f(...) call sites are not jit dispatches
+        entries = {}
         for entry in index.jit_entries():
             fn = entry.fn.node
             rel = entry.fn.module.relpath
@@ -74,6 +99,10 @@ class RecompileAnalyzer(Analyzer):
             findings.extend(self._check_signature(fn, rel, qual, statics))
             findings.extend(self._check_branches(
                 fn, rel, qual, entry.traced_params))
+            if entry.alias_name is None:
+                entries[id(fn)] = entry
+        for mi in index.modules.values():
+            findings.extend(self._check_call_sites(index, mi, entries))
         return sorted(findings, key=lambda f: (f.path, f.line, f.code))
 
     @staticmethod
@@ -87,6 +116,17 @@ class RecompileAnalyzer(Analyzer):
             list(zip(args.kwonlyargs, args.kw_defaults))
         for param, default in params:
             if param.arg in statics:
+                why = _unhashable_static(param.annotation, default)
+                if why is not None:
+                    yield Finding(
+                        analyzer="recompilation-hazard", code="RC004",
+                        path=rel, line=param.lineno,
+                        message=f"jitted `{qual}` marks `{param.arg}` "
+                                f"static but its {why} is unhashable: "
+                                f"jit's cache key requires hashable "
+                                f"statics — pass a tuple/frozenset "
+                                f"instead",
+                        key=f"{qual}:static:{param.arg}")
                 continue
             why = _scalar_annotation(param.annotation)
             if why is None and isinstance(default, ast.Constant) \
@@ -104,6 +144,64 @@ class RecompileAnalyzer(Analyzer):
                         f"static_argnames: each distinct value risks a "
                         f"silent retrace (strs/dicts always do)",
                 key=f"{qual}:{param.arg}")
+
+    def _check_call_sites(self, index: ProjectIndex, mi,
+                          entries) -> Iterable[Finding]:
+        """RC004 (non-deterministic statics) / RC005 (weak-type scalar
+        literals) at every resolvable call of a decorator-form jit
+        entry. Each call site is visited exactly once: module-level
+        statements with the module as scope, each function's own
+        statements with its scope chain (nested defs excluded — they
+        are their own FunctionInfo)."""
+        scopes = [((mi.module.tree,), mi.module.tree)]
+        for info in mi.functions:
+            scopes.append((info.scope_chain + (info.node,), info.node))
+        for chain, owner in scopes:
+            for call in _own_calls(owner):
+                callee = index.resolve_call(mi, chain, call)
+                if callee is None:
+                    continue
+                entry = entries.get(id(callee.node))
+                if entry is None:
+                    continue
+                statics = set(entry.static_argnames)
+                traced = entry.traced_params
+                qual = entry.fn.qualname
+                for param, value in _bind_call_args(entry, call):
+                    if param in statics:
+                        src = _nondet_source(value)
+                        if src is not None:
+                            yield Finding(
+                                analyzer="recompilation-hazard",
+                                code="RC004", path=mi.module.relpath,
+                                line=value.lineno,
+                                message=f"call to jitted `{qual}` "
+                                        f"derives static `{param}` "
+                                        f"from non-deterministic "
+                                        f"`{src}`: every call mints a "
+                                        f"fresh cache key, so the "
+                                        f"program recompiles per call "
+                                        f"and a persistent compile "
+                                        f"cache can never hit",
+                                key=f"{qual}:nondet:{param}")
+                    elif param in traced:
+                        lit = _numeric_literal(value)
+                        if lit is not None:
+                            yield Finding(
+                                analyzer="recompilation-hazard",
+                                code="RC005", path=mi.module.relpath,
+                                line=value.lineno,
+                                message=f"call to jitted `{qual}` "
+                                        f"passes bare Python {lit} "
+                                        f"literal as traced "
+                                        f"`{param}`: weak-typed "
+                                        f"scalars key the executable "
+                                        f"cache differently from "
+                                        f"arrays of the same value — "
+                                        f"wrap it (jnp.asarray(v, "
+                                        f"dtype)) or mark the "
+                                        f"parameter static",
+                                key=f"{qual}:weak:{param}")
 
     @staticmethod
     def _check_branches(fn, rel: str, qual: str,
@@ -132,6 +230,87 @@ class RecompileAnalyzer(Analyzer):
                             f"value — use jnp.where/lax.cond, or mark "
                             f"the parameter static",
                     key=f"{qual}:branch:{name}")
+
+
+def _unhashable_static(annotation: Optional[ast.AST],
+                       default: Optional[ast.AST]) -> Optional[str]:
+    """'annotation `list`' / 'default literal' when a static parameter
+    is declared or defaulted unhashable; None otherwise."""
+    node = annotation
+    if isinstance(node, ast.Subscript):
+        node = node.value
+    if isinstance(node, ast.Name) and node.id in UNHASHABLE_ANNOTATIONS:
+        return f"annotation `{node.id}`"
+    if isinstance(default, ast.List):
+        return "default (a list literal)"
+    if isinstance(default, ast.Set):
+        return "default (a set literal)"
+    if isinstance(default, ast.Dict):
+        return "default (a dict literal)"
+    return None
+
+
+def _own_calls(owner: ast.AST):
+    """Every ast.Call in `owner`'s own statements, NOT descending into
+    nested function/lambda bodies (those are scanned as their own
+    scopes)."""
+    stack = list(ast.iter_child_nodes(owner))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue
+        if isinstance(node, ast.Call):
+            yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _bind_call_args(entry, call: ast.Call):
+    """(param_name, value_expr) for the call's explicit arguments
+    (starred/dict-splat arguments can't be bound statically)."""
+    pos = positional_params(entry.fn.node)
+    bound = []
+    for i, arg in enumerate(call.args):
+        if isinstance(arg, ast.Starred):
+            break
+        if i < len(pos):
+            bound.append((pos[i], arg))
+    for kw in call.keywords:
+        if kw.arg is not None:
+            bound.append((kw.arg, kw.value))
+    return bound
+
+
+def _nondet_source(expr: ast.AST) -> Optional[str]:
+    """The dotted name of a non-deterministic call anywhere inside
+    `expr` (time.monotonic(), np.random.random(), uuid.uuid4(), ...),
+    or None."""
+    for node in ast.walk(expr):
+        if not isinstance(node, ast.Call):
+            continue
+        dotted = call_target(node)
+        if dotted is None:
+            continue
+        parts = dotted.split(".")
+        if parts[0] in NONDET_MODULE_HEADS \
+                or (len(parts) > 1 and parts[1] == "random") \
+                or dotted in NONDET_CALLS:
+            return dotted
+    return None
+
+
+def _numeric_literal(expr: ast.AST) -> Optional[str]:
+    """'int'/'float' when `expr` is a bare numeric literal (unary +/-
+    included; bools excluded — a traced bool literal is the
+    lax.cond-predicate idiom, not a dtype hazard)."""
+    node = expr
+    if isinstance(node, ast.UnaryOp) \
+            and isinstance(node.op, (ast.UAdd, ast.USub)):
+        node = node.operand
+    if isinstance(node, ast.Constant) \
+            and type(node.value) in (int, float):
+        return type(node.value).__name__
+    return None
 
 
 def _scan_test(test: ast.AST,

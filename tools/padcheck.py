@@ -247,7 +247,8 @@ def compare_outputs(spec: Optional[Spec], o0, ox, real: Dict[str, int],
     errors.append(f"{where}: unhandled spec {spec!r}")
 
 
-def run_contract(key: str, contract, seed: int) -> List[str]:
+def run_contract(key: str, contract, seed: int,
+                 packed: bool = False) -> List[str]:
     import functools
 
     import jax
@@ -281,6 +282,16 @@ def run_contract(key: str, contract, seed: int) -> List[str]:
     import jax.numpy as jnp
     kw0 = jax.tree_util.tree_map(jnp.asarray, kw0)
     kwx = jax.tree_util.tree_map(jnp.asarray, kwx)
+    if packed:
+        # --packed: both runs consume bf16-round-tripped score/metric
+        # columns (snapshot/packing.PACKABLE). The differential
+        # assertions are unchanged — pad inertness and band discipline
+        # must hold under packing exactly as they do at full f32
+        # (packable pad fills are proven bf16-exact, so the bands stay
+        # bit-exact through the round-trip).
+        from koordinator_tpu.snapshot import packing
+        kw0 = packing.roundtrip_tree(kw0)
+        kwx = packing.roundtrip_tree(kwx)
     try:
         out0 = jax.device_get(fn(**kw0))
         outx = jax.device_get(fn(**kwx))
@@ -295,7 +306,7 @@ def run_contract(key: str, contract, seed: int) -> List[str]:
 
 
 def run_all(seed: int = BASE_SEED, verbose: bool = False,
-            only: Optional[str] = None) -> int:
+            only: Optional[str] = None, packed: bool = False) -> int:
     import importlib
 
     import jax
@@ -313,15 +324,17 @@ def run_all(seed: int = BASE_SEED, verbose: bool = False,
         if only is not None and only not in key:
             continue
         total += 1
-        errs = run_contract(key, SHAPE_CONTRACTS[key], seed)
+        errs = run_contract(key, SHAPE_CONTRACTS[key], seed,
+                            packed=packed)
         if errs:
             failures += 1
             for e in errs:
                 print(f"FAIL {e}")
         elif verbose:
             print(f"ok   {key}")
+    mode = "bf16-packed inputs" if packed else "zero-pad vs padded runs"
     print(f"padcheck: {total - failures}/{total} contracts pad-inert "
-          f"under zero-pad vs padded runs (seed={seed:#x})")
+          f"under {mode} (seed={seed:#x})")
     return 1 if failures else 0
 
 
@@ -365,6 +378,10 @@ def main(argv: Optional[List[str]] = None) -> int:
                         help="base seed for the real problem draw")
     parser.add_argument("--only", help="substring filter on contract "
                                        "keys")
+    parser.add_argument("--packed", action="store_true",
+                        help="run both differential legs on bf16-"
+                             "round-tripped score/metric columns "
+                             "(snapshot/packing.PACKABLE)")
     parser.add_argument("--self-test-mutation", action="store_true",
                         help="prove both koordpad tiers live: plant "
                              "one defect per tier in a temp copy and "
@@ -373,7 +390,8 @@ def main(argv: Optional[List[str]] = None) -> int:
     args = parser.parse_args(argv)
     if args.self_test_mutation:
         return self_test_mutation()
-    return run_all(seed=args.seed, verbose=args.verbose, only=args.only)
+    return run_all(seed=args.seed, verbose=args.verbose, only=args.only,
+                   packed=args.packed)
 
 
 if __name__ == "__main__":

@@ -58,6 +58,7 @@ CONTRACT_MODULES = (
     "koordinator_tpu.scheduler.cascade",
     "koordinator_tpu.scheduler.core",
     "koordinator_tpu.scheduler.guards",
+    "koordinator_tpu.compilecache.precompile",
     "koordinator_tpu.parallel.shardops",
     "koordinator_tpu.scheduler.plugins.loadaware",
     "koordinator_tpu.scheduler.plugins.deviceshare",
