@@ -176,6 +176,44 @@ class Histogram(_Metric):
             h = self._hist.get(key)
             return 0.0 if h is None else h[len(self.buckets) + 1]
 
+    def percentile(self, q: float, *values: str) -> Optional[float]:
+        """Bucketed quantile estimate (Prometheus histogram_quantile
+        semantics): find the first bucket whose CUMULATIVE count
+        reaches q*total and interpolate linearly inside it, taking the
+        lowest bucket's lower bound as 0 (latencies are non-negative)
+        and clamping the +Inf bucket to the last finite bound.
+
+        Returns None for an empty child. Accuracy is bounded by bucket
+        width — tests/test_trace.py pins it against numpy.quantile
+        within that bound.
+        """
+        if not 0.0 <= q <= 1.0:
+            raise ValueError(f"quantile must be in [0, 1], got {q}")
+        key = _validate_labels(self.label_names, values)
+        with self._lock:
+            h = self._hist.get(key)
+            if h is None:
+                return None
+            total = h[len(self.buckets)]
+            if total <= 0:
+                return None
+            target = q * total
+            prev_bound = 0.0
+            prev_count = 0.0
+            for i, b in enumerate(self.buckets):
+                if h[i] >= target:
+                    in_bucket = h[i] - prev_count
+                    if in_bucket <= 0:
+                        return float(b)
+                    frac = (target - prev_count) / in_bucket
+                    return prev_bound + (float(b) - prev_bound) * frac
+                prev_bound = float(b)
+                prev_count = h[i]
+            # q falls in the +Inf bucket: no finite upper bound to
+            # interpolate toward, so report the last finite bound
+            # (Prometheus does the same)
+            return float(self.buckets[-1]) if self.buckets else None
+
     def samples(self) -> List[Tuple[str, Tuple[Tuple[str, str], ...], float]]:
         out = []
         with self._lock:

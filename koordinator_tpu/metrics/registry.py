@@ -48,6 +48,11 @@ SCHEDULER_PRECOMPILE_SECONDS = "scheduler_precompile_seconds"
 SCHEDULER_RECOVERY_REPLAY_SECONDS = "scheduler_recovery_replay_seconds"
 SCHEDULER_RECOVERY_COMPILE_SECONDS = \
     "scheduler_recovery_compile_seconds"
+# koordtrace observability plane (koordinator_tpu/obs/): span-buffer
+# overflow accounting and the per-phase cycle-time breakdown every
+# closed host span feeds (phase label values come from obs/phases.py)
+SCHEDULER_TRACE_SPANS_DROPPED = "scheduler_trace_spans_dropped"
+SCHEDULER_CYCLE_PHASE_SECONDS = "scheduler_cycle_phase_seconds"
 
 # --- koordlet (pkg/koordlet/metrics/: cpi.go, psi.go, cpu_suppress.go,
 #     cpu_burst.go, core_sched.go, prediction.go, resource_summary.go,

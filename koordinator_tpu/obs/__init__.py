@@ -1,0 +1,35 @@
+"""koordtrace: the observability plane (docs/OBSERVABILITY.md).
+
+Three pieces:
+  * `obs.trace` — the bounded span tracer threaded through
+    `SchedulerService` cycles (host spans),
+  * `obs.phases` — the shared phase-name table every span /
+    named_scope label comes from (koordlint OB001 enforces it),
+  * `obs.export` — chrome|jsonl|prom rendering of a span buffer plus
+    the metrics registry.
+
+`phase(name)` is THE way kernel code opens a named region: a
+`jax.named_scope` whose label is validated against the table, so
+device-side profiler streams and host-side spans can never drift
+apart. named_scope is pure metadata (it only names HLO ops) — it
+cannot perturb shapes, pads, or placement results, which is why the
+koordshape/koordpad gates stay untouched by annotation.
+"""
+
+from koordinator_tpu.obs import phases  # noqa: F401
+from koordinator_tpu.obs.phases import ALL_PHASES, check_phase  # noqa: F401
+from koordinator_tpu.obs.trace import (  # noqa: F401
+    NOOP_SPAN, SpanRecord, Tracer, jsonl_record,
+)
+
+
+def phase(name: str):
+    """A validated `jax.named_scope` for one kernel phase region.
+
+    Raises ValueError on a name missing from obs/phases.py (the
+    runtime complement of koordlint OB001). Import of jax is deferred
+    so the obs package stays importable in device-free tooling.
+    """
+    import jax
+
+    return jax.named_scope(check_phase(name))

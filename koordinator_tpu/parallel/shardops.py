@@ -34,6 +34,8 @@ import jax.numpy as jnp
 from jax.experimental.shard_map import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
+from koordinator_tpu import obs
+from koordinator_tpu.obs import phases as obs_phases
 from koordinator_tpu.parallel.mesh import (
     NODE_AXIS,
     node_shards,
@@ -88,9 +90,10 @@ def topk_merge(vals: jnp.ndarray, idxs: jnp.ndarray
     slicing the merged row to k is bit-identical to `lax.top_k` over
     the full row — including ties, which lax.top_k breaks toward the
     lowest index."""
-    order = jnp.lexsort((idxs, -vals), axis=-1)
-    return (jnp.take_along_axis(vals, order, axis=-1),
-            jnp.take_along_axis(idxs, order, axis=-1))
+    with obs.phase(obs_phases.PHASE_ICI_MERGE):
+        order = jnp.lexsort((idxs, -vals), axis=-1)
+        return (jnp.take_along_axis(vals, order, axis=-1),
+                jnp.take_along_axis(idxs, order, axis=-1))
 
 
 def shard_local_topk(mesh: Mesh, scores: jnp.ndarray, k: int
@@ -117,13 +120,17 @@ def shard_local_topk(mesh: Mesh, scores: jnp.ndarray, k: int
                          "a single shard could hold the whole top-k")
 
     def per_shard(x):
-        v, i = jax.lax.top_k(x, k)
-        off = jax.lax.axis_index(NODE_AXIS) * local
-        i = (i + off).astype(jnp.int32)
-        v = jax.lax.all_gather(v, NODE_AXIS, axis=v.ndim - 1, tiled=True)
-        i = jax.lax.all_gather(i, NODE_AXIS, axis=i.ndim - 1, tiled=True)
-        mv, mi = topk_merge(v, i)
-        return mv[..., :k], mi[..., :k]
+        with obs.phase(obs_phases.PHASE_TOPK):
+            v, i = jax.lax.top_k(x, k)
+            off = jax.lax.axis_index(NODE_AXIS) * local
+            i = (i + off).astype(jnp.int32)
+        with obs.phase(obs_phases.PHASE_ICI_MERGE):
+            v = jax.lax.all_gather(v, NODE_AXIS, axis=v.ndim - 1,
+                                   tiled=True)
+            i = jax.lax.all_gather(i, NODE_AXIS, axis=i.ndim - 1,
+                                   tiled=True)
+            mv, mi = topk_merge(v, i)
+            return mv[..., :k], mi[..., :k]
 
     fn = shard_map(per_shard, mesh=mesh,
                    in_specs=P(None, NODE_AXIS),

@@ -51,6 +51,8 @@ from typing import Optional, Tuple
 
 import jax.numpy as jnp
 
+from koordinator_tpu import obs
+from koordinator_tpu.obs import phases as obs_phases
 from koordinator_tpu.ops import feasibility
 from koordinator_tpu.scheduler.batching import MAX_NODE_SCORE
 from koordinator_tpu.scheduler.plugins import loadaware
@@ -87,21 +89,22 @@ def static_gates(nodes: NodeState, pods: PodBatch,
       means the batch carries no toleration modeling (synthetic fast
       path) and the gates compile out (taint_penalty None).
     """
-    sel = jnp.maximum(pods.selector_id, 0)
-    sel_ok = (pods.selector_id[:, None] < 0) | \
-        pods.selector_match[sel][:, nodes.label_group]           # [P, N]
-    la_ok = loadaware.filter_mask(nodes, pods, cfg)
-    static_ok = la_ok & sel_ok & nodes.schedulable[None, :]      # [P, N]
-    if pods.has_taints:
-        tol_row = pods.tol_forbid[jnp.maximum(pods.toleration_id, 0)]
-        static_ok &= ~tol_row[:, nodes.taint_group]              # [P, N]
-        prefer_cnt = pods.tol_prefer[
-            jnp.maximum(pods.toleration_id, 0)][:, nodes.taint_group]
-        taint_penalty = prefer_cnt / jnp.maximum(
-            jnp.max(pods.tol_prefer), 1.0) * MAX_NODE_SCORE
-    else:
-        taint_penalty = None
-    return static_ok, taint_penalty
+    with obs.phase(obs_phases.PHASE_STAGE1_STATIC):
+        sel = jnp.maximum(pods.selector_id, 0)
+        sel_ok = (pods.selector_id[:, None] < 0) | \
+            pods.selector_match[sel][:, nodes.label_group]       # [P, N]
+        la_ok = loadaware.filter_mask(nodes, pods, cfg)
+        static_ok = la_ok & sel_ok & nodes.schedulable[None, :]  # [P, N]
+        if pods.has_taints:
+            tol_row = pods.tol_forbid[jnp.maximum(pods.toleration_id, 0)]
+            static_ok &= ~tol_row[:, nodes.taint_group]          # [P, N]
+            prefer_cnt = pods.tol_prefer[
+                jnp.maximum(pods.toleration_id, 0)][:, nodes.taint_group]
+            taint_penalty = prefer_cnt / jnp.maximum(
+                jnp.max(pods.tol_prefer), 1.0) * MAX_NODE_SCORE
+        else:
+            taint_penalty = None
+        return static_ok, taint_penalty
 
 
 @shape_contract(
@@ -126,12 +129,13 @@ def stage1_mask(snap: ClusterSnapshot, pods: PodBatch,
     so a full node legitimately admits its slot's consumers
     (core keeps `static_base` for the slot columns).
     """
-    mask = static_ok & feasibility.resource_fit(
-        snap.nodes.allocatable, snap.nodes.requested, pods.requests,
-        fit_dims)
-    mask &= feasibility.quota_ceiling_ok(
-        snap.quotas, pods, quota_depth, fit_dims)[:, None]
-    return mask
+    with obs.phase(obs_phases.PHASE_STAGE1_MASK):
+        mask = static_ok & feasibility.resource_fit(
+            snap.nodes.allocatable, snap.nodes.requested, pods.requests,
+            fit_dims)
+        mask &= feasibility.quota_ceiling_ok(
+            snap.quotas, pods, quota_depth, fit_dims)[:, None]
+        return mask
 
 
 @shape_contract(mask="bool[P~pad:invalid,N~pad:false]",

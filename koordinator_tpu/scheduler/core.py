@@ -52,6 +52,8 @@ from koordinator_tpu.scheduler.batching import (
     segment_prefix_ok,
     stable_rank,
 )
+from koordinator_tpu import obs
+from koordinator_tpu.obs import phases as obs_phases
 from koordinator_tpu.scheduler import topologymanager
 from koordinator_tpu.scheduler.cascade import stage1_mask, static_gates
 from koordinator_tpu.scheduler.plugins import deviceshare, loadaware, numaaware
@@ -307,18 +309,22 @@ def schedule_batch(snap: ClusterSnapshot, pods: PodBatch,
         # node-allocatable columns (deviceshare
         # UnschedulableAndUnresolvable). Runs even with zero instance
         # capacity so such pods never silently place without a GPU.
-        static_ok = and_rows(
-            static_ok, deviceshare.prefilter(devices0, heavy_rows(dev_pg)),
-            dev_pg)
+        with obs.phase(obs_phases.PHASE_STAGE2_DEVICESHARE):
+            static_ok = and_rows(
+                static_ok,
+                deviceshare.prefilter(devices0, heavy_rows(dev_pg)),
+                dev_pg)
     if use_gpu:
-        dev_scores = deviceshare.score_matrix(devices0, heavy_rows(dev_pg),
-                                              device_strategy)
-        if dev_pg < p:
-            # exact pad: rows beyond pg carry no device request, so
-            # their score rows are 0 by construction
-            dev_scores = jnp.concatenate(
-                [dev_scores,
-                 jnp.zeros((p - dev_pg, n_nodes), dev_scores.dtype)], axis=0)
+        with obs.phase(obs_phases.PHASE_STAGE2_DEVICESHARE):
+            dev_scores = deviceshare.score_matrix(
+                devices0, heavy_rows(dev_pg), device_strategy)
+            if dev_pg < p:
+                # exact pad: rows beyond pg carry no device request, so
+                # their score rows are 0 by construction
+                dev_scores = jnp.concatenate(
+                    [dev_scores,
+                     jnp.zeros((p - dev_pg, n_nodes), dev_scores.dtype)],
+                    axis=0)
     numa_used0 = nodes0.numa_cap - nodes0.numa_free              # [N, Z, 2]
     if enable_numa:
         numa_pn = pn if (cascade and pn < p) else p
@@ -329,15 +335,17 @@ def schedule_batch(snap: ClusterSnapshot, pods: PodBatch,
         # numa_prefix contract guarantees a policy-free snapshot, so
         # rows beyond pass the gates and score 0.
         pods_pn = heavy_rows(numa_pn)
-        static_ok = and_rows(
-            static_ok, numaaware.zone_prefilter(nodes0, pods_pn), numa_pn)
-        numa_scores = numaaware.numa_score_matrix(nodes0, pods_pn,
-                                                  numa_strategy)
-        if numa_pn < p:
-            numa_scores = jnp.concatenate(
-                [numa_scores,
-                 jnp.zeros((p - numa_pn, n_nodes), numa_scores.dtype)],
-                axis=0)
+        with obs.phase(obs_phases.PHASE_STAGE2_NUMA):
+            static_ok = and_rows(
+                static_ok, numaaware.zone_prefilter(nodes0, pods_pn),
+                numa_pn)
+            numa_scores = numaaware.numa_score_matrix(nodes0, pods_pn,
+                                                      numa_strategy)
+            if numa_pn < p:
+                numa_scores = jnp.concatenate(
+                    [numa_scores,
+                     jnp.zeros((p - numa_pn, n_nodes), numa_scores.dtype)],
+                    axis=0)
         n_zones = nodes0.numa_cap.shape[1]
         # every pod's (cpu, mem) zone demand: on a node whose topology
         # policy engages the manager, ALL pods charge zone usage
@@ -348,14 +356,15 @@ def schedule_batch(snap: ClusterSnapshot, pods: PodBatch,
         numa_policy0 = nodes0.numa_policy                        # i32[N]
         # policy-node combined-fit prefilter (upper bound): a policy node
         # whose total valid-zone free cannot hold the pod is infeasible
-        total_zfree = jnp.sum(
-            nodes0.numa_free * nodes0.numa_valid[:, :, None], axis=1)
-        static_ok = and_rows(
-            static_ok,
-            (numa_policy0 == topologymanager.POLICY_NONE)[None]
-            | jnp.all(total_zfree[None] + EPS
-                      >= req2_all[:numa_pn, None, :], axis=-1),
-            numa_pn)
+        with obs.phase(obs_phases.PHASE_STAGE2_POLICY):
+            total_zfree = jnp.sum(
+                nodes0.numa_free * nodes0.numa_valid[:, :, None], axis=1)
+            static_ok = and_rows(
+                static_ok,
+                (numa_policy0 == topologymanager.POLICY_NONE)[None]
+                | jnp.all(total_zfree[None] + EPS
+                          >= req2_all[:numa_pn, None, :], axis=-1),
+                numa_pn)
 
     # --- reservations as virtual nodes (transformer.go restore/nominate) ---
     # Each reservation slot is an extra owner-restricted column with the
@@ -719,16 +728,18 @@ def schedule_batch(snap: ClusterSnapshot, pods: PodBatch,
             ni = jnp.arange(n_ext, dtype=jnp.uint32)[None, :]
             h = (pi * jnp.uint32(2654435761) + ni * jnp.uint32(40503)) & 1023
             scores = scores + h.astype(jnp.float32) * (0.49 / 1024.0)
-        masked = jnp.where(feasible, scores, -1.0)
-        k = min(k_choices, n_ext)
-        if approx_topk:
-            # TPU-optimized partial reduction (approx_max_k) — the choice
-            # list is a heuristic preference order, so bounded recall only
-            # means an occasional pod falls to a later round.
-            topk_val, topk_idx = jax.lax.approx_max_k(masked, k)
-        else:
-            topk_val, topk_idx = jax.lax.top_k(masked, k)
-        topk_idx = topk_idx.astype(jnp.int32)
+        with obs.phase(obs_phases.PHASE_TOPK):
+            masked = jnp.where(feasible, scores, -1.0)
+            k = min(k_choices, n_ext)
+            if approx_topk:
+                # TPU-optimized partial reduction (approx_max_k) — the
+                # choice list is a heuristic preference order, so
+                # bounded recall only means an occasional pod falls to
+                # a later round.
+                topk_val, topk_idx = jax.lax.approx_max_k(masked, k)
+            else:
+                topk_val, topk_idx = jax.lax.top_k(masked, k)
+            topk_idx = topk_idx.astype(jnp.int32)
 
         def inner(inner_carry, _):
             requested, quota_used, numa_used, gpu_free, aux_free, \
@@ -1418,6 +1429,13 @@ def tail_select(pods: PodBatch, assign: jnp.ndarray, tried: jnp.ndarray,
     adaptive caller keeps running until it drains; the in-prefix mask
     below is the safety net for the degenerate few-stragglers case.
     """
+    with obs.phase(obs_phases.PHASE_TAIL_SELECT):
+        return _tail_select_body(pods, assign, tried, tail_chunk,
+                                 topo_prefix, topo_mask)
+
+
+def _tail_select_body(pods, assign, tried, tail_chunk, topo_prefix,
+                      topo_mask):
     bad = pods.valid & (assign < 0)
     if topo_prefix is None:
         key = jnp.where(bad & ~tried, 0, jnp.where(bad, 1, 2))
@@ -1480,19 +1498,20 @@ def tail_pass(step_fn, snap: ClusterSnapshot, counts: tuple,
     """
     idx, attempt = tail_select(pods, assign, tried, tail_chunk,
                                topo_prefix, topo_mask)
-    retry = pods.replace(
-        **{f: getattr(pods, f)[idx]
-           for f in PER_POD_FIELDS if f != "valid"},
-        valid=attempt)
-    retry = retry.replace(**dict(zip(COUNT_FIELDS, counts)))
-    tried = tried.at[idx].set(tried[idx] | attempt)
-    res = step_fn(snap, retry, cfg)
-    if charge_counts:
-        counts = charge_all_counts(counts, retry, res.assignment)
-    got = attempt & (res.assignment >= 0)
-    assign = assign.at[idx].set(
-        jnp.where(got, res.assignment, assign[idx]))
-    return res.snapshot, counts, assign, tried
+    with obs.phase(obs_phases.PHASE_TAIL_PASS):
+        retry = pods.replace(
+            **{f: getattr(pods, f)[idx]
+               for f in PER_POD_FIELDS if f != "valid"},
+            valid=attempt)
+        retry = retry.replace(**dict(zip(COUNT_FIELDS, counts)))
+        tried = tried.at[idx].set(tried[idx] | attempt)
+        res = step_fn(snap, retry, cfg)
+        if charge_counts:
+            counts = charge_all_counts(counts, retry, res.assignment)
+        got = attempt & (res.assignment >= 0)
+        assign = assign.at[idx].set(
+            jnp.where(got, res.assignment, assign[idx]))
+        return res.snapshot, counts, assign, tried
 
 
 @shape_contract(
@@ -1565,7 +1584,8 @@ def tail_compaction_loop(step_fn, snap: ClusterSnapshot, counts: tuple,
 
     init = (snap, counts, assign, jnp.zeros((p,), bool), jnp.int32(0),
             left0, jnp.asarray(False), left0)
-    (snap, counts, assign, _, passes, left, _, never_retried) = \
-        jax.lax.while_loop(cond, body, init)
+    with obs.phase(obs_phases.PHASE_TAIL_LOOP):
+        (snap, counts, assign, _, passes, left, _, never_retried) = \
+            jax.lax.while_loop(cond, body, init)
     stats = jnp.stack([left0, left, never_retried, passes])
     return snap, counts, assign, stats

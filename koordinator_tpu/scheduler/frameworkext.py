@@ -27,6 +27,7 @@ Capability parity with pkg/scheduler/frameworkext (SURVEY.md 2.1):
 from __future__ import annotations
 
 import dataclasses
+import itertools
 import json
 import logging
 import threading
@@ -43,6 +44,8 @@ from koordinator_tpu.utils.httpserver import (
 )
 
 from koordinator_tpu.metrics import kernel_timer
+from koordinator_tpu.obs import phases as obs_phases
+from koordinator_tpu.obs.trace import NOOP_SPAN, Tracer
 from koordinator_tpu.scheduler import core, guards
 from koordinator_tpu.scheduler.errorhandler import (
     Backoff,
@@ -542,6 +545,7 @@ class SchedulerService:
                  retry_policy: Optional[RetryPolicy] = None,
                  journal=None,
                  compile_cache=None,
+                 trace: Optional[Tracer] = None,
                  **schedule_kwargs):
         self.store = store or SnapshotStore()
         self.cfg = cfg if cfg is not None else LoadAwareConfig.make()
@@ -593,6 +597,25 @@ class SchedulerService:
         self.compile_cache = compile_cache
         if compile_cache is not None:
             compile_cache.activate()
+        # koordtrace (docs/OBSERVABILITY.md): an optional span tracer.
+        # None (the default) keeps the dispatch path allocation-free —
+        # every span site routes through _span(), which returns the
+        # shared NOOP_SPAN singleton when tracing is off. With a tracer
+        # attached, closed spans feed scheduler_cycle_phase_seconds and
+        # ring overflow feeds scheduler_trace_spans_dropped unless the
+        # caller wired its own hooks.
+        self.tracer = Tracer() if trace is True else trace
+        if self.tracer is not None:
+            if self.tracer.observer is None:
+                self.tracer.observer = (
+                    lambda name, dur:
+                    self.metrics.cycle_phase_seconds
+                        .labels(name).observe(dur))
+            if self.tracer.on_drop is None:
+                self.tracer.on_drop = self.metrics.trace_spans_dropped.inc
+        # trace cycle ids: a process-monotonic sequence assigned per
+        # schedule() call (itertools.count: one atomic bump per cycle)
+        self._cycle_ids = itertools.count()
         self.epoch = journal.next_epoch() if journal is not None else 0
         # epochs whose records THIS process appended: a base-version
         # mismatch on one of these is a raced ingest between retry
@@ -650,6 +673,36 @@ class SchedulerService:
         # rebuilds/topology deltas keep the in-flight charges
         self.on_assumed: Optional[Callable] = None
         self.registry.register("scheduler", self.summary)
+
+    def _span(self, name: str, cycle: Optional[int] = None):
+        """Open a koordtrace span, or the shared NOOP_SPAN when tracing
+        is off. Deliberately takes NO attrs argument: hot-path callers
+        attach attributes via the yielded dict (`as a: ... if a is not
+        None`), so the disabled path allocates nothing — not even an
+        empty dict."""
+        t = self.tracer
+        if t is None:
+            return NOOP_SPAN
+        return t.span(name, None, cycle)
+
+    def _event(self, name: str, attrs: Optional[dict] = None,
+               cycle: Optional[int] = None) -> None:
+        if self.tracer is not None:
+            self.tracer.event(name, attrs, cycle)
+
+    def dump_trace(self, out_dir: str, prefix: str = "koordtrace",
+                   formats=("chrome", "jsonl", "prom")) -> List[str]:
+        """Write the span buffer (+ this service's metric registry, for
+        the prom format) into `out_dir`; returns the written paths.
+        Raises without a tracer attached — a silent empty dump would
+        read as 'the service did nothing'."""
+        if self.tracer is None:
+            raise RuntimeError(
+                "dump_trace: this service was built with trace=None")
+        from koordinator_tpu.obs import export as obs_export
+
+        return obs_export.dump(self.tracer, self.metrics.registry,
+                               out_dir, prefix=prefix, formats=formats)
 
     def commit_guard(self):
         """The batch-commit lock, exposed so host-side snapshot writers
@@ -841,13 +894,14 @@ class SchedulerService:
             return
         from koordinator_tpu.compilecache import precompile
 
-        try:
-            precompile.ensure_cycle_program(
-                self.compile_cache, snap, pods, self.cfg, kwargs,
-                guarded=self.guards_enabled, metrics=self.metrics)
-        except Exception:  # noqa: BLE001 — warmth is advisory
-            log.warning("compile-cache ensure failed; cycle will "
-                        "cold-jit", exc_info=True)
+        with self._span(obs_phases.SPAN_ENSURE_CACHED):
+            try:
+                precompile.ensure_cycle_program(
+                    self.compile_cache, snap, pods, self.cfg, kwargs,
+                    guarded=self.guards_enabled, metrics=self.metrics)
+            except Exception:  # noqa: BLE001 — warmth is advisory
+                log.warning("compile-cache ensure failed; cycle will "
+                            "cold-jit", exc_info=True)
 
     def _run_program(self, snap: ClusterSnapshot, pods: PodBatch,
                      kwargs: dict):
@@ -883,7 +937,16 @@ class SchedulerService:
             delta_watermark=self.store.applied_delta_version,
             batch_digest=self._cycle_digest,
             assignment=np.asarray(assignment, np.int32))
-        wrote = self.journal.append(rec)
+        with self._span(obs_phases.SPAN_JOURNAL_APPEND) as jrn:
+            wrote = self.journal.append(rec)
+            if jrn is not None:
+                # the trace <-> commit-log join: a journal record is
+                # findable from its span and vice versa
+                jrn["epoch"] = self.epoch
+                jrn["chunk"] = chunk
+                jrn["n_chunks"] = n_chunks
+                jrn["bytes"] = int(wrote)
+                jrn["replayed"] = not wrote
         if wrote:
             self._own_epochs.add(self.epoch)
             self.metrics.journal_appends.inc()
@@ -1031,33 +1094,46 @@ class SchedulerService:
         """The serialized snapshot-read -> program -> commit section of
         one cycle attempt."""
         with self._commit_lock:
-            snap = self.store.current()
-            if self.journal is not None:
-                self._begin_journal_cycle(pods)
-            # amplified-CPU auto-detection happens on the snapshot the
-            # batch actually runs against (an explicit
-            # enable_amplification kwarg from the constructor wins).
-            # Deriving here rather than at publish time keeps the flag
-            # correct for writers that bypass service.publish() and put
-            # snapshots straight into the shared SnapshotStore
-            # (SnapshotSyncer._rebuild, embedded compositions).
-            if not self._explicit_amp:
-                self.schedule_kwargs["enable_amplification"] = bool(
-                    np.asarray(snap.nodes.cpu_amplification > 1.0).any())
-            # a journaled resume (forced chunk layout) also forbids
-            # prefix packing: slicing a packed batch breaks the
-            # row-range contracts, exactly like the chunked rung
-            sched_pods, pack_kwargs, inv = self._prepare_batch(
-                snap, pods,
-                allow_prefix_pack=not state.chunked
-                and (self._forced_chunks is None
-                     or self._forced_chunks <= 1))
+            with self._span(obs_phases.SPAN_ADMIT) as adm:
+                snap = self.store.current()
+                if self.journal is not None:
+                    self._begin_journal_cycle(pods)
+                # amplified-CPU auto-detection happens on the snapshot
+                # the batch actually runs against (an explicit
+                # enable_amplification kwarg from the constructor
+                # wins). Deriving here rather than at publish time
+                # keeps the flag correct for writers that bypass
+                # service.publish() and put snapshots straight into the
+                # shared SnapshotStore (SnapshotSyncer._rebuild,
+                # embedded compositions).
+                if not self._explicit_amp:
+                    self.schedule_kwargs["enable_amplification"] = bool(
+                        np.asarray(
+                            snap.nodes.cpu_amplification > 1.0).any())
+                # a journaled resume (forced chunk layout) also forbids
+                # prefix packing: slicing a packed batch breaks the
+                # row-range contracts, exactly like the chunked rung
+                sched_pods, pack_kwargs, inv = self._prepare_batch(
+                    snap, pods,
+                    allow_prefix_pack=not state.chunked
+                    and (self._forced_chunks is None
+                         or self._forced_chunks <= 1))
+                if adm is not None:
+                    # the trace <-> journal join at cycle granularity
+                    adm["base_version"] = self.store.version
+                    if self.journal is not None:
+                        adm["epoch"] = self.epoch
             with kernel_timer(self.metrics.kernel_seconds,
-                              "koord/schedule_batch"):
-                result, health_dev, _node_bad, pod_bad = \
-                    self._device_cycle(
-                        snap, sched_pods,
-                        {**self.schedule_kwargs, **pack_kwargs}, state)
+                              obs_phases.PHASE_SCHEDULE_BATCH):
+                with self._span(obs_phases.SPAN_DISPATCH) as dsp:
+                    result, health_dev, _node_bad, pod_bad = \
+                        self._device_cycle(
+                            snap, sched_pods,
+                            {**self.schedule_kwargs, **pack_kwargs},
+                            state)
+                    if dsp is not None:
+                        dsp["ladder"] = state.label()
+                        dsp["mesh_size"] = self._last_mesh_size
                 if inv is not None:
                     # back to the CALLER's pod order before anything
                     # (hooks, error chain, debug tables) sees the result
@@ -1068,12 +1144,18 @@ class SchedulerService:
                         pod_bad = pod_bad[inv]
                 # single D2H transfer doubles as the completion barrier
                 # (and makes the kernel timer measure device time)
-                assignment = np.asarray(result.assignment)
+                with self._span(obs_phases.SPAN_DEVICE_WAIT):
+                    assignment = np.asarray(result.assignment)
             # the guards' ONE packed readback ([word, bad nodes, bad
             # pods]); the full masks stay on device unless the word is
             # non-zero (cold path)
-            health = (np.asarray(health_dev)
-                      if health_dev is not None else None)
+            with self._span(obs_phases.SPAN_GUARD_SCAN) as gsc:
+                health = (np.asarray(health_dev)
+                          if health_dev is not None else None)
+                if gsc is not None:
+                    gsc["guards"] = self.guards_enabled
+                    if health is not None:
+                        gsc["word"] = int(health[0])
             # what _device_cycle ACTUALLY ran: the journaled layout
             # overrides the ladder in both directions
             chunked_run = (self._forced_chunks > 1
@@ -1084,16 +1166,20 @@ class SchedulerService:
                 # record lands BEFORE the store publish below, so a
                 # crash between them replays rather than loses the batch
                 self._journal_commit(0, 1, assignment)
-            self.store.update(lambda _old: result.snapshot)
-            if self.journal is not None:
-                # the batch committed: the epoch is sealed (its chunk
-                # set is complete in the journal) and the next schedule
-                # opens a new one; the own-epoch marker only matters
-                # for the CURRENT epoch's retries, so drop the sealed
-                # one (a resident service must not accrete the set)
-                self._own_epochs.discard(self.epoch)
-                self.epoch += 1
-                self._forced_chunks = None
+            with self._span(obs_phases.SPAN_PUBLISH) as pub:
+                self.store.update(lambda _old: result.snapshot)
+                if self.journal is not None:
+                    # the batch committed: the epoch is sealed (its
+                    # chunk set is complete in the journal) and the
+                    # next schedule opens a new one; the own-epoch
+                    # marker only matters for the CURRENT epoch's
+                    # retries, so drop the sealed one (a resident
+                    # service must not accrete the set)
+                    self._own_epochs.discard(self.epoch)
+                    self.epoch += 1
+                    self._forced_chunks = None
+                if pub is not None:
+                    pub["version"] = self.store.version
             # THE COMMIT POINT: everything below ran against a snapshot
             # version that is now published. A failure past here must
             # NOT re-enter the retry loop — re-running the cycle would
@@ -1117,6 +1203,16 @@ class SchedulerService:
                 raise _CommittedCycleError(exc) from exc
         return snap, result, assignment, health, pod_bad, version
 
+    def _trace_transitions(self, n_before: int, cycle_id: int) -> None:
+        """Emit one koordtrace instant event per ladder transition the
+        last ladder call appended (detected by list-length delta — the
+        ladder itself stays trace-free)."""
+        if self.tracer is None:
+            return
+        for cause, label in self.ladder.transitions[n_before:]:
+            self._event(obs_phases.EVENT_LADDER_TRANSITION,
+                        {"cause": cause, "to": label}, cycle=cycle_id)
+
     def schedule(self, pods: PodBatch,
                  pod_names: Optional[List[str]] = None,
                  typed_pods: Optional[List] = None) -> core.ScheduleResult:
@@ -1132,12 +1228,23 @@ class SchedulerService:
         token = self.monitor.start_cycle()
         backoff = Backoff(self.retry_policy, seed=self.batches)
         attempts = 0
+        cycle_id = next(self._cycle_ids)
         while True:
+            n_trans = len(self.ladder.transitions)
             state, probing = self.ladder.begin_cycle()
+            self._trace_transitions(n_trans, cycle_id)
             try:
-                (snap, result, assignment, health, pod_bad,
-                 version) = self._locked_cycle(pods, typed_pods, state)
+                with self._span(obs_phases.SPAN_CYCLE,
+                                cycle=cycle_id) as cyc:
+                    if cyc is not None:
+                        cyc["attempt"] = attempts
+                        cyc["ladder"] = state.label()
+                    (snap, result, assignment, health, pod_bad,
+                     version) = self._locked_cycle(pods, typed_pods,
+                                                   state)
+                n_trans = len(self.ladder.transitions)
                 self.ladder.on_success(probing, state)
+                self._trace_transitions(n_trans, cycle_id)
                 break
             except _CommittedCycleError as exc:
                 # the snapshot already committed: never retry (see
@@ -1157,6 +1264,12 @@ class SchedulerService:
                 fc = classify_failure(exc)
                 self.metrics.failures_classified.labels(fc.value).inc()
                 attempts += 1
+                if self.tracer is not None:
+                    self._event(obs_phases.EVENT_RETRY,
+                                {"failure_class": fc.value,
+                                 "attempt": attempts,
+                                 "ladder": state.label()},
+                                cycle=cycle_id)
                 log.warning(
                     "scheduling cycle failed (class=%s, attempt %d, "
                     "ladder=%s): %r", fc.value, attempts, state.label(),
@@ -1167,10 +1280,19 @@ class SchedulerService:
                 if probing:
                     # a failed up-probe falls straight back; the
                     # pre-probe state was never left
+                    n_trans = len(self.ladder.transitions)
                     self.ladder.on_failure(fc, probing=True)
+                    self._trace_transitions(n_trans, cycle_id)
                     continue
                 if fc in TRANSIENT_CLASSES and not backoff.exhausted():
-                    self._sleep(backoff.next_delay())
+                    delay = backoff.next_delay()
+                    with self._span(obs_phases.SPAN_BACKOFF,
+                                    cycle=cycle_id) as bko:
+                        if bko is not None:
+                            bko["failure_class"] = fc.value
+                            bko["attempt"] = attempts
+                            bko["delay_s"] = delay
+                        self._sleep(delay)
                     continue
                 survivors = None
                 if fc is FailureClass.DEVICE_LOST:
@@ -1179,11 +1301,13 @@ class SchedulerService:
                     # mesh-shrink rung, fewer abandons the mesh
                     survivors = len(self.surviving_devices())
                 pre_level = self.ladder.level
+                n_trans = len(self.ladder.transitions)
                 if not self.ladder.on_failure(fc, probing=False,
                                               survivors=survivors):
                     # no lower rung left: the failure is terminal
                     self.monitor.complete_cycle(token)
                     raise
+                self._trace_transitions(n_trans, cycle_id)
                 if self.ladder.level == DegradationLadder.L_MESH_SHRINK \
                         and pre_level != DegradationLadder.L_MESH_SHRINK:
                     self.metrics.mesh_shrink_events.inc()
@@ -1211,6 +1335,11 @@ class SchedulerService:
                     n_bad_pods)
             if pod_bad is not None:
                 pod_bad_np = np.asarray(pod_bad)
+            if self.tracer is not None:
+                self._event(obs_phases.EVENT_QUARANTINE,
+                            {"word": word, "defects": defects,
+                             "bad_nodes": n_bad_nodes,
+                             "bad_pods": n_bad_pods}, cycle=cycle_id)
             log.warning(
                 "health guards tripped: word=0x%x (%s); %d node(s) / "
                 "%d pod(s) quarantined", word, ",".join(defects),
@@ -1222,8 +1351,10 @@ class SchedulerService:
             # a watchdog trip is a classified failure like any other
             self.metrics.failures_classified.labels(
                 FailureClass.WATCHDOG_STALL.value).inc()
+            n_trans = len(self.ladder.transitions)
             self.ladder.on_failure(FailureClass.WATCHDOG_STALL,
                                    probing=False)
+            self._trace_transitions(n_trans, cycle_id)
         # per-CALL (version, elapsed) for the calling thread: the
         # threaded sidecar reads them after scheduling, and the shared
         # attributes race with concurrent ingests/schedules
@@ -1265,12 +1396,18 @@ class SchedulerService:
                 snap, pods, self.cfg, pod_names))
         # the post-commit checkpoint, outside the commit lock: a fsync
         # must never stall the next cycle's snapshot read
-        if self.store.maybe_checkpoint() and self.journal is not None:
-            # epochs below the fresh checkpoint can never replay:
-            # prune them so a resident service's journal stays bounded
-            # (serialized with appends via the commit lock)
-            with self._commit_lock:
-                self.journal.prune(self.store.last_checkpoint_version)
+        with self._span(obs_phases.SPAN_CHECKPOINT,
+                        cycle=cycle_id) as ckp:
+            wrote_ckpt = self.store.maybe_checkpoint()
+            if ckp is not None:
+                ckp["wrote"] = bool(wrote_ckpt)
+            if wrote_ckpt and self.journal is not None:
+                # epochs below the fresh checkpoint can never replay:
+                # prune them so a resident service's journal stays
+                # bounded (serialized with appends via the commit lock)
+                with self._commit_lock:
+                    self.journal.prune(
+                        self.store.last_checkpoint_version)
         return result
 
     def abandon_interrupted_epoch(self) -> bool:
@@ -1319,6 +1456,7 @@ class SchedulerService:
         from koordinator_tpu.compilecache import counters as compile_counters
 
         t0 = time.monotonic()
+        t0_ns = time.monotonic_ns()
         restored = False
         # the whole recovery runs under a compile watcher so the
         # recorded time splits into what replay actually spent vs what
@@ -1352,6 +1490,26 @@ class SchedulerService:
         self.metrics.recovery_seconds.observe(seconds)
         self.metrics.recovery_compile_seconds.observe(compile_seconds)
         self.metrics.recovery_replay_seconds.observe(replay_seconds)
+        if self.tracer is not None:
+            # the recover span plus its replay-vs-compile split as two
+            # child spans. The split is derived from the compile
+            # watcher, not separately clocked, so the children are laid
+            # out proportionally inside the parent (replay first) —
+            # their DURATIONS are the measured truth, their ordering an
+            # approximation.
+            end_ns = t0_ns + int(seconds * 1e9)
+            split_ns = t0_ns + int(replay_seconds * 1e9)
+            self.tracer.record_span(
+                obs_phases.SPAN_RECOVER, t0_ns, end_ns,
+                attrs={"epochs": list(epochs),
+                       "records_replayed": replayed,
+                       "restored_checkpoint": restored})
+            self.tracer.record_span(
+                obs_phases.SPAN_RECOVER_REPLAY, t0_ns, split_ns,
+                parent=obs_phases.SPAN_RECOVER)
+            self.tracer.record_span(
+                obs_phases.SPAN_RECOVER_COMPILE, split_ns, end_ns,
+                parent=obs_phases.SPAN_RECOVER)
         self.last_recovery = {
             "restored_checkpoint": restored,
             "epochs_replayed": epochs,

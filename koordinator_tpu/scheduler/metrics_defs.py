@@ -16,6 +16,7 @@ from koordinator_tpu.metrics import Registry, global_registry
 from koordinator_tpu.metrics.registry import (  # noqa: F401  (re-export)
     SCHEDULER_COMPILE_CACHE_HITS,
     SCHEDULER_COMPILE_CACHE_MISSES,
+    SCHEDULER_CYCLE_PHASE_SECONDS,
     SCHEDULER_DEGRADATION_LEVEL,
     SCHEDULER_DEGRADED_CYCLES,
     SCHEDULER_DELTA_REJECTED,
@@ -36,16 +37,25 @@ from koordinator_tpu.metrics.registry import (  # noqa: F401  (re-export)
     SCHEDULER_SCHEDULE_CYCLE_SECONDS,
     SCHEDULER_SCHEDULING_TIMEOUT,
     SCHEDULER_SNAPSHOT_VERSION,
+    SCHEDULER_TRACE_SPANS_DROPPED,
 )
 
 # device-time scale: schedule_batch is ~0.5ms-1s depending on chunk size
 KERNEL_BUCKETS = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
                   0.25, 0.5, 1.0, 2.5)
 
+# host-span scale: a journal append is ~100us, a cold full-gate dispatch
+# tens of seconds — the phase histogram must resolve both ends
+PHASE_BUCKETS = (0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01,
+                 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0)
+
 
 class SchedulerMetrics:
     def __init__(self, registry: Registry = None):
         r = registry if registry is not None else global_registry()
+        # kept for the koordtrace export surface: obs/export.py renders
+        # this registry's expose() next to the span dump
+        self.registry = r
         self.scheduling_timeout = r.counter(
             SCHEDULER_SCHEDULING_TIMEOUT,
             "Scheduling cycles that exceeded the watchdog budget "
@@ -150,3 +160,18 @@ class SchedulerMetrics:
             "XLA compile-or-retrieve time inside "
             "SchedulerService.recover() (near zero with a warmed "
             "compile cache)")
+        # koordtrace observability plane (docs/OBSERVABILITY.md): the
+        # span ring's overflow count and the per-phase breakdown of
+        # cycle time — phase label values come from obs/phases.py, and
+        # every closed host span feeds its duration here via the
+        # tracer's observer hook
+        self.trace_spans_dropped = r.counter(
+            SCHEDULER_TRACE_SPANS_DROPPED,
+            "koordtrace span records dropped by ring-buffer overflow "
+            "(oldest-first; raise the tracer capacity if nonzero)")
+        self.cycle_phase_seconds = r.histogram(
+            SCHEDULER_CYCLE_PHASE_SECONDS,
+            "Wall time of one koordtrace host span within a scheduling "
+            "cycle, by phase (obs/phases.py names: admit, dispatch, "
+            "device_wait, journal_append, publish, ...)",
+            labels=("phase",), buckets=PHASE_BUCKETS)

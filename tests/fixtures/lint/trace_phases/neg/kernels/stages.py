@@ -1,0 +1,30 @@
+"""Negative trace-phases fixture: annotation labels drawn from the
+phase-table constants (or from variables) — nothing fires."""
+
+import jax
+
+from obs import phases
+
+
+def stage_scope(x):
+    with jax.named_scope(phases.PHASE_GOOD):
+        return x + 1
+
+
+def stage_annotation(x):
+    with jax.profiler.TraceAnnotation(phases.SPAN_CYCLE):
+        return x * 2
+
+
+def stage_timer(hist, fn, x, label):
+    with kernel_timer(hist, label):
+        return fn(x)
+
+
+def unrelated_call(x):
+    # same tail name but a different arity slot left empty is ignored
+    return jax.named_scope
+
+
+def kernel_timer(hist, annotation):
+    return hist.labels(annotation)

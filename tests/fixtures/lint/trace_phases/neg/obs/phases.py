@@ -1,0 +1,5 @@
+"""Negative trace-phases fixture: the shared phase table (the pass is
+active here, but every consumer routes through the constants)."""
+
+PHASE_GOOD = "fix/good_phase"
+SPAN_CYCLE = "cycle"

@@ -1,0 +1,28 @@
+"""Positive trace-phases fixture: bare string-literal annotation labels
+in every recognized callable form."""
+
+import jax
+
+
+def stage_scope(x):
+    with jax.named_scope("fix/bare_scope"):       # OB001
+        return x + 1
+
+
+def stage_annotation(x):
+    with jax.profiler.TraceAnnotation("fix/bare_anno"):   # OB001
+        return x * 2
+
+
+def stage_timer(hist, fn, x):
+    with kernel_timer(hist, "fix/bare_timer"):    # OB001
+        return fn(x)
+
+
+def stage_keyword(x):
+    with jax.named_scope(name="fix/bare_kw"):     # OB001: keyword form
+        return x - 1
+
+
+def kernel_timer(hist, annotation):
+    return hist.labels(annotation)

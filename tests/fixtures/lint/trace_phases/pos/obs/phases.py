@@ -1,0 +1,5 @@
+"""Positive trace-phases fixture: the shared phase table (its presence
+activates the pass; its own literals are exempt)."""
+
+PHASE_GOOD = "fix/good_phase"
+SPAN_CYCLE = "cycle"

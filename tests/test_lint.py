@@ -42,6 +42,7 @@ _ANALYZER_NAMES = {
     "shape_contract": "shape-contract",
     "tail_readback": "tail-readback",
     "pad_soundness": "pad-soundness",
+    "trace_phases": "trace-phases",
 }
 
 
@@ -70,6 +71,7 @@ def empty_baseline(tmp_path):
     ("tail_readback", {"HS006"}),
     ("pad_soundness", {"PS001", "PS002", "PS003", "PS004", "PS005"}),
     ("determinism", {"ND001"}),
+    ("trace_phases", {"OB001"}),
 ])
 def test_positive_fixture(fixture_dir, expected_codes, empty_baseline):
     findings = fixture_findings(fixture_dir, "pos", empty_baseline)

@@ -93,7 +93,8 @@ def test_kernel_timer_records_and_annotates():
     r = Registry()
     h = r.histogram("kernel_seconds", labels=("op",))
     import jax.numpy as jnp
-    with kernel_timer(h, "koord/test_kernel", labels=("matmul",)):
+    with kernel_timer(h, "koord/test_kernel",  # koordlint: disable=OB001
+                      labels=("matmul",)):
         x = jnp.ones((128, 128)) @ jnp.ones((128, 128))
         np.asarray(x)
     assert h.count("matmul") == 1

@@ -72,6 +72,15 @@ echo "=== crash smoke (kill-injected recovery matrix, CPU) ==="
 # torn tails surfaced with a typed reason (tools/crash_smoke.py)
 JAX_PLATFORMS=cpu python tools/crash_smoke.py
 
+echo "=== koordtrace smoke (observability contract, CPU) ==="
+# a journaled, traced service on a small full-gate workload: every
+# committed cycle carries the full host span skeleton under one cycle
+# id, the Chrome dump is valid trace-event JSON (Perfetto-loadable),
+# fault-injected cycles carry quarantine/retry/backoff/ladder records,
+# every span name resolves against obs/phases.py, and journal_append
+# span attrs join to the commit journal (tools/trace_smoke.py)
+JAX_PLATFORMS=cpu python tools/trace_smoke.py
+
 echo "=== warm-cache smoke (compile-cache warm-start gate, CPU) ==="
 # the flagship cycle runs in three REAL child processes against ONE
 # compile-cache dir: cold (compiles, populates manifest), warm (ZERO

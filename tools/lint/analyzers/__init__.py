@@ -13,4 +13,5 @@ from tools.lint.analyzers import (  # noqa: F401
     robustness,
     shape_contract,
     tail_readback,
+    trace_phases,
 )

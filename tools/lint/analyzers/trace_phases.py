@@ -1,0 +1,95 @@
+"""koordtrace phase-name discipline: profiler annotation labels must
+come from the shared phase table (`koordinator_tpu/obs/phases.py`).
+
+A `jax.named_scope(...)` / `jax.profiler.TraceAnnotation(...)` /
+`kernel_timer(hist, ...)` label spelled as a bare string literal can
+silently drift from the table the trace parsers
+(tools/trace_fullgate.py, tools/trace_smoke.py) and the
+`scheduler_cycle_phase_seconds{phase=...}` series join on — a renamed
+constant keeps every consumer honest, a renamed literal orphans the
+phase in one consumer and nobody notices until a trace stops
+attributing.
+
+The pass activates only when the scanned project contains a phase
+table (any module whose relpath ends `obs/phases.py` — the fixture
+roots and the tools self-lint root stay inert), mirroring the
+metric-registry pass's registry gating. The table module itself is
+exempt (the literals LIVE there), and Name/Attribute label arguments
+are accepted unverified — the table's `check_phase` raises at runtime
+on a constant that drifted.
+
+Codes:
+  OB001  bare string-literal annotation label while a shared phase
+         table exists — use the obs/phases.py constant
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Optional
+
+from tools.lint.astutil import call_target, str_const
+from tools.lint.framework import Analyzer, Finding, Module, Project, register
+
+# callables whose label argument is a trace/profiler annotation, and
+# which positional slot carries it (keyword fallback in _label_node)
+ANNOTATION_CALLS = {
+    "named_scope": (0, "name"),
+    "TraceAnnotation": (0, "name"),
+    "kernel_timer": (1, "annotation"),
+}
+
+
+def _is_phase_table(module: Module) -> bool:
+    return module.relpath.endswith("obs/phases.py")
+
+
+def _label_node(call: ast.Call, pos: int, kw: str) -> Optional[ast.AST]:
+    if len(call.args) > pos:
+        return call.args[pos]
+    for k in call.keywords:
+        if k.arg == kw:
+            return k.value
+    return None
+
+
+@register
+class TracePhasesAnalyzer(Analyzer):
+    name = "trace-phases"
+    description = ("bare string-literal jax.named_scope/TraceAnnotation/"
+                   "kernel_timer labels while a shared obs/phases.py "
+                   "table exists")
+
+    def run(self, project: Project) -> Iterable[Finding]:
+        if not any(_is_phase_table(m) for m in project.modules):
+            return []
+        findings: List[Finding] = []
+        for module in project.modules:
+            if _is_phase_table(module):
+                continue
+            for call in ast.walk(module.tree):
+                if not isinstance(call, ast.Call):
+                    continue
+                target = call_target(call)
+                if target is None:
+                    continue
+                tail = target.rsplit(".", 1)[-1]
+                spec = ANNOTATION_CALLS.get(tail)
+                if spec is None:
+                    continue
+                node = _label_node(call, *spec)
+                if node is None:
+                    continue
+                literal = str_const(node)
+                if literal is None:
+                    continue
+                findings.append(Finding(
+                    analyzer="trace-phases", code="OB001",
+                    path=module.relpath, line=node.lineno,
+                    message=f"annotation label {literal!r} is a bare "
+                            f"string literal; use the constant from "
+                            f"the shared phase table (obs/phases.py) "
+                            f"so trace parsers and the phase metric "
+                            f"cannot drift",
+                    key=f"bare:{tail}:{literal}"))
+        return sorted(findings, key=lambda f: (f.path, f.line, f.code))

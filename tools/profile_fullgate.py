@@ -4,6 +4,15 @@ Runs the SWEEP only (no tail) at a reduced pod count so each compile is
 cheap, toggling one gate family off at a time; the delta against the
 all-on baseline localizes where the 100k x 10k full-gate time goes.
 Usage: JAX_PLATFORMS=axon python tools/profile_fullgate.py [pods] [nodes]
+
+Besides the human table, the bisection emits its per-gate deltas as
+koordtrace JSONL (obs.trace.jsonl_record) keyed by the SHARED phase
+table (koordinator_tpu/obs/phases.py) — the same names
+tools/trace_fullgate.py attributes from the XLA profiler stream and the
+`scheduler_cycle_phase_seconds{phase=...}` series carries, so the
+subtractive and the sampled attributions land in one namespace and can
+be compared line-for-line. PROFILE_TRACE_OUT=<path> writes the records
+there; unset, they print after the table.
 """
 
 import functools
@@ -23,6 +32,8 @@ import jax
 if os.environ.get("JAX_PLATFORMS"):
     jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
 
+from koordinator_tpu.obs import phases as obs_phases
+from koordinator_tpu.obs.trace import jsonl_record
 from koordinator_tpu.scheduler import core
 from koordinator_tpu.scheduler.plugins.loadaware import LoadAwareConfig
 from koordinator_tpu.utils import synthetic
@@ -30,6 +41,14 @@ from koordinator_tpu.utils import synthetic
 P = int(sys.argv[1]) if len(sys.argv) > 1 else 10_000
 N = int(sys.argv[2]) if len(sys.argv) > 2 else 10_000
 CHUNK = 2_000
+
+# which shared phase each subtractive gate-off row attributes to; gate
+# families without a kernel phase of their own (the topo score terms,
+# taints) charge the whole-batch phase with the family in the attrs
+GATE_PHASES = {
+    "numa": obs_phases.PHASE_STAGE2_NUMA,
+    "devices": obs_phases.PHASE_STAGE2_DEVICESHARE,
+}
 
 
 def time_sweep(tag, pods, step_kw, slim=False, pack=False):
@@ -105,33 +124,68 @@ def time_sweep(tag, pods, step_kw, slim=False, pack=False):
     return run_s
 
 
+def emit_gate_trace(baseline_s, gate_rows):
+    """Render the subtractive attribution as koordtrace JSONL: one
+    record per gate family, `duration_s` = the delta the family costs
+    over the all-on packed baseline (clamped at zero — timing noise on
+    a cheap gate must not emit a negative span). Synthetic spans anchor
+    at t=0 (obs.trace.jsonl_record), so any JSONL consumer — including
+    obs.export's chrome conversion — renders them side by side."""
+    lines = [jsonl_record(
+        obs_phases.PHASE_SCHEDULE_BATCH, baseline_s,
+        attrs={"source": "profile_fullgate", "row": "ALL-ON packed",
+               "pods": P, "nodes": N, "chunk": CHUNK})]
+    for gate, off_s in gate_rows:
+        phase = GATE_PHASES.get(gate, obs_phases.PHASE_SCHEDULE_BATCH)
+        lines.append(jsonl_record(
+            phase, max(baseline_s - off_s, 0.0),
+            attrs={"source": "profile_fullgate", "gate": gate,
+                   "baseline_s": round(baseline_s, 4),
+                   "gate_off_s": round(off_s, 4)}))
+    out = (os.environ.get("PROFILE_TRACE_OUT") or "").strip()
+    if out:
+        with open(out, "w") as f:
+            f.write("\n".join(lines) + "\n")
+        print(f"koordtrace JSONL -> {out}", flush=True)
+    else:
+        for line in lines:
+            print(line, flush=True)
+
+
 def main():
     print(f"platform={jax.devices()[0].platform} P={P} N={N} chunk={CHUNK}",
           flush=True)
     pods = synthetic.full_gate_pods(P, N, seed=1, num_quotas=32)
     full_kw = dict(enable_numa=True, enable_devices=True)
     time_sweep("ALL-ON unpacked (ref)", pods, full_kw)
-    time_sweep("ALL-ON packed", pods, full_kw, pack=True)
-    time_sweep("packed, numa off", pods, dict(enable_numa=False,
-                                              enable_devices=True),
-               pack=True)
-    time_sweep("packed, devices off", pods, dict(enable_numa=True,
-                                                 enable_devices=False),
-               pack=True)
-    time_sweep("packed, spread off", pods.replace(has_spread=False),
-               full_kw, pack=True)
-    time_sweep("packed, anti off", pods.replace(has_anti=False),
-               full_kw, pack=True)
-    time_sweep("packed, aff off", pods.replace(has_aff=False),
-               full_kw, pack=True)
-    time_sweep("packed, taints off", pods.replace(has_taints=False),
-               full_kw, pack=True)
-    time_sweep("packed, topo all off", pods.replace(
-        has_spread=False, has_anti=False, has_aff=False), full_kw,
-        pack=True)
+    baseline_s = time_sweep("ALL-ON packed", pods, full_kw, pack=True)
+    gate_rows = [
+        ("numa", time_sweep("packed, numa off", pods,
+                            dict(enable_numa=False, enable_devices=True),
+                            pack=True)),
+        ("devices", time_sweep("packed, devices off", pods,
+                               dict(enable_numa=True,
+                                    enable_devices=False), pack=True)),
+        ("spread", time_sweep("packed, spread off",
+                              pods.replace(has_spread=False), full_kw,
+                              pack=True)),
+        ("anti", time_sweep("packed, anti off",
+                            pods.replace(has_anti=False), full_kw,
+                            pack=True)),
+        ("aff", time_sweep("packed, aff off",
+                           pods.replace(has_aff=False), full_kw,
+                           pack=True)),
+        ("taints", time_sweep("packed, taints off",
+                              pods.replace(has_taints=False), full_kw,
+                              pack=True)),
+        ("topo_all", time_sweep("packed, topo all off", pods.replace(
+            has_spread=False, has_anti=False, has_aff=False), full_kw,
+            pack=True)),
+    ]
     slim_pods = synthetic.synthetic_pods(P, seed=1, num_quotas=32)
     time_sweep("slim workload (ref)", slim_pods, dict(enable_numa=False),
                slim=True)
+    emit_gate_trace(baseline_s, gate_rows)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,201 @@
+"""Sampled kernel-phase attribution of the flagship full-gate batch.
+
+Captures a `jax.profiler.trace` of one warmed `core.schedule_batch`
+dispatch on the full-gate workload, parses the trace-event stream the
+profiler writes (Perfetto's `*.trace.json.gz`), and attributes device
+time to the shared koordtrace phase table
+(koordinator_tpu/obs/phases.py) — every kernel region is wrapped in a
+`jax.named_scope` phase label (cascade stage 1, the stage-2 gate
+families, top-k + ICI merge, the adaptive tail), so each XLA
+instruction's `op_name` metadata carries the `koord/...` scope.
+
+The join is two-step because backends differ in what the trace stream
+preserves: TPU-style captures embed the scope path in the event args
+(substring match suffices), but the CPU profiler emits only the bare
+HLO instruction names (`add.635`, `fusion.19`) — so the tool also
+compiles the SAME program, parses `op_name="...koord/..."` metadata
+out of the HLO text, and joins trace events to phases through the
+instruction-name map. Same program, same names, exact join.
+
+This is the SAMPLED attribution; tools/profile_fullgate.py is the
+SUBTRACTIVE one (gate-off deltas). Both emit koordtrace JSONL keyed by
+the same phase names, so the two can be compared line-for-line.
+
+Usage: JAX_PLATFORMS=cpu python tools/trace_fullgate.py [pods] [nodes]
+  TRACE_FULLGATE_OUT=<path>  also write the per-phase koordtrace JSONL
+  TRACE_FULLGATE_DIR=<dir>   keep the raw profiler capture (default: a
+                             temp dir, deleted after parsing)
+
+If a backend yields no attributable events the tool says so and exits
+0 — an empty capture is a backend property, not a phase-table failure.
+"""
+
+import functools
+import glob
+import gzip
+import json
+import os
+import re
+import shutil
+import sys
+import tempfile
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import jax
+
+if os.environ.get("JAX_PLATFORMS"):
+    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+from koordinator_tpu.obs import phases as obs_phases
+from koordinator_tpu.obs.trace import jsonl_record
+from koordinator_tpu.scheduler import core
+from koordinator_tpu.scheduler.plugins.loadaware import LoadAwareConfig
+from koordinator_tpu.utils import synthetic
+
+P = int(sys.argv[1]) if len(sys.argv) > 1 else 2_000
+N = int(sys.argv[2]) if len(sys.argv) > 2 else 1_000
+
+_OP_NAME = re.compile(r'%?([\w.-]+) = [^\n]*op_name="([^"]*)"')
+_PHASE_IN_OP = re.compile(r"(koord/\w+)")
+
+
+def build_step():
+    step = jax.jit(functools.partial(
+        core.schedule_batch, num_rounds=2, k_choices=8,
+        score_dims=(0, 1), tie_break=True, quota_depth=2,
+        fit_dims=(0, 1, 2, 3), cascade=True,
+        enable_numa=True, enable_devices=True))
+    snap = jax.device_put(synthetic.full_gate_cluster(
+        N, seed=0, num_quotas=8, num_gangs=8))
+    pods = jax.device_put(synthetic.full_gate_pods(
+        P, N, seed=1, num_quotas=8, num_gangs=8))
+    cfg = jax.device_put(LoadAwareConfig.make())
+    return step, snap, pods, cfg
+
+
+def instruction_phases(step, snap, pods, cfg):
+    """{hlo instruction name: phase} parsed out of the compiled
+    program's `op_name` metadata — the named_scope labels end up as
+    path components there, and the profiler's X events reuse the
+    instruction names verbatim."""
+    txt = step.lower(snap, pods, cfg).compile().as_text()
+    mapping = {}
+    for instr, op_name in _OP_NAME.findall(txt):
+        m = _PHASE_IN_OP.search(op_name)
+        if m and m.group(1) in obs_phases.KERNEL_PHASES:
+            mapping[instr] = m.group(1)
+    return mapping
+
+
+def capture(step, snap, pods, cfg, trace_dir):
+    """One compiled dispatch under jax.profiler.trace (warmed first —
+    the capture must hold the steady-state dispatch, not the
+    compile)."""
+    jax.block_until_ready(step(snap, pods, cfg).assignment)
+    with jax.profiler.trace(trace_dir):
+        out = step(snap, pods, cfg)
+        jax.block_until_ready(out.assignment)
+    return int((jax.numpy.asarray(out.assignment) >= 0).sum())
+
+
+def load_trace_events(trace_dir):
+    """All traceEvents from every Perfetto JSON the profiler wrote
+    (plugins/profile/*/.../*.trace.json.gz)."""
+    events = []
+    pats = (os.path.join(trace_dir, "**", "*.trace.json.gz"),
+            os.path.join(trace_dir, "**", "*.trace.json"))
+    for pat in pats:
+        for path in sorted(glob.glob(pat, recursive=True)):
+            opener = gzip.open if path.endswith(".gz") else open
+            try:
+                with opener(path, "rt") as f:
+                    doc = json.load(f)
+            except (OSError, ValueError):
+                continue
+            events.extend(doc.get("traceEvents", []))
+    return events
+
+
+def phase_of(event, instr2phase):
+    """Map one profiler X event to a koordtrace phase, or None. Exact
+    instruction-name join first (the CPU stream carries nothing else);
+    scope-substring match over name + string args second (TPU-style
+    captures embed the full path) — innermost (longest) phase wins
+    when scopes nest."""
+    name = str(event.get("name", ""))
+    hit = instr2phase.get(name)
+    if hit is not None:
+        return hit
+    hay = [name]
+    args = event.get("args")
+    if isinstance(args, dict):
+        hay.extend(str(v) for v in args.values())
+    best = None
+    for phase in obs_phases.KERNEL_PHASES:
+        if any(phase in h for h in hay):
+            if best is None or len(phase) > len(best):
+                best = phase
+    return best
+
+
+def attribute(events, instr2phase):
+    """{phase: (total_duration_s, event_count)} over complete ('X')
+    events; container/metadata events carry no duration and are
+    skipped."""
+    totals = {}
+    for ev in events:
+        if ev.get("ph") != "X":
+            continue
+        phase = phase_of(ev, instr2phase)
+        if phase is None:
+            continue
+        dur_s = float(ev.get("dur", 0)) / 1e6   # trace-event us
+        tot, cnt = totals.get(phase, (0.0, 0))
+        totals[phase] = (tot + dur_s, cnt + 1)
+    return totals
+
+
+def main():
+    keep_dir = (os.environ.get("TRACE_FULLGATE_DIR") or "").strip()
+    trace_dir = keep_dir or tempfile.mkdtemp(prefix="trace_fullgate_")
+    print(f"platform={jax.devices()[0].platform} P={P} N={N} "
+          f"capture={trace_dir}", flush=True)
+    try:
+        step, snap, pods, cfg = build_step()
+        instr2phase = instruction_phases(step, snap, pods, cfg)
+        print(f"hlo_instructions_mapped={len(instr2phase)}", flush=True)
+        placed = capture(step, snap, pods, cfg, trace_dir)
+        events = load_trace_events(trace_dir)
+        totals = attribute(events, instr2phase)
+        print(f"placed={placed} profiler_events={len(events)} "
+              f"attributed_phases={len(totals)}", flush=True)
+        if not totals:
+            print("trace_fullgate: no phase-attributed events in this "
+                  "backend's capture (empty capture is a backend "
+                  "property, not a phase-table failure)", flush=True)
+            return 0
+        width = max(len(p) for p in totals)
+        lines = []
+        for phase, (dur_s, cnt) in sorted(totals.items(),
+                                          key=lambda kv: -kv[1][0]):
+            print(f"{phase:{width}s} total={dur_s * 1e3:9.3f}ms "
+                  f"events={cnt}", flush=True)
+            lines.append(jsonl_record(
+                phase, dur_s,
+                attrs={"source": "trace_fullgate", "events": cnt,
+                       "pods": P, "nodes": N}))
+        out = (os.environ.get("TRACE_FULLGATE_OUT") or "").strip()
+        if out:
+            with open(out, "w") as f:
+                f.write("\n".join(lines) + "\n")
+            print(f"koordtrace JSONL -> {out}", flush=True)
+        return 0
+    finally:
+        if not keep_dir:
+            shutil.rmtree(trace_dir, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
