@@ -18,6 +18,8 @@ import collections
 import threading
 from typing import Dict, Optional
 
+from koordinator_tpu.utils.sync import guard_module
+
 # the event names jax 0.4.x emits (jax/_src/compiler.py,
 # jax/_src/compilation_cache.py); pinned by tests/test_compilecache.py
 EVENT_CACHE_HIT = "/jax/compilation_cache/cache_hits"
@@ -29,6 +31,8 @@ _lock = threading.Lock()
 _counts: collections.Counter = collections.Counter()
 _durations: Dict[str, float] = collections.defaultdict(float)
 _installed = False
+guard_module(__name__, _counts="_lock", _durations="_lock",
+             _installed="_lock")
 
 
 def _on_event(event: str, **_kw) -> None:

@@ -13,6 +13,8 @@ import dataclasses
 import threading
 from typing import Dict, Iterable, Mapping
 
+from koordinator_tpu.utils.sync import guarded_by
+
 
 @dataclasses.dataclass(frozen=True)
 class FeatureSpec:
@@ -21,6 +23,7 @@ class FeatureSpec:
     lock_to_default: bool = False
 
 
+@guarded_by(_specs="_lock", _overrides="_lock")
 class FeatureGate:
     """Mutable view over a spec registry (featuregate.MutableFeatureGate)."""
 
@@ -38,23 +41,24 @@ class FeatureGate:
                 self._specs[name] = spec
 
     def known(self) -> Iterable[str]:
-        return sorted(self._specs)
+        with self._lock:
+            return sorted(self._specs)
 
     def enabled(self, name: str) -> bool:
-        spec = self._specs.get(name)
-        if spec is None:
-            raise KeyError(f"unknown feature gate {name!r}")
         with self._lock:
+            spec = self._specs.get(name)
+            if spec is None:
+                raise KeyError(f"unknown feature gate {name!r}")
             return self._overrides.get(name, spec.default)
 
     def set(self, name: str, value: bool) -> None:
-        spec = self._specs.get(name)
-        if spec is None:
-            raise KeyError(f"unknown feature gate {name!r}")
-        if spec.lock_to_default and value != spec.default:
-            raise ValueError(f"feature gate {name} is locked to "
-                             f"{spec.default}")
         with self._lock:
+            spec = self._specs.get(name)
+            if spec is None:
+                raise KeyError(f"unknown feature gate {name!r}")
+            if spec.lock_to_default and value != spec.default:
+                raise ValueError(f"feature gate {name} is locked to "
+                                 f"{spec.default}")
             self._overrides[name] = value
 
     def set_from_map(self, values: Mapping[str, bool]) -> None:

@@ -20,6 +20,7 @@ from koordinator_tpu.utils.httpserver import (
     BackgroundHTTPServer,
     QuietJsonHandler,
 )
+from koordinator_tpu.utils.sync import guarded_by
 
 
 @dataclasses.dataclass
@@ -39,6 +40,15 @@ class Event:
         return cls(**json.loads(line))
 
 
+@guarded_by(
+    _ring="_lock",
+    _fh="_lock",
+    _fh_bytes="_lock",
+    _ring_size="publish-once",
+    _log_dir="publish-once",
+    _max_file_bytes="publish-once",
+    _max_files="publish-once",
+)
 class Auditor:
     """Ring buffer + rotating files. Thread-safe."""
 
@@ -133,6 +143,16 @@ class _Reader:
         self.refresh_at = now
 
 
+@guarded_by(
+    _readers="_lock",
+    auditor="publish-once",
+    default_limit="publish-once",
+    max_limit="publish-once",
+    reader_ttl="publish-once",
+    max_readers="publish-once",
+    _server="publish-once",
+    port="publish-once",
+)
 class AuditQueryServer:
     """HTTP query endpoint for audit events (auditor.go:130 HttpHandler,
     gated by AuditEventsHTTPHandler): GET /events?size=N&pageToken=T

@@ -21,6 +21,8 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from koordinator_tpu.utils.sync import guarded_by
+
 # --- metric kinds (metric_resources.go) ---------------------------------
 NODE_CPU_USAGE = "node_cpu_usage"            # cores
 NODE_MEMORY_USAGE = "node_memory_usage"      # bytes
@@ -107,6 +109,7 @@ class _Ring:
         return float(self.ts[i]), float(self.val[i])
 
 
+@guarded_by(_series="_lock", _kv="_lock", _cap="publish-once")
 class MetricCache:
     """Thread-safe append/query store (MetricCache interface,
     metric_cache.go:56-60)."""

@@ -26,6 +26,8 @@ import struct
 import threading
 from typing import Callable, Dict, List, Optional, Set
 
+from koordinator_tpu.utils.sync import guarded_by
+
 IN_CREATE = 0x00000100
 IN_DELETE = 0x00000200
 IN_ISDIR = 0x40000000
@@ -169,6 +171,7 @@ class InotifyWatcher:
         os.close(self._fd)
 
 
+@guarded_by(_handlers="_lock", watcher="publish-once")
 class Pleg:
     """Drives a watcher and fans events out to handlers (pleg.go)."""
 

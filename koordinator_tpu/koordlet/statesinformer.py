@@ -22,6 +22,7 @@ import time
 from typing import Callable, Dict, List, Optional
 
 from koordinator_tpu.api import types as api
+from koordinator_tpu.utils.sync import guarded_by
 from koordinator_tpu.api.extension import (
     ANNOTATION_RESOURCE_STATUS,
     PriorityClass,
@@ -97,6 +98,15 @@ class PodMeta:
                 CgroupDriver.CGROUPFS)
 
 
+@guarded_by(
+    _node="_lock",
+    _pods="_lock",
+    _node_slo="_lock",
+    _topology="_lock",
+    _device="_lock",
+    _pvc_volumes="_lock",
+    _callbacks="_lock",
+)
 class StatesInformer:
     """Typed state registry with subscriber callbacks."""
 
@@ -115,7 +125,13 @@ class StatesInformer:
             self._callbacks.setdefault(state, []).append(cb)
 
     def _notify(self, state: str, value: object) -> None:
-        for cb in self._callbacks.get(state, []):
+        # snapshot the subscriber list under the lock, call OUTSIDE it:
+        # iterating the live list races subscribe()'s append, and
+        # holding an RLock through arbitrary callbacks invites
+        # re-entrant surprises the setters never signed up for
+        with self._lock:
+            cbs = list(self._callbacks.get(state, []))
+        for cb in cbs:
             cb(value)
 
     # --- setters (informer plugin update paths) -------------------------

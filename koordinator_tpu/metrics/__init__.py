@@ -25,6 +25,8 @@ import time
 from contextlib import contextmanager
 from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 
+from koordinator_tpu.utils.sync import guarded_by
+
 __all__ = [
     "Counter", "Gauge", "Histogram", "Registry",
     "global_registry", "kernel_timer",
@@ -41,6 +43,12 @@ def _validate_labels(names: Sequence[str], values: Sequence[str]) -> Tuple[str, 
     return tuple(str(v) for v in values)
 
 
+@guarded_by(
+    _children="_lock",
+    name="publish-once",
+    help="publish-once",
+    label_names="publish-once",
+)
 class _Metric:
     """Base: a named family of label-keyed children."""
 
@@ -139,6 +147,12 @@ class Gauge(_Metric):
         self._add((), delta)
 
 
+@guarded_by(
+    # _lock is INHERITED from _Metric — one lock guards both the
+    # scalar children and the bucket arrays
+    _hist="_lock",
+    buckets="publish-once",
+)
 class Histogram(_Metric):
     kind = "histogram"
 
@@ -235,6 +249,7 @@ class Histogram(_Metric):
             self._hist.clear()
 
 
+@guarded_by(_metrics="_lock", prefix="publish-once")
 class Registry:
     """A named collection of metric families with text exposition."""
 

@@ -28,6 +28,8 @@ import time
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional, Tuple
 
+from koordinator_tpu.utils.sync import guarded_by
+
 
 @dataclass(frozen=True)
 class SpanRecord:
@@ -94,6 +96,22 @@ class _Span:
         return False
 
 
+@guarded_by(
+    _buf="_lock",
+    _head="_lock",
+    _dropped="_lock",
+    # the span stack lives behind a threading.local handle: each
+    # thread nests its own cycles without touching the lock
+    _tls="confined",
+    # wired by the owning service before the first span opens, never
+    # rebound after; hook CALLS deliberately run outside the lock
+    observer="publish-once",
+    on_drop="publish-once",
+    capacity="publish-once",
+    anchor_monotonic_ns="publish-once",
+    anchor_unix_ns="publish-once",
+    pid="publish-once",
+)
 class Tracer:
     """Bounded structured span tracer.
 

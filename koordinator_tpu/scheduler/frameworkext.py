@@ -59,10 +59,18 @@ from koordinator_tpu.scheduler.metrics_defs import SchedulerMetrics
 from koordinator_tpu.scheduler.plugins.loadaware import LoadAwareConfig
 from koordinator_tpu.snapshot.schema import ClusterSnapshot, PodBatch
 from koordinator_tpu.snapshot.store import SnapshotStore
+from koordinator_tpu.utils.sync import guarded_by
 
 log = logging.getLogger(__name__)
 
 
+@guarded_by(
+    _inflight="_lock",
+    _seq="_lock",
+    timeouts="_lock",
+    timeout="publish-once",
+    metrics="publish-once",
+)
 class SchedulerMonitor:
     """Per-batch cycle watchdog."""
 
@@ -151,6 +159,17 @@ class LadderState:
         return name
 
 
+@guarded_by(
+    # see the class docstring: the ladder is cycle machinery — the
+    # service mutates it only between program attempts of one cycle
+    level="confined",
+    chunk_splits="confined",
+    clean_streak="confined",
+    degraded_cycles="confined",
+    transitions="confined",
+    probe_after="publish-once",
+    max_chunk_splits="publish-once",
+)
 class DegradationLadder:
     """The explicit ladder between "all healthy" and "crash".
 
@@ -526,6 +545,57 @@ class ServicesServer:
         self._server.close()
 
 
+@guarded_by(
+    # batch commits: snapshot read -> device program -> publish, plus
+    # all journal/epoch bookkeeping, serialize under the commit lock
+    epoch="_commit_lock",
+    _own_epochs="_commit_lock",
+    _forced_chunks="_commit_lock",
+    _cycle_digest="_commit_lock",
+    _cycle_base_version="_commit_lock",
+    _cycle_replayed="_commit_lock",
+    _cycle_state="_commit_lock",
+    _last_mesh_size="_commit_lock",
+    last_committed_version="_commit_lock",
+    schedule_kwargs="_commit_lock",
+    # post-commit throughput counters get their own cheap lock so
+    # readers never queue behind a device program
+    batches="_counter_lock",
+    pods_placed="_counter_lock",
+    # per-thread (version, elapsed) handoff — see last_schedule_info
+    _tls="confined",
+    # shared last_* observability attrs: torn reads tolerated by
+    # design (last_schedule_info is the race-free alternative)
+    last_elapsed="racy-monitor",
+    last_health_word="racy-monitor",
+    last_quarantined_pods="racy-monitor",
+    last_ladder_state="racy-monitor",
+    last_gang_failed="racy-monitor",
+    last_recovery="racy-monitor",
+    # wiring, fixed before concurrent traffic starts
+    store="publish-once",
+    cfg="publish-once",
+    metrics="publish-once",
+    monitor="publish-once",
+    flags="publish-once",
+    registry="publish-once",
+    auto_pack="publish-once",
+    guards_enabled="publish-once",
+    max_cycle_attempts="publish-once",
+    ladder="publish-once",
+    retry_policy="publish-once",
+    _sleep="publish-once",
+    fault_injection="publish-once",
+    journal="publish-once",
+    compile_cache="publish-once",
+    tracer="publish-once",
+    _cycle_ids="publish-once",
+    device_health="publish-once",
+    _explicit_amp="publish-once",
+    error_dispatcher="publish-once",
+    on_gang_failed="publish-once",
+    on_assumed="publish-once",
+)
 class SchedulerService:
     """The sidecar seam: snapshot in, assignments out.
 
@@ -1201,7 +1271,13 @@ class SchedulerService:
                     self.on_assumed(assignment, typed_pods, result)
             except Exception as exc:
                 raise _CommittedCycleError(exc) from exc
-        return snap, result, assignment, health, pod_bad, version
+            # cycle-local copies captured under the lock: by the time
+            # schedule() publishes metrics, a concurrent cycle may have
+            # overwritten the shared attributes
+            mesh_size = self._last_mesh_size
+            replayed = self._cycle_replayed
+        return (snap, result, assignment, health, pod_bad, version,
+                mesh_size, replayed)
 
     def _trace_transitions(self, n_before: int, cycle_id: int) -> None:
         """Emit one koordtrace instant event per ladder transition the
@@ -1226,9 +1302,12 @@ class SchedulerService:
         backoff sleeps happen OUTSIDE the commit lock so publishes and
         ingests proceed while a retry waits."""
         token = self.monitor.start_cycle()
-        backoff = Backoff(self.retry_policy, seed=self.batches)
-        attempts = 0
         cycle_id = next(self._cycle_ids)
+        # the cycle id is unique per call (no two concurrent cycles
+        # share a jitter stream) and needs no lock, unlike the batch
+        # counter it used to seed from
+        backoff = Backoff(self.retry_policy, seed=cycle_id)
+        attempts = 0
         while True:
             n_trans = len(self.ladder.transitions)
             state, probing = self.ladder.begin_cycle()
@@ -1240,8 +1319,9 @@ class SchedulerService:
                         cyc["attempt"] = attempts
                         cyc["ladder"] = state.label()
                     (snap, result, assignment, health, pod_bad,
-                     version) = self._locked_cycle(pods, typed_pods,
-                                                   state)
+                     version, mesh_size,
+                     replayed) = self._locked_cycle(pods, typed_pods,
+                                                    state)
                 n_trans = len(self.ladder.transitions)
                 self.ladder.on_success(probing, state)
                 self._trace_transitions(n_trans, cycle_id)
@@ -1316,9 +1396,9 @@ class SchedulerService:
         if state.degraded or probing:
             self.metrics.degraded_cycles.labels(state.label()).inc()
         self.metrics.degradation_level.set(float(self.ladder.level))
-        self.metrics.mesh_size.set(float(self._last_mesh_size))
-        if self.journal is not None and self._cycle_replayed:
-            self.metrics.recovery_replayed.inc(self._cycle_replayed)
+        self.metrics.mesh_size.set(float(mesh_size))
+        if self.journal is not None and replayed:
+            self.metrics.recovery_replayed.inc(replayed)
         word = int(health[0]) if health is not None else 0
         self.last_health_word = word
         pod_bad_np: Optional[np.ndarray] = None
@@ -1480,10 +1560,17 @@ class SchedulerService:
             for e in epochs:
                 pods = batches(e) if callable(batches) else batches[e]
                 typed = (typed_pods_by_epoch or {}).get(e)
-                self.epoch = e
+                # epoch/bookkeeping writes take the commit lock even on
+                # this (normally single-threaded) startup path: a
+                # producer already re-ingesting deltas concurrently
+                # must never see a half-switched epoch
+                with self._commit_lock:
+                    self.epoch = e
                 results[e] = self.schedule(pods, typed_pods=typed)
-                replayed += self._cycle_replayed
-            self.epoch = self.journal.next_epoch()
+                with self._commit_lock:
+                    replayed += self._cycle_replayed
+            with self._commit_lock:
+                self.epoch = self.journal.next_epoch()
         seconds = time.monotonic() - t0
         compile_seconds = min(compile_watch.compile_seconds, seconds)
         replay_seconds = seconds - compile_seconds
@@ -1550,16 +1637,22 @@ class SchedulerService:
         return version, self._tls.elapsed
 
     def summary(self) -> dict:
+        with self._counter_lock:
+            batches, placed = self.batches, self.pods_placed
         return {
-            "batches": self.batches,
-            "podsPlaced": self.pods_placed,
+            "batches": batches,
+            "podsPlaced": placed,
             "lastCycleSeconds": round(self.last_elapsed, 4),
             "cycleTimeouts": self.monitor.timeouts,
             "snapshotVersion": self.store.version,
             "degradationLevel": DegradationLadder.LEVELS[self.ladder.level],
             "ladderTransitions": len(self.ladder.transitions),
             "lastHealthWord": self.last_health_word,
-            "meshSize": self._last_mesh_size,
-            "epoch": self.epoch,
+            # deliberately lockless: a monitoring read must never queue
+            # behind an in-flight device program on the commit lock;
+            # torn values here cost a stale dashboard sample, nothing
+            # more
+            "meshSize": self._last_mesh_size,  # koordlint: disable=GB001
+            "epoch": self.epoch,  # koordlint: disable=GB001
             "journaled": self.journal is not None,
         }

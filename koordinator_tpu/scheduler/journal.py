@@ -44,6 +44,7 @@ from typing import Callable, Dict, List, Optional, Set
 import numpy as np
 
 from koordinator_tpu.snapshot.schema import STRUCT_SPECS
+from koordinator_tpu.utils.sync import guarded_by
 
 # record framing: MAGIC, payload length, crc32(payload)
 _MAGIC = 0x4B4A4C31  # "KJL1"
@@ -148,6 +149,19 @@ def batch_digest(pods) -> int:
     return d & 0xFFFFFFFF
 
 
+@guarded_by(
+    # the journal deliberately owns NO lock: every mutation happens
+    # inside the owning service's commit critical section (append-
+    # before-publish), so the commit lock IS the journal's lock
+    records="external:SchedulerService._commit_lock",
+    abandoned="external:SchedulerService._commit_lock",
+    tail_reason="external:SchedulerService._commit_lock",
+    appended_records="external:SchedulerService._commit_lock",
+    appended_bytes="external:SchedulerService._commit_lock",
+    _good_end="external:SchedulerService._commit_lock",
+    path="publish-once",
+    crash_hook="publish-once",
+)
 class CommitJournal:
     """Append-only, checksummed, torn-tail-tolerant chunk commit log.
 

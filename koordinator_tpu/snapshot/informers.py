@@ -26,6 +26,7 @@ import numpy as np
 from koordinator_tpu.api import types as api
 from koordinator_tpu.snapshot.builder import SnapshotBuilder
 from koordinator_tpu.snapshot.store import SnapshotStore
+from koordinator_tpu.utils.sync import guarded_by
 
 # event kinds (informer registry; frameworkext/informers.go)
 KIND_NODE = "node"
@@ -42,6 +43,22 @@ EVENT_UPDATE = "update"
 EVENT_DELETE = "delete"
 
 
+@guarded_by(
+    resource_version="_lock",
+    _nodes="_lock",
+    _pods="_lock",
+    _metrics="_lock",
+    _reservations="_lock",
+    _pod_groups="_lock",
+    _quotas="_lock",
+    _quota_profiles="_lock",
+    _devices="_lock",
+    _pods_by_node="_lock",
+    _pods_by_owner="_lock",
+    _handlers="_lock",
+    _assumed="_lock",
+    _recent_assigned="_lock",
+)
 class ClusterInformerHub:
     """Typed caches + incremental indexes + subscriber fan-out. Also
     implements the manager's ClusterSource protocol so one hub feeds the
@@ -220,8 +237,12 @@ class ClusterInformerHub:
             self._notify(kind, event, obj)
 
     def set_node_metric(self, metric: api.NodeMetric) -> None:
-        self._upsert(self._metrics, metric.node_name, KIND_NODE_METRIC,
-                     metric)
+        # even reading the cache BINDING belongs under the (reentrant)
+        # lock: the guarded-by contract covers the attribute, and the
+        # argument would otherwise be evaluated bare
+        with self._lock:
+            self._upsert(self._metrics, metric.node_name,
+                         KIND_NODE_METRIC, metric)
 
     def upsert_reservation(self, r: api.Reservation) -> None:
         with self._lock:
@@ -230,7 +251,8 @@ class ClusterInformerHub:
             # the same consumer twice (status.currentOwners)
             for uid in r.current_owners:
                 self._retire_assumed(uid)
-        self._upsert(self._reservations, r.meta.name, KIND_RESERVATION, r)
+            self._upsert(self._reservations, r.meta.name,
+                         KIND_RESERVATION, r)
 
     def delete_reservation(self, name: str) -> None:
         with self._lock:
@@ -239,17 +261,23 @@ class ClusterInformerHub:
                 self._notify(KIND_RESERVATION, EVENT_DELETE, r)
 
     def upsert_pod_group(self, pg: api.PodGroup) -> None:
-        self._upsert(self._pod_groups, pg.meta.name, KIND_POD_GROUP, pg)
+        with self._lock:
+            self._upsert(self._pod_groups, pg.meta.name, KIND_POD_GROUP,
+                         pg)
 
     def upsert_quota(self, q: api.ElasticQuota) -> None:
-        self._upsert(self._quotas, q.meta.name, KIND_QUOTA, q)
+        with self._lock:
+            self._upsert(self._quotas, q.meta.name, KIND_QUOTA, q)
 
     def upsert_quota_profile(self, p: api.ElasticQuotaProfile) -> None:
-        self._upsert(self._quota_profiles, p.meta.name, KIND_QUOTA_PROFILE,
-                     p)
+        with self._lock:
+            self._upsert(self._quota_profiles, p.meta.name,
+                         KIND_QUOTA_PROFILE, p)
 
     def set_device(self, device: api.Device) -> None:
-        self._upsert(self._devices, device.node_name, KIND_DEVICE, device)
+        with self._lock:
+            self._upsert(self._devices, device.node_name, KIND_DEVICE,
+                         device)
 
     # --- reads / indexes ------------------------------------------------
     def get_pod(self, uid: str) -> Optional[api.Pod]:
@@ -363,6 +391,33 @@ def _node_identity(node: api.Node) -> tuple:
             node.unschedulable, tfp)
 
 
+@guarded_by(
+    _full_dirty="_lock",
+    _dirty_metrics="_lock",
+    _dirty_topology="_lock",
+    _node_seen="_lock",
+    # builder/ctx mutate only inside the attached service's commit
+    # critical section (sync()/build_pod_batch take _commit_guard());
+    # _view_lock ADDITIONALLY pairs the (snapshot, builder) swap for
+    # cross-thread summary readers — lock order commit -> view
+    builder="external:SchedulerService._commit_lock",
+    ctx="external:SchedulerService._commit_lock",
+    # sync() runs on one loop; these tallies are observability reads
+    # elsewhere — torn reads tolerated by design
+    full_rebuilds="racy-monitor",
+    delta_ingests="racy-monitor",
+    topology_ingests="racy-monitor",
+    # wired once by attach_scheduler before concurrent traffic starts
+    _service="publish-once",
+    hub="publish-once",
+    store="publish-once",
+    max_nodes="publish-once",
+    delta_pad="publish-once",
+    now_fn="publish-once",
+    assume_ttl="publish-once",
+    estimation_ttl="publish-once",
+    builder_caps="publish-once",
+)
 class SnapshotSyncer:
     """Keeps a SnapshotStore fresh from a hub: NodeMetric churn becomes
     an O(K) device-side delta (store.ingest), anything that changes the

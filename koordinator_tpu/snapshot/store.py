@@ -35,6 +35,7 @@ from koordinator_tpu.snapshot.schema import (
     STRUCT_SPECS,
     ClusterSnapshot,
 )
+from koordinator_tpu.utils.sync import guarded_by
 
 # checkpoint framing: MAGIC, store version, applied delta watermark,
 # npz byte length, then crc32 over ALL of the preceding header fields
@@ -74,6 +75,21 @@ def _build_struct(name: str, arrays: Dict[str, np.ndarray],
     return STRUCT_CLASSES[name](**fields)
 
 
+@guarded_by(
+    _current="_lock",
+    _version="_lock",
+    _applied_delta_version="_lock",
+    _last_delta_rejection="_lock",
+    delta_rejections="_lock",
+    _last_checkpoint_version="_lock",
+    # checkpoint serialization: _ck_lock spans capture -> tmp ->
+    # os.replace and owns the written-checkpoint counter
+    checkpoints_written="_ck_lock",
+    _sharding="publish-once",
+    checkpoint_path="publish-once",
+    checkpoint_every="publish-once",
+    crash_hook="publish-once",
+)
 class SnapshotStore:
     """Holds the current device-resident ClusterSnapshot.
 
@@ -117,18 +133,21 @@ class SnapshotStore:
 
     @property
     def version(self) -> int:
-        return self._version
+        with self._lock:
+            return self._version
 
     @property
     def applied_delta_version(self) -> int:
-        return self._applied_delta_version
+        with self._lock:
+            return self._applied_delta_version
 
     @property
     def last_checkpoint_version(self) -> int:
         """Store version of the last durable checkpoint (0 = none) —
         the anchor below which journal epochs can never replay
         (CommitJournal.prune)."""
-        return self._last_checkpoint_version
+        with self._lock:
+            return self._last_checkpoint_version
 
     def take_delta_rejection(self):
         """Pop the last ingest's DeltaRejectReason (None if it applied)

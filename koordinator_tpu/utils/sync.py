@@ -1,0 +1,127 @@
+"""koordrace contracts: declared guarded-by tables for concurrent state.
+
+The fourth rung of the contract ladder (shape -> dtype -> pad -> race).
+`@guarded_by(...)` declares, per class, which lock guards each mutable
+attribute — the same move `@shape_contract` makes for kernel shapes: a
+zero-cost literal table that two independent tiers check.
+
+  Tier A: tools/lint/analyzers/race.py walks the AST against these
+          tables (GB001 access outside the lock, GB002 check-then-act,
+          GB003 escaping references, GB004 declared-vs-actual drift,
+          GB005 malformed contracts).
+  Tier B: tools/racecheck.py drives seeded deterministic interleavings
+          over the real classes and asserts their invariants hold.
+
+Contract vocabulary (every guard value is a literal string — the static
+tier never evaluates code):
+
+  "_lock"            the instance attribute naming the guarding lock;
+                     every read/write of the field must happen inside a
+                     `with self._lock:` block (helper methods are
+                     resolved through the intra-class call graph)
+  "publish-once"     assigned in __init__ (or before threads start) and
+                     never rebound after publication; readers need no
+                     lock because writers no longer exist
+  "confined"         touched by exactly one thread for the object's
+                     whole life (per-cycle scheduler machinery,
+                     threading.local handles) — confinement IS the lock
+  "racy-monitor"     deliberately unsynchronized monitoring state
+                     (last_* observability attrs): torn reads are
+                     tolerated by design and documented here rather
+                     than silenced with pragmas
+  "external:Owner.lock"
+                     guarded by ANOTHER object's lock — the journal's
+                     records are mutated only under the owning
+                     SchedulerService's commit lock; the class itself
+                     deliberately owns no lock
+
+The decorator costs nothing at runtime beyond one dict insert at import
+time: no wrappers, no per-access checks, no __slots__ games. Duplicate
+registration raises — two contracts for one class means one is stale.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Dict
+
+# dotted class name ("koordinator_tpu.snapshot.store.SnapshotStore")
+# -> {attr: guard}. Populated at import time by @guarded_by.
+GUARDED_BY: Dict[str, Dict[str, str]] = {}
+
+# module name -> {global_name: guard} for module-level locks (the
+# compilecache counters pattern). Guard grammar is the subset that
+# makes sense at module scope: a module-global lock name.
+MODULE_GUARDS: Dict[str, Dict[str, str]] = {}
+
+# the non-lock guard keywords; anything else must be an attribute name
+# (a lock the class owns) or an external:Owner.lock reference
+GUARD_VOCAB = ("publish-once", "confined", "racy-monitor")
+
+_IDENT = re.compile(r"^[A-Za-z_]\w*$")
+_EXTERNAL = re.compile(r"^external:[A-Za-z_]\w*(\.[A-Za-z_]\w*)+$")
+
+
+def _validate(owner: str, table: Dict[str, str]) -> None:
+    if not table:
+        raise ValueError(f"guarded_by on {owner}: empty contract — a "
+                         f"lock-owning class must declare its fields")
+    for attr, guard in table.items():
+        if not isinstance(attr, str) or not _IDENT.match(attr):
+            raise ValueError(f"guarded_by on {owner}: field name "
+                             f"{attr!r} is not an identifier")
+        if not isinstance(guard, str):
+            raise ValueError(f"guarded_by on {owner}: guard for "
+                             f"{attr!r} must be a literal string, got "
+                             f"{type(guard).__name__}")
+        if guard in GUARD_VOCAB:
+            continue
+        if guard.startswith("external:"):
+            if not _EXTERNAL.match(guard):
+                raise ValueError(
+                    f"guarded_by on {owner}: malformed external guard "
+                    f"{guard!r} for {attr!r} (want "
+                    f"'external:Owner.lock_attr')")
+            continue
+        if not _IDENT.match(guard):
+            raise ValueError(f"guarded_by on {owner}: guard {guard!r} "
+                             f"for {attr!r} is neither a lock "
+                             f"attribute name nor one of {GUARD_VOCAB}")
+
+
+def guarded_by(**table: str):
+    """Class decorator: register the class's concurrency contract.
+
+    Keyword names are instance attributes; values are guards per the
+    module docstring's vocabulary. The table is validated and frozen at
+    decoration time; the class itself is returned untouched.
+    """
+
+    def deco(cls: type) -> type:
+        name = getattr(cls, "__name__", None)
+        module = getattr(cls, "__module__", None)
+        if not name or not module:
+            raise ValueError("guarded_by target has no name/module")
+        key = f"{module}.{name}"
+        _validate(key, table)
+        if key in GUARDED_BY:
+            raise ValueError(f"duplicate guarded_by contract {key}")
+        GUARDED_BY[key] = dict(table)
+        return cls
+
+    return deco
+
+
+def guard_module(module: str, **table: str) -> None:
+    """Declare guards for MODULE-LEVEL mutable globals (the
+    compilecache counters pattern: one module lock, a few dicts).
+    Call as `guard_module(__name__, _counts="_lock", ...)` next to the
+    globals it describes. Guards follow the same vocabulary as
+    guarded_by; lock names refer to module globals."""
+    if not isinstance(module, str) or not module:
+        raise ValueError("guard_module: module name required "
+                         "(pass __name__)")
+    _validate(module, table)
+    if module in MODULE_GUARDS:
+        raise ValueError(f"duplicate guard_module contract {module}")
+    MODULE_GUARDS[module] = dict(table)

@@ -1,0 +1,103 @@
+"""Negative race-guard fixtures: disciplined contracts the analyzer
+must stay silent on — base-class lock inheritance, entry-held helper
+resolution, the never-guess rule for unresolvable context managers,
+the spanning-lock check-then-act exemption, copy-out returns, and the
+declaration-only vocabulary guards."""
+
+import threading
+
+from koordinator_tpu.utils.sync import guard_module, guarded_by
+
+_lock = threading.Lock()
+_events = []
+
+guard_module(__name__, _events="_lock")
+
+
+def record(ev):
+    with _lock:
+        _events.append(ev)
+
+
+def snapshot():
+    with _lock:
+        return list(_events)
+
+
+@guarded_by(_epoch="_lock")
+class _Base:
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._epoch = 0
+
+    def tick(self):
+        with self._lock:
+            self._epoch += 1
+
+
+@guarded_by(
+    _items="_lock",                # inherited from _Base
+    _stats="_lock",
+    _sink="confined",
+    capacity="publish-once",
+    journal="external:Owner._commit_lock",
+)
+class Store(_Base):
+    def __init__(self):
+        super().__init__()
+        self._ck = threading.Lock()
+        self._items = []
+        self._stats = {}
+        self._sink = []
+        self.capacity = 8
+        self.journal = None
+        self._warm()
+
+    def _warm(self):
+        # reachable only from construction: exempt from inheritance
+        self._stats = {"n": 0}
+
+    def add(self, x):
+        with self._lock:
+            self._append_locked(x)
+
+    def extend(self, xs):
+        with self._lock:
+            for x in xs:
+                self._append_locked(x)
+
+    def _append_locked(self, x):
+        # entry-held: every intra-class call site holds _lock
+        self._items.append(x)
+        self._stats = dict(self._stats, n=len(self._items))
+
+    def drain(self):
+        with self._lock:
+            out = list(self._items)   # copy-out: no escaping reference
+            self._items = []
+        return out
+
+    def checkpointed_trim(self, cap):
+        # two _lock windows, but _ck spans both: the read cannot go
+        # stale between them (the SnapshotStore.checkpoint pattern)
+        with self._ck:
+            with self._lock:
+                n = self._stats["n"]
+            keep = min(n, cap)
+            with self._lock:
+                self._stats = dict(self._stats, n=keep)
+
+    def export(self, fh):
+        with fh:
+            # unresolvable context manager: never guess what it
+            # synchronizes, report nothing inside it
+            self._stats = dict(self._stats, exported=True)
+
+    def sink(self, x):
+        self._sink.append(x)       # confined: declaration-only
+
+    def cap(self):
+        return self.capacity       # publish-once: no lock needed
+
+    def journal_ref(self):
+        return self.journal        # external guard: owner enforces

@@ -1,0 +1,88 @@
+"""Positive race-guard fixtures: every GB code fires at least once —
+unguarded accesses (class and module scope), a check-then-act window,
+an escaping mutable reference, all three GB004 drift shapes, and a
+malformed contract."""
+
+import threading
+
+from koordinator_tpu.utils.sync import guard_module, guarded_by
+
+_lock = threading.Lock()
+_pending = []
+
+guard_module(__name__, _pending="_lock")
+
+
+def enqueue(item):
+    _pending.append(item)          # GB001: module global outside _lock
+
+
+def drain_pending():
+    with _lock:
+        return list(_pending)
+
+
+@guarded_by(_count="_lock", _items="_lock")
+class Accounts:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._count = 0
+        self._items = []
+
+    def bump(self):
+        self._count += 1           # GB001: write outside the lock
+
+    def reserve(self, n):
+        with self._lock:
+            have = self._count
+        if have < n:
+            return False
+        with self._lock:
+            self._count = have - n  # GB002: acts on the stale read
+        return True
+
+    def items(self):
+        with self._lock:
+            return self._items     # GB003: live mutable ref escapes
+
+    def put(self, x):
+        with self._lock:
+            self._items.append(x)
+            self._count += 1
+
+
+class NoContract:                  # GB004: lock-owning, no contract
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.value = 0
+
+    def set(self, v):
+        with self._lock:
+            self.value = v
+
+
+@guarded_by(_data="_missing")      # GB004: guard names no real lock
+class Drifted:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._data = {}
+
+    def get(self):
+        with self._lock:
+            return dict(self._data)
+
+
+@guarded_by(_q="_qlock")           # GB004: guard never acquired
+class DeadGuard:
+    def __init__(self):
+        self._qlock = threading.Lock()
+        self._q = []
+
+    def size_hint(self):
+        return 0
+
+
+@guarded_by(_x="not an identifier!")   # GB005: outside the grammar
+class Malformed:
+    def __init__(self):
+        self._x = 0

@@ -38,6 +38,7 @@ _ANALYZER_NAMES = {
     "lock_discipline": "lock-discipline",
     "metric_names": "metric-registry",
     "proto_drift": "proto-drift",
+    "race": "race-guard",
     "robustness": "robustness",
     "shape_contract": "shape-contract",
     "tail_readback": "tail-readback",
@@ -66,6 +67,7 @@ def empty_baseline(tmp_path):
     ("lock_discipline", {"LK001", "LK002", "LK003", "LK004", "LK005"}),
     ("metric_names", {"MN001", "MN002", "MN003", "MN004"}),
     ("proto_drift", {"PD001", "PD002", "PD003"}),
+    ("race", {"GB001", "GB002", "GB003", "GB004", "GB005"}),
     ("robustness", {"RB001"}),
     ("shape_contract", {"SH001", "SH002", "SH003", "SH004", "SH005"}),
     ("tail_readback", {"HS006"}),

@@ -40,6 +40,22 @@ echo "=== koordpad dual-tier mutation smoke (gate liveness) ==="
 # pad-soundness lint must flag PS002)
 JAX_PLATFORMS=cpu python tools/padcheck.py --self-test-mutation
 
+echo "=== koordrace Tier B (deterministic interleaving gate) ==="
+# the guarded concurrent classes (store ingest/update/read, journal
+# append/prune/reload, tracer span storm, metrics observe/export) run
+# under a seeded deterministic scheduler across rr + random +
+# bounded-preemption schedules; same seed must replay the same
+# schedule (tools/racecheck.py)
+JAX_PLATFORMS=cpu python tools/racecheck.py
+
+echo "=== koordrace dual-tier mutation smoke (gate liveness) ==="
+# one seeded lock drop per tier in a TEMP COPY: ingest's version guard
+# on a fresh lock only the interleaving explorer can see (racecheck
+# must FAIL, race-guard lint must pass), and a cold-path MetricCache
+# unlock only the guarded-by contracts can see (GB001 must fire,
+# racecheck must pass) — complementarity, not redundancy
+JAX_PLATFORMS=cpu python tools/racecheck.py --self-test-mutation
+
 echo "=== full-gate cascade smoke (2k pods x 200 nodes, CPU) ==="
 # correctness + straggler-count assertions, not wall-clock: cascade
 # on/off conformance, device-tail drain, single-stats-readback

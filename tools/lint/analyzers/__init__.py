@@ -9,6 +9,7 @@ from tools.lint.analyzers import (  # noqa: F401
     metric_names,
     pad_soundness,
     proto_drift,
+    race,
     recompile,
     robustness,
     shape_contract,

@@ -43,10 +43,16 @@ import ast
 from dataclasses import dataclass, field
 from typing import Dict, Iterable, List, Optional, Set, Tuple
 
-from tools.lint.astutil import call_target, collect_imports, dotted_name
+from tools.lint.astutil import call_target, collect_imports
 from tools.lint.framework import Analyzer, Finding, Module, Project, register
-
-LOCK_CTORS = {"threading.Lock", "threading.RLock", "threading.Condition"}
+from tools.lint.locks import (
+    LOCK_CTORS,  # noqa: F401  (re-exported; fixtures/tests import it here)
+    ModuleLocks,
+    header_exprs as _header_exprs,
+    index_module,
+    short as _short,
+    stmt_bodies as _bodies,
+)
 
 BLOCKING_DOTTED = {
     "time.sleep",
@@ -200,130 +206,58 @@ class LockDisciplineAnalyzer(Analyzer):
         basename = module.relpath.replace("\\", "/").rsplit("/", 1)[-1]
         self._file_io_exempt = basename in FILE_IO_SEAM_BASENAMES
 
-        def lock_ctor(value: ast.AST) -> Optional[str]:
-            if not isinstance(value, ast.Call):
-                return None
-            tgt = call_target(value)
-            resolved = imports.resolve(tgt) if tgt is not None else None
-            return resolved if resolved in LOCK_CTORS else None
+        # lock identities come from the SHARED index (tools/lint/locks):
+        # own constructor assignments, same-module base-class
+        # inheritance (Histogram's `with self._lock:` resolves to
+        # `metrics._Metric._lock`), and the @guarded_by contract tables
+        # resolve against the same owner walk — so the LK and GB
+        # families can never disagree on what a lock IS
+        idx = index_module(module)
 
-        def cond_wrapped_attr(value: ast.Call) -> Optional[str]:
-            """`threading.Condition(self.X)` / `Condition(NAME)` wraps
-            an EXISTING lock: wait() releases that lock, so LK004 must
-            not count it as pinned. Returns the wrapped attr/name."""
-            if not value.args:
-                return None
-            arg = value.args[0]
-            if isinstance(arg, ast.Attribute) \
-                    and isinstance(arg.value, ast.Name) \
-                    and arg.value.id == "self":
-                return arg.attr
-            if isinstance(arg, ast.Name):
-                return arg.id
-            return None
-
-        # pass 1: lock identities (conditions tracked separately — the
-        # LK004 wait analysis needs to know which locks can .wait(),
-        # and which existing lock a Condition wraps)
-        class_locks: Dict[str, Set[str]] = {}
-        class_conds: Dict[str, Set[str]] = {}
-        class_wraps: Dict[str, Dict[str, str]] = {}
-        module_locks: Set[str] = set()
-        module_conds: Set[str] = set()
-        module_wraps: Dict[str, str] = {}
-        for node in module.tree.body:
-            if isinstance(node, ast.Assign):
-                ctor = lock_ctor(node.value)
-                if ctor is not None:
-                    for t in node.targets:
-                        if isinstance(t, ast.Name):
-                            module_locks.add(t.id)
-                            if ctor == "threading.Condition":
-                                module_conds.add(t.id)
-                                wrapped = cond_wrapped_attr(node.value)
-                                if wrapped is not None:
-                                    module_wraps[t.id] = wrapped
-            if isinstance(node, ast.ClassDef):
-                locks: Set[str] = set()
-                conds: Set[str] = set()
-                wraps: Dict[str, str] = {}
-                for sub in ast.walk(node):
-                    if isinstance(sub, ast.Assign):
-                        ctor = lock_ctor(sub.value)
-                        if ctor is None:
-                            continue
-                        for t in sub.targets:
-                            if isinstance(t, ast.Attribute) \
-                                    and isinstance(t.value, ast.Name) \
-                                    and t.value.id == "self":
-                                locks.add(t.attr)
-                                if ctor == "threading.Condition":
-                                    conds.add(t.attr)
-                                    wrapped = cond_wrapped_attr(
-                                        sub.value)
-                                    if wrapped is not None:
-                                        wraps[t.attr] = wrapped
-                if locks:
-                    class_locks[node.name] = locks
-                    class_conds[node.name] = conds
-                    class_wraps[node.name] = wraps
-
-        # pass 2: per-function facts
         units: List[_Unit] = []
         for node in module.tree.body:
             if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                units.append(self._scan_unit(
-                    module, imports, None, node, module_locks, set(),
-                    module_conds, set(), module_wraps, {}))
+                units.append(self._scan_unit(module, imports, None,
+                                             node, idx))
             elif isinstance(node, ast.ClassDef):
-                locks = class_locks.get(node.name, set())
-                conds = class_conds.get(node.name, set())
-                wraps = class_wraps.get(node.name, {})
                 for sub in node.body:
                     if isinstance(sub, (ast.FunctionDef,
                                         ast.AsyncFunctionDef)):
                         units.append(self._scan_unit(
-                            module, imports, node.name, sub,
-                            module_locks, locks, module_conds, conds,
-                            module_wraps, wraps))
+                            module, imports, node.name, sub, idx))
         return units
 
     def _scan_unit(self, module: Module, imports, cls: Optional[str],
-                   fn, module_locks: Set[str],
-                   self_locks: Set[str],
-                   module_conds: Set[str] = frozenset(),
-                   self_conds: Set[str] = frozenset(),
-                   module_wraps: Optional[Dict[str, str]] = None,
-                   self_wraps: Optional[Dict[str, str]] = None) -> _Unit:
+                   fn, idx: ModuleLocks) -> _Unit:
         unit = _Unit(module=module, cls=cls, name=fn.name, node=fn)
         prefix = module.dotted
 
         def lock_id(expr: ast.AST) -> Optional[str]:
-            if isinstance(expr, ast.Attribute) \
+            if cls is not None and isinstance(expr, ast.Attribute) \
                     and isinstance(expr.value, ast.Name) \
-                    and expr.value.id == "self" \
-                    and expr.attr in self_locks:
-                return f"{prefix}.{cls}.{expr.attr}"
-            if isinstance(expr, ast.Name) and expr.id in module_locks:
-                return f"{prefix}.{expr.id}"
+                    and expr.value.id == "self":
+                return idx.canonical(cls, expr.attr)
+            if isinstance(expr, ast.Name):
+                return idx.module_lock_id(expr.id)
             return None
 
-        mwraps = module_wraps or {}
-        swraps = self_wraps or {}
-
-        def cond_id(expr: ast.AST) -> Optional[str]:
+        def cond_id(expr: ast.AST) -> Optional[Tuple[str, Optional[str]]]:
             """lock_id restricted to threading.Condition identities.
             Returns (id, wrapped-lock id or None): Condition(existing)
             releases the WRAPPED lock on wait, so LK004 exempts it."""
-            if isinstance(expr, ast.Attribute) \
+            if cls is not None and isinstance(expr, ast.Attribute) \
                     and isinstance(expr.value, ast.Name) \
-                    and expr.value.id == "self" \
-                    and expr.attr in self_conds:
-                wrapped = swraps.get(expr.attr)
-                return (f"{prefix}.{cls}.{expr.attr}",
-                        f"{prefix}.{cls}.{wrapped}" if wrapped else None)
-            if isinstance(expr, ast.Name) and expr.id in module_conds:
-                wrapped = mwraps.get(expr.id)
+                    and expr.value.id == "self":
+                owner = idx.cond_owner(cls, expr.attr)
+                if owner is None:
+                    return None
+                wrapped = idx.cond_wrapped(cls, expr.attr)
+                wid = (idx.canonical(cls, wrapped)
+                       or (idx.module_lock_id(wrapped)
+                           if wrapped else None)) if wrapped else None
+                return (f"{prefix}.{owner}.{expr.attr}", wid)
+            if isinstance(expr, ast.Name) and expr.id in idx.module_conds:
+                wrapped = idx.module_wraps.get(expr.id)
                 return (f"{prefix}.{expr.id}",
                         f"{prefix}.{wrapped}" if wrapped else None)
             return None
@@ -461,15 +395,6 @@ class LockDisciplineAnalyzer(Analyzer):
         return None
 
 
-def _bodies(stmt: ast.stmt) -> Iterable[List[ast.stmt]]:
-    for attr in ("body", "orelse", "finalbody"):
-        sub = getattr(stmt, attr, None)
-        if isinstance(sub, list) and sub and isinstance(sub[0], ast.stmt):
-            yield sub
-    for h in getattr(stmt, "handlers", []) or []:
-        yield h.body
-
-
 def _lk005(u: _Unit, held: str, target: str, line: int,
            via: Optional[str] = None) -> Finding:
     how = f"a call to `{via}` which reaches " if via else ""
@@ -519,19 +444,6 @@ def _close_summaries(units: List[_Unit]
                     changed = True
             summaries[key] = (acq, blk, fio)
     return summaries
-
-
-def _header_exprs(stmt: ast.stmt) -> Iterable[ast.AST]:
-    """Expressions evaluated by a compound statement itself (its test /
-    iterable), as opposed to its nested bodies."""
-    for attr in ("test", "iter"):
-        node = getattr(stmt, attr, None)
-        if node is not None:
-            yield node
-
-
-def _short(lock: str) -> str:
-    return ".".join(lock.split(".")[-2:])
 
 
 def _cycles(edges: Dict[Tuple[str, str], Tuple[str, int]]

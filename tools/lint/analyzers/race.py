@@ -1,0 +1,602 @@
+"""race-guard: static enforcement of the `@guarded_by` concurrency
+contracts (koordinator_tpu/utils/sync.py) — the Tier-A half of
+koordrace, paired with the dynamic interleaving gate in
+tools/racecheck.py.
+
+A contract says which lock guards each mutable attribute; this analyzer
+proves the code practices what it declares. Like every rung of the
+contract ladder it NEVER GUESSES: an access whose lock context cannot
+be resolved syntactically (an unresolvable context manager on the with
+stack, a helper reachable through an unknown call path) joins "unknown"
+and reports nothing. Only lock-attribute guards are enforced at access
+sites; the `publish-once` / `confined` / `racy-monitor` / `external:`
+vocabulary declares a discipline the static tier cannot see the edges
+of, so its value is the declaration itself plus the GB004/GB005 checks
+that keep the table honest — and the dynamic tier, which drives the
+real interleavings.
+
+Codes:
+  GB001  guarded attribute read/written outside its declared lock: the
+         access races every `with`-guarded access of the same
+         attribute. Private helpers inherit the INTERSECTION of the
+         lock sets held at their intra-class call sites (a meet, so
+         one unguarded call site voids the inheritance); helpers
+         reachable only from `__init__` (or not at all from inside the
+         class) are exempt — construction precedes sharing.
+  GB002  check-then-act: a guarded read in one `with` block and a
+         dependent write of the same attribute under a RE-ACQUIRED
+         lock in a later block of the same function. Between the two
+         blocks another thread can act on the stale read (lost
+         update). Exempt when some OTHER lock spans both blocks (the
+         SnapshotStore.checkpoint pattern: `_ck_lock` held across two
+         `_lock` windows).
+  GB003  guarded mutable state escaping its lock scope: `return self.x`
+         / `yield self.x` of an attribute the constructor binds to a
+         mutable container hands the caller a live reference that the
+         lock no longer covers; return a copy (`list(...)`,
+         `dict(...)`, a slice) instead.
+  GB004  declared-vs-actual drift and totality: a lock-owning class (or
+         module) with no guarded-by contract; a contract guard naming a
+         lock attribute no constructor assigns; a guard lock that no
+         `with` block in the module ever acquires.
+  GB005  malformed contract: non-literal or ** tables, guards outside
+         the sync.py grammar, duplicate entries or decorations, empty
+         tables. The static mirror of sync._validate.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, FrozenSet, Iterable, List, Optional, Set, Tuple
+
+from tools.lint.astutil import call_target
+from tools.lint.framework import Analyzer, Finding, Module, Project, register
+from tools.lint.locks import (
+    ClassLocks,
+    ModuleLocks,
+    guard_kind,
+    header_exprs,
+    index_module,
+    short,
+    stmt_bodies,
+)
+
+# sentinel member of a held set: "something unresolvable is held here",
+# which disables reporting (never-guess) without granting any guard
+UNKNOWN = "<unknown>"
+
+INIT_NAMES = ("__init__", "__post_init__")
+
+# constructors whose result is a shared mutable container: returning
+# the bare attribute leaks a reference the lock no longer covers
+MUTABLE_CTORS = {
+    "list", "dict", "set", "bytearray",
+    "collections.defaultdict", "collections.OrderedDict",
+    "collections.Counter", "collections.deque",
+}
+
+# held state: ((lock id, lineno of the acquiring `with`), ...) — the
+# with line distinguishes re-acquisition (GB002) from one hold
+_Held = Tuple[Tuple[str, int], ...]
+
+
+def _ids(held: _Held) -> FrozenSet[str]:
+    return frozenset(l for l, _ in held)
+
+
+@dataclass
+class _Scan:
+    """Lock-relevant facts of one function/method body."""
+
+    name: str
+    accesses: List[Tuple[str, str, int, _Held]] = field(
+        default_factory=list)               # attr/name, kind, line, held
+    calls: List[Tuple[str, FrozenSet[str], int]] = field(
+        default_factory=list)               # callee, held ids, line
+    acquired: Set[str] = field(default_factory=set)
+    escapes: List[Tuple[str, int]] = field(default_factory=list)
+
+
+def _bare_self_attr(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Attribute) and isinstance(node.value, ast.Name) \
+            and node.value.id == "self":
+        return node.attr
+    return None
+
+
+def _scan_callable(fn, lock_id, self_mode: bool,
+                   names: Optional[Set[str]] = None) -> _Scan:
+    """Walk one def: attribute (or module-name) accesses with the held
+    lock stack at each, intra-scope calls, acquisitions, and bare
+    return/yield escapes. `lock_id(expr)` resolves a with-item to a
+    canonical lock id or None."""
+    scan = _Scan(name=fn.name)
+    watched = names or set()
+
+    def visit_expr(root: ast.AST, held: _Held) -> None:
+        held_ids = _ids(held)
+
+        def rec(node: ast.AST) -> None:
+            if isinstance(node, (ast.Lambda, ast.FunctionDef,
+                                 ast.AsyncFunctionDef, ast.ClassDef)):
+                return  # deferred execution: lock context unknowable
+            if isinstance(node, ast.Call):
+                f = node.func
+                callee = None
+                if self_mode and isinstance(f, ast.Attribute) \
+                        and isinstance(f.value, ast.Name) \
+                        and f.value.id == "self":
+                    callee = f.attr
+                elif not self_mode and isinstance(f, ast.Name):
+                    callee = f.id
+                if callee is not None:
+                    scan.calls.append((callee, held_ids, node.lineno))
+                else:
+                    rec(f)
+                for a in node.args:
+                    rec(a)
+                for kw in node.keywords:
+                    rec(kw.value)
+                return
+            if isinstance(node, ast.Yield) and node.value is not None:
+                a = _bare_self_attr(node.value) if self_mode else None
+                if a is not None:
+                    scan.escapes.append((a, node.lineno))
+            if self_mode:
+                a = _bare_self_attr(node)
+                if a is not None:
+                    kind = "write" if isinstance(
+                        node.ctx, (ast.Store, ast.Del)) else "read"
+                    scan.accesses.append((a, kind, node.lineno, held))
+                    return
+            elif isinstance(node, ast.Name):
+                if node.id in watched:
+                    kind = "write" if isinstance(
+                        node.ctx, (ast.Store, ast.Del)) else "read"
+                    scan.accesses.append((node.id, kind, node.lineno,
+                                          held))
+                return
+            for child in ast.iter_child_nodes(node):
+                rec(child)
+
+        rec(root)
+
+    def walk(body: List[ast.stmt], held: _Held) -> None:
+        for stmt in body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                continue
+            if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                now = list(held)
+                for item in stmt.items:
+                    lid = lock_id(item.context_expr)
+                    if lid is None:
+                        # a non-lock / unresolvable context manager:
+                        # evaluate its expression under the locks so
+                        # far, then poison the inner scope — never
+                        # guess what an unknown CM synchronizes
+                        visit_expr(item.context_expr, tuple(now))
+                        now.append((UNKNOWN, stmt.lineno))
+                    else:
+                        scan.acquired.add(lid)
+                        now.append((lid, stmt.lineno))
+                walk(stmt.body, tuple(now))
+                continue
+            if isinstance(stmt, ast.Return):
+                if stmt.value is not None:
+                    a = _bare_self_attr(stmt.value) if self_mode else None
+                    if a is not None:
+                        scan.escapes.append((a, stmt.lineno))
+                    visit_expr(stmt.value, held)
+                continue
+            subs = list(stmt_bodies(stmt))
+            if subs:
+                for header in header_exprs(stmt):
+                    visit_expr(header, held)
+                for sub in subs:
+                    walk(sub, held)
+            else:
+                visit_expr(stmt, held)
+
+    walk(fn.body, ())
+    return scan
+
+
+def _entry_fixpoint(scans: List[_Scan]) -> Dict[str, Optional[FrozenSet[str]]]:
+    """Entry-held lock set per method name. Public methods start (and
+    stay) empty — any caller may enter them bare. Private helpers start
+    at TOP (None: assume guarded) and take the meet over their
+    intra-class call sites from non-`__init__` methods; a site whose
+    caller is itself TOP, or whose held set contains UNKNOWN,
+    contributes nothing (never-guess). No surviving site leaves the
+    helper at TOP: reachable only from construction, or not from
+    inside the class at all — both exempt."""
+    entry: Dict[str, Optional[FrozenSet[str]]] = {}
+    for s in scans:
+        if s.name in entry:
+            continue
+        private = s.name.startswith("_") and not s.name.startswith("__")
+        entry[s.name] = None if private else frozenset()
+    changed = True
+    while changed:
+        changed = False
+        for target, cur in list(entry.items()):
+            if not (target.startswith("_")
+                    and not target.startswith("__")):
+                continue
+            sites: List[FrozenSet[str]] = []
+            for caller in scans:
+                if caller.name in INIT_NAMES:
+                    continue
+                ce = entry.get(caller.name)
+                if ce is None:
+                    continue
+                for callee, held_ids, _line in caller.calls:
+                    if callee != target or UNKNOWN in held_ids:
+                        continue
+                    sites.append(ce | held_ids)
+            new = None if not sites else frozenset.intersection(*sites)
+            if new != cur:
+                entry[target] = new
+                changed = True
+    return entry
+
+
+def _mutable_init_attrs(info: ClassLocks, idx: ModuleLocks) -> Set[str]:
+    out: Set[str] = set()
+    for node in info.node.body:
+        if isinstance(node, ast.FunctionDef) and node.name in INIT_NAMES:
+            for sub in ast.walk(node):
+                if not isinstance(sub, ast.Assign):
+                    continue
+                for t in sub.targets:
+                    a = _bare_self_attr(t)
+                    if a is not None and _is_mutable_ctor(sub.value, idx):
+                        out.add(a)
+    return out
+
+
+def _is_mutable_ctor(node: ast.AST, idx: ModuleLocks) -> bool:
+    if isinstance(node, (ast.List, ast.Dict, ast.Set, ast.ListComp,
+                         ast.DictComp, ast.SetComp)):
+        return True
+    if isinstance(node, ast.Call):
+        t = call_target(node)
+        if t is None:
+            return False
+        return idx.imports.resolve(t) in MUTABLE_CTORS
+    return False
+
+
+@register
+class RaceGuardAnalyzer(Analyzer):
+    name = "race-guard"
+    description = ("guarded-by contract enforcement: accesses outside "
+                   "the declared lock, check-then-act windows, "
+                   "lock-scope escapes, and contract/code drift")
+
+    def run(self, project: Project) -> Iterable[Finding]:
+        findings: List[Finding] = []
+        for module in project.modules:
+            idx = index_module(module)
+            interesting = (idx.module_locks or idx.module_guard
+                           or idx.extra_module_guards
+                           or any(c.locks or c.guard or c.extra_guards
+                                  for c in idx.classes.values()))
+            if not interesting:
+                continue
+            self._check_module(idx, findings)
+        return sorted(findings, key=lambda f: (f.path, f.line, f.code))
+
+    # ------------------------------------------------------------------
+
+    def _check_module(self, idx: ModuleLocks,
+                      findings: List[Finding]) -> None:
+        module = idx.module
+
+        def make_lock_id(cls: Optional[str]):
+            def lock_id(expr: ast.AST) -> Optional[str]:
+                if cls is not None:
+                    a = _bare_self_attr(expr)
+                    if a is not None:
+                        return idx.canonical(cls, a)
+                if isinstance(expr, ast.Name):
+                    return idx.module_lock_id(expr.id)
+                return None
+            return lock_id
+
+        # scan every class + module-level function once; acquisitions
+        # feed the GB004 dead-guard check module-wide
+        class_scans: Dict[str, List[_Scan]] = {}
+        for name, info in idx.classes.items():
+            lock_id = make_lock_id(name)
+            class_scans[name] = [
+                _scan_callable(n, lock_id, self_mode=True)
+                for n in info.node.body
+                if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+        guarded_names = set()
+        if idx.module_guard is not None:
+            guarded_names = set(idx.module_guard.table)
+        mod_lock_id = make_lock_id(None)
+        module_scans = [
+            _scan_callable(n, mod_lock_id, self_mode=False,
+                           names=guarded_names)
+            for n in module.tree.body
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+        all_acquired: Set[str] = set()
+        for scans in class_scans.values():
+            for s in scans:
+                all_acquired |= s.acquired
+        for s in module_scans:
+            all_acquired |= s.acquired
+
+        for name, info in idx.classes.items():
+            self._check_class(idx, info, class_scans[name], all_acquired,
+                              findings)
+        self._check_module_guard(idx, module_scans, all_acquired,
+                                 findings)
+
+    # ------------------------------------------------------------------
+
+    def _check_class(self, idx: ModuleLocks, info: ClassLocks,
+                     scans: List[_Scan], all_acquired: Set[str],
+                     findings: List[Finding]) -> None:
+        relpath = idx.module.relpath
+        cls = info.name
+
+        def emit(code: str, line: int, message: str, key: str) -> None:
+            findings.append(Finding(
+                analyzer=self.name, code=code, path=relpath, line=line,
+                message=message, key=key))
+
+        for gt in ([info.guard] if info.guard else []) + info.extra_guards:
+            for line, slug, reason in gt.malformed:
+                emit("GB005", line,
+                     f"malformed guarded-by contract on `{cls}`: "
+                     f"{reason}", f"{cls}:{slug}")
+        for gt in info.extra_guards:
+            emit("GB005", gt.line,
+                 f"`{cls}` is decorated with guarded_by more than "
+                 f"once; merge the tables — one class, one contract",
+                 f"{cls}:duplicate-decoration")
+
+        if info.guard is None:
+            if info.locks:
+                owned = ", ".join(sorted(info.locks))
+                emit("GB004", info.node.lineno,
+                     f"`{cls}` constructs lock(s) ({owned}) but "
+                     f"declares no @guarded_by contract; every "
+                     f"lock-owning class must say which attributes "
+                     f"each lock guards (koordinator_tpu/utils/"
+                     f"sync.py)", f"{cls}:contract-missing")
+            return
+
+        gt = info.guard
+        # classify: attr -> (guard attr, canonical lock id)
+        lock_guards: Dict[str, Tuple[str, str]] = {}
+        bad_guards: Set[str] = set()
+        for attr, guard in gt.table.items():
+            if guard_kind(guard) != "lock":
+                continue
+            canon = idx.canonical(cls, guard)
+            if canon is None:
+                if guard not in bad_guards:
+                    bad_guards.add(guard)
+                    emit("GB004", gt.line,
+                         f"`{cls}` contract guards attributes with "
+                         f"`{guard}` but no `self.{guard} = "
+                         f"threading.Lock()` exists in the class or "
+                         f"its bases — the declaration drifted from "
+                         f"the code", f"{cls}:{guard}:guard-unresolved")
+                continue
+            lock_guards[attr] = (guard, canon)
+        for guard, canon in sorted({v for v in lock_guards.values()}):
+            if canon not in all_acquired:
+                emit("GB004", gt.line,
+                     f"`{cls}` contract names guard `{guard}` but no "
+                     f"`with self.{guard}:` in this module ever "
+                     f"acquires it — the declared discipline is not "
+                     f"practiced", f"{cls}:{guard}:guard-dead")
+
+        entry = _entry_fixpoint(scans)
+        mutable_attrs = _mutable_init_attrs(info, idx)
+        seen: Set[str] = set()
+
+        for scan in scans:
+            if scan.name in INIT_NAMES:
+                continue
+            e = entry.get(scan.name)
+            if e is None:
+                continue  # helper reachable only via construction
+            # GB001
+            for attr, kind, line, held in scan.accesses:
+                g = lock_guards.get(attr)
+                if g is None:
+                    continue
+                guard_attr, canon = g
+                held_ids = _ids(held)
+                if UNKNOWN in held_ids:
+                    continue
+                if canon in held_ids or canon in e:
+                    continue
+                key = f"{cls}.{scan.name}:{attr}:{kind}"
+                if key in seen:
+                    continue
+                seen.add(key)
+                verb = "writes" if kind == "write" else "reads"
+                emit("GB001", line,
+                     f"`{cls}.{scan.name}` {verb} `self.{attr}` "
+                     f"outside its declared guard `{short(canon)}`: "
+                     f"wrap the access in `with self.{guard_attr}:` "
+                     f"(or amend the contract if the discipline "
+                     f"changed)", key)
+            # GB002
+            for attr, (guard_attr, canon) in lock_guards.items():
+                if canon in e:
+                    continue  # lock spans the whole body via entry
+                key = f"{cls}.{scan.name}:{attr}:check-then-act"
+                if key in seen:
+                    continue
+                pair = _check_then_act(scan, attr, canon, e)
+                if pair is None:
+                    continue
+                seen.add(key)
+                rl, wl = pair
+                emit("GB002", wl,
+                     f"`{cls}.{scan.name}` reads `self.{attr}` under "
+                     f"`{short(canon)}` (line {rl}), releases it, then "
+                     f"writes `self.{attr}` under a re-acquired "
+                     f"`{short(canon)}`: another thread can act on "
+                     f"the stale read in between (lost update) — do "
+                     f"the read-check-write in ONE critical section, "
+                     f"or hold a spanning lock across both", key)
+            # GB003
+            for attr, line in scan.escapes:
+                g = lock_guards.get(attr)
+                if g is None or attr not in mutable_attrs:
+                    continue
+                guard_attr, canon = g
+                key = f"{cls}.{scan.name}:{attr}:escape"
+                if key in seen:
+                    continue
+                seen.add(key)
+                emit("GB003", line,
+                     f"`{cls}.{scan.name}` returns `self.{attr}` — a "
+                     f"live reference to mutable state guarded by "
+                     f"`{short(canon)}` escapes its lock scope; hand "
+                     f"out a copy (`list(...)`, `dict(...)`, a slice) "
+                     f"so callers cannot race the guarded mutations",
+                     key)
+
+    # ------------------------------------------------------------------
+
+    def _check_module_guard(self, idx: ModuleLocks,
+                            scans: List[_Scan], all_acquired: Set[str],
+                            findings: List[Finding]) -> None:
+        module = idx.module
+        relpath = module.relpath
+
+        def emit(code: str, line: int, message: str, key: str) -> None:
+            findings.append(Finding(
+                analyzer=self.name, code=code, path=relpath, line=line,
+                message=message, key=key))
+
+        for gt in (([idx.module_guard] if idx.module_guard else [])
+                   + idx.extra_module_guards):
+            for line, slug, reason in gt.malformed:
+                emit("GB005", line,
+                     f"malformed guard_module contract: {reason}",
+                     f"<module>:{slug}")
+        for gt in idx.extra_module_guards:
+            emit("GB005", gt.line,
+                 "guard_module called more than once for this module; "
+                 "merge the tables", "<module>:duplicate-guard-module")
+
+        if idx.module_guard is None:
+            if idx.module_locks:
+                line = _first_module_lock_line(idx)
+                owned = ", ".join(sorted(idx.module_locks))
+                emit("GB004", line,
+                     f"module-level lock(s) ({owned}) but no "
+                     f"guard_module(...) contract; declare which "
+                     f"globals each lock guards (koordinator_tpu/"
+                     f"utils/sync.py)", "<module>:contract-missing")
+            return
+
+        gt = idx.module_guard
+        lock_guards: Dict[str, Tuple[str, str]] = {}
+        bad_guards: Set[str] = set()
+        for name, guard in gt.table.items():
+            if guard_kind(guard) != "lock":
+                continue
+            canon = idx.module_lock_id(guard)
+            if canon is None:
+                if guard not in bad_guards:
+                    bad_guards.add(guard)
+                    emit("GB004", gt.line,
+                         f"guard_module names `{guard}` but no "
+                         f"module-level `{guard} = threading.Lock()` "
+                         f"exists — the declaration drifted from the "
+                         f"code", f"<module>:{guard}:guard-unresolved")
+                continue
+            lock_guards[name] = (guard, canon)
+        for guard, canon in sorted({v for v in lock_guards.values()}):
+            if canon not in all_acquired:
+                emit("GB004", gt.line,
+                     f"guard_module names `{guard}` but no `with "
+                     f"{guard}:` in this module ever acquires it",
+                     f"<module>:{guard}:guard-dead")
+
+        entry = _entry_fixpoint(scans)
+        seen: Set[str] = set()
+        for scan in scans:
+            e = entry.get(scan.name)
+            if e is None:
+                continue
+            for name, kind, line, held in scan.accesses:
+                g = lock_guards.get(name)
+                if g is None:
+                    continue
+                guard_name, canon = g
+                held_ids = _ids(held)
+                if UNKNOWN in held_ids:
+                    continue
+                if canon in held_ids or canon in e:
+                    continue
+                key = f"{scan.name}:{name}:{kind}"
+                if key in seen:
+                    continue
+                seen.add(key)
+                verb = "writes" if kind == "write" else "reads"
+                emit("GB001", line,
+                     f"`{scan.name}` {verb} module global `{name}` "
+                     f"outside its declared guard `{guard_name}`: "
+                     f"wrap the access in `with {guard_name}:`", key)
+
+
+def _check_then_act(scan: _Scan, attr: str, canon: str,
+                    entry: FrozenSet[str]) -> Optional[Tuple[int, int]]:
+    """(read line, write line) of the first GB002 pair for `attr`, or
+    None. Pairs a guarded read with a LATER guarded write whose
+    acquiring `with` is a different statement, unless some other lock
+    (or an entry-held lock) spans both windows."""
+    reads: List[Tuple[int, _Held]] = []
+    writes: List[Tuple[int, _Held]] = []
+    for a, kind, line, held in scan.accesses:
+        if a != attr:
+            continue
+        ids = _ids(held)
+        if canon not in ids or UNKNOWN in ids:
+            continue
+        (writes if kind == "write" else reads).append((line, held))
+    for rl, rh in reads:
+        r_with = _with_line(rh, canon)
+        for wl, wh in writes:
+            if wl <= rl:
+                continue
+            if _with_line(wh, canon) == r_with:
+                continue
+            common = ((_ids(rh) | entry) & (_ids(wh) | entry)) \
+                - {canon, UNKNOWN}
+            if common:
+                continue
+            return rl, wl
+    return None
+
+
+def _with_line(held: _Held, lock: str) -> int:
+    line = -1
+    for lid, wl in held:
+        if lid == lock:
+            line = wl  # innermost (re-entrant) acquisition wins
+    return line
+
+
+def _first_module_lock_line(idx: ModuleLocks) -> int:
+    for node in idx.module.tree.body:
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name) and t.id in idx.module_locks:
+                    return node.lineno
+    return 1

@@ -1,0 +1,335 @@
+"""Shared lock-identity resolution for the LK (lock-discipline) and GB
+(race-guard) analyzer families.
+
+Both analyzers must agree on what "the lock" is before they can agree
+on anything else: LK edges and GB guard obligations are keyed by
+canonical lock identities, and a disagreement (LK calling Histogram's
+lock `metrics.Histogram._lock` while GB calls it
+`metrics._Metric._lock`) would let a finding in one family contradict
+an exemption in the other. So identity lives here, once:
+
+  * `self.X = threading.Lock()/RLock/Condition()` anywhere in a class
+    body makes X a lock attribute OWNED by that class;
+  * a subclass (same module, `class Histogram(_Metric)`) inherits its
+    bases' lock attributes, and the canonical identity stays with the
+    OWNER: `with self._lock:` inside Histogram resolves to
+    `koordinator_tpu.metrics._Metric._lock`;
+  * `NAME = threading.Lock()` at module level makes NAME a module lock
+    (`module.NAME`).
+
+This module also parses `@guarded_by(...)` / `guard_module(...)`
+contract tables (koordinator_tpu/utils/sync.py) out of the AST —
+literal keyword strings only, never an import of the analyzed tree —
+so the GB analyzer can check declarations against acquisitions and the
+LK analyzer can resolve a guard-named lock through the same owner walk.
+Everything is stdlib `ast`.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set, Tuple
+
+from tools.lint.astutil import Imports, call_target, collect_imports
+from tools.lint.framework import Module
+
+LOCK_CTORS = {"threading.Lock", "threading.RLock", "threading.Condition"}
+
+# the non-lock guard vocabulary of utils/sync.py, mirrored: these
+# declare a synchronization DISCIPLINE rather than a lock, so GB001
+# never enforces them — their value is the declaration itself plus the
+# GB004/GB005 checks that keep the table honest
+GUARD_VOCAB = ("publish-once", "confined", "racy-monitor")
+_IDENT = re.compile(r"^[A-Za-z_]\w*$")
+_EXTERNAL = re.compile(r"^external:[A-Za-z_]\w*(\.[A-Za-z_]\w*)+$")
+
+
+def guard_kind(guard: str) -> str:
+    """"lock" (an instance lock-attribute name), "vocab", "external",
+    or "bad" for anything the sync.py grammar rejects."""
+    if guard in GUARD_VOCAB:
+        return "vocab"
+    if guard.startswith("external:"):
+        return "external" if _EXTERNAL.match(guard) else "bad"
+    return "lock" if _IDENT.match(guard) else "bad"
+
+
+@dataclass
+class GuardTable:
+    """One parsed `@guarded_by(...)` decoration or `guard_module(...)`
+    call. `table` holds only the well-formed literal entries; every
+    AST-visible grammar violation lands in `malformed` as a
+    (line, slug, human reason) triple for GB005."""
+
+    line: int
+    table: Dict[str, str] = field(default_factory=dict)
+    malformed: List[Tuple[int, str, str]] = field(default_factory=list)
+
+
+@dataclass
+class ClassLocks:
+    """Lock facts for one module-body class."""
+
+    name: str
+    node: ast.ClassDef
+    bases: Tuple[str, ...]          # same-module base-class names
+    locks: Set[str] = field(default_factory=set)   # own ctor assignments
+    conds: Set[str] = field(default_factory=set)
+    wraps: Dict[str, str] = field(default_factory=dict)  # cond -> wrapped
+    guard: Optional[GuardTable] = None
+    extra_guards: List[GuardTable] = field(default_factory=list)
+
+
+@dataclass
+class ModuleLocks:
+    """The per-module lock index both analyzer families resolve
+    against."""
+
+    module: Module
+    imports: Imports
+    classes: Dict[str, ClassLocks] = field(default_factory=dict)
+    module_locks: Set[str] = field(default_factory=set)
+    module_conds: Set[str] = field(default_factory=set)
+    module_wraps: Dict[str, str] = field(default_factory=dict)
+    module_guard: Optional[GuardTable] = None
+    extra_module_guards: List[GuardTable] = field(default_factory=list)
+
+    def lock_owner(self, cls: str, attr: str) -> Optional[str]:
+        """The class (cls itself or a same-module base, breadth-first)
+        whose body constructs `self.<attr>` as a lock; None when none
+        does."""
+        seen: Set[str] = set()
+        queue = [cls]
+        while queue:
+            c = queue.pop(0)
+            if c in seen:
+                continue
+            seen.add(c)
+            info = self.classes.get(c)
+            if info is None:
+                continue
+            if attr in info.locks:
+                return c
+            queue.extend(info.bases)
+        return None
+
+    def lock_attrs(self, cls: str) -> Set[str]:
+        """Every lock attribute visible on `cls`: its own plus those
+        inherited from same-module bases."""
+        out: Set[str] = set()
+        seen: Set[str] = set()
+        queue = [cls]
+        while queue:
+            c = queue.pop(0)
+            if c in seen:
+                continue
+            seen.add(c)
+            info = self.classes.get(c)
+            if info is None:
+                continue
+            out |= info.locks
+            queue.extend(info.bases)
+        return out
+
+    def cond_owner(self, cls: str, attr: str) -> Optional[str]:
+        seen: Set[str] = set()
+        queue = [cls]
+        while queue:
+            c = queue.pop(0)
+            if c in seen:
+                continue
+            seen.add(c)
+            info = self.classes.get(c)
+            if info is None:
+                continue
+            if attr in info.conds:
+                return c
+            queue.extend(info.bases)
+        return None
+
+    def cond_wrapped(self, cls: str, attr: str) -> Optional[str]:
+        owner = self.cond_owner(cls, attr)
+        if owner is None:
+            return None
+        return self.classes[owner].wraps.get(attr)
+
+    def canonical(self, cls: str, attr: str) -> Optional[str]:
+        """`module.Owner.attr` for a lock attribute reached from `cls`
+        (owner = the defining class, so subclasses and their bases
+        agree on identity); None when attr is not a known lock."""
+        owner = self.lock_owner(cls, attr)
+        if owner is None:
+            return None
+        return f"{self.module.dotted}.{owner}.{attr}"
+
+    def module_lock_id(self, name: str) -> Optional[str]:
+        if name in self.module_locks:
+            return f"{self.module.dotted}.{name}"
+        return None
+
+
+def _lock_ctor(value: ast.AST, imports: Imports) -> Optional[str]:
+    if not isinstance(value, ast.Call):
+        return None
+    tgt = call_target(value)
+    resolved = imports.resolve(tgt) if tgt is not None else None
+    return resolved if resolved in LOCK_CTORS else None
+
+
+def _cond_wrapped_attr(value: ast.Call) -> Optional[str]:
+    """`threading.Condition(self.X)` / `Condition(NAME)` wraps an
+    EXISTING lock: wait() releases that lock, so the LK004 analysis
+    must not count it as pinned. Returns the wrapped attr/name."""
+    if not value.args:
+        return None
+    arg = value.args[0]
+    if isinstance(arg, ast.Attribute) and isinstance(arg.value, ast.Name) \
+            and arg.value.id == "self":
+        return arg.attr
+    if isinstance(arg, ast.Name):
+        return arg.id
+    return None
+
+
+def _resolves_to(call_func: ast.AST, imports: Imports, tail: str) -> bool:
+    dotted = None
+    if isinstance(call_func, (ast.Name, ast.Attribute)):
+        from tools.lint.astutil import dotted_name
+        dotted = dotted_name(call_func)
+    if dotted is None:
+        return False
+    resolved = imports.resolve(dotted)
+    return resolved == tail or resolved.endswith("." + tail) \
+        or resolved.endswith(f".sync.{tail}")
+
+
+def _parse_guard_call(call: ast.Call, skip_args: int,
+                      what: str) -> GuardTable:
+    """Parse the keyword table of a guarded_by/guard_module call into a
+    GuardTable, recording every grammar violation the AST can see.
+    `skip_args` positional args are expected (guard_module's module
+    name); any beyond that is malformed."""
+    gt = GuardTable(line=call.lineno)
+    if len(call.args) > skip_args:
+        gt.malformed.append((call.lineno, "positional-args",
+                             f"{what} takes guard entries as keyword "
+                             f"arguments only"))
+    for kw in call.keywords:
+        if kw.arg is None:
+            gt.malformed.append((kw.value.lineno, "splat",
+                                 f"{what} table must be literal keyword "
+                                 f"arguments, not a ** splat — the "
+                                 f"static tier cannot read a computed "
+                                 f"table"))
+            continue
+        if not (isinstance(kw.value, ast.Constant)
+                and isinstance(kw.value.value, str)):
+            gt.malformed.append((kw.value.lineno, f"{kw.arg}:non-literal",
+                                 f"guard for `{kw.arg}` must be a "
+                                 f"string literal"))
+            continue
+        guard = kw.value.value
+        if guard_kind(guard) == "bad":
+            gt.malformed.append((kw.value.lineno, f"{kw.arg}:bad-guard",
+                                 f"guard {guard!r} for `{kw.arg}` is "
+                                 f"neither a lock-attribute name, one "
+                                 f"of {GUARD_VOCAB}, nor "
+                                 f"'external:Owner.lock_attr'"))
+            continue
+        if kw.arg in gt.table:
+            gt.malformed.append((kw.value.lineno, f"{kw.arg}:duplicate",
+                                 f"`{kw.arg}` declared twice"))
+            continue
+        gt.table[kw.arg] = guard
+    if not gt.table and not gt.malformed:
+        gt.malformed.append((call.lineno, "empty",
+                             f"{what} with an empty table declares "
+                             f"nothing"))
+    return gt
+
+
+def stmt_bodies(stmt: ast.stmt):
+    """The nested statement lists of a compound statement (if/try/for/
+    while bodies, else/finally, except handlers)."""
+    for attr in ("body", "orelse", "finalbody"):
+        sub = getattr(stmt, attr, None)
+        if isinstance(sub, list) and sub and isinstance(sub[0], ast.stmt):
+            yield sub
+    for h in getattr(stmt, "handlers", []) or []:
+        yield h.body
+
+
+def header_exprs(stmt: ast.stmt):
+    """Expressions evaluated by a compound statement itself (its test /
+    iterable), as opposed to its nested bodies."""
+    for attr in ("test", "iter"):
+        node = getattr(stmt, attr, None)
+        if node is not None:
+            yield node
+
+
+def short(lock: str) -> str:
+    """`Class.attr` tail of a canonical lock id, for messages."""
+    return ".".join(lock.split(".")[-2:])
+
+
+def index_module(module: Module) -> ModuleLocks:
+    """Build the lock + contract index for one parsed module."""
+    package = module.dotted.rsplit(".", 1)[0] if "." in module.dotted \
+        else ""
+    imports = collect_imports(module.tree, package)
+    idx = ModuleLocks(module=module, imports=imports)
+
+    for node in module.tree.body:
+        if isinstance(node, ast.Assign):
+            ctor = _lock_ctor(node.value, imports)
+            if ctor is not None:
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        idx.module_locks.add(t.id)
+                        if ctor == "threading.Condition":
+                            idx.module_conds.add(t.id)
+                            wrapped = _cond_wrapped_attr(node.value)
+                            if wrapped is not None:
+                                idx.module_wraps[t.id] = wrapped
+        elif isinstance(node, ast.Expr) and isinstance(node.value, ast.Call):
+            if _resolves_to(node.value.func, imports, "guard_module"):
+                gt = _parse_guard_call(node.value, skip_args=1,
+                                       what="guard_module")
+                if idx.module_guard is None:
+                    idx.module_guard = gt
+                else:
+                    idx.extra_module_guards.append(gt)
+        elif isinstance(node, ast.ClassDef):
+            bases = tuple(b.id for b in node.bases
+                          if isinstance(b, ast.Name))
+            info = ClassLocks(name=node.name, node=node, bases=bases)
+            for sub in ast.walk(node):
+                if not isinstance(sub, ast.Assign):
+                    continue
+                ctor = _lock_ctor(sub.value, imports)
+                if ctor is None:
+                    continue
+                for t in sub.targets:
+                    if isinstance(t, ast.Attribute) \
+                            and isinstance(t.value, ast.Name) \
+                            and t.value.id == "self":
+                        info.locks.add(t.attr)
+                        if ctor == "threading.Condition":
+                            info.conds.add(t.attr)
+                            wrapped = _cond_wrapped_attr(sub.value)
+                            if wrapped is not None:
+                                info.wraps[t.attr] = wrapped
+            for deco in node.decorator_list:
+                if isinstance(deco, ast.Call) \
+                        and _resolves_to(deco.func, imports, "guarded_by"):
+                    gt = _parse_guard_call(deco, skip_args=0,
+                                           what="guarded_by")
+                    if info.guard is None:
+                        info.guard = gt
+                    else:
+                        info.extra_guards.append(gt)
+            idx.classes[node.name] = info
+    return idx

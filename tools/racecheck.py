@@ -1,0 +1,791 @@
+"""koordrace Tier B: the deterministic interleaving gate.
+
+Where the `race-guard` koordlint pass (Tier A) proves guarded-by
+contract conformance STATICALLY — every access to a `@guarded_by`
+field happens under a `with` on its declared lock — this gate runs the
+real concurrent classes CONCRETELY under a seeded, deterministic
+thread scheduler and asserts their cross-thread invariants over many
+explored interleavings:
+
+  * a token-passing scheduler (`DetScheduler`) owns every worker
+    thread: exactly one runs at a time, and control moves only at
+    SWITCH POINTS — Python line events inside `koordinator_tpu/`
+    files (via per-thread `sys.settrace`) and lock-contention yields.
+    A seeded `random.Random` picks the next thread, so one seed IS
+    one schedule: the recorded trace of (kind, from, to, location)
+    switches is bit-identical across runs of the same seed, which the
+    battery itself re-checks (nondeterminism here would make every
+    red run unreproducible).
+  * `rr` mode switches at EVERY line, round-robin — the densest
+    interleaving, guaranteed to drive any two threads through each
+    other's check-then-act windows; `random` mode explores sparser
+    preemption; a bounded-preemption run (small-CHESS: most races
+    need very few preemptions, so a tiny budget covers a huge class
+    of schedules cheaply) caps forced switches per run.
+  * locks under test are swapped for `InstrumentedLock`s — pure
+    owner/count state machines that YIELD to the scheduler instead of
+    blocking, so contention becomes exploration instead of deadlock,
+    and an actual lock-order deadlock is detected (no thread makes a
+    line of progress) rather than hung on.
+
+The scenarios target the seams the guarded-by contracts protect:
+ingest-vs-update-vs-read on `SnapshotStore` (the delta version guard
+must apply each version EXACTLY once across racing duplicate
+producers), append-vs-prune-vs-reload on `CommitJournal` under its
+external commit lock, an 8-thread `Tracer` span storm over a tiny
+ring (retained + dropped == appended, per-thread order preserved),
+and metrics observe-vs-export exactness.
+
+`--self-test-mutation` proves the two tiers are live AND complementary
+by construction (tools/seedmut.py): dropping the store lock around
+ingest's version guard must fail THIS gate while remaining invisible
+to the static tier (the mutated `with threading.Lock():` is an
+unresolvable context manager, which the never-guess analyzer treats
+as "unknown lock held" — tools/lint/analyzers/race.py); deleting the
+lock around `MetricCache.set_kv` must fail the static tier (GB001)
+while THIS battery — which never touches `MetricCache` — passes.
+Each defect is caught by exactly its own tier and demonstrably
+missed by the other; a defect both saw would prove redundancy, not
+coverage.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import random
+import sys
+import tempfile
+import threading
+from typing import Callable, Dict, List, Optional, Tuple
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+# appended (not prepended) so a mutated tree earlier on PYTHONPATH wins
+if REPO_ROOT not in sys.path:
+    sys.path.append(REPO_ROOT)
+
+from tools.seedmut import (  # noqa: E402
+    Mutation,
+    check_gate_catches,
+    check_gate_passes,
+)
+
+_PKG_DIR: Optional[str] = None
+
+
+def _pkg_dir() -> str:
+    """Directory of the IMPORTED koordinator_tpu package — under
+    --self-test-mutation the children resolve this to the mutated temp
+    tree, so switch points track whichever tree is actually running."""
+    global _PKG_DIR
+    if _PKG_DIR is None:
+        import koordinator_tpu
+
+        _PKG_DIR = os.path.dirname(
+            os.path.abspath(koordinator_tpu.__file__)) + os.sep
+    return _PKG_DIR
+
+
+class DeadlockError(RuntimeError):
+    """No thread can make a line of progress: every live worker is
+    spinning on a lock (or the owner exited while holding one)."""
+
+
+class _Worker:
+    __slots__ = ("name", "fn", "index", "go", "finished", "thread")
+
+    def __init__(self, name: str, fn: Callable[[], None], index: int):
+        self.name = name
+        self.fn = fn
+        self.index = index
+        self.go = threading.Event()
+        self.finished = False
+        self.thread: Optional[threading.Thread] = None
+
+
+class DetScheduler:
+    """Deterministic cooperative thread scheduler.
+
+    Exactly one spawned worker holds the token at a time; the rest wait
+    on per-worker Events. Token handoffs happen only at switch points,
+    chosen by `mode`:
+
+      rr        switch to the next live worker at EVERY package line —
+                maximal interleaving density, zero randomness;
+      random    at each package line, switch with `switch_prob` to a
+                seeded-random live worker; `preempt_budget` (when set)
+                bounds how many such forced preemptions one run may
+                spend — contention yields and exits never consume it.
+
+    The schedule trace (`self.trace`) records every actual handoff as
+    (kind, from, to, file:line); same seed -> same trace, which
+    run_all re-asserts per scenario.
+    """
+
+    _STALL_LIMIT = 20000  # contention yields with no line progress
+
+    def __init__(self, seed: int = 0, mode: str = "random",
+                 switch_prob: float = 0.25,
+                 preempt_budget: Optional[int] = None):
+        if mode not in ("rr", "random"):
+            raise ValueError(f"unknown scheduler mode {mode!r}")
+        self.mode = mode
+        self.seed = seed
+        self.rng = random.Random(seed)
+        self.switch_prob = switch_prob
+        self.preempt_budget = preempt_budget
+        self.workers: List[_Worker] = []
+        self.trace: List[Tuple[str, str, str, str]] = []
+        self.switch_points = 0  # line events seen (potential switches)
+        self.acquires = 0       # successful InstrumentedLock acquires
+        self._by_ident: Dict[int, _Worker] = {}
+        self._stall = 0
+        self._all_done = threading.Event()
+        self._errors: List[Tuple[str, BaseException]] = []
+        self._pkg = _pkg_dir()
+
+    # --- registration / run ---------------------------------------------
+
+    def spawn(self, fn: Callable[[], None], name: str) -> None:
+        self.workers.append(_Worker(name, fn, len(self.workers)))
+
+    def run(self, timeout: float = 120.0) -> None:
+        """Start every worker, hand the token to the first, and wait for
+        all to finish. Re-raises the first worker exception (including
+        DeadlockError from the stall detector)."""
+        if not self.workers:
+            return
+        for w in self.workers:
+            w.thread = threading.Thread(
+                target=self._wrapper, args=(w,),
+                name=f"racecheck-{w.name}", daemon=True)
+            w.thread.start()
+        self.workers[0].go.set()
+        if not self._all_done.wait(timeout):
+            alive = [w.name for w in self.workers if not w.finished]
+            raise DeadlockError(
+                f"scheduler timed out after {timeout}s; "
+                f"stuck workers: {alive}")
+        for w in self.workers:
+            assert w.thread is not None
+            w.thread.join(timeout=10)
+        if self._errors:
+            name, exc = self._errors[0]
+            raise RuntimeError(
+                f"worker {name!r} raised "
+                f"{type(exc).__name__}: {exc}") from exc
+
+    def _wrapper(self, w: _Worker) -> None:
+        self._by_ident[threading.get_ident()] = w
+        w.go.wait()
+        sys.settrace(self._trace_call)
+        try:
+            w.fn()
+        except BaseException as exc:  # noqa: BLE001 — reported by run()
+            self._errors.append((w.name, exc))
+        finally:
+            sys.settrace(None)
+            w.finished = True
+            self._handoff_exit(w)
+
+    # --- switch points ---------------------------------------------------
+
+    def _trace_call(self, frame, event, arg):
+        # local tracing only for package frames: stdlib / numpy / this
+        # module never become switch points (returning None disables
+        # line events for the whole frame)
+        if event == "call" and frame.f_code.co_filename.startswith(
+                self._pkg):
+            return self._trace_line
+        return None
+
+    def _trace_line(self, frame, event, arg):
+        if event == "line":
+            self.switch_points += 1
+            self._stall = 0  # a real line executed: progress
+            me = self._by_ident.get(threading.get_ident())
+            if me is not None and not me.finished:
+                loc = (frame.f_code.co_filename[len(self._pkg):]
+                       + f":{frame.f_lineno}")
+                self._preempt(me, loc)
+        return self._trace_line
+
+    def _live_after(self, w: _Worker) -> List[_Worker]:
+        """Live workers in cyclic registration order starting after `w`
+        — the deterministic candidate order for both modes."""
+        n = len(self.workers)
+        return [self.workers[(w.index + k) % n] for k in range(1, n)
+                if not self.workers[(w.index + k) % n].finished]
+
+    def _preempt(self, me: _Worker, loc: str) -> None:
+        others = self._live_after(me)
+        if not others:
+            return
+        if self.mode == "rr":
+            self._switch(me, others[0], "rr", loc)
+            return
+        if self.preempt_budget is not None and self.preempt_budget <= 0:
+            return
+        if self.rng.random() < self.switch_prob:
+            target = others[self.rng.randrange(len(others))]
+            if self.preempt_budget is not None:
+                self.preempt_budget -= 1
+            self._switch(me, target, "preempt", loc)
+
+    def block_switch(self, what: str) -> None:
+        """Called by a contended InstrumentedLock: yield the token so
+        the owner can run. Counts toward the stall detector — if every
+        live thread is doing this and none executes a real line, the
+        scenario is deadlocked."""
+        self._stall += 1
+        if self._stall > self._STALL_LIMIT:
+            raise DeadlockError(
+                f"no thread progressed across {self._stall} contention "
+                f"yields (last waiting on {what})")
+        me = self._by_ident.get(threading.get_ident())
+        if me is None:
+            return  # contention outside a scheduled run: nothing to do
+        others = self._live_after(me)
+        if not others:
+            raise DeadlockError(
+                f"{me.name} waits on {what} with no other live thread "
+                f"to release it")
+        if self.mode == "rr":
+            target = others[0]
+        else:
+            target = others[self.rng.randrange(len(others))]
+        self._switch(me, target, "block", what)
+
+    def note_acquire(self) -> None:
+        self._stall = 0
+        self.acquires += 1
+
+    # --- token handoff ---------------------------------------------------
+
+    def _switch(self, me: _Worker, target: _Worker, kind: str,
+                loc: str) -> None:
+        self.trace.append((kind, me.name, target.name, loc))
+        me.go.clear()
+        target.go.set()
+        me.go.wait()
+
+    def _handoff_exit(self, w: _Worker) -> None:
+        nxt = self._live_after(w)
+        if nxt:
+            self.trace.append(("exit", w.name, nxt[0].name, ""))
+            nxt[0].go.set()
+        else:
+            self._all_done.set()
+
+
+class InstrumentedLock:
+    """A scheduler-cooperative lock: a pure (owner, count) state
+    machine with NO embedded threading primitive. Only the token
+    holder ever touches it, so plain attribute updates are already
+    atomic under the scheduler; contention yields via
+    `DetScheduler.block_switch` instead of blocking, which is what
+    turns lock ordering bugs into detected deadlocks and dropped-lock
+    bugs into explorable interleavings. Reentrant when asked (stands
+    in for RLock); a non-reentrant relock fails loudly as the real
+    deadlock it would be."""
+
+    def __init__(self, sched: DetScheduler, name: str,
+                 reentrant: bool = False):
+        self._sched = sched
+        self.name = name
+        self._reentrant = reentrant
+        self._owner: Optional[int] = None
+        self._count = 0
+
+    def acquire(self, blocking: bool = True) -> bool:
+        me = threading.get_ident()
+        while True:
+            if self._owner is None:
+                self._owner = me
+                self._count = 1
+                self._sched.note_acquire()
+                return True
+            if self._owner == me:
+                if not self._reentrant:
+                    raise DeadlockError(
+                        f"non-reentrant relock of {self.name}")
+                self._count += 1
+                return True
+            if not blocking:
+                return False
+            self._sched.block_switch(self.name)
+
+    def release(self) -> None:
+        if self._owner != threading.get_ident():
+            raise RuntimeError(
+                f"release of {self.name} by a non-owner thread")
+        self._count -= 1
+        if self._count == 0:
+            self._owner = None
+
+    def __enter__(self) -> "InstrumentedLock":
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self.release()
+        return False
+
+
+def _instrument(obj, attr: str, sched: DetScheduler, name: str,
+                reentrant: bool = False) -> InstrumentedLock:
+    """Swap a real lock attribute for an InstrumentedLock — the
+    scenario-side seam that puts an object under the scheduler."""
+    lk = InstrumentedLock(sched, name, reentrant=reentrant)
+    setattr(obj, attr, lk)
+    return lk
+
+
+Report = Callable[[str], None]
+
+
+def store_accounting_invariants(store, *, base_version: int,
+                                base_watermark: int, base_rejections: int,
+                                n_versions: int, n_producers: int,
+                                n_updates: int, report: Report) -> None:
+    """The SnapshotStore exactly-once ledger, shared between this
+    deterministic battery (scenario_store) and the wall-clock thread
+    soak (tools/soak_service.py --threads): `n_producers` replay the
+    SAME `n_versions` version sequence, so every version must admit
+    exactly once, every duplicate must reject with a typed reason, and
+    the version counter must advance by exactly applies + functional
+    updates — the algebra that breaks first when the store lock stops
+    covering the version guard."""
+    want_wm = base_watermark + n_versions
+    if store.applied_delta_version != want_wm:
+        report(f"delta watermark {store.applied_delta_version}, want "
+               f"{want_wm} — a version was lost or double-applied")
+    want_rej = base_rejections + (n_producers - 1) * n_versions
+    if store.delta_rejections != want_rej:
+        report(f"{store.delta_rejections - base_rejections} rejections "
+               f"for {n_producers} producers x {n_versions} versions, "
+               f"want {want_rej - base_rejections} — duplicate replays "
+               f"slipped past the version guard")
+    want_ver = base_version + n_versions + n_updates
+    if store.version != want_ver:
+        report(f"store version {store.version}, want {want_ver} "
+               f"({base_version} base + {n_versions} applies + "
+               f"{n_updates} updates)")
+
+
+# --- scenario: SnapshotStore ingest vs update vs read --------------------
+
+
+class _FakeDelta:
+    """Duck-typed versioned delta: `delta_version` only reads
+    `source_version`, and the apply kernel is monkeypatched, so the
+    scenario exercises the store's REAL version-guard path without
+    building a full columnar snapshot."""
+
+    def __init__(self, version: int):
+        self.source_version = version
+
+
+def scenario_store(sched: DetScheduler, report: Report) -> None:
+    """Two producers replay the SAME delta version sequence (a
+    restarted producer racing its own ghost) against one store, while
+    an updater publishes functional updates and a reader drains
+    rejection reasons. The guarded-by contract on `_lock` is what
+    makes the version guard atomic; the invariants below are exactly
+    what breaks when it is not:
+
+      * every version applies EXACTLY once, in increasing order,
+      * rejections account for every duplicate,
+      * the version counter equals 1 + applies + updates.
+    """
+    import koordinator_tpu.snapshot.delta as delta_mod
+    from koordinator_tpu.snapshot.store import SnapshotStore
+
+    n_versions, n_updates = 6, 4
+    store = SnapshotStore()
+    # bypass publish(): the device upload is irrelevant to the lock
+    # discipline under test, and keeps the scenario jit-free
+    store._current = object()
+    store._version = 1
+    _instrument(store, "_lock", sched, "store._lock")
+
+    applies: List[int] = []
+    real_apply = delta_mod.apply_metric_delta
+
+    def fake_apply(snap, delta):
+        # runs INSIDE store._lock on the healthy tree; the append is
+        # the observable "the guard admitted this version" event
+        applies.append(int(delta.source_version))
+        return snap
+
+    def ingest_worker():
+        for v in range(1, n_versions + 1):
+            store.ingest(_FakeDelta(v))
+
+    def update_worker():
+        for _ in range(n_updates):
+            store.update(lambda s: s)
+
+    def reader_worker():
+        for _ in range(n_updates):
+            store.take_delta_rejection()
+            _ = store.version
+
+    delta_mod.apply_metric_delta = fake_apply
+    try:
+        sched.spawn(ingest_worker, "ingest-a")
+        sched.spawn(ingest_worker, "ingest-b")
+        sched.spawn(update_worker, "update")
+        sched.spawn(reader_worker, "reader")
+        sched.run()
+    finally:
+        delta_mod.apply_metric_delta = real_apply
+
+    want = list(range(1, n_versions + 1))
+    if sorted(applies) != want:
+        report(f"delta versions applied {sorted(applies)}, want each of "
+               f"{want} exactly once — the version guard raced")
+    elif applies != want:
+        report(f"applies out of order: {applies} — watermark moved "
+               f"backwards")
+    store_accounting_invariants(
+        store, base_version=1, base_watermark=0, base_rejections=0,
+        n_versions=n_versions, n_producers=2, n_updates=n_updates,
+        report=report)
+
+
+# --- scenario: CommitJournal under its external commit lock --------------
+
+
+def scenario_journal(sched: DetScheduler, report: Report) -> None:
+    """Two appenders durably commit IDENTICAL chunk records (the
+    idempotent-replay path), a pruner truncates behind a checkpoint
+    watermark, and a reader walks the epoch index — every mutation
+    under the one shared commit lock, exactly the external:
+    guarded-by contract the journal declares. The invariant is the
+    journal's reason to exist: a fresh reload of the file equals the
+    in-memory index, byte-for-byte per record."""
+    import numpy as np
+
+    from koordinator_tpu.scheduler.journal import (
+        CommitJournal,
+        JournalRecord,
+        JournalTail,
+    )
+
+    with tempfile.TemporaryDirectory(prefix="racecheck-") as td:
+        j = CommitJournal(os.path.join(td, "journal.bin"))
+        commit = InstrumentedLock(sched, "commit_lock")
+        epochs, n_chunks = (1, 2, 3), 2
+
+        def rec(e: int, c: int) -> JournalRecord:
+            return JournalRecord(
+                epoch=e, chunk=c, n_chunks=n_chunks, base_version=e,
+                delta_watermark=e, batch_digest=e * 7 + c,
+                assignment=np.asarray([e * 10 + c], np.int32))
+
+        def appender():
+            for e in epochs:
+                for c in range(n_chunks):
+                    with commit:
+                        j.append(rec(e, c))
+
+        def pruner():
+            for _ in range(3):
+                with commit:
+                    j.prune(min_base_version=2)
+
+        def reader():
+            for _ in range(4):
+                with commit:
+                    for e in j.epochs():
+                        j.records_for(e)
+                    j.next_epoch()
+
+        sched.spawn(appender, "append-a")
+        sched.spawn(appender, "append-b")
+        sched.spawn(pruner, "prune")
+        sched.spawn(reader, "read")
+        sched.run()
+
+        if j.tail_reason is not JournalTail.CLEAN:
+            report(f"journal tail {j.tail_reason} after clean appends")
+        for e in j.epochs():
+            got = j.records_for(e)
+            for c, r in got.items():
+                if not r.same_payload(rec(e, c)):
+                    report(f"(epoch {e}, chunk {c}) payload diverged "
+                           f"in memory")
+        reloaded = CommitJournal(j.path)
+        if reloaded.epochs() != j.epochs():
+            report(f"reload sees epochs {reloaded.epochs()}, memory "
+                   f"has {j.epochs()} — durable and in-memory state "
+                   f"diverged")
+        for e in j.epochs():
+            mem, disk = j.records_for(e), reloaded.records_for(e)
+            if set(mem) != set(disk) or not all(
+                    mem[c].same_payload(disk[c]) for c in mem):
+                report(f"epoch {e} reloads differently than the "
+                       f"in-memory index")
+
+
+# --- scenario: Tracer span storm -----------------------------------------
+
+
+def scenario_trace(sched: DetScheduler, report: Report) -> None:
+    """Eight threads close nested spans into a deliberately tiny ring:
+    the guarded-by contract on the buffer is what keeps
+    retained + dropped == appended exact under overflow, and the
+    thread-local span stacks are what keep each thread's records in
+    its own program order (checked via a per-span sequence attr)."""
+    from koordinator_tpu.obs.trace import Tracer
+
+    capacity, n_threads, n_spans = 16, 8, 4
+    tracer = Tracer(capacity=capacity)
+    _instrument(tracer, "_lock", sched, "tracer._lock")
+
+    def storm(tid: int) -> Callable[[], None]:
+        def run():
+            for i in range(n_spans):
+                with tracer.span(f"t{tid}", attrs={"seq": i},
+                                 cycle=tid):
+                    with tracer.span(f"t{tid}.inner"):
+                        pass
+        return run
+
+    for tid in range(n_threads):
+        sched.spawn(storm(tid), f"span-{tid}")
+    sched.run()
+
+    total = n_threads * n_spans * 2  # outer + inner per iteration
+    recs = tracer.records()
+    if len(recs) != min(total, capacity):
+        report(f"ring holds {len(recs)} records, want "
+               f"{min(total, capacity)}")
+    if len(recs) + tracer.dropped != total:
+        report(f"retained {len(recs)} + dropped {tracer.dropped} != "
+               f"appended {total} — overflow accounting raced")
+    for tid in range(n_threads):
+        seqs = [r.attrs["seq"] for r in recs if r.name == f"t{tid}"]
+        if seqs != sorted(seqs):
+            report(f"thread {tid} records out of program order: {seqs}")
+        inner = [r for r in recs if r.name == f"t{tid}.inner"]
+        if any(r.parent != f"t{tid}" or r.cycle != tid for r in inner):
+            report(f"thread {tid} inner spans lost their parent/cycle "
+                   f"— span stacks leaked across threads")
+
+
+# --- scenario: metrics observe vs export ---------------------------------
+
+
+def scenario_metrics(sched: DetScheduler, report: Report) -> None:
+    """Three observers drive a counter, a histogram, and a labeled
+    gauge while an exporter renders the scrape payload and reads
+    percentiles mid-flight: every count must land exactly once."""
+    from koordinator_tpu.metrics import Registry
+
+    reg = Registry()
+    counter = reg.counter("racecheck_total", "racecheck counter")
+    hist = reg.histogram("racecheck_seconds", "racecheck histogram",
+                         buckets=(0.1, 1.0))
+    gauge = reg.gauge("racecheck_inflight", "racecheck gauge",
+                      labels=("worker",))
+    _instrument(reg, "_lock", sched, "registry._lock")
+    for m in (counter, hist, gauge):
+        _instrument(m, "_lock", sched, f"{m.name}._lock")
+
+    n_workers, n_obs = 3, 5
+
+    def observer():
+        for _ in range(n_obs):
+            counter.inc()
+            hist.observe(0.5)
+            gauge.labels("shared").add(1.0)
+
+    def exporter():
+        for _ in range(3):
+            reg.expose()
+            hist.percentile(0.9)
+
+    for k in range(n_workers):
+        sched.spawn(observer, f"observe-{k}")
+    sched.spawn(exporter, "export")
+    sched.run()
+
+    want = float(n_workers * n_obs)
+    if counter.value() != want:
+        report(f"counter {counter.value()}, want {want} — an inc was "
+               f"lost to a racing read-modify-write")
+    if hist.count() != want or hist.sum() != 0.5 * want:
+        report(f"histogram count={hist.count()} sum={hist.sum()}, "
+               f"want {want}/{0.5 * want}")
+    if gauge.value("shared") != want:
+        report(f"gauge {gauge.value('shared')}, want {want}")
+    line = f"racecheck_total {int(want)}"
+    if line not in reg.expose():
+        report(f"final exposition missing {line!r}")
+
+
+SCENARIOS: Dict[str, Callable[[DetScheduler, Report], None]] = {
+    "store": scenario_store,
+    "journal": scenario_journal,
+    "trace": scenario_trace,
+    "metrics": scenario_metrics,
+}
+
+
+# --- battery -------------------------------------------------------------
+
+
+def _run_one(name: str, seed: int, mode: str,
+             preempt_budget: Optional[int] = None,
+             ) -> Tuple[List[str], List[Tuple[str, str, str, str]], int]:
+    """One scenario under one schedule -> (failures, trace, switch
+    point count). Worker exceptions and detected deadlocks become
+    failures, not crashes, so one red schedule never hides another."""
+    sched = DetScheduler(seed=seed, mode=mode,
+                         preempt_budget=preempt_budget)
+    failures: List[str] = []
+    try:
+        SCENARIOS[name](sched, failures.append)
+    except (RuntimeError, DeadlockError) as exc:
+        failures.append(f"scenario raised {type(exc).__name__}: {exc}")
+    return failures, sched.trace, sched.switch_points
+
+
+def run_all(seed: int = 0, verbose: bool = False,
+            only: Optional[str] = None, n_seeds: int = 3) -> int:
+    names = [n for n in SCENARIOS if only is None or only in n]
+    if not names:
+        print(f"no scenario matches {only!r}; "
+              f"have {sorted(SCENARIOS)}", file=sys.stderr)
+        return 2
+    failures: List[str] = []
+    runs = 0
+    for name in names:
+        schedules: List[Tuple[str, int, Optional[int]]] = [("rr", 0, None)]
+        schedules += [("random", seed + i, None) for i in range(n_seeds)]
+        # bounded preemption (small-CHESS): most races need only a
+        # couple of forced switches, so a tiny budget is a distinct,
+        # cheap slice of schedule space
+        schedules.append(("random", seed + n_seeds, 4))
+        for mode, s, budget in schedules:
+            fails, trace, points = _run_one(name, s, mode, budget)
+            runs += 1
+            tag = f"{name} [{mode} seed={s}" + (
+                f" budget={budget}]" if budget is not None else "]")
+            for msg in fails:
+                failures.append(f"{tag} {msg}")
+            if verbose and not fails:
+                print(f"ok   {tag}: {points} switch points, "
+                      f"{len(trace)} switches")
+        # determinism: the same seed must reproduce the same schedule,
+        # or a red run cannot be replayed for debugging
+        _, t1, _ = _run_one(name, seed, "random")
+        _, t2, _ = _run_one(name, seed, "random")
+        runs += 2
+        if t1 != t2:
+            failures.append(
+                f"{name} [random seed={seed}] nondeterministic: two "
+                f"runs produced different schedules "
+                f"({len(t1)} vs {len(t2)} switches)")
+        elif verbose:
+            print(f"ok   {name} determinism: seed {seed} replays "
+                  f"{len(t1)} switches identically")
+    for msg in failures:
+        print(f"FAIL {msg}")
+    print(f"racecheck: {len(names)} scenario(s), {runs} schedule "
+          f"run(s), {len(failures)} failure(s)")
+    return 1 if failures else 0
+
+
+# --- self-test mutations -------------------------------------------------
+
+# Tier-B defect: ingest's version guard runs under a FRESH lock per
+# call — mutual exclusion is gone, but every access still happens
+# inside *a* with-block, so the static tier (which never guesses about
+# unresolvable context managers) cannot see it. Only exploration can.
+_STORE_MUT = Mutation(
+    relpath="koordinator_tpu/snapshot/store.py",
+    anchor=(
+        "        with self._lock:\n"
+        "            if self._current is None:\n"
+        "                raise RuntimeError(\"no snapshot published yet\")\n"
+        "            if ver is not None:"),
+    replacement=(
+        "        with threading.Lock():\n"
+        "            if self._current is None:\n"
+        "                raise RuntimeError(\"no snapshot published yet\")\n"
+        "            if ver is not None:"),
+    note="ingest's delta version guard no longer holds the store lock",
+)
+
+# Tier-A defect: a cold code path (nothing in this battery drives
+# MetricCache) drops its lock entirely — invisible to any dynamic
+# explorer that doesn't happen to execute it, caught unconditionally
+# by the guarded-by contract check.
+_METRIC_MUT = Mutation(
+    relpath="koordinator_tpu/koordlet/metriccache.py",
+    anchor=(
+        "    def set_kv(self, key: str, value: object) -> None:\n"
+        "        with self._lock:\n"
+        "            self._kv[key] = value"),
+    replacement=(
+        "    def set_kv(self, key: str, value: object) -> None:\n"
+        "        self._kv[key] = value"),
+    note="MetricCache.set_kv writes the KV map with no lock",
+)
+
+
+def self_test_mutation() -> int:
+    """Prove both tiers live and complementary: each planted defect
+    must be caught by exactly its own tier and MISSED by the other."""
+    # run by path, not -m: `-m` puts the CWD first on sys.path, which
+    # would shadow the mutated tree seedmut prepends via PYTHONPATH
+    battery = [sys.executable, os.path.abspath(__file__),
+               "--seed", "7", "--seeds", "2"]
+    lint = [sys.executable, "-m", "tools.lint", "--root", "{tree}",
+            "--analyzers", "race-guard,lock-discipline"]
+    rc = 0
+    rc = max(rc, check_gate_catches(
+        _STORE_MUT, battery, marker="FAIL", label="racecheck"))
+    rc = max(rc, check_gate_passes(
+        _STORE_MUT, lint, label="race-guard lint"))
+    rc = max(rc, check_gate_catches(
+        _METRIC_MUT, lint, marker="GB001", label="race-guard lint"))
+    rc = max(rc, check_gate_passes(
+        _METRIC_MUT, battery, label="racecheck"))
+    if rc == 0:
+        print("racecheck self-test: both planted defects caught by "
+              "exactly their own tier (dynamic explorer + static "
+              "contracts are complementary)")
+    return rc
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m tools.racecheck",
+        description="koordrace Tier B: deterministic interleaving "
+                    "exploration of the guarded concurrent classes")
+    parser.add_argument("--seed", type=lambda s: int(s, 0), default=0,
+                        help="base schedule seed (default 0)")
+    parser.add_argument("--seeds", type=int, default=3,
+                        help="number of random schedules per scenario "
+                             "(default 3; rr + bounded runs ride along)")
+    parser.add_argument("--only", help="substring filter on scenario "
+                                       "names")
+    parser.add_argument("--self-test-mutation", action="store_true",
+                        help="plant one defect per tier and prove each "
+                             "is caught by exactly its own tier")
+    parser.add_argument("-v", "--verbose", action="store_true")
+    args = parser.parse_args(argv)
+    if args.self_test_mutation:
+        return self_test_mutation()
+    return run_all(seed=args.seed, verbose=args.verbose, only=args.only,
+                   n_seeds=args.seeds)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

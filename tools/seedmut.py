@@ -45,6 +45,34 @@ class Mutation:
     note: str  # one-line description for the smoke report
 
 
+def _run_mutated(mutation: Mutation, argv: Sequence[str], *,
+                 label: str, repo_root: str, timeout: int):
+    """Plant `mutation` in a temp copy of the package and run `argv`
+    against it. Returns the completed process, or None when the anchor
+    has drifted out of the tree (the smoke itself is stale)."""
+    with tempfile.TemporaryDirectory(prefix="seedmut-") as td:
+        shutil.copytree(os.path.join(repo_root, PACKAGE),
+                        os.path.join(td, PACKAGE))
+        target = os.path.join(td, mutation.relpath)
+        with open(target, encoding="utf-8") as f:
+            src = f.read()
+        if mutation.anchor not in src:
+            print(f"mutation smoke [{label}]: anchor "
+                  f"{mutation.anchor!r} missing from {mutation.relpath}"
+                  f" — refresh the smoke's anchor", file=sys.stderr)
+            return None
+        with open(target, "w", encoding="utf-8") as f:
+            f.write(src.replace(mutation.anchor,
+                                mutation.replacement, 1))
+        env = dict(os.environ)
+        env["PYTHONPATH"] = os.pathsep.join(
+            [td, repo_root] + ([env["PYTHONPATH"]]
+                               if env.get("PYTHONPATH") else []))
+        cmd = [a.replace("{tree}", td) for a in argv]
+        return subprocess.run(cmd, capture_output=True, text=True,
+                              env=env, cwd=repo_root, timeout=timeout)
+
+
 def check_gate_catches(mutation: Mutation, argv: Sequence[str], *,
                        marker: Optional[str] = None,
                        label: str = "gate",
@@ -56,27 +84,10 @@ def check_gate_catches(mutation: Mutation, argv: Sequence[str], *,
     for the right reason. Returns 2 when the anchor has drifted out of
     the tree (the smoke itself is stale), 1 when the gate let the
     defect through or failed for an unrelated reason."""
-    with tempfile.TemporaryDirectory(prefix="seedmut-") as td:
-        shutil.copytree(os.path.join(repo_root, PACKAGE),
-                        os.path.join(td, PACKAGE))
-        target = os.path.join(td, mutation.relpath)
-        with open(target, encoding="utf-8") as f:
-            src = f.read()
-        if mutation.anchor not in src:
-            print(f"mutation smoke [{label}]: anchor "
-                  f"{mutation.anchor!r} missing from {mutation.relpath}"
-                  f" — refresh the smoke's anchor", file=sys.stderr)
-            return 2
-        with open(target, "w", encoding="utf-8") as f:
-            f.write(src.replace(mutation.anchor,
-                                mutation.replacement, 1))
-        env = dict(os.environ)
-        env["PYTHONPATH"] = os.pathsep.join(
-            [td, repo_root] + ([env["PYTHONPATH"]]
-                               if env.get("PYTHONPATH") else []))
-        cmd = [a.replace("{tree}", td) for a in argv]
-        proc = subprocess.run(cmd, capture_output=True, text=True,
-                              env=env, cwd=repo_root, timeout=timeout)
+    proc = _run_mutated(mutation, argv, label=label,
+                        repo_root=repo_root, timeout=timeout)
+    if proc is None:
+        return 2
     if proc.returncode == 0:
         print(f"mutation smoke [{label}]: the gate PASSED a mutated "
               f"tree ({mutation.note}) — it is not protecting "
@@ -90,4 +101,31 @@ def check_gate_catches(mutation: Mutation, argv: Sequence[str], *,
         return 1
     print(f"mutation smoke [{label}]: {mutation.note} — correctly "
           f"caught (gate is live)")
+    return 0
+
+
+def check_gate_passes(mutation: Mutation, argv: Sequence[str], *,
+                      label: str = "gate",
+                      repo_root: str = REPO_ROOT,
+                      timeout: int = 1200) -> int:
+    """The complement of check_gate_catches: return 0 iff the gate
+    PASSES the mutated tree. Dual-tier smokes use this to prove the
+    two tiers are complementary BY CONSTRUCTION — each planted defect
+    must be caught by exactly its own tier, and demonstrably invisible
+    to the other (a defect both tiers see proves redundancy, not
+    coverage). Returns 2 on anchor drift, 1 when the gate failed (it
+    can see the defect after all)."""
+    proc = _run_mutated(mutation, argv, label=label,
+                        repo_root=repo_root, timeout=timeout)
+    if proc is None:
+        return 2
+    if proc.returncode != 0:
+        print(f"mutation smoke [{label}]: expected the gate to MISS "
+              f"this defect ({mutation.note}) but it failed — the "
+              f"tiers overlap where they should complement:",
+              file=sys.stderr)
+        print(proc.stdout + proc.stderr, file=sys.stderr)
+        return 1
+    print(f"mutation smoke [{label}]: {mutation.note} — invisible to "
+          f"this tier, as designed")
     return 0

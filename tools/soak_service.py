@@ -23,12 +23,26 @@ the no-crash oracle with exactly one journal record per (epoch, chunk)
 — the tools/crash_smoke.py machinery, randomized. Each cycle pays a
 subprocess jax start, so the default seed count is small.
 
+`--threads K` (koordrace Tier B's wall-clock complement) adds a
+per-seed thread-stress phase: K REAL threads — duplicate-replaying
+ingest drivers, a concurrent schedule driver, a checkpoint/reader
+driver — hammer the seed's live service under genuine preemption, and
+the SnapshotStore exactly-once ledger is then asserted via the SAME
+invariant helper the deterministic battery uses
+(tools/racecheck.store_accounting_invariants). Where racecheck
+explores seeded schedules it can replay, this explores whatever the
+OS scheduler does — cheap breadth on top of deterministic depth.
+Composes with --chaos (the stress runs on the fault-injected service)
+and with --kill (each crash-recovery seed gets its own stressed
+service).
+
 Usage: JAX_PLATFORMS=cpu python tools/soak_service.py [n_seeds]
-           [--chaos | --kill]
+           [--chaos | --kill] [--threads K]
 """
 
 import os
 import sys
+import threading
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
@@ -46,7 +60,12 @@ from koordinator_tpu.utils import synthetic
 P, N = 1_024, 256
 CHAOS = "--chaos" in sys.argv[1:]
 KILL = "--kill" in sys.argv[1:]
-_counts = [a for a in sys.argv[1:] if not a.startswith("-")]
+_args = sys.argv[1:]
+THREADS = int(_args[_args.index("--threads") + 1]) \
+    if "--threads" in _args else 0
+if "--threads" in _args:
+    del _args[_args.index("--threads"):_args.index("--threads") + 2]
+_counts = [a for a in _args if not a.startswith("-")]
 N_SEEDS = int(_counts[0]) if _counts else (5 if KILL else 100)
 
 # per-seed chaos menu: one of these fires each seed (seeded choice)
@@ -75,6 +94,98 @@ def apply_chaos(service, snap, pods, seed):
     return snap, pods, quarantined
 
 
+def _stress_delta(snap, version):
+    """A real (tiny, all-zero) NodeMetricDelta stamped with `version`:
+    the stress cares about the store's version-guard ledger, not the
+    metric values, but the delta must be genuine so ingest runs the
+    jitted apply kernel under the real locks."""
+    from koordinator_tpu.snapshot.delta import NodeMetricDelta
+
+    nodes = snap.nodes
+    k = 4
+    row = np.zeros((k,) + np.asarray(nodes.usage).shape[1:], np.float32)
+    agg = np.zeros((k,) + np.asarray(nodes.agg_usage).shape[1:],
+                   np.float32)
+    return NodeMetricDelta(
+        idx=np.arange(k, dtype=np.int32),
+        metric_fresh=np.ones(k, bool),
+        usage=row, prod_usage=row, agg_usage=agg,
+        has_agg=np.zeros(k, bool),
+        assigned_estimated=row, assigned_correction=row,
+        prod_assigned_estimated=row, prod_assigned_correction=row,
+        source_version=np.int32(version))
+
+
+def stress_threads(service, pods, seed, k):
+    """The --threads phase: k real threads race the seed's live service
+    — ingest drivers all replaying the SAME delta version sequence
+    (racing ghosts), a schedule driver committing a full batch through
+    the commit lock mid-replay, a checkpoint/reader driver — then the
+    store's exactly-once ledger is asserted with the invariant helper
+    the deterministic racecheck battery uses. Returns 1 on violation."""
+    from tools.racecheck import store_accounting_invariants
+
+    store = service.store
+    base_ver = store.version
+    base_wm = store.applied_delta_version
+    base_rej = store.delta_rejections
+    n_versions = 4
+    snap = store.current()
+    deltas = [_stress_delta(snap, base_wm + 1 + j)
+              for j in range(n_versions)]
+    roles = [("ingest", "ingest", "schedule", "checkpoint")[t % 4]
+             for t in range(k)]
+    commits = []
+    errors = []
+
+    def ingest_driver():
+        for d in deltas:
+            service.ingest(d)
+
+    def schedule_driver():
+        res = service.schedule(pods)
+        commits.append(int(np.asarray(res.assignment).shape[0]))
+
+    def checkpoint_driver():
+        for _ in range(n_versions):
+            service.store.maybe_checkpoint()
+            _ = store.version
+            _ = store.applied_delta_version
+            store.current()
+
+    drivers = {"ingest": ingest_driver, "schedule": schedule_driver,
+               "checkpoint": checkpoint_driver}
+
+    def run(fn):
+        try:
+            fn()
+        except Exception as exc:  # noqa: BLE001 — reported below
+            errors.append(exc)
+
+    threads = [threading.Thread(target=run, args=(drivers[role],),
+                                name=f"stress-{role}-{t}")
+               for t, role in enumerate(roles)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join(timeout=300)
+
+    fails = []
+    if any(th.is_alive() for th in threads):
+        fails.append("a stress driver is still running after 300s")
+    for exc in errors:
+        fails.append(f"driver raised {type(exc).__name__}: {exc}")
+    # each successful schedule commits exactly one functional update
+    store_accounting_invariants(
+        store, base_version=base_ver, base_watermark=base_wm,
+        base_rejections=base_rej, n_versions=n_versions,
+        n_producers=roles.count("ingest"), n_updates=len(commits),
+        report=fails.append)
+    for msg in fails:
+        print(f"seed {seed}: THREAD-STRESS {msg}", flush=True)
+    return 1 if fails else 0
+
+
 def main_kill():
     """The crash soak: one SIGKILLed child + recovery per seed, crash
     point and hit drawn from the seed so a failure reproduces from its
@@ -99,6 +210,16 @@ def main_kill():
             bad += 1
             print(f"KILL FAIL seed {i} ({point}:{hit}): {exc}",
                   flush=True)
+        if THREADS:
+            # the crash cases run in child processes, so the thread
+            # stress gets its own in-process service per seed
+            service = SchedulerService(num_rounds=2, k_choices=4)
+            service._sleep = lambda _s: None
+            service.publish(synthetic.full_gate_cluster(
+                N, seed=i, num_quotas=8, num_gangs=8))
+            pods = synthetic.full_gate_pods(
+                P, N, seed=i + 500, num_quotas=8, num_gangs=8)
+            bad += stress_threads(service, pods, i, THREADS)
     print(f"KILL SOAK DONE: {N_SEEDS} seeds, {bad} violations",
           flush=True)
     return 1 if bad else 0
@@ -143,6 +264,8 @@ def main():
         if not ok:
             print(f"seed {i}: ROW-CONSISTENCY VIOLATION", flush=True)
             bad += 1
+        if THREADS:
+            bad += stress_threads(service, pods, i, THREADS)
         if (i + 1) % 20 == 0:
             print(f"{i + 1}/{N_SEEDS} seeds, {bad} violations",
                   flush=True)
