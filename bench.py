@@ -576,6 +576,28 @@ def run_northstar(full_gate: bool = False, num_pods: int = None,
         return (snap, counts, np.asarray(assign), left_after_sweep,
                 left, never_retried, passes)
 
+    # BENCH_COST=1: static cost stamps for the flagship program this
+    # line actually runs (obs/costmodel.py over the SAME jitted
+    # callable) — flops, bytes accessed, static HBM peak, flops/pod.
+    # Opt-in because it pays one extra AOT lower+compile of the
+    # flagship (the persistent cache absorbs it when configured);
+    # lowering happens BEFORE the warmup so the donated buffers are
+    # still live to trace against.
+    cost_stamp = {}
+    if os.environ.get("BENCH_COST", "0") not in ("0", "false", ""):
+        from koordinator_tpu.obs import costmodel
+        cost_target = sweep_and_tail if tail_mode == "device" else sweep
+        cost_compiled = cost_target.lower(snap0, counts0, stacked,
+                                          pods_dev, cfg).compile()
+        stamp = costmodel.flagship_stamp(cost_compiled, num_pods)
+        cost_stamp = {
+            "flops": stamp["flops"],
+            "bytes_accessed": stamp["bytes_accessed"],
+            "hbm_peak_bytes": stamp["hbm_peak_bytes"],
+            "flops_per_pod": round(stamp["flops_per_pod"], 1),
+        }
+        del cost_compiled
+
     # warmup/compile (sweep + tail always run at least MIN passes — no
     # cold path in the timed region regardless of the warm data). The
     # compile watcher around it feeds the warm-start stamps: what
@@ -699,6 +721,10 @@ def run_northstar(full_gate: bool = False, num_pods: int = None,
         "compile_s": round(warm_watch.compile_seconds, 4),
         "warm_start_s": round(warm_start_s, 4),
         "cache": cache_status,
+        # present ONLY on a BENCH_COST=1 run: static cost/memory of the
+        # flagship program this line ran (obs/costmodel.py) — joins the
+        # measured trajectory to the AOT cost model
+        **cost_stamp,
         # present ONLY on a bf16-packed run (BENCH_PACK_SNAPSHOT): the
         # kernels consumed packed score/metric columns and the line
         # says what the packed layout saves on the wire

@@ -190,6 +190,21 @@ class Histogram(_Metric):
             h = self._hist.get(key)
             return 0.0 if h is None else h[len(self.buckets) + 1]
 
+    def count_le(self, value: float, *values: str) -> float:
+        """Cumulative count of observations <= the first bucket bound
+        at or above `value` (Prometheus `le` semantics: the answer is
+        bucket-resolution, so thresholds should sit on bucket bounds).
+        The SLO plane reads good-event counts off this."""
+        key = _validate_labels(self.label_names, values)
+        with self._lock:
+            h = self._hist.get(key)
+            if h is None:
+                return 0.0
+            for i, b in enumerate(self.buckets):
+                if value <= b:
+                    return h[i]
+            return h[len(self.buckets)]  # above every finite bound
+
     def percentile(self, q: float, *values: str) -> Optional[float]:
         """Bucketed quantile estimate (Prometheus histogram_quantile
         semantics): find the first bucket whose CUMULATIVE count

@@ -53,6 +53,16 @@ SCHEDULER_RECOVERY_COMPILE_SECONDS = \
 # closed host span feeds (phase label values come from obs/phases.py)
 SCHEDULER_TRACE_SPANS_DROPPED = "scheduler_trace_spans_dropped"
 SCHEDULER_CYCLE_PHASE_SECONDS = "scheduler_cycle_phase_seconds"
+# koordcost resource/SLO plane (obs/slo.py + obs/memwatch.py +
+# tools/costcheck.py): error-budget accounting per objective, device
+# memory in use / peak as sampled at the dispatch span boundaries, the
+# leak sentinel's fire count, and the drift gate's verdict ledger
+SCHEDULER_SLO_BUDGET_REMAINING = "scheduler_slo_budget_remaining"
+SCHEDULER_SLO_BURN_RATE = "scheduler_slo_burn_rate"
+SCHEDULER_HBM_BYTES_IN_USE = "scheduler_hbm_bytes_in_use"
+SCHEDULER_HBM_BYTES_PEAK = "scheduler_hbm_bytes_peak"
+SCHEDULER_MEMWATCH_LEAK_EVENTS = "scheduler_memwatch_leak_events"
+SCHEDULER_COST_DRIFT_CHECKS = "scheduler_cost_drift_checks"
 
 # --- koordlet (pkg/koordlet/metrics/: cpi.go, psi.go, cpu_suppress.go,
 #     cpu_burst.go, core_sched.go, prediction.go, resource_summary.go,

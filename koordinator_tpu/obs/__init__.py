@@ -1,12 +1,25 @@
-"""koordtrace: the observability plane (docs/OBSERVABILITY.md).
+"""koordtrace + koordcost: the observability plane
+(docs/OBSERVABILITY.md).
 
-Three pieces:
+The pieces:
   * `obs.trace` — the bounded span tracer threaded through
     `SchedulerService` cycles (host spans),
   * `obs.phases` — the shared phase-name table every span /
     named_scope label comes from (koordlint OB001 enforces it),
   * `obs.export` — chrome|jsonl|prom rendering of a span buffer plus
-    the metrics registry.
+    the metrics registry,
+  * `obs.hloattrib` — the shared HLO op_name -> phase parser the
+    sampled-time and static-cost views both join through,
+  * `obs.costmodel` — registry-walking static cost/memory accounting
+    (tools/costcheck.py gates it against perf/COST_BASELINE.json),
+  * `obs.memwatch` / `obs.slo` — runtime device-memory telemetry with
+    the leak sentinel, and multi-window SLO error-budget burn rates
+    (surfaced via SchedulerService.health()).
+
+costmodel/memwatch/slo are deliberately NOT imported here: costmodel
+pulls jax and the contract registry at import, and the obs package
+must stay cheap to import from device-free tooling — consumers import
+the submodules they need.
 
 `phase(name)` is THE way kernel code opens a named region: a
 `jax.named_scope` whose label is validated against the table, so

@@ -1,0 +1,213 @@
+"""Shared HLO `op_name` phase attribution — the one parser joining
+compiled-program metadata to the koordtrace phase table.
+
+Every kernel region is wrapped in a `jax.named_scope` phase label
+(obs.phase(...)), and XLA threads those labels into each instruction's
+`op_name="...koord/<phase>/..."` metadata. Two views consume that
+metadata and MUST agree on the join:
+
+  * the sampled-time view (tools/trace_fullgate.py): profiler trace
+    events joined to phases through the instruction-name map;
+  * the static-cost view (obs/costmodel.py): per-instruction output
+    bytes and instruction counts attributed per phase.
+
+Before koordcost the parser lived inside trace_fullgate; extracting it
+here means the two views literally share one regex pair and one
+innermost-scope-wins rule, so they can never drift apart.
+
+The byte model is deliberately simple and SELF-CONSISTENT: each parsed
+instruction contributes its output-buffer size (dtype width x element
+count, tuples summed), and per-phase attribution always sums to the
+total over the same instruction set — `costmodel` and its tests rely
+on that closure property, not on matching XLA's internal buffer
+assignment.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass
+from typing import Dict, Iterable, List, Optional, Tuple
+
+from koordinator_tpu.obs import phases as obs_phases
+
+__all__ = [
+    "OP_NAME_RE", "PHASE_IN_OP_RE", "HloInstruction", "UNATTRIBUTED",
+    "parse_instructions", "instruction_phases", "phase_of_event",
+    "attribute_bytes", "coverage",
+]
+
+# one instruction line of HLO text: `%name = <type> opcode(...)`, with
+# optional metadata={... op_name="..."} — the same two regexes the
+# sampled and static views both join on
+OP_NAME_RE = re.compile(r'%?([\w.-]+) = [^\n]*op_name="([^"]*)"')
+PHASE_IN_OP_RE = re.compile(r"(koord/\w+)")
+
+# the bucket for instructions whose op_name carries no koord/ scope
+# (XLA-introduced copies, parameter plumbing, un-scoped library calls)
+UNATTRIBUTED = "unattributed"
+
+_INSTR_RE = re.compile(r"^\s*(?:ROOT\s+)?%([\w.-]+)\s+=\s+")
+_ARRAY_TYPE_RE = re.compile(r"([a-z]+\d*)\[([\d,]*)\]")
+
+# HLO primitive dtype -> bytes per element (pred is byte-backed)
+_DTYPE_BYTES = {
+    "pred": 1, "s8": 1, "u8": 1, "s16": 2, "u16": 2, "f16": 2,
+    "bf16": 2, "s32": 4, "u32": 4, "f32": 4, "s64": 8, "u64": 8,
+    "f64": 8, "c64": 8, "c128": 16,
+}
+
+
+@dataclass(frozen=True)
+class HloInstruction:
+    """One parsed HLO instruction: its name, the total output-buffer
+    bytes of its result type (tuple elements summed), and the phase
+    its op_name metadata resolves to (UNATTRIBUTED when none)."""
+
+    name: str
+    output_bytes: int
+    phase: str
+
+
+def _type_bytes(type_str: str) -> int:
+    """Output-buffer bytes of one HLO result type string — an array
+    type (`f32[64,32]{1,0}`), a scalar (`f32[]`), or a tuple
+    (`(f32[4], s32[4])`); layout annotations are ignored and unknown
+    dtypes contribute zero rather than guessing a width."""
+    total = 0
+    for dtype, dims in _ARRAY_TYPE_RE.findall(type_str):
+        width = _DTYPE_BYTES.get(dtype)
+        if width is None:
+            continue
+        n = 1
+        if dims:
+            for d in dims.split(","):
+                n *= int(d)
+        total += width * n
+    return total
+
+
+def _result_type(line: str, start: int) -> str:
+    """The result-type portion of an instruction line, starting at
+    `start` (just past `= `): a parenthesized tuple runs to its
+    matching close, an array type to the first space."""
+    if start < len(line) and line[start] == "(":
+        depth = 0
+        for i in range(start, len(line)):
+            if line[i] == "(":
+                depth += 1
+            elif line[i] == ")":
+                depth -= 1
+                if depth == 0:
+                    return line[start:i + 1]
+        return line[start:]
+    end = line.find(" ", start)
+    return line[start:] if end < 0 else line[start:end]
+
+
+def parse_instructions(hlo_text: str,
+                       phases: Optional[Iterable[str]] = None
+                       ) -> List[HloInstruction]:
+    """Every instruction line of `hlo_text` (entry and nested
+    computations alike) as an HloInstruction, phase-resolved against
+    `phases` (default: the kernel-phase table). Innermost scope wins
+    when named scopes nest — op_name records the scope PATH, and the
+    rightmost koord/ component is the narrowest enclosing phase."""
+    table = frozenset(phases if phases is not None
+                      else obs_phases.KERNEL_PHASES)
+    out: List[HloInstruction] = []
+    for line in hlo_text.splitlines():
+        m = _INSTR_RE.match(line)
+        if m is None:
+            continue
+        name = m.group(1)
+        type_str = _result_type(line, m.end())
+        phase = UNATTRIBUTED
+        om = re.search(r'op_name="([^"]*)"', line)
+        op_name = om.group(1) if om else ""
+        if op_name:
+            hits = [p for p in PHASE_IN_OP_RE.findall(op_name)
+                    if p in table]
+            if hits:
+                phase = hits[-1]  # innermost (rightmost in the path)
+        out.append(HloInstruction(name=name,
+                                  output_bytes=_type_bytes(type_str),
+                                  phase=phase))
+    return out
+
+
+def instruction_phases(hlo_text: str,
+                       phases: Optional[Iterable[str]] = None
+                       ) -> Dict[str, str]:
+    """{hlo instruction name: phase} for every instruction whose
+    op_name metadata resolves to a phase — the map trace_fullgate joins
+    profiler events through (CPU captures carry only bare instruction
+    names). Unattributed instructions are deliberately absent: the
+    sampled view reports them as coverage gaps, never as phantom
+    phases."""
+    return {i.name: i.phase
+            for i in parse_instructions(hlo_text, phases)
+            if i.phase != UNATTRIBUTED}
+
+
+def phase_of_event(name: str, extra_haystacks: Iterable[str],
+                   instr2phase: Dict[str, str],
+                   phases: Optional[Iterable[str]] = None
+                   ) -> Optional[str]:
+    """Map one profiler event to a phase, or None. Exact
+    instruction-name join first (the CPU stream carries nothing else);
+    scope-substring match over name + string args second (TPU-style
+    captures embed the full path) — innermost (longest) phase wins
+    when scopes nest."""
+    hit = instr2phase.get(name)
+    if hit is not None:
+        return hit
+    table = phases if phases is not None else obs_phases.KERNEL_PHASES
+    hay = [name]
+    hay.extend(extra_haystacks)
+    best = None
+    for phase in table:
+        if any(phase in h for h in hay):
+            if best is None or len(phase) > len(best):
+                best = phase
+    return best
+
+
+def attribute_bytes(hlo_text: str,
+                    phases: Optional[Iterable[str]] = None
+                    ) -> Dict[str, Dict[str, int]]:
+    """{phase: {"instructions": n, "output_bytes": b}} over EVERY
+    parsed instruction, UNATTRIBUTED bucket included — so the per-phase
+    attribution sums to the totals over the same instruction set by
+    construction (the closure property tests/test_costmodel.py pins)."""
+    out: Dict[str, Dict[str, int]] = {}
+    for instr in parse_instructions(hlo_text, phases):
+        slot = out.setdefault(instr.phase,
+                              {"instructions": 0, "output_bytes": 0})
+        slot["instructions"] += 1
+        slot["output_bytes"] += instr.output_bytes
+    return out
+
+
+def coverage(attribution: Dict[str, Dict[str, int]]
+             ) -> Dict[str, float]:
+    """Attribution coverage of one program/capture: what fraction of
+    instructions (and of output bytes) resolved to a phase. A silent
+    gap in the mapped set shows up here as a dropped fraction instead
+    of vanishing — trace_fullgate's coverage floor reads this."""
+    instr_total = sum(v["instructions"] for v in attribution.values())
+    bytes_total = sum(v["output_bytes"] for v in attribution.values())
+    un = attribution.get(UNATTRIBUTED,
+                         {"instructions": 0, "output_bytes": 0})
+    mapped_i = instr_total - un["instructions"]
+    mapped_b = bytes_total - un["output_bytes"]
+    return {
+        "instructions_total": float(instr_total),
+        "instructions_mapped": float(mapped_i),
+        "instruction_coverage": (mapped_i / instr_total
+                                 if instr_total else 0.0),
+        "output_bytes_total": float(bytes_total),
+        "output_bytes_mapped": float(mapped_b),
+        "output_byte_coverage": (mapped_b / bytes_total
+                                 if bytes_total else 0.0),
+    }

@@ -1,0 +1,210 @@
+"""koordcost device-memory telemetry: where the HBM actually is, and a
+leak sentinel over committed cycles.
+
+`sample_devices()` answers "how many device bytes are in use right now,
+per device" from the best source the backend offers:
+
+  * `device.memory_stats()` — TPU/GPU allocator stats: bytes in use,
+    allocator peak, and the bytes limit (which gives real HBM
+    headroom);
+  * a live-buffer walk (`jax.live_arrays()` summed per device) when
+    the backend reports no allocator stats (CPU) — no peak or limit,
+    but the in-use series still feeds the leak sentinel.
+
+`MemWatch` is the service-side consumer: the scheduler samples at the
+dispatch/device_wait span boundaries (cheap: one stats call per
+device), and after each COMMITTED cycle feeds the freshest sample into
+a per-device window. The sentinel fires when in-use bytes grew
+strictly monotonically across the whole window AND the total growth
+clears a floor — a resident service re-dispatching the same programs
+over a bounded store should plateau, so N cycles of uninterrupted
+growth is the leak signature, while the floor keeps allocator jitter
+and small caches quiet. Firing clears the window (one event per
+sustained climb, not one per cycle).
+
+Strictly opt-in at the service (`memwatch=True|MemWatch(...)`); the
+disabled path adds zero work to the cycle.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional
+
+from koordinator_tpu.utils.sync import guarded_by
+
+__all__ = ["MemorySample", "sample_devices", "MemWatch"]
+
+
+@dataclass(frozen=True)
+class MemorySample:
+    """One device's memory reading: in-use bytes, the allocator's peak
+    and limit when the backend reports them (None on the live-buffer
+    fallback), and which source answered."""
+
+    device: str
+    bytes_in_use: int
+    peak_bytes: Optional[int]
+    limit_bytes: Optional[int]
+    source: str  # "memory_stats" | "live_buffers"
+
+
+def _device_label(d) -> str:
+    return f"{d.platform}:{d.id}"
+
+
+def sample_devices(devices=None) -> Dict[str, MemorySample]:
+    """Per-device memory readings, preferring allocator stats and
+    falling back to one shared live-array walk for every device whose
+    backend reports none."""
+    import jax
+
+    devs = list(jax.devices() if devices is None else devices)
+    out: Dict[str, MemorySample] = {}
+    fallback: List = []
+    for d in devs:
+        label = _device_label(d)
+        try:
+            stats = d.memory_stats()
+        except Exception:
+            stats = None
+        if stats and "bytes_in_use" in stats:
+            peak = stats.get("peak_bytes_in_use")
+            limit = stats.get("bytes_limit")
+            out[label] = MemorySample(
+                device=label,
+                bytes_in_use=int(stats["bytes_in_use"]),
+                peak_bytes=None if peak is None else int(peak),
+                limit_bytes=None if limit is None else int(limit),
+                source="memory_stats")
+        else:
+            fallback.append((d, label))
+    if fallback:
+        per = {label: 0 for _, label in fallback}
+        try:
+            arrays = jax.live_arrays()
+        except Exception:
+            arrays = []
+        for a in arrays:
+            try:
+                holders = list(a.devices())
+            except Exception:
+                continue
+            if not holders:
+                continue
+            # a replicated array charges each holding device its share
+            nbytes = int(getattr(a, "nbytes", 0)) // len(holders)
+            for d in holders:
+                label = _device_label(d)
+                if label in per:
+                    per[label] += nbytes
+        for _, label in fallback:
+            out[label] = MemorySample(
+                device=label, bytes_in_use=per[label], peak_bytes=None,
+                limit_bytes=None, source="live_buffers")
+    return out
+
+
+@guarded_by(
+    _last="_lock",
+    _peaks="_lock",
+    _history="_lock",
+    _leak_events="_lock",
+    # wiring, fixed before concurrent traffic
+    leak_window="publish-once",
+    min_growth_bytes="publish-once",
+    metrics="publish-once",
+    _sampler="publish-once",
+)
+class MemWatch:
+    """The per-service memory monitor: boundary samples, high-water
+    peaks, and the monotonic-growth leak sentinel. Thread-safe — the
+    scheduler samples under its commit lock while health() readers
+    snapshot from any thread."""
+
+    def __init__(self, leak_window: int = 8,
+                 min_growth_bytes: int = 1 << 20,
+                 metrics=None,
+                 sampler: Callable[[], Dict[str, MemorySample]]
+                 = sample_devices):
+        if leak_window < 2:
+            raise ValueError("leak_window must cover >= 2 cycles")
+        self.leak_window = int(leak_window)
+        self.min_growth_bytes = int(min_growth_bytes)
+        # a SchedulerMetrics catalog (or None): leak events and the
+        # in-use/peak gauges publish through it when attached
+        self.metrics = metrics
+        self._sampler = sampler
+        self._lock = threading.Lock()
+        self._last: Dict[str, MemorySample] = {}
+        self._peaks: Dict[str, int] = {}
+        self._history: Dict[str, deque] = {}
+        self._leak_events = 0
+
+    def sample(self) -> Dict[str, MemorySample]:
+        """Take one boundary sample (dispatch open / device_wait close)
+        and fold it into the high-water marks. Does NOT advance the
+        leak window — that is per committed cycle, not per boundary."""
+        samples = self._sampler()
+        with self._lock:
+            self._last = dict(samples)
+            for label, s in samples.items():
+                peak = s.bytes_in_use if s.peak_bytes is None \
+                    else max(s.peak_bytes, s.bytes_in_use)
+                if peak > self._peaks.get(label, 0):
+                    self._peaks[label] = peak
+        return samples
+
+    def observe_cycle(self) -> List[str]:
+        """Advance the leak window with the freshest boundary sample —
+        once per COMMITTED cycle. Returns the devices whose sentinel
+        fired, publishes gauges/counters when a catalog is attached."""
+        fired: List[str] = []
+        with self._lock:
+            for label, s in self._last.items():
+                hist = self._history.setdefault(
+                    label, deque(maxlen=self.leak_window))
+                hist.append(s.bytes_in_use)
+                if len(hist) == self.leak_window and \
+                        all(b > a for a, b in zip(hist, list(hist)[1:])) \
+                        and hist[-1] - hist[0] >= self.min_growth_bytes:
+                    self._leak_events += 1
+                    fired.append(label)
+                    hist.clear()  # one event per sustained climb
+            latest = dict(self._last)
+            peaks = dict(self._peaks)
+        if self.metrics is not None:
+            for label, s in latest.items():
+                self.metrics.hbm_bytes_in_use.labels(label).set(
+                    float(s.bytes_in_use))
+                self.metrics.hbm_bytes_peak.labels(label).set(
+                    float(peaks.get(label, s.bytes_in_use)))
+            for label in fired:
+                self.metrics.memwatch_leak_events.labels(label).inc()
+        return fired
+
+    def snapshot(self) -> dict:
+        """The health() view: per-device readings + peaks, total leak
+        events, and HBM headroom (min over devices reporting a limit;
+        None when no backend reports one — the CPU fallback)."""
+        with self._lock:
+            latest = dict(self._last)
+            peaks = dict(self._peaks)
+            leaks = self._leak_events
+        headrooms = [s.limit_bytes - s.bytes_in_use
+                     for s in latest.values()
+                     if s.limit_bytes is not None]
+        return {
+            "devices": {
+                label: {
+                    "bytes_in_use": s.bytes_in_use,
+                    "peak_bytes": peaks.get(label, s.bytes_in_use),
+                    "limit_bytes": s.limit_bytes,
+                    "source": s.source,
+                } for label, s in sorted(latest.items())},
+            "leak_events": leaks,
+            "leak_window": self.leak_window,
+            "headroom_bytes": min(headrooms) if headrooms else None,
+        }

@@ -1,0 +1,198 @@
+"""koordcost SLO plane: objectives, multi-window error-budget burn
+rate, and the health verdict — computed off the metric series the
+scheduler already records.
+
+An objective is a budgeted bad-event fraction:
+
+  * `cycle_latency_p99` — a committed cycle is BAD when its wall time
+    exceeds the latency target; the 1% default budget makes the
+    objective exactly "p99 cycle latency <= target". Events come from
+    the existing `scheduler_cycle_phase_seconds{phase="cycle"}`
+    histogram (falling back to `scheduler_schedule_cycle_seconds` on
+    an untraced service) via `Histogram.count_le` — so the SLO, the
+    trace, and the dashboards all read the same measurements, and the
+    target should sit on a bucket bound.
+  * `placement_success` — a pod-event is BAD when it lands
+    unschedulable; events come from `scheduler_pods_scheduled`.
+
+Burn rate follows the multi-window error-budget idiom (Koordinator's
+slo-controller turns metrics into SLO decisions the same way; SRE
+workbook otherwise): per window of N committed cycles, burn =
+(bad fraction over the window) / budget — 1.0 means burning exactly
+the budget, sustained >1 on the long window means the budget exhausts
+early, and the short window catches fast regressions the long window
+dilutes. The tracker keeps a ring of CUMULATIVE (total, bad) counter
+snapshots, one per committed cycle, so windowed deltas are two
+subtractions — no per-event storage.
+
+Strictly opt-in at the service (`slo=True|SloTracker(...)`); disabled
+adds zero work to the cycle.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from dataclasses import dataclass
+from typing import Dict, Optional, Sequence, Tuple
+
+from koordinator_tpu.obs import phases as obs_phases
+from koordinator_tpu.utils.sync import guarded_by
+
+__all__ = ["SloObjective", "DEFAULT_OBJECTIVES", "DEFAULT_WINDOWS",
+           "SloTracker"]
+
+
+@dataclass(frozen=True)
+class SloObjective:
+    """One budgeted objective: `budget` is the allowed bad-event
+    fraction; `threshold_s` is the latency target (latency kind only,
+    and it should sit on a PHASE_BUCKETS bound — `count_le` is
+    bucket-resolution)."""
+
+    name: str
+    kind: str  # "latency" | "placement"
+    budget: float
+    threshold_s: float = 0.0
+
+    def __post_init__(self):
+        if self.kind not in ("latency", "placement"):
+            raise ValueError(f"unknown SLO kind {self.kind!r}")
+        if not 0.0 < self.budget <= 1.0:
+            raise ValueError("budget is a fraction in (0, 1]")
+
+
+# generous defaults: a CPU CI service and the soak must sit deep inside
+# them, so a non-green health() always means something real moved
+DEFAULT_OBJECTIVES: Tuple[SloObjective, ...] = (
+    SloObjective(name="cycle_latency_p99", kind="latency",
+                 budget=0.01, threshold_s=30.0),
+    SloObjective(name="placement_success", kind="placement",
+                 budget=0.05),
+)
+
+# windows in COMMITTED CYCLES (not wall time — a paused service burns
+# no budget): short catches fast regressions, long sets the verdict
+DEFAULT_WINDOWS: Tuple[int, ...] = (8, 64)
+
+
+@guarded_by(
+    _rings="_lock",
+    # wiring, fixed before concurrent traffic
+    metrics="publish-once",
+    objectives="publish-once",
+    windows="publish-once",
+)
+class SloTracker:
+    """Rings of cumulative (total, bad) event counts per objective,
+    advanced once per committed cycle; burn rates and remaining budget
+    fall out as windowed deltas."""
+
+    def __init__(self, metrics,
+                 objectives: Sequence[SloObjective] = DEFAULT_OBJECTIVES,
+                 windows: Sequence[int] = DEFAULT_WINDOWS):
+        if not objectives:
+            raise ValueError("need at least one objective")
+        if not windows or any(w < 1 for w in windows):
+            raise ValueError("windows are positive cycle counts")
+        self.metrics = metrics
+        self.objectives = tuple(objectives)
+        self.windows = tuple(sorted(set(int(w) for w in windows)))
+        self._lock = threading.Lock()
+        # ring of cumulative snapshots; +1 so the longest window has a
+        # reference point one cycle before its start
+        self._rings: Dict[str, deque] = {
+            o.name: deque(maxlen=self.windows[-1] + 1)
+            for o in self.objectives}
+        # seed each ring with the counters AT ATTACH TIME: the first
+        # cycle's window delta must cover that cycle's events, and a
+        # tracker attached to a long-running service must not charge
+        # itself history it never watched
+        for o in self.objectives:
+            self._rings[o.name].append(self._cumulative(o))
+
+    def _cumulative(self, obj: SloObjective) -> Tuple[float, float]:
+        """(total events, bad events) since process start, off the live
+        metric families."""
+        m = self.metrics
+        if obj.kind == "latency":
+            h = m.cycle_phase_seconds
+            total = h.count(obs_phases.SPAN_CYCLE)
+            if total > 0:
+                good = h.count_le(obj.threshold_s, obs_phases.SPAN_CYCLE)
+            else:  # untraced service: no cycle spans, same measurement
+                h = m.cycle_seconds
+                total = h.count()
+                good = h.count_le(obj.threshold_s)
+            return total, total - good
+        placed = m.pods_scheduled.value("placed")
+        bad = m.pods_scheduled.value("unschedulable")
+        return placed + bad, bad
+
+    def observe_cycle(self) -> None:
+        """Append one cumulative snapshot per objective (call once per
+        committed cycle) and publish the burn/budget gauges."""
+        status = None
+        with self._lock:
+            for obj in self.objectives:
+                self._rings[obj.name].append(self._cumulative(obj))
+            status = self._status_locked()
+        if self.metrics is not None:
+            for name, s in status["objectives"].items():
+                for w, rate in s["burn_rate"].items():
+                    self.metrics.slo_burn_rate.labels(name, w).set(rate)
+                self.metrics.slo_budget_remaining.labels(name).set(
+                    s["budget_remaining"])
+
+    def _window_delta(self, ring, w: int) -> Tuple[float, float]:
+        """(total, bad) accrued over the last `w` cycles (or since
+        start, early on): current minus the reference snapshot."""
+        cur_t, cur_b = ring[-1]
+        ref_t, ref_b = ring[-(w + 1)] if len(ring) > w else ring[0]
+        return cur_t - ref_t, cur_b - ref_b
+
+    def _status_locked(self) -> dict:
+        objectives: Dict[str, dict] = {}
+        for obj in self.objectives:
+            ring = self._rings[obj.name]
+            if not ring:
+                objectives[obj.name] = {
+                    "kind": obj.kind, "budget": obj.budget, "ok": True,
+                    "burn_rate": {f"{w}c": 0.0 for w in self.windows},
+                    "budget_remaining": 1.0,
+                    "events_total": 0.0, "events_bad": 0.0,
+                }
+                continue
+            burn: Dict[str, float] = {}
+            for w in self.windows:
+                dt, db = self._window_delta(ring, w)
+                frac = db / dt if dt > 0 else 0.0
+                burn[f"{w}c"] = frac / obj.budget
+            # the verdict window is the longest: remaining budget is
+            # what its bad fraction leaves of the allowance
+            long_rate = burn[f"{self.windows[-1]}c"]
+            total, bad = ring[-1]
+            objectives[obj.name] = {
+                "kind": obj.kind,
+                "budget": obj.budget,
+                "ok": all(r <= 1.0 for r in burn.values()),
+                "burn_rate": burn,
+                "budget_remaining": max(0.0, 1.0 - long_rate),
+                "events_total": total,
+                "events_bad": bad,
+            }
+        return {
+            "ok": all(s["ok"] for s in objectives.values()),
+            "budget_remaining": min(
+                (s["budget_remaining"] for s in objectives.values()),
+                default=1.0),
+            "windows": [f"{w}c" for w in self.windows],
+            "objectives": objectives,
+        }
+
+    def status(self) -> dict:
+        """The health() view: per-objective burn rates over every
+        window, remaining budget on the verdict window, and the
+        aggregate ok bit."""
+        with self._lock:
+            return self._status_locked()

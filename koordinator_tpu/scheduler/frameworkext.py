@@ -45,6 +45,8 @@ from koordinator_tpu.utils.httpserver import (
 
 from koordinator_tpu.metrics import kernel_timer
 from koordinator_tpu.obs import phases as obs_phases
+from koordinator_tpu.obs.memwatch import MemWatch
+from koordinator_tpu.obs.slo import SloTracker
 from koordinator_tpu.obs.trace import NOOP_SPAN, Tracer
 from koordinator_tpu.scheduler import core, guards
 from koordinator_tpu.scheduler.errorhandler import (
@@ -589,6 +591,8 @@ class ServicesServer:
     journal="publish-once",
     compile_cache="publish-once",
     tracer="publish-once",
+    memwatch="publish-once",
+    slo="publish-once",
     _cycle_ids="publish-once",
     device_health="publish-once",
     _explicit_amp="publish-once",
@@ -683,6 +687,21 @@ class SchedulerService:
                         .labels(name).observe(dur))
             if self.tracer.on_drop is None:
                 self.tracer.on_drop = self.metrics.trace_spans_dropped.inc
+        # koordcost runtime plane (docs/OBSERVABILITY.md): both knobs
+        # STRICTLY OPT-IN, exactly like the tracer — None (the default)
+        # adds zero work to the cycle path. memwatch samples device
+        # memory at the dispatch/device_wait span boundaries and runs
+        # the leak sentinel per committed cycle; slo turns the cycle
+        # and placement series into error-budget burn. Both surface
+        # through health().
+        memwatch = schedule_kwargs.pop("memwatch", None)
+        if memwatch is True:
+            memwatch = MemWatch(metrics=self.metrics)
+        self.memwatch: Optional[MemWatch] = memwatch or None
+        slo = schedule_kwargs.pop("slo", None)
+        if slo is True:
+            slo = SloTracker(self.metrics)
+        self.slo: Optional[SloTracker] = slo or None
         # trace cycle ids: a process-monotonic sequence assigned per
         # schedule() call (itertools.count: one atomic bump per cycle)
         self._cycle_ids = itertools.count()
@@ -1193,6 +1212,9 @@ class SchedulerService:
                     adm["base_version"] = self.store.version
                     if self.journal is not None:
                         adm["epoch"] = self.epoch
+            if self.memwatch is not None:
+                # boundary sample 1: residency as the dispatch opens
+                self.memwatch.sample()
             with kernel_timer(self.metrics.kernel_seconds,
                               obs_phases.PHASE_SCHEDULE_BATCH):
                 with self._span(obs_phases.SPAN_DISPATCH) as dsp:
@@ -1216,6 +1238,11 @@ class SchedulerService:
                 # (and makes the kernel timer measure device time)
                 with self._span(obs_phases.SPAN_DEVICE_WAIT):
                     assignment = np.asarray(result.assignment)
+                if self.memwatch is not None:
+                    # boundary sample 2: residency after the program
+                    # completed — the sample the leak sentinel advances
+                    # on at commit
+                    self.memwatch.sample()
             # the guards' ONE packed readback ([word, bad nodes, bad
             # pods]); the full masks stay on device unless the word is
             # non-zero (cold path)
@@ -1458,6 +1485,12 @@ class SchedulerService:
         self.metrics.pods_scheduled.labels("unschedulable").inc(
             int(unsched.sum()))
         self.metrics.snapshot_version.set(float(self.store.version))
+        # koordcost: the cycle committed and its counters/histograms
+        # are final — advance the leak sentinel and the SLO rings
+        if self.memwatch is not None:
+            self.memwatch.observe_cycle()
+        if self.slo is not None:
+            self.slo.observe_cycle()
         gang_failed = np.asarray(result.gang_failed)
         self.last_gang_failed = gang_failed
         if gang_failed.any() and self.on_gang_failed is not None:
@@ -1655,4 +1688,40 @@ class SchedulerService:
             "meshSize": self._last_mesh_size,  # koordlint: disable=GB001
             "epoch": self.epoch,  # koordlint: disable=GB001
             "journaled": self.journal is not None,
+        }
+
+    def health(self) -> dict:
+        """The koordcost health snapshot: the degradation rung, SLO
+        status (burn rates, remaining budget) when an SloTracker is
+        attached, device-memory telemetry + HBM headroom when a
+        MemWatch is attached, and the journal's replay lag. `ok` is
+        the one-bit verdict: every SLO objective inside budget AND the
+        leak sentinel silent — a service built without either plane is
+        vacuously ok (this method stays cheap and lock-free either
+        way, like summary())."""
+        slo_status = self.slo.status() if self.slo is not None else None
+        mem = self.memwatch.snapshot() \
+            if self.memwatch is not None else None
+        ok = True
+        budget_remaining = None
+        if slo_status is not None:
+            ok = slo_status["ok"]
+            budget_remaining = slo_status["budget_remaining"]
+        leak_events = 0 if mem is None else mem["leak_events"]
+        return {
+            "ok": bool(ok and leak_events == 0),
+            "rung": DegradationLadder.LEVELS[self.ladder.level],
+            "slo": slo_status,
+            "budgetRemaining": budget_remaining,
+            "memory": mem,
+            "hbmHeadroomBytes":
+                None if mem is None else mem["headroom_bytes"],
+            "leakEvents": leak_events,
+            # epochs still resident in the journal = how much a crash
+            # right now would have to replay (pruned at checkpoints)
+            "journalLagEpochs":
+                len(self.journal.epochs())
+                if self.journal is not None else 0,
+            "lastCycleSeconds": round(self.last_elapsed, 4),
+            "snapshotVersion": self.store.version,
         }

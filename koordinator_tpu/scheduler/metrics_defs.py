@@ -16,14 +16,18 @@ from koordinator_tpu.metrics import Registry, global_registry
 from koordinator_tpu.metrics.registry import (  # noqa: F401  (re-export)
     SCHEDULER_COMPILE_CACHE_HITS,
     SCHEDULER_COMPILE_CACHE_MISSES,
+    SCHEDULER_COST_DRIFT_CHECKS,
     SCHEDULER_CYCLE_PHASE_SECONDS,
     SCHEDULER_DEGRADATION_LEVEL,
     SCHEDULER_DEGRADED_CYCLES,
     SCHEDULER_DELTA_REJECTED,
     SCHEDULER_FAILURES_CLASSIFIED,
     SCHEDULER_GUARD_TRIPS,
+    SCHEDULER_HBM_BYTES_IN_USE,
+    SCHEDULER_HBM_BYTES_PEAK,
     SCHEDULER_JOURNAL_APPENDS,
     SCHEDULER_JOURNAL_BYTES,
+    SCHEDULER_MEMWATCH_LEAK_EVENTS,
     SCHEDULER_MESH_SHRINK_EVENTS,
     SCHEDULER_MESH_SIZE,
     SCHEDULER_PODS_SCHEDULED,
@@ -36,6 +40,8 @@ from koordinator_tpu.metrics.registry import (  # noqa: F401  (re-export)
     SCHEDULER_SCHEDULE_BATCH_KERNEL_SECONDS,
     SCHEDULER_SCHEDULE_CYCLE_SECONDS,
     SCHEDULER_SCHEDULING_TIMEOUT,
+    SCHEDULER_SLO_BUDGET_REMAINING,
+    SCHEDULER_SLO_BURN_RATE,
     SCHEDULER_SNAPSHOT_VERSION,
     SCHEDULER_TRACE_SPANS_DROPPED,
 )
@@ -175,3 +181,39 @@ class SchedulerMetrics:
             "cycle, by phase (obs/phases.py names: admit, dispatch, "
             "device_wait, journal_append, publish, ...)",
             labels=("phase",), buckets=PHASE_BUCKETS)
+        # koordcost resource/SLO plane (docs/OBSERVABILITY.md
+        # "SLO objectives & error budgets"): per-objective burn-rate
+        # windows and remaining budget (obs/slo.py), device-memory
+        # telemetry sampled at the dispatch/device_wait span boundaries
+        # with its leak sentinel (obs/memwatch.py), and the static
+        # cost-drift gate's verdict ledger (tools/costcheck.py)
+        self.slo_budget_remaining = r.gauge(
+            SCHEDULER_SLO_BUDGET_REMAINING,
+            "Fraction of the error budget left per SLO objective over "
+            "its longest window (1 = untouched, 0 = exhausted)",
+            labels=("objective",))
+        self.slo_burn_rate = r.gauge(
+            SCHEDULER_SLO_BURN_RATE,
+            "Error-budget burn rate per objective and window (1 = "
+            "burning exactly the budget; >1 exhausts it early)",
+            labels=("objective", "window"))
+        self.hbm_bytes_in_use = r.gauge(
+            SCHEDULER_HBM_BYTES_IN_USE,
+            "Device memory in use at the last memwatch sample "
+            "(device.memory_stats() on TPU; live-buffer walk on "
+            "backends without allocator stats)", labels=("device",))
+        self.hbm_bytes_peak = r.gauge(
+            SCHEDULER_HBM_BYTES_PEAK,
+            "Peak device memory seen by memwatch since service start "
+            "(allocator peak when the backend reports one, else the "
+            "high-water mark of the sampled in-use series)",
+            labels=("device",))
+        self.memwatch_leak_events = r.counter(
+            SCHEDULER_MEMWATCH_LEAK_EVENTS,
+            "Leak-sentinel firings: device memory in use grew "
+            "monotonically across the full sentinel window of "
+            "committed cycles", labels=("device",))
+        self.cost_drift_checks = r.counter(
+            SCHEDULER_COST_DRIFT_CHECKS,
+            "Static cost-baseline comparisons by verdict "
+            "(tools/costcheck.py gate runs)", labels=("result",))

@@ -133,3 +133,59 @@ def test_ladder_out_of_device_rungs_propagates(bench_mod, monkeypatch):
     monkeypatch.setattr(bench_mod.jax, "devices", lambda: [0])
     with pytest.raises(RuntimeError, match="device lost"):
         bench_mod.run_with_ladder(max_halvings=3, _run=fake_run)
+
+
+# --- BENCH_COST=1: the flagship cost stamp (koordcost satellite) -----------
+
+class _FakeMemStats:
+    argument_size_in_bytes = 1000
+    output_size_in_bytes = 400
+    temp_size_in_bytes = 300
+    alias_size_in_bytes = 250
+    generated_code_size_in_bytes = 0
+
+
+class _FakeCompiled:
+    """A device-free stand-in for jax's Compiled: the three methods
+    costmodel.program_report reads, with known arithmetic."""
+
+    def cost_analysis(self):
+        # jax returns a LIST of per-computation dicts on CPU; the
+        # stamp must read the first, and 'bytes accessed' has a space
+        return [{"flops": 5000.0, "bytes accessed": 2000.0}]
+
+    def memory_analysis(self):
+        return _FakeMemStats()
+
+    def as_text(self):
+        return ('  %p.1 = f32[8]{0} parameter(0)\n'
+                '  ROOT %add.2 = f32[8]{0} add(%p.1, %p.1), '
+                'metadata={op_name="jit/koord/stage1_mask/add"}\n')
+
+
+def test_flagship_stamp_keys_and_arithmetic():
+    """The BENCH_COST stamp pins exactly the four bench-line keys, with
+    hbm_peak_bytes = arg + out + tmp - alias (donation visible) and
+    flops_per_pod = flops / P."""
+    from koordinator_tpu.obs import costmodel
+
+    stamp = costmodel.flagship_stamp(_FakeCompiled(), num_pods=100)
+    assert set(stamp) == {"flops", "bytes_accessed", "hbm_peak_bytes",
+                          "flops_per_pod"}
+    assert stamp["flops"] == 5000.0
+    assert stamp["bytes_accessed"] == 2000.0
+    assert stamp["hbm_peak_bytes"] == 1000 + 400 + 300 - 250
+    assert stamp["flops_per_pod"] == 50.0
+
+
+def test_bench_cost_stamp_is_opt_in_and_spliced(bench_mod):
+    """BENCH_COST is read at run time (one env read) and the stamp is
+    spliced into the emitted line — absent entirely when off, so old
+    trajectories and benchdiff joins see no phantom keys."""
+    import inspect
+    src = inspect.getsource(bench_mod)
+    reads = [l for l in src.splitlines()
+             if "BENCH_COST" in l and "environ" in l]
+    assert len(reads) == 1, reads
+    assert "**cost_stamp," in src
+    assert "flagship_stamp" in src

@@ -1,0 +1,248 @@
+"""koordcost runtime plane: SLO burn-rate windows, the memwatch leak
+sentinel, and the service health() snapshot.
+
+The SLO tracker is driven through REAL metric families (a private
+Registry per test) — the point of the design is that burn rates are
+derived from the same histograms the dashboards read, so the tests
+feed those histograms, never a private API.
+"""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from koordinator_tpu.metrics import Registry
+from koordinator_tpu.obs import phases as obs_phases
+from koordinator_tpu.obs.memwatch import MemorySample, MemWatch, \
+    sample_devices
+from koordinator_tpu.obs.slo import DEFAULT_OBJECTIVES, SloObjective, \
+    SloTracker
+from koordinator_tpu.scheduler.metrics_defs import SchedulerMetrics
+from koordinator_tpu.utils import synthetic
+
+
+def _metrics():
+    return SchedulerMetrics(Registry())
+
+
+# --- SloTracker ---------------------------------------------------------
+
+LATENCY = SloObjective(name="cycle_latency_p99", kind="latency",
+                       budget=0.25, threshold_s=1.0)  # a bucket bound
+PLACEMENT = SloObjective(name="placement_success", kind="placement",
+                         budget=0.10)
+
+
+def test_objective_validation():
+    with pytest.raises(ValueError):
+        SloObjective(name="x", kind="weather", budget=0.1)
+    with pytest.raises(ValueError):
+        SloObjective(name="x", kind="latency", budget=0.0)
+    with pytest.raises(ValueError):
+        SloTracker(_metrics(), objectives=())
+    with pytest.raises(ValueError):
+        SloTracker(_metrics(), windows=(0,))
+
+
+def test_latency_burn_rate_over_windows():
+    m = _metrics()
+    t = SloTracker(m, objectives=(LATENCY,), windows=(4, 8))
+
+    def cycle(seconds):
+        m.cycle_phase_seconds.labels(obs_phases.SPAN_CYCLE).observe(
+            seconds)
+        t.observe_cycle()
+
+    for _ in range(4):
+        cycle(0.01)
+    status = t.status()
+    obj = status["objectives"]["cycle_latency_p99"]
+    assert status["ok"] and obj["burn_rate"] == {"4c": 0.0, "8c": 0.0}
+    assert obj["budget_remaining"] == 1.0
+
+    # four straight slow cycles: the short window saturates (4 bad / 4
+    # total = 1.0 bad fraction, /0.25 budget = burn 4.0) while the long
+    # window dilutes to half that — the multi-window idiom
+    for _ in range(4):
+        cycle(2.5)
+    status = t.status()
+    obj = status["objectives"]["cycle_latency_p99"]
+    assert not status["ok"]
+    assert obj["burn_rate"]["4c"] == pytest.approx(4.0)
+    assert obj["burn_rate"]["8c"] == pytest.approx(2.0)
+    # verdict window burned 2x budget: nothing left
+    assert obj["budget_remaining"] == 0.0
+    assert status["budget_remaining"] == 0.0
+    # gauges published through the same catalog
+    assert m.slo_burn_rate.value("cycle_latency_p99", "4c") \
+        == pytest.approx(4.0)
+    assert m.slo_budget_remaining.value("cycle_latency_p99") == 0.0
+
+
+def test_latency_falls_back_to_untraced_cycle_histogram():
+    m = _metrics()
+    t = SloTracker(m, objectives=(LATENCY,), windows=(4,))
+    # an untraced service records no cycle spans — the plain cycle
+    # histogram is the same measurement and must feed the objective
+    m.cycle_seconds.observe(0.02)
+    t.observe_cycle()
+    obj = t.status()["objectives"]["cycle_latency_p99"]
+    assert obj["events_total"] == 1.0 and obj["events_bad"] == 0.0
+
+
+def test_placement_burn_rate():
+    m = _metrics()
+    t = SloTracker(m, objectives=(PLACEMENT,), windows=(4,))
+    m.pods_scheduled.labels("placed").inc(95)
+    m.pods_scheduled.labels("unschedulable").inc(5)
+    t.observe_cycle()
+    obj = t.status()["objectives"]["placement_success"]
+    # 5% unschedulable against a 10% budget: half the budget burning
+    assert obj["burn_rate"]["4c"] == pytest.approx(0.5)
+    assert obj["ok"] and obj["events_bad"] == 5.0
+
+
+def test_status_schema_and_defaults():
+    t = SloTracker(_metrics())
+    status = t.status()  # before any cycle: vacuously green
+    assert status["ok"] and status["budget_remaining"] == 1.0
+    assert status["windows"] == ["8c", "64c"]
+    assert set(status["objectives"]) == {o.name
+                                         for o in DEFAULT_OBJECTIVES}
+    for obj in status["objectives"].values():
+        assert set(obj) == {"kind", "budget", "ok", "burn_rate",
+                            "budget_remaining", "events_total",
+                            "events_bad"}
+
+
+# --- MemWatch -----------------------------------------------------------
+
+def _fake_sampler(series):
+    """A sampler yielding the next bytes_in_use from `series` each
+    call (sticking at the last value)."""
+    it = iter(series)
+    state = {"cur": series[0]}
+
+    def sampler():
+        try:
+            state["cur"] = next(it)
+        except StopIteration:
+            pass
+        return {"tpu:0": MemorySample(
+            device="tpu:0", bytes_in_use=state["cur"],
+            peak_bytes=state["cur"], limit_bytes=1 << 30,
+            source="memory_stats")}
+
+    return sampler
+
+
+def test_leak_sentinel_fires_on_sustained_growth():
+    mb = 1 << 20
+    grow = [i * 2 * mb for i in range(1, 9)]
+    m = _metrics()
+    w = MemWatch(leak_window=4, metrics=m, sampler=_fake_sampler(grow))
+    fired = []
+    for _ in range(8):
+        w.sample()
+        fired.extend(w.observe_cycle())
+    # fires once per sustained climb (window clears after firing), not
+    # once per growing cycle
+    assert fired == ["tpu:0", "tpu:0"]
+    assert w.snapshot()["leak_events"] == 2
+    assert m.memwatch_leak_events.value("tpu:0") == 2.0
+    # gauges track the freshest sample and the high-water mark
+    assert m.hbm_bytes_in_use.value("tpu:0") == float(grow[-1])
+    assert m.hbm_bytes_peak.value("tpu:0") == float(grow[-1])
+
+
+def test_leak_sentinel_quiet_on_plateau_and_jitter():
+    mb = 1 << 20
+    # plateau: growth not strictly monotonic across the window
+    flat = [100 * mb, 102 * mb, 102 * mb, 104 * mb, 103 * mb, 105 * mb]
+    w = MemWatch(leak_window=3, sampler=_fake_sampler(flat))
+    for _ in range(len(flat)):
+        w.sample()
+        assert w.observe_cycle() == []
+    # monotonic but under the growth floor: allocator jitter, not a leak
+    tiny = [100 * mb + i * 1024 for i in range(8)]
+    w = MemWatch(leak_window=3, sampler=_fake_sampler(tiny))
+    for _ in range(len(tiny)):
+        w.sample()
+        assert w.observe_cycle() == []
+    assert w.snapshot()["leak_events"] == 0
+
+
+def test_snapshot_headroom_and_window_validation():
+    w = MemWatch(leak_window=2,
+                 sampler=_fake_sampler([5 << 20]))
+    w.sample()
+    snap = w.snapshot()
+    assert snap["headroom_bytes"] == (1 << 30) - (5 << 20)
+    assert snap["devices"]["tpu:0"]["source"] == "memory_stats"
+    with pytest.raises(ValueError):
+        MemWatch(leak_window=1)
+
+
+def test_sample_devices_cpu_fallback_counts_live_buffers():
+    keep = jax.device_put(jnp.zeros((1024,), jnp.float32))
+    try:
+        samples = sample_devices()
+        assert samples  # one per visible device (8-device CPU mesh)
+        holder = f"{keep.devices().pop().platform}:" \
+                 f"{keep.devices().pop().id}"
+        s = samples[holder]
+        # CPU reports no allocator stats: the live-buffer walk answers,
+        # with no peak/limit (and therefore no headroom claim)
+        assert s.source == "live_buffers"
+        assert s.bytes_in_use >= keep.nbytes
+        assert s.limit_bytes is None
+    finally:
+        del keep
+
+
+# --- SchedulerService.health() ------------------------------------------
+
+def _service(**kw):
+    from koordinator_tpu.scheduler.frameworkext import SchedulerService
+
+    svc = SchedulerService(metrics=_metrics(), num_rounds=1,
+                           k_choices=4, **kw)
+    svc._sleep = lambda _s: None
+    snap = synthetic.synthetic_cluster(16, num_quotas=4)
+    pods = synthetic.synthetic_pods(16, num_quotas=4)
+    return svc, snap, pods
+
+
+@pytest.mark.slow
+def test_health_reports_slo_and_memory_on_a_traced_service():
+    """Marked slow: tools/soak_service.py asserts the same green
+    health() across a full soak as its own ci.sh stage."""
+    svc, snap, pods = _service(trace=True, memwatch=True, slo=True)
+    svc.publish(snap)
+    for _ in range(2):
+        svc.schedule(pods)
+    health = svc.health()
+    assert health["ok"] is True
+    assert health["rung"] == "normal"
+    assert health["slo"]["objectives"]["cycle_latency_p99"][
+        "events_total"] == 2.0
+    assert health["budgetRemaining"] == 1.0
+    assert health["leakEvents"] == 0
+    # CPU fallback: live-buffer telemetry present, no headroom claim
+    assert health["memory"]["devices"]
+    assert health["hbmHeadroomBytes"] is None
+    assert health["snapshotVersion"] == svc.store.version
+    assert health["lastCycleSeconds"] >= 0.0
+
+
+@pytest.mark.slow
+def test_health_disabled_is_vacuously_green_and_free():
+    svc, snap, pods = _service()
+    assert svc.memwatch is None and svc.slo is None
+    svc.publish(snap)
+    svc.schedule(pods)
+    health = svc.health()
+    assert health["ok"] is True
+    assert health["slo"] is None and health["memory"] is None
+    assert health["budgetRemaining"] is None
+    assert health["hbmHeadroomBytes"] is None

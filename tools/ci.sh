@@ -97,6 +97,30 @@ echo "=== koordtrace smoke (observability contract, CPU) ==="
 # span attrs join to the commit journal (tools/trace_smoke.py)
 JAX_PLATFORMS=cpu python tools/trace_smoke.py
 
+echo "=== koordcost drift gate (static cost/memory baseline, CPU) ==="
+# every contracted kernel + the flagship cascade forms lowered and
+# priced (flops, bytes accessed, donation-aware static peak, per-phase
+# attribution, packed-representation bytes) and compared against
+# perf/COST_BASELINE.json with loud provenance — any move beyond
+# tolerance without a restamp fails with COST DRIFT (tools/costcheck.py)
+JAX_PLATFORMS=cpu python tools/costcheck.py
+
+echo "=== koordcost mutation smoke (gate liveness + complementarity) ==="
+# a seeded bf16->f32 upcast in the packable path in a TEMP COPY: the
+# cost gate must FAIL on the bytes drift while koordlint and shapecheck
+# — hygiene and shapes, not bytes — must PASS the mutated tree
+JAX_PLATFORMS=cpu python tools/costcheck.py --self-test-mutation
+
+echo "=== benchdiff gate (proxy-shape bench vs checked-in baseline) ==="
+# the comparator's own discrimination proof (seeded noise neutral,
+# planted regressions flagged), then the pinned proxy shape runs fresh
+# and joins against perf/BENCH_BASELINE.json: wall-clock fields loose
+# (live-migrating CI hosts), deterministic counts and BENCH_COST stamps
+# exact — a regression prints BENCH REGRESSION and fails
+python tools/benchdiff.py --self-test
+JAX_PLATFORMS=cpu python tools/benchdiff.py --proxy-run /tmp/_bench_proxy.jsonl
+JAX_PLATFORMS=cpu python tools/benchdiff.py perf/BENCH_BASELINE.json /tmp/_bench_proxy.jsonl
+
 echo "=== warm-cache smoke (compile-cache warm-start gate, CPU) ==="
 # the flagship cycle runs in three REAL child processes against ONE
 # compile-cache dir: cold (compiles, populates manifest), warm (ZERO

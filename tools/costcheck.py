@@ -1,0 +1,284 @@
+"""koordcost drift gate: static cost/memory accounting vs a checked-in
+baseline.
+
+`obs/costmodel.py` prices every program the scheduler can dispatch —
+all contracted kernels, the flagship cycle per cascade form, the
+donated tail, and the packed-snapshot byte contract — entirely from
+AOT lowering, no device run. This tool freezes that model into
+`perf/COST_BASELINE.json` (``--stamp``) and fails CI when any number
+moves beyond tolerance without a restamp:
+
+  * flops / bytes-accessed growth (a pad explosion, a rank growth, an
+    accidental broadcast);
+  * peak-memory growth (argument+output+temp-alias);
+  * alias collapse (a lost `donate_argnums` shows up as alias_bytes
+    dropping to zero — flagged by name, not just by percentage);
+  * packed-representation growth (a bf16->f32 upcast in
+    snapshot/packing.py doubles `packed_bytes` here long before it
+    doubles checkpoint volume on hardware).
+
+The baseline is a loud-provenance manifest in the compilecache style:
+it records the contract fingerprint, jax version, backend, and working
+set it was stamped at, and the gate REFUSES to compare across a
+provenance mismatch — a contract edit or jax upgrade demands an
+explicit restamp in the same change, so the diff shows the new numbers.
+
+Every drift finding carries the ``COST DRIFT`` marker
+(`tools/seedmut.py` smokes key on it).
+
+Usage:
+  JAX_PLATFORMS=cpu python tools/costcheck.py              # gate
+  JAX_PLATFORMS=cpu python tools/costcheck.py --stamp      # rewrite baseline
+  JAX_PLATFORMS=cpu python tools/costcheck.py --only packing/   # label-prefix subset
+  JAX_PLATFORMS=cpu python tools/costcheck.py --self-test-mutation
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Any, Dict, List, Optional, Tuple
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO_ROOT not in sys.path:
+    sys.path.insert(0, REPO_ROOT)
+
+BASELINE_VERSION = 1
+BASELINE_PATH = os.path.join("perf", "COST_BASELINE.json")
+MARKER = "COST DRIFT"
+
+# relative tolerance per compared field; 0.0 means exact. The model is
+# deterministic for fixed (fingerprint, jax, backend) — the slack on
+# the float fields absorbs only cost-analysis rounding, not real drift.
+TOLERANCES: Dict[str, float] = {
+    "flops": 0.01,
+    "bytes_accessed": 0.01,
+    "argument_bytes": 0.0,
+    "output_bytes": 0.0,
+    "temp_bytes": 0.01,
+    "alias_bytes": 0.0,
+    "peak_bytes": 0.01,
+    "hlo_instructions": 0.02,
+    "hlo_output_bytes": 0.02,
+    "packed_bytes": 0.0,
+    "unpacked_bytes": 0.0,
+    "saved_bytes": 0.0,
+}
+
+
+def baseline_path(root: str = REPO_ROOT) -> str:
+    return os.path.join(root, BASELINE_PATH)
+
+
+def _provenance() -> Dict[str, Any]:
+    import jax
+
+    from koordinator_tpu.compilecache import keys
+
+    return {
+        "fingerprint": keys.contract_fingerprint(),
+        "jax_version": jax.__version__,
+        "backend": jax.default_backend(),
+    }
+
+
+def load_baseline(path: str) -> Optional[Dict[str, Any]]:
+    if not os.path.exists(path):
+        return None
+    with open(path, encoding="utf-8") as f:
+        manifest = json.load(f)
+    if manifest.get("version") != BASELINE_VERSION:
+        return None
+    return manifest
+
+
+def save_baseline(path: str, manifest: Dict[str, Any]) -> None:
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w", encoding="utf-8") as f:
+        json.dump(manifest, f, indent=1, sort_keys=True)
+        f.write("\n")
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+def compare_entry(label: str, old: Dict[str, Any], new: Dict[str, Any]
+                  ) -> List[str]:
+    """Drift findings for one program: every compared field beyond its
+    tolerance, with the lost-donation case called out by name."""
+    findings = []
+    for field, tol in TOLERANCES.items():
+        if field not in old and field not in new:
+            continue
+        ov = float(old.get(field, 0.0))
+        nv = float(new.get(field, 0.0))
+        rel = abs(nv - ov) / max(abs(ov), 1.0)
+        if rel <= tol:
+            continue
+        extra = ""
+        if field == "alias_bytes" and ov > 0 and nv == 0:
+            extra = " (donation aliasing LOST)"
+        findings.append(
+            f"{MARKER}: {label} {field} {ov:.0f} -> {nv:.0f} "
+            f"({rel:+.1%} vs tol {tol:.1%}){extra}")
+    return findings
+
+
+def compare(baseline: Dict[str, Any], entries: Dict[str, Dict[str, Any]],
+            only: Optional[str] = None) -> List[str]:
+    old_entries = baseline["entries"]
+    if only:
+        old_entries = {k: v for k, v in old_entries.items()
+                       if k.startswith(only)}
+    findings: List[str] = []
+    for label in sorted(set(old_entries) | set(entries)):
+        if label not in entries:
+            findings.append(f"{MARKER}: {label} vanished from the cost "
+                            f"model (baseline still stamps it)")
+        elif label not in old_entries:
+            findings.append(f"{MARKER}: {label} is new and unstamped "
+                            f"(run --stamp to baseline it)")
+        else:
+            findings.extend(
+                compare_entry(label, old_entries[label], entries[label]))
+    return findings
+
+
+def run_gate(stamp: bool, only: Optional[str],
+             root: str = REPO_ROOT) -> int:
+    from koordinator_tpu.obs import costmodel
+
+    prov = _provenance()
+    path = baseline_path(root)
+    baseline = load_baseline(path)
+
+    if stamp:
+        entries = costmodel.collect(log_fn=print)
+        manifest = {
+            "version": BASELINE_VERSION,
+            "sizes": dict(costmodel.COST_SIZES),
+            **prov,
+            "entries": entries,
+        }
+        save_baseline(path, manifest)
+        print(f"costcheck: stamped {len(entries)} programs -> "
+              f"{os.path.relpath(path, root)} "
+              f"(fingerprint {prov['fingerprint'][:12]}, "
+              f"jax {prov['jax_version']}, {prov['backend']})")
+        return 0
+
+    if baseline is None:
+        print(f"{MARKER}: no readable baseline at "
+              f"{os.path.relpath(path, root)} — run --stamp first")
+        return 1
+    # loud provenance: never compare numbers whose meaning changed
+    stale = [k for k in ("fingerprint", "jax_version", "backend")
+             if baseline.get(k) != prov[k]]
+    if stale:
+        for k in stale:
+            print(f"{MARKER}: baseline {k} {baseline.get(k)!r} != "
+                  f"current {prov[k]!r}")
+        print(f"{MARKER}: provenance mismatch — restamp the baseline "
+              f"in the same change that moved it")
+        return 1
+
+    sizes = dict(baseline.get("sizes", costmodel.COST_SIZES))
+    if only and only.startswith("packing/"):
+        entries: Dict[str, Dict[str, Any]] = {
+            k: dict(v, kind="packing")
+            for k, v in costmodel.packing_report(sizes).items()}
+    else:
+        entries = costmodel.collect(sizes=sizes)
+        if only:
+            entries = {k: v for k, v in entries.items()
+                       if k.startswith(only)}
+
+    findings = compare(baseline, entries, only=only)
+    _count_drift_check(bool(findings))
+    for line in findings:
+        print(line)
+    scope = f" (only {only})" if only else ""
+    if findings:
+        print(f"costcheck: {len(findings)} drift finding(s) across "
+              f"{len(entries)} program(s){scope} — restamp if "
+              f"intentional")
+        return 1
+    print(f"costcheck: {len(entries)} program(s){scope} within "
+          f"tolerance of {os.path.relpath(path, root)}")
+    return 0
+
+
+def _count_drift_check(drifted: bool) -> None:
+    """Feed scheduler_cost_drift_checks{result=...} so any embedding
+    process (soak harness, resident service running periodic checks)
+    exposes gate outcomes alongside its other scheduler metrics."""
+    try:
+        from koordinator_tpu.metrics import Registry
+        from koordinator_tpu.scheduler.metrics_defs import SchedulerMetrics
+        m = SchedulerMetrics(Registry())
+        m.cost_drift_checks.labels(
+            "drift" if drifted else "clean").inc()
+    except Exception:
+        pass  # the gate's verdict never depends on the metrics plane
+
+
+# The planted defect for the self-test: upcast the packable columns to
+# f32 inside snapshot/packing.py. No shape contract covers packing's
+# internal dtype (packable columns are unpacked back to their declared
+# dtypes), so koordshape and koordlint are blind to it BY DESIGN — only
+# the byte contract (packing/* packed_bytes) moves, and it moves ~44%.
+PACKING_MUTATION_ANCHOR = "return jnp.bfloat16"
+PACKING_MUTATION_REPLACEMENT = "return jnp.float32"
+
+
+def self_test_mutation() -> int:
+    from tools import seedmut
+
+    mutation = seedmut.Mutation(
+        relpath=os.path.join("koordinator_tpu", "snapshot", "packing.py"),
+        anchor=PACKING_MUTATION_ANCHOR,
+        replacement=PACKING_MUTATION_REPLACEMENT,
+        note="bf16->f32 upcast in the packable path",
+    )
+    py = sys.executable
+    rc = seedmut.check_gate_catches(
+        mutation, [py, os.path.join("tools", "costcheck.py"),
+                   "--only", "packing/"],
+        marker=MARKER, label="costcheck")
+    if rc:
+        return rc
+    # complementarity: the same defect must be INVISIBLE to the static
+    # tiers — koordlint reads source only, koordshape checks declared
+    # shapes/dtypes at contract boundaries, and packing's upcast
+    # changes neither
+    rc = seedmut.check_gate_passes(
+        mutation, [py, "-m", "tools.lint", "--root", "{tree}"],
+        label="koordlint")
+    if rc:
+        return rc
+    return seedmut.check_gate_passes(
+        mutation, [py, os.path.join("tools", "shapecheck.py")],
+        label="shapecheck")
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--stamp", action="store_true",
+                        help="rewrite the baseline from the live model")
+    parser.add_argument("--only", default=None, metavar="PREFIX",
+                        help="restrict to baseline labels with PREFIX "
+                             "(e.g. packing/)")
+    parser.add_argument("--self-test-mutation", action="store_true",
+                        help="prove the gate catches a planted f32 "
+                             "upcast the static tiers miss")
+    args = parser.parse_args(argv)
+    if args.self_test_mutation:
+        return self_test_mutation()
+    return run_gate(stamp=args.stamp, only=args.only)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

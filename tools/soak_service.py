@@ -213,24 +213,55 @@ def main_kill():
         if THREADS:
             # the crash cases run in child processes, so the thread
             # stress gets its own in-process service per seed
-            service = SchedulerService(num_rounds=2, k_choices=4)
-            service._sleep = lambda _s: None
+            service = make_service(num_rounds=2, k_choices=4)
             service.publish(synthetic.full_gate_cluster(
                 N, seed=i, num_quotas=8, num_gangs=8))
             pods = synthetic.full_gate_pods(
                 P, N, seed=i + 500, num_quotas=8, num_gangs=8)
             bad += stress_threads(service, pods, i, THREADS)
+            bad += check_health(service, i,
+                                lambda msg: print(msg, flush=True))
     print(f"KILL SOAK DONE: {N_SEEDS} seeds, {bad} violations",
           flush=True)
     return 1 if bad else 0
+
+
+def make_service(**kw):
+    """A soak service with the koordcost health plane attached: memwatch
+    plus a LATENCY-ONLY SloTracker — the soak plants impossible pods on
+    purpose, so the placement_success objective would burn its budget by
+    design; cycle latency and the leak sentinel are the signals that
+    must stay green across every seed."""
+    from koordinator_tpu.metrics import Registry
+    from koordinator_tpu.obs.slo import DEFAULT_OBJECTIVES, SloTracker
+    from koordinator_tpu.scheduler.metrics_defs import SchedulerMetrics
+
+    metrics = SchedulerMetrics(Registry())
+    latency = tuple(o for o in DEFAULT_OBJECTIVES if o.kind == "latency")
+    service = SchedulerService(
+        metrics=metrics, memwatch=True,
+        slo=SloTracker(metrics, objectives=latency), **kw)
+    service._sleep = lambda _s: None
+    return service
+
+
+def check_health(service, seed, report):
+    """One green-or-fail verdict per seed: every SLO objective inside
+    budget and zero leak-sentinel events across the soak's cycles."""
+    health = service.health()
+    if health["ok"] and health["leakEvents"] == 0:
+        return 0
+    report(f"seed {seed}: HEALTH NOT GREEN: ok={health['ok']} "
+           f"leaks={health['leakEvents']} "
+           f"budget={health['budgetRemaining']}")
+    return 1
 
 
 def main():
     bad = 0
     for i in range(N_SEEDS):
         rng = np.random.default_rng(i)
-        service = SchedulerService(num_rounds=2, k_choices=4)
-        service._sleep = lambda _s: None
+        service = make_service(num_rounds=2, k_choices=4)
         snap = synthetic.full_gate_cluster(
             N, seed=i, num_quotas=8, num_gangs=8)
         pods = synthetic.full_gate_pods(P, N, seed=i + 500,
@@ -266,6 +297,8 @@ def main():
             bad += 1
         if THREADS:
             bad += stress_threads(service, pods, i, THREADS)
+        bad += check_health(service, i,
+                            lambda msg: print(msg, flush=True))
         if (i + 1) % 20 == 0:
             print(f"{i + 1}/{N_SEEDS} seeds, {bad} violations",
                   flush=True)

@@ -35,7 +35,6 @@ import glob
 import gzip
 import json
 import os
-import re
 import shutil
 import sys
 import tempfile
@@ -48,7 +47,7 @@ import jax
 if os.environ.get("JAX_PLATFORMS"):
     jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
 
-from koordinator_tpu.obs import phases as obs_phases
+from koordinator_tpu.obs import hloattrib
 from koordinator_tpu.obs.trace import jsonl_record
 from koordinator_tpu.scheduler import core
 from koordinator_tpu.scheduler.plugins.loadaware import LoadAwareConfig
@@ -57,8 +56,14 @@ from koordinator_tpu.utils import synthetic
 P = int(sys.argv[1]) if len(sys.argv) > 1 else 2_000
 N = int(sys.argv[2]) if len(sys.argv) > 2 else 1_000
 
-_OP_NAME = re.compile(r'%?([\w.-]+) = [^\n]*op_name="([^"]*)"')
-_PHASE_IN_OP = re.compile(r"(koord/\w+)")
+# attribution-coverage floor over the compiled program's instructions.
+# Deliberately modest: the full parse counts EVERY instruction line —
+# parameter/constant plumbing and XLA-introduced copies carry no
+# op_name at all — and the measured flagship sits near 8% instructions
+# / 26% output bytes. The floor exists to catch the scope labels
+# silently vanishing (a named_scope refactor dropping the koord/
+# prefix), not to demand XLA annotate its own plumbing.
+MIN_INSTRUCTION_COVERAGE = 0.02
 
 
 def build_step():
@@ -76,17 +81,16 @@ def build_step():
 
 
 def instruction_phases(step, snap, pods, cfg):
-    """{hlo instruction name: phase} parsed out of the compiled
-    program's `op_name` metadata — the named_scope labels end up as
-    path components there, and the profiler's X events reuse the
-    instruction names verbatim."""
+    """{hlo instruction name: phase} plus attribution coverage, both
+    from the SHARED parser (obs.hloattrib) — the named_scope labels end
+    up as op_name path components, and the profiler's X events reuse
+    the instruction names verbatim. Using hloattrib here means this
+    sampled view and the static-cost view (obs.costmodel) join on
+    literally the same regexes and the same innermost-scope rule."""
     txt = step.lower(snap, pods, cfg).compile().as_text()
-    mapping = {}
-    for instr, op_name in _OP_NAME.findall(txt):
-        m = _PHASE_IN_OP.search(op_name)
-        if m and m.group(1) in obs_phases.KERNEL_PHASES:
-            mapping[instr] = m.group(1)
-    return mapping
+    mapping = hloattrib.instruction_phases(txt)
+    cov = hloattrib.coverage(hloattrib.attribute_bytes(txt))
+    return mapping, cov
 
 
 def capture(step, snap, pods, cfg, trace_dir):
@@ -119,42 +123,48 @@ def load_trace_events(trace_dir):
 
 
 def phase_of(event, instr2phase):
-    """Map one profiler X event to a koordtrace phase, or None. Exact
-    instruction-name join first (the CPU stream carries nothing else);
-    scope-substring match over name + string args second (TPU-style
-    captures embed the full path) — innermost (longest) phase wins
-    when scopes nest."""
+    """Map one profiler X event to a koordtrace phase, or None — the
+    shared two-step join (exact instruction-name first, scope-substring
+    over name + string args second) lives in obs.hloattrib now."""
     name = str(event.get("name", ""))
-    hit = instr2phase.get(name)
-    if hit is not None:
-        return hit
-    hay = [name]
     args = event.get("args")
-    if isinstance(args, dict):
-        hay.extend(str(v) for v in args.values())
-    best = None
-    for phase in obs_phases.KERNEL_PHASES:
-        if any(phase in h for h in hay):
-            if best is None or len(phase) > len(best):
-                best = phase
-    return best
+    extra = ([str(v) for v in args.values()]
+             if isinstance(args, dict) else [])
+    return hloattrib.phase_of_event(name, extra, instr2phase)
 
 
 def attribute(events, instr2phase):
-    """{phase: (total_duration_s, event_count)} over complete ('X')
-    events; container/metadata events carry no duration and are
-    skipped."""
+    """({phase: (total_duration_s, event_count)}, device-time coverage)
+    over complete ('X') events; container/metadata events carry no
+    duration and are skipped. Coverage counts how many duration-
+    carrying events (and how much of their device time) mapped to a
+    phase — the unmapped remainder is reported, never dropped
+    silently."""
     totals = {}
+    mapped_ev = unmapped_ev = 0
+    mapped_s = unmapped_s = 0.0
     for ev in events:
         if ev.get("ph") != "X":
             continue
+        dur_s = float(ev.get("dur", 0)) / 1e6   # trace-event us
         phase = phase_of(ev, instr2phase)
         if phase is None:
+            unmapped_ev += 1
+            unmapped_s += dur_s
             continue
-        dur_s = float(ev.get("dur", 0)) / 1e6   # trace-event us
+        mapped_ev += 1
+        mapped_s += dur_s
         tot, cnt = totals.get(phase, (0.0, 0))
         totals[phase] = (tot + dur_s, cnt + 1)
-    return totals
+    total_ev = mapped_ev + unmapped_ev
+    total_s = mapped_s + unmapped_s
+    cov = {
+        "events_total": total_ev, "events_mapped": mapped_ev,
+        "event_coverage": mapped_ev / total_ev if total_ev else 0.0,
+        "device_time_total_s": total_s, "device_time_mapped_s": mapped_s,
+        "device_time_coverage": mapped_s / total_s if total_s else 0.0,
+    }
+    return totals, cov
 
 
 def main():
@@ -164,13 +174,31 @@ def main():
           f"capture={trace_dir}", flush=True)
     try:
         step, snap, pods, cfg = build_step()
-        instr2phase = instruction_phases(step, snap, pods, cfg)
-        print(f"hlo_instructions_mapped={len(instr2phase)}", flush=True)
+        instr2phase, static_cov = instruction_phases(step, snap, pods,
+                                                     cfg)
+        print(f"hlo_instructions_mapped={len(instr2phase)} "
+              f"instruction_coverage="
+              f"{static_cov['instruction_coverage']:.3f} "
+              f"output_byte_coverage="
+              f"{static_cov['output_byte_coverage']:.3f}", flush=True)
+        if static_cov["instruction_coverage"] < MIN_INSTRUCTION_COVERAGE:
+            print(f"trace_fullgate: ATTRIBUTION COVERAGE below floor "
+                  f"({static_cov['instruction_coverage']:.3f} < "
+                  f"{MIN_INSTRUCTION_COVERAGE}) — the koord/ scope "
+                  f"labels are not reaching op_name metadata",
+                  flush=True)
+            return 1
         placed = capture(step, snap, pods, cfg, trace_dir)
         events = load_trace_events(trace_dir)
-        totals = attribute(events, instr2phase)
+        totals, ev_cov = attribute(events, instr2phase)
         print(f"placed={placed} profiler_events={len(events)} "
-              f"attributed_phases={len(totals)}", flush=True)
+              f"attributed_phases={len(totals)} "
+              f"events_mapped={ev_cov['events_mapped']}"
+              f"/{ev_cov['events_total']} "
+              f"device_time_mapped="
+              f"{ev_cov['device_time_mapped_s'] * 1e3:.3f}ms"
+              f"/{ev_cov['device_time_total_s'] * 1e3:.3f}ms",
+              flush=True)
         if not totals:
             print("trace_fullgate: no phase-attributed events in this "
                   "backend's capture (empty capture is a backend "
